@@ -1,0 +1,206 @@
+"""AMP — automatic mixed precision.
+
+Reference parity: python/paddle/amp (auto_cast with WHITE/BLACK lists
+dygraph/amp/auto_cast.py:27-52; GradScaler grad_scaler.py:20 ← AmpScaler
+loss_scaler.py:28 with dynamic loss scaling driven by
+check_finite_and_unscale + update_loss_scaling ops, operators/amp/).
+
+TPU-native notes: bf16 is the native mixed-precision dtype — it shares fp32's
+exponent range, so loss scaling is mathematically unnecessary; GradScaler
+keeps full API parity (dynamic scale bookkeeping included) and is a cheap
+no-op-ish path when dtype='bfloat16'.
+"""
+import contextlib
+
+import jax.numpy as jnp
+
+from ..core import dtypes
+from ..core.tensor import Tensor
+from ..core.autograd import no_grad
+
+# Parity: dygraph/amp/auto_cast.py:27-52
+WHITE_LIST = {'conv2d', 'matmul', 'matmul_v2', 'mul', 'linear',
+              'fused_attention'}
+BLACK_LIST = {'exp', 'square', 'log', 'mean', 'sum', 'cos_sim', 'softmax',
+              'softmax_with_cross_entropy', 'sigmoid_cross_entropy_with_logits',
+              'cross_entropy', 'cross_entropy2', 'reduce_sum',
+              'reduce_mean', 'layer_norm', 'batch_norm'}
+
+_amp_state = {'enabled': False, 'dtype': jnp.bfloat16, 'level': 'O1',
+              'custom_white': set(), 'custom_black': set()}
+
+
+def amp_state():
+    return _amp_state
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level='O1', dtype='bfloat16'):
+    """Parity: paddle.amp.auto_cast. Ops in the white list run in bf16/fp16;
+    black-list ops run fp32; others follow their inputs (O1). O2 casts
+    everything except black-list."""
+    saved = dict(_amp_state)
+    _amp_state.update(
+        enabled=enable, level=level,
+        dtype=dtypes.convert_dtype(dtype),
+        custom_white=set(custom_white_list or ()),
+        custom_black=set(custom_black_list or ()))
+    try:
+        yield
+    finally:
+        _amp_state.update(saved)
+
+
+amp_guard = auto_cast
+
+
+def _should_cast_to_low(op_name):
+    if not _amp_state['enabled']:
+        return None
+    white = (WHITE_LIST | _amp_state['custom_white']) - _amp_state['custom_black']
+    black = (BLACK_LIST | _amp_state['custom_black']) - _amp_state['custom_white']
+    if op_name in white:
+        return True
+    if op_name in black:
+        return False
+    if _amp_state['level'] == 'O2':
+        return True
+    return None  # follow inputs
+
+
+def maybe_autocast_args(op_name, tensors):
+    """Called from the op layer: cast float inputs per the amp lists."""
+    decision = _should_cast_to_low(op_name)
+    if decision is None:
+        return tensors
+    target = _amp_state['dtype'] if decision else jnp.float32
+    from ..ops import manip
+    out = []
+    for t in tensors:
+        if dtypes.is_floating(t.data.dtype) and t.data.dtype != target:
+            out.append(manip.cast(t, target))
+        else:
+            out.append(t)
+    return out
+
+
+class GradScaler:
+    """Parity: paddle.amp.GradScaler (grad_scaler.py:20 / AmpScaler
+    loss_scaler.py:28): dynamic loss scaling with incr/decr_every_n."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.**15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n = incr_every_n_steps
+        self._decr_every_n = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._unscaled = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return self._scale
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        from ..ops import math as M
+        return M.scale(var, self._scale)
+
+    def unscale_(self, optimizer):
+        """Parity: check_finite_and_unscale (operators/amp/...cc:138)."""
+        if not self._enable or self._unscaled:
+            return
+        params = optimizer._parameter_list or []
+        found = False
+        inv = 1.0 / self._scale
+        for p in params:
+            if p.grad is None:
+                continue
+            g = p.grad.data.astype(jnp.float32) * inv
+            found = found | bool(jnp.any(~jnp.isfinite(g)))
+            p.grad.data = g.astype(p.grad.dtype)
+        self._found_inf = bool(found)
+        self._unscaled = True
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self._update()
+        self._unscaled = False
+
+    def minimize(self, optimizer, scaled_loss):
+        self.step(optimizer)
+        return [], []
+
+    def update(self):
+        pass  # folded into step() like AmpScaler.minimize
+
+    def _update(self):
+        """Parity: update_loss_scaling op."""
+        if not self._dynamic:
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def state_dict(self):
+        return {'scale': self._scale, 'incr_ratio': self._incr_ratio,
+                'decr_ratio': self._decr_ratio,
+                'incr_every_n_steps': self._incr_every_n,
+                'decr_every_n_nan_or_inf': self._decr_every_n,
+                'good_steps': self._good_steps, 'bad_steps': self._bad_steps,
+                'use_dynamic_loss_scaling': self._dynamic}
+
+    def set_state_dict(self, sd):
+        self._scale = sd.get('scale', self._scale)
+        self._good_steps = sd.get('good_steps', 0)
+        self._bad_steps = sd.get('bad_steps', 0)
+
+
+def decorate(models=None, optimizers=None, level='O2', dtype='bfloat16',
+             master_weight=None, save_dtype=None):
+    """Parity: paddle.amp.decorate — casts model params to the amp dtype for
+    O2 (pure bf16/fp16) training; optimizers keep fp32 master weights."""
+    target = dtypes.convert_dtype(dtype)
+    def _cast_model(m):
+        for p in m.parameters():
+            if dtypes.is_floating(p.dtype):
+                p.data = p.data.astype(target)
+        return m
+    if models is None:
+        return None
+    single_model = not isinstance(models, (list, tuple))
+    ms = [models] if single_model else list(models)
+    ms = [_cast_model(m) for m in ms]
+    if optimizers is None:
+        return ms[0] if single_model else ms
+    return (ms[0] if single_model else ms), optimizers

@@ -1,0 +1,82 @@
+"""paddle_tpu.autograd — PyLayer + backward.
+
+Reference parity: python/paddle/autograd (py_layer.py:21 PyLayer — user
+fwd/bwd, the substrate for recompute) and paddle.autograd.backward.
+"""
+from ..core.autograd import backward as _backward, no_grad, enable_grad
+from ..core.autograd import record, run_op
+from ..core.tensor import Tensor
+from ..framework import grad
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    _backward(tensors, grad_tensors, retain_graph)
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = []
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    def saved_tensor(self):
+        return self._saved
+
+
+class PyLayer:
+    """Parity: paddle.autograd.PyLayer (py_layer.py:21/192).
+
+    Subclass with @staticmethod forward(ctx, *args) / backward(ctx, *grads);
+    apply() records one tape node whose vjp calls user backward.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..core import autograd as ag
+        ctx = PyLayerContext()
+        tensor_args = [a for a in args if isinstance(a, Tensor)]
+        out = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(out, (tuple, list))
+        outs = list(out) if multi else [out]
+
+        needs = [not t.stop_gradient for t in tensor_args]
+        if ag.grad_enabled() and any(needs):
+            def vjp_fn(cts):
+                cts_list = list(cts) if isinstance(cts, tuple) else [cts]
+                ct_tensors = [Tensor(c, stop_gradient=True)
+                              for c in cts_list]
+                with no_grad():
+                    gin = cls.backward(ctx, *ct_tensors)
+                gin = list(gin) if isinstance(gin, (tuple, list)) else [gin]
+                out_grads = []
+                gi = iter(gin)
+                for a in tensor_args:
+                    g = next(gi, None)
+                    out_grads.append(None if g is None else g.data)
+                return out_grads
+
+            detached = []
+            for t in outs:
+                nt = Tensor(t.data, stop_gradient=False)
+                detached.append(nt)
+            record(cls.__name__, lambda ct: vjp_fn(ct), tensor_args, needs,
+                   detached)
+            outs = detached
+        return tuple(outs) if multi else outs[0]
+
+
+class PyLayerContext_:  # legacy alias
+    pass
+
+
+LegacyPyLayer = PyLayer

@@ -1,0 +1,280 @@
+"""Eager autograd engine: a Wengert-list tape over `jax.vjp`.
+
+Reference parity: paddle/fluid/imperative — `Tracer::TraceOp` (tracer.cc:144)
+records an OpBase grad node per executed op; `BasicEngine::Execute`
+(basic_engine.cc:305) walks the grad graph topologically; GradientAccumulator
+sums multi-consumer grads. The TPU-native design replaces per-op hand-written
+grad kernels with `jax.vjp`: every traced op captures a vjp closure (residuals
+live on device), and `backward()` replays closures in reverse creation order —
+a Wengert list, which is already a valid topological order because an op's
+inputs always precede it.
+
+Grad accumulation into leaf `.grad` matches paddle's accumulate-until-
+`clear_grad` semantics (gradient_accumulator.cc).
+"""
+import contextlib
+import weakref
+
+import jax
+import jax.numpy as jnp
+
+from . import dtypes
+
+_grad_enabled = True
+_node_counter = 0
+
+# Installed by paddle_tpu.static.enable_static(): fn(name, fn, args, kwargs)
+# that records the op into the current Program instead of executing it.
+STATIC_RECORD_HOOK = None
+
+
+def grad_enabled():
+    return _grad_enabled
+
+
+@contextlib.contextmanager
+def no_grad():
+    """Parity: paddle.no_grad."""
+    global _grad_enabled
+    saved = _grad_enabled
+    _grad_enabled = False
+    try:
+        yield
+    finally:
+        _grad_enabled = saved
+
+
+@contextlib.contextmanager
+def enable_grad():
+    global _grad_enabled
+    saved = _grad_enabled
+    _grad_enabled = True
+    try:
+        yield
+    finally:
+        _grad_enabled = saved
+
+
+class Node:
+    """One executed op on the tape.
+
+    Holds the vjp closure, strong refs to input Tensors (so leaf params stay
+    alive), and weak refs to outputs (so dead activations break the chain).
+    """
+    __slots__ = ('id', 'name', 'vjp_fn', 'inputs', 'input_needs_grad',
+                 'outputs', 'out_meta', 'n_outputs', '__weakref__')
+
+    def __init__(self, name, vjp_fn, inputs, input_needs_grad, outputs):
+        global _node_counter
+        _node_counter += 1
+        self.id = _node_counter
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs                  # list[Tensor]
+        self.input_needs_grad = input_needs_grad  # list[bool]
+        self.outputs = [weakref.ref(t) for t in outputs]
+        self.out_meta = [(t.data.shape, t.data.dtype) for t in outputs]
+        self.n_outputs = len(outputs)
+
+
+def record(name, vjp_fn, inputs, input_needs_grad, outputs):
+    node = Node(name, vjp_fn, inputs, input_needs_grad, outputs)
+    for t in outputs:
+        t._node = node
+    return node
+
+
+def _accumulate(slot, idx, value):
+    if slot[idx] is None:
+        slot[idx] = value
+    else:
+        slot[idx] = slot[idx] + value
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False, capture=None,
+             accumulate_leaves=None):
+    """Run reverse-mode over the tape from `tensors`.
+
+    Parity: paddle.autograd.backward / Tensor.backward →
+    BasicEngine::Execute (basic_engine.cc:305). When `capture` (a dict
+    id(tensor)->None) is given, grads reaching those tensors are stored there
+    and leaf `.grad` fields are left untouched — the PartialGradEngine mode
+    used by paddle.grad (partial_grad_engine.cc).
+    """
+    from .tensor import Tensor
+    if accumulate_leaves is None:
+        accumulate_leaves = capture is None
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+
+    # node_id -> (node, [cotangent per output])
+    pending = {}
+    roots = []
+
+    def leaf_store(t, g):
+        if capture is not None and id(t) in capture:
+            capture[id(t)] = g if capture[id(t)] is None else capture[id(t)] + g
+        elif accumulate_leaves:
+            _leaf_accumulate(t, g)
+
+    def seed_grad(t, g):
+        if capture is not None and id(t) in capture and t._node is None:
+            leaf_store(t, g)
+            return
+        if t._node is not None:
+            node = t._node
+            entry = pending.get(node.id)
+            if entry is None:
+                entry = (node, [None] * node.n_outputs)
+                pending[node.id] = entry
+            for i, ref in enumerate(node.outputs):
+                if ref() is t:
+                    _accumulate(entry[1], i, g)
+                    break
+        elif not t.stop_gradient:
+            leaf_store(t, g)
+
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient and t._node is None:
+            continue
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "backward() on a non-scalar tensor requires grad_tensors")
+            garr = jnp.ones_like(t.data)
+        else:
+            garr = g.data if isinstance(g, Tensor) else jnp.asarray(g)
+        seed_grad(t, garr)
+        roots.append(t)
+
+    # Process nodes in decreasing creation id — a valid reverse topological
+    # order for a Wengert list.
+    while pending:
+        nid = max(pending)
+        node, cotangents = pending.pop(nid)
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                f"autograd: grad graph through op '{node.name}' was already "
+                "released; pass retain_graph=True to backward()")
+        cts = []
+        for i, (shape, dt) in enumerate(node.out_meta):
+            ct = cotangents[i]
+            if ct is None:
+                ct = jnp.zeros(shape, dt)
+            cts.append(ct)
+        in_grads = node.vjp_fn(tuple(cts) if node.n_outputs > 1 else cts[0])
+        for t, needs, g in zip(node.inputs, node.input_needs_grad, in_grads):
+            if not needs or g is None:
+                continue
+            if capture is not None and id(t) in capture:
+                leaf_store(t, g)
+            if t._node is not None:
+                prev = pending.get(t._node.id)
+                if prev is None:
+                    prev = (t._node, [None] * t._node.n_outputs)
+                    pending[t._node.id] = prev
+                for i, ref in enumerate(t._node.outputs):
+                    if ref() is t:
+                        _accumulate(prev[1], i, g)
+                        break
+            elif not t.stop_gradient:
+                if (capture is None or accumulate_leaves) and \
+                        not (capture is not None and id(t) in capture):
+                    _leaf_accumulate(t, g)
+
+    if not retain_graph:
+        for t in roots:
+            _release_graph(t)
+
+
+def _leaf_accumulate(t, g):
+    from .tensor import Tensor
+    if g.dtype != t.data.dtype:
+        g = g.astype(t.data.dtype)
+    if t.grad is None:
+        t.grad = Tensor(g, stop_gradient=True)
+    else:
+        t.grad = Tensor(t.grad.data + g, stop_gradient=True)
+
+
+def _release_graph(root):
+    """Drop vjp closures reachable from root so residuals free."""
+    stack = [root._node] if root._node is not None else []
+    seen = set()
+    while stack:
+        node = stack.pop()
+        if node is None or node.id in seen:
+            continue
+        seen.add(node.id)
+        node.vjp_fn = None
+        for t in node.inputs:
+            if t._node is not None and t._node.vjp_fn is not None:
+                stack.append(t._node)
+        node.inputs = []
+    root._node = None
+
+
+def run_op(name, fn, tensor_args, static_kwargs=None, n_nondiff=0):
+    """Execute op `fn` over Tensor args; record a tape node if needed.
+
+    `fn(*arrays, **static_kwargs)` must be a jax-traceable function.
+    `n_nondiff` trailing tensor args are passed through without vjp (e.g.
+    integer index tensors).
+    """
+    from .tensor import Tensor
+    static_kwargs = static_kwargs or {}
+
+    # Static-graph mode: record instead of execute (parity with the
+    # dual dygraph/static dispatch in python/paddle/fluid/framework.py).
+    # The hook is installed by paddle_tpu.static.enable_static().
+    if STATIC_RECORD_HOOK is not None:
+        return STATIC_RECORD_HOOK(name, fn, tensor_args, static_kwargs)
+
+    arrs = tuple(t.data for t in tensor_args)
+
+    diff_mask = []
+    for i, t in enumerate(tensor_args):
+        ok = (i < len(tensor_args) - n_nondiff
+              and dtypes.is_floating(t.data.dtype))
+        diff_mask.append(ok)
+
+    needs = [diff_mask[i] and not t.stop_gradient
+             for i, t in enumerate(tensor_args)]
+    trace = _grad_enabled and any(needs)
+
+    if trace:
+        diff_idx = [i for i, d in enumerate(diff_mask) if d]
+        const_idx = [i for i, d in enumerate(diff_mask) if not d]
+        const_arrs = [arrs[i] for i in const_idx]
+
+        def closed(*diff_arrs):
+            full = [None] * len(arrs)
+            for j, i in enumerate(diff_idx):
+                full[i] = diff_arrs[j]
+            for j, i in enumerate(const_idx):
+                full[i] = const_arrs[j]
+            return fn(*full, **static_kwargs)
+
+        out, vjp_fn = jax.vjp(closed, *[arrs[i] for i in diff_idx])
+
+        def full_vjp(ct, _vjp=vjp_fn, _dix=tuple(diff_idx), _n=len(arrs)):
+            partial = _vjp(ct)
+            full = [None] * _n
+            for j, i in enumerate(_dix):
+                full[i] = partial[j]
+            return full
+    else:
+        out = fn(*arrs, **static_kwargs)
+        full_vjp = None
+
+    multi = isinstance(out, (tuple, list))
+    outs = list(out) if multi else [out]
+    out_tensors = [Tensor(o, stop_gradient=not trace) for o in outs]
+
+    if trace:
+        record(name, full_vjp, list(tensor_args), needs, out_tensors)
+    return tuple(out_tensors) if multi else out_tensors[0]

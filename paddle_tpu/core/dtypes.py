@@ -1,0 +1,54 @@
+"""Dtype registry mapping paddle-style dtype names to JAX dtypes.
+
+Reference parity: paddle/fluid/framework/framework.proto VarType (:106) enumerates
+the dtype vocabulary; python/paddle/fluid/data_feeder.py convert_dtype does the
+string mapping. Here dtypes are plain numpy/jax dtypes with paddle-style aliases.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_STR2DTYPE = {
+    'bool': bool_, 'uint8': uint8, 'int8': int8, 'int16': int16,
+    'int32': int32, 'int64': int64, 'float16': float16, 'bfloat16': bfloat16,
+    'float32': float32, 'float64': float64, 'complex64': complex64,
+    'complex128': complex128,
+}
+
+_FLOATS = {jnp.dtype(d) for d in (float16, bfloat16, float32, float64)}
+
+
+def convert_dtype(dtype):
+    """Normalize a dtype spec (str / np.dtype / jnp dtype) to a numpy dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _STR2DTYPE:
+            raise TypeError(f"Unsupported dtype string: {dtype!r}")
+        return jnp.dtype(_STR2DTYPE[dtype])
+    return jnp.dtype(dtype)
+
+
+def dtype_name(dtype):
+    d = jnp.dtype(dtype)
+    return d.name
+
+
+def is_floating(dtype):
+    return jnp.dtype(dtype) in _FLOATS or jnp.issubdtype(jnp.dtype(dtype), np.floating)
+
+
+def is_integer(dtype):
+    return jnp.issubdtype(jnp.dtype(dtype), np.integer)

@@ -1,0 +1,300 @@
+"""paddle_tpu.Tensor — eager tensor wrapping a jax.Array.
+
+Reference parity: the dygraph VarBase (paddle/fluid/imperative/layer.h) with
+paddle's Tensor method surface (python/paddle/fluid/dygraph/math_op_patch.py and
+python/paddle/tensor/*). Device memory, layout, and transfers are owned by
+jax/PJRT; autograd is the tape in core/autograd.py.
+
+`stop_gradient` defaults to True like paddle's dygraph VarBase; parameters are
+created with stop_gradient=False.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import autograd, dtypes
+
+
+class Tensor:
+    __slots__ = ('_data', 'stop_gradient', 'grad', '_node', 'name',
+                 'persistable', 'is_distributed', '__weakref__', '__dict__')
+
+    def __init__(self, data, dtype=None, stop_gradient=True, name=None):
+        if isinstance(data, Tensor):
+            data = data._data
+        if dtype is not None:
+            dtype = dtypes.convert_dtype(dtype)
+        if isinstance(data, (jnp.ndarray, jax.Array)) or hasattr(data, 'aval'):
+            self._data = data if dtype is None else data.astype(dtype)
+        else:
+            arr = np.asarray(data)
+            if dtype is None and arr.dtype == np.float64:
+                dtype = jnp.float32  # paddle default fp32
+            self._data = jnp.asarray(arr, dtype=dtype)
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._node = None
+        self.name = name
+        self.persistable = False
+        self.is_distributed = False
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def data(self):
+        return self._data
+
+    @data.setter
+    def data(self, value):
+        self._data = value._data if isinstance(value, Tensor) else value
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def T(self):
+        from .. import ops
+        return ops.manip.transpose(self, list(range(self.ndim))[::-1])
+
+    @property
+    def place(self):
+        try:
+            return str(list(self._data.devices())[0])
+        except Exception:
+            return 'traced'
+
+    def numel(self):
+        return self.size
+
+    # -- conversions --------------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def item(self):
+        return self._data.item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def astype(self, dtype):
+        from .. import ops
+        return ops.manip.cast(self, dtype)
+
+    cast = astype
+
+    def cpu(self):
+        return Tensor(jax.device_get(self._data), stop_gradient=self.stop_gradient)
+
+    def pin_memory(self):
+        return self
+
+    def cuda(self, *a, **k):  # paddle API compat; TPU is the device
+        return self
+
+    # -- autograd -----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        autograd.backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    def clear_gradient(self):
+        self.grad = None
+
+    def detach(self):
+        t = Tensor(self._data, stop_gradient=True)
+        return t
+
+    def clone(self):
+        from .. import ops
+        return ops.math.assign(self)
+
+    def register_hook(self, hook):
+        raise NotImplementedError("tensor hooks land with PyLayer")
+
+    # -- in-place mutation (eager only) -------------------------------------
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._data
+        self._data = jnp.asarray(value, dtype=self._data.dtype).reshape(self._data.shape)
+        return self
+
+    def copy_(self, other):
+        return self.set_value(other)
+
+    def fill_(self, value):
+        self._data = jnp.full_like(self._data, value)
+        return self
+
+    def zero_(self):
+        self._data = jnp.zeros_like(self._data)
+        return self
+
+    def scale_(self, scale):
+        self._data = self._data * scale
+        return self
+
+    def add_(self, other):
+        o = other._data if isinstance(other, Tensor) else other
+        self._data = self._data + o
+        return self
+
+    def subtract_(self, other):
+        o = other._data if isinstance(other, Tensor) else other
+        self._data = self._data - o
+        return self
+
+    def multiply_(self, other):
+        o = other._data if isinstance(other, Tensor) else other
+        self._data = self._data * o
+        return self
+
+    def clip_(self, min=None, max=None):
+        self._data = jnp.clip(self._data, min, max)
+        return self
+
+    # -- indexing -----------------------------------------------------------
+    def __getitem__(self, idx):
+        from .. import ops
+        return ops.manip.getitem(self, idx)
+
+    def __setitem__(self, idx, value):
+        v = value._data if isinstance(value, Tensor) else value
+        self._data = self._data.at[idx].set(v)
+
+    def __len__(self):
+        if not self._data.shape:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # -- repr ---------------------------------------------------------------
+    def __repr__(self):
+        try:
+            body = repr(np.asarray(self._data))
+        except Exception:
+            body = f"<traced {self._data.shape} {self._data.dtype}>"
+        return (f"Tensor(shape={self.shape}, dtype={dtypes.dtype_name(self.dtype)}, "
+                f"stop_gradient={self.stop_gradient},\n       {body})")
+
+    def __bool__(self):
+        return bool(self._data)
+
+    def __int__(self):
+        return int(self._data)
+
+    def __float__(self):
+        return float(self._data)
+
+    def __hash__(self):
+        return id(self)
+
+
+def _install_operators():
+    """Patch arithmetic dunders onto Tensor (parity: math_op_patch.py)."""
+    from .. import ops
+    m = ops.math
+
+    def binop(fn, swap=False):
+        def impl(self, other):
+            if swap:
+                return fn(other, self)
+            return fn(self, other)
+        return impl
+
+    Tensor.__add__ = binop(m.add)
+    Tensor.__radd__ = binop(m.add, swap=True)
+    Tensor.__sub__ = binop(m.subtract)
+    Tensor.__rsub__ = binop(m.subtract, swap=True)
+    Tensor.__mul__ = binop(m.multiply)
+    Tensor.__rmul__ = binop(m.multiply, swap=True)
+    Tensor.__truediv__ = binop(m.divide)
+    Tensor.__rtruediv__ = binop(m.divide, swap=True)
+    Tensor.__floordiv__ = binop(m.floor_divide)
+    Tensor.__mod__ = binop(m.remainder)
+    Tensor.__pow__ = binop(m.pow)
+    Tensor.__rpow__ = binop(m.pow, swap=True)
+    Tensor.__matmul__ = binop(m.matmul)
+    Tensor.__neg__ = lambda self: m.scale(self, -1.0)
+    Tensor.__abs__ = lambda self: m.abs(self)
+    Tensor.__eq__ = binop(m.equal)
+    Tensor.__ne__ = binop(m.not_equal)
+    Tensor.__lt__ = binop(m.less_than)
+    Tensor.__le__ = binop(m.less_equal)
+    Tensor.__gt__ = binop(m.greater_than)
+    Tensor.__ge__ = binop(m.greater_equal)
+    Tensor.__invert__ = lambda self: m.logical_not(self)
+
+    # Method surface (subset mirrored from python/paddle/tensor/__init__.py).
+    method_table = {
+        'add': m.add, 'subtract': m.subtract, 'multiply': m.multiply,
+        'divide': m.divide, 'matmul': m.matmul, 'pow': m.pow, 'abs': m.abs,
+        'exp': m.exp, 'log': m.log, 'sqrt': m.sqrt, 'rsqrt': m.rsqrt,
+        'square': m.square, 'sin': m.sin, 'cos': m.cos, 'tanh': m.tanh,
+        'sigmoid': m.sigmoid, 'floor': m.floor, 'ceil': m.ceil,
+        'round': m.round, 'sign': m.sign, 'reciprocal': m.reciprocal,
+        'sum': m.sum, 'mean': m.mean, 'max': m.max, 'min': m.min,
+        'prod': m.prod, 'argmax': m.argmax, 'argmin': m.argmin,
+        'argsort': m.argsort, 'sort': m.sort, 'topk': m.topk,
+        'cumsum': m.cumsum, 'clip': m.clip, 'scale': m.scale,
+        'maximum': m.maximum, 'minimum': m.minimum, 'equal': m.equal,
+        'not_equal': m.not_equal, 'less_than': m.less_than,
+        'less_equal': m.less_equal, 'greater_than': m.greater_than,
+        'greater_equal': m.greater_equal, 'equal_all': m.equal_all,
+        'allclose': m.allclose, 'isnan': m.isnan, 'isinf': m.isinf,
+        'isfinite': m.isfinite, 'logical_and': m.logical_and,
+        'logical_or': m.logical_or, 'logical_not': m.logical_not,
+        'logical_xor': m.logical_xor, 'norm': m.norm, 'dot': m.dot,
+        'dist': m.dist, 'floor_divide': m.floor_divide,
+        'remainder': m.remainder, 'mod': m.remainder, 'kron': m.kron,
+        'erf': m.erf, 'lgamma': m.lgamma, 'digamma': m.digamma,
+        'trunc': m.trunc, 'log2': m.log2, 'log10': m.log10,
+        'log1p': m.log1p, 'expm1': m.expm1, 'any': m.any, 'all': m.all,
+        'mm': m.matmul, 'bmm': m.bmm, 'inner': m.inner, 'outer': m.outer,
+        'median': m.median, 'mode': m.mode, 'nonzero': m.nonzero,
+        'std': m.std, 'var': m.var, 'bitwise_and': m.bitwise_and,
+        'bitwise_or': m.bitwise_or, 'bitwise_xor': m.bitwise_xor,
+        'bitwise_not': m.bitwise_not,
+    }
+    mp = ops.manip
+    method_table.update({
+        'reshape': mp.reshape, 'transpose': mp.transpose,
+        'squeeze': mp.squeeze, 'unsqueeze': mp.unsqueeze,
+        'flatten': mp.flatten, 'split': mp.split, 'chunk': mp.chunk,
+        'concat_with': None, 'tile': mp.tile, 'expand': mp.expand,
+        'expand_as': mp.expand_as, 'flip': mp.flip, 'roll': mp.roll,
+        'gather': mp.gather, 'gather_nd': mp.gather_nd,
+        'scatter': mp.scatter, 'index_select': mp.index_select,
+        'masked_select': mp.masked_select, 'slice': mp.slice,
+        'unbind': mp.unbind, 'broadcast_to': mp.broadcast_to,
+        'tril': mp.tril, 'triu': mp.triu, 'where_self': None,
+        'unstack': mp.unstack, 'unique': mp.unique,
+        'index_sample': mp.index_sample, 'diagonal': mp.diagonal,
+    })
+    for name, fn in method_table.items():
+        if fn is not None:
+            setattr(Tensor, name, fn)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """Parity: paddle.to_tensor."""
+    return Tensor(data, dtype=dtype, stop_gradient=stop_gradient)

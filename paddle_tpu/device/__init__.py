@@ -1,0 +1,69 @@
+"""paddle_tpu.device — device queries.
+
+Reference parity: python/paddle/device + platform/device_context (N1). Device
+lifetime is owned by PJRT through jax; this module exposes the paddle-shaped
+query surface.
+"""
+import jax
+
+from ..framework import set_device, get_device, CPUPlace, CUDAPlace, TPUPlace
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_all_custom_device_type():
+    return ['tpu']
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return get_available_device()
+
+
+def device_count():
+    return jax.device_count()
+
+
+def local_device_count():
+    return jax.local_device_count()
+
+
+def is_compiled_with_cinn():
+    return False
+
+
+def XPUPlace(idx=0):
+    return TPUPlace(idx)
+
+
+class cuda:
+    """paddle.device.cuda namespace compat (maps to the TPU device)."""
+
+    @staticmethod
+    def device_count():
+        return jax.device_count()
+
+    @staticmethod
+    def synchronize(device=None):
+        import jax
+        for d in jax.live_arrays():
+            d.block_until_ready()
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        stats = jax.devices()[0].memory_stats() or {}
+        return stats.get('peak_bytes_in_use', 0)
+
+    @staticmethod
+    def memory_allocated(device=None):
+        stats = jax.devices()[0].memory_stats() or {}
+        return stats.get('bytes_in_use', 0)
+
+
+def synchronize():
+    cuda.synchronize()

@@ -1,0 +1,23 @@
+"""paddle_tpu.distributed — collective API + fleet.
+
+Reference parity: python/paddle/distributed/__init__.py surface
+(SURVEY.md §1-L8).
+"""
+from .env import (ParallelEnv, get_rank, get_world_size, is_initialized,
+                  parallel_env)
+from .collective import (ReduceOp, Group, new_group, get_group,
+                         init_parallel_env, destroy_process_group, wait,
+                         barrier, all_reduce, reduce, broadcast, all_gather,
+                         reduce_scatter, scatter, alltoall, alltoall_single,
+                         send, recv, isend, irecv, ppermute, shift, split,
+                         spmd_region, in_spmd_region,
+                         _c_identity, _mp_allreduce, _c_concat, _c_split,
+                         _c_softmax_with_cross_entropy, _c_embedding)
+from .parallel import DataParallel, spawn
+from . import topology_runtime
+from . import fleet
+from . import utils
+
+
+def get_backend():
+    return 'xla'

@@ -1,0 +1,521 @@
+"""Collective communication API.
+
+Reference parity: python/paddle/distributed/collective.py (new_group:209,
+all_reduce:415, broadcast:348, all_gather:589, scatter:667, alltoall:1456,
+send:1528, recv:1578, barrier:167, and the model-parallel helpers
+_c_identity:748.._parallel_embedding:1178, split:1283) over the C++
+operators/collective/ op zoo (N24) and NCCLCommContext ring registry (N7).
+
+TPU-native design — the ring_id→ncclComm map becomes a Group→mesh-axis map:
+  * Inside an SPMD region (a shard_map/pjit trace entered via
+    paddle_tpu.distributed.spmd or the fleet engines), each collective lowers
+    to the XLA collective on the group's mesh axes: psum → AllReduce over ICI,
+    all_gather → AllGather, reduce_scatter → ReduceScatter, alltoall →
+    AllToAll, send/recv → CollectivePermute. XLA assigns channel ids — the
+    TPU analogue of ring ids.
+  * Outside (pure eager, single process): world_size==1 ⇒ collectives are
+    identities, matching the reference's degenerate behavior.
+"""
+import contextlib
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor
+from ..core.autograd import run_op
+from .env import parallel_env, get_rank, get_world_size
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+class Group:
+    """Parity: collective.py Group — here it names mesh axes instead of an
+    NCCL ring (A.3c's magic ring-id ints become axis names)."""
+
+    _next_id = 0
+
+    def __init__(self, rank, nranks, id=None, ranks=None, axis_name=None):
+        self.rank = rank
+        self.nranks = nranks
+        if id is None:
+            id = Group._next_id
+        Group._next_id = max(Group._next_id + 1, id + 1)
+        self.id = id
+        self.ranks = ranks or list(range(nranks))
+        self.axis_name = axis_name  # mesh axis (str or tuple) in SPMD regions
+
+    def is_member(self):
+        return self.rank >= 0
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    @property
+    def process_group(self):
+        return self
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def __repr__(self):
+        return (f"Group(id={self.id}, nranks={self.nranks}, "
+                f"axis={self.axis_name})")
+
+
+_default_group = None
+_group_map = {}
+
+# ---- SPMD region bookkeeping ------------------------------------------------
+_spmd_axes = []  # stack of tuples of active mesh axis names
+
+
+@contextlib.contextmanager
+def spmd_region(axis_names):
+    """Mark that we are tracing inside shard_map over `axis_names`. The fleet
+    engines enter this around their per-device step functions."""
+    _spmd_axes.append(tuple(axis_names))
+    try:
+        yield
+    finally:
+        _spmd_axes.pop()
+
+
+def in_spmd_region():
+    return bool(_spmd_axes)
+
+
+def current_spmd_axes():
+    return _spmd_axes[-1] if _spmd_axes else ()
+
+
+def _group_axes(group):
+    """Resolve the mesh axes a collective should run over."""
+    if group is not None and group.axis_name is not None:
+        ax = group.axis_name
+        return ax if isinstance(ax, tuple) else (ax,)
+    return current_spmd_axes()
+
+
+# ---- init / groups ----------------------------------------------------------
+def init_parallel_env():
+    """Parity: paddle.distributed.init_parallel_env (parallel.py:58) — the
+    NCCL-id broadcast + comm init is replaced by the PJRT client handshake
+    (jax.distributed for multi-host DCN)."""
+    global _default_group
+    env = parallel_env()
+    if _default_group is None:
+        _default_group = Group(env.rank, env.world_size, id=0)
+        _group_map[0] = _default_group
+    return _default_group
+
+
+def _get_default_group():
+    if _default_group is None:
+        return init_parallel_env()
+    return _default_group
+
+
+def get_group(id=0):
+    return _group_map.get(id)
+
+
+def new_group(ranks=None, backend=None, timeout=None, axis_name=None):
+    """Parity: collective.py new_group:209 — allocates a fresh communicator
+    namespace. On TPU this is metadata only; XLA materializes the comm."""
+    env = parallel_env()
+    if ranks is None:
+        ranks = list(range(env.world_size))
+    rank = ranks.index(env.rank) if env.rank in ranks else -1
+    g = Group(rank, len(ranks), ranks=list(ranks), axis_name=axis_name)
+    _group_map[g.id] = g
+    return g
+
+
+def destroy_process_group(group=None):
+    global _default_group
+    if group is None:
+        _group_map.clear()
+        _default_group = None
+    else:
+        _group_map.pop(group.id, None)
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if isinstance(tensor, Tensor) and hasattr(tensor.data,
+                                              'block_until_ready'):
+        tensor.data.block_until_ready()
+
+
+def barrier(group=None):
+    """Parity: collective.py barrier:167."""
+    if in_spmd_region():
+        return
+    # eager: sync device
+    for d in jax.live_arrays():
+        d.block_until_ready()
+        break
+
+
+# ---- core collectives -------------------------------------------------------
+def _psum_like(arr, op, axes):
+    if op == ReduceOp.SUM:
+        return lax.psum(arr, axes)
+    if op == ReduceOp.MAX:
+        return lax.pmax(arr, axes)
+    if op == ReduceOp.MIN:
+        return lax.pmin(arr, axes)
+    if op == ReduceOp.AVG:
+        return lax.pmean(arr, axes)
+    if op == ReduceOp.PROD:
+        return lax.pprod(arr, axes) if hasattr(lax, 'pprod') else \
+            jnp.exp(lax.psum(jnp.log(arr), axes))
+    raise ValueError(f"bad reduce op {op}")
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
+               use_calc_stream=True):
+    """Parity: c_allreduce_{sum,max,min,prod} (operators/collective/
+    c_allreduce_op.h:268-301) → XLA AllReduce."""
+    axes = _group_axes(group)
+    if in_spmd_region() and axes:
+        out = run_op('c_allreduce', lambda a: _psum_like(a, op, axes),
+                     [tensor])
+        tensor._data = out._data
+        tensor._node = out._node
+        tensor.stop_gradient = out.stop_gradient
+        return tensor
+    # eager single-process: identity
+    return tensor
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Parity: c_reduce_* — on TPU SPMD all replicas hold the result; dst
+    semantics preserved at the API level."""
+    return all_reduce(tensor, op=op, group=group)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True, use_calc_stream=True):
+    """Parity: c_broadcast. In SPMD: take src's shard via a masked psum."""
+    axes = _group_axes(group)
+    if in_spmd_region() and axes:
+        def fn(a):
+            idx = _axis_index(axes)
+            masked = jnp.where(idx == src, a, jnp.zeros_like(a))
+            return lax.psum(masked, axes)
+        out = run_op('c_broadcast', fn, [tensor])
+        tensor._data = out._data
+        tensor._node = out._node
+        return tensor
+    return tensor
+
+
+def _axis_index(axes):
+    idx = lax.axis_index(axes[0])
+    size_so_far = lax.axis_size(axes[0]) if hasattr(lax, 'axis_size') else \
+        lax.psum(1, axes[0])
+    for ax in axes[1:]:
+        idx = idx * lax.psum(1, ax) + lax.axis_index(ax)
+    return idx
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True,
+               use_calc_stream=True):
+    """Parity: c_allgather → XLA AllGather. Appends per-rank shards to
+    tensor_list (paddle list-out API)."""
+    axes = _group_axes(group)
+    n = get_world_size(group)
+    if in_spmd_region() and axes:
+        out = run_op('c_allgather',
+                     lambda a: lax.all_gather(a, axes[0], tiled=False),
+                     [tensor])
+        from ..ops import manip
+        shards = manip.unstack(out, axis=0)
+        tensor_list.extend(shards)
+        return tensor_list
+    tensor_list.append(tensor)
+    return tensor_list
+
+
+def all_gather_concat(tensor, axis=0, group=None):
+    """XLA-native all_gather returning concatenated tensor (used by mp
+    layers; parity with the c_concat op)."""
+    axes = _group_axes(group)
+    if in_spmd_region() and axes:
+        return run_op('c_concat',
+                      lambda a: lax.all_gather(a, axes[0], axis=axis,
+                                               tiled=True), [tensor])
+    return tensor
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
+                   group=None, sync_op=True):
+    """Parity: c_reducescatter → XLA ReduceScatter."""
+    axes = _group_axes(group)
+    src = tensor_or_tensor_list
+    if isinstance(src, (list, tuple)):
+        from ..ops import manip
+        src = manip.concat(list(src), axis=0)
+    if in_spmd_region() and axes:
+        out = run_op('c_reducescatter',
+                     lambda a: lax.psum_scatter(a, axes[0], tiled=True),
+                     [src])
+        tensor._data = out._data
+        tensor._node = out._node
+        return tensor
+    tensor._data = src._data
+    return tensor
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    """Parity: c_scatter — each rank takes its slice of src's tensor."""
+    axes = _group_axes(group)
+    if in_spmd_region() and axes and tensor_list is not None:
+        from ..ops import manip
+        full = manip.stack(tensor_list, axis=0)
+        def fn(a):
+            idx = _axis_index(axes)
+            return jnp.take(a, idx, axis=0)
+        out = run_op('c_scatter', fn, [full])
+        tensor._data = out._data
+        return tensor
+    if tensor_list is not None:
+        tensor._data = tensor_list[src]._data
+    return tensor
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    """Parity: alltoall op → XLA AllToAll."""
+    axes = _group_axes(group)
+    from ..ops import manip
+    if isinstance(in_tensor_list, Tensor):
+        x = in_tensor_list
+        split_concat = True
+    else:
+        x = manip.stack(list(in_tensor_list), axis=0)
+        split_concat = False
+    if in_spmd_region() and axes:
+        out = run_op(
+            'alltoall',
+            lambda a: lax.all_to_all(a, axes[0], split_axis=0,
+                                     concat_axis=0, tiled=split_concat),
+            [x])
+    else:
+        out = x
+    if out_tensor_list is not None:
+        if split_concat:
+            out_tensor_list.append(out)
+        else:
+            out_tensor_list.extend(manip.unstack(out, axis=0))
+        return out_tensor_list
+    return out
+
+
+def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    out = alltoall(in_tensor, None, group=group)
+    if out_tensor is not None:
+        out_tensor._data = out._data
+        return out_tensor
+    return out
+
+
+def send(tensor, dst=0, group=None, sync_op=True, use_calc_stream=True):
+    """Parity: send_v2 → CollectivePermute toward dst (paired with recv in
+    the same SPMD program — see fleet p2p for the pipeline usage)."""
+    axes = _group_axes(group)
+    if in_spmd_region() and axes:
+        n = lax.psum(1, axes[0])
+        # materialize a permute shifting data src->dst; the matching recv
+        # reads it. Standalone eager send is host-mediated (not supported
+        # single-process).
+        return ppermute(tensor, [(get_rank(group), dst)], group)
+    return tensor
+
+
+def recv(tensor, src=0, group=None, sync_op=True, use_calc_stream=True):
+    axes = _group_axes(group)
+    if in_spmd_region() and axes:
+        out = ppermute(tensor, [(src, get_rank(group))], group)
+        tensor._data = out._data
+        tensor._node = out._node
+        return tensor
+    return tensor
+
+
+def isend(tensor, dst=0, group=None):
+    return send(tensor, dst, group, sync_op=False)
+
+
+def irecv(tensor, src=0, group=None):
+    return recv(tensor, src, group, sync_op=False)
+
+
+def ppermute(tensor, perm_pairs, group=None):
+    """XLA collective-permute (ICI neighbor exchange) — the TPU replacement
+    for NCCL p2p send/recv pairs (SURVEY.md §5.8)."""
+    axes = _group_axes(group)
+    if in_spmd_region() and axes:
+        return run_op('collective_permute',
+                      lambda a: lax.ppermute(a, axes[0], perm_pairs),
+                      [tensor])
+    return tensor
+
+
+def shift(tensor, offset=1, group=None):
+    """Ring shift along the group axis (pipeline/ring-attention building
+    block)."""
+    axes = _group_axes(group)
+    if in_spmd_region() and axes:
+        n = _axis_size(axes[0])
+        pairs = [(i, (i + offset) % n) for i in range(n)]
+        return ppermute(tensor, pairs, group)
+    return tensor
+
+
+def _axis_size(axis):
+    from . import topology_runtime
+    return topology_runtime.axis_size(axis)
+
+
+# ---- model-parallel helper ops (collective.py:748-1283 parity) -------------
+def _c_identity(tensor, group=None):
+    """Identity fwd, allreduce bwd (column-parallel input)."""
+    axes = _group_axes(group)
+    if not (in_spmd_region() and axes):
+        return tensor
+
+    @jax.custom_vjp
+    def ident(a):
+        return a
+
+    def fwd(a):
+        return a, None
+
+    def bwd(_, ct):
+        return (lax.psum(ct, axes),)
+    ident.defvjp(fwd, bwd)
+    return run_op('c_identity', ident, [tensor])
+
+
+def _mp_allreduce(tensor, group=None):
+    """Allreduce fwd, identity bwd (row-parallel output)."""
+    axes = _group_axes(group)
+    if not (in_spmd_region() and axes):
+        return tensor
+
+    @jax.custom_vjp
+    def mp_ar(a):
+        return lax.psum(a, axes)
+
+    def fwd(a):
+        return lax.psum(a, axes), None
+
+    def bwd(_, ct):
+        return (ct,)
+    mp_ar.defvjp(fwd, bwd)
+    return run_op('mp_allreduce_sum', mp_ar, [tensor])
+
+
+def _c_concat(tensor, group=None):
+    """All-gather along last dim (parity: c_concat op)."""
+    axes = _group_axes(group)
+    if not (in_spmd_region() and axes):
+        return tensor
+    return run_op('c_concat',
+                  lambda a: lax.all_gather(a, axes[0], axis=a.ndim - 1,
+                                           tiled=True), [tensor])
+
+
+def _c_split(tensor, group=None):
+    """Keep only this rank's slice of the last dim (parity: c_split op)."""
+    axes = _group_axes(group)
+    if not (in_spmd_region() and axes):
+        return tensor
+
+    def fn(a):
+        n = lax.psum(1, axes[0])
+        idx = lax.axis_index(axes[0])
+        size = a.shape[-1] // n
+        return lax.dynamic_slice_in_dim(a, idx * size, size, axis=a.ndim - 1)
+    return run_op('c_split', fn, [tensor])
+
+
+def _c_softmax_with_cross_entropy(logits, label, group=None,
+                                  ignore_index=-100):
+    """Vocab-parallel softmax CE (parity: c_softmax_with_cross_entropy op).
+    logits are sharded on the class dim across the group axis."""
+    axes = _group_axes(group)
+    if not (in_spmd_region() and axes):
+        from ..ops import nn_ops
+        return nn_ops.softmax_with_cross_entropy(logits, label)
+
+    def fn(lg, lb):
+        part = lg.shape[-1]
+        idx = lax.axis_index(axes[0])
+        vocab_start = idx * part
+        # global max for stability
+        local_max = jnp.max(lg, axis=-1, keepdims=True)
+        gmax = lax.pmax(local_max, axes)
+        shifted = lg - gmax
+        sumexp = lax.psum(jnp.sum(jnp.exp(shifted), axis=-1, keepdims=True),
+                          axes)
+        logZ = jnp.log(sumexp)
+        lb_local = lb - vocab_start
+        in_range = (lb_local >= 0) & (lb_local < part)
+        safe = jnp.clip(lb_local, 0, part - 1)
+        picked = jnp.take_along_axis(shifted, safe[..., None], axis=-1)
+        picked = jnp.where(in_range[..., None], picked, 0.0)
+        picked = lax.psum(picked, axes)
+        return (logZ - picked).reshape(lb.shape + (1,))
+    return run_op('c_softmax_with_cross_entropy', fn, [logits, label],
+                  n_nondiff=1)
+
+
+def _c_embedding(weight, x, start_index=0, group=None):
+    """Row-sharded embedding lookup (parity: c_embedding op)."""
+    axes = _group_axes(group)
+
+    def fn(w, idx):
+        local = idx - start_index
+        rows = w.shape[0]
+        in_range = (local >= 0) & (local < rows)
+        safe = jnp.clip(local, 0, rows - 1)
+        out = jnp.take(w, safe, axis=0)
+        out = jnp.where(in_range[..., None], out, 0.0)
+        if in_spmd_region() and axes:
+            out = lax.psum(out, axes)
+        return out
+    return run_op('c_embedding', fn, [weight, x], n_nondiff=1)
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Parity: paddle.distributed.split:1283 — auto row/column-parallel
+    Linear / Embedding. Returns the layer output; the underlying sharded
+    layers live in fleet.meta_parallel.parallel_layers."""
+    from .fleet.meta_parallel.parallel_layers import mp_layers
+    if operation == 'linear':
+        if axis == 0:
+            layer = mp_layers.RowParallelLinear(
+                size[0], size[1], weight_attr=weight_attr,
+                has_bias=bias_attr is not False,
+                input_is_parallel=False)
+        else:
+            layer = mp_layers.ColumnParallelLinear(
+                size[0], size[1], weight_attr=weight_attr,
+                has_bias=bias_attr is not False, gather_output=gather_out)
+        return layer(x)
+    if operation == 'embedding':
+        layer = mp_layers.VocabParallelEmbedding(size[0], size[1],
+                                                 weight_attr=weight_attr)
+        return layer(x)
+    raise ValueError(f"unsupported split operation {operation}")

@@ -1,0 +1,92 @@
+"""Distributed environment.
+
+Reference parity: python/paddle/distributed/parallel.py ParallelEnv (env-var
+driven: PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ENDPOINTS)
++ platform/gen_comm_id_helper (N8 bootstrap).
+
+TPU-native model: ONE process per host drives all local chips through PJRT
+(multi-controller across hosts via jax.distributed). "rank" therefore has two
+levels, as on real TPU pods:
+  * process rank  — jax.process_index() (host granularity, DCN)
+  * device rank   — a position in the global device mesh (chip granularity,
+    ICI); collectives inside pjit/shard_map address mesh axes, not ranks.
+The paddle-style integer rank maps to the device rank so existing fleet
+topology math (CommunicateTopology) carries over unchanged.
+"""
+import os
+
+import jax
+
+
+def _int_env(name, default):
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class ParallelEnv:
+    """Parity: paddle.distributed.ParallelEnv."""
+
+    def __init__(self):
+        self._device_id = _int_env('FLAGS_selected_tpus',
+                                   _int_env('FLAGS_selected_gpus', 0))
+
+    @property
+    def rank(self):
+        return _int_env('PADDLE_TRAINER_ID', 0)
+
+    @property
+    def world_size(self):
+        n = _int_env('PADDLE_TRAINERS_NUM', 0)
+        if n:
+            return n
+        return jax.device_count()
+
+    @property
+    def local_rank(self):
+        return self.rank
+
+    @property
+    def device_id(self):
+        return self._device_id
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get('PADDLE_CURRENT_ENDPOINT', '127.0.0.1:6170')
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get('PADDLE_TRAINER_ENDPOINTS', '')
+        return eps.split(',') if eps else [self.current_endpoint]
+
+    @property
+    def nranks(self):
+        return self.world_size
+
+
+_parallel_env = None
+
+
+def parallel_env():
+    global _parallel_env
+    if _parallel_env is None:
+        _parallel_env = ParallelEnv()
+    return _parallel_env
+
+
+def get_rank(group=None):
+    if group is not None and getattr(group, 'rank', None) is not None:
+        return group.rank
+    return parallel_env().rank
+
+
+def get_world_size(group=None):
+    if group is not None and getattr(group, 'nranks', None):
+        return group.nranks
+    return parallel_env().world_size
+
+
+def is_initialized():
+    from . import collective
+    return collective._default_group is not None

@@ -1,0 +1,51 @@
+"""paddle_tpu.distributed.fleet — module-as-singleton API.
+
+Reference parity: fleet/__init__.py:16-80 — exports Fleet /
+DistributedStrategy / role makers / topology classes, and re-binds a
+singleton `fleet = Fleet()` whose methods are module-level functions.
+"""
+from .base.fleet_base import Fleet, UtilBase
+from .base.distributed_strategy import DistributedStrategy
+from .base.role_maker import (PaddleCloudRoleMaker, UserDefinedRoleMaker,
+                              Role)
+from .base.topology import (CommunicateTopology, HybridCommunicateGroup,
+                            ParallelMode)
+from . import meta_parallel
+from . import meta_optimizers
+from . import utils
+from .meta_optimizers.dygraph_optimizer import (HybridParallelOptimizer,
+                                                DygraphShardingOptimizer,
+                                                HybridParallelGradScaler)
+from .utils.recompute import recompute
+
+fleet = Fleet()
+
+# module-level singleton methods (parity: fleet/__init__.py re-binding)
+init = fleet.init
+distributed_optimizer = fleet.distributed_optimizer
+distributed_model = fleet.distributed_model
+get_hybrid_communicate_group = fleet.get_hybrid_communicate_group
+worker_num = fleet.worker_num
+is_first_worker = fleet.is_first_worker
+is_worker = fleet.is_worker
+is_server = fleet.is_server
+worker_endpoints = fleet.worker_endpoints
+server_endpoints = fleet.server_endpoints
+server_num = fleet.server_num
+barrier_worker = fleet.barrier_worker
+init_worker = fleet.init_worker
+init_server = fleet.init_server
+run_server = fleet.run_server
+stop_worker = fleet.stop_worker
+minimize = fleet.minimize
+save_persistables = fleet.save_persistables
+save = fleet.save
+shrink = fleet.shrink
+
+
+def worker_index():
+    return fleet._role_maker.worker_index() if fleet._role_maker else 0
+
+
+def util():
+    return fleet.util

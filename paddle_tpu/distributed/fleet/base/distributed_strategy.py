@@ -1,0 +1,133 @@
+"""DistributedStrategy.
+
+Reference parity: fleet/base/distributed_strategy.py:105 over
+framework/distributed_strategy.proto:159 — the strategy object with typed
+config sub-dicts: amp, recompute, pipeline, sharding, tensor_parallel,
+hybrid_configs, gradient_merge, localsgd, lamb, lars, dgc, a_sync, asp,
+elastic... Protobuf is replaced by plain dataclass-style dicts with the same
+field names so user code ports unchanged; save_to_prototxt serializes JSON.
+"""
+import copy
+import json
+
+
+_DEFAULTS = {
+    'amp': False,
+    'amp_configs': {
+        'init_loss_scaling': 32768.0, 'incr_every_n_steps': 1000,
+        'decr_every_n_nan_or_inf': 2, 'incr_ratio': 2.0, 'decr_ratio': 0.5,
+        'use_dynamic_loss_scaling': True, 'custom_white_list': [],
+        'custom_black_list': [], 'custom_black_varnames': [],
+        'use_pure_fp16': False, 'use_fp16_guard': True, 'dtype': 'bfloat16'},
+    'recompute': False,
+    'recompute_configs': {'checkpoints': [], 'enable_offload': False,
+                          'checkpoint_shape': []},
+    'pipeline': False,
+    'pipeline_configs': {'micro_batch_size': 1, 'accumulate_steps': 1,
+                         'schedule_mode': '1F1B', 'p2p_cache_shape': True},
+    'sharding': False,
+    'sharding_configs': {
+        'sharding_segment_strategy': 'segment_broadcast_MB',
+        'segment_broadcast_MB': 32.0, 'segment_anchors': None,
+        'sharding_degree': 8, 'mp_degree': 1, 'pp_degree': 1, 'dp_degree': 1,
+        'hybrid_dp': False, 'gradient_merge_acc_step': 1,
+        'optimize_offload': False, 'stage': 1,
+        'pp_allreduce_in_optimize': False, 'optimize_cast': False},
+    'tensor_parallel': False,
+    'tensor_parallel_configs': {'tensor_parallel_degree': 1,
+                                'tensor_init_seed': -1},
+    'hybrid_configs': {'dp_degree': -1, 'mp_degree': 1, 'pp_degree': 1,
+                       'sharding_degree': 1, 'sep_degree': 1},
+    'gradient_merge': False,
+    'gradient_merge_configs': {'k_steps': 1, 'avg': True},
+    'localsgd': False,
+    'localsgd_configs': {'k_steps': 1, 'begin_step': 1},
+    'adaptive_localsgd': False,
+    'adaptive_localsgd_configs': {'init_k_steps': 1, 'begin_step': 1},
+    'dgc': False,
+    'dgc_configs': {'rampup_begin_step': 0, 'rampup_step': 1,
+                    'sparsity': [0.999]},
+    'lars': False,
+    'lars_configs': {'lars_coeff': 0.001, 'lars_weight_decay': 0.0005,
+                     'epsilon': 0, 'exclude_from_weight_decay': []},
+    'lamb': False,
+    'lamb_configs': {'lamb_weight_decay': 0.01,
+                     'exclude_from_weight_decay': []},
+    'a_sync': False,
+    'a_sync_configs': {'k_steps': -1, 'max_merge_var_num': 1,
+                       'send_queue_size': 16,
+                       'independent_recv_thread': False,
+                       'min_send_grad_num_before_recv': 1,
+                       'thread_pool_size': 1, 'send_wait_times': 1,
+                       'runtime_split_send_recv': False, 'launch_barrier':
+                       True, 'heter_worker_device_guard': 'cpu',
+                       'lr_decay_steps': 10, 'use_ps_gpu': 0},
+    'asp': False,
+    'fp16_allreduce': False,
+    'sync_nccl_allreduce': True,
+    'sync_batch_norm': False,
+    'fuse_all_reduce_ops': True,
+    'fuse_grad_size_in_MB': 32,
+    'fuse_grad_size_in_TFLOPS': 50,
+    'nccl_comm_num': 1,
+    'use_hierarchical_allreduce': False,
+    'hierarchical_allreduce_inter_nranks': 1,
+    'find_unused_parameters': False,
+    'without_graph_optimization': False,
+    'elastic': False,
+    'auto': False,
+    'semi_auto': False,
+    'heter_ccl_mode': False,
+    'cudnn_exhaustive_search': False,
+    'conv_workspace_size_limit': 512,
+    'cudnn_batchnorm_spatial_persistent': False,
+    'last_comm_group_size_MB': 1.0,
+    'gradient_scale_configs': {'scale_strategy': 'avg'},
+}
+
+
+class DistributedStrategy:
+    """Parity: DistributedStrategy:105. Attribute surface mirrors the proto
+    fields; unknown assignments raise to catch typos like the original's
+    check."""
+
+    def __init__(self):
+        object.__setattr__(self, '_conf', copy.deepcopy(_DEFAULTS))
+
+    def __getattr__(self, name):
+        conf = object.__getattribute__(self, '_conf')
+        if name in conf:
+            return copy.copy(conf[name])
+        raise AttributeError(f"DistributedStrategy has no field {name!r}")
+
+    def __setattr__(self, name, value):
+        conf = object.__getattribute__(self, '_conf')
+        if name not in conf:
+            raise AttributeError(f"DistributedStrategy has no field {name!r}")
+        if name.endswith('_configs'):
+            merged = dict(conf[name])
+            for k, v in value.items():
+                if k not in merged:
+                    raise ValueError(
+                        f"{name} has no config key {k!r} "
+                        f"(valid: {sorted(merged)})")
+                merged[k] = v
+            conf[name] = merged
+        else:
+            conf[name] = value
+
+    # -- (de)serialization (parity: save_to_prototxt:146) --------------------
+    def save_to_prototxt(self, output):
+        with open(output, 'w') as f:
+            json.dump(object.__getattribute__(self, '_conf'), f, indent=2)
+
+    def load_from_prototxt(self, pb_file):
+        with open(pb_file) as f:
+            loaded = json.load(f)
+        conf = object.__getattribute__(self, '_conf')
+        conf.update(loaded)
+
+    def __repr__(self):
+        conf = object.__getattribute__(self, '_conf')
+        on = [k for k, v in conf.items() if v is True]
+        return f"DistributedStrategy(enabled={on})"

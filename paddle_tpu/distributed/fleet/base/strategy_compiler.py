@@ -1,0 +1,62 @@
+"""Strategy compiler.
+
+Reference parity: fleet/base/strategy_compiler.py StrategyCompiler:114 —
+resolves enabled meta-optimizers into one valid application order
+(maximum_path_len_algo:91 over declared inner-opt compatibility).
+"""
+
+
+def maximum_path_len_algo(optimizer_list):
+    """Parity: strategy_compiler.py:91 — pick the longest chain of
+    meta-optimizers where each accepts the next as its inner optimizer."""
+    max_idx = 0
+    max_len = 0
+    candidates = []
+    for opt in optimizer_list:
+        local = [opt]
+        for other in optimizer_list:
+            if other is opt:
+                continue
+            names = [type(o).__name__ for o in local]
+            if type(other).__name__ in getattr(local[-1],
+                                               'meta_optimizers_white_list',
+                                               []):
+                local.append(other)
+        candidates.append(local)
+    for idx, c in enumerate(candidates):
+        if len(c) > max_len:
+            max_len = len(c)
+            max_idx = idx
+    if not candidates:
+        return []
+    chain = candidates[max_idx]
+    for i in range(len(chain) - 1):
+        chain[i]._update_inner_optimizer(chain[i + 1])
+    return chain
+
+
+class StrategyCompilerBase:
+    pass
+
+
+class StrategyCompiler(StrategyCompilerBase):
+    """Parity: StrategyCompiler:114."""
+
+    def __init__(self):
+        self._meta_optimizers = []
+        self._graph_optimizers = []
+        self._valid_optimizer_list = None
+
+    def _get_applied_meta_list(self):
+        return [type(o).__name__ for o in (self._valid_optimizer_list or [])]
+
+    def generate_optimizer(self, loss, role_maker, optimizer,
+                           user_defined_strategy, meta_optimizers,
+                           graph_optimizers=None):
+        self._meta_optimizers = meta_optimizers
+        if not meta_optimizers:
+            self._valid_optimizer_list = []
+            return []
+        chain = maximum_path_len_algo(meta_optimizers)
+        self._valid_optimizer_list = chain
+        return chain

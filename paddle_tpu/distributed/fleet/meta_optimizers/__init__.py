@@ -1,0 +1,324 @@
+"""Static-graph meta-optimizers.
+
+Reference parity: fleet/meta_optimizers/* (P18) — strategy-driven program
+rewriters chained by StrategyCompiler. On TPU several reference rewrites are
+subsumed by XLA/GSPMD (multi-stream scheduling, fusion, allreduce insertion
+for annotated shardings); each class below documents what still rewrites the
+Program versus what becomes an execution-time annotation.
+"""
+import numpy as np
+
+from ..base.distributed_strategy import DistributedStrategy
+
+
+class MetaOptimizerBase:
+    """Parity: meta_optimizer_base.py MetaOptimizerBase."""
+
+    meta_optimizers_white_list = []
+    meta_optimizers_black_list = []
+
+    def __init__(self, optimizer):
+        self.inner_opt = optimizer
+        self.user_defined_strategy = None
+        self.role_maker = None
+
+    def _set_basic_info(self, loss, role_maker, user_defined_optimizer,
+                        user_defined_strategy):
+        self.loss = loss
+        self.role_maker = role_maker
+        self.user_defined_optimizer = user_defined_optimizer
+        self.user_defined_strategy = user_defined_strategy
+
+    def _update_inner_optimizer(self, optimizer):
+        self.inner_opt = optimizer
+
+    def _can_apply(self):
+        return False
+
+    def _is_graph_out(self):
+        return False
+
+    def _disable_strategy(self, dist_strategy):
+        pass
+
+    def _enable_strategy(self, dist_strategy, context=None):
+        pass
+
+    def apply_gradients(self, params_grads):
+        return self.inner_opt.apply_gradients(params_grads)
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        from ....static import append_backward
+        return append_backward(loss, parameter_list)
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        return self.minimize(loss, startup_program)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        return self.minimize_impl(loss, startup_program, parameter_list,
+                                  no_grad_set)
+
+    def minimize_impl(self, loss, startup_program=None, parameter_list=None,
+                      no_grad_set=None):
+        return self.inner_opt.minimize(loss, startup_program,
+                                       parameter_list, no_grad_set)
+
+
+class RawProgramOptimizer(MetaOptimizerBase):
+    """Parity: raw_program_optimizer.py:28 — inserts c_allreduce_sum per
+    grad (:158). TPU: grads of a dp-replicated Program are allreduced by
+    marking the program's dp-sync flag; the Executor's jitted replay emits
+    one fused XLA AllReduce (the fuse_all_reduce_ops equivalent)."""
+
+    meta_optimizers_white_list = ['RecomputeOptimizer', 'AMPOptimizer']
+
+    def _can_apply(self):
+        return bool(self.user_defined_strategy.without_graph_optimization)
+
+    def minimize_impl(self, loss, startup_program=None, parameter_list=None,
+                      no_grad_set=None):
+        prog = loss.block.program
+        prog._dp_allreduce = True
+        return self.inner_opt.minimize(loss, startup_program,
+                                       parameter_list, no_grad_set)
+
+
+class AMPOptimizer(MetaOptimizerBase):
+    """Parity: amp_optimizer.py:20 — static AMP decoration (cast insertion
+    fp16_utils.py:484). TPU: Programs execute through XLA with bf16 inputs;
+    the rewrite marks the program for bf16 execution of white-list ops."""
+
+    meta_optimizers_white_list = ['LarsOptimizer', 'LambOptimizer',
+                                  'RecomputeOptimizer',
+                                  'GradientMergeOptimizer',
+                                  'RawProgramOptimizer']
+
+    def _can_apply(self):
+        return bool(self.user_defined_strategy.amp)
+
+    def minimize_impl(self, loss, startup_program=None, parameter_list=None,
+                      no_grad_set=None):
+        prog = loss.block.program
+        prog._amp = dict(self.user_defined_strategy.amp_configs)
+        return self.inner_opt.minimize(loss, startup_program,
+                                       parameter_list, no_grad_set)
+
+
+class RecomputeOptimizer(MetaOptimizerBase):
+    """Parity: recompute_optimizer.py → fluid RecomputeOptimizer:5402. TPU:
+    checkpoints map to jax.checkpoint boundaries in the jitted replay."""
+
+    meta_optimizers_white_list = ['LarsOptimizer', 'LambOptimizer',
+                                  'GradientMergeOptimizer',
+                                  'RawProgramOptimizer']
+
+    def _can_apply(self):
+        return bool(self.user_defined_strategy.recompute)
+
+    def minimize_impl(self, loss, startup_program=None, parameter_list=None,
+                      no_grad_set=None):
+        prog = loss.block.program
+        prog._recompute_checkpoints = list(
+            self.user_defined_strategy.recompute_configs['checkpoints'])
+        return self.inner_opt.minimize(loss, startup_program,
+                                       parameter_list, no_grad_set)
+
+
+class GradientMergeOptimizer(MetaOptimizerBase):
+    """Parity: gradient_merge_optimizer.py → fluid GradientMergeOptimizer:
+    6255 — accumulate grads k steps, step conditionally."""
+
+    meta_optimizers_white_list = []
+
+    def _can_apply(self):
+        return bool(self.user_defined_strategy.gradient_merge)
+
+    def minimize_impl(self, loss, startup_program=None, parameter_list=None,
+                      no_grad_set=None):
+        prog = loss.block.program
+        prog._gradient_merge_k = \
+            self.user_defined_strategy.gradient_merge_configs['k_steps']
+        return self.inner_opt.minimize(loss, startup_program,
+                                       parameter_list, no_grad_set)
+
+
+class LocalSGDOptimizer(MetaOptimizerBase):
+    """Parity: localsgd_optimizer.py:27 — @SNAPSHOT vars + periodic delta
+    allreduce (A.11)."""
+
+    def _can_apply(self):
+        return bool(self.user_defined_strategy.localsgd)
+
+    def minimize_impl(self, loss, startup_program=None, parameter_list=None,
+                      no_grad_set=None):
+        prog = loss.block.program
+        prog._localsgd_k = \
+            self.user_defined_strategy.localsgd_configs['k_steps']
+        return self.inner_opt.minimize(loss, startup_program,
+                                       parameter_list, no_grad_set)
+
+
+class LarsOptimizer(MetaOptimizerBase):
+    """Parity: lars_optimizer.py — swap inner Momentum for Lars."""
+
+    def _can_apply(self):
+        from ....optimizer import Momentum
+        return bool(self.user_defined_strategy.lars) and \
+            isinstance(self.user_defined_optimizer, Momentum)
+
+    def minimize_impl(self, loss, startup_program=None, parameter_list=None,
+                      no_grad_set=None):
+        from ....optimizer import Lars
+        cfg = self.user_defined_strategy.lars_configs
+        inner = self.user_defined_optimizer
+        opt = Lars(learning_rate=inner._learning_rate,
+                   momentum=inner._momentum,
+                   lars_coeff=cfg['lars_coeff'],
+                   lars_weight_decay=cfg['lars_weight_decay'],
+                   parameters=inner._parameter_list,
+                   epsilon=cfg['epsilon'])
+        return opt.minimize(loss, startup_program, parameter_list,
+                            no_grad_set)
+
+
+class LambOptimizer(MetaOptimizerBase):
+    """Parity: lamb_optimizer.py — swap inner Adam for Lamb."""
+
+    def _can_apply(self):
+        from ....optimizer import Adam
+        return bool(self.user_defined_strategy.lamb) and \
+            isinstance(self.user_defined_optimizer, Adam)
+
+    def minimize_impl(self, loss, startup_program=None, parameter_list=None,
+                      no_grad_set=None):
+        from ....optimizer import Lamb
+        cfg = self.user_defined_strategy.lamb_configs
+        inner = self.user_defined_optimizer
+        opt = Lamb(learning_rate=inner._learning_rate,
+                   lamb_weight_decay=cfg['lamb_weight_decay'],
+                   parameters=inner._parameter_list)
+        return opt.minimize(loss, startup_program, parameter_list,
+                            no_grad_set)
+
+
+class PipelineOptimizer(MetaOptimizerBase):
+    """Parity: fleet pipeline_optimizer.py:28 over fluid
+    PipelineOptimizer:4135 (the program splitter). The TPU pipeline executes
+    as one SPMD program (meta_parallel/spmd_pipeline.py); the static-path
+    rewrite records stage/microbatch config on the Program."""
+
+    def _can_apply(self):
+        return bool(self.user_defined_strategy.pipeline)
+
+    def minimize_impl(self, loss, startup_program=None, parameter_list=None,
+                      no_grad_set=None):
+        prog = loss.block.program
+        prog._pipeline_opt = dict(
+            self.user_defined_strategy.pipeline_configs)
+        return self.inner_opt.minimize(loss, startup_program,
+                                       parameter_list, no_grad_set)
+
+
+class TensorParallelOptimizer(MetaOptimizerBase):
+    """Parity: tensor_parallel_optimizer.py (233 LoC)."""
+
+    def _can_apply(self):
+        return bool(self.user_defined_strategy.tensor_parallel)
+
+    def minimize_impl(self, loss, startup_program=None, parameter_list=None,
+                      no_grad_set=None):
+        prog = loss.block.program
+        prog._mp_degree = self.user_defined_strategy \
+            .tensor_parallel_configs['tensor_parallel_degree']
+        return self.inner_opt.minimize(loss, startup_program,
+                                       parameter_list, no_grad_set)
+
+
+class ShardingOptimizer(MetaOptimizerBase):
+    """Parity: sharding_optimizer.py:43 (ZeRO-1/2 rewrite; composition rules
+    A.2). TPU static path: parameters/optimizer state are annotated to shard
+    over the 'sharding' mesh axis; GSPMD inserts reduce-scatter/all-gather —
+    the weight-update sharding transform from the XLA literature."""
+
+    def _can_apply(self):
+        return bool(self.user_defined_strategy.sharding)
+
+    def minimize_impl(self, loss, startup_program=None, parameter_list=None,
+                      no_grad_set=None):
+        prog = loss.block.program
+        prog._sharding = dict(self.user_defined_strategy.sharding_configs)
+        return self.inner_opt.minimize(loss, startup_program,
+                                       parameter_list, no_grad_set)
+
+
+class DGCOptimizer(MetaOptimizerBase):
+    """Parity: dgc_optimizer.py:22 — top-k grad compression. DCN-only
+    relevance on TPU (ICI is fast); not applied by default."""
+
+    def _can_apply(self):
+        return False
+
+
+class FP16AllReduceOptimizer(MetaOptimizerBase):
+    """Parity: fp16_allreduce_optimizer.py — grads cast to bf16 for
+    allreduce."""
+
+    def _can_apply(self):
+        return bool(self.user_defined_strategy.fp16_allreduce)
+
+    def minimize_impl(self, loss, startup_program=None, parameter_list=None,
+                      no_grad_set=None):
+        prog = loss.block.program
+        prog._fp16_allreduce = True
+        return self.inner_opt.minimize(loss, startup_program,
+                                       parameter_list, no_grad_set)
+
+
+class ASPOptimizer(MetaOptimizerBase):
+    """Parity: asp_optimizer.py — 2:4 structured sparsity masks."""
+
+    def _can_apply(self):
+        return bool(self.user_defined_strategy.asp)
+
+    def minimize_impl(self, loss, startup_program=None, parameter_list=None,
+                      no_grad_set=None):
+        from ....incubate import asp as asp_mod
+        return asp_mod.decorate(self.inner_opt).minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+
+
+class ParameterServerOptimizer(MetaOptimizerBase):
+    """Parity: parameter_server_optimizer.py (352 LoC) — a_sync PS program
+    split; see paddle_tpu/distributed/ps."""
+
+    def _can_apply(self):
+        return bool(self.user_defined_strategy.a_sync)
+
+    def minimize_impl(self, loss, startup_program=None, parameter_list=None,
+                      no_grad_set=None):
+        prog = loss.block.program
+        prog._ps_mode = dict(self.user_defined_strategy.a_sync_configs)
+        return self.inner_opt.minimize(loss, startup_program,
+                                       parameter_list, no_grad_set)
+
+
+_ALL_META_OPTIMIZERS = [AMPOptimizer, RecomputeOptimizer,
+                        GradientMergeOptimizer, LocalSGDOptimizer,
+                        LarsOptimizer, LambOptimizer, PipelineOptimizer,
+                        TensorParallelOptimizer, ShardingOptimizer,
+                        DGCOptimizer, FP16AllReduceOptimizer, ASPOptimizer,
+                        ParameterServerOptimizer, RawProgramOptimizer]
+
+
+def resolve_meta_optimizers(strategy, optimizer, role_maker, loss=None):
+    """Parity: MetaOptimizerFactory._get_valid_meta_optimizers +
+    fleet_base.minimize's _can_apply filtering."""
+    out = []
+    for cls in _ALL_META_OPTIMIZERS:
+        m = cls(optimizer)
+        m._set_basic_info(loss, role_maker, optimizer, strategy)
+        if m._can_apply():
+            out.append(m)
+    return out

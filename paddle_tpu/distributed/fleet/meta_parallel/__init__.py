@@ -1,0 +1,10 @@
+"""fleet.meta_parallel (parity: fleet/meta_parallel/__init__.py)."""
+from .parallel_layers import (VocabParallelEmbedding, ColumnParallelLinear,
+                              RowParallelLinear, ParallelCrossEntropy,
+                              LayerDesc, SharedLayerDesc, PipelineLayer,
+                              RNGStatesTracker, get_rng_state_tracker,
+                              model_parallel_random_seed)
+from .meta_parallel_base import MetaParallelBase
+from .pipeline_parallel import PipelineParallel
+from .tensor_parallel import TensorParallel
+from .sharding_parallel import ShardingParallel

@@ -1,0 +1,6 @@
+from .mp_layers import (VocabParallelEmbedding, ColumnParallelLinear,
+                        RowParallelLinear, ParallelCrossEntropy)
+from .pp_layers import (LayerDesc, SharedLayerDesc, SegmentLayers,
+                        PipelineLayer)
+from .random_ import (RNGStatesTracker, get_rng_state_tracker,
+                      model_parallel_random_seed)

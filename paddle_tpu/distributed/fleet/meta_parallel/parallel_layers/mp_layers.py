@@ -1,0 +1,139 @@
+"""Megatron-style tensor-parallel layers.
+
+Reference parity: fleet/meta_parallel/parallel_layers/mp_layers.py —
+VocabParallelEmbedding:30, ColumnParallelLinear:97, RowParallelLinear:170,
+ParallelCrossEntropy:249. TPU-native: weights carry their shard (this rank's
+slice); matmuls stay full-size MXU calls; the comm primitives
+(_c_identity/_mp_allreduce/_c_concat/c_embedding psum) lower to XLA
+collectives on the 'mp' mesh axis inside the SPMD train step. Outside an
+SPMD region (single device) the layers degrade to their dense equivalents
+with mp_degree=1.
+"""
+import numpy as np
+
+from .....core.tensor import Tensor
+from .....nn.layer.base import Layer
+from .....nn import initializer as I
+from .....ops import nn_ops as F
+from .... import collective as C
+
+
+def _mp_info(mp_group=None):
+    """(world_size, rank, group) for the model-parallel axis."""
+    try:
+        from ... import fleet as fleet_mod
+    except ImportError:
+        fleet_mod = None
+    from ... import fleet
+    hcg = fleet.fleet._hcg if fleet.fleet._hcg is not None else None
+    if mp_group is not None:
+        return mp_group.nranks, max(mp_group.rank, 0), mp_group
+    if hcg is not None:
+        return (hcg.get_model_parallel_world_size(),
+                hcg.get_model_parallel_rank(),
+                hcg.get_model_parallel_group())
+    return 1, 0, None
+
+
+class VocabParallelEmbedding(Layer):
+    """Parity: mp_layers.py:30 — vocab dim sharded across mp ranks."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.world_size, self.rank, self.group = _mp_info(mp_group)
+        assert num_embeddings % self.world_size == 0
+        self.num_embeddings = num_embeddings
+        self.per_part_size = num_embeddings // self.world_size
+        self.vocab_start_index = self.rank * self.per_part_size
+        self.weight = self.create_parameter(
+            [self.per_part_size, embedding_dim], attr=weight_attr,
+            default_initializer=I.XavierUniform())
+        self.weight.is_distributed = self.world_size > 1
+
+    def forward(self, x):
+        if self.world_size == 1:
+            return F.embedding(x, self.weight)
+        return C._c_embedding(self.weight, x,
+                              start_index=self.vocab_start_index,
+                              group=self.group)
+
+
+class ColumnParallelLinear(Layer):
+    """Parity: mp_layers.py:97 — weight [in, out/mp]; forward =
+    c_identity → matmul (→ optional all-gather of outputs)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.world_size, self.rank, self.group = _mp_info(mp_group)
+        assert out_features % self.world_size == 0
+        self.out_per_part = out_features // self.world_size
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            [in_features, self.out_per_part], attr=weight_attr,
+            default_initializer=I.XavierUniform())
+        self.weight.is_distributed = self.world_size > 1
+        if has_bias is None:
+            has_bias = True
+        self.bias = self.create_parameter(
+            [self.out_per_part], is_bias=True) if has_bias else None
+        if self.bias is not None:
+            self.bias.is_distributed = self.world_size > 1
+
+    def forward(self, x):
+        if self.world_size > 1:
+            x = C._c_identity(x, group=self.group)
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output and self.world_size > 1:
+            out = C._c_concat(out, group=self.group)
+        return out
+
+
+class RowParallelLinear(Layer):
+    """Parity: mp_layers.py:170 — weight [in/mp, out]; forward = (split
+    input) → matmul → mp_allreduce(+bias)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.world_size, self.rank, self.group = _mp_info(mp_group)
+        assert in_features % self.world_size == 0
+        self.in_per_part = in_features // self.world_size
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            [self.in_per_part, out_features], attr=weight_attr,
+            default_initializer=I.XavierUniform())
+        self.weight.is_distributed = self.world_size > 1
+        self.bias = self.create_parameter(
+            [out_features], is_bias=True) if has_bias else None
+        # bias added AFTER allreduce → replicated, not distributed
+
+    def forward(self, x):
+        if self.world_size == 1:
+            return F.linear(x, self.weight, self.bias)
+        if not self.input_is_parallel:
+            x = C._c_split(x, group=self.group)
+        out = F.linear(x, self.weight)
+        out = C._mp_allreduce(out, group=self.group)
+        if self.bias is not None:
+            from .....ops import math as M
+            out = M.add(out, self.bias)
+        return out
+
+
+class ParallelCrossEntropy(Layer):
+    """Parity: mp_layers.py:249 — vocab-parallel softmax cross entropy."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.world_size, self.rank, self.group = _mp_info(mp_group)
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        if self.world_size == 1:
+            return F.softmax_with_cross_entropy(input, label)
+        return C._c_softmax_with_cross_entropy(
+            input, label, group=self.group, ignore_index=self.ignore_index)

@@ -1,0 +1,197 @@
+"""Pipeline layer partitioning.
+
+Reference parity: fleet/meta_parallel/parallel_layers/pp_layers.py —
+SegmentLayers:23 (uniform/param-weighted split), LayerDesc:44,
+SharedLayerDesc:62, PipelineLayer:77 (builds only this stage's segment;
+shared-weight comm groups A.4). The partitioning math is identical; the
+execution engine (meta_parallel/pipeline_parallel.py) drives stages with XLA
+collectives instead of SectionWorker threads.
+"""
+import math
+
+import numpy as np
+
+from .....nn.layer.base import Layer
+from .....nn.layer.container import LayerList, Sequential
+
+
+class LayerDesc:
+    """Parity: pp_layers.py:44 — lazy layer constructor."""
+
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_func, Layer):
+            raise TypeError("LayerDesc expects an nn.Layer subclass")
+
+    def build_layer(self):
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_func.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Parity: pp_layers.py:62 — layers shared across stages (e.g. tied
+    embeddings)."""
+
+    def __init__(self, key, layer_func, forward_func=None,
+                 shared_weight_attr='weight', *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """Parity: pp_layers.py:23."""
+
+    def __init__(self, layers_desc, num_parts, method="uniform"):
+        self._layers_desc = layers_desc
+        self.method = method
+        self.num_parts = num_parts
+        self.num_items = len(layers_desc)
+        assert self.num_items >= self.num_parts
+
+    def do_segment(self):
+        if self.method == "uniform":
+            return self.uniform(self.num_items, self.num_parts)
+        if self.method.startswith("layer:"):
+            # cut by named layer class occurrences
+            name = self.method.split(':', 1)[1]
+            hits = [0]
+            for i, d in enumerate(self._layers_desc):
+                cls = d.layer_func if isinstance(d, LayerDesc) else type(d)
+                if getattr(cls, '__name__', '') == name:
+                    hits.append(i)
+            hits.append(self.num_items)
+            # merge into num_parts contiguous groups
+            per = max(1, (len(hits) - 1) // self.num_parts)
+            result = [0]
+            for p in range(1, self.num_parts):
+                result.append(hits[min(p * per, len(hits) - 2)])
+            result.append(self.num_items)
+            return result
+        raise ValueError(f"bad segment method {self.method}")
+
+    @staticmethod
+    def uniform(num_items, num_parts):
+        result = [0] * (num_parts + 1)
+        part_size = math.floor(num_items / num_parts)
+        extras = num_items % num_parts
+        for i in range(1, num_parts + 1):
+            result[i] = result[i - 1] + part_size + (1 if i <= extras else 0)
+        return result
+
+
+class PipelineLayer(Layer):
+    """Parity: pp_layers.py:77. Holds ALL segment descriptions; materializes
+    only this stage's layers. run_function() exposes the local chunk to the
+    engine."""
+
+    def __init__(self, layers, num_stages=None, topology=None,
+                 loss_fn=None, seg_method="uniform", recompute_interval=0,
+                 recompute_ctx=None, num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._layers_desc = list(layers)
+        self._loss_fn = loss_fn
+        self._topo = topology
+        self._recompute_interval = recompute_interval
+        if num_stages is None and topology is None:
+            num_stages = 1
+        if topology is not None:
+            from ... import fleet
+            hcg = fleet.fleet._hcg
+            self._num_stages = hcg.get_pipe_parallel_world_size() \
+                if hcg else (num_stages or 1)
+            self._stage_id = hcg.get_stage_id() if hcg else 0
+        else:
+            self._num_stages = num_stages
+            self._stage_id = 0
+            from ... import fleet
+            if fleet.fleet._hcg is not None:
+                self._num_stages = \
+                    fleet.fleet._hcg.get_pipe_parallel_world_size()
+                self._stage_id = fleet.fleet._hcg.get_stage_id()
+
+        self.segment_parts = SegmentLayers(
+            self._layers_desc, self._num_stages, seg_method).do_segment()
+        self._start = self.segment_parts[self._stage_id]
+        self._end = self.segment_parts[self._stage_id + 1]
+
+        self.run_function = []
+        self._shared_layers = {}
+        self.shared_weight_keys = []
+        for i in range(self._start, self._end):
+            self._build_one(i)
+
+        # register built layers so parameters() sees them
+        for idx, f in enumerate(self.run_function):
+            if isinstance(f, Layer):
+                self.add_sublayer(str(idx), f)
+
+    def _build_one(self, i):
+        desc = self._layers_desc[i]
+        if isinstance(desc, SharedLayerDesc):
+            if desc.layer_name not in self._shared_layers:
+                self._shared_layers[desc.layer_name] = desc.build_layer()
+                self.shared_weight_keys.append(desc.layer_name)
+            layer = self._shared_layers[desc.layer_name]
+            if desc.forward_func is None:
+                self.run_function.append(layer)
+            else:
+                import functools
+                self.run_function.append(
+                    functools.partial(desc.forward_func, layer))
+        elif isinstance(desc, LayerDesc):
+            self.run_function.append(desc.build_layer())
+        else:
+            self.run_function.append(desc)
+
+    def get_stage_from_index(self, layer_idx):
+        for stage in range(self._num_stages):
+            if self.segment_parts[stage] <= layer_idx \
+                    < self.segment_parts[stage + 1]:
+                return stage
+        raise ValueError("index out of range")
+
+    def get_num_stages(self):
+        return self._num_stages
+
+    @property
+    def parameters_desc(self):
+        return self._layers_desc
+
+    def forward(self, input, chunk_id=None):
+        x = input
+        for i, f in enumerate(self.run_function):
+            if self._recompute_interval > 0 and \
+                    i % self._recompute_interval == 0 and \
+                    not isinstance(x, tuple):
+                from ...utils.recompute import recompute
+                x = recompute(f, x)
+            else:
+                x = f(*x) if isinstance(x, tuple) else f(x)
+        return x
+
+    def build_full_model(self):
+        """Materialize ALL stages' layers (used by the SPMD pipeline engine
+        that holds every stage's weights stacked over the 'pp' mesh axis)."""
+        funcs = []
+        shared = {}
+        for desc in self._layers_desc:
+            if isinstance(desc, SharedLayerDesc):
+                if desc.layer_name not in shared:
+                    shared[desc.layer_name] = desc.build_layer()
+                layer = shared[desc.layer_name]
+                if desc.forward_func is None:
+                    funcs.append(layer)
+                else:
+                    import functools
+                    funcs.append(functools.partial(desc.forward_func, layer))
+            elif isinstance(desc, LayerDesc):
+                funcs.append(desc.build_layer())
+            else:
+                funcs.append(desc)
+        return funcs, shared

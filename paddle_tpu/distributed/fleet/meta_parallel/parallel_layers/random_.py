@@ -1,0 +1,85 @@
+"""TP RNG state tracker.
+
+Reference parity: fleet/meta_parallel/parallel_layers/random.py:24
+RNGStatesTracker — named RNG states so dropout differs across mp ranks while
+weight init stays replicated. TPU-native: jax.random key folding per
+(name, mp_rank) (SURVEY.md A.5 mapping note).
+"""
+import contextlib
+
+import jax
+
+from .....core import rng as rng_mod
+
+MODEL_PARALLEL_RNG = 'model_parallel_rng'
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError(f'seed {seed} already exists')
+        self.seeds_.add(seed)
+        if name in self.states_:
+            raise ValueError(f'state {name} already exists')
+        self.states_[name] = (jax.random.key(seed), 0)
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+
+    @contextlib.contextmanager
+    def rng_state(self, name=MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise ValueError(f'state {name} does not exist')
+        key, counter = self.states_[name]
+        saved = rng_mod.get_rng_state()
+        rng_mod.set_rng_state((key, counter))
+        try:
+            yield
+        finally:
+            self.states_[name] = rng_mod.get_rng_state()
+            rng_mod.set_rng_state(saved)
+
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _RNG_STATE_TRACKER
+
+
+def model_parallel_random_seed(seed=None):
+    """Parity: random.py model_parallel_random_seed."""
+    from ... import fleet
+    hcg = fleet.fleet._hcg
+    rank = hcg.get_model_parallel_rank() if hcg else 0
+    if seed:
+        global_seed = seed
+        local_seed = seed * 1024 + rank * 100
+    else:
+        global_seed = 100
+        local_seed = 41000 + rank
+    tracker = get_rng_state_tracker()
+    tracker.reset()
+    tracker.add(MODEL_PARALLEL_RNG, local_seed)
+    rng_mod.seed(global_seed)
+
+
+@contextlib.contextmanager
+def dropout_with_rng_tracker(name=MODEL_PARALLEL_RNG):
+    tracker = get_rng_state_tracker()
+    if name in tracker.states_:
+        with tracker.rng_state(name):
+            yield
+    else:
+        yield

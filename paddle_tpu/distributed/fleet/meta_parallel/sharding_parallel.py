@@ -1,0 +1,10 @@
+"""ShardingParallel wrapper (parity:
+fleet/meta_parallel/sharding_parallel.py) — ZeRO grouping is done by
+DygraphShardingOptimizer; this wrapper only broadcasts params at setup."""
+from .meta_parallel_base import MetaParallelBase
+from ..utils.hybrid_parallel_util import broadcast_sharding_parameters
+
+
+class ShardingParallel(MetaParallelBase):
+    def _prepare_for_model(self):
+        broadcast_sharding_parameters(self._layers, self._hcg)

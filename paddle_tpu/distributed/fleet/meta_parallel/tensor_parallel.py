@@ -1,0 +1,18 @@
+"""TensorParallel wrapper (parity: fleet/meta_parallel/tensor_parallel.py) —
+broadcasts inputs across the mp group and dp-syncs grads; the mp collectives
+live inside the mp_layers."""
+from .meta_parallel_base import MetaParallelBase
+from ..utils.hybrid_parallel_util import (broadcast_input_data,
+                                          broadcast_mp_parameters,
+                                          broadcast_dp_parameters,
+                                          fused_allreduce_gradients)
+
+
+class TensorParallel(MetaParallelBase):
+    def _prepare_for_model(self):
+        broadcast_mp_parameters(self._layers, self._hcg)
+        broadcast_dp_parameters(self._layers, self._hcg)
+
+    def forward(self, *inputs, **kwargs):
+        inputs = broadcast_input_data(self._hcg, *inputs)
+        return self._layers(*inputs, **kwargs)

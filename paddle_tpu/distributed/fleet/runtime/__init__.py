@@ -1,0 +1,1 @@
+from . import the_one_ps

@@ -1,0 +1,40 @@
+"""PS runtime front-end.
+
+Reference parity: fleet/runtime/the_one_ps.py:434 TheOnePSRuntime — builds
+the C++ parameter server from strategy protos. The TPU rebuild's PS lives in
+paddle_tpu/distributed/ps (host-side embedding tables + dense TPU towers);
+this runtime wires fleet.init_server/init_worker to it.
+"""
+
+
+class TheOnePSRuntime:
+    def __init__(self):
+        self._server = None
+        self._worker = None
+
+    def init_worker(self, fleet_obj):
+        from ...ps.ps_runtime import get_or_create_worker
+        self._worker = get_or_create_worker(fleet_obj)
+
+    def init_server(self, fleet_obj, *args, **kwargs):
+        from ...ps.ps_runtime import get_or_create_server
+        self._server = get_or_create_server(fleet_obj)
+
+    def run_server(self, fleet_obj):
+        if self._server is None:
+            self.init_server(fleet_obj)
+        self._server.run()
+
+    def stop_worker(self, fleet_obj):
+        if self._worker is not None:
+            self._worker.stop()
+
+
+_runtime = None
+
+
+def runtime():
+    global _runtime
+    if _runtime is None:
+        _runtime = TheOnePSRuntime()
+    return _runtime

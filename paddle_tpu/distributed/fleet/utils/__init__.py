@@ -1,0 +1,5 @@
+"""fleet.utils (parity: fleet/utils/__init__.py)."""
+from .recompute import recompute, recompute_jax
+from .hybrid_parallel_util import (fused_allreduce_gradients,
+                                   sharding_reduce_gradients, unwrap_model)
+from .fs import LocalFS, HDFSClient

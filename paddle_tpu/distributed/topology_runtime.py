@@ -1,0 +1,47 @@
+"""Global mesh registry.
+
+Reference parity: the role of platform/collective_helper.h NCCLCommContext —
+the per-process registry mapping communicator namespaces to device resources.
+On TPU the resource is a jax.sharding.Mesh; fleet's CommunicateTopology
+declares logical axes (dp/pp/sharding/mp/sep...) and this registry realizes
+them as one named device mesh whose fastest-varying axis rides the innermost
+ICI dimension (SURVEY.md A.1 mapping note).
+"""
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+_current_mesh = None
+
+
+def set_mesh(mesh):
+    global _current_mesh
+    _current_mesh = mesh
+    return mesh
+
+
+def get_mesh():
+    return _current_mesh
+
+
+def build_mesh(axis_names, axis_sizes, devices=None):
+    """Create + register a Mesh. Axis order: outermost first (slowest ICI
+    hops — dp/pp) to innermost last (mp on fastest ICI), matching the
+    reference's rank layout mp→sharding→pp→dp innermost→outermost (A.1)."""
+    devices = devices if devices is not None else jax.devices()
+    n = int(np.prod(axis_sizes))
+    if n > len(devices):
+        raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
+    arr = np.asarray(devices[:n]).reshape(axis_sizes)
+    return set_mesh(Mesh(arr, tuple(axis_names)))
+
+
+def axis_size(axis):
+    if _current_mesh is not None and axis in _current_mesh.shape:
+        return _current_mesh.shape[axis]
+    return 1
+
+
+def mesh_axis_names():
+    return tuple(_current_mesh.axis_names) if _current_mesh is not None \
+        else ()

@@ -1,0 +1,1 @@
+"""distributed.utils (launch helpers re-export)."""

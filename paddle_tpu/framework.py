@@ -1,0 +1,148 @@
+"""Framework-level utilities: save/load, default dtype, places, paddle.grad.
+
+Reference parity: python/paddle/framework/io.py (save:550/load:766 — pickled
+nested state dicts of numpy arrays, protocol 4), framework.py places, and
+imperative/partial_grad_engine.cc for `paddle.grad`.
+"""
+import pickle
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .core import dtypes as _dtypes
+from .core import autograd as _autograd
+from .core.tensor import Tensor
+
+_default_dtype = jnp.float32
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    _default_dtype = _dtypes.convert_dtype(d)
+
+
+def get_default_dtype():
+    return _dtypes.dtype_name(_default_dtype)
+
+
+def in_dynamic_mode():
+    return True
+
+
+def set_grad_enabled(mode):
+    class _Guard:
+        def __enter__(self):
+            self._saved = _autograd._grad_enabled
+            _autograd._grad_enabled = bool(mode)
+            return self
+        def __exit__(self, *a):
+            _autograd._grad_enabled = self._saved
+            return False
+    return _Guard()
+
+
+def is_grad_enabled():
+    return _autograd.grad_enabled()
+
+
+# ---- places -----------------------------------------------------------------
+class Place:
+    def __init__(self, idx=0):
+        self.idx = idx
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.idx})"
+
+
+class CPUPlace(Place):
+    pass
+
+
+class CUDAPlace(Place):  # accepted for API compat; maps to the TPU device
+    pass
+
+
+class CUDAPinnedPlace(Place):
+    pass
+
+
+class TPUPlace(Place):
+    """The native device of this framework (parity: platform/device_context.h
+    Place variants — here PJRT owns the device)."""
+
+
+_current_device = 'tpu'
+
+
+def set_device(device):
+    global _current_device
+    _current_device = device
+    return device
+
+
+def get_device():
+    return _current_device
+
+
+# ---- save / load ------------------------------------------------------------
+def _to_saveable(obj):
+    if isinstance(obj, Tensor):
+        return np.asarray(jax.device_get(obj.data))
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_saveable(v) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    """Parity: paddle.save (framework/io.py:550) — pickled numpy state dicts."""
+    with open(path, 'wb') as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    """Parity: paddle.load (framework/io.py:766)."""
+    with open(path, 'rb') as f:
+        obj = pickle.load(f)
+
+    def back(o):
+        if isinstance(o, np.ndarray):
+            return Tensor(o)
+        if isinstance(o, dict):
+            return {k: back(v) for k, v in o.items()}
+        if isinstance(o, (list, tuple)):
+            return type(o)(back(v) for v in o)
+        return o
+    if configs.get('return_numpy', False):
+        return obj
+    return back(obj)
+
+
+# ---- paddle.grad -------------------------------------------------------------
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """Parity: paddle.grad → PartialGradEngine (partial_grad_engine.cc).
+
+    Computes d(outputs)/d(inputs) without touching `.grad` of other leaves.
+    Implemented by running the tape backward into a scratch grad map.
+    """
+    single = isinstance(inputs, Tensor)
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    if single:
+        inputs = [inputs]
+    capture = {id(t): None for t in inputs}
+    _autograd.backward(outputs, grad_outputs,
+                       retain_graph=True if retain_graph is None else retain_graph,
+                       capture=capture)
+    grads = []
+    for t in inputs:
+        g = capture[id(t)]
+        if g is None and not allow_unused:
+            g = jnp.zeros_like(t.data)
+        grads.append(Tensor(g) if g is not None else None)
+    return grads

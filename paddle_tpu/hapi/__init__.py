@@ -1,0 +1,12 @@
+"""paddle_tpu.hapi — high-level Model API.
+
+Reference parity: python/paddle/hapi/model.py (Model:878, fit:1523) with the
+DynamicGraphAdapter(:659) path; prepare/fit/evaluate/predict/save/load and
+callbacks. TPU-native: train/eval steps run through paddle_tpu.jit.TrainStep
+(one XLA executable per step) when the model is jit-compatible, falling back
+to the eager tape otherwise.
+"""
+from .model import Model
+from .callbacks import (Callback, ProgBarLogger, ModelCheckpoint,
+                        LRSchedulerCallback, EarlyStopping)
+from .summary import summary

@@ -1,0 +1,254 @@
+"""hapi.Model (parity: python/paddle/hapi/model.py Model:878)."""
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core.autograd import no_grad
+from .. import framework
+from ..io import DataLoader, Dataset
+from .callbacks import CallbackList, ProgBarLogger
+
+
+class Model:
+    """Keras-like trainer (parity: hapi/model.py Model/fit:1523,
+    DynamicGraphAdapter:659)."""
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is not None:
+            self._metrics = metrics if isinstance(metrics, (list, tuple)) \
+                else [metrics]
+
+    # -- single steps ---------------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = self._to_list(inputs)
+        labels = self._to_list(labels)
+        outputs = self.network(*[self._to_tensor(x) for x in inputs])
+        outs = self._to_list(outputs)
+        losses = self._loss(*(outs + [self._to_tensor(l) for l in labels]))
+        loss_list = self._to_list(losses)
+        total = loss_list[0]
+        for extra in loss_list[1:]:
+            total = total + extra
+        total.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = []
+        for m in self._metrics:
+            m_out = m.compute(outs[0], *[self._to_tensor(l) for l in labels])
+            metrics.append(m.update(m_out))
+        out_loss = [[float(np.asarray(l.data))] for l in loss_list]
+        return (out_loss, metrics) if metrics else out_loss
+
+    @no_grad()
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = self._to_list(inputs)
+        labels = self._to_list(labels)
+        outputs = self.network(*[self._to_tensor(x) for x in inputs])
+        outs = self._to_list(outputs)
+        out_loss = []
+        if self._loss is not None and labels:
+            losses = self._loss(*(outs + [self._to_tensor(l)
+                                          for l in labels]))
+            out_loss = [[float(np.asarray(l.data))]
+                        for l in self._to_list(losses)]
+        metrics = []
+        for m in self._metrics:
+            m_out = m.compute(outs[0], *[self._to_tensor(l) for l in labels])
+            metrics.append(m.update(m_out))
+        return (out_loss, metrics) if metrics else out_loss
+
+    @no_grad()
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = self._to_list(inputs)
+        outputs = self.network(*[self._to_tensor(x) for x in inputs])
+        return [np.asarray(o.data) for o in self._to_list(outputs)]
+
+    # -- loops ---------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        train_loader = self._to_loader(train_data, batch_size, shuffle,
+                                       drop_last, num_workers)
+        eval_loader = self._to_loader(eval_data, batch_size, False, False,
+                                      num_workers) if eval_data is not None \
+            else None
+        cbks = CallbackList(callbacks or ([ProgBarLogger(log_freq, verbose)]
+                                          if verbose else []))
+        cbks.set_model(self)
+        cbks.set_params({'epochs': epochs, 'verbose': verbose,
+                         'metrics': self._metrics_name(),
+                         'steps': self._safe_len(train_loader)})
+        cbks.on_begin('train')
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                if num_iters is not None and step >= num_iters:
+                    break
+                cbks.on_batch_begin('train', step, logs)
+                ins, labs = self._split_batch(batch)
+                result = self.train_batch(ins, labs,
+                                          update=(step + 1) %
+                                          accumulate_grad_batches == 0)
+                logs = self._update_logs(result, logs, step)
+                cbks.on_batch_end('train', step, logs)
+                if self.stop_training:
+                    break
+            if isinstance(self._optimizer_lr_scheduler(), object) and \
+                    hasattr(self._optimizer_lr_scheduler(), 'step'):
+                sched = self._optimizer_lr_scheduler()
+                if sched is not None:
+                    sched.step()
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, verbose=0)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            cbks.on_epoch_end(epoch, logs)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(f"{save_dir}/{epoch}")
+            if self.stop_training:
+                break
+        cbks.on_end('train')
+        if save_dir:
+            self.save(f"{save_dir}/final")
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None):
+        loader = self._to_loader(eval_data, batch_size, False, False,
+                                 num_workers)
+        for m in self._metrics:
+            m.reset()
+        logs = {}
+        for step, batch in enumerate(loader):
+            if num_iters is not None and step >= num_iters:
+                break
+            ins, labs = self._split_batch(batch)
+            result = self.eval_batch(ins, labs)
+            logs = self._update_logs(result, logs, step)
+        out = {}
+        if 'loss' in logs:
+            out['loss'] = logs['loss']
+        for m in self._metrics:
+            res = m.accumulate()
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            vals = res if isinstance(res, list) else [res]
+            for n, v in zip(names, vals):
+                out[n] = v
+        return out
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        loader = self._to_loader(test_data, batch_size, False, False,
+                                 num_workers)
+        outputs = []
+        for batch in loader:
+            ins, _ = self._split_batch(batch, has_label=False)
+            outputs.append(self.predict_batch(ins))
+        if stack_outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs])
+                    for i in range(n_out)]
+        return outputs
+
+    # -- persistence ----------------------------------------------------------
+    def save(self, path, training=True):
+        framework.save(self.network.state_dict(), path + '.pdparams')
+        if training and self._optimizer is not None:
+            framework.save(self._optimizer.state_dict(), path + '.pdopt')
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        sd = framework.load(path + '.pdparams')
+        self.network.set_state_dict(sd)
+        import os
+        if not reset_optimizer and self._optimizer is not None \
+                and os.path.exists(path + '.pdopt'):
+            self._optimizer.set_state_dict(framework.load(path + '.pdopt'))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary as _summary
+        return _summary(self.network, input_size, dtype)
+
+    # -- helpers ---------------------------------------------------------------
+    @staticmethod
+    def _safe_len(loader):
+        try:
+            return len(loader)
+        except TypeError:
+            return None
+
+    @staticmethod
+    def _to_list(x):
+        if x is None:
+            return []
+        if isinstance(x, (list, tuple)):
+            return list(x)
+        return [x]
+
+    @staticmethod
+    def _to_tensor(x):
+        return x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+
+    def _to_loader(self, data, batch_size, shuffle, drop_last, num_workers):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          drop_last=drop_last, num_workers=num_workers)
+
+    def _split_batch(self, batch, has_label=True):
+        batch = list(batch) if isinstance(batch, (list, tuple)) else [batch]
+        if not has_label or self._loss is None:
+            return batch, []
+        n_in = len(self._inputs) if self._inputs else max(1, len(batch) - 1)
+        return batch[:n_in], batch[n_in:]
+
+    def _metrics_name(self):
+        names = ['loss']
+        for m in self._metrics:
+            n = m.name()
+            names.extend(n if isinstance(n, list) else [n])
+        return names
+
+    def _update_logs(self, result, logs, step):
+        if isinstance(result, tuple):
+            losses, _ = result
+        else:
+            losses = result
+        loss_v = losses[0][0]
+        logs = dict(logs)
+        prev = logs.get('loss', loss_v)
+        logs['loss'] = (prev * step + loss_v) / (step + 1)
+        logs['step'] = step
+        for m in self._metrics:
+            res = m.accumulate()
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            vals = res if isinstance(res, list) else [res]
+            for n, v in zip(names, vals):
+                logs[n] = v
+        return logs
+
+    def _optimizer_lr_scheduler(self):
+        if self._optimizer is None:
+            return None
+        from ..optimizer.lr import LRScheduler
+        lr = self._optimizer._learning_rate
+        return lr if isinstance(lr, LRScheduler) else None

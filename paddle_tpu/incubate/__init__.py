@@ -1,0 +1,2 @@
+"""paddle_tpu.incubate (parity: python/paddle/incubate)."""
+from . import optimizer

@@ -1,0 +1,83 @@
+"""Incubate optimizers: LookAhead, ModelAverage (parity:
+python/paddle/incubate/optimizer)."""
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..optimizer import Optimizer
+
+
+class LookAhead(Optimizer):
+    """Parity: incubate/optimizer/lookahead.py — k fast steps then slow-weight
+    interpolation."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._slow = {}
+        self._k_count = 0
+        self._parameter_list = inner_optimizer._parameter_list
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._k_count += 1
+        if self._k_count % self.k == 0:
+            for p in self._parameter_list:
+                key = p.name or str(id(p))
+                slow = self._slow.get(key)
+                if slow is None:
+                    slow = p.data
+                slow = slow + self.alpha * (p.data - slow)
+                self._slow[key] = slow
+                p.data = slow
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def state_dict(self):
+        return self.inner_optimizer.state_dict()
+
+    def set_state_dict(self, sd):
+        self.inner_optimizer.set_state_dict(sd)
+
+
+class ModelAverage(Optimizer):
+    """Parity: incubate/optimizer/modelaverage.py — running average of params
+    applied at eval time."""
+
+    def __init__(self, average_window_rate, parameters=None, min_average_window=10000,
+                 max_average_window=10000, name=None):
+        super().__init__(0.0, parameters)
+        self._sum = {}
+        self._count = 0
+        self._saved = None
+
+    def step(self):
+        self._count += 1
+        for p in self._parameter_list or []:
+            key = p.name or str(id(p))
+            self._sum[key] = self._sum.get(key, 0) + p.data
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            saved = [(p, p.data) for p in self._parameter_list or []]
+            for p in self._parameter_list or []:
+                key = p.name or str(id(p))
+                if key in self._sum and self._count:
+                    p.data = (self._sum[key] / self._count).astype(p.dtype)
+            try:
+                yield
+            finally:
+                if need_restore:
+                    for p, d in saved:
+                        p.data = d
+        return ctx()
+
+    def restore(self, executor=None):
+        pass

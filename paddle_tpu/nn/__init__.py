@@ -1,0 +1,39 @@
+"""paddle_tpu.nn — layers + functional.
+
+Reference parity: python/paddle/nn/__init__.py surface.
+"""
+from . import functional
+from . import initializer
+from .layer.base import Layer, ParamAttr
+from .layer.common import (Linear, Embedding, Dropout, Dropout2D,
+                           AlphaDropout, Flatten, Identity, Pad1D, Pad2D,
+                           Pad3D, Upsample, UpsamplingBilinear2D,
+                           UpsamplingNearest2D, Bilinear, CosineSimilarity,
+                           Unfold)
+from .layer.container import (Sequential, LayerList, LayerDict,
+                              ParameterList)
+from .layer.conv import (Conv1D, Conv2D, Conv3D, Conv2DTranspose,
+                         Conv1DTranspose)
+from .layer.norm import (BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
+                         SyncBatchNorm, LayerNorm, GroupNorm, InstanceNorm1D,
+                         InstanceNorm2D, InstanceNorm3D, LocalResponseNorm,
+                         SpectralNorm)
+from .layer.activation import (ReLU, ReLU6, Sigmoid, Tanh, GELU, ELU, SELU,
+                               CELU, Silu, Swish, Mish, Hardswish,
+                               Hardsigmoid, Hardshrink, Hardtanh, Softshrink,
+                               Softplus, Softsign, Tanhshrink,
+                               ThresholdedReLU, LogSigmoid, Maxout, LeakyReLU,
+                               PReLU, Softmax, LogSoftmax)
+from .layer.pooling import (AvgPool1D, AvgPool2D, MaxPool1D, MaxPool2D,
+                            AdaptiveAvgPool1D, AdaptiveAvgPool2D,
+                            AdaptiveMaxPool2D)
+from .layer.loss import (CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss,
+                         BCEWithLogitsLoss, KLDivLoss, SmoothL1Loss,
+                         MarginRankingLoss)
+from .layer.transformer import (MultiHeadAttention, TransformerEncoderLayer,
+                                TransformerEncoder, TransformerDecoderLayer,
+                                TransformerDecoder, Transformer)
+from .layer.rnn import (RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN,
+                        SimpleRNN, LSTM, GRU, BiRNN)
+from .clip import (ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm,
+                   clip_grad_norm_)

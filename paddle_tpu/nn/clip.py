@@ -1,0 +1,98 @@
+"""Gradient clipping.
+
+Reference parity: python/paddle/fluid/clip.py — ClipGradByValue,
+ClipGradByNorm, ClipGradByGlobalNorm; applied by optimizers over
+params_grads before the update (optimizer.py _create_optimization_pass).
+"""
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, 'need_clip', True):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor(jnp.clip(g.data, self.min, self.max))))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, 'need_clip', True):
+                out.append((p, g))
+                continue
+            norm = jnp.sqrt(jnp.sum(g.data.astype(jnp.float32) ** 2))
+            factor = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12),
+                                 1.0)
+            out.append((p, Tensor((g.data * factor).astype(g.dtype))))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """Parity: fluid/clip.py GradientClipByGlobalNorm. The hybrid-parallel
+    variant (TP/PP-aware partial norms + cross-mesh allreduce, reference
+    hybrid_parallel_optimizer.py:32) lives in
+    distributed/fleet/meta_optimizers/dygraph_optimizer."""
+
+    def __init__(self, clip_norm=1.0, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def global_norm(self, params_grads):
+        sq = 0.0
+        for p, g in params_grads:
+            if g is None or not getattr(p, 'need_clip', True):
+                continue
+            sq = sq + jnp.sum(g.data.astype(jnp.float32) ** 2)
+        return jnp.sqrt(sq)
+
+    def __call__(self, params_grads):
+        gn = self.global_norm(params_grads)
+        factor = self.clip_norm / jnp.maximum(gn, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, 'need_clip', True):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor((g.data.astype(jnp.float32) * factor)
+                                  .astype(g.dtype))))
+        return out
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.asarray(0.0))
+    if norm_type == float('inf'):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g.data)) for g in grads]))
+    else:
+        total = jnp.power(
+            sum(jnp.sum(jnp.power(jnp.abs(g.data.astype(jnp.float32)),
+                                  norm_type)) for g in grads),
+            1.0 / norm_type)
+    factor = jnp.minimum(max_norm / jnp.maximum(total, 1e-6), 1.0)
+    for p in parameters:
+        if p.grad is not None:
+            p.grad.data = (p.grad.data * factor).astype(p.grad.dtype)
+    return Tensor(total)

@@ -1,0 +1,28 @@
+"""paddle_tpu.nn.functional — functional API.
+
+Reference parity: python/paddle/nn/functional (11 modules re-exported flat).
+"""
+from ...ops.nn_ops import *  # noqa
+from ...ops.nn_ops import (  # explicit for linters
+    relu, relu6, gelu, elu, selu, celu, silu, swish, mish, leaky_relu, prelu,
+    softplus, softsign, hardsigmoid, hardswish, hardtanh, hardshrink,
+    softshrink, tanhshrink, thresholded_relu, log_sigmoid, maxout, softmax,
+    log_softmax, gumbel_softmax, layer_norm, batch_norm, group_norm,
+    instance_norm, local_response_norm, normalize, linear, conv1d, conv2d,
+    conv3d, conv2d_transpose, avg_pool1d, avg_pool2d, max_pool1d, max_pool2d,
+    adaptive_avg_pool2d, adaptive_max_pool2d, unfold, dropout, dropout2d,
+    alpha_dropout, embedding, softmax_with_cross_entropy, cross_entropy,
+    nll_loss, mse_loss, l1_loss, smooth_l1_loss, binary_cross_entropy,
+    binary_cross_entropy_with_logits, sigmoid_cross_entropy_with_logits,
+    kl_div, hinge_loss, margin_ranking_loss, log_loss, square_error_cost,
+    cosine_similarity, label_smooth, interpolate, upsample, grid_sample,
+    affine_grid, fused_softmax_mask_upper_triangle, temporal_shift,
+    npair_loss, one_hot, sequence_mask,
+)
+from ...ops.math import sigmoid, tanh  # noqa
+from ...ops.manip import pad  # noqa
+
+
+def diag_embed(*a, **k):
+    from ...ops.math import diag_embed as _d
+    return _d(*a, **k)

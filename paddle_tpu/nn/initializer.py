@@ -1,0 +1,169 @@
+"""Weight initializers.
+
+Reference parity: python/paddle/nn/initializer + fluid/initializer.py
+(Constant/Uniform/Normal/TruncatedNormal/Xavier/KaimingMSRA/Assign).
+Initializers are callables: (shape, dtype) -> jax array, drawing from the
+global RNG stream (core/rng.py).
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import rng, dtypes
+from ..core.tensor import Tensor
+
+
+class Initializer:
+    def __call__(self, shape, dtype=jnp.float32):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype=jnp.float32):
+        return jnp.full(tuple(shape), self.value, dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype=jnp.float32):
+        return jax.random.uniform(rng.next_key(), tuple(shape), dtype,
+                                  self.low, self.high)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=jnp.float32):
+        return jax.random.normal(rng.next_key(), tuple(shape), dtype) \
+            * self.std + self.mean
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=jnp.float32):
+        out = jax.random.truncated_normal(rng.next_key(), -2.0, 2.0,
+                                          tuple(shape), dtype)
+        return out * self.std + self.mean
+
+
+def _fan_in_out(shape):
+    shape = tuple(shape)
+    if len(shape) == 2:
+        fan_in, fan_out = shape[0], shape[1]
+    elif len(shape) >= 3:
+        rf = int(np.prod(shape[2:]))
+        fan_in, fan_out = shape[1] * rf, shape[0] * rf
+    else:
+        fan_in = fan_out = int(np.prod(shape)) if shape else 1
+    return fan_in, fan_out
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype=jnp.float32):
+        fi, fo = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(rng.next_key(), tuple(shape), dtype,
+                                  -limit, limit)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype=jnp.float32):
+        fi, fo = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        return jax.random.normal(rng.next_key(), tuple(shape), dtype) * std
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity='relu'):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+
+    def __call__(self, shape, dtype=jnp.float32):
+        fi, _ = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        limit = math.sqrt(6.0 / fi)
+        return jax.random.uniform(rng.next_key(), tuple(shape), dtype,
+                                  -limit, limit)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity='relu'):
+        self.fan_in = fan_in
+
+    def __call__(self, shape, dtype=jnp.float32):
+        fi, _ = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        std = math.sqrt(2.0 / fi)
+        return jax.random.normal(rng.next_key(), tuple(shape), dtype) * std
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype=jnp.float32):
+        v = self.value
+        if isinstance(v, Tensor):
+            v = v.data
+        return jnp.asarray(np.asarray(v), dtype).reshape(tuple(shape))
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype=jnp.float32):
+        return jax.nn.initializers.orthogonal(scale=self.gain)(
+            rng.next_key(), tuple(shape), dtype)
+
+
+class Dirac(Initializer):
+    def __init__(self, groups=1):
+        self.groups = groups
+
+    def __call__(self, shape, dtype=jnp.float32):
+        out = np.zeros(shape, dtype=np.float32)
+        oc, ic = shape[0], shape[1]
+        centers = [s // 2 for s in shape[2:]]
+        for i in range(min(oc, ic)):
+            out[(i, i) + tuple(centers)] = 1.0
+        return jnp.asarray(out, dtype)
+
+
+# Default initializer used by layers when weight_attr is None — matches
+# fluid's default XavierInitializer for weights, Constant(0) for bias.
+def _default_weight_init():
+    return XavierUniform()
+
+
+def _default_bias_init():
+    return Constant(0.0)
+
+
+def calculate_gain(nonlinearity, param=None):
+    gains = {'sigmoid': 1.0, 'linear': 1.0, 'conv2d': 1.0, 'tanh': 5.0 / 3,
+             'relu': math.sqrt(2.0), 'selu': 3.0 / 4}
+    if nonlinearity == 'leaky_relu':
+        a = param if param is not None else 0.01
+        return math.sqrt(2.0 / (1 + a ** 2))
+    return gains.get(nonlinearity, 1.0)

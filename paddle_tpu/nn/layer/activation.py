@@ -1,0 +1,92 @@
+"""Activation layers. Reference parity: python/paddle/nn/layer/activation.py."""
+from ...ops import nn_ops as F
+from .. import initializer as I
+from .base import Layer
+
+
+def _simple(name, fn, **defaults):
+    class _Act(Layer):
+        def __init__(self, name=None, **kwargs):
+            super().__init__()
+            self._kwargs = {**defaults, **{k: v for k, v in kwargs.items()
+                                           if k != 'name'}}
+
+        def forward(self, x):
+            return fn(x, **self._kwargs)
+    _Act.__name__ = name
+    _Act.__qualname__ = name
+    return _Act
+
+
+def _sigmoid(x):
+    from ...ops import math as M
+    return M.sigmoid(x)
+
+
+def _tanh(x):
+    from ...ops import math as M
+    return M.tanh(x)
+
+
+ReLU = _simple('ReLU', lambda x: F.relu(x))
+ReLU6 = _simple('ReLU6', lambda x: F.relu6(x))
+Sigmoid = _simple('Sigmoid', _sigmoid)
+Tanh = _simple('Tanh', _tanh)
+GELU = _simple('GELU', F.gelu)
+ELU = _simple('ELU', F.elu, alpha=1.0)
+SELU = _simple('SELU', F.selu)
+CELU = _simple('CELU', F.celu, alpha=1.0)
+Silu = _simple('Silu', lambda x: F.silu(x))
+Swish = _simple('Swish', lambda x: F.swish(x))
+Mish = _simple('Mish', lambda x: F.mish(x))
+Hardswish = _simple('Hardswish', lambda x: F.hardswish(x))
+Hardsigmoid = _simple('Hardsigmoid', lambda x: F.hardsigmoid(x))
+Hardshrink = _simple('Hardshrink', F.hardshrink, threshold=0.5)
+Hardtanh = _simple('Hardtanh', F.hardtanh, min=-1.0, max=1.0)
+Softshrink = _simple('Softshrink', F.softshrink, threshold=0.5)
+Softplus = _simple('Softplus', F.softplus, beta=1.0, threshold=20.0)
+Softsign = _simple('Softsign', lambda x: F.softsign(x))
+Tanhshrink = _simple('Tanhshrink', lambda x: F.tanhshrink(x))
+ThresholdedReLU = _simple('ThresholdedReLU', F.thresholded_relu, threshold=1.0)
+LogSigmoid = _simple('LogSigmoid', F.log_sigmoid)
+Maxout = _simple('Maxout', F.maxout, groups=1)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01, name=None):
+        super().__init__()
+        self._negative_slope = negative_slope
+
+    def forward(self, x):
+        return F.leaky_relu(x, self._negative_slope)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format='NCHW', name=None):
+        super().__init__()
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            [num_parameters], attr=weight_attr,
+            default_initializer=I.Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, self._data_format)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return F.softmax(x, axis=self._axis)
+
+
+class LogSoftmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return F.log_softmax(x, axis=self._axis)

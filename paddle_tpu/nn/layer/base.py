@@ -1,0 +1,308 @@
+"""nn.Layer — the module base class.
+
+Reference parity: python/paddle/fluid/dygraph/layers.py (`Layer`): parameter /
+buffer / sublayer registries via __setattr__, named_* iterators, state_dict /
+set_state_dict, train/eval propagation, forward pre/post hooks, apply, to.
+Parameters are Tensors with stop_gradient=False created through ParamAttr +
+initializers (fluid/param_attr.py).
+"""
+import collections
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...core import dtypes
+from ...core.tensor import Tensor
+from .. import initializer as init_mod
+
+
+class ParamAttr:
+    """Parity: fluid/param_attr.py ParamAttr."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=True,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        if attr is None:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        if isinstance(attr, init_mod.Initializer):
+            return ParamAttr(initializer=attr)
+        if attr is False:
+            return False
+        return ParamAttr()
+
+
+_name_counters = collections.defaultdict(int)
+
+
+def _unique_name(prefix):
+    _name_counters[prefix] += 1
+    return f"{prefix}_{_name_counters[prefix] - 1}"
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype='float32'):
+        self.training = True
+        self._dtype = dtypes.convert_dtype(dtype) if dtype else jnp.float32
+        self._parameters = collections.OrderedDict()
+        self._buffers = collections.OrderedDict()
+        self._sub_layers = collections.OrderedDict()
+        self._forward_pre_hooks = collections.OrderedDict()
+        self._forward_post_hooks = collections.OrderedDict()
+        self._full_name = _unique_name(
+            name_scope or type(self).__name__.lower())
+
+    # -- registration ------------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get('_parameters')
+        layers = self.__dict__.get('_sub_layers')
+        buffers = self.__dict__.get('_buffers')
+        if params is not None and isinstance(value, Tensor) \
+                and not value.stop_gradient:
+            params[name] = value
+            for d in (layers, buffers):
+                if d is not None and name in d:
+                    del d[name]
+            object.__setattr__(self, name, value)
+        elif layers is not None and isinstance(value, Layer):
+            layers[name] = value
+            if params is not None and name in params:
+                del params[name]
+            object.__setattr__(self, name, value)
+        else:
+            if params is not None and name in params and not isinstance(value, Tensor):
+                del params[name]
+            if buffers is not None and name in buffers:
+                if isinstance(value, Tensor):
+                    buffers[name] = value
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute {name!r}")
+
+    # -- parameter creation ------------------------------------------------
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        """Parity: Layer.create_parameter (dygraph/layers.py)."""
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        dtype = dtypes.convert_dtype(dtype) if dtype else self._dtype
+        init = attr.initializer or default_initializer or (
+            init_mod.Constant(0.0) if is_bias else init_mod.XavierUniform())
+        data = init(shape, dtype)
+        p = Tensor(data, stop_gradient=not attr.trainable)
+        p.name = attr.name or _unique_name('param')
+        p.persistable = True
+        p.optimize_attr = {'learning_rate': attr.learning_rate}
+        p.regularizer = attr.regularizer
+        p.need_clip = attr.need_clip
+        p.is_bias = is_bias
+        p.trainable = attr.trainable
+        return p
+
+    def add_parameter(self, name, parameter):
+        if parameter is not None:
+            self._parameters[name] = parameter
+        object.__setattr__(self, name, parameter)
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[name] = sublayer
+        object.__setattr__(self, name, sublayer)
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        self._buffers[name] = tensor
+        if tensor is not None:
+            tensor.persistable = persistable
+        object.__setattr__(self, name, tensor)
+        return tensor
+
+    # -- iteration ---------------------------------------------------------
+    def named_parameters(self, prefix='', include_sublayers=True):
+        seen = set()
+        for name, p in self._parameters.items():
+            if p is not None and id(p) not in seen:
+                seen.add(id(p))
+                yield (prefix + ('.' if prefix else '') + name, p)
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                if layer is None:
+                    continue
+                sub_prefix = prefix + ('.' if prefix else '') + lname
+                for n, p in layer.named_parameters(sub_prefix):
+                    if id(p) not in seen:
+                        seen.add(id(p))
+                        yield (n, p)
+
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_sublayers(self, prefix='', include_self=False):
+        if include_self:
+            yield prefix, self
+        for name, layer in self._sub_layers.items():
+            if layer is None:
+                continue
+            p = prefix + ('.' if prefix else '') + name
+            yield p, layer
+            yield from layer.named_sublayers(p)
+
+    def sublayers(self, include_self=False):
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def children(self):
+        return iter(l for l in self._sub_layers.values() if l is not None)
+
+    def named_children(self):
+        return iter((n, l) for n, l in self._sub_layers.items()
+                    if l is not None)
+
+    def named_buffers(self, prefix='', include_sublayers=True):
+        for name, b in self._buffers.items():
+            if b is not None:
+                yield (prefix + ('.' if prefix else '') + name, b)
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                if layer is None:
+                    continue
+                sub_prefix = prefix + ('.' if prefix else '') + lname
+                yield from layer.named_buffers(sub_prefix)
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(
+            include_sublayers=include_sublayers)]
+
+    # -- mode --------------------------------------------------------------
+    def train(self):
+        self.training = True
+        for l in self.sublayers():
+            l.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        for l in self.sublayers():
+            l.training = False
+        return self
+
+    def apply(self, fn):
+        for l in self.sublayers(include_self=True):
+            fn(l)
+        return self
+
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            dtype = dtypes.convert_dtype(dtype)
+            for p in self.parameters():
+                if dtypes.is_floating(p.dtype):
+                    p.data = p.data.astype(dtype)
+            for b in self.buffers():
+                if dtypes.is_floating(b.dtype):
+                    b.data = b.data.astype(dtype)
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def full_name(self):
+        return self._full_name
+
+    # -- state dict ---------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix='', use_hook=True):
+        dest = destination if destination is not None else collections.OrderedDict()
+        for name, p in self.named_parameters():
+            dest[structured_name_prefix + name] = p
+        for name, b in self.named_buffers():
+            if b is not None and getattr(b, 'persistable', True):
+                dest[structured_name_prefix + name] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        """Parity: Layer.set_state_dict — matches by structured name."""
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for k, v in state_dict.items():
+            if k in own:
+                tgt = own[k]
+                arr = v.data if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+                tgt.set_value(arr.astype(tgt.dtype)
+                              if dtypes.is_floating(tgt.dtype) else arr)
+            else:
+                unexpected.append(k)
+        for k in own:
+            if k not in state_dict:
+                missing.append(k)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    # -- hooks ---------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        handle = HookRemoveHelper(self._forward_pre_hooks)
+        self._forward_pre_hooks[handle.id] = hook
+        return handle
+
+    def register_forward_post_hook(self, hook):
+        handle = HookRemoveHelper(self._forward_post_hooks)
+        self._forward_post_hooks[handle.id] = hook
+        return handle
+
+    # -- call ----------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            result = hook(self, inputs)
+            if result is not None:
+                inputs = result if isinstance(result, tuple) else (result,)
+        out = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            result = hook(self, inputs, out)
+            if result is not None:
+                out = result
+        return out
+
+    def extra_repr(self):
+        return ''
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, layer in self._sub_layers.items():
+            body = repr(layer).split('\n')
+            body = [body[0]] + ['  ' + b for b in body[1:]]
+            lines.append(f"({name}): " + '\n'.join(body))
+        main = f"{type(self).__name__}({extra}"
+        if lines:
+            main += '\n  ' + '\n  '.join(lines) + '\n'
+        return main + ')'
+
+
+class HookRemoveHelper:
+    _next_id = 0
+
+    def __init__(self, hooks):
+        self._hooks = hooks
+        self.id = HookRemoveHelper._next_id
+        HookRemoveHelper._next_id += 1
+
+    def remove(self):
+        self._hooks.pop(self.id, None)

@@ -1,0 +1,118 @@
+"""Conv layers. Reference parity: python/paddle/nn/layer/conv.py."""
+import numpy as np
+
+from ...ops import nn_ops as F
+from .. import initializer as I
+from .base import Layer
+
+
+class _ConvNd(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride,
+                 padding, dilation, groups, weight_attr, bias_attr,
+                 data_format, nd=2, transpose=False, output_padding=0):
+        super().__init__()
+        self._in_channels = in_channels
+        self._out_channels = out_channels
+        k = kernel_size if isinstance(kernel_size, (list, tuple)) \
+            else (kernel_size,) * nd
+        self._kernel_size = tuple(k)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._groups = groups
+        self._data_format = data_format
+        self._output_padding = output_padding
+        if transpose:
+            w_shape = [in_channels, out_channels // groups, *k]
+        else:
+            w_shape = [out_channels, in_channels // groups, *k]
+        fan_in = (in_channels // groups) * int(np.prod(k))
+        self.weight = self.create_parameter(
+            w_shape, attr=weight_attr,
+            default_initializer=I.XavierUniform(fan_in=fan_in))
+        self.bias = self.create_parameter([out_channels], attr=bias_attr,
+                                          is_bias=True)
+
+
+class Conv1D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode='zeros',
+                 weight_attr=None, bias_attr=None, data_format='NCL'):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, weight_attr, bias_attr,
+                         data_format, nd=1)
+
+    def forward(self, x):
+        return F.conv1d(x, self.weight, self.bias, self._stride,
+                        self._padding, self._dilation, self._groups)
+
+
+class Conv2D(_ConvNd):
+    """Parity: nn.Conv2D → operators/conv_op (MXU via
+    lax.conv_general_dilated)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode='zeros',
+                 weight_attr=None, bias_attr=None, data_format='NCHW'):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, weight_attr, bias_attr,
+                         data_format, nd=2)
+
+    def forward(self, x):
+        return F.conv2d(x, self.weight, self.bias, self._stride,
+                        self._padding, self._dilation, self._groups,
+                        self._data_format)
+
+    def extra_repr(self):
+        return (f"{self._in_channels}, {self._out_channels}, "
+                f"kernel_size={self._kernel_size}, stride={self._stride}")
+
+
+class Conv3D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode='zeros',
+                 weight_attr=None, bias_attr=None, data_format='NCDHW'):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, weight_attr, bias_attr,
+                         data_format, nd=3)
+
+    def forward(self, x):
+        return F.conv3d(x, self.weight, self.bias, self._stride,
+                        self._padding, self._dilation, self._groups)
+
+
+class Conv2DTranspose(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None, data_format='NCHW'):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, weight_attr, bias_attr,
+                         data_format, nd=2, transpose=True,
+                         output_padding=output_padding)
+
+    def forward(self, x, output_size=None):
+        return F.conv2d_transpose(x, self.weight, self.bias, self._stride,
+                                  self._padding, self._output_padding,
+                                  self._dilation, self._groups, output_size)
+
+
+class Conv1DTranspose(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None, data_format='NCL'):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, weight_attr, bias_attr,
+                         data_format, nd=1, transpose=True,
+                         output_padding=output_padding)
+
+    def forward(self, x, output_size=None):
+        import jax.numpy as jnp
+        from ...core.autograd import run_op
+        x4 = run_op('unsqueeze2', lambda a: jnp.expand_dims(a, -1), [x])
+        w4 = run_op('unsqueeze2', lambda a: jnp.expand_dims(a, -1),
+                    [self.weight])
+        s = self._stride if isinstance(self._stride, int) else self._stride[0]
+        p = self._padding if isinstance(self._padding, int) else self._padding[0]
+        out = F.conv2d_transpose(x4, w4, self.bias, (s, 1),
+                                 [(p, p), (0, 0)], 0, 1, self._groups)
+        return run_op('squeeze2', lambda a: jnp.squeeze(a, -1), [out])

@@ -1,0 +1,104 @@
+"""Loss layers. Reference parity: python/paddle/nn/layer/loss.py."""
+from ...ops import nn_ops as F
+from .base import Layer
+
+
+class CrossEntropyLoss(Layer):
+    def __init__(self, weight=None, ignore_index=-100, reduction='mean',
+                 soft_label=False, axis=-1, use_softmax=True, name=None):
+        super().__init__()
+        self._weight = weight
+        self._ignore_index = ignore_index
+        self._reduction = reduction
+        self._soft_label = soft_label
+        self._axis = axis
+        self._use_softmax = use_softmax
+
+    def forward(self, input, label):
+        return F.cross_entropy(input, label, weight=self._weight,
+                               ignore_index=self._ignore_index,
+                               reduction=self._reduction,
+                               soft_label=self._soft_label, axis=self._axis,
+                               use_softmax=self._use_softmax)
+
+
+class MSELoss(Layer):
+    def __init__(self, reduction='mean'):
+        super().__init__()
+        self._reduction = reduction
+
+    def forward(self, input, label):
+        return F.mse_loss(input, label, reduction=self._reduction)
+
+
+class L1Loss(Layer):
+    def __init__(self, reduction='mean', name=None):
+        super().__init__()
+        self._reduction = reduction
+
+    def forward(self, input, label):
+        return F.l1_loss(input, label, reduction=self._reduction)
+
+
+class NLLLoss(Layer):
+    def __init__(self, weight=None, ignore_index=-100, reduction='mean',
+                 name=None):
+        super().__init__()
+        self._args = (weight, ignore_index, reduction)
+
+    def forward(self, input, label):
+        w, ig, red = self._args
+        return F.nll_loss(input, label, weight=w, ignore_index=ig,
+                          reduction=red)
+
+
+class BCELoss(Layer):
+    def __init__(self, weight=None, reduction='mean', name=None):
+        super().__init__()
+        self._weight, self._reduction = weight, reduction
+
+    def forward(self, input, label):
+        return F.binary_cross_entropy(input, label, weight=self._weight,
+                                      reduction=self._reduction)
+
+
+class BCEWithLogitsLoss(Layer):
+    def __init__(self, weight=None, reduction='mean', pos_weight=None,
+                 name=None):
+        super().__init__()
+        self._args = (weight, reduction, pos_weight)
+
+    def forward(self, logit, label):
+        w, red, pw = self._args
+        return F.binary_cross_entropy_with_logits(logit, label, weight=w,
+                                                  reduction=red,
+                                                  pos_weight=pw)
+
+
+class KLDivLoss(Layer):
+    def __init__(self, reduction='mean'):
+        super().__init__()
+        self._reduction = reduction
+
+    def forward(self, input, label):
+        return F.kl_div(input, label, reduction=self._reduction)
+
+
+class SmoothL1Loss(Layer):
+    def __init__(self, reduction='mean', delta=1.0, name=None):
+        super().__init__()
+        self._reduction, self._delta = reduction, delta
+
+    def forward(self, input, label):
+        return F.smooth_l1_loss(input, label, reduction=self._reduction,
+                                delta=self._delta)
+
+
+class MarginRankingLoss(Layer):
+    def __init__(self, margin=0.0, reduction='mean', name=None):
+        super().__init__()
+        self._margin, self._reduction = margin, reduction
+
+    def forward(self, input, other, label):
+        return F.margin_ranking_loss(input, other, label, self._margin,
+                                     self._reduction)

@@ -1,0 +1,82 @@
+"""Pooling layers. Reference parity: python/paddle/nn/layer/pooling.py."""
+from ...ops import nn_ops as F
+from .base import Layer
+
+
+class AvgPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, exclusive=True,
+                 ceil_mode=False, name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, exclusive, ceil_mode)
+
+    def forward(self, x):
+        k, s, p, ex, cm = self.args
+        return F.avg_pool1d(x, k, s, p, exclusive=ex, ceil_mode=cm)
+
+
+class AvgPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, divisor_override=None, data_format='NCHW',
+                 name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, ceil_mode, exclusive,
+                     divisor_override)
+
+    def forward(self, x):
+        k, s, p, cm, ex, dv = self.args
+        return F.avg_pool2d(x, k, s, p, ceil_mode=cm, exclusive=ex,
+                            divisor_override=dv)
+
+
+class MaxPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
+                 ceil_mode=False, name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, return_mask, ceil_mode)
+
+    def forward(self, x):
+        k, s, p, rm, cm = self.args
+        return F.max_pool1d(x, k, s, p, return_mask=rm, ceil_mode=cm)
+
+
+class MaxPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
+                 ceil_mode=False, data_format='NCHW', name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, return_mask, ceil_mode)
+
+    def forward(self, x):
+        k, s, p, rm, cm = self.args
+        return F.max_pool2d(x, k, s, p, return_mask=rm, ceil_mode=cm)
+
+
+class AdaptiveAvgPool2D(Layer):
+    def __init__(self, output_size, data_format='NCHW', name=None):
+        super().__init__()
+        self._output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_avg_pool2d(x, self._output_size)
+
+
+class AdaptiveMaxPool2D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self._output_size = output_size
+        self._return_mask = return_mask
+
+    def forward(self, x):
+        return F.adaptive_max_pool2d(x, self._output_size, self._return_mask)
+
+
+class AdaptiveAvgPool1D(Layer):
+    def __init__(self, output_size, name=None):
+        super().__init__()
+        self._output_size = output_size
+
+    def forward(self, x):
+        import jax.numpy as jnp
+        from ...core.autograd import run_op
+        x4 = run_op('unsqueeze2', lambda a: jnp.expand_dims(a, -1), [x])
+        out = F.adaptive_avg_pool2d(x4, (self._output_size, 1))
+        return run_op('squeeze2', lambda a: jnp.squeeze(a, -1), [out])

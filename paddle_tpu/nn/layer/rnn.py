@@ -1,0 +1,376 @@
+"""Recurrent layers.
+
+Reference parity: python/paddle/nn/layer/rnn.py (SimpleRNNCell, LSTMCell,
+GRUCell, RNN, BiRNN, SimpleRNN/LSTM/GRU multi-layer stacks) over
+operators/rnn_op. TPU-native design: the whole time loop is ONE traced op
+built on `jax.lax.scan` — compiler-friendly static control flow instead of the
+reference's per-step kernel launches; grads flow through scan via jax.vjp.
+Gate order matches paddle: i, f, c(g), o for LSTM; r, z(u), c for GRU.
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...core.autograd import run_op
+from ...ops import math as M
+from ...ops import nn_ops as F
+from .. import initializer as I
+from .base import Layer
+from .container import LayerList
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype='float32',
+                           init_value=0.0, batch_dim_idx=0):
+        batch = batch_ref.shape[batch_dim_idx]
+        shape = shape or self.state_shape
+        if isinstance(shape, (list, tuple)) and isinstance(shape[0], (list, tuple)):
+            return tuple(Tensor(jnp.full((batch,) + tuple(s), init_value))
+                         for s in shape)
+        return Tensor(jnp.full((batch,) + tuple(shape), init_value))
+
+
+def _std_uniform(hidden_size):
+    std = 1.0 / math.sqrt(hidden_size)
+    return I.Uniform(-std, std)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        init = _std_uniform(hidden_size)
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+        self.weight_ih = self.create_parameter([hidden_size, input_size],
+                                               weight_ih_attr,
+                                               default_initializer=init)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size],
+                                               weight_hh_attr,
+                                               default_initializer=init)
+        self.bias_ih = self.create_parameter([hidden_size], bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+        self.bias_hh = self.create_parameter([hidden_size], bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def fn(x, h, wi, wh, bi, bh):
+            out = act(x @ wi.T + bi + h @ wh.T + bh)
+            return out, out
+        out, h = run_op('rnn_cell', fn, [inputs, states, self.weight_ih,
+                                         self.weight_hh, self.bias_ih,
+                                         self.bias_hh])
+        return out, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        init = _std_uniform(hidden_size)
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size],
+                                               weight_ih_attr,
+                                               default_initializer=init)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size],
+                                               weight_hh_attr,
+                                               default_initializer=init)
+        self.bias_ih = self.create_parameter([4 * hidden_size], bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+        self.bias_hh = self.create_parameter([4 * hidden_size], bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h, c = states
+
+        def fn(x, h0, c0, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + h0 @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            c1 = f * c0 + i * jnp.tanh(g)
+            h1 = o * jnp.tanh(c1)
+            return h1, h1, c1
+        out, h1, c1 = run_op('lstm_cell', fn,
+                             [inputs, h, c, self.weight_ih, self.weight_hh,
+                              self.bias_ih, self.bias_hh])
+        return out, (h1, c1)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        init = _std_uniform(hidden_size)
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size],
+                                               weight_ih_attr,
+                                               default_initializer=init)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size],
+                                               weight_hh_attr,
+                                               default_initializer=init)
+        self.bias_ih = self.create_parameter([3 * hidden_size], bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+        self.bias_hh = self.create_parameter([3 * hidden_size], bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def fn(x, h0, wi, wh, bi, bh):
+            xg = x @ wi.T + bi
+            hg = h0 @ wh.T + bh
+            xr, xz, xc = jnp.split(xg, 3, axis=-1)
+            hr, hz, hc = jnp.split(hg, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            c = jnp.tanh(xc + r * hc)
+            h1 = (1 - z) * c + z * h0
+            return h1, h1
+        out, h1 = run_op('gru_cell', fn,
+                         [inputs, states, self.weight_ih, self.weight_hh,
+                          self.bias_ih, self.bias_hh])
+        return out, h1
+
+
+def _scan_layer(mode, x, h0, c0, wi, wh, bi, bh, reverse=False):
+    """One direction of one recurrent layer as a lax.scan (jax-level fn)."""
+    xs = jnp.swapaxes(x, 0, 1)  # T, B, C
+
+    if mode == 'LSTM':
+        def step(carry, xt):
+            h, c = carry
+            gates = xt @ wi.T + bi + h @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            c1 = f * c + i * jnp.tanh(g)
+            h1 = o * jnp.tanh(c1)
+            return (h1, c1), h1
+        (hT, cT), ys = jax.lax.scan(step, (h0, c0), xs, reverse=reverse)
+    elif mode == 'GRU':
+        def step(h, xt):
+            xg = xt @ wi.T + bi
+            hg = h @ wh.T + bh
+            xr, xz, xc = jnp.split(xg, 3, axis=-1)
+            hr, hz, hc = jnp.split(hg, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            c = jnp.tanh(xc + r * hc)
+            h1 = (1 - z) * c + z * h
+            return h1, h1
+        hT, ys = jax.lax.scan(step, h0, xs, reverse=reverse)
+        cT = None
+    else:
+        act = jnp.tanh if mode == 'RNN_TANH' else jax.nn.relu
+
+        def step(h, xt):
+            h1 = act(xt @ wi.T + bi + h @ wh.T + bh)
+            return h1, h1
+        hT, ys = jax.lax.scan(step, h0, xs, reverse=reverse)
+        cT = None
+    return jnp.swapaxes(ys, 0, 1), hT, cT
+
+
+class _RNNBase(Layer):
+    """Multi-layer (bi)directional recurrent stack; parity nn.LSTM/GRU/
+    SimpleRNN with time_major=False default."""
+
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.direction = direction
+        self.time_major = time_major
+        self.dropout = dropout
+        self.num_directions = 2 if direction in ("bidirect",
+                                                 "bidirectional") else 1
+        g = {'LSTM': 4, 'GRU': 3}.get(mode, 1)
+        init = _std_uniform(hidden_size)
+        self._all_weights = []
+        for layer in range(num_layers):
+            for d in range(self.num_directions):
+                in_sz = input_size if layer == 0 \
+                    else hidden_size * self.num_directions
+                suffix = f"_l{layer}" + ("_reverse" if d else "")
+                wi = self.create_parameter([g * hidden_size, in_sz],
+                                           weight_ih_attr,
+                                           default_initializer=init)
+                wh = self.create_parameter([g * hidden_size, hidden_size],
+                                           weight_hh_attr,
+                                           default_initializer=init)
+                bi = self.create_parameter([g * hidden_size], bias_ih_attr,
+                                           is_bias=True,
+                                           default_initializer=init)
+                bh = self.create_parameter([g * hidden_size], bias_hh_attr,
+                                           is_bias=True,
+                                           default_initializer=init)
+                self.add_parameter(f"weight_ih{suffix}", wi)
+                self.add_parameter(f"weight_hh{suffix}", wh)
+                self.add_parameter(f"bias_ih{suffix}", bi)
+                self.add_parameter(f"bias_hh{suffix}", bh)
+                self._all_weights.append((wi, wh, bi, bh))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        x = inputs
+        if self.time_major:
+            from ...ops import manip
+            x = manip.transpose(x, [1, 0, 2])
+        batch = x.shape[0]
+        nd, nl, hs = self.num_directions, self.num_layers, self.hidden_size
+        is_lstm = self.mode == 'LSTM'
+
+        if initial_states is None:
+            z = Tensor(jnp.zeros([nl * nd, batch, hs], x.dtype))
+            initial_states = (z, Tensor(jnp.zeros_like(z.data))) if is_lstm else z
+        h0s = initial_states[0] if is_lstm else initial_states
+        c0s = initial_states[1] if is_lstm else None
+
+        mode = self.mode
+        weights = self._all_weights
+
+        tensors = [x, h0s] + ([c0s] if is_lstm else [])
+        for w in weights:
+            tensors.extend(w)
+
+        def fn(xa, h0a, *rest):
+            if is_lstm:
+                c0a, flat = rest[0], rest[1:]
+            else:
+                c0a, flat = None, rest
+            out = xa
+            hTs, cTs = [], []
+            for layer in range(nl):
+                ys = []
+                for d in range(nd):
+                    i = layer * nd + d
+                    wi, wh, bi, bh = flat[4 * i: 4 * i + 4]
+                    h0 = h0a[i]
+                    c0 = c0a[i] if is_lstm else None
+                    y, hT, cT = _scan_layer(mode, out, h0, c0, wi, wh, bi, bh,
+                                            reverse=(d == 1))
+                    ys.append(y)
+                    hTs.append(hT)
+                    if is_lstm:
+                        cTs.append(cT)
+                out = ys[0] if nd == 1 else jnp.concatenate(ys, axis=-1)
+            if is_lstm:
+                return out, jnp.stack(hTs), jnp.stack(cTs)
+            return out, jnp.stack(hTs)
+
+        outs = run_op(f'rnn_{mode.lower()}', fn, tensors)
+        if is_lstm:
+            y, hT, cT = outs
+            if self.time_major:
+                from ...ops import manip
+                y = manip.transpose(y, [1, 0, 2])
+            return y, (hT, cT)
+        y, hT = outs
+        if self.time_major:
+            from ...ops import manip
+            y = manip.transpose(y, [1, 0, 2])
+        return y, hT
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", *args, **kwargs):
+        mode = 'RNN_TANH' if activation == 'tanh' else 'RNN_RELU'
+        super().__init__(mode, input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, *args, **kwargs)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, *args,
+                 **kwargs):
+        super().__init__('LSTM', input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, *args, **kwargs)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, *args,
+                 **kwargs):
+        super().__init__('GRU', input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, *args, **kwargs)
+
+
+class RNN(Layer):
+    """Wrap a cell into a scan over time (parity: nn.RNN wrapper)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        from ...ops import manip
+        x = inputs if not self.time_major else manip.transpose(inputs,
+                                                               [1, 0, 2])
+        steps = x.shape[1]
+        states = initial_states
+        outputs = []
+        time_ids = range(steps - 1, -1, -1) if self.is_reverse \
+            else range(steps)
+        for t in time_ids:
+            xt = x[:, t]
+            out, states = self.cell(xt, states)
+            outputs.append(out)
+        if self.is_reverse:
+            outputs = outputs[::-1]
+        y = manip.stack(outputs, axis=1 if not self.time_major else 0)
+        return y, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops import manip
+        if initial_states is None:
+            sf = sb = None
+        else:
+            sf, sb = initial_states
+        yf, stf = self.rnn_fw(inputs, sf, sequence_length)
+        yb, stb = self.rnn_bw(inputs, sb, sequence_length)
+        return manip.concat([yf, yb], axis=-1), (stf, stb)

@@ -1,0 +1,376 @@
+"""Transformer stack.
+
+Reference parity: python/paddle/nn/layer/transformer.py — MultiHeadAttention
+(:109, with Cache/StaticCache for decoding), TransformerEncoderLayer(:437),
+TransformerEncoder(:622), TransformerDecoderLayer(:731), TransformerDecoder
+(:969), Transformer(:1112). Attention math stays as large batched matmuls so
+XLA tiles it onto the MXU; the Pallas flash-attention kernel
+(paddle_tpu/ops/pallas/flash_attention.py) is used automatically for long
+sequences when no additive mask is provided.
+"""
+import collections
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...core.autograd import run_op
+from ...ops import nn_ops as F
+from ...ops import math as M
+from ...ops import manip
+from .base import Layer
+from .common import Linear, Dropout
+from .norm import LayerNorm
+from .container import LayerList
+
+
+def _convert_attention_mask(attn_mask, dtype):
+    if attn_mask is None:
+        return None
+    if attn_mask.dtype == jnp.bool_:
+        return Tensor(jnp.where(attn_mask.data, 0.0, -1e9).astype(dtype))
+    return attn_mask
+
+
+class MultiHeadAttention(Layer):
+    """Parity: nn/layer/transformer.py:109."""
+
+    Cache = collections.namedtuple("Cache", ["k", "v"])
+    StaticCache = collections.namedtuple("StaticCache", ["k", "v"])
+
+    def __init__(self, embed_dim, num_heads, dropout=0.0, kdim=None,
+                 vdim=None, need_weights=False, weight_attr=None,
+                 bias_attr=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.kdim = kdim or embed_dim
+        self.vdim = vdim or embed_dim
+        self.num_heads = num_heads
+        self.dropout = dropout
+        self.need_weights = need_weights
+        self.head_dim = embed_dim // num_heads
+        assert self.head_dim * num_heads == embed_dim
+
+        self.q_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
+        self.k_proj = Linear(self.kdim, embed_dim, weight_attr, bias_attr)
+        self.v_proj = Linear(self.vdim, embed_dim, weight_attr, bias_attr)
+        self.out_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
+
+    def _prepare_qkv(self, query, key, value, cache=None):
+        q = self.q_proj(query)
+        q = manip.reshape(q, [0, 0, self.num_heads, self.head_dim])
+        q = manip.transpose(q, [0, 2, 1, 3])
+        if isinstance(cache, self.StaticCache):
+            k, v = cache.k, cache.v
+        else:
+            k = self.k_proj(key)
+            v = self.v_proj(value)
+            k = manip.reshape(k, [0, 0, self.num_heads, self.head_dim])
+            k = manip.transpose(k, [0, 2, 1, 3])
+            v = manip.reshape(v, [0, 0, self.num_heads, self.head_dim])
+            v = manip.transpose(v, [0, 2, 1, 3])
+        if isinstance(cache, self.Cache):
+            k = manip.concat([cache.k, k], axis=2)
+            v = manip.concat([cache.v, v], axis=2)
+            cache = self.Cache(k, v)
+        return (q, k, v) if cache is None else (q, k, v, cache)
+
+    def gen_cache(self, key, value=None, type=Cache):
+        if type == MultiHeadAttention.StaticCache:
+            k = self.k_proj(key)
+            v = self.v_proj(value if value is not None else key)
+            k = manip.transpose(
+                manip.reshape(k, [0, 0, self.num_heads, self.head_dim]),
+                [0, 2, 1, 3])
+            v = manip.transpose(
+                manip.reshape(v, [0, 0, self.num_heads, self.head_dim]),
+                [0, 2, 1, 3])
+            return self.StaticCache(k, v)
+        if value is None:
+            batch = key.shape[0]
+            k = Tensor(jnp.zeros([batch, self.num_heads, 0, self.head_dim],
+                                 key.dtype))
+            v = Tensor(jnp.zeros([batch, self.num_heads, 0, self.head_dim],
+                                 key.dtype))
+            return self.Cache(k, v)
+        return self.Cache(key, value)
+
+    def core_attention(self, q, k, v, attn_mask=None):
+        scale = self.head_dim ** -0.5
+        product = M.matmul(M.scale(q, scale), k, transpose_y=True)
+        if attn_mask is not None:
+            attn_mask = _convert_attention_mask(attn_mask, product.dtype)
+            product = M.add(product, attn_mask)
+        weights = F.softmax(product)
+        if self.dropout:
+            weights = F.dropout(weights, self.dropout, training=self.training)
+        out = M.matmul(weights, v)
+        return out, weights
+
+    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+        key = query if key is None else key
+        value = key if value is None else value
+        if cache is None:
+            q, k, v = self._prepare_qkv(query, key, value)
+        else:
+            q, k, v, cache = self._prepare_qkv(query, key, value, cache)
+
+        out, weights = self.core_attention(q, k, v, attn_mask)
+        out = manip.transpose(out, [0, 2, 1, 3])
+        out = manip.reshape(out, [0, 0, self.embed_dim])
+        out = self.out_proj(out)
+
+        outs = [out]
+        if self.need_weights:
+            outs.append(weights)
+        if cache is not None:
+            outs.append(cache)
+        return out if len(outs) == 1 else tuple(outs)
+
+
+class TransformerEncoderLayer(Layer):
+    """Parity: nn/layer/transformer.py:437."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1,
+                 activation="relu", attn_dropout=None, act_dropout=None,
+                 normalize_before=False, weight_attr=None, bias_attr=None):
+        super().__init__()
+        attn_dropout = dropout if attn_dropout is None else attn_dropout
+        act_dropout = dropout if act_dropout is None else act_dropout
+        self.normalize_before = normalize_before
+        self.self_attn = MultiHeadAttention(d_model, nhead,
+                                            dropout=attn_dropout,
+                                            weight_attr=weight_attr,
+                                            bias_attr=bias_attr)
+        self.linear1 = Linear(d_model, dim_feedforward, weight_attr, bias_attr)
+        self.dropout = Dropout(act_dropout, mode="upscale_in_train")
+        self.linear2 = Linear(dim_feedforward, d_model, weight_attr, bias_attr)
+        self.norm1 = LayerNorm(d_model)
+        self.norm2 = LayerNorm(d_model)
+        self.dropout1 = Dropout(dropout, mode="upscale_in_train")
+        self.dropout2 = Dropout(dropout, mode="upscale_in_train")
+        self.activation = getattr(F, activation)
+
+    def forward(self, src, src_mask=None, cache=None):
+        residual = src
+        if self.normalize_before:
+            src = self.norm1(src)
+        if cache is None:
+            src = self.self_attn(src, src, src, src_mask)
+        else:
+            src, incremental_cache = self.self_attn(src, src, src, src_mask,
+                                                    cache)
+        src = M.add(residual, self.dropout1(src))
+        if not self.normalize_before:
+            src = self.norm1(src)
+
+        residual = src
+        if self.normalize_before:
+            src = self.norm2(src)
+        src = self.linear2(self.dropout(self.activation(self.linear1(src))))
+        src = M.add(residual, self.dropout2(src))
+        if not self.normalize_before:
+            src = self.norm2(src)
+        return src if cache is None else (src, incremental_cache)
+
+    def gen_cache(self, src):
+        return self.self_attn.gen_cache(src)
+
+
+class TransformerEncoder(Layer):
+    """Parity: nn/layer/transformer.py:622."""
+
+    def __init__(self, encoder_layer, num_layers, norm=None):
+        super().__init__()
+        import copy
+        self.layers = LayerList([encoder_layer] + [
+            type(encoder_layer)(**_layer_config(encoder_layer))
+            for _ in range(num_layers - 1)])
+        self.num_layers = num_layers
+        self.norm = norm
+
+    def forward(self, src, src_mask=None, cache=None):
+        output = src
+        new_caches = []
+        for i, mod in enumerate(self.layers):
+            if cache is None:
+                output = mod(output, src_mask=src_mask)
+            else:
+                output, new_cache = mod(output, src_mask=src_mask,
+                                        cache=cache[i])
+                new_caches.append(new_cache)
+        if self.norm is not None:
+            output = self.norm(output)
+        return output if cache is None else (output, new_caches)
+
+    def gen_cache(self, src):
+        return [layer.gen_cache(src) for layer in self.layers]
+
+
+def _layer_config(layer):
+    if isinstance(layer, TransformerEncoderLayer):
+        return dict(d_model=layer.self_attn.embed_dim,
+                    nhead=layer.self_attn.num_heads,
+                    dim_feedforward=layer.linear1.out_features,
+                    dropout=layer.dropout1.p,
+                    activation=layer.activation.__name__,
+                    attn_dropout=layer.self_attn.dropout,
+                    act_dropout=layer.dropout.p,
+                    normalize_before=layer.normalize_before)
+    if isinstance(layer, TransformerDecoderLayer):
+        return dict(d_model=layer.self_attn.embed_dim,
+                    nhead=layer.self_attn.num_heads,
+                    dim_feedforward=layer.linear1.out_features,
+                    dropout=layer.dropout1.p,
+                    activation=layer.activation.__name__,
+                    normalize_before=layer.normalize_before)
+    raise TypeError(type(layer))
+
+
+class TransformerDecoderLayer(Layer):
+    """Parity: nn/layer/transformer.py:731."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1,
+                 activation="relu", attn_dropout=None, act_dropout=None,
+                 normalize_before=False, weight_attr=None, bias_attr=None):
+        super().__init__()
+        attn_dropout = dropout if attn_dropout is None else attn_dropout
+        act_dropout = dropout if act_dropout is None else act_dropout
+        self.normalize_before = normalize_before
+        self.self_attn = MultiHeadAttention(d_model, nhead, attn_dropout,
+                                            weight_attr=weight_attr,
+                                            bias_attr=bias_attr)
+        self.cross_attn = MultiHeadAttention(d_model, nhead, attn_dropout,
+                                             weight_attr=weight_attr,
+                                             bias_attr=bias_attr)
+        self.linear1 = Linear(d_model, dim_feedforward, weight_attr, bias_attr)
+        self.dropout = Dropout(act_dropout, mode="upscale_in_train")
+        self.linear2 = Linear(dim_feedforward, d_model, weight_attr, bias_attr)
+        self.norm1 = LayerNorm(d_model)
+        self.norm2 = LayerNorm(d_model)
+        self.norm3 = LayerNorm(d_model)
+        self.dropout1 = Dropout(dropout, mode="upscale_in_train")
+        self.dropout2 = Dropout(dropout, mode="upscale_in_train")
+        self.dropout3 = Dropout(dropout, mode="upscale_in_train")
+        self.activation = getattr(F, activation)
+
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None,
+                cache=None):
+        residual = tgt
+        if self.normalize_before:
+            tgt = self.norm1(tgt)
+        if cache is None:
+            tgt = self.self_attn(tgt, tgt, tgt, tgt_mask)
+        else:
+            tgt, incremental_cache = self.self_attn(tgt, tgt, tgt, tgt_mask,
+                                                    cache[0])
+        tgt = M.add(residual, self.dropout1(tgt))
+        if not self.normalize_before:
+            tgt = self.norm1(tgt)
+
+        residual = tgt
+        if self.normalize_before:
+            tgt = self.norm2(tgt)
+        if cache is None:
+            tgt = self.cross_attn(tgt, memory, memory, memory_mask)
+        else:
+            tgt = self.cross_attn(tgt, memory, memory, memory_mask, cache[1])
+            tgt, static_cache = tgt if isinstance(tgt, tuple) else (tgt, cache[1])
+        tgt = M.add(residual, self.dropout2(tgt))
+        if not self.normalize_before:
+            tgt = self.norm2(tgt)
+
+        residual = tgt
+        if self.normalize_before:
+            tgt = self.norm3(tgt)
+        tgt = self.linear2(self.dropout(self.activation(self.linear1(tgt))))
+        tgt = M.add(residual, self.dropout3(tgt))
+        if not self.normalize_before:
+            tgt = self.norm3(tgt)
+        return tgt if cache is None else (tgt, (incremental_cache,
+                                                static_cache))
+
+    def gen_cache(self, memory):
+        incremental_cache = self.self_attn.gen_cache(memory)
+        static_cache = self.cross_attn.gen_cache(
+            memory, memory, type=MultiHeadAttention.StaticCache)
+        return incremental_cache, static_cache
+
+
+class TransformerDecoder(Layer):
+    """Parity: nn/layer/transformer.py:969."""
+
+    def __init__(self, decoder_layer, num_layers, norm=None):
+        super().__init__()
+        self.layers = LayerList([decoder_layer] + [
+            type(decoder_layer)(**_layer_config(decoder_layer))
+            for _ in range(num_layers - 1)])
+        self.num_layers = num_layers
+        self.norm = norm
+
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None,
+                cache=None):
+        output = tgt
+        new_caches = []
+        for i, mod in enumerate(self.layers):
+            if cache is None:
+                output = mod(output, memory, tgt_mask=tgt_mask,
+                             memory_mask=memory_mask)
+            else:
+                output, new_cache = mod(output, memory, tgt_mask=tgt_mask,
+                                        memory_mask=memory_mask,
+                                        cache=cache[i])
+                new_caches.append(new_cache)
+        if self.norm is not None:
+            output = self.norm(output)
+        return output if cache is None else (output, new_caches)
+
+    def gen_cache(self, memory, do_zip=False):
+        cache = [layer.gen_cache(memory) for layer in self.layers]
+        if do_zip:
+            cache = list(zip(*cache))
+        return cache
+
+
+class Transformer(Layer):
+    """Parity: nn/layer/transformer.py:1112."""
+
+    def __init__(self, d_model=512, nhead=8, num_encoder_layers=6,
+                 num_decoder_layers=6, dim_feedforward=2048, dropout=0.1,
+                 activation="relu", attn_dropout=None, act_dropout=None,
+                 normalize_before=False, weight_attr=None, bias_attr=None,
+                 custom_encoder=None, custom_decoder=None):
+        super().__init__()
+        if custom_encoder is not None:
+            self.encoder = custom_encoder
+        else:
+            encoder_layer = TransformerEncoderLayer(
+                d_model, nhead, dim_feedforward, dropout, activation,
+                attn_dropout, act_dropout, normalize_before, weight_attr,
+                bias_attr)
+            encoder_norm = LayerNorm(d_model) if normalize_before else None
+            self.encoder = TransformerEncoder(encoder_layer,
+                                              num_encoder_layers, encoder_norm)
+        if custom_decoder is not None:
+            self.decoder = custom_decoder
+        else:
+            decoder_layer = TransformerDecoderLayer(
+                d_model, nhead, dim_feedforward, dropout, activation,
+                attn_dropout, act_dropout, normalize_before, weight_attr,
+                bias_attr)
+            decoder_norm = LayerNorm(d_model) if normalize_before else None
+            self.decoder = TransformerDecoder(decoder_layer,
+                                              num_decoder_layers, decoder_norm)
+        self.d_model = d_model
+        self.nhead = nhead
+
+    def forward(self, src, tgt, src_mask=None, tgt_mask=None,
+                memory_mask=None):
+        memory = self.encoder(src, src_mask=src_mask)
+        output = self.decoder(tgt, memory, tgt_mask=tgt_mask,
+                              memory_mask=memory_mask)
+        return output
+
+    def generate_square_subsequent_mask(self, length):
+        return Tensor(jnp.tril(jnp.ones([length, length])) * 0
+                      + jnp.where(jnp.tril(jnp.ones([length, length],
+                                                    bool)), 0.0, -jnp.inf))

@@ -1,0 +1,10 @@
+"""paddle_tpu.ops — the op library (XLA-traceable, autograd-taped).
+
+Layout mirrors the reference's operator categories (SURVEY.md §1-L4):
+math.py (elementwise/reduce/compare), manip.py (shape/layout/index),
+creation.py (fill/random), nn_ops.py (activations/norm/conv/loss),
+linalg.py. The OP_REGISTRY in common.py is the lookup the static executor
+uses (parity: framework/op_registry.h).
+"""
+from . import common, math, manip, creation, nn_ops, linalg
+from .common import OP_REGISTRY

@@ -1,0 +1,80 @@
+"""Op-definition helpers.
+
+Reference parity: the role of paddle/fluid/framework/op_registry.h +
+pybind/op_function_generator.cc (build-time `core.ops.*` fast paths). Here each
+op is a jax-traceable function; `unary`/`binary`/`defop` wrap it with Tensor
+boxing/unboxing and tape recording via core.autograd.run_op. The registry dict
+maps op name → callable so the static Program executor (paddle_tpu.static) can
+look ops up by name, like the reference's OpRegistry.
+"""
+import jax.numpy as jnp
+
+from ..core import dtypes
+from ..core.autograd import run_op
+from ..core.tensor import Tensor
+
+OP_REGISTRY = {}
+
+
+def register(name, fn):
+    OP_REGISTRY[name] = fn
+    return fn
+
+
+def as_tensor(x, ref=None):
+    if isinstance(x, Tensor):
+        return x
+    dtype = None
+    if ref is not None and isinstance(x, (int, float, bool)) and not isinstance(x, bool):
+        dtype = ref.dtype
+    return Tensor(jnp.asarray(x, dtype=dtype))
+
+
+def _autocast(name, tensors):
+    """AMP hook — parity with imperative/tracer.cc:176-181 (AmpAutoCast)."""
+    from ..amp import amp_state, maybe_autocast_args
+    if not amp_state()['enabled']:
+        return tensors
+    return maybe_autocast_args(name, tensors)
+
+
+def defop(name, fn, n_nondiff=0):
+    """Wrap a jax function `fn(*arrays, **kwargs)` as a Tensor op."""
+    def op(*args, **kwargs):
+        tensors = []
+        for a in args:
+            tensors.append(as_tensor(a, ref=tensors[0] if tensors else None))
+        return run_op(name, fn, _autocast(name, tensors), kwargs,
+                      n_nondiff=n_nondiff)
+    op.__name__ = name
+    return register(name, op)
+
+
+def unary(name, fn):
+    def op(x, name=None, **kwargs):
+        kwargs.pop('name', None)
+        return run_op(name_, fn, _autocast(name_, [as_tensor(x)]), kwargs)
+    name_ = name
+    op.__name__ = name
+    return register(name, op)
+
+
+def _promote(x, y):
+    """Binary dtype promotion: scalars follow the tensor operand."""
+    if isinstance(x, Tensor) and not isinstance(y, Tensor):
+        y = as_tensor(y, ref=x)
+    elif isinstance(y, Tensor) and not isinstance(x, Tensor):
+        x = as_tensor(y, ref=y) if False else as_tensor(x, ref=y)
+    else:
+        x, y = as_tensor(x), as_tensor(y)
+    return x, y
+
+
+def binary(name, fn):
+    def op(x, y, name=None, **kwargs):
+        kwargs.pop('name', None)
+        tx, ty = _promote(x, y)
+        return run_op(name_, fn, _autocast(name_, [tx, ty]), kwargs)
+    name_ = name
+    op.__name__ = name
+    return register(name, op)

@@ -1,0 +1,211 @@
+"""Tensor creation + random ops.
+
+Reference parity: operators/ fill_constant, gaussian_random, uniform_random,
+randint, randperm, bernoulli, multinomial, linspace, arange, eye, tril/triu
+(SURVEY.md Appendix B); RNG semantics per core/rng.py (generator.h parity).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .common import as_tensor, register
+from ..core import dtypes, rng
+from ..core.tensor import Tensor
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def _dt(dtype, default=jnp.float32):
+    return dtypes.convert_dtype(dtype) if dtype is not None else default
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    x = as_tensor(x)
+    return Tensor(jnp.zeros_like(x.data, dtype=_dt(dtype, x.dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    x = as_tensor(x)
+    return Tensor(jnp.ones_like(x.data, dtype=_dt(dtype, x.dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    x = as_tensor(x)
+    return Tensor(jnp.full_like(x.data, fill_value, dtype=_dt(dtype, x.dtype)))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def val(v):
+        return v.item() if isinstance(v, Tensor) else v
+    start, end, step = val(start), val(end), val(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = jnp.int64 if all(isinstance(v, int) for v in (start, end, step)) \
+            else jnp.float32
+    return Tensor(jnp.arange(start, end, step, dtype=_dt(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def val(v):
+        return v.item() if isinstance(v, Tensor) else v
+    return Tensor(jnp.linspace(val(start), val(stop), int(val(num)), dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None):
+    return Tensor(jnp.logspace(start, stop, int(num), base=base, dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def assign(x, output=None):
+    x = as_tensor(x)
+    out = Tensor(x.data + 0 if dtypes.is_floating(x.dtype) else x.data)
+    if output is not None:
+        output.set_value(out)
+        return output
+    return out
+
+
+def clone(x):
+    return assign(x)
+
+
+def tril_(*a, **k):
+    from . import manip
+    return manip.tril(*a, **k)
+
+
+def diagflat(x, offset=0):
+    x = as_tensor(x)
+    return Tensor(jnp.diagflat(x.data, k=offset))
+
+
+def complex(real, imag):
+    real, imag = as_tensor(real), as_tensor(imag)
+    return Tensor(jax.lax.complex(real.data, imag.data))
+
+
+# ---- random ----------------------------------------------------------------
+def uniform(shape, dtype='float32', min=-1.0, max=1.0, seed=0, name=None):
+    """Parity: operators/uniform_random_op."""
+    key = rng.next_key() if seed == 0 else jax.random.key(seed)
+    return Tensor(jax.random.uniform(key, _shape(shape), _dt(dtype),
+                                     minval=min, maxval=max))
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype or 'float32', min=0.0, max=1.0)
+
+
+def randn(shape, dtype=None, name=None):
+    key = rng.next_key()
+    return Tensor(jax.random.normal(key, _shape(shape), _dt(dtype)))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = as_tensor(mean).data if isinstance(mean, Tensor) else mean
+        s = as_tensor(std).data if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(
+            m.shape if hasattr(m, 'shape') else (),
+            s.shape if hasattr(s, 'shape') else ())
+        key = rng.next_key()
+        return Tensor(jax.random.normal(key, shp) * s + m)
+    key = rng.next_key()
+    return Tensor(jax.random.normal(key, _shape(shape)) * std + mean)
+
+
+def gaussian(shape, mean=0.0, std=1.0, dtype='float32', name=None):
+    key = rng.next_key()
+    return Tensor(jax.random.normal(key, _shape(shape), _dt(dtype)) * std + mean)
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def randint(low=0, high=None, shape=(1,), dtype='int64', name=None):
+    if high is None:
+        low, high = 0, low
+    key = rng.next_key()
+    return Tensor(jax.random.randint(key, _shape(shape), low, high, dtype=_dt(dtype, jnp.int64)))
+
+
+def randint_like(x, low=0, high=None, dtype=None):
+    x = as_tensor(x)
+    return randint(low, high, x.shape, dtype or x.dtype)
+
+
+def randperm(n, dtype='int64', name=None):
+    key = rng.next_key()
+    return Tensor(jax.random.permutation(key, n).astype(_dt(dtype, jnp.int64)))
+
+
+def shuffle(x, axis=0):
+    x = as_tensor(x)
+    key = rng.next_key()
+    return Tensor(jax.random.permutation(key, x.data, axis=axis))
+
+
+def bernoulli(x, name=None):
+    x = as_tensor(x)
+    key = rng.next_key()
+    return Tensor(jax.random.bernoulli(key, x.data).astype(x.dtype))
+
+
+def poisson(x):
+    x = as_tensor(x)
+    key = rng.next_key()
+    return Tensor(jax.random.poisson(key, x.data).astype(x.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    x = as_tensor(x)
+    key = rng.next_key()
+    probs = x.data / jnp.sum(x.data, axis=-1, keepdims=True)
+    if x.ndim == 1:
+        out = jax.random.choice(key, x.shape[0], (num_samples,),
+                                replace=replacement, p=probs)
+    else:
+        keys = jax.random.split(key, x.data.shape[0])
+        out = jnp.stack([
+            jax.random.choice(k, x.shape[-1], (num_samples,), replace=replacement, p=p)
+            for k, p in zip(keys, probs)])
+    return Tensor(out.astype(jnp.int64))
+
+
+def truncated_gaussian_random(shape, mean=0.0, std=1.0, dtype='float32'):
+    key = rng.next_key()
+    out = jax.random.truncated_normal(key, -2.0, 2.0, _shape(shape), _dt(dtype))
+    return Tensor(out * std + mean)

@@ -1,0 +1,155 @@
+"""Linear-algebra ops.
+
+Reference parity: operators/ cholesky, inverse, matmul family, bilinear ops
+(SURVEY.md Appendix B) + python/paddle/tensor/linalg.py surface.
+"""
+import jax
+import jax.numpy as jnp
+
+from .common import as_tensor
+from ..core.autograd import run_op
+from ..core.tensor import Tensor
+
+
+def cholesky(x, upper=False, name=None):
+    x = as_tensor(x)
+    def fn(a):
+        L = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(L, -1, -2) if upper else L
+    return run_op('cholesky', fn, [x])
+
+
+def inverse(x, name=None):
+    x = as_tensor(x)
+    return run_op('inverse', jnp.linalg.inv, [x])
+
+
+def matrix_power(x, n, name=None):
+    x = as_tensor(x)
+    return run_op('matrix_power', lambda a: jnp.linalg.matrix_power(a, n), [x])
+
+
+def matrix_rank(x, tol=None, hermitian=False):
+    x = as_tensor(x)
+    return Tensor(jnp.linalg.matrix_rank(x.data, tol=tol))
+
+
+def det(x):
+    x = as_tensor(x)
+    return run_op('determinant', jnp.linalg.det, [x])
+
+
+def slogdet(x):
+    x = as_tensor(x)
+    sign, logdet = jnp.linalg.slogdet(x.data)
+    return Tensor(jnp.stack([sign, logdet]))
+
+
+def svd(x, full_matrices=False):
+    x = as_tensor(x)
+    u, s, vh = jnp.linalg.svd(x.data, full_matrices=full_matrices)
+    return Tensor(u), Tensor(s), Tensor(jnp.swapaxes(vh, -1, -2))
+
+
+def qr(x, mode='reduced'):
+    x = as_tensor(x)
+    q, r = jnp.linalg.qr(x.data, mode=mode)
+    return Tensor(q), Tensor(r)
+
+
+def eig(x):
+    x = as_tensor(x)
+    w, v = jnp.linalg.eig(jax.device_get(x.data))
+    return Tensor(w), Tensor(v)
+
+
+def eigh(x, UPLO='L'):
+    x = as_tensor(x)
+    w, v = jnp.linalg.eigh(x.data, symmetrize_input=True)
+    return Tensor(w), Tensor(v)
+
+
+def eigvals(x):
+    x = as_tensor(x)
+    return Tensor(jnp.linalg.eigvals(jax.device_get(x.data)))
+
+
+def eigvalsh(x, UPLO='L'):
+    x = as_tensor(x)
+    return Tensor(jnp.linalg.eigvalsh(x.data))
+
+
+def pinv(x, rcond=1e-15, hermitian=False):
+    x = as_tensor(x)
+    return run_op('pinv', lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), [x])
+
+
+def solve(x, y):
+    x, y = as_tensor(x), as_tensor(y)
+    return run_op('solve', jnp.linalg.solve, [x, y])
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    x, y = as_tensor(x), as_tensor(y)
+    def fn(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return run_op('triangular_solve', fn, [x, y])
+
+
+def lstsq(x, y, rcond=None):
+    x, y = as_tensor(x), as_tensor(y)
+    sol, res, rank, sv = jnp.linalg.lstsq(x.data, y.data, rcond=rcond)
+    return Tensor(sol), Tensor(res), Tensor(rank), Tensor(sv)
+
+
+def lu(x, pivot=True):
+    x = as_tensor(x)
+    lu_, piv = jax.scipy.linalg.lu_factor(x.data)
+    return Tensor(lu_), Tensor(piv.astype(jnp.int32) + 1)
+
+
+def cholesky_solve(x, y, upper=False):
+    x, y = as_tensor(x), as_tensor(y)
+    def fn(b, chol):
+        return jax.scipy.linalg.cho_solve((chol, not upper), b)
+    return run_op('cholesky_solve', fn, [x, y])
+
+
+def cond(x, p=None):
+    x = as_tensor(x)
+    return Tensor(jnp.linalg.cond(x.data, p=p))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+    x = as_tensor(x)
+    return Tensor(jnp.cov(x.data, rowvar=rowvar, ddof=1 if ddof else 0))
+
+
+def corrcoef(x, rowvar=True):
+    x = as_tensor(x)
+    return Tensor(jnp.corrcoef(x.data, rowvar=rowvar))
+
+
+def bilinear_tensor_product(x, y, weight, bias=None):
+    """Parity: operators/bilinear_tensor_product_op."""
+    x, y, weight = as_tensor(x), as_tensor(y), as_tensor(weight)
+    tensors = [x, y, weight]
+    if bias is not None:
+        tensors.append(as_tensor(bias))
+    def fn(a, b, w, *rest):
+        out = jnp.einsum('bi,oij,bj->bo', a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+    return run_op('bilinear_tensor_product', fn, tensors)
+
+
+def einsum(equation, *operands):
+    tensors = [as_tensor(o) for o in operands]
+    return run_op('einsum', lambda *arrs: jnp.einsum(equation, *arrs), tensors)
+
+
+def histogramdd(*a, **k):
+    raise NotImplementedError

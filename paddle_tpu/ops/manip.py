@@ -1,0 +1,490 @@
+"""Shape/layout manipulation ops.
+
+Reference parity: operators/ reshape, transpose, concat, split, stack, squeeze,
+unsqueeze, expand_v2, tile, flip, roll, gather(_nd), scatter(_nd_add), slice,
+strided_slice, index_select, masked_select, tril_triu, unbind, unique, cast,
+one_hot_v2 (SURVEY.md Appendix B). All are pure jnp views/copies — XLA fuses.
+"""
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .common import as_tensor, register
+from ..core import dtypes
+from ..core.autograd import run_op
+from ..core.tensor import Tensor
+
+
+def _norm_shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    return [int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape]
+
+
+def cast(x, dtype):
+    x = as_tensor(x)
+    dt = dtypes.convert_dtype(dtype)
+    if dt == x.data.dtype:
+        return x
+    if dtypes.is_floating(dt) and dtypes.is_floating(x.data.dtype):
+        return run_op('cast', lambda a: a.astype(dt), [x])
+    return Tensor(x.data.astype(dt), stop_gradient=True)
+register('cast', cast)
+
+
+def reshape(x, shape, name=None):
+    x = as_tensor(x)
+    shape = _norm_shape(shape)
+    # paddle semantics: 0 means copy the input dim at that position
+    out_shape = [x.shape[i] if s == 0 else s for i, s in enumerate(shape)] \
+        if any(s == 0 for s in shape) else shape
+    return run_op('reshape2', lambda a: jnp.reshape(a, out_shape), [x])
+register('reshape2', reshape)
+
+
+def transpose(x, perm, name=None):
+    x = as_tensor(x)
+    return run_op('transpose2', lambda a: jnp.transpose(a, tuple(perm)), [x])
+register('transpose2', transpose)
+
+
+def moveaxis(x, source, destination):
+    x = as_tensor(x)
+    return run_op('moveaxis', lambda a: jnp.moveaxis(a, source, destination), [x])
+
+
+def swapaxes(x, axis0, axis1):
+    x = as_tensor(x)
+    return run_op('swapaxes', lambda a: jnp.swapaxes(a, axis0, axis1), [x])
+
+transpose_ = transpose
+
+
+def squeeze(x, axis=None, name=None):
+    x = as_tensor(x)
+    if isinstance(axis, int):
+        axis = [axis]
+    def fn(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        ax = tuple(i for i in axis if a.shape[i] == 1)
+        return jnp.squeeze(a, axis=ax) if ax else a
+    return run_op('squeeze2', fn, [x])
+
+
+def unsqueeze(x, axis, name=None):
+    x = as_tensor(x)
+    if isinstance(axis, int):
+        axis = [axis]
+    axis = [int(a.item()) if isinstance(a, Tensor) else int(a) for a in axis]
+    def fn(a):
+        out = a
+        for ax in sorted(axis):
+            out = jnp.expand_dims(out, ax)
+        return out
+    return run_op('unsqueeze2', fn, [x])
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = as_tensor(x)
+    nd = x.ndim
+    sa = start_axis % nd if nd else 0
+    ea = stop_axis % nd if nd else 0
+    new_shape = x.shape[:sa] + [-1] + x.shape[ea + 1:]
+    return run_op('flatten_contiguous_range',
+                  lambda a: jnp.reshape(a, new_shape), [x])
+
+
+def concat(x, axis=0, name=None):
+    tensors = [as_tensor(t) for t in x]
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return run_op('concat', lambda *arrs: jnp.concatenate(arrs, axis=axis), tensors)
+register('concat', concat)
+
+
+def stack(x, axis=0, name=None):
+    tensors = [as_tensor(t) for t in x]
+    return run_op('stack', lambda *arrs: jnp.stack(arrs, axis=axis), tensors)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    """Parity: operators/split_op."""
+    x = as_tensor(x)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(s) for s in num_or_sections]
+        n_neg = sizes.count(-1)
+        if n_neg:
+            rest = dim - builtins.sum(s for s in sizes if s != -1)
+            sizes = [rest if s == -1 else s for s in sizes]
+    offsets = np.cumsum([0] + sizes[:-1]).tolist()
+
+    def fn(a):
+        return tuple(jax.lax.slice_in_dim(a, o, o + s, axis=axis)
+                     for o, s in zip(offsets, sizes))
+    return list(run_op('split', fn, [x]))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis=axis)
+
+
+def unstack(x, axis=0, num=None):
+    x = as_tensor(x)
+    n = num or x.shape[axis]
+    def fn(a):
+        return tuple(jnp.squeeze(s, axis=axis)
+                     for s in jnp.split(a, n, axis=axis))
+    return list(run_op('unstack', fn, [x]))
+
+
+def unbind(x, axis=0):
+    return unstack(x, axis=axis)
+
+
+def tile(x, repeat_times, name=None):
+    x = as_tensor(x)
+    reps = _norm_shape(repeat_times)
+    return run_op('tile', lambda a: jnp.tile(a, tuple(reps)), [x])
+
+
+def expand(x, shape, name=None):
+    x = as_tensor(x)
+    shape = _norm_shape(shape)
+    tgt = [x.shape[i - (len(shape) - x.ndim)] if s == -1 else s
+           for i, s in enumerate(shape)]
+    return run_op('expand_v2', lambda a: jnp.broadcast_to(a, tgt), [x])
+
+
+def expand_as(x, y, name=None):
+    return expand(x, as_tensor(y).shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs):
+    arrs = jnp.broadcast_arrays(*[as_tensor(t).data for t in inputs])
+    return [Tensor(a) for a in arrs]
+
+
+def flip(x, axis, name=None):
+    x = as_tensor(x)
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return run_op('flip', lambda a: jnp.flip(a, axis=ax), [x])
+
+
+def roll(x, shifts, axis=None, name=None):
+    x = as_tensor(x)
+    return run_op('roll', lambda a: jnp.roll(a, shifts, axis=axis), [x])
+
+
+def rot90(x, k=1, axes=(0, 1)):
+    x = as_tensor(x)
+    return run_op('rot90', lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), [x])
+
+
+# ---- gather / scatter ------------------------------------------------------
+def gather(x, index, axis=0, name=None):
+    """Parity: operators/gather_op — select rows of `axis` by 1-D index."""
+    x, index = as_tensor(x), as_tensor(index)
+    def fn(a, idx):
+        return jnp.take(a, idx.reshape(-1), axis=axis)
+    return run_op('gather', fn, [x, index], n_nondiff=1)
+
+
+def gather_nd(x, index, name=None):
+    x, index = as_tensor(x), as_tensor(index)
+    def fn(a, idx):
+        return a[tuple(jnp.moveaxis(idx, -1, 0))]
+    return run_op('gather_nd', fn, [x, index], n_nondiff=1)
+
+
+def take_along_axis(x, indices, axis):
+    x, indices = as_tensor(x), as_tensor(indices)
+    def fn(a, idx):
+        return jnp.take_along_axis(a, idx, axis=axis)
+    return run_op('take_along_axis', fn, [x, indices], n_nondiff=1)
+
+
+def put_along_axis(x, indices, values, axis, reduce='assign'):
+    x, indices = as_tensor(x), as_tensor(indices)
+    values = as_tensor(values, ref=x)
+    def fn(a, v, idx):
+        if reduce == 'add':
+            return a.at[_along_axis_index(a, idx, axis)].add(v)
+        return a.at[_along_axis_index(a, idx, axis)].set(v)
+    return run_op('put_along_axis', fn, [x, values, indices], n_nondiff=1)
+
+
+def _along_axis_index(a, idx, axis):
+    ix = []
+    for d in range(a.ndim):
+        if d == axis:
+            ix.append(idx)
+        else:
+            shape = [1] * a.ndim
+            shape[d] = a.shape[d]
+            ix.append(jnp.arange(a.shape[d]).reshape(shape))
+    return tuple(ix)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    """Parity: operators/scatter_op — rows of x at `index` set/added."""
+    x = as_tensor(x)
+    updates = as_tensor(updates, ref=x)
+    index = as_tensor(index)
+    def fn(a, u, idx):
+        idx = idx.reshape(-1)
+        if overwrite:
+            return a.at[idx].set(u)
+        base = a.at[idx].set(jnp.zeros_like(u))
+        return base.at[idx].add(u)
+    return run_op('scatter', fn, [x, updates, index], n_nondiff=1)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    x = as_tensor(x)
+    updates = as_tensor(updates, ref=x)
+    index = as_tensor(index)
+    def fn(a, u, idx):
+        return a.at[tuple(jnp.moveaxis(idx, -1, 0))].add(u)
+    return run_op('scatter_nd_add', fn, [x, updates, index], n_nondiff=1)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    updates = as_tensor(updates)
+    zeros = Tensor(jnp.zeros(_norm_shape(shape), updates.dtype))
+    return scatter_nd_add(zeros, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    x, index = as_tensor(x), as_tensor(index)
+    def fn(a, idx):
+        return jnp.take(a, idx.reshape(-1), axis=axis)
+    return run_op('index_select', fn, [x, index], n_nondiff=1)
+
+
+def index_sample(x, index):
+    """Parity: operators/index_sample_op — per-row gather."""
+    x, index = as_tensor(x), as_tensor(index)
+    def fn(a, idx):
+        return jnp.take_along_axis(a, idx.astype(jnp.int32), axis=1)
+    return run_op('index_sample', fn, [x, index], n_nondiff=1)
+
+
+def masked_select(x, mask, name=None):
+    x, mask = as_tensor(x), as_tensor(mask)
+    arr = np.asarray(x.data)
+    m = np.asarray(mask.data)
+    return Tensor(arr[np.broadcast_to(m, arr.shape)])
+
+
+def masked_fill(x, mask, value):
+    x, mask = as_tensor(x), as_tensor(mask)
+    def fn(a, m):
+        return jnp.where(m, jnp.asarray(value, a.dtype), a)
+    return run_op('masked_fill', fn, [x, mask], n_nondiff=1)
+
+
+# ---- slicing ---------------------------------------------------------------
+def slice(x, axes, starts, ends, name=None):
+    """Parity: operators/slice_op."""
+    x = as_tensor(x)
+    starts = _norm_shape(starts)
+    ends = _norm_shape(ends)
+    def fn(a):
+        out = a
+        for ax, st, en in zip(axes, starts, ends):
+            dim = a.shape[ax]
+            st2 = st + dim if st < 0 else min(st, dim)
+            en2 = en + dim if en < 0 else min(en, dim)
+            out = jax.lax.slice_in_dim(out, st2, en2, axis=ax)
+        return out
+    return run_op('slice', fn, [x])
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    x = as_tensor(x)
+    def fn(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, st, en, sd in zip(axes, _norm_shape(starts), _norm_shape(ends),
+                                  _norm_shape(strides)):
+            idx[ax] = builtins.slice(st, en, sd)
+        return a[tuple(idx)]
+    return run_op('strided_slice', fn, [x])
+
+
+def getitem(x, idx):
+    x = as_tensor(x)
+    if isinstance(idx, Tensor):
+        if idx.dtype == jnp.bool_:
+            return masked_select(x, idx)
+        idx_arr = idx.data
+        return run_op('getitem', lambda a, i: a[i], [x, idx], n_nondiff=1)
+    if isinstance(idx, tuple):
+        idx = tuple(i.data if isinstance(i, Tensor) else i for i in idx)
+    return run_op('getitem', lambda a: a[idx], [x])
+
+
+def tril(x, diagonal=0, name=None):
+    x = as_tensor(x)
+    return run_op('tril_triu', lambda a: jnp.tril(a, k=diagonal), [x])
+
+
+def triu(x, diagonal=0, name=None):
+    x = as_tensor(x)
+    return run_op('tril_triu', lambda a: jnp.triu(a, k=diagonal), [x])
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    x = as_tensor(x)
+    return run_op('diagonal',
+                  lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2), [x])
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype='int64', name=None):
+    x = as_tensor(x)
+    res = np.unique(np.asarray(x.data), return_index=return_index,
+                    return_inverse=return_inverse, return_counts=return_counts,
+                    axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(res)
+    outs = [Tensor(res[0])]
+    for r in res[1:]:
+        outs.append(Tensor(r.astype(np.int64)))
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None):
+    x = as_tensor(x)
+    arr = np.asarray(x.data)
+    if axis is None:
+        arr = arr.reshape(-1)
+    keep = np.concatenate([[True], arr[1:] != arr[:-1]]) if arr.ndim == 1 else None
+    out = arr[keep]
+    outs = [Tensor(out)]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        outs.append(Tensor(inv.astype(np.int64)))
+    if return_counts:
+        idx = np.nonzero(keep)[0]
+        counts = np.diff(np.concatenate([idx, [len(arr)]]))
+        outs.append(Tensor(counts.astype(np.int64)))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+# ---- padding ---------------------------------------------------------------
+def pad(x, pad, mode='constant', value=0.0, data_format='NCHW', name=None):
+    """Parity: operators/pad3d / pad2d / pad_op."""
+    x = as_tensor(x)
+    pad = _norm_shape(pad)
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # paddle NCHW convention: pad is [left, right, top, bottom, ...] on
+        # trailing spatial dims, reversed axis order
+        n_spatial = len(pad) // 2
+        widths = [(0, 0)] * (nd - n_spatial)
+        spatial = []
+        for i in range(n_spatial):
+            spatial.append((pad[2 * i], pad[2 * i + 1]))
+        widths += spatial[::-1]
+    jmode = {'constant': 'constant', 'reflect': 'reflect',
+             'replicate': 'edge', 'circular': 'wrap'}[mode]
+    def fn(a):
+        if jmode == 'constant':
+            return jnp.pad(a, widths, mode='constant', constant_values=value)
+        return jnp.pad(a, widths, mode=jmode)
+    return run_op('pad3d', fn, [x])
+
+
+def one_hot(x, num_classes, name=None):
+    x = as_tensor(x)
+    return Tensor(jax.nn.one_hot(x.data, num_classes))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """Parity: operators/shard_index_op.cc — used by c_embedding."""
+    input = as_tensor(input)
+    shard_size = (index_num + nshards - 1) // nshards
+    def fn(idx):
+        lo = shard_id * shard_size
+        hi = (shard_id + 1) * shard_size
+        in_range = (idx >= lo) & (idx < hi)
+        return jnp.where(in_range, idx - lo, ignore_value)
+    return Tensor(fn(input.data))
+
+
+def meshgrid(*args, **kwargs):
+    tensors = [as_tensor(a) for a in (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
+    outs = jnp.meshgrid(*[t.data for t in tensors], indexing='ij')
+    return [Tensor(o) for o in outs]
+
+
+def repeat_interleave(x, repeats, axis=None):
+    x = as_tensor(x)
+    r = repeats.data if isinstance(repeats, Tensor) else repeats
+    return run_op('repeat_interleave',
+                  lambda a: jnp.repeat(a, r, axis=axis), [x])
+
+
+def as_complex(x):
+    x = as_tensor(x)
+    return run_op('as_complex', lambda a: jax.lax.complex(a[..., 0], a[..., 1]), [x])
+
+
+def as_real(x):
+    x = as_tensor(x)
+    return run_op('as_real', lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), [x])
+
+
+def real(x):
+    x = as_tensor(x)
+    return run_op('real', jnp.real, [x])
+
+
+def imag(x):
+    x = as_tensor(x)
+    return run_op('imag', jnp.imag, [x])
+
+
+def numel(x):
+    x = as_tensor(x)
+    return Tensor(jnp.asarray(x.size, dtype=jnp.int64))
+
+
+def shape(x):
+    x = as_tensor(x)
+    return Tensor(np.asarray(x.shape, dtype=np.int32))
+
+
+def space_to_depth(x, blocksize):
+    x = as_tensor(x)
+    def fn(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, c, h // blocksize, blocksize, w // blocksize, blocksize)
+        a = a.transpose(0, 3, 5, 1, 2, 4)
+        return a.reshape(n, c * blocksize * blocksize, h // blocksize, w // blocksize)
+    return run_op('space_to_depth', fn, [x])
+
+
+def pixel_shuffle(x, upscale_factor, data_format='NCHW'):
+    x = as_tensor(x)
+    r = upscale_factor
+    def fn(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, c // (r * r), r, r, h, w)
+        a = a.transpose(0, 1, 4, 2, 5, 3)
+        return a.reshape(n, c // (r * r), h * r, w * r)
+    return run_op('pixel_shuffle', fn, [x])

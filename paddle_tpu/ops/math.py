@@ -1,0 +1,438 @@
+"""Math / reduction / comparison / logic ops.
+
+Reference parity: paddle/fluid/operators root op families (Appendix B of
+SURVEY.md) — elementwise_*, reduce_*, activation, matmul_v2, argsort/top_k,
+compare/logical ops — re-expressed as XLA-traceable jnp functions; grads come
+from jax.vjp instead of hand-registered GradOpMakers.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .common import defop, unary, binary, as_tensor, register
+from ..core.autograd import run_op
+from ..core.tensor import Tensor
+
+# ---- elementwise binary (operators/elementwise/) --------------------------
+add = binary('elementwise_add', lambda x, y: x + y)
+subtract = binary('elementwise_sub', lambda x, y: x - y)
+multiply = binary('elementwise_mul', lambda x, y: x * y)
+divide = binary('elementwise_div', lambda x, y: x / y)
+floor_divide = binary('elementwise_floordiv', lambda x, y: jnp.floor_divide(x, y))
+remainder = binary('elementwise_mod', lambda x, y: jnp.remainder(x, y))
+pow = binary('elementwise_pow', lambda x, y: jnp.power(x, y))
+maximum = binary('elementwise_max', jnp.maximum)
+minimum = binary('elementwise_min', jnp.minimum)
+fmax = binary('elementwise_fmax', jnp.fmax)
+fmin = binary('elementwise_fmin', jnp.fmin)
+atan2 = binary('atan2', jnp.arctan2)
+hypot = binary('hypot', jnp.hypot)
+
+mod = remainder
+floor_mod = remainder
+
+# ---- unary math (operators/activation_op.cc etc.) -------------------------
+exp = unary('exp', jnp.exp)
+expm1 = unary('expm1', jnp.expm1)
+log = unary('log', jnp.log)
+log2 = unary('log2', jnp.log2)
+log10 = unary('log10', jnp.log10)
+log1p = unary('log1p', jnp.log1p)
+sqrt = unary('sqrt', jnp.sqrt)
+rsqrt = unary('rsqrt', jax.lax.rsqrt)
+square = unary('square', jnp.square)
+abs = unary('abs', jnp.abs)
+sign = unary('sign', jnp.sign)
+floor = unary('floor', jnp.floor)
+ceil = unary('ceil', jnp.ceil)
+round = unary('round', jnp.round)
+trunc = unary('trunc', jnp.trunc)
+reciprocal = unary('reciprocal', lambda x: 1.0 / x)
+neg = unary('neg', jnp.negative)
+sin = unary('sin', jnp.sin)
+cos = unary('cos', jnp.cos)
+tan = unary('tan', jnp.tan)
+asin = unary('asin', jnp.arcsin)
+acos = unary('acos', jnp.arccos)
+atan = unary('atan', jnp.arctan)
+sinh = unary('sinh', jnp.sinh)
+cosh = unary('cosh', jnp.cosh)
+tanh = unary('tanh', jnp.tanh)
+asinh = unary('asinh', jnp.arcsinh)
+acosh = unary('acosh', jnp.arccosh)
+atanh = unary('atanh', jnp.arctanh)
+sigmoid = unary('sigmoid', jax.nn.sigmoid)
+erf = unary('erf', jax.scipy.special.erf)
+lgamma = unary('lgamma', jax.scipy.special.gammaln)
+digamma = unary('digamma', jax.scipy.special.digamma)
+
+# ---- scale / clip / assign ------------------------------------------------
+scale = defop('scale', lambda x, scale=1.0, bias=0.0, bias_after_scale=True:
+              x * scale + bias if bias_after_scale else (x + bias) * scale)
+clip = defop('clip', lambda x, min=None, max=None: jnp.clip(x, min, max))
+assign = defop('assign', lambda x: x + 0)
+increment = defop('increment', lambda x, value=1.0: x + value)
+stanh = defop('stanh', lambda x, scale_a=0.67, scale_b=1.7159:
+              scale_b * jnp.tanh(scale_a * x))
+
+
+def clip_by_norm(x, max_norm):
+    x = as_tensor(x)
+    def fn(a):
+        norm = jnp.sqrt(jnp.sum(a * a))
+        return jnp.where(norm > max_norm, a * (max_norm / norm), a)
+    return run_op('clip_by_norm', fn, [x])
+
+
+# ---- matmul family --------------------------------------------------------
+def _matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        axes = list(range(x.ndim)); axes[-1], axes[-2] = axes[-2], axes[-1]
+        x = jnp.transpose(x, axes)
+    if transpose_y:
+        axes = list(range(y.ndim)); axes[-1], axes[-2] = axes[-2], axes[-1]
+        y = jnp.transpose(y, axes)
+    return jnp.matmul(x, y)
+
+matmul = binary('matmul_v2', _matmul)
+bmm = binary('bmm', jnp.matmul)
+mm = matmul
+dot = binary('dot', lambda x, y: jnp.sum(x * y, axis=-1))
+inner = binary('inner', jnp.inner)
+outer = binary('outer', jnp.outer)
+kron = binary('kron', jnp.kron)
+cross = binary('cross', jnp.cross)
+mv = binary('mv', jnp.matmul)
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    return add(scale(as_tensor(input), beta), scale(matmul(x, y), alpha))
+
+def multiply_(x, y):
+    return multiply(x, y)
+
+# ---- reductions (operators/reduce_ops/) -----------------------------------
+def _reduce(name, jfn):
+    def op(x, axis=None, keepdim=False, name=None):
+        x = as_tensor(x)
+        if isinstance(axis, (list, tuple)):
+            axis = tuple(axis) if len(axis) else None
+        return run_op(name_, lambda a, axis, keepdims: jfn(a, axis=axis, keepdims=keepdims),
+                      [x], {'axis': axis, 'keepdims': keepdim})
+    name_ = name
+    op.__name__ = name
+    return register(name, op)
+
+sum = _reduce('reduce_sum', jnp.sum)
+mean = _reduce('reduce_mean', jnp.mean)
+max = _reduce('reduce_max', jnp.max)
+min = _reduce('reduce_min', jnp.min)
+prod = _reduce('reduce_prod', jnp.prod)
+amax = max
+amin = min
+nansum = _reduce('nansum', jnp.nansum)
+nanmean = _reduce('nanmean', jnp.nanmean)
+logsumexp = _reduce('logsumexp', jax.scipy.special.logsumexp)
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    x = as_tensor(x)
+    return Tensor(jnp.all(x.data, axis=axis if not isinstance(axis, list) else tuple(axis),
+                          keepdims=keepdim))
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    x = as_tensor(x)
+    return Tensor(jnp.any(x.data, axis=axis if not isinstance(axis, list) else tuple(axis),
+                          keepdims=keepdim))
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = as_tensor(x)
+    ddof = 1 if unbiased else 0
+    return run_op('std', lambda a: jnp.std(a, axis=axis, ddof=ddof, keepdims=keepdim), [x])
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = as_tensor(x)
+    ddof = 1 if unbiased else 0
+    return run_op('var', lambda a: jnp.var(a, axis=axis, ddof=ddof, keepdims=keepdim), [x])
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    x = as_tensor(x)
+    return run_op('median', lambda a: jnp.median(a, axis=axis, keepdims=keepdim), [x])
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    x = as_tensor(x)
+    arr = np.asarray(x.data)
+    vals, counts = None, None
+    def _mode_1d(a):
+        u, c = np.unique(a, return_counts=True)
+        return u[np.argmax(c)]
+    out = np.apply_along_axis(_mode_1d, axis, arr)
+    if keepdim:
+        out = np.expand_dims(out, axis)
+    return Tensor(out)
+
+
+def quantile(x, q, axis=None, keepdim=False):
+    x = as_tensor(x)
+    return run_op('quantile', lambda a: jnp.quantile(a, q, axis=axis, keepdims=keepdim), [x])
+
+# ---- cum ops --------------------------------------------------------------
+cumsum_ = lambda a, axis: jnp.cumsum(a, axis=axis)
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    x = as_tensor(x)
+    def fn(a):
+        if axis is None:
+            return jnp.cumsum(a.reshape(-1))
+        return jnp.cumsum(a, axis=axis)
+    out = run_op('cumsum', fn, [x])
+    return out.astype(dtype) if dtype is not None else out
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    x = as_tensor(x)
+    out = run_op('cumprod', lambda a: jnp.cumprod(a, axis=dim), [x])
+    return out.astype(dtype) if dtype is not None else out
+
+# ---- arg / sort / topk ----------------------------------------------------
+def argmax(x, axis=None, keepdim=False, dtype='int64', name=None):
+    x = as_tensor(x)
+    out = jnp.argmax(x.data, axis=axis, keepdims=keepdim if axis is not None else False)
+    return Tensor(out.astype(jnp.dtype(dtype) if isinstance(dtype, str) else dtype))
+
+
+def argmin(x, axis=None, keepdim=False, dtype='int64', name=None):
+    x = as_tensor(x)
+    out = jnp.argmin(x.data, axis=axis, keepdims=keepdim if axis is not None else False)
+    return Tensor(out.astype(jnp.dtype(dtype) if isinstance(dtype, str) else dtype))
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    x = as_tensor(x)
+    idx = jnp.argsort(x.data, axis=axis, descending=descending)
+    return Tensor(idx.astype(jnp.int64))
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    x = as_tensor(x)
+    return run_op('argsort', lambda a: jnp.sort(a, axis=axis, descending=descending), [x])
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    """Parity: operators/top_k_v2_op."""
+    x = as_tensor(x)
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    ax = axis if axis is not None else x.ndim - 1
+
+    def fn(a):
+        arr = jnp.moveaxis(a, ax, -1)
+        src = arr if largest else -arr
+        vals, idx = jax.lax.top_k(src, k)
+        if not largest:
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx, -1, ax)
+
+    vals, idx = run_op('top_k_v2', fn, [x])
+    return vals, Tensor(idx.data.astype(jnp.int64))
+
+
+def nonzero(x, as_tuple=False):
+    x = as_tensor(x)
+    nz = np.nonzero(np.asarray(x.data))
+    if as_tuple:
+        return tuple(Tensor(n.astype(np.int64)) for n in nz)
+    return Tensor(np.stack(nz, axis=1).astype(np.int64))
+
+# ---- comparison (operators/controlflow/compare_op.cc) ---------------------
+def _cmp(name, fn):
+    def op(x, y, name=None):
+        tx = as_tensor(x)
+        ty = as_tensor(y, ref=tx)
+        return Tensor(fn(tx.data, ty.data))
+    op.__name__ = name
+    return register(name, op)
+
+equal = _cmp('equal', lambda x, y: x == y)
+not_equal = _cmp('not_equal', lambda x, y: x != y)
+less_than = _cmp('less_than', lambda x, y: x < y)
+less_equal = _cmp('less_equal', lambda x, y: x <= y)
+greater_than = _cmp('greater_than', lambda x, y: x > y)
+greater_equal = _cmp('greater_equal', lambda x, y: x >= y)
+
+
+def equal_all(x, y, name=None):
+    x, y = as_tensor(x), as_tensor(y)
+    return Tensor(jnp.array_equal(x.data, y.data))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    x, y = as_tensor(x), as_tensor(y)
+    return Tensor(jnp.allclose(x.data, y.data, rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    x, y = as_tensor(x), as_tensor(y)
+    return Tensor(jnp.isclose(x.data, y.data, rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+# ---- logic / bitwise ------------------------------------------------------
+logical_and = _cmp('logical_and', jnp.logical_and)
+logical_or = _cmp('logical_or', jnp.logical_or)
+logical_xor = _cmp('logical_xor', jnp.logical_xor)
+bitwise_and = _cmp('bitwise_and', lambda x, y: x & y)
+bitwise_or = _cmp('bitwise_or', lambda x, y: x | y)
+bitwise_xor = _cmp('bitwise_xor', lambda x, y: x ^ y)
+
+
+def logical_not(x, name=None):
+    return Tensor(jnp.logical_not(as_tensor(x).data))
+
+
+def bitwise_not(x, name=None):
+    return Tensor(~as_tensor(x).data)
+
+# ---- isnan family (operators/isfinite_v2_op.cc) ---------------------------
+def isnan(x, name=None):
+    return Tensor(jnp.isnan(as_tensor(x).data))
+
+
+def isinf(x, name=None):
+    return Tensor(jnp.isinf(as_tensor(x).data))
+
+
+def isfinite(x, name=None):
+    return Tensor(jnp.isfinite(as_tensor(x).data))
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    x = as_tensor(x)
+    return run_op('nan_to_num',
+                  lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf), [x])
+
+# ---- norms ----------------------------------------------------------------
+def norm(x, p='fro', axis=None, keepdim=False, name=None):
+    """Parity: operators/p_norm_op.cc + norm_op.cc."""
+    x = as_tensor(x)
+    def fn(a):
+        if p in ('fro', 2) and axis is None:
+            return jnp.sqrt(jnp.sum(a * a))
+        if axis is None:
+            flat = a.reshape(-1)
+            return jnp.linalg.norm(flat, ord=p)
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        return jnp.linalg.norm(a, ord=p if p != 'fro' else None, axis=ax, keepdims=keepdim)
+    return run_op('p_norm', fn, [x])
+
+
+def dist(x, y, p=2, name=None):
+    x, y = as_tensor(x), as_tensor(y)
+    def fn(a, b):
+        d = (a - b).reshape(-1)
+        if p == 0:
+            return jnp.sum(d != 0).astype(a.dtype)
+        if p == float('inf'):
+            return jnp.max(jnp.abs(d))
+        if p == float('-inf'):
+            return jnp.min(jnp.abs(d))
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(d), p)), 1.0 / p)
+    return run_op('dist', fn, [x, y])
+
+# ---- where / select -------------------------------------------------------
+def where(condition, x=None, y=None, name=None):
+    condition = as_tensor(condition)
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=False)
+    tx = as_tensor(x)
+    ty = as_tensor(y, ref=tx)
+    return run_op('where', lambda c, a, b: jnp.where(c, a, b),
+                  [condition, tx, ty], n_nondiff=0)
+
+
+def multiplex(inputs, index, name=None):
+    index = as_tensor(index)
+    stacked = jnp.stack([as_tensor(i).data for i in inputs], axis=0)
+    idx = index.data.reshape(-1).astype(jnp.int32)
+    rows = jnp.arange(idx.shape[0])
+    return Tensor(stacked[idx, rows])
+
+# ---- misc -----------------------------------------------------------------
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    x = as_tensor(x)
+    return run_op('trace', lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2), [x])
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    x = as_tensor(x)
+    def fn(a):
+        if a.ndim == 1:
+            out = jnp.diag(a, k=offset)
+            if padding_value != 0:
+                mask = jnp.diag(jnp.ones_like(a), k=offset)
+                out = out + (1 - mask) * padding_value
+            return out
+        return jnp.diagonal(a, offset=offset)
+    return run_op('diag_v2', fn, [x])
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    x = as_tensor(x)
+    return run_op('diag_embed',
+                  lambda a: jnp.apply_along_axis(jnp.diag, -1, a) if offset == 0 and dim1 == -2 and dim2 == -1
+                  else jnp.vectorize(lambda v: jnp.diag(v, k=offset), signature='(n)->(m,m)')(a),
+                  [x])
+
+
+def lerp(x, y, weight, name=None):
+    x, y = as_tensor(x), as_tensor(y)
+    w = weight.data if isinstance(weight, Tensor) else weight
+    return run_op('lerp', lambda a, b: a + w * (b - a), [x, y])
+
+
+def frac(x):
+    x = as_tensor(x)
+    return run_op('frac', lambda a: a - jnp.trunc(a), [x])
+
+
+def rad2deg(x):
+    return scale(as_tensor(x), 180.0 / np.pi)
+
+
+def deg2rad(x):
+    return scale(as_tensor(x), np.pi / 180.0)
+
+
+def gcd(x, y):
+    x, y = as_tensor(x), as_tensor(y)
+    return Tensor(jnp.gcd(x.data, y.data))
+
+
+def lcm(x, y):
+    x, y = as_tensor(x), as_tensor(y)
+    return Tensor(jnp.lcm(x.data, y.data))
+
+
+def count_nonzero(x, axis=None, keepdim=False):
+    x = as_tensor(x)
+    return Tensor(jnp.count_nonzero(x.data, axis=axis, keepdims=keepdim).astype(jnp.int64))
+
+
+def heaviside(x, y):
+    x, y = as_tensor(x), as_tensor(y)
+    return run_op('heaviside', jnp.heaviside, [x, y])
+
+
+def histogram(input, bins=100, min=0, max=0):
+    input = as_tensor(input)
+    arr = np.asarray(input.data)
+    lo, hi = (min, max) if (min != 0 or max != 0) else (arr.min(), arr.max())
+    hist, _ = np.histogram(arr, bins=bins, range=(lo, hi))
+    return Tensor(hist.astype(np.int64))
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))

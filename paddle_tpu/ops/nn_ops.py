@@ -1,0 +1,952 @@
+"""Neural-network functional ops.
+
+Reference parity: operators/ activation_op.cc (≈40 activations), softmax,
+log_softmax, layer_norm, batch_norm, group/instance_norm, conv2d(+cudnn),
+conv_transpose, pool2d, dropout, lookup_table_v2 (embedding),
+softmax_with_cross_entropy, cross_entropy2, bce/nll/smooth_l1/kldiv losses,
+interpolate_v2 (SURVEY.md Appendix B). Convs/matmuls map straight to the MXU via
+lax.conv_general_dilated / jnp.matmul; elementwise ops fuse in XLA.
+"""
+import math as _pymath
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .common import as_tensor, register, unary
+from ..core import rng
+from ..core.autograd import run_op, grad_enabled
+from ..core.tensor import Tensor
+
+# ---- activations -----------------------------------------------------------
+relu = unary('relu', jax.nn.relu)
+relu6 = unary('relu6', jax.nn.relu6)
+elu_ = jax.nn.elu
+silu = unary('silu', jax.nn.silu)
+swish = unary('swish', jax.nn.silu)
+softplus_ = jax.nn.softplus
+softsign = unary('softsign', jax.nn.soft_sign)
+hardsigmoid = unary('hard_sigmoid', lambda x: jnp.clip(x / 6.0 + 0.5, 0.0, 1.0))
+hardswish = unary('hard_swish', jax.nn.hard_swish)
+mish = unary('mish', lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+tanhshrink = unary('tanh_shrink', lambda x: x - jnp.tanh(x))
+
+
+def gelu(x, approximate=False, name=None):
+    x = as_tensor(x)
+    return run_op('gelu', lambda a: jax.nn.gelu(a, approximate=approximate), [x])
+
+
+def elu(x, alpha=1.0, name=None):
+    x = as_tensor(x)
+    return run_op('elu', lambda a: jax.nn.elu(a, alpha=alpha), [x])
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    x = as_tensor(x)
+    return run_op('selu', lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), [x])
+
+
+def celu(x, alpha=1.0, name=None):
+    x = as_tensor(x)
+    return run_op('celu', lambda a: jax.nn.celu(a, alpha=alpha), [x])
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    x = as_tensor(x)
+    return run_op('leaky_relu', lambda a: jax.nn.leaky_relu(a, negative_slope), [x])
+
+
+def prelu(x, weight, data_format='NCHW', name=None):
+    x, weight = as_tensor(x), as_tensor(weight)
+    def fn(a, w):
+        if w.size > 1 and a.ndim > 1:
+            shape = [1] * a.ndim
+            ch_axis = 1 if data_format == 'NCHW' else a.ndim - 1
+            shape[ch_axis] = w.size
+            w = w.reshape(shape)
+        return jnp.where(a > 0, a, a * w)
+    return run_op('prelu', fn, [x, weight])
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    x = as_tensor(x)
+    def fn(a):
+        scaled = beta * a
+        return jnp.where(scaled > threshold, a, jax.nn.softplus(scaled) / beta)
+    return run_op('softplus', fn, [x])
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    x = as_tensor(x)
+    return run_op('brelu', lambda a: jnp.clip(a, min, max), [x])
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    x = as_tensor(x)
+    return run_op('hard_shrink', lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), [x])
+
+
+def softshrink(x, threshold=0.5, name=None):
+    x = as_tensor(x)
+    return run_op('softshrink',
+                  lambda a: jnp.where(a > threshold, a - threshold,
+                                      jnp.where(a < -threshold, a + threshold, 0.0)), [x])
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    x = as_tensor(x)
+    return run_op('thresholded_relu', lambda a: jnp.where(a > threshold, a, 0.0), [x])
+
+
+def log_sigmoid(x, name=None):
+    x = as_tensor(x)
+    return run_op('logsigmoid', jax.nn.log_sigmoid, [x])
+
+
+def maxout(x, groups, axis=1, name=None):
+    x = as_tensor(x)
+    def fn(a):
+        c = a.shape[axis]
+        new_shape = list(a.shape)
+        new_shape[axis] = c // groups
+        new_shape.insert(axis + 1, groups)
+        return jnp.max(a.reshape(new_shape), axis=axis + 1)
+    return run_op('maxout', fn, [x])
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    x = as_tensor(x)
+    out = run_op('softmax', lambda a: jax.nn.softmax(a, axis=axis), [x])
+    return out.astype(dtype) if dtype is not None else out
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    x = as_tensor(x)
+    out = run_op('log_softmax', lambda a: jax.nn.log_softmax(a, axis=axis), [x])
+    return out.astype(dtype) if dtype is not None else out
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1):
+    x = as_tensor(x)
+    key = rng.next_key()
+    def fn(a):
+        g = jax.random.gumbel(key, a.shape, a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            y_hard = jax.nn.one_hot(jnp.argmax(y, axis=axis), a.shape[axis],
+                                    dtype=a.dtype, axis=axis)
+            y = y_hard + y - jax.lax.stop_gradient(y)
+        return y
+    return run_op('gumbel_softmax', fn, [x])
+
+# ---- normalization ---------------------------------------------------------
+def layer_norm(x, normalized_shape=None, weight=None, bias=None, epsilon=1e-05,
+               name=None):
+    """Parity: operators/layer_norm_op."""
+    x = as_tensor(x)
+    if normalized_shape is None:
+        normalized_shape = x.shape[-1:]
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_axes = len(normalized_shape)
+    axes = tuple(range(x.ndim - n_axes, x.ndim))
+    tensors = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        tensors.append(as_tensor(weight))
+    if has_b:
+        tensors.append(as_tensor(bias))
+
+    def fn(*args):
+        a = args[0]
+        w = args[1] if has_w else None
+        b = args[1 + has_w] if has_b else None
+        mean = jnp.mean(a.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(a.astype(jnp.float32), axis=axes, keepdims=True)
+        out = ((a.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + epsilon)).astype(a.dtype)
+        if w is not None:
+            out = out * w
+        if b is not None:
+            out = out + b
+        return out
+    return run_op('layer_norm', fn, tensors)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-05,
+               data_format='NCHW', use_global_stats=None, name=None):
+    """Parity: operators/batch_norm_op. Running stats update is an eager
+    side-effect on the passed mean/var tensors (as in paddle)."""
+    x = as_tensor(x)
+    ch_axis = 1 if data_format.startswith('NC') and x.ndim > 1 else x.ndim - 1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    shape = [1] * x.ndim
+    shape[ch_axis] = x.shape[ch_axis]
+
+    use_batch_stats = training and not use_global_stats
+    if use_batch_stats:
+        xf = x.data.astype(jnp.float32)
+        batch_mean = jnp.mean(xf, axis=reduce_axes)
+        batch_var = jnp.var(xf, axis=reduce_axes)
+        if running_mean is not None:
+            running_mean.set_value(momentum * running_mean.data
+                                   + (1 - momentum) * batch_mean)
+            running_var.set_value(momentum * running_var.data
+                                  + (1 - momentum) * batch_var)
+        mean_arr, var_arr = batch_mean, batch_var
+    else:
+        mean_arr, var_arr = running_mean.data, running_var.data
+
+    tensors = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        tensors.append(as_tensor(weight))
+    if has_b:
+        tensors.append(as_tensor(bias))
+
+    def fn(*args):
+        a = args[0]
+        w = args[1] if has_w else None
+        b = args[1 + has_w] if has_b else None
+        if use_batch_stats:
+            af = a.astype(jnp.float32)
+            m = jnp.mean(af, axis=reduce_axes).reshape(shape)
+            v = jnp.var(af, axis=reduce_axes).reshape(shape)
+        else:
+            m = mean_arr.reshape(shape)
+            v = var_arr.reshape(shape)
+        out = (a - m.astype(a.dtype)) * jax.lax.rsqrt(v + epsilon).astype(a.dtype)
+        if w is not None:
+            out = out * w.reshape(shape)
+        if b is not None:
+            out = out + b.reshape(shape)
+        return out
+    return run_op('batch_norm', fn, tensors)
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format='NCHW', name=None):
+    x = as_tensor(x)
+    tensors = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        tensors.append(as_tensor(weight))
+    if has_b:
+        tensors.append(as_tensor(bias))
+
+    def fn(*args):
+        a = args[0]
+        w = args[1] if has_w else None
+        b = args[1 + has_w] if has_b else None
+        n, c = a.shape[0], a.shape[1]
+        g = a.reshape(n, num_groups, c // num_groups, *a.shape[2:])
+        axes = tuple(range(2, g.ndim))
+        mean = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - mean) * jax.lax.rsqrt(var + epsilon)).reshape(a.shape)
+        shape = [1, c] + [1] * (a.ndim - 2)
+        if w is not None:
+            out = out * w.reshape(shape)
+        if b is not None:
+            out = out + b.reshape(shape)
+        return out
+    return run_op('group_norm', fn, tensors)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, eps=1e-05, momentum=0.9, data_format='NCHW'):
+    x = as_tensor(x)
+    tensors = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        tensors.append(as_tensor(weight))
+    if has_b:
+        tensors.append(as_tensor(bias))
+
+    def fn(*args):
+        a = args[0]
+        w = args[1] if has_w else None
+        b = args[1 + has_w] if has_b else None
+        axes = tuple(range(2, a.ndim))
+        mean = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - mean) * jax.lax.rsqrt(var + eps)
+        shape = [1, a.shape[1]] + [1] * (a.ndim - 2)
+        if w is not None:
+            out = out * w.reshape(shape)
+        if b is not None:
+            out = out + b.reshape(shape)
+        return out
+    return run_op('instance_norm', fn, tensors)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format='NCHW'):
+    x = as_tensor(x)
+    def fn(a):
+        sq = a * a
+        half = size // 2
+        pad = [(0, 0), (half, size - 1 - half)] + [(0, 0)] * (a.ndim - 2)
+        padded = jnp.pad(sq, pad)
+        acc = jnp.zeros_like(a)
+        for i in range(size):
+            acc = acc + jax.lax.slice_in_dim(padded, i, i + a.shape[1], axis=1)
+        return a / jnp.power(k + alpha * acc, beta)
+    return run_op('lrn', fn, [x])
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    x = as_tensor(x)
+    def fn(a):
+        nrm = jnp.power(jnp.sum(jnp.power(jnp.abs(a), p), axis=axis, keepdims=True), 1.0 / p)
+        return a / jnp.maximum(nrm, epsilon)
+    return run_op('normalize', fn, [x])
+
+# ---- linear / conv / pool --------------------------------------------------
+def linear(x, weight, bias=None, name=None):
+    """Parity: operators/ matmul_v2 + elementwise_add fusion (fc)."""
+    x, weight = as_tensor(x), as_tensor(weight)
+    if bias is not None:
+        bias = as_tensor(bias)
+        return run_op('linear', lambda a, w, b: jnp.matmul(a, w) + b,
+                      [x, weight, bias])
+    return run_op('linear', lambda a, w: jnp.matmul(a, w), [x, weight])
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(v)
+    return (v,) * n
+
+
+def _conv_padding(padding, k, stride, dilation, nd=2):
+    if isinstance(padding, str):
+        return padding.upper()  # 'SAME' / 'VALID'
+    if isinstance(padding, int):
+        return [(padding, padding)] * nd
+    padding = list(padding)
+    if len(padding) == nd:
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * nd:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(nd)]
+    raise ValueError(f"bad padding {padding}")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format='NCHW', name=None):
+    """Parity: operators/conv_op (+conv_cudnn) → lax.conv_general_dilated
+    (MXU path)."""
+    x, weight = as_tensor(x), as_tensor(weight)
+    stride = _pair(stride)
+    dilation = _pair(dilation)
+    pad = _conv_padding(padding, weight.shape[2:], stride, dilation)
+    dn = ('NCHW', 'OIHW', 'NCHW') if data_format == 'NCHW' else ('NHWC', 'HWIO', 'NHWC')
+    tensors = [x, weight]
+    has_b = bias is not None
+    if has_b:
+        tensors.append(as_tensor(bias))
+
+    def fn(a, w, *rest):
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=stride, padding=pad,
+            rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=jnp.float32 if a.dtype == jnp.bfloat16 else None)
+        out = out.astype(a.dtype)
+        if rest:
+            b = rest[0]
+            shape = [1, b.shape[0], 1, 1] if data_format == 'NCHW' else [1, 1, 1, b.shape[0]]
+            out = out + b.reshape(shape)
+        return out
+    return run_op('conv2d', fn, tensors)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format='NCL', name=None):
+    x, weight = as_tensor(x), as_tensor(weight)
+    from . import manip
+    x4 = run_op('unsqueeze2', lambda a: jnp.expand_dims(a, -1), [x])
+    w4 = run_op('unsqueeze2', lambda a: jnp.expand_dims(a, -1), [weight])
+    s = _pair(stride, 1) + (1,) if not isinstance(stride, (list, tuple)) else tuple(stride) + (1,)
+    p = padding if isinstance(padding, str) else (
+        [(padding, padding), (0, 0)] if isinstance(padding, int)
+        else [(padding[0], padding[0]), (0, 0)])
+    d = (dilation if isinstance(dilation, int) else dilation[0], 1)
+    out = conv2d(x4, w4, bias, stride=(s[0], 1), padding=p, dilation=d, groups=groups)
+    return run_op('squeeze2', lambda a: jnp.squeeze(a, -1), [out])
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format='NCDHW', name=None):
+    x, weight = as_tensor(x), as_tensor(weight)
+    stride = _pair(stride, 3)
+    dilation = _pair(dilation, 3)
+    pad = _conv_padding(padding, weight.shape[2:], stride, dilation, nd=3)
+    dn = ('NCDHW', 'OIDHW', 'NCDHW')
+    tensors = [x, weight]
+    has_b = bias is not None
+    if has_b:
+        tensors.append(as_tensor(bias))
+
+    def fn(a, w, *rest):
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=stride, padding=pad,
+            rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=groups)
+        if rest:
+            out = out + rest[0].reshape(1, -1, 1, 1, 1)
+        return out
+    return run_op('conv3d', fn, tensors)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     output_size=None, data_format='NCHW', name=None):
+    """Parity: operators/conv_transpose_op. weight layout IOHW (paddle)."""
+    x, weight = as_tensor(x), as_tensor(weight)
+    stride = _pair(stride)
+    dilation = _pair(dilation)
+    opad = _pair(output_padding)
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        p = _conv_padding(padding, weight.shape[2:], stride, dilation)
+        kh, kw = weight.shape[2], weight.shape[3]
+        # transpose conv padding transform: lo = k-1-p_lo, hi = k-1-p_hi+opad
+        pad = [(dilation[0] * (kh - 1) - p[0][0],
+                dilation[0] * (kh - 1) - p[0][1] + opad[0]),
+               (dilation[1] * (kw - 1) - p[1][0],
+                dilation[1] * (kw - 1) - p[1][1] + opad[1])]
+    tensors = [x, weight]
+    has_b = bias is not None
+    if has_b:
+        tensors.append(as_tensor(bias))
+
+    def fn(a, w, *rest):
+        # IOHW → OIHW flipped = standard transpose-conv as dilated conv
+        w2 = jnp.flip(w, axis=(2, 3)).transpose(1, 0, 2, 3)
+        if groups > 1:
+            ci = w.shape[0]
+            w_g = w.reshape(groups, ci // groups, *w.shape[1:])
+            w2 = jnp.concatenate([jnp.flip(g, axis=(3,)).transpose(1, 0, 2, 3)
+                                  for g in [wg for wg in w_g]], axis=0) if False else w2
+        out = jax.lax.conv_general_dilated(
+            a, w2, window_strides=(1, 1), padding=pad,
+            lhs_dilation=stride, rhs_dilation=dilation,
+            dimension_numbers=('NCHW', 'OIHW', 'NCHW'),
+            feature_group_count=groups)
+        if rest:
+            out = out + rest[0].reshape(1, -1, 1, 1)
+        return out
+    return run_op('conv2d_transpose', fn, tensors)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format='NCHW',
+               name=None):
+    """Parity: operators/pool_op (avg)."""
+    x = as_tensor(x)
+    k = _pair(kernel_size)
+    s = _pair(stride) if stride is not None else k
+    pad = _conv_padding(padding, k, s, (1, 1))
+    if isinstance(pad, str):
+        pads = pad
+    else:
+        pads = [(0, 0), (0, 0)] + list(pad)
+
+    def fn(a):
+        window = (1, 1) + k
+        strides = (1, 1) + s
+        summed = jax.lax.reduce_window(a, 0.0, jax.lax.add, window, strides, pads)
+        if divisor_override:
+            return summed / divisor_override
+        if exclusive and pads != 'VALID' and not isinstance(pads, str):
+            ones = jnp.ones_like(a)
+            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
+            return summed / counts
+        return summed / (k[0] * k[1])
+    return run_op('pool2d_avg', fn, [x])
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format='NCHW', name=None):
+    x = as_tensor(x)
+    k = _pair(kernel_size)
+    s = _pair(stride) if stride is not None else k
+    pad = _conv_padding(padding, k, s, (1, 1))
+    pads = pad if isinstance(pad, str) else [(0, 0), (0, 0)] + list(pad)
+
+    def fn(a):
+        return jax.lax.reduce_window(a, -jnp.inf, jax.lax.max,
+                                     (1, 1) + k, (1, 1) + s, pads)
+    out = run_op('pool2d_max', fn, [x])
+    if return_mask:
+        idx = Tensor(jnp.zeros(out.shape, jnp.int32))  # mask indices: placeholder
+        return out, idx
+    return out
+
+
+def adaptive_avg_pool2d(x, output_size, data_format='NCHW', name=None):
+    x = as_tensor(x)
+    oh, ow = _pair(output_size)
+    h, w = x.shape[2], x.shape[3]
+    if oh is None:
+        oh = h
+    if ow is None:
+        ow = w
+    if h % oh == 0 and w % ow == 0:
+        k = (h // oh, w // ow)
+        return avg_pool2d(x, k, stride=k, padding=0, exclusive=False)
+
+    def fn(a):
+        # general adaptive: mean over variable windows
+        out = jnp.zeros(a.shape[:2] + (oh, ow), a.dtype)
+        rows = [(int(np.floor(i * h / oh)), int(np.ceil((i + 1) * h / oh))) for i in range(oh)]
+        cols = [(int(np.floor(j * w / ow)), int(np.ceil((j + 1) * w / ow))) for j in range(ow)]
+        parts = []
+        for r0, r1 in rows:
+            row_parts = []
+            for c0, c1 in cols:
+                row_parts.append(jnp.mean(a[:, :, r0:r1, c0:c1], axis=(2, 3)))
+            parts.append(jnp.stack(row_parts, axis=-1))
+        return jnp.stack(parts, axis=-2)
+    return run_op('adaptive_avg_pool2d', fn, [x])
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    x = as_tensor(x)
+    oh, ow = _pair(output_size)
+    h, w = x.shape[2], x.shape[3]
+    if h % oh == 0 and w % ow == 0:
+        k = (h // oh, w // ow)
+        return max_pool2d(x, k, stride=k, padding=0, return_mask=return_mask)
+    raise NotImplementedError("non-divisible adaptive max pool")
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    x = as_tensor(x)
+    x4 = run_op('unsqueeze2', lambda a: jnp.expand_dims(a, -1), [x])
+    k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+    s = stride if stride is not None else k
+    s = s if isinstance(s, int) else s[0]
+    p = padding if isinstance(padding, int) else padding[0]
+    out = avg_pool2d(x4, (k, 1), (s, 1), [(p, p), (0, 0)], exclusive=exclusive)
+    return run_op('squeeze2', lambda a: jnp.squeeze(a, -1), [out])
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    x = as_tensor(x)
+    x4 = run_op('unsqueeze2', lambda a: jnp.expand_dims(a, -1), [x])
+    k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+    s = stride if stride is not None else k
+    s = s if isinstance(s, int) else s[0]
+    p = padding if isinstance(padding, int) else padding[0]
+    out = max_pool2d(x4, (k, 1), (s, 1), [(p, p), (0, 0)])
+    return run_op('squeeze2', lambda a: jnp.squeeze(a, -1), [out])
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """Parity: operators/unfold_op (im2col)."""
+    x = as_tensor(x)
+    k = _pair(kernel_sizes)
+    s = _pair(strides)
+    p = _pair(paddings)
+    d = _pair(dilations)
+
+    def fn(a):
+        n, c, h, w = a.shape
+        a = jnp.pad(a, [(0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])])
+        oh = (a.shape[2] - (d[0] * (k[0] - 1) + 1)) // s[0] + 1
+        ow = (a.shape[3] - (d[1] * (k[1] - 1) + 1)) // s[1] + 1
+        cols = []
+        for i in range(k[0]):
+            for j in range(k[1]):
+                patch = a[:, :, i * d[0]: i * d[0] + oh * s[0]: s[0],
+                          j * d[1]: j * d[1] + ow * s[1]: s[1]]
+                cols.append(patch)
+        out = jnp.stack(cols, axis=2)  # N, C, k*k, OH, OW
+        return out.reshape(n, c * k[0] * k[1], oh * ow)
+    return run_op('unfold', fn, [x])
+
+# ---- dropout / embedding ---------------------------------------------------
+def dropout(x, p=0.5, axis=None, training=True, mode='upscale_in_train',
+            name=None):
+    """Parity: operators/dropout_op."""
+    x = as_tensor(x)
+    if not training or p == 0.0:
+        return x if mode == 'upscale_in_train' else run_op(
+            'dropout', lambda a: a * (1 - p), [x])
+    key = rng.next_key()
+
+    def fn(a):
+        shape = a.shape if axis is None else tuple(
+            a.shape[i] if i in ([axis] if isinstance(axis, int) else axis) else 1
+            for i in range(a.ndim))
+        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        if mode == 'upscale_in_train':
+            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+    return run_op('dropout', fn, [x])
+
+
+def dropout2d(x, p=0.5, training=True, data_format='NCHW', name=None):
+    return dropout(x, p=p, axis=[0, 1] if data_format == 'NCHW' else [0, 3],
+                   training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True):
+    x = as_tensor(x)
+    if not training or p == 0.0:
+        return x
+    key = rng.next_key()
+    alpha = 1.6732632423543772
+    scale_ = 1.0507009873554805
+    alpha_p = -alpha * scale_
+
+    def fn(a):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        q = 1.0 - p
+        coef_a = (q + alpha_p ** 2 * q * p) ** -0.5
+        coef_b = -coef_a * alpha_p * p
+        return coef_a * jnp.where(keep, a, alpha_p) + coef_b
+    return run_op('alpha_dropout', fn, [x])
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Parity: operators/lookup_table_v2_op."""
+    x, weight = as_tensor(x), as_tensor(weight)
+
+    def fn(w, idx):
+        out = jnp.take(w, idx, axis=0)
+        if padding_idx is not None and padding_idx >= 0:
+            mask = (idx == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+    return run_op('lookup_table_v2', fn, [weight, x], n_nondiff=1)
+
+# ---- losses ----------------------------------------------------------------
+def _reduce_loss(loss, reduction):
+    if reduction == 'mean':
+        return jnp.mean(loss)
+    if reduction == 'sum':
+        return jnp.sum(loss)
+    return loss
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, axis=-1,
+                               return_softmax=False):
+    """Parity: operators/softmax_with_cross_entropy_op (fused, numerically
+    stable log-softmax + NLL)."""
+    logits, label = as_tensor(logits), as_tensor(label)
+
+    if soft_label:
+        def fn(lg, lb):
+            logp = jax.nn.log_softmax(lg, axis=axis)
+            return -jnp.sum(lb * logp, axis=axis, keepdims=True)
+        loss = run_op('softmax_with_cross_entropy', fn, [logits, label])
+    else:
+        def fn(lg, lb):
+            logp = jax.nn.log_softmax(lg.astype(jnp.float32), axis=axis)
+            idx = lb.astype(jnp.int32)
+            if idx.shape == lg.shape[:axis % lg.ndim] + lg.shape[axis % lg.ndim + 1:]:
+                idx_exp = jnp.expand_dims(idx, axis)
+            else:
+                idx_exp = idx
+            picked = jnp.take_along_axis(logp, idx_exp, axis=axis)
+            loss = -picked
+            if ignore_index >= 0:
+                loss = jnp.where(idx_exp == ignore_index, 0.0, loss)
+            return loss.astype(lg.dtype)
+        loss = run_op('softmax_with_cross_entropy', fn, [logits, label], n_nondiff=1)
+    if return_softmax:
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction='mean', soft_label=False, axis=-1,
+                  use_softmax=True, name=None):
+    """Parity: nn/functional/loss.py cross_entropy → softmax_with_cross_entropy."""
+    input, label = as_tensor(input), as_tensor(label)
+    if label.ndim == input.ndim and not soft_label and label.shape[axis % input.ndim] == 1:
+        from . import manip
+        label = manip.squeeze(label, axis=axis)
+    if use_softmax:
+        loss = softmax_with_cross_entropy(input, label, soft_label=soft_label,
+                                          ignore_index=ignore_index, axis=axis)
+    else:
+        def fn(lg, lb):
+            logp = jnp.log(jnp.clip(lg, 1e-12, None))
+            idx_exp = jnp.expand_dims(lb.astype(jnp.int32), axis)
+            return -jnp.take_along_axis(logp, idx_exp, axis=axis)
+        loss = run_op('cross_entropy2', fn, [input, label], n_nondiff=1)
+
+    if weight is not None:
+        weight = as_tensor(weight)
+        def wfn(ls, w, lb):
+            wt = jnp.take(w, lb.astype(jnp.int32))
+            return ls * jnp.expand_dims(wt, axis)
+        loss = run_op('ce_weight', wfn, [loss, weight, label], n_nondiff=1)
+
+    if reduction == 'none':
+        return loss
+    def rfn(ls):
+        return _reduce_loss(jnp.squeeze(ls, axis=axis) if ls.ndim > label.ndim else ls,
+                            reduction)
+    return run_op('reduce_loss', rfn, [loss])
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction='mean',
+             name=None):
+    input, label = as_tensor(input), as_tensor(label)
+    def fn(lg, lb):
+        idx = jnp.expand_dims(lb.astype(jnp.int32), 1)
+        picked = -jnp.take_along_axis(lg, idx, axis=1)[:, 0]
+        return _reduce_loss(picked, reduction)
+    return run_op('nll_loss', fn, [input, label], n_nondiff=1)
+
+
+def mse_loss(input, label, reduction='mean', name=None):
+    input = as_tensor(input)
+    label = as_tensor(label, ref=input)
+    return run_op('mse_loss',
+                  lambda a, b: _reduce_loss((a - b) ** 2, reduction),
+                  [input, label])
+
+
+def l1_loss(input, label, reduction='mean', name=None):
+    input = as_tensor(input)
+    label = as_tensor(label, ref=input)
+    return run_op('l1_loss',
+                  lambda a, b: _reduce_loss(jnp.abs(a - b), reduction),
+                  [input, label])
+
+
+def smooth_l1_loss(input, label, reduction='mean', delta=1.0, name=None):
+    input = as_tensor(input)
+    label = as_tensor(label, ref=input)
+    def fn(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        return _reduce_loss(loss, reduction)
+    return run_op('smooth_l1_loss', fn, [input, label])
+
+
+def binary_cross_entropy(input, label, weight=None, reduction='mean', name=None):
+    input = as_tensor(input)
+    label = as_tensor(label, ref=input)
+    def fn(a, b):
+        a = jnp.clip(a, 1e-12, 1.0 - 1e-7)
+        loss = -(b * jnp.log(a) + (1 - b) * jnp.log(1 - a))
+        if weight is not None:
+            loss = loss * (weight.data if isinstance(weight, Tensor) else weight)
+        return _reduce_loss(loss, reduction)
+    return run_op('bce_loss', fn, [input, label])
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction='mean', pos_weight=None,
+                                     name=None):
+    """Parity: operators/sigmoid_cross_entropy_with_logits_op."""
+    logit = as_tensor(logit)
+    label = as_tensor(label, ref=logit)
+    def fn(a, b):
+        maxv = jnp.maximum(a, 0)
+        loss = maxv - a * b + jnp.log1p(jnp.exp(-jnp.abs(a)))
+        if pos_weight is not None:
+            pw = pos_weight.data if isinstance(pos_weight, Tensor) else pos_weight
+            log_w = (pw - 1) * b + 1
+            loss = loss * log_w
+        if weight is not None:
+            loss = loss * (weight.data if isinstance(weight, Tensor) else weight)
+        return _reduce_loss(loss, reduction)
+    return run_op('sigmoid_cross_entropy_with_logits', fn, [logit, label])
+
+sigmoid_cross_entropy_with_logits = binary_cross_entropy_with_logits
+
+
+def kl_div(input, label, reduction='mean', name=None):
+    input = as_tensor(input)
+    label = as_tensor(label, ref=input)
+    def fn(a, b):
+        loss = b * (jnp.log(jnp.clip(b, 1e-12, None)) - a)
+        if reduction == 'batchmean':
+            return jnp.sum(loss) / a.shape[0]
+        return _reduce_loss(loss, reduction)
+    return run_op('kldiv_loss', fn, [input, label])
+
+
+def hinge_loss(input, label):
+    input = as_tensor(input)
+    label = as_tensor(label, ref=input)
+    return run_op('hinge_loss',
+                  lambda a, b: jnp.maximum(0.0, 1.0 - (2 * b - 1) * a),
+                  [input, label])
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction='mean'):
+    input = as_tensor(input)
+    other = as_tensor(other, ref=input)
+    label = as_tensor(label, ref=input)
+    return run_op('margin_rank_loss',
+                  lambda a, b, l: _reduce_loss(
+                      jnp.maximum(0.0, -l * (a - b) + margin), reduction),
+                  [input, other, label])
+
+
+def log_loss(input, label, epsilon=1e-4):
+    input = as_tensor(input)
+    label = as_tensor(label, ref=input)
+    return run_op('log_loss',
+                  lambda a, b: -b * jnp.log(a + epsilon)
+                  - (1 - b) * jnp.log(1 - a + epsilon),
+                  [input, label])
+
+
+def square_error_cost(input, label):
+    input = as_tensor(input)
+    label = as_tensor(label, ref=input)
+    return run_op('squared_l2_distance', lambda a, b: (a - b) ** 2, [input, label])
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    x1 = as_tensor(x1)
+    x2 = as_tensor(x2, ref=x1)
+    def fn(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+    return run_op('cos_sim', fn, [x1, x2])
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    label = as_tensor(label)
+    def fn(lb):
+        k = lb.shape[-1]
+        if prior_dist is not None:
+            pd = prior_dist.data if isinstance(prior_dist, Tensor) else prior_dist
+            return (1 - epsilon) * lb + epsilon * pd
+        return (1 - epsilon) * lb + epsilon / k
+    return run_op('label_smooth', fn, [label])
+
+# ---- misc nn ---------------------------------------------------------------
+def interpolate(x, size=None, scale_factor=None, mode='nearest',
+                align_corners=False, align_mode=0, data_format='NCHW',
+                name=None):
+    """Parity: operators/interpolate_v2_op (nearest/bilinear)."""
+    x = as_tensor(x)
+    n, c, h, w = x.shape
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = size.tolist()
+        oh, ow = int(size[0]), int(size[1])
+    else:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else (scale_factor, scale_factor)
+        oh, ow = int(h * sf[0]), int(w * sf[1])
+
+    method = {'nearest': 'nearest', 'bilinear': 'linear', 'bicubic': 'cubic',
+              'area': 'linear'}[mode]
+
+    def fn(a):
+        a_ = jnp.transpose(a, (0, 2, 3, 1))
+        out = jax.image.resize(a_, (n, oh, ow, c), method=method)
+        return jnp.transpose(out, (0, 3, 1, 2)).astype(a.dtype)
+    return run_op('interpolate_v2', fn, [x])
+
+
+upsample = interpolate
+
+
+def grid_sample(x, grid, mode='bilinear', padding_mode='zeros',
+                align_corners=True):
+    x, grid = as_tensor(x), as_tensor(grid)
+    def fn(a, g):
+        n, c, h, w = a.shape
+        gx = (g[..., 0] + 1) * (w - 1) / 2 if align_corners else ((g[..., 0] + 1) * w - 1) / 2
+        gy = (g[..., 1] + 1) * (h - 1) / 2 if align_corners else ((g[..., 1] + 1) * h - 1) / 2
+        import functools
+        def sample_one(img, cx, cy):
+            coords = jnp.stack([cy.reshape(-1), cx.reshape(-1)])
+            out = jax.vmap(lambda ch: jax.scipy.ndimage.map_coordinates(
+                ch, coords, order=1, mode='constant'))(img)
+            return out.reshape(c, *cx.shape)
+        return jax.vmap(sample_one)(a, gx, gy)
+    return run_op('grid_sampler', fn, [x, grid])
+
+
+def affine_grid(theta, out_shape, align_corners=True):
+    theta = as_tensor(theta)
+    n, c, h, w = [int(v) for v in (out_shape.tolist() if isinstance(out_shape, Tensor) else out_shape)]
+    def fn(th):
+        ys = jnp.linspace(-1, 1, h) if align_corners else jnp.linspace(-1 + 1 / h, 1 - 1 / h, h)
+        xs = jnp.linspace(-1, 1, w) if align_corners else jnp.linspace(-1 + 1 / w, 1 - 1 / w, w)
+        gy, gx = jnp.meshgrid(ys, xs, indexing='ij')
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # H,W,3
+        return jnp.einsum('nij,hwj->nhwi', th, base)
+    return run_op('affine_grid', fn, [theta])
+
+
+def fused_softmax_mask_upper_triangle(x):
+    """Parity: operators/fused_softmax_mask_upper_triangle_op (causal mask)."""
+    x = as_tensor(x)
+    def fn(a):
+        L = a.shape[-1]
+        mask = jnp.tril(jnp.ones((L, L), bool))
+        return jax.nn.softmax(jnp.where(mask, a, -1e9), axis=-1)
+    return run_op('fused_softmax_mask_upper_triangle', fn, [x])
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25):
+    x = as_tensor(x)
+    def fn(a):
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        a = a.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        left = jnp.concatenate([a[:, 1:, :fold], jnp.zeros_like(a[:, :1, :fold])], axis=1)
+        right = jnp.concatenate([jnp.zeros_like(a[:, :1, fold:2 * fold]),
+                                 a[:, :-1, fold:2 * fold]], axis=1)
+        rest = a[:, :, 2 * fold:]
+        return jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)
+    return run_op('temporal_shift', fn, [x])
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    anchor, positive = as_tensor(anchor), as_tensor(positive)
+    labels = as_tensor(labels)
+    def fn(a, p, lb):
+        sim = jnp.matmul(a, p.T)
+        lbl = lb.reshape(-1, 1)
+        tgt = (lbl == lbl.T).astype(a.dtype)
+        tgt = tgt / jnp.sum(tgt, axis=1, keepdims=True)
+        logp = jax.nn.log_softmax(sim, axis=1)
+        ce = -jnp.mean(jnp.sum(tgt * logp, axis=1))
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, axis=1))
+                        + jnp.mean(jnp.sum(p * p, axis=1))) / 2
+        return ce + reg
+    return run_op('npair_loss', fn, [anchor, positive, labels], n_nondiff=1)
+
+
+def one_hot(x, num_classes):
+    from . import manip
+    return manip.one_hot(x, num_classes)
+
+
+def sequence_mask(lengths, maxlen=None, dtype='int64'):
+    lengths = as_tensor(lengths)
+    ml = int(maxlen) if maxlen is not None else int(np.asarray(lengths.data).max())
+    def fn(l):
+        return (jnp.arange(ml)[None, :] < l[:, None])
+    out = fn(lengths.data.reshape(-1))
+    out = out.reshape(tuple(lengths.shape) + (ml,))
+    from ..core import dtypes as _dt
+    return Tensor(out.astype(_dt.convert_dtype(dtype)))

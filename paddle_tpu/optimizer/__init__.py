@@ -1,0 +1,4 @@
+"""paddle_tpu.optimizer — parity: python/paddle/optimizer."""
+from . import lr
+from .optimizer import (Optimizer, SGD, Momentum, Adagrad, RMSProp, Adam,
+                        AdamW, Adamax, Lamb, Lars, LarsMomentum)

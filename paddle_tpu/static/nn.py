@@ -1,0 +1,119 @@
+"""Static-graph layer API.
+
+Reference parity: python/paddle/static/nn (fluid/layers/nn.py subset): fc,
+conv2d, embedding, batch_norm, etc. These build Parameters in the current
+Program and record ops through the shared op layer.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from ..core import dtypes
+from ..ops import nn_ops as F
+from ..ops import math as M
+from ..ops import manip
+from ..nn import initializer as I
+from .program import default_main_program, Parameter
+
+__all__ = ['fc', 'embedding', 'conv2d', 'batch_norm', 'cross_entropy',
+           'softmax_with_cross_entropy', 'mean', 'dropout']
+
+
+def _make_param(shape, dtype='float32', initializer=None, attr=None):
+    prog = default_main_program()
+    block = prog.global_block()
+    init = initializer
+    if attr is not None and getattr(attr, 'initializer', None) is not None:
+        init = attr.initializer
+    name = None
+    if attr is not None and getattr(attr, 'name', None):
+        name = attr.name
+    return block.create_parameter(name=name, shape=shape, dtype=dtype,
+                                  initializer=init or I.XavierUniform())
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """Parity: fluid/layers/nn.py fc → mul + elementwise_add (+act)."""
+    in_dim = int(np.prod(x.shape[num_flatten_dims:]))
+    w = _make_param([in_dim, size], x.dtype, attr=weight_attr)
+    if len(x.shape) > num_flatten_dims + 1:
+        x = manip.reshape(x, list(x.shape[:num_flatten_dims]) + [in_dim])
+    out = M.matmul(x, w)
+    if bias_attr is not False:
+        b = _make_param([size], x.dtype, initializer=I.Constant(0.0),
+                        attr=bias_attr)
+        out = M.add(out, b)
+    if activation:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype='float32'):
+    w = _make_param(list(size), dtype, attr=param_attr)
+    return F.embedding(input, w, padding_idx=padding_idx)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCHW", name=None):
+    k = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size, filter_size)
+    cin = input.shape[1]
+    w = _make_param([num_filters, cin // groups, k[0], k[1]], input.dtype,
+                    attr=param_attr)
+    b = None
+    if bias_attr is not False:
+        b = _make_param([num_filters], input.dtype,
+                        initializer=I.Constant(0.0), attr=bias_attr)
+    out = F.conv2d(input, w, b, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-05,
+               param_attr=None, bias_attr=None, data_layout='NCHW',
+               **kwargs):
+    c = input.shape[1] if data_layout == 'NCHW' else input.shape[-1]
+    scale = _make_param([c], input.dtype, initializer=I.Constant(1.0),
+                        attr=param_attr)
+    bias = _make_param([c], input.dtype, initializer=I.Constant(0.0),
+                       attr=bias_attr)
+
+    # Static BN uses in-graph batch statistics (global-stat tracking needs
+    # state vars; the dygraph path owns that).
+    from ..core.autograd import run_op
+    ch_axis = 1 if data_layout == 'NCHW' else input.ndim - 1
+    axes = tuple(i for i in range(input.ndim) if i != ch_axis)
+
+    def fn(a, w, b):
+        shape = [1] * a.ndim
+        shape[ch_axis] = a.shape[ch_axis]
+        m = jnp.mean(a, axis=axes, keepdims=True)
+        v = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - m) / jnp.sqrt(v + epsilon)
+        return out * w.reshape(shape) + b.reshape(shape)
+    out = run_op('batch_norm', fn, [input, scale, bias])
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100):
+    return F.cross_entropy(input, label, soft_label=soft_label,
+                           ignore_index=ignore_index, reduction='none',
+                           use_softmax=False)
+
+
+def softmax_with_cross_entropy(logits, label, **kwargs):
+    return F.softmax_with_cross_entropy(logits, label, **kwargs)
+
+
+def mean(x):
+    return M.mean(x)
+
+
+def dropout(x, dropout_prob=0.5, is_test=False, **kwargs):
+    return F.dropout(x, p=dropout_prob, training=not is_test)

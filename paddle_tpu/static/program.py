@@ -1,0 +1,397 @@
+"""Static Program IR.
+
+Reference parity: Program/Block/Operator/Variable of
+python/paddle/fluid/framework.py (6,005 LoC) over framework.proto
+(ProgramDesc:202/OpDesc:43/VarDesc:169). TPU-native design: an op record
+carries its jax-traceable fn (the same fns the eager ops use), so the Program
+is directly lowerable — `Executor` replays it under one jax.jit trace. op_role
+attrs (Forward/Backward/Optimize/LRSched, fluid/backward.py) are kept because
+the distributed program rewrites (pipeline/sharding meta-optimizers) key on
+them, as in the reference.
+"""
+import contextlib
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtypes
+from ..core.tensor import Tensor
+
+
+class OpRole:
+    """Parity: fluid/framework.py op_role values (load-bearing for pipeline &
+    sharding passes — SURVEY.md §1-L7)."""
+    Forward = 0
+    Backward = 1
+    Optimize = 2
+    RPC = 3
+    Dist = 4
+    LRSched = 16
+    Loss = 256
+
+
+_static_mode = False
+_program_stack = []
+_device_stack = []
+
+
+def in_static_mode():
+    return _static_mode
+
+
+def enable_static():
+    global _static_mode
+    _static_mode = True
+    from ..core import autograd
+    autograd.STATIC_RECORD_HOOK = record_op
+
+
+def disable_static():
+    global _static_mode
+    _static_mode = False
+    from ..core import autograd
+    autograd.STATIC_RECORD_HOOK = None
+
+
+class Variable:
+    """Symbolic tensor (parity: fluid/framework.py Variable). Holds only an
+    aval (shape/dtype); values live in the Scope at run time."""
+
+    def __init__(self, block, name, shape, dtype, persistable=False,
+                 stop_gradient=True, is_parameter=False):
+        self.block = block
+        self.name = name
+        self._shape = list(shape)
+        self.dtype = dtypes.convert_dtype(dtype)
+        self.persistable = persistable
+        self.stop_gradient = stop_gradient
+        self.is_parameter = is_parameter
+        self.op_device = _device_stack[-1] if _device_stack else ''
+        # autograd tape fields unused in static mode but probed by shared code
+        self._node = None
+        self.grad = None
+
+    @property
+    def data(self):
+        return jax.ShapeDtypeStruct(tuple(d if d is not None and d >= 0 else 1
+                                          for d in self._shape), self.dtype)
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def size(self):
+        return int(np.prod([d for d in self._shape]))
+
+    def astype(self, dtype):
+        from ..ops import manip
+        return manip.cast(self, dtype)
+
+    def backward(self, *a, **k):
+        raise RuntimeError("Variable.backward: use append_backward + "
+                           "Executor in static mode")
+
+    def __repr__(self):
+        return (f"Variable(name={self.name}, shape={self._shape}, "
+                f"dtype={dtypes.dtype_name(self.dtype)})")
+
+    # arithmetic operates through the shared op layer (records ops)
+    def _binop(self, other, opname):
+        from ..ops import math as M
+        return getattr(M, opname)(self, other)
+
+    def __add__(self, o):
+        return self._binop(o, 'add')
+
+    def __radd__(self, o):
+        from ..ops import math as M
+        return M.add(o, self)
+
+    def __sub__(self, o):
+        return self._binop(o, 'subtract')
+
+    def __mul__(self, o):
+        return self._binop(o, 'multiply')
+
+    def __rmul__(self, o):
+        from ..ops import math as M
+        return M.multiply(o, self)
+
+    def __truediv__(self, o):
+        return self._binop(o, 'divide')
+
+    def __matmul__(self, o):
+        return self._binop(o, 'matmul')
+
+
+class Parameter(Variable):
+    def __init__(self, *args, initializer=None, trainable=True, **kwargs):
+        super().__init__(*args, persistable=True,
+                         stop_gradient=not trainable, is_parameter=True,
+                         **kwargs)
+        self.initializer = initializer
+        self.trainable = trainable
+        self.optimize_attr = {'learning_rate': 1.0}
+        self.regularizer = None
+        self.need_clip = True
+
+
+class Operator:
+    """One recorded op (parity: fluid/framework.py Operator over OpDesc)."""
+
+    _id_counter = 0
+
+    def __init__(self, type, fn, inputs, outputs, attrs=None,
+                 op_role=OpRole.Forward):
+        Operator._id_counter += 1
+        self.idx = Operator._id_counter
+        self.type = type
+        self.fn = fn                      # jax fn(*arrays, **attrs)
+        self.input_names = inputs         # list[str]
+        self.output_names = outputs       # list[str]
+        self.attrs = attrs or {}
+        self.op_role = op_role
+        self.op_device = _device_stack[-1] if _device_stack else ''
+
+    def attr(self, name):
+        if name == 'op_role':
+            return self.op_role
+        if name == 'op_device':
+            return self.op_device
+        return self.attrs.get(name)
+
+    def _set_attr(self, name, value):
+        if name == 'op_role':
+            self.op_role = value
+        elif name == 'op_device':
+            self.op_device = value
+        else:
+            self.attrs[name] = value
+
+    def __repr__(self):
+        return (f"{{{', '.join(self.output_names)}}} = {self.type}"
+                f"({', '.join(self.input_names)})")
+
+
+class Block:
+    """Parity: fluid/framework.py Block over BlockDesc."""
+
+    def __init__(self, program, idx):
+        self.program = program
+        self.idx = idx
+        self.vars = {}
+        self.ops = []
+
+    def var(self, name):
+        if name not in self.vars:
+            raise ValueError(f"var {name} not in block")
+        return self.vars[name]
+
+    def has_var(self, name):
+        return name in self.vars
+
+    def create_var(self, name=None, shape=None, dtype='float32',
+                   persistable=False, stop_gradient=True, **kwargs):
+        name = name or self.program._unique_name('tmp')
+        v = Variable(self, name, shape or [], dtype, persistable,
+                     stop_gradient)
+        self.vars[name] = v
+        return v
+
+    def create_parameter(self, name=None, shape=None, dtype='float32',
+                         initializer=None, trainable=True, **kwargs):
+        name = name or self.program._unique_name('param')
+        p = Parameter(self, name, shape or [], dtype,
+                      initializer=initializer, trainable=trainable)
+        self.vars[name] = p
+        self.program.startup_ops.append(p)
+        return p
+
+    def append_op(self, op):
+        self.ops.append(op)
+        return op
+
+    def all_parameters(self):
+        return [v for v in self.vars.values() if isinstance(v, Parameter)]
+
+
+class Program:
+    """Parity: fluid/framework.py Program."""
+
+    def __init__(self):
+        self.blocks = [Block(self, 0)]
+        self._name_counter = {}
+        self.startup_ops = []  # parameters needing init
+        self._loss_var = None
+        self._grad_map = {}    # param name -> grad var name
+        self.random_seed = 0
+        self._pipeline_opt = None
+        self._fetch_list = None
+
+    def _unique_name(self, prefix):
+        self._name_counter[prefix] = self._name_counter.get(prefix, 0) + 1
+        return f"{prefix}_{self._name_counter[prefix] - 1}"
+
+    def global_block(self):
+        return self.blocks[0]
+
+    def current_block(self):
+        return self.blocks[-1]
+
+    def all_parameters(self):
+        out = []
+        for b in self.blocks:
+            out.extend(b.all_parameters())
+        return out
+
+    def list_vars(self):
+        for b in self.blocks:
+            yield from b.vars.values()
+
+    def clone(self, for_test=False):
+        import copy
+        p = Program.__new__(Program)
+        p.__dict__.update(self.__dict__)
+        p.blocks = self.blocks       # shallow: shares blocks (paddle clones
+        return p                     # descs; our replay is non-destructive)
+
+    @property
+    def num_blocks(self):
+        return len(self.blocks)
+
+    def save(self, path):
+        """Serialize program parameters (full program serialization uses the
+        Scope; see Executor)."""
+        raise NotImplementedError
+
+    def load(self, path):
+        raise NotImplementedError
+
+    def to_string(self, throw_on_error=True, with_details=False):
+        lines = [f"Program(ops={len(self.global_block().ops)})"]
+        for op in self.global_block().ops:
+            lines.append("  " + repr(op))
+        return "\n".join(lines)
+
+    def __repr__(self):
+        return self.to_string()
+
+
+_default_main_program = Program()
+_default_startup_program = Program()
+
+
+def default_main_program():
+    return _program_stack[-1][0] if _program_stack else _default_main_program
+
+
+def default_startup_program():
+    return _program_stack[-1][1] if _program_stack else \
+        _default_startup_program
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    _program_stack.append((main_program,
+                           startup_program or _default_startup_program))
+    try:
+        yield
+    finally:
+        _program_stack.pop()
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    yield
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """Parity: fluid/framework.py device_guard — sets per-op op_device attr;
+    pipeline stage splitting keys on it (optimizer.py:4628)."""
+    _device_stack.append(device or '')
+    try:
+        yield
+    finally:
+        _device_stack.pop()
+
+
+class InputSpec:
+    """Parity: paddle.static.InputSpec."""
+
+    def __init__(self, shape, dtype='float32', name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtypes.convert_dtype(dtype)
+        self.name = name
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype, name)
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+def data(name, shape, dtype='float32', lod_level=0):
+    """Parity: paddle.static.data — declares a feed Variable."""
+    prog = default_main_program()
+    block = prog.global_block()
+    v = Variable(block, name, shape, dtype, stop_gradient=True)
+    v.is_data = True
+    block.vars[name] = v
+    return v
+
+
+# ---- op recording hook (called from core.autograd.run_op) -----------------
+def record_op(name, fn, args, static_kwargs):
+    """Record an op into the current Program and return symbolic outputs.
+    Shape inference via jax.eval_shape (parity: InferShape in
+    operator.cc:1132)."""
+    prog = default_main_program()
+    block = prog.current_block()
+
+    in_names = []
+    avals = []
+    for a in args:
+        if isinstance(a, Variable):
+            in_names.append(a.name)
+            avals.append(a.data)
+        else:  # concrete Tensor closed over (e.g. constants)
+            cname = prog._unique_name(f'const')
+            block.vars[cname] = _ConstVar(block, cname, a)
+            in_names.append(cname)
+            avals.append(jax.ShapeDtypeStruct(tuple(a.data.shape),
+                                              a.data.dtype))
+
+    out_aval = jax.eval_shape(lambda *xs: fn(*xs, **static_kwargs), *avals)
+    multi = isinstance(out_aval, (tuple, list))
+    out_avals = list(out_aval) if multi else [out_aval]
+    outs = []
+    for oa in out_avals:
+        oname = prog._unique_name(name)
+        ov = Variable(block, oname, list(oa.shape), oa.dtype,
+                      stop_gradient=all(getattr(a, 'stop_gradient', True)
+                                        for a in args))
+        block.vars[oname] = ov
+        outs.append(ov)
+
+    role = OpRole.Forward
+    op = Operator(name, lambda *xs: fn(*xs, **static_kwargs), in_names,
+                  [o.name for o in outs], dict(static_kwargs), role)
+    block.append_op(op)
+    return tuple(outs) if multi else outs[0]
+
+
+class _ConstVar(Variable):
+    """A captured concrete tensor appearing in a recorded program."""
+
+    def __init__(self, block, name, tensor):
+        super().__init__(block, name, list(tensor.data.shape),
+                         tensor.data.dtype, persistable=True)
+        self.value = tensor.data

@@ -1,0 +1,28 @@
+"""paddle_tpu.utils — misc utilities (parity: python/paddle/utils)."""
+from . import download
+from . import unique_name
+from ..core.tensor import Tensor
+
+
+def try_import(module_name, err_msg=None):
+    import importlib
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(err_msg or f"module {module_name} not found")
+
+
+def run_check():
+    import jax
+    import jax.numpy as jnp
+    x = jnp.ones((2, 2))
+    y = (x @ x).block_until_ready()
+    print(f"paddle_tpu is installed successfully! "
+          f"devices: {jax.devices()}")
+    return True
+
+
+def deprecated(update_to="", since="", reason=""):
+    def decorator(fn):
+        return fn
+    return decorator

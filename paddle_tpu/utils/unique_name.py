@@ -1,0 +1,26 @@
+"""Unique name generator (parity: fluid/unique_name.py)."""
+import collections
+import contextlib
+
+_counters = collections.defaultdict(int)
+
+
+def generate(key):
+    _counters[key] += 1
+    return f"{key}_{_counters[key] - 1}"
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    global _counters
+    saved = _counters
+    _counters = collections.defaultdict(int)
+    try:
+        yield
+    finally:
+        _counters = saved
+
+
+def switch(new_generator=None):
+    global _counters
+    _counters = collections.defaultdict(int)

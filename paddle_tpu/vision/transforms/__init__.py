@@ -1,0 +1,5 @@
+"""Image transforms (parity: python/paddle/vision/transforms)."""
+from .transforms import (Compose, Resize, RandomCrop, CenterCrop,
+                         RandomHorizontalFlip, Normalize, ToTensor,
+                         Transpose, RandomResizedCrop, BrightnessTransform,
+                         normalize, to_tensor, resize, hflip)

@@ -1,0 +1,13 @@
+"""Test config: run on an 8-device virtual CPU mesh (SURVEY.md §4 —
+multi-controller simulation replaces the reference's 2-process NCCL tests).
+"""
+import os
+import sys
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+flags = os.environ.get('XLA_FLAGS', '')
+if 'xla_force_host_platform_device_count' not in flags:
+    os.environ['XLA_FLAGS'] = \
+        flags + ' --xla_force_host_platform_device_count=8'
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,119 @@
+"""Autograd engine tests (reference pattern: imperative tests —
+BasicEngine/PartialGradEngine semantics)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+
+
+def test_backward_accumulates():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    (x * 2).sum().backward()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0])
+
+
+def test_clear_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).backward()
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0], stop_gradient=True)
+    (x * y).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_detach():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * 2
+    z = y.detach() * x
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+def test_multi_output_op():
+    x = paddle.to_tensor(np.arange(6, dtype='float32').reshape(2, 3),
+                         stop_gradient=False)
+    a, b, c = paddle.split(x, 3, axis=1)
+    (a.sum() + 2 * c.sum()).backward()
+    np.testing.assert_allclose(x.grad.numpy(),
+                               [[1, 0, 2], [1, 0, 2]])
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 5
+    assert y.stop_gradient
+
+
+def test_paddle_grad_nonleaf():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x          # non-leaf
+    z = (y * y).sum()  # z = x^4, dz/dy = 2y = 8
+    g = paddle.framework.grad(z, y)
+    np.testing.assert_allclose(g[0].numpy(), [8.0])
+
+
+def test_deep_chain_matches_jax():
+    rng = np.random.RandomState(3)
+    a = rng.randn(4, 4).astype('float32')
+
+    def f(x):
+        h = jnp.tanh(x @ x)
+        h = jax.nn.softmax(h, axis=-1)
+        return jnp.sum(h * h)
+
+    t = paddle.to_tensor(a, stop_gradient=False)
+    h = paddle.tanh(paddle.matmul(t, t))
+    h = paddle.nn.functional.softmax(h)
+    paddle.sum(h * h).backward()
+    ref = jax.grad(f)(jnp.asarray(a))
+    np.testing.assert_allclose(t.grad.numpy(), np.asarray(ref), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_pylayer():
+    from paddle_tpu.autograd import PyLayer
+
+    class Double(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, grad):
+            return grad * 2
+
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = Double.apply(x)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    np.testing.assert_allclose(y.numpy(), [6.0])
+
+
+def test_recompute_matches_plain():
+    from paddle_tpu.distributed.fleet.utils import recompute
+    net = paddle.nn.Sequential(paddle.nn.Linear(4, 4), paddle.nn.Tanh())
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(2, 4).astype('float32'), stop_gradient=False)
+
+    out1 = net(x)
+    out1.sum().backward()
+    g_plain = [p.grad.numpy().copy() for p in net.parameters()]
+    for p in net.parameters():
+        p.clear_grad()
+    x.clear_grad()
+
+    out2 = recompute(net, x)
+    out2.sum().backward()
+    g_rc = [p.grad.numpy() for p in net.parameters()]
+    for a, b in zip(g_plain, g_rc):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)

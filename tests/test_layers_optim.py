@@ -1,0 +1,183 @@
+"""Layer + optimizer tests (reference pattern: unittests/test_layers.py,
+test_adam_op.py etc.)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def test_linear_shapes_and_state_dict():
+    l = nn.Linear(4, 3)
+    out = l(paddle.randn([2, 4]))
+    assert out.shape == [2, 3]
+    sd = l.state_dict()
+    assert set(sd) == {'weight', 'bias'}
+    l2 = nn.Linear(4, 3)
+    l2.set_state_dict(sd)
+    np.testing.assert_allclose(l2.weight.numpy(), l.weight.numpy())
+
+
+def test_sublayer_registration():
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(2, 2)
+            self.seq = nn.Sequential(nn.Linear(2, 2), nn.ReLU())
+
+        def forward(self, x):
+            return self.seq(self.fc1(x))
+
+    m = M()
+    names = [n for n, _ in m.named_parameters()]
+    assert 'fc1.weight' in names and 'seq.0.weight' in names
+    assert len(m.parameters()) == 4
+
+
+def test_train_eval_propagation():
+    m = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+    m.eval()
+    assert not m[1].training
+    m.train()
+    assert m[1].training
+
+
+def test_batchnorm_running_stats():
+    bn = nn.BatchNorm2D(3, momentum=0.5)
+    x = paddle.randn([4, 3, 5, 5]) * 2 + 1
+    bn.train()
+    bn(x)
+    assert not np.allclose(bn._mean.numpy(), 0.0)
+    bn.eval()
+    y = bn(x)
+    assert y.shape == [4, 3, 5, 5]
+
+
+def test_lstm_forward_backward():
+    lstm = nn.LSTM(4, 8, num_layers=2, direction='bidirect')
+    x = paddle.randn([2, 5, 4])
+    y, (h, c) = lstm(x)
+    assert y.shape == [2, 5, 16]
+    assert h.shape == [4, 2, 8]
+    y.sum().backward()
+    assert lstm.weight_ih_l0.grad is not None
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(d_model=16, nhead=4,
+                                       dim_feedforward=32, dropout=0.0)
+    enc = nn.TransformerEncoder(layer, 2)
+    x = paddle.randn([2, 6, 16])
+    out = enc(x)
+    assert out.shape == [2, 6, 16]
+    out.sum().backward()
+
+
+def test_mha_cache_decoding():
+    mha = nn.MultiHeadAttention(16, 4)
+    q = paddle.randn([2, 1, 16])
+    cache = mha.gen_cache(q)
+    out, cache = mha(q, q, q, cache=cache)
+    assert cache.k.shape[2] == 1
+    out, cache = mha(q, q, q, cache=cache)
+    assert cache.k.shape[2] == 2
+
+
+@pytest.mark.parametrize('opt_cls,kw', [
+    (paddle.optimizer.SGD, {}),
+    (paddle.optimizer.Momentum, {'momentum': 0.9}),
+    (paddle.optimizer.Adam, {}),
+    (paddle.optimizer.AdamW, {'weight_decay': 0.01}),
+    (paddle.optimizer.Adagrad, {}),
+    (paddle.optimizer.RMSProp, {}),
+    (paddle.optimizer.Lamb, {}),
+    (paddle.optimizer.Adamax, {}),
+])
+def test_optimizer_reduces_loss(opt_cls, kw):
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 16), nn.Tanh(), nn.Linear(16, 1))
+    opt = opt_cls(learning_rate=0.05, parameters=net.parameters(), **kw)
+    x = paddle.randn([32, 4])
+    y = paddle.randn([32, 1])
+    first = None
+    for i in range(15):
+        loss = nn.functional.mse_loss(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if first is None:
+            first = float(loss)
+    assert float(loss) < first
+
+
+def test_optimizer_state_dict_roundtrip():
+    net = nn.Linear(2, 2)
+    opt = paddle.optimizer.Adam(parameters=net.parameters())
+    loss = net(paddle.randn([4, 2])).sum()
+    loss.backward()
+    opt.step()
+    sd = opt.state_dict()
+    opt2 = paddle.optimizer.Adam(parameters=net.parameters())
+    opt2.set_state_dict(sd)
+    assert opt2._step_count == opt._step_count
+
+
+def test_lr_schedulers():
+    from paddle_tpu.optimizer import lr
+    s = lr.StepDecay(0.1, step_size=2, gamma=0.5)
+    vals = []
+    for _ in range(5):
+        vals.append(s())
+        s.step()
+    np.testing.assert_allclose(vals, [0.1, 0.1, 0.05, 0.05, 0.025])
+
+    warm = lr.LinearWarmup(0.1, warmup_steps=4, start_lr=0.0, end_lr=0.1)
+    got = []
+    for _ in range(5):
+        got.append(warm())
+        warm.step()
+    np.testing.assert_allclose(got[:4], [0.0, 0.025, 0.05, 0.075])
+
+    cos = lr.CosineAnnealingDecay(1.0, T_max=10)
+    assert abs(cos() - 1.0) < 1e-6
+
+    noam = lr.NoamDecay(d_model=64, warmup_steps=10, learning_rate=1.0)
+    noam.step()
+    assert noam() > 0
+
+
+def test_grad_clip_global_norm():
+    net = nn.Linear(2, 2)
+    clip = nn.ClipGradByGlobalNorm(0.1)
+    opt = paddle.optimizer.SGD(learning_rate=1.0,
+                               parameters=net.parameters(), grad_clip=clip)
+    (net(paddle.ones([4, 2])) * 100).sum().backward()
+    before = [p.numpy().copy() for p in net.parameters()]
+    opt.step()
+    total_move = sum(np.abs(p.numpy() - b).sum()
+                     for p, b in zip(net.parameters(), before))
+    assert total_move < 0.5  # clipped to 0.1 norm * lr 1.0
+
+
+def test_amp_autocast_bf16():
+    import jax.numpy as jnp
+    with paddle.amp.auto_cast(dtype='bfloat16'):
+        a = paddle.randn([4, 4])
+        b = paddle.randn([4, 4])
+        out = paddle.matmul(a, b)
+        assert out.dtype == jnp.bfloat16
+        s = paddle.nn.functional.softmax(out.astype('float32'))
+        assert s.dtype == jnp.float32
+
+
+def test_grad_scaler():
+    net = nn.Linear(2, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=2.0)
+    loss = net(paddle.ones([2, 2])).sum()
+    scaled = scaler.scale(loss)
+    scaled.backward()
+    scaler.step(opt)
+    opt.clear_grad()
+    assert scaler._scale >= 2.0

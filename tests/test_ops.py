@@ -1,0 +1,267 @@
+"""Op unit tests vs numpy — the OpTest pattern (reference:
+python/paddle/fluid/tests/unittests/op_test.py:270): run op, compare against
+a numpy reference, check gradients against jax.grad (replacing the
+perturbation-based get_numeric_gradient:110 with the exact reference
+gradient, which jax provides)."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+
+RNG = np.random.RandomState(0)
+
+
+def check_grad(op_fn, jax_fn, *arrays):
+    tensors = [paddle.to_tensor(a, stop_gradient=False) for a in arrays]
+    out = op_fn(*tensors)
+    loss = paddle.sum(out * out)
+    loss.backward()
+    ref_grads = jax.grad(
+        lambda *xs: jnp.sum(jax_fn(*xs) ** 2), argnums=tuple(
+            range(len(arrays))))(*[jnp.asarray(a) for a in arrays])
+    for t, g in zip(tensors, ref_grads):
+        np.testing.assert_allclose(t.grad.numpy(), np.asarray(g),
+                                   rtol=2e-4, atol=2e-4)
+
+
+class TestElementwise:
+    def test_add_broadcast(self):
+        a = RNG.randn(3, 4).astype('float32')
+        b = RNG.randn(4).astype('float32')
+        out = paddle.add(paddle.to_tensor(a), paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), a + b, rtol=1e-6)
+        check_grad(paddle.add, jnp.add, a, b)
+
+    def test_mul_div_sub(self):
+        a = RNG.rand(2, 3).astype('float32') + 0.5
+        b = RNG.rand(2, 3).astype('float32') + 0.5
+        np.testing.assert_allclose(
+            paddle.multiply(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+            a * b, rtol=1e-6)
+        np.testing.assert_allclose(
+            paddle.divide(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+            a / b, rtol=1e-5)
+        check_grad(paddle.divide, jnp.divide, a, b)
+
+    def test_pow_scalar(self):
+        a = RNG.rand(4).astype('float32') + 0.1
+        out = paddle.to_tensor(a) ** 2
+        np.testing.assert_allclose(out.numpy(), a ** 2, rtol=1e-6)
+
+    def test_maximum_minimum(self):
+        a = RNG.randn(5).astype('float32')
+        b = RNG.randn(5).astype('float32')
+        np.testing.assert_allclose(
+            paddle.maximum(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+            np.maximum(a, b))
+
+
+class TestUnary:
+    @pytest.mark.parametrize('name,npfn', [
+        ('exp', np.exp), ('log', np.log), ('sqrt', np.sqrt),
+        ('tanh', np.tanh), ('abs', np.abs), ('floor', np.floor),
+        ('ceil', np.ceil), ('square', np.square), ('sin', np.sin),
+        ('cos', np.cos),
+    ])
+    def test_unary(self, name, npfn):
+        a = (RNG.rand(3, 4).astype('float32') + 0.1)
+        out = getattr(paddle, name)(paddle.to_tensor(a))
+        np.testing.assert_allclose(out.numpy(), npfn(a), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_sigmoid_grad(self):
+        a = RNG.randn(3, 3).astype('float32')
+        check_grad(paddle.sigmoid, jax.nn.sigmoid, a)
+
+
+class TestMatmul:
+    def test_matmul(self):
+        a = RNG.randn(3, 4).astype('float32')
+        b = RNG.randn(4, 5).astype('float32')
+        out = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
+        check_grad(paddle.matmul, jnp.matmul, a, b)
+
+    def test_matmul_transpose(self):
+        a = RNG.randn(4, 3).astype('float32')
+        b = RNG.randn(4, 5).astype('float32')
+        out = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b),
+                            transpose_x=True)
+        np.testing.assert_allclose(out.numpy(), a.T @ b, rtol=1e-5)
+
+    def test_batched(self):
+        a = RNG.randn(2, 3, 4).astype('float32')
+        b = RNG.randn(2, 4, 5).astype('float32')
+        out = paddle.bmm(paddle.to_tensor(a), paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
+
+
+class TestReduce:
+    def test_sum_axis(self):
+        a = RNG.randn(2, 3, 4).astype('float32')
+        out = paddle.sum(paddle.to_tensor(a), axis=[1, 2])
+        np.testing.assert_allclose(out.numpy(), a.sum(axis=(1, 2)),
+                                   rtol=1e-5)
+
+    def test_mean_keepdim(self):
+        a = RNG.randn(2, 3).astype('float32')
+        out = paddle.mean(paddle.to_tensor(a), axis=1, keepdim=True)
+        np.testing.assert_allclose(out.numpy(), a.mean(1, keepdims=True),
+                                   rtol=1e-6)
+
+    def test_max_min_prod(self):
+        a = RNG.rand(3, 4).astype('float32')
+        np.testing.assert_allclose(paddle.max(paddle.to_tensor(a),
+                                              axis=0).numpy(), a.max(0))
+        np.testing.assert_allclose(paddle.min(paddle.to_tensor(a)).numpy(),
+                                   a.min())
+        np.testing.assert_allclose(paddle.prod(paddle.to_tensor(a),
+                                               axis=1).numpy(),
+                                   a.prod(1), rtol=1e-5)
+
+
+class TestManip:
+    def test_reshape_zero_dim(self):
+        a = RNG.randn(2, 3, 4).astype('float32')
+        out = paddle.reshape(paddle.to_tensor(a), [0, 12])
+        assert out.shape == [2, 12]
+
+    def test_concat_split(self):
+        a = RNG.randn(2, 3).astype('float32')
+        b = RNG.randn(2, 5).astype('float32')
+        cat = paddle.concat([paddle.to_tensor(a), paddle.to_tensor(b)],
+                            axis=1)
+        assert cat.shape == [2, 8]
+        xs = paddle.split(cat, [3, 5], axis=1)
+        np.testing.assert_allclose(xs[0].numpy(), a)
+        np.testing.assert_allclose(xs[1].numpy(), b)
+
+    def test_transpose_squeeze(self):
+        a = RNG.randn(2, 1, 3).astype('float32')
+        out = paddle.transpose(paddle.to_tensor(a), [2, 1, 0])
+        assert out.shape == [3, 1, 2]
+        sq = paddle.squeeze(paddle.to_tensor(a), axis=1)
+        assert sq.shape == [2, 3]
+
+    def test_gather_scatter(self):
+        a = RNG.randn(5, 3).astype('float32')
+        idx = np.array([0, 2, 4])
+        out = paddle.gather(paddle.to_tensor(a), paddle.to_tensor(idx))
+        np.testing.assert_allclose(out.numpy(), a[idx])
+        upd = np.ones((3, 3), dtype='float32')
+        s = paddle.scatter(paddle.to_tensor(a), paddle.to_tensor(idx),
+                           paddle.to_tensor(upd))
+        ref = a.copy()
+        ref[idx] = 1.0
+        np.testing.assert_allclose(s.numpy(), ref)
+
+    def test_tile_expand(self):
+        a = RNG.randn(1, 3).astype('float32')
+        assert paddle.tile(paddle.to_tensor(a), [2, 2]).shape == [2, 6]
+        assert paddle.expand(paddle.to_tensor(a), [4, 3]).shape == [4, 3]
+
+    def test_topk_argsort(self):
+        a = RNG.randn(3, 8).astype('float32')
+        vals, idx = paddle.topk(paddle.to_tensor(a), k=3)
+        np.testing.assert_allclose(vals.numpy(),
+                                   np.sort(a, axis=1)[:, ::-1][:, :3],
+                                   rtol=1e-6)
+
+    def test_getitem(self):
+        a = RNG.randn(4, 5).astype('float32')
+        t = paddle.to_tensor(a)
+        np.testing.assert_allclose(t[1].numpy(), a[1])
+        np.testing.assert_allclose(t[1:3, 2:].numpy(), a[1:3, 2:])
+
+
+class TestNNOps:
+    def test_softmax_ce(self):
+        logits = RNG.randn(4, 10).astype('float32')
+        labels = RNG.randint(0, 10, (4,))
+        loss = paddle.nn.functional.softmax_with_cross_entropy(
+            paddle.to_tensor(logits), paddle.to_tensor(labels))
+        # numpy reference
+        e = np.exp(logits - logits.max(1, keepdims=True))
+        p = e / e.sum(1, keepdims=True)
+        ref = -np.log(p[np.arange(4), labels])
+        np.testing.assert_allclose(loss.numpy().squeeze(), ref, rtol=1e-5)
+
+    def test_layer_norm(self):
+        x = RNG.randn(2, 5).astype('float32')
+        w = np.ones(5, dtype='float32')
+        b = np.zeros(5, dtype='float32')
+        out = paddle.nn.functional.layer_norm(
+            paddle.to_tensor(x), [5], paddle.to_tensor(w),
+            paddle.to_tensor(b))
+        mu = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        ref = (x - mu) / np.sqrt(var + 1e-5)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_conv2d(self):
+        x = RNG.randn(1, 2, 5, 5).astype('float32')
+        w = RNG.randn(3, 2, 3, 3).astype('float32')
+        out = paddle.nn.functional.conv2d(paddle.to_tensor(x),
+                                          paddle.to_tensor(w), padding=1)
+        assert out.shape == [1, 3, 5, 5]
+        ref = jax.lax.conv_general_dilated(
+            jnp.asarray(x), jnp.asarray(w), (1, 1), [(1, 1), (1, 1)],
+            dimension_numbers=('NCHW', 'OIHW', 'NCHW'))
+        np.testing.assert_allclose(out.numpy(), np.asarray(ref), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_pool(self):
+        x = RNG.randn(1, 1, 4, 4).astype('float32')
+        out = paddle.nn.functional.max_pool2d(paddle.to_tensor(x), 2)
+        ref = x.reshape(1, 1, 2, 2, 2, 2).max(axis=(3, 5))
+        np.testing.assert_allclose(out.numpy(), ref)
+
+    def test_dropout_train_eval(self):
+        x = paddle.ones([100, 100])
+        paddle.seed(1)
+        out = paddle.nn.functional.dropout(x, p=0.5, training=True)
+        frac = float((out.numpy() == 0).mean())
+        assert 0.4 < frac < 0.6
+        out_eval = paddle.nn.functional.dropout(x, p=0.5, training=False)
+        np.testing.assert_allclose(out_eval.numpy(), np.ones((100, 100)))
+
+    def test_embedding(self):
+        w = RNG.randn(10, 4).astype('float32')
+        idx = np.array([[1, 2], [3, 4]])
+        out = paddle.nn.functional.embedding(paddle.to_tensor(idx),
+                                             paddle.to_tensor(w))
+        np.testing.assert_allclose(out.numpy(), w[idx])
+
+
+class TestComparisonLogic:
+    def test_compare(self):
+        a = paddle.to_tensor([1.0, 2.0, 3.0])
+        b = paddle.to_tensor([2.0, 2.0, 2.0])
+        np.testing.assert_array_equal((a < b).numpy(),
+                                      [True, False, False])
+        np.testing.assert_array_equal(paddle.equal(a, b).numpy(),
+                                      [False, True, False])
+
+    def test_where(self):
+        c = paddle.to_tensor([True, False])
+        x = paddle.to_tensor([1.0, 1.0])
+        y = paddle.to_tensor([2.0, 2.0])
+        np.testing.assert_allclose(paddle.where(c, x, y).numpy(), [1.0, 2.0])
+
+
+class TestCreation:
+    def test_creation_family(self):
+        assert paddle.zeros([2, 3]).numpy().sum() == 0
+        assert paddle.ones([2]).numpy().sum() == 2
+        assert paddle.full([2], 7.0).numpy().tolist() == [7.0, 7.0]
+        assert paddle.arange(5).numpy().tolist() == [0, 1, 2, 3, 4]
+        assert paddle.eye(3).numpy().trace() == 3.0
+
+    def test_random_reproducible(self):
+        paddle.seed(42)
+        a = paddle.randn([4]).numpy()
+        paddle.seed(42)
+        b = paddle.randn([4]).numpy()
+        np.testing.assert_allclose(a, b)
