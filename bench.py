@@ -1,0 +1,92 @@
+"""Benchmark: flagship GPT train-step throughput on one TPU chip.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no numbers (BASELINE.md); vs_baseline is measured
+MFU against the BASELINE.json north-star target of 45% MFU (value > 1.0
+beats the target). Model: GPT ~124M (config ladder step toward GPT-1.3B),
+bf16, fused single-program train step (forward+backward+Adam).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.gpt import (GPTConfig, GPTForCausalLM,
+                                       GPTPretrainingCriterion)
+
+    paddle.seed(0)
+    B, L = 16, 1024
+    config = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                       num_heads=12, max_seq_len=L, hidden_dropout=0.0,
+                       attn_dropout=0.0, use_flash_attention=True)
+    model = GPTForCausalLM(config)
+    # bf16 params (fp32 master kept by the optimizer)
+    for p in model.parameters():
+        if p.data.dtype == jnp.float32:
+            p.data = p.data.astype(jnp.bfloat16)
+    crit = GPTPretrainingCriterion(config)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters(),
+                                 weight_decay=0.01)
+
+    def loss_fn(m, ids, labels):
+        return crit(m(ids), labels)
+
+    step = TrainStep(model, loss_fn, opt)
+    rng = np.random.RandomState(0)
+    n_iter = 10
+    ids_np = rng.randint(0, config.vocab_size,
+                         (n_iter, B, L)).astype('int32')
+    labels_np = np.roll(ids_np, -1, 2).astype('int32')
+    ids_stack = Tensor(ids_np)
+    labels_stack = Tensor(labels_np)
+
+    # warmup/compile: k steps fused into one dispatch (lax.scan over the
+    # train step) so launch overhead amortizes — the TPU-idiomatic loop.
+    losses = step.run_steps(ids_stack, labels_stack)
+    float(losses[0])
+    t0 = time.time()
+    losses = step.run_steps(ids_stack, labels_stack)
+    float(losses[-1])  # sync
+    dt = (time.time() - t0) / n_iter
+
+    # FLOPs: 6 * n_params * tokens (fwd+bwd) + attention term
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    tokens = B * L
+    flops = 6 * n_params * tokens + 12 * config.num_layers * \
+        config.hidden_size * L * tokens
+    tflops = flops / dt / 1e12
+    # TPU v5e peak: 197 bf16 TFLOP/s
+    mfu = tflops / 197.0
+    target_mfu = 0.45
+    result = {
+        "metric": "gpt124m_trainstep_mfu",
+        "value": round(mfu, 4),
+        "unit": "fraction_of_v5e_peak",
+        "vs_baseline": round(mfu / target_mfu, 4),
+        "detail": {
+            "ms_per_step": round(dt * 1000, 2),
+            "tokens_per_sec": round(tokens / dt, 1),
+            "tflops": round(tflops, 2),
+            "params": n_params,
+            "batch": B, "seq_len": L,
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == '__main__':
+    main()

@@ -462,8 +462,9 @@ def _c_softmax_with_cross_entropy(logits, label, group=None,
         part = lg.shape[-1]
         idx = lax.axis_index(axes[0])
         vocab_start = idx * part
-        # global max for stability
-        local_max = jnp.max(lg, axis=-1, keepdims=True)
+        # global max for stability (shift-invariant → safe to stop-grad,
+        # and pmax has no AD rule)
+        local_max = lax.stop_gradient(jnp.max(lg, axis=-1, keepdims=True))
         gmax = lax.pmax(local_max, axes)
         shifted = lg - gmax
         sumexp = lax.psum(jnp.sum(jnp.exp(shifted), axis=-1, keepdims=True),
@@ -475,18 +476,28 @@ def _c_softmax_with_cross_entropy(logits, label, group=None,
         picked = jnp.take_along_axis(shifted, safe[..., None], axis=-1)
         picked = jnp.where(in_range[..., None], picked, 0.0)
         picked = lax.psum(picked, axes)
-        return (logZ - picked).reshape(lb.shape + (1,))
+        loss = logZ - picked
+        # ignored labels: zero loss and (via where's masked vjp) zero grad
+        ignored = (lb == ignore_index)[..., None]
+        loss = jnp.where(ignored, 0.0, loss)
+        return loss.reshape(lb.shape + (1,))
     return run_op('c_softmax_with_cross_entropy', fn, [logits, label],
                   n_nondiff=1)
 
 
-def _c_embedding(weight, x, start_index=0, group=None):
-    """Row-sharded embedding lookup (parity: c_embedding op)."""
+def _c_embedding(weight, x, start_index=None, group=None):
+    """Row-sharded embedding lookup (parity: c_embedding op). When
+    start_index is None it is derived from the rank's position on the group
+    axis × local rows (the shard_map local-view convention)."""
     axes = _group_axes(group)
 
     def fn(w, idx):
-        local = idx - start_index
         rows = w.shape[0]
+        if start_index is None and in_spmd_region() and axes:
+            start = _axis_index(axes) * rows
+        else:
+            start = start_index or 0
+        local = idx - start
         in_range = (local >= 0) & (local < rows)
         safe = jnp.clip(local, 0, rows - 1)
         out = jnp.take(w, safe, axis=0)

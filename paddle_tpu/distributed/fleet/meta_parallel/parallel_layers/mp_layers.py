@@ -2,14 +2,19 @@
 
 Reference parity: fleet/meta_parallel/parallel_layers/mp_layers.py —
 VocabParallelEmbedding:30, ColumnParallelLinear:97, RowParallelLinear:170,
-ParallelCrossEntropy:249. TPU-native: weights carry their shard (this rank's
-slice); matmuls stay full-size MXU calls; the comm primitives
-(_c_identity/_mp_allreduce/_c_concat/c_embedding psum) lower to XLA
-collectives on the 'mp' mesh axis inside the SPMD train step. Outside an
-SPMD region (single device) the layers degrade to their dense equivalents
-with mp_degree=1.
+ParallelCrossEntropy:249.
+
+TPU-native (single-controller) design: each logical parameter is ONE
+global-shaped array annotated with `split_axis` metadata. The hybrid engine
+runs the layer inside `shard_map` with in_spec P(...,'mp') on that axis, so
+the forward below sees the LOCAL shard — exactly the per-rank view the
+reference's multi-process layers hold — and the explicit collectives
+(_c_identity/_mp_allreduce/_c_concat/psum) lower to XLA collectives on the
+'mp' mesh axis. Outside an SPMD region the same code degrades to the dense
+layer (collectives are identities, the "shard" is the whole array), which is
+also what the reference does at mp_degree=1.
 """
-import numpy as np
+import jax.numpy as jnp
 
 from .....core.tensor import Tensor
 from .....nn.layer.base import Layer
@@ -20,12 +25,8 @@ from .... import collective as C
 
 def _mp_info(mp_group=None):
     """(world_size, rank, group) for the model-parallel axis."""
-    try:
-        from ... import fleet as fleet_mod
-    except ImportError:
-        fleet_mod = None
-    from ... import fleet
-    hcg = fleet.fleet._hcg if fleet.fleet._hcg is not None else None
+    from ... import fleet as fleet_singleton
+    hcg = fleet_singleton._hcg
     if mp_group is not None:
         return mp_group.nranks, max(mp_group.rank, 0), mp_group
     if hcg is not None:
@@ -35,8 +36,15 @@ def _mp_info(mp_group=None):
     return 1, 0, None
 
 
+def _mark(p, split_axis):
+    p.is_distributed = True
+    p.split_axis = split_axis
+    return p
+
+
 class VocabParallelEmbedding(Layer):
-    """Parity: mp_layers.py:30 — vocab dim sharded across mp ranks."""
+    """Parity: mp_layers.py:30 — vocab dim sharded across mp ranks
+    (split_axis=0 on the global [V, D] table)."""
 
     def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
                  mp_group=None, name=None):
@@ -44,24 +52,24 @@ class VocabParallelEmbedding(Layer):
         self.world_size, self.rank, self.group = _mp_info(mp_group)
         assert num_embeddings % self.world_size == 0
         self.num_embeddings = num_embeddings
-        self.per_part_size = num_embeddings // self.world_size
-        self.vocab_start_index = self.rank * self.per_part_size
+        self.embedding_dim = embedding_dim
         self.weight = self.create_parameter(
-            [self.per_part_size, embedding_dim], attr=weight_attr,
+            [num_embeddings, embedding_dim], attr=weight_attr,
             default_initializer=I.XavierUniform())
-        self.weight.is_distributed = self.world_size > 1
+        if self.world_size > 1:
+            _mark(self.weight, 0)
 
     def forward(self, x):
-        if self.world_size == 1:
+        if not (self.world_size > 1 and C.in_spmd_region()):
             return F.embedding(x, self.weight)
-        return C._c_embedding(self.weight, x,
-                              start_index=self.vocab_start_index,
+        return C._c_embedding(self.weight, x, start_index=None,
                               group=self.group)
 
 
 class ColumnParallelLinear(Layer):
-    """Parity: mp_layers.py:97 — weight [in, out/mp]; forward =
-    c_identity → matmul (→ optional all-gather of outputs)."""
+    """Parity: mp_layers.py:97 — global weight [in, out], sharded on the
+    out dim (split_axis=1). Forward: c_identity → local matmul → optional
+    c_concat."""
 
     def __init__(self, in_features, out_features, weight_attr=None,
                  has_bias=None, gather_output=True, fuse_matmul_bias=False,
@@ -69,31 +77,34 @@ class ColumnParallelLinear(Layer):
         super().__init__()
         self.world_size, self.rank, self.group = _mp_info(mp_group)
         assert out_features % self.world_size == 0
-        self.out_per_part = out_features // self.world_size
+        self.in_features = in_features
+        self.out_features = out_features
         self.gather_output = gather_output
         self.weight = self.create_parameter(
-            [in_features, self.out_per_part], attr=weight_attr,
+            [in_features, out_features], attr=weight_attr,
             default_initializer=I.XavierUniform())
-        self.weight.is_distributed = self.world_size > 1
-        if has_bias is None:
-            has_bias = True
+        has_bias = True if has_bias is None else has_bias
         self.bias = self.create_parameter(
-            [self.out_per_part], is_bias=True) if has_bias else None
-        if self.bias is not None:
-            self.bias.is_distributed = self.world_size > 1
+            [out_features], is_bias=True) if has_bias else None
+        if self.world_size > 1:
+            _mark(self.weight, 1)
+            if self.bias is not None:
+                _mark(self.bias, 0)
 
     def forward(self, x):
-        if self.world_size > 1:
+        spmd = self.world_size > 1 and C.in_spmd_region()
+        if spmd:
             x = C._c_identity(x, group=self.group)
         out = F.linear(x, self.weight, self.bias)
-        if self.gather_output and self.world_size > 1:
+        if spmd and self.gather_output:
             out = C._c_concat(out, group=self.group)
         return out
 
 
 class RowParallelLinear(Layer):
-    """Parity: mp_layers.py:170 — weight [in/mp, out]; forward = (split
-    input) → matmul → mp_allreduce(+bias)."""
+    """Parity: mp_layers.py:170 — global weight [in, out], sharded on the
+    in dim (split_axis=0). Forward: (split input) → local matmul →
+    mp_allreduce → +bias (bias replicated)."""
 
     def __init__(self, in_features, out_features, weight_attr=None,
                  has_bias=True, input_is_parallel=False,
@@ -101,18 +112,20 @@ class RowParallelLinear(Layer):
         super().__init__()
         self.world_size, self.rank, self.group = _mp_info(mp_group)
         assert in_features % self.world_size == 0
-        self.in_per_part = in_features // self.world_size
+        self.in_features = in_features
+        self.out_features = out_features
         self.input_is_parallel = input_is_parallel
         self.weight = self.create_parameter(
-            [self.in_per_part, out_features], attr=weight_attr,
+            [in_features, out_features], attr=weight_attr,
             default_initializer=I.XavierUniform())
-        self.weight.is_distributed = self.world_size > 1
         self.bias = self.create_parameter(
             [out_features], is_bias=True) if has_bias else None
-        # bias added AFTER allreduce → replicated, not distributed
+        if self.world_size > 1:
+            _mark(self.weight, 0)
 
     def forward(self, x):
-        if self.world_size == 1:
+        spmd = self.world_size > 1 and C.in_spmd_region()
+        if not spmd:
             return F.linear(x, self.weight, self.bias)
         if not self.input_is_parallel:
             x = C._c_split(x, group=self.group)
@@ -125,7 +138,8 @@ class RowParallelLinear(Layer):
 
 
 class ParallelCrossEntropy(Layer):
-    """Parity: mp_layers.py:249 — vocab-parallel softmax cross entropy."""
+    """Parity: mp_layers.py:249 — vocab-parallel softmax cross entropy over
+    class-dim-sharded logits."""
 
     def __init__(self, mp_group=None, name=None, ignore_index=-100):
         super().__init__()
@@ -133,7 +147,8 @@ class ParallelCrossEntropy(Layer):
         self.ignore_index = ignore_index
 
     def forward(self, input, label):
-        if self.world_size == 1:
-            return F.softmax_with_cross_entropy(input, label)
+        if not (self.world_size > 1 and C.in_spmd_region()):
+            return F.softmax_with_cross_entropy(
+                input, label, ignore_index=self.ignore_index)
         return C._c_softmax_with_cross_entropy(
             input, label, group=self.group, ignore_index=self.ignore_index)

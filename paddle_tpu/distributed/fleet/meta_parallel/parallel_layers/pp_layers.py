@@ -100,20 +100,14 @@ class PipelineLayer(Layer):
         self._recompute_interval = recompute_interval
         if num_stages is None and topology is None:
             num_stages = 1
-        if topology is not None:
-            from ... import fleet
-            hcg = fleet.fleet._hcg
-            self._num_stages = hcg.get_pipe_parallel_world_size() \
-                if hcg else (num_stages or 1)
-            self._stage_id = hcg.get_stage_id() if hcg else 0
+        from ... import fleet as fleet_singleton
+        hcg = fleet_singleton._hcg
+        if hcg is not None:
+            self._num_stages = hcg.get_pipe_parallel_world_size()
+            self._stage_id = hcg.get_stage_id()
         else:
-            self._num_stages = num_stages
+            self._num_stages = num_stages or 1
             self._stage_id = 0
-            from ... import fleet
-            if fleet.fleet._hcg is not None:
-                self._num_stages = \
-                    fleet.fleet._hcg.get_pipe_parallel_world_size()
-                self._stage_id = fleet.fleet._hcg.get_stage_id()
 
         self.segment_parts = SegmentLayers(
             self._layers_desc, self._num_stages, seg_method).do_segment()

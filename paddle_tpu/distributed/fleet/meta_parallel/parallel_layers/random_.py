@@ -60,8 +60,8 @@ def get_rng_state_tracker():
 
 def model_parallel_random_seed(seed=None):
     """Parity: random.py model_parallel_random_seed."""
-    from ... import fleet
-    hcg = fleet.fleet._hcg
+    from ... import fleet as fleet_singleton
+    hcg = fleet_singleton._hcg
     rank = hcg.get_model_parallel_rank() if hcg else 0
     if seed:
         global_seed = seed

@@ -113,9 +113,16 @@ class TrainStep:
                              if not p.stop_gradient]
         self._params = get_params(model)
         self._buffers = get_buffers(model)
-        self._opt_states = {
-            n: optimizer.init_state(dict(_named_params(model))[n])
-            for n in self._param_names}
+        lookup = dict(_named_params(model))
+        self._opt_states = {}
+        for n in self._param_names:
+            st = optimizer.init_state(lookup[n])
+            if lookup[n].data.dtype != jnp.float32 and \
+                    getattr(optimizer, '_multi_precision', True):
+                # pre-seed the fp32 master so the state pytree structure is
+                # stable across steps (lax.scan carry requirement)
+                st['master'] = lookup[n].data.astype(jnp.float32)
+            self._opt_states[n] = st
         self._compiled = jax.jit(
             self._step,
             donate_argnums=(0, 1, 2) if donate else ())
@@ -150,6 +157,41 @@ class TrainStep:
     def sync_model(self):
         """Write jitted state back into the eager Layer (for save/eval)."""
         write_back(self.model, self._params, self._buffers)
+
+    # -- multi-step: k steps per dispatch (amortizes host→device launch; on
+    # a tunneled/remote chip this is the difference between RTT-bound and
+    # compute-bound) ---------------------------------------------------------
+    def compile_multi_step(self, k=None):
+        if getattr(self, '_multi', None) is not None:
+            return  # jax.jit caches per input shape — one jit covers all k
+        step = self._step
+
+        def many(params, buffers, opt_states, lr, keys, batch_stack):
+            def body(carry, xs):
+                p, b, s = carry
+                key = xs[0]
+                batch = xs[1]
+                loss, p2, b2, s2 = step(p, b, s, lr, key, batch)
+                return (p2, b2, s2), loss
+            (p, b, s), losses = jax.lax.scan(
+                body, (params, buffers, opt_states), (keys, batch_stack))
+            return losses, p, b, s
+
+        self._multi = jax.jit(many, donate_argnums=(0, 1, 2))
+
+    def run_steps(self, *batch_stacks):
+        """Each arg: array with leading dim k (one slice per step). Returns
+        the k per-step losses as one Tensor."""
+        arrays = tuple(b.data if isinstance(b, Tensor) else jnp.asarray(b)
+                       for b in batch_stacks)
+        k = arrays[0].shape[0]
+        self.compile_multi_step()
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        keys = jax.random.split(rng_mod.next_key(), k)
+        losses, self._params, self._buffers, self._opt_states = self._multi(
+            self._params, self._buffers, self._opt_states, lr, keys, arrays)
+        self._step_i += k
+        return Tensor(losses)
 
 
 class EvalStep:

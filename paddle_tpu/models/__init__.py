@@ -1,0 +1,11 @@
+"""Flagship model zoo (language models; vision lives in paddle_tpu.vision).
+
+Reference parity: the GPT/BERT model definitions used by the reference's
+fleet hybrid-parallel tests (hybrid_parallel_pp_transformer.py,
+hybrid_parallel_mp_model.py patterns) and the PaddleNLP GPT that
+sandyhouse/Paddle's pipeline/sharding work was built to train.
+"""
+from .gpt import (GPTConfig, GPTModel, GPTForCausalLM,
+                  GPTPretrainingCriterion, gpt_tiny, gpt_small, gpt_medium,
+                  gpt_1p3b)
+from .bert import BertConfig, BertModel, BertForPretraining

@@ -1,0 +1,279 @@
+"""GPT — the flagship model (BASELINE config 4: GPT-3 1.3B hybrid parallel).
+
+Reference parity: the GPT used by sandyhouse/Paddle's fleet hybrid-parallel
+stack (the pipeline/sharding meta-optimizers were built to train it;
+test models: fluid/tests/unittests/hybrid_parallel_pp_transformer.py,
+hybrid_parallel_mp_layers.py patterns).
+
+TPU-native: decoder blocks are built from the tensor-parallel layers
+(VocabParallelEmbedding / ColumnParallelLinear / RowParallelLinear), so under
+the hybrid engine's shard_map the qkv/ffn matmuls run on mp-local shards with
+XLA collectives between them — Megatron semantics on ICI. Attention uses one
+fused softmax(QK^T)V with a causal mask in-kernel (MXU-shaped batched
+matmuls); the Pallas flash-attention kernel swaps in for long sequences.
+All shapes static; dropout keys via the global RNG stream.
+"""
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.tensor import Tensor
+from ..core.autograd import run_op
+from ..ops import math as M
+from ..ops import manip
+from ..ops import nn_ops as F
+from ..nn import initializer as I
+from ..distributed.fleet.meta_parallel.parallel_layers.mp_layers import (
+    VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear,
+    ParallelCrossEntropy, _mp_info)
+
+
+class GPTConfig:
+    def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
+                 num_heads=12, ffn_hidden_size=None, max_seq_len=1024,
+                 hidden_dropout=0.1, attn_dropout=0.1,
+                 initializer_range=0.02, layer_norm_eps=1e-5,
+                 use_flash_attention=True):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.ffn_hidden_size = ffn_hidden_size or 4 * hidden_size
+        self.max_seq_len = max_seq_len
+        self.hidden_dropout = hidden_dropout
+        self.attn_dropout = attn_dropout
+        self.initializer_range = initializer_range
+        self.layer_norm_eps = layer_norm_eps
+        self.use_flash_attention = use_flash_attention
+
+
+def gpt_tiny(**kw):
+    return GPTConfig(vocab_size=1024, hidden_size=128, num_layers=4,
+                     num_heads=4, max_seq_len=256, **kw)
+
+
+def gpt_small(**kw):  # GPT-2 124M
+    return GPTConfig(hidden_size=768, num_layers=12, num_heads=12, **kw)
+
+
+def gpt_medium(**kw):  # 350M
+    return GPTConfig(hidden_size=1024, num_layers=24, num_heads=16, **kw)
+
+
+def gpt_1p3b(**kw):  # GPT-3 1.3B (BASELINE config 4)
+    return GPTConfig(hidden_size=2048, num_layers=24, num_heads=16,
+                     max_seq_len=2048, **kw)
+
+
+class GPTEmbeddings(nn.Layer):
+    """Token (vocab-parallel) + learned position embeddings."""
+
+    def __init__(self, config):
+        super().__init__()
+        init = I.Normal(0.0, config.initializer_range)
+        self.word_embeddings = VocabParallelEmbedding(
+            config.vocab_size, config.hidden_size,
+            weight_attr=nn.ParamAttr(initializer=init))
+        self.position_embeddings = nn.Embedding(
+            config.max_seq_len, config.hidden_size,
+            weight_attr=nn.ParamAttr(initializer=init))
+        self.dropout = nn.Dropout(config.hidden_dropout)
+
+    def forward(self, input_ids, position_ids=None):
+        if position_ids is None:
+            L = input_ids.shape[-1]
+            position_ids = Tensor(jnp.arange(L, dtype=jnp.int32))
+        tok = self.word_embeddings(input_ids)
+        pos = self.position_embeddings(position_ids)
+        return self.dropout(M.add(tok, pos))
+
+
+class GPTAttention(nn.Layer):
+    """Causal self-attention, heads sharded over mp.
+
+    qkv = ColumnParallel (gather_output=False) so each mp rank holds
+    nh/mp heads; out proj = RowParallel(input_is_parallel) — one allreduce
+    per attention block, Megatron-style.
+    """
+
+    def __init__(self, config):
+        super().__init__()
+        self.world_size, _, _ = _mp_info()
+        self.num_heads = config.num_heads
+        self.head_dim = config.hidden_size // config.num_heads
+        self.local_heads = config.num_heads // self.world_size
+        self.attn_dropout_p = config.attn_dropout
+        self.use_flash = config.use_flash_attention
+        init = I.Normal(0.0, config.initializer_range)
+        out_init = I.Normal(
+            0.0, config.initializer_range / math.sqrt(2 * config.num_layers))
+        self.qkv_proj = ColumnParallelLinear(
+            config.hidden_size, 3 * config.hidden_size,
+            weight_attr=nn.ParamAttr(initializer=init), gather_output=False)
+        self.out_proj = RowParallelLinear(
+            config.hidden_size, config.hidden_size,
+            weight_attr=nn.ParamAttr(initializer=out_init),
+            input_is_parallel=True)
+        self.dropout = nn.Dropout(config.hidden_dropout)
+
+    def forward(self, x):
+        B, L, _ = x.shape
+        qkv = self.qkv_proj(x)  # [B, L, 3*H/mp]
+        hd, nh = self.head_dim, qkv.shape[-1] // (3 * self.head_dim)
+
+        # out-dim layout is (head, 3, hd): column-sharding then hands each
+        # mp rank whole heads (Megatron qkv packing), so TP == dense.
+        def attn(a, key=None):
+            x5 = a.reshape(B, L, nh, 3, hd)
+            q, k, v = x5[:, :, :, 0], x5[:, :, :, 1], x5[:, :, :, 2]
+            q = q.transpose(0, 2, 1, 3)  # B, nh, L, hd
+            k = k.transpose(0, 2, 1, 3)
+            v = v.transpose(0, 2, 1, 3)
+            scores = jnp.einsum('bhqd,bhkd->bhqk', q, k,
+                                preferred_element_type=jnp.float32)
+            scores = scores * (1.0 / math.sqrt(hd))
+            causal = jnp.tril(jnp.ones((L, L), bool))
+            scores = jnp.where(causal, scores, jnp.asarray(-1e9, scores.dtype))
+            probs = jax.nn.softmax(scores, axis=-1).astype(a.dtype)
+            out = jnp.einsum('bhqk,bhkd->bhqd', probs, v)
+            return out.transpose(0, 2, 1, 3).reshape(B, L, nh * hd)
+
+        if self.use_flash and L >= 512:
+            from ..ops.pallas import flash_attention as fa
+            ctx = fa.causal_attention(qkv, nh, hd,
+                                      dropout=self.attn_dropout_p
+                                      if self.training else 0.0)
+        else:
+            ctx = run_op('fused_attention', attn, [qkv])
+        out = self.out_proj(ctx)
+        return self.dropout(out)
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        init = I.Normal(0.0, config.initializer_range)
+        out_init = I.Normal(
+            0.0, config.initializer_range / math.sqrt(2 * config.num_layers))
+        self.fc1 = ColumnParallelLinear(
+            config.hidden_size, config.ffn_hidden_size,
+            weight_attr=nn.ParamAttr(initializer=init), gather_output=False)
+        self.fc2 = RowParallelLinear(
+            config.ffn_hidden_size, config.hidden_size,
+            weight_attr=nn.ParamAttr(initializer=out_init),
+            input_is_parallel=True)
+        self.dropout = nn.Dropout(config.hidden_dropout)
+
+    def forward(self, x):
+        return self.dropout(self.fc2(F.gelu(self.fc1(x), approximate=True)))
+
+
+class GPTDecoderLayer(nn.Layer):
+    """Pre-LN transformer block."""
+
+    def __init__(self, config):
+        super().__init__()
+        self.ln1 = nn.LayerNorm(config.hidden_size,
+                                epsilon=config.layer_norm_eps)
+        self.attn = GPTAttention(config)
+        self.ln2 = nn.LayerNorm(config.hidden_size,
+                                epsilon=config.layer_norm_eps)
+        self.mlp = GPTMLP(config)
+
+    def forward(self, x):
+        x = M.add(x, self.attn(self.ln1(x)))
+        x = M.add(x, self.mlp(self.ln2(x)))
+        return x
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.config = config
+        self.embeddings = GPTEmbeddings(config)
+        self.layers = nn.LayerList(
+            [GPTDecoderLayer(config) for _ in range(config.num_layers)])
+        self.final_norm = nn.LayerNorm(config.hidden_size,
+                                       epsilon=config.layer_norm_eps)
+
+    def forward(self, input_ids, position_ids=None):
+        x = self.embeddings(input_ids, position_ids)
+        for layer in self.layers:
+            x = layer(x)
+        return self.final_norm(x)
+
+
+class GPTForCausalLM(nn.Layer):
+    """LM head tied to the (vocab-parallel) input embedding — parity with
+    the SharedLayerDesc tying in the reference's pipeline GPT (A.4)."""
+
+    def __init__(self, config):
+        super().__init__()
+        self.gpt = GPTModel(config)
+        self.config = config
+
+    def forward(self, input_ids, position_ids=None):
+        hidden = self.gpt(input_ids, position_ids)
+        w = self.gpt.embeddings.word_embeddings.weight  # [V(/mp local), H]
+        logits = M.matmul(hidden, w, transpose_y=True)
+        return logits  # class dim vocab-parallel under mp
+
+
+class GPTPretrainingCriterion(nn.Layer):
+    """Parity: vocab-parallel softmax CE loss with mean over tokens."""
+
+    def __init__(self, config=None):
+        super().__init__()
+        self.ce = ParallelCrossEntropy()
+
+    def forward(self, logits, labels, loss_mask=None):
+        loss = self.ce(logits, labels)
+        if loss_mask is not None:
+            masked = M.multiply(manip.reshape(loss, labels.shape), loss_mask)
+            return M.divide(M.sum(masked), M.sum(loss_mask))
+        return M.mean(loss)
+
+
+class GPTLMHead(nn.Layer):
+    """Final norm + (vocab-parallel) LM head + criterion — the last pipeline
+    stage's tail. Untied head weight (the tied variant runs under the
+    non-pipelined hybrid engine; tying across stages costs a pp-psum the
+    engine applies to the embed tree — A.4)."""
+
+    def __init__(self, config):
+        super().__init__()
+        self.norm = nn.LayerNorm(config.hidden_size,
+                                 epsilon=config.layer_norm_eps)
+        init = I.Normal(0.0, config.initializer_range)
+        self.out = ColumnParallelLinear(
+            config.hidden_size, config.vocab_size,
+            weight_attr=nn.ParamAttr(initializer=init),
+            has_bias=False, gather_output=False)
+        self.ce = ParallelCrossEntropy()
+
+    def forward(self, hidden, labels):
+        logits = self.out(self.norm(hidden))
+        loss = self.ce(logits, labels)
+        return M.mean(loss)
+
+
+def build_gpt_pipeline(config):
+    """(embed, blocks, head) triple for SpmdPipelineEngine."""
+    embed = GPTEmbeddings(config)
+    blocks = [GPTDecoderLayer(config) for _ in range(config.num_layers)]
+    head = GPTLMHead(config)
+    return embed, blocks, head
+
+
+def gpt_pipeline_descs(config):
+    """LayerDesc list for PipelineLayer partitioning (parity: pp GPT built
+    from LayerDesc/SharedLayerDesc, pp_layers.py)."""
+    from ..distributed.fleet.meta_parallel import LayerDesc, SharedLayerDesc
+    descs = [SharedLayerDesc('embed', GPTEmbeddings, config=config)]
+    for _ in range(config.num_layers):
+        descs.append(LayerDesc(GPTDecoderLayer, config))
+    descs.append(LayerDesc(nn.LayerNorm, config.hidden_size))
+    return descs

@@ -1,0 +1,8 @@
+"""Pallas TPU kernels — custom kernels where XLA fusion isn't enough.
+
+Reference parity: the role of operators/fused/ (fused_attention,
+fused_softmax_mask, multihead_matmul — N27) — on TPU most fusions are XLA's
+job; Pallas covers the blockwise-algorithm cases (flash attention's online
+softmax) that XLA cannot derive.
+"""
+from . import flash_attention

@@ -4,10 +4,17 @@ multi-controller simulation replaces the reference's 2-process NCCL tests).
 import os
 import sys
 
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+os.environ['JAX_PLATFORMS'] = 'cpu'  # override the session's axon default
 flags = os.environ.get('XLA_FLAGS', '')
 if 'xla_force_host_platform_device_count' not in flags:
     os.environ['XLA_FLAGS'] = \
         flags + ' --xla_force_host_platform_device_count=8'
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The axon sitecustomize force-registers the TPU plugin regardless of env;
+# re-pin the platform at the config level so tests run on the virtual
+# 8-device CPU mesh.
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', 'cpu')
