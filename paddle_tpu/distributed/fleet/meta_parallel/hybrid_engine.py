@@ -70,6 +70,7 @@ class HybridParallelTrainStep:
         self.dp = self.mesh.shape.get('dp', 1)
         self.sharding_deg = self.mesh.shape.get('sharding', 1)
         self.mp = self.mesh.shape.get('mp', 1)
+        self.sp = self.mesh.shape.get('sp', 1)
 
         named = [(n, p) for n, p in model.named_parameters()
                  if not p.stop_gradient]
@@ -124,10 +125,12 @@ class HybridParallelTrainStep:
                               NamedSharding(self.mesh, spec))
 
     # -- the SPMD step --------------------------------------------------------
-    def _build(self, n_batch):
+    def _build(self):
         model, loss_fn, opt = self.model, self.loss_fn, self.optimizer
         axes = self.axes
-        dp_axes = tuple(a for a in ('dp', 'sharding') if a in axes
+        # axes whose shards see different data → loss/grad pmean + distinct
+        # dropout keys ('sp' chunks are different tokens, like dp shards)
+        dp_axes = tuple(a for a in ('dp', 'sharding', 'sp') if a in axes
                         and self.mesh.shape[a] > 1)
         zero_ok = self._zero_ok
         s = self.sharding_deg
@@ -206,8 +209,23 @@ class HybridParallelTrainStep:
                     new_states[n] = ns
                 return loss, new_params, new_states
 
-        batch_specs = tuple(P('dp') for _ in range(n_batch)) \
-            if 'dp' in axes else tuple(P() for _ in range(n_batch))
+        # sequence sharding only for models that declare support (GPT sets
+        # _supports_sequence_parallel; others would silently attend within
+        # chunks) — the mesh may still carry an sp axis for other tensors.
+        sp_on = ('sp' in axes and self.mesh.shape['sp'] > 1
+                 and getattr(self.model, '_supports_sequence_parallel',
+                             False))
+        if 'sp' in axes and self.mesh.shape['sp'] > 1 and not sp_on:
+            raise ValueError(
+                "mesh has sp>1 but the model does not declare "
+                "_supports_sequence_parallel; sequence-sharding it would "
+                "silently train wrong")
+        dp_name = 'dp' if 'dp' in axes else None
+        def _bspec(nd):
+            if nd >= 2 and sp_on:
+                return P(dp_name, 'sp')
+            return P(dp_name) if dp_name else P()
+        batch_specs = tuple(_bspec(nd) for nd in self._batch_ndims)
         in_specs = (self._param_specs, self._state_specs, P(), P(),
                     *batch_specs)
         out_specs = (P(), self._param_specs, self._state_specs)
@@ -241,7 +259,8 @@ class HybridParallelTrainStep:
         arrays = tuple(b.data if isinstance(b, Tensor) else jnp.asarray(b)
                        for b in batch)
         if self._compiled is None:
-            self._compiled = self._build(len(arrays))
+            self._batch_ndims = tuple(a.ndim for a in arrays)
+            self._compiled = self._build()
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         key = rng_mod.next_key()
         loss, self._params, self._states = self._compiled(

@@ -68,8 +68,17 @@ def gpt_1p3b(**kw):  # GPT-3 1.3B (BASELINE config 4)
                      max_seq_len=2048, **kw)
 
 
+def _sp_active():
+    """True when tracing inside an SPMD region with a live 'sp' axis."""
+    from ..distributed import collective as C
+    from ..distributed import topology_runtime
+    return (C.in_spmd_region() and 'sp' in C.current_spmd_axes()
+            and topology_runtime.axis_size('sp') > 1)
+
+
 class GPTEmbeddings(nn.Layer):
-    """Token (vocab-parallel) + learned position embeddings."""
+    """Token (vocab-parallel) + learned position embeddings. Under sequence
+    parallelism the local chunk's positions are offset by the sp rank."""
 
     def __init__(self, config):
         super().__init__()
@@ -85,7 +94,11 @@ class GPTEmbeddings(nn.Layer):
     def forward(self, input_ids, position_ids=None):
         if position_ids is None:
             L = input_ids.shape[-1]
-            position_ids = Tensor(jnp.arange(L, dtype=jnp.int32))
+            pos = jnp.arange(L, dtype=jnp.int32)
+            if _sp_active():
+                from jax import lax
+                pos = pos + lax.axis_index('sp') * L
+            position_ids = Tensor(pos)
         tok = self.word_embeddings(input_ids)
         pos = self.position_embeddings(position_ids)
         return self.dropout(M.add(tok, pos))
@@ -141,7 +154,16 @@ class GPTAttention(nn.Layer):
             out = jnp.einsum('bhqk,bhkd->bhqd', probs, v)
             return out.transpose(0, 2, 1, 3).reshape(B, L, nh * hd)
 
-        if self.use_flash and L >= 512:
+        if _sp_active():
+            # sequence-parallel: K/V ring over the 'sp' axis (net-new vs the
+            # reference — SURVEY.md §5.7)
+            from ..ops import ring_attention as ra
+            from ..distributed import topology_runtime
+            ctx = ra.ring_causal_qkv(qkv, nh, hd, axis_name='sp',
+                                     sp=topology_runtime.axis_size('sp'),
+                                     dropout=self.attn_dropout_p
+                                     if self.training else 0.0)
+        elif self.use_flash and L >= 512:
             from ..ops.pallas import flash_attention as fa
             ctx = fa.causal_attention(qkv, nh, hd,
                                       dropout=self.attn_dropout_p
@@ -190,6 +212,8 @@ class GPTDecoderLayer(nn.Layer):
 
 
 class GPTModel(nn.Layer):
+    _supports_sequence_parallel = True
+
     def __init__(self, config):
         super().__init__()
         self.config = config
@@ -209,6 +233,8 @@ class GPTModel(nn.Layer):
 class GPTForCausalLM(nn.Layer):
     """LM head tied to the (vocab-parallel) input embedding — parity with
     the SharedLayerDesc tying in the reference's pipeline GPT (A.4)."""
+
+    _supports_sequence_parallel = True
 
     def __init__(self, config):
         super().__init__()

@@ -1,0 +1,163 @@
+"""Ring attention — sequence/context parallelism over the 'sp' mesh axis.
+
+NET-NEW capability (the reference has none — SURVEY.md §5.7 verified absent);
+designed TPU-first per the survey's recommendation: sequence dim sharded over
+a mesh axis, K/V blocks rotating around the ICI ring via
+`lax.ppermute` while each device accumulates its queries' attention with an
+online softmax (blockwise/flash-style), so attention over a sequence of
+length L costs O(L/sp) memory per chip and the K/V transfer fully overlaps
+with per-block compute under XLA's async collectives.
+
+Causality across the ring: each device holds a contiguous sequence chunk
+(chunk index = axis position). A rotating K/V block is
+  * fully visible   if src_chunk <  my_chunk
+  * causal-diagonal if src_chunk == my_chunk (lower-triangular in-block)
+  * invisible       if src_chunk >  my_chunk  (skipped via mask)
+"""
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor
+from ..core.autograd import run_op
+
+NEG_INF = -1e30
+
+
+def _ring_attention_arrays(q, k, v, axis_name, causal=True, sp=None,
+                           dropout=0.0, key=None):
+    """q/k/v: [B, nh, Lc, hd] local chunks; returns [B, nh, Lc, hd]."""
+    if sp is None:
+        sp = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    B, nh, Lc, hd = q.shape
+    scale = 1.0 / math.sqrt(hd)
+    qf = q.astype(jnp.float32) * scale
+    if dropout > 0.0 and key is None:
+        from ..core import rng as rng_mod
+        key = rng_mod.next_key()
+
+    m0 = jnp.full((B, nh, Lc, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, nh, Lc, 1), jnp.float32)
+    acc0 = jnp.zeros((B, nh, Lc, hd), jnp.float32)
+
+    def compute_block(kk, vv, m, l, acc, src):
+        s = jnp.einsum('bhqd,bhkd->bhqk', qf, kk.astype(jnp.float32),
+                       preferred_element_type=jnp.float32)
+        if causal:
+            rows = lax.broadcasted_iota(jnp.int32, (Lc, Lc), 0) + my * Lc
+            cols = lax.broadcasted_iota(jnp.int32, (Lc, Lc), 1) + src * Lc
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        # normalizer uses the UNdropped probs (standard attention-dropout
+        # semantics: mask applied to softmax output, denominator unchanged)
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        if dropout > 0.0:
+            bk = jax.random.fold_in(jax.random.fold_in(key, my), src)
+            keep = jax.random.bernoulli(bk, 1.0 - dropout, p.shape)
+            p = jnp.where(keep, p / (1.0 - dropout), 0.0)
+        acc_new = acc * alpha + jnp.einsum(
+            'bhqk,bhkd->bhqd', p, vv.astype(jnp.float32),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    def step(carry, i):
+        kk, vv, m, l, acc = carry
+        src = (my + i) % sp  # which chunk kk/vv currently holds
+        if causal:
+            # invisible blocks (src > my): skip the attention math entirely
+            # (≈half the ring FLOPs); predicate is per-device but contains
+            # no collectives, so cond is safe under shard_map.
+            m, l, acc = lax.cond(
+                src <= my,
+                lambda args: compute_block(*args, src),
+                lambda args: (args[2], args[3], args[4]),
+                (kk, vv, m, l, acc))
+        else:
+            m, l, acc = compute_block(kk, vv, m, l, acc, src)
+        # rotate K/V to the next device (overlaps with next block's matmul);
+        # the final rotation's result is never read but keeping it
+        # unconditional keeps the collective schedule uniform across devices
+        perm = [(j, (j - 1) % sp) for j in range(sp)]
+        kk = lax.ppermute(kk, axis_name, perm)
+        vv = lax.ppermute(vv, axis_name, perm)
+        return (kk, vv, m, l, acc), None
+
+    (kk, vv, m, l, acc), _ = lax.scan(
+        step, (k, v, m0, l0, acc0), jnp.arange(sp))
+    out = acc / jnp.maximum(l, 1e-30)
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, axis_name='sp', causal=True, sp=None):
+    """Tensor-level op: q/k/v [B, nh, Lc, hd] (sequence-chunk local)."""
+    def fn(qa, ka, va):
+        return _ring_attention_arrays(qa, ka, va, axis_name, causal=causal,
+                                      sp=sp)
+    return run_op('ring_attention', fn, [q, k, v])
+
+
+def ring_causal_qkv(qkv, num_heads, head_dim, axis_name='sp', sp=None,
+                    dropout=0.0):
+    """GPTAttention entry: qkv [B, Lc, nh*3*hd] ((head,3,hd) packing) →
+    [B, Lc, nh*hd]."""
+    if dropout > 0.0:
+        from ..core import rng as rng_mod
+        key = rng_mod.next_key()
+    else:
+        key = None
+
+    def fn(a):
+        B, Lc, _ = a.shape
+        x = a.reshape(B, Lc, num_heads, 3, head_dim)
+        q = x[:, :, :, 0].transpose(0, 2, 1, 3)
+        k = x[:, :, :, 1].transpose(0, 2, 1, 3)
+        v = x[:, :, :, 2].transpose(0, 2, 1, 3)
+        o = _ring_attention_arrays(q, k, v, axis_name, causal=True, sp=sp,
+                                   dropout=dropout, key=key)
+        return o.transpose(0, 2, 1, 3).reshape(B, Lc, num_heads * head_dim)
+    return run_op('ring_attention_qkv', fn, [qkv])
+
+
+# ---- all-to-all sequence parallelism (DeepSpeed-Ulysses style) -------------
+def ulysses_attention(qkv, num_heads, head_dim, axis_name='sp', sp=None):
+    """Alternative long-context scheme: all-to-all swaps the sequence
+    sharding for a head sharding, runs FULL-sequence attention on nh/sp
+    local heads, and swaps back — 2 AllToAlls instead of a ring, better when
+    nh ≥ sp and per-chip memory allows L-length scores blocks.
+    qkv [B, Lc, nh*3*hd] → [B, Lc, nh*hd]."""
+    if sp is not None and num_heads % sp != 0:
+        raise ValueError(
+            f"ulysses_attention: num_heads ({num_heads}) must be divisible "
+            f"by the sequence-parallel degree ({sp})")
+
+    def fn(a):
+        B, Lc, _ = a.shape
+        n = lax.psum(1, axis_name) if sp is None else sp
+        x = a.reshape(B, Lc, num_heads, 3 * head_dim)
+        # [B, Lc, nh, 3hd] → all-to-all: split heads, concat sequence
+        x = lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                           tiled=True)  # [B, L, nh/sp, 3hd]
+        L = x.shape[1]
+        nh_loc = x.shape[2]
+        x5 = x.reshape(B, L, nh_loc, 3, head_dim)
+        q = x5[:, :, :, 0].transpose(0, 2, 1, 3)
+        k = x5[:, :, :, 1].transpose(0, 2, 1, 3)
+        v = x5[:, :, :, 2].transpose(0, 2, 1, 3)
+        s = jnp.einsum('bhqd,bhkd->bhqk', q.astype(jnp.float32),
+                       k.astype(jnp.float32)) / math.sqrt(head_dim)
+        mask = jnp.tril(jnp.ones((L, L), bool))
+        s = jnp.where(mask, s, NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum('bhqk,bhkd->bhqd', p, v.astype(jnp.float32))
+        o = o.astype(a.dtype).transpose(0, 2, 1, 3)  # B, L, nh/sp, hd
+        # swap back: split sequence, concat heads
+        o = lax.all_to_all(o, axis_name, split_axis=1, concat_axis=2,
+                           tiled=True)  # B, Lc, nh, hd
+        return o.reshape(B, Lc, num_heads * head_dim)
+    return run_op('ulysses_attention', fn, [qkv])
