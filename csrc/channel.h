@@ -1,0 +1,66 @@
+// Blocking bounded MPMC channel.
+//
+// Reference parity: paddle/fluid/framework/channel.h — the queue backing the
+// data-feed pipeline (file readers -> batch assembler -> device feed).
+#pragma once
+
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+
+namespace ptpu {
+
+template <typename T>
+class Channel {
+ public:
+  explicit Channel(size_t capacity = 0) : capacity_(capacity) {}
+
+  // Returns false if the channel is closed.
+  bool Put(T&& item) {
+    std::unique_lock<std::mutex> lk(mu_);
+    send_cv_.wait(lk, [&] {
+      return closed_ || capacity_ == 0 || buf_.size() < capacity_;
+    });
+    if (closed_) return false;
+    buf_.push_back(std::move(item));
+    recv_cv_.notify_one();
+    return true;
+  }
+
+  // Returns false when closed AND drained.
+  bool Get(T* out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    recv_cv_.wait(lk, [&] { return closed_ || !buf_.empty(); });
+    if (buf_.empty()) return false;
+    *out = std::move(buf_.front());
+    buf_.pop_front();
+    send_cv_.notify_one();
+    return true;
+  }
+
+  void Close() {
+    std::lock_guard<std::mutex> lk(mu_);
+    closed_ = true;
+    send_cv_.notify_all();
+    recv_cv_.notify_all();
+  }
+
+  size_t Size() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return buf_.size();
+  }
+
+  bool Closed() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return closed_;
+  }
+
+ private:
+  size_t capacity_;
+  bool closed_ = false;
+  std::deque<T> buf_;
+  std::mutex mu_;
+  std::condition_variable send_cv_, recv_cv_;
+};
+
+}  // namespace ptpu

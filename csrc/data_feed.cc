@@ -1,0 +1,234 @@
+// Host data-feed pipeline: files -> reader threads -> channel -> batches.
+//
+// Reference parity: paddle/fluid/framework/data_feed.cc (DataFeed:208,
+// InMemoryDataFeed:395, MultiSlotDataFeed:757) + data_set.cc shuffle — the
+// C++ ingestion stack under fleet's InMemoryDataset. TPU-native shape: the
+// assembled batch is a dense contiguous float/int64 buffer ready for one
+// host->device transfer (PJRT handles the copy; no LoD — fixed slot widths).
+//
+// Record text format (MultiSlot-style, one instance per line):
+//   slot0_v0 slot0_v1 ... | slot1_v0 ... | ...
+// with per-slot fixed widths declared at init; '|' separates slots.
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <memory>
+#include <random>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "channel.h"
+
+namespace ptpu {
+
+struct SlotDesc {
+  int width;      // values per instance
+  bool is_float;  // else int64
+};
+
+struct Instance {
+  std::vector<float> fvals;
+  std::vector<int64_t> ivals;
+};
+
+class DataFeed {
+ public:
+  DataFeed(std::vector<SlotDesc> slots, int batch_size, int num_threads,
+           size_t channel_capacity)
+      : slots_(std::move(slots)),
+        batch_size_(batch_size),
+        num_threads_(num_threads),
+        channel_(channel_capacity ? channel_capacity : 4096) {
+    fwidth_ = iwidth_ = 0;
+    for (auto& s : slots_) {
+      (s.is_float ? fwidth_ : iwidth_) += s.width;
+    }
+  }
+
+  ~DataFeed() { Stop(); }
+
+  void SetFiles(std::vector<std::string> files) { files_ = std::move(files); }
+
+  void Start() {
+    done_readers_ = 0;
+    file_cursor_ = 0;
+    for (int i = 0; i < num_threads_; ++i) {
+      readers_.emplace_back([this] { ReadLoop(); });
+    }
+  }
+
+  void Stop() {
+    channel_.Close();
+    for (auto& t : readers_) {
+      if (t.joinable()) t.join();
+    }
+    readers_.clear();
+  }
+
+  // Fill caller buffers with one batch; returns rows filled (0 = exhausted).
+  int NextBatch(float* fbuf, int64_t* ibuf) {
+    int n = 0;
+    Instance inst;
+    while (n < batch_size_ && channel_.Get(&inst)) {
+      if (fbuf && fwidth_)
+        std::memcpy(fbuf + (size_t)n * fwidth_, inst.fvals.data(),
+                    sizeof(float) * fwidth_);
+      if (ibuf && iwidth_)
+        std::memcpy(ibuf + (size_t)n * iwidth_, inst.ivals.data(),
+                    sizeof(int64_t) * iwidth_);
+      ++n;
+    }
+    return n;
+  }
+
+  // In-memory global shuffle (reference: data_set.cc shuffle semantics,
+  // single-host scope here; cross-host shuffle rides the PS/launcher tier).
+  void LoadIntoMemoryAndShuffle(uint64_t seed) {
+    std::vector<Instance> all;
+    Instance inst;
+    for (auto& f : files_) {
+      std::ifstream in(f);
+      std::string line;
+      while (std::getline(in, line)) {
+        if (Parse(line, &inst)) all.push_back(std::move(inst));
+      }
+    }
+    std::mt19937_64 rng(seed);
+    std::shuffle(all.begin(), all.end(), rng);
+    memory_ = std::move(all);
+    mem_cursor_ = 0;
+  }
+
+  int NextBatchFromMemory(float* fbuf, int64_t* ibuf) {
+    int n = 0;
+    while (n < batch_size_ && mem_cursor_ < memory_.size()) {
+      const Instance& inst = memory_[mem_cursor_++];
+      if (fbuf && fwidth_)
+        std::memcpy(fbuf + (size_t)n * fwidth_, inst.fvals.data(),
+                    sizeof(float) * fwidth_);
+      if (ibuf && iwidth_)
+        std::memcpy(ibuf + (size_t)n * iwidth_, inst.ivals.data(),
+                    sizeof(int64_t) * iwidth_);
+      ++n;
+    }
+    return n;
+  }
+
+  void RewindMemory(bool reshuffle, uint64_t seed) {
+    if (reshuffle) {
+      std::mt19937_64 rng(seed);
+      std::shuffle(memory_.begin(), memory_.end(), rng);
+    }
+    mem_cursor_ = 0;
+  }
+
+  size_t MemorySize() const { return memory_.size(); }
+  int FloatWidth() const { return fwidth_; }
+  int IntWidth() const { return iwidth_; }
+
+ private:
+  void ReadLoop() {
+    while (true) {
+      size_t idx = file_cursor_.fetch_add(1);
+      if (idx >= files_.size()) break;
+      std::ifstream in(files_[idx]);
+      std::string line;
+      Instance inst;
+      while (std::getline(in, line)) {
+        if (Parse(line, &inst)) {
+          if (!channel_.Put(std::move(inst))) return;
+          inst = Instance();
+        }
+      }
+    }
+    if (++done_readers_ == num_threads_) channel_.Close();
+  }
+
+  bool Parse(const std::string& line, Instance* out) {
+    out->fvals.clear();
+    out->ivals.clear();
+    out->fvals.reserve(fwidth_);
+    out->ivals.reserve(iwidth_);
+    const char* p = line.c_str();
+    for (auto& slot : slots_) {
+      for (int i = 0; i < slot.width; ++i) {
+        while (*p == ' ' || *p == '|') ++p;
+        if (*p == '\0') return false;
+        char* end = nullptr;
+        if (slot.is_float) {
+          out->fvals.push_back(std::strtof(p, &end));
+        } else {
+          out->ivals.push_back(std::strtoll(p, &end, 10));
+        }
+        if (end == p) return false;
+        p = end;
+      }
+    }
+    return out->fvals.size() == (size_t)fwidth_ &&
+           out->ivals.size() == (size_t)iwidth_;
+  }
+
+  std::vector<SlotDesc> slots_;
+  int batch_size_;
+  int num_threads_;
+  int fwidth_, iwidth_;
+  Channel<Instance> channel_;
+  std::vector<std::string> files_;
+  std::atomic<size_t> file_cursor_{0};
+  std::atomic<int> done_readers_{0};
+  std::vector<std::thread> readers_;
+  std::vector<Instance> memory_;
+  size_t mem_cursor_ = 0;
+};
+
+}  // namespace ptpu
+
+// ---- C API (ctypes) --------------------------------------------------------
+extern "C" {
+
+void* ptpu_datafeed_create(const int* widths, const int* is_float,
+                           int num_slots, int batch_size, int num_threads,
+                           int channel_capacity) {
+  std::vector<ptpu::SlotDesc> slots;
+  for (int i = 0; i < num_slots; ++i)
+    slots.push_back({widths[i], is_float[i] != 0});
+  return new ptpu::DataFeed(std::move(slots), batch_size, num_threads,
+                            channel_capacity);
+}
+
+void ptpu_datafeed_set_files(void* h, const char** files, int n) {
+  std::vector<std::string> fs(files, files + n);
+  static_cast<ptpu::DataFeed*>(h)->SetFiles(std::move(fs));
+}
+
+void ptpu_datafeed_start(void* h) { static_cast<ptpu::DataFeed*>(h)->Start(); }
+
+int ptpu_datafeed_next(void* h, float* fbuf, int64_t* ibuf) {
+  return static_cast<ptpu::DataFeed*>(h)->NextBatch(fbuf, ibuf);
+}
+
+void ptpu_datafeed_load_shuffle(void* h, uint64_t seed) {
+  static_cast<ptpu::DataFeed*>(h)->LoadIntoMemoryAndShuffle(seed);
+}
+
+int ptpu_datafeed_next_mem(void* h, float* fbuf, int64_t* ibuf) {
+  return static_cast<ptpu::DataFeed*>(h)->NextBatchFromMemory(fbuf, ibuf);
+}
+
+void ptpu_datafeed_rewind(void* h, int reshuffle, uint64_t seed) {
+  static_cast<ptpu::DataFeed*>(h)->RewindMemory(reshuffle != 0, seed);
+}
+
+int64_t ptpu_datafeed_memory_size(void* h) {
+  return (int64_t)static_cast<ptpu::DataFeed*>(h)->MemorySize();
+}
+
+void ptpu_datafeed_destroy(void* h) {
+  delete static_cast<ptpu::DataFeed*>(h);
+}
+}

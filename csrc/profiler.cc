@@ -1,0 +1,149 @@
+// Host profiler: RAII-style event records + chrome-trace export.
+//
+// Reference parity: platform/profiler.cc RecordEvent + device_tracer.cc's
+// chrome-trace output (N4). Device-side timing comes from XLA/PJRT's own
+// profiler (jax.profiler — xplane); this records the HOST side (op dispatch,
+// data feed, checkpoint IO) with thread ids, matching the reference's
+// host-event tables. Export is the chrome://tracing JSON the reference's
+// tooling consumes.
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace ptpu {
+
+struct Event {
+  std::string name;
+  uint64_t start_us;
+  uint64_t end_us;
+  uint64_t tid;
+};
+
+class Profiler {
+ public:
+  static Profiler& Get() {
+    static Profiler p;
+    return p;
+  }
+
+  void Enable(bool on) { enabled_ = on; }
+  bool Enabled() const { return enabled_; }
+
+  uint64_t NowUs() {
+    return std::chrono::duration_cast<std::chrono::microseconds>(
+               std::chrono::steady_clock::now().time_since_epoch())
+        .count();
+  }
+
+  void Record(const char* name, uint64_t start_us, uint64_t end_us) {
+    if (!enabled_) return;
+    std::lock_guard<std::mutex> lk(mu_);
+    events_.push_back({name, start_us, end_us,
+                       std::hash<std::thread::id>()(
+                           std::this_thread::get_id()) %
+                           100000});
+  }
+
+  void Clear() {
+    std::lock_guard<std::mutex> lk(mu_);
+    events_.clear();
+  }
+
+  size_t Count() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return events_.size();
+  }
+
+  // Aggregated table: name -> (calls, total_us, min_us, max_us).
+  std::string Summary() {
+    std::lock_guard<std::mutex> lk(mu_);
+    struct Agg {
+      uint64_t calls = 0, total = 0, mn = UINT64_MAX, mx = 0;
+    };
+    std::map<std::string, Agg> agg;
+    for (auto& e : events_) {
+      auto& a = agg[e.name];
+      uint64_t d = e.end_us - e.start_us;
+      a.calls++;
+      a.total += d;
+      if (d < a.mn) a.mn = d;
+      if (d > a.mx) a.mx = d;
+    }
+    std::string out =
+        "name\tcalls\ttotal_ms\tavg_us\tmin_us\tmax_us\n";
+    char buf[512];
+    for (auto& kv : agg) {
+      snprintf(buf, sizeof(buf), "%s\t%llu\t%.3f\t%.1f\t%llu\t%llu\n",
+               kv.first.c_str(), (unsigned long long)kv.second.calls,
+               kv.second.total / 1000.0,
+               (double)kv.second.total / kv.second.calls,
+               (unsigned long long)kv.second.mn,
+               (unsigned long long)kv.second.mx);
+      out += buf;
+    }
+    return out;
+  }
+
+  bool ExportChromeTrace(const std::string& path) {
+    std::lock_guard<std::mutex> lk(mu_);
+    std::ofstream out(path);
+    if (!out) return false;
+    out << "{\"traceEvents\":[";
+    bool first = true;
+    for (auto& e : events_) {
+      if (!first) out << ",";
+      first = false;
+      out << "{\"name\":\"" << e.name << "\",\"ph\":\"X\",\"pid\":0,"
+          << "\"tid\":" << e.tid << ",\"ts\":" << e.start_us
+          << ",\"dur\":" << (e.end_us - e.start_us) << "}";
+    }
+    out << "]}";
+    return out.good();
+  }
+
+ private:
+  std::atomic<bool> enabled_{false};
+  std::mutex mu_;
+  std::vector<Event> events_;
+};
+
+}  // namespace ptpu
+
+extern "C" {
+
+void ptpu_profiler_enable(int on) { ptpu::Profiler::Get().Enable(on != 0); }
+
+uint64_t ptpu_profiler_now() { return ptpu::Profiler::Get().NowUs(); }
+
+void ptpu_profiler_record(const char* name, uint64_t start_us,
+                          uint64_t end_us) {
+  ptpu::Profiler::Get().Record(name, start_us, end_us);
+}
+
+void ptpu_profiler_clear() { ptpu::Profiler::Get().Clear(); }
+
+int64_t ptpu_profiler_count() {
+  return (int64_t)ptpu::Profiler::Get().Count();
+}
+
+// Writes summary into buf (truncated at cap); returns needed length.
+int ptpu_profiler_summary(char* buf, int cap) {
+  std::string s = ptpu::Profiler::Get().Summary();
+  int n = (int)s.size() < cap - 1 ? (int)s.size() : cap - 1;
+  std::memcpy(buf, s.data(), n);
+  buf[n] = '\0';
+  return (int)s.size();
+}
+
+int ptpu_profiler_export(const char* path) {
+  return ptpu::Profiler::Get().ExportChromeTrace(path) ? 1 : 0;
+}
+}

@@ -1,0 +1,227 @@
+// Sharded concurrent sparse embedding table with fused optimizer update.
+//
+// Reference parity: paddle/fluid/distributed tables — CommonSparseTable
+// (service/…, N30) and the heterPS GPU hashtable (framework/fleet/heter_ps/
+// hashtable.h, optimizer.cuh.h, N31): feature-id -> embedding row with the
+// optimizer state stored inline, pull (lookup w/ on-miss init) and push
+// (gradient update) APIs. TPU-native shape: this table lives on HOST CPU
+// memory (trillion-parameter scale — BASELINE config 5); the TPU holds only
+// the dense towers. Pull gathers rows into a contiguous buffer for one H2D
+// transfer; push applies adagrad/sgd on the host shards in parallel.
+//
+// Layout per row: [embedding dim floats][adagrad G2 accumulator (dim)] —
+// SGD mode stores only the embedding.
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <fstream>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace ptpu {
+
+class SparseTable {
+ public:
+  enum Opt { SGD = 0, ADAGRAD = 1 };
+
+  SparseTable(int dim, int num_shards, int opt, float init_range,
+              uint64_t seed)
+      : dim_(dim),
+        num_shards_(num_shards),
+        opt_((Opt)opt),
+        init_range_(init_range),
+        seed_(seed),
+        shards_(num_shards),
+        locks_(num_shards) {}
+
+  int RowWidth() const { return opt_ == ADAGRAD ? dim_ * 2 : dim_; }
+
+  // Gather rows for `n` ids into out[n, dim]; missing ids are initialized
+  // (uniform[-init_range, init_range]) — reference accessor "create on
+  // miss" semantics.
+  void Pull(const int64_t* ids, int n, float* out) {
+    ParallelOver(n, [&](int i) {
+      int64_t id = ids[i];
+      size_t s = Shard(id);
+      std::lock_guard<std::mutex> lk(locks_[s]);
+      auto& row = GetOrInit(s, id);
+      std::memcpy(out + (size_t)i * dim_, row.data(), sizeof(float) * dim_);
+    });
+  }
+
+  // Apply gradients: grads[n, dim] for ids[n]; duplicate ids accumulate
+  // sequentially per shard (deterministic within a shard).
+  void Push(const int64_t* ids, int n, const float* grads, float lr) {
+    ParallelOver(n, [&](int i) {
+      int64_t id = ids[i];
+      size_t s = Shard(id);
+      std::lock_guard<std::mutex> lk(locks_[s]);
+      auto& row = GetOrInit(s, id);
+      const float* g = grads + (size_t)i * dim_;
+      if (opt_ == ADAGRAD) {
+        float* w = row.data();
+        float* g2 = row.data() + dim_;
+        for (int d = 0; d < dim_; ++d) {
+          g2[d] += g[d] * g[d];
+          w[d] -= lr * g[d] / (std::sqrt(g2[d]) + 1e-6f);
+        }
+      } else {
+        float* w = row.data();
+        for (int d = 0; d < dim_; ++d) w[d] -= lr * g[d];
+      }
+    });
+  }
+
+  int64_t Size() const {
+    int64_t total = 0;
+    for (auto& s : shards_) total += (int64_t)s.size();
+    return total;
+  }
+
+  // Shrink: drop rows whose L2 norm is below threshold (reference:
+  // SSDSparseTable/CommonSparseTable shrink for stale features).
+  int64_t Shrink(float threshold) {
+    int64_t dropped = 0;
+    for (size_t s = 0; s < shards_.size(); ++s) {
+      std::lock_guard<std::mutex> lk(locks_[s]);
+      for (auto it = shards_[s].begin(); it != shards_[s].end();) {
+        float norm = 0;
+        for (int d = 0; d < dim_; ++d)
+          norm += it->second[d] * it->second[d];
+        if (std::sqrt(norm) < threshold) {
+          it = shards_[s].erase(it);
+          ++dropped;
+        } else {
+          ++it;
+        }
+      }
+    }
+    return dropped;
+  }
+
+  bool Save(const std::string& path) {
+    std::ofstream out(path, std::ios::binary);
+    if (!out) return false;
+    int64_t n = Size();
+    int rw = RowWidth();
+    out.write((char*)&dim_, sizeof(dim_));
+    out.write((char*)&rw, sizeof(rw));
+    out.write((char*)&n, sizeof(n));
+    for (size_t s = 0; s < shards_.size(); ++s) {
+      std::lock_guard<std::mutex> lk(locks_[s]);
+      for (auto& kv : shards_[s]) {
+        out.write((char*)&kv.first, sizeof(int64_t));
+        out.write((char*)kv.second.data(), sizeof(float) * rw);
+      }
+    }
+    return out.good();
+  }
+
+  bool Load(const std::string& path) {
+    std::ifstream in(path, std::ios::binary);
+    if (!in) return false;
+    int dim, rw;
+    int64_t n;
+    in.read((char*)&dim, sizeof(dim));
+    in.read((char*)&rw, sizeof(rw));
+    in.read((char*)&n, sizeof(n));
+    if (dim != dim_ || rw != RowWidth()) return false;
+    for (int64_t i = 0; i < n; ++i) {
+      int64_t id;
+      in.read((char*)&id, sizeof(id));
+      std::vector<float> row(rw);
+      in.read((char*)row.data(), sizeof(float) * rw);
+      size_t s = Shard(id);
+      std::lock_guard<std::mutex> lk(locks_[s]);
+      shards_[s][id] = std::move(row);
+    }
+    return in.good();
+  }
+
+ private:
+  size_t Shard(int64_t id) const {
+    return ((uint64_t)id * 0x9E3779B97F4A7C15ull >> 32) % num_shards_;
+  }
+
+  std::vector<float>& GetOrInit(size_t s, int64_t id) {
+    auto it = shards_[s].find(id);
+    if (it != shards_[s].end()) return it->second;
+    std::vector<float> row(RowWidth(), 0.f);
+    std::mt19937_64 rng(seed_ ^ (uint64_t)id);
+    std::uniform_real_distribution<float> dist(-init_range_, init_range_);
+    for (int d = 0; d < dim_; ++d) row[d] = dist(rng);
+    return shards_[s].emplace(id, std::move(row)).first->second;
+  }
+
+  template <typename F>
+  void ParallelOver(int n, F f) {
+    int nthreads = (int)std::min<size_t>(
+        std::max(1u, std::thread::hardware_concurrency()), 8);
+    if (n < 1024 || nthreads <= 1) {
+      for (int i = 0; i < n; ++i) f(i);
+      return;
+    }
+    std::vector<std::thread> ts;
+    int chunk = (n + nthreads - 1) / nthreads;
+    for (int t = 0; t < nthreads; ++t) {
+      int lo = t * chunk, hi = std::min(n, lo + chunk);
+      if (lo >= hi) break;
+      ts.emplace_back([&, lo, hi] {
+        for (int i = lo; i < hi; ++i) f(i);
+      });
+    }
+    for (auto& t : ts) t.join();
+  }
+
+  int dim_;
+  int num_shards_;
+  Opt opt_;
+  float init_range_;
+  uint64_t seed_;
+  std::vector<std::unordered_map<int64_t, std::vector<float>>> shards_;
+  std::vector<std::mutex> locks_;
+};
+
+}  // namespace ptpu
+
+extern "C" {
+
+void* ptpu_table_create(int dim, int num_shards, int opt, float init_range,
+                        uint64_t seed) {
+  return new ptpu::SparseTable(dim, num_shards, opt, init_range, seed);
+}
+
+void ptpu_table_pull(void* h, const int64_t* ids, int n, float* out) {
+  static_cast<ptpu::SparseTable*>(h)->Pull(ids, n, out);
+}
+
+void ptpu_table_push(void* h, const int64_t* ids, int n, const float* grads,
+                     float lr) {
+  static_cast<ptpu::SparseTable*>(h)->Push(ids, n, grads, lr);
+}
+
+int64_t ptpu_table_size(void* h) {
+  return static_cast<ptpu::SparseTable*>(h)->Size();
+}
+
+int64_t ptpu_table_shrink(void* h, float threshold) {
+  return static_cast<ptpu::SparseTable*>(h)->Shrink(threshold);
+}
+
+int ptpu_table_save(void* h, const char* path) {
+  return static_cast<ptpu::SparseTable*>(h)->Save(path) ? 1 : 0;
+}
+
+int ptpu_table_load(void* h, const char* path) {
+  return static_cast<ptpu::SparseTable*>(h)->Load(path) ? 1 : 0;
+}
+
+void ptpu_table_destroy(void* h) {
+  delete static_cast<ptpu::SparseTable*>(h);
+}
+}

@@ -1,0 +1,340 @@
+// TCP key-value store: rendezvous + barrier for multi-host launch.
+//
+// Reference parity: platform/gen_comm_id_helper.{h,cc} (SocketServer, TCP
+// broadcast of ncclUniqueId — N8) + the Gloo HTTP/FS KV rendezvous
+// (role_maker.py Gloo:35, gloo_wrapper HdfsStore — N9). One store serves a
+// job: rank 0 hosts it; all ranks set/get/wait keys and barrier on it. On
+// TPU the payloads are the jax.distributed coordinator address and the
+// cluster membership instead of NCCL ids; the protocol is payload-agnostic.
+//
+// Wire protocol (all little-endian):
+//   u8 op ('S' set, 'G' get, 'W' wait, 'A' add, 'B' barrier-enter)
+//   u32 key_len, key bytes
+//   op S:  u32 val_len, val bytes             -> u8 ok
+//   op G:  -> u32 val_len (0xFFFFFFFF = miss), val bytes
+//   op W:  blocks until key exists            -> same as G
+//   op A:  i64 delta                          -> i64 new value
+//   op B:  u32 world                          -> u8 released
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace ptpu {
+
+static bool ReadN(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= r;
+  }
+  return true;
+}
+
+static bool WriteN(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= r;
+  }
+  return true;
+}
+
+class TcpStoreServer {
+ public:
+  explicit TcpStoreServer(int port) : port_(port) {}
+
+  bool Start() {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return false;
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = INADDR_ANY;
+    addr.sin_port = htons(port_);
+    if (::bind(listen_fd_, (sockaddr*)&addr, sizeof(addr)) != 0) return false;
+    if (port_ == 0) {
+      socklen_t len = sizeof(addr);
+      ::getsockname(listen_fd_, (sockaddr*)&addr, &len);
+      port_ = ntohs(addr.sin_port);
+    }
+    if (::listen(listen_fd_, 128) != 0) return false;
+    running_ = true;
+    accept_thread_ = std::thread([this] { AcceptLoop(); });
+    return true;
+  }
+
+  void Stop() {
+    running_ = false;
+    if (listen_fd_ >= 0) {
+      ::shutdown(listen_fd_, SHUT_RDWR);
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+    }
+    cv_.notify_all();
+    if (accept_thread_.joinable()) accept_thread_.join();
+    for (auto& t : workers_)
+      if (t.joinable()) t.join();
+    workers_.clear();
+  }
+
+  int port() const { return port_; }
+
+  ~TcpStoreServer() { Stop(); }
+
+ private:
+  void AcceptLoop() {
+    while (running_) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) break;
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      workers_.emplace_back([this, fd] { Serve(fd); });
+    }
+  }
+
+  void Serve(int fd) {
+    while (running_) {
+      uint8_t op;
+      if (!ReadN(fd, &op, 1)) break;
+      uint32_t klen;
+      if (!ReadN(fd, &klen, 4)) break;
+      std::string key(klen, '\0');
+      if (klen && !ReadN(fd, key.data(), klen)) break;
+      if (op == 'S') {
+        uint32_t vlen;
+        if (!ReadN(fd, &vlen, 4)) break;
+        std::string val(vlen, '\0');
+        if (vlen && !ReadN(fd, val.data(), vlen)) break;
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          kv_[key] = std::move(val);
+        }
+        cv_.notify_all();
+        uint8_t ok = 1;
+        if (!WriteN(fd, &ok, 1)) break;
+      } else if (op == 'G' || op == 'W') {
+        std::unique_lock<std::mutex> lk(mu_);
+        if (op == 'W') {
+          cv_.wait(lk, [&] { return !running_ || kv_.count(key); });
+        }
+        auto it = kv_.find(key);
+        if (it == kv_.end()) {
+          uint32_t miss = 0xFFFFFFFFu;
+          lk.unlock();
+          if (!WriteN(fd, &miss, 4)) break;
+        } else {
+          std::string val = it->second;
+          lk.unlock();
+          uint32_t vlen = (uint32_t)val.size();
+          if (!WriteN(fd, &vlen, 4)) break;
+          if (vlen && !WriteN(fd, val.data(), vlen)) break;
+        }
+      } else if (op == 'A') {
+        int64_t delta;
+        if (!ReadN(fd, &delta, 8)) break;
+        int64_t now;
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          int64_t cur = 0;
+          auto it = kv_.find(key);
+          if (it != kv_.end() && it->second.size() == 8)
+            std::memcpy(&cur, it->second.data(), 8);
+          now = cur + delta;
+          std::string v(8, '\0');
+          std::memcpy(v.data(), &now, 8);
+          kv_[key] = std::move(v);
+        }
+        cv_.notify_all();
+        if (!WriteN(fd, &now, 8)) break;
+      } else if (op == 'B') {
+        uint32_t world;
+        if (!ReadN(fd, &world, 4)) break;
+        uint64_t gen;
+        {
+          std::unique_lock<std::mutex> lk(mu_);
+          auto& b = barriers_[key];
+          gen = b.generation;
+          if (++b.arrived == world) {
+            b.arrived = 0;
+            b.generation++;
+            cv_.notify_all();
+          } else {
+            cv_.wait(lk, [&] {
+              return !running_ || barriers_[key].generation != gen;
+            });
+          }
+        }
+        uint8_t ok = 1;
+        if (!WriteN(fd, &ok, 1)) break;
+      } else {
+        break;
+      }
+    }
+    ::close(fd);
+  }
+
+  struct Barrier {
+    uint32_t arrived = 0;
+    uint64_t generation = 0;
+  };
+
+  int port_;
+  int listen_fd_ = -1;
+  std::atomic<bool> running_{false};
+  std::thread accept_thread_;
+  std::vector<std::thread> workers_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::map<std::string, std::string> kv_;
+  std::map<std::string, Barrier> barriers_;
+};
+
+class TcpStoreClient {
+ public:
+  bool Connect(const std::string& host, int port, int timeout_sec) {
+    for (int i = 0; i < timeout_sec * 10; ++i) {
+      fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+      sockaddr_in addr{};
+      addr.sin_family = AF_INET;
+      addr.sin_port = htons(port);
+      ::inet_pton(AF_INET, host.c_str(), &addr.sin_addr);
+      if (::connect(fd_, (sockaddr*)&addr, sizeof(addr)) == 0) {
+        int one = 1;
+        ::setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        return true;
+      }
+      ::close(fd_);
+      ::usleep(100 * 1000);
+    }
+    return false;
+  }
+
+  bool Set(const std::string& key, const std::string& val) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!SendHeader('S', key)) return false;
+    uint32_t vlen = (uint32_t)val.size();
+    if (!WriteN(fd_, &vlen, 4)) return false;
+    if (vlen && !WriteN(fd_, val.data(), vlen)) return false;
+    uint8_t ok;
+    return ReadN(fd_, &ok, 1) && ok == 1;
+  }
+
+  bool Get(const std::string& key, std::string* out, bool wait) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!SendHeader(wait ? 'W' : 'G', key)) return false;
+    uint32_t vlen;
+    if (!ReadN(fd_, &vlen, 4)) return false;
+    if (vlen == 0xFFFFFFFFu) return false;
+    out->resize(vlen);
+    return vlen == 0 || ReadN(fd_, out->data(), vlen);
+  }
+
+  bool Add(const std::string& key, int64_t delta, int64_t* out) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!SendHeader('A', key)) return false;
+    if (!WriteN(fd_, &delta, 8)) return false;
+    return ReadN(fd_, out, 8);
+  }
+
+  bool Barrier(const std::string& key, uint32_t world) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!SendHeader('B', key)) return false;
+    if (!WriteN(fd_, &world, 4)) return false;
+    uint8_t ok;
+    return ReadN(fd_, &ok, 1) && ok == 1;
+  }
+
+  ~TcpStoreClient() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+ private:
+  bool SendHeader(uint8_t op, const std::string& key) {
+    if (!WriteN(fd_, &op, 1)) return false;
+    uint32_t klen = (uint32_t)key.size();
+    if (!WriteN(fd_, &klen, 4)) return false;
+    return klen == 0 || WriteN(fd_, key.data(), klen);
+  }
+
+  int fd_ = -1;
+  std::mutex mu_;
+};
+
+}  // namespace ptpu
+
+extern "C" {
+
+void* ptpu_store_server_start(int port) {
+  auto* s = new ptpu::TcpStoreServer(port);
+  if (!s->Start()) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+int ptpu_store_server_port(void* h) {
+  return static_cast<ptpu::TcpStoreServer*>(h)->port();
+}
+
+void ptpu_store_server_stop(void* h) {
+  delete static_cast<ptpu::TcpStoreServer*>(h);
+}
+
+void* ptpu_store_client_connect(const char* host, int port, int timeout_sec) {
+  auto* c = new ptpu::TcpStoreClient();
+  if (!c->Connect(host, port, timeout_sec)) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+int ptpu_store_set(void* h, const char* key, const char* val, int vlen) {
+  return static_cast<ptpu::TcpStoreClient*>(h)->Set(
+             key, std::string(val, vlen))
+             ? 1
+             : 0;
+}
+
+// Returns length, -1 on miss. Caller buffer must be >= cap.
+int ptpu_store_get(void* h, const char* key, char* buf, int cap, int wait) {
+  std::string out;
+  if (!static_cast<ptpu::TcpStoreClient*>(h)->Get(key, &out, wait != 0))
+    return -1;
+  int n = (int)out.size() < cap ? (int)out.size() : cap;
+  std::memcpy(buf, out.data(), n);
+  return (int)out.size();
+}
+
+int64_t ptpu_store_add(void* h, const char* key, int64_t delta) {
+  int64_t out = -1;
+  static_cast<ptpu::TcpStoreClient*>(h)->Add(key, delta, &out);
+  return out;
+}
+
+int ptpu_store_barrier(void* h, const char* key, uint32_t world) {
+  return static_cast<ptpu::TcpStoreClient*>(h)->Barrier(key, world) ? 1 : 0;
+}
+
+void ptpu_store_client_close(void* h) {
+  delete static_cast<ptpu::TcpStoreClient*>(h);
+}
+}

@@ -1,0 +1,301 @@
+"""ctypes bindings to the C++ native runtime (csrc/).
+
+Reference parity: the pybind layer (paddle/fluid/pybind — N33) for the
+runtime-services subset that stays native in the TPU rebuild: data feed
+(N19), TCP store rendezvous (N8/N9), sparse PS table (N30), host profiler
+(N4). Builds csrc/ on demand with make (g++ only — no pybind11 dependency;
+plain C ABI + ctypes).
+"""
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_LIB = None
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), 'csrc')
+_SO = os.path.join(_CSRC, 'libpaddle_tpu_native.so')
+
+
+def load_native(required=False):
+    """Load (building if needed) the native library. Returns None when
+    unavailable and not required."""
+    global _LIB
+    if _LIB is not None:
+        return _LIB
+    if not os.path.exists(_SO):
+        try:
+            subprocess.run(['make', '-C', _CSRC], check=True,
+                           capture_output=True)
+        except Exception as e:
+            if required:
+                raise RuntimeError(f"native build failed: {e}")
+            return None
+    try:
+        lib = ctypes.CDLL(_SO)
+    except OSError as e:
+        if required:
+            raise
+        return None
+
+    # datafeed
+    lib.ptpu_datafeed_create.restype = ctypes.c_void_p
+    lib.ptpu_datafeed_create.argtypes = [
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    lib.ptpu_datafeed_set_files.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p), ctypes.c_int]
+    lib.ptpu_datafeed_start.argtypes = [ctypes.c_void_p]
+    lib.ptpu_datafeed_next.restype = ctypes.c_int
+    lib.ptpu_datafeed_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                       ctypes.c_void_p]
+    lib.ptpu_datafeed_load_shuffle.argtypes = [ctypes.c_void_p,
+                                               ctypes.c_uint64]
+    lib.ptpu_datafeed_next_mem.restype = ctypes.c_int
+    lib.ptpu_datafeed_next_mem.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                           ctypes.c_void_p]
+    lib.ptpu_datafeed_rewind.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                         ctypes.c_uint64]
+    lib.ptpu_datafeed_memory_size.restype = ctypes.c_int64
+    lib.ptpu_datafeed_memory_size.argtypes = [ctypes.c_void_p]
+    lib.ptpu_datafeed_destroy.argtypes = [ctypes.c_void_p]
+
+    # tcp store
+    lib.ptpu_store_server_start.restype = ctypes.c_void_p
+    lib.ptpu_store_server_start.argtypes = [ctypes.c_int]
+    lib.ptpu_store_server_port.restype = ctypes.c_int
+    lib.ptpu_store_server_port.argtypes = [ctypes.c_void_p]
+    lib.ptpu_store_server_stop.argtypes = [ctypes.c_void_p]
+    lib.ptpu_store_client_connect.restype = ctypes.c_void_p
+    lib.ptpu_store_client_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                              ctypes.c_int]
+    lib.ptpu_store_set.restype = ctypes.c_int
+    lib.ptpu_store_set.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_char_p, ctypes.c_int]
+    lib.ptpu_store_get.restype = ctypes.c_int
+    lib.ptpu_store_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_char_p, ctypes.c_int,
+                                   ctypes.c_int]
+    lib.ptpu_store_add.restype = ctypes.c_int64
+    lib.ptpu_store_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_int64]
+    lib.ptpu_store_barrier.restype = ctypes.c_int
+    lib.ptpu_store_barrier.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       ctypes.c_uint32]
+    lib.ptpu_store_client_close.argtypes = [ctypes.c_void_p]
+
+    # sparse table
+    lib.ptpu_table_create.restype = ctypes.c_void_p
+    lib.ptpu_table_create.argtypes = [ctypes.c_int, ctypes.c_int,
+                                      ctypes.c_int, ctypes.c_float,
+                                      ctypes.c_uint64]
+    lib.ptpu_table_pull.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                    ctypes.c_int, ctypes.c_void_p]
+    lib.ptpu_table_push.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                    ctypes.c_int, ctypes.c_void_p,
+                                    ctypes.c_float]
+    lib.ptpu_table_size.restype = ctypes.c_int64
+    lib.ptpu_table_size.argtypes = [ctypes.c_void_p]
+    lib.ptpu_table_shrink.restype = ctypes.c_int64
+    lib.ptpu_table_shrink.argtypes = [ctypes.c_void_p, ctypes.c_float]
+    lib.ptpu_table_save.restype = ctypes.c_int
+    lib.ptpu_table_save.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.ptpu_table_load.restype = ctypes.c_int
+    lib.ptpu_table_load.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.ptpu_table_destroy.argtypes = [ctypes.c_void_p]
+
+    # profiler
+    lib.ptpu_profiler_enable.argtypes = [ctypes.c_int]
+    lib.ptpu_profiler_now.restype = ctypes.c_uint64
+    lib.ptpu_profiler_record.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                         ctypes.c_uint64]
+    lib.ptpu_profiler_count.restype = ctypes.c_int64
+    lib.ptpu_profiler_summary.restype = ctypes.c_int
+    lib.ptpu_profiler_summary.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.ptpu_profiler_export.restype = ctypes.c_int
+    lib.ptpu_profiler_export.argtypes = [ctypes.c_char_p]
+
+    _LIB = lib
+    return lib
+
+
+class NativeDataFeed:
+    """Parity: framework/data_feed.cc MultiSlotDataFeed through C++."""
+
+    def __init__(self, slots, batch_size, num_threads=2,
+                 channel_capacity=4096):
+        """slots: list of (width, kind) with kind in {'float','int64'}."""
+        self.lib = load_native(required=True)
+        widths = (ctypes.c_int * len(slots))(*[w for w, _ in slots])
+        isf = (ctypes.c_int * len(slots))(
+            *[1 if k == 'float' else 0 for _, k in slots])
+        self.h = self.lib.ptpu_datafeed_create(
+            widths, isf, len(slots), batch_size, num_threads,
+            channel_capacity)
+        self.batch_size = batch_size
+        self.fwidth = sum(w for w, k in slots if k == 'float')
+        self.iwidth = sum(w for w, k in slots if k == 'int64')
+
+    def set_filelist(self, files):
+        arr = (ctypes.c_char_p * len(files))(
+            *[f.encode() for f in files])
+        self.lib.ptpu_datafeed_set_files(self.h, arr, len(files))
+
+    def start(self):
+        self.lib.ptpu_datafeed_start(self.h)
+
+    def _buffers(self):
+        f = np.empty((self.batch_size, self.fwidth), np.float32) \
+            if self.fwidth else None
+        i = np.empty((self.batch_size, self.iwidth), np.int64) \
+            if self.iwidth else None
+        return f, i
+
+    def __iter__(self):
+        while True:
+            f, i = self._buffers()
+            n = self.lib.ptpu_datafeed_next(
+                self.h,
+                f.ctypes.data_as(ctypes.c_void_p) if f is not None else None,
+                i.ctypes.data_as(ctypes.c_void_p) if i is not None else None)
+            if n == 0:
+                return
+            yield (f[:n] if f is not None else None,
+                   i[:n] if i is not None else None)
+
+    def load_into_memory(self, seed=0):
+        self.lib.ptpu_datafeed_load_shuffle(self.h, seed)
+
+    def memory_size(self):
+        return self.lib.ptpu_datafeed_memory_size(self.h)
+
+    def iter_memory(self):
+        while True:
+            f, i = self._buffers()
+            n = self.lib.ptpu_datafeed_next_mem(
+                self.h,
+                f.ctypes.data_as(ctypes.c_void_p) if f is not None else None,
+                i.ctypes.data_as(ctypes.c_void_p) if i is not None else None)
+            if n == 0:
+                return
+            yield (f[:n] if f is not None else None,
+                   i[:n] if i is not None else None)
+
+    def rewind(self, reshuffle=False, seed=0):
+        self.lib.ptpu_datafeed_rewind(self.h, 1 if reshuffle else 0, seed)
+
+    def __del__(self):
+        if getattr(self, 'h', None) and self.lib:
+            self.lib.ptpu_datafeed_destroy(self.h)
+            self.h = None
+
+
+class TCPStore:
+    """Parity: gen_comm_id_helper SocketServer + Gloo KV (N8/N9)."""
+
+    def __init__(self, host='127.0.0.1', port=0, is_master=False,
+                 timeout=60):
+        self.lib = load_native(required=True)
+        self.server = None
+        if is_master:
+            self.server = self.lib.ptpu_store_server_start(port)
+            if not self.server:
+                raise RuntimeError(f"TCPStore: bind failed on port {port}")
+            port = self.lib.ptpu_store_server_port(self.server)
+        self.port = port
+        self.host = host
+        self.client = self.lib.ptpu_store_client_connect(
+            host.encode(), port, timeout)
+        if not self.client:
+            raise RuntimeError(f"TCPStore: connect to {host}:{port} failed")
+
+    def set(self, key, value):
+        if isinstance(value, str):
+            value = value.encode()
+        ok = self.lib.ptpu_store_set(self.client, key.encode(), value,
+                                     len(value))
+        if not ok:
+            raise RuntimeError("TCPStore.set failed")
+
+    def get(self, key, wait=True):
+        cap = 1 << 20
+        buf = ctypes.create_string_buffer(cap)
+        n = self.lib.ptpu_store_get(self.client, key.encode(), buf, cap,
+                                    1 if wait else 0)
+        if n < 0:
+            return None
+        return buf.raw[:n]
+
+    def add(self, key, delta=1):
+        return self.lib.ptpu_store_add(self.client, key.encode(), delta)
+
+    def barrier(self, key, world_size):
+        ok = self.lib.ptpu_store_barrier(self.client, key.encode(),
+                                         world_size)
+        if not ok:
+            raise RuntimeError("TCPStore.barrier failed")
+
+    def close(self):
+        if getattr(self, 'client', None):
+            self.lib.ptpu_store_client_close(self.client)
+            self.client = None
+        if getattr(self, 'server', None):
+            self.lib.ptpu_store_server_stop(self.server)
+            self.server = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeSparseTable:
+    """Parity: distributed/table CommonSparseTable + heterPS hashtable."""
+
+    SGD = 0
+    ADAGRAD = 1
+
+    def __init__(self, dim, num_shards=16, optimizer='adagrad',
+                 init_range=0.05, seed=0):
+        self.lib = load_native(required=True)
+        self.dim = dim
+        opt = self.ADAGRAD if optimizer == 'adagrad' else self.SGD
+        self.h = self.lib.ptpu_table_create(dim, num_shards, opt,
+                                            init_range, seed)
+
+    def pull(self, ids):
+        ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        out = np.empty((len(ids), self.dim), np.float32)
+        self.lib.ptpu_table_pull(
+            self.h, ids.ctypes.data_as(ctypes.c_void_p), len(ids),
+            out.ctypes.data_as(ctypes.c_void_p))
+        return out
+
+    def push(self, ids, grads, lr=0.01):
+        ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        grads = np.ascontiguousarray(grads, np.float32).reshape(
+            len(ids), self.dim)
+        self.lib.ptpu_table_push(
+            self.h, ids.ctypes.data_as(ctypes.c_void_p), len(ids),
+            grads.ctypes.data_as(ctypes.c_void_p), lr)
+
+    def __len__(self):
+        return self.lib.ptpu_table_size(self.h)
+
+    def shrink(self, threshold):
+        return self.lib.ptpu_table_shrink(self.h, threshold)
+
+    def save(self, path):
+        if not self.lib.ptpu_table_save(self.h, path.encode()):
+            raise IOError(f"table save failed: {path}")
+
+    def load(self, path):
+        if not self.lib.ptpu_table_load(self.h, path.encode()):
+            raise IOError(f"table load failed: {path}")
+
+    def __del__(self):
+        if getattr(self, 'h', None) and self.lib:
+            self.lib.ptpu_table_destroy(self.h)
+            self.h = None

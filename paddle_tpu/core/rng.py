@@ -19,9 +19,25 @@ import jax
 
 
 class _GeneratorState:
+    """Lazy: the jax key materializes on first draw, NOT at import —
+    importing paddle_tpu must not initialize the device backend (launcher /
+    utility processes share hosts with the trainer, and a tunneled TPU
+    admits one client)."""
+
     def __init__(self, seed=0):
-        self.key = jax.random.key(seed)
+        self._seed = seed
+        self._key = None
         self.counter = 0
+
+    @property
+    def key(self):
+        if self._key is None:
+            self._key = jax.random.key(self._seed)
+        return self._key
+
+    @key.setter
+    def key(self, k):
+        self._key = k
 
     def next_key(self):
         k = jax.random.fold_in(self.key, self.counter)

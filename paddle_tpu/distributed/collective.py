@@ -75,18 +75,28 @@ _default_group = None
 _group_map = {}
 
 # ---- SPMD region bookkeeping ------------------------------------------------
-_spmd_axes = []  # stack of tuples of active mesh axis names
+_spmd_axes = []       # stack of tuples of active mesh axis names
+_sp_data_sharded = []  # stack of bools: is the BATCH sharded over 'sp'?
 
 
 @contextlib.contextmanager
-def spmd_region(axis_names):
+def spmd_region(axis_names, sp_data_sharded=False):
     """Mark that we are tracing inside shard_map over `axis_names`. The fleet
-    engines enter this around their per-device step functions."""
+    engines enter this around their per-device step functions.
+    `sp_data_sharded` declares that batch tensors are sequence-sharded over
+    the 'sp' axis — models key sequence-parallel behavior off THIS, not off
+    mere axis presence (an sp axis may exist for other tensors)."""
     _spmd_axes.append(tuple(axis_names))
+    _sp_data_sharded.append(bool(sp_data_sharded))
     try:
         yield
     finally:
         _spmd_axes.pop()
+        _sp_data_sharded.pop()
+
+
+def sp_data_sharded():
+    return bool(_sp_data_sharded and _sp_data_sharded[-1])
 
 
 def in_spmd_region():

@@ -1,0 +1,117 @@
+"""Elastic training manager.
+
+Reference parity: fleet/elastic.py ElasticManager:99 — etcd-backed
+membership (host register :171, watch callbacks :192-218), scale-up/down
+detection, local-proc relaunch via LauncherInterface. TPU rebuild: the
+native TCPStore replaces etcd (no external dependency); membership is
+heartbeat keys with staleness-based death detection; the PJRT/jax.distributed
+world restarts on membership change (XLA worlds are fixed-size — a resize is
+a relaunch, same as the reference's re-exec path).
+"""
+import os
+import threading
+import time
+
+from ...core.native import TCPStore  # noqa: F401  (re-exported for users)
+
+
+class LauncherInterface:
+    """Parity: elastic.py LauncherInterface — local proc control."""
+
+    def __init__(self, procs=None):
+        self.procs = procs or []
+
+    def _terminate_procs(self):
+        import signal
+        for p in self.procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.time() + 10
+        for p in self.procs:
+            while p.poll() is None and time.time() < deadline:
+                time.sleep(0.2)
+            if p.poll() is None:
+                p.kill()
+
+    def launch(self):
+        raise NotImplementedError
+
+    def stop(self):
+        self._terminate_procs()
+
+
+class ElasticStatus:
+    COMPLETED = 'completed'
+    ERROR = 'error'
+    HOLD = 'hold'
+    RESTART = 'restart'
+    EXIT = 'exit'
+
+
+class ElasticManager:
+    """Parity: elastic.py ElasticManager:99."""
+
+    def __init__(self, args=None, store=None, job_id=None,
+                 np_min=1, np_max=None, heartbeat_interval=2.0,
+                 dead_after=10.0):
+        self.job_id = job_id or os.environ.get('PADDLE_ELASTIC_JOB_ID',
+                                               'default_job')
+        self.np_min = np_min
+        self.np_max = np_max
+        self.heartbeat_interval = heartbeat_interval
+        self.dead_after = dead_after
+        self.store = store
+        self.host = os.environ.get('PADDLE_CURRENT_ENDPOINT',
+                                   '127.0.0.1:6170')
+        self._stop = threading.Event()
+        self._hb_thread = None
+        self.enabled = store is not None
+
+    # -- membership (reference: _host_register / _match / _update_hosts) ----
+    def register(self):
+        if not self.enabled:
+            return
+        self.store.set(self._key(self.host), str(time.time()))
+        self._hb_thread = threading.Thread(target=self._heartbeat_loop,
+                                           daemon=True)
+        self._hb_thread.start()
+
+    def _key(self, host):
+        return f"elastic/{self.job_id}/{host}"
+
+    def _heartbeat_loop(self):
+        while not self._stop.is_set():
+            self.store.set(self._key(self.host), str(time.time()))
+            self._stop.wait(self.heartbeat_interval)
+
+    def hosts(self, known_hosts):
+        """Live hosts among `known_hosts` by heartbeat freshness."""
+        now = time.time()
+        alive = []
+        for h in known_hosts:
+            v = self.store.get(self._key(h), wait=False)
+            if v is None:
+                continue
+            try:
+                ts = float(v.decode())
+            except ValueError:
+                continue
+            if now - ts < self.dead_after:
+                alive.append(h)
+        return alive
+
+    def watch(self, known_hosts):
+        """One watch tick → ElasticStatus (reference: watch loop :192-218)."""
+        if not self.enabled:
+            return ElasticStatus.COMPLETED
+        alive = self.hosts(known_hosts)
+        if len(alive) == len(known_hosts):
+            return ElasticStatus.HOLD
+        if len(alive) < self.np_min:
+            return ElasticStatus.ERROR
+        return ElasticStatus.RESTART  # scale event → relaunch world
+
+    def exit(self, completed=True):
+        self._stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2)

@@ -50,11 +50,16 @@ class HybridParallelTrainStep:
     """Compile a full train step over the registered mesh.
 
     loss_fn(model, *batch) -> scalar loss Tensor. Batch tensors are sharded
-    on axis 0 over 'dp'.
+    on axis 0 over 'dp'; when the mesh has sp>1 (and the model declares
+    _supports_sequence_parallel), every batch tensor of rank >= 2 is ALSO
+    sharded on axis 1 over 'sp' — pass `sp_shard_args` (a set of positional
+    batch indices) to restrict sequence sharding to the token-aligned
+    tensors if the loss takes non-sequence rank-2 inputs.
     """
 
     def __init__(self, model, loss_fn, optimizer, mesh=None,
-                 accumulate_steps=1, use_remat=False):
+                 accumulate_steps=1, use_remat=False, sp_shard_args=None):
+        self.sp_shard_args = sp_shard_args
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -137,7 +142,7 @@ class HybridParallelTrainStep:
         use_remat = self.use_remat
 
         def step(params, states, lr, key, *batch):
-            with C.spmd_region(axes):
+            with C.spmd_region(axes, sp_data_sharded=sp_on):
                 def loss_of(ps):
                     with bind_arrays(model, ps):
                         # fold data-parallel position into the key so dp
@@ -212,20 +217,23 @@ class HybridParallelTrainStep:
         # sequence sharding only for models that declare support (GPT sets
         # _supports_sequence_parallel; others would silently attend within
         # chunks) — the mesh may still carry an sp axis for other tensors.
-        sp_on = ('sp' in axes and self.mesh.shape['sp'] > 1
+        sp_on = ('sp' in axes and self.sp > 1
                  and getattr(self.model, '_supports_sequence_parallel',
                              False))
-        if 'sp' in axes and self.mesh.shape['sp'] > 1 and not sp_on:
+        if 'sp' in axes and self.sp > 1 and not sp_on:
             raise ValueError(
                 "mesh has sp>1 but the model does not declare "
                 "_supports_sequence_parallel; sequence-sharding it would "
                 "silently train wrong")
         dp_name = 'dp' if 'dp' in axes else None
-        def _bspec(nd):
-            if nd >= 2 and sp_on:
+        def _bspec(idx, nd):
+            shard_seq = sp_on and nd >= 2 and (
+                self.sp_shard_args is None or idx in self.sp_shard_args)
+            if shard_seq:
                 return P(dp_name, 'sp')
             return P(dp_name) if dp_name else P()
-        batch_specs = tuple(_bspec(nd) for nd in self._batch_ndims)
+        batch_specs = tuple(_bspec(i, nd)
+                            for i, nd in enumerate(self._batch_ndims))
         in_specs = (self._param_specs, self._state_specs, P(), P(),
                     *batch_specs)
         out_specs = (P(), self._param_specs, self._state_specs)

@@ -1,0 +1,160 @@
+"""fleetrun — multi-host launcher.
+
+Reference parity: fleet/launch.py (launch:396) + launch_utils.py
+(Cluster:59/Pod:173, env injection, watch loop). TPU topology note: the
+reference spawns one process per GPU; on TPU the single-controller runtime
+drives all local chips from ONE process per host, so the launcher starts one
+trainer per host and wires the hosts together:
+  * rendezvous over the native TCPStore (csrc/tcp_store.cc) instead of the
+    reference's gloo HTTP/FS KV — node 0 serves the store;
+  * each node registers its endpoint; a barrier releases once all arrive;
+  * the trainer env gets PADDLE_TRAINER_ID/PADDLE_TRAINERS_NUM/
+    PADDLE_TRAINER_ENDPOINTS (reference names) plus the jax.distributed
+    coordinator address for the PJRT DCN handshake;
+  * a watch loop restarts-or-aborts on child death (elastic mode defers to
+    ElasticManager).
+
+Usage:
+  python -m paddle_tpu.distributed.launch [--nnodes N] [--node_rank R]
+      [--master HOST:PORT] [--elastic] train.py [args...]
+"""
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def _parse():
+    p = argparse.ArgumentParser('fleetrun')
+    p.add_argument('--nnodes', type=int,
+                   default=int(os.environ.get('PADDLE_NNODES', 1)))
+    p.add_argument('--node_rank', type=int,
+                   default=int(os.environ.get('PADDLE_NODE_RANK', 0)))
+    p.add_argument('--master',
+                   default=os.environ.get('PADDLE_MASTER',
+                                          '127.0.0.1:6170'))
+    p.add_argument('--elastic', action='store_true')
+    p.add_argument('--max_restarts', type=int, default=3)
+    p.add_argument('--log_dir', default=None)
+    p.add_argument('training_script')
+    p.add_argument('training_script_args', nargs=argparse.REMAINDER)
+    return p.parse_args()
+
+
+def _rendezvous(args):
+    """Register this node, learn the full endpoint list."""
+    from ..core.native import TCPStore
+    host, port = args.master.rsplit(':', 1)
+    port = int(port)
+    is_master = args.node_rank == 0
+    store = TCPStore(host=host, port=port, is_master=is_master, timeout=120)
+    my_ep = f"{host if is_master else _my_ip()}:{port + 1 + args.node_rank}"
+    store.set(f"ep/{args.node_rank}", my_ep)
+    store.barrier('rendezvous', args.nnodes)
+    eps = [store.get(f"ep/{i}").decode() for i in range(args.nnodes)]
+    return store, eps
+
+
+def _my_ip():
+    import socket
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(('8.8.8.8', 80))
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        return '127.0.0.1'
+
+
+def _trainer_env(args, endpoints):
+    env = dict(os.environ)
+    env.update({
+        'PADDLE_TRAINER_ID': str(args.node_rank),
+        'PADDLE_TRAINERS_NUM': str(args.nnodes),
+        'PADDLE_CURRENT_ENDPOINT': endpoints[args.node_rank],
+        'PADDLE_TRAINER_ENDPOINTS': ','.join(endpoints),
+        # PJRT multi-host handshake (jax.distributed)
+        'JAX_COORDINATOR_ADDRESS': args.master,
+        'JAX_NUM_PROCESSES': str(args.nnodes),
+        'JAX_PROCESS_ID': str(args.node_rank),
+    })
+    return env
+
+
+def start_local_trainer(args, endpoints):
+    """Parity: launch_utils.start_local_trainers (one proc per host)."""
+    env = _trainer_env(args, endpoints)
+    cmd = [sys.executable, '-u', args.training_script] + \
+        args.training_script_args
+    stdout = None
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+        stdout = open(os.path.join(
+            args.log_dir, f"trainer.{args.node_rank}.log"), 'a')
+    return subprocess.Popen(cmd, env=env, stdout=stdout,
+                            stderr=subprocess.STDOUT if stdout else None)
+
+
+def watch_loop(args, endpoints, store):
+    """Parity: launch_utils.watch_local_trainers — restart (elastic) or
+    abort the pod on child death."""
+    restarts = 0
+    proc = start_local_trainer(args, endpoints)
+
+    def forward_signal(signum, frame):
+        proc.send_signal(signum)
+    signal.signal(signal.SIGTERM, forward_signal)
+
+    while True:
+        ret = proc.poll()
+        if ret is None:
+            if args.elastic:
+                store.set(f"heartbeat/{args.node_rank}",
+                          str(time.time()))
+            time.sleep(3)
+            continue
+        if ret == 0:
+            return 0
+        if args.elastic and restarts < args.max_restarts:
+            restarts += 1
+            print(f"[fleetrun] trainer exited {ret}; restart "
+                  f"{restarts}/{args.max_restarts}", file=sys.stderr)
+            proc = start_local_trainer(args, endpoints)
+            continue
+        print(f"[fleetrun] trainer exited {ret}; aborting pod",
+              file=sys.stderr)
+        return ret
+
+
+class _NullStore:
+    def set(self, *a, **k):
+        pass
+
+    def close(self):
+        pass
+
+
+def launch():
+    """Parity: fleet/launch.py launch:396."""
+    args = _parse()
+    if args.nnodes <= 1:
+        if args.elastic:
+            ret = watch_loop(args, ['127.0.0.1:6171'], _NullStore())
+            sys.exit(ret)
+        env = _trainer_env(args, ['127.0.0.1:6171'])
+        cmd = [sys.executable, '-u', args.training_script] + \
+            args.training_script_args
+        ret = subprocess.call(cmd, env=env)
+        sys.exit(ret)
+    store, endpoints = _rendezvous(args)
+    ret = watch_loop(args, endpoints, store)
+    store.barrier('teardown', args.nnodes)
+    store.close()
+    sys.exit(ret)
+
+
+if __name__ == '__main__':
+    launch()

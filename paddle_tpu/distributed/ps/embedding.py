@@ -1,0 +1,130 @@
+"""Distributed sparse embedding — the host-side parameter server coupling.
+
+Reference parity: operators/pscore distributed_lookup_table + push_sparse
+bridging the graph to the PS (N32), over CommonSparseTable (N30) /
+heterPS (N31). TPU-native split (the heterPS analogue from SURVEY.md §7
+step 9): the trillion-parameter sparse table lives in HOST memory
+(csrc/sparse_table.cc); each step pulls the batch's rows into one
+contiguous buffer (one H2D transfer), the TPU runs the dense math, and the
+embedding gradients flow back through the autograd tape into an async push.
+"""
+import threading
+import queue as _queue
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...core.autograd import record, grad_enabled
+from ...core.native import NativeSparseTable
+from ...nn.layer.base import Layer
+
+
+class AsyncCommunicator:
+    """Parity: distributed C++ Communicator:197 — background send queue for
+    async sparse-grad push (a_sync mode)."""
+
+    def __init__(self, send_queue_size=16):
+        self._q = _queue.Queue(maxsize=send_queue_size)
+        self._running = False
+        self._thread = None
+
+    def start(self):
+        if self._running:
+            return
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            table, ids, grads, lr = item
+            table.push(ids, grads, lr)
+            self._q.task_done()
+
+    def send(self, table, ids, grads, lr):
+        if not self._running:
+            table.push(ids, grads, lr)
+            return
+        self._q.put((table, ids, grads, lr))
+
+    def flush(self):
+        if self._running:
+            self._q.join()
+
+    def stop(self):
+        if self._running:
+            self._q.put(None)
+            self._running = False
+            if self._thread is not None:
+                self._thread.join()
+                self._thread = None
+
+
+_global_communicator = AsyncCommunicator()
+
+
+def global_communicator():
+    return _global_communicator
+
+
+class DistributedEmbedding(Layer):
+    """Sparse embedding backed by the host PS table.
+
+    Forward pulls rows for the batch ids; backward captures the row grads on
+    the tape and routes them into push (sync, or async via the
+    communicator). The table is unbounded — features materialize on first
+    touch (reference accessor semantics)."""
+
+    def __init__(self, embedding_dim, optimizer='adagrad', learning_rate=0.01,
+                 init_range=0.05, num_shards=16, seed=0, a_sync=False,
+                 name=None):
+        super().__init__()
+        self.embedding_dim = embedding_dim
+        self.table = NativeSparseTable(embedding_dim, num_shards=num_shards,
+                                       optimizer=optimizer,
+                                       init_range=init_range, seed=seed)
+        self.learning_rate = learning_rate
+        self.a_sync = a_sync
+        if a_sync:
+            _global_communicator.start()
+
+    def forward(self, ids):
+        """ids: int Tensor [...]; returns [..., dim] float Tensor."""
+        ids_np = np.asarray(ids.data).astype(np.int64)
+        flat = ids_np.reshape(-1)
+        rows = self.table.pull(flat)
+        out_arr = jnp.asarray(rows).reshape(ids_np.shape +
+                                            (self.embedding_dim,))
+        out = Tensor(out_arr, stop_gradient=not grad_enabled())
+        if not out.stop_gradient:
+            table, lr, dim = self.table, self.learning_rate, \
+                self.embedding_dim
+            a_sync = self.a_sync
+
+            def vjp_fn(ct):
+                # host-side push — the PS path is eager by design (ids and
+                # table live on the host); ct is concrete here
+                g = np.asarray(ct, np.float32).reshape(-1, dim)
+                if a_sync:
+                    _global_communicator.send(table, flat, g, lr)
+                else:
+                    table.push(flat, g, lr)
+                return []
+            record('distributed_lookup_table', vjp_fn, [], [], [out])
+        return out
+
+    def flush(self):
+        _global_communicator.flush()
+
+    def save(self, path):
+        self.table.save(path)
+
+    def load(self, path):
+        self.table.load(path)
+
+    def __len__(self):
+        return len(self.table)

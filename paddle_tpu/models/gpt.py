@@ -69,10 +69,13 @@ def gpt_1p3b(**kw):  # GPT-3 1.3B (BASELINE config 4)
 
 
 def _sp_active():
-    """True when tracing inside an SPMD region with a live 'sp' axis."""
+    """True only when the engine declared the batch sequence-sharded over a
+    live 'sp' axis (mere axis presence is not enough — e.g. the pipeline
+    engine runs with the full mesh in scope but dp-only batch sharding)."""
     from ..distributed import collective as C
     from ..distributed import topology_runtime
-    return (C.in_spmd_region() and 'sp' in C.current_spmd_axes()
+    return (C.in_spmd_region() and C.sp_data_sharded()
+            and 'sp' in C.current_spmd_axes()
             and topology_runtime.axis_size('sp') > 1)
 
 
@@ -139,7 +142,12 @@ class GPTAttention(nn.Layer):
 
         # out-dim layout is (head, 3, hd): column-sharding then hands each
         # mp rank whole heads (Megatron qkv packing), so TP == dense.
-        def attn(a, key=None):
+        attn_key = None
+        if self.attn_dropout_p > 0.0 and self.training:
+            from ..core import rng as _rng
+            attn_key = _rng.next_key()
+
+        def attn(a):
             x5 = a.reshape(B, L, nh, 3, hd)
             q, k, v = x5[:, :, :, 0], x5[:, :, :, 1], x5[:, :, :, 2]
             q = q.transpose(0, 2, 1, 3)  # B, nh, L, hd
@@ -151,6 +159,11 @@ class GPTAttention(nn.Layer):
             causal = jnp.tril(jnp.ones((L, L), bool))
             scores = jnp.where(causal, scores, jnp.asarray(-1e9, scores.dtype))
             probs = jax.nn.softmax(scores, axis=-1).astype(a.dtype)
+            if attn_key is not None:
+                keep = jax.random.bernoulli(
+                    attn_key, 1.0 - self.attn_dropout_p, probs.shape)
+                probs = jnp.where(keep,
+                                  probs / (1.0 - self.attn_dropout_p), 0.0)
             out = jnp.einsum('bhqk,bhkd->bhqd', probs, v)
             return out.transpose(0, 2, 1, 3).reshape(B, L, nh * hd)
 

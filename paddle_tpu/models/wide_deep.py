@@ -1,0 +1,51 @@
+"""Wide&Deep — BASELINE config 5 (trillion-param sparse PS + dense TPU).
+
+Reference parity: the canonical PS-mode ranking model the reference's
+parameter-server stack trains (a_sync strategy + distributed_lookup_table);
+sparse side rides paddle_tpu.distributed.ps (host tables), dense towers run
+on TPU.
+"""
+import numpy as np
+
+from .. import nn
+from ..core.tensor import Tensor
+from ..ops import math as M
+from ..ops import manip
+from ..ops import nn_ops as F
+from ..distributed.ps.embedding import DistributedEmbedding
+
+
+class WideDeep(nn.Layer):
+    def __init__(self, sparse_feature_dim=16, num_sparse_slots=8,
+                 dense_dim=13, hidden_sizes=(64, 32), a_sync=False,
+                 sparse_lr=0.05):
+        super().__init__()
+        self.num_sparse_slots = num_sparse_slots
+        self.embedding = DistributedEmbedding(
+            sparse_feature_dim, optimizer='adagrad',
+            learning_rate=sparse_lr, a_sync=a_sync)
+        # wide part: per-feature scalar weights from a second tiny table
+        self.wide_embedding = DistributedEmbedding(
+            1, optimizer='sgd', learning_rate=sparse_lr, a_sync=a_sync)
+        layers = []
+        in_dim = dense_dim + num_sparse_slots * sparse_feature_dim
+        for h in hidden_sizes:
+            layers += [nn.Linear(in_dim, h), nn.ReLU()]
+            in_dim = h
+        layers.append(nn.Linear(in_dim, 1))
+        self.deep = nn.Sequential(*layers)
+
+    def forward(self, sparse_ids, dense_feats):
+        """sparse_ids: int64 [B, num_slots]; dense_feats: [B, dense_dim]."""
+        emb = self.embedding(sparse_ids)          # B, S, D
+        emb_flat = manip.reshape(
+            emb, [emb.shape[0], emb.shape[1] * emb.shape[2]])
+        deep_in = manip.concat([dense_feats, emb_flat], axis=1)
+        deep_out = self.deep(deep_in)             # B, 1
+        wide = self.wide_embedding(sparse_ids)    # B, S, 1
+        wide_out = M.sum(wide, axis=[1])          # B, 1
+        return M.add(deep_out, wide_out)
+
+    def loss(self, logits, labels):
+        return F.binary_cross_entropy_with_logits(
+            logits, labels.astype('float32'))

@@ -14,14 +14,12 @@ Causality across the ring: each device holds a contiguous sequence chunk
   * causal-diagonal if src_chunk == my_chunk (lower-triangular in-block)
   * invisible       if src_chunk >  my_chunk  (skipped via mask)
 """
-import functools
 import math
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..core.tensor import Tensor
 from ..core.autograd import run_op
 
 NEG_INF = -1e30
@@ -131,14 +129,16 @@ def ulysses_attention(qkv, num_heads, head_dim, axis_name='sp', sp=None):
     local heads, and swaps back — 2 AllToAlls instead of a ring, better when
     nh ≥ sp and per-chip memory allows L-length scores blocks.
     qkv [B, Lc, nh*3*hd] → [B, Lc, nh*hd]."""
-    if sp is not None and num_heads % sp != 0:
+    if sp is None:
+        from ..distributed import topology_runtime
+        sp = topology_runtime.axis_size(axis_name)
+    if sp and num_heads % sp != 0:
         raise ValueError(
             f"ulysses_attention: num_heads ({num_heads}) must be divisible "
             f"by the sequence-parallel degree ({sp})")
 
     def fn(a):
         B, Lc, _ = a.shape
-        n = lax.psum(1, axis_name) if sp is None else sp
         x = a.reshape(B, Lc, num_heads, 3 * head_dim)
         # [B, Lc, nh, 3hd] → all-to-all: split heads, concat sequence
         x = lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,

@@ -1,0 +1,136 @@
+"""Profiler.
+
+Reference parity: python/paddle/fluid/profiler.py (profiler:314 context
+manager, RecordEvent markers) over platform/profiler.cc + device_tracer.cc
+(N4). Host events go through the C++ recorder (csrc/profiler.cc, chrome-trace
+export); device-side timing is delegated to jax.profiler (XLA xplane) —
+`start_device_trace`/`stop_device_trace` wrap it so one API drives both, as
+the reference's tracer correlates CUPTI with host events.
+"""
+import contextlib
+import os
+
+from .core.native import load_native
+
+
+class RecordEvent:
+    """Parity: paddle.profiler.RecordEvent / platform::RecordEvent RAII."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._lib = load_native()
+        self._start = None
+
+    def begin(self):
+        if self._lib is not None:
+            self._start = self._lib.ptpu_profiler_now()
+
+    def end(self):
+        if self._lib is not None and self._start is not None:
+            self._lib.ptpu_profiler_record(self.name.encode(), self._start,
+                                           self._lib.ptpu_profiler_now())
+            self._start = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *a):
+        self.end()
+        return False
+
+
+def start_profiler(state='All', tracer_option='Default'):
+    lib = load_native()
+    if lib is not None:
+        lib.ptpu_profiler_enable(1)
+
+
+def stop_profiler(sorted_key=None, profile_path='/tmp/profile'):
+    lib = load_native()
+    if lib is None:
+        return
+    lib.ptpu_profiler_enable(0)
+    print(summary())
+    if profile_path:
+        export_chrome_tracing(profile_path + '.json')
+
+
+def reset_profiler():
+    lib = load_native()
+    if lib is not None:
+        lib.ptpu_profiler_clear()
+
+
+def summary():
+    lib = load_native()
+    if lib is None:
+        return ''
+    import ctypes
+    cap = 1 << 20
+    buf = ctypes.create_string_buffer(cap)
+    lib.ptpu_profiler_summary(buf, cap)
+    return buf.value.decode()
+
+
+def export_chrome_tracing(path):
+    lib = load_native()
+    if lib is not None:
+        lib.ptpu_profiler_export(path.encode())
+    return path
+
+
+@contextlib.contextmanager
+def profiler(state='All', sorted_key=None, profile_path='/tmp/profile',
+             tracer_option='Default'):
+    """Parity: fluid/profiler.py profiler:314 context manager."""
+    start_profiler(state, tracer_option)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+# ---- device-side (XLA) trace ------------------------------------------------
+def start_device_trace(logdir='/tmp/paddle_tpu_trace'):
+    """XLA/PJRT profiler (parity role: device_tracer.cc CUPTI capture)."""
+    import jax
+    jax.profiler.start_trace(logdir)
+    return logdir
+
+
+def stop_device_trace():
+    import jax
+    jax.profiler.stop_trace()
+
+
+class Profiler:
+    """paddle.profiler.Profiler-shaped wrapper (2.x API surface)."""
+
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False):
+        self.timer_only = timer_only
+
+    def start(self):
+        start_profiler()
+
+    def stop(self):
+        stop_profiler(profile_path=None)
+
+    def step(self):
+        pass
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit='ms'):
+        return summary()
+
+    def export(self, path, format='json'):
+        return export_chrome_tracing(path)
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *a):
+        self.stop()
+        return False
