@@ -105,3 +105,6 @@ def is_compiled_with_tpu():
 def summary(net, input_size=None, dtypes=None):
     from .hapi.summary import summary as _summary
     return _summary(net, input_size, dtypes)
+from . import text  # noqa: E402
+from . import profiler  # noqa: E402
+from . import models  # noqa: E402

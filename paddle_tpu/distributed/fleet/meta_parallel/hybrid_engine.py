@@ -284,3 +284,29 @@ class HybridParallelTrainStep:
     @property
     def params(self):
         return self._params
+
+    # -- checkpoint (parity: fleet.save/set_state_dict re-broadcast flow,
+    # SURVEY.md §5.4) --------------------------------------------------------
+    def state_dict(self):
+        import numpy as _np
+        import jax as _jax
+        out = {'params': {}, 'states': {}}
+        for n, a in self._params.items():
+            out['params'][n] = _np.asarray(_jax.device_get(a))
+        for n, st in self._states.items():
+            out['states'][n] = {k: _np.asarray(_jax.device_get(v))
+                                for k, v in st.items()}
+        out['step'] = self._step_count
+        return out
+
+    def set_state_dict(self, sd):
+        for n, a in sd['params'].items():
+            if n in self._params:
+                self._params[n] = self._place(a, self._param_specs[n])
+        for n, st in sd.get('states', {}).items():
+            if n in self._states:
+                for k, v in st.items():
+                    if k in self._state_specs[n]:
+                        self._states[n][k] = self._place(
+                            v, self._state_specs[n][k])
+        self._step_count = sd.get('step', 0)

@@ -1,0 +1,2 @@
+from .auto_checkpoint import (AutoCheckpointChecker, TrainEpochRange,
+                              train_epoch_range)

@@ -1,0 +1,158 @@
+"""Auto-checkpoint.
+
+Reference parity: fluid/incubate/checkpoint/auto_checkpoint.py —
+AutoCheckpointChecker:71 (env-driven enablement), TrainEpochRange:265 (wraps
+the epoch loop; serializes state each epoch with epoch_no metadata; restores
+on restart) + checkpoint_saver.py CheckpointSaver. The reference stores to
+HDFS via PaddleCloud env; here the FS abstraction (fleet.utils.fs LocalFS /
+HDFSClient) backs it, keyed by the same env names so job-platform wiring
+carries over.
+"""
+import json
+import os
+import time
+
+from ... import framework
+from ...distributed.fleet.utils.fs import LocalFS
+
+
+class AutoCheckpointChecker:
+    """Parity: auto_checkpoint.py:71 — env-driven config."""
+
+    def __init__(self):
+        self.run_env = os.environ.get('PADDLE_RUNNING_ENV', '')
+        self.platform = os.environ.get('PADDLE_RUNNING_PLATFORM', '')
+        self.job_id = os.environ.get('PADDLE_JOB_ID', '')
+        self.hdfs_home = os.environ.get('PADDLE_EDL_HDFS_HOME', '')
+        self.checkpoint_dir = os.environ.get(
+            'PADDLE_EDL_HDFS_CHECKPOINT_PATH',
+            os.environ.get('PADDLE_CHECKPOINT_DIR', ''))
+        self.save_checkpoint_inter = int(os.environ.get(
+            'PADDLE_EDL_SAVE_CHECKPOINT_INTER', '900'))
+
+    def get_range_checkpoint_path(self, name):
+        return os.path.join(self.checkpoint_dir, self.job_id or 'job',
+                            'range', name)
+
+    @property
+    def valid(self):
+        return bool(self.checkpoint_dir)
+
+
+class CheckpointSaver:
+    """Parity: checkpoint_saver.py — numbered checkpoint dirs with metadata,
+    keep-last semantics."""
+
+    def __init__(self, fs=None):
+        self.fs = fs or LocalFS()
+
+    def save_checkpoint(self, path, state, epoch_no, max_keep=3):
+        import tempfile
+        self.fs.mkdirs(path)
+        ckpt_dir = os.path.join(path, f"__paddle_checkpoint__{epoch_no}")
+        self.fs.mkdirs(ckpt_dir)
+        local = isinstance(self.fs, LocalFS)
+        stage = ckpt_dir if local else tempfile.mkdtemp()
+        framework.save(state, os.path.join(stage, 'state.pdparams'))
+        meta = {'epoch_no': epoch_no, 'time': time.time()}
+        with open(os.path.join(stage, 'meta.json'), 'w') as f:
+            json.dump(meta, f)
+        if not local:
+            # remote FS: stage locally then upload through the abstraction
+            self.fs.upload(os.path.join(stage, 'state.pdparams'),
+                           os.path.join(ckpt_dir, 'state.pdparams'))
+            self.fs.upload(os.path.join(stage, 'meta.json'),
+                           os.path.join(ckpt_dir, 'meta.json'))
+        # prune old
+        dirs, _ = self.fs.ls_dir(path)
+        nums = sorted(int(d.rsplit('__', 1)[-1]) for d in dirs
+                      if d.startswith('__paddle_checkpoint__'))
+        for n in nums[:-max_keep]:
+            self.fs.delete(os.path.join(path, f"__paddle_checkpoint__{n}"))
+        return ckpt_dir
+
+    def load_checkpoint(self, path):
+        if not self.fs.is_exist(path):
+            return None, -1
+        dirs, _ = self.fs.ls_dir(path)
+        nums = sorted(int(d.rsplit('__', 1)[-1]) for d in dirs
+                      if d.startswith('__paddle_checkpoint__'))
+        if not nums:
+            return None, -1
+        latest = os.path.join(path, f"__paddle_checkpoint__{nums[-1]}")
+        if isinstance(self.fs, LocalFS):
+            stage = latest
+        else:
+            import tempfile
+            stage = tempfile.mkdtemp()
+            self.fs.download(os.path.join(latest, 'state.pdparams'),
+                             os.path.join(stage, 'state.pdparams'))
+            self.fs.download(os.path.join(latest, 'meta.json'),
+                             os.path.join(stage, 'meta.json'))
+        state = framework.load(os.path.join(stage, 'state.pdparams'))
+        with open(os.path.join(stage, 'meta.json')) as f:
+            meta = json.load(f)
+        return state, meta['epoch_no']
+
+
+class TrainEpochRange:
+    """Parity: auto_checkpoint.py TrainEpochRange:265 — iterate epochs,
+    skipping already-completed ones after a restart and saving state at each
+    epoch end.
+
+        r = TrainEpochRange(10, 'job1', model=model, optimizer=opt)
+        for epoch in r.get():
+            ... train ...
+    """
+
+    def __init__(self, max_epoch_num, name, model=None, optimizer=None,
+                 checkpoint_dir=None, save_checkpoint_inter=0):
+        self.save_checkpoint_inter = save_checkpoint_inter
+        self._last_save_time = 0.0
+        self.max_epoch_num = max_epoch_num
+        self.name = name
+        self.model = model
+        self.optimizer = optimizer
+        self.checker = AutoCheckpointChecker()
+        base = checkpoint_dir or self.checker.checkpoint_dir or '/tmp/acp'
+        self.path = os.path.join(base, name)
+        self.saver = CheckpointSaver()
+        self._restored_epoch = -1
+        state, epoch_no = self.saver.load_checkpoint(self.path)
+        if state is not None:
+            self._restored_epoch = epoch_no
+            if self.model is not None and 'model' in state:
+                self.model.set_state_dict(state['model'])
+            if self.optimizer is not None and 'optimizer' in state:
+                self.optimizer.set_state_dict(state['optimizer'])
+
+    def get(self):
+        start = self._restored_epoch + 1
+        for epoch in range(start, self.max_epoch_num):
+            yield epoch
+            # throttle by wall time (parity: PADDLE_EDL_SAVE_CHECKPOINT_INTER)
+            # but always persist the final epoch
+            due = (time.time() - self._last_save_time
+                   >= self.save_checkpoint_inter)
+            if due or epoch == self.max_epoch_num - 1:
+                self.save(epoch)
+                self._last_save_time = time.time()
+
+    def save(self, epoch_no):
+        state = {}
+        if self.model is not None:
+            state['model'] = self.model.state_dict()
+        if self.optimizer is not None:
+            state['optimizer'] = self.optimizer.state_dict()
+        self.saver.save_checkpoint(self.path, state, epoch_no)
+
+    @property
+    def restored_from(self):
+        return self._restored_epoch
+
+
+def train_epoch_range(max_epoch_num, save_checkpoint_inter=None, name='acp',
+                      **kwargs):
+    """Parity: the module-level helper used inside Executor.run's hook."""
+    r = TrainEpochRange(max_epoch_num, name, **kwargs)
+    yield from r.get()

@@ -22,7 +22,7 @@ def register(name, fn):
 
 
 def as_tensor(x, ref=None):
-    if isinstance(x, Tensor):
+    if isinstance(x, Tensor) or getattr(x, '_is_symbolic', False):
         return x
     dtype = None
     if ref is not None and isinstance(x, (int, float, bool)) and not isinstance(x, bool):

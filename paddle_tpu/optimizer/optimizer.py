@@ -184,6 +184,17 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        from ..static import program as sprog
+        if sprog.in_static_mode():
+            # Static path (parity: Optimizer.minimize appending backward +
+            # optimize ops to the Program): append_backward marks grads; the
+            # Executor's jitted replay calls functional_apply with state
+            # threaded through the Scope.
+            from ..static.backward import append_backward
+            params_grads = append_backward(loss, parameter_list=parameters)
+            prog = loss.block.program
+            prog._optimizer = self
+            return [], params_grads
         loss.backward()
         self.step()
         return [], []

@@ -86,19 +86,40 @@ class Executor:
                 feed_arrays.append(jnp.asarray(np.asarray(v)))
 
         param_names, param_arrays = self._collect_params(program, scope)
+        opt = getattr(program, '_optimizer', None)
+        states_key = f'__opt_states__/{id(program)}/{id(opt)}'
+        opt_states = scope.find_var(states_key)
+        if opt is not None and opt_states is None:
+            opt_states = {}
+            for name in param_names:
+                arr = scope.find_var(name)
+                st = opt.init_state(Tensor(arr))
+                if arr.dtype != jnp.float32 and \
+                        getattr(opt, '_multi_precision', True):
+                    st['master'] = arr.astype(jnp.float32)
+                opt_states[name] = st
+            scope.set(states_key, opt_states)
+        if opt_states is None:
+            opt_states = {}
+        lr = jnp.asarray(opt.get_lr() if opt is not None else 0.0,
+                         jnp.float32)
+
         key = (id(program), feed_names,
                tuple((a.shape, str(a.dtype)) for a in feed_arrays),
-               tuple(fetch_names), len(program.global_block().ops))
+               tuple(fetch_names), len(program.global_block().ops),
+               id(opt))
         compiled = self._cache.get(key)
         if compiled is None:
             compiled = jax.jit(self._make_replay(program, feed_names,
                                                  param_names, fetch_names))
             self._cache[key] = compiled
 
-        fetches, new_params = compiled(tuple(feed_arrays),
-                                       tuple(param_arrays))
+        fetches, new_params, new_states = compiled(
+            tuple(feed_arrays), tuple(param_arrays), opt_states, lr)
         for name, arr in zip(param_names, new_params):
             scope.set(name, arr)
+        if opt is not None:
+            scope.set(states_key, new_states)
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return [Tensor(f) for f in fetches]
@@ -131,9 +152,9 @@ class Executor:
         loss_name = program._loss_var.name if program._loss_var is not None \
             else None
         grad_map = dict(program._grad_map)
-        opt_hook = getattr(program, '_opt_hook', None)
+        opt = getattr(program, '_optimizer', None)
 
-        def replay(feed_arrays, param_arrays):
+        def replay(feed_arrays, param_arrays, opt_states, lr):
             env = {}
             for name, arr in zip(feed_names, feed_arrays):
                 env[name] = arr
@@ -181,16 +202,18 @@ class Executor:
                 run_ops()
 
             new_params = [env[n] for n in param_names]
-            if opt_hook is not None:
+            new_states = opt_states
+            if opt is not None and grad_map:
                 params = {n: env[n] for n in param_names}
                 grads = {n: env.get(grad_map.get(n, '__none__'))
                          for n in param_names}
                 grads = {n: g for n, g in grads.items() if g is not None}
-                updated = opt_hook(params, grads)
+                updated, new_states = opt.functional_apply(
+                    params, grads, opt_states, lr)
                 new_params = [updated.get(n, env[n]) for n in param_names]
 
             fetches = [env[n] for n in fetch_names]
-            return fetches, new_params
+            return fetches, new_params, new_states
         return replay
 
 

@@ -58,6 +58,8 @@ class Variable:
     """Symbolic tensor (parity: fluid/framework.py Variable). Holds only an
     aval (shape/dtype); values live in the Scope at run time."""
 
+    _is_symbolic = True
+
     def __init__(self, block, name, shape, dtype, persistable=False,
                  stop_gradient=True, is_parameter=False):
         self.block = block
@@ -255,11 +257,18 @@ class Program:
             yield from b.vars.values()
 
     def clone(self, for_test=False):
-        import copy
         p = Program.__new__(Program)
         p.__dict__.update(self.__dict__)
         p.blocks = self.blocks       # shallow: shares blocks (paddle clones
-        return p                     # descs; our replay is non-destructive)
+                                     # descs; our replay is non-destructive)
+        if for_test:
+            # prune backward + optimize work (parity: clone(for_test=True)
+            # removes grad/optimize ops) — otherwise evaluating the clone
+            # would keep training on eval data
+            p._optimizer = None
+            p._grad_map = {}
+            p._loss_var = None
+        return p
 
     @property
     def num_blocks(self):

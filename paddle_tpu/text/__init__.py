@@ -1,0 +1,3 @@
+"""paddle_tpu.text (parity: python/paddle/text — datasets + viterbi)."""
+from . import datasets
+from .datasets import Imdb, Imikolov, UCIHousing, WMT14, Conll05st

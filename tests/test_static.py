@@ -1,0 +1,192 @@
+"""Static-graph path tests.
+
+Reference patterns: program construction + Executor (fluid tests),
+meta-optimizer compile-only golden tests (§4.3 —
+test_fleet_*_meta_optimizer.py assert on the rewritten program, no devices
+needed)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+
+
+@pytest.fixture(autouse=True)
+def _static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+
+
+def test_program_records_ops():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data('x', [4, 8])
+        y = static.nn.fc(x, 16, activation='relu')
+        out = paddle.mean(y)
+    types = [op.type for op in main.global_block().ops]
+    assert 'matmul_v2' in types and 'relu' in types \
+        and 'reduce_mean' in types
+    assert out.shape == []
+    assert len(main.all_parameters()) == 2  # w + b
+
+
+def test_executor_forward():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data('x', [2, 4])
+        y = static.nn.fc(x, 3)
+    exe = static.Executor()
+    with static.scope_guard(static.Scope()):
+        res = exe.run(main, feed={'x': np.ones((2, 4), 'float32')},
+                      fetch_list=[y])
+    assert res[0].shape == (2, 3)
+
+
+def test_minimize_trains_regression():
+    """fit_a_line pattern (book test) through the static path."""
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+    xs = rng.rand(64, 4).astype('float32')
+    w_true = np.array([[1.0], [-2.0], [3.0], [0.5]], 'float32')
+    ys = xs @ w_true + 0.1
+
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data('x', [64, 4])
+        label = static.data('label', [64, 1])
+        pred = static.nn.fc(x, 1)
+        loss = paddle.mean((pred - label) * (pred - label))
+        opt = paddle.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+
+    exe = static.Executor()
+    scope = static.Scope()
+    losses = []
+    with static.scope_guard(scope):
+        for i in range(150):
+            res = exe.run(main, feed={'x': xs, 'label': ys},
+                          fetch_list=[loss])
+            losses.append(float(res[0]))
+    assert losses[-1] < 0.1 < losses[0]
+
+
+def test_minimize_adam_state_persists():
+    paddle.seed(1)
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data('x', [8, 4])
+        y = static.nn.fc(x, 2)
+        loss = paddle.mean(y * y)
+        paddle.optimizer.Adam(learning_rate=0.1).minimize(loss)
+    exe = static.Executor()
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        xs = np.random.RandomState(0).rand(8, 4).astype('float32')
+        l0 = exe.run(main, feed={'x': xs}, fetch_list=[loss])[0]
+        for _ in range(5):
+            l1 = exe.run(main, feed={'x': xs}, fetch_list=[loss])[0]
+        keys = [k for k in scope.vars if k.startswith('__opt_states__')]
+        assert keys, scope.vars.keys()
+        states = scope.find_var(keys[0])
+        first = next(iter(states.values()))
+        assert 'moment1' in first  # adam state threaded through the scope
+    assert float(l1) < float(l0)
+
+
+def test_device_guard_records_op_device():
+    """Pipeline stage marking (parity: device_guard → op_device attr,
+    optimizer.py:4628 keys on it)."""
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data('x', [2, 4])
+        with static.device_guard('gpu:0'):
+            h = static.nn.fc(x, 8)
+        with static.device_guard('gpu:1'):
+            y = static.nn.fc(h, 2)
+    devices = [op.op_device for op in main.global_block().ops]
+    assert 'gpu:0' in devices and 'gpu:1' in devices
+
+
+class TestMetaOptimizerGolden:
+    """Compile-only meta-optimizer tests (§4.3 pattern): apply a strategy,
+    assert on the rewritten/annotated program — no devices needed."""
+
+    def _toy(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data('x', [4, 8])
+            y = static.nn.fc(x, 2)
+            loss = paddle.mean(y * y)
+        return main, loss
+
+    def _minimize(self, strategy, loss):
+        import paddle_tpu.distributed.fleet as fleet
+        import os
+        os.environ.setdefault('PADDLE_TRAINER_ID', '0')
+        fleet.fleet._hcg = None
+        fleet.init(is_collective=True, strategy=strategy)
+        opt = paddle.optimizer.SGD(learning_rate=0.1)
+        opt = fleet.fleet.distributed_optimizer(opt)
+        fleet.fleet.minimize(loss)
+
+    def test_amp_strategy_marks_program(self):
+        from paddle_tpu.distributed.fleet import DistributedStrategy
+        main, loss = self._toy()
+        s = DistributedStrategy()
+        s.amp = True
+        s.amp_configs = {'init_loss_scaling': 1024.0}
+        self._minimize(s, loss)
+        assert getattr(main, '_amp', None) is not None
+        assert main._amp['init_loss_scaling'] == 1024.0
+
+    def test_recompute_strategy(self):
+        from paddle_tpu.distributed.fleet import DistributedStrategy
+        main, loss = self._toy()
+        s = DistributedStrategy()
+        s.recompute = True
+        s.recompute_configs = {'checkpoints': ['fc_0.tmp']}
+        self._minimize(s, loss)
+        assert main._recompute_checkpoints == ['fc_0.tmp']
+
+    def test_pipeline_strategy(self):
+        from paddle_tpu.distributed.fleet import DistributedStrategy
+        main, loss = self._toy()
+        s = DistributedStrategy()
+        s.pipeline = True
+        s.pipeline_configs = {'accumulate_steps': 4,
+                              'micro_batch_size': 2}
+        self._minimize(s, loss)
+        assert main._pipeline_opt['accumulate_steps'] == 4
+
+    def test_sharding_strategy(self):
+        from paddle_tpu.distributed.fleet import DistributedStrategy
+        main, loss = self._toy()
+        s = DistributedStrategy()
+        s.sharding = True
+        s.sharding_configs = {'sharding_degree': 4, 'stage': 2}
+        self._minimize(s, loss)
+        assert main._sharding['sharding_degree'] == 4
+        assert main._sharding['stage'] == 2
+
+    def test_strategy_unknown_key_raises(self):
+        from paddle_tpu.distributed.fleet import DistributedStrategy
+        s = DistributedStrategy()
+        with pytest.raises(AttributeError):
+            s.not_a_real_field = True
+        with pytest.raises(ValueError):
+            s.sharding_configs = {'bogus_key': 1}
+
+    def test_strategy_prototxt_roundtrip(self):
+        import tempfile
+        import os
+        from paddle_tpu.distributed.fleet import DistributedStrategy
+        s = DistributedStrategy()
+        s.amp = True
+        s.hybrid_configs = {'dp_degree': 2, 'mp_degree': 4}
+        path = os.path.join(tempfile.mkdtemp(), 's.prototxt')
+        s.save_to_prototxt(path)
+        s2 = DistributedStrategy()
+        s2.load_from_prototxt(path)
+        assert s2.amp is True
+        assert s2.hybrid_configs['mp_degree'] == 4
