@@ -1,0 +1,126 @@
+"""Communication-reduction training utilities (dygraph side).
+
+Reference parity: the eager counterparts of the LocalSGD / GradientMerge
+meta-optimizers (fleet/meta_optimizers/localsgd_optimizer.py:27 — @SNAPSHOT
+params, k-step delta allreduce, A.11; gradient_merge_optimizer.py — k-step
+grad accumulation with a conditional update).
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from ....core.tensor import Tensor
+from ... import collective as C
+
+
+class LocalSGD:
+    """Train locally k steps, then average params across the dp group.
+
+    Parity: LocalSGDOptimizer (@SNAPSHOT + allreduce of deltas). On the
+    single-controller SPMD runtime, param averaging is a pmean inside an
+    SPMD region; eagerly (1 process) it is the identity, matching the
+    reference's degenerate case.
+    """
+
+    def __init__(self, optimizer, k_steps=4, group=None):
+        self._inner = optimizer
+        self.k_steps = k_steps
+        self.group = group
+        self._step_i = 0
+        self._snapshots = {}
+
+    def _snapshot(self):
+        for p in self._inner._parameter_list or []:
+            self._snapshots[id(p)] = p.data
+
+    def step(self):
+        if not self._snapshots:
+            self._snapshot()
+        self._inner.step()
+        self._step_i += 1
+        if self._step_i % self.k_steps == 0:
+            self._sync()
+
+    def _sync(self):
+        # Outside an SPMD region eager all_reduce is an identity, so the
+        # delta must NOT be divided — only average when a real collective
+        # ran (in-region the divisor is the group size).
+        if C.in_spmd_region():
+            for p in self._inner._parameter_list or []:
+                delta = Tensor(p.data - self._snapshots[id(p)])
+                C.all_reduce(delta, group=self.group)
+                n = C.get_world_size(self.group)
+                p.data = self._snapshots[id(p)] + delta.data / n
+        self._snapshot()
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self.step()
+        return [], []
+
+    def clear_grad(self):
+        self._inner.clear_grad()
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__['_inner'], item)
+
+
+class AdaptiveLocalSGD(LocalSGD):
+    """Parity: adaptive_localsgd — adjust k from loss progress."""
+
+    def __init__(self, optimizer, init_k_steps=1, max_k_steps=16,
+                 group=None):
+        super().__init__(optimizer, init_k_steps, group)
+        self.max_k_steps = max_k_steps
+        self._last_loss = None
+
+    def report_loss(self, loss):
+        v = float(loss)
+        if self._last_loss is not None and v < self._last_loss:
+            self.k_steps = min(self.k_steps * 2, self.max_k_steps)
+        else:
+            self.k_steps = max(1, self.k_steps // 2)
+        self._last_loss = v
+
+
+class GradientMerge:
+    """Accumulate grads k steps, then one optimizer update (parity:
+    GradientMergeOptimizer:6255 — @GRAD@MERGED buffers + conditional
+    block)."""
+
+    def __init__(self, optimizer, k_steps=4, avg=True):
+        self._inner = optimizer
+        self.k_steps = k_steps
+        self.avg = avg
+        self._step_i = 0
+        self._merged = {}
+
+    def step(self):
+        self._step_i += 1
+        for p in self._inner._parameter_list or []:
+            if p.grad is None:
+                continue
+            acc = self._merged.get(id(p))
+            self._merged[id(p)] = p.grad.data if acc is None \
+                else acc + p.grad.data
+            p.grad = None
+        if self._step_i % self.k_steps == 0:
+            for p in self._inner._parameter_list or []:
+                acc = self._merged.pop(id(p), None)
+                if acc is None:
+                    continue
+                if self.avg:
+                    acc = acc / self.k_steps
+                p.grad = Tensor(acc)
+            self._inner.step()
+            self._inner.clear_grad()
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self.step()
+        return [], []
+
+    def clear_grad(self):
+        pass  # grads are consumed into the merge buffers
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__['_inner'], item)

@@ -1,2 +1,5 @@
 """paddle_tpu.incubate (parity: python/paddle/incubate)."""
 from . import optimizer
+from . import asp
+from . import checkpoint
+from .optimizer import LookAhead, ModelAverage

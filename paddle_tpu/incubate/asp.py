@@ -1,0 +1,118 @@
+"""ASP — 2:4 structured sparsity.
+
+Reference parity: fluid/contrib/sparsity/asp.py — decorate(optimizer):55,
+prune_model:95, ASPHelper:214 (generate 2:4 masks per supported weight and
+re-apply the mask after every optimizer step via an appended elementwise
+multiply). TPU note: 2:4 sparse matmul acceleration is an Ampere-TensorCore
+feature without an MXU analogue, so here ASP provides the ALGORITHMIC side
+(mask generation, mask maintenance through training) — the reference's
+accuracy-preserving pruning workflow — with dense execution.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+def _mask_n_m_numpy(w, n=2, m=4):
+    """Keep the n largest-magnitude entries of every m along the last
+    axis."""
+    shape = w.shape
+    flat = w.reshape(-1, shape[-1])
+    cols = shape[-1] - shape[-1] % m
+    mask = np.ones_like(flat, dtype=np.float32)
+    if cols:
+        blocks = flat[:, :cols].reshape(flat.shape[0], -1, m)
+        order = np.argsort(np.abs(blocks), axis=-1)
+        bm = np.ones_like(blocks, dtype=np.float32)
+        np.put_along_axis(bm, order[..., :m - n], 0.0, axis=-1)
+        mask[:, :cols] = bm.reshape(flat.shape[0], cols)
+    return mask.reshape(shape)
+
+
+def create_mask(tensor, func_name='mask_2d_best', n=2, m=4):
+    """Parity: sparsity.create_mask."""
+    w = np.asarray(tensor.data if isinstance(tensor, Tensor) else tensor)
+    return Tensor(_mask_n_m_numpy(w, n, m))
+
+
+def check_sparsity(tensor, n=2, m=4):
+    w = np.asarray(tensor.data if isinstance(tensor, Tensor) else tensor,
+                   dtype=np.float32)
+    cols = w.shape[-1] - w.shape[-1] % m
+    if cols == 0:
+        return True
+    blocks = np.abs(w[..., :cols].reshape(-1, m))
+    nz = (blocks != 0).sum(-1)
+    return bool((nz <= n).all())
+
+
+class ASPHelper:
+    """Parity: asp.py ASPHelper:214."""
+
+    _masks = {}
+
+    @classmethod
+    def _supported(cls, p):
+        return len(p.shape) == 2 and p.shape[0] >= 4 and p.shape[1] >= 4
+
+    @classmethod
+    def prune_model(cls, model, n=2, m=4, mask_algo='mask_1d'):
+        for name, p in model.named_parameters():
+            if not cls._supported(p) or p.stop_gradient:
+                continue
+            mask = jnp.asarray(_mask_n_m_numpy(np.asarray(p.data), n, m),
+                               p.data.dtype)  # keep param dtype (bf16 safe)
+            cls._masks[name if p.name is None else p.name] = mask
+            p.data = p.data * mask
+        return cls._masks
+
+    @classmethod
+    def apply_masks(cls, model):
+        for name, p in model.named_parameters():
+            key = name if p.name is None else p.name
+            if key in cls._masks:
+                p.data = p.data * cls._masks[key]
+
+
+def prune_model(model, n=2, m=4, mask_algo='mask_1d', with_mask=True):
+    """Parity: sparsity.prune_model:95."""
+    return ASPHelper.prune_model(model, n, m, mask_algo)
+
+
+class _ASPOptimizerWrapper:
+    """Re-applies masks after every step (parity: the appended
+    elementwise_mul ops)."""
+
+    def __init__(self, optimizer, model=None):
+        self._inner = optimizer
+        self._model = model
+
+    def step(self):
+        self._inner.step()
+        if self._model is not None:
+            ASPHelper.apply_masks(self._model)
+        else:
+            for p in self._inner._parameter_list or []:
+                key = p.name
+                if key in ASPHelper._masks:
+                    p.data = p.data * ASPHelper._masks[key]
+
+    def minimize(self, loss, *a, **k):
+        out = self._inner.minimize(loss, *a, **k)
+        self.step_masks_only()
+        return out
+
+    def step_masks_only(self):
+        for p in self._inner._parameter_list or []:
+            key = p.name
+            if key in ASPHelper._masks:
+                p.data = p.data * ASPHelper._masks[key]
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__['_inner'], item)
+
+
+def decorate(optimizer, model=None):
+    """Parity: sparsity.decorate(optimizer):55."""
+    return _ASPOptimizerWrapper(optimizer, model)

@@ -1,0 +1,81 @@
+"""Inference export/load — the TPU-native save_inference_model.
+
+Reference parity: save_inference_model / AnalysisPredictor
+(api/analysis_predictor.h:82, N36). TPU-native: the deployable artifact is a
+serialized StableHLO executable (jax.export) + the parameter state — the AOT
+analogue of the reference's pruned ProgramDesc + params; loading rebuilds a
+callable predictor with no Python model code required.
+"""
+import os
+import pickle
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+def save_inference_model(path_prefix, layer, example_inputs):
+    """Export `layer` (eager nn.Layer) at the given example input specs.
+
+    Produces <prefix>.stablehlo (portable serialized module) and
+    <prefix>.pdiparams (weights).
+    """
+    from jax import export as jax_export
+    from ..jit import functional_call, get_params, get_buffers
+
+    params = get_params(layer)
+    buffers = get_buffers(layer)
+    was_training = layer.training
+    layer.eval()
+
+    def fwd(params, buffers, *args):
+        out, _ = functional_call(layer, params, args, buffers)
+        return out
+
+    arg_arrays = tuple(a.data if isinstance(a, Tensor) else jnp.asarray(a)
+                       for a in example_inputs)
+    exported = jax_export.export(jax.jit(fwd))(params, buffers, *arg_arrays)
+    blob = exported.serialize()
+    with open(path_prefix + '.stablehlo', 'wb') as f:
+        f.write(blob)
+    state = {
+        'params': {k: np.asarray(jax.device_get(v))
+                   for k, v in params.items()},
+        'buffers': {k: np.asarray(jax.device_get(v))
+                    for k, v in buffers.items()},
+        'input_specs': [(tuple(a.shape), str(a.dtype))
+                        for a in arg_arrays],
+    }
+    with open(path_prefix + '.pdiparams', 'wb') as f:
+        pickle.dump(state, f, protocol=4)
+    if was_training:
+        layer.train()
+    return path_prefix
+
+
+class Predictor:
+    """Parity: the AnalysisPredictor role — load + run, no model code."""
+
+    def __init__(self, path_prefix):
+        from jax import export as jax_export
+        with open(path_prefix + '.stablehlo', 'rb') as f:
+            self._exported = jax_export.deserialize(f.read())
+        with open(path_prefix + '.pdiparams', 'rb') as f:
+            state = pickle.load(f)
+        self._params = {k: jnp.asarray(v)
+                        for k, v in state['params'].items()}
+        self._buffers = {k: jnp.asarray(v)
+                         for k, v in state['buffers'].items()}
+        self.input_specs = state['input_specs']
+
+    def run(self, *inputs):
+        arrays = tuple(i.data if isinstance(i, Tensor) else jnp.asarray(i)
+                       for i in inputs)
+        out = self._exported.call(self._params, self._buffers, *arrays)
+        return jax.tree_util.tree_map(np.asarray, out)
+
+
+def load_inference_model(path_prefix):
+    return Predictor(path_prefix)

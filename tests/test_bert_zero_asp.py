@@ -1,0 +1,62 @@
+"""BASELINE config 3 (BERT + bf16 + ZeRO-ish sharding) + ASP tests."""
+import numpy as np
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed import topology_runtime
+from paddle_tpu.distributed.fleet.meta_parallel.hybrid_engine import (
+    HybridParallelTrainStep)
+
+
+def test_bert_tiny_bf16_zero_trains():
+    """Config 3 pattern: BERT pretraining, bf16 params + fp32 masters,
+    dp=2 x sharding=4 optimizer-state sharding."""
+    from paddle_tpu.models.bert import (BertConfig, BertForPretraining,
+                                        bert_pretrain_loss)
+    topology_runtime.build_mesh(['dp', 'sharding'], [2, 4])
+    paddle.seed(0)
+    cfg = BertConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                     num_heads=2, intermediate_size=64, max_seq_len=32,
+                     hidden_dropout=0.0, attn_dropout=0.0)
+    model = BertForPretraining(cfg)
+    for p in model.parameters():
+        if p.data.dtype == jnp.float32:
+            p.data = p.data.astype(jnp.bfloat16)
+
+    def loss_fn(m, ids, mlm_labels, nsp_labels):
+        mlm_logits, nsp_logits = m(ids)
+        return bert_pretrain_loss(mlm_logits, nsp_logits, mlm_labels,
+                                  nsp_labels)
+
+    opt = paddle.optimizer.AdamW(learning_rate=5e-3,
+                                 parameters=model.parameters(),
+                                 weight_decay=0.01)
+    eng = HybridParallelTrainStep(model, loss_fn, opt)
+    rng = np.random.RandomState(0)
+    ids = Tensor(rng.randint(0, 128, (8, 32)).astype('int32'))
+    mlm = Tensor(np.asarray(ids.data).astype('int64'))
+    nsp = Tensor(rng.randint(0, 2, (8,)).astype('int64'))
+    losses = [float(eng(ids, mlm, nsp)) for _ in range(6)]
+    assert losses[-1] < losses[0], losses
+    # ZeRO: adam moments for eligible params are sharded over 'sharding'
+    name = 'bert.encoder.layers.0.linear1.weight'
+    assert eng._state_specs[name]['moment1'][0] == 'sharding'
+
+
+def test_asp_2_4_masks():
+    from paddle_tpu.incubate import asp
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    asp.prune_model(net)
+    w = net[0].weight
+    assert asp.check_sparsity(w)
+    # masks survive an optimizer step
+    opt = asp.decorate(
+        paddle.optimizer.SGD(learning_rate=0.1,
+                             parameters=net.parameters()), model=net)
+    loss = net(paddle.randn([4, 16])).sum()
+    loss.backward()
+    opt.step()
+    assert asp.check_sparsity(net[0].weight)

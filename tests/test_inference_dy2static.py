@@ -1,0 +1,74 @@
+"""Inference export (StableHLO AOT) + dy2static tests (reference patterns:
+save_inference_model round-trips; dygraph_to_static output-equality)."""
+import os
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.core.tensor import Tensor
+
+
+def test_save_load_inference_model():
+    from paddle_tpu.static.inference import (save_inference_model,
+                                             load_inference_model)
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 3))
+    x = paddle.randn([2, 4])
+    ref = net(x).numpy()
+    with tempfile.TemporaryDirectory() as tmp:
+        prefix = os.path.join(tmp, 'model')
+        save_inference_model(prefix, net, [x])
+        assert os.path.exists(prefix + '.stablehlo')
+        pred = load_inference_model(prefix)
+        out = pred.run(x)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_to_static_matches_eager():
+    paddle.seed(1)
+    net = nn.Sequential(nn.Linear(8, 8), nn.Tanh(), nn.Linear(8, 2))
+    x = paddle.randn([4, 8])
+    eager_out = net(x).numpy()
+    snet = paddle.jit.to_static(net)
+    static_out = snet(x)
+    np.testing.assert_allclose(static_out.numpy(), eager_out, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_to_static_function():
+    @paddle.jit.to_static
+    def f(a, b):
+        return paddle.tanh(a @ b) * 2
+    a = paddle.randn([3, 3])
+    b = paddle.randn([3, 3])
+    np.testing.assert_allclose(
+        f(a, b).numpy(),
+        np.tanh(a.numpy() @ b.numpy()) * 2, rtol=1e-5, atol=1e-6)
+
+
+def test_localsgd_gradient_merge():
+    from paddle_tpu.distributed.fleet.utils import LocalSGD, GradientMerge
+    paddle.seed(0)
+    net = nn.Linear(4, 2)
+    base = paddle.optimizer.SGD(learning_rate=0.1,
+                                parameters=net.parameters())
+    opt = LocalSGD(base, k_steps=2)
+    for _ in range(4):
+        net(paddle.randn([4, 4])).sum().backward()
+        opt.step()
+        opt.clear_grad()
+
+    net2 = nn.Linear(4, 2)
+    w0 = net2.weight.numpy().copy()
+    gm = GradientMerge(paddle.optimizer.SGD(learning_rate=0.1,
+                                            parameters=net2.parameters()),
+                       k_steps=2, avg=True)
+    x = paddle.ones([2, 4])
+    for i in range(2):
+        net2(x).sum().backward()
+        gm.step()
+    # after k=2 steps exactly one update with averaged grad happened
+    w1 = net2.weight.numpy()
+    assert not np.allclose(w0, w1)
