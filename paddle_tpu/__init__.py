@@ -108,3 +108,8 @@ def summary(net, input_size=None, dtypes=None):
 from . import text  # noqa: E402
 from . import profiler  # noqa: E402
 from . import models  # noqa: E402
+from .ops import fft  # noqa: E402
+from .ops.math import (  # noqa: E402
+    bincount, bucketize, searchsorted, take, tensordot, logcumsumexp,
+    renorm, diff, trapezoid, vander, angle, conj, polar, crop)
+from .core.flags import set_flags, get_flags  # noqa: E402

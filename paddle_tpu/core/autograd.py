@@ -273,6 +273,19 @@ def run_op(name, fn, tensor_args, static_kwargs=None, n_nondiff=0):
 
     multi = isinstance(out, (tuple, list))
     outs = list(out) if multi else [out]
+
+    # FLAGS_check_nan_inf: post-kernel scan (parity:
+    # details/nan_inf_utils_detail.cc:299 behind flags.cc:44), eager only.
+    from .flags import flag as _flag
+    if _flag('FLAGS_check_nan_inf') and \
+            not isinstance(outs[0], jax.core.Tracer):
+        for i, o in enumerate(outs):
+            if dtypes.is_floating(getattr(o, 'dtype', None) or o.dtype) and \
+                    bool(jnp.any(~jnp.isfinite(o))):
+                raise FloatingPointError(
+                    f"NaN or Inf found in output {i} of op '{name}' "
+                    "(FLAGS_check_nan_inf)")
+
     out_tensors = [Tensor(o, stop_gradient=not trace) for o in outs]
 
     if trace:

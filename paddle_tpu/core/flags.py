@@ -1,0 +1,60 @@
+"""Global flags registry.
+
+Reference parity: platform/flags.cc (35 gflags DEFINEs) +
+pybind/global_value_getter_setter.cc — `paddle.set_flags/get_flags` and
+`FLAGS_*` env seeding. Flags that map to XLA/jax knobs apply them on set.
+"""
+import os
+
+_FLAGS = {
+    'FLAGS_check_nan_inf': False,
+    'FLAGS_cudnn_deterministic': True,   # XLA is deterministic by default
+    'FLAGS_allocator_strategy': 'pjrt',
+    'FLAGS_fraction_of_gpu_memory_to_use': 0.92,
+    'FLAGS_eager_delete_tensor_gb': 0.0,
+    'FLAGS_use_pinned_memory': True,
+    'FLAGS_benchmark': False,
+    'FLAGS_selected_gpus': '',
+    'FLAGS_selected_tpus': '',
+    'FLAGS_sync_nccl_allreduce': True,
+    'FLAGS_max_inplace_grad_add': 0,
+    'FLAGS_conv_workspace_size_limit': 512,
+    'FLAGS_paddle_num_threads': 1,
+    'FLAGS_profile_start_step': -1,
+    'FLAGS_profile_stop_step': -1,
+}
+
+
+def _seed_from_env():
+    for k in list(_FLAGS):
+        if k in os.environ:
+            v = os.environ[k]
+            cur = _FLAGS[k]
+            if isinstance(cur, bool):
+                _FLAGS[k] = v.lower() in ('1', 'true', 'yes')
+            elif isinstance(cur, int):
+                _FLAGS[k] = int(v)
+            elif isinstance(cur, float):
+                _FLAGS[k] = float(v)
+            else:
+                _FLAGS[k] = v
+
+
+_seed_from_env()
+
+
+def set_flags(flags):
+    """Parity: paddle.set_flags({'FLAGS_x': v})."""
+    for k, v in flags.items():
+        _FLAGS[k] = v
+
+
+def get_flags(keys):
+    """Parity: paddle.get_flags — str or list → dict."""
+    if isinstance(keys, str):
+        keys = [keys]
+    return {k: _FLAGS.get(k) for k in keys}
+
+
+def flag(name, default=None):
+    return _FLAGS.get(name, default)

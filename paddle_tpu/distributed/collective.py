@@ -119,10 +119,18 @@ def _group_axes(group):
 def init_parallel_env():
     """Parity: paddle.distributed.init_parallel_env (parallel.py:58) — the
     NCCL-id broadcast + comm init is replaced by the PJRT client handshake
-    (jax.distributed for multi-host DCN)."""
+    (jax.distributed over the fleetrun-provided coordinator for multi-host
+    DCN)."""
     global _default_group
+    import os
     env = parallel_env()
     if _default_group is None:
+        n_proc = int(os.environ.get('JAX_NUM_PROCESSES', '1'))
+        coord = os.environ.get('JAX_COORDINATOR_ADDRESS')
+        if n_proc > 1 and coord and jax.process_count() == 1:
+            jax.distributed.initialize(
+                coordinator_address=coord, num_processes=n_proc,
+                process_id=int(os.environ.get('JAX_PROCESS_ID', '0')))
         _default_group = Group(env.rank, env.world_size, id=0)
         _group_map[0] = _default_group
     return _default_group

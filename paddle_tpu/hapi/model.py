@@ -22,15 +22,19 @@ class Model:
         self.stop_training = False
 
     def prepare(self, optimizer=None, loss=None, metrics=None,
-                amp_configs=None):
+                amp_configs=None, jit=False):
         self._optimizer = optimizer
         self._loss = loss
+        self._jit = jit
+        self._train_step = None
         if metrics is not None:
             self._metrics = metrics if isinstance(metrics, (list, tuple)) \
                 else [metrics]
 
     # -- single steps ---------------------------------------------------------
     def train_batch(self, inputs, labels=None, update=True):
+        if getattr(self, '_jit', False) and update:
+            return self._train_batch_jit(inputs, labels)
         self.network.train()
         inputs = self._to_list(inputs)
         labels = self._to_list(labels)
@@ -52,8 +56,37 @@ class Model:
         out_loss = [[float(np.asarray(l.data))] for l in loss_list]
         return (out_loss, metrics) if metrics else out_loss
 
+    def _train_batch_jit(self, inputs, labels):
+        """One fused XLA program per step (paddle_tpu.jit.TrainStep) — the
+        TPU-idiomatic fit loop."""
+        from ..jit import TrainStep
+        inputs = self._to_list(inputs)
+        labels = self._to_list(labels)
+        if self._train_step is None:
+            n_in = len(inputs)
+            loss_obj = self._loss
+
+            def loss_fn(model, *batch):
+                outs = model(*batch[:n_in])
+                outs = outs if isinstance(outs, (list, tuple)) else [outs]
+                losses = loss_obj(*(list(outs) + list(batch[n_in:])))
+                losses = losses if isinstance(losses, (list, tuple)) \
+                    else [losses]
+                total = losses[0]
+                for extra in losses[1:]:
+                    total = total + extra
+                return total
+            self.network.train()
+            self._train_step = TrainStep(self.network, loss_fn,
+                                         self._optimizer)
+        batch = [self._to_tensor(x) for x in inputs + labels]
+        loss = self._train_step(*batch)
+        return [[float(np.asarray(loss.data))]]
+
     @no_grad()
     def eval_batch(self, inputs, labels=None):
+        if getattr(self, '_train_step', None) is not None:
+            self._train_step.sync_model()  # pull jitted params into the layer
         self.network.eval()
         inputs = self._to_list(inputs)
         labels = self._to_list(labels)
@@ -169,6 +202,8 @@ class Model:
 
     # -- persistence ----------------------------------------------------------
     def save(self, path, training=True):
+        if getattr(self, '_train_step', None) is not None:
+            self._train_step.sync_model()
         framework.save(self.network.state_dict(), path + '.pdparams')
         if training and self._optimizer is not None:
             framework.save(self._optimizer.state_dict(), path + '.pdopt')

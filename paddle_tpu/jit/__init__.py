@@ -111,8 +111,12 @@ class TrainStep:
         self.optimizer = optimizer
         self._param_names = [n for n, p in _named_params(model)
                              if not p.stop_gradient]
-        self._params = get_params(model)
-        self._buffers = get_buffers(model)
+        # copies, not views: the compiled step DONATES these buffers and the
+        # eager layer must keep its own arrays alive for eval/save
+        self._params = {n: jnp.array(a, copy=True)
+                        for n, a in get_params(model).items()}
+        self._buffers = {n: jnp.array(a, copy=True)
+                         for n, a in get_buffers(model).items()}
         lookup = dict(_named_params(model))
         self._opt_states = {}
         for n in self._param_names:

@@ -436,3 +436,119 @@ def histogram(input, bins=100, min=0, max=0):
 
 def broadcast_shape(x_shape, y_shape):
     return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+# ---- tier-2 additions (Appendix B coverage) -------------------------------
+def bincount(x, weights=None, minlength=0):
+    x = as_tensor(x)
+    if weights is not None:
+        w = as_tensor(weights)
+        return Tensor(jnp.bincount(x.data.reshape(-1), w.data.reshape(-1),
+                                   minlength=minlength))
+    return Tensor(jnp.bincount(x.data.reshape(-1), minlength=minlength))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False):
+    x, s = as_tensor(x), as_tensor(sorted_sequence)
+    side = 'right' if right else 'left'
+    out = jnp.searchsorted(s.data, x.data, side=side)
+    return Tensor(out.astype(jnp.int32 if out_int32 else jnp.int64))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    return bucketize(values, sorted_sequence, out_int32, right)
+
+
+def take(x, index, mode='raise'):
+    x, index = as_tensor(x), as_tensor(index)
+    def fn(a, idx):
+        return jnp.take(a.reshape(-1), idx, mode='clip')
+    return run_op('take', fn, [x, index], n_nondiff=1)
+
+
+def tensordot(x, y, axes=2, name=None):
+    x, y = as_tensor(x), as_tensor(y)
+    return run_op('tensordot', lambda a, b: jnp.tensordot(a, b, axes=axes),
+                  [x, y])
+
+
+def logcumsumexp(x, axis=None, name=None):
+    x = as_tensor(x)
+    def fn(a):
+        if axis is None:
+            a = a.reshape(-1)
+            ax = 0
+        else:
+            ax = axis
+        m = jnp.max(a, axis=ax, keepdims=True)
+        return jnp.log(jnp.cumsum(jnp.exp(a - m), axis=ax)) + m
+    return run_op('logcumsumexp', fn, [x])
+
+
+def renorm(x, p, axis, max_norm):
+    x = as_tensor(x)
+    def fn(a):
+        moved = jnp.moveaxis(a, axis, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        norms = jnp.power(jnp.sum(jnp.power(jnp.abs(flat), p), axis=1),
+                          1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        out = flat * factor[:, None]
+        return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+    return run_op('renorm', fn, [x])
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None):
+    x = as_tensor(x)
+    pre = prepend.data if isinstance(prepend, Tensor) else prepend
+    app = append.data if isinstance(append, Tensor) else append
+    return run_op('diff', lambda a: jnp.diff(a, n=n, axis=axis, prepend=pre,
+                                             append=app), [x])
+
+
+def trapezoid(y, x=None, dx=None, axis=-1):
+    y = as_tensor(y)
+    if x is not None:
+        x = as_tensor(x)
+        return run_op('trapezoid',
+                      lambda a, b: jnp.trapezoid(a, b, axis=axis), [y, x])
+    return run_op('trapezoid',
+                  lambda a: jnp.trapezoid(a, dx=dx or 1.0, axis=axis), [y])
+
+
+def vander(x, n=None, increasing=False):
+    x = as_tensor(x)
+    return run_op('vander',
+                  lambda a: jnp.vander(a, N=n, increasing=increasing), [x])
+
+
+def angle(x, name=None):
+    x = as_tensor(x)
+    return run_op('angle', jnp.angle, [x])
+
+
+def conj(x, name=None):
+    x = as_tensor(x)
+    return run_op('conj', jnp.conj, [x])
+
+
+def polar(abs, angle):
+    abs, angle = as_tensor(abs), as_tensor(angle)
+    return run_op('polar',
+                  lambda r, t: r * jnp.exp(1j * t.astype(jnp.complex64)),
+                  [abs, angle])
+
+
+def crop(x, shape=None, offsets=None):
+    from . import manip
+    x = as_tensor(x)
+    shape_ = [x.shape[i] if s == -1 else s for i, s in enumerate(shape)]
+    offsets = offsets or [0] * x.ndim
+    axes = list(range(x.ndim))
+    starts = offsets
+    ends = [o + s for o, s in zip(offsets, shape_)]
+    return manip.slice(x, axes, starts, ends)
+
+
+def inner_outer_placeholder():
+    pass
