@@ -113,3 +113,4 @@ from .ops.math import (  # noqa: E402
     bincount, bucketize, searchsorted, take, tensordot, logcumsumexp,
     renorm, diff, trapezoid, vander, angle, conj, polar, crop)
 from .core.flags import set_flags, get_flags  # noqa: E402
+from . import distribution  # noqa: E402

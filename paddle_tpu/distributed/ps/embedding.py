@@ -71,6 +71,30 @@ def global_communicator():
     return _global_communicator
 
 
+class _RemoteTable:
+    """PsClient adapter with the NativeSparseTable surface."""
+
+    def __init__(self, client, table_id, dim):
+        self.client = client
+        self.table_id = table_id
+        self.dim = dim
+
+    def pull(self, ids):
+        return self.client.pull(self.table_id, ids, self.dim)
+
+    def push(self, ids, grads, lr):
+        self.client.push(self.table_id, ids, grads, lr)
+
+    def save(self, path):
+        self.client.save(self.table_id, path)
+
+    def load(self, path):
+        raise NotImplementedError("load via the server side")
+
+    def __len__(self):
+        return self.client.table_size(self.table_id)
+
+
 class DistributedEmbedding(Layer):
     """Sparse embedding backed by the host PS table.
 
@@ -81,12 +105,19 @@ class DistributedEmbedding(Layer):
 
     def __init__(self, embedding_dim, optimizer='adagrad', learning_rate=0.01,
                  init_range=0.05, num_shards=16, seed=0, a_sync=False,
-                 name=None):
+                 endpoints=None, table_id=0, name=None):
         super().__init__()
         self.embedding_dim = embedding_dim
-        self.table = NativeSparseTable(embedding_dim, num_shards=num_shards,
-                                       optimizer=optimizer,
-                                       init_range=init_range, seed=seed)
+        if endpoints:
+            # remote PS mode (parity: distributed_lookup_table →
+            # BrpcPsClient): pull/push go to the server fleet
+            from .service import PsClient
+            self.table = _RemoteTable(PsClient(endpoints), table_id,
+                                      embedding_dim)
+        else:
+            self.table = NativeSparseTable(
+                embedding_dim, num_shards=num_shards, optimizer=optimizer,
+                init_range=init_range, seed=seed)
         self.learning_rate = learning_rate
         self.a_sync = a_sync
         if a_sync:

@@ -1,22 +1,47 @@
-"""PS worker/server runtime (detailed implementation in ps/tables.py —
-reference: BrpcPsClient/Server, Communicator:197)."""
+"""PS worker/server runtime.
+
+Reference parity: TheOnePSRuntime's worker/server lifecycle over the
+service tier (BrpcPsServer/Client → distributed/ps/service.py).
+Table configs come from env (PADDLE_PS_TABLES="id:dim:opt,...") or
+defaults; server endpoint from PADDLE_CURRENT_ENDPOINT.
+"""
+import os
+
+
+def _table_configs():
+    spec = os.environ.get('PADDLE_PS_TABLES', '0:16:adagrad')
+    out = []
+    for part in spec.split(','):
+        tid, dim, opt = part.split(':')
+        out.append((int(tid), int(dim), opt))
+    return out
 
 
 class _Worker:
     def __init__(self, fleet_obj):
         self.fleet = fleet_obj
+        self.client = None
+        eps = fleet_obj.server_endpoints() if fleet_obj._role_maker else []
+        if eps:
+            from .service import PsClient
+            self.client = PsClient(eps)
 
     def stop(self):
-        pass
+        if self.client is not None:
+            self.client.close()
 
 
 class _Server:
     def __init__(self, fleet_obj):
-        self.fleet = fleet_obj
+        from .service import PsServer
+        ep = os.environ.get('PADDLE_CURRENT_ENDPOINT', '0.0.0.0:0')
+        port = int(ep.rsplit(':', 1)[1]) if ':' in ep else 0
+        self.server = PsServer(port=port)
+        for tid, dim, opt in _table_configs():
+            self.server.add_table(tid, dim, optimizer=opt)
 
     def run(self):
-        raise NotImplementedError(
-            "standalone PS server process lands with distributed/ps/tables")
+        self.server.run()
 
 
 def get_or_create_worker(fleet_obj):

@@ -1,0 +1,230 @@
+"""Parameter-server service: remote pull/push over TCP.
+
+Reference parity: paddle/fluid/distributed/service — BrpcPsServer/
+BrpcPsClient (sendrecv.proto dense/sparse push-pull) and the
+PsService RPC surface (N30). The transport is a compact binary protocol over
+TCP sockets; the table math stays in C++ (csrc/sparse_table.cc) on the
+server. Workers shard feature ids across servers by the same hash the
+tables use internally, so a multi-host deployment scales horizontally like
+the reference's PS cluster.
+
+Wire protocol (little-endian):
+  u8 op ('P' pull, 'U' push, 'S' save, 'L' load, 'N' size, 'Q' shutdown)
+  u32 table_id
+  P: u32 n, i64[n] ids                  -> f32[n*dim] rows
+  U: u32 n, f32 lr, i64[n] ids, f32[n*dim] grads -> u8 ok
+  S/L: u32 len, path bytes              -> u8 ok
+  N: -> i64 size
+"""
+import socket
+import struct
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from ...core.native import NativeSparseTable
+
+
+def _read_n(sock, n):
+    buf = b''
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+class PsServer:
+    """Parity: BrpcPsServer — hosts tables, serves pull/push."""
+
+    def __init__(self, host='0.0.0.0', port=0):
+        self.tables = {}
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self.port = self._sock.getsockname()[1]
+        self._running = False
+        self._threads = []
+
+    def add_table(self, table_id, dim, optimizer='adagrad', init_range=0.05,
+                  num_shards=16, seed=0):
+        """Parity: table config from the_one_ps proto."""
+        self.tables[table_id] = NativeSparseTable(
+            dim, num_shards=num_shards, optimizer=optimizer,
+            init_range=init_range, seed=seed)
+        return self.tables[table_id]
+
+    def start(self):
+        self._running = True
+        self._sock.listen(64)
+        self._accept_thread = threading.Thread(target=self._accept,
+                                               daemon=True)
+        self._accept_thread.start()
+        return self
+
+    def _accept(self):
+        while self._running:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t = threading.Thread(target=self._serve, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve(self, conn):
+        try:
+            while True:
+                op = _read_n(conn, 1)
+                if op == b'Q':
+                    conn.sendall(b'\x01')
+                    self.stop()
+                    return
+                (tid,) = struct.unpack('<I', _read_n(conn, 4))
+                table = self.tables[tid]
+                if op == b'P':
+                    (n,) = struct.unpack('<I', _read_n(conn, 4))
+                    ids = np.frombuffer(_read_n(conn, 8 * n), np.int64)
+                    rows = table.pull(ids)
+                    conn.sendall(rows.tobytes())
+                elif op == b'U':
+                    n, lr = struct.unpack('<If', _read_n(conn, 8))
+                    ids = np.frombuffer(_read_n(conn, 8 * n), np.int64)
+                    grads = np.frombuffer(
+                        _read_n(conn, 4 * n * table.dim),
+                        np.float32).reshape(n, table.dim)
+                    table.push(ids, grads, lr)
+                    conn.sendall(b'\x01')
+                elif op in (b'S', b'L'):
+                    (ln,) = struct.unpack('<I', _read_n(conn, 4))
+                    path = _read_n(conn, ln).decode()
+                    (table.save if op == b'S' else table.load)(path)
+                    conn.sendall(b'\x01')
+                elif op == b'N':
+                    conn.sendall(struct.pack('<q', len(table)))
+                else:
+                    return
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def run(self):
+        """Blocking serve (parity: fleet.run_server)."""
+        self.start()
+        self._accept_thread.join()
+
+    def stop(self):
+        self._running = False
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class PsClient:
+    """Parity: BrpcPsClient — shards requests across servers by id hash."""
+
+    def __init__(self, endpoints, timeout=60):
+        self._socks = []
+        self._locks = []
+        for ep in endpoints:
+            host, port = ep.rsplit(':', 1)
+            s = socket.create_connection((host, int(port)), timeout=timeout)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._socks.append(s)
+            self._locks.append(threading.Lock())
+        self.n_servers = len(self._socks)
+        # shard requests fan out concurrently (reference BrpcPsClient issues
+        # parallel RPCs; serial round-trips would scale latency with the
+        # server count)
+        self._pool = ThreadPoolExecutor(max_workers=min(self.n_servers, 16)) \
+            if self.n_servers > 1 else None
+
+    def _shard(self, ids):
+        return (ids.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15)
+                >> np.uint64(33)) % np.uint64(self.n_servers)
+
+    def _fanout(self, fn, shard_ids):
+        if self._pool is None or len(shard_ids) <= 1:
+            for s in shard_ids:
+                fn(s)
+            return
+        list(self._pool.map(fn, shard_ids))
+
+    def pull(self, table_id, ids, dim):
+        ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        out = np.empty((len(ids), dim), np.float32)
+        shards = self._shard(ids)
+
+        def one(s):
+            mask = shards == s
+            if not mask.any():
+                return
+            sub = ids[mask]
+            with self._locks[s]:
+                sock = self._socks[s]
+                sock.sendall(b'P' + struct.pack('<II', table_id, len(sub))
+                             + sub.tobytes())
+                rows = np.frombuffer(_read_n(sock, 4 * len(sub) * dim),
+                                     np.float32).reshape(len(sub), dim)
+            out[mask] = rows
+        self._fanout(one, range(self.n_servers))
+        return out
+
+    def push(self, table_id, ids, grads, lr):
+        ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        grads = np.ascontiguousarray(grads, np.float32)
+        shards = self._shard(ids)
+
+        def one(s):
+            mask = shards == s
+            if not mask.any():
+                return
+            sub = ids[mask]
+            sub_g = grads[mask]
+            with self._locks[s]:
+                sock = self._socks[s]
+                sock.sendall(b'U' + struct.pack('<IIf', table_id, len(sub),
+                                                lr)
+                             + sub.tobytes() + sub_g.tobytes())
+                _read_n(sock, 1)
+        self._fanout(one, range(self.n_servers))
+
+    def save(self, table_id, path):
+        for s in range(self.n_servers):
+            with self._locks[s]:
+                sock = self._socks[s]
+                p = f"{path}.part{s}".encode()
+                sock.sendall(b'S' + struct.pack('<II', table_id, len(p)) + p)
+                _read_n(sock, 1)
+
+    def table_size(self, table_id):
+        total = 0
+        for s in range(self.n_servers):
+            with self._locks[s]:
+                sock = self._socks[s]
+                sock.sendall(b'N' + struct.pack('<I', table_id))
+                (n,) = struct.unpack('<q', _read_n(sock, 8))
+            total += n
+        return total
+
+    def shutdown(self):
+        for s in range(self.n_servers):
+            try:
+                with self._locks[s]:
+                    self._socks[s].sendall(b'Q')
+                    _read_n(self._socks[s], 1)
+            except (ConnectionError, OSError):
+                pass
+
+    def close(self):
+        for s in self._socks:
+            try:
+                s.close()
+            except OSError:
+                pass

@@ -1,0 +1,125 @@
+"""paddle.distribution (parity: fluid/layers/distributions.py + the 2.x
+paddle.distribution package: Normal, Uniform, Categorical, Beta,
+Multinomial-lite)."""
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .core import rng as rng_mod
+from .core.tensor import Tensor
+from .ops.common import as_tensor
+
+
+class Distribution:
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def probs(self, value):
+        from .ops import math as M
+        return M.exp(self.log_prob(value))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = as_tensor(low)
+        self.high = as_tensor(high)
+
+    def sample(self, shape=(), seed=0):
+        key = rng_mod.next_key()
+        shp = tuple(shape) + tuple(self.low.shape)
+        u = jax.random.uniform(key, shp)
+        return Tensor(self.low.data + u * (self.high.data - self.low.data))
+
+    def log_prob(self, value):
+        value = as_tensor(value)
+        from .ops import math as M
+        inside = (value.data >= self.low.data) & (value.data < self.high.data)
+        lp = jnp.where(inside,
+                       -jnp.log(self.high.data - self.low.data), -jnp.inf)
+        return Tensor(lp)
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high.data - self.low.data))
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = as_tensor(loc)
+        self.scale = as_tensor(scale)
+
+    def sample(self, shape=(), seed=0):
+        key = rng_mod.next_key()
+        shp = tuple(shape) + tuple(self.loc.shape)
+        return Tensor(self.loc.data
+                      + self.scale.data * jax.random.normal(key, shp))
+
+    def log_prob(self, value):
+        value = as_tensor(value)
+        var = self.scale.data ** 2
+        return Tensor(-((value.data - self.loc.data) ** 2) / (2 * var)
+                      - jnp.log(self.scale.data)
+                      - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return Tensor(0.5 + 0.5 * math.log(2 * math.pi)
+                      + jnp.log(self.scale.data))
+
+    def kl_divergence(self, other):
+        var_ratio = (self.scale.data / other.scale.data) ** 2
+        t1 = ((self.loc.data - other.loc.data) / other.scale.data) ** 2
+        return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = as_tensor(logits)
+
+    def sample(self, shape=(), seed=0):
+        key = rng_mod.next_key()
+        batch = tuple(self.logits.shape[:-1])
+        shp = tuple(shape) + batch
+        return Tensor(jax.random.categorical(key, self.logits.data,
+                                             shape=shp or None))
+
+    def log_prob(self, value):
+        value = as_tensor(value)
+        logp = jax.nn.log_softmax(self.logits.data, axis=-1)
+        return Tensor(jnp.take_along_axis(
+            logp, value.data.astype(jnp.int32)[..., None], axis=-1)[..., 0])
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits.data, axis=-1)
+        p = jnp.exp(logp)
+        return Tensor(-jnp.sum(p * logp, axis=-1))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta):
+        self.alpha = as_tensor(alpha)
+        self.beta = as_tensor(beta)
+
+    def sample(self, shape=(), seed=0):
+        key = rng_mod.next_key()
+        shp = tuple(shape) + tuple(self.alpha.shape)
+        return Tensor(jax.random.beta(key, self.alpha.data, self.beta.data,
+                                      shp))
+
+    def log_prob(self, value):
+        from jax.scipy.special import betaln
+        v = as_tensor(value).data
+        a, b = self.alpha.data, self.beta.data
+        return Tensor((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v)
+                      - betaln(a, b))
+
+
+def kl_divergence(p, q):
+    if isinstance(p, Normal) and isinstance(q, Normal):
+        return p.kl_divergence(q)
+    raise NotImplementedError(type(p))

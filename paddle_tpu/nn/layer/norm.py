@@ -83,10 +83,66 @@ class BatchNorm3D(_BatchNormBase):
 
 
 class SyncBatchNorm(_BatchNormBase):
-    """Cross-replica BN. In eager single-process mode it degrades to local BN;
-    under a pjit'd train step the batch axis is globally sharded, so XLA's
-    partitioner already computes global moments — matching
-    sync_batch_norm_op.cu semantics without a custom kernel."""
+    """Cross-replica BN (parity: operators/sync_batch_norm_op.cu). Inside an
+    SPMD region, batch moments are psum-averaged over the data axes before
+    normalization, so every replica normalizes with GLOBAL statistics;
+    eagerly (one device) it degrades to local BN like the reference at
+    nranks==1."""
+
+    def forward(self, x):
+        from ...distributed import collective as C
+        if not (self.training and C.in_spmd_region()):
+            return super().forward(x)
+        from jax import lax
+        import jax.numpy as jnp
+        from ...core.autograd import run_op
+        axes = tuple(a for a in C.current_spmd_axes()
+                     if a in ('dp', 'sharding', 'sp'))
+        if not axes:
+            return super().forward(x)
+        eps = self._epsilon
+        ch_axis = 1 if self._data_format.startswith('NC') else x.ndim - 1
+        reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+        tensors = [x]
+        has_w = self.weight is not None
+        has_b = self.bias is not None
+        if has_w:
+            tensors.append(self.weight)
+        if has_b:
+            tensors.append(self.bias)
+
+        def fn(a, *wb):
+            af = a.astype(jnp.float32)
+            cnt = 1.0
+            for i in reduce_axes:
+                cnt = cnt * a.shape[i]
+            s1 = lax.psum(jnp.sum(af, axis=reduce_axes), axes)
+            s2 = lax.psum(jnp.sum(af * af, axis=reduce_axes), axes)
+            n = lax.psum(cnt, axes)
+            mean = s1 / n
+            var = s2 / n - mean * mean
+            shape = [1] * a.ndim
+            shape[ch_axis] = a.shape[ch_axis]
+            out = (af - mean.reshape(shape)) * lax.rsqrt(
+                var.reshape(shape) + eps)
+            out = out.astype(a.dtype)
+            i = 0
+            if has_w:
+                out = out * wb[i].reshape(shape)
+                i += 1
+            if has_b:
+                out = out + wb[i].reshape(shape)
+            return out, mean, var
+        out, mean, var = run_op('sync_batch_norm', fn, tensors)
+        # running stats track the GLOBAL moments (reference
+        # sync_batch_norm_op updates them with the cross-replica values);
+        # under TrainStep the buffer thread carries these, elsewhere
+        # bind_arrays restores originals.
+        m = self._momentum
+        self._mean.set_value(m * self._mean.data + (1 - m) * mean.data)
+        self._variance.set_value(m * self._variance.data
+                                 + (1 - m) * var.data)
+        return out
 
     @classmethod
     def convert_sync_batchnorm(cls, layer):

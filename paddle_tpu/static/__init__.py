@@ -16,6 +16,7 @@ from .executor import Executor, scope_guard, global_scope, Scope
 from .backward import append_backward, gradients
 from .nn import *  # noqa
 from . import nn
+from .control_flow import while_loop, cond, switch_case, case
 
 
 class BuildStrategy:

@@ -1,0 +1,114 @@
+"""Control-flow ops.
+
+Reference parity: operators/controlflow (while, conditional_block, select —
+N28) and the fluid.layers control_flow user API (While/cond/case/
+switch_case). TPU-native: these ARE lax.while_loop/cond/switch — compiled
+structured control flow instead of the reference's op-microkernel
+interpreters; they run eagerly too (lax executes op-by-op outside jit).
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor
+
+
+def _unbox(x):
+    return x.data if isinstance(x, Tensor) else x
+
+
+def _box(x):
+    return jax.tree_util.tree_map(
+        lambda a: Tensor(a) if not isinstance(a, Tensor) else a, x,
+        is_leaf=lambda a: not isinstance(a, (list, tuple, dict)))
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """Parity: paddle.static.nn.while_loop."""
+    def c(vs):
+        out = cond(*_rebox_args(vs))
+        return _unbox(out).reshape(())
+
+    def b(vs):
+        out = body(*_rebox_args(vs))
+        out = out if isinstance(out, (list, tuple)) else [out]
+        return [_unbox(o) for o in out]
+
+    def _rebox_args(vs):
+        return [Tensor(v) for v in vs]
+
+    res = lax.while_loop(c, b, [_unbox(v) for v in loop_vars])
+    return [Tensor(r) for r in res]
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """Parity: paddle.static.nn.cond (an omitted branch is a no-op
+    returning a zero scalar so both branches match structurally)."""
+    p = _unbox(pred)
+    true_fn = true_fn or (lambda: Tensor(jnp.asarray(0)))
+    false_fn = false_fn or (lambda: Tensor(jnp.asarray(0)))
+
+    def t(_):
+        out = true_fn()
+        return jax.tree_util.tree_map(
+            _unbox, out, is_leaf=lambda a: isinstance(a, Tensor))
+
+    def f(_):
+        out = false_fn()
+        return jax.tree_util.tree_map(
+            _unbox, out, is_leaf=lambda a: isinstance(a, Tensor))
+
+    res = lax.cond(p.reshape(()), t, f, 0)
+    return jax.tree_util.tree_map(
+        lambda a: Tensor(a), res,
+        is_leaf=lambda a: not isinstance(a, (list, tuple, dict)))
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Parity: paddle.static.nn.switch_case — branch keys are the DECLARED
+    indices (dict keys or (index, fn) pairs); unmatched keys route to
+    `default` (or the last branch when default is None, as in paddle)."""
+    idx = _unbox(branch_index).reshape(()).astype(jnp.int32)
+    if isinstance(branch_fns, dict):
+        pairs = sorted(branch_fns.items())
+    elif branch_fns and isinstance(branch_fns[0], (tuple, list)):
+        pairs = [(int(k), f) for k, f in branch_fns]
+    else:
+        pairs = list(enumerate(branch_fns))
+    keys = jnp.asarray([k for k, _ in pairs], jnp.int32)
+    fns = [f for _, f in pairs]
+    if default is None:
+        default = fns[-1]
+    fns = fns + [default]
+    default_pos = len(fns) - 1
+    # exact-match key → position; miss → default
+    matches = (keys == idx)
+    pos = jnp.where(jnp.any(matches),
+                    jnp.argmax(matches).astype(jnp.int32),
+                    jnp.asarray(default_pos, jnp.int32))
+
+    def wrap(f):
+        return lambda _: jax.tree_util.tree_map(
+            _unbox, f(), is_leaf=lambda a: isinstance(a, Tensor))
+
+    res = lax.switch(pos, [wrap(f) for f in fns], 0)
+    return jax.tree_util.tree_map(
+        lambda a: Tensor(a), res,
+        is_leaf=lambda a: not isinstance(a, (list, tuple, dict)))
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """Parity: paddle.static.nn.case — first true predicate wins; with no
+    default the LAST fn is the fallback (paddle semantics; lax.cond traces
+    both branches so the fallback must be a callable, never a raise)."""
+    pairs = list(pred_fn_pairs)
+    if default is None:
+        default = pairs[-1][1]
+        pairs = pairs[:-1]
+
+    def build(i):
+        if i >= len(pairs):
+            return default()
+        pred, fn = pairs[i]
+        return cond(pred, fn, lambda: build(i + 1))
+    return build(0)

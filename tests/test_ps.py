@@ -68,3 +68,42 @@ def test_async_communicator_flush():
     after = emb.table.pull(np.arange(32))
     np.testing.assert_allclose(after, before - 1.0, rtol=1e-5)
     global_communicator().stop()
+
+
+def test_ps_service_remote_pull_push():
+    """BrpcPsClient/Server pattern: 2 servers, sharded ids, remote grads."""
+    from paddle_tpu.distributed.ps.service import PsServer, PsClient
+    s1 = PsServer().start()
+    s2 = PsServer().start()
+    for s in (s1, s2):
+        s.add_table(0, dim=8, optimizer='sgd', seed=1)
+    client = PsClient([f'127.0.0.1:{s1.port}', f'127.0.0.1:{s2.port}'])
+    ids = np.arange(100, dtype=np.int64)
+    rows = client.pull(0, ids, 8)
+    assert rows.shape == (100, 8)
+    # determinism: re-pull matches
+    np.testing.assert_allclose(client.pull(0, ids, 8), rows)
+    # push grads of ones with lr 0.5 → rows drop by 0.5
+    client.push(0, ids, np.ones((100, 8), np.float32), lr=0.5)
+    after = client.pull(0, ids, 8)
+    np.testing.assert_allclose(after, rows - 0.5, rtol=1e-5)
+    assert client.table_size(0) == 100
+    client.shutdown()
+    client.close()
+
+
+def test_wide_deep_remote_ps():
+    """Wide&Deep with REMOTE embedding tables (the full PS deployment
+    shape, in-process servers)."""
+    from paddle_tpu.distributed.ps.service import PsServer
+    from paddle_tpu.distributed.ps.embedding import DistributedEmbedding
+    server = PsServer().start()
+    server.add_table(0, dim=8, optimizer='adagrad')
+    emb = DistributedEmbedding(8, endpoints=[f'127.0.0.1:{server.port}'],
+                               table_id=0, learning_rate=0.1)
+    ids = Tensor(np.array([[1, 2], [3, 1]], np.int64))
+    out = emb(ids)
+    assert out.shape == [2, 2, 8]
+    paddle.sum(out).backward()
+    assert len(emb) == 3
+    server.stop()
