@@ -1,5 +1,6 @@
 """paddle_tpu.utils — misc utilities (parity: python/paddle/utils)."""
 from . import download
+from . import cpp_extension
 from . import unique_name
 from ..core.tensor import Tensor
 

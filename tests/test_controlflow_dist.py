@@ -1,0 +1,79 @@
+"""Control-flow + distribution + hapi-jit regression tests."""
+import numpy as np
+import jax
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.core.tensor import Tensor
+
+
+def test_while_cond_switch_case():
+    i = paddle.to_tensor(0)
+    s = paddle.to_tensor(0)
+    out = paddle.static.while_loop(lambda i, s: i < 5,
+                                   lambda i, s: [i + 1, s + i], [i, s])
+    assert int(out[1]) == 10
+    assert float(paddle.static.cond(paddle.to_tensor(True),
+                                    lambda: paddle.to_tensor(1.0),
+                                    lambda: paddle.to_tensor(2.0))) == 1.0
+    # declared-index branches + default routing
+    assert float(paddle.static.switch_case(
+        paddle.to_tensor(2),
+        {1: lambda: paddle.to_tensor(10.0),
+         3: lambda: paddle.to_tensor(30.0)},
+        default=lambda: paddle.to_tensor(-1.0))) == -1.0
+    assert float(paddle.static.switch_case(
+        paddle.to_tensor(1),
+        [(1, lambda: paddle.to_tensor(100.0)),
+         (2, lambda: paddle.to_tensor(200.0))])) == 100.0
+    # case without default: last fn is fallback
+    assert float(paddle.static.case(
+        [(paddle.to_tensor(False), lambda: paddle.to_tensor(1.0)),
+         (paddle.to_tensor(False), lambda: paddle.to_tensor(2.0))])) == 2.0
+
+
+def test_distributions():
+    paddle.seed(0)
+    d = paddle.distribution.Normal(0.0, 2.0)
+    s = d.sample([2000])
+    assert abs(float(s.numpy().std()) - 2.0) < 0.15
+    np.testing.assert_allclose(float(d.entropy()),
+                               0.5 + 0.5 * np.log(2 * np.pi) + np.log(2.0),
+                               rtol=1e-5)
+    c = paddle.distribution.Categorical(
+        paddle.to_tensor(np.zeros((4, 5), 'float32')))
+    assert c.sample((10,)).shape == [10, 4]
+    lp = c.log_prob(paddle.to_tensor(np.zeros((4,), 'int64')))
+    np.testing.assert_allclose(lp.numpy(), np.log(0.2), rtol=1e-5)
+    u = paddle.distribution.Uniform(0.0, 4.0)
+    assert float(u.entropy()) == np.log(4.0).astype('float32')
+    kl = paddle.distribution.kl_divergence(
+        paddle.distribution.Normal(0.0, 1.0),
+        paddle.distribution.Normal(0.0, 1.0))
+    assert abs(float(kl)) < 1e-6
+
+
+def test_hapi_jit_fit_eval():
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.vision.datasets import MNIST
+    paddle.seed(0)
+    net = nn.Sequential(nn.Flatten(), nn.Linear(784, 32), nn.ReLU(),
+                        nn.Linear(32, 10))
+    m = Model(net)
+    m.prepare(optimizer=paddle.optimizer.Adam(learning_rate=1e-3,
+                                              parameters=net.parameters()),
+              loss=nn.CrossEntropyLoss(), jit=True)
+    m.fit(MNIST(mode='train'), epochs=1, batch_size=64, verbose=0,
+          num_iters=8)
+    res = m.evaluate(MNIST(mode='test'), batch_size=128, verbose=0)
+    assert np.isfinite(res['loss'])
+
+
+def test_flags_nan_check():
+    paddle.set_flags({'FLAGS_check_nan_inf': True})
+    try:
+        import pytest
+        with pytest.raises(FloatingPointError):
+            paddle.log(paddle.to_tensor([-1.0]))
+    finally:
+        paddle.set_flags({'FLAGS_check_nan_inf': False})
