@@ -127,7 +127,11 @@ def init_parallel_env():
     if _default_group is None:
         n_proc = int(os.environ.get('JAX_NUM_PROCESSES', '1'))
         coord = os.environ.get('JAX_COORDINATOR_ADDRESS')
-        if n_proc > 1 and coord and jax.process_count() == 1:
+        # NB: do not probe jax.process_count() here — it initializes the
+        # backend, after which distributed.initialize refuses to run.
+        from jax._src import distributed as _jd
+        already = getattr(_jd.global_state, 'client', None) is not None
+        if n_proc > 1 and coord and not already:
             jax.distributed.initialize(
                 coordinator_address=coord, num_processes=n_proc,
                 process_id=int(os.environ.get('JAX_PROCESS_ID', '0')))

@@ -71,13 +71,17 @@ def _my_ip():
 
 def _trainer_env(args, endpoints):
     env = dict(os.environ)
+    host, port = args.master.rsplit(':', 1)
+    # the jax.distributed coordinator gets its own port — the master port
+    # itself is the rendezvous TCP store
+    coord = f"{host}:{int(port) + 977}"
     env.update({
         'PADDLE_TRAINER_ID': str(args.node_rank),
         'PADDLE_TRAINERS_NUM': str(args.nnodes),
         'PADDLE_CURRENT_ENDPOINT': endpoints[args.node_rank],
         'PADDLE_TRAINER_ENDPOINTS': ','.join(endpoints),
         # PJRT multi-host handshake (jax.distributed)
-        'JAX_COORDINATOR_ADDRESS': args.master,
+        'JAX_COORDINATOR_ADDRESS': coord,
         'JAX_NUM_PROCESSES': str(args.nnodes),
         'JAX_PROCESS_ID': str(args.node_rank),
     })

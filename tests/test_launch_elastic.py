@@ -111,3 +111,54 @@ def test_elastic_manager_membership():
     m1.exit()
     c2.close()
     master.close()
+
+
+def test_multihost_world_via_fleetrun():
+    """The full DCN deployment shape: two fleetrun pods rendezvous over the
+    TCP store, form ONE jax.distributed world (2 procs x 4 virtual chips),
+    and run a cross-process psum (reference: multi-node NCCL world; here
+    PJRT multi-controller)."""
+    with tempfile.TemporaryDirectory() as tmp:
+        script = os.path.join(tmp, 'train.py')
+        with open(script, 'w') as f:
+            f.write(f'''
+import sys, os
+sys.path.insert(0, {REPO!r})
+os.environ['XLA_FLAGS'] = '--xla_force_host_platform_device_count=4'
+os.environ['JAX_PLATFORMS'] = 'cpu'
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import paddle_tpu as paddle
+paddle.distributed.init_parallel_env()
+assert jax.process_count() == 2
+assert jax.device_count() == 8
+import numpy as np_, jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+from jax.experimental import multihost_utils
+mesh = Mesh(np_.array(jax.devices()).reshape(8), ('dp',))
+arr = multihost_utils.host_local_array_to_global_array(
+    np_.full((4, 1), float(os.environ['PADDLE_TRAINER_ID']) + 1.0,
+             np_.float32), mesh, P('dp'))
+out = jax.jit(shard_map(lambda x: jax.lax.psum(x, 'dp'), mesh=mesh,
+                        in_specs=P('dp'), out_specs=P('dp')))(arr)
+local = multihost_utils.global_array_to_host_local_array(out, mesh,
+                                                         P('dp'))
+assert float(np_.asarray(local.addressable_data(0))[0, 0]) == 12.0
+print('MULTIHOST_OK', flush=True)
+''')
+        port = 18400 + np.random.RandomState().randint(400)
+        # strip the axon sitecustomize so jax.distributed owns backend init
+        env = {**os.environ, 'PYTHONPATH': REPO}
+        procs = []
+        for rank in (1, 0):
+            procs.append(subprocess.Popen(
+                [sys.executable, '-m', 'paddle_tpu.distributed.launch',
+                 '--nnodes', '2', '--node_rank', str(rank),
+                 '--master', f'127.0.0.1:{port}', script],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, cwd=REPO, env=env))
+        outs = [p.communicate(timeout=120)[0] for p in procs]
+        for p, o in zip(procs, outs):
+            assert 'MULTIHOST_OK' in o, o[-800:]
+            assert p.returncode == 0
