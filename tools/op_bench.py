@@ -1,0 +1,112 @@
+"""Op micro-benchmark harness.
+
+Reference parity: paddle/fluid/operators/benchmark/op_tester.cc +
+tools/test_op_benchmark.sh (the op-benchmark CI gate). Times the hot ops
+from the BASELINE list on the current device and emits JSON for regression
+comparison: python tools/op_bench.py [--repeat N] [--out FILE].
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def bench_one(make, repeat):
+    """Chain `repeat` executions inside one jit via lax.scan and fetch a
+    scalar — on tunneled devices block_until_ready alone is not a reliable
+    sync, and independent dispatches can overlap or dedupe. Numbers are
+    conservative upper bounds (the chain serializes iterations and adds a
+    full-output reduction per step)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    fn, args = make()
+
+    def many(*a):
+        def body(carry, i):
+            a0 = a[0] + (carry * 1e-30).astype(a[0].dtype)
+            out = fn(a0, *a[1:])
+            leaf = jax.tree_util.tree_leaves(out)[0]
+            # full-output reduction: keeps XLA from dead-code-eliminating
+            # any of the op's work
+            return carry + jnp.sum(leaf.astype(jnp.float32)), None
+        c, _ = lax.scan(body, jnp.asarray(0.0, jnp.float32),
+                        jnp.arange(repeat))
+        return c
+
+    jfn = jax.jit(many)
+    float(jfn(*args))  # compile + warm
+    t0 = time.time()
+    float(jfn(*args))
+    return (time.time() - t0) / repeat * 1e3
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument('--repeat', type=int, default=20)
+    p.add_argument('--out', default=None)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+
+    def t(*shape, dtype=jnp.bfloat16):
+        return jnp.asarray(rng.randn(*shape).astype('float32')).astype(dtype)
+
+    def flash():
+        from paddle_tpu.ops.pallas.flash_attention import flash_attention_bhld
+        return flash_attention_bhld, (t(8, 2048, 128), t(8, 2048, 128),
+                                      t(8, 2048, 128))
+
+    def conv():
+        f = lambda x, w: jax.lax.conv_general_dilated(
+            x, w, (1, 1), 'SAME', dimension_numbers=('NCHW', 'OIHW', 'NCHW'))
+        return f, (t(32, 256, 56, 56), t(256, 256, 3, 3))
+
+    def swce():
+        def f(lg, lb):
+            return -jnp.take_along_axis(jax.nn.log_softmax(lg, axis=-1),
+                                        lb[:, None], axis=-1).mean()
+        return f, (t(512, 50304, dtype=jnp.float32),
+                   jnp.asarray(rng.randint(0, 50304, 512)))
+
+    def adamw():
+        def f(p_, g, m1, m2):
+            m1n = 0.9 * m1 + 0.1 * g
+            m2n = 0.999 * m2 + 0.001 * g * g
+            return p_ - 1e-4 * m1n / (jnp.sqrt(m2n) + 1e-8), m1n, m2n
+        shape = (125_000_000 // 8, 8)
+        return f, tuple(t(*shape, dtype=jnp.float32) for _ in range(4))
+
+    cases = {
+        'matmul_4kx4k_bf16':
+            lambda: (lambda a, b: a @ b, (t(4096, 4096), t(4096, 4096))),
+        'conv2d_256x56x56_3x3': conv,
+        'layer_norm_8x2048x4096':
+            lambda: (lambda x: jax.nn.standardize(x, axis=-1),
+                     (t(8, 2048, 4096),)),
+        'softmax_ce_512x50k': swce,
+        'flash_attention_8x2048x128': flash,
+        'adamw_update_125m': adamw,
+    }
+    results = {}
+    for name, make in cases.items():
+        try:
+            results[name] = round(bench_one(make, args.repeat), 3)
+        except Exception as e:
+            results[name] = f"ERROR: {type(e).__name__}"
+    out = json.dumps({'unit': 'ms', 'results': results}, indent=1)
+    print(out)
+    if args.out:
+        with open(args.out, 'w') as f:
+            f.write(out)
+
+
+if __name__ == '__main__':
+    main()
