@@ -114,3 +114,4 @@ from .ops.math import (  # noqa: E402
     renorm, diff, trapezoid, vander, angle, conj, polar, crop)
 from .core.flags import set_flags, get_flags  # noqa: E402
 from . import distribution  # noqa: E402
+from . import regularizer  # noqa: E402

@@ -260,6 +260,37 @@ class GPTForCausalLM(nn.Layer):
         logits = M.matmul(hidden, w, transpose_y=True)
         return logits  # class dim vocab-parallel under mp
 
+    def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
+                 top_k=0, eos_token_id=None):
+        """Greedy / top-k sampling decode (parity role: the beam_search/
+        sampling ops tier; full-context re-forward per token — the KV-cached
+        decode path is the inference engine's job)."""
+        import numpy as np_
+        from ..core import rng as rng_mod
+        from ..core.autograd import no_grad
+        ids = np_.asarray(input_ids.data if isinstance(input_ids, Tensor)
+                          else input_ids)
+        with no_grad():
+            for _ in range(max_new_tokens):
+                window = ids[:, -self.config.max_seq_len:]
+                logits = self(Tensor(window.astype('int32')))
+                step = np_.asarray(logits.data)[:, -1, :] / max(temperature,
+                                                                1e-6)
+                if top_k and top_k > 0:
+                    kth = np_.sort(step, axis=-1)[:, -top_k][:, None]
+                    step = np_.where(step < kth, -1e30, step)
+                    z = step - step.max(-1, keepdims=True)
+                    p = np_.exp(z) / np_.exp(z).sum(-1, keepdims=True)
+                    nxt = np_.asarray(
+                        [np_.random.choice(p.shape[-1], p=row)
+                         for row in p])
+                else:
+                    nxt = step.argmax(-1)
+                ids = np_.concatenate([ids, nxt[:, None]], axis=1)
+                if eos_token_id is not None and (nxt == eos_token_id).all():
+                    break
+        return Tensor(ids)
+
 
 class GPTPretrainingCriterion(nn.Layer):
     """Parity: vocab-parallel softmax CE loss with mean over tokens."""

@@ -164,7 +164,13 @@ class Optimizer:
                 else g.data
             plr = lr * getattr(p, 'optimize_attr',
                                {'learning_rate': 1.0})['learning_rate']
-            if self._weight_decay and self._decay_into_grad():
+            reg = getattr(p, 'regularizer', None)
+            if reg is not None:
+                # per-param regularizer (ParamAttr.regularizer) takes
+                # precedence over the optimizer-level weight_decay, matching
+                # the reference's append_regularization_ops rule
+                garr = garr + reg(master)
+            elif self._weight_decay and self._decay_into_grad():
                 garr = garr + self._weight_decay * master
             new_p, new_state = self.update(master, garr, state, plr)
             self._accumulators[key] = new_state

@@ -1,0 +1,31 @@
+"""paddle.regularizer (parity: python/paddle/regularizer.py — L1Decay/
+L2Decay applied per-param via ParamAttr.regularizer or optimizer
+weight_decay)."""
+
+
+class WeightDecayRegularizer:
+    def __call__(self, param_array):
+        raise NotImplementedError
+
+
+class L2Decay(WeightDecayRegularizer):
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    def __call__(self, param_array):
+        return self._coeff * param_array
+
+    def __repr__(self):
+        return f"L2Decay({self._coeff})"
+
+
+class L1Decay(WeightDecayRegularizer):
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    def __call__(self, param_array):
+        import jax.numpy as jnp
+        return self._coeff * jnp.sign(param_array)
+
+    def __repr__(self):
+        return f"L1Decay({self._coeff})"
