@@ -89,15 +89,51 @@ class PipelineParallel(MetaParallelBase):
 
     def _train_batch_spmd(self, data, optimizer, lr_scheduler=None,
                           scaler=None):
-        from .spmd_pipeline import SpmdPipelineEngine
+        if scaler is not None and scaler.is_enable():
+            raise NotImplementedError(
+                "GradScaler with pp_degree>1: bf16 training needs no loss "
+                "scaling on TPU; fp16 scaling inside the SPMD pipeline is "
+                "not implemented")
+        from .spmd_pipeline import engine_from_pipeline_layer
         if self._spmd_engine is None:
-            self._spmd_engine = SpmdPipelineEngine(
-                self._layers, self._hcg, self.accumulate_steps,
-                self.micro_batch_size, optimizer)
+            inner = getattr(optimizer, '_inner_opt', optimizer)
+            self._spmd_engine = engine_from_pipeline_layer(
+                self._layers, inner, self.accumulate_steps)
+        inputs = data[0]
+        n = (inputs.shape[0] if hasattr(inputs, 'shape')
+             else len(inputs))
+        dp = self._hcg.get_data_parallel_world_size()
+        expect = dp * self.accumulate_steps * self.micro_batch_size
+        if n != expect:
+            raise ValueError(
+                f"batch size {n} != dp({dp}) x accumulate_steps"
+                f"({self.accumulate_steps}) x micro_batch_size"
+                f"({self.micro_batch_size}); adjust pipeline_configs")
         loss = self._spmd_engine.train_batch(data)
         if lr_scheduler is not None:
             lr_scheduler.step()
         return loss
+
+    def sync_model(self):
+        """Pull the engine's trained weights back into the full-model
+        layers the engine was built from (state_dict()/eval_batch read
+        through these)."""
+        if self._spmd_engine is not None:
+            self._spmd_engine.sync_model()
+
+    def state_dict(self, *args, **kwargs):
+        if self._spmd_engine is not None:
+            self._spmd_engine.sync_model()
+            sd = {}
+            for n, p in self._spmd_engine.embed.named_parameters():
+                sd[f"embed.{n}"] = p
+            for i, b in enumerate(self._spmd_engine.blocks):
+                for n, p in b.named_parameters():
+                    sd[f"blocks.{i}.{n}"] = p
+            for n, p in self._spmd_engine.head.named_parameters():
+                sd[f"head.{n}"] = p
+            return sd
+        return self._layers.state_dict(*args, **kwargs)
 
     def eval_batch(self, data, compute_loss=False):
         self._layers.eval()

@@ -53,6 +53,103 @@ def _spec_for(p, axes, extra_leading_pp=False):
     return P(*spec)
 
 
+from ....nn.layer.base import Layer as _Layer
+from ....nn.layer.container import LayerList as _LayerList
+
+
+class _FnLayer(_Layer):
+    """Parameterless adapter for plain-callable pipeline descs."""
+
+    def __init__(self, fn):
+        super().__init__()
+        self._fn = fn
+
+    def forward(self, *args):
+        return self._fn(*args)
+
+
+class _HeadWrapper(_Layer):
+    """Adapts (tail layers + loss_fn) into the engine's head(hidden, labels)
+    contract. A loss_fn that owns trainable parameters must itself be an
+    nn.Layer (so the engine can lift them); a plain closure capturing
+    parameters would silently bake them as compile-time constants."""
+
+    def __init__(self, tail_layers, loss_fn):
+        super().__init__()
+        self.tail = _LayerList([
+            t if isinstance(t, _Layer) else _FnLayer(t)
+            for t in tail_layers])
+        if isinstance(loss_fn, _Layer):
+            self.loss_layer = loss_fn
+            self._loss_call = loss_fn
+        else:
+            self._loss_call = loss_fn
+
+    def forward(self, hidden, labels):
+        x = hidden
+        for layer in self.tail:
+            x = layer(x)
+        return self._loss_call(x, labels)
+
+
+def engine_from_pipeline_layer(pipeline_layer, optimizer, accumulate_steps,
+                               mesh=None, use_remat=True):
+    """Build a SpmdPipelineEngine from a PipelineLayer's descs (parity: the
+    dygraph PipelineParallel engine construction from pp_layers).
+
+    Convention: desc[0] is the embedding/input stage, the trailing
+    non-uniform descs (e.g. final norm) plus the PipelineLayer's loss_fn
+    form the head, and the uniform middle run becomes the stacked blocks.
+    """
+    funcs, shared = pipeline_layer.build_full_model()
+    if pipeline_layer._loss_fn is None:
+        raise ValueError("PipelineLayer needs loss_fn for SPMD training")
+    if len(funcs) < 2:
+        raise ValueError("pipeline model too small to split: need "
+                         "embed + blocks")
+    # Tied weights across segments would silently untie here (embed and head
+    # trees get independent arrays) — refuse rather than train a wrong
+    # parameterization. Untied heads (GPTLMHead pattern) are the supported
+    # shape; single-segment sharing is fine.
+    uses = {}
+    for f in funcs:
+        for key, layer in shared.items():
+            if f is layer or getattr(f, 'func', None) is layer \
+                    or getattr(f, '__self__', None) is layer:
+                uses[key] = uses.get(key, 0) + 1
+    multi = [k for k, c in uses.items() if c > 1]
+    if multi:
+        raise NotImplementedError(
+            f"SharedLayerDesc keys {multi} are used by multiple pipeline "
+            "segments; cross-stage weight tying is not supported by the "
+            "SPMD pipeline engine yet — use an untied head "
+            "(e.g. models.gpt.GPTLMHead / build_gpt_pipeline)")
+
+    embed = funcs[0]
+
+    def sig(layer):
+        if not hasattr(layer, 'named_parameters'):
+            return None
+        return tuple(sorted((n, tuple(p.shape))
+                            for n, p in layer.named_parameters())) or None
+
+    # find the maximal uniform run starting at funcs[1]
+    base = sig(funcs[1]) if len(funcs) > 1 else None
+    if base is None:
+        raise ValueError("desc[1] must be the first transformer block (a "
+                         "Layer with parameters); got "
+                         f"{type(funcs[1]).__name__}")
+    end = 1
+    while end < len(funcs) and sig(funcs[end]) == base:
+        end += 1
+    blocks = funcs[1:end]
+    tail = funcs[end:]
+    head = _HeadWrapper(tail, pipeline_layer._loss_fn)
+    return SpmdPipelineEngine(embed, blocks, head, optimizer,
+                              accumulate_steps, mesh=mesh,
+                              use_remat=use_remat)
+
+
 class SpmdPipelineEngine:
     """Pipelined hybrid train step.
 
