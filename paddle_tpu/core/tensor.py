@@ -200,10 +200,11 @@ class Tensor:
         return bool(self._data)
 
     def __int__(self):
-        return int(self._data)
+        # paddle semantics: any size-1 tensor converts
+        return int(self._data.item())
 
     def __float__(self):
-        return float(self._data)
+        return float(self._data.item())
 
     def __hash__(self):
         return id(self)
@@ -293,6 +294,20 @@ def _install_operators():
     for name, fn in method_table.items():
         if fn is not None:
             setattr(Tensor, name, fn)
+
+    # paddle's inplace-suffixed variants: compute then overwrite storage
+    def make_inplace(f):
+        def impl(self, *a, **k):
+            out = f(self, *a, **k)
+            self._data = out.data
+            return self
+        return impl
+    for name in ('exp', 'sqrt', 'rsqrt', 'reciprocal', 'tanh', 'sigmoid',
+                 'abs', 'floor', 'ceil', 'round', 'clip', 'scale',
+                 'reshape', 'squeeze', 'unsqueeze', 'flatten'):
+        base = method_table.get(name) or getattr(Tensor, name, None)
+        if base is not None and not hasattr(Tensor, name + '_'):
+            setattr(Tensor, name + '_', make_inplace(base))
 
 
 def to_tensor(data, dtype=None, place=None, stop_gradient=True):

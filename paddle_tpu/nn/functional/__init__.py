@@ -19,8 +19,12 @@ from ...ops.nn_ops import (  # explicit for linters
     affine_grid, fused_softmax_mask_upper_triangle, temporal_shift,
     npair_loss, one_hot, sequence_mask,
 )
+from ...ops.nn_ops import (  # noqa
+    triplet_margin_loss, cosine_embedding_loss, soft_margin_loss,
+    multi_margin_loss, ctc_loss, glu, pairwise_distance, pixel_unshuffle,
+    channel_shuffle, fold)
 from ...ops.math import sigmoid, tanh  # noqa
-from ...ops.manip import pad  # noqa
+from ...ops.manip import pad, pixel_shuffle  # noqa
 
 
 def diag_embed(*a, **k):

@@ -950,3 +950,174 @@ def sequence_mask(lengths, maxlen=None, dtype='int64'):
     out = out.reshape(tuple(lengths.shape) + (ml,))
     from ..core import dtypes as _dt
     return Tensor(out.astype(_dt.convert_dtype(dtype)))
+
+
+# ---- loss/functional long tail --------------------------------------------
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction='mean'):
+    input = as_tensor(input)
+    positive = as_tensor(positive, ref=input)
+    negative = as_tensor(negative, ref=input)
+    def fn(a, pos, neg):
+        def dist(x, y):
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(x - y) + epsilon, p),
+                                     axis=-1), 1.0 / p)
+        d_pos = dist(a, pos)
+        d_neg = dist(a, neg)
+        if swap:
+            d_neg = jnp.minimum(d_neg, dist(pos, neg))
+        return _reduce_loss(jnp.maximum(d_pos - d_neg + margin, 0.0),
+                            reduction)
+    return run_op('triplet_margin_loss', fn, [input, positive, negative])
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction='mean'):
+    input1 = as_tensor(input1)
+    input2 = as_tensor(input2, ref=input1)
+    label = as_tensor(label)
+    def fn(a, b, l):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(l > 0, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce_loss(loss, reduction)
+    return run_op('cosine_embedding_loss', fn, [input1, input2, label],
+                  n_nondiff=1)
+
+
+def soft_margin_loss(input, label, reduction='mean'):
+    input = as_tensor(input)
+    label = as_tensor(label, ref=input)
+    return run_op('soft_margin_loss',
+                  lambda a, l: _reduce_loss(jnp.log1p(jnp.exp(-l * a)),
+                                            reduction), [input, label])
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction='mean'):
+    input = as_tensor(input)
+    label = as_tensor(label)
+    def fn(a, l):
+        n, c = a.shape
+        correct = jnp.take_along_axis(a, l[:, None].astype(jnp.int32),
+                                      axis=1)
+        loss = jnp.power(jnp.maximum(margin - correct + a, 0.0), p)
+        mask = jax.nn.one_hot(l, c) == 0
+        return _reduce_loss(jnp.sum(loss * mask, 1) / c, reduction)
+    return run_op('multi_margin_loss', fn, [input, label], n_nondiff=1)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction='mean'):
+    """Parity: operators/warpctc_op — CTC via dynamic programming in
+    log-space (lax.scan over time)."""
+    log_probs = as_tensor(log_probs)   # [T, B, C]
+    labels = as_tensor(labels)         # [B, S]
+    input_lengths = as_tensor(input_lengths)
+    label_lengths = as_tensor(label_lengths)
+
+    def fn(lp, lb, il, ll):
+        T, B, C = lp.shape
+        S = lb.shape[1]
+        ext = jnp.full((B, 2 * S + 1), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lb.astype(jnp.int32))
+        L = 2 * S + 1
+        neg = -1e30
+        alpha0 = jnp.full((B, L), neg)
+        alpha0 = alpha0.at[:, 0].set(lp[0, :, blank])
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.take_along_axis(lp[0], ext[:, 1:2], axis=1)[:, 0])
+
+        def lse2(a, b):
+            m = jnp.maximum(a, b)
+            return m + jnp.log(jnp.exp(a - m) + jnp.exp(b - m))
+
+        def step(alpha, t):
+            prev1 = jnp.concatenate([jnp.full((B, 1), neg),
+                                     alpha[:, :-1]], 1)
+            prev2 = jnp.concatenate([jnp.full((B, 2), neg),
+                                     alpha[:, :-2]], 1)
+            can_skip = jnp.concatenate(
+                [jnp.zeros((B, 2), bool),
+                 (ext[:, 2:] != blank) & (ext[:, 2:] != ext[:, :-2])], 1)
+            a = lse2(alpha, prev1)
+            a = jnp.where(can_skip, lse2(a, prev2), a)
+            emit = jnp.take_along_axis(lp[t], ext, axis=1)
+            new = a + emit
+            return jnp.where(t < il[:, None], new, alpha), None
+
+        alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+        end1 = 2 * ll.astype(jnp.int32)
+        end2 = end1 - 1
+        a1 = jnp.take_along_axis(alpha, end1[:, None], 1)[:, 0]
+        a2 = jnp.take_along_axis(alpha, jnp.maximum(end2, 0)[:, None],
+                                 1)[:, 0]
+        nll = -lse2(a1, a2)
+        return _reduce_loss(nll / jnp.maximum(ll.astype(jnp.float32), 1.0),
+                            reduction)
+    return run_op('warpctc', fn, [log_probs, labels, input_lengths,
+                                  label_lengths], n_nondiff=3)
+
+
+def glu(x, axis=-1):
+    x = as_tensor(x)
+    def fn(a):
+        a1, a2 = jnp.split(a, 2, axis=axis)
+        return a1 * jax.nn.sigmoid(a2)
+    return run_op('glu', fn, [x])
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False):
+    x = as_tensor(x)
+    y = as_tensor(y, ref=x)
+    return run_op('pairwise_distance',
+                  lambda a, b: jnp.power(
+                      jnp.sum(jnp.power(jnp.abs(a - b) + epsilon, p),
+                              axis=-1, keepdims=keepdim), 1.0 / p), [x, y])
+
+
+def pixel_unshuffle(x, downscale_factor, data_format='NCHW'):
+    x = as_tensor(x)
+    r = downscale_factor
+    def fn(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, c, h // r, r, w // r, r)
+        a = a.transpose(0, 1, 3, 5, 2, 4)
+        return a.reshape(n, c * r * r, h // r, w // r)
+    return run_op('pixel_unshuffle', fn, [x])
+
+
+def channel_shuffle(x, groups, data_format='NCHW'):
+    x = as_tensor(x)
+    def fn(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, groups, c // groups, h, w)
+        return a.transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+    return run_op('channel_shuffle', fn, [x])
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """Parity: operators/fold_op (col2im) — adjoint of unfold."""
+    x = as_tensor(x)
+    oh, ow = _pair(output_sizes)
+    k = _pair(kernel_sizes)
+    s = _pair(strides)
+    p = _pair(paddings)
+    d = _pair(dilations)
+
+    def fn(a):
+        n, ckk, l = a.shape
+        c = ckk // (k[0] * k[1])
+        hh = oh + 2 * p[0]
+        ww = ow + 2 * p[1]
+        nh = (hh - (d[0] * (k[0] - 1) + 1)) // s[0] + 1
+        nw = (ww - (d[1] * (k[1] - 1) + 1)) // s[1] + 1
+        out = jnp.zeros((n, c, hh, ww), a.dtype)
+        cols = a.reshape(n, c, k[0], k[1], nh, nw)
+        for i in range(k[0]):
+            for j in range(k[1]):
+                out = out.at[:, :, i * d[0]: i * d[0] + nh * s[0]: s[0],
+                             j * d[1]: j * d[1] + nw * s[1]: s[1]].add(
+                    cols[:, :, i, j])
+        return out[:, :, p[0]:p[0] + oh, p[1]:p[1] + ow]
+    return run_op('fold', fn, [x])
