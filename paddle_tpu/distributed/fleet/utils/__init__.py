@@ -4,3 +4,4 @@ from .hybrid_parallel_util import (fused_allreduce_gradients,
                                    sharding_reduce_gradients, unwrap_model)
 from .fs import LocalFS, HDFSClient
 from .comm_reduce import LocalSGD, AdaptiveLocalSGD, GradientMerge
+from .log_util import logger

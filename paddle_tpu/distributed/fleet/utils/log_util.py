@@ -1,0 +1,21 @@
+"""Fleet logger (parity: fleet/utils/log_util.py)."""
+import logging
+import os
+import sys
+
+logger = logging.getLogger('paddle_tpu.fleet')
+if not logger.handlers:
+    h = logging.StreamHandler(sys.stderr)
+    h.setFormatter(logging.Formatter(
+        '%(asctime)s %(levelname)s [rank '
+        + os.environ.get('PADDLE_TRAINER_ID', '0') + '] %(message)s'))
+    logger.addHandler(h)
+    logger.setLevel(os.environ.get('FLEET_LOG_LEVEL', 'INFO'))
+
+
+def layer_to_str(base, *args, **kwargs):
+    name = base + "("
+    name += ", ".join(str(a) for a in args)
+    if kwargs:
+        name += ", " + ", ".join(f"{k}={v}" for k, v in kwargs.items())
+    return name + ")"

@@ -9,7 +9,8 @@ from .layer.common import (Linear, Embedding, Dropout, Dropout2D,
                            AlphaDropout, Flatten, Identity, Pad1D, Pad2D,
                            Pad3D, Upsample, UpsamplingBilinear2D,
                            UpsamplingNearest2D, Bilinear, CosineSimilarity,
-                           Unfold)
+                           Unfold, PixelShuffle, PixelUnshuffle,
+                           ChannelShuffle, Fold, GLU, ZeroPad2D)
 from .layer.container import (Sequential, LayerList, LayerDict,
                               ParameterList)
 from .layer.conv import (Conv1D, Conv2D, Conv3D, Conv2DTranspose,
@@ -29,7 +30,9 @@ from .layer.pooling import (AvgPool1D, AvgPool2D, MaxPool1D, MaxPool2D,
                             AdaptiveMaxPool2D)
 from .layer.loss import (CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss,
                          BCEWithLogitsLoss, KLDivLoss, SmoothL1Loss,
-                         MarginRankingLoss)
+                         MarginRankingLoss, TripletMarginLoss,
+                         CosineEmbeddingLoss, SoftMarginLoss,
+                         MultiMarginLoss, CTCLoss)
 from .layer.transformer import (MultiHeadAttention, TransformerEncoderLayer,
                                 TransformerEncoder, TransformerDecoderLayer,
                                 TransformerDecoder, Transformer)

@@ -198,3 +198,59 @@ class Unfold(Layer):
 
     def forward(self, x):
         return F.unfold(x, *self.args)
+
+
+class PixelShuffle(Layer):
+    def __init__(self, upscale_factor, data_format='NCHW', name=None):
+        super().__init__()
+        self._factor = upscale_factor
+
+    def forward(self, x):
+        return manip.pixel_shuffle(x, self._factor)
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor, data_format='NCHW', name=None):
+        super().__init__()
+        self._factor = downscale_factor
+
+    def forward(self, x):
+        return F.pixel_unshuffle(x, self._factor)
+
+
+class ChannelShuffle(Layer):
+    def __init__(self, groups, data_format='NCHW', name=None):
+        super().__init__()
+        self._groups = groups
+
+    def forward(self, x):
+        return F.channel_shuffle(x, self._groups)
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self.args = (output_sizes, kernel_sizes, strides, paddings,
+                     dilations)
+
+    def forward(self, x):
+        return F.fold(x, *self.args)
+
+
+class GLU(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return F.glu(x, axis=self._axis)
+
+
+class ZeroPad2D(Layer):
+    def __init__(self, padding, data_format='NCHW', name=None):
+        super().__init__()
+        self._padding = padding
+
+    def forward(self, x):
+        return manip.pad(x, self._padding, mode='constant', value=0.0)
