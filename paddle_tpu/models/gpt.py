@@ -135,7 +135,11 @@ class GPTAttention(nn.Layer):
             input_is_parallel=True)
         self.dropout = nn.Dropout(config.hidden_dropout)
 
-    def forward(self, x):
+    def forward(self, x, cache=None, cache_len=None):
+        """cache: optional (k, v) Tensors [B, nh, max_len, hd] (fixed-size,
+        position-indexed by cache_len) enabling O(1)-per-token decode."""
+        if cache is not None:
+            return self._forward_cached(x, cache, cache_len)
         B, L, _ = x.shape
         qkv = self.qkv_proj(x)  # [B, L, 3*H/mp]
         hd, nh = self.head_dim, qkv.shape[-1] // (3 * self.head_dim)
@@ -186,6 +190,41 @@ class GPTAttention(nn.Layer):
         out = self.out_proj(ctx)
         return self.dropout(out)
 
+    def _forward_cached(self, x, cache, cache_len):
+        """Single-step decode: x [B, 1, H]; write this token's k/v at
+        position cache_len, attend over cache[:cache_len+1]."""
+        B, L, _ = x.shape
+        qkv = self.qkv_proj(x)
+        hd = self.head_dim
+        nh = qkv.shape[-1] // (3 * hd)
+        k_cache, v_cache = cache
+        pos = cache_len.data if isinstance(cache_len, Tensor) else cache_len
+
+        def fn(a, kc, vc):
+            x5 = a.reshape(B, L, nh, 3, hd)
+            q = x5[:, :, :, 0].transpose(0, 2, 1, 3)  # B,nh,1,hd
+            k = x5[:, :, :, 1].transpose(0, 2, 1, 3)
+            v = x5[:, :, :, 2].transpose(0, 2, 1, 3)
+            kc2 = jax.lax.dynamic_update_slice(
+                kc, k.astype(kc.dtype), (0, 0, pos, 0))
+            vc2 = jax.lax.dynamic_update_slice(
+                vc, v.astype(vc.dtype), (0, 0, pos, 0))
+            scores = jnp.einsum('bhqd,bhkd->bhqk', q,
+                                kc2.astype(q.dtype),
+                                preferred_element_type=jnp.float32)
+            scores = scores * (1.0 / math.sqrt(hd))
+            idx = jnp.arange(kc.shape[2])
+            mask = idx[None, None, None, :] <= pos
+            scores = jnp.where(mask, scores, -1e9)
+            probs = jax.nn.softmax(scores, axis=-1).astype(a.dtype)
+            o = jnp.einsum('bhqk,bhkd->bhqd', probs, vc2.astype(a.dtype))
+            o = o.transpose(0, 2, 1, 3).reshape(B, L, nh * hd)
+            return o, kc2, vc2
+        ctx, kc2, vc2 = run_op('cached_attention', fn,
+                               [qkv, k_cache, v_cache])
+        out = self.out_proj(ctx)
+        return self.dropout(out), (kc2, vc2)
+
 
 class GPTMLP(nn.Layer):
     def __init__(self, config):
@@ -218,7 +257,13 @@ class GPTDecoderLayer(nn.Layer):
                                 epsilon=config.layer_norm_eps)
         self.mlp = GPTMLP(config)
 
-    def forward(self, x):
+    def forward(self, x, cache=None, cache_len=None):
+        if cache is not None:
+            a, new_cache = self.attn(self.ln1(x), cache=cache,
+                                     cache_len=cache_len)
+            x = M.add(x, a)
+            x = M.add(x, self.mlp(self.ln2(x)))
+            return x, new_cache
         x = M.add(x, self.attn(self.ln1(x)))
         x = M.add(x, self.mlp(self.ln2(x)))
         return x
@@ -236,11 +281,28 @@ class GPTModel(nn.Layer):
         self.final_norm = nn.LayerNorm(config.hidden_size,
                                        epsilon=config.layer_norm_eps)
 
-    def forward(self, input_ids, position_ids=None):
+    def forward(self, input_ids, position_ids=None, caches=None,
+                cache_len=None):
         x = self.embeddings(input_ids, position_ids)
+        if caches is not None:
+            new_caches = []
+            for layer, c in zip(self.layers, caches):
+                x, nc = layer(x, cache=c, cache_len=cache_len)
+                new_caches.append(nc)
+            return self.final_norm(x), new_caches
         for layer in self.layers:
             x = layer(x)
         return self.final_norm(x)
+
+    def init_caches(self, batch, max_len, dtype=None):
+        import jax.numpy as _jnp
+        cfg = self.config
+        hd = cfg.hidden_size // cfg.num_heads
+        nh_local = self.layers[0].attn.local_heads
+        dt = dtype or self.embeddings.word_embeddings.weight.dtype
+        return [(Tensor(_jnp.zeros((batch, nh_local, max_len, hd), dt)),
+                 Tensor(_jnp.zeros((batch, nh_local, max_len, hd), dt)))
+                for _ in range(cfg.num_layers)]
 
 
 class GPTForCausalLM(nn.Layer):
@@ -261,10 +323,14 @@ class GPTForCausalLM(nn.Layer):
         return logits  # class dim vocab-parallel under mp
 
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
-                 top_k=0, eos_token_id=None):
+                 top_k=0, eos_token_id=None, use_cache=True):
         """Greedy / top-k sampling decode (parity role: the beam_search/
-        sampling ops tier; full-context re-forward per token — the KV-cached
-        decode path is the inference engine's job)."""
+        sampling ops tier). use_cache=True runs the O(1)-per-token KV-cached
+        path with a jitted fixed-shape decode step; False re-forwards the
+        full window per token."""
+        if use_cache:
+            return self._generate_cached(input_ids, max_new_tokens,
+                                         temperature, top_k, eos_token_id)
         import numpy as np_
         from ..core import rng as rng_mod
         from ..core.autograd import no_grad
@@ -290,6 +356,74 @@ class GPTForCausalLM(nn.Layer):
                 if eos_token_id is not None and (nxt == eos_token_id).all():
                     break
         return Tensor(ids)
+
+    def _generate_cached(self, input_ids, max_new_tokens, temperature,
+                         top_k, eos_token_id):
+        import numpy as np_
+        from ..core.autograd import no_grad
+        from ..jit import bind_arrays, get_params
+        ids = np_.asarray(input_ids.data if isinstance(input_ids, Tensor)
+                          else input_ids).astype('int32')
+        B, L0 = ids.shape
+        max_len = min(self.config.max_seq_len, L0 + max_new_tokens)
+        model = self
+        params = {n: p.data for n, p in self.named_parameters()}
+
+        with no_grad():
+            caches = self.gpt.init_caches(B, max_len)
+            cache_arrays = [(c[0].data, c[1].data) for c in caches]
+
+            def prefill(ps, token_ids):
+                with bind_arrays(model, ps):
+                    logits = model(Tensor(token_ids))
+                return logits.data[:, -1, :]
+
+            def step(ps, token, pos, kv):
+                cts = [(Tensor(k), Tensor(v)) for k, v in kv]
+                with bind_arrays(model, ps):
+                    pos_ids = Tensor(pos[None].astype(jnp.int32))
+                    h, new_caches = model.gpt(Tensor(token), pos_ids,
+                                              caches=cts, cache_len=pos)
+                    w = model.gpt.embeddings.word_embeddings.weight
+                    logits = M.matmul(h, w, transpose_y=True)
+                new_kv = [(c[0].data, c[1].data) for c in new_caches]
+                return logits.data[:, -1, :], new_kv
+
+            jit_step = jax.jit(step)
+
+            # prefill: run the prompt once through the uncached path while
+            # filling caches token-by-token would be O(L0) steps; simplest
+            # correct: feed prompt tokens sequentially through the cache.
+            last_logits = None
+            for t in range(L0):
+                last_logits, cache_arrays = jit_step(
+                    params, ids[:, t:t + 1], jnp.asarray(t, jnp.int32),
+                    cache_arrays)
+
+            out = ids
+            for i in range(max_new_tokens):
+                pos = L0 + i
+                if pos >= max_len:
+                    break
+                step_logits = np_.asarray(last_logits) / max(temperature,
+                                                             1e-6)
+                if top_k and top_k > 0:
+                    kth = np_.sort(step_logits, axis=-1)[:, -top_k][:, None]
+                    z = np_.where(step_logits < kth, -1e30, step_logits)
+                    z = z - z.max(-1, keepdims=True)
+                    p = np_.exp(z) / np_.exp(z).sum(-1, keepdims=True)
+                    nxt = np_.asarray(
+                        [np_.random.choice(p.shape[-1], p=row) for row in p])
+                else:
+                    nxt = step_logits.argmax(-1)
+                out = np_.concatenate([out, nxt[:, None].astype('int32')],
+                                      axis=1)
+                if eos_token_id is not None and (nxt == eos_token_id).all():
+                    break
+                last_logits, cache_arrays = jit_step(
+                    params, out[:, -1:], jnp.asarray(pos, jnp.int32),
+                    cache_arrays)
+        return Tensor(out)
 
 
 class GPTPretrainingCriterion(nn.Layer):

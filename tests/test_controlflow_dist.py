@@ -77,3 +77,17 @@ def test_flags_nan_check():
             paddle.log(paddle.to_tensor([-1.0]))
     finally:
         paddle.set_flags({'FLAGS_check_nan_inf': False})
+
+
+def test_gpt_kv_cache_decode_matches_full():
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    paddle.seed(0)
+    m = GPTForCausalLM(GPTConfig(vocab_size=64, hidden_size=32,
+                                 num_layers=2, num_heads=4, max_seq_len=64,
+                                 hidden_dropout=0.0, attn_dropout=0.0,
+                                 use_flash_attention=False))
+    m.eval()
+    prompt = paddle.to_tensor(np.array([[5, 9, 2]], 'int32'))
+    cached = m.generate(prompt, max_new_tokens=5, use_cache=True)
+    full = m.generate(prompt, max_new_tokens=5, use_cache=False)
+    assert cached.numpy().tolist() == full.numpy().tolist()
