@@ -322,15 +322,34 @@ class GPTForCausalLM(nn.Layer):
         logits = M.matmul(hidden, w, transpose_y=True)
         return logits  # class dim vocab-parallel under mp
 
+    @staticmethod
+    def _sample_next(step_logits, temperature, top_k):
+        import numpy as np_
+        step = step_logits / max(temperature, 1e-6)
+        if top_k and top_k > 0:
+            kth = np_.sort(step, axis=-1)[:, -top_k][:, None]
+            z = np_.where(step < kth, -1e30, step)
+            z = z - z.max(-1, keepdims=True)
+            p = np_.exp(z) / np_.exp(z).sum(-1, keepdims=True)
+            return np_.asarray(
+                [np_.random.choice(p.shape[-1], p=row) for row in p])
+        return step.argmax(-1)
+
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
                  top_k=0, eos_token_id=None, use_cache=True):
         """Greedy / top-k sampling decode (parity role: the beam_search/
         sampling ops tier). use_cache=True runs the O(1)-per-token KV-cached
         path with a jitted fixed-shape decode step; False re-forwards the
         full window per token."""
-        if use_cache:
+        ids_probe = input_ids.data if isinstance(input_ids, Tensor) \
+            else input_ids
+        fits = (ids_probe.shape[-1] + max_new_tokens
+                <= self.config.max_seq_len)
+        if use_cache and fits:
             return self._generate_cached(input_ids, max_new_tokens,
                                          temperature, top_k, eos_token_id)
+        # beyond max_seq_len the cached path would truncate; the sliding-
+        # window re-forward below matches the uncached semantics exactly
         import numpy as np_
         from ..core import rng as rng_mod
         from ..core.autograd import no_grad
@@ -340,18 +359,8 @@ class GPTForCausalLM(nn.Layer):
             for _ in range(max_new_tokens):
                 window = ids[:, -self.config.max_seq_len:]
                 logits = self(Tensor(window.astype('int32')))
-                step = np_.asarray(logits.data)[:, -1, :] / max(temperature,
-                                                                1e-6)
-                if top_k and top_k > 0:
-                    kth = np_.sort(step, axis=-1)[:, -top_k][:, None]
-                    step = np_.where(step < kth, -1e30, step)
-                    z = step - step.max(-1, keepdims=True)
-                    p = np_.exp(z) / np_.exp(z).sum(-1, keepdims=True)
-                    nxt = np_.asarray(
-                        [np_.random.choice(p.shape[-1], p=row)
-                         for row in p])
-                else:
-                    nxt = step.argmax(-1)
+                nxt = self._sample_next(np_.asarray(logits.data)[:, -1, :],
+                                        temperature, top_k)
                 ids = np_.concatenate([ids, nxt[:, None]], axis=1)
                 if eos_token_id is not None and (nxt == eos_token_id).all():
                     break
@@ -361,22 +370,20 @@ class GPTForCausalLM(nn.Layer):
                          top_k, eos_token_id):
         import numpy as np_
         from ..core.autograd import no_grad
-        from ..jit import bind_arrays, get_params
+        from ..jit import bind_arrays
         ids = np_.asarray(input_ids.data if isinstance(input_ids, Tensor)
                           else input_ids).astype('int32')
         B, L0 = ids.shape
         max_len = min(self.config.max_seq_len, L0 + max_new_tokens)
         model = self
         params = {n: p.data for n, p in self.named_parameters()}
+        was_training = self.training
+        self.eval()  # generation is deterministic-forward; dropout keys
+        # would otherwise bake into the trace as constants
 
         with no_grad():
             caches = self.gpt.init_caches(B, max_len)
             cache_arrays = [(c[0].data, c[1].data) for c in caches]
-
-            def prefill(ps, token_ids):
-                with bind_arrays(model, ps):
-                    logits = model(Tensor(token_ids))
-                return logits.data[:, -1, :]
 
             def step(ps, token, pos, kv):
                 cts = [(Tensor(k), Tensor(v)) for k, v in kv]
@@ -389,11 +396,11 @@ class GPTForCausalLM(nn.Layer):
                 new_kv = [(c[0].data, c[1].data) for c in new_caches]
                 return logits.data[:, -1, :], new_kv
 
-            jit_step = jax.jit(step)
+            # donate the cache so XLA updates it in place (no per-token
+            # full-cache copy)
+            jit_step = jax.jit(step, donate_argnums=(3,))
 
-            # prefill: run the prompt once through the uncached path while
-            # filling caches token-by-token would be O(L0) steps; simplest
-            # correct: feed prompt tokens sequentially through the cache.
+            # prefill: feed prompt tokens sequentially through the cache
             last_logits = None
             for t in range(L0):
                 last_logits, cache_arrays = jit_step(
@@ -405,17 +412,8 @@ class GPTForCausalLM(nn.Layer):
                 pos = L0 + i
                 if pos >= max_len:
                     break
-                step_logits = np_.asarray(last_logits) / max(temperature,
-                                                             1e-6)
-                if top_k and top_k > 0:
-                    kth = np_.sort(step_logits, axis=-1)[:, -top_k][:, None]
-                    z = np_.where(step_logits < kth, -1e30, step_logits)
-                    z = z - z.max(-1, keepdims=True)
-                    p = np_.exp(z) / np_.exp(z).sum(-1, keepdims=True)
-                    nxt = np_.asarray(
-                        [np_.random.choice(p.shape[-1], p=row) for row in p])
-                else:
-                    nxt = step_logits.argmax(-1)
+                nxt = self._sample_next(np_.asarray(last_logits),
+                                        temperature, top_k)
                 out = np_.concatenate([out, nxt[:, None].astype('int32')],
                                       axis=1)
                 if eos_token_id is not None and (nxt == eos_token_id).all():
@@ -423,6 +421,8 @@ class GPTForCausalLM(nn.Layer):
                 last_logits, cache_arrays = jit_step(
                     params, out[:, -1:], jnp.asarray(pos, jnp.int32),
                     cache_arrays)
+        if was_training:
+            self.train()
         return Tensor(out)
 
 
