@@ -366,6 +366,86 @@ class GPTForCausalLM(nn.Layer):
                     break
         return Tensor(ids)
 
+    def generate_scan(self, input_ids, max_new_tokens=32, temperature=1.0,
+                      top_k=0, seed=0):
+        """Whole-generation-in-one-dispatch decode: prefill + the full
+        token loop run as ONE jitted lax.scan (amortizes host→device
+        latency; on a tunneled chip this is the difference between
+        ~140 ms/token and one RTT total). Sampling runs on device via
+        jax.random; greedy when top_k == 0."""
+        import numpy as np_
+        from ..core.autograd import no_grad
+        from ..jit import bind_arrays
+        from jax import lax
+        ids = np_.asarray(input_ids.data if isinstance(input_ids, Tensor)
+                          else input_ids).astype('int32')
+        B, L0 = ids.shape
+        max_len = L0 + max_new_tokens
+        if max_len > self.config.max_seq_len:
+            raise ValueError(
+                f"prompt({L0}) + max_new_tokens({max_new_tokens}) exceeds "
+                f"max_seq_len({self.config.max_seq_len})")
+        model = self
+        params = {n: p.data for n, p in self.named_parameters()}
+        was_training = self.training
+        self.eval()
+
+        def run(ps, prompt, key):
+            caches = model.gpt.init_caches(B, max_len)
+            kv0 = [(c[0].data, c[1].data) for c in caches]
+
+            def one(tok, pos, kv):
+                cts = [(Tensor(k), Tensor(v)) for k, v in kv]
+                with bind_arrays(model, ps):
+                    pos_ids = Tensor(pos[None].astype(jnp.int32))
+                    h, ncs = model.gpt(Tensor(tok), pos_ids, caches=cts,
+                                       cache_len=pos)
+                    w = model.gpt.embeddings.word_embeddings.weight
+                    logits = M.matmul(h, w, transpose_y=True)
+                return logits.data[:, -1, :], [(c[0].data, c[1].data)
+                                               for c in ncs]
+
+            def prefill_step(kv, t):
+                logits, kv = one(lax.dynamic_slice_in_dim(prompt, t, 1, 1),
+                                 t, kv)
+                return kv, logits
+
+            kv, all_logits = lax.scan(prefill_step, kv0, jnp.arange(L0))
+            last = all_logits[-1]
+
+            def decode_step(carry, i):
+                kv, last, k = carry
+                scaled = last / jnp.maximum(temperature, 1e-6)
+                if top_k and top_k > 0:
+                    kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
+                    scaled = jnp.where(scaled < kth, -1e30, scaled)
+                    k, sub = jax.random.split(k)
+                    nxt = jax.random.categorical(sub, scaled, axis=-1)
+                else:
+                    nxt = jnp.argmax(scaled, axis=-1)
+                nxt = nxt.astype(jnp.int32)
+                last, kv = one(nxt[:, None], L0 + i, kv)
+                return (kv, last, k), nxt
+
+            (_, _, _), toks = lax.scan(
+                decode_step, (kv, last, key), jnp.arange(max_new_tokens))
+            return toks.T  # [B, max_new_tokens]
+
+        with no_grad():
+            key = jax.random.key(seed)
+            cache_key = (B, L0, max_new_tokens, float(temperature),
+                         int(top_k))
+            if not hasattr(self, '_gen_cache'):
+                self._gen_cache = {}
+            jfn = self._gen_cache.get(cache_key)
+            if jfn is None:
+                jfn = jax.jit(run)
+                self._gen_cache[cache_key] = jfn
+            new = jfn(params, jnp.asarray(ids), key)
+        if was_training:
+            self.train()
+        return Tensor(np_.concatenate([ids, np_.asarray(new)], axis=1))
+
     def _generate_cached(self, input_ids, max_new_tokens, temperature,
                          top_k, eos_token_id):
         import numpy as np_

@@ -91,3 +91,25 @@ def test_gpt_kv_cache_decode_matches_full():
     cached = m.generate(prompt, max_new_tokens=5, use_cache=True)
     full = m.generate(prompt, max_new_tokens=5, use_cache=False)
     assert cached.numpy().tolist() == full.numpy().tolist()
+
+
+def test_gpt_generate_scan_matches_greedy():
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    paddle.seed(0)
+    m = GPTForCausalLM(GPTConfig(vocab_size=64, hidden_size=32,
+                                 num_layers=2, num_heads=4, max_seq_len=64,
+                                 hidden_dropout=0.0, attn_dropout=0.0,
+                                 use_flash_attention=False))
+    m.eval()
+    p = paddle.to_tensor(np.array([[7, 1, 4]], 'int32'))
+    scan_out = m.generate_scan(p, max_new_tokens=6)
+    ref = m.generate(p, max_new_tokens=6, use_cache=False)
+    assert scan_out.numpy().tolist() == ref.numpy().tolist()
+    # cached fn reused on second call (no recompile)
+    assert len(m._gen_cache) == 1
+    m.generate_scan(p, max_new_tokens=6)
+    assert len(m._gen_cache) == 1
+    # overflow guard
+    import pytest as _pt
+    with _pt.raises(ValueError):
+        m.generate_scan(p, max_new_tokens=100)
