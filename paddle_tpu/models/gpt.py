@@ -477,8 +477,14 @@ class GPTForCausalLM(nn.Layer):
                 return logits.data[:, -1, :], new_kv
 
             # donate the cache so XLA updates it in place (no per-token
-            # full-cache copy)
-            jit_step = jax.jit(step, donate_argnums=(3,))
+            # full-cache copy); cache the compiled step across calls
+            if not hasattr(self, '_step_cache'):
+                self._step_cache = {}
+            ck = (B, max_len)
+            jit_step = self._step_cache.get(ck)
+            if jit_step is None:
+                jit_step = jax.jit(step, donate_argnums=(3,))
+                self._step_cache[ck] = jit_step
 
             # prefill: feed prompt tokens sequentially through the cache
             last_logits = None
