@@ -115,3 +115,8 @@ from .ops.math import (  # noqa: E402
 from .core.flags import set_flags, get_flags  # noqa: E402
 from . import distribution  # noqa: E402
 from . import regularizer  # noqa: E402
+from . import version  # noqa: E402
+
+
+def get_cudnn_version():
+    return None

@@ -115,7 +115,18 @@ def backward(tensors, grad_tensors=None, retain_graph=False, capture=None,
     pending = {}
     roots = []
 
+    def _apply_hooks(t, g):
+        hooks = getattr(t, '_grad_hooks', None)
+        if hooks:
+            from .tensor import Tensor as _T
+            for hook in list(hooks.values()):
+                r = hook(_T(g, stop_gradient=True))
+                if r is not None:
+                    g = r.data if isinstance(r, _T) else r
+        return g
+
     def leaf_store(t, g):
+        g = _apply_hooks(t, g)
         if capture is not None and id(t) in capture:
             capture[id(t)] = g if capture[id(t)] is None else capture[id(t)] + g
         elif accumulate_leaves:
@@ -170,6 +181,8 @@ def backward(tensors, grad_tensors=None, retain_graph=False, capture=None,
         for t, needs, g in zip(node.inputs, node.input_needs_grad, in_grads):
             if not needs or g is None:
                 continue
+            if getattr(t, '_grad_hooks', None) and t._node is not None:
+                g = _apply_hooks(t, g)
             if capture is not None and id(t) in capture:
                 leaf_store(t, g)
             if t._node is not None:
@@ -184,7 +197,7 @@ def backward(tensors, grad_tensors=None, retain_graph=False, capture=None,
             elif not t.stop_gradient:
                 if (capture is None or accumulate_leaves) and \
                         not (capture is not None and id(t) in capture):
-                    _leaf_accumulate(t, g)
+                    _leaf_accumulate(t, _apply_hooks(t, g))
 
     if not retain_graph:
         for t in roots:

@@ -126,7 +126,21 @@ class Tensor:
         return ops.math.assign(self)
 
     def register_hook(self, hook):
-        raise NotImplementedError("tensor hooks land with PyLayer")
+        """Parity: Tensor.register_hook — called with the gradient when it
+        reaches this tensor during backward; a non-None return replaces the
+        gradient. Returns a removable handle."""
+        if not hasattr(self, '_grad_hooks'):
+            self._grad_hooks = {}
+        hid = len(self._grad_hooks)
+        self._grad_hooks[hid] = hook
+
+        class _Handle:
+            def __init__(self, owner, hid):
+                self._owner, self._hid = owner, hid
+
+            def remove(self):
+                self._owner._grad_hooks.pop(self._hid, None)
+        return _Handle(self, hid)
 
     # -- in-place mutation (eager only) -------------------------------------
     def set_value(self, value):

@@ -1,0 +1,15 @@
+"""paddle.version parity."""
+full_version = '0.1.0'
+major = '0'
+minor = '1'
+patch = '0'
+rc = '0'
+istaged = True
+commit = 'tpu-native'
+with_tpu = 'ON'
+cuda_version = 'False'
+cudnn_version = 'False'
+
+
+def show():
+    print(f"paddle_tpu {full_version} (tpu-native, commit {commit})")

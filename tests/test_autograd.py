@@ -117,3 +117,22 @@ def test_recompute_matches_plain():
     g_rc = [p.grad.numpy() for p in net.parameters()]
     for a, b in zip(g_plain, g_rc):
         np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_register_hook():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    h = x.register_hook(lambda g: g * 2)
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0, 6.0])
+    h.remove()
+    x.clear_grad()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 3.0])
+    # observing hook on an intermediate
+    y = paddle.to_tensor([2.0], stop_gradient=False)
+    z = y * 4
+    seen = []
+    z.register_hook(lambda g: seen.append(g.numpy()) or None)
+    (z * z).backward()
+    np.testing.assert_allclose(seen[0], [16.0])
+    np.testing.assert_allclose(y.grad.numpy(), [64.0])
