@@ -114,6 +114,9 @@ def backward(tensors, grad_tensors=None, retain_graph=False, capture=None,
     # node_id -> (node, [cotangent per output])
     pending = {}
     roots = []
+    # leaf grads accumulate here during the walk; hooks run ONCE on the
+    # fully-summed value at the end (paddle/torch hook semantics)
+    leaf_grads = {}   # id(t) -> (tensor, grad)
 
     def _apply_hooks(t, g):
         hooks = getattr(t, '_grad_hooks', None)
@@ -126,11 +129,8 @@ def backward(tensors, grad_tensors=None, retain_graph=False, capture=None,
         return g
 
     def leaf_store(t, g):
-        g = _apply_hooks(t, g)
-        if capture is not None and id(t) in capture:
-            capture[id(t)] = g if capture[id(t)] is None else capture[id(t)] + g
-        elif accumulate_leaves:
-            _leaf_accumulate(t, g)
+        prev = leaf_grads.get(id(t))
+        leaf_grads[id(t)] = (t, g if prev is None else prev[1] + g)
 
     def seed_grad(t, g):
         if capture is not None and id(t) in capture and t._node is None:
@@ -176,13 +176,17 @@ def backward(tensors, grad_tensors=None, retain_graph=False, capture=None,
             ct = cotangents[i]
             if ct is None:
                 ct = jnp.zeros(shape, dt)
+            else:
+                out_t = node.outputs[i]()
+                if out_t is not None and getattr(out_t, '_grad_hooks',
+                                                 None):
+                    # summed cotangent for this tensor is now final
+                    ct = _apply_hooks(out_t, ct)
             cts.append(ct)
         in_grads = node.vjp_fn(tuple(cts) if node.n_outputs > 1 else cts[0])
         for t, needs, g in zip(node.inputs, node.input_needs_grad, in_grads):
             if not needs or g is None:
                 continue
-            if getattr(t, '_grad_hooks', None) and t._node is not None:
-                g = _apply_hooks(t, g)
             if capture is not None and id(t) in capture:
                 leaf_store(t, g)
             if t._node is not None:
@@ -197,7 +201,16 @@ def backward(tensors, grad_tensors=None, retain_graph=False, capture=None,
             elif not t.stop_gradient:
                 if (capture is None or accumulate_leaves) and \
                         not (capture is not None and id(t) in capture):
-                    _leaf_accumulate(t, _apply_hooks(t, g))
+                    leaf_store(t, g)
+
+    # finalize leaves: hooks on the fully-accumulated grads, then route to
+    # capture or .grad
+    for tid, (t, g) in leaf_grads.items():
+        g = _apply_hooks(t, g)
+        if capture is not None and tid in capture:
+            capture[tid] = g if capture[tid] is None else capture[tid] + g
+        elif accumulate_leaves or capture is None:
+            _leaf_accumulate(t, g)
 
     if not retain_graph:
         for t in roots:
