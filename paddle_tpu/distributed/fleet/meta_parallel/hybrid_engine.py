@@ -7,7 +7,8 @@ DataParallel grad allreduce (imperative/reducer.cc), TensorParallel
 (SURVEY.md A.1).
 
 TPU-native design: ONE `jax.jit(shard_map(step))` over the registered Mesh.
-  * batch sharded over 'dp' (axis 0), params replicated over dp;
+  * batch sharded over ('dp','sharding') on axis 0 — ZeRO ranks ARE
+    data-parallel ranks; params replicated over both;
   * TP params sharded over 'mp' at their `split_axis` (mp_layers emit the
     explicit collectives inside the traced forward);
   * ZeRO-1: optimizer states (incl. fp32 master weights) sharded over
@@ -50,7 +51,8 @@ class HybridParallelTrainStep:
     """Compile a full train step over the registered mesh.
 
     loss_fn(model, *batch) -> scalar loss Tensor. Batch tensors are sharded
-    on axis 0 over 'dp'; when the mesh has sp>1 (and the model declares
+    on axis 0 over ('dp','sharding') — leading batch dims must divide
+    dp*sharding_degree; when the mesh has sp>1 (and the model declares
     _supports_sequence_parallel), every batch tensor of rank >= 2 is ALSO
     sharded on axis 1 over 'sp' — pass `sp_shard_args` (a set of positional
     batch indices) to restrict sequence sharding to the token-aligned
@@ -225,7 +227,13 @@ class HybridParallelTrainStep:
                 "mesh has sp>1 but the model does not declare "
                 "_supports_sequence_parallel; sequence-sharding it would "
                 "silently train wrong")
-        dp_name = 'dp' if 'dp' in axes else None
+        # batch is data-parallel over BOTH 'dp' and 'sharding': ZeRO ranks
+        # ARE data-parallel ranks (parity: dygraph_sharding_optimizer.py:27
+        # shards the optimizer over the DP group) — replicating data over
+        # 'sharding' would buy state memory but zero throughput.
+        batch_axes = tuple(a for a in ('dp', 'sharding') if a in axes
+                           and self.mesh.shape[a] > 1)
+        dp_name = batch_axes if batch_axes else None
         def _bspec(idx, nd):
             shard_seq = sp_on and nd >= 2 and (
                 self.sp_shard_args is None or idx in self.sp_shard_args)
@@ -234,6 +242,7 @@ class HybridParallelTrainStep:
             return P(dp_name) if dp_name else P()
         batch_specs = tuple(_bspec(i, nd)
                             for i, nd in enumerate(self._batch_ndims))
+        self._batch_specs = batch_specs
         in_specs = (self._param_specs, self._state_specs, P(), P(),
                     *batch_specs)
         out_specs = (P(), self._param_specs, self._state_specs)
@@ -268,6 +277,14 @@ class HybridParallelTrainStep:
                        for b in batch)
         if self._compiled is None:
             self._batch_ndims = tuple(a.ndim for a in arrays)
+            ddeg = self.dp * self.sharding_deg
+            for i, a in enumerate(arrays):
+                if a.ndim >= 1 and a.shape[0] % ddeg != 0:
+                    raise ValueError(
+                        f"batch arg {i} has leading dim {a.shape[0]}, not "
+                        f"divisible by dp*sharding = {self.dp}*"
+                        f"{self.sharding_deg} = {ddeg} (ZeRO 'sharding' "
+                        f"ranks are data-parallel ranks)")
             self._compiled = self._build()
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         key = rng_mod.next_key()

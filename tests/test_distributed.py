@@ -101,6 +101,23 @@ class TestHybridEngine:
         ref = run_steps(eng2)
         np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
 
+    def test_sharding_axis_is_data_parallel(self):
+        """ZeRO ranks ARE dp ranks (dygraph_sharding_optimizer.py:27): the
+        batch must be sharded over ('dp','sharding') so sharding_degree=k
+        scales per-step throughput — not replicate compute k times."""
+        topology_runtime.build_mesh(['dp', 'sharding'], [2, 4])
+        net = make_mlp(0)
+        opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                    parameters=net.parameters())
+        eng = HybridParallelTrainStep(net, mse_loss_fn, opt)
+        eng(Tensor(X), Tensor(Y))
+        for spec in eng._batch_specs:
+            assert spec[0] == ('dp', 'sharding'), spec
+        # each device sees BATCH/(dp*sharding) rows, not BATCH/dp
+        from jax.sharding import NamedSharding
+        ns = NamedSharding(eng.mesh, eng._batch_specs[0])
+        assert ns.shard_shape(X.shape) == (BATCH // 8, 8)
+
     def test_tp_matches_dense(self):
         """mp=4 TP layers (column→row with explicit collectives) match the
         dense equivalent run on one device."""
