@@ -62,9 +62,11 @@ class Node:
     alive), and weak refs to outputs (so dead activations break the chain).
     """
     __slots__ = ('id', 'name', 'vjp_fn', 'inputs', 'input_needs_grad',
-                 'outputs', 'out_meta', 'n_outputs', '__weakref__')
+                 'outputs', 'out_meta', 'n_outputs', 'primal_fn',
+                 'diff_idx', '__weakref__')
 
-    def __init__(self, name, vjp_fn, inputs, input_needs_grad, outputs):
+    def __init__(self, name, vjp_fn, inputs, input_needs_grad, outputs,
+                 primal_fn=None, diff_idx=None):
         global _node_counter
         _node_counter += 1
         self.id = _node_counter
@@ -75,10 +77,18 @@ class Node:
         self.outputs = [weakref.ref(t) for t in outputs]
         self.out_meta = [(t.data.shape, t.data.dtype) for t in outputs]
         self.n_outputs = len(outputs)
+        # for create_graph (double grad): the primal closure over the diff
+        # inputs, re-vjp'd THROUGH the tape so grads-of-grads exist
+        # (parity: hand-written double-grad kernels, e.g.
+        # matmul_v2_op.cc MatMulV2GradGrad)
+        self.primal_fn = primal_fn
+        self.diff_idx = diff_idx
 
 
-def record(name, vjp_fn, inputs, input_needs_grad, outputs):
-    node = Node(name, vjp_fn, inputs, input_needs_grad, outputs)
+def record(name, vjp_fn, inputs, input_needs_grad, outputs,
+           primal_fn=None, diff_idx=None):
+    node = Node(name, vjp_fn, inputs, input_needs_grad, outputs,
+                primal_fn=primal_fn, diff_idx=diff_idx)
     for t in outputs:
         t._node = node
     return node
@@ -92,7 +102,7 @@ def _accumulate(slot, idx, value):
 
 
 def backward(tensors, grad_tensors=None, retain_graph=False, capture=None,
-             accumulate_leaves=None):
+             accumulate_leaves=None, create_graph=False):
     """Run reverse-mode over the tape from `tensors`.
 
     Parity: paddle.autograd.backward / Tensor.backward →
@@ -100,6 +110,11 @@ def backward(tensors, grad_tensors=None, retain_graph=False, capture=None,
     id(tensor)->None) is given, grads reaching those tensors are stored there
     and leaf `.grad` fields are left untouched — the PartialGradEngine mode
     used by paddle.grad (partial_grad_engine.cc).
+
+    With `create_graph=True` cotangents are carried as live Tensors and each
+    node's vjp is re-derived from its recorded primal closure THROUGH
+    run_op, so the produced grads are themselves differentiable
+    (higher-order grad; parity: partial_grad_engine.cc create_graph).
     """
     from .tensor import Tensor
     if accumulate_leaves is None:
@@ -111,21 +126,40 @@ def backward(tensors, grad_tensors=None, retain_graph=False, capture=None,
     elif isinstance(grad_tensors, Tensor):
         grad_tensors = [grad_tensors]
 
+    def _wrap(g):
+        """Cotangent representation: raw array normally, live Tensor when
+        building a differentiable backward."""
+        if create_graph:
+            return g if isinstance(g, Tensor) else Tensor(
+                g, stop_gradient=False)
+        return g.data if isinstance(g, Tensor) else g
+
     # node_id -> (node, [cotangent per output])
     pending = {}
     roots = []
     # leaf grads accumulate here during the walk; hooks run ONCE on the
     # fully-summed value at the end (paddle/torch hook semantics)
     leaf_grads = {}   # id(t) -> (tensor, grad)
+    # ids whose hooks already fired at node-pop (intermediate capture
+    # targets) — finalize must not fire them a second time
+    hook_done = set()
 
     def _apply_hooks(t, g):
         hooks = getattr(t, '_grad_hooks', None)
         if hooks:
             from .tensor import Tensor as _T
+            arr = g.data if isinstance(g, _T) else g
+            changed = False
             for hook in list(hooks.values()):
-                r = hook(_T(g, stop_gradient=True))
+                r = hook(_T(arr, stop_gradient=True))
                 if r is not None:
-                    g = r.data if isinstance(r, _T) else r
+                    arr = r.data if isinstance(r, _T) else r
+                    changed = True
+            if changed:
+                # a replacing hook detaches the value (hooks are opaque
+                # Python; no double-grad through them)
+                g = _wrap(arr)
+            # unchanged: keep the original (possibly live) cotangent
         return g
 
     def leaf_store(t, g):
@@ -156,9 +190,9 @@ def backward(tensors, grad_tensors=None, retain_graph=False, capture=None,
             if t.size != 1:
                 raise RuntimeError(
                     "backward() on a non-scalar tensor requires grad_tensors")
-            garr = jnp.ones_like(t.data)
+            garr = _wrap(jnp.ones_like(t.data))
         else:
-            garr = g.data if isinstance(g, Tensor) else jnp.asarray(g)
+            garr = _wrap(g if isinstance(g, Tensor) else jnp.asarray(g))
         seed_grad(t, garr)
         roots.append(t)
 
@@ -175,15 +209,26 @@ def backward(tensors, grad_tensors=None, retain_graph=False, capture=None,
         for i, (shape, dt) in enumerate(node.out_meta):
             ct = cotangents[i]
             if ct is None:
-                ct = jnp.zeros(shape, dt)
+                ct = _wrap(jnp.zeros(shape, dt))
             else:
                 out_t = node.outputs[i]()
-                if out_t is not None and getattr(out_t, '_grad_hooks',
-                                                 None):
-                    # summed cotangent for this tensor is now final
-                    ct = _apply_hooks(out_t, ct)
+                if out_t is not None:
+                    if getattr(out_t, '_grad_hooks', None):
+                        # summed cotangent for this tensor is now final
+                        ct = _apply_hooks(out_t, ct)
+                        hook_done.add(id(out_t))
+                    if capture is not None and id(out_t) in capture:
+                        # store the FINAL (post-hook) cotangent for the
+                        # captured intermediate; overwrites the running
+                        # pre-hook sum leaf_store accumulated on in-flow
+                        leaf_grads[id(out_t)] = (out_t, ct)
+                        hook_done.add(id(out_t))
             cts.append(ct)
-        in_grads = node.vjp_fn(tuple(cts) if node.n_outputs > 1 else cts[0])
+        if create_graph:
+            in_grads = _differentiable_vjp(node, cts)
+        else:
+            in_grads = node.vjp_fn(
+                tuple(cts) if node.n_outputs > 1 else cts[0])
         for t, needs, g in zip(node.inputs, node.input_needs_grad, in_grads):
             if not needs or g is None:
                 continue
@@ -206,7 +251,8 @@ def backward(tensors, grad_tensors=None, retain_graph=False, capture=None,
     # finalize leaves: hooks on the fully-accumulated grads, then route to
     # capture or .grad
     for tid, (t, g) in leaf_grads.items():
-        g = _apply_hooks(t, g)
+        if tid not in hook_done:
+            g = _apply_hooks(t, g)
         if capture is not None and tid in capture:
             capture[tid] = g if capture[tid] is None else capture[tid] + g
         elif accumulate_leaves or capture is None:
@@ -217,8 +263,44 @@ def backward(tensors, grad_tensors=None, retain_graph=False, capture=None,
             _release_graph(t)
 
 
+def _differentiable_vjp(node, cts):
+    """Apply a node's vjp THROUGH the tape: re-derive it from the recorded
+    primal closure with jax.vjp inside run_op, so the resulting grads carry
+    their own tape nodes (create_graph / double grad).
+
+    Parity: the reference's per-op GradGrad kernels (e.g.
+    operators/matmul_v2_op.cc MatMulV2GradGrad) — here one generic rule
+    covers every op because jax.vjp composes.
+    """
+    from .tensor import Tensor
+    if node.primal_fn is None:
+        raise RuntimeError(
+            f"create_graph=True: op '{node.name}' has no recorded primal "
+            "closure (custom PyLayer/recompute vjp) — double grad through "
+            "it is unsupported")
+    diff_in = [node.inputs[i] for i in node.diff_idx]
+    nd = len(diff_in)
+    multi = node.n_outputs > 1
+    ct_tensors = [c if isinstance(c, Tensor) else Tensor(c) for c in cts]
+
+    def _ho(*arrays, _f=node.primal_fn, _nd=nd, _multi=multi):
+        prim, ctl = arrays[:_nd], arrays[_nd:]
+        _, vjp = jax.vjp(_f, *prim)
+        return tuple(vjp(tuple(ctl) if _multi else ctl[0]))
+
+    gt = run_op('grad_' + node.name, _ho,
+                tuple(diff_in) + tuple(ct_tensors))
+    gt = gt if isinstance(gt, tuple) else (gt,)
+    in_grads = [None] * len(node.inputs)
+    for j, i in enumerate(node.diff_idx):
+        in_grads[i] = gt[j]
+    return in_grads
+
+
 def _leaf_accumulate(t, g):
     from .tensor import Tensor
+    if isinstance(g, Tensor):
+        g = g.data
     if g.dtype != t.data.dtype:
         g = g.astype(t.data.dtype)
     if t.grad is None:
@@ -237,6 +319,7 @@ def _release_graph(root):
             continue
         seen.add(node.id)
         node.vjp_fn = None
+        node.primal_fn = None   # closes over input arrays — must free too
         for t in node.inputs:
             if t._node is not None and t._node.vjp_fn is not None:
                 stack.append(t._node)
@@ -315,5 +398,6 @@ def run_op(name, fn, tensor_args, static_kwargs=None, n_nondiff=0):
     out_tensors = [Tensor(o, stop_gradient=not trace) for o in outs]
 
     if trace:
-        record(name, full_vjp, list(tensor_args), needs, out_tensors)
+        record(name, full_vjp, list(tensor_args), needs, out_tensors,
+               primal_fn=closed, diff_idx=tuple(diff_idx))
     return tuple(out_tensors) if multi else out_tensors[0]

@@ -351,25 +351,28 @@ def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
 
 
 def send(tensor, dst=0, group=None, sync_op=True, use_calc_stream=True):
-    """Parity: send_v2 → CollectivePermute toward dst (paired with recv in
-    the same SPMD program — see fleet p2p for the pipeline usage)."""
+    """Parity: send_v2. Point-to-point send is inherently per-rank control
+    flow; under single-controller SPMD one traced program runs on EVERY
+    device, so "my rank" is not a Python constant (get_rank returns the
+    host process rank) — use ppermute(tensor, pairs) / shift() with an
+    explicit pair list instead (the pipeline engines do)."""
     axes = _group_axes(group)
     if in_spmd_region() and axes:
-        n = lax.psum(1, axes[0])
-        # materialize a permute shifting data src->dst; the matching recv
-        # reads it. Standalone eager send is host-mediated (not supported
-        # single-process).
-        return ppermute(tensor, [(get_rank(group), dst)], group)
+        raise NotImplementedError(
+            "standalone send() inside an SPMD region cannot infer the "
+            "per-device source rank; use dist.ppermute(tensor, "
+            f"[(src, {dst})], group) or dist.shift() with explicit pairs")
     return tensor
 
 
 def recv(tensor, src=0, group=None, sync_op=True, use_calc_stream=True):
+    """Parity: recv_v2 — see send() for the SPMD p2p story."""
     axes = _group_axes(group)
     if in_spmd_region() and axes:
-        out = ppermute(tensor, [(src, get_rank(group))], group)
-        tensor._data = out._data
-        tensor._node = out._node
-        return tensor
+        raise NotImplementedError(
+            "standalone recv() inside an SPMD region cannot infer the "
+            "per-device destination rank; use dist.ppermute(tensor, "
+            f"[({src}, dst)], group) with explicit pairs")
     return tensor
 
 

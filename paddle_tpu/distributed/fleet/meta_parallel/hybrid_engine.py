@@ -275,16 +275,16 @@ class HybridParallelTrainStep:
     def __call__(self, *batch):
         arrays = tuple(b.data if isinstance(b, Tensor) else jnp.asarray(b)
                        for b in batch)
+        ddeg = self.dp * self.sharding_deg
+        for i, a in enumerate(arrays):
+            if a.ndim >= 1 and a.shape[0] % ddeg != 0:
+                raise ValueError(
+                    f"batch arg {i} has leading dim {a.shape[0]}, not "
+                    f"divisible by dp*sharding = {self.dp}*"
+                    f"{self.sharding_deg} = {ddeg} (ZeRO 'sharding' "
+                    f"ranks are data-parallel ranks)")
         if self._compiled is None:
             self._batch_ndims = tuple(a.ndim for a in arrays)
-            ddeg = self.dp * self.sharding_deg
-            for i, a in enumerate(arrays):
-                if a.ndim >= 1 and a.shape[0] % ddeg != 0:
-                    raise ValueError(
-                        f"batch arg {i} has leading dim {a.shape[0]}, not "
-                        f"divisible by dp*sharding = {self.dp}*"
-                        f"{self.sharding_deg} = {ddeg} (ZeRO 'sharding' "
-                        f"ranks are data-parallel ranks)")
             self._compiled = self._build()
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         key = rng_mod.next_key()

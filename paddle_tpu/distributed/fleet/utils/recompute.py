@@ -54,7 +54,11 @@ class RecomputeFunction(PyLayer):
             saved_rng = rng_mod.get_rng_state()
             rng_mod.set_rng_state(ctx.fw_rng_state)
         try:
-            outputs = ctx.run_function(*inputs)
+            # PyLayer.apply calls backward under no_grad; the re-forward
+            # must build a tape, and parameter grads must accumulate into
+            # .grad (accumulate_leaves) — the whole point of recompute.
+            with ag.enable_grad():
+                outputs = ctx.run_function(*inputs)
         finally:
             if saved_rng is not None:
                 rng_mod.set_rng_state(saved_rng)
@@ -63,7 +67,8 @@ class RecomputeFunction(PyLayer):
         outs = [o for o in outs if isinstance(o, Tensor)]
         gts = list(grads)[:len(outs)]
         cap = {id(d): None for d in detached if not d.stop_gradient}
-        ag.backward(list(outs), gts, retain_graph=False, capture=cap)
+        ag.backward(list(outs), gts, retain_graph=False, capture=cap,
+                    accumulate_leaves=True)
         return tuple(Tensor(cap[id(d)]) if cap.get(id(d)) is not None
                      else None for d in detached)
 

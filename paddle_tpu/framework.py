@@ -138,11 +138,20 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     capture = {id(t): None for t in inputs}
     _autograd.backward(outputs, grad_outputs,
                        retain_graph=True if retain_graph is None else retain_graph,
-                       capture=capture)
+                       capture=capture, create_graph=create_graph)
     grads = []
-    for t in inputs:
+    for i, t in enumerate(inputs):
         g = capture[id(t)]
         if g is None and not allow_unused:
-            g = jnp.zeros_like(t.data)
-        grads.append(Tensor(g) if g is not None else None)
+            raise RuntimeError(
+                f"paddle.grad: input {i} is unreachable from outputs "
+                "(no grad path); pass allow_unused=True to get None "
+                "instead")
+        if g is None:
+            grads.append(None)
+        elif isinstance(g, Tensor):
+            # create_graph: keep the live tape so grads are differentiable
+            grads.append(g)
+        else:
+            grads.append(Tensor(g, stop_gradient=True))
     return grads

@@ -136,3 +136,96 @@ def test_register_hook():
     (z * z).backward()
     np.testing.assert_allclose(seen[0], [16.0])
     np.testing.assert_allclose(y.grad.numpy(), [64.0])
+
+
+# ---- round-2 fixes (ADVICE.md) ----------------------------------------------
+
+def test_double_grad_create_graph():
+    """d2/dx2 of x^2 = 2 (ADVICE: create_graph was silently ignored).
+    Parity: PartialGradEngine create_graph (partial_grad_engine.cc)."""
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * x
+    (g1,) = paddle.framework.grad(y, x, create_graph=True)
+    assert not g1.stop_gradient
+    (g2,) = paddle.framework.grad(g1, x)
+    np.testing.assert_allclose(g1.numpy(), [6.0])
+    np.testing.assert_allclose(g2.numpy(), [2.0])
+
+
+def test_double_grad_mixed_expression():
+    """grad of (dy/dx)^2 for y = x^3: d/dx (3x^2)^2 = 36 x^3."""
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x * x
+    (g1,) = paddle.framework.grad(y, x, create_graph=True)
+    loss = (g1 * g1).sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [36.0 * 8.0], rtol=1e-6)
+
+
+def test_triple_grad():
+    """d3/dx3 of x^4 = 24x."""
+    x = paddle.to_tensor([1.5], stop_gradient=False)
+    y = x * x * x * x
+    (g1,) = paddle.framework.grad(y, x, create_graph=True)
+    (g2,) = paddle.framework.grad(g1, x, create_graph=True)
+    (g3,) = paddle.framework.grad(g2, x)
+    np.testing.assert_allclose(g3.numpy(), [24.0 * 1.5], rtol=1e-6)
+
+
+def test_grad_allow_unused_raises():
+    """ADVICE: allow_unused=False must raise, not mask with zeros."""
+    import pytest
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    z = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * 2).sum()
+    with pytest.raises(RuntimeError, match="unreachable"):
+        paddle.framework.grad(y, [x, z])
+    gx, gz = paddle.framework.grad((x * 2).sum(), [x, z],
+                                   allow_unused=True)
+    np.testing.assert_allclose(gx.numpy(), [2.0])
+    assert gz is None
+
+
+def test_hook_fires_once_for_captured_intermediate():
+    """ADVICE: grad hook double-fired when the hooked intermediate is also
+    a paddle.grad capture target."""
+    calls = []
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x
+    y.register_hook(lambda g: calls.append(float(g.numpy()[0])) or None)
+    (gy,) = paddle.framework.grad((y * y).sum(), y)
+    assert len(calls) == 1, calls
+    np.testing.assert_allclose(gy.numpy(), [8.0])
+
+
+def test_recompute_pylayer_accumulates_param_grads():
+    """ADVICE: RecomputeFunction.apply returned None grads (re-forward ran
+    under no_grad). Parity: fleet/utils/recompute.py:63."""
+    from paddle_tpu.distributed.fleet.utils.recompute import (
+        RecomputeFunction)
+    x = paddle.to_tensor([[1.0, 2.0]], stop_gradient=False)
+    w = paddle.to_tensor([[1.0], [3.0]], stop_gradient=False)
+
+    def fn(a):
+        return paddle.matmul(a, w)
+
+    out = RecomputeFunction.apply(fn, True, x)
+    out.sum().backward()
+    assert x.grad is not None and w.grad is not None
+    np.testing.assert_allclose(x.grad.numpy(), [[1.0, 3.0]])
+    np.testing.assert_allclose(w.grad.numpy(), [[1.0], [2.0]])
+
+
+def test_spmd_standalone_send_recv_raise():
+    """ADVICE: send/recv built wrong ppermute pairs from the host rank;
+    they now refuse inside SPMD regions (use ppermute/shift)."""
+    import pytest
+    import paddle_tpu.distributed.collective as C
+    from paddle_tpu.distributed import topology_runtime
+    topology_runtime.build_mesh(['dp'], [8])
+    t = paddle.to_tensor([1.0])
+    with C.spmd_region(('dp',)):
+        with pytest.raises(NotImplementedError):
+            C.send(t, dst=1)
+        with pytest.raises(NotImplementedError):
+            C.recv(t, src=0)
