@@ -98,7 +98,8 @@ class PipelineParallel(MetaParallelBase):
         if self._spmd_engine is None:
             inner = getattr(optimizer, '_inner_opt', optimizer)
             self._spmd_engine = engine_from_pipeline_layer(
-                self._layers, inner, self.accumulate_steps)
+                self._layers, inner, self.accumulate_steps,
+                schedule=self.schedule_mode)
         inputs = data[0]
         n = (inputs.shape[0] if hasattr(inputs, 'shape')
              else len(inputs))

@@ -18,10 +18,13 @@ part (a)):
     CollectivePermute replacement for send_v2/recv_v2 NCCL pairs;
   * stage-dependent behavior (ingest on stage 0, loss on last stage) is
     `jnp.where` masking — SPMD-uniform code, XLA-friendly;
-  * backward is `jax.grad` through the whole pipelined schedule: scan
-    transposition yields the reverse pipeline automatically (F-then-B, like
-    the reference's dygraph schedule), with `jax.checkpoint` on the block fn
-    for activation recompute;
+  * two schedules, matching section_worker.cc:134-185's schedule_mode pair:
+    '1F1B' (default) hand-interleaves one forward + one backward sub-step
+    per tick with a circular O(pp) stage-input buffer and per-tick local
+    `jax.vjp` (see _build_1f1b); 'F-then-B' takes `jax.grad` through the
+    whole tick scan — scan transposition yields the reverse pipeline
+    automatically, at O(A) boundary-activation cost — with
+    `jax.checkpoint` on the block fn for activation recompute;
   * embedding/head weights are replicated over 'pp'; their grads get
     psum('pp') — exactly allreduce_shared_weight_gradients;
   * dp grad sync = pmean over 'dp'; mp collectives run inside blocks.
@@ -93,7 +96,7 @@ class _HeadWrapper(_Layer):
 
 
 def engine_from_pipeline_layer(pipeline_layer, optimizer, accumulate_steps,
-                               mesh=None, use_remat=True):
+                               mesh=None, use_remat=True, schedule='1F1B'):
     """Build a SpmdPipelineEngine from a PipelineLayer's descs (parity: the
     dygraph PipelineParallel engine construction from pp_layers).
 
@@ -147,7 +150,7 @@ def engine_from_pipeline_layer(pipeline_layer, optimizer, accumulate_steps,
     head = _HeadWrapper(tail, pipeline_layer._loss_fn)
     return SpmdPipelineEngine(embed, blocks, head, optimizer,
                               accumulate_steps, mesh=mesh,
-                              use_remat=use_remat)
+                              use_remat=use_remat, schedule=schedule)
 
 
 class SpmdPipelineEngine:
@@ -164,13 +167,19 @@ class SpmdPipelineEngine:
     """
 
     def __init__(self, embed, blocks, head, optimizer, accumulate_steps,
-                 mesh=None, use_remat=True):
+                 mesh=None, use_remat=True, schedule='1F1B'):
         self.embed = embed
         self.blocks = blocks
         self.head = head
         self.optimizer = optimizer
         self.A = accumulate_steps
         self.use_remat = use_remat
+        if schedule in ('FThenB', 'F-then-B'):
+            schedule = 'F-then-B'
+        elif schedule != '1F1B':
+            raise ValueError(f"unknown pipeline schedule {schedule!r}; "
+                             "expected '1F1B' or 'F-then-B'")
+        self.schedule = schedule
         self.mesh = mesh if mesh is not None else topology_runtime.get_mesh()
         if self.mesh is None:
             raise ValueError("no mesh registered")
@@ -259,21 +268,18 @@ class SpmdPipelineEngine:
         return out.data
 
     def _build(self):
-        A, pp = self.A, self.pp
-        axes = self.axes
-        embed, head = self.embed, self.head
-        template = self.blocks[0]
-        layers_per_stage = len(self.blocks) // max(pp, 1)
-        use_remat = self.use_remat
-        opt = self.optimizer
-        dp_on = 'dp' in axes and self.mesh.shape['dp'] > 1
+        if self.schedule == '1F1B':
+            return self._build_1f1b()
+        return self._build_fthenb()
 
-        block_apply = functools.partial(self._block_apply, template)
-        if use_remat:
+    # -- shared tail of both schedules ---------------------------------------
+    def _make_stage_forward(self):
+        """(block_params_local, x, key) -> x: scan this stage's blocks."""
+        block_apply = functools.partial(self._block_apply, self.blocks[0])
+        if self.use_remat:
             block_apply = jax.checkpoint(block_apply)
 
         def stage_forward(block_params_local, x, key):
-            """Scan this stage's blocks over the activation."""
             def body(carry, xs):
                 pslice, k = xs
                 return block_apply(pslice, carry, k), None
@@ -282,6 +288,208 @@ class SpmdPipelineEngine:
             keys = jax.random.split(key, n_local)
             out, _ = lax.scan(body, x, (block_params_local, keys))
             return out
+        return stage_forward
+
+    def _reduce_and_update(self, params, states, loss, grads, lr, dp_on):
+        """Cross-axis loss/grad reductions + optimizer update (both
+        schedules): tied/replicated trees (embed, head) psum over pp;
+        everything pmeans over dp."""
+        pp = self.pp
+        if pp > 1:
+            loss = lax.psum(loss, 'pp')  # only last stage ≠ 0
+        if dp_on:
+            loss = lax.pmean(loss, 'dp')
+
+        def sync(tree, over_pp):
+            def one(g):
+                if over_pp and pp > 1:
+                    g = lax.psum(g, 'pp')
+                if dp_on:
+                    g = lax.pmean(g, 'dp')
+                return g
+            return jax.tree_util.tree_map(one, tree)
+
+        grads = {'embed': sync(grads['embed'], True),
+                 'blocks': sync(grads['blocks'], False),
+                 'head': sync(grads['head'], True)}
+
+        new_params, new_states = {}, {}
+        for grp in ('embed', 'blocks', 'head'):
+            new_params[grp], new_states[grp] = {}, {}
+            for n, p in params[grp].items():
+                np_, ns = self._update_one(
+                    p, grads[grp][n], dict(states[grp][n]), lr)
+                new_params[grp][n] = np_
+                new_states[grp][n] = ns
+        return loss, new_params, new_states
+
+    def _finalize(self, step, dp_on):
+        dp_sp = P('dp') if dp_on else P()
+        in_specs = (self._specs, self._state_specs, P(), P(), dp_sp, dp_sp)
+        out_specs = (P(), self._specs, self._state_specs)
+        mapped = shard_map(step, mesh=self.mesh, in_specs=in_specs,
+                           out_specs=out_specs, check_rep=False)
+        return jax.jit(mapped, donate_argnums=(0, 1))
+
+    def _build_1f1b(self):
+        """1F1B steady-state schedule (section_worker.cc:147-184 parity).
+
+        TPU-native formulation: ONE `lax.scan` over T = A + 2*(pp-1) ticks.
+        Every tick, every stage runs one forward sub-step (microbatch
+        m_f = t - stage) and one backward sub-step (microbatch
+        m_b = t - (2*(pp-1) - stage)), lockstep-SPMD with `jnp.where`
+        masking outside the active windows. Activations flow +1 over the
+        'pp' ring and cotangents flow -1, one `lax.ppermute` each per tick.
+
+        Memory: only the stage-INPUT activation of each in-flight
+        microbatch is kept, in a circular buffer of B = min(A, 2*pp-1)
+        slots; backward re-runs the stage from the saved input via a
+        local `jax.vjp` consumed in the same tick (full-remat cost, same
+        as the F-then-B path's jax.checkpoint). Live boundary activations
+        are therefore O(pp), not O(A) — the reference 1F1B's memory
+        property (in-flight <= 2*(pp-1)+1 here vs Megatron's pp: the
+        constant-factor price of every stage doing fwd+bwd each tick in
+        lockstep). Stage 0 embeds each microbatch on its tick — no
+        [A, mb, L, H] up-front buffer.
+        """
+        A, pp = self.A, self.pp
+        axes = self.axes
+        embed, head = self.embed, self.head
+        opt = self.optimizer
+        dp_on = 'dp' in axes and self.mesh.shape['dp'] > 1
+        B = min(A, 2 * pp - 1)
+        T = A + 2 * (pp - 1)
+        stage_forward = self._make_stage_forward()
+
+        def step(params, states, lr, key, input_ids, labels):
+            with C.spmd_region(axes):
+                stage = lax.axis_index('pp') if pp > 1 else 0
+                is_last = stage == pp - 1
+                mb = input_ids.shape[0] // A
+                pe, pb, ph = params['embed'], params['blocks'], params['head']
+                k0 = key
+                if dp_on:
+                    k0 = jax.random.fold_in(k0, lax.axis_index('dp'))
+
+                ids_mb = input_ids.reshape(A, mb, *input_ids.shape[1:])
+                labels_mb = labels.reshape(A, mb, *labels.shape[1:])
+
+                def embed_apply(pe_, ids_m, k):
+                    with bind_arrays(embed, pe_):
+                        with rng_mod.rng_guard(k), autograd.no_grad():
+                            return embed(Tensor(ids_m)).data
+
+                def head_apply(ph_, out, lab, k):
+                    with bind_arrays(head, ph_):
+                        with rng_mod.rng_guard(k), autograd.no_grad():
+                            return head(Tensor(out), Tensor(lab)).data \
+                                .astype(jnp.float32)
+
+                emb_shape = jax.eval_shape(
+                    embed_apply, pe, ids_mb[0], k0)
+                act_shape, act_dtype = emb_shape.shape, emb_shape.dtype
+
+                def fwd_only(pe_, pb_, x_in, m, k_mb):
+                    """Forward sub-step: embed (stage 0) + local blocks.
+                    Keys derive from (microbatch, stage) so the backward
+                    recompute replays identical dropout."""
+                    ke = jax.random.fold_in(k_mb, 17)
+                    ks = jax.random.fold_in(
+                        jax.random.fold_in(k_mb, 31), stage)
+                    if pp > 1:
+                        x = lax.cond(
+                            stage == 0,
+                            lambda: embed_apply(pe_, ids_mb[m], ke),
+                            lambda: x_in)
+                    else:
+                        x = embed_apply(pe_, ids_mb[m], ke)
+                    return stage_forward(pb_, x, ks)
+
+                def full_fn(p3, x_in, m, k_mb):
+                    """fwd_only + head loss (last stage) — the function the
+                    backward sub-step differentiates."""
+                    pe_, pb_, ph_ = p3
+                    out = fwd_only(pe_, pb_, x_in, m, k_mb)
+                    kh = jax.random.fold_in(k_mb, 7919)
+                    if pp > 1:
+                        loss = lax.cond(
+                            is_last,
+                            lambda: head_apply(ph_, out, labels_mb[m], kh),
+                            lambda: jnp.asarray(0.0, jnp.float32))
+                    else:
+                        loss = head_apply(ph_, out, labels_mb[m], kh)
+                    return out, loss
+
+                gacc0 = jax.tree_util.tree_map(
+                    lambda a: jnp.zeros(a.shape, jnp.float32), (pe, pb, ph))
+                carry0 = (jnp.zeros(act_shape, act_dtype),          # fwd act
+                          jnp.zeros(act_shape, act_dtype),          # cotangent
+                          jnp.zeros((B,) + act_shape, act_dtype),   # inputs buf
+                          gacc0,
+                          jnp.asarray(0.0, jnp.float32))            # loss acc
+
+                def tick(carry, t):
+                    fwd_act, grad_in, buf, gacc, loss_acc = carry
+
+                    # ---- forward sub-step: microbatch m_f = t - stage ----
+                    m_f = t - stage
+                    f_active = (m_f >= 0) & (m_f < A)
+                    m_fc = jnp.clip(m_f, 0, A - 1)
+                    out_f = fwd_only(pe, pb, fwd_act, m_fc,
+                                     jax.random.fold_in(k0, m_fc))
+                    # stash this microbatch's stage input for its backward
+                    slot_f = jnp.mod(m_fc, B)
+                    old = lax.dynamic_index_in_dim(buf, slot_f, 0,
+                                                   keepdims=False)
+                    buf = lax.dynamic_update_index_in_dim(
+                        buf, jnp.where(f_active, fwd_act, old), slot_f, 0)
+
+                    # ---- backward sub-step: m_b = t - (2(pp-1) - stage) --
+                    m_b = t - (2 * (pp - 1) - stage)
+                    b_active = (m_b >= 0) & (m_b < A)
+                    m_bc = jnp.clip(m_b, 0, A - 1)
+                    x_saved = lax.dynamic_index_in_dim(buf, jnp.mod(m_bc, B),
+                                                       0, keepdims=False)
+                    k_b = jax.random.fold_in(k0, m_bc)
+                    (_out_p, loss_p), vjp_fn = jax.vjp(
+                        lambda p3, x: full_fn(p3, x, m_bc, k_b),
+                        (pe, pb, ph), x_saved)
+                    g_out = jnp.where(is_last, jnp.zeros_like(_out_p),
+                                      grad_in.astype(_out_p.dtype))
+                    d_p3, dx = vjp_fn((g_out,
+                                       jnp.asarray(1.0 / A, jnp.float32)))
+                    gacc = jax.tree_util.tree_map(
+                        lambda a, g: a + jnp.where(b_active,
+                                                   g.astype(jnp.float32), 0.),
+                        gacc, d_p3)
+                    loss_acc = loss_acc + jnp.where(b_active, loss_p, 0.0)
+                    dx = jnp.where(b_active, dx, jnp.zeros_like(dx))
+
+                    if pp > 1:
+                        nxt_act = lax.ppermute(
+                            out_f, 'pp',
+                            [(i, (i + 1) % pp) for i in range(pp)])
+                        nxt_grad = lax.ppermute(
+                            dx, 'pp', [(i, (i - 1) % pp) for i in range(pp)])
+                    else:
+                        nxt_act, nxt_grad = out_f, dx
+                    return (nxt_act, nxt_grad, buf, gacc, loss_acc), None
+
+                (_, _, _, gacc, loss_sum), _ = lax.scan(
+                    tick, carry0, jnp.arange(T))
+                grads = {'embed': gacc[0], 'blocks': gacc[1],
+                         'head': gacc[2]}
+                return self._reduce_and_update(
+                    params, states, loss_sum / A, grads, lr, dp_on)
+
+        return self._finalize(step, dp_on)
+
+    def _build_fthenb(self):
+        A, pp = self.A, self.pp
+        axes = self.axes
+        embed, head = self.embed, self.head
+        dp_on = 'dp' in axes and self.mesh.shape['dp'] > 1
+        stage_forward = self._make_stage_forward()
 
         def step(params, states, lr, key, input_ids, labels):
             with C.spmd_region(axes):
@@ -369,43 +577,10 @@ class SpmdPipelineEngine:
                     return loss_sum / A
 
                 loss, grads = jax.value_and_grad(loss_of)(params)
-                if pp > 1:
-                    loss = lax.psum(loss, 'pp')  # only last stage ≠ 0
-                if dp_on:
-                    loss = lax.pmean(loss, 'dp')
+                return self._reduce_and_update(
+                    params, states, loss, grads, lr, dp_on)
 
-                # grad syncs: tied/replicated trees psum over pp;
-                # dp mean everywhere
-                def sync(tree, over_pp):
-                    def one(g):
-                        if over_pp and pp > 1:
-                            g = lax.psum(g, 'pp')
-                        if dp_on:
-                            g = lax.pmean(g, 'dp')
-                        return g
-                    return jax.tree_util.tree_map(one, tree)
-
-                grads = {'embed': sync(grads['embed'], True),
-                         'blocks': sync(grads['blocks'], False),
-                         'head': sync(grads['head'], True)}
-
-                new_params, new_states = {}, {}
-                for grp in ('embed', 'blocks', 'head'):
-                    new_params[grp], new_states[grp] = {}, {}
-                    for n, p in params[grp].items():
-                        np_, ns = self._update_one(
-                            p, grads[grp][n], dict(states[grp][n]), lr)
-                        new_params[grp][n] = np_
-                        new_states[grp][n] = ns
-                return loss, new_params, new_states
-
-        in_specs = (self._specs, self._state_specs, P(), P(),
-                    P('dp') if dp_on else P(),
-                    P('dp') if dp_on else P())
-        out_specs = (P(), self._specs, self._state_specs)
-        mapped = shard_map(step, mesh=self.mesh, in_specs=in_specs,
-                           out_specs=out_specs, check_rep=False)
-        return jax.jit(mapped, donate_argnums=(0, 1))
+        return self._finalize(step, dp_on)
 
     def _update_one(self, p, g, st, lr):
         opt = self.optimizer
