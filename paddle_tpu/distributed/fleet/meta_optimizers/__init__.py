@@ -205,20 +205,32 @@ class LambOptimizer(MetaOptimizerBase):
 
 class PipelineOptimizer(MetaOptimizerBase):
     """Parity: fleet pipeline_optimizer.py:28 over fluid
-    PipelineOptimizer:4135 (the program splitter). The TPU pipeline executes
-    as one SPMD program (meta_parallel/spmd_pipeline.py); the static-path
-    rewrite records stage/microbatch config on the Program."""
+    PipelineOptimizer:4135 (the program splitter). After the inner minimize
+    records backward + optimize ops, the program is REALLY split: one
+    program per stage keyed on op_device, send_v2/recv_v2 at boundaries
+    (static/pipeline_pass.py). The SPMD engine
+    (meta_parallel/spmd_pipeline.py) remains the multi-chip fast path."""
 
     def _can_apply(self):
         return bool(self.user_defined_strategy.pipeline)
 
     def minimize_impl(self, loss, startup_program=None, parameter_list=None,
                       no_grad_set=None):
+        from ....static.pipeline_pass import split_program, _stage_of
         prog = loss.block.program
         prog._pipeline_opt = dict(
             self.user_defined_strategy.pipeline_configs)
-        return self.inner_opt.minimize(loss, startup_program,
-                                       parameter_list, no_grad_set)
+        out = self.inner_opt.minimize(loss, startup_program,
+                                      parameter_list, no_grad_set)
+        # num_stages = highest device_guard stage annotation + 1
+        stages = [s for op in prog.global_block().ops
+                  for s in [_stage_of(op.op_device, 1 << 30)]
+                  if s is not None]
+        if stages and max(stages) > 0:
+            progs, rings = split_program(prog, max(stages) + 1)
+            prog._pipeline_stage_programs = progs
+            prog._pipeline_pair_rings = rings
+        return out
 
 
 class TensorParallelOptimizer(MetaOptimizerBase):
@@ -237,20 +249,35 @@ class TensorParallelOptimizer(MetaOptimizerBase):
 
 
 class ShardingOptimizer(MetaOptimizerBase):
-    """Parity: sharding_optimizer.py:43 (ZeRO-1/2 rewrite; composition rules
-    A.2). TPU static path: parameters/optimizer state are annotated to shard
-    over the 'sharding' mesh axis; GSPMD inserts reduce-scatter/all-gather —
-    the weight-update sharding transform from the XLA literature."""
+    """Parity: sharding_optimizer.py:43 (ZeRO-1/2). After the inner
+    minimize records backward + optimize ops, the program is REALLY
+    rewritten for this rank (static/sharding_pass.py): per-grad
+    c_reduce_sum/c_allreduce_sum, non-owned optimize ops + state pruned,
+    c_broadcast of updated params. On a real mesh the same semantics run
+    through the hybrid SPMD engine (GSPMD reduce-scatter/all-gather)."""
 
     def _can_apply(self):
         return bool(self.user_defined_strategy.sharding)
 
     def minimize_impl(self, loss, startup_program=None, parameter_list=None,
                       no_grad_set=None):
+        from ....static.sharding_pass import shard_program
         prog = loss.block.program
-        prog._sharding = dict(self.user_defined_strategy.sharding_configs)
-        return self.inner_opt.minimize(loss, startup_program,
-                                       parameter_list, no_grad_set)
+        cfg = dict(self.user_defined_strategy.sharding_configs)
+        prog._sharding = cfg
+        out = self.inner_opt.minimize(loss, startup_program,
+                                      parameter_list, no_grad_set)
+        degree = int(cfg.get('sharding_degree', 1) or 1)
+        if degree > 1:
+            rank = 0
+            if self.role_maker is not None:
+                try:
+                    rank = self.role_maker._worker_index()
+                except Exception:
+                    rank = 0
+            shard_program(prog, rank % degree, degree,
+                          stage=int(cfg.get('stage', 2) or 2))
+        return out
 
 
 class DGCOptimizer(MetaOptimizerBase):

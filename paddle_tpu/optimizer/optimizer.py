@@ -192,18 +192,113 @@ class Optimizer:
                  no_grad_set=None):
         from ..static import program as sprog
         if sprog.in_static_mode():
-            # Static path (parity: Optimizer.minimize appending backward +
-            # optimize ops to the Program): append_backward marks grads; the
-            # Executor's jitted replay calls functional_apply with state
-            # threaded through the Scope.
+            # Static path (parity: Optimizer.minimize = append_backward +
+            # apply_gradients appending one optimize op per parameter,
+            # fluid/optimizer.py _append_optimize_op). Real Optimize-role
+            # ops land in the Program so distributed rewrites (sharding
+            # prune, pipeline split) can move/delete them like the
+            # reference passes do.
             from ..static.backward import append_backward
             params_grads = append_backward(loss, parameter_list=parameters)
             prog = loss.block.program
             prog._optimizer = self
+            self._append_optimize_ops(prog, params_grads)
             return [], params_grads
         loss.backward()
         self.step()
         return [], []
+
+    def _append_optimize_ops(self, prog, params_grads):
+        """Record Optimize-role ops into a static Program: an optional
+        global-norm clip op over all grads, then one `<optimizer>` op per
+        parameter whose state is threaded through persistable vars named
+        `<param>_<opt>_<state>_0` (reference: accumulator naming of
+        fluid/optimizer.py _add_accumulator)."""
+        from ..static.program import Variable, Operator, OpRole
+        from ..nn.clip import (ClipGradByGlobalNorm, ClipGradByNorm,
+                               ClipGradByValue)
+        block = prog.global_block()
+        if '@LR' not in block.vars:
+            block.vars['@LR'] = Variable(block, '@LR', [], 'float32',
+                                         persistable=True)
+        op_type = type(self).__name__.lower()
+
+        grads = [g for _, g in params_grads if g is not None]
+        if isinstance(self._grad_clip, ClipGradByGlobalNorm) and grads:
+            cn = float(self._grad_clip.clip_norm)
+
+            def clip_fn(*gs, _cn=cn):
+                sq = sum(jnp.sum(g.astype(jnp.float32) ** 2) for g in gs)
+                factor = _cn / jnp.maximum(jnp.sqrt(sq), _cn)
+                return tuple(g * factor.astype(g.dtype) for g in gs)
+            cop = Operator('clip_by_global_norm', clip_fn,
+                           [g.name for g in grads],
+                           [g.name for g in grads],
+                           {'clip_norm': cn}, op_role=OpRole.Optimize)
+            cop.multi_out = True
+            cop.op_device = 'all'   # spans stages, like the reference's
+            block.append_op(cop)    # global-clip reduction ops (gpu:all)
+
+        for p, g in params_grads:
+            if g is None:
+                continue
+            st_tmpl = self.init_state(
+                Tensor(jnp.zeros(tuple(p.shape), jnp.float32)))
+            low = jnp.dtype(p.dtype) != jnp.float32
+            if low and self._multi_precision:
+                st_tmpl['master'] = None   # placeholder; init from param
+            skeys = sorted(st_tmpl.keys())
+            svars = []
+            for k in skeys:
+                sname = f"{p.name}_{op_type}_{k}_0"
+                if sname not in block.vars:
+                    arr = st_tmpl[k]
+                    if arr is None:   # fp32 master weight
+                        sv = Variable(block, sname, list(p.shape),
+                                      'float32', persistable=True)
+                        sv._init_from = p.name
+                    else:
+                        sv = Variable(block, sname, list(arr.shape),
+                                      str(arr.dtype), persistable=True)
+                        sv.initializer = (
+                            lambda shape, dtype, _a=arr: jnp.asarray(_a))
+                    block.vars[sname] = sv
+                    prog.startup_ops.append(sv)
+                svars.append(sname)
+
+            per_clip = self._grad_clip if isinstance(
+                self._grad_clip, (ClipGradByNorm, ClipGradByValue)) else None
+            plr_scale = getattr(p, 'optimize_attr',
+                                {'learning_rate': 1.0})['learning_rate']
+
+            def opt_fn(p_arr, g_arr, lr_arr, *state_arrs,
+                       _keys=tuple(skeys), _clip=per_clip, _s=plr_scale,
+                       _pdt=None):
+                st = dict(zip(_keys, state_arrs))
+                master = st.pop('master', None)
+                g32 = g_arr.astype(jnp.float32)
+                if isinstance(_clip, ClipGradByNorm):
+                    n = jnp.sqrt(jnp.sum(g32 ** 2))
+                    g32 = g32 * jnp.minimum(
+                        _clip.clip_norm / jnp.maximum(n, 1e-12), 1.0)
+                elif isinstance(_clip, ClipGradByValue):
+                    g32 = jnp.clip(g32, _clip.min, _clip.max)
+                p32 = master if master is not None \
+                    else p_arr.astype(jnp.float32)
+                if self._weight_decay and self._decay_into_grad():
+                    g32 = g32 + self._weight_decay * p32
+                np_, ns = self.update(p32, g32, st, lr_arr * _s)
+                ns = dict(ns)
+                if master is not None:
+                    ns['master'] = np_
+                return (np_.astype(p_arr.dtype),) + tuple(
+                    ns[k] for k in _keys)
+
+            op = Operator(op_type, opt_fn, [p.name, g.name, '@LR'] + svars,
+                          [p.name] + svars, {'param': p.name},
+                          op_role=OpRole.Optimize)
+            op.multi_out = True
+            block.append_op(op)
 
     # -- checkpoint ---------------------------------------------------------------
     def state_dict(self):

@@ -1,19 +1,69 @@
-"""Static autodiff.
+"""Static autodiff — op-level append_backward.
 
 Reference parity: python/paddle/fluid/backward.py append_backward (2,017 LoC,
-per-op GradOpMaker) — here gradients are derived by differentiating the whole
-Program replay with jax.grad at Executor-compile time, which is both simpler
-and XLA-optimal (one fused backward). append_backward's contract is kept:
-grad Variables named `<param>@GRAD` appear in the block, op roles marked, and
-(param, grad) pairs returned for optimizers and the distributed program
-rewrites to key on.
+per-op GradOpMaker): walks the block's ops in reverse from the loss, appends
+one `<type>_grad` op per forward op (inputs = forward inputs + output
+cotangents `<name>@GRAD`, outputs = input cotangents), inserts `sum` ops when
+a variable feeds several consumers, and marks every grad op with
+op_role=Backward and the forward op's op_device. These recorded ops are what
+the distributed program rewrites (pipeline split, sharding prune) key on —
+exactly as in the reference, where the sharding/pipeline passes move/prune
+grad ops by role and device.
+
+TPU-native grad maker: instead of ~700 hand-written GradOpMakers, each grad
+op's fn is derived generically from the forward op's jax fn with `jax.vjp`
+at replay-trace time — XLA CSEs the re-traced forward with the primal pass,
+so the compiled program matches what a hand-fused backward would give.
 """
-from .program import (Variable, Parameter, OpRole, default_main_program)
+import jax
+import jax.numpy as jnp
+
+from ..core import dtypes
+from .program import (Variable, Parameter, Operator, OpRole,
+                      default_main_program, _ConstVar)
+
+
+def _is_float_var(v):
+    try:
+        return dtypes.is_floating(v.dtype)
+    except Exception:
+        return False
+
+
+def _make_grad_fn(op, n_in, n_out, grad_idx):
+    """Build the generic vjp-based grad fn for `op`.
+
+    Signature: (primal inputs..., output cotangents...) ->
+    (cotangents of inputs listed in grad_idx...).
+    """
+    multi = getattr(op, 'multi_out', False) or n_out > 1
+    fwd_fn = op.fn
+
+    def grad_fn(*args):
+        primals, cots = args[:n_in], args[n_in:]
+        _, vjp_fn = jax.vjp(lambda *xs: fwd_fn(*xs), *primals)
+        cot = tuple(cots) if multi else cots[0]
+        dxs = vjp_fn(cot)
+        outs = []
+        for i in grad_idx:
+            d = dxs[i]
+            # jax returns float0 cotangents for int inputs; callers never
+            # request those (grad_idx is float-only), but guard anyway
+            if d.dtype == jax.dtypes.float0:
+                d = jnp.zeros(primals[i].shape, jnp.float32)
+            outs.append(d)
+        return tuple(outs) if len(outs) > 1 else outs[0]
+    return grad_fn
 
 
 def append_backward(loss, parameter_list=None, no_grad_set=None,
                     callbacks=None, checkpoints=None):
-    """Parity: fluid/backward.py append_backward."""
+    """Parity: fluid/backward.py append_backward — appends real grad ops.
+
+    Returns [(param, grad_var)] like the reference; grad vars are named
+    `<param>@GRAD` and the program gains Backward-role ops that the
+    Executor replays like any others.
+    """
     prog = loss.block.program if hasattr(loss, 'block') \
         else default_main_program()
     prog._loss_var = loss
@@ -23,15 +73,143 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
         params = [p for p in prog.all_parameters() if p.trainable]
     else:
         params = [block.var(p) if isinstance(p, str) else p for p in params]
+    no_grad = set()
+    for t in (no_grad_set or []):
+        no_grad.add(t if isinstance(t, str) else t.name)
+
+    # -- forward sweep: which vars (transitively) depend on the params ------
+    needs = {p.name for p in params if p.name not in no_grad}
+    ops = list(block.ops)
+    for op in ops:
+        if any(n in needs for n in op.input_names):
+            needs.update(op.output_names)
+    needs -= no_grad
+
+    grad_of = {}    # var name -> its current cotangent var name
+
+    def _new_grad_var(name, like, suffix=''):
+        gname = name + '@GRAD' + suffix
+        if gname in block.vars:   # uniquify renames
+            k = 0
+            while f"{gname}@RENAME@{k}" in block.vars:
+                k += 1
+            gname = f"{gname}@RENAME@{k}"
+        gv = Variable(block, gname, like.shape, like.dtype)
+        gv.op_role = OpRole.Backward
+        block.vars[gname] = gv
+        return gv
+
+    def _accumulate(name, contrib_name, device):
+        """Point grad_of[name] at contrib, summing with any prior one
+        (parity: backward.py gradient aggregation via `sum` ops)."""
+        prev = grad_of.get(name)
+        if prev is None:
+            grad_of[name] = contrib_name
+            return
+        target = _new_grad_var(name, block.vars[name], suffix='')
+        sum_op = Operator(
+            'sum', lambda *xs: sum(xs[1:], xs[0]),
+            [prev, contrib_name], [target.name], {},
+            op_role=OpRole.Backward)
+        sum_op.op_device = device
+        block.append_op(sum_op)
+        grad_of[name] = target.name
+
+    # -- seed: d loss / d loss = 1 ------------------------------------------
+    if loss.name in needs:
+        seed = _new_grad_var(loss.name, loss)
+        producers = {}
+        for op in ops:
+            for o in op.output_names:
+                producers[o] = op
+        loss_op = producers.get(loss.name)
+        seed_op = Operator('fill_any_like', lambda x: jnp.ones_like(x),
+                           [loss.name], [seed.name], {'value': 1.0},
+                           op_role=OpRole.Backward | OpRole.Loss)
+        seed_op.op_device = loss_op.op_device if loss_op is not None else ''
+        block.append_op(seed_op)
+        grad_of[loss.name] = seed.name
+
+        # -- reverse sweep ---------------------------------------------------
+        for op in reversed(ops):
+            if not any(o in grad_of for o in op.output_names):
+                continue
+            # differentiable inputs that need a cotangent
+            grad_idx = []
+            for i, iname in enumerate(op.input_names):
+                v = block.vars.get(iname)
+                if (iname in needs and v is not None
+                        and not isinstance(v, _ConstVar)
+                        and _is_float_var(v)):
+                    grad_idx.append(i)
+            if not grad_idx:
+                continue
+            # cotangents for every output (zeros where unused)
+            cot_names = []
+            for oname in op.output_names:
+                if oname in grad_of:
+                    cot_names.append(grad_of[oname])
+                else:
+                    zv = _new_grad_var(oname, block.vars[oname])
+                    z_op = Operator('fill_zeros_like',
+                                    lambda x: jnp.zeros_like(x),
+                                    [oname], [zv.name], {},
+                                    op_role=OpRole.Backward)
+                    z_op.op_device = op.op_device
+                    block.append_op(z_op)
+                    cot_names.append(zv.name)
+
+            out_gvars = [_new_grad_var(op.input_names[i],
+                                       block.vars[op.input_names[i]],
+                                       suffix='@TMP')
+                         for i in grad_idx]
+            g_op = Operator(
+                op.type + '_grad',
+                _make_grad_fn(op, len(op.input_names),
+                              len(op.output_names), grad_idx),
+                list(op.input_names) + cot_names,
+                [gv.name for gv in out_gvars], dict(op.attrs),
+                op_role=OpRole.Backward)
+            g_op.multi_out = len(out_gvars) > 1
+            g_op.op_device = op.op_device
+            block.append_op(g_op)
+            for i, gv in zip(grad_idx, out_gvars):
+                _accumulate(op.input_names[i], gv.name, op.op_device)
+
+    # -- bind params to canonical @GRAD names -------------------------------
     params_grads = []
     for p in params:
         gname = p.name + '@GRAD'
-        if gname not in block.vars:
-            g = Variable(block, gname, p.shape, p.dtype)
-            g.op_role = OpRole.Backward
-            block.vars[gname] = g
+        have = grad_of.get(p.name)
+        if have is None:
+            # unreachable param: zero grad (reference errors at runtime
+            # unless the optimizer tolerates empty grads; zeros keep the
+            # optimize op well-formed)
+            if gname not in block.vars:
+                gv = Variable(block, gname, p.shape, p.dtype)
+                gv.op_role = OpRole.Backward
+                block.vars[gname] = gv
+                z = Operator('fill_zeros_like', lambda x: jnp.zeros_like(x),
+                             [p.name], [gname], {}, op_role=OpRole.Backward)
+                block.append_op(z)
+        elif have != gname:
+            # alias the final accumulated grad to <param>@GRAD
+            if gname not in block.vars:
+                gv = Variable(block, gname, p.shape, p.dtype)
+                gv.op_role = OpRole.Backward
+                block.vars[gname] = gv
+            a = Operator('share_data', lambda x: x, [have], [gname], {},
+                         op_role=OpRole.Backward)
+            prod_dev = ''
+            for o in reversed(block.ops):
+                if have in o.output_names:
+                    prod_dev = o.op_device
+                    break
+            a.op_device = prod_dev
+            block.append_op(a)
         prog._grad_map[p.name] = gname
         params_grads.append((p, block.vars[gname]))
+    prog._has_backward_ops = True
     return params_grads
 
 

@@ -87,20 +87,6 @@ class Executor:
 
         param_names, param_arrays = self._collect_params(program, scope)
         opt = getattr(program, '_optimizer', None)
-        states_key = f'__opt_states__/{id(program)}/{id(opt)}'
-        opt_states = scope.find_var(states_key)
-        if opt is not None and opt_states is None:
-            opt_states = {}
-            for name in param_names:
-                arr = scope.find_var(name)
-                st = opt.init_state(Tensor(arr))
-                if arr.dtype != jnp.float32 and \
-                        getattr(opt, '_multi_precision', True):
-                    st['master'] = arr.astype(jnp.float32)
-                opt_states[name] = st
-            scope.set(states_key, opt_states)
-        if opt_states is None:
-            opt_states = {}
         lr = jnp.asarray(opt.get_lr() if opt is not None else 0.0,
                          jnp.float32)
 
@@ -114,12 +100,10 @@ class Executor:
                                                  param_names, fetch_names))
             self._cache[key] = compiled
 
-        fetches, new_params, new_states = compiled(
-            tuple(feed_arrays), tuple(param_arrays), opt_states, lr)
+        fetches, new_params = compiled(
+            tuple(feed_arrays), tuple(param_arrays), lr)
         for name, arr in zip(param_names, new_params):
             scope.set(name, arr)
-        if opt is not None:
-            scope.set(states_key, new_states)
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return [Tensor(f) for f in fetches]
@@ -129,33 +113,52 @@ class Executor:
         from ..nn import initializer as I
         for p in program.startup_ops:
             if scope.find_var(p.name) is None:
-                init = p.initializer or I.XavierUniform()
+                src = getattr(p, '_init_from', None)
+                if src is not None:   # fp32 master weight mirrors its param
+                    scope.set(p.name,
+                              scope.find_var(src).astype(jnp.float32))
+                    continue
+                init = getattr(p, 'initializer', None) or I.XavierUniform()
                 scope.set(p.name, init(p.shape, p.dtype))
         program.startup_ops = []
 
     def _collect_params(self, program, scope):
+        """All persistable state threaded through the jitted replay:
+        Parameters plus optimizer-state vars (recorded by
+        _append_optimize_ops)."""
         names, arrays = [], []
         for v in program.list_vars():
-            if isinstance(v, Parameter):
+            if isinstance(v, _ConstVar) or v.name == '@LR':
+                continue
+            if isinstance(v, Parameter) or v.persistable:
                 arr = scope.find_var(v.name)
                 if arr is None:
                     from ..nn import initializer as I
-                    arr = (v.initializer or I.XavierUniform())(v.shape,
-                                                              v.dtype)
+                    src = getattr(v, '_init_from', None)
+                    if src is not None:
+                        base = scope.find_var(src)
+                        if base is None:
+                            continue
+                        arr = base.astype(jnp.float32)
+                    else:
+                        arr = (getattr(v, 'initializer', None)
+                               or I.XavierUniform())(v.shape, v.dtype)
                     scope.set(v.name, arr)
                 names.append(v.name)
                 arrays.append(arr)
         return names, arrays
 
     def _make_replay(self, program, feed_names, param_names, fetch_names):
+        """Pure op replay: every recorded op (forward, backward, optimize)
+        executes in order inside one jax.jit trace. Gradients and optimizer
+        updates are ordinary ops appended by append_backward /
+        _append_optimize_ops, so distributed rewrites that moved or pruned
+        ops replay exactly what they left in the block."""
         block = program.global_block()
-        loss_name = program._loss_var.name if program._loss_var is not None \
-            else None
-        grad_map = dict(program._grad_map)
-        opt = getattr(program, '_optimizer', None)
+        from .program import run_op_in_env
 
-        def replay(feed_arrays, param_arrays, opt_states, lr):
-            env = {}
+        def replay(feed_arrays, param_arrays, lr):
+            env = {'@LR': lr}
             for name, arr in zip(feed_names, feed_arrays):
                 env[name] = arr
             for name, arr in zip(param_names, param_arrays):
@@ -164,56 +167,12 @@ class Executor:
                 if isinstance(v, _ConstVar):
                     env[v.name] = v.value
 
-            def run_ops():
-                for op in block.ops:
-                    ins = [env[n] for n in op.input_names]
-                    outs = op.fn(*ins)
-                    if not isinstance(outs, (tuple, list)):
-                        outs = (outs,)
-                    for n, o in zip(op.output_names, outs):
-                        env[n] = o
-                return env
-
-            if grad_map and loss_name is not None:
-                # Differentiate the whole replay wrt parameters — the
-                # XLA-native append_backward (fluid/backward.py parity).
-                grad_param_names = [p for p in grad_map
-                                    if p in set(param_names)]
-
-                def loss_of(pa):
-                    env_local = dict(env)
-                    for n, a in zip(grad_param_names, pa):
-                        env_local[n] = a
-                    for op in block.ops:
-                        ins = [env_local[n] for n in op.input_names]
-                        outs = op.fn(*ins)
-                        if not isinstance(outs, (tuple, list)):
-                            outs = (outs,)
-                        for n, o in zip(op.output_names, outs):
-                            env_local[n] = o
-                    return env_local[loss_name].sum(), env_local
-
-                pa = tuple(env[n] for n in grad_param_names)
-                grads, env2 = jax.grad(loss_of, has_aux=True)(pa)
-                env.update(env2)
-                for n, g in zip(grad_param_names, grads):
-                    env[grad_map[n]] = g
-            else:
-                run_ops()
+            for op in block.ops:
+                run_op_in_env(op, env)
 
             new_params = [env[n] for n in param_names]
-            new_states = opt_states
-            if opt is not None and grad_map:
-                params = {n: env[n] for n in param_names}
-                grads = {n: env.get(grad_map.get(n, '__none__'))
-                         for n in param_names}
-                grads = {n: g for n, g in grads.items() if g is not None}
-                updated, new_states = opt.functional_apply(
-                    params, grads, opt_states, lr)
-                new_params = [updated.get(n, env[n]) for n in param_names]
-
             fetches = [env[n] for n in fetch_names]
-            return fetches, new_params, new_states
+            return fetches, new_params
         return replay
 
 

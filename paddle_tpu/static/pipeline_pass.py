@@ -1,0 +1,345 @@
+"""Static pipeline program splitting.
+
+Reference parity: fluid.optimizer.PipelineOptimizer's program surgery —
+`_add_op_device_attr` (optimizer.py:4628, devices inferred for unmarked ops),
+`_check_validation` (:4647, every op gets a role + device),
+`_split_program` (:4374, one program per stage keyed on the op_device attr),
+`_insert_sendrecv_ops_for_boundaries` (:4722, send_v2/recv_v2 pairs per
+cross-stage edge, relay chains hop-by-hop for non-adjacent stages, one
+dedicated ring per (prev, cur) pair keyed prev*1000+cur), and
+`_accumulate_gradients` (:4974, grads merged across microbatches with the
+optimizer run once) — executed by PipelineTrainer/SectionWorker
+(section_worker.cc:104-185).
+
+TPU-native split: the per-stage programs carry real Forward/Backward/Optimize
+ops (append_backward records op-level grads), so ONE generic boundary rule
+covers both directions — a forward activation crossing stages gets
+send_v2/recv_v2, and so does its @GRAD flowing back, because the grad op is
+just another op whose input is produced on a different stage. The
+LocalPipelineRunner mirrors the single-node PipelineTrainer semantics for
+tests; multi-chip pipelines execute through the SPMD engine
+(meta_parallel/spmd_pipeline.py), which is the ICI-native fast path.
+"""
+import re
+
+import numpy as np
+import jax.numpy as jnp
+
+from .program import (Program, Block, Operator, OpRole, _ConstVar,
+                      run_op_in_env)
+
+
+def _stage_of(device, num_stages):
+    """'gpu:3' / 'tpu:3' / 'stage:3' -> 3; ''/'all'/'gpu:all' -> None."""
+    if not device:
+        return None
+    m = re.match(r'^[a-z]*:?(\d+|all)$', device)
+    if m is None:
+        return None
+    tok = m.group(1)
+    if tok == 'all':
+        return None
+    s = int(tok)
+    if s >= num_stages:
+        raise ValueError(f"op_device {device!r} >= num_stages {num_stages}")
+    return s
+
+
+def _add_op_device_attr(block, num_stages):
+    """Fill op_device for unmarked ops (parity: optimizer.py:4628-4645).
+
+    Forward ops inherit the max stage among their inputs' producers
+    (data-feed inputs pin to stage 0); backward/sum ops already carry the
+    forward op's device from append_backward; optimize ops follow their
+    parameter's consuming stage (:4587); global ops (clip) stay 'all'.
+    """
+    producer_stage = {}
+    param_stage = {}
+    for op in block.ops:
+        # normalize explicit replicate-everywhere marks ('gpu:all',
+        # 'tpu:all', 'all') so they survive inference untouched
+        if op.op_device and op.op_device.split(':')[-1] == 'all':
+            op.op_device = 'all'
+    for op in block.ops:
+        if op.op_device == 'all':
+            continue
+        if op.op_role & (OpRole.Backward | OpRole.Optimize):
+            continue
+        s = _stage_of(op.op_device, num_stages)
+        if s is None:
+            cands = [producer_stage[i] for i in op.input_names
+                     if i in producer_stage]
+            s = max(cands) if cands else 0
+            op.op_device = f'stage:{s}'
+        for i in op.input_names:
+            v = block.vars.get(i)
+            if v is not None and getattr(v, 'is_parameter', False) \
+                    and i not in param_stage:
+                param_stage[i] = s
+        for o in op.output_names:
+            producer_stage[o] = s
+
+    for op in block.ops:
+        if op.op_device == 'all':
+            continue
+        s = _stage_of(op.op_device, num_stages)
+        if s is not None:
+            for o in op.output_names:
+                producer_stage.setdefault(o, s)
+            continue
+        if op.op_role & OpRole.Optimize:
+            pname = op.attrs.get('param')
+            s = param_stage.get(pname, 0)
+        else:  # backward op whose forward op had no explicit device
+            cands = [producer_stage[i] for i in op.input_names
+                     if i in producer_stage]
+            s = max(cands) if cands else 0
+        op.op_device = f'stage:{s}'
+        for o in op.output_names:
+            producer_stage[o] = s
+    return producer_stage
+
+
+def _check_validation(block):
+    """Parity: optimizer.py:4647 — every op must carry a role + device."""
+    valid = (OpRole.Forward, OpRole.Backward, OpRole.Optimize,
+             OpRole.LRSched, OpRole.Backward | OpRole.Loss,
+             OpRole.Forward | OpRole.Loss)
+    for op in block.ops:
+        if op.op_role not in valid:
+            raise ValueError(f"op {op.type} has invalid op_role "
+                             f"{op.op_role}")
+        if op.op_device is None or op.op_device == '':
+            raise ValueError(f"op {op.type} has no op_device")
+
+
+def split_program(program, num_stages):
+    """Split one Program into per-stage Programs with send/recv boundary
+    ops (parity: _split_program:4374 + _insert_sendrecv:4722).
+
+    Returns (stage_programs, pair_rings): stage_programs[s].global_block()
+    holds stage s's ops (device s or 'all') plus inserted send_v2/recv_v2;
+    pair_rings maps (src, dst) -> ring_id (src*1000+dst, the reference's
+    pair_key convention).
+    """
+    block = program.global_block()
+    _add_op_device_attr(block, num_stages)
+    _check_validation(block)
+
+    stage_ops = [[] for _ in range(num_stages)]
+    op_stage = {}
+    for op in block.ops:
+        s = _stage_of(op.op_device, num_stages)
+        if s is None:   # 'all': replicate into every stage
+            for lst in stage_ops:
+                lst.append(op)
+            op_stage[id(op)] = None
+        else:
+            stage_ops[s].append(op)
+            op_stage[id(op)] = s
+
+    producer = {}
+    for op in block.ops:
+        s = op_stage[id(op)]
+        for o in op.output_names:
+            if s is not None:
+                producer[o] = s
+
+    pair_rings = {}
+    inserted = set()
+    # per-stage op lists are rebuilt with sends after producers and recvs
+    # before first consumer; relay hop-by-hop for non-adjacent stages
+    out_lists = [[] for _ in range(num_stages)]
+
+    def _ring(src, dst):
+        key = (src, dst)
+        if key not in pair_rings:
+            pair_rings[key] = src * 1000 + dst   # reference pair_key
+        return pair_rings[key]
+
+    def _mk_send(var, src, dst, role):
+        op = Operator('send_v2', lambda x: x, [var], [],
+                      {'peer': dst, 'ring_id': _ring(src, dst),
+                       'use_calc_stream': True}, op_role=role)
+        op.op_device = f'stage:{src}'
+        return op
+
+    def _mk_recv(var, src, dst, role):
+        v = block.vars[var]
+        op = Operator('recv_v2', lambda: None, [], [var],
+                      {'peer': src, 'ring_id': _ring(src, dst),
+                       'out_shape': list(v.shape),
+                       'dtype': str(v.dtype),
+                       'use_calc_stream': True}, op_role=role)
+        op.op_device = f'stage:{dst}'
+        return op
+
+    # which stages consume each var (cross-stage edges only); 'all'-ops'
+    # inputs are excluded — globals (e.g. the clip op's grads) are the
+    # dist-rewrites' job, as in the reference (gpu:all reduction ops)
+    consumers = {}
+    for op in block.ops:
+        s = op_stage[id(op)]
+        if s is None:
+            continue
+        for i in op.input_names:
+            consumers.setdefault(i, set()).add(s)
+
+    # walk ops in global order; sends follow their producer immediately, so
+    # the matching recv lands in the consumer stage's list before any
+    # consumer op (which comes later in global order)
+    for op in block.ops:
+        s = op_stage[id(op)]
+        if s is None:
+            for lst in out_lists:
+                lst.append(op)
+            continue
+        out_lists[s].append(op)
+        for o in op.output_names:
+            for dst in sorted(consumers.get(o, ())):
+                if dst == s:
+                    continue
+                cur, step = s, (1 if dst > s else -1)
+                while cur != dst:   # relay chain (optimizer.py:4772-4790)
+                    nxt = cur + step
+                    if (o, cur, nxt) not in inserted:
+                        inserted.add((o, cur, nxt))
+                        out_lists[cur].append(
+                            _mk_send(o, cur, nxt, op.op_role))
+                        out_lists[nxt].append(
+                            _mk_recv(o, cur, nxt, op.op_role))
+                    cur = nxt
+
+    progs = []
+    for s in range(num_stages):
+        p = Program.__new__(Program)
+        p.__dict__.update(program.__dict__)
+        b = Block(p, 0)
+        b.vars = block.vars          # shared var table
+        b.ops = out_lists[s]
+        p.blocks = [b]
+        p._stage_id = s
+        progs.append(p)
+    return progs, pair_rings
+
+
+class LocalPipelineRunner:
+    """Single-process multi-stage interpreter for split programs (parity:
+    PipelineTrainer + SectionWorker on one device — the
+    pipeline_mnist_one_device.py test pattern). send/recv resolve through
+    an in-memory channel; per-microbatch Forward+Backward run per stage in
+    order, param grads accumulate across microbatches (mean), then
+    Optimize-role ops run once (parity: _accumulate_gradients:4974).
+
+    This is the semantics-checking path; the performance path for real
+    meshes is the SPMD pipeline engine.
+    """
+
+    def __init__(self, stage_programs, scope):
+        self.progs = stage_programs
+        self.scope = scope
+
+    def run(self, feeds_per_microbatch, fetch_name=None):
+        from ..nn import initializer as I
+        scope = self.scope
+        block0 = self.progs[0].global_block()
+        # startup: shared var table → params initialized once
+        for prog in self.progs:
+            for v in prog.global_block().vars.values():
+                if (getattr(v, 'persistable', False)
+                        and not isinstance(v, _ConstVar)
+                        and v.name != '@LR'
+                        and scope.find_var(v.name) is None):
+                    src = getattr(v, '_init_from', None)
+                    if src is not None:
+                        scope.set(v.name,
+                                  scope.find_var(src).astype(jnp.float32))
+                    else:
+                        init = getattr(v, 'initializer', None) \
+                            or I.XavierUniform()
+                        scope.set(v.name, init(v.shape, v.dtype))
+
+        A = len(feeds_per_microbatch)
+        merged = {}
+        channel = {}
+        fetch_vals = []
+        opt = getattr(self.progs[0], '_optimizer', None)
+        lr = jnp.asarray(opt.get_lr() if opt is not None else 0.0,
+                         jnp.float32)
+
+        def run_op(op, env, mb):
+            if op.type == 'send_v2':
+                channel[(op.input_names[0], op.attrs['ring_id'], mb)] = \
+                    env[op.input_names[0]]
+                return
+            if op.type == 'recv_v2':
+                env[op.output_names[0]] = \
+                    channel[(op.output_names[0], op.attrs['ring_id'], mb)]
+                return
+            run_op_in_env(op, env)
+
+        grad_names = set(self.progs[0]._grad_map.values())
+        nstages = len(self.progs)
+        for mb, feed in enumerate(feeds_per_microbatch):
+            envs = []
+            for s, prog in enumerate(self.progs):
+                env = {'@LR': lr}
+                for k, v in feed.items():
+                    env[k] = jnp.asarray(np.asarray(v))
+                for v in prog.global_block().vars.values():
+                    if isinstance(v, _ConstVar):
+                        env[v.name] = v.value
+                    elif getattr(v, 'persistable', False) \
+                            and scope.find_var(v.name) is not None:
+                        env[v.name] = scope.find_var(v.name)
+                envs.append(env)
+            # forward sweep stage 0→N-1, backward sweep N-1→0 (SectionWorker
+            # RunForward/RunBackward filtering by op_role)
+            for s in range(nstages):
+                for op in self.progs[s].global_block().ops:
+                    if not (op.op_role & (OpRole.Backward
+                                          | OpRole.Optimize)):
+                        run_op(op, envs[s], mb)
+            for s in reversed(range(nstages)):
+                for op in self.progs[s].global_block().ops:
+                    if op.op_role & OpRole.Backward:
+                        run_op(op, envs[s], mb)
+            seen_mb = set()
+            fetched = False
+            for s, env in enumerate(envs):
+                for gname in grad_names:
+                    if gname in env and gname not in seen_mb:
+                        seen_mb.add(gname)
+                        merged[gname] = merged.get(gname, 0) + env[gname]
+                if fetch_name and fetch_name in env and not fetched:
+                    fetched = True
+                    fetch_vals.append(env[fetch_name])
+
+        # optimize once over mean grads (loss is per-microbatch mean)
+        for s, prog in enumerate(self.progs):
+            env = {'@LR': lr}
+            for v in prog.global_block().vars.values():
+                if isinstance(v, _ConstVar):
+                    env[v.name] = v.value
+                elif getattr(v, 'persistable', False) \
+                        and scope.find_var(v.name) is not None:
+                    env[v.name] = scope.find_var(v.name)
+            for g, val in merged.items():
+                env[g] = val / A
+            ran = False
+            for op in prog.global_block().ops:
+                if not (op.op_role & OpRole.Optimize):
+                    continue
+                if not all(n in env for n in op.input_names):
+                    continue
+                run_op(op, env, -1)
+                ran = True
+            if ran:
+                for v in prog.global_block().vars.values():
+                    if getattr(v, 'persistable', False) and v.name in env \
+                            and v.name != '@LR':
+                        scope.set(v.name, env[v.name])
+        if fetch_vals:
+            return float(jnp.mean(jnp.stack(
+                [jnp.asarray(v) for v in fetch_vals])))
+        return None

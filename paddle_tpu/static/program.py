@@ -160,6 +160,7 @@ class Operator:
         self.attrs = attrs or {}
         self.op_role = op_role
         self.op_device = _device_stack[-1] if _device_stack else ''
+        self.multi_out = False   # fn returns a tuple (even of length 1)
 
     def attr(self, name):
         if name == 'op_role':
@@ -262,12 +263,21 @@ class Program:
         p.blocks = self.blocks       # shallow: shares blocks (paddle clones
                                      # descs; our replay is non-destructive)
         if for_test:
-            # prune backward + optimize work (parity: clone(for_test=True)
-            # removes grad/optimize ops) — otherwise evaluating the clone
-            # would keep training on eval data
+            # prune backward + optimize ops (parity: clone(for_test=True))
+            # — otherwise evaluating the clone would keep training on eval
+            # data. Vars are shared; only the op list is filtered.
+            p.blocks = []
+            for b in self.blocks:
+                nb = Block(p, b.idx)
+                nb.vars = b.vars
+                nb.ops = [op for op in b.ops
+                          if not (op.op_role & (OpRole.Backward
+                                                | OpRole.Optimize))]
+                p.blocks.append(nb)
             p._optimizer = None
             p._grad_map = {}
             p._loss_var = None
+            p._has_backward_ops = False
         return p
 
     @property
@@ -393,8 +403,20 @@ def record_op(name, fn, args, static_kwargs):
     role = OpRole.Forward
     op = Operator(name, lambda *xs: fn(*xs, **static_kwargs), in_names,
                   [o.name for o in outs], dict(static_kwargs), role)
+    op.multi_out = multi
     block.append_op(op)
     return tuple(outs) if multi else outs[0]
+
+
+def run_op_in_env(op, env):
+    """Execute one recorded op against a name→array env (shared by the
+    Executor replay and the pipeline/sharding interpreters)."""
+    ins = [env[n] for n in op.input_names]
+    outs = op.fn(*ins)
+    if not isinstance(outs, (tuple, list)):
+        outs = (outs,)
+    for n, o in zip(op.output_names, outs):
+        env[n] = o
 
 
 class _ConstVar(Variable):

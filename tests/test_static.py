@@ -86,11 +86,11 @@ def test_minimize_adam_state_persists():
         l0 = exe.run(main, feed={'x': xs}, fetch_list=[loss])[0]
         for _ in range(5):
             l1 = exe.run(main, feed={'x': xs}, fetch_list=[loss])[0]
-        keys = [k for k in scope.vars if k.startswith('__opt_states__')]
+        # adam state threaded through named persistable vars (parity:
+        # _add_accumulator naming) and mutated across runs
+        keys = [k for k in scope.vars if 'adam_moment1' in k]
         assert keys, scope.vars.keys()
-        states = scope.find_var(keys[0])
-        first = next(iter(states.values()))
-        assert 'moment1' in first  # adam state threaded through the scope
+        assert float(np.abs(np.asarray(scope.find_var(keys[0]))).sum()) > 0
     assert float(l1) < float(l0)
 
 
@@ -106,6 +106,225 @@ def test_device_guard_records_op_device():
             y = static.nn.fc(h, 2)
     devices = [op.op_device for op in main.global_block().ops]
     assert 'gpu:0' in devices and 'gpu:1' in devices
+
+
+class TestProgramRewriteGolden:
+    """Real program-rewrite golden tests (§4.3 pattern): the pass output's
+    op list is asserted directly, the reference's cheapest, most portable
+    test form (test_fleet_sharding_meta_optimizer.py /
+    test_fleet_pipeline_meta_optimizer.py)."""
+
+    def _pipeline_program(self, batch=4):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data('x', [batch, 4])
+            label = static.data('label', [batch, 1])
+            with static.device_guard('stage:0'):
+                h = static.nn.fc(x, 8, activation='relu')
+            with static.device_guard('stage:1'):
+                h2 = static.nn.fc(h, 8, activation='relu')
+            with static.device_guard('stage:2'):
+                pred = static.nn.fc(h2, 1)
+                loss = paddle.mean((pred - label) * (pred - label))
+            paddle.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        return main, loss
+
+    def test_backward_records_grad_ops(self):
+        """append_backward appends real *_grad ops with Backward role and
+        the forward op's device."""
+        main, _ = self._pipeline_program()
+        ops = main.global_block().ops
+        types = [op.type for op in ops]
+        assert 'matmul_v2_grad' in types and 'relu_grad' in types
+        assert 'fill_any_like' in types          # d loss seed
+        assert types.count('sgd') == 6           # one optimize op per param
+        for op in ops:
+            if op.type.endswith('_grad'):
+                assert op.op_role & static.program.OpRole.Backward
+
+    def test_pipeline_split_golden(self):
+        from paddle_tpu.static.pipeline_pass import split_program
+        main, loss = self._pipeline_program()
+        progs, rings = split_program(main, 3)
+        assert len(progs) == 3
+        t = [[op.type for op in p.global_block().ops] for p in progs]
+        # forward boundary sends on stages 0/1, recvs on 1/2
+        assert 'send_v2' in t[0] and 'recv_v2' in t[1]
+        assert 'send_v2' in t[1] and 'recv_v2' in t[2]
+        # backward boundary: grads flow 2->1->0
+        assert 'send_v2' in t[2] and 'recv_v2' in t[0]
+        # loss + its seed only on the last stage
+        assert 'reduce_mean' in t[2] and 'fill_any_like' in t[2]
+        assert 'reduce_mean' not in t[0] and 'fill_any_like' not in t[0]
+        # optimize ops follow their params' stages: 2 per stage here
+        assert [tt.count('sgd') for tt in t] == [2, 2, 2]
+        # reference pair_key ring convention src*1000+dst
+        assert rings[(0, 1)] == 1 and rings[(1, 2)] == 1002
+        assert rings[(2, 1)] == 2001 and rings[(1, 0)] == 1000
+        # every op carries a stage device
+        for p in progs:
+            for op in p.global_block().ops:
+                assert op.op_device, op.type
+
+    def test_pipeline_runner_matches_single_program(self):
+        """Split programs + microbatched runner == unsplit Executor,
+        loss-trajectory-identical (pipeline_mnist_one_device pattern)."""
+        from paddle_tpu.static.pipeline_pass import (split_program,
+                                                     LocalPipelineRunner)
+        rng = np.random.RandomState(0)
+        xs = rng.rand(8, 4).astype('float32')
+        ys = (xs @ rng.rand(4, 1).astype('float32') + 0.1).astype('float32')
+
+        paddle.seed(0)
+        main, loss = self._pipeline_program(batch=4)
+        progs, _ = split_program(main, 3)
+        scope = static.Scope()
+        runner = LocalPipelineRunner(progs, scope)
+        pl = [runner.run([{'x': xs[:4], 'label': ys[:4]},
+                          {'x': xs[4:], 'label': ys[4:]}],
+                         fetch_name=loss.name) for _ in range(20)]
+
+        paddle.seed(0)
+        main2, loss2 = self._pipeline_program(batch=8)
+        exe = static.Executor()
+        with static.scope_guard(static.Scope()):
+            ref = [float(exe.run(main2, feed={'x': xs, 'label': ys},
+                                 fetch_list=[loss2])[0])
+                   for _ in range(20)]
+        np.testing.assert_allclose(pl, ref, rtol=1e-4, atol=1e-5)
+
+    def _sharding_program(self, minimize=True):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data('x', [8, 4])
+            label = static.data('label', [8, 1])
+            h = static.nn.fc(x, 8, activation='relu')
+            pred = static.nn.fc(h, 1)
+            loss = paddle.mean((pred - label) * (pred - label))
+            if minimize:
+                paddle.optimizer.Adam(learning_rate=0.05).minimize(loss)
+        return main, loss
+
+    def test_sharding_rewrite_golden(self):
+        from paddle_tpu.static.sharding_pass import shard_program
+        main, _ = self._sharding_program()
+        n_params = 4
+        p2r = shard_program(main, 0, 2, stage=2)
+        types = [op.type for op in main.global_block().ops]
+        owned = [p for p, r in p2r.items() if r == 0]
+        # ZeRO-2: one reduce-to-owner + scale per grad
+        assert types.count('c_reduce_sum') == n_params
+        assert types.count('scale') >= n_params
+        # optimize ops pruned to owned params only
+        assert types.count('adam') == len(owned)
+        # updated params broadcast from their owners
+        assert types.count('c_broadcast') == n_params
+        roots = [op.attrs['root'] for op in main.global_block().ops
+                 if op.type == 'c_broadcast']
+        assert set(roots) == {0, 1}
+        # non-owned optimizer state vars deleted (the ZeRO memory saving)
+        moments = [v for v in main.global_block().vars
+                   if 'adam_moment1' in v]
+        assert len(moments) == len(owned)
+
+    def test_sharding_two_rank_matches_unsharded(self):
+        """2-rank ZeRO-2 lockstep == single unsharded run (in-process
+        stand-in for test_dist_base's 2-process loss comparison)."""
+        from paddle_tpu.static.sharding_pass import (
+            shard_program, MultiRankShardingSimulator)
+        rng = np.random.RandomState(0)
+        xs = rng.rand(8, 4).astype('float32')
+        ys = (xs @ rng.rand(4, 1).astype('float32') + 0.1).astype('float32')
+
+        rank_progs = []
+        loss_name = None
+        for r in range(2):
+            m, loss = self._sharding_program()
+            shard_program(m, r, 2, stage=2)
+            rank_progs.append(m)
+            loss_name = loss.name
+        sim = MultiRankShardingSimulator(rank_progs, seed=0)
+        losses = []
+        for _ in range(25):
+            ls = sim.run([{'x': xs, 'label': ys}, {'x': xs, 'label': ys}],
+                         fetch_name=loss_name)
+            assert abs(ls[0] - ls[1]) < 1e-6   # ranks stay in sync
+            losses.append(ls[0])
+
+        paddle.seed(0)
+        m3, loss3 = self._sharding_program()
+        exe = static.Executor()
+        with static.scope_guard(static.Scope()):
+            ref = [float(exe.run(m3, feed={'x': xs, 'label': ys},
+                                 fetch_list=[loss3])[0])
+                   for _ in range(25)]
+        np.testing.assert_allclose(losses, ref, rtol=1e-3, atol=1e-5)
+
+    def test_sharding_zero2_global_clip_matches_unsharded(self):
+        """ZeRO-2 + ClipGradByGlobalNorm: the clip norm is computed over
+        owned (reduced) grads and allreduced across shards (parity:
+        sharding/gradient_clip_helper.py) — naive per-rank clipping over
+        mixed reduced/unreduced grads diverges."""
+        from paddle_tpu.static.sharding_pass import (
+            shard_program, MultiRankShardingSimulator)
+        from paddle_tpu.nn import ClipGradByGlobalNorm
+        rng = np.random.RandomState(0)
+        xs = rng.rand(8, 4).astype('float32')
+        ys = (xs @ rng.rand(4, 1).astype('float32') + 0.1).astype('float32')
+
+        def build():
+            main = static.Program()
+            with static.program_guard(main):
+                x = static.data('x', [8, 4])
+                label = static.data('label', [8, 1])
+                h = static.nn.fc(x, 8, activation='relu')
+                pred = static.nn.fc(h, 1)
+                loss = paddle.mean((pred - label) * (pred - label))
+                paddle.optimizer.Adam(
+                    learning_rate=0.05,
+                    grad_clip=ClipGradByGlobalNorm(0.5)).minimize(loss)
+            return main, loss
+
+        rank_progs = []
+        for r in range(2):
+            m, loss = build()
+            shard_program(m, r, 2, stage=2)
+            rank_progs.append(m)
+        sim = MultiRankShardingSimulator(rank_progs, seed=0)
+        losses = []
+        for _ in range(20):
+            ls = sim.run([{'x': xs, 'label': ys}, {'x': xs, 'label': ys}],
+                         fetch_name=loss.name)
+            assert abs(ls[0] - ls[1]) < 1e-6
+            losses.append(ls[0])
+
+        paddle.seed(0)
+        m3, loss3 = build()
+        exe = static.Executor()
+        with static.scope_guard(static.Scope()):
+            ref = [float(exe.run(m3, feed={'x': xs, 'label': ys},
+                                 fetch_list=[loss3])[0])
+                   for _ in range(20)]
+        np.testing.assert_allclose(losses, ref, rtol=1e-3, atol=1e-5)
+
+    def test_sharding_meta_optimizer_rewrites(self):
+        """Through the user-facing fleet path: strategy.sharding really
+        rewrites the program (not just an annotation)."""
+        import os
+        import paddle_tpu.distributed.fleet as fleet
+        os.environ.setdefault('PADDLE_TRAINER_ID', '0')
+        fleet.fleet._hcg = None
+        main, loss = self._sharding_program(minimize=False)
+        s = fleet.DistributedStrategy()
+        s.sharding = True
+        s.sharding_configs = {'sharding_degree': 2, 'stage': 2}
+        fleet.init(is_collective=True, strategy=s)
+        opt = paddle.optimizer.SGD(learning_rate=0.1)
+        opt = fleet.fleet.distributed_optimizer(opt)
+        fleet.fleet.minimize(loss)
+        types = [op.type for op in main.global_block().ops]
+        assert 'c_reduce_sum' in types and 'c_broadcast' in types
+        assert types.count('sgd') < 4   # some optimize ops pruned
 
 
 class TestMetaOptimizerGolden:
