@@ -64,6 +64,7 @@ from . import autograd
 from . import jit
 from . import static
 from . import distributed
+from .distributed import DataParallel   # parity: paddle.DataParallel
 from . import device
 from . import framework
 from . import utils

@@ -115,6 +115,39 @@ def _group_axes(group):
     return current_spmd_axes()
 
 
+_OP_NAMES = {ReduceOp.SUM: 'sum', ReduceOp.MAX: 'max', ReduceOp.MIN: 'min',
+             ReduceOp.PROD: 'prod', ReduceOp.AVG: 'avg'}
+
+
+def _host_backend(group):
+    """Eager (outside-SPMD) multi-PROCESS backend, or None when this job
+    is a single process. Keyed on the process count (PADDLE_TRAINERS_NUM),
+    NOT device-derived world size: one process driving N chips does eager
+    collectives as identities (cross-device work is the SPMD engines').
+    A multi-process eager collective without a backend RAISES — the
+    reference does real NCCL/Gloo work here (imperative/all_reduce.cc);
+    a silent identity would train wrong."""
+    import os
+    nproc = int(os.environ.get('PADDLE_TRAINERS_NUM', '1') or '1')
+    if nproc <= 1:
+        return None
+    if group is not None and group.axis_name is not None:
+        return None   # mesh-axis group: collective belongs to SPMD regions
+    if group is not None and group.nranks not in (0, nproc):
+        raise NotImplementedError(
+            "eager collectives over a sub-group are not supported outside "
+            "SPMD regions; pass axis-named groups inside an SPMD region "
+            "or use the world group")
+    from . import host_collectives as HC
+    g = HC.host_group() or HC.init_host_collectives()
+    if g is None:
+        raise RuntimeError(
+            f"eager collective across {nproc} processes outside an SPMD "
+            "region needs the TCPStore host backend (run under fleetrun / "
+            "set PADDLE_MASTER) — refusing to silently no-op")
+    return g
+
+
 # ---- init / groups ----------------------------------------------------------
 def init_parallel_env():
     """Parity: paddle.distributed.init_parallel_env (parallel.py:58) — the
@@ -181,7 +214,11 @@ def barrier(group=None):
     """Parity: collective.py barrier:167."""
     if in_spmd_region():
         return
-    # eager: sync device
+    hb = _host_backend(group)
+    if hb is not None:
+        hb.barrier()
+        return
+    # eager single-process: sync device
     for d in jax.live_arrays():
         d.block_until_ready()
         break
@@ -215,8 +252,12 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
         tensor._node = out._node
         tensor.stop_gradient = out.stop_gradient
         return tensor
-    # eager single-process: identity
-    return tensor
+    hb = _host_backend(group)
+    if hb is not None:   # host-mediated cross-process reduce
+        res = hb.all_reduce(np.asarray(tensor.data), _OP_NAMES[op])
+        tensor._data = jnp.asarray(res).astype(tensor.data.dtype)
+        return tensor
+    return tensor   # world_size == 1: identity
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
@@ -236,6 +277,11 @@ def broadcast(tensor, src=0, group=None, sync_op=True, use_calc_stream=True):
         out = run_op('c_broadcast', fn, [tensor])
         tensor._data = out._data
         tensor._node = out._node
+        return tensor
+    hb = _host_backend(group)
+    if hb is not None:
+        res = hb.broadcast(np.asarray(tensor.data), src=src)
+        tensor._data = jnp.asarray(res).astype(tensor.data.dtype)
         return tensor
     return tensor
 
@@ -262,6 +308,11 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True,
         from ..ops import manip
         shards = manip.unstack(out, axis=0)
         tensor_list.extend(shards)
+        return tensor_list
+    hb = _host_backend(group)
+    if hb is not None:
+        vals = hb.all_gather(np.asarray(tensor.data))
+        tensor_list.extend(Tensor(jnp.asarray(v)) for v in vals)
         return tensor_list
     tensor_list.append(tensor)
     return tensor_list
@@ -293,6 +344,13 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
         tensor._data = out._data
         tensor._node = out._node
         return tensor
+    hb = _host_backend(group)
+    if hb is not None:
+        total = hb.all_reduce(np.asarray(src.data), _OP_NAMES[op])
+        n = total.shape[0] // hb.world_size
+        me = get_rank(group)
+        tensor._data = jnp.asarray(total[me * n:(me + 1) * n])
+        return tensor
     tensor._data = src._data
     return tensor
 
@@ -308,6 +366,17 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
             return jnp.take(a, idx, axis=0)
         out = run_op('c_scatter', fn, [full])
         tensor._data = out._data
+        return tensor
+    hb = _host_backend(group)
+    if hb is not None:
+        me = get_rank(group)
+        if me == src:
+            full = np.stack([np.asarray(t.data) for t in tensor_list])
+        else:
+            full = np.zeros((hb.world_size,) + tuple(tensor.data.shape),
+                            dtype=np.asarray(tensor.data).dtype)
+        got = hb.broadcast(full, src=src)
+        tensor._data = jnp.asarray(got[me]).astype(tensor.data.dtype)
         return tensor
     if tensor_list is not None:
         tensor._data = tensor_list[src]._data
@@ -331,7 +400,19 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
                                      concat_axis=0, tiled=split_concat),
             [x])
     else:
-        out = x
+        hb = _host_backend(group)
+        if hb is not None:
+            me = get_rank(group)
+            vals = hb.all_gather(np.asarray(x.data))   # [ws] of [ws, ...]
+            if split_concat:
+                n = vals[0].shape[0] // hb.world_size
+                out = Tensor(jnp.concatenate(
+                    [jnp.asarray(v[me * n:(me + 1) * n]) for v in vals]))
+            else:
+                out = Tensor(jnp.stack(
+                    [jnp.asarray(v[me]) for v in vals]))
+        else:
+            out = x
     if out_tensor_list is not None:
         if split_concat:
             out_tensor_list.append(out)

@@ -30,6 +30,8 @@ def cast(x, dtype):
         return x
     if dtypes.is_floating(dt) and dtypes.is_floating(x.data.dtype):
         return run_op('cast', lambda a: a.astype(dt), [x])
+    if getattr(x, '_is_symbolic', False):   # static mode records the op
+        return run_op('cast', lambda a: a.astype(dt), [x])
     return Tensor(x.data.astype(dt), stop_gradient=True)
 register('cast', cast)
 

@@ -239,7 +239,7 @@ def topk(x, k, axis=None, largest=True, sorted=True, name=None):
         return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx, -1, ax)
 
     vals, idx = run_op('top_k_v2', fn, [x])
-    return vals, Tensor(idx.data.astype(jnp.int64))
+    return vals, idx.astype('int64')   # works in both eager and static
 
 
 def nonzero(x, as_tuple=False):

@@ -270,10 +270,12 @@ class Optimizer:
                 self._grad_clip, (ClipGradByNorm, ClipGradByValue)) else None
             plr_scale = getattr(p, 'optimize_attr',
                                 {'learning_rate': 1.0})['learning_rate']
+            decay_fun = getattr(self, '_apply_decay_param_fun', None)
+            decay_on = decay_fun is None or bool(decay_fun(p.name))
 
             def opt_fn(p_arr, g_arr, lr_arr, *state_arrs,
                        _keys=tuple(skeys), _clip=per_clip, _s=plr_scale,
-                       _pdt=None):
+                       _decay=decay_on):
                 st = dict(zip(_keys, state_arrs))
                 master = st.pop('master', None)
                 g32 = g_arr.astype(jnp.float32)
@@ -287,7 +289,14 @@ class Optimizer:
                     else p_arr.astype(jnp.float32)
                 if self._weight_decay and self._decay_into_grad():
                     g32 = g32 + self._weight_decay * p32
-                np_, ns = self.update(p32, g32, st, lr_arr * _s)
+                saved_decay = getattr(type(self), '_cur_decay', None)
+                if saved_decay is not None:   # AdamW per-param exclusion
+                    self._cur_decay = _decay
+                try:
+                    np_, ns = self.update(p32, g32, st, lr_arr * _s)
+                finally:
+                    if saved_decay is not None:
+                        self._cur_decay = saved_decay
                 ns = dict(ns)
                 if master is not None:
                     ns['master'] = np_

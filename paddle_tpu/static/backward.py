@@ -40,8 +40,14 @@ def _make_grad_fn(op, n_in, n_out, grad_idx):
     fwd_fn = op.fn
 
     def grad_fn(*args):
+        import numpy as _np
         primals, cots = args[:n_in], args[n_in:]
-        _, vjp_fn = jax.vjp(lambda *xs: fwd_fn(*xs), *primals)
+        outs, vjp_fn = jax.vjp(lambda *xs: fwd_fn(*xs), *primals)
+        # integer outputs (e.g. top_k indices) take float0 cotangents
+        out_list = list(outs) if isinstance(outs, (tuple, list)) else [outs]
+        cots = [c if dtypes.is_floating(o.dtype)
+                else _np.zeros(o.shape, jax.dtypes.float0)
+                for o, c in zip(out_list, cots)]
         cot = tuple(cots) if multi else cots[0]
         dxs = vjp_fn(cot)
         outs = []

@@ -67,6 +67,17 @@ class Executor:
         fetch_list = fetch_list or []
         scope = scope or global_scope()
 
+        if getattr(program, '_sharding_degree', 1) > 1:
+            # A sharded program's c_reduce_sum/c_broadcast ops need peer
+            # ranks; replaying them single-process as identities would
+            # silently skip the pruned params' updates and train wrong.
+            raise RuntimeError(
+                "this program was rewritten for sharding_degree="
+                f"{program._sharding_degree}: run one rank per process "
+                "with real collectives (fleetrun + the hybrid SPMD "
+                "engine), or use MultiRankShardingSimulator for "
+                "single-process checks")
+
         # Startup program: initialize parameters eagerly.
         if program.startup_ops or not program.global_block().ops:
             self._run_startup(program, scope)
@@ -110,40 +121,26 @@ class Executor:
 
     # -- helpers -------------------------------------------------------------
     def _run_startup(self, program, scope):
-        from ..nn import initializer as I
-        for p in program.startup_ops:
-            if scope.find_var(p.name) is None:
-                src = getattr(p, '_init_from', None)
-                if src is not None:   # fp32 master weight mirrors its param
-                    scope.set(p.name,
-                              scope.find_var(src).astype(jnp.float32))
-                    continue
-                init = getattr(p, 'initializer', None) or I.XavierUniform()
-                scope.set(p.name, init(p.shape, p.dtype))
+        from .program import materialize_persistables
+        materialize_persistables(program.startup_ops, scope.find_var,
+                                 scope.set)
         program.startup_ops = []
 
     def _collect_params(self, program, scope):
         """All persistable state threaded through the jitted replay:
         Parameters plus optimizer-state vars (recorded by
         _append_optimize_ops)."""
+        from .program import materialize_persistables
+        materialize_persistables(program.list_vars(), scope.find_var,
+                                 scope.set)
         names, arrays = [], []
         for v in program.list_vars():
             if isinstance(v, _ConstVar) or v.name == '@LR':
                 continue
-            if isinstance(v, Parameter) or v.persistable:
+            if v.persistable:
                 arr = scope.find_var(v.name)
                 if arr is None:
-                    from ..nn import initializer as I
-                    src = getattr(v, '_init_from', None)
-                    if src is not None:
-                        base = scope.find_var(src)
-                        if base is None:
-                            continue
-                        arr = base.astype(jnp.float32)
-                    else:
-                        arr = (getattr(v, 'initializer', None)
-                               or I.XavierUniform())(v.shape, v.dtype)
-                    scope.set(v.name, arr)
+                    continue
                 names.append(v.name)
                 arrays.append(arr)
         return names, arrays

@@ -240,24 +240,12 @@ class LocalPipelineRunner:
         self.scope = scope
 
     def run(self, feeds_per_microbatch, fetch_name=None):
-        from ..nn import initializer as I
+        from .program import materialize_persistables
         scope = self.scope
-        block0 = self.progs[0].global_block()
         # startup: shared var table → params initialized once
         for prog in self.progs:
-            for v in prog.global_block().vars.values():
-                if (getattr(v, 'persistable', False)
-                        and not isinstance(v, _ConstVar)
-                        and v.name != '@LR'
-                        and scope.find_var(v.name) is None):
-                    src = getattr(v, '_init_from', None)
-                    if src is not None:
-                        scope.set(v.name,
-                                  scope.find_var(src).astype(jnp.float32))
-                    else:
-                        init = getattr(v, 'initializer', None) \
-                            or I.XavierUniform()
-                        scope.set(v.name, init(v.shape, v.dtype))
+            materialize_persistables(prog.global_block().vars.values(),
+                                     scope.find_var, scope.set)
 
         A = len(feeds_per_microbatch)
         merged = {}

@@ -408,6 +408,35 @@ def record_op(name, fn, args, static_kwargs):
     return tuple(outs) if multi else outs[0]
 
 
+def materialize_persistables(vars_iter, find, set_, apply_masters=True):
+    """Initialize missing persistable vars (shared by the Executor startup
+    and the pipeline/sharding interpreters). `_init_from` fp32 masters
+    mirror their parameter; other vars use their initializer (default
+    XavierUniform). With apply_masters=False the (var, src) master pairs
+    are returned unapplied so callers can sync params across ranks first.
+    """
+    from ..nn import initializer as I
+    deferred = []
+    for v in vars_iter:
+        if (not getattr(v, 'persistable', False)
+                or isinstance(v, _ConstVar) or v.name == '@LR'
+                or find(v.name) is not None):
+            continue
+        src = getattr(v, '_init_from', None)
+        if src is not None:
+            deferred.append((v, src))
+            continue
+        init = getattr(v, 'initializer', None) or I.XavierUniform()
+        set_(v.name, init(v.shape, v.dtype))
+    if not apply_masters:
+        return deferred
+    for v, src in deferred:
+        base = find(src)
+        if base is not None:
+            set_(v.name, base.astype(jnp.float32))
+    return []
+
+
 def run_op_in_env(op, env):
     """Execute one recorded op against a name→array env (shared by the
     Executor replay and the pipeline/sharding interpreters)."""

@@ -169,25 +169,17 @@ class MultiRankShardingSimulator:
         self._startup(seed)
 
     def _startup(self, seed=None):
-        from ..nn import initializer as I
+        from .program import materialize_persistables
         masters = []
         for r, prog in enumerate(self.progs):
             if seed is not None:   # identical init draws on every rank,
                 import paddle_tpu  # like seeded multi-process startup
                 paddle_tpu.seed(seed)
             scope = self.scopes[r]
-            for v in prog.global_block().vars.values():
-                if (getattr(v, 'persistable', False)
-                        and not isinstance(v, _ConstVar)
-                        and v.name != '@LR'
-                        and v.name not in scope):
-                    src = getattr(v, '_init_from', None)
-                    if src is not None:
-                        masters.append((r, v.name, src))
-                        continue
-                    init = getattr(v, 'initializer', None) \
-                        or I.XavierUniform()
-                    scope[v.name] = init(v.shape, v.dtype)
+            deferred = materialize_persistables(
+                prog.global_block().vars.values(), scope.get,
+                scope.__setitem__, apply_masters=False)
+            masters.extend((r, v.name, src) for v, src in deferred)
         # startup param broadcast from each param's owner (parity: the
         # sharding pass rewrites the startup program with c_broadcast so
         # all ranks start from identical weights)

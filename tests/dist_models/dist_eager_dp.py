@@ -1,0 +1,55 @@
+"""2-process eager DataParallel model script (parity: the dist_mnist.py
+model files run by test_dist_base.py:744). Each rank trains on its shard;
+grads sync through the host collective backend; losses print as JSON."""
+import json
+import os
+import sys
+
+import jax
+jax.config.update('jax_platforms', 'cpu')
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import numpy as np                                    # noqa: E402
+import paddle_tpu as paddle                           # noqa: E402
+from paddle_tpu import nn                             # noqa: E402
+import paddle_tpu.distributed as dist                 # noqa: E402
+
+
+def main():
+    rank = int(os.environ['PADDLE_TRAINER_ID'])
+    ws = int(os.environ['PADDLE_TRAINERS_NUM'])
+    dist.init_parallel_env()
+
+    paddle.seed(7)
+    model = nn.Sequential(
+        nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 1))
+    # exercise the eager broadcast path: params synced from rank 0
+    for p in model.parameters():
+        dist.broadcast(p, src=0)
+    dp = paddle.DataParallel(model)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+
+    rng = np.random.RandomState(0)
+    xs = rng.rand(16, 4).astype('float32')
+    ys = (xs @ rng.rand(4, 1).astype('float32') + 0.1).astype('float32')
+    n = 16 // ws
+    x_r = paddle.to_tensor(xs[rank * n:(rank + 1) * n])
+    y_r = paddle.to_tensor(ys[rank * n:(rank + 1) * n])
+
+    losses = []
+    for _ in range(20):
+        pred = dp(x_r)
+        loss = ((pred - y_r) * (pred - y_r)).mean()
+        loss.backward()
+        dp.apply_collective_grads()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    print("LOSSES:" + json.dumps(losses))
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,103 @@
+"""True 2-process eager collective tests (parity: test_dist_base.py:744 —
+launch trainer subprocesses on localhost, compare losses vs the
+single-process run). These pin the r1-VERDICT weak #3 fix: eager
+multi-process grad sync does REAL cross-process work through the TCPStore
+host backend, and a multi-process eager collective with no backend raises
+instead of silently no-opping."""
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(('127.0.0.1', 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _launch(rank, ws, port, script):
+    env = dict(os.environ)
+    env.update({
+        'PADDLE_TRAINER_ID': str(rank),
+        'PADDLE_TRAINERS_NUM': str(ws),
+        'PADDLE_MASTER': f'127.0.0.1:{port}',
+        'JAX_PLATFORMS': 'cpu',
+    })
+    env.pop('XLA_FLAGS', None)
+    return subprocess.Popen(
+        [sys.executable, '-u', os.path.join(HERE, 'dist_models', script)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+
+
+class TestEagerMultiProcess:
+    def test_two_process_dp_matches_single(self):
+        """2-process DataParallel == single-process full-batch training:
+        the average of the rank losses equals the full-batch loss and
+        both ranks march in lockstep."""
+        port = _free_port() - 7   # backend adds +7
+        procs = [_launch(r, 2, port, 'dist_eager_dp.py') for r in range(2)]
+        outs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            assert p.returncode == 0, out
+            outs.append(out)
+        rank_losses = []
+        for out in outs:
+            line = [l for l in out.splitlines()
+                    if l.startswith('LOSSES:')][-1]
+            rank_losses.append(json.loads(line[len('LOSSES:'):]))
+
+        # single-process reference on the full batch
+        paddle.seed(7)
+        model = nn.Sequential(
+            nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 1))
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=model.parameters())
+        rng = np.random.RandomState(0)
+        xs = rng.rand(16, 4).astype('float32')
+        ys = (xs @ rng.rand(4, 1).astype('float32') + 0.1).astype('float32')
+        x, y = paddle.to_tensor(xs), paddle.to_tensor(ys)
+        ref = []
+        for _ in range(20):
+            pred = model(x)
+            loss = ((pred - y) * (pred - y)).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            ref.append(float(loss))
+
+        avg = [(a + b) / 2 for a, b in zip(*rank_losses)]
+        np.testing.assert_allclose(avg, ref, rtol=1e-4, atol=1e-5)
+
+    def test_eager_collective_without_backend_raises(self):
+        """world_size>1 with no host backend must raise, not silently
+        no-op (the r1 silent 1/N-scaled-grads bug)."""
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed import collective as C
+        from paddle_tpu.distributed import host_collectives as HC
+        saved = dict(os.environ)
+        try:
+            os.environ['PADDLE_TRAINER_ID'] = '0'
+            os.environ['PADDLE_TRAINERS_NUM'] = '2'
+            os.environ.pop('PADDLE_MASTER', None)
+            os.environ.pop('PADDLE_TRAINER_ENDPOINTS', None)
+            assert HC.host_group() is None
+            t = paddle.to_tensor(np.ones(4, 'float32'))
+            with pytest.raises(RuntimeError):
+                C.all_reduce(t)
+        finally:
+            os.environ.clear()
+            os.environ.update(saved)

@@ -307,6 +307,38 @@ class TestProgramRewriteGolden:
                    for _ in range(20)]
         np.testing.assert_allclose(losses, ref, rtol=1e-3, atol=1e-5)
 
+    def test_executor_refuses_sharded_program(self):
+        """Running a rank-rewritten sharded program through the plain
+        Executor would replay identity collectives and skip pruned
+        updates — it must raise, not silently mistrain."""
+        from paddle_tpu.static.sharding_pass import shard_program
+        main, loss = self._sharding_program()
+        shard_program(main, 0, 2, stage=2)
+        exe = static.Executor()
+        with static.scope_guard(static.Scope()):
+            with pytest.raises(RuntimeError):
+                exe.run(main, feed={'x': np.zeros((8, 4), 'float32'),
+                                    'label': np.zeros((8, 1), 'float32')},
+                        fetch_list=[loss])
+
+    def test_backward_through_int_output_op(self):
+        """Multi-output op with an integer output (top-k indices) on the
+        grad path: integer cotangents become float0, not a trace error."""
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data('x', [4, 8])
+            h = static.nn.fc(x, 8)
+            vals, idx = paddle.topk(h, k=3)
+            loss = paddle.mean(vals)
+            paddle.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        exe = static.Executor()
+        with static.scope_guard(static.Scope()):
+            xs = np.random.RandomState(0).rand(4, 8).astype('float32')
+            l0 = exe.run(main, feed={'x': xs}, fetch_list=[loss])[0]
+            for _ in range(5):
+                l1 = exe.run(main, feed={'x': xs}, fetch_list=[loss])[0]
+        assert np.isfinite(l0) and np.isfinite(l1)
+
     def test_sharding_meta_optimizer_rewrites(self):
         """Through the user-facing fleet path: strategy.sharding really
         rewrites the program (not just an annotation)."""
