@@ -223,33 +223,78 @@ class StaticFunction:
     XLA executable (the ProgramCache:692 analogue is jax.jit's cache)."""
 
     def __init__(self, function, input_spec=None):
-        self._function = function
+        # dy2static: rewrite data-dependent if/while/for-range into
+        # lax.cond/while_loop dispatchers before tracing (parity:
+        # program_translator's AST conversion)
+        from . import dy2static
+        self._function = dy2static.convert_function(function)
+        self._dygraph_function = function
         self._layer = getattr(function, '__self__', None)
         self.input_spec = input_spec
-        self._jitted = None
+        self._jit_cache = {}   # static-kwargs snapshot -> jitted trace
 
     def __call__(self, *args, **kwargs):
-        if self._jitted is None:
+        if not ProgramTranslator.get_instance().enable_to_static:
+            return self._dygraph_function(*args, **kwargs)
+        # tensor kwargs trace as inputs; other kwargs are compile-time
+        # constants keyed into the cache (a new value recompiles instead
+        # of silently reusing the first call's)
+        t_kwargs = {k: v for k, v in kwargs.items()
+                    if isinstance(v, Tensor)}
+        s_kwargs = {k: v for k, v in kwargs.items()
+                    if not isinstance(v, Tensor)}
+        # positional args: tensors/numerics trace; anything else is a
+        # compile-time constant keyed into the cache
+        spec, arrays, static_pos = [], [], {}
+        for i, a in enumerate(args):
+            if isinstance(a, Tensor):
+                spec.append('t')
+                arrays.append(a.data)
+            elif isinstance(a, (np.ndarray, jnp.ndarray)):
+                spec.append('t')
+                arrays.append(jnp.asarray(a))
+            else:   # python scalars/objects are compile-time constants
+                spec.append('s')
+                static_pos[i] = a
+
+        def _hkey(items):
+            try:
+                k = tuple(items)
+                hash(k)
+                return k
+            except TypeError:
+                return tuple((a, repr(b)) for a, b in items)
+        skey = (tuple(spec), _hkey(sorted(static_pos.items())),
+                _hkey(sorted(s_kwargs.items())))
+        jitted = self._jit_cache.get(skey)
+        if jitted is None:
             fn = self._function
             layer = self._layer
 
-            def traced(params, buffers, key, arrays):
+            def traced(params, buffers, key, arrs, t_arrays,
+                       _sk=dict(s_kwargs), _sp=dict(static_pos),
+                       _spec=tuple(spec)):
+                it = iter(arrs)
+                full = [Tensor(next(it)) if s == 't' else _sp[i]
+                        for i, s in enumerate(_spec)]
                 with bind_arrays(layer, params, buffers) if layer is not None \
                         else contextlib.nullcontext() as _:
                     with rng_mod.rng_guard(key), autograd.no_grad():
-                        out = fn(*[Tensor(a) for a in arrays], **kwargs)
+                        kw = dict(_sk)
+                        kw.update({k: Tensor(a)
+                                   for k, a in t_arrays.items()})
+                        out = fn(*full, **kw)
                 return jax.tree_util.tree_map(
                     lambda t: t.data if isinstance(t, Tensor) else t, out,
                     is_leaf=lambda t: isinstance(t, Tensor))
-            self._jitted = jax.jit(traced)
-        arrays = tuple(a.data if isinstance(a, Tensor) else jnp.asarray(a)
-                       for a in args)
+            jitted = self._jit_cache[skey] = jax.jit(traced)
         if self._layer is not None:
             params = {n: p.data for n, p in _named_params(self._layer)}
             buffers = get_buffers(self._layer)
         else:
             params, buffers = {}, {}
-        out = self._jitted(params, buffers, rng_mod.next_key(), arrays)
+        out = jitted(params, buffers, rng_mod.next_key(), tuple(arrays),
+                     {k: v.data for k, v in t_kwargs.items()})
         return jax.tree_util.tree_map(Tensor, out)
 
 

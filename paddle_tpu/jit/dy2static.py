@@ -1,0 +1,672 @@
+"""dy2static: AST conversion of data-dependent Python control flow.
+
+Reference parity: dygraph_to_static/program_translator.py:232-759 and the
+per-construct transformers (ifelse_transformer, loop_transformer,
+logical_transformer): `@to_static` functions get their source rewritten so
+`if`/`while`/`for range()` over tensors become runtime conversion calls.
+
+TPU-native lowering: the reference converts to conditional_block/while ops
+in a ProgramDesc; here the runtime calls dispatch on whether the condition
+is a traced value — `lax.cond` / `lax.while_loop` under jit (XLA-native
+control flow, SURVEY N28), plain Python control flow otherwise. State is
+threaded functionally: the transformer hoists each branch/body into a
+closure that mutates enclosing locals via `nonlocal`, plus get/set closures
+over the union of assigned names — exactly the reference's
+get_args/set_args convention (convert_operators.py convert_ifelse /
+convert_while_loop).
+
+Conversion is conservative: an `if` whose subtree contains return, or a
+loop containing break/continue/return, is left as Python control flow
+(fine for Python conditions; tensor conditions there raise jax's tracer
+error). Calls into other functions are not converted (the reference's
+convert_call dynamic conversion is future work).
+"""
+import ast
+import functools
+import inspect
+import textwrap
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor
+
+
+class _UndefinedType:
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self):
+        return '<undefined>'
+
+
+UNDEFINED = _UndefinedType()
+
+
+def _raw(x):
+    return x.data if isinstance(x, Tensor) else x
+
+
+def _is_traced(x):
+    return isinstance(_raw(x), jax.core.Tracer)
+
+
+def to_bool(x):
+    r = _raw(x)
+    if isinstance(r, jax.core.Tracer):
+        return r.reshape(()).astype(bool)
+    return bool(np.asarray(r).reshape(()))
+
+
+# ---- state packing ----------------------------------------------------------
+def _flatten_state(state):
+    leaves, treedef = jax.tree_util.tree_flatten(
+        state, is_leaf=lambda t: isinstance(t, Tensor))
+    kinds, carry, statics = [], [], []
+    for lf in leaves:
+        if isinstance(lf, Tensor):
+            kinds.append('t')
+            carry.append(lf.data)
+        elif isinstance(lf, (jax.Array, jax.core.Tracer)):
+            kinds.append('a')
+            carry.append(lf)
+        elif isinstance(lf, (bool, int, float, np.generic)) \
+                and not isinstance(lf, _UndefinedType):
+            kinds.append('a')   # python numbers ride the carry as arrays
+            carry.append(jnp.asarray(lf))
+        else:
+            kinds.append('s')
+            statics.append(lf)
+    return treedef, kinds, carry, statics
+
+
+def _unflatten_state(treedef, kinds, carry, statics):
+    leaves, ci, si = [], 0, 0
+    for k in kinds:
+        if k == 't':
+            leaves.append(Tensor(carry[ci]))
+            ci += 1
+        elif k in ('a', 'n'):
+            leaves.append(carry[ci])
+            ci += 1
+        else:
+            leaves.append(statics[si])
+            si += 1
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def _check_match(tag, treedef, kinds, treedef2, kinds2):
+    if treedef != treedef2 or kinds != kinds2:
+        raise TypeError(
+            f"dy2static {tag}: control-flow state diverged between paths "
+            "(a variable is defined/typed in only one branch, or changes "
+            "its structure inside the loop) — give it a value of the same "
+            "type on every path before the control flow")
+
+
+def _check_statics(tag, statics, statics2):
+    for a, b in zip(statics, statics2):
+        if a is b:
+            continue
+        try:
+            if a == b:
+                continue
+        except Exception:
+            pass
+        raise TypeError(
+            f"dy2static {tag}: a non-tensor value ({a!r} vs {b!r}) is "
+            "assigned differently under a traced condition — make it a "
+            "tensor, or lift the assignment out of the converted branch")
+
+
+# ---- runtime converters -----------------------------------------------------
+def convert_ifelse(pred, true_fn, false_fn, get_state, set_state):
+    """Parity: convert_operators.convert_ifelse — lax.cond when the
+    predicate is traced, Python if otherwise."""
+    p = _raw(pred)
+    if not isinstance(p, jax.core.Tracer):
+        if bool(np.asarray(p).reshape(())):
+            true_fn()
+        else:
+            false_fn()
+        return
+    init = get_state()
+    treedef0, kinds0, carry0, statics0 = _flatten_state(init)
+    out_spec = {}
+
+    def run_branch(fn, carry):
+        set_state(_unflatten_state(treedef0, kinds0, carry, statics0))
+        fn()
+        td2, k2, c2, s2 = _flatten_state(get_state())
+        # branches' OUTPUT trees must match each other (not the input:
+        # a var first assigned inside both branches is fine)
+        if 'spec' not in out_spec:
+            out_spec['spec'] = (td2, k2, s2)
+        else:
+            _check_match('if', out_spec['spec'][0], out_spec['spec'][1],
+                         td2, k2)
+            _check_statics('if', out_spec['spec'][2], s2)
+        return c2
+
+    out = lax.cond(p.reshape(()).astype(bool),
+                   lambda c: run_branch(true_fn, c),
+                   lambda c: run_branch(false_fn, c),
+                   carry0)
+    td2, k2, s2 = out_spec['spec']
+    set_state(_unflatten_state(td2, k2, out, s2))
+
+
+def convert_while_loop(cond_fn, body_fn, get_state, set_state):
+    """Parity: convert_operators.convert_while_loop — lax.while_loop when
+    the condition is traced (NB: not reverse-differentiable under jax;
+    use lax.scan-style loops for training-path recurrences)."""
+    c0 = cond_fn()
+    if not _is_traced(c0):
+        c = bool(np.asarray(_raw(c0)).reshape(()))
+        while c:
+            body_fn()
+            c = to_bool(cond_fn())
+            if isinstance(c, jax.core.Tracer):
+                raise TypeError(
+                    "dy2static while: condition became a traced tensor "
+                    "after the first iteration — make it a tensor from "
+                    "the start so the loop converts to lax.while_loop")
+        return
+    init = get_state()
+    treedef, kinds, carry0, statics0 = _flatten_state(init)
+
+    def cf(carry):
+        set_state(_unflatten_state(treedef, kinds, carry, statics0))
+        return to_bool(cond_fn())
+
+    def bf(carry):
+        set_state(_unflatten_state(treedef, kinds, carry, statics0))
+        body_fn()
+        td2, k2, c2, s2 = _flatten_state(get_state())
+        _check_match('while', treedef, kinds, td2, k2)
+        _check_statics('while', statics0, s2)
+        return c2
+
+    out = lax.while_loop(cf, bf, carry0)
+    set_state(_unflatten_state(treedef, kinds, out, statics0))
+
+
+def normalize_range(*args):
+    if len(args) == 1:
+        return 0, args[0], 1
+    if len(args) == 2:
+        return args[0], args[1], 1
+    return args[0], args[1], args[2]
+
+
+def range_cond(i, stop, step):
+    """i advancing by step still inside [start, stop)."""
+    if _is_traced(i) or _is_traced(stop) or _is_traced(step):
+        ri, rs, rp = _raw(i), _raw(stop), _raw(step)
+        return jnp.where(rp > 0, ri < rs, ri > rs)
+    return (i < stop) if step > 0 else (i > stop)
+
+
+def convert_logical_and(lhs_fn, rhs_fn):
+    l = lhs_fn()
+    if _is_traced(l):
+        return Tensor(jnp.logical_and(_raw(l).astype(bool),
+                                      _raw(rhs_fn()).astype(bool)))
+    return l and rhs_fn()          # preserves Python operand semantics
+
+
+def convert_logical_or(lhs_fn, rhs_fn):
+    l = lhs_fn()
+    if _is_traced(l):
+        return Tensor(jnp.logical_or(_raw(l).astype(bool),
+                                     _raw(rhs_fn()).astype(bool)))
+    return l or rhs_fn()
+
+
+def convert_logical_not(x):
+    if _is_traced(x):
+        return Tensor(jnp.logical_not(_raw(x).astype(bool)))
+    return not x
+
+
+# ---- AST analysis helpers ---------------------------------------------------
+class _AssignedNames(ast.NodeVisitor):
+    """Names bound in a statement list (not descending into nested defs)."""
+
+    def __init__(self):
+        self.names = set()
+
+    def visit_FunctionDef(self, node):
+        self.names.add(node.name)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        self.names.add(node.name)
+
+    def visit_Lambda(self, node):
+        pass
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            self.names.add(node.id)
+
+    def visit_comprehension(self, node):   # comp targets are scoped (py3)
+        self.generic_visit(node)
+
+
+def _assigned_names(stmts):
+    v = _AssignedNames()
+    for s in stmts:
+        v.visit(s)
+    # generated conversion helpers are not user state
+    return sorted(n for n in v.names if not n.startswith('_pt_'))
+
+
+class _HasUnsupported(ast.NodeVisitor):
+    """Return anywhere in the subtree, or break/continue belonging to the
+    converted construct itself (not to a nested loop)."""
+
+    def __init__(self, loop_level=False):
+        self.found = False
+        self._loop_depth = 1 if loop_level else 0
+
+    def visit_Return(self, node):
+        self.found = True
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass
+
+    def _loop(self, node):
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    def visit_For(self, node):
+        self._loop(node)
+
+    def visit_While(self, node):
+        self._loop(node)
+
+    def visit_Break(self, node):
+        if self._loop_depth <= 1:
+            self.found = True
+
+    def visit_Continue(self, node):
+        if self._loop_depth <= 1:
+            self.found = True
+
+    def visit_Global(self, node):
+        self.found = True
+
+    def visit_Nonlocal(self, node):
+        self.found = True
+
+    def visit_Attribute(self, node):
+        # obj.attr = ... side effects cannot be threaded through lax.cond
+        # (both branches trace; the write would leak) — keep Python flow
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            self.found = True
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node):
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            self.found = True
+        self.generic_visit(node)
+
+
+def _unsupported(stmts, loop_level=False):
+    v = _HasUnsupported(loop_level=loop_level)
+    v._loop_depth = 1 if loop_level else 0
+    for s in stmts:
+        v.visit(s)
+    return v.found
+
+
+def _load(name):
+    return ast.Name(id=name, ctx=ast.Load())
+
+
+def _store(name):
+    return ast.Name(id=name, ctx=ast.Store())
+
+
+def _jst_call(fname, args):
+    return ast.Call(
+        func=ast.Attribute(value=_load('_jst'), attr=fname, ctx=ast.Load()),
+        args=args, keywords=[])
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self._uid = 0
+
+    def _next(self):
+        self._uid += 1
+        return self._uid
+
+    def _guards(self, names):
+        """try: x  except NameError/UnboundLocalError: x = _jst.UNDEFINED"""
+        out = []
+        for n in names:
+            out.append(ast.Try(
+                body=[ast.Expr(value=_load(n))],
+                handlers=[ast.ExceptHandler(
+                    type=ast.Tuple(elts=[_load('NameError'),
+                                         _load('UnboundLocalError')],
+                                   ctx=ast.Load()),
+                    name=None,
+                    body=[ast.Assign(
+                        targets=[_store(n)],
+                        value=ast.Attribute(value=_load('_jst'),
+                                            attr='UNDEFINED',
+                                            ctx=ast.Load()))])],
+                orelse=[], finalbody=[]))
+        return out
+
+    def _state_fns(self, uid, names):
+        get_fn = ast.FunctionDef(
+            name=f'_pt_get_{uid}', args=_no_args(),
+            body=[ast.Return(value=ast.Tuple(
+                elts=[_load(n) for n in names], ctx=ast.Load()))],
+            decorator_list=[])
+        set_body = []
+        if names:
+            set_body.append(ast.Nonlocal(names=list(names)))
+            set_body.append(ast.Assign(
+                targets=[ast.Tuple(elts=[_store(n) for n in names],
+                                   ctx=ast.Store())],
+                value=_load('_pt_vals')))
+        else:
+            set_body.append(ast.Pass())
+        set_fn = ast.FunctionDef(
+            name=f'_pt_set_{uid}', args=_one_arg('_pt_vals'),
+            body=set_body, decorator_list=[])
+        return get_fn, set_fn
+
+    def _body_fn(self, name, names, body):
+        fn_body = []
+        if names:
+            fn_body.append(ast.Nonlocal(names=list(names)))
+        fn_body.extend(body if body else [])
+        if not fn_body:
+            fn_body = [ast.Pass()]
+        return ast.FunctionDef(name=name, args=_no_args(), body=fn_body,
+                               decorator_list=[])
+
+    # -- if --------------------------------------------------------------
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if _unsupported(node.body) or _unsupported(node.orelse):
+            return node
+        uid = self._next()
+        names = _assigned_names(node.body + node.orelse)
+        true_fn = self._body_fn(f'_pt_true_{uid}', names, node.body)
+        false_fn = self._body_fn(f'_pt_false_{uid}', names, node.orelse)
+        get_fn, set_fn = self._state_fns(uid, names)
+        call = ast.Expr(value=_jst_call('convert_ifelse', [
+            node.test, _load(true_fn.name), _load(false_fn.name),
+            _load(get_fn.name), _load(set_fn.name)]))
+        return self._guards(names) + [true_fn, false_fn, get_fn, set_fn,
+                                      call]
+
+    # -- while -----------------------------------------------------------
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse or _unsupported(node.body, loop_level=True):
+            return node
+        uid = self._next()
+        names = _assigned_names(node.body)
+        cond_fn = ast.FunctionDef(
+            name=f'_pt_wcond_{uid}', args=_no_args(),
+            body=[ast.Return(value=node.test)], decorator_list=[])
+        body_fn = self._body_fn(f'_pt_wbody_{uid}', names, node.body)
+        get_fn, set_fn = self._state_fns(uid, names)
+        call = ast.Expr(value=_jst_call('convert_while_loop', [
+            _load(cond_fn.name), _load(body_fn.name),
+            _load(get_fn.name), _load(set_fn.name)]))
+        return self._guards(names) + [cond_fn, body_fn, get_fn, set_fn,
+                                      call]
+
+    # -- for range(...) ----------------------------------------------------
+    def visit_For(self, node):
+        if not (isinstance(node.iter, ast.Call)
+                and isinstance(node.iter.func, ast.Name)
+                and node.iter.func.id == 'range'
+                and isinstance(node.target, ast.Name)
+                and not node.orelse
+                and not _unsupported(node.body, loop_level=True)):
+            self.generic_visit(node)
+            return node
+        uid = self._next()
+        i = node.target.id
+        # hidden induction counter (`_ds_` so it stays in loop state):
+        # the user variable is assigned FROM it each iteration, so body
+        # reassignments of the loop var can't corrupt iteration and its
+        # post-loop value matches Python's (last yielded value)
+        ctr = f'_ds_i_{uid}'
+        start, stop, step = (f'_pt_start_{uid}', f'_pt_stop_{uid}',
+                             f'_pt_step_{uid}')
+        setup = ast.Assign(
+            targets=[ast.Tuple(elts=[_store(start), _store(stop),
+                                     _store(step)], ctx=ast.Store())],
+            value=_jst_call('normalize_range', list(node.iter.args)))
+        init = ast.Assign(
+            targets=[ast.Tuple(elts=[_store(ctr), _store(i)],
+                               ctx=ast.Store())],
+            value=ast.Tuple(elts=[_load(start), _load(start)],
+                            ctx=ast.Load()))
+        take = ast.Assign(targets=[_store(i)], value=_load(ctr))
+        bump = ast.Assign(
+            targets=[_store(ctr)],
+            value=ast.BinOp(left=_load(ctr), op=ast.Add(),
+                            right=_load(step)))
+        loop = ast.While(
+            test=_jst_call('range_cond',
+                           [_load(ctr), _load(stop), _load(step)]),
+            body=[take] + list(node.body) + [bump], orelse=[])
+        loop_out = self.visit_While(loop)
+        if not isinstance(loop_out, list):
+            loop_out = [loop_out]
+        return [setup, init] + loop_out
+
+    # -- and/or/not --------------------------------------------------------
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        fname = 'convert_logical_and' if isinstance(node.op, ast.And) \
+            else 'convert_logical_or'
+        out = node.values[-1]
+        for v in reversed(node.values[:-1]):
+            out = _jst_call(fname, [
+                ast.Lambda(args=_no_args_lambda(), body=v),
+                ast.Lambda(args=_no_args_lambda(), body=out)])
+        return out
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return _jst_call('convert_logical_not', [node.operand])
+        return node
+
+
+def _no_args():
+    return ast.arguments(posonlyargs=[], args=[], vararg=None,
+                         kwonlyargs=[], kw_defaults=[], kwarg=None,
+                         defaults=[])
+
+
+def _one_arg(name):
+    return ast.arguments(posonlyargs=[], args=[ast.arg(arg=name)],
+                         vararg=None, kwonlyargs=[], kw_defaults=[],
+                         kwarg=None, defaults=[])
+
+
+def _no_args_lambda():
+    return _no_args()
+
+
+def final_return(v):
+    """The fall-off-the-end path returns None (Python semantics)."""
+    return None if v is UNDEFINED else v
+
+
+class _ReturnInIf(ast.NodeVisitor):
+    """Is there a Return directly inside an If branch (recursing through
+    nested Ifs but not loops/defs)? Those are the returns we lower."""
+
+    def __init__(self):
+        self.found = False
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def visit_For(self, node):
+        pass
+
+    def visit_While(self, node):
+        pass
+
+    def visit_Return(self, node):
+        self.found = True
+
+
+def _needs_return_lowering(stmts):
+    for s in stmts:
+        if isinstance(s, ast.If):
+            v = _ReturnInIf()
+            v.generic_visit(s)
+            if v.found:
+                return True
+    return False
+
+
+def _lower_returns(stmts):
+    """Rewrite `return e` into `_ds_ret = e`, merging the statements that
+    follow an if into whichever branch falls through (parity:
+    return_transformer.py — linear for guard-clause chains; duplicated
+    trace for genuinely diamond-shaped flow, which XLA CSEs away).
+
+    Returns (new_stmts, always_returns)."""
+    out = []
+    for idx, s in enumerate(stmts):
+        rest = stmts[idx + 1:]
+        if isinstance(s, ast.Return):
+            val = s.value if s.value is not None else \
+                ast.Constant(value=None)
+            out.append(ast.Assign(targets=[_store('_ds_ret')], value=val))
+            return out, True          # following stmts are dead
+        if isinstance(s, ast.If):
+            v = _ReturnInIf()
+            v.generic_visit(s)
+            if v.found:
+                body2, bret = _lower_returns(s.body)
+                orelse2, oret = _lower_returns(s.orelse)
+                rest2, rret = _lower_returns(rest)
+                if not bret:
+                    body2 = body2 + rest2
+                if not oret:
+                    orelse2 = orelse2 + rest2
+                out.append(ast.If(test=s.test, body=body2,
+                                  orelse=orelse2 or [ast.Pass()]))
+                return out, (bret or rret) and (oret or rret)
+        out.append(s)
+    return out, False
+
+
+# ---- function conversion ----------------------------------------------------
+_factory_cache = {}
+
+
+def convert_function(fn):
+    """Rewrite `fn`'s control flow; returns a new function with the same
+    closure/globals, or `fn` unchanged when the source is unavailable or
+    contains nothing convertible. Parity: ProgramTranslator's
+    to-static conversion of the decorated callable.
+
+    The transformed/compiled factory is cached per code object, but the
+    factory is re-applied to EACH function's own closure cells — two
+    closures sharing code get their own values (cell contents are
+    snapshotted at conversion time)."""
+    base = getattr(fn, '__func__', fn)
+    key = getattr(base, '__code__', None)
+    if key in _factory_cache:
+        factory = _factory_cache[key]
+    else:
+        factory = _build_factory(base)
+        _factory_cache[key] = factory
+    if factory is None:
+        return fn
+    try:
+        cells = [c.cell_contents for c in (base.__closure__ or ())]
+        conv = factory(*cells)
+    except Exception:
+        return fn
+    conv.__defaults__ = base.__defaults__
+    conv.__kwdefaults__ = base.__kwdefaults__
+    conv = functools.wraps(base)(conv)
+    if getattr(fn, '__self__', None) is not None:   # rebind methods
+        return conv.__get__(fn.__self__)
+    return conv
+
+
+def _build_factory(fn):
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return None
+    fdef = next((n for n in tree.body
+                 if isinstance(n, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef))), None)
+    if fdef is None:
+        return None
+    fdef.decorator_list = []
+    if _needs_return_lowering(fdef.body):
+        fdef.body, _ = _lower_returns(fdef.body)
+        fdef.body.insert(0, ast.Assign(
+            targets=[_store('_ds_ret')],
+            value=ast.Attribute(value=_load('_jst'), attr='UNDEFINED',
+                                ctx=ast.Load())))
+        fdef.body.append(ast.Return(
+            value=_jst_call('final_return', [_load('_ds_ret')])))
+    _ControlFlowTransformer().visit(fdef)
+    ast.fix_missing_locations(tree)
+
+    freevars = fn.__code__.co_freevars
+    factory_name = f'_pt_factory_{fn.__name__}'
+    factory = ast.FunctionDef(
+        name=factory_name,
+        args=ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=v) for v in freevars],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[]),
+        body=[fdef, ast.Return(value=_load(fdef.name))],
+        decorator_list=[])
+    mod = ast.Module(body=[factory], type_ignores=[])
+    ast.fix_missing_locations(mod)
+
+    import sys
+    glb = dict(fn.__globals__)
+    glb['_jst'] = sys.modules[__name__]
+    try:
+        code = compile(mod, filename=f'<dy2static {fn.__qualname__}>',
+                       mode='exec')
+        ns = {}
+        exec(code, glb, ns)
+        return ns[factory_name]
+    except Exception:
+        return None
