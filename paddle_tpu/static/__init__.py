@@ -17,6 +17,9 @@ from .backward import append_backward, gradients
 from .nn import *  # noqa
 from . import nn
 from .control_flow import while_loop, cond, switch_case, case
+from .serialization import (save, load, save_inference_model,
+                            load_inference_model, serialize_program,
+                            deserialize_program)
 
 
 class BuildStrategy:
@@ -84,25 +87,6 @@ class ParallelExecutor:
     def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
         return self._exe.run(self._program, feed=feed or feed_dict,
                              fetch_list=fetch_list, return_numpy=return_numpy)
-
-
-def save(program, model_path, protocol=4, **configs):
-    program.save(model_path)
-
-
-def load(program, model_path, executor=None, var_names=None):
-    program.load(model_path)
-
-
-def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
-                         **kwargs):
-    program = default_main_program()
-    program.save(path_prefix + '.pdmodel')
-
-
-def load_inference_model(path_prefix, executor, **kwargs):
-    raise NotImplementedError(
-        "inference model loading lands with program serialization v2")
 
 
 def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):

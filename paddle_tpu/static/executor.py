@@ -98,8 +98,9 @@ class Executor:
 
         param_names, param_arrays = self._collect_params(program, scope)
         opt = getattr(program, '_optimizer', None)
-        lr = jnp.asarray(opt.get_lr() if opt is not None else 0.0,
-                         jnp.float32)
+        lr = jnp.asarray(
+            opt.get_lr() if opt is not None
+            else getattr(program, '_loaded_lr', 0.0), jnp.float32)
 
         key = (id(program), feed_names,
                tuple((a.shape, str(a.dtype)) for a in feed_arrays),

@@ -371,16 +371,37 @@ def data(name, shape, dtype='float32', lod_level=0):
 def record_op(name, fn, args, static_kwargs):
     """Record an op into the current Program and return symbolic outputs.
     Shape inference via jax.eval_shape (parity: InferShape in
-    operator.cc:1132)."""
+    operator.cc:1132). Dynamic dims (-1/None, the paddle dynamic-batch
+    idiom) infer through jax symbolic shapes so they stay dynamic on
+    the outputs."""
     prog = default_main_program()
     block = prog.current_block()
+
+    dyn = any(isinstance(a, Variable)
+              and any(d is None or d < 0 for d in a._shape) for a in args)
+    sym_scope = None
+    if dyn:
+        from jax import export as jax_export
+        sym_scope = jax_export.SymbolicScope()
+
+    def _var_aval(v):
+        if not dyn or all(d is not None and d >= 0 for d in v._shape):
+            return v.data
+        from jax import export as jax_export
+        import re
+        safe = re.sub(r'\W', '_', v.name)
+        parts = []
+        for j, d in enumerate(v._shape):
+            parts.append(f'_{safe}_d{j}' if d is None or d < 0 else str(d))
+        dims = jax_export.symbolic_shape(', '.join(parts), scope=sym_scope)
+        return jax.ShapeDtypeStruct(tuple(dims), v.dtype)
 
     in_names = []
     avals = []
     for a in args:
         if isinstance(a, Variable):
             in_names.append(a.name)
-            avals.append(a.data)
+            avals.append(_var_aval(a))
         else:  # concrete Tensor closed over (e.g. constants)
             cname = prog._unique_name(f'const')
             block.vars[cname] = _ConstVar(block, cname, a)
@@ -394,7 +415,8 @@ def record_op(name, fn, args, static_kwargs):
     outs = []
     for oa in out_avals:
         oname = prog._unique_name(name)
-        ov = Variable(block, oname, list(oa.shape), oa.dtype,
+        oshape = [d if isinstance(d, int) else -1 for d in oa.shape]
+        ov = Variable(block, oname, oshape, oa.dtype,
                       stop_gradient=all(getattr(a, 'stop_gradient', True)
                                         for a in args))
         block.vars[oname] = ov
