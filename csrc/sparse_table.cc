@@ -27,7 +27,7 @@ namespace ptpu {
 
 class SparseTable {
  public:
-  enum Opt { SGD = 0, ADAGRAD = 1 };
+  enum Opt { SGD = 0, ADAGRAD = 1, ADAM = 2 };
 
   SparseTable(int dim, int num_shards, int opt, float init_range,
               uint64_t seed)
@@ -39,7 +39,13 @@ class SparseTable {
         shards_(num_shards),
         locks_(num_shards) {}
 
-  int RowWidth() const { return opt_ == ADAGRAD ? dim_ * 2 : dim_; }
+  // Row layouts: SGD [w]; ADAGRAD [w, g2]; ADAM [w, m, v, t] — the
+  // optimizer state inline with the embedding (reference: sparse
+  // accessor "embedx + sgd/adam fields", ctr_accessor / sparse_sgd_rule)
+  int RowWidth() const {
+    if (opt_ == ADAM) return dim_ * 3 + 1;
+    return opt_ == ADAGRAD ? dim_ * 2 : dim_;
+  }
 
   // Gather rows for `n` ids into out[n, dim]; missing ids are initialized
   // (uniform[-init_range, init_range]) — reference accessor "create on
@@ -70,10 +76,39 @@ class SparseTable {
           g2[d] += g[d] * g[d];
           w[d] -= lr * g[d] / (std::sqrt(g2[d]) + 1e-6f);
         }
+      } else if (opt_ == ADAM) {
+        // bias-corrected adam per row (beta1=.9, beta2=.999, eps=1e-8 —
+        // the reference sparse-adam accessor defaults)
+        float* w = row.data();
+        float* m = row.data() + dim_;
+        float* v = row.data() + 2 * dim_;
+        float& t = row[3 * dim_];
+        t += 1.f;
+        float bc1 = 1.f - std::pow(0.9f, t);
+        float bc2 = 1.f - std::pow(0.999f, t);
+        for (int d = 0; d < dim_; ++d) {
+          m[d] = 0.9f * m[d] + 0.1f * g[d];
+          v[d] = 0.999f * v[d] + 0.001f * g[d] * g[d];
+          w[d] -= lr * (m[d] / bc1) / (std::sqrt(v[d] / bc2) + 1e-8f);
+        }
       } else {
         float* w = row.data();
         for (int d = 0; d < dim_; ++d) w[d] -= lr * g[d];
       }
+    });
+  }
+
+  // Assign embedding values (optimizer state untouched) — used by the
+  // geo communicator to refresh the worker-local mirror from the server
+  // (reference: SparseGeoTable pull-and-overwrite semantics).
+  void Set(const int64_t* ids, int n, const float* rows) {
+    ParallelOver(n, [&](int i) {
+      int64_t id = ids[i];
+      size_t s = Shard(id);
+      std::lock_guard<std::mutex> lk(locks_[s]);
+      auto& row = GetOrInit(s, id);
+      std::memcpy(row.data(), rows + (size_t)i * dim_,
+                  sizeof(float) * dim_);
     });
   }
 
@@ -187,9 +222,126 @@ class SparseTable {
   std::vector<std::mutex> locks_;
 };
 
+// Server-side dense parameter table (reference parity:
+// distributed/table/common_dense_table.h — a fixed-size parameter block
+// workers pull whole and push gradients into, with the optimizer applied
+// server-side).
+class DenseTable {
+ public:
+  DenseTable(int64_t size, int opt)
+      : size_(size), opt_((SparseTable::Opt)opt), w_(size, 0.f), t_(0.f) {
+    if (opt_ != SparseTable::SGD) g2_.assign(size, 0.f);
+    if (opt_ == SparseTable::ADAM) v_.assign(size, 0.f);
+  }
+
+  void Set(const float* vals) {
+    std::lock_guard<std::mutex> lk(mu_);
+    std::memcpy(w_.data(), vals, sizeof(float) * size_);
+  }
+
+  void Pull(float* out) {
+    std::lock_guard<std::mutex> lk(mu_);
+    std::memcpy(out, w_.data(), sizeof(float) * size_);
+  }
+
+  void Push(const float* g, float lr) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (opt_ == SparseTable::ADAGRAD) {
+      for (int64_t d = 0; d < size_; ++d) {
+        g2_[d] += g[d] * g[d];
+        w_[d] -= lr * g[d] / (std::sqrt(g2_[d]) + 1e-6f);
+      }
+    } else if (opt_ == SparseTable::ADAM) {
+      t_ += 1.f;
+      float bc1 = 1.f - std::pow(0.9f, t_);
+      float bc2 = 1.f - std::pow(0.999f, t_);
+      for (int64_t d = 0; d < size_; ++d) {
+        g2_[d] = 0.9f * g2_[d] + 0.1f * g[d];  // m in g2_
+        v_[d] = 0.999f * v_[d] + 0.001f * g[d] * g[d];
+        w_[d] -= lr * (g2_[d] / bc1) / (std::sqrt(v_[d] / bc2) + 1e-8f);
+      }
+    } else {
+      for (int64_t d = 0; d < size_; ++d) w_[d] -= lr * g[d];
+    }
+  }
+
+  int64_t Size() const { return size_; }
+
+  bool Save(const std::string& path) {
+    std::lock_guard<std::mutex> lk(mu_);
+    std::ofstream out(path, std::ios::binary);
+    if (!out) return false;
+    int opt = (int)opt_;
+    out.write((char*)&size_, sizeof(size_));
+    out.write((char*)&opt, sizeof(opt));
+    out.write((char*)&t_, sizeof(t_));
+    out.write((char*)w_.data(), sizeof(float) * size_);
+    if (!g2_.empty()) out.write((char*)g2_.data(), sizeof(float) * size_);
+    if (!v_.empty()) out.write((char*)v_.data(), sizeof(float) * size_);
+    return out.good();
+  }
+
+  bool Load(const std::string& path) {
+    std::lock_guard<std::mutex> lk(mu_);
+    std::ifstream in(path, std::ios::binary);
+    if (!in) return false;
+    int64_t size;
+    int opt;
+    in.read((char*)&size, sizeof(size));
+    in.read((char*)&opt, sizeof(opt));
+    // optimizer layout mismatch would silently misread the accumulator
+    // blocks (SparseTable::Load's rw check plays the same role)
+    if (size != size_ || opt != (int)opt_) return false;
+    in.read((char*)&t_, sizeof(t_));
+    in.read((char*)w_.data(), sizeof(float) * size_);
+    if (!g2_.empty()) in.read((char*)g2_.data(), sizeof(float) * size_);
+    if (!v_.empty()) in.read((char*)v_.data(), sizeof(float) * size_);
+    return in.good();
+  }
+
+ private:
+  int64_t size_;
+  SparseTable::Opt opt_;
+  std::vector<float> w_, g2_, v_;
+  float t_;
+  std::mutex mu_;
+};
+
 }  // namespace ptpu
 
 extern "C" {
+
+void* ptpu_dense_create(int64_t size, int opt) {
+  return new ptpu::DenseTable(size, opt);
+}
+
+void ptpu_dense_set(void* h, const float* vals) {
+  static_cast<ptpu::DenseTable*>(h)->Set(vals);
+}
+
+void ptpu_dense_pull(void* h, float* out) {
+  static_cast<ptpu::DenseTable*>(h)->Pull(out);
+}
+
+void ptpu_dense_push(void* h, const float* g, float lr) {
+  static_cast<ptpu::DenseTable*>(h)->Push(g, lr);
+}
+
+int64_t ptpu_dense_size(void* h) {
+  return static_cast<ptpu::DenseTable*>(h)->Size();
+}
+
+int ptpu_dense_save(void* h, const char* path) {
+  return static_cast<ptpu::DenseTable*>(h)->Save(path) ? 1 : 0;
+}
+
+int ptpu_dense_load(void* h, const char* path) {
+  return static_cast<ptpu::DenseTable*>(h)->Load(path) ? 1 : 0;
+}
+
+void ptpu_dense_destroy(void* h) {
+  delete static_cast<ptpu::DenseTable*>(h);
+}
 
 void* ptpu_table_create(int dim, int num_shards, int opt, float init_range,
                         uint64_t seed) {
@@ -203,6 +355,10 @@ void ptpu_table_pull(void* h, const int64_t* ids, int n, float* out) {
 void ptpu_table_push(void* h, const int64_t* ids, int n, const float* grads,
                      float lr) {
   static_cast<ptpu::SparseTable*>(h)->Push(ids, n, grads, lr);
+}
+
+void ptpu_table_set(void* h, const int64_t* ids, int n, const float* rows) {
+  static_cast<ptpu::SparseTable*>(h)->Set(ids, n, rows);
 }
 
 int64_t ptpu_table_size(void* h) {

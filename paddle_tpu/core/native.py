@@ -95,6 +95,8 @@ def load_native(required=False):
     lib.ptpu_table_push.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
                                     ctypes.c_int, ctypes.c_void_p,
                                     ctypes.c_float]
+    lib.ptpu_table_set.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                   ctypes.c_int, ctypes.c_void_p]
     lib.ptpu_table_size.restype = ctypes.c_int64
     lib.ptpu_table_size.argtypes = [ctypes.c_void_p]
     lib.ptpu_table_shrink.restype = ctypes.c_int64
@@ -104,6 +106,21 @@ def load_native(required=False):
     lib.ptpu_table_load.restype = ctypes.c_int
     lib.ptpu_table_load.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.ptpu_table_destroy.argtypes = [ctypes.c_void_p]
+
+    # dense table
+    lib.ptpu_dense_create.restype = ctypes.c_void_p
+    lib.ptpu_dense_create.argtypes = [ctypes.c_int64, ctypes.c_int]
+    lib.ptpu_dense_set.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.ptpu_dense_pull.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.ptpu_dense_push.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                    ctypes.c_float]
+    lib.ptpu_dense_size.restype = ctypes.c_int64
+    lib.ptpu_dense_size.argtypes = [ctypes.c_void_p]
+    lib.ptpu_dense_save.restype = ctypes.c_int
+    lib.ptpu_dense_save.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.ptpu_dense_load.restype = ctypes.c_int
+    lib.ptpu_dense_load.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.ptpu_dense_destroy.argtypes = [ctypes.c_void_p]
 
     # profiler
     lib.ptpu_profiler_enable.argtypes = [ctypes.c_int]
@@ -256,12 +273,14 @@ class NativeSparseTable:
 
     SGD = 0
     ADAGRAD = 1
+    ADAM = 2
+    _OPTS = {'sgd': SGD, 'adagrad': ADAGRAD, 'adam': ADAM}
 
     def __init__(self, dim, num_shards=16, optimizer='adagrad',
                  init_range=0.05, seed=0):
         self.lib = load_native(required=True)
         self.dim = dim
-        opt = self.ADAGRAD if optimizer == 'adagrad' else self.SGD
+        opt = self._OPTS.get(optimizer, self.SGD)
         self.h = self.lib.ptpu_table_create(dim, num_shards, opt,
                                             init_range, seed)
 
@@ -281,6 +300,15 @@ class NativeSparseTable:
             self.h, ids.ctypes.data_as(ctypes.c_void_p), len(ids),
             grads.ctypes.data_as(ctypes.c_void_p), lr)
 
+    def set(self, ids, rows):
+        """Assign embedding values (optimizer state untouched)."""
+        ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        rows = np.ascontiguousarray(rows, np.float32).reshape(
+            len(ids), self.dim)
+        self.lib.ptpu_table_set(
+            self.h, ids.ctypes.data_as(ctypes.c_void_p), len(ids),
+            rows.ctypes.data_as(ctypes.c_void_p))
+
     def __len__(self):
         return self.lib.ptpu_table_size(self.h)
 
@@ -298,4 +326,47 @@ class NativeSparseTable:
     def __del__(self):
         if getattr(self, 'h', None) and self.lib:
             self.lib.ptpu_table_destroy(self.h)
+            self.h = None
+
+
+class NativeDenseTable:
+    """Parity: distributed/table/common_dense_table.h — a fixed-size
+    parameter block with the optimizer applied server-side."""
+
+    def __init__(self, size, optimizer='sgd'):
+        self.lib = load_native(required=True)
+        self.size = int(size)
+        opt = NativeSparseTable._OPTS.get(optimizer, 0)
+        self.h = self.lib.ptpu_dense_create(self.size, opt)
+
+    def set(self, values):
+        v = np.ascontiguousarray(values, np.float32).reshape(-1)
+        assert len(v) == self.size
+        self.lib.ptpu_dense_set(self.h, v.ctypes.data_as(ctypes.c_void_p))
+
+    def pull(self):
+        out = np.empty(self.size, np.float32)
+        self.lib.ptpu_dense_pull(self.h,
+                                 out.ctypes.data_as(ctypes.c_void_p))
+        return out
+
+    def push(self, grad, lr=0.01):
+        g = np.ascontiguousarray(grad, np.float32).reshape(-1)
+        self.lib.ptpu_dense_push(self.h,
+                                 g.ctypes.data_as(ctypes.c_void_p), lr)
+
+    def save(self, path):
+        if not self.lib.ptpu_dense_save(self.h, path.encode()):
+            raise IOError(f"dense table save failed: {path}")
+
+    def load(self, path):
+        if not self.lib.ptpu_dense_load(self.h, path.encode()):
+            raise IOError(f"dense table load failed: {path}")
+
+    def __len__(self):
+        return self.size
+
+    def __del__(self):
+        if getattr(self, 'h', None) and self.lib:
+            self.lib.ptpu_dense_destroy(self.h)
             self.h = None

@@ -71,6 +71,74 @@ def global_communicator():
     return _global_communicator
 
 
+class GeoCommunicator:
+    """Geo-SGD async mode (parity: SparseGeoTable +
+    service/communicator.h GeoCommunicator): the worker trains a LOCAL
+    native mirror at full speed; every k steps the accumulated WEIGHT
+    DELTAS (not gradients) push to the server, which sums deltas from all
+    workers, and fresh rows pull back into the mirror. The server table
+    must use the 'sgd' accessor (delta applied via lr=-1 — the reference's
+    geo SUM-table semantics).
+    """
+
+    def __init__(self, remote_table, dim, k_steps=10):
+        self.remote = remote_table
+        self.dim = dim
+        self.k = max(1, int(k_steps))
+        self.local = NativeSparseTable(dim, optimizer='sgd')
+        self.base = {}          # id -> row at last sync
+        self.touched = set()
+        self._step = 0
+
+    def pull(self, ids):
+        flat = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        unseen = np.array(
+            sorted(set(int(i) for i in flat) - set(self.base)), np.int64)
+        if len(unseen):
+            rows = self.remote.pull(unseen)
+            self.local.set(unseen, rows)
+            for j, i in enumerate(unseen):
+                self.base[int(i)] = rows[j].copy()
+        return self.local.pull(flat)
+
+    def push(self, ids, grads, lr):
+        flat = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        self.local.push(flat, grads, lr)
+        self.touched.update(int(i) for i in flat)
+        self._step += 1
+        if self._step % self.k == 0:
+            self.sync()
+
+    def sync(self):
+        if not self.touched:
+            return
+        ids = np.array(sorted(self.touched), np.int64)
+        delta = self.local.pull(ids) - np.stack(
+            [self.base[int(i)] for i in ids])
+        self.remote.push(ids, delta, -1.0)   # server: w += delta
+        fresh = self.remote.pull(ids)
+        self.local.set(ids, fresh)
+        for j, i in enumerate(ids):
+            self.base[int(i)] = fresh[j].copy()
+        self.touched.clear()
+
+    def save(self, path):
+        self.sync()
+        self.remote.save(path)
+
+    def load(self, path):
+        """Reload the base table and invalidate the mirror (rows re-pull
+        lazily on next touch)."""
+        self.remote.load(path)
+        self.local = NativeSparseTable(self.dim, optimizer='sgd')
+        self.base.clear()
+        self.touched.clear()
+        self._step = 0
+
+    def __len__(self):
+        return len(self.remote)
+
+
 class _RemoteTable:
     """PsClient adapter with the NativeSparseTable surface."""
 
@@ -105,9 +173,13 @@ class DistributedEmbedding(Layer):
 
     def __init__(self, embedding_dim, optimizer='adagrad', learning_rate=0.01,
                  init_range=0.05, num_shards=16, seed=0, a_sync=False,
-                 endpoints=None, table_id=0, name=None):
+                 endpoints=None, table_id=0, mode=None, geo_k=10, name=None):
         super().__init__()
         self.embedding_dim = embedding_dim
+        if mode is None:
+            mode = 'async' if a_sync else 'sync'
+        if mode not in ('sync', 'async', 'geo'):
+            raise ValueError(f"bad PS mode {mode!r}")
         if endpoints:
             # remote PS mode (parity: distributed_lookup_table →
             # BrpcPsClient): pull/push go to the server fleet
@@ -115,12 +187,20 @@ class DistributedEmbedding(Layer):
             self.table = _RemoteTable(PsClient(endpoints), table_id,
                                       embedding_dim)
         else:
+            # geo deltas apply to the base table via sgd/lr=-1; the
+            # accessor there must be 'sgd' (server-side: configure the
+            # server's table with optimizer='sgd' for geo workers)
             self.table = NativeSparseTable(
-                embedding_dim, num_shards=num_shards, optimizer=optimizer,
+                embedding_dim, num_shards=num_shards,
+                optimizer='sgd' if mode == 'geo' else optimizer,
                 init_range=init_range, seed=seed)
+        if mode == 'geo':
+            self.table = GeoCommunicator(self.table, embedding_dim,
+                                         k_steps=geo_k)
         self.learning_rate = learning_rate
-        self.a_sync = a_sync
-        if a_sync:
+        self.mode = mode
+        self.a_sync = mode == 'async'
+        if self.a_sync:
             _global_communicator.start()
 
     def forward(self, ids):

@@ -9,21 +9,38 @@ tables use internally, so a multi-host deployment scales horizontally like
 the reference's PS cluster.
 
 Wire protocol (little-endian):
-  u8 op ('P' pull, 'U' push, 'S' save, 'L' load, 'N' size, 'Q' shutdown)
-  u32 table_id
+  u8 op ('P' pull, 'U' push, 'S' save, 'L' load, 'N' size, 'Q' shutdown,
+         'H' heartbeat, 'd' dense pull, 'e' dense push, 'I' dense set)
+  u32 table_id ('H' has none)
   P: u32 n, i64[n] ids                  -> f32[n*dim] rows
-  U: u32 n, f32 lr, i64[n] ids, f32[n*dim] grads -> u8 ok
+  U: 16s client_uuid, u64 seq, u32 n, f32 lr, i64[n] ids,
+     f32[n*dim] grads                   -> u8 ok
   S/L: u32 len, path bytes              -> u8 ok
   N: -> i64 size
+  d: -> u32 size, f32[size]
+  e: 16s client_uuid, u64 seq, f32 lr, u32 size, f32[size] grads -> u8 ok
+  I: u32 size, f32[size] values         -> u8 ok
+  H: -> u8 ok
+
+Pushes are NOT idempotent, so they carry a (client_uuid, seq) tag: a
+retry after a lost ack replays the same tag and the server skips the
+re-apply (at-most-once for the replayed request) while still acking.
+
+Fault tolerance (parity: brpc keepalive + the Communicator's retry):
+PsClient remembers endpoints and transparently reconnects with retry on
+any transport error — a killed-and-relaunched server (reloading its table
+snapshot) resumes serving the same workers; an optional heartbeat thread
+tracks per-server liveness.
 """
 import socket
 import struct
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
-from ...core.native import NativeSparseTable
+from ...core.native import NativeSparseTable, NativeDenseTable
 
 
 def _read_n(sock, n):
@@ -47,6 +64,9 @@ class PsServer:
         self.port = self._sock.getsockname()[1]
         self._running = False
         self._threads = []
+        self._conns = []
+        self._conns_lock = threading.Lock()
+        self._applied = {}          # client uuid -> last applied push seq
 
     def add_table(self, table_id, dim, optimizer='adagrad', init_range=0.05,
                   num_shards=16, seed=0):
@@ -54,6 +74,11 @@ class PsServer:
         self.tables[table_id] = NativeSparseTable(
             dim, num_shards=num_shards, optimizer=optimizer,
             init_range=init_range, seed=seed)
+        return self.tables[table_id]
+
+    def add_dense_table(self, table_id, size, optimizer='sgd'):
+        """Parity: CommonDenseTable config."""
+        self.tables[table_id] = NativeDenseTable(size, optimizer=optimizer)
         return self.tables[table_id]
 
     def start(self):
@@ -71,6 +96,8 @@ class PsServer:
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._conns_lock:
+                self._conns.append(conn)
             t = threading.Thread(target=self._serve, args=(conn,),
                                  daemon=True)
             t.start()
@@ -84,20 +111,45 @@ class PsServer:
                     conn.sendall(b'\x01')
                     self.stop()
                     return
+                if op == b'H':
+                    conn.sendall(b'\x01')
+                    continue
                 (tid,) = struct.unpack('<I', _read_n(conn, 4))
                 table = self.tables[tid]
-                if op == b'P':
+                if op == b'd':
+                    rows = table.pull()
+                    conn.sendall(struct.pack('<I', len(rows))
+                                 + rows.tobytes())
+                elif op == b'e':
+                    uuid = _read_n(conn, 16)
+                    (seq,) = struct.unpack('<Q', _read_n(conn, 8))
+                    lr, n = struct.unpack('<fI', _read_n(conn, 8))
+                    g = np.frombuffer(_read_n(conn, 4 * n), np.float32)
+                    if self._applied.get(uuid) != seq:   # replay dedup
+                        table.push(g, lr)
+                        self._applied[uuid] = seq
+                    conn.sendall(b'\x01')
+                elif op == b'I':
+                    (n,) = struct.unpack('<I', _read_n(conn, 4))
+                    vals = np.frombuffer(_read_n(conn, 4 * n), np.float32)
+                    table.set(vals)
+                    conn.sendall(b'\x01')
+                elif op == b'P':
                     (n,) = struct.unpack('<I', _read_n(conn, 4))
                     ids = np.frombuffer(_read_n(conn, 8 * n), np.int64)
                     rows = table.pull(ids)
                     conn.sendall(rows.tobytes())
                 elif op == b'U':
+                    uuid = _read_n(conn, 16)
+                    (seq,) = struct.unpack('<Q', _read_n(conn, 8))
                     n, lr = struct.unpack('<If', _read_n(conn, 8))
                     ids = np.frombuffer(_read_n(conn, 8 * n), np.int64)
                     grads = np.frombuffer(
                         _read_n(conn, 4 * n * table.dim),
                         np.float32).reshape(n, table.dim)
-                    table.push(ids, grads, lr)
+                    if self._applied.get(uuid) != seq:   # replay dedup
+                        table.push(ids, grads, lr)
+                        self._applied[uuid] = seq
                     conn.sendall(b'\x01')
                 elif op in (b'S', b'L'):
                     (ln,) = struct.unpack('<I', _read_n(conn, 4))
@@ -112,6 +164,11 @@ class PsServer:
             pass
         finally:
             conn.close()
+            with self._conns_lock:
+                try:
+                    self._conns.remove(conn)
+                except ValueError:
+                    pass
 
     def run(self):
         """Blocking serve (parity: fleet.run_server)."""
@@ -120,30 +177,127 @@ class PsServer:
 
     def stop(self):
         self._running = False
+        try:   # wake the blocked accept so the kernel listener dies too
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:
             pass
+        with self._conns_lock:
+            conns, self._conns = self._conns, []
+        for c in conns:         # drop live worker connections too —
+            try:                # a stop IS an outage, not a drain
+                c.shutdown(socket.SHUT_RDWR)   # wakes the blocked recv
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
 
 
 class PsClient:
-    """Parity: BrpcPsClient — shards requests across servers by id hash."""
+    """Parity: BrpcPsClient — shards requests across servers by id hash.
 
-    def __init__(self, endpoints, timeout=60):
-        self._socks = []
-        self._locks = []
-        for ep in endpoints:
-            host, port = ep.rsplit(':', 1)
-            s = socket.create_connection((host, int(port)), timeout=timeout)
-            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._socks.append(s)
-            self._locks.append(threading.Lock())
-        self.n_servers = len(self._socks)
+    Transport errors trigger transparent reconnect-with-retry (up to
+    `retry_timeout` seconds), so a relaunched server resumes serving the
+    same client; `start_heartbeat` tracks per-server liveness."""
+
+    def __init__(self, endpoints, timeout=60, retry_timeout=30):
+        self.endpoints = list(endpoints)
+        self._timeout = timeout
+        self._retry_timeout = retry_timeout
+        self._socks = [None] * len(self.endpoints)
+        self._locks = [threading.Lock() for _ in self.endpoints]
+        self.n_servers = len(self.endpoints)
+        self.alive = [True] * self.n_servers
+        import uuid as _uuid
+        self._uuid = _uuid.uuid4().bytes    # push replay-dedup identity
+        self._seq = 0
+        self._seq_lock = threading.Lock()
+        self._hb_thread = None
+        self._hb_stop = threading.Event()
+        for s in range(self.n_servers):
+            self._connect(s)
         # shard requests fan out concurrently (reference BrpcPsClient issues
         # parallel RPCs; serial round-trips would scale latency with the
         # server count)
         self._pool = ThreadPoolExecutor(max_workers=min(self.n_servers, 16)) \
             if self.n_servers > 1 else None
+
+    def _connect(self, s):
+        host, port = self.endpoints[s].rsplit(':', 1)
+        sock = socket.create_connection((host, int(port)),
+                                        timeout=self._timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._socks[s] = sock
+        return sock
+
+    def _rpc(self, s, fn):
+        """Run `fn(sock)` under the server lock, reconnecting with retry
+        on transport errors (caller must make fn a full request —
+        replayable on a fresh connection)."""
+        deadline = time.monotonic() + self._retry_timeout
+        with self._locks[s]:
+            while True:
+                try:
+                    if self._socks[s] is None:
+                        self._connect(s)
+                    out = fn(self._socks[s])
+                    self.alive[s] = True
+                    return out
+                except (ConnectionError, OSError):
+                    try:
+                        if self._socks[s] is not None:
+                            self._socks[s].close()
+                    except OSError:
+                        pass
+                    self._socks[s] = None
+                    self.alive[s] = False
+                    if time.monotonic() >= deadline:
+                        raise
+                    time.sleep(0.2)
+
+    # -- heartbeat (parity: brpc keepalive / Communicator heartbeats) -----
+    def start_heartbeat(self, interval=1.0):
+        if self._hb_thread is not None:
+            return
+
+        def loop():
+            while not self._hb_stop.wait(interval):
+                for s in range(self.n_servers):
+                    used = None
+                    try:
+                        with self._locks[s]:
+                            if self._socks[s] is None:
+                                self._connect(s)
+                            used = self._socks[s]
+                            used.sendall(b'H')
+                            _read_n(used, 1)
+                        self.alive[s] = True
+                    except (ConnectionError, OSError):
+                        self.alive[s] = False
+                        with self._locks[s]:
+                            # only tear down the socket WE failed on; a
+                            # concurrent _rpc may have reconnected already
+                            if used is not None \
+                                    and self._socks[s] is used:
+                                try:
+                                    used.close()
+                                except OSError:
+                                    pass
+                                self._socks[s] = None
+        self._hb_thread = threading.Thread(target=loop, daemon=True)
+        self._hb_thread.start()
+
+    def stop_heartbeat(self):
+        if self._hb_thread is not None:
+            self._hb_stop.set()
+            self._hb_thread.join()
+            self._hb_thread = None
+            self._hb_stop.clear()
 
     def _shard(self, ids):
         return (ids.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15)
@@ -166,13 +320,13 @@ class PsClient:
             if not mask.any():
                 return
             sub = ids[mask]
-            with self._locks[s]:
-                sock = self._socks[s]
+
+            def req(sock):
                 sock.sendall(b'P' + struct.pack('<II', table_id, len(sub))
                              + sub.tobytes())
-                rows = np.frombuffer(_read_n(sock, 4 * len(sub) * dim),
+                return np.frombuffer(_read_n(sock, 4 * len(sub) * dim),
                                      np.float32).reshape(len(sub), dim)
-            out[mask] = rows
+            out[mask] = self._rpc(s, req)
         self._fanout(one, range(self.n_servers))
         return out
 
@@ -181,50 +335,94 @@ class PsClient:
         grads = np.ascontiguousarray(grads, np.float32)
         shards = self._shard(ids)
 
+        with self._seq_lock:
+            self._seq += 1
+            seq = self._seq
+        tag = self._uuid + struct.pack('<Q', seq)
+
         def one(s):
             mask = shards == s
             if not mask.any():
                 return
             sub = ids[mask]
             sub_g = grads[mask]
-            with self._locks[s]:
-                sock = self._socks[s]
-                sock.sendall(b'U' + struct.pack('<IIf', table_id, len(sub),
-                                                lr)
+
+            def req(sock):
+                sock.sendall(b'U' + struct.pack('<I', table_id) + tag
+                             + struct.pack('<If', len(sub), lr)
                              + sub.tobytes() + sub_g.tobytes())
                 _read_n(sock, 1)
+            self._rpc(s, req)
         self._fanout(one, range(self.n_servers))
 
     def save(self, table_id, path):
         for s in range(self.n_servers):
-            with self._locks[s]:
-                sock = self._socks[s]
-                p = f"{path}.part{s}".encode()
-                sock.sendall(b'S' + struct.pack('<II', table_id, len(p)) + p)
+            p = f"{path}.part{s}".encode()
+
+            def req(sock, _p=p):
+                sock.sendall(b'S' + struct.pack('<II', table_id, len(_p))
+                             + _p)
                 _read_n(sock, 1)
+            self._rpc(s, req)
 
     def table_size(self, table_id):
         total = 0
         for s in range(self.n_servers):
-            with self._locks[s]:
-                sock = self._socks[s]
+            def req(sock):
                 sock.sendall(b'N' + struct.pack('<I', table_id))
-                (n,) = struct.unpack('<q', _read_n(sock, 8))
-            total += n
+                return struct.unpack('<q', _read_n(sock, 8))[0]
+            total += self._rpc(s, req)
         return total
 
+    # -- dense table (one table lives on server table_id % n_servers) -----
+    def _dense_server(self, table_id):
+        return table_id % self.n_servers
+
+    def dense_init(self, table_id, values):
+        vals = np.ascontiguousarray(values, np.float32).reshape(-1)
+
+        def req(sock):
+            sock.sendall(b'I' + struct.pack('<II', table_id, len(vals))
+                         + vals.tobytes())
+            _read_n(sock, 1)
+        self._rpc(self._dense_server(table_id), req)
+
+    def dense_pull(self, table_id):
+        def req(sock):
+            sock.sendall(b'd' + struct.pack('<I', table_id))
+            (n,) = struct.unpack('<I', _read_n(sock, 4))
+            return np.frombuffer(_read_n(sock, 4 * n), np.float32)
+        return self._rpc(self._dense_server(table_id), req)
+
+    def dense_push(self, table_id, grad, lr):
+        g = np.ascontiguousarray(grad, np.float32).reshape(-1)
+        with self._seq_lock:
+            self._seq += 1
+            seq = self._seq
+        tag = self._uuid + struct.pack('<Q', seq)
+
+        def req(sock):
+            sock.sendall(b'e' + struct.pack('<I', table_id) + tag
+                         + struct.pack('<fI', lr, len(g)) + g.tobytes())
+            _read_n(sock, 1)
+        self._rpc(self._dense_server(table_id), req)
+
     def shutdown(self):
+        self.stop_heartbeat()
         for s in range(self.n_servers):
             try:
                 with self._locks[s]:
-                    self._socks[s].sendall(b'Q')
-                    _read_n(self._socks[s], 1)
+                    if self._socks[s] is not None:
+                        self._socks[s].sendall(b'Q')
+                        _read_n(self._socks[s], 1)
             except (ConnectionError, OSError):
                 pass
 
     def close(self):
+        self.stop_heartbeat()
         for s in self._socks:
             try:
-                s.close()
+                if s is not None:
+                    s.close()
             except OSError:
                 pass

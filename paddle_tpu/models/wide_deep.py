@@ -18,15 +18,16 @@ from ..distributed.ps.embedding import DistributedEmbedding
 class WideDeep(nn.Layer):
     def __init__(self, sparse_feature_dim=16, num_sparse_slots=8,
                  dense_dim=13, hidden_sizes=(64, 32), a_sync=False,
-                 sparse_lr=0.05):
+                 sparse_lr=0.05, mode=None, geo_k=10):
         super().__init__()
         self.num_sparse_slots = num_sparse_slots
         self.embedding = DistributedEmbedding(
             sparse_feature_dim, optimizer='adagrad',
-            learning_rate=sparse_lr, a_sync=a_sync)
+            learning_rate=sparse_lr, a_sync=a_sync, mode=mode, geo_k=geo_k)
         # wide part: per-feature scalar weights from a second tiny table
         self.wide_embedding = DistributedEmbedding(
-            1, optimizer='sgd', learning_rate=sparse_lr, a_sync=a_sync)
+            1, optimizer='sgd', learning_rate=sparse_lr, a_sync=a_sync,
+            mode=mode, geo_k=geo_k)
         layers = []
         in_dim = dense_dim + num_sparse_slots * sparse_feature_dim
         for h in hidden_sizes:

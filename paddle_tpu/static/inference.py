@@ -16,11 +16,13 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor
 
 
-def save_inference_model(path_prefix, layer, example_inputs):
-    """Export `layer` (eager nn.Layer) at the given example input specs.
+def export_layer(path_prefix, layer, example_inputs):
+    """Export an eager nn.Layer as an AOT predictor artifact (the
+    paddle.jit.save(TranslatedLayer) role — distinct from
+    paddle.static.save_inference_model, which serializes a PROGRAM).
 
     Produces <prefix>.stablehlo (portable serialized module) and
-    <prefix>.pdiparams (weights).
+    <prefix>.pdexec (weights/buffers/input specs).
     """
     from jax import export as jax_export
     from ..jit import functional_call, get_params, get_buffers
@@ -48,7 +50,7 @@ def save_inference_model(path_prefix, layer, example_inputs):
         'input_specs': [(tuple(a.shape), str(a.dtype))
                         for a in arg_arrays],
     }
-    with open(path_prefix + '.pdiparams', 'wb') as f:
+    with open(path_prefix + '.pdexec', 'wb') as f:
         pickle.dump(state, f, protocol=4)
     if was_training:
         layer.train()
@@ -62,7 +64,7 @@ class Predictor:
         from jax import export as jax_export
         with open(path_prefix + '.stablehlo', 'rb') as f:
             self._exported = jax_export.deserialize(f.read())
-        with open(path_prefix + '.pdiparams', 'rb') as f:
+        with open(path_prefix + '.pdexec', 'rb') as f:
             state = pickle.load(f)
         self._params = {k: jnp.asarray(v)
                         for k, v in state['params'].items()}
@@ -77,5 +79,5 @@ class Predictor:
         return jax.tree_util.tree_map(np.asarray, out)
 
 
-def load_inference_model(path_prefix):
+def load_predictor(path_prefix):
     return Predictor(path_prefix)

@@ -29,18 +29,13 @@ FORMAT_VERSION = 1
 _PLATFORMS = ('cpu', 'tpu')
 
 
-def _aval_of(v, scope=None, counter=None):
+def _aval_of(v, scope=None):
     """Dynamic dims (None/-1, the paddle dynamic-batch idiom) export as
-    jax symbolic dimensions so loaded kernels accept any size there."""
+    jax symbolic dimensions so loaded kernels accept any size there.
+    All dynamic dims share one symbol (the batch), matching record_op."""
     if all(d is not None and d >= 0 for d in v.shape):
         return jax.ShapeDtypeStruct(tuple(v.shape), v.dtype)
-    parts = []
-    for d in v.shape:
-        if d is None or d < 0:
-            counter[0] += 1
-            parts.append(f'_d{counter[0]}')
-        else:
-            parts.append(str(d))
+    parts = ['_dyn' if d is None or d < 0 else str(d) for d in v.shape]
     dims = jax_export.symbolic_shape(', '.join(parts), scope=scope)
     return jax.ShapeDtypeStruct(tuple(dims), v.dtype)
 
@@ -88,8 +83,7 @@ def serialize_program(program):
             desc['fallback'] = 'identity'
         else:
             sym_scope = jax_export.SymbolicScope()
-            counter = [0]
-            avals = [_aval_of(block.vars[n], sym_scope, counter)
+            avals = [_aval_of(block.vars[n], sym_scope)
                      for n in op.input_names]
             exported = jax_export.export(
                 jax.jit(op.fn), platforms=list(_PLATFORMS))(*avals)

@@ -11,17 +11,17 @@ from paddle_tpu.core.tensor import Tensor
 
 
 def test_save_load_inference_model():
-    from paddle_tpu.static.inference import (save_inference_model,
-                                             load_inference_model)
+    from paddle_tpu.static.inference import (export_layer,
+                                             load_predictor)
     paddle.seed(0)
     net = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 3))
     x = paddle.randn([2, 4])
     ref = net(x).numpy()
     with tempfile.TemporaryDirectory() as tmp:
         prefix = os.path.join(tmp, 'model')
-        save_inference_model(prefix, net, [x])
+        export_layer(prefix, net, [x])
         assert os.path.exists(prefix + '.stablehlo')
-        pred = load_inference_model(prefix)
+        pred = load_predictor(prefix)
         out = pred.run(x)
         np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
 
