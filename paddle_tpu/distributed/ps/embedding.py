@@ -90,19 +90,25 @@ class GeoCommunicator:
         self.touched = set()
         self._step = 0
 
-    def pull(self, ids):
-        flat = np.ascontiguousarray(ids, np.int64).reshape(-1)
-        unseen = np.array(
-            sorted(set(int(i) for i in flat) - set(self.base)), np.int64)
-        if len(unseen):
+    def _ensure(self, flat):
+        """Materialize server rows for any not-yet-mirrored ids
+        (O(batch): membership tests against the base dict)."""
+        unseen = sorted({int(i) for i in flat} - self.base.keys())
+        if unseen:
+            unseen = np.asarray(unseen, np.int64)
             rows = self.remote.pull(unseen)
             self.local.set(unseen, rows)
             for j, i in enumerate(unseen):
                 self.base[int(i)] = rows[j].copy()
+
+    def pull(self, ids):
+        flat = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        self._ensure(flat)
         return self.local.pull(flat)
 
     def push(self, ids, grads, lr):
         flat = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        self._ensure(flat)   # push without prior pull still needs a base
         self.local.push(flat, grads, lr)
         self.touched.update(int(i) for i in flat)
         self._step += 1

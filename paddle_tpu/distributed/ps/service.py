@@ -12,15 +12,20 @@ Wire protocol (little-endian):
   u8 op ('P' pull, 'U' push, 'S' save, 'L' load, 'N' size, 'Q' shutdown,
          'H' heartbeat, 'd' dense pull, 'e' dense push, 'I' dense set)
   u32 table_id ('H' has none)
-  P: u32 n, i64[n] ids                  -> f32[n*dim] rows
-  U: 16s client_uuid, u64 seq, u32 n, f32 lr, i64[n] ids,
+  P: u32 n, u32 dim, i64[n] ids         -> u8 ok, f32[n*dim] rows
+  U: 16s client_uuid, u64 seq, u32 n, u32 dim, f32 lr, i64[n] ids,
      f32[n*dim] grads                   -> u8 ok
   S/L: u32 len, path bytes              -> u8 ok
-  N: -> i64 size
-  d: -> u32 size, f32[size]
+  N: -> u8 ok, i64 size
+  d: -> u8 ok, u32 size, f32[size]
   e: 16s client_uuid, u64 seq, f32 lr, u32 size, f32[size] grads -> u8 ok
   I: u32 size, f32[size] values         -> u8 ok
   H: -> u8 ok
+
+Every response leads with a status byte: 0x01 ok, 0x00 application error
+followed by u32 len + utf-8 message. Application errors (bad path, missing
+table, wrong table kind) surface to the caller as PsError — they are NOT
+transport failures and are not retried.
 
 Pushes are NOT idempotent, so they carry a (client_uuid, seq) tag: a
 retry after a lost ack replays the same tag and the server skips the
@@ -51,6 +56,18 @@ def _read_n(sock, n):
             raise ConnectionError("peer closed")
         buf += chunk
     return buf
+
+
+class PsError(RuntimeError):
+    """Server-side application error (bad path, missing table, dim
+    mismatch) — surfaced to the caller, never retried."""
+
+
+def _read_status(sock):
+    if _read_n(sock, 1) == b'\x01':
+        return
+    (ln,) = struct.unpack('<I', _read_n(sock, 4))
+    raise PsError(_read_n(sock, ln).decode())
 
 
 class PsServer:
@@ -103,63 +120,97 @@ class PsServer:
             t.start()
             self._threads.append(t)
 
+    def _table(self, tid, dense=None):
+        t = self.tables.get(tid)
+        if t is None:
+            raise KeyError(f"no table {tid} on this server")
+        is_dense = isinstance(t, NativeDenseTable)
+        if dense is not None and dense != is_dense:
+            raise TypeError(f"table {tid} is "
+                            f"{'dense' if is_dense else 'sparse'}")
+        return t
+
     def _serve(self, conn):
+        def ok(payload=b''):
+            conn.sendall(b'\x01' + payload)
+
+        def fail(e):
+            msg = f"{type(e).__name__}: {e}".encode()[:65535]
+            conn.sendall(b'\x00' + struct.pack('<I', len(msg)) + msg)
+
         try:
             while True:
                 op = _read_n(conn, 1)
                 if op == b'Q':
-                    conn.sendall(b'\x01')
+                    ok()
                     self.stop()
                     return
                 if op == b'H':
-                    conn.sendall(b'\x01')
+                    ok()
                     continue
                 (tid,) = struct.unpack('<I', _read_n(conn, 4))
-                table = self.tables[tid]
-                if op == b'd':
-                    rows = table.pull()
-                    conn.sendall(struct.pack('<I', len(rows))
-                                 + rows.tobytes())
-                elif op == b'e':
-                    uuid = _read_n(conn, 16)
-                    (seq,) = struct.unpack('<Q', _read_n(conn, 8))
-                    lr, n = struct.unpack('<fI', _read_n(conn, 8))
-                    g = np.frombuffer(_read_n(conn, 4 * n), np.float32)
-                    if self._applied.get(uuid) != seq:   # replay dedup
-                        table.push(g, lr)
-                        self._applied[uuid] = seq
-                    conn.sendall(b'\x01')
-                elif op == b'I':
-                    (n,) = struct.unpack('<I', _read_n(conn, 4))
-                    vals = np.frombuffer(_read_n(conn, 4 * n), np.float32)
-                    table.set(vals)
-                    conn.sendall(b'\x01')
-                elif op == b'P':
-                    (n,) = struct.unpack('<I', _read_n(conn, 4))
-                    ids = np.frombuffer(_read_n(conn, 8 * n), np.int64)
-                    rows = table.pull(ids)
-                    conn.sendall(rows.tobytes())
-                elif op == b'U':
-                    uuid = _read_n(conn, 16)
-                    (seq,) = struct.unpack('<Q', _read_n(conn, 8))
-                    n, lr = struct.unpack('<If', _read_n(conn, 8))
-                    ids = np.frombuffer(_read_n(conn, 8 * n), np.int64)
-                    grads = np.frombuffer(
-                        _read_n(conn, 4 * n * table.dim),
-                        np.float32).reshape(n, table.dim)
-                    if self._applied.get(uuid) != seq:   # replay dedup
-                        table.push(ids, grads, lr)
-                        self._applied[uuid] = seq
-                    conn.sendall(b'\x01')
-                elif op in (b'S', b'L'):
-                    (ln,) = struct.unpack('<I', _read_n(conn, 4))
-                    path = _read_n(conn, ln).decode()
-                    (table.save if op == b'S' else table.load)(path)
-                    conn.sendall(b'\x01')
-                elif op == b'N':
-                    conn.sendall(struct.pack('<q', len(table)))
-                else:
-                    return
+                try:
+                    # each branch reads its FULL request before any table
+                    # lookup/apply, so application errors never desync
+                    # the stream
+                    if op == b'd':
+                        rows = self._table(tid, dense=True).pull()
+                        ok(struct.pack('<I', len(rows)) + rows.tobytes())
+                    elif op == b'e':
+                        uuid = _read_n(conn, 16)
+                        (seq,) = struct.unpack('<Q', _read_n(conn, 8))
+                        lr, n = struct.unpack('<fI', _read_n(conn, 8))
+                        g = np.frombuffer(_read_n(conn, 4 * n), np.float32)
+                        table = self._table(tid, dense=True)
+                        if self._applied.get(uuid) != seq:  # replay dedup
+                            table.push(g, lr)
+                            self._applied[uuid] = seq
+                        ok()
+                    elif op == b'I':
+                        (n,) = struct.unpack('<I', _read_n(conn, 4))
+                        vals = np.frombuffer(_read_n(conn, 4 * n),
+                                             np.float32)
+                        self._table(tid, dense=True).set(vals)
+                        ok()
+                    elif op == b'P':
+                        n, dim = struct.unpack('<II', _read_n(conn, 8))
+                        ids = np.frombuffer(_read_n(conn, 8 * n), np.int64)
+                        table = self._table(tid, dense=False)
+                        if table.dim != dim:
+                            raise ValueError(
+                                f"table {tid} dim {table.dim} != {dim}")
+                        ok(table.pull(ids).tobytes())
+                    elif op == b'U':
+                        uuid = _read_n(conn, 16)
+                        (seq,) = struct.unpack('<Q', _read_n(conn, 8))
+                        n, dim, lr = struct.unpack('<IIf',
+                                                   _read_n(conn, 12))
+                        ids = np.frombuffer(_read_n(conn, 8 * n), np.int64)
+                        grads = np.frombuffer(
+                            _read_n(conn, 4 * n * dim),
+                            np.float32).reshape(n, dim)
+                        table = self._table(tid, dense=False)
+                        if table.dim != dim:
+                            raise ValueError(
+                                f"table {tid} dim {table.dim} != {dim}")
+                        if self._applied.get(uuid) != seq:  # replay dedup
+                            table.push(ids, grads, lr)
+                            self._applied[uuid] = seq
+                        ok()
+                    elif op in (b'S', b'L'):
+                        (ln,) = struct.unpack('<I', _read_n(conn, 4))
+                        path = _read_n(conn, ln).decode()
+                        table = self._table(tid)
+                        (table.save if op == b'S' else table.load)(path)
+                        ok()
+                    elif op == b'N':
+                        ok(struct.pack('<q', len(self._table(tid))))
+                    else:
+                        return
+                except ConnectionError:
+                    raise
+                except Exception as e:   # application error, not transport
+                    fail(e)
         except (ConnectionError, OSError):
             pass
         finally:
@@ -271,9 +322,25 @@ class PsClient:
                     used = None
                     try:
                         with self._locks[s]:
-                            if self._socks[s] is None:
-                                self._connect(s)
+                            need_connect = self._socks[s] is None
+                        if need_connect:
+                            # connect OUTSIDE the lock: a blackholed host
+                            # would otherwise stall every rpc behind the
+                            # heartbeat's connect timeout
+                            host, port = self.endpoints[s].rsplit(':', 1)
+                            fresh = socket.create_connection(
+                                (host, int(port)), timeout=self._timeout)
+                            fresh.setsockopt(socket.IPPROTO_TCP,
+                                             socket.TCP_NODELAY, 1)
+                            with self._locks[s]:
+                                if self._socks[s] is None:
+                                    self._socks[s] = fresh
+                                else:   # an _rpc beat us to it
+                                    fresh.close()
+                        with self._locks[s]:
                             used = self._socks[s]
+                            if used is None:
+                                continue
                             used.sendall(b'H')
                             _read_n(used, 1)
                         self.alive[s] = True
@@ -322,8 +389,10 @@ class PsClient:
             sub = ids[mask]
 
             def req(sock):
-                sock.sendall(b'P' + struct.pack('<II', table_id, len(sub))
+                sock.sendall(b'P' + struct.pack('<III', table_id,
+                                                len(sub), dim)
                              + sub.tobytes())
+                _read_status(sock)
                 return np.frombuffer(_read_n(sock, 4 * len(sub) * dim),
                                      np.float32).reshape(len(sub), dim)
             out[mask] = self._rpc(s, req)
@@ -349,9 +418,10 @@ class PsClient:
 
             def req(sock):
                 sock.sendall(b'U' + struct.pack('<I', table_id) + tag
-                             + struct.pack('<If', len(sub), lr)
+                             + struct.pack('<IIf', len(sub),
+                                           grads.shape[1], lr)
                              + sub.tobytes() + sub_g.tobytes())
-                _read_n(sock, 1)
+                _read_status(sock)
             self._rpc(s, req)
         self._fanout(one, range(self.n_servers))
 
@@ -362,7 +432,7 @@ class PsClient:
             def req(sock, _p=p):
                 sock.sendall(b'S' + struct.pack('<II', table_id, len(_p))
                              + _p)
-                _read_n(sock, 1)
+                _read_status(sock)
             self._rpc(s, req)
 
     def table_size(self, table_id):
@@ -370,6 +440,7 @@ class PsClient:
         for s in range(self.n_servers):
             def req(sock):
                 sock.sendall(b'N' + struct.pack('<I', table_id))
+                _read_status(sock)
                 return struct.unpack('<q', _read_n(sock, 8))[0]
             total += self._rpc(s, req)
         return total
@@ -384,15 +455,27 @@ class PsClient:
         def req(sock):
             sock.sendall(b'I' + struct.pack('<II', table_id, len(vals))
                          + vals.tobytes())
-            _read_n(sock, 1)
+            _read_status(sock)
         self._rpc(self._dense_server(table_id), req)
 
     def dense_pull(self, table_id):
         def req(sock):
             sock.sendall(b'd' + struct.pack('<I', table_id))
+            _read_status(sock)
             (n,) = struct.unpack('<I', _read_n(sock, 4))
             return np.frombuffer(_read_n(sock, 4 * n), np.float32)
         return self._rpc(self._dense_server(table_id), req)
+
+    def dense_save(self, table_id, path):
+        """Dense tables live on ONE server (table_id % n_servers), so
+        their save targets only that server (sparse save fans out to all
+        shard servers)."""
+        p = f"{path}.part{self._dense_server(table_id)}".encode()
+
+        def req(sock):
+            sock.sendall(b'S' + struct.pack('<II', table_id, len(p)) + p)
+            _read_status(sock)
+        self._rpc(self._dense_server(table_id), req)
 
     def dense_push(self, table_id, grad, lr):
         g = np.ascontiguousarray(grad, np.float32).reshape(-1)
@@ -404,7 +487,7 @@ class PsClient:
         def req(sock):
             sock.sendall(b'e' + struct.pack('<I', table_id) + tag
                          + struct.pack('<fI', lr, len(g)) + g.tobytes())
-            _read_n(sock, 1)
+            _read_status(sock)
         self._rpc(self._dense_server(table_id), req)
 
     def shutdown(self):

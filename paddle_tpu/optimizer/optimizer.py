@@ -346,9 +346,11 @@ class SGD(Optimizer):
     """Parity: operators/optimizers/sgd_op."""
 
     def __init__(self, learning_rate=0.001, parameters=None,
-                 weight_decay=None, grad_clip=None, name=None, **kwargs):
+                 weight_decay=None, grad_clip=None, multi_precision=True,
+                 name=None, **kwargs):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          name)
+        self._multi_precision = multi_precision
 
     def update(self, param, grad, state, lr):
         return param - lr * grad, state

@@ -388,11 +388,10 @@ def record_op(name, fn, args, static_kwargs):
         if not dyn or all(d is not None and d >= 0 for d in v._shape):
             return v.data
         from jax import export as jax_export
-        # every dynamic dim shares ONE symbol so shapes unify across
-        # inputs — paddle's -1 is the batch dim, shared by data/label
-        # (distinct independent dynamic dims per op are not supported)
-        parts = ['_dyn' if d is None or d < 0 else str(d)
-                 for d in v._shape]
+        # dynamic dims share a symbol PER AXIS POSITION so data/label
+        # batch dims unify while (-1, -1) inputs keep independent dims
+        parts = [f'_dyn{j}' if d is None or d < 0 else str(d)
+                 for j, d in enumerate(v._shape)]
         dims = jax_export.symbolic_shape(', '.join(parts), scope=sym_scope)
         return jax.ShapeDtypeStruct(tuple(dims), v.dtype)
 

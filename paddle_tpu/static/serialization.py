@@ -32,10 +32,11 @@ _PLATFORMS = ('cpu', 'tpu')
 def _aval_of(v, scope=None):
     """Dynamic dims (None/-1, the paddle dynamic-batch idiom) export as
     jax symbolic dimensions so loaded kernels accept any size there.
-    All dynamic dims share one symbol (the batch), matching record_op."""
+    Dynamic dims share a symbol per axis position, matching record_op."""
     if all(d is not None and d >= 0 for d in v.shape):
         return jax.ShapeDtypeStruct(tuple(v.shape), v.dtype)
-    parts = ['_dyn' if d is None or d < 0 else str(d) for d in v.shape]
+    parts = [f'_dyn{j}' if d is None or d < 0 else str(d)
+             for j, d in enumerate(v.shape)]
     dims = jax_export.symbolic_shape(', '.join(parts), scope=scope)
     return jax.ShapeDtypeStruct(tuple(dims), v.dtype)
 

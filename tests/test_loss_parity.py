@@ -1,0 +1,17 @@
+"""bf16-vs-fp32 loss-parity (the north star's "loss-curve-matching"
+criterion; VERDICT r1 #9). Both legs run on CPU here for determinism; the
+tools/loss_parity.py script runs the same harness on the TPU chip."""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), 'tools'))
+
+
+def test_bf16_curve_tracks_fp32():
+    from loss_parity import compare
+    report = compare(steps=30, rel_tol=0.05)
+    assert report['fp32_decreased'] and report['bf16_decreased'], report
+    assert report['max_rel_gap'] < 0.05, report

@@ -120,10 +120,45 @@ def test_dense_table_remote():
     w = client.dense_pull(3)
     assert np.abs(w - 2.0).max() < 0.1, w
     path = os.path.join(tempfile.mkdtemp(), 'dense')
-    client.save(3, path)
+    client.dense_save(3, path)
     assert os.path.exists(path + '.part0')
     client.shutdown()
     client.close()
+
+
+def test_application_errors_surface_not_retry():
+    """Bad path / missing table / dim mismatch raise PsError immediately
+    (application error), not a 30s transport-retry storm."""
+    import time
+    from paddle_tpu.distributed.ps.service import (PsServer, PsClient,
+                                                   PsError)
+    server = PsServer().start()
+    server.add_table(0, dim=4, optimizer='sgd')
+    client = PsClient([f'127.0.0.1:{server.port}'], retry_timeout=30)
+    ids = np.arange(4, dtype=np.int64)
+    t0 = time.time()
+    with pytest.raises(PsError):
+        client.save(0, '/nonexistent_dir_xyz/snap')
+    with pytest.raises(PsError):
+        client.pull(7, ids, 4)          # missing table
+    with pytest.raises(PsError):
+        client.pull(0, ids, 8)          # dim mismatch
+    assert time.time() - t0 < 5        # no retry storm
+    # connection still healthy afterwards (stream not desynced)
+    assert client.pull(0, ids, 4).shape == (4, 4)
+    client.shutdown()
+    client.close()
+
+
+def test_geo_push_without_pull():
+    from paddle_tpu.distributed.ps.embedding import GeoCommunicator
+    from paddle_tpu.core.native import NativeSparseTable
+    base = NativeSparseTable(4, optimizer='sgd', seed=3)
+    geo = GeoCommunicator(base, 4, k_steps=1)
+    ids = np.array([5, 6], np.int64)
+    w0 = base.pull(ids).copy()
+    geo.push(ids, np.ones((2, 4), np.float32), lr=0.1)   # no prior pull
+    np.testing.assert_allclose(base.pull(ids), w0 - 0.1, rtol=1e-5)
 
 
 def test_kill_one_server_recovers():
