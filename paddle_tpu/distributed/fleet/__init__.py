@@ -11,6 +11,7 @@ from .base.role_maker import (PaddleCloudRoleMaker, UserDefinedRoleMaker,
 from .base.topology import (CommunicateTopology, HybridCommunicateGroup,
                             ParallelMode)
 from . import meta_parallel
+from . import metrics
 from . import meta_optimizers
 from . import utils
 from .meta_optimizers.dygraph_optimizer import (HybridParallelOptimizer,

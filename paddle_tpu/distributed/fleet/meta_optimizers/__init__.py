@@ -281,11 +281,32 @@ class ShardingOptimizer(MetaOptimizerBase):
 
 
 class DGCOptimizer(MetaOptimizerBase):
-    """Parity: dgc_optimizer.py:22 — top-k grad compression. DCN-only
-    relevance on TPU (ICI is fast); not applied by default."""
+    """Parity: dgc_optimizer.py:22 — swaps Momentum for
+    DGCMomentumOptimizer (top-k grad compression with local residual
+    accumulation). DCN-relevant on TPU (ICI is fast)."""
 
     def _can_apply(self):
-        return False
+        from ....optimizer import Momentum
+        return bool(self.user_defined_strategy.dgc) and \
+            isinstance(self.user_defined_optimizer, Momentum)
+
+    def minimize_impl(self, loss, startup_program=None, parameter_list=None,
+                      no_grad_set=None):
+        from ....optimizer import DGCMomentumOptimizer
+        cfg = self.user_defined_strategy.dgc_configs
+        inner = self.user_defined_optimizer
+        opt = DGCMomentumOptimizer(
+            learning_rate=inner._learning_rate,
+            momentum=inner._momentum,
+            rampup_begin_step=cfg.get('rampup_begin_step', 0),
+            rampup_step=cfg.get('rampup_step', 1),
+            sparsity=cfg.get('sparsity', [0.999]),
+            parameters=inner._parameter_list,
+            use_nesterov=inner._use_nesterov,
+            weight_decay=inner._weight_decay,
+            grad_clip=inner._grad_clip)
+        return opt.minimize(loss, startup_program, parameter_list,
+                            no_grad_set)
 
 
 class FP16AllReduceOptimizer(MetaOptimizerBase):

@@ -9,6 +9,7 @@ reference's double-buffered reader. A C++ feed pipeline (csrc/datafeed) slots in
 underneath for file-based ingestion (reference framework/data_feed.cc).
 """
 import itertools
+import os
 import queue as _queue
 import threading
 
@@ -249,10 +250,40 @@ def default_collate_fn(batch):
     return batch
 
 
+def _worker_loop(dataset, index_q, result_q, parent_pid, worker_id,
+                 worker_init_fn):
+    """Parity: fluid/dataloader/worker.py _worker_loop:251 — reads index
+    batches, emits raw samples; the ParentWatchDog role is the getppid
+    check (exit when the parent dies)."""
+    import queue as q
+    if worker_init_fn is not None:
+        try:
+            worker_init_fn(worker_id)
+        except Exception as e:
+            result_q.put((-1, None, f"worker_init_fn: {e!r}"))
+            return
+    while True:
+        if os.getppid() != parent_pid:        # parent died
+            return
+        try:
+            item = index_q.get(timeout=1.0)
+        except q.Empty:
+            continue
+        if item is None:
+            return
+        idx, indices = item
+        try:
+            result_q.put((idx, [dataset[i] for i in indices], None))
+        except Exception as e:
+            result_q.put((idx, None, repr(e)))
+            return
+
+
 class DataLoader:
-    """Parity: paddle.io.DataLoader (fluid/reader.py:146). Background-thread
-    prefetch replaces the reference's worker-process + blocking-queue pipeline
-    (A.6); num_workers>0 currently maps to thread prefetch depth."""
+    """Parity: paddle.io.DataLoader (fluid/reader.py:146). num_workers>0
+    runs REAL worker processes with an index queue, result reordering and
+    parent/worker death detection (A.6); IterableDataset uses a
+    background prefetch thread."""
 
     def __init__(self, dataset, feed_list=None, places=None,
                  return_list=True, batch_sampler=None, batch_size=1,
@@ -264,6 +295,8 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch = max(2, prefetch_factor)
+        self._worker_init_fn = worker_init_fn
+        self._timeout = timeout
         self._iterable_ds = isinstance(dataset, IterableDataset)
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
@@ -300,6 +333,13 @@ class DataLoader:
         if self.num_workers == 0:
             yield from self._gen()
             return
+        if self._iterable_ds or self.batch_sampler is None:
+            yield from self._thread_iter()
+            return
+        yield from self._multiprocess_iter()
+
+    def _thread_iter(self):
+        """Background-thread prefetch (IterableDataset path)."""
         q = _queue.Queue(maxsize=self.prefetch)
         _SENTINEL = object()
 
@@ -317,6 +357,81 @@ class DataLoader:
             if item is _SENTINEL:
                 break
             yield item
+
+    def _multiprocess_iter(self):
+        """Real worker processes (parity: fluid/dataloader/worker.py
+        _worker_loop:251 + reader.py multiprocess path): an index queue
+        feeds num_workers forked readers; samples return via a result
+        queue (raw, collated in the parent — workers never touch the
+        device runtime); results reorder to sampler order; a
+        ParentWatchDog in each worker exits on parent death, and the
+        parent detects dead workers instead of hanging."""
+        import multiprocessing as mp
+        ctx = mp.get_context('fork')
+        window = max(2, self.prefetch) * self.num_workers
+        index_q = ctx.Queue(maxsize=window)
+        result_q = ctx.Queue(maxsize=window)
+        total = {}     # set once the (possibly unsized) sampler exhausts
+
+        def feeder():
+            """Feed index batches lazily — infinite/streaming samplers
+            work, and a huge epoch never materializes up front."""
+            n = 0
+            for item in enumerate(self.batch_sampler):
+                index_q.put(item)
+                n += 1
+            total['n'] = n
+            for _ in range(self.num_workers):
+                index_q.put(None)
+        feed_thread = threading.Thread(target=feeder, daemon=True)
+        feed_thread.start()
+
+        workers = [
+            ctx.Process(target=_worker_loop,
+                        args=(self.dataset, index_q, result_q,
+                              os.getpid(), wid, self._worker_init_fn),
+                        daemon=True)
+            for wid in range(self.num_workers)]
+        for w in workers:
+            w.start()
+        # timeout semantics (paddle parity): 0 = wait forever; >0 = max
+        # wait per BATCH (reset after every yielded batch)
+        per_batch = self._timeout if self._timeout else None
+        pending = {}
+        want = 0
+        try:
+            while True:
+                if 'n' in total and want >= total['n']:
+                    break
+                waited = 0.0
+                while want not in pending:
+                    try:
+                        idx, samples, err = result_q.get(timeout=1.0)
+                    except _queue.Empty:
+                        if 'n' in total and want >= total['n']:
+                            break
+                        if not any(w.is_alive() for w in workers):
+                            raise RuntimeError(
+                                "DataLoader workers died (see worker "
+                                "stderr)")
+                        waited += 1.0
+                        if per_batch is not None and waited >= per_batch:
+                            raise RuntimeError(
+                                "DataLoader worker timeout "
+                                f"({per_batch}s for one batch)")
+                        continue
+                    if err is not None:
+                        raise RuntimeError(f"DataLoader worker: {err}")
+                    pending[idx] = samples
+                if want in pending:
+                    yield self.collate_fn(pending.pop(want))
+                    want += 1
+        finally:
+            for w in workers:
+                if w.is_alive():
+                    w.terminate()
+            for w in workers:
+                w.join(timeout=5)
 
 
 def get_worker_info():

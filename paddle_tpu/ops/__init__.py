@@ -6,5 +6,5 @@ creation.py (fill/random), nn_ops.py (activations/norm/conv/loss),
 linalg.py. The OP_REGISTRY in common.py is the lookup the static executor
 uses (parity: framework/op_registry.h).
 """
-from . import common, math, manip, creation, nn_ops, linalg
+from . import common, math, manip, creation, nn_ops, linalg, sequence
 from .common import OP_REGISTRY

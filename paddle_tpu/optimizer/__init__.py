@@ -1,4 +1,5 @@
 """paddle_tpu.optimizer — parity: python/paddle/optimizer."""
 from . import lr
 from .optimizer import (Optimizer, SGD, Momentum, Adagrad, RMSProp, Adam,
-                        AdamW, Adamax, Lamb, Lars, LarsMomentum)
+                        AdamW, Adamax, Lamb, Lars, LarsMomentum,
+                        DGCMomentumOptimizer)

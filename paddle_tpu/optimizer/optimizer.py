@@ -14,6 +14,7 @@ param is re-cast after the update.
 """
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from ..core import dtypes
 from ..core.tensor import Tensor
@@ -377,6 +378,52 @@ class Momentum(Optimizer):
         else:
             new_p = param - lr * v
         return new_p, {'velocity': v}
+
+
+class DGCMomentumOptimizer(Momentum):
+    """Parity: fluid.optimizer.DGCMomentumOptimizer:1453 + dgc_op.cc
+    (Deep Gradient Compression): momentum-corrected gradients are top-k
+    sparsified before application/communication, with the residual
+    accumulated locally (u/v buffers) until it crosses the threshold.
+    On TPU the win is DCN-only (ICI is fast); rampup delays compression
+    like the reference (`rampup_begin_step`)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 rampup_begin_step=0, rampup_step=1, sparsity=(0.999,),
+                 parameters=None, use_nesterov=False, weight_decay=None,
+                 grad_clip=None, name=None, **kwargs):
+        super().__init__(learning_rate, momentum, parameters, use_nesterov,
+                         weight_decay, grad_clip, name=name)
+        self._rampup_begin_step = float(rampup_begin_step)
+        self._rampup_step = max(1.0, float(rampup_step))
+        if not isinstance(sparsity, (list, tuple)):
+            sparsity = [sparsity]
+        self._sparsity_schedule = tuple(float(s) for s in sparsity)
+
+    def init_state(self, param):
+        z = jnp.zeros(param.data.shape, jnp.float32)
+        return {'u': z, 'v': z, 'step': jnp.zeros((), jnp.float32)}
+
+    def update(self, param, grad, state, lr):
+        step = state['step']
+        u = self._momentum * state['u'] + grad       # momentum correction
+        corrected = grad + self._momentum * u if self._use_nesterov else u
+        v = state['v'] + corrected
+        # rampup sparsity schedule (dgc paper / reference warm-up):
+        # sparsity steps through the list once every rampup_step steps
+        sched = jnp.asarray(self._sparsity_schedule, jnp.float32)
+        idx = jnp.clip(((step - self._rampup_begin_step)
+                        / self._rampup_step).astype(jnp.int32),
+                       0, len(self._sparsity_schedule) - 1)
+        sp = sched[idx]
+        thr = jnp.quantile(jnp.abs(v.reshape(-1)), sp)
+        mask = (jnp.abs(v) >= thr).astype(v.dtype)
+        ramping = step >= self._rampup_begin_step
+        mask = jnp.where(ramping, mask, jnp.ones_like(mask))
+        enc = v * mask                               # the communicated part
+        new_p = param - lr * enc
+        return new_p, {'u': u * (1 - mask), 'v': v * (1 - mask),
+                       'step': step + 1}
 
 
 class Adagrad(Optimizer):

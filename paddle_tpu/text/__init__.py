@@ -1,3 +1,5 @@
 """paddle_tpu.text (parity: python/paddle/text — datasets + viterbi)."""
 from . import datasets
 from .datasets import Imdb, Imikolov, UCIHousing, WMT14, Conll05st
+from ..ops.sequence import (viterbi_decode, ViterbiDecoder,
+                            linear_chain_crf, crf_decoding, beam_search)

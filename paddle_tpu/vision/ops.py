@@ -1,4 +1,5 @@
 """Vision ops (parity subset: python/paddle/vision/ops)."""
+import numpy as np
 import jax.numpy as jnp
 from ..core.tensor import Tensor
 
@@ -29,5 +30,122 @@ def nms(boxes, iou_threshold=0.3, scores=None, **kwargs):
     return Tensor(np.asarray(keep, dtype=np.int64))
 
 
-def roi_align(*a, **k):
-    raise NotImplementedError("roi_align lands with the detection tier")
+def roi_align(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """Parity: paddle.vision.ops.roi_align (operators/roi_align_op.cc).
+
+    Bilinear-sampled ROI pooling, fully vectorized (vmap over ROIs — the
+    CUDA kernel's thread-per-cell loop becomes one gather/average graph).
+    sampling_ratio <= 0 uses 2 samples per cell axis (the adaptive
+    ceil(roi/out) rule is data-dependent, which XLA's static shapes
+    exclude; 2 matches the common detectron default).
+    """
+    import jax
+    from ..core.autograd import run_op
+    from ..ops.common import as_tensor
+    x = as_tensor(x)
+    boxes = as_tensor(boxes)
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) \
+        else output_size
+    if boxes_num is not None:
+        bn = np.asarray(as_tensor(boxes_num).data).reshape(-1)
+    else:
+        bn = np.array([boxes.shape[0]])
+    batch_idx = jnp.asarray(np.repeat(np.arange(len(bn)), bn), jnp.int32)
+    sr = sampling_ratio if sampling_ratio > 0 else 2
+
+    def fn(feat, bxs):
+        offset = 0.5 if aligned else 0.0
+        x1 = bxs[:, 0] * spatial_scale - offset
+        y1 = bxs[:, 1] * spatial_scale - offset
+        x2 = bxs[:, 2] * spatial_scale - offset
+        y2 = bxs[:, 3] * spatial_scale - offset
+        rw, rh = x2 - x1, y2 - y1
+        if not aligned:
+            rw = jnp.maximum(rw, 1.0)
+            rh = jnp.maximum(rh, 1.0)
+        bw, bh = rw / ow, rh / oh
+        H, W = feat.shape[2], feat.shape[3]
+
+        # sample coords per roi: [oh*sr] x [ow*sr]
+        gy = (jnp.arange(oh * sr) + 0.5) / sr          # in bin units
+        gx = (jnp.arange(ow * sr) + 0.5) / sr
+
+        def one(b, yy1, xx1, bhh, bww):
+            ys = yy1 + gy * bhh                        # [oh*sr]
+            xs = xx1 + gx * bww
+            # reference kernel: samples outside [-1, H]/[-1, W] contribute
+            # zero (not edge replication)
+            yok = (ys >= -1.0) & (ys <= H)
+            xok = (xs >= -1.0) & (xs <= W)
+            ys = jnp.clip(ys, 0.0, H - 1)
+            xs = jnp.clip(xs, 0.0, W - 1)
+            y0 = jnp.floor(ys)
+            x0 = jnp.floor(xs)
+            y1i = jnp.clip(y0 + 1, 0, H - 1).astype(jnp.int32)
+            x1i = jnp.clip(x0 + 1, 0, W - 1).astype(jnp.int32)
+            ly = jnp.clip(ys - y0, 0.0, 1.0)
+            lx = jnp.clip(xs - x0, 0.0, 1.0)
+            y0 = y0.astype(jnp.int32)
+            x0 = x0.astype(jnp.int32)
+            fm = feat[b]                               # [C, H, W]
+            v00 = fm[:, y0][:, :, x0]
+            v01 = fm[:, y0][:, :, x1i]
+            v10 = fm[:, y1i][:, :, x0]
+            v11 = fm[:, y1i][:, :, x1i]
+            ly = ly[None, :, None]
+            lx = lx[None, None, :]
+            val = (v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx
+                   + v10 * ly * (1 - lx) + v11 * ly * lx)  # [C,oh*sr,ow*sr]
+            val = val * (yok[None, :, None] & xok[None, None, :])
+            C = val.shape[0]
+            return val.reshape(C, oh, sr, ow, sr).mean((2, 4))
+        return jax.vmap(one)(batch_idx, y1, x1, bh, bw)
+    return run_op('roi_align', fn, [x, boxes])
+
+
+def roi_pool(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0,
+             name=None):
+    """Parity: paddle.vision.ops.roi_pool (max pooling over ROI bins)."""
+    import jax
+    from ..core.autograd import run_op
+    from ..ops.common import as_tensor
+    x = as_tensor(x)
+    boxes = as_tensor(boxes)
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) \
+        else output_size
+    if boxes_num is not None:
+        bn = np.asarray(as_tensor(boxes_num).data).reshape(-1)
+    else:
+        bn = np.array([boxes.shape[0]])
+    batch_idx = jnp.asarray(np.repeat(np.arange(len(bn)), bn), jnp.int32)
+
+    def fn(feat, bxs):
+        H, W = feat.shape[2], feat.shape[3]
+        x1 = jnp.floor(bxs[:, 0] * spatial_scale)
+        y1 = jnp.floor(bxs[:, 1] * spatial_scale)
+        x2 = jnp.ceil(bxs[:, 2] * spatial_scale)
+        y2 = jnp.ceil(bxs[:, 3] * spatial_scale)
+
+        def one(b, yy1, xx1, yy2, xx2):
+            rh = jnp.maximum(yy2 - yy1, 1.0)
+            rw = jnp.maximum(xx2 - xx1, 1.0)
+            fm = feat[b]
+            ys = jnp.arange(H, dtype=jnp.float32)
+            xs = jnp.arange(W, dtype=jnp.float32)
+            out = []
+            for i in range(oh):
+                for j in range(ow):
+                    ylo = yy1 + rh * i / oh
+                    yhi = yy1 + rh * (i + 1) / oh
+                    xlo = xx1 + rw * j / ow
+                    xhi = xx1 + rw * (j + 1) / ow
+                    my = (ys >= jnp.floor(ylo)) & (ys < jnp.ceil(yhi))
+                    mx = (xs >= jnp.floor(xlo)) & (xs < jnp.ceil(xhi))
+                    m = my[:, None] & mx[None, :]
+                    cell = jnp.where(m[None], fm, -jnp.inf).max((1, 2))
+                    out.append(jnp.where(jnp.isfinite(cell), cell, 0.0))
+            C = fm.shape[0]
+            return jnp.stack(out, -1).reshape(C, oh, ow)
+        return jax.vmap(one)(batch_idx, y1, x1, y2, x2)
+    return run_op('roi_pool', fn, [x, boxes])

@@ -1,0 +1,126 @@
+"""DGC optimizer, fleet distributed metrics, multiprocess DataLoader."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.core.tensor import Tensor
+
+
+class TestDGC:
+    def test_dgc_momentum_converges(self):
+        """Top-k sparsified updates + residual accumulation still solve
+        the regression (parity: DGCMomentumOptimizer semantics)."""
+        paddle.seed(0)
+        rng = np.random.RandomState(0)
+        xs = rng.rand(64, 8).astype('float32')
+        w_true = rng.randn(8, 1).astype('float32')
+        ys = xs @ w_true
+        net = nn.Linear(8, 1)
+        opt = paddle.optimizer.DGCMomentumOptimizer(
+            learning_rate=0.2, momentum=0.9, sparsity=[0.75],
+            rampup_begin_step=0, parameters=net.parameters())
+        x, y = Tensor(xs), Tensor(ys)
+        losses = []
+        for _ in range(120):
+            loss = ((net(x) - y) * (net(x) - y)).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < 0.05 * losses[0], (losses[0], losses[-1])
+
+    def test_dgc_update_is_sparse(self):
+        w = paddle.to_tensor(np.zeros(100, 'float32'))
+        w.stop_gradient = False
+        opt = paddle.optimizer.DGCMomentumOptimizer(
+            learning_rate=1.0, momentum=0.0, sparsity=[0.9],
+            rampup_begin_step=0, parameters=[w])
+        g = np.random.RandomState(0).randn(100).astype('float32')
+        loss = (w * Tensor(g)).sum()
+        loss.backward()
+        opt.step()
+        # ~10% of entries updated, the rest accumulate locally
+        changed = (np.asarray(w.data) != 0).sum()
+        assert changed <= 15, changed
+
+    def test_dgc_meta_optimizer_applies(self):
+        import os
+        import paddle_tpu.distributed.fleet as fleet
+        import paddle_tpu.static as static
+        os.environ.setdefault('PADDLE_TRAINER_ID', '0')
+        paddle.enable_static()
+        try:
+            fleet.fleet._hcg = None
+            main = static.Program()
+            with static.program_guard(main):
+                x = static.data('x', [4, 8])
+                yv = static.nn.fc(x, 1)
+                loss = paddle.mean(yv * yv)
+            s = fleet.DistributedStrategy()
+            s.dgc = True
+            fleet.init(is_collective=True, strategy=s)
+            opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9)
+            opt = fleet.fleet.distributed_optimizer(opt)
+            fleet.fleet.minimize(loss)
+            types = [op.type for op in main.global_block().ops]
+            assert 'dgcmomentumoptimizer' in types, types
+        finally:
+            paddle.disable_static()
+
+
+class TestFleetMetrics:
+    def test_local_aggregates(self):
+        from paddle_tpu.distributed.fleet import metrics as M
+        assert M.sum(np.array([1.0, 2.0, 3.0])) == 6.0
+        assert M.max(np.array([1.0, 5.0])) == 5.0
+        assert M.min(Tensor(np.array([2.0, 7.0], 'float32'))) == 2.0
+        assert abs(M.acc(np.array([8.0]), np.array([10.0])) - 0.8) < 1e-9
+
+    def test_auc_from_buckets(self):
+        from paddle_tpu.distributed.fleet import metrics as M
+        # perfect separation: positives in the top bucket
+        pos = np.array([0.0, 0.0, 0.0, 10.0])
+        neg = np.array([10.0, 0.0, 0.0, 0.0])
+        assert M.auc(pos, neg) == 1.0
+        # identical distributions -> 0.5
+        same = np.array([5.0, 5.0, 5.0, 5.0])
+        assert abs(M.auc(same, same) - 0.5) < 1e-9
+
+
+class _SquareDataset:
+    def __len__(self):
+        return 32
+
+    def __getitem__(self, i):
+        return (np.full((3,), i, np.float32),
+                np.array([i * i], np.float32))
+
+
+class TestMultiprocessDataLoader:
+    def test_worker_processes_match_single(self):
+        from paddle_tpu.io import DataLoader
+        ds = _SquareDataset()
+        ref = [tuple(np.asarray(t.data) for t in b)
+               for b in DataLoader(ds, batch_size=4, num_workers=0)]
+        got = [tuple(np.asarray(t.data) for t in b)
+               for b in DataLoader(ds, batch_size=4, num_workers=2)]
+        assert len(got) == len(ref) == 8
+        for (a1, b1), (a2, b2) in zip(ref, got):   # order preserved
+            np.testing.assert_allclose(a1, a2)
+            np.testing.assert_allclose(b1, b2)
+
+    def test_worker_error_surfaces(self):
+        from paddle_tpu.io import DataLoader
+
+        class Bad:
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                if i == 5:
+                    raise ValueError("boom")
+                return np.zeros(2, np.float32)
+
+        with pytest.raises(RuntimeError, match="boom"):
+            list(DataLoader(Bad(), batch_size=2, num_workers=2))

@@ -1,0 +1,235 @@
+"""Op tier-3 tests vs numpy references (the op_test.py pattern):
+sequence ops, linear-chain CRF, viterbi, beam search, roi_align/pool."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops import sequence as S
+
+
+def _np(t):
+    return np.asarray(t.data if isinstance(t, Tensor) else t)
+
+
+class TestSequenceOps:
+    def test_pad_unpad_roundtrip(self):
+        rng = np.random.RandomState(0)
+        lens = np.array([3, 1, 4], np.int64)
+        packed = rng.rand(int(lens.sum()), 5).astype('float32')
+        padded, _ = S.sequence_pad(Tensor(packed), Tensor(lens),
+                                   pad_value=0.0)
+        assert _np(padded).shape == (3, 4, 5)
+        assert np.all(_np(padded)[1, 1:] == 0)
+        back = S.sequence_unpad(padded, Tensor(lens))
+        np.testing.assert_allclose(_np(back), packed)
+
+    def test_expand_and_reverse(self):
+        x = np.arange(6, dtype='float32').reshape(3, 2)
+        out = S.sequence_expand(Tensor(x), Tensor(np.array([2, 0, 3])))
+        ref = np.repeat(x, [2, 0, 3], axis=0)
+        np.testing.assert_allclose(_np(out), ref)
+
+        seq = np.arange(24, dtype='float32').reshape(2, 4, 3)
+        lens = np.array([3, 4], np.int64)
+        rev = S.sequence_reverse(Tensor(seq), Tensor(lens))
+        ref = seq.copy()
+        ref[0, :3] = seq[0, :3][::-1]
+        ref[1] = seq[1][::-1]
+        np.testing.assert_allclose(_np(rev), ref)
+
+
+def _crf_ref_nll(emit, trans, label, lens):
+    """Brute-force CRF NLL by path enumeration."""
+    import itertools
+    start, stop, sq = trans[0], trans[1], trans[2:]
+    B, T, N = emit.shape
+    out = np.zeros((B, 1), np.float64)
+    for b in range(B):
+        L = int(lens[b])
+        scores = []
+        for path in itertools.product(range(N), repeat=L):
+            s = start[path[0]] + emit[b, 0, path[0]]
+            for t in range(1, L):
+                s += sq[path[t - 1], path[t]] + emit[b, t, path[t]]
+            s += stop[path[L - 1]]
+            scores.append(s)
+        logz = np.log(np.sum(np.exp(np.array(scores))))
+        y = label[b, :L]
+        gold = start[y[0]] + emit[b, 0, y[0]]
+        for t in range(1, L):
+            gold += sq[y[t - 1], y[t]] + emit[b, t, y[t]]
+        gold += stop[y[L - 1]]
+        out[b, 0] = logz - gold
+    return out
+
+
+class TestCRF:
+    def test_linear_chain_crf_matches_enumeration(self):
+        rng = np.random.RandomState(0)
+        B, T, N = 3, 4, 3
+        emit = rng.randn(B, T, N).astype('float32')
+        trans = rng.randn(N + 2, N).astype('float32')
+        label = rng.randint(0, N, (B, T))
+        lens = np.array([4, 2, 3], np.int64)
+        nll = S.linear_chain_crf(Tensor(emit), Tensor(trans),
+                                 Tensor(label.astype(np.int64)),
+                                 Tensor(lens))
+        ref = _crf_ref_nll(emit.astype(np.float64), trans.astype(np.float64),
+                           label, lens)
+        np.testing.assert_allclose(_np(nll), ref, rtol=1e-4, atol=1e-4)
+
+    def test_crf_decoding_matches_enumeration(self):
+        import itertools
+        rng = np.random.RandomState(1)
+        B, T, N = 2, 4, 3
+        emit = rng.randn(B, T, N).astype('float32')
+        trans = rng.randn(N + 2, N).astype('float32')
+        lens = np.array([4, 3], np.int64)
+        path = S.crf_decoding(Tensor(emit), Tensor(trans), Tensor(lens))
+        start, stop, sq = trans[0], trans[1], trans[2:]
+        for b in range(B):
+            L = int(lens[b])
+            best, best_s = None, -1e18
+            for p in itertools.product(range(N), repeat=L):
+                s = start[p[0]] + emit[b, 0, p[0]]
+                for t in range(1, L):
+                    s += sq[p[t - 1], p[t]] + emit[b, t, p[t]]
+                s += stop[p[L - 1]]
+                if s > best_s:
+                    best, best_s = p, s
+            np.testing.assert_array_equal(_np(path)[b, :L], best)
+
+    def test_crf_trains(self):
+        """linear_chain_crf is differentiable: transitions learn a forced
+        tag pattern."""
+        rng = np.random.RandomState(0)
+        B, T, N = 8, 6, 4
+        emit_np = rng.randn(B, T, N).astype('float32') * 0.1
+        label = np.tile(np.arange(T) % N, (B, 1)).astype(np.int64)
+        lens = np.full((B,), T, np.int64)
+        trans = paddle.to_tensor(
+            rng.randn(N + 2, N).astype('float32') * 0.1)
+        trans.stop_gradient = False
+        opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=[trans])
+        first = None
+        for i in range(40):
+            nll = S.linear_chain_crf(Tensor(emit_np), trans,
+                                     Tensor(label), Tensor(lens))
+            loss = paddle.mean(nll)
+            if first is None:
+                first = float(loss)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert float(loss) < first * 0.5
+        decoded = S.crf_decoding(Tensor(emit_np), trans, Tensor(lens))
+        assert (_np(decoded) == label).mean() > 0.9
+
+    def test_viterbi_decode_api(self):
+        rng = np.random.RandomState(2)
+        B, T, N = 2, 5, 4
+        emit = rng.randn(B, T, N).astype('float32')
+        trans = rng.randn(N, N).astype('float32')
+        lens = np.array([5, 3], np.int64)
+        scores, path = S.viterbi_decode(Tensor(emit), Tensor(trans),
+                                        Tensor(lens),
+                                        include_bos_eos_tag=False)
+        # brute force
+        import itertools
+        for b in range(B):
+            L = int(lens[b])
+            best, best_s = None, -1e18
+            for p in itertools.product(range(N), repeat=L):
+                s = emit[b, 0, p[0]]
+                for t in range(1, L):
+                    s += trans[p[t - 1], p[t]] + emit[b, t, p[t]]
+                if s > best_s:
+                    best, best_s = p, s
+            np.testing.assert_array_equal(_np(path)[b, :L], best)
+            np.testing.assert_allclose(_np(scores)[b], best_s, rtol=1e-5)
+
+
+class TestBeamSearch:
+    def test_beam_matches_exhaustive(self):
+        """Markov LM with fixed per-step log-probs: beam K=V recovers the
+        exact best path of an exhaustive search."""
+        rng = np.random.RandomState(0)
+        V, T = 5, 4
+        table = rng.randn(V, V).astype('float32')   # logp[next | cur]
+        table = table - np.log(np.exp(table).sum(1, keepdims=True))
+
+        def step_fn(ids, state):
+            import jax.numpy as jnp
+            return jnp.asarray(table)[ids], state
+
+        seqs, scores = S.beam_search(step_fn, {}, bos_id=0, eos_id=99,
+                                     beam_size=V, max_len=T, batch_size=1)
+        # exhaustive best path from bos=0
+        import itertools
+        best_s, best_p = -1e18, None
+        for p in itertools.product(range(V), repeat=T):
+            s, cur = 0.0, 0
+            for tok in p:
+                s += table[cur, tok]
+                cur = tok
+            if s > best_s:
+                best_s, best_p = s, p
+        np.testing.assert_array_equal(_np(seqs)[0, 0], best_p)
+        np.testing.assert_allclose(_np(scores)[0, 0], best_s, rtol=1e-5)
+
+    def test_eos_freezes_beam(self):
+        import jax.numpy as jnp
+        V = 4
+
+        def step_fn(ids, state):
+            logp = jnp.full((ids.shape[0], V), -10.0)
+            logp = logp.at[:, 1].set(-0.1)    # prefer eos=1
+            return logp, state
+
+        seqs, scores = S.beam_search(step_fn, {}, bos_id=0, eos_id=1,
+                                     beam_size=2, max_len=5, batch_size=1)
+        top = _np(seqs)[0, 0]
+        assert top[0] == 1 and np.all(top == 1)   # eos then frozen padding
+        np.testing.assert_allclose(_np(scores)[0, 0], -0.1, atol=1e-5)
+
+
+class TestRoiOps:
+    def test_roi_align_linear_field_exact(self):
+        """Bilinear sampling of a linear field f(x,y)=x+10y is exact: any
+        aligned ROI returns the value at its (shifted) center."""
+        from paddle_tpu.vision.ops import roi_align
+        xs, ys = np.meshgrid(np.arange(8), np.arange(8))
+        feat = (xs + 10.0 * ys).astype('float32').reshape(1, 1, 8, 8)
+        boxes = np.array([[1.0, 1.0, 3.0, 3.0],
+                          [2.0, 0.0, 6.0, 4.0]], 'float32')
+        out = roi_align(Tensor(feat), Tensor(boxes),
+                        Tensor(np.array([2], np.int32)), output_size=1,
+                        spatial_scale=1.0, aligned=True)
+        # aligned center = ((x1+x2)/2 - 0.5, (y1+y2)/2 - 0.5)
+        np.testing.assert_allclose(_np(out).reshape(-1),
+                                   [1.5 + 10 * 1.5, 3.5 + 10 * 1.5],
+                                   atol=1e-4)
+
+    def test_roi_align_shape_and_grad(self):
+        from paddle_tpu.vision.ops import roi_align
+        rng = np.random.RandomState(0)
+        feat = paddle.to_tensor(rng.rand(2, 3, 8, 8).astype('float32'))
+        feat.stop_gradient = False
+        boxes = np.array([[0, 0, 4, 4], [2, 2, 7, 7], [1, 0, 5, 3]],
+                         'float32')
+        out = roi_align(feat, Tensor(boxes),
+                        Tensor(np.array([2, 1], np.int32)), output_size=2)
+        assert _np(out).shape == (3, 3, 2, 2)
+        paddle.sum(out).backward()
+        assert feat.grad is not None
+        assert float(np.abs(_np(feat.grad)).sum()) > 0
+
+    def test_roi_pool_max(self):
+        from paddle_tpu.vision.ops import roi_pool
+        feat = np.arange(16, dtype='float32').reshape(1, 1, 4, 4)
+        boxes = np.array([[0, 0, 4, 4]], 'float32')
+        out = roi_pool(Tensor(feat), Tensor(boxes),
+                       Tensor(np.array([1], np.int32)), output_size=2)
+        np.testing.assert_allclose(
+            _np(out).reshape(2, 2), [[5, 7], [13, 15]])
