@@ -86,9 +86,10 @@ class RawProgramOptimizer(MetaOptimizerBase):
 
 
 class AMPOptimizer(MetaOptimizerBase):
-    """Parity: amp_optimizer.py:20 — static AMP decoration (cast insertion
-    fp16_utils.py:484). TPU: Programs execute through XLA with bf16 inputs;
-    the rewrite marks the program for bf16 execution of white-list ops."""
+    """Parity: amp_optimizer.py:20 — static AMP via REAL cast-insertion
+    (fp16_utils.rewrite_program:484) over the recorded forward ops, run
+    BEFORE append_backward so grads differentiate through the casts. The
+    low-precision dtype is bf16 (MXU-native)."""
 
     meta_optimizers_white_list = ['LarsOptimizer', 'LambOptimizer',
                                   'RecomputeOptimizer',
@@ -100,8 +101,15 @@ class AMPOptimizer(MetaOptimizerBase):
 
     def minimize_impl(self, loss, startup_program=None, parameter_list=None,
                       no_grad_set=None):
+        from ....static.amp_pass import (rewrite_program_amp,
+                                         AutoMixedPrecisionLists)
         prog = loss.block.program
-        prog._amp = dict(self.user_defined_strategy.amp_configs)
+        cfg = dict(self.user_defined_strategy.amp_configs)
+        prog._amp = cfg
+        lists = AutoMixedPrecisionLists(
+            cfg.get('custom_white_list'), cfg.get('custom_black_list'),
+            cfg.get('custom_black_varnames'))
+        rewrite_program_amp(prog, lists)
         return self.inner_opt.minimize(loss, startup_program,
                                        parameter_list, no_grad_set)
 

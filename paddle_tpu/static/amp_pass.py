@@ -1,0 +1,106 @@
+"""Static AMP: cast-insertion program rewrite.
+
+Reference parity: fluid/contrib/mixed_precision/fp16_utils.py
+`rewrite_program:484` + `_insert_cast_op:83` over AutoMixedPrecisionLists
+(fp16_lists.py): white-list ops run in low precision (cast ops inserted on
+their float inputs), black-list ops are pinned to fp32, gray ops follow
+their inputs. On TPU the low-precision dtype is bf16 (MXU-native; no loss
+scaling needed, though GradScaler still accepts the knobs for parity).
+
+The rewrite runs BEFORE append_backward, so the recorded backward ops
+differentiate straight through the inserted casts — the same ordering as
+the reference's OptimizerWithMixedPrecision.
+"""
+import jax.numpy as jnp
+
+from ..core import dtypes
+from .program import Variable, Operator, OpRole
+
+# auto_cast.py:27-52 lists (bf16 spellings)
+WHITE_LIST = {'matmul', 'matmul_v2', 'mul', 'conv2d', 'fc'}
+BLACK_LIST = {'exp', 'square', 'log', 'mean', 'reduce_mean', 'sum',
+              'reduce_sum', 'cos_sim', 'softmax',
+              'softmax_with_cross_entropy',
+              'sigmoid_cross_entropy_with_logits', 'cross_entropy',
+              'cross_entropy2'}
+
+
+class AutoMixedPrecisionLists:
+    """Parity: fp16_lists.AutoMixedPrecisionLists."""
+
+    def __init__(self, custom_white_list=None, custom_black_list=None,
+                 custom_black_varnames=None):
+        self.white_list = set(WHITE_LIST) | set(custom_white_list or ())
+        self.black_list = set(BLACK_LIST) | set(custom_black_list or ())
+        self.black_list -= self.white_list
+        self.black_varnames = set(custom_black_varnames or ())
+
+
+def rewrite_program_amp(program, amp_lists=None, dest_dtype='bfloat16'):
+    """Insert cast ops so white-list ops consume `dest_dtype` and
+    black-list ops consume float32 (parity: rewrite_program:484).
+    Returns the number of cast ops inserted."""
+    lists = amp_lists or AutoMixedPrecisionLists()
+    block = program.global_block()
+    low = dtypes.convert_dtype(dest_dtype)
+    f32 = dtypes.convert_dtype('float32')
+    cast_cache = {}      # (var, dtype name) -> cast var name
+    out_ops = []
+    n_casts = 0
+
+    def _cast_to(name, dt, role):
+        nonlocal n_casts
+        key = (name, str(dt))
+        if key in cast_cache:
+            return cast_cache[key]
+        src = block.vars[name]
+        cname = f"{name}.cast_{dtypes.dtype_name(dt)}"
+        if cname not in block.vars:
+            cv = Variable(block, cname, src.shape, dt,
+                          stop_gradient=src.stop_gradient)
+            block.vars[cname] = cv
+        op = Operator('cast', lambda a, _d=dt: a.astype(_d), [name],
+                      [cname], {'out_dtype': dtypes.dtype_name(dt)},
+                      op_role=role)
+        out_ops.append(op)
+        cast_cache[key] = cname
+        n_casts += 1
+        return cname
+
+    var_dtype = {n: v.dtype for n, v in block.vars.items()}
+    for op in block.ops:
+        if op.op_role & (OpRole.Backward | OpRole.Optimize):
+            out_ops.append(op)
+            continue
+        if op.type in lists.white_list:
+            want = low
+        elif op.type in lists.black_list:
+            want = f32
+        else:
+            want = None     # gray: follow inputs
+        if want is not None:
+            new_ins = []
+            for n in op.input_names:
+                v = block.vars.get(n)
+                if (v is not None and dtypes.is_floating(var_dtype[n])
+                        and var_dtype[n] != want
+                        and n not in lists.black_varnames):
+                    new_ins.append(_cast_to(n, want, op.op_role))
+                else:
+                    new_ins.append(n)
+            op.input_names = new_ins
+        out_ops.append(op)
+        # infer output dtypes from (possibly cast) inputs
+        in_dts = [var_dtype.get(n) for n in op.input_names
+                  if n in var_dtype and dtypes.is_floating(var_dtype[n])]
+        out_dt = want if want is not None else (
+            low if in_dts and all(d == low for d in in_dts) else None)
+        for o in op.output_names:
+            if o in block.vars and dtypes.is_floating(var_dtype.get(o,
+                                                                    f32)):
+                if out_dt is not None:
+                    var_dtype[o] = out_dt
+                    block.vars[o].dtype = out_dt
+    block.ops = out_ops
+    program._amp_rewritten = True
+    return n_casts
