@@ -22,6 +22,9 @@ _FLAGS = {
     'FLAGS_paddle_num_threads': 1,
     'FLAGS_profile_start_step': -1,
     'FLAGS_profile_stop_step': -1,
+    # route eligible nn.MultiHeadAttention through the Pallas flash kernel
+    # (parity: the reference's fused_attention op swap-in)
+    'FLAGS_use_flash_attention': True,
 }
 
 

@@ -101,12 +101,23 @@ class BertForPretraining(nn.Layer):
                                      epsilon=config.layer_norm_eps)
         self.nsp = nn.Linear(config.hidden_size, 2)
 
-    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                masked_lm_labels=None, next_sentence_label=None):
+        """Without labels: returns (mlm_logits, nsp_logits). With labels:
+        returns the pretraining loss, computed through the chunked fused
+        projection-xent so the [B*L, vocab] logits never materialize."""
         seq, pooled = self.bert(input_ids, token_type_ids, attention_mask)
         h = self.mlm_norm(F.gelu(self.mlm_transform(seq)))
         w = self.bert.embeddings.word_embeddings.weight
-        mlm_logits = M.matmul(h, w, transpose_y=True)
         nsp_logits = self.nsp(pooled)
+        if masked_lm_labels is not None:
+            mlm = F.fused_linear_cross_entropy(h, w, masked_lm_labels,
+                                               ignore_index=-100)
+            if next_sentence_label is None:
+                return mlm
+            nsp = F.cross_entropy(nsp_logits, next_sentence_label)
+            return M.add(mlm, nsp)
+        mlm_logits = M.matmul(h, w, transpose_y=True)
         return mlm_logits, nsp_logits
 
 

@@ -545,7 +545,21 @@ class GPTLMHead(nn.Layer):
         self.ce = ParallelCrossEntropy()
 
     def forward(self, hidden, labels):
-        logits = self.out(self.norm(hidden))
+        h = self.norm(hidden)
+        from ..distributed import collective as C
+        vocab_parallel = self.out.world_size > 1 and C.in_spmd_region()
+        n_tokens = int(np.prod(h.shape[:-1]))
+        if not vocab_parallel and n_tokens * self.out.out_features > 2 ** 28:
+            # big-logits regime: chunked fused projection+xent — the
+            # [tokens, vocab] logits never hit HBM (recompute backward, see
+            # ops/nn_ops.fused_linear_cross_entropy). Below the threshold
+            # the single matmul + fused hard-xent (bf16-only residual) is
+            # faster: recompute would spend ~2% extra FLOPs to save memory
+            # that isn't scarce.
+            return F.fused_linear_cross_entropy(
+                h, self.out.weight, labels, ignore_index=-100,
+                transpose_y=False)
+        logits = self.out(h)
         loss = self.ce(logits, labels)
         return M.mean(loss)
 

@@ -1,0 +1,88 @@
+"""Fused (chunked) linear+softmax-xent vs the composed oracle.
+
+Reference parity: softmax_with_cross_entropy_op numerics tests
+(test_softmax_with_cross_entropy_op.py pattern) applied to the LM-head
+fusion.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops import nn_ops as F
+from paddle_tpu.ops import math as M
+
+
+class TestFusedLinearXent:
+    def _data(self, N=64, H=16, V=50, seed=0, ignore_frac=0.0):
+        rng = np.random.RandomState(seed)
+        x = rng.randn(N, H).astype('float32')
+        w = rng.randn(V, H).astype('float32') * 0.1
+        idx = rng.randint(0, V, (N,))
+        if ignore_frac:
+            mask = rng.rand(N) < ignore_frac
+            idx = np.where(mask, -100, idx)
+        return x, w, idx.astype('int64')
+
+    def test_matches_unfused(self):
+        x, w, idx = self._data()
+        fused = F.fused_linear_cross_entropy(Tensor(jnp.asarray(x)),
+                                             Tensor(jnp.asarray(w)),
+                                             Tensor(jnp.asarray(idx)))
+        logits = jnp.asarray(x) @ jnp.asarray(w).T
+        ref = F.cross_entropy(Tensor(logits), Tensor(jnp.asarray(idx)))
+        np.testing.assert_allclose(float(fused), float(ref), rtol=1e-5)
+
+    def test_ignore_index(self):
+        x, w, idx = self._data(ignore_frac=0.3, seed=1)
+        fused = F.fused_linear_cross_entropy(Tensor(jnp.asarray(x)),
+                                             Tensor(jnp.asarray(w)),
+                                             Tensor(jnp.asarray(idx)),
+                                             reduction='none')
+        logits = jnp.asarray(x) @ jnp.asarray(w).T
+        ref = F.cross_entropy(Tensor(logits), Tensor(jnp.asarray(idx)),
+                              reduction='none')
+        np.testing.assert_allclose(np.asarray(fused.data),
+                                   np.asarray(ref.data)[:, 0], rtol=1e-5,
+                                   atol=1e-6)
+        assert np.all(np.asarray(fused.data)[np.asarray(idx) == -100] == 0)
+
+    def test_grads_match_unfused(self):
+        x, w, idx = self._data(seed=2, ignore_frac=0.2)
+
+        def run(fused):
+            xt = Tensor(jnp.asarray(x)); xt.stop_gradient = False
+            wt = Tensor(jnp.asarray(w)); wt.stop_gradient = False
+            lt = Tensor(jnp.asarray(idx))
+            if fused:
+                loss = F.fused_linear_cross_entropy(xt, wt, lt)
+            else:
+                logits = M.matmul(xt, wt, transpose_y=True)
+                loss = F.cross_entropy(logits, lt)
+            loss.backward()
+            return (np.asarray(xt.grad.data), np.asarray(wt.grad.data),
+                    float(loss))
+
+        dxf, dwf, lf = run(True)
+        dxu, dwu, lu = run(False)
+        np.testing.assert_allclose(lf, lu, rtol=1e-5)
+        np.testing.assert_allclose(dxf, dxu, rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(dwf, dwu, rtol=1e-4, atol=1e-6)
+
+    def test_3d_input_and_bert_forward(self):
+        from paddle_tpu.models.bert import BertConfig, BertForPretraining
+        paddle.seed(0)
+        cfg = BertConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                         num_heads=2, intermediate_size=64, max_seq_len=16,
+                         hidden_dropout=0.0, attn_dropout=0.0)
+        model = BertForPretraining(cfg)
+        rng = np.random.RandomState(0)
+        ids = Tensor(rng.randint(0, 64, (2, 16)).astype('int32'))
+        mlm = Tensor(rng.randint(0, 64, (2, 16)).astype('int64'))
+        nsp = Tensor(rng.randint(0, 2, (2,)).astype('int64'))
+        loss = model(ids, masked_lm_labels=mlm, next_sentence_label=nsp)
+        # oracle: explicit logits path
+        from paddle_tpu.models.bert import bert_pretrain_loss
+        mlm_logits, nsp_logits = model(ids)
+        ref = bert_pretrain_loss(mlm_logits, nsp_logits, mlm, nsp)
+        np.testing.assert_allclose(float(loss), float(ref), rtol=1e-5)
