@@ -138,8 +138,11 @@ class MultiHeadAttention(Layer):
         Lq, Lk = q.shape[2], k.shape[2]
         # below ~1k tokens XLA's fused dense attention wins on TPU (measured
         # at BERT shapes: dense 43.1% vs flash 37.3% step MFU at L=512,
-        # d=64); the flash kernel's O(L) memory only pays off at long L
+        # d=64); the flash kernel's O(L) memory only pays off at long L.
+        # head_dim must be MXU-lane-shaped for the kernel's VMEM tiles.
         if Lq != Lk or Lq < 1024 or Lq % 256 != 0:
+            return None
+        if self.head_dim not in (64, 128, 256):
             return None
         bias = None
         if attn_mask is not None:

@@ -157,8 +157,7 @@ def box_coder(prior_box, prior_box_var, target_box,
         pcx, pcy = pcx.reshape(shape), pcy.reshape(shape)
         pw, ph = pw.reshape(shape), ph.reshape(shape)
         if v is not None:
-            vv = v.reshape(shape + (4,)) if False else (
-                v[None, :, :] if axis == 0 else v[:, None, :])
+            vv = v[None, :, :] if axis == 0 else v[:, None, :]
             v0, v1, v2, v3 = vv[..., 0], vv[..., 1], vv[..., 2], vv[..., 3]
         elif variance:
             v0, v1, v2, v3 = variance
@@ -240,7 +239,7 @@ def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
         var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
                                (H, W, P, 4))
         return out, var
-    return run_op('prior_box', fn, [input, image], n_outputs=2)
+    return run_op('prior_box', fn, [input, image])
 
 
 def anchor_generator(input, anchor_sizes, aspect_ratios, variances,
@@ -254,26 +253,29 @@ def anchor_generator(input, anchor_sizes, aspect_ratios, variances,
     for ar in aspect_ratios:
         for s in anchor_sizes:
             area = float(stride[0] * stride[1])
-            area_ratios = area * float(ar)
-            base_w = round(math.sqrt(area_ratios))
-            base_h = round(base_w / float(ar))
+            base_w = round(math.sqrt(area / float(ar)))
+            base_h = round(base_w * float(ar))
             scale_w = float(s) / stride[0]
             scale_h = float(s) / stride[1]
             whs.append((scale_w * base_w, scale_h * base_h))
     A = len(whs)
 
     def fn(_x):
-        cx = (jnp.arange(W, dtype=jnp.float32) + offset) * stride[0]
-        cy = (jnp.arange(H, dtype=jnp.float32) + offset) * stride[1]
+        # centers at stride*i + offset*(stride-1); corners at
+        # center ± (size-1)/2 — anchor_generator_op.h:68-95
+        cx = jnp.arange(W, dtype=jnp.float32) * stride[0] \
+            + offset * (stride[0] - 1)
+        cy = jnp.arange(H, dtype=jnp.float32) * stride[1] \
+            + offset * (stride[1] - 1)
         cx = jnp.broadcast_to(cx[None, :, None], (H, W, A))
         cy = jnp.broadcast_to(cy[:, None, None], (H, W, A))
-        hw = jnp.asarray([w for w, _ in whs], jnp.float32) / 2
-        hh = jnp.asarray([h for _, h in whs], jnp.float32) / 2
+        hw = (jnp.asarray([w for w, _ in whs], jnp.float32) - 1) / 2
+        hh = (jnp.asarray([h for _, h in whs], jnp.float32) - 1) / 2
         anchors = jnp.stack([cx - hw, cy - hh, cx + hw, cy + hh], axis=-1)
         var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
                                (H, W, A, 4))
         return anchors, var
-    return run_op('anchor_generator', fn, [input], n_outputs=2)
+    return run_op('anchor_generator', fn, [input])
 
 
 # ---------------------------------------------------------------------------
@@ -334,7 +336,7 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
         scores = jnp.where(keep[..., None], scores, 0.0)
         return (boxes.reshape(N, an * H * W, 4),
                 scores.reshape(N, an * H * W, class_num))
-    return run_op('yolo_box', fn, [x, img_size], n_outputs=2,
+    return run_op('yolo_box', fn, [x, img_size],
                   n_nondiff=1)
 
 
@@ -396,7 +398,7 @@ def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
         if not batched:
             midx, mdist = midx[0], mdist[0]
         return midx, mdist
-    return run_op('bipartite_match', fn, [dist_matrix], n_outputs=2,
+    return run_op('bipartite_match', fn, [dist_matrix],
                   n_nondiff=1)
 
 
@@ -405,8 +407,10 @@ def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
 # ---------------------------------------------------------------------------
 
 def _greedy_nms_mask(boxes, scores, iou_threshold, normalized=True,
-                     score_threshold=None):
-    """Greedy NMS over all boxes (descending score) → keep mask [M]."""
+                     score_threshold=None, eta=1.0):
+    """Greedy NMS over all boxes (descending score) → keep mask [M].
+    eta < 1 tightens the threshold after each kept box once it exceeds 0.5
+    (adaptive NMS — multiclass_nms_op.cc NMSFast)."""
     M = boxes.shape[0]
     iou = _iou_matrix(boxes, boxes, normalized)
     order = jnp.argsort(-scores)
@@ -414,15 +418,18 @@ def _greedy_nms_mask(boxes, scores, iou_threshold, normalized=True,
         (scores > score_threshold)
 
     def body(i, state):
-        keep, supp = state
+        keep, supp, thr = state
         idx = order[i]
         ok = (~supp[idx]) & valid0[idx]
         keep = keep.at[idx].set(ok)
-        supp = jnp.where(ok, supp | (iou[idx] > iou_threshold), supp)
-        return keep, supp
+        supp = jnp.where(ok, supp | (iou[idx] > thr), supp)
+        if eta < 1.0:
+            thr = jnp.where(ok & (thr > 0.5), thr * eta, thr)
+        return keep, supp, thr
 
-    keep, _ = lax.fori_loop(0, M, body,
-                            (jnp.zeros((M,), bool), jnp.zeros((M,), bool)))
+    keep, _, _ = lax.fori_loop(
+        0, M, body, (jnp.zeros((M,), bool), jnp.zeros((M,), bool),
+                     jnp.asarray(iou_threshold, jnp.float32)))
     return keep
 
 
@@ -445,22 +452,19 @@ def multiclass_nms(bboxes, scores, score_threshold=0.0, nms_top_k=-1,
         def one(boxes, s):
             # per-class greedy NMS (background skipped via score=-inf)
             def per_class(c_scores):
-                keep = _greedy_nms_mask(boxes, c_scores, nms_threshold,
-                                        normalized, score_threshold)
+                cs = c_scores
+                if 0 < nms_top_k < M:
+                    # pre-NMS candidate truncation
+                    # (multiclass_nms_op.cc GetMaxScoreIndex top_k)
+                    kth = -jnp.sort(-cs)[nms_top_k - 1]
+                    cs = jnp.where(cs >= kth, cs, -jnp.inf)
+                keep = _greedy_nms_mask(boxes, cs, nms_threshold,
+                                        normalized, score_threshold,
+                                        eta=nms_eta)
                 return jnp.where(keep, c_scores, -jnp.inf)
-            cls_ids = jnp.arange(C)
             kept_scores = jax.vmap(per_class)(s)        # [C, M]
             if background_label >= 0:
                 kept_scores = kept_scores.at[background_label].set(-jnp.inf)
-            if nms_top_k > 0:
-                # keep only the nms_top_k best per class before the global
-                # cut (reference applies it pre-NMS; post-NMS it can only
-                # remove extra boxes, and the global top-K below re-cuts)
-                thr = -jnp.sort(-kept_scores, axis=1)[:,
-                                                      min(nms_top_k,
-                                                          M) - 1][:, None]
-                kept_scores = jnp.where(kept_scores >= thr, kept_scores,
-                                        -jnp.inf)
             flat = kept_scores.reshape(-1)               # [C*M]
             top, arg = lax.top_k(flat, K)
             label = (arg // M).astype(jnp.float32)
@@ -474,7 +478,7 @@ def multiclass_nms(bboxes, scores, score_threshold=0.0, nms_top_k=-1,
             idx_out = jnp.where(valid, box_id, -1).astype(jnp.int32)
             return row, idx_out, jnp.sum(valid).astype(jnp.int32)
         return jax.vmap(one)(bb, sc)
-    return run_op('multiclass_nms', fn, [bboxes, scores], n_outputs=3,
+    return run_op('multiclass_nms', fn, [bboxes, scores],
                   n_nondiff=1)
 
 
@@ -499,6 +503,12 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
 
             def per_class(c_scores):
                 valid = c_scores > score_threshold
+                if 0 < nms_top_k < M:
+                    # pre-decay candidate truncation
+                    # (matrix_nms_op.cc:125-126)
+                    kth = -jnp.sort(-jnp.where(valid, c_scores,
+                                               -jnp.inf))[nms_top_k - 1]
+                    valid = valid & (c_scores >= kth)
                 cs = jnp.where(valid, c_scores, -jnp.inf)
                 order = jnp.argsort(-cs)
                 rank = jnp.argsort(order)        # rank[i]: position of box i
@@ -534,7 +544,7 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
             idx_out = jnp.where(valid, box_id, -1).astype(jnp.int32)
             return row, idx_out, jnp.sum(valid).astype(jnp.int32)
         return jax.vmap(one)(bb, sc)
-    return run_op('matrix_nms', fn, [bboxes, scores], n_outputs=3,
+    return run_op('matrix_nms', fn, [bboxes, scores],
                   n_nondiff=1)
 
 
@@ -616,7 +626,7 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
         return jax.vmap(one)(sc, deltas, imgs.astype(sc.dtype))
     return run_op('generate_proposals', fn,
                   [scores, bbox_deltas, img_size, anchors, variances],
-                  n_outputs=3, n_nondiff=3)
+                  n_nondiff=3)
 
 
 # ---------------------------------------------------------------------------

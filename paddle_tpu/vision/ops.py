@@ -149,3 +149,58 @@ def roi_pool(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0,
             return jnp.stack(out, -1).reshape(C, oh, ow)
         return jax.vmap(one)(batch_idx, y1, x1, y2, x2)
     return run_op('roi_pool', fn, [x, boxes])
+
+
+# ---- detection tier (paddle.vision.ops parity surface) ---------------------
+# Implementations in vision/detection.py (fixed-shape TPU-native programs).
+from .detection import (  # noqa: E402,F401
+    yolo_box, prior_box, box_coder, anchor_generator, box_clip,
+    iou_similarity, bipartite_match, multiclass_nms, matrix_nms,
+    generate_proposals, deform_conv2d)
+
+
+_DEFORM_CONV_CLS = None
+
+
+def _deform_conv_cls():
+    global _DEFORM_CONV_CLS
+    if _DEFORM_CONV_CLS is None:
+        from ..nn.layer.base import Layer
+        from ..nn import initializer as I
+
+        class DeformConv2D(Layer):
+            """Parity: paddle.vision.ops.DeformConv2D — layer wrapper over
+            deform_conv2d (deformable_conv_op v1/v2)."""
+
+            def __init__(self, in_channels, out_channels, kernel_size,
+                         stride=1, padding=0, dilation=1,
+                         deformable_groups=1, groups=1, weight_attr=None,
+                         bias_attr=None):
+                super().__init__()
+                ks = kernel_size if isinstance(kernel_size, (list, tuple)) \
+                    else (kernel_size, kernel_size)
+                self._attrs = dict(stride=stride, padding=padding,
+                                   dilation=dilation,
+                                   deformable_groups=deformable_groups,
+                                   groups=groups)
+                self.weight = self.create_parameter(
+                    [out_channels, in_channels // groups, ks[0], ks[1]],
+                    attr=weight_attr,
+                    default_initializer=I.XavierUniform())
+                self.bias = self.create_parameter(
+                    [out_channels], attr=bias_attr, is_bias=True) \
+                    if bias_attr is not False else None
+
+            def forward(self, x, offset, mask=None):
+                return deform_conv2d(x, offset, self.weight, self.bias,
+                                     mask=mask, **self._attrs)
+        _DEFORM_CONV_CLS = DeformConv2D
+    return _DEFORM_CONV_CLS
+
+
+def __getattr__(name):
+    # single lazily-defined class (isinstance-stable across constructions);
+    # lazy only to keep vision importable without pulling the whole nn tree
+    if name == 'DeformConv2D':
+        return _deform_conv_cls()
+    raise AttributeError(name)

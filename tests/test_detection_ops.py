@@ -1,0 +1,462 @@
+"""Detection op tier vs independent numpy references.
+
+Reference parity: the op_test.py pattern of fluid's detection op tests
+(test_yolo_box_op.py, test_box_coder_op.py, test_prior_box_op.py,
+test_bipartite_match_op.py, test_multiclass_nms_op.py,
+test_generate_proposals_v2_op.py, test_iou_similarity_op.py,
+test_deformable_conv_op.py) — each op checked against a from-scratch
+numpy implementation of the documented semantics.
+"""
+import math
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.vision import detection as D
+
+
+def _t(a):
+    return Tensor(jnp.asarray(a))
+
+
+# ---- numpy oracles ---------------------------------------------------------
+
+def np_iou(a, b, normalized=True):
+    off = 0.0 if normalized else 1.0
+    out = np.zeros((len(a), len(b)), 'float32')
+    for i, p in enumerate(a):
+        for j, q in enumerate(b):
+            ix1, iy1 = max(p[0], q[0]), max(p[1], q[1])
+            ix2, iy2 = min(p[2], q[2]), min(p[3], q[3])
+            iw, ih = max(ix2 - ix1 + off, 0), max(iy2 - iy1 + off, 0)
+            inter = iw * ih
+            ua = ((p[2] - p[0] + off) * (p[3] - p[1] + off)
+                  + (q[2] - q[0] + off) * (q[3] - q[1] + off) - inter)
+            out[i, j] = inter / ua if ua > 0 else 0.0
+    return out
+
+
+def np_encode(target, prior, variance, normalized=True):
+    off = 0.0 if normalized else 1.0
+    M, N = len(target), len(prior)
+    out = np.zeros((M, N, 4), 'float32')
+    for j in range(N):
+        pw = prior[j, 2] - prior[j, 0] + off
+        ph = prior[j, 3] - prior[j, 1] + off
+        pcx = prior[j, 0] + pw / 2
+        pcy = prior[j, 1] + ph / 2
+        for i in range(M):
+            tw = target[i, 2] - target[i, 0] + off
+            th = target[i, 3] - target[i, 1] + off
+            tcx = (target[i, 0] + target[i, 2]) / 2
+            tcy = (target[i, 1] + target[i, 3]) / 2
+            e = [(tcx - pcx) / pw, (tcy - pcy) / ph,
+                 math.log(abs(tw / pw)), math.log(abs(th / ph))]
+            out[i, j] = [e[k] / variance[k] for k in range(4)]
+    return out
+
+
+def np_decode(deltas, prior, variance, normalized=True):
+    off = 0.0 if normalized else 1.0
+    M = deltas.shape[0]
+    N = prior.shape[0]
+    out = np.zeros((M, N, 4), 'float32')
+    for j in range(N):
+        pw = prior[j, 2] - prior[j, 0] + off
+        ph = prior[j, 3] - prior[j, 1] + off
+        pcx = prior[j, 0] + pw / 2
+        pcy = prior[j, 1] + ph / 2
+        for i in range(M):
+            d = deltas[i, j]
+            cx = variance[0] * d[0] * pw + pcx
+            cy = variance[1] * d[1] * ph + pcy
+            w = math.exp(variance[2] * d[2]) * pw
+            h = math.exp(variance[3] * d[3]) * ph
+            out[i, j] = [cx - w / 2, cy - h / 2,
+                         cx + w / 2 - off, cy + h / 2 - off]
+    return out
+
+
+def np_greedy_nms(boxes, scores, thresh, score_thresh=None, normalized=True):
+    order = np.argsort(-scores)
+    keep = []
+    suppressed = np.zeros(len(boxes), bool)
+    for idx in order:
+        if suppressed[idx]:
+            continue
+        if score_thresh is not None and scores[idx] <= score_thresh:
+            continue
+        keep.append(idx)
+        ious = np_iou(boxes[idx:idx + 1], boxes, normalized)[0]
+        suppressed |= ious > thresh
+        suppressed[idx] = True
+    return keep
+
+
+# ---- tests -----------------------------------------------------------------
+
+class TestIouBoxCoder:
+    def test_iou_similarity(self):
+        rng = np.random.RandomState(0)
+        a = np.sort(rng.rand(5, 4).astype('float32'), -1)[:, [0, 1, 2, 3]]
+        a = np.stack([a[:, 0], a[:, 1], a[:, 0] + a[:, 2] + 0.1,
+                      a[:, 1] + a[:, 3] + 0.1], 1)
+        b = np.stack([a[:, 0] + 0.05, a[:, 1] + 0.05, a[:, 2], a[:, 3]],
+                     1)[:3]
+        out = D.iou_similarity(_t(a), _t(b))
+        np.testing.assert_allclose(np.asarray(out.data), np_iou(a, b),
+                                   rtol=1e-5, atol=1e-6)
+
+    @pytest.mark.parametrize('normalized', [True, False])
+    def test_box_coder_encode(self, normalized):
+        rng = np.random.RandomState(1)
+        prior = np.abs(rng.rand(6, 4).astype('float32')) * 10
+        prior[:, 2:] += prior[:, :2] + 1
+        target = np.abs(rng.rand(4, 4).astype('float32')) * 10
+        target[:, 2:] += target[:, :2] + 1
+        var = [0.1, 0.1, 0.2, 0.2]
+        out = D.box_coder(_t(prior), var, _t(target),
+                          code_type='encode_center_size',
+                          box_normalized=normalized)
+        ref = np_encode(target, prior, var, normalized)
+        np.testing.assert_allclose(np.asarray(out.data), ref, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_box_coder_decode_roundtrip(self):
+        rng = np.random.RandomState(2)
+        prior = np.abs(rng.rand(5, 4).astype('float32')) * 10
+        prior[:, 2:] += prior[:, :2] + 1
+        target = np.abs(rng.rand(5, 4).astype('float32')) * 10
+        target[:, 2:] += target[:, :2] + 1
+        var = [0.1, 0.1, 0.2, 0.2]
+        enc = D.box_coder(_t(prior), var, _t(target),
+                          code_type='encode_center_size')
+        # decode with axis=0 expects deltas [M, N, 4]; take the diagonal
+        # pairing (each target with its own prior)
+        deltas = np.asarray(enc.data)
+        dec = D.box_coder(_t(prior), var, _t(deltas),
+                          code_type='decode_center_size', axis=0)
+        rec = np.asarray(dec.data)[np.arange(5), np.arange(5)]
+        np.testing.assert_allclose(rec, target, rtol=1e-3, atol=1e-3)
+        ref = np_decode(deltas, prior, var)
+        np.testing.assert_allclose(np.asarray(dec.data), ref, rtol=1e-4,
+                                   atol=1e-4)
+
+
+class TestPriorAnchor:
+    def test_prior_box_values(self):
+        x = np.zeros((1, 8, 4, 4), 'float32')
+        img = np.zeros((1, 3, 32, 32), 'float32')
+        boxes, var = D.prior_box(_t(x), _t(img), min_sizes=[4.0],
+                                 max_sizes=[8.0], aspect_ratios=[2.0],
+                                 flip=True, clip=True)
+        b = np.asarray(boxes.data)
+        # ladder: ar=1 (min), ar=2, ar=1/2, then max-size box
+        assert b.shape == (4, 4, 4, 4)
+        step = 32 / 4
+        cx = (0 + 0.5) * step
+        ms = 4.0
+        exp0 = [(cx - ms / 2) / 32, (cx - ms / 2) / 32,
+                (cx + ms / 2) / 32, (cx + ms / 2) / 32]
+        np.testing.assert_allclose(b[0, 0, 0], exp0, rtol=1e-5)
+        sq = math.sqrt(4.0 * 8.0)
+        exp_max = [(cx - sq / 2) / 32, (cx - sq / 2) / 32,
+                   (cx + sq / 2) / 32, (cx + sq / 2) / 32]
+        np.testing.assert_allclose(b[0, 0, 3], exp_max, rtol=1e-5)
+        w2 = ms * math.sqrt(2.0)
+        np.testing.assert_allclose(
+            b[0, 0, 1],
+            [(cx - w2 / 2) / 32, (cx - ms / math.sqrt(2) / 2) / 32,
+             (cx + w2 / 2) / 32, (cx + ms / math.sqrt(2) / 2) / 32],
+            rtol=1e-5)
+        v = np.asarray(var.data)
+        assert v.shape == (4, 4, 4, 4)
+        np.testing.assert_allclose(v[2, 3, 1], [0.1, 0.1, 0.2, 0.2])
+
+    def test_anchor_generator_shapes(self):
+        x = np.zeros((1, 8, 3, 5), 'float32')
+        anchors, var = D.anchor_generator(
+            _t(x), anchor_sizes=[32.0, 64.0], aspect_ratios=[0.5, 1.0],
+            variances=[0.1, 0.1, 0.2, 0.2], stride=[16.0, 16.0])
+        a = np.asarray(anchors.data)
+        assert a.shape == (3, 5, 4, 4)
+        # centers at i*stride + offset*(stride-1) — anchor_generator_op.h:68
+        cx = (np.asarray(a[..., 0]) + np.asarray(a[..., 2])) / 2
+        exp_cx = np.arange(5) * 16.0 + 0.5 * 15.0
+        np.testing.assert_allclose(cx[0, :, 0], exp_cx, rtol=1e-5)
+        # ar=0.5 → wide box: base_w=round(sqrt(256/0.5))=23, base_h=round(
+        # 23*0.5)=12, scaled by 32/16 → w=46, h=24; corners span (size-1)
+        w0 = a[0, 0, 0, 2] - a[0, 0, 0, 0]
+        h0 = a[0, 0, 0, 3] - a[0, 0, 0, 1]
+        np.testing.assert_allclose([w0, h0], [45.0, 23.0], rtol=1e-5)
+
+
+class TestYoloBox:
+    def test_vs_numpy(self):
+        rng = np.random.RandomState(3)
+        N, an, cls, H, W = 1, 2, 3, 2, 2
+        anchors = [10, 14, 23, 27]
+        x = rng.randn(N, an * (5 + cls), H, W).astype('float32')
+        img = np.array([[64, 96]], 'int32')
+        ds = 32
+        boxes, scores = D.yolo_box(_t(x), _t(img), anchors, cls,
+                                   conf_thresh=0.0, downsample_ratio=ds,
+                                   clip_bbox=False)
+
+        def sigmoid(v):
+            return 1 / (1 + np.exp(-v))
+        xr = x.reshape(N, an, 5 + cls, H, W)
+        exp_boxes = np.zeros((N, an, H, W, 4))
+        exp_scores = np.zeros((N, an, H, W, cls))
+        for a in range(an):
+            for j in range(H):
+                for i in range(W):
+                    t = xr[0, a, :, j, i]
+                    cx = (i + sigmoid(t[0])) * 96 / W
+                    cy = (j + sigmoid(t[1])) * 64 / H
+                    bw = math.exp(t[2]) * anchors[2 * a] * 96 / (ds * W)
+                    bh = math.exp(t[3]) * anchors[2 * a + 1] * 64 / (ds * H)
+                    conf = sigmoid(t[4])
+                    exp_boxes[0, a, j, i] = [cx - bw / 2, cy - bh / 2,
+                                             cx + bw / 2, cy + bh / 2]
+                    exp_scores[0, a, j, i] = conf * sigmoid(t[5:])
+        np.testing.assert_allclose(
+            np.asarray(boxes.data), exp_boxes.reshape(N, -1, 4), rtol=1e-4,
+            atol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(scores.data), exp_scores.reshape(N, -1, cls),
+            rtol=1e-4, atol=1e-5)
+
+    def test_conf_thresh_zeroes(self):
+        rng = np.random.RandomState(4)
+        x = rng.randn(1, 2 * 7, 2, 2).astype('float32')
+        img = np.array([[64, 64]], 'int32')
+        boxes, scores = D.yolo_box(_t(x), _t(img), [10, 14, 23, 27], 2,
+                                   conf_thresh=0.99)
+        conf = 1 / (1 + np.exp(-x.reshape(1, 2, 7, 2, 2)[:, :, 4]))
+        dead = (conf < 0.99).reshape(-1)
+        b = np.asarray(boxes.data)[0]
+        assert np.all(b[dead] == 0)
+
+
+class TestBipartiteMatch:
+    def test_greedy_global_max(self):
+        dist = np.array([[0.9, 0.1, 0.3],
+                         [0.8, 0.7, 0.2]], 'float32')
+        idx, d = D.bipartite_match(_t(dist))
+        # global max 0.9 → col0=row0; then 0.7 → col1=row1; col2 unmatched
+        np.testing.assert_array_equal(np.asarray(idx.data), [0, 1, -1])
+        np.testing.assert_allclose(np.asarray(d.data), [0.9, 0.7, 0.0])
+
+    def test_per_prediction_fill(self):
+        dist = np.array([[0.9, 0.1, 0.6],
+                         [0.8, 0.7, 0.2]], 'float32')
+        idx, d = D.bipartite_match(_t(dist), match_type='per_prediction',
+                                   dist_threshold=0.5)
+        # bipartite: col0=0 (0.9), col1=1 (0.7); col2 best row=0 at 0.6>=0.5
+        np.testing.assert_array_equal(np.asarray(idx.data), [0, 1, 0])
+        np.testing.assert_allclose(np.asarray(d.data), [0.9, 0.7, 0.6])
+
+    def test_batched_matches_per_image_greedy(self):
+        rng = np.random.RandomState(5)
+        dist = rng.rand(3, 6, 4).astype('float32')
+        idx, d = D.bipartite_match(_t(dist))
+        for b in range(3):
+            # numpy greedy oracle
+            dd = dist[b].copy()
+            midx = -np.ones(4, int)
+            row_used = np.zeros(6, bool)
+            for _ in range(4):
+                masked = dd.copy()
+                masked[row_used, :] = -1
+                masked[:, midx >= 0] = -1
+                r, c = np.unravel_index(np.argmax(masked), masked.shape)
+                if masked[r, c] <= 1e-6:
+                    break
+                midx[c] = r
+                row_used[r] = True
+            np.testing.assert_array_equal(np.asarray(idx.data)[b], midx)
+
+
+class TestNMS:
+    def test_multiclass_nms_vs_numpy(self):
+        rng = np.random.RandomState(6)
+        N, M, C = 1, 12, 3
+        boxes = np.zeros((N, M, 4), 'float32')
+        for m in range(M):
+            x1, y1 = rng.rand(2) * 0.5
+            boxes[0, m] = [x1, y1, x1 + 0.3 + rng.rand() * 0.2,
+                           y1 + 0.3 + rng.rand() * 0.2]
+        scores = rng.rand(N, C, M).astype('float32')
+        out, index, count = D.multiclass_nms(
+            _t(boxes), _t(scores), score_threshold=0.3, nms_threshold=0.4,
+            keep_top_k=10, background_label=0)
+        # numpy oracle
+        rows = []
+        for c in range(1, C):
+            keep = np_greedy_nms(boxes[0], scores[0, c], 0.4,
+                                 score_thresh=0.3)
+            for k in keep:
+                rows.append((float(c), scores[0, c, k], k))
+        rows.sort(key=lambda r: -r[1])
+        rows = rows[:10]
+        got = np.asarray(out.data)[0]
+        cnt = int(np.asarray(count.data)[0])
+        assert cnt == len(rows)
+        for i, (label, score, k) in enumerate(rows):
+            assert got[i, 0] == label
+            np.testing.assert_allclose(got[i, 1], score, rtol=1e-5)
+            np.testing.assert_allclose(got[i, 2:], boxes[0, k], rtol=1e-5)
+            assert int(np.asarray(index.data)[0, i]) == k
+        assert np.all(got[cnt:, 0] == -1)
+
+    def test_matrix_nms_decay(self):
+        # two heavily-overlapping boxes, one clear winner: loser's score
+        # decays below the winner but stays positive (soft suppression)
+        boxes = np.array([[[0.0, 0.0, 1.0, 1.0],
+                           [0.05, 0.0, 1.05, 1.0],
+                           [3.0, 3.0, 4.0, 4.0]]], 'float32')
+        scores = np.array([[[0.9, 0.8, 0.6]]], 'float32')
+        out, idx, cnt = D.matrix_nms(_t(boxes), _t(scores),
+                                     score_threshold=0.1, keep_top_k=3,
+                                     background_label=-1)
+        got = np.asarray(out.data)[0]
+        assert int(np.asarray(cnt.data)[0]) == 3
+        assert got[0, 1] == pytest.approx(0.9)          # winner untouched
+        assert got[1, 1] == pytest.approx(0.6)          # isolated box
+        assert got[2, 1] < 0.5                           # decayed overlap
+
+
+class TestGenerateProposals:
+    def test_vs_numpy(self):
+        rng = np.random.RandomState(7)
+        N, A, H, W = 1, 3, 4, 4
+        scores = rng.rand(N, A, H, W).astype('float32')
+        deltas = (rng.randn(N, 4 * A, H, W) * 0.2).astype('float32')
+        img = np.array([[64.0, 64.0]], 'float32')
+        anchors = np.zeros((H, W, A, 4), 'float32')
+        sizes = [8.0, 16.0, 24.0]
+        for j in range(H):
+            for i in range(W):
+                for a in range(A):
+                    cx, cy = (i + 0.5) * 16, (j + 0.5) * 16
+                    s = sizes[a]
+                    anchors[j, i, a] = [cx - s / 2, cy - s / 2,
+                                        cx + s / 2, cy + s / 2]
+        var = np.ones((H, W, A, 4), 'float32')
+        rois, rscores, rnum = D.generate_proposals(
+            _t(scores), _t(deltas), _t(img), _t(anchors), _t(var),
+            pre_nms_top_n=20, post_nms_top_n=8, nms_thresh=0.6,
+            min_size=2.0)
+        # numpy oracle
+        s_f = scores[0].transpose(1, 2, 0).reshape(-1)
+        d_f = deltas[0].reshape(A, 4, H, W).transpose(2, 3, 0, 1) \
+            .reshape(-1, 4)
+        a_f = anchors.reshape(-1, 4)
+        order = np.argsort(-s_f)[:20]
+        dec = []
+        for k in order:
+            aw = a_f[k, 2] - a_f[k, 0] + 1
+            ah = a_f[k, 3] - a_f[k, 1] + 1
+            acx, acy = a_f[k, 0] + aw / 2, a_f[k, 1] + ah / 2
+            clip = math.log(1000 / 16)
+            cx = d_f[k, 0] * aw + acx
+            cy = d_f[k, 1] * ah + acy
+            w = math.exp(min(d_f[k, 2], clip)) * aw
+            h = math.exp(min(d_f[k, 3], clip)) * ah
+            box = [cx - w / 2, cy - h / 2, cx + w / 2 - 1, cy + h / 2 - 1]
+            box = [min(max(box[0], 0), 63), min(max(box[1], 0), 63),
+                   min(max(box[2], 0), 63), min(max(box[3], 0), 63)]
+            dec.append(box)
+        dec = np.array(dec, 'float32')
+        sc = s_f[order]
+        big = ((dec[:, 2] - dec[:, 0] + 1) >= 2.0) \
+            & ((dec[:, 3] - dec[:, 1] + 1) >= 2.0)
+        sc2 = np.where(big, sc, -np.inf)
+        keep = np_greedy_nms(dec, sc2, 0.6, normalized=False)
+        keep = [k for k in keep if big[k]][:8]
+        got_rois = np.asarray(rois.data)[0]
+        got_n = int(np.asarray(rnum.data)[0])
+        assert got_n == len(keep)
+        for i, k in enumerate(keep):
+            np.testing.assert_allclose(got_rois[i], dec[k], rtol=1e-4,
+                                       atol=1e-3)
+
+
+class TestDeformConv:
+    def test_zero_offset_equals_conv(self):
+        import jax
+        rng = np.random.RandomState(8)
+        N, Cin, H, W = 2, 4, 6, 6
+        Cout, kh, kw = 5, 3, 3
+        x = rng.randn(N, Cin, H, W).astype('float32')
+        wgt = rng.randn(Cout, Cin, kh, kw).astype('float32')
+        offset = np.zeros((N, 2 * kh * kw, H, W), 'float32')
+        out = D.deform_conv2d(_t(x), _t(offset), _t(wgt), padding=1)
+        ref = jax.lax.conv_general_dilated(
+            jnp.asarray(x), jnp.asarray(wgt), (1, 1), [(1, 1), (1, 1)],
+            dimension_numbers=('NCHW', 'OIHW', 'NCHW'))
+        np.testing.assert_allclose(np.asarray(out.data), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_mask_and_offset_vs_numpy(self):
+        rng = np.random.RandomState(9)
+        N, Cin, H, W = 1, 2, 5, 5
+        Cout, kh, kw = 3, 3, 3
+        x = rng.randn(N, Cin, H, W).astype('float32')
+        wgt = rng.randn(Cout, Cin, kh, kw).astype('float32')
+        offset = (rng.randn(N, 2 * kh * kw, H, W) * 0.7).astype('float32')
+        mask = rng.rand(N, kh * kw, H, W).astype('float32')
+        out = D.deform_conv2d(_t(x), _t(offset), _t(wgt), padding=1,
+                              mask=_t(mask))
+
+        def bilinear(img, y, xx):
+            if y <= -1 or y >= H or xx <= -1 or xx >= W:
+                return 0.0
+            y0, x0 = math.floor(y), math.floor(xx)
+            wy, wx = y - y0, xx - x0
+            val = 0.0
+            for dy, dx, wt in [(0, 0, (1 - wy) * (1 - wx)),
+                               (0, 1, (1 - wy) * wx),
+                               (1, 0, wy * (1 - wx)), (1, 1, wy * wx)]:
+                yy, xc = y0 + dy, x0 + dx
+                if 0 <= yy < H and 0 <= xc < W:
+                    val += wt * img[yy, xc]
+            return val
+
+        exp = np.zeros((N, Cout, H, W), 'float32')
+        off_r = offset.reshape(N, kh * kw, 2, H, W)
+        for oy in range(H):
+            for ox in range(W):
+                for co in range(Cout):
+                    acc = 0.0
+                    for ci in range(Cin):
+                        for i in range(kh):
+                            for j in range(kw):
+                                kk = i * kw + j
+                                py = oy - 1 + i + off_r[0, kk, 0, oy, ox]
+                                px = ox - 1 + j + off_r[0, kk, 1, oy, ox]
+                                v = bilinear(x[0, ci], py, px) \
+                                    * mask[0, kk, oy, ox]
+                                acc += v * wgt[co, ci, i, j]
+                    exp[0, co, oy, ox] = acc
+        np.testing.assert_allclose(np.asarray(out.data), exp, rtol=1e-3,
+                                   atol=1e-3)
+
+    def test_differentiable(self):
+        import paddle_tpu as paddle
+        rng = np.random.RandomState(10)
+        x = _t(rng.randn(1, 2, 4, 4).astype('float32'))
+        x.stop_gradient = False
+        wgt = _t(rng.randn(2, 2, 3, 3).astype('float32'))
+        wgt.stop_gradient = False
+        offset = _t((rng.randn(1, 18, 4, 4) * 0.3).astype('float32'))
+        offset.stop_gradient = False
+        out = D.deform_conv2d(x, offset, wgt, padding=1)
+        loss = paddle.sum(out * out)
+        loss.backward()
+        for t in (x, wgt, offset):
+            assert t.grad is not None
+            assert np.isfinite(np.asarray(t.grad.data)).all()

@@ -86,3 +86,27 @@ class TestFusedLinearXent:
         mlm_logits, nsp_logits = model(ids)
         ref = bert_pretrain_loss(mlm_logits, nsp_logits, mlm, nsp)
         np.testing.assert_allclose(float(loss), float(ref), rtol=1e-5)
+
+    def test_transpose_y_false_matches(self):
+        """[H, V] Linear layout — the GPTLMHead fast-path branch."""
+        x, w, idx = self._data(seed=3, ignore_frac=0.1)
+
+        def run(fused):
+            xt = Tensor(jnp.asarray(x)); xt.stop_gradient = False
+            wt = Tensor(jnp.asarray(w.T.copy())); wt.stop_gradient = False
+            lt = Tensor(jnp.asarray(idx))
+            if fused:
+                loss = F.fused_linear_cross_entropy(xt, wt, lt,
+                                                    transpose_y=False)
+            else:
+                logits = M.matmul(xt, wt)
+                loss = F.cross_entropy(logits, lt)
+            loss.backward()
+            return (np.asarray(xt.grad.data), np.asarray(wt.grad.data),
+                    float(loss))
+
+        dxf, dwf, lf = run(True)
+        dxu, dwu, lu = run(False)
+        np.testing.assert_allclose(lf, lu, rtol=1e-5)
+        np.testing.assert_allclose(dxf, dxu, rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(dwf, dwu, rtol=1e-4, atol=1e-6)
