@@ -103,10 +103,34 @@ def save(obj, path, protocol=4, **configs):
         pickle.dump(_to_saveable(obj), f, protocol=protocol)
 
 
+class _SafeUnpickler(pickle.Unpickler):
+    """paddle.load keeps the reference's pickled-state-dict format
+    (framework/io.py:766) but refuses to resolve any global outside a
+    numpy/stdlib-container whitelist, so a crafted checkpoint cannot
+    execute arbitrary code on load."""
+
+    _ALLOWED = {
+        ('collections', 'OrderedDict'),
+        ('numpy', 'ndarray'), ('numpy', 'dtype'),
+        ('numpy.core.multiarray', '_reconstruct'),
+        ('numpy._core.multiarray', '_reconstruct'),
+        ('numpy.core.multiarray', 'scalar'),
+        ('numpy._core.multiarray', 'scalar'),
+    }
+
+    def find_class(self, module, name):
+        if (module, name) in self._ALLOWED:
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"paddle.load: refusing to unpickle global {module}.{name} "
+            "(only numpy arrays / containers are allowed in checkpoints)")
+
+
 def load(path, **configs):
-    """Parity: paddle.load (framework/io.py:766)."""
+    """Parity: paddle.load (framework/io.py:766). Unpickling is
+    restricted to numpy/stdlib containers — see _SafeUnpickler."""
     with open(path, 'rb') as f:
-        obj = pickle.load(f)
+        obj = _SafeUnpickler(f).load()
 
     def back(o):
         if isinstance(o, np.ndarray):

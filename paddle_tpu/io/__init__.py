@@ -358,16 +358,50 @@ class DataLoader:
                 break
             yield item
 
+    def _mp_start_method(self):
+        """'spawn' when the dataset/worker_init_fn are picklable, else
+        'fork' with a warning. Decided once and cached (the probe streams
+        to a null sink — no giant transient bytes for in-memory
+        datasets)."""
+        if getattr(self, '_mp_method', None) is not None:
+            return self._mp_method
+        import pickle as _pickle
+        import warnings as _warnings
+
+        class _Null:
+            def write(self, _):
+                return 0
+        try:
+            _pickle.Pickler(_Null()).dump(self.dataset)
+            _pickle.Pickler(_Null()).dump(self._worker_init_fn)
+            self._mp_method = 'spawn'
+        except Exception:
+            _warnings.warn(
+                "DataLoader dataset/worker_init_fn is not picklable; "
+                "falling back to the 'fork' start method. Forking after "
+                "JAX initializes can deadlock workers — make the dataset "
+                "picklable (module-level class) to use 'spawn'.",
+                RuntimeWarning)
+            self._mp_method = 'fork'
+        return self._mp_method
+
     def _multiprocess_iter(self):
         """Real worker processes (parity: fluid/dataloader/worker.py
         _worker_loop:251 + reader.py multiprocess path): an index queue
-        feeds num_workers forked readers; samples return via a result
+        feeds num_workers spawned readers; samples return via a result
         queue (raw, collated in the parent — workers never touch the
         device runtime); results reorder to sampler order; a
         ParentWatchDog in each worker exits on parent death, and the
-        parent detects dead workers instead of hanging."""
+        parent detects dead workers instead of hanging.
+
+        Workers use the 'spawn' start method: forking after JAX has
+        initialized its multithreaded runtime can deadlock the child
+        (CPython emits 'will likely lead to a deadlock' for exactly this),
+        so a fresh interpreter per worker is the only safe default.
+        Datasets/worker_init_fn must therefore be picklable; a dataset
+        that is not raises at startup instead of hanging mid-epoch."""
         import multiprocessing as mp
-        ctx = mp.get_context('fork')
+        ctx = mp.get_context(self._mp_start_method())
         window = max(2, self.prefetch) * self.num_workers
         index_q = ctx.Queue(maxsize=window)
         result_q = ctx.Queue(maxsize=window)

@@ -7,7 +7,9 @@ analogue of the reference's pruned ProgramDesc + params; loading rebuilds a
 callable predictor with no Python model code required.
 """
 import os
-import pickle
+import io as _io
+import json
+import zipfile
 
 import numpy as np
 import jax
@@ -42,16 +44,21 @@ def export_layer(path_prefix, layer, example_inputs):
     blob = exported.serialize()
     with open(path_prefix + '.stablehlo', 'wb') as f:
         f.write(blob)
-    state = {
-        'params': {k: np.asarray(jax.device_get(v))
-                   for k, v in params.items()},
-        'buffers': {k: np.asarray(jax.device_get(v))
-                    for k, v in buffers.items()},
-        'input_specs': [(tuple(a.shape), str(a.dtype))
-                        for a in arg_arrays],
-    }
-    with open(path_prefix + '.pdexec', 'wb') as f:
-        pickle.dump(state, f, protocol=4)
+    # data-only container (zip: json specs + npz arrays) — loading an
+    # untrusted .pdexec cannot execute code (same rationale as
+    # serialization.py's ProgramDesc container)
+    arrays = {}
+    for k, v in params.items():
+        arrays['p:' + k] = np.asarray(jax.device_get(v))
+    for k, v in buffers.items():
+        arrays['b:' + k] = np.asarray(jax.device_get(v))
+    npz = _io.BytesIO()
+    np.savez(npz, **arrays)
+    meta = {'input_specs': [[list(a.shape), str(a.dtype)]
+                            for a in arg_arrays]}
+    with zipfile.ZipFile(path_prefix + '.pdexec', 'w') as z:
+        z.writestr('meta.json', json.dumps(meta))
+        z.writestr('arrays.npz', npz.getvalue())
     if was_training:
         layer.train()
     return path_prefix
@@ -64,13 +71,17 @@ class Predictor:
         from jax import export as jax_export
         with open(path_prefix + '.stablehlo', 'rb') as f:
             self._exported = jax_export.deserialize(f.read())
-        with open(path_prefix + '.pdexec', 'rb') as f:
-            state = pickle.load(f)
-        self._params = {k: jnp.asarray(v)
-                        for k, v in state['params'].items()}
-        self._buffers = {k: jnp.asarray(v)
-                         for k, v in state['buffers'].items()}
-        self.input_specs = state['input_specs']
+        with zipfile.ZipFile(path_prefix + '.pdexec') as z:
+            meta = json.loads(z.read('meta.json'))
+            loaded = np.load(_io.BytesIO(z.read('arrays.npz')),
+                             allow_pickle=False)
+            arrays = {k: loaded[k] for k in loaded.files}
+        self._params = {k[2:]: jnp.asarray(v)
+                        for k, v in arrays.items() if k.startswith('p:')}
+        self._buffers = {k[2:]: jnp.asarray(v)
+                         for k, v in arrays.items() if k.startswith('b:')}
+        self.input_specs = [(tuple(sh), dt)
+                            for sh, dt in meta['input_specs']]
 
     def run(self, *inputs):
         arrays = tuple(i.data if isinstance(i, Tensor) else jnp.asarray(i)

@@ -12,9 +12,17 @@ portable StableHLO (jax.export, cpu+tpu platforms) at the op's recorded
 input shapes — the "kernel" the reference looks up by op type at run time
 ships with the program instead. Parameters are saved separately
 (save/load_inference_model) like the reference's .pdiparams.
+
+Container: a zip holding program.json (data-only op/var tables),
+arrays.npz (consts + array-valued attrs, loaded with allow_pickle=False)
+and kernels/<i> StableHLO blobs. Like the reference's protobuf
+ProgramDesc, NOTHING in a model file is evaluated as code — loading an
+untrusted .pdmodel/.pdiparams cannot execute arbitrary Python (the round-2
+advisor flagged the earlier pickle container for exactly that).
 """
 import io
-import pickle
+import json
+import zipfile
 
 import numpy as np
 import jax
@@ -25,7 +33,7 @@ from ..core import dtypes
 from .program import (Program, Block, Variable, Parameter, Operator,
                       _ConstVar)
 
-FORMAT_VERSION = 1
+FORMAT_VERSION = 2   # v2: data-only zip/json/npz container (v1 was pickle)
 _PLATFORMS = ('cpu', 'tpu')
 
 
@@ -41,22 +49,80 @@ def _aval_of(v, scope=None):
     return jax.ShapeDtypeStruct(tuple(dims), v.dtype)
 
 
-def _safe_attrs(attrs):
-    out = {}
-    for k, v in (attrs or {}).items():
-        try:
-            pickle.dumps(v)
-            out[k] = v
-        except Exception:
-            out[k] = repr(v)
-    return out
+def _encode_attr(v, arrays):
+    """Attr value -> JSON-safe structure; ndarray payloads go to `arrays`
+    (saved in the npz section). Unknown objects degrade to repr — data, not
+    code."""
+    if isinstance(v, (bool, int, float, str, type(None))):
+        return v
+    if isinstance(v, np.generic):
+        return v.item()
+    if isinstance(v, list):
+        return [_encode_attr(x, arrays) for x in v]
+    if isinstance(v, tuple):
+        return {'__tuple__': [_encode_attr(x, arrays) for x in v]}
+    if isinstance(v, dict):
+        return {'__dict__': {str(k): _encode_attr(x, arrays)
+                             for k, x in v.items()}}
+    if hasattr(v, '__array__'):
+        arr = np.asarray(v)
+        if arr.dtype == object:
+            # np.savez would silently pickle object arrays on write while
+            # the allow_pickle=False load refuses them — degrade to repr
+            # at save time instead of producing an unloadable artifact
+            return {'__repr__': repr(v)}
+        key = f'attr_{len(arrays)}'
+        arrays[key] = arr
+        return {'__ndarray__': key}
+    return {'__repr__': repr(v)}
+
+
+def _decode_attr(v, arrays):
+    if isinstance(v, list):
+        return [_decode_attr(x, arrays) for x in v]
+    if isinstance(v, dict):
+        if '__tuple__' in v:
+            return tuple(_decode_attr(x, arrays) for x in v['__tuple__'])
+        if '__dict__' in v:
+            return {k: _decode_attr(x, arrays)
+                    for k, x in v['__dict__'].items()}
+        if '__ndarray__' in v:
+            return arrays[v['__ndarray__']]
+        if '__repr__' in v:
+            return v['__repr__']
+    return v
+
+
+def _safe_attrs(attrs, arrays):
+    return {k: _encode_attr(v, arrays) for k, v in (attrs or {}).items()}
+
+
+def _zip_bytes(entries):
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, 'w', zipfile.ZIP_STORED) as z:
+        for name, data in entries.items():
+            z.writestr(name, data)
+    return buf.getvalue()
+
+
+def _npz_bytes(arrays):
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def _load_npz(data):
+    if not data:
+        return {}
+    loaded = np.load(io.BytesIO(data), allow_pickle=False)
+    return {k: loaded[k] for k in loaded.files}
 
 
 def serialize_program(program):
     """Program -> bytes. Ops whose fn cannot be exported (host-side ops
     like recv_v2) are stored with a named fallback instead of a kernel."""
     block = program.global_block()
-    vars_desc, consts = [], {}
+    vars_desc, arrays = [], {}
     for v in block.vars.values():
         d = {'name': v.name, 'shape': list(v.shape),
              'dtype': dtypes.dtype_name(v.dtype),
@@ -67,14 +133,14 @@ def serialize_program(program):
              'init_from': getattr(v, '_init_from', None),
              'is_const': isinstance(v, _ConstVar)}
         if isinstance(v, _ConstVar):
-            consts[v.name] = np.asarray(jax.device_get(v.value))
+            arrays['const:' + v.name] = np.asarray(jax.device_get(v.value))
         vars_desc.append(d)
 
     ops_desc, kernels = [], []
     for op in block.ops:
         desc = {'type': op.type, 'inputs': list(op.input_names),
                 'outputs': list(op.output_names),
-                'attrs': _safe_attrs(op.attrs),
+                'attrs': _safe_attrs(op.attrs, arrays),
                 'op_role': op.op_role, 'op_device': op.op_device,
                 'multi_out': bool(getattr(op, 'multi_out', False)),
                 'kernel': None}
@@ -96,8 +162,7 @@ def serialize_program(program):
         'version': FORMAT_VERSION,
         'vars': vars_desc,
         'ops': ops_desc,
-        'kernels': kernels,
-        'consts': consts,
+        'n_kernels': len(kernels),
         'grad_map': dict(program._grad_map),
         'loss_var': program._loss_var.name
         if program._loss_var is not None else None,
@@ -107,7 +172,12 @@ def serialize_program(program):
                if getattr(program, '_optimizer', None) is not None
                else None),
     }
-    return pickle.dumps(payload, protocol=4)
+    entries = {'program.json': json.dumps(payload)}
+    if arrays:
+        entries['arrays.npz'] = _npz_bytes(arrays)
+    for i, blob in enumerate(kernels):
+        entries[f'kernels/{i}'] = blob
+    return _zip_bytes(entries)
 
 
 def _kernel_fn(blob, multi_out):
@@ -124,11 +194,24 @@ def _kernel_fn(blob, multi_out):
 
 
 def deserialize_program(data):
-    """bytes -> Program (editable, Executor-runnable)."""
-    payload = pickle.loads(data)
-    if payload['version'] != FORMAT_VERSION:
-        raise ValueError(f"program format {payload['version']} "
-                         f"(expected {FORMAT_VERSION})")
+    """bytes -> Program (editable, Executor-runnable). Data-only: json +
+    npz + StableHLO; no code is evaluated from the file."""
+    try:
+        zf = zipfile.ZipFile(io.BytesIO(data))
+    except zipfile.BadZipFile:
+        raise ValueError(
+            "not a paddle_tpu program container (format v2 is a zip; "
+            "v1 pickle-era files are no longer loadable)")
+    with zf as z:
+        payload = json.loads(z.read('program.json'))
+        if payload.get('version') != FORMAT_VERSION:
+            raise ValueError(f"program format {payload.get('version')} "
+                             f"(expected {FORMAT_VERSION})")
+        names = set(z.namelist())
+        arrays = _load_npz(z.read('arrays.npz')
+                           if 'arrays.npz' in names else b'')
+        kernels = [z.read(f'kernels/{i}')
+                   for i in range(payload['n_kernels'])]
     prog = Program()
     block = prog.global_block()
     for d in payload['vars']:
@@ -136,7 +219,7 @@ def deserialize_program(data):
             v = _ConstVar.__new__(_ConstVar)
             Variable.__init__(v, block, d['name'], d['shape'], d['dtype'],
                               persistable=True)
-            v.value = jnp.asarray(payload['consts'][d['name']])
+            v.value = jnp.asarray(arrays['const:' + d['name']])
         elif d['is_parameter']:
             v = Parameter(block, d['name'], d['shape'], d['dtype'],
                           trainable=not d['stop_gradient'])
@@ -151,9 +234,13 @@ def deserialize_program(data):
         if d['persistable'] and not d['is_const']:
             prog.startup_ops.append(v)
 
+    attr_arrays = {k: v for k, v in arrays.items()
+                   if not k.startswith('const:')}
     for d in payload['ops']:
+        d['attrs'] = {k: _decode_attr(v, attr_arrays)
+                      for k, v in d.get('attrs', {}).items()}
         if d['kernel'] is not None:
-            fn = _kernel_fn(payload['kernels'][d['kernel']],
+            fn = _kernel_fn(kernels[d['kernel']],
                             d['multi_out'])
         elif d.get('fallback') == 'identity':
             fn = lambda x: x                      # noqa: E731
@@ -178,7 +265,8 @@ def deserialize_program(data):
 def save(program, path_prefix, protocol=4, scope=None, **configs):
     """Parity: paddle.static.save(program, model_path, protocol) —
     program + persistable values. `protocol` accepted for signature
-    parity (pickle protocol 4 is always used)."""
+    parity only: the format is the data-only zip/npz container, not
+    pickle."""
     from .executor import global_scope
     scope = scope or global_scope()
     with open(path_prefix + '.pdmodel', 'wb') as f:
@@ -190,7 +278,7 @@ def save(program, path_prefix, protocol=4, scope=None, **configs):
             if arr is not None:
                 state[v.name] = np.asarray(jax.device_get(arr))
     with open(path_prefix + '.pdiparams', 'wb') as f:
-        pickle.dump(state, f, protocol=4)
+        f.write(_npz_bytes(state))          # data-only (npz)
     return path_prefix
 
 
@@ -210,7 +298,7 @@ def load(program_or_path, path_prefix=None, executor=None, var_names=None,
         with open(path_prefix + '.pdmodel', 'rb') as f:
             program = deserialize_program(f.read())
     with open(path_prefix + '.pdiparams', 'rb') as f:
-        state = pickle.load(f)
+        state = _load_npz(f.read())
     for name, arr in state.items():
         scope.set(name, jnp.asarray(arr))
     # loaded values supersede initializers
@@ -254,8 +342,8 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
     pruned._grad_map = {}
     pruned._optimizer = None
     save(pruned, path_prefix, scope=scope)
-    with open(path_prefix + '.pdmodel.meta', 'wb') as f:
-        pickle.dump({'feed': feed_names, 'fetch': fetch_names}, f)
+    with open(path_prefix + '.pdmodel.meta', 'w') as f:
+        json.dump({'feed': feed_names, 'fetch': fetch_names}, f)
     return path_prefix
 
 
@@ -263,6 +351,6 @@ def load_inference_model(path_prefix, executor=None, scope=None):
     """Parity: paddle.static.load_inference_model -> (program,
     feed_names, fetch_names)."""
     program = load(path_prefix, scope=scope)
-    with open(path_prefix + '.pdmodel.meta', 'rb') as f:
-        meta = pickle.load(f)
+    with open(path_prefix + '.pdmodel.meta') as f:
+        meta = json.load(f)
     return program, meta['feed'], meta['fetch']

@@ -121,11 +121,11 @@ def test_inference_artifact_excludes_training_state():
         path = os.path.join(tempfile.mkdtemp(), 'model')
         static.save_inference_model(path, [main.global_block().var('x')],
                                     [pred], exe, program=main, scope=scope)
-    import pickle
+    import io as _io
     with open(path + '.pdiparams', 'rb') as f:
-        state = pickle.load(f)
-    assert not any('moment' in k or '@GRAD' in k for k in state), \
-        list(state)
+        state = np.load(_io.BytesIO(f.read()), allow_pickle=False)
+    assert not any('moment' in k or '@GRAD' in k for k in state.files), \
+        list(state.files)
 
 
 def test_loaded_program_is_still_rewritable():
