@@ -11,6 +11,7 @@
 //
 // Layout per row: [embedding dim floats][adagrad G2 accumulator (dim)] —
 // SGD mode stores only the embedding.
+#include <algorithm>
 #include <atomic>
 #include <cmath>
 #include <cstdint>
@@ -30,14 +31,20 @@ class SparseTable {
   enum Opt { SGD = 0, ADAGRAD = 1, ADAM = 2 };
 
   SparseTable(int dim, int num_shards, int opt, float init_range,
-              uint64_t seed)
+              uint64_t seed, float beta1 = 0.9f, float beta2 = 0.999f,
+              float eps = 1e-8f)
       : dim_(dim),
         num_shards_(num_shards),
         opt_((Opt)opt),
         init_range_(init_range),
         seed_(seed),
+        beta1_(beta1),
+        beta2_(beta2),
+        eps_(eps),
         shards_(num_shards),
         locks_(num_shards) {}
+
+  virtual ~SparseTable() = default;
 
   // Row layouts: SGD [w]; ADAGRAD [w, g2]; ADAM [w, m, v, t] — the
   // optimizer state inline with the embedding (reference: sparse
@@ -74,22 +81,23 @@ class SparseTable {
         float* g2 = row.data() + dim_;
         for (int d = 0; d < dim_; ++d) {
           g2[d] += g[d] * g[d];
-          w[d] -= lr * g[d] / (std::sqrt(g2[d]) + 1e-6f);
+          w[d] -= lr * g[d] / (std::sqrt(g2[d]) + eps_);
         }
       } else if (opt_ == ADAM) {
-        // bias-corrected adam per row (beta1=.9, beta2=.999, eps=1e-8 —
-        // the reference sparse-adam accessor defaults)
+        // bias-corrected adam per row; hypers are per-table accessor
+        // config (reference: ps.proto TableParameter / sparse_sgd_rule),
+        // not compile-time constants
         float* w = row.data();
         float* m = row.data() + dim_;
         float* v = row.data() + 2 * dim_;
         float& t = row[3 * dim_];
         t += 1.f;
-        float bc1 = 1.f - std::pow(0.9f, t);
-        float bc2 = 1.f - std::pow(0.999f, t);
+        float bc1 = 1.f - std::pow(beta1_, t);
+        float bc2 = 1.f - std::pow(beta2_, t);
         for (int d = 0; d < dim_; ++d) {
-          m[d] = 0.9f * m[d] + 0.1f * g[d];
-          v[d] = 0.999f * v[d] + 0.001f * g[d] * g[d];
-          w[d] -= lr * (m[d] / bc1) / (std::sqrt(v[d] / bc2) + 1e-8f);
+          m[d] = beta1_ * m[d] + (1.f - beta1_) * g[d];
+          v[d] = beta2_ * v[d] + (1.f - beta2_) * g[d] * g[d];
+          w[d] -= lr * (m[d] / bc1) / (std::sqrt(v[d] / bc2) + eps_);
         }
       } else {
         float* w = row.data();
@@ -178,19 +186,15 @@ class SparseTable {
     return in.good();
   }
 
- private:
+ protected:
   size_t Shard(int64_t id) const {
     return ((uint64_t)id * 0x9E3779B97F4A7C15ull >> 32) % num_shards_;
   }
 
-  std::vector<float>& GetOrInit(size_t s, int64_t id) {
+  virtual std::vector<float>& GetOrInit(size_t s, int64_t id) {
     auto it = shards_[s].find(id);
     if (it != shards_[s].end()) return it->second;
-    std::vector<float> row(RowWidth(), 0.f);
-    std::mt19937_64 rng(seed_ ^ (uint64_t)id);
-    std::uniform_real_distribution<float> dist(-init_range_, init_range_);
-    for (int d = 0; d < dim_; ++d) row[d] = dist(rng);
-    return shards_[s].emplace(id, std::move(row)).first->second;
+    return shards_[s].emplace(id, NewRow(id)).first->second;
   }
 
   template <typename F>
@@ -213,13 +217,233 @@ class SparseTable {
     for (auto& t : ts) t.join();
   }
 
+  std::vector<float> NewRow(int64_t id) {
+    std::vector<float> row(RowWidth(), 0.f);
+    std::mt19937_64 rng(seed_ ^ (uint64_t)id);
+    std::uniform_real_distribution<float> dist(-init_range_, init_range_);
+    for (int d = 0; d < dim_; ++d) row[d] = dist(rng);
+    return row;
+  }
+
   int dim_;
   int num_shards_;
   Opt opt_;
   float init_range_;
   uint64_t seed_;
+  float beta1_, beta2_, eps_;
   std::vector<std::unordered_map<int64_t, std::vector<float>>> shards_;
   std::vector<std::mutex> locks_;
+};
+
+// Disk-spilling sparse table (reference parity:
+// distributed/table/ssd_sparse_table.h — hot rows in memory, cold rows in
+// a disk store; here an append-only per-shard log with an in-memory
+// id→offset index instead of rocksdb, which this image doesn't ship).
+// Eviction: approximate LRU by per-row access epoch — when a shard's hot
+// map exceeds its budget the oldest half spills to its log.
+class SsdSparseTable : public SparseTable {
+ public:
+  SsdSparseTable(int dim, int num_shards, int opt, float init_range,
+                 uint64_t seed, float beta1, float beta2, float eps,
+                 int64_t mem_budget_rows, const std::string& dir)
+      : SparseTable(dim, num_shards, opt, init_range, seed, beta1, beta2,
+                    eps),
+        dir_(dir),
+        budget_per_shard_(
+            std::max<int64_t>(2, mem_budget_rows / num_shards)),
+        epochs_(num_shards),
+        access_(num_shards),
+        index_(num_shards),
+        logs_(num_shards) {
+    for (int s = 0; s < num_shards; ++s) {
+      logs_[s].open(LogPath(s),
+                    std::ios::binary | std::ios::app | std::ios::out);
+    }
+  }
+
+  ~SsdSparseTable() override {
+    for (auto& f : logs_) f.close();
+  }
+
+  int64_t MemRows() {
+    int64_t n = 0;
+    for (size_t s = 0; s < shards_.size(); ++s) {
+      std::lock_guard<std::mutex> lk(locks_[s]);
+      n += (int64_t)shards_[s].size();
+    }
+    return n;
+  }
+
+  // total DISTINCT rows (hot + cold)
+  int64_t DiskRows() {
+    int64_t n = 0;
+    for (size_t s = 0; s < index_.size(); ++s) {
+      std::lock_guard<std::mutex> lk(locks_[s]);
+      n += (int64_t)shards_[s].size();
+      for (auto& kv : index_[s])
+        if (!shards_[s].count(kv.first)) ++n;
+    }
+    return n;
+  }
+
+  // Full-table snapshot incl. cold rows (base Save would silently drop
+  // everything spilled). Format-compatible with SparseTable::Save.
+  bool SaveAll(const std::string& path) {
+    Flush();
+    std::ofstream out(path, std::ios::binary);
+    if (!out) return false;
+    int rw = RowWidth();
+    int64_t n = DiskRows();
+    out.write((char*)&dim_, sizeof(dim_));
+    out.write((char*)&rw, sizeof(rw));
+    out.write((char*)&n, sizeof(n));
+    for (size_t s = 0; s < index_.size(); ++s) {
+      std::lock_guard<std::mutex> lk(locks_[s]);
+      for (auto& kv : index_[s]) {
+        std::vector<float> row = shards_[s].count(kv.first)
+            ? shards_[s][kv.first] : ReadRow(s, kv.second, kv.first);
+        out.write((char*)&kv.first, sizeof(int64_t));
+        out.write((char*)row.data(), sizeof(float) * rw);
+      }
+    }
+    return out.good();
+  }
+
+  // Restore a snapshot straight into the logs (never materializes the
+  // table in RAM — the point of the spill tier).
+  bool LoadAll(const std::string& path) {
+    std::ifstream in(path, std::ios::binary);
+    if (!in) return false;
+    int dim, rw;
+    int64_t n;
+    in.read((char*)&dim, sizeof(dim));
+    in.read((char*)&rw, sizeof(rw));
+    in.read((char*)&n, sizeof(n));
+    if (dim != dim_ || rw != RowWidth()) return false;
+    std::vector<float> row(rw);
+    for (int64_t i = 0; i < n; ++i) {
+      int64_t id;
+      in.read((char*)&id, sizeof(id));
+      in.read((char*)row.data(), sizeof(float) * rw);
+      if (!in) return false;
+      size_t s = Shard(id);
+      std::lock_guard<std::mutex> lk(locks_[s]);
+      SpillRow(s, id, row);
+    }
+    for (auto& f : logs_) f.flush();
+    return true;
+  }
+
+  // Spill every hot row to the log (checkpoint/shutdown).
+  void Flush() {
+    for (size_t s = 0; s < shards_.size(); ++s) {
+      std::lock_guard<std::mutex> lk(locks_[s]);
+      for (auto& kv : shards_[s]) SpillRow(s, kv.first, kv.second);
+      logs_[s].flush();
+    }
+  }
+
+  // Rebuild the disk index by scanning the logs (restart recovery —
+  // last record per id wins). A crash-truncated trailing record is
+  // dropped, not indexed. Hot maps start empty.
+  bool Recover() {
+    int rw = RowWidth();
+    int64_t rec = (int64_t)(sizeof(int64_t) + sizeof(float) * rw);
+    for (size_t s = 0; s < index_.size(); ++s) {
+      std::lock_guard<std::mutex> lk(locks_[s]);
+      index_[s].clear();
+      shards_[s].clear();
+      access_[s].clear();
+      std::ifstream in(LogPath(s), std::ios::binary | std::ios::ate);
+      if (!in) continue;
+      int64_t file_size = (int64_t)in.tellg();
+      in.seekg(0);
+      int64_t off = 0;
+      int64_t id;
+      while (off + rec <= file_size &&
+             in.read((char*)&id, sizeof(id))) {
+        index_[s][id] = off;
+        off += rec;
+        in.seekg(off);
+      }
+    }
+    return true;
+  }
+
+ protected:
+  std::vector<float>& GetOrInit(size_t s, int64_t id) override {
+    ++epochs_[s];
+    auto it = shards_[s].find(id);
+    if (it == shards_[s].end()) {
+      std::vector<float> row;
+      auto dit = index_[s].find(id);
+      if (dit != index_[s].end()) {
+        row = ReadRow(s, dit->second, id);
+      } else {
+        row = NewRow(id);
+      }
+      it = shards_[s].emplace(id, std::move(row)).first;
+      access_[s][id] = epochs_[s];
+      MaybeEvict(s);
+      it = shards_[s].find(id);   // eviction may rehash
+    } else {
+      access_[s][id] = epochs_[s];
+    }
+    return it->second;
+  }
+
+ private:
+  std::string LogPath(int s) const {
+    return dir_ + "/shard_" + std::to_string(s) + ".log";
+  }
+
+  void SpillRow(size_t s, int64_t id, const std::vector<float>& row) {
+    logs_[s].seekp(0, std::ios::end);
+    int64_t off = (int64_t)logs_[s].tellp();
+    logs_[s].write((const char*)&id, sizeof(id));
+    logs_[s].write((const char*)row.data(),
+                   sizeof(float) * row.size());
+    index_[s][id] = off;
+  }
+
+  std::vector<float> ReadRow(size_t s, int64_t off, int64_t id) {
+    std::vector<float> row(RowWidth());
+    std::ifstream in(LogPath(s), std::ios::binary);
+    in.seekg(off + (int64_t)sizeof(int64_t));
+    in.read((char*)row.data(), sizeof(float) * row.size());
+    if ((size_t)in.gcount() != sizeof(float) * row.size()) {
+      // unreadable record (should have been dropped by Recover's
+      // truncation guard) — fall back to a fresh init, never garbage
+      return NewRow(id);
+    }
+    return row;
+  }
+
+  void MaybeEvict(size_t s) {
+    if ((int64_t)shards_[s].size() <= budget_per_shard_) return;
+    // spill the oldest half by access epoch
+    std::vector<std::pair<uint64_t, int64_t>> order;
+    order.reserve(shards_[s].size());
+    for (auto& kv : shards_[s])
+      order.emplace_back(access_[s][kv.first], kv.first);
+    std::sort(order.begin(), order.end());
+    size_t n_evict = order.size() / 2;
+    logs_[s].seekp(0, std::ios::end);
+    for (size_t i = 0; i < n_evict; ++i) {
+      int64_t id = order[i].second;
+      SpillRow(s, id, shards_[s][id]);
+      shards_[s].erase(id);
+      access_[s].erase(id);
+    }
+    logs_[s].flush();
+  }
+
+  std::string dir_;
+  int64_t budget_per_shard_;
+  std::vector<uint64_t> epochs_;
+  std::vector<std::unordered_map<int64_t, uint64_t>> access_;
+  std::vector<std::unordered_map<int64_t, int64_t>> index_;
+  mutable std::vector<std::fstream> logs_;
 };
 
 // Server-side dense parameter table (reference parity:
@@ -346,6 +570,46 @@ void ptpu_dense_destroy(void* h) {
 void* ptpu_table_create(int dim, int num_shards, int opt, float init_range,
                         uint64_t seed) {
   return new ptpu::SparseTable(dim, num_shards, opt, init_range, seed);
+}
+
+// v2: per-table accessor hypers (ps.proto TableParameter analogue)
+void* ptpu_table_create2(int dim, int num_shards, int opt, float init_range,
+                         uint64_t seed, float beta1, float beta2,
+                         float eps) {
+  return new ptpu::SparseTable(dim, num_shards, opt, init_range, seed,
+                               beta1, beta2, eps);
+}
+
+void* ptpu_ssd_table_create(int dim, int num_shards, int opt,
+                            float init_range, uint64_t seed, float beta1,
+                            float beta2, float eps, int64_t mem_budget_rows,
+                            const char* dir) {
+  return new ptpu::SsdSparseTable(dim, num_shards, opt, init_range, seed,
+                                  beta1, beta2, eps, mem_budget_rows, dir);
+}
+
+int64_t ptpu_ssd_mem_rows(void* h) {
+  return static_cast<ptpu::SsdSparseTable*>(h)->MemRows();
+}
+
+int64_t ptpu_ssd_total_rows(void* h) {
+  return static_cast<ptpu::SsdSparseTable*>(h)->DiskRows();
+}
+
+void ptpu_ssd_flush(void* h) {
+  static_cast<ptpu::SsdSparseTable*>(h)->Flush();
+}
+
+int ptpu_ssd_recover(void* h) {
+  return static_cast<ptpu::SsdSparseTable*>(h)->Recover() ? 1 : 0;
+}
+
+int ptpu_ssd_save(void* h, const char* path) {
+  return static_cast<ptpu::SsdSparseTable*>(h)->SaveAll(path) ? 1 : 0;
+}
+
+int ptpu_ssd_load(void* h, const char* path) {
+  return static_cast<ptpu::SsdSparseTable*>(h)->LoadAll(path) ? 1 : 0;
 }
 
 void ptpu_table_pull(void* h, const int64_t* ids, int n, float* out) {

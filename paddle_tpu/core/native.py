@@ -90,6 +90,27 @@ def load_native(required=False):
     lib.ptpu_table_create.argtypes = [ctypes.c_int, ctypes.c_int,
                                       ctypes.c_int, ctypes.c_float,
                                       ctypes.c_uint64]
+    lib.ptpu_table_create2.restype = ctypes.c_void_p
+    lib.ptpu_table_create2.argtypes = [ctypes.c_int, ctypes.c_int,
+                                       ctypes.c_int, ctypes.c_float,
+                                       ctypes.c_uint64, ctypes.c_float,
+                                       ctypes.c_float, ctypes.c_float]
+    lib.ptpu_ssd_table_create.restype = ctypes.c_void_p
+    lib.ptpu_ssd_table_create.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_float,
+        ctypes.c_uint64, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+        ctypes.c_int64, ctypes.c_char_p]
+    lib.ptpu_ssd_mem_rows.restype = ctypes.c_int64
+    lib.ptpu_ssd_mem_rows.argtypes = [ctypes.c_void_p]
+    lib.ptpu_ssd_total_rows.restype = ctypes.c_int64
+    lib.ptpu_ssd_total_rows.argtypes = [ctypes.c_void_p]
+    lib.ptpu_ssd_flush.argtypes = [ctypes.c_void_p]
+    lib.ptpu_ssd_recover.restype = ctypes.c_int
+    lib.ptpu_ssd_recover.argtypes = [ctypes.c_void_p]
+    lib.ptpu_ssd_save.restype = ctypes.c_int
+    lib.ptpu_ssd_save.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.ptpu_ssd_load.restype = ctypes.c_int
+    lib.ptpu_ssd_load.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.ptpu_table_pull.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
                                     ctypes.c_int, ctypes.c_void_p]
     lib.ptpu_table_push.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
@@ -277,12 +298,14 @@ class NativeSparseTable:
     _OPTS = {'sgd': SGD, 'adagrad': ADAGRAD, 'adam': ADAM}
 
     def __init__(self, dim, num_shards=16, optimizer='adagrad',
-                 init_range=0.05, seed=0):
+                 init_range=0.05, seed=0, beta1=0.9, beta2=0.999,
+                 eps=1e-8):
         self.lib = load_native(required=True)
         self.dim = dim
         opt = self._OPTS.get(optimizer, self.SGD)
-        self.h = self.lib.ptpu_table_create(dim, num_shards, opt,
-                                            init_range, seed)
+        self.h = self.lib.ptpu_table_create2(dim, num_shards, opt,
+                                             init_range, seed, beta1,
+                                             beta2, eps)
 
     def pull(self, ids):
         ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
@@ -327,6 +350,53 @@ class NativeSparseTable:
         if getattr(self, 'h', None) and self.lib:
             self.lib.ptpu_table_destroy(self.h)
             self.h = None
+
+
+class NativeSsdSparseTable(NativeSparseTable):
+    """Parity: distributed/table/ssd_sparse_table.h — hot rows in memory
+    under a row budget, cold rows spilled to per-shard append-only logs
+    (the rocksdb analogue); Recover() rebuilds the index after a crash."""
+
+    def __init__(self, dim, path, num_shards=16, optimizer='adagrad',
+                 init_range=0.05, seed=0, beta1=0.9, beta2=0.999,
+                 eps=1e-8, mem_budget_rows=1 << 20):
+        import os as _os
+        self.lib = load_native(required=True)
+        self.dim = dim
+        self.path = path
+        _os.makedirs(path, exist_ok=True)
+        opt = self._OPTS.get(optimizer, self.SGD)
+        self.h = self.lib.ptpu_ssd_table_create(
+            dim, num_shards, opt, init_range, seed, beta1, beta2, eps,
+            mem_budget_rows, path.encode())
+
+    def mem_rows(self):
+        return self.lib.ptpu_ssd_mem_rows(self.h)
+
+    def total_rows(self):
+        return self.lib.ptpu_ssd_total_rows(self.h)
+
+    def flush(self):
+        """Spill all hot rows to the logs (checkpoint/shutdown)."""
+        self.lib.ptpu_ssd_flush(self.h)
+
+    def recover(self):
+        """Rebuild the id→offset index from the logs after a restart."""
+        if not self.lib.ptpu_ssd_recover(self.h):
+            raise IOError(f"ssd table recover failed: {self.path}")
+
+    def __len__(self):
+        return self.total_rows()      # base Size() counts hot rows only
+
+    def save(self, path):
+        """Full snapshot incl. cold rows (streamed, never in RAM)."""
+        if not self.lib.ptpu_ssd_save(self.h, path.encode()):
+            raise IOError(f"ssd table save failed: {path}")
+
+    def load(self, path):
+        """Restore a snapshot straight into the spill logs."""
+        if not self.lib.ptpu_ssd_load(self.h, path.encode()):
+            raise IOError(f"ssd table load failed: {path}")
 
 
 class NativeDenseTable:

@@ -2,18 +2,56 @@
 
 Reference parity: TheOnePSRuntime's worker/server lifecycle over the
 service tier (BrpcPsServer/Client → distributed/ps/service.py).
-Table configs come from env (PADDLE_PS_TABLES="id:dim:opt,...") or
-defaults; server endpoint from PADDLE_CURRENT_ENDPOINT.
+Table configs come from `strategy.sparse_table_configs`-style dicts
+(set_table_configs / the_one_ps _get_fleet_proto analogue), the env
+(PADDLE_PS_TABLES — either the legacy "id:dim:opt,..." or a JSON list of
+TableParameter dicts), or defaults; server endpoint from
+PADDLE_CURRENT_ENDPOINT.
 """
+import json
 import os
+
+# programmatic table configs (list of TableParameter dicts); takes
+# precedence over the env (parity: the_one_ps builds table protos from
+# the DistributedStrategy, the env is the launch-time channel)
+_TABLE_CONFIGS = None
+
+_TABLE_KEYS = {'table_id', 'embedx_dim', 'optimizer', 'init_range',
+               'shard_num', 'seed', 'beta1', 'beta2', 'eps', 'ssd_path',
+               'mem_budget_rows'}
+
+
+def set_table_configs(configs):
+    """configs: list of dicts with keys table_id, embedx_dim, optimizer,
+    and optionally init_range/shard_num/seed/beta1/beta2/eps/ssd_path/
+    mem_budget_rows (parity: ps.proto TableParameter + accessor)."""
+    global _TABLE_CONFIGS
+    for c in configs or []:
+        unknown = set(c) - _TABLE_KEYS
+        if unknown:
+            raise ValueError(f"unknown table config keys: {unknown}")
+        if 'table_id' not in c or 'embedx_dim' not in c:
+            raise ValueError("table config needs table_id and embedx_dim")
+    _TABLE_CONFIGS = list(configs) if configs else None
 
 
 def _table_configs():
+    """→ list of TableParameter dicts."""
+    if _TABLE_CONFIGS is not None:
+        return list(_TABLE_CONFIGS)
     spec = os.environ.get('PADDLE_PS_TABLES', '0:16:adagrad')
+    if spec.lstrip().startswith('['):
+        cfgs = json.loads(spec)
+        for c in cfgs:            # validate without caching — the env is
+            unknown = set(c) - _TABLE_KEYS   # re-read on every call
+            if unknown:
+                raise ValueError(f"unknown table config keys: {unknown}")
+        return cfgs
     out = []
     for part in spec.split(','):
         tid, dim, opt = part.split(':')
-        out.append((int(tid), int(dim), opt))
+        out.append({'table_id': int(tid), 'embedx_dim': int(dim),
+                    'optimizer': opt})
     return out
 
 
@@ -37,8 +75,11 @@ class _Server:
         ep = os.environ.get('PADDLE_CURRENT_ENDPOINT', '0.0.0.0:0')
         port = int(ep.rsplit(':', 1)[1]) if ':' in ep else 0
         self.server = PsServer(port=port)
-        for tid, dim, opt in _table_configs():
-            self.server.add_table(tid, dim, optimizer=opt)
+        for cfg in _table_configs():
+            c = dict(cfg)
+            tid = c.pop('table_id')
+            dim = c.pop('embedx_dim')
+            self.server.add_table(tid, dim, **c)
 
     def run(self):
         self.server.run()

@@ -45,7 +45,8 @@ from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
-from ...core.native import NativeSparseTable, NativeDenseTable
+from ...core.native import (NativeSparseTable, NativeDenseTable,
+                            NativeSsdSparseTable)
 
 
 def _read_n(sock, n):
@@ -86,11 +87,22 @@ class PsServer:
         self._applied = {}          # client uuid -> last applied push seq
 
     def add_table(self, table_id, dim, optimizer='adagrad', init_range=0.05,
-                  num_shards=16, seed=0):
-        """Parity: table config from the_one_ps proto."""
-        self.tables[table_id] = NativeSparseTable(
-            dim, num_shards=num_shards, optimizer=optimizer,
-            init_range=init_range, seed=seed)
+                  num_shards=16, seed=0, beta1=0.9, beta2=0.999, eps=1e-8,
+                  ssd_path=None, mem_budget_rows=1 << 20, shard_num=None):
+        """Parity: table config from the_one_ps proto (TableParameter:
+        embedx dim, shard_num, per-table optimizer hypers, SSD spill)."""
+        if shard_num is not None:     # ps.proto spelling
+            num_shards = shard_num
+        if ssd_path:
+            self.tables[table_id] = NativeSsdSparseTable(
+                dim, ssd_path, num_shards=num_shards, optimizer=optimizer,
+                init_range=init_range, seed=seed, beta1=beta1, beta2=beta2,
+                eps=eps, mem_budget_rows=mem_budget_rows)
+        else:
+            self.tables[table_id] = NativeSparseTable(
+                dim, num_shards=num_shards, optimizer=optimizer,
+                init_range=init_range, seed=seed, beta1=beta1, beta2=beta2,
+                eps=eps)
         return self.tables[table_id]
 
     def add_dense_table(self, table_id, size, optimizer='sgd'):

@@ -125,10 +125,99 @@ def _check_statics(tag, statics, statics2):
             "tensor, or lift the assignment out of the converted branch")
 
 
+# ---- static-Program recording branch ---------------------------------------
+def _flatten_static_state(state):
+    """Like _flatten_state but for static Variables (Program recording):
+    Variables ride the carry; everything else is static."""
+    from ..static.program import Variable as SV
+    leaves, treedef = jax.tree_util.tree_flatten(
+        state, is_leaf=lambda t: isinstance(t, (Tensor, SV)))
+    kinds, carry, statics = [], [], []
+    for lf in leaves:
+        if isinstance(lf, (SV, Tensor)):
+            # concrete Tensors (e.g. paddle.zeros initials) ride the carry
+            # too — the recorders materialize them as captured consts
+            kinds.append('v')
+            carry.append(lf)
+        else:
+            kinds.append('s')
+            statics.append(lf)
+    return treedef, kinds, carry, statics
+
+
+def _unflatten_static_state(treedef, kinds, carry, statics):
+    leaves, ci, si = [], 0, 0
+    for k in kinds:
+        if k == 'v':
+            leaves.append(carry[ci])
+            ci += 1
+        else:
+            leaves.append(statics[si])
+            si += 1
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def _static_ifelse(pred, true_fn, false_fn, get_state, set_state):
+    """Record a conditional_block op with sub-blocks instead of tracing
+    lax.cond — the Program carries the control flow (VERDICT r2 #3)."""
+    from ..static import control_flow as CF
+    init = get_state()
+    td0, k0, c0, s0 = _flatten_static_state(init)
+
+    def branch(fn):
+        def run():
+            set_state(_unflatten_static_state(td0, k0, list(c0), s0))
+            fn()
+            td2, k2, c2, s2 = _flatten_static_state(get_state())
+            _check_match('if', td0, k0, td2, k2)
+            return c2
+        return run
+
+    outs = CF._record_cond(pred, branch(true_fn), branch(false_fn))
+    outs = [] if outs is None else (
+        list(outs) if isinstance(outs, tuple) else [outs])
+    set_state(_unflatten_static_state(td0, k0, outs, s0))
+
+
+def _static_while(cond_fn, body_fn, get_state, set_state):
+    from ..static import control_flow as CF
+    init = get_state()
+    td0, k0, c0, s0 = _flatten_static_state(init)
+
+    def c(*carry):
+        set_state(_unflatten_static_state(td0, k0, list(carry), s0))
+        return cond_fn()
+
+    def b(*carry):
+        set_state(_unflatten_static_state(td0, k0, list(carry), s0))
+        body_fn()
+        td2, k2, c2, s2 = _flatten_static_state(get_state())
+        _check_match('while', td0, k0, td2, k2)
+        return c2
+
+    outs = CF._record_while(c, b, c0)
+    set_state(_unflatten_static_state(td0, k0, list(outs), s0))
+
+
+def _static_pred(pred):
+    from ..static.program import Variable as SV
+    return isinstance(pred, SV)
+
+
+def _state_is_static(state):
+    from ..static.program import Variable as SV
+    leaves, _ = jax.tree_util.tree_flatten(
+        state, is_leaf=lambda t: isinstance(t, (Tensor, SV)))
+    return any(isinstance(lf, SV) for lf in leaves)
+
+
 # ---- runtime converters -----------------------------------------------------
 def convert_ifelse(pred, true_fn, false_fn, get_state, set_state):
     """Parity: convert_operators.convert_ifelse — lax.cond when the
     predicate is traced, Python if otherwise."""
+    if _static_pred(pred):
+        return _static_ifelse(pred, true_fn, false_fn, get_state,
+                              set_state)
     p = _raw(pred)
     if not isinstance(p, jax.core.Tracer):
         if bool(np.asarray(p).reshape(())):
@@ -166,7 +255,14 @@ def convert_while_loop(cond_fn, body_fn, get_state, set_state):
     """Parity: convert_operators.convert_while_loop — lax.while_loop when
     the condition is traced (NB: not reverse-differentiable under jax;
     use lax.scan-style loops for training-path recurrences)."""
+    from ..static.program import in_static_mode
+    if in_static_mode() and _state_is_static(get_state()):
+        # dispatch BEFORE evaluating cond_fn — a probe call would record
+        # a dead compare op into the outer block
+        return _static_while(cond_fn, body_fn, get_state, set_state)
     c0 = cond_fn()
+    if _static_pred(c0):
+        return _static_while(cond_fn, body_fn, get_state, set_state)
     if not _is_traced(c0):
         c = bool(np.asarray(_raw(c0)).reshape(()))
         while c:

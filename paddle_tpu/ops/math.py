@@ -254,7 +254,9 @@ def _cmp(name, fn):
     def op(x, y, name=None):
         tx = as_tensor(x)
         ty = as_tensor(y, ref=tx)
-        return Tensor(fn(tx.data, ty.data))
+        # through run_op so static mode records a compare op (while/cond
+        # conditions) instead of evaluating on symbolic avals
+        return run_op(name, fn, [tx, ty])
     op.__name__ = name
     return register(name, op)
 

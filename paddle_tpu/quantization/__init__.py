@@ -1,0 +1,398 @@
+"""Quantization (slim-lite): fake-quant ops, imperative QAT, static QAT
+pass, and int8 export.
+
+Reference parity:
+  * fake_quantize op family — operators/fake_quantize_op.cc
+    (FindAbsMaxFunctor:33, ClipAndFakeQuantFunctor:86, the
+    quant+dequant variants, channel-wise, moving-average state)
+  * QuantizationTransformPass —
+    contrib/slim/quantization/quantization_pass.py:263 (insert fake
+    quant/dequant on quantizable ops' inputs)
+  * ImperativeQuantAware — contrib/slim/quantization/imperative/qat.py
+    (wrap Linear/Conv2D with quant-aware forwards)
+  * PostTrainingQuantization — post_training_quantization.py (abs-max
+    calibration, int8 weight export)
+
+TPU-native design: fake quant-dequant is a single fused elementwise
+program with a straight-through-estimator custom VJP (the reference's
+separate quant/dequant CUDA kernels fuse away in XLA); moving-average
+scales are ordinary buffers threaded through jit; int8 export stores
+int8 weights + fp32 scales in the same data-only container
+static/inference.py uses.
+"""
+import functools
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.autograd import run_op
+from ..ops.common import as_tensor
+
+__all__ = [
+    'fake_quantize_dequantize_abs_max',
+    'fake_channel_wise_quantize_dequantize_abs_max',
+    'fake_quantize_dequantize_moving_average_abs_max',
+    'quantize_to_int8', 'dequantize_from_int8',
+    'ImperativeQuantAware', 'QuantizationTransformPass',
+    'export_quantized_layer', 'load_quantized_predictor',
+]
+
+
+# ---------------------------------------------------------------------------
+# fake quant ops (straight-through estimator VJP)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _fake_qdq(x, scale, bin_cnt):
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.round(jnp.clip(x, -s, s) * (bin_cnt / s))
+    return q * (s / bin_cnt)
+
+
+def _fake_qdq_fwd(x, scale, bin_cnt):
+    return _fake_qdq(x, scale, bin_cnt), (x, scale)
+
+
+def _fake_qdq_bwd(bin_cnt, res, g):
+    x, scale = res
+    # straight-through inside the clip range (fake_quantize_op grads)
+    inside = (jnp.abs(x) <= jnp.maximum(scale, 1e-8)).astype(g.dtype)
+    return g * inside, jnp.zeros_like(scale)
+
+
+_fake_qdq.defvjp(_fake_qdq_fwd, _fake_qdq_bwd)
+
+
+def fake_quantize_dequantize_abs_max(x, bits=8, name=None):
+    """Parity: fake_quantize_dequantize_abs_max — per-tensor abs-max scale
+    from the CURRENT tensor. Returns (out, scale)."""
+    x = as_tensor(x)
+    bin_cnt = float(2 ** (bits - 1) - 1)
+
+    def fn(a):
+        s = jnp.max(jnp.abs(a.astype(jnp.float32)))
+        out = _fake_qdq(a.astype(jnp.float32), s, bin_cnt)
+        return out.astype(a.dtype), s
+    return run_op('fake_quantize_dequantize_abs_max', fn, [x])
+
+
+def fake_channel_wise_quantize_dequantize_abs_max(x, quant_axis=0, bits=8,
+                                                  name=None):
+    """Parity: fake_channel_wise_quantize_dequantize_abs_max — per-channel
+    scales along quant_axis (0 for conv filters, 1 for mul/matmul
+    weights). Returns (out, scales)."""
+    if quant_axis not in (0, 1):
+        raise ValueError("'quant_axis' should be 0 or 1, got "
+                         f"{quant_axis}")
+    x = as_tensor(x)
+    bin_cnt = float(2 ** (bits - 1) - 1)
+
+    def fn(a):
+        af = a.astype(jnp.float32)
+        axes = tuple(i for i in range(af.ndim) if i != quant_axis)
+        s = jnp.max(jnp.abs(af), axis=axes)        # [C]
+        shape = [1] * af.ndim
+        shape[quant_axis] = af.shape[quant_axis]
+        out = _fake_qdq(af, s.reshape(shape), bin_cnt)
+        return out.astype(a.dtype), s
+    return run_op('fake_channel_wise_quantize_dequantize_abs_max', fn, [x])
+
+
+def fake_quantize_dequantize_moving_average_abs_max(
+        x, scale_state, moving_rate=0.9, bits=8, training=True, name=None):
+    """Parity: fake_quantize_dequantize_moving_average_abs_max — EMA of
+    the per-batch abs max; eval uses the accumulated scale unchanged.
+    scale_state: Tensor scalar. Returns (out, new_scale_state)."""
+    x, scale_state = as_tensor(x), as_tensor(scale_state)
+    bin_cnt = float(2 ** (bits - 1) - 1)
+    r = float(moving_rate)
+
+    def fn(a, st):
+        af = a.astype(jnp.float32)
+        if training:
+            cur = jnp.max(jnp.abs(af))
+            new = jnp.where(st > 0, r * st + (1 - r) * cur, cur)
+        else:
+            new = st
+        out = _fake_qdq(af, new, bin_cnt)
+        return out.astype(a.dtype), new
+    return run_op('fake_quantize_dequantize_moving_average_abs_max', fn,
+                  [x, scale_state])
+
+
+def quantize_to_int8(arr, quant_axis=None, bits=8):
+    """Concrete (host-side) int8 quantization for export: returns
+    (int8 np.ndarray, fp32 scales np.ndarray). Parity: the export path of
+    post_training_quantization.py."""
+    a = np.asarray(arr, np.float32)
+    bin_cnt = float(2 ** (bits - 1) - 1)
+    if quant_axis is None:
+        s = np.maximum(np.max(np.abs(a)), 1e-8)
+        q = np.round(np.clip(a, -s, s) * (bin_cnt / s)).astype(np.int8)
+        return q, np.float32(s)
+    axes = tuple(i for i in range(a.ndim) if i != quant_axis)
+    s = np.maximum(np.max(np.abs(a), axis=axes), 1e-8)
+    shape = [1] * a.ndim
+    shape[quant_axis] = a.shape[quant_axis]
+    q = np.round(np.clip(a, -s.reshape(shape), s.reshape(shape))
+                 * (bin_cnt / s.reshape(shape))).astype(np.int8)
+    return q, s.astype(np.float32)
+
+
+def dequantize_from_int8(q, scale, quant_axis=None, bits=8):
+    bin_cnt = float(2 ** (bits - 1) - 1)
+    qf = np.asarray(q, np.float32)
+    s = np.asarray(scale, np.float32)
+    if quant_axis is None:
+        return qf * (s / bin_cnt)
+    shape = [1] * qf.ndim
+    shape[quant_axis] = qf.shape[quant_axis]
+    return qf * (s.reshape(shape) / bin_cnt)
+
+
+# ---------------------------------------------------------------------------
+# imperative QAT (dygraph)
+# ---------------------------------------------------------------------------
+
+class _QuantWrapper:
+    """Quant-aware forward for one Linear/Conv2D: fake-qdq the input
+    (moving-average scale buffer) and the weight (abs-max / channel-wise)
+    before the original forward (parity: imperative/qat.py QuantedLinear/
+    QuantedConv2D)."""
+
+    def __init__(self, layer, weight_quantize_type, activation_bits,
+                 weight_bits, moving_rate, weight_axis):
+        self.layer = layer
+        self.weight_quantize_type = weight_quantize_type
+        self.activation_bits = activation_bits
+        self.weight_bits = weight_bits
+        self.moving_rate = moving_rate
+        self.weight_axis = weight_axis
+        self._orig_forward = layer.forward
+        layer.register_buffer('_act_quant_scale',
+                              Tensor(jnp.zeros((), jnp.float32)))
+        layer.forward = self._forward
+
+    def _forward(self, x, *args, **kwargs):
+        layer = self.layer
+        x = as_tensor(x)
+        xq, new_scale = fake_quantize_dequantize_moving_average_abs_max(
+            x, layer._act_quant_scale, moving_rate=self.moving_rate,
+            bits=self.activation_bits, training=layer.training)
+        if layer.training:
+            # keep it a buffer: a stop_gradient=False Tensor would
+            # re-register as a parameter through Layer.__setattr__
+            new_scale.stop_gradient = True
+            layer._act_quant_scale = new_scale
+        w = layer.weight
+        if self.weight_quantize_type == 'channel_wise_abs_max':
+            wq, _ = fake_channel_wise_quantize_dequantize_abs_max(
+                w, quant_axis=self.weight_axis, bits=self.weight_bits)
+        else:
+            wq, _ = fake_quantize_dequantize_abs_max(
+                w, bits=self.weight_bits)
+        orig_w = layer.weight
+        layer.weight = wq
+        try:
+            return self._orig_forward(xq, *args, **kwargs)
+        finally:
+            layer.weight = orig_w
+
+
+class ImperativeQuantAware:
+    """Parity: contrib/slim/quantization/imperative/qat.py
+    ImperativeQuantAware — in-place quant-aware rewrite of a dygraph
+    model's Linear/Conv2D sublayers."""
+
+    def __init__(self, quantizable_layer_type=('Conv2D', 'Linear'),
+                 weight_quantize_type='abs_max',
+                 activation_quantize_type='moving_average_abs_max',
+                 weight_bits=8, activation_bits=8, moving_rate=0.9):
+        if activation_quantize_type != 'moving_average_abs_max':
+            raise NotImplementedError(activation_quantize_type)
+        self.types = tuple(quantizable_layer_type)
+        self.weight_quantize_type = weight_quantize_type
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.moving_rate = moving_rate
+
+    def quantize(self, model):
+        from ..nn.layer.common import Linear
+        from ..nn.layer.conv import Conv2D
+        type_map = {'Linear': Linear, 'Conv2D': Conv2D}
+        targets = tuple(type_map[t] for t in self.types if t in type_map)
+        wrapped = []
+        for name, sub in model.named_sublayers(include_self=True):
+            if isinstance(sub, targets) and \
+                    not hasattr(sub, '_quant_wrapper'):
+                # conv filters quantize per-output-channel on axis 0,
+                # Linear [in, out] weights on axis 1 (quantization_pass
+                # conv/mul convention)
+                axis = 0 if isinstance(sub, type_map.get('Conv2D', ()))\
+                    else 1
+                sub._quant_wrapper = _QuantWrapper(
+                    sub, self.weight_quantize_type, self.activation_bits,
+                    self.weight_bits, self.moving_rate, axis)
+                wrapped.append(name)
+        if not wrapped:
+            raise ValueError("no quantizable sublayers found")
+        return model
+
+
+# ---------------------------------------------------------------------------
+# static QAT pass
+# ---------------------------------------------------------------------------
+
+class QuantizationTransformPass:
+    """Parity: quantization_pass.py:263 — insert fake quant-dequant ops on
+    the float inputs of quantizable ops in a recorded Program. Scales are
+    emitted as extra outputs so a calibration run can fetch them."""
+
+    _supported_quantizable_op_type = ['conv2d', 'depthwise_conv2d',
+                                     'conv2d_transpose', 'mul', 'matmul',
+                                     'matmul_v2']
+
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 quantizable_op_type=('conv2d', 'depthwise_conv2d', 'mul',
+                                      'matmul', 'matmul_v2'),
+                 skip_pattern=('skip_quant',)):
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.ops = set(quantizable_op_type)
+        self.skip_pattern = tuple(skip_pattern)
+
+    def apply(self, program):
+        """Rewrite in place; returns the number of quant ops inserted."""
+        from ..static.program import Variable, Operator, OpRole
+        from ..core import dtypes as _dt
+        block = program.global_block()
+        out_ops = []
+        quantized = {}        # var name -> quantized var name
+        n = 0
+        for op in block.ops:
+            if op.type in self.ops and not any(
+                    p in (op.attrs.get('name') or '')
+                    for p in self.skip_pattern):
+                new_ins = []
+                for iname in op.input_names:
+                    v = block.vars.get(iname)
+                    if v is None or not _dt.is_floating(v.dtype):
+                        new_ins.append(iname)
+                        continue
+                    if iname in quantized:
+                        new_ins.append(quantized[iname])
+                        continue
+                    bits = self.weight_bits if getattr(
+                        v, 'is_parameter', False) else self.activation_bits
+                    bin_cnt = float(2 ** (bits - 1) - 1)
+                    qname = f"{iname}.quantized"
+                    sname = f"{iname}.quant_scale"
+                    qv = Variable(block, qname, v.shape, v.dtype,
+                                  stop_gradient=v.stop_gradient)
+                    sv = Variable(block, sname, [], jnp.float32)
+                    block.vars[qname] = qv
+                    block.vars[sname] = sv
+
+                    def qfn(a, _b=bin_cnt):
+                        af = a.astype(jnp.float32)
+                        s = jnp.max(jnp.abs(af))
+                        return (_fake_qdq(af, s, _b).astype(a.dtype), s)
+                    qop = Operator('fake_quantize_dequantize_abs_max',
+                                   qfn, [iname], [qname, sname],
+                                   {'bit_length': bits},
+                                   op_role=op.op_role)
+                    qop.multi_out = True
+                    out_ops.append(qop)
+                    quantized[iname] = qname
+                    new_ins.append(qname)
+                    n += 1
+                op.input_names = new_ins
+            out_ops.append(op)
+        block.ops = out_ops
+        program._quant_rewritten = True
+        return n
+
+
+# ---------------------------------------------------------------------------
+# int8 export / load
+# ---------------------------------------------------------------------------
+
+def export_quantized_layer(path_prefix, layer, example_inputs,
+                           weight_bits=8):
+    """Int8 export through the static/inference.py container: weights of
+    quantized sublayers stored as int8 + per-channel fp32 scales; the
+    predictor dequantizes at load (weight-only int8 — the
+    post_training_quantization artifact shape)."""
+    import io as _io
+    import json
+    import zipfile
+    from ..static.inference import export_layer
+    export_layer(path_prefix, layer, example_inputs)
+
+    # rewrite the .pdexec arrays: quantize eligible params
+    with zipfile.ZipFile(path_prefix + '.pdexec') as z:
+        meta = json.loads(z.read('meta.json'))
+        loaded = np.load(_io.BytesIO(z.read('arrays.npz')),
+                         allow_pickle=False)
+        arrays = {k: loaded[k] for k in loaded.files}
+    q_arrays, q_meta = {}, {}
+    for k, a in arrays.items():
+        if k.startswith('p:') and a.ndim >= 2 and \
+                a.dtype in (np.float32, np.float16):
+            axis = a.ndim - 1        # out-channel axis (Linear [in,out],
+            q, s = quantize_to_int8(a, quant_axis=axis,  # conv [O,I,kh,kw]
+                                    bits=weight_bits)
+            if a.ndim == 4:
+                q, s = quantize_to_int8(a, quant_axis=0, bits=weight_bits)
+                axis = 0
+            q_arrays[k] = q
+            q_arrays[k + '.scale'] = s
+            q_meta[k] = {'quant_axis': axis, 'bits': weight_bits,
+                         'dtype': str(a.dtype)}
+        else:
+            q_arrays[k] = a
+    meta['quantized'] = q_meta
+    npz = _io.BytesIO()
+    np.savez(npz, **q_arrays)
+    with zipfile.ZipFile(path_prefix + '.pdexec', 'w') as z:
+        z.writestr('meta.json', json.dumps(meta))
+        z.writestr('arrays.npz', npz.getvalue())
+    return path_prefix
+
+
+def load_quantized_predictor(path_prefix):
+    """Load an int8 artifact: dequantize weights, return a Predictor."""
+    import io as _io
+    import json
+    import zipfile
+    from ..static.inference import Predictor
+    with zipfile.ZipFile(path_prefix + '.pdexec') as z:
+        meta = json.loads(z.read('meta.json'))
+        loaded = np.load(_io.BytesIO(z.read('arrays.npz')),
+                         allow_pickle=False)
+        arrays = {k: loaded[k] for k in loaded.files}
+    q_meta = meta.get('quantized', {})
+    deq = {}
+    for k, a in arrays.items():
+        if k.endswith('.scale'):
+            continue
+        if k in q_meta:
+            info = q_meta[k]
+            deq[k] = dequantize_from_int8(
+                a, arrays[k + '.scale'], quant_axis=info['quant_axis'],
+                bits=info['bits']).astype(info['dtype'])
+        else:
+            deq[k] = a
+    pred = Predictor.__new__(Predictor)
+    from jax import export as jax_export
+    with open(path_prefix + '.stablehlo', 'rb') as f:
+        pred._exported = jax_export.deserialize(f.read())
+    pred._params = {k[2:]: jnp.asarray(v) for k, v in deq.items()
+                    if k.startswith('p:')}
+    pred._buffers = {k[2:]: jnp.asarray(v) for k, v in deq.items()
+                     if k.startswith('b:')}
+    pred.input_specs = [(tuple(sh), dt) for sh, dt in meta['input_specs']]
+    return pred

@@ -30,9 +30,14 @@ class AutoMixedPrecisionLists:
 
     def __init__(self, custom_white_list=None, custom_black_list=None,
                  custom_black_varnames=None):
-        self.white_list = set(WHITE_LIST) | set(custom_white_list or ())
-        self.black_list = set(BLACK_LIST) | set(custom_black_list or ())
-        self.black_list -= self.white_list
+        cw = set(custom_white_list or ())
+        cb = set(custom_black_list or ())
+        if cw & cb:
+            raise ValueError(f"ops in both custom lists: {cw & cb}")
+        # custom entries override the defaults (fp16_lists.py moves an op
+        # out of the default list before adding it to the other)
+        self.white_list = (set(WHITE_LIST) - cb) | cw
+        self.black_list = (set(BLACK_LIST) - cw) | cb
         self.black_varnames = set(custom_black_varnames or ())
 
 
@@ -68,6 +73,30 @@ def rewrite_program_amp(program, amp_lists=None, dest_dtype='bfloat16'):
         return cname
 
     var_dtype = {n: v.dtype for n, v in block.vars.items()}
+
+    def _infer_out_dtypes(op):
+        """Real output dtypes via jax.eval_shape on the op's fn at the
+        (possibly cast) input avals — JAX type promotion at replay is the
+        ground truth, not an all-inputs-low heuristic (a bf16+f32 gray op
+        yields f32). Dynamic dims use a placeholder extent: dtype inference
+        is size-independent."""
+        import jax
+        avals = []
+        for n in op.input_names:
+            v = block.vars.get(n)
+            if v is None:
+                return None
+            shape = tuple(2 if (d is None or d < 0) else d
+                          for d in v.shape)
+            avals.append(jax.ShapeDtypeStruct(shape,
+                                              var_dtype.get(n, v.dtype)))
+        try:
+            out = jax.eval_shape(op.fn, *avals)
+        except Exception:
+            return None
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        return [o.dtype for o in outs]
+
     for op in block.ops:
         if op.op_role & (OpRole.Backward | OpRole.Optimize):
             out_ops.append(op)
@@ -90,17 +119,14 @@ def rewrite_program_amp(program, amp_lists=None, dest_dtype='bfloat16'):
                     new_ins.append(n)
             op.input_names = new_ins
         out_ops.append(op)
-        # infer output dtypes from (possibly cast) inputs
-        in_dts = [var_dtype.get(n) for n in op.input_names
-                  if n in var_dtype and dtypes.is_floating(var_dtype[n])]
-        out_dt = want if want is not None else (
-            low if in_dts and all(d == low for d in in_dts) else None)
-        for o in op.output_names:
+        out_dts = _infer_out_dtypes(op)
+        for i, o in enumerate(op.output_names):
             if o in block.vars and dtypes.is_floating(var_dtype.get(o,
                                                                     f32)):
-                if out_dt is not None:
-                    var_dtype[o] = out_dt
-                    block.vars[o].dtype = out_dt
+                if out_dts is not None and i < len(out_dts) \
+                        and dtypes.is_floating(out_dts[i]):
+                    var_dtype[o] = out_dts[i]
+                    block.vars[o].dtype = out_dts[i]
     block.ops = out_ops
     program._amp_rewritten = True
     return n_casts

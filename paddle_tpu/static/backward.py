@@ -150,6 +150,13 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
                     grad_idx.append(i)
             if not grad_idx:
                 continue
+            if op.type in ('conditional_block', 'while'):
+                raise NotImplementedError(
+                    "append_backward through conditional_block/while "
+                    "sub-block ops is not supported: keep recorded "
+                    "control flow out of the loss path, or use the "
+                    "dygraph/jit path (lax.cond differentiates; "
+                    "lax.while_loop is not reverse-differentiable)")
             # cotangents for every output (zeros where unused)
             cot_names = []
             for oname in op.output_names:

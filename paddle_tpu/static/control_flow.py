@@ -5,6 +5,13 @@ N28) and the fluid.layers control_flow user API (While/cond/case/
 switch_case). TPU-native: these ARE lax.while_loop/cond/switch — compiled
 structured control flow instead of the reference's op-microkernel
 interpreters; they run eagerly too (lax executes op-by-op outside jit).
+
+Under static recording (enable_static + program_guard) cond/while_loop
+instead record `conditional_block` / `while` OPS whose branches/bodies are
+nested sub-Blocks (parity: framework.proto BlockDesc:178 nesting +
+conditional_block_op.cc / while_op.cc) — so a recorded Program carries
+data-dependent control flow, serializes with it, and the Executor replays
+it through lax.cond / lax.while_loop.
 """
 import jax
 import jax.numpy as jnp
@@ -23,8 +30,161 @@ def _box(x):
         is_leaf=lambda a: not isinstance(a, (list, tuple, dict)))
 
 
+def _as_var_list(out):
+    if out is None:
+        return []
+    return list(out) if isinstance(out, (list, tuple)) else [out]
+
+
+def _ensure_var(prog, block, v):
+    """Materialize a concrete Tensor as a captured const Variable of
+    `block` (untouched state leaves / loop initials)."""
+    from .program import Variable, _ConstVar
+    if isinstance(v, Variable):
+        return v
+    cname = prog._unique_name('const')
+    cv = _ConstVar(block, cname, v)
+    block.vars[cname] = cv
+    return cv
+
+
+def _external_inputs(prog, blocks):
+    """Names sub-block ops consume that are not defined inside them —
+    listed as the control-flow op's inputs so program pruning
+    (save_inference_model) keeps their producers."""
+    used, defined = [], set()
+
+    def walk(b):
+        defined.update(b.vars)
+        for op in b.ops:
+            for n in op.input_names:
+                if n not in defined:
+                    used.append(n)
+            defined.update(op.output_names)
+            for key in ('sub_block_true', 'sub_block_false',
+                        'cond_block', 'body_block'):
+                if key in op.attrs:
+                    walk(prog.blocks[op.attrs[key]])
+    for b in blocks:
+        walk(b)
+    seen, out = set(), []
+    for n in used:
+        if n not in seen:
+            seen.add(n)
+            out.append(n)
+    return out
+
+
+def _record_cond(pred, true_fn, false_fn):
+    """Record a conditional_block op with two sub-blocks (parity:
+    conditional_block_op.cc; layers/control_flow.py cond)."""
+    from .program import default_main_program, Variable, Operator
+    prog = default_main_program()
+    outer = prog.current_block()
+    true_fn = true_fn or (lambda: None)
+    false_fn = false_fn or (lambda: None)
+
+    tb = prog._create_block()
+    t_list = [_ensure_var(prog, tb, v) for v in _as_var_list(true_fn())]
+    prog._rollback()
+    fb = prog._create_block()
+    f_list = [_ensure_var(prog, fb, v) for v in _as_var_list(false_fn())]
+    prog._rollback()
+    if len(t_list) != len(f_list):
+        raise ValueError(
+            f"cond branches return {len(t_list)} vs {len(f_list)} outputs "
+            "— both branches must produce the same structure")
+    outs = []
+    for tv, fv in zip(t_list, f_list):
+        if list(tv.shape) != list(fv.shape) or tv.dtype != fv.dtype:
+            raise ValueError(
+                f"cond branch outputs mismatch: {tv.shape}/{tv.dtype} vs "
+                f"{fv.shape}/{fv.dtype}")
+        name = prog._unique_name('cond')
+        ov = Variable(outer, name, tv.shape, tv.dtype,
+                      stop_gradient=tv.stop_gradient and fv.stop_gradient)
+        outer.vars[name] = ov
+        outs.append(ov)
+    ext = _external_inputs(prog, [tb, fb])
+    op = Operator('conditional_block', None, [pred.name] + ext,
+                  [o.name for o in outs],
+                  {'sub_block_true': tb.idx, 'sub_block_false': fb.idx,
+                   'true_outs': [v.name for v in t_list],
+                   'false_outs': [v.name for v in f_list]})
+    outer.append_op(op)
+    if not outs:
+        return None
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def _record_while(cond_fn, body_fn, loop_vars):
+    """Record a while op whose cond/body are sub-blocks over named carry
+    vars (parity: while_op.cc; layers/control_flow.py While)."""
+    from .program import (default_main_program, Variable, Operator,
+                          _ConstVar)
+    prog = default_main_program()
+    outer = prog.current_block()
+    # concrete Tensors among loop vars (e.g. paddle.zeros initials)
+    # become captured consts
+    resolved = []
+    for v in loop_vars:
+        if isinstance(v, Variable):
+            resolved.append(v)
+        else:
+            cname = prog._unique_name('const')
+            cv = _ConstVar(outer, cname, v)
+            outer.vars[cname] = cv
+            resolved.append(cv)
+    loop_vars = resolved
+    infos = [(prog._unique_name('while_carry'), v.shape, v.dtype,
+              v.stop_gradient) for v in loop_vars]
+
+    cb = prog._create_block()
+    c_shadows = []
+    for nm, shp, dt, sg in infos:
+        sv = Variable(cb, nm, shp, dt, stop_gradient=sg)
+        cb.vars[nm] = sv
+        c_shadows.append(sv)
+    c_out = cond_fn(*c_shadows)
+    prog._rollback()
+
+    bb = prog._create_block()
+    b_shadows = []
+    for nm, shp, dt, sg in infos:
+        sv = Variable(bb, nm, shp, dt, stop_gradient=sg)
+        bb.vars[nm] = sv
+        b_shadows.append(sv)
+    b_list = [_ensure_var(prog, bb, v)
+              for v in _as_var_list(body_fn(*b_shadows))]
+    prog._rollback()
+    if len(b_list) != len(loop_vars):
+        raise ValueError(
+            f"while body returns {len(b_list)} vars for {len(loop_vars)} "
+            "loop vars")
+
+    outs = []
+    for v in loop_vars:
+        name = prog._unique_name('while')
+        ov = Variable(outer, name, v.shape, v.dtype,
+                      stop_gradient=v.stop_gradient)
+        outer.vars[name] = ov
+        outs.append(ov)
+    ext = _external_inputs(prog, [cb, bb])
+    op = Operator('while', None, [v.name for v in loop_vars] + ext,
+                  [o.name for o in outs],
+                  {'cond_block': cb.idx, 'body_block': bb.idx,
+                   'carry_names': [i[0] for i in infos],
+                   'cond_out': c_out.name,
+                   'body_outs': [o.name for o in b_list]})
+    outer.append_op(op)
+    return outs
+
+
 def while_loop(cond, body, loop_vars, is_test=False, name=None):
     """Parity: paddle.static.nn.while_loop."""
+    from .program import Variable as _V
+    if any(isinstance(v, _V) for v in loop_vars):
+        return _record_while(cond, body, loop_vars)
     def c(vs):
         out = cond(*_rebox_args(vs))
         return _unbox(out).reshape(())
@@ -44,6 +204,9 @@ def while_loop(cond, body, loop_vars, is_test=False, name=None):
 def cond(pred, true_fn=None, false_fn=None, name=None):
     """Parity: paddle.static.nn.cond (an omitted branch is a no-op
     returning a zero scalar so both branches match structurally)."""
+    from .program import Variable as _V
+    if isinstance(pred, _V):
+        return _record_cond(pred, true_fn, false_fn)
     p = _unbox(pred)
     true_fn = true_fn or (lambda: Tensor(jnp.asarray(0)))
     false_fn = false_fn or (lambda: Tensor(jnp.asarray(0)))

@@ -161,12 +161,13 @@ class Executor:
                 env[name] = arr
             for name, arr in zip(param_names, param_arrays):
                 env[name] = arr
-            for v in block.vars.values():
-                if isinstance(v, _ConstVar):
-                    env[v.name] = v.value
+            for b in program.blocks:     # consts incl. sub-block captures
+                for v in b.vars.values():
+                    if isinstance(v, _ConstVar):
+                        env[v.name] = v.value
 
             for op in block.ops:
-                run_op_in_env(op, env)
+                run_op_in_env(op, env, program)
 
             new_params = [env[n] for n in param_names]
             fetches = [env[n] for n in fetch_names]

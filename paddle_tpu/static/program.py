@@ -131,6 +131,20 @@ class Variable:
     def __matmul__(self, o):
         return self._binop(o, 'matmul')
 
+    # comparisons record ops too (needed by while/cond conditions);
+    # __eq__/__hash__ stay identity-based — Variables live in dicts/sets
+    def __lt__(self, o):
+        return self._binop(o, 'less_than')
+
+    def __le__(self, o):
+        return self._binop(o, 'less_equal')
+
+    def __gt__(self, o):
+        return self._binop(o, 'greater_than')
+
+    def __ge__(self, o):
+        return self._binop(o, 'greater_equal')
+
 
 class Parameter(Variable):
     def __init__(self, *args, initializer=None, trainable=True, **kwargs):
@@ -183,11 +197,14 @@ class Operator:
 
 
 class Block:
-    """Parity: fluid/framework.py Block over BlockDesc."""
+    """Parity: fluid/framework.py Block over BlockDesc (incl. the nested
+    sub-block structure framework.proto:178 uses for conditional_block/
+    while ops)."""
 
-    def __init__(self, program, idx):
+    def __init__(self, program, idx, parent_idx=-1):
         self.program = program
         self.idx = idx
+        self.parent_idx = parent_idx
         self.vars = {}
         self.ops = []
 
@@ -195,6 +212,17 @@ class Block:
         if name not in self.vars:
             raise ValueError(f"var {name} not in block")
         return self.vars[name]
+
+    def _find_var_recursive(self, name):
+        """Resolve a name through the parent-block chain (parity:
+        Block._var_recursive)."""
+        b = self
+        while b is not None:
+            if name in b.vars:
+                return b.vars[name]
+            b = (self.program.blocks[b.parent_idx]
+                 if b.parent_idx >= 0 else None)
+        return None
 
     def has_var(self, name):
         return name in self.vars
@@ -229,6 +257,7 @@ class Program:
 
     def __init__(self):
         self.blocks = [Block(self, 0)]
+        self._block_stack = [0]
         self._name_counter = {}
         self.startup_ops = []  # parameters needing init
         self._loss_var = None
@@ -245,7 +274,23 @@ class Program:
         return self.blocks[0]
 
     def current_block(self):
-        return self.blocks[-1]
+        stack = getattr(self, '_block_stack', None) or [0]
+        return self.blocks[stack[-1]]
+
+    def _create_block(self):
+        """Push a new sub-block; subsequent record_op calls land in it
+        (parity: Program._create_block)."""
+        if not hasattr(self, '_block_stack'):
+            self._block_stack = [0]
+        b = Block(self, len(self.blocks),
+                  parent_idx=self._block_stack[-1])
+        self.blocks.append(b)
+        self._block_stack.append(b.idx)
+        return b
+
+    def _rollback(self):
+        """Pop back to the parent block (parity: Program._rollback)."""
+        self._block_stack.pop()
 
     def all_parameters(self):
         out = []
@@ -268,7 +313,7 @@ class Program:
             # data. Vars are shared; only the op list is filtered.
             p.blocks = []
             for b in self.blocks:
-                nb = Block(p, b.idx)
+                nb = Block(p, b.idx, parent_idx=getattr(b, 'parent_idx', -1))
                 nb.vars = b.vars
                 nb.ops = [op for op in b.ops
                           if not (op.op_role & (OpRole.Backward
@@ -458,13 +503,81 @@ def materialize_persistables(vars_iter, find, set_, apply_masters=True):
     return []
 
 
-def run_op_in_env(op, env):
+def run_op_in_env(op, env, program=None):
     """Execute one recorded op against a name→array env (shared by the
-    Executor replay and the pipeline/sharding interpreters)."""
+    Executor replay and the pipeline/sharding interpreters). Control-flow
+    ops (conditional_block / while) replay their sub-blocks through
+    lax.cond / lax.while_loop — `program` must be passed for those."""
+    if op.type == 'conditional_block':
+        return _run_conditional_block(op, env, program)
+    if op.type == 'while':
+        return _run_while(op, env, program)
     ins = [env[n] for n in op.input_names]
     outs = op.fn(*ins)
     if not isinstance(outs, (tuple, list)):
         outs = (outs,)
+    for n, o in zip(op.output_names, outs):
+        env[n] = o
+
+
+def _replay_block(block, env, program):
+    for op in block.ops:
+        run_op_in_env(op, env, program)
+
+
+def _run_conditional_block(op, env, program):
+    """conditional_block op (parity:
+    operators/controlflow/conditional_block_op.cc) — both branches are
+    sub-blocks; executes as lax.cond so it traces under jit."""
+    if program is None:
+        raise RuntimeError("conditional_block op needs the owning Program")
+    pred = env[op.input_names[0]]
+    tb = program.blocks[op.attrs['sub_block_true']]
+    fb = program.blocks[op.attrs['sub_block_false']]
+    t_outs = op.attrs['true_outs']
+    f_outs = op.attrs['false_outs']
+
+    def branch(blk, out_names):
+        def run(_):
+            local = dict(env)
+            _replay_block(blk, local, program)
+            return tuple(local[n] for n in out_names)
+        return run
+
+    outs = jax.lax.cond(jnp.asarray(pred).reshape(()).astype(bool),
+                        branch(tb, t_outs), branch(fb, f_outs),
+                        operand=None)
+    for n, o in zip(op.output_names, outs):
+        env[n] = o
+
+
+def _run_while(op, env, program):
+    """while op (parity: operators/controlflow/while_op.cc) — cond and
+    body are sub-blocks over named carry vars; executes as
+    lax.while_loop."""
+    if program is None:
+        raise RuntimeError("while op needs the owning Program")
+    cb = program.blocks[op.attrs['cond_block']]
+    bb = program.blocks[op.attrs['body_block']]
+    carry_names = op.attrs['carry_names']
+    n_carry = len(carry_names)
+    init = tuple(jnp.asarray(env[n]) for n in op.input_names[:n_carry])
+
+    def c(carry):
+        local = dict(env)
+        local.update(zip(carry_names, carry))
+        _replay_block(cb, local, program)
+        return jnp.asarray(local[op.attrs['cond_out']]) \
+            .reshape(()).astype(bool)
+
+    def b(carry):
+        local = dict(env)
+        local.update(zip(carry_names, carry))
+        _replay_block(bb, local, program)
+        return tuple(jnp.asarray(local[n]).astype(i.dtype)
+                     for n, i in zip(op.attrs['body_outs'], init))
+
+    outs = jax.lax.while_loop(c, b, init)
     for n, o in zip(op.output_names, outs):
         env[n] = o
 

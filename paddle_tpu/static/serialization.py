@@ -33,7 +33,7 @@ from ..core import dtypes
 from .program import (Program, Block, Variable, Parameter, Operator,
                       _ConstVar)
 
-FORMAT_VERSION = 2   # v2: data-only zip/json/npz container (v1 was pickle)
+FORMAT_VERSION = 3   # v3: nested blocks; v2: data-only zip; v1: pickle
 _PLATFORMS = ('cpu', 'tpu')
 
 
@@ -120,48 +120,57 @@ def _load_npz(data):
 
 def serialize_program(program):
     """Program -> bytes. Ops whose fn cannot be exported (host-side ops
-    like recv_v2) are stored with a named fallback instead of a kernel."""
-    block = program.global_block()
-    vars_desc, arrays = [], {}
-    for v in block.vars.values():
-        d = {'name': v.name, 'shape': list(v.shape),
-             'dtype': dtypes.dtype_name(v.dtype),
-             'persistable': bool(getattr(v, 'persistable', False)),
-             'stop_gradient': bool(getattr(v, 'stop_gradient', True)),
-             'is_parameter': isinstance(v, Parameter),
-             'op_device': getattr(v, 'op_device', ''),
-             'init_from': getattr(v, '_init_from', None),
-             'is_const': isinstance(v, _ConstVar)}
-        if isinstance(v, _ConstVar):
-            arrays['const:' + v.name] = np.asarray(jax.device_get(v.value))
-        vars_desc.append(d)
+    like recv_v2) are stored with a named fallback instead of a kernel;
+    conditional_block/while ops serialize as block references (parity:
+    BlockDesc nesting, framework.proto:178) — their sub-blocks' op kernels
+    ship like any others."""
+    arrays, kernels, blocks_desc = {}, [], []
+    for block in program.blocks:
+        vars_desc = []
+        for v in block.vars.values():
+            d = {'name': v.name, 'shape': list(v.shape),
+                 'dtype': dtypes.dtype_name(v.dtype),
+                 'persistable': bool(getattr(v, 'persistable', False)),
+                 'stop_gradient': bool(getattr(v, 'stop_gradient', True)),
+                 'is_parameter': isinstance(v, Parameter),
+                 'op_device': getattr(v, 'op_device', ''),
+                 'init_from': getattr(v, '_init_from', None),
+                 'is_const': isinstance(v, _ConstVar)}
+            if isinstance(v, _ConstVar):
+                arrays['const:' + v.name] = np.asarray(
+                    jax.device_get(v.value))
+            vars_desc.append(d)
 
-    ops_desc, kernels = [], []
-    for op in block.ops:
-        desc = {'type': op.type, 'inputs': list(op.input_names),
-                'outputs': list(op.output_names),
-                'attrs': _safe_attrs(op.attrs, arrays),
-                'op_role': op.op_role, 'op_device': op.op_device,
-                'multi_out': bool(getattr(op, 'multi_out', False)),
-                'kernel': None}
-        if op.type == 'recv_v2':
-            desc['fallback'] = 'none'
-        elif op.type == 'send_v2':
-            desc['fallback'] = 'identity'
-        else:
-            sym_scope = jax_export.SymbolicScope()
-            avals = [_aval_of(block.vars[n], sym_scope)
-                     for n in op.input_names]
-            exported = jax_export.export(
-                jax.jit(op.fn), platforms=list(_PLATFORMS))(*avals)
-            desc['kernel'] = len(kernels)
-            kernels.append(exported.serialize())
-        ops_desc.append(desc)
+        ops_desc = []
+        for op in block.ops:
+            desc = {'type': op.type, 'inputs': list(op.input_names),
+                    'outputs': list(op.output_names),
+                    'attrs': _safe_attrs(op.attrs, arrays),
+                    'op_role': op.op_role, 'op_device': op.op_device,
+                    'multi_out': bool(getattr(op, 'multi_out', False)),
+                    'kernel': None}
+            if op.type in ('conditional_block', 'while'):
+                desc['fallback'] = 'control_flow'
+            elif op.type == 'recv_v2':
+                desc['fallback'] = 'none'
+            elif op.type == 'send_v2':
+                desc['fallback'] = 'identity'
+            else:
+                sym_scope = jax_export.SymbolicScope()
+                avals = [_aval_of(block._find_var_recursive(n), sym_scope)
+                         for n in op.input_names]
+                exported = jax_export.export(
+                    jax.jit(op.fn), platforms=list(_PLATFORMS))(*avals)
+                desc['kernel'] = len(kernels)
+                kernels.append(exported.serialize())
+            ops_desc.append(desc)
+        blocks_desc.append({'idx': block.idx,
+                            'parent_idx': getattr(block, 'parent_idx', -1),
+                            'vars': vars_desc, 'ops': ops_desc})
 
     payload = {
         'version': FORMAT_VERSION,
-        'vars': vars_desc,
-        'ops': ops_desc,
+        'blocks': blocks_desc,
         'n_kernels': len(kernels),
         'grad_map': dict(program._grad_map),
         'loss_var': program._loss_var.name
@@ -200,7 +209,7 @@ def deserialize_program(data):
         zf = zipfile.ZipFile(io.BytesIO(data))
     except zipfile.BadZipFile:
         raise ValueError(
-            "not a paddle_tpu program container (format v2 is a zip; "
+            "not a paddle_tpu program container (v2+ is a zip; "
             "v1 pickle-era files are no longer loadable)")
     with zf as z:
         payload = json.loads(z.read('program.json'))
@@ -213,44 +222,52 @@ def deserialize_program(data):
         kernels = [z.read(f'kernels/{i}')
                    for i in range(payload['n_kernels'])]
     prog = Program()
-    block = prog.global_block()
-    for d in payload['vars']:
-        if d['is_const']:
-            v = _ConstVar.__new__(_ConstVar)
-            Variable.__init__(v, block, d['name'], d['shape'], d['dtype'],
-                              persistable=True)
-            v.value = jnp.asarray(arrays['const:' + d['name']])
-        elif d['is_parameter']:
-            v = Parameter(block, d['name'], d['shape'], d['dtype'],
-                          trainable=not d['stop_gradient'])
-        else:
-            v = Variable(block, d['name'], d['shape'], d['dtype'],
-                         persistable=d['persistable'],
-                         stop_gradient=d['stop_gradient'])
-        if d.get('init_from'):
-            v._init_from = d['init_from']
-        v.op_device = d.get('op_device', '')
-        block.vars[d['name']] = v
-        if d['persistable'] and not d['is_const']:
-            prog.startup_ops.append(v)
-
+    from .program import Block
     attr_arrays = {k: v for k, v in arrays.items()
                    if not k.startswith('const:')}
-    for d in payload['ops']:
-        d['attrs'] = {k: _decode_attr(v, attr_arrays)
-                      for k, v in d.get('attrs', {}).items()}
-        if d['kernel'] is not None:
-            fn = _kernel_fn(kernels[d['kernel']],
-                            d['multi_out'])
-        elif d.get('fallback') == 'identity':
-            fn = lambda x: x                      # noqa: E731
+    for bd in payload['blocks']:
+        if bd['idx'] == 0:
+            block = prog.global_block()
         else:
-            fn = lambda: None                     # noqa: E731
-        op = Operator(d['type'], fn, d['inputs'], d['outputs'],
-                      d['attrs'], op_role=d['op_role'])
-        op.op_device = d['op_device']
-        op.multi_out = d['multi_out']
-        block.append_op(op)
+            block = Block(prog, bd['idx'], parent_idx=bd['parent_idx'])
+            prog.blocks.append(block)
+        for d in bd['vars']:
+            if d['is_const']:
+                v = _ConstVar.__new__(_ConstVar)
+                Variable.__init__(v, block, d['name'], d['shape'],
+                                  d['dtype'], persistable=True)
+                v.value = jnp.asarray(arrays['const:' + d['name']])
+            elif d['is_parameter']:
+                v = Parameter(block, d['name'], d['shape'], d['dtype'],
+                              trainable=not d['stop_gradient'])
+            else:
+                v = Variable(block, d['name'], d['shape'], d['dtype'],
+                             persistable=d['persistable'],
+                             stop_gradient=d['stop_gradient'])
+            if d.get('init_from'):
+                v._init_from = d['init_from']
+            v.op_device = d.get('op_device', '')
+            block.vars[d['name']] = v
+            if d['persistable'] and not d['is_const']:
+                prog.startup_ops.append(v)
+
+        for d in bd['ops']:
+            d['attrs'] = {k: _decode_attr(v, attr_arrays)
+                          for k, v in d.get('attrs', {}).items()}
+            if d['kernel'] is not None:
+                fn = _kernel_fn(kernels[d['kernel']],
+                                d['multi_out'])
+            elif d.get('fallback') == 'control_flow':
+                fn = None       # executed via sub-block replay
+            elif d.get('fallback') == 'identity':
+                fn = lambda x: x                      # noqa: E731
+            else:
+                fn = lambda: None                     # noqa: E731
+            op = Operator(d['type'], fn, d['inputs'], d['outputs'],
+                          d['attrs'], op_role=d['op_role'])
+            op.op_device = d['op_device']
+            op.multi_out = d['multi_out']
+            block.append_op(op)
 
     prog._grad_map = dict(payload['grad_map'])
     prog._has_backward_ops = payload['has_backward_ops']

@@ -1,0 +1,188 @@
+"""Quantization slim-lite: fake-quant numerics, QAT, static pass golden,
+int8 export.
+
+Reference parity: test_fake_quantize_op.py (numpy-oracle op checks),
+test_quantization_pass.py (golden rewrite), test_imperative_qat.py
+(LeNet QAT accuracy survives), post_training_quantization int8 export.
+"""
+import os
+import tempfile
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu import quantization as Q
+
+
+def _t(a):
+    return Tensor(jnp.asarray(a))
+
+
+def np_qdq(a, s, bits=8):
+    bin_cnt = 2 ** (bits - 1) - 1
+    s = max(s, 1e-8)
+    return np.round(np.clip(a, -s, s) * (bin_cnt / s)) * (s / bin_cnt)
+
+
+class TestFakeQuantOps:
+    def test_abs_max_matches_numpy(self):
+        rng = np.random.RandomState(0)
+        a = (rng.randn(4, 6) * 3).astype('float32')
+        out, scale = Q.fake_quantize_dequantize_abs_max(_t(a))
+        s = np.max(np.abs(a))
+        np.testing.assert_allclose(float(scale), s, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(out.data), np_qdq(a, s),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_channel_wise_matches_numpy(self):
+        rng = np.random.RandomState(1)
+        a = (rng.randn(3, 5) * 2).astype('float32')
+        for axis in (0, 1):
+            out, scales = \
+                Q.fake_channel_wise_quantize_dequantize_abs_max(
+                    _t(a), quant_axis=axis)
+            s = np.max(np.abs(a), axis=1 - axis)
+            np.testing.assert_allclose(np.asarray(scales.data), s,
+                                       rtol=1e-6)
+            exp = np.stack([np_qdq(np.take(a, i, axis), s[i])
+                            for i in range(a.shape[axis])], axis=axis)
+            np.testing.assert_allclose(np.asarray(out.data), exp,
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_moving_average_state(self):
+        rng = np.random.RandomState(2)
+        a1 = rng.randn(8).astype('float32')
+        a2 = (rng.randn(8) * 2).astype('float32')
+        st = _t(np.zeros((), 'float32'))
+        out1, st1 = Q.fake_quantize_dequantize_moving_average_abs_max(
+            _t(a1), st, moving_rate=0.9)
+        # first batch: state was 0 → scale = cur
+        np.testing.assert_allclose(float(st1), np.max(np.abs(a1)),
+                                   rtol=1e-6)
+        out2, st2 = Q.fake_quantize_dequantize_moving_average_abs_max(
+            _t(a2), st1, moving_rate=0.9)
+        exp = 0.9 * float(st1) + 0.1 * np.max(np.abs(a2))
+        np.testing.assert_allclose(float(st2), exp, rtol=1e-6)
+        # eval mode: state unchanged
+        _, st3 = Q.fake_quantize_dequantize_moving_average_abs_max(
+            _t(a2), st2, training=False)
+        np.testing.assert_allclose(float(st3), float(st2))
+
+    def test_straight_through_gradient(self):
+        a = np.array([-5.0, -0.5, 0.2, 3.0], 'float32')
+        x = _t(a)
+        x.stop_gradient = False
+        out, scale = Q.fake_quantize_dequantize_abs_max(x)
+        loss = paddle.sum(out)
+        loss.backward()
+        # STE: all inside |x| <= s (s == 5) → grad ones
+        np.testing.assert_allclose(np.asarray(x.grad.data),
+                                   np.ones(4), rtol=1e-6)
+
+    def test_int8_roundtrip(self):
+        rng = np.random.RandomState(3)
+        a = (rng.randn(6, 4) * 1.7).astype('float32')
+        q, s = Q.quantize_to_int8(a, quant_axis=1)
+        assert q.dtype == np.int8
+        back = Q.dequantize_from_int8(q, s, quant_axis=1)
+        assert np.max(np.abs(back - a)) < np.max(np.abs(a)) / 100
+
+
+class TestStaticQuantPass:
+    def test_golden_rewrite(self):
+        import paddle_tpu.static as static
+        paddle.enable_static()
+        try:
+            main = static.Program()
+            with static.program_guard(main):
+                x = static.data('x', [4, 8])
+                y = static.nn.fc(x, 3)
+                out = paddle.mean(y)
+            before = [op.type for op in main.global_block().ops]
+            n = Q.QuantizationTransformPass().apply(main)
+            after = [op.type for op in main.global_block().ops]
+            # matmul_v2 has two float inputs (x, w) → 2 quant ops inserted
+            # immediately before it
+            assert n == 2
+            assert after.count('fake_quantize_dequantize_abs_max') == 2
+            mm = after.index('matmul_v2')
+            assert after[mm - 1] == 'fake_quantize_dequantize_abs_max'
+            assert after[mm - 2] == 'fake_quantize_dequantize_abs_max'
+            assert [t for t in after
+                    if t != 'fake_quantize_dequantize_abs_max'] == before
+            mm_op = next(op for op in main.global_block().ops
+                         if op.type == 'matmul_v2')
+            assert all(i.endswith('.quantized') for i in mm_op.input_names)
+            # rewritten program still executes
+            exe = static.Executor()
+            with static.scope_guard(static.Scope()):
+                r = exe.run(main,
+                            feed={'x': np.ones((4, 8), 'float32')},
+                            fetch_list=[out])
+            assert np.isfinite(r[0]).all()
+        finally:
+            paddle.disable_static()
+
+
+class TestQATLeNet:
+    def _data(self, n=256):
+        rng = np.random.RandomState(0)
+        # synthetic 2-class 'images': class mean patterns + noise
+        y = rng.randint(0, 2, n)
+        x = rng.randn(n, 1, 28, 28).astype('float32') * 0.3
+        x[y == 1, :, 7:21, 7:21] += 1.0
+        return x, y.astype('int64')
+
+    def _acc(self, model, x, y):
+        model.eval()
+        logits = model(_t(x))
+        pred = np.argmax(np.asarray(logits.data), -1)
+        model.train()
+        return float((pred == y).mean())
+
+    def test_lenet_qat_accuracy_survives(self):
+        from paddle_tpu.vision.models import LeNet
+        paddle.seed(0)
+        x, y = self._data()
+        model = LeNet(num_classes=2)
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=model.parameters())
+
+        def steps(k):
+            for i in range(k):
+                b = slice((i * 32) % 224, (i * 32) % 224 + 32)
+                loss = paddle.nn.functional.cross_entropy(
+                    model(_t(x[b])), _t(y[b]))
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+
+        steps(20)
+        acc_fp32 = self._acc(model, x, y)
+        assert acc_fp32 > 0.9
+        # QAT wrap + brief fine-tune
+        Q.ImperativeQuantAware().quantize(model)
+        steps(10)
+        acc_qat = self._acc(model, x, y)
+        assert acc_qat >= acc_fp32 - 0.05, (acc_fp32, acc_qat)
+
+    def test_int8_export_predictions_close(self):
+        from paddle_tpu.vision.models import LeNet
+        paddle.seed(1)
+        x, y = self._data(64)
+        model = LeNet(num_classes=2)
+        model.eval()
+        ref = np.asarray(model(_t(x[:8])).data)
+        d = tempfile.mkdtemp()
+        path = os.path.join(d, 'lenet_int8')
+        Q.export_quantized_layer(path, model, [_t(x[:8])])
+        pred = Q.load_quantized_predictor(path)
+        out = np.asarray(pred.run(_t(x[:8])))
+        # int8 weight quantization: predictions close, argmax identical
+        assert np.max(np.abs(out - ref)) < 0.15 * max(np.max(np.abs(ref)),
+                                                      1.0)
+        np.testing.assert_array_equal(np.argmax(out, -1),
+                                      np.argmax(ref, -1))
