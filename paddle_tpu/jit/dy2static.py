@@ -15,11 +15,15 @@ over the union of assigned names — exactly the reference's
 get_args/set_args convention (convert_operators.py convert_ifelse /
 convert_while_loop).
 
-Conversion is conservative: an `if` whose subtree contains return, or a
-loop containing break/continue/return, is left as Python control flow
-(fine for Python conditions; tensor conditions there raise jax's tracer
-error). Calls into other functions are not converted (the reference's
-convert_call dynamic conversion is future work).
+Conversion is conservative where it must be: an `if` whose subtree
+contains return inside a loop is left as Python control flow (fine for
+Python conditions; tensor conditions there raise jax's tracer error).
+break/continue lower to flag variables with guarded fall-through
+(break_continue_transformer.py parity), and every call site dispatches
+through convert_call so callee functions convert recursively
+(convert_call_func.py parity). Converted code executes against the
+function's LIVE globals — later rebinding of module names behaves
+exactly as in eager.
 """
 import ast
 import functools
@@ -251,10 +255,85 @@ def convert_ifelse(pred, true_fn, false_fn, get_state, set_state):
     set_state(_unflatten_state(td2, k2, out, s2))
 
 
-def convert_while_loop(cond_fn, body_fn, get_state, set_state):
+def _run_lax_while(cond_fn, body_fn, get_state, set_state):
+    """lax.while_loop over positionally-planned carry: non-static leaves
+    carry; static leaves must not change — EXCEPT leaves that start
+    UNDEFINED, which are loop-LOCALS (assigned-before-use temporaries,
+    the reference's loop-var liveness refinement): they are recomputed
+    each iteration, never carried, and read back as UNDEFINED after the
+    loop."""
+    leaves0, treedef = jax.tree_util.tree_flatten(
+        get_state(), is_leaf=lambda t: isinstance(t, Tensor))
+    n = len(leaves0)
+
+    def kind_of(lf):
+        if isinstance(lf, Tensor):
+            return 't'
+        if isinstance(lf, (jax.Array, jax.core.Tracer)):
+            return 'a'
+        if isinstance(lf, (bool, int, float, np.generic)) \
+                and not isinstance(lf, _UndefinedType):
+            return 'a'
+        return 's'
+
+    kinds0 = [kind_of(lf) for lf in leaves0]
+    carry_pos = [i for i in range(n) if kinds0[i] != 's']
+
+    def to_state(carry):
+        full = list(leaves0)
+        for j, i in enumerate(carry_pos):
+            full[i] = Tensor(carry[j]) if kinds0[i] == 't' else carry[j]
+        return jax.tree_util.tree_unflatten(treedef, full)
+
+    def extract_carry(leaves, tag):
+        out = []
+        for i in carry_pos:
+            lf = leaves[i]
+            k = kind_of(lf)
+            if k != kinds0[i]:
+                raise TypeError(
+                    f"dy2static {tag}: control-flow state changed kind "
+                    f"inside the loop ({kinds0[i]!r} → {k!r} at leaf "
+                    f"{i}: {lf!r}) — keep each variable's type stable "
+                    "across iterations")
+            out.append(lf.data if isinstance(lf, Tensor)
+                       else (jnp.asarray(lf)
+                             if not isinstance(lf, (jax.Array,
+                                                    jax.core.Tracer))
+                             else lf))
+        for i in range(n):
+            if kinds0[i] == 's' and leaves0[i] is not UNDEFINED:
+                _check_statics(tag, [leaves0[i]], [leaves[i]])
+        return out
+
+    carry0 = extract_carry(leaves0, 'while')
+
+    def cf(carry):
+        set_state(to_state(carry))
+        return to_bool(cond_fn())
+
+    def bf(carry):
+        set_state(to_state(carry))
+        body_fn()
+        leaves2, td2 = jax.tree_util.tree_flatten(
+            get_state(), is_leaf=lambda t: isinstance(t, Tensor))
+        if len(leaves2) != n or td2 != treedef:
+            _check_match('while', treedef, kinds0, td2,
+                         [kind_of(lf) for lf in leaves2])
+        return extract_carry(leaves2, 'while')
+
+    out = lax.while_loop(cf, bf, carry0)
+    set_state(to_state(out))
+
+
+def convert_while_loop(cond_fn, body_fn, get_state, set_state,
+                       has_jump=False):
     """Parity: convert_operators.convert_while_loop — lax.while_loop when
-    the condition is traced (NB: not reverse-differentiable under jax;
-    use lax.scan-style loops for training-path recurrences)."""
+    the condition is traced, Python loop otherwise (kept differentiable
+    by unrolling). For loops with lowered break/continue (has_jump) whose
+    STATE is traced, a traced branch can flip a jump flag mid-loop, so
+    those run as lax.while_loop from the start (not reverse-
+    differentiable — use python-condition jumps on training paths)."""
     from ..static.program import in_static_mode
     if in_static_mode() and _state_is_static(get_state()):
         # dispatch BEFORE evaluating cond_fn — a probe call would record
@@ -263,34 +342,23 @@ def convert_while_loop(cond_fn, body_fn, get_state, set_state):
     c0 = cond_fn()
     if _static_pred(c0):
         return _static_while(cond_fn, body_fn, get_state, set_state)
-    if not _is_traced(c0):
-        c = bool(np.asarray(_raw(c0)).reshape(()))
-        while c:
-            body_fn()
-            c = to_bool(cond_fn())
-            if isinstance(c, jax.core.Tracer):
-                raise TypeError(
-                    "dy2static while: condition became a traced tensor "
-                    "after the first iteration — make it a tensor from "
-                    "the start so the loop converts to lax.while_loop")
-        return
-    init = get_state()
-    treedef, kinds, carry0, statics0 = _flatten_state(init)
-
-    def cf(carry):
-        set_state(_unflatten_state(treedef, kinds, carry, statics0))
-        return to_bool(cond_fn())
-
-    def bf(carry):
-        set_state(_unflatten_state(treedef, kinds, carry, statics0))
+    if _is_traced(c0):
+        return _run_lax_while(cond_fn, body_fn, get_state, set_state)
+    if has_jump:
+        leaves0, _ = jax.tree_util.tree_flatten(
+            get_state(), is_leaf=lambda t: isinstance(t, Tensor))
+        if any(isinstance(_raw(lf), jax.core.Tracer) for lf in leaves0):
+            return _run_lax_while(cond_fn, body_fn, get_state, set_state)
+    c = bool(np.asarray(_raw(c0)).reshape(()))
+    while c:
         body_fn()
-        td2, k2, c2, s2 = _flatten_state(get_state())
-        _check_match('while', treedef, kinds, td2, k2)
-        _check_statics('while', statics0, s2)
-        return c2
-
-    out = lax.while_loop(cf, bf, carry0)
-    set_state(_unflatten_state(treedef, kinds, out, statics0))
+        c = to_bool(cond_fn())
+        if isinstance(c, jax.core.Tracer):
+            raise TypeError(
+                "dy2static while: condition became a traced tensor "
+                "after the first iteration — make it a tensor from "
+                "the start so the loop converts to lax.while_loop")
+    return
 
 
 def normalize_range(*args):
@@ -309,25 +377,31 @@ def range_cond(i, stop, step):
     return (i < stop) if step > 0 else (i > stop)
 
 
+def _as_bool_arr(v):
+    # mixed operands: one side may be a plain Python bool (e.g. a
+    # break/continue flag before any traced assignment touches it)
+    return jnp.asarray(_raw(v)).astype(bool)
+
+
 def convert_logical_and(lhs_fn, rhs_fn):
     l = lhs_fn()
     if _is_traced(l):
-        return Tensor(jnp.logical_and(_raw(l).astype(bool),
-                                      _raw(rhs_fn()).astype(bool)))
-    return l and rhs_fn()          # preserves Python operand semantics
+        return Tensor(jnp.logical_and(_as_bool_arr(l),
+                                      _as_bool_arr(rhs_fn())))
+    return l and rhs_fn()      # Python value semantics: rhs unchanged
 
 
 def convert_logical_or(lhs_fn, rhs_fn):
     l = lhs_fn()
     if _is_traced(l):
-        return Tensor(jnp.logical_or(_raw(l).astype(bool),
-                                     _raw(rhs_fn()).astype(bool)))
+        return Tensor(jnp.logical_or(_as_bool_arr(l),
+                                     _as_bool_arr(rhs_fn())))
     return l or rhs_fn()
 
 
 def convert_logical_not(x):
     if _is_traced(x):
-        return Tensor(jnp.logical_not(_raw(x).astype(bool)))
+        return Tensor(jnp.logical_not(_as_bool_arr(x)))
     return not x
 
 
@@ -395,12 +469,24 @@ class _HasUnsupported(ast.NodeVisitor):
     def visit_While(self, node):
         self._loop(node)
 
+    def visit_With(self, node):
+        self._other_block = getattr(self, '_other_block', 0) + 1
+        self.generic_visit(node)
+        self._other_block -= 1
+
+    visit_AsyncWith = visit_With
+    visit_Try = visit_With
+
     def visit_Break(self, node):
-        if self._loop_depth <= 1:
+        # lowerable to flag vars only when this check runs for the
+        # enclosing LOOP (depth >= 1) and the jump sits under plain If
+        # nesting; under With/Try (or when checking an If body directly,
+        # depth 0) the rewrite can't preserve semantics
+        if getattr(self, '_other_block', 0) or self._loop_depth == 0:
             self.found = True
 
     def visit_Continue(self, node):
-        if self._loop_depth <= 1:
+        if getattr(self, '_other_block', 0) or self._loop_depth == 0:
             self.found = True
 
     def visit_Global(self, node):
@@ -442,6 +528,78 @@ def _jst_call(fname, args):
     return ast.Call(
         func=ast.Attribute(value=_load('_jst'), attr=fname, ctx=ast.Load()),
         args=args, keywords=[])
+
+
+class _HasBreakContinue(ast.NodeVisitor):
+    """break/continue binding to THIS loop (not nested ones)."""
+
+    def __init__(self):
+        self.found = False
+
+    def visit_For(self, node):
+        pass
+
+    def visit_While(self, node):
+        pass
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def visit_Break(self, node):
+        self.found = True
+
+    def visit_Continue(self, node):
+        self.found = True
+
+
+def _has_break_continue(stmts):
+    v = _HasBreakContinue()
+    for st in stmts:
+        v.visit(st)
+    return v.found
+
+
+def _assign_const(name, value):
+    return ast.Assign(targets=[_store(name)], value=ast.Constant(value))
+
+
+def _lower_break_continue(stmts, brk, cont):
+    """Rewrite break/continue into flag assignments with guarded
+    fall-through (parity: break_continue_transformer.py). `break` sets
+    `brk`, `continue` sets `cont`; statements after a construct that may
+    have jumped are wrapped in `if not (brk or cont): ...` so both the
+    Python path and the traced lax.cond path skip them. The loop itself
+    adds `and not brk` to its condition and resets `cont` per iteration.
+
+    Returns (new_stmts, may_jump)."""
+    out = []
+    for idx, st in enumerate(stmts):
+        rest = stmts[idx + 1:]
+        if isinstance(st, ast.Break):
+            out.append(_assign_const(brk, True))
+            return out, True          # rest is dead
+        if isinstance(st, ast.Continue):
+            out.append(_assign_const(cont, True))
+            return out, True
+        if isinstance(st, ast.If) and (_has_break_continue([st])):
+            body2, bj = _lower_break_continue(st.body, brk, cont)
+            orelse2, oj = _lower_break_continue(st.orelse, brk, cont)
+            out.append(ast.If(test=st.test, body=body2,
+                              orelse=orelse2 or []))
+            rest2, rj = _lower_break_continue(rest, brk, cont)
+            if rest2:
+                # guard: if not (brk or cont): <rest>
+                guard = ast.UnaryOp(
+                    op=ast.Not(),
+                    operand=ast.BoolOp(op=ast.Or(),
+                                       values=[_load(brk), _load(cont)]))
+                out.append(ast.If(test=guard, body=rest2, orelse=[]))
+            return out, True
+        out.append(st)
+    return out, False
 
 
 class _ControlFlowTransformer(ast.NodeTransformer):
@@ -518,10 +676,32 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                                       call]
 
     # -- while -----------------------------------------------------------
-    def visit_While(self, node):
-        self.generic_visit(node)
+    def visit_While(self, node, extra_tail=None):
         if node.orelse or _unsupported(node.body, loop_level=True):
+            self.generic_visit(node)
             return node
+        pre = []
+        has_jump = False
+        if _has_break_continue(node.body):
+            has_jump = True
+            uid_bc = self._next()
+            brk = f'_ds_brk_{uid_bc}'
+            cont = f'_ds_cont_{uid_bc}'
+            body2, _ = _lower_break_continue(list(node.body), brk, cont)
+            tail = list(extra_tail or [])
+            node = ast.While(
+                test=ast.BoolOp(op=ast.And(), values=[
+                    ast.UnaryOp(op=ast.Not(), operand=_load(brk)),
+                    node.test]),
+                body=[_assign_const(cont, False)] + body2 + tail,
+                orelse=[])
+            pre = [_assign_const(brk, False), _assign_const(cont, False)]
+        elif extra_tail:
+            node = ast.While(test=node.test,
+                             body=list(node.body) + list(extra_tail),
+                             orelse=[])
+        ast.fix_missing_locations(node)
+        self.generic_visit(node)
         uid = self._next()
         names = _assigned_names(node.body)
         cond_fn = ast.FunctionDef(
@@ -531,9 +711,10 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         get_fn, set_fn = self._state_fns(uid, names)
         call = ast.Expr(value=_jst_call('convert_while_loop', [
             _load(cond_fn.name), _load(body_fn.name),
-            _load(get_fn.name), _load(set_fn.name)]))
-        return self._guards(names) + [cond_fn, body_fn, get_fn, set_fn,
-                                      call]
+            _load(get_fn.name), _load(set_fn.name),
+            ast.Constant(value=has_jump)]))
+        return pre + self._guards(names) + [cond_fn, body_fn, get_fn,
+                                            set_fn, call]
 
     # -- for range(...) ----------------------------------------------------
     def visit_For(self, node):
@@ -568,11 +749,14 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             targets=[_store(ctr)],
             value=ast.BinOp(left=_load(ctr), op=ast.Add(),
                             right=_load(step)))
+        # bump rides as extra_tail: with break/continue it must run
+        # OUTSIDE the lowered guards (continue still advances the
+        # induction var; break exits via the loop condition)
         loop = ast.While(
             test=_jst_call('range_cond',
                            [_load(ctr), _load(stop), _load(step)]),
-            body=[take] + list(node.body) + [bump], orelse=[])
-        loop_out = self.visit_While(loop)
+            body=[take] + list(node.body), orelse=[])
+        loop_out = self.visit_While(loop, extra_tail=[bump])
         if not isinstance(loop_out, list):
             loop_out = [loop_out]
         return [setup, init] + loop_out
@@ -595,6 +779,19 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             return _jst_call('convert_logical_not', [node.operand])
         return node
 
+    # -- calls -------------------------------------------------------------
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        f = node.func
+        # our own injected dispatchers stay bare
+        if isinstance(f, ast.Attribute) and                 isinstance(f.value, ast.Name) and f.value.id == '_jst':
+            return node
+        # super() must keep its zero-arg magic (cell access)
+        if isinstance(f, ast.Name) and f.id == 'super':
+            return node
+        return ast.Call(func=_jst_call('convert_call', [f]),
+                        args=node.args, keywords=node.keywords)
+
 
 def _no_args():
     return ast.arguments(posonlyargs=[], args=[], vararg=None,
@@ -615,6 +812,53 @@ def _no_args_lambda():
 def final_return(v):
     """The fall-off-the-end path returns None (Python semantics)."""
     return None if v is UNDEFINED else v
+
+
+_NO_CONVERT_MODULE_PREFIXES = ('paddle_tpu', 'jax', 'numpy', 'builtins',
+                               'functools', 'itertools', 'math', 'torch')
+
+
+import weakref
+
+_converted_fn_cache = weakref.WeakKeyDictionary()
+
+
+def convert_call(f):
+    """Parity: convert_call_func.py convert_call — recursively convert
+    callees at the call site. Framework/library callables pass through;
+    plain user functions and methods get the same AST conversion as the
+    entry function. Plain functions cache their converted form (keyed on
+    the function object, revalidated on closure-cell identity); bound
+    methods reconvert per call (the method object is fresh each access,
+    but the factory underneath is cached per code object)."""
+    if not callable(f):
+        return f
+    mod = getattr(f, '__module__', None) or ''
+    if any(mod == p or mod.startswith(p + '.')
+           for p in _NO_CONVERT_MODULE_PREFIXES):
+        return f
+    if inspect.isclass(f) or inspect.isbuiltin(f):
+        return f
+    if inspect.isfunction(f) and getattr(f, '__self__', None) is None:
+        cells = tuple(id(c) for c in (f.__closure__ or ()))
+        hit = _converted_fn_cache.get(f)
+        if hit is not None and hit[0] == cells:
+            return hit[1]
+        try:
+            conv = convert_function(f)
+        except Exception:
+            conv = f
+        try:
+            _converted_fn_cache[f] = (cells, conv)
+        except TypeError:
+            pass
+        return conv
+    if inspect.ismethod(f):
+        try:
+            return convert_function(f)
+        except Exception:
+            return f
+    return f
 
 
 class _ReturnInIf(ast.NodeVisitor):
@@ -756,8 +1000,16 @@ def _build_factory(fn):
     ast.fix_missing_locations(mod)
 
     import sys
-    glb = dict(fn.__globals__)
-    glb['_jst'] = sys.modules[__name__]
+    # the LIVE module globals, not a snapshot: later rebinding of a
+    # module-level name is visible to the converted function exactly as
+    # to the eager one (ADVICE r2; the reference resolves through the
+    # live function object). `_jst` is injected; on the (pathological)
+    # collision with a user global of that name we fall back to a copy.
+    ours = sys.modules[__name__]
+    glb = fn.__globals__
+    if glb.get('_jst', ours) is not ours:
+        glb = dict(fn.__globals__)
+    glb['_jst'] = ours
     try:
         code = compile(mod, filename=f'<dy2static {fn.__qualname__}>',
                        mode='exec')
