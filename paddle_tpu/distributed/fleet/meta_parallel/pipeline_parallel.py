@@ -89,11 +89,6 @@ class PipelineParallel(MetaParallelBase):
 
     def _train_batch_spmd(self, data, optimizer, lr_scheduler=None,
                           scaler=None):
-        if scaler is not None and scaler.is_enable():
-            raise NotImplementedError(
-                "GradScaler with pp_degree>1: bf16 training needs no loss "
-                "scaling on TPU; fp16 scaling inside the SPMD pipeline is "
-                "not implemented")
         from .spmd_pipeline import engine_from_pipeline_layer
         if self._spmd_engine is None:
             inner = getattr(optimizer, '_inner_opt', optimizer)
@@ -110,7 +105,19 @@ class PipelineParallel(MetaParallelBase):
                 f"batch size {n} != dp({dp}) x accumulate_steps"
                 f"({self.accumulate_steps}) x micro_batch_size"
                 f"({self.micro_batch_size}); adjust pipeline_configs")
-        loss = self._spmd_engine.train_batch(data)
+        if scaler is not None and scaler.is_enable():
+            # fp16 loss scaling through the pipeline (parity:
+            # hybrid_parallel_gradscaler.py): the engine scales the
+            # differentiated loss, unscales grads, skips the update on a
+            # global found_inf, and the scaler's dynamic schedule runs on
+            # the returned flag
+            loss = self._spmd_engine.train_batch(data,
+                                                 scale=scaler._scale)
+            scaler._found_inf = bool(
+                np.asarray(self._spmd_engine.last_found_inf))
+            scaler._update()
+        else:
+            loss = self._spmd_engine.train_batch(data)
         if lr_scheduler is not None:
             lr_scheduler.step()
         return loss

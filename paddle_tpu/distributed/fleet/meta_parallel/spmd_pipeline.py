@@ -186,6 +186,7 @@ class SpmdPipelineEngine:
             raise ValueError(f"unknown pipeline schedule {schedule!r}; "
                              "expected '1F1B' or 'F-then-B'")
         self.schedule = schedule
+        self._use_scaling = False     # fp16 GradScaler path (compile-time)
         self.mesh = mesh if mesh is not None else topology_runtime.get_mesh()
         if self.mesh is None:
             raise ValueError("no mesh registered")
@@ -296,10 +297,16 @@ class SpmdPipelineEngine:
             return out
         return stage_forward
 
-    def _reduce_and_update(self, params, states, loss, grads, lr, dp_on):
+    def _reduce_and_update(self, params, states, loss, grads, lr, dp_on,
+                           scale=None):
         """Cross-axis loss/grad reductions + optimizer update (both
         schedules): tied/replicated trees (embed, head) psum over pp;
-        everything pmeans over dp."""
+        everything pmeans over dp. With loss scaling, grads unscale here
+        and a non-finite gradient anywhere skips the whole update
+        (parity: check_finite_and_unscale + update_loss_scaling driven by
+        hybrid_parallel_gradscaler.py — found_inf is global after the
+        psum/pmean sync, since an inf on any rank infects the reduced
+        value)."""
         pp = self.pp
         if pp > 1:
             loss = lax.psum(loss, 'pp')  # only last stage ≠ 0
@@ -319,20 +326,43 @@ class SpmdPipelineEngine:
                  'blocks': sync(grads['blocks'], False),
                  'head': sync(grads['head'], True)}
 
+        found_inf = jnp.asarray(False)
+        if scale is not None:
+            leaves = jax.tree_util.tree_leaves(grads)
+            found_inf = jnp.any(jnp.stack(
+                [jnp.any(~jnp.isfinite(g)) for g in leaves]))
+            # block grads are stage-LOCAL (never psum'd over pp): an
+            # overflow on one stage must skip the update on ALL stages or
+            # the replicated embed/head trees desync — reduce the flag
+            # over pp (dp grads are already pmean'd, so dp ranks agree)
+            if pp > 1:
+                found_inf = lax.pmax(found_inf.astype(jnp.int32),
+                                     'pp') > 0
+            inv = (1.0 / scale).astype(jnp.float32)
+            grads = jax.tree_util.tree_map(
+                lambda g: (g.astype(jnp.float32) * inv).astype(g.dtype),
+                grads)
+
         new_params, new_states = {}, {}
         for grp in ('embed', 'blocks', 'head'):
             new_params[grp], new_states[grp] = {}, {}
             for n, p in params[grp].items():
                 np_, ns = self._update_one(
                     p, grads[grp][n], dict(states[grp][n]), lr)
+                if scale is not None:
+                    np_ = jnp.where(found_inf, p, np_)
+                    ns = jax.tree_util.tree_map(
+                        lambda new, old: jnp.where(found_inf, old, new),
+                        ns, dict(states[grp][n]))
                 new_params[grp][n] = np_
                 new_states[grp][n] = ns
-        return loss, new_params, new_states
+        return loss, new_params, new_states, found_inf
 
     def _finalize(self, step, dp_on):
         dp_sp = P('dp') if dp_on else P()
-        in_specs = (self._specs, self._state_specs, P(), P(), dp_sp, dp_sp)
-        out_specs = (P(), self._specs, self._state_specs)
+        in_specs = (self._specs, self._state_specs, P(), P(), P(), dp_sp,
+                    dp_sp)
+        out_specs = (P(), self._specs, self._state_specs, P())
         mapped = shard_map(step, mesh=self.mesh, in_specs=in_specs,
                            out_specs=out_specs, check_rep=False)
         return jax.jit(mapped, donate_argnums=(0, 1))
@@ -363,11 +393,12 @@ class SpmdPipelineEngine:
         embed, head = self.embed, self.head
         opt = self.optimizer
         dp_on = 'dp' in axes and self.mesh.shape['dp'] > 1
+        use_scaling = self._use_scaling
         B = min(A, 2 * pp - 1)
         T = A + 2 * (pp - 1)
         stage_forward = self._make_stage_forward()
 
-        def step(params, states, lr, key, input_ids, labels):
+        def step(params, states, lr, scale, key, input_ids, labels):
             with C.spmd_region(axes):
                 stage = lax.axis_index('pp') if pp > 1 else 0
                 is_last = stage == pp - 1
@@ -465,8 +496,10 @@ class SpmdPipelineEngine:
                         (pe, pb, ph), x_saved)
                     g_out = jnp.where(is_last, jnp.zeros_like(_out_p),
                                       grad_in.astype(_out_p.dtype))
-                    d_p3, dx = vjp_fn((g_out,
-                                       jnp.asarray(1.0 / A, jnp.float32)))
+                    cot = (scale / A).astype(jnp.float32) \
+                        if use_scaling else jnp.asarray(1.0 / A,
+                                                        jnp.float32)
+                    d_p3, dx = vjp_fn((g_out, cot))
                     gacc = jax.tree_util.tree_map(
                         lambda a, g: a + jnp.where(
                             b_active, g.astype(a.dtype),
@@ -490,7 +523,8 @@ class SpmdPipelineEngine:
                 grads = {'embed': gacc[0], 'blocks': gacc[1],
                          'head': gacc[2]}
                 return self._reduce_and_update(
-                    params, states, loss_sum / A, grads, lr, dp_on)
+                    params, states, loss_sum / A, grads, lr, dp_on,
+                    scale=scale if use_scaling else None)
 
         return self._finalize(step, dp_on)
 
@@ -499,9 +533,10 @@ class SpmdPipelineEngine:
         axes = self.axes
         embed, head = self.embed, self.head
         dp_on = 'dp' in axes and self.mesh.shape['dp'] > 1
+        use_scaling = self._use_scaling
         stage_forward = self._make_stage_forward()
 
-        def step(params, states, lr, key, input_ids, labels):
+        def step(params, states, lr, scale, key, input_ids, labels):
             with C.spmd_region(axes):
                 stage = lax.axis_index('pp') if pp > 1 else 0
                 mb = input_ids.shape[0] // A
@@ -586,9 +621,16 @@ class SpmdPipelineEngine:
                     # value_and_grad.
                     return loss_sum / A
 
-                loss, grads = jax.value_and_grad(loss_of)(params)
+                if use_scaling:
+                    loss, grads = jax.value_and_grad(
+                        lambda ps: loss_of(ps)
+                        * scale.astype(jnp.float32))(params)
+                    loss = loss / scale.astype(jnp.float32)
+                else:
+                    loss, grads = jax.value_and_grad(loss_of)(params)
                 return self._reduce_and_update(
-                    params, states, loss, grads, lr, dp_on)
+                    params, states, loss, grads, lr, dp_on,
+                    scale=scale if use_scaling else None)
 
         return self._finalize(step, dp_on)
 
@@ -609,19 +651,35 @@ class SpmdPipelineEngine:
         return np_.astype(p.dtype), ns
 
     # ------------------------------------------------------------------------
-    def train_batch(self, data):
-        """data = (input_ids, labels) covering dp_degree × A × micro_bs."""
+    def train_batch(self, data, scale=None):
+        """data = (input_ids, labels) covering dp_degree × A × micro_bs.
+        `scale`: optional loss-scaling factor (fp16 GradScaler path); the
+        step unscales grads, skips the update on non-finite gradients,
+        and records `self.last_found_inf` for the scaler's dynamic
+        update."""
         input_ids, labels = data
         ii = input_ids.data if isinstance(input_ids, Tensor) \
             else jnp.asarray(input_ids)
         ll = labels.data if isinstance(labels, Tensor) \
             else jnp.asarray(labels)
-        if self._compiled is None:
-            self._compiled = self._build()
+        want_scaling = scale is not None
+        if not hasattr(self, '_compiled_by_mode'):
+            self._compiled_by_mode = {}
+        if want_scaling != self._use_scaling or self._compiled is None:
+            self._use_scaling = want_scaling
+            # two-slot cache: alternating scaled/unscaled steps must not
+            # recompile the pipeline each switch
+            self._compiled = self._compiled_by_mode.get(want_scaling)
+            if self._compiled is None:
+                self._compiled = self._build()
+                self._compiled_by_mode[want_scaling] = self._compiled
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        sc = jnp.asarray(1.0 if scale is None else float(scale),
+                         jnp.float32)
         key = rng_mod.next_key()
-        loss, self._params, self._states = self._compiled(
-            self._params, self._states, lr, key, ii, ll)
+        loss, self._params, self._states, found = self._compiled(
+            self._params, self._states, lr, sc, key, ii, ll)
+        self.last_found_inf = found
         return Tensor(loss)
 
     def sync_model(self):

@@ -222,6 +222,9 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
             block.append_op(a)
         prog._grad_map[p.name] = gname
         params_grads.append((p, block.vars[gname]))
+    # full var→cotangent map (heter pass wires distributed_push off the
+    # lookup outputs' cotangents — trainer_pass append_send_ops role)
+    prog._var_grad_map = dict(grad_of)
     prog._has_backward_ops = True
     return params_grads
 

@@ -1,0 +1,253 @@
+"""Heterogeneous program split: host(PS) sparse segments vs TPU dense
+segments.
+
+Reference parity: incubate/fleet/parameter_server/ir/trainer_pass.py —
+find_heter_ops:441 (segment the program into device-contiguous op blocks
+by op_device) and create_heter_program:558 (carve the host segments out to
+run against the parameter-server tier), plus the HeterClient/HeterServer
+execution split (distributed/service/heter_server.h). heterPS pairs a CPU
+host (huge sparse tables) with an accelerator (dense towers); on TPU the
+same disaggregation pairs the host-resident `csrc/sparse_table.cc` tier
+with the jitted dense program.
+
+TPU-native design: ops recorded under `device_guard('cpu')` (and the
+distributed_lookup/distributed_push PS ops, which are born host-side)
+carry op_device='cpu'. `find_heter_ops` segments the op list;
+`HeterProgramRunner` replays device segments as cached jax.jit programs
+and host segments eagerly — distributed_lookup/push route to the PS
+worker (PsClient or an in-process table). `wire_sparse_grads` appends the
+push ops that carry each lookup output's cotangent back to the server
+(the reference's backward send — trainer_pass append_send_ops role).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtypes
+from .program import (Variable, Operator, OpRole, _ConstVar,
+                      default_main_program, run_op_in_env)
+
+DEVICE_LIST = ('tpu', 'cpu', 'gpu')
+_PS_OPS = ('distributed_lookup', 'distributed_push')
+
+
+# ---------------------------------------------------------------------------
+# recordable PS ops (host-side by construction)
+# ---------------------------------------------------------------------------
+
+def distributed_lookup(ids, table_id, dim, name=None):
+    """Record a host-side PS embedding lookup: ids [...] int → rows
+    [..., dim] (parity: distributed_lookup_table / pscore
+    distributed_lookup_table_op; execution happens in the runner via the
+    PS worker, never inside the jitted device program)."""
+    prog = default_main_program()
+    block = prog.current_block()
+    out_name = prog._unique_name('dist_lookup')
+    out = Variable(block, out_name, list(ids.shape) + [dim], 'float32',
+                   stop_gradient=False)
+    block.vars[out_name] = out
+    op = Operator('distributed_lookup', None, [ids.name], [out_name],
+                  {'table_id': int(table_id), 'dim': int(dim)})
+    op.op_device = 'cpu'
+    block.append_op(op)
+    return out
+
+
+def wire_sparse_grads(program, lr_name='@LR'):
+    """Post-backward pass: for every distributed_lookup whose output has a
+    gradient var, append a distributed_push op (op_device cpu, Backward
+    role) carrying that cotangent to the server — the reference's
+    append_send_ops half of the split. Returns the number of push ops."""
+    block = program.global_block()
+    grad_of = dict(getattr(program, '_var_grad_map', {}))
+    grad_of.update(getattr(program, '_grad_map', {}))
+    n = 0
+    pushes = []
+    for op in block.ops:
+        if op.type != 'distributed_lookup':
+            continue
+        gname = grad_of.get(op.output_names[0])
+        if gname is None or gname not in block.vars:
+            continue
+        push = Operator('distributed_push', None,
+                        [op.input_names[0], gname], [],
+                        {'table_id': op.attrs['table_id'],
+                         'dim': op.attrs['dim']},
+                        op_role=OpRole.Backward)
+        push.op_device = 'cpu'
+        pushes.append(push)
+        n += 1
+    block.ops.extend(pushes)
+    return n
+
+
+# ---------------------------------------------------------------------------
+# segmentation (find_heter_ops parity)
+# ---------------------------------------------------------------------------
+
+def find_heter_ops(program, default_device='tpu'):
+    """Segment the global block into device-contiguous op runs.
+
+    Returns (segments, heter_ops, default_ops) where segments is an
+    ordered [(device, [ops])] list and heter_ops/default_ops mirror the
+    reference's {device: {segment_index: [ops]}} summaries
+    (trainer_pass.py:441)."""
+    if default_device not in DEVICE_LIST:
+        raise ValueError(f"device {default_device} not in {DEVICE_LIST}")
+    segments = []
+    cur_dev, cur_ops = None, []
+    for op in program.global_block().ops:
+        dev = op.op_device or default_device
+        if op.type in _PS_OPS:
+            dev = 'cpu'
+        if dev != cur_dev and cur_ops:
+            segments.append((cur_dev, cur_ops))
+            cur_ops = []
+        cur_dev = dev
+        cur_ops.append(op)
+    if cur_ops:
+        segments.append((cur_dev, cur_ops))
+    heter_ops, default_ops = {}, {default_device: {}}
+    for i, (dev, ops) in enumerate(segments):
+        if dev == default_device:
+            default_ops[default_device][i] = ops
+        else:
+            heter_ops.setdefault(dev, {})[i] = ops
+    return segments, heter_ops, default_ops
+
+
+# ---------------------------------------------------------------------------
+# split execution
+# ---------------------------------------------------------------------------
+
+class HeterProgramRunner:
+    """Execute a heter-split program: host segments eagerly (PS ops via
+    the worker), device segments as cached jitted replays (parity: the
+    trainer side of HeterClient/HeterServer — heter_server.h — collapsed
+    into one process boundary: host python vs XLA program)."""
+
+    def __init__(self, program, ps, default_device='tpu'):
+        """ps: object with pull(table_id, ids, dim) -> np [n, dim] and
+        push(table_id, ids, grads, lr) (PsClient or an in-process
+        adapter)."""
+        self.program = program
+        self.ps = ps
+        self.segments, self.heter_ops, _ = find_heter_ops(
+            program, default_device)
+        self._jitted = {}
+        self.lr = 0.01
+
+    # -- host segment -------------------------------------------------------
+    def _run_host_op(self, op, env):
+        if op.type == 'distributed_lookup':
+            ids = np.asarray(env[op.input_names[0]])
+            rows = self.ps.pull(op.attrs['table_id'], ids.reshape(-1),
+                                op.attrs['dim'])
+            env[op.output_names[0]] = jnp.asarray(
+                rows.reshape(ids.shape + (op.attrs['dim'],)))
+        elif op.type == 'distributed_push':
+            ids = np.asarray(env[op.input_names[0]])
+            g = np.asarray(env[op.input_names[1]], np.float32)
+            self.ps.push(op.attrs['table_id'], ids.reshape(-1),
+                         g.reshape(-1, op.attrs['dim']), self.lr)
+        else:
+            run_op_in_env(op, env, self.program)
+
+    # -- device segment -----------------------------------------------------
+    def _segment_io(self, idx, ops):
+        """Input names the segment reads from outside itself; output names
+        it defines that later segments (or fetches) read."""
+        defined = set()
+        reads = []
+        for op in ops:
+            for nm in op.input_names:
+                if nm not in defined:
+                    reads.append(nm)
+            defined.update(op.output_names)
+        later_reads = set()
+        for _, later in self.segments[idx + 1:]:
+            for op in later:
+                later_reads.update(op.input_names)
+        persist = {v.name for v in self.program.list_vars()
+                   if getattr(v, 'persistable', False)}
+        outs = [nm for op in ops for nm in op.output_names
+                if nm in later_reads or nm in self._fetch_names
+                or nm in persist]
+        seen = set()
+        reads = [r for r in reads if not (r in seen or seen.add(r))]
+        seen = set()
+        outs = [o for o in outs if not (o in seen or seen.add(o))]
+        return reads, outs
+
+    def _run_device_segment(self, idx, ops, env):
+        key = idx
+        if key not in self._jitted:
+            reads, outs = self._segment_io(idx, ops)
+
+            def replay(in_arrays, _reads=tuple(reads), _outs=tuple(outs),
+                       _ops=tuple(ops)):
+                local = dict(zip(_reads, in_arrays))
+                for v in self.program.global_block().vars.values():
+                    if isinstance(v, _ConstVar):
+                        local[v.name] = v.value
+                for op in _ops:
+                    run_op_in_env(op, local, self.program)
+                return tuple(local[o] for o in _outs)
+            self._jitted[key] = (jax.jit(replay), reads, outs)
+        fn, reads, outs = self._jitted[key]
+        results = fn(tuple(jnp.asarray(env[r]) for r in reads))
+        env.update(zip(outs, results))
+
+    # -- public -------------------------------------------------------------
+    def run(self, feed, fetch_list, lr=None):
+        if lr is not None:
+            self.lr = lr
+        self._fetch_names = [f.name if isinstance(f, Variable) else str(f)
+                             for f in fetch_list]
+        env = {'@LR': jnp.asarray(self.lr, jnp.float32)}
+        for k, v in feed.items():
+            env[k] = jnp.asarray(v)
+        for v in self.program.global_block().vars.values():
+            if isinstance(v, _ConstVar):
+                env[v.name] = v.value
+        from .program import materialize_persistables
+        from .executor import global_scope
+        scope = global_scope()
+        materialize_persistables(self.program.list_vars(),
+                                 scope.find_var, scope.set)
+        for v in self.program.list_vars():
+            if getattr(v, 'persistable', False) \
+                    and not isinstance(v, _ConstVar):
+                arr = scope.find_var(v.name)
+                if arr is not None and v.name not in env:
+                    env[v.name] = arr
+
+        for idx, (dev, ops) in enumerate(self.segments):
+            if dev == 'cpu':
+                for op in ops:
+                    self._run_host_op(op, env)
+            else:
+                self._run_device_segment(idx, ops, env)
+
+        # persist updated persistables (optimizer state etc.)
+        for v in self.program.list_vars():
+            if getattr(v, 'persistable', False) \
+                    and not isinstance(v, _ConstVar) and v.name in env:
+                scope.set(v.name, env[v.name])
+        return [np.asarray(env[n]) for n in self._fetch_names]
+
+
+class InProcessPsAdapter:
+    """The runner's `ps` interface over an in-process NativeSparseTable —
+    the single-node heterPS shape (host tables + device towers in one
+    process), also the loss-parity oracle's table."""
+
+    def __init__(self, tables):
+        self.tables = dict(tables)
+
+    def pull(self, table_id, ids, dim):
+        return self.tables[table_id].pull(np.asarray(ids, np.int64))
+
+    def push(self, table_id, ids, grads, lr):
+        self.tables[table_id].push(np.asarray(ids, np.int64),
+                                   np.asarray(grads, np.float32), lr=lr)

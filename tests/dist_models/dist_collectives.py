@@ -1,0 +1,67 @@
+"""Per-collective 2-process checks over the host backend (parity:
+test_collective_base.py:32 — each collective verified against its
+definition from both ranks)."""
+import json
+import os
+import sys
+
+import jax
+jax.config.update('jax_platforms', 'cpu')
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import numpy as np                                    # noqa: E402
+import paddle_tpu as paddle                           # noqa: E402
+import paddle_tpu.distributed as dist                 # noqa: E402
+
+
+def main():
+    rank = int(os.environ['PADDLE_TRAINER_ID'])
+    ws = int(os.environ['PADDLE_TRAINERS_NUM'])
+    dist.init_parallel_env()
+    results = {}
+
+    # all_reduce sum / max
+    t = paddle.to_tensor(np.arange(4, dtype='float32') + rank * 10)
+    dist.all_reduce(t)
+    results['all_reduce_sum'] = np.asarray(t.data).tolist()
+
+    t = paddle.to_tensor(np.arange(4, dtype='float32') + rank * 10)
+    dist.all_reduce(t, op=dist.ReduceOp.MAX)
+    results['all_reduce_max'] = np.asarray(t.data).tolist()
+
+    # broadcast from rank 1
+    t = paddle.to_tensor(np.full((3,), float(rank), 'float32'))
+    dist.broadcast(t, src=1)
+    results['broadcast'] = np.asarray(t.data).tolist()
+
+    # all_gather
+    outs = []
+    t = paddle.to_tensor(np.asarray([float(rank), rank + 0.5], 'float32'))
+    dist.all_gather(outs, t)
+    results['all_gather'] = [np.asarray(o.data).tolist() for o in outs]
+
+    # reduce_scatter: each rank contributes [ws*k] rows, gets its slice
+    src = paddle.to_tensor(
+        (np.arange(ws * 2, dtype='float32') + rank).reshape(ws, 2))
+    out = paddle.to_tensor(np.zeros((2,), 'float32'))
+    dist.reduce_scatter(out, src)
+    results['reduce_scatter'] = np.asarray(out.data).reshape(-1).tolist()
+
+    # scatter from rank 0
+    if rank == 0:
+        parts = [paddle.to_tensor(np.full((2,), float(i + 1), 'float32'))
+                 for i in range(ws)]
+    else:
+        parts = None
+    t = paddle.to_tensor(np.zeros((2,), 'float32'))
+    dist.scatter(t, parts, src=0)
+    results['scatter'] = np.asarray(t.data).tolist()
+
+    dist.barrier()
+    print("RESULTS:" + json.dumps(results))
+
+
+if __name__ == '__main__':
+    main()

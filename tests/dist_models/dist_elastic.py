@@ -1,0 +1,57 @@
+"""Elastic scale-down drill (parity: elastic.py watch-loop tests): both
+ranks register heartbeats in the TCPStore; rank 1 exits mid-run; rank 0's
+watch tick flips HOLD → RESTART (scale event) and it reports the
+surviving membership."""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from paddle_tpu.core.native import TCPStore             # noqa: E402
+from paddle_tpu.distributed.fleet.elastic import (      # noqa: E402
+    ElasticManager, ElasticStatus)
+
+
+def main():
+    rank = int(os.environ['PADDLE_TRAINER_ID'])
+    master = os.environ['PADDLE_MASTER']
+    host, port = master.rsplit(':', 1)
+    hosts = ['127.0.0.1:7001', '127.0.0.1:7002']
+    os.environ['PADDLE_CURRENT_ENDPOINT'] = hosts[rank]
+    store = TCPStore(host, int(port), is_master=(rank == 0))
+    mgr = ElasticManager(store=store, job_id='drill', np_min=1,
+                         heartbeat_interval=0.2, dead_after=1.5)
+    mgr.register()
+
+    # both ranks wait until both heartbeats are visible
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if len(mgr.hosts(hosts)) == 2:
+            break
+        time.sleep(0.1)
+    assert mgr.watch(hosts) == ElasticStatus.HOLD
+
+    if rank == 1:
+        mgr.exit(completed=True)     # stop heartbeating and leave
+        print("RANK1_EXIT", flush=True)
+        return
+
+    # rank 0: wait for the scale-down signal
+    status = None
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        status = mgr.watch(hosts)
+        if status == ElasticStatus.RESTART:
+            break
+        time.sleep(0.2)
+    alive = mgr.hosts(hosts)
+    print("ELASTIC:" + json.dumps({'status': status, 'alive': alive}),
+          flush=True)
+    mgr.exit()
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,26 @@
+"""PS server process for the 2-trainer+1-server subprocess drill (parity:
+the server half of test_dist_fleet_ps tests). Hosts one sparse embedding
+table + one dense table; announces its port through stdout; serves until
+killed."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from paddle_tpu.distributed.ps.service import PsServer   # noqa: E402
+
+
+def main():
+    srv = PsServer(port=int(os.environ.get('PS_PORT', '0')))
+    srv.add_table(0, 8, optimizer='adagrad', seed=3)
+    srv.add_dense_table(1, 4, optimizer='sgd')
+    srv.start()
+    print(f"PORT:{srv.port}", flush=True)
+    while True:
+        time.sleep(0.2)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,41 @@
+"""PS trainer process: 2 trainers share one server, training an
+embedding-sum regression through PsClient pull/push (parity: the trainer
+half of the dist fleet PS convergence tests). Prints its loss curve."""
+import json
+import os
+import sys
+
+import jax
+jax.config.update('jax_platforms', 'cpu')
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import numpy as np                                    # noqa: E402
+from paddle_tpu.distributed.ps.service import PsClient  # noqa: E402
+
+
+def main():
+    rank = int(os.environ['PADDLE_TRAINER_ID'])
+    endpoint = os.environ['PS_ENDPOINT']
+    client = PsClient([endpoint])
+
+    dim = 8
+    rng = np.random.RandomState(100 + rank)
+    # fixed ground truth shared by both trainers
+    w_true = np.random.RandomState(0).rand(32, dim).astype('float32')
+
+    losses = []
+    for step in range(60):
+        ids = rng.randint(0, 32, (16,)).astype(np.int64)
+        rows = client.pull(0, ids, dim)            # [16, dim]
+        target = w_true[ids]
+        err = rows - target
+        losses.append(float((err * err).mean()))
+        client.push(0, ids, 2.0 * err / err.size * len(ids), lr=0.5)
+    print("LOSSES:" + json.dumps(losses), flush=True)
+    client.close()
+
+
+if __name__ == '__main__':
+    main()

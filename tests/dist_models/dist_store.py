@@ -1,0 +1,37 @@
+"""TCPStore cross-process KV drill (parity: test_gen_comm_id /
+gloo-store tests): set/get/add/wait across 2 processes."""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from paddle_tpu.core.native import TCPStore             # noqa: E402
+
+
+def main():
+    rank = int(os.environ['PADDLE_TRAINER_ID'])
+    master = os.environ['PADDLE_MASTER']
+    host, port = master.rsplit(':', 1)
+    store = TCPStore(host, int(port), is_master=(rank == 0))
+    results = {}
+    if rank == 0:
+        store.set('k0', 'hello-from-0')
+        v = store.get('k1')                  # blocks until rank1 sets
+        results['peer_value'] = v.decode()
+    else:
+        v = store.get('k0')
+        results['peer_value'] = v.decode()
+        store.set('k1', 'hello-from-1')
+    total = store.add('counter', rank + 1)   # 1 + 2 in some order
+    results['add_seen'] = int(total)
+    # rendezvous: both wait for both marks
+    store.set(f'done{rank}', 'x')
+    store.get(f'done{1 - rank}')
+    results['final_counter'] = int(store.add('counter', 0))
+    print("RESULTS:" + json.dumps(results), flush=True)
+
+
+if __name__ == '__main__':
+    main()

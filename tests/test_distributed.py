@@ -291,7 +291,8 @@ class TestSpmdPipeline:
                               jnp.int32)
             comp = eng._build().lower(
                 eng._params, eng._states, jnp.asarray(0.01, jnp.float32),
-                jax.random.PRNGKey(0), ids, ids).compile()
+                jnp.asarray(1.0, jnp.float32), jax.random.PRNGKey(0),
+                ids, ids).compile()
             return comp.memory_analysis().temp_size_in_bytes
 
         one_8, one_32 = temp_bytes('1F1B', 8), temp_bytes('1F1B', 32)
@@ -630,3 +631,116 @@ class TestPipelineLayerSpmd:
         with _pt.raises(NotImplementedError):
             m2.train_batch((Tensor(ids), Tensor(lab)), opt)
         fm.fleet._hcg = None
+
+
+class TestPipelineGradScaler:
+    """fp16 GradScaler through the SPMD pipeline engine (VERDICT r2 #10;
+    parity: hybrid_parallel_gradscaler.py — found_inf psum'd inside the
+    step, update skipped, dynamic scale driven by the flag)."""
+
+    def _setup(self, pp=2):
+        from paddle_tpu.models.gpt import GPTConfig, build_gpt_pipeline
+        from paddle_tpu.distributed.fleet.meta_parallel.spmd_pipeline \
+            import SpmdPipelineEngine
+        import paddle_tpu.distributed.fleet as fleet_mod
+        fleet_mod.fleet._hcg = None
+        paddle.seed(5)
+        config = GPTConfig(vocab_size=64, hidden_size=16, num_layers=2,
+                           num_heads=2, max_seq_len=32, hidden_dropout=0.0,
+                           attn_dropout=0.0, use_flash_attention=False)
+        topology_runtime.build_mesh(['dp', 'pp'], [1, pp])
+        embed, blocks, head = build_gpt_pipeline(config)
+        opt = paddle.optimizer.SGD(learning_rate=1e-2, parameters=[])
+        eng = SpmdPipelineEngine(embed, blocks, head, opt,
+                                 accumulate_steps=2, use_remat=False,
+                                 schedule='1F1B')
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 64, (2, 32)).astype('int32')
+        labels = np.roll(ids, -1, 1).astype('int32')
+        return eng, (Tensor(ids), Tensor(labels))
+
+    def test_scaled_step_matches_unscaled(self):
+        eng, data = self._setup()
+        l0 = float(eng.train_batch(data, scale=1024.0))
+        assert not bool(np.asarray(eng.last_found_inf))
+        eng2, data2 = self._setup()
+        l0u = float(eng2.train_batch(data2))
+        np.testing.assert_allclose(l0, l0u, rtol=1e-4)
+        # second scaled step: loss decreased (update actually applied,
+        # grads correctly unscaled)
+        l1 = float(eng.train_batch(data, scale=1024.0))
+        l1u = float(eng2.train_batch(data2))
+        np.testing.assert_allclose(l1, l1u, rtol=1e-3)
+        assert l1 < l0
+
+    def test_overflow_skips_update_and_scaler_backs_off(self):
+        from paddle_tpu.amp import GradScaler
+        import jax.numpy as jnp
+        eng, data = self._setup()
+        # poison one embed param with NaN: grads go non-finite, which is
+        # exactly what found_inf must catch and the update must skip
+        name = next(iter(eng._params['embed']))
+        eng._params['embed'][name] = (eng._params['embed'][name]
+                                      * jnp.nan)
+        params_before = {n: np.asarray(v)
+                         for n, v in eng._params['head'].items()}
+        loss = eng.train_batch(data, scale=1024.0)
+        assert bool(np.asarray(eng.last_found_inf))
+        for n, v in eng._params['head'].items():
+            np.testing.assert_array_equal(np.asarray(v),
+                                          params_before[n])
+        # the scaler's dynamic schedule consumes the flag
+        scaler = GradScaler(init_loss_scaling=1024.0,
+                            decr_every_n_nan_or_inf=1)
+        scaler._found_inf = bool(np.asarray(eng.last_found_inf))
+        scaler._update()
+        assert scaler._scale < 1024.0
+
+    def test_pipeline_layer_train_batch_with_scaler(self):
+        """The PipelineParallel FRONT-END drives the scaler end-to-end
+        through _train_batch_spmd (the r2 NotImplementedError is gone):
+        train_batch(data, optimizer, scaler=...) scales/unscales inside
+        the engine and feeds the scaler's dynamic schedule."""
+        from paddle_tpu.amp import GradScaler
+        from paddle_tpu.models.gpt import (GPTConfig, GPTEmbeddings,
+                                           GPTDecoderLayer, GPTLMHead)
+        import paddle_tpu.distributed.fleet as fm
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            LayerDesc, PipelineLayer, PipelineParallel)
+        from paddle_tpu.distributed.fleet.base.topology import (
+            CommunicateTopology, HybridCommunicateGroup)
+        old_hcg = fm.fleet._hcg
+        try:
+            topo = CommunicateTopology(
+                hybrid_group_names=['data', 'pipe', 'sharding', 'model'],
+                dims=[1, 2, 1, 1])
+            fm.fleet._hcg = HybridCommunicateGroup(topo)
+            topology_runtime.build_mesh(['dp', 'pp'], [1, 2])
+            paddle.seed(6)
+            config = GPTConfig(vocab_size=64, hidden_size=16,
+                               num_layers=2, num_heads=2, max_seq_len=32,
+                               hidden_dropout=0.0, attn_dropout=0.0,
+                               use_flash_attention=False)
+            head = GPTLMHead(config)
+            descs = ([LayerDesc(GPTEmbeddings, config)]
+                     + [LayerDesc(GPTDecoderLayer, config)
+                        for _ in range(2)])
+            pipe = PipelineLayer(descs, loss_fn=head)
+            model = PipelineParallel(pipe, fm.fleet._hcg, strategy=None)
+            model.accumulate_steps = 2
+            model.micro_batch_size = 1
+            opt = paddle.optimizer.SGD(learning_rate=1e-2, parameters=[])
+            scaler = GradScaler(init_loss_scaling=256.0,
+                                incr_every_n_steps=2)
+            rng = np.random.RandomState(1)
+            ids = rng.randint(0, 64, (2, 32)).astype('int32')
+            labels = np.roll(ids, -1, 1).astype('int32')
+            losses = [
+                float(model.train_batch((Tensor(ids), Tensor(labels)),
+                                        opt, scaler=scaler))
+                for _ in range(3)]
+            assert losses[-1] < losses[0]
+            assert scaler._scale >= 256.0       # grew (no infs)
+            assert not scaler._found_inf
+        finally:
+            fm.fleet._hcg = old_hcg

@@ -1,0 +1,138 @@
+"""Heter program split: host sparse segments + TPU dense segments
+(VERDICT r2 #7).
+
+Reference parity: trainer_pass.py find_heter_ops:441 segmentation tests +
+the heterPS wide&deep convergence pattern — the split run must be
+loss-IDENTICAL to the monolithic model (same math, different placement).
+"""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+from paddle_tpu.static import heter_pass as H
+from paddle_tpu.static.program import Parameter, device_guard
+from paddle_tpu.static.backward import append_backward
+from paddle_tpu.core.native import NativeSparseTable
+
+
+@pytest.fixture(autouse=True)
+def _static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+
+
+VOCAB, DIM = 50, 8
+
+
+def _build_split_program():
+    main = static.Program()
+    with static.program_guard(main):
+        ids = static.data('ids', [16], dtype='int64')
+        dense_x = static.data('dense_x', [16, 4])
+        label = static.data('label', [16, 1])
+        emb = H.distributed_lookup(ids, table_id=0, dim=DIM)   # host
+        h = static.nn.fc(paddle.concat([emb, dense_x], axis=1), 16,
+                         activation='relu')
+        pred = static.nn.fc(h, 1)
+        loss = paddle.mean((pred - label) * (pred - label))
+    return main, emb, loss
+
+
+class TestSegmentation:
+    def test_find_heter_ops_segments_by_device(self):
+        main, emb, loss = _build_split_program()
+        segments, heter_ops, default_ops = H.find_heter_ops(main)
+        devs = [d for d, _ in segments]
+        assert devs[0] == 'cpu'            # lookup opens a host segment
+        assert 'tpu' in devs               # dense tower on device
+        assert 'cpu' in heter_ops
+        assert all(op.type == 'distributed_lookup'
+                   for op in heter_ops['cpu'][0])
+
+    def test_wire_sparse_grads_appends_push(self):
+        main, emb, loss = _build_split_program()
+        params = main.all_parameters()
+        append_backward(loss, parameter_list=params + [emb])
+        n = H.wire_sparse_grads(main)
+        assert n == 1
+        push = [op for op in main.global_block().ops
+                if op.type == 'distributed_push']
+        assert len(push) == 1
+        assert push[0].op_device == 'cpu'
+        assert push[0].input_names[0] == 'ids'
+
+
+class TestLossParity:
+    def _data(self, steps=15, seed=0):
+        rng = np.random.RandomState(seed)
+        w_emb = (rng.rand(VOCAB, DIM).astype('float32') - 0.5) * 0.2
+        batches = []
+        for _ in range(steps):
+            ids = rng.randint(0, VOCAB, (16,)).astype('int64')
+            dense = rng.rand(16, 4).astype('float32')
+            label = rng.rand(16, 1).astype('float32')
+            batches.append((ids, dense, label))
+        return w_emb, batches
+
+    def test_split_matches_monolithic(self):
+        """wide_deep-style model end-to-end through the heter split ==
+        the monolithic model, step for step (SGD both sides)."""
+        lr = 0.1
+        w_emb, batches = self._data()
+
+        # ---- split run: PS table (host) + jitted dense tower ----------
+        paddle.seed(42)
+        main, emb, loss = _build_split_program()
+        params = main.all_parameters()
+        pg = append_backward(loss, parameter_list=params + [emb])
+        opt = paddle.optimizer.SGD(learning_rate=lr)
+        main._optimizer = opt
+        opt._append_optimize_ops(
+            main, [(p, g) for p, g in pg if isinstance(p, Parameter)])
+        H.wire_sparse_grads(main)
+
+        table = NativeSparseTable(DIM, optimizer='sgd', seed=9)
+        table.set(np.arange(VOCAB, dtype=np.int64), w_emb)
+        runner = H.HeterProgramRunner(
+            main, H.InProcessPsAdapter({0: table}))
+        scope = static.Scope()
+        split_losses = []
+        with static.scope_guard(scope):
+            for ids, dense, label in batches:
+                out = runner.run({'ids': ids, 'dense_x': dense,
+                                  'label': label}, [loss], lr=lr)
+                split_losses.append(float(out[0]))
+
+        # ---- monolithic oracle: same params, in-process embedding -----
+        paddle.seed(42)          # identical dense init
+        mono = static.Program()
+        with static.program_guard(mono):
+            ids_v = static.data('ids', [16], dtype='int64')
+            dense_x = static.data('dense_x', [16, 4])
+            label_v = static.data('label', [16, 1])
+            emb_p = mono.global_block().create_parameter(
+                name='emb_w', shape=[VOCAB, DIM], dtype='float32')
+            emb_v = paddle.gather(emb_p, ids_v)
+            h = static.nn.fc(paddle.concat([emb_v, dense_x], axis=1), 16,
+                             activation='relu')
+            pred = static.nn.fc(h, 1)
+            loss_m = paddle.mean((pred - label_v) * (pred - label_v))
+            opt_m = paddle.optimizer.SGD(learning_rate=lr)
+            opt_m.minimize(loss_m)
+        exe = static.Executor()
+        scope_m = static.Scope()
+        mono_losses = []
+        with static.scope_guard(scope_m):
+            scope_m.set('emb_w', jnp.asarray(w_emb))
+            for ids, dense, label in batches:
+                r = exe.run(mono, feed={'ids': ids, 'dense_x': dense,
+                                        'label': label},
+                            fetch_list=[loss_m])
+                mono_losses.append(float(r[0]))
+
+        np.testing.assert_allclose(split_losses, mono_losses, rtol=2e-4,
+                                   atol=1e-6)
+        assert split_losses[-1] < split_losses[0]   # actually trains
