@@ -1,0 +1,240 @@
+"""Remaining tier-2/3 op families (fluid.layers surface).
+
+Reference parity: operators/ nce_op.cc, hierarchical_sigmoid_op.cc,
+unpool_op.cc, im2sequence_op.cc, spp_op.cc, row_conv_op.cc,
+spectral_norm_op.cc (VERDICT r2 missing #1 / Appendix B remainder).
+
+TPU-native: each op is one fixed-shape jnp program — candidate sampling
+uses the functional RNG stream; hsigmoid walks the complete binary tree
+with a static-length (ceil(log2 C)) vectorized path instead of the
+reference's per-sample host loops; im2sequence rides
+conv_general_dilated_patches (the MXU-friendly patch extractor).
+"""
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .common import as_tensor
+from ..core import rng
+from ..core.autograd import run_op
+
+
+def nce(input, label, num_total_classes, weight, bias=None,
+        num_neg_samples=5, sampler='uniform', name=None):
+    """Parity: operators/nce_op.cc — noise-contrastive estimation loss.
+    input [N, D], label [N] or [N, 1] int, weight [C, D], bias [C] →
+    cost [N, 1]. Negatives drawn per batch from the uniform sampler (the
+    reference's default); loss = -log σ(s_pos) − Σ_neg log σ(−s_neg)."""
+    if sampler != 'uniform':
+        raise NotImplementedError(f"nce sampler {sampler!r} (uniform only)")
+    input, label, weight = (as_tensor(input), as_tensor(label),
+                            as_tensor(weight))
+    tensors = [input, weight]
+    has_bias = bias is not None
+    if has_bias:
+        tensors.append(as_tensor(bias))
+    tensors.append(label)
+    key = rng.next_key()
+    k_neg = int(num_neg_samples)
+
+    def fn(*args):
+        x, w = args[0], args[1]
+        b = args[2] if has_bias else None
+        lb = args[-1].reshape(-1).astype(jnp.int32)
+        N = x.shape[0]
+        neg = jax.random.randint(key, (N, k_neg), 0, num_total_classes)
+        pos_w = w[lb]                                   # [N, D]
+        s_pos = jnp.sum(x * pos_w, -1)                  # [N]
+        neg_w = w[neg]                                  # [N, k, D]
+        s_neg = jnp.einsum('nd,nkd->nk', x, neg_w)
+        if b is not None:
+            s_pos = s_pos + b[lb]
+            s_neg = s_neg + b[neg]
+        # sample-prob correction (uniform q = k/C, nce_op.cc):
+        logq = jnp.log(jnp.asarray(k_neg / num_total_classes,
+                                   jnp.float32))
+        pos = jax.nn.log_sigmoid(s_pos - logq)
+        negl = jax.nn.log_sigmoid(-(s_neg - logq)).sum(-1)
+        return (-(pos + negl))[:, None]
+    return run_op('nce', fn, tensors, n_nondiff=1)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Parity: operators/hierarchical_sigmoid_op.cc — complete-binary-tree
+    hierarchical softmax. input [N, D], label [N], weight [C-1, D],
+    bias [C-1] → loss [N, 1]. Custom trees via path_table/path_code
+    [N, L] (MatchTableByPath role)."""
+    input, label, weight = (as_tensor(input), as_tensor(label),
+                            as_tensor(weight))
+    tensors = [input, weight]
+    has_bias = bias is not None
+    if has_bias:
+        tensors.append(as_tensor(bias))
+    tensors.append(label)
+    custom = path_table is not None
+    if custom:
+        tensors.append(as_tensor(path_table))
+        tensors.append(as_tensor(path_code))
+    L = int(math.ceil(math.log2(max(num_classes, 2))))
+
+    def fn(*args):
+        x, w = args[0], args[1]
+        b = args[2] if has_bias else None
+        if custom:
+            lb = args[-3].reshape(-1).astype(jnp.int32)
+            table = args[-2].astype(jnp.int32)          # [N, L]
+            code = args[-1].astype(jnp.float32)         # [N, L]
+            valid = (table >= 0).astype(jnp.float32)
+            nodes = jnp.maximum(table, 0)
+        else:
+            lb = args[-1].reshape(-1).astype(jnp.int32)
+            # complete binary tree: leaf id = label + C; walk to the root
+            # (node 1); internal node n stores row n-1
+            node = lb + num_classes
+            nodes_l, codes_l = [], []
+            for _ in range(L):
+                parent = node // 2
+                codes_l.append((node % 2).astype(jnp.float32))
+                nodes_l.append(parent - 1)
+                node = parent
+            nodes = jnp.stack(nodes_l, 1)               # [N, L]
+            code = jnp.stack(codes_l, 1)
+            valid = (nodes + 1 >= 1).astype(jnp.float32) \
+                * (nodes + 1 <= num_classes - 1).astype(jnp.float32)
+            nodes = jnp.clip(nodes, 0, max(num_classes - 2, 0))
+        wr = w[nodes]                                   # [N, L, D]
+        logits = jnp.einsum('nd,nld->nl', x, wr)
+        if b is not None:
+            logits = logits + b[nodes]
+        # BCE against the path code: -[c·log σ(z) + (1−c)·log σ(−z)]
+        loss = -(code * jax.nn.log_sigmoid(logits)
+                 + (1 - code) * jax.nn.log_sigmoid(-logits))
+        return jnp.sum(loss * valid, -1, keepdims=True)
+    return run_op('hierarchical_sigmoid', fn, tensors,
+                  n_nondiff=(3 if custom else 1))
+
+
+def unpool(x, indices, kernel_size, stride=None, padding=0,
+           output_size=None, data_format='NCHW', name=None):
+    """Parity: operators/unpool_op.cc — max-unpool2d: scatter each pooled
+    value back to the argmax position recorded by max_pool2d
+    (return_mask=True). indices are flat per-channel-map positions."""
+    x, indices = as_tensor(x), as_tensor(indices)
+    ks = kernel_size if isinstance(kernel_size, (list, tuple)) \
+        else (kernel_size, kernel_size)
+    st = stride if stride is not None else ks
+    st = st if isinstance(st, (list, tuple)) else (st, st)
+    pd = padding if isinstance(padding, (list, tuple)) \
+        else (padding, padding)
+
+    def fn(a, idx):
+        N, C, H, W = a.shape
+        if output_size is not None:
+            Ho, Wo = output_size[-2], output_size[-1]
+        else:
+            Ho = (H - 1) * st[0] - 2 * pd[0] + ks[0]
+            Wo = (W - 1) * st[1] - 2 * pd[1] + ks[1]
+        flat = jnp.zeros((N, C, Ho * Wo), a.dtype)
+        ii = idx.reshape(N, C, H * W).astype(jnp.int32)
+        out = flat.at[
+            jnp.arange(N)[:, None, None],
+            jnp.arange(C)[None, :, None], ii].set(
+                a.reshape(N, C, H * W))
+        return out.reshape(N, C, Ho, Wo)
+    return run_op('unpool', fn, [x, indices], n_nondiff=1)
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, name=None):
+    """Parity: operators/im2sequence_op.cc — sliding k×k patches become a
+    sequence: [N, C, H, W] → [N * out_h * out_w, C * kh * kw] (row-major
+    over output positions, the LoD the reference emits becomes the
+    leading dim factorization)."""
+    input = as_tensor(input)
+    ks = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size, filter_size)
+    st = stride if isinstance(stride, (list, tuple)) else (stride, stride)
+    pd = padding if isinstance(padding, (list, tuple)) \
+        else (padding, padding, padding, padding)
+    if len(pd) == 2:
+        pd = (pd[0], pd[0], pd[1], pd[1])
+
+    def fn(a):
+        N, C = a.shape[0], a.shape[1]
+        patches = lax.conv_general_dilated_patches(
+            a, ks, st, [(pd[0], pd[1]), (pd[2], pd[3])],
+            dimension_numbers=('NCHW', 'OIHW', 'NCHW'),
+            precision=lax.Precision.HIGHEST)   # exact on TPU (bf16 default
+        #                                        would round the values)
+        # [N, C*kh*kw, oh, ow] → [N*oh*ow, C*kh*kw]
+        Np, CK, oh, ow = patches.shape
+        return patches.transpose(0, 2, 3, 1).reshape(N * oh * ow, CK)
+    return run_op('im2sequence', fn, [input])
+
+
+def spp(input, pyramid_height=3, pool_type='max', name=None):
+    """Parity: operators/spp_op.cc — spatial pyramid pooling: levels
+    l=0..h-1 adaptively pool to 2^l x 2^l bins; concat flattened bins →
+    [N, C * Σ 4^l]."""
+    from . import nn_ops as F
+    input = as_tensor(input)
+    outs = []
+    for l in range(pyramid_height):
+        bins = 2 ** l
+        if pool_type == 'max':
+            p = F.adaptive_max_pool2d(input, bins)
+        else:
+            p = F.adaptive_avg_pool2d(input, bins)
+        from . import manip
+        outs.append(manip.reshape(p, [p.shape[0], -1]))
+    from . import manip
+    return manip.concat(outs, axis=1)
+
+
+def row_conv(input, weight, name=None):
+    """Parity: operators/row_conv_op.cc — lookahead (row) convolution for
+    streaming models: out[:, t] = Σ_{i<k, t+i<T} x[:, t+i] * w[i].
+    input [N, T, D], weight [k, D]."""
+    input, weight = as_tensor(input), as_tensor(weight)
+
+    def fn(a, w):
+        k = w.shape[0]
+        T = a.shape[1]
+        out = jnp.zeros_like(a)
+        for i in range(k):
+            seg = a[:, i:, :] * w[i][None, None, :]
+            out = out.at[:, :T - i, :].add(seg)
+        return out
+    return run_op('row_conv', fn, [input, weight])
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, u=None, v=None,
+                  name=None):
+    """Parity: operators/spectral_norm_op.cc — normalize the weight by its
+    largest singular value via `power_iters` rounds of power iteration
+    (fresh-start u when no state is passed, like the op's Input(U))."""
+    weight = as_tensor(weight)
+    tensors = [weight]
+    if u is not None:
+        tensors.append(as_tensor(u))
+    key = rng.next_key()
+
+    def fn(*args):
+        w = args[0]
+        mat = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+        h, wdim = mat.shape
+        uu = args[1].reshape(h) if len(args) > 1 else \
+            jax.random.normal(key, (h,), jnp.float32)
+        vv = None
+        for _ in range(max(power_iters, 1)):
+            vv = mat.T @ uu
+            vv = vv / (jnp.linalg.norm(vv) + eps)
+            uu = mat @ vv
+            uu = uu / (jnp.linalg.norm(uu) + eps)
+        sigma = uu @ mat @ vv
+        return w / sigma
+    return run_op('spectral_norm', fn, tensors)

@@ -482,14 +482,45 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
     pad = _conv_padding(padding, k, s, (1, 1))
     pads = pad if isinstance(pad, str) else [(0, 0), (0, 0)] + list(pad)
 
-    def fn(a):
-        return jax.lax.reduce_window(a, -jnp.inf, jax.lax.max,
-                                     (1, 1) + k, (1, 1) + s, pads)
-    out = run_op('pool2d_max', fn, [x])
-    if return_mask:
-        idx = Tensor(jnp.zeros(out.shape, jnp.int32))  # mask indices: placeholder
-        return out, idx
-    return out
+    if not return_mask:
+        def fn(a):
+            return jax.lax.reduce_window(a, -jnp.inf, jax.lax.max,
+                                         (1, 1) + k, (1, 1) + s, pads)
+        return run_op('pool2d_max', fn, [x])
+
+    # with-index variant (parity: max_pool2d_with_index op): indices are
+    # flat positions in the per-channel H*W map, the unpool contract
+    if isinstance(pad, str):
+        raise NotImplementedError(
+            "max_pool2d(return_mask=True) needs explicit padding")
+    (p0, p1), (p2, p3) = pad
+
+    def fn_idx(a):
+        N, Cc, H, W = a.shape
+        av = jnp.pad(a, ((0, 0), (0, 0), (p0, p1), (p2, p3)),
+                     constant_values=-jnp.inf)
+        pos = jnp.broadcast_to(
+            jnp.arange(H * W, dtype=jnp.float32).reshape(1, 1, H, W),
+            (N, Cc, H, W))
+        pv = jnp.pad(pos, ((0, 0), (0, 0), (p0, p1), (p2, p3)),
+                     constant_values=-1.0)
+        def patches(arr):
+            # HIGHEST precision: the patch extractor is a matmul under the
+            # hood — TPU's default bf16 multiplies would round values AND
+            # corrupt position indices > 256
+            pt = jax.lax.conv_general_dilated_patches(
+                arr, k, s, [(0, 0), (0, 0)],
+                dimension_numbers=('NCHW', 'OIHW', 'NCHW'),
+                precision=jax.lax.Precision.HIGHEST)
+            oh, ow = pt.shape[2], pt.shape[3]
+            return pt.reshape(N, Cc, k[0] * k[1], oh, ow)
+        vals = patches(av)
+        poss = patches(pv)
+        am = jnp.argmax(vals, axis=2)
+        out = jnp.take_along_axis(vals, am[:, :, None], axis=2)[:, :, 0]
+        idx = jnp.take_along_axis(poss, am[:, :, None], axis=2)[:, :, 0]
+        return out, idx.astype(jnp.int32)
+    return run_op('pool2d_max_with_index', fn_idx, [x])
 
 
 def adaptive_avg_pool2d(x, output_size, data_format='NCHW', name=None):

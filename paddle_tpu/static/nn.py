@@ -117,3 +117,207 @@ def mean(x):
 
 def dropout(x, dropout_prob=0.5, is_test=False, **kwargs):
     return F.dropout(x, p=dropout_prob, training=not is_test)
+
+
+# ---------------------------------------------------------------------------
+# fluid.layers breadth (P23): the wider static surface — parameterized
+# wrappers where fluid created parameters, re-exports where the shared op
+# layer already records (fluid/layers/nn.py + sequence_lod.py +
+# detection.py + control_flow.py surfaces)
+# ---------------------------------------------------------------------------
+
+def conv2d_transpose(input, num_filters, filter_size, stride=1, padding=0,
+                     dilation=1, groups=1, param_attr=None, bias_attr=None,
+                     act=None, name=None):
+    """Parity: fluid/layers/nn.py conv2d_transpose."""
+    k = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size, filter_size)
+    cin = input.shape[1]
+    w = _make_param([cin, num_filters // groups, k[0], k[1]], input.dtype,
+                    attr=param_attr)
+    b = None
+    if bias_attr is not False:
+        b = _make_param([num_filters], input.dtype,
+                        initializer=I.Constant(0.0), attr=bias_attr)
+    out = F.conv2d_transpose(input, w, b, stride=stride, padding=padding,
+                             dilation=dilation, groups=groups)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-05, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    """Parity: fluid/layers/nn.py layer_norm."""
+    import numpy as _np
+    norm_shape = [int(_np.prod(input.shape[begin_norm_axis:]))]
+    w = _make_param(norm_shape, input.dtype,
+                    initializer=I.Constant(1.0),
+                    attr=param_attr) if scale else None
+    b = _make_param(norm_shape, input.dtype,
+                    initializer=I.Constant(0.0),
+                    attr=bias_attr) if shift else None
+    # dynamic (-1) leading dims: flatten against the single CONCRETE
+    # trailing size so only one unknown axis remains in the reshape
+    lead = list(input.shape[:begin_norm_axis])
+    if any(d is None or d < 0 for d in lead):
+        lead = [-1]
+    flat = manip.reshape(input, lead + [norm_shape[0]])
+    out = F.layer_norm(flat, norm_shape, w, b, epsilon=epsilon)
+    out = manip.reshape(out, [d if d is not None else -1
+                              for d in input.shape])
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def group_norm(input, groups, epsilon=1e-05, param_attr=None,
+               bias_attr=None, act=None, data_layout='NCHW', name=None):
+    """Parity: fluid/layers/nn.py group_norm."""
+    c = input.shape[1] if data_layout == 'NCHW' else input.shape[-1]
+    w = _make_param([c], input.dtype, initializer=I.Constant(1.0),
+                    attr=param_attr)
+    b = _make_param([c], input.dtype, initializer=I.Constant(0.0),
+                    attr=bias_attr)
+    out = F.group_norm(input, groups, epsilon=epsilon, weight=w, bias=b,
+                       data_format=data_layout)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def prelu(x, mode='all', param_attr=None, name=None):
+    """Parity: fluid/layers/nn.py prelu (modes all/channel/element)."""
+    if mode == 'all':
+        shape = [1]
+    elif mode == 'channel':
+        shape = [x.shape[1]]
+    else:
+        shape = list(x.shape[1:])
+    a = _make_param(shape, x.dtype, initializer=I.Constant(0.25),
+                    attr=param_attr)
+    return F.prelu(x, a)
+
+
+def nce(input, label, num_total_classes, num_neg_samples=5,
+        param_attr=None, bias_attr=None, sampler='uniform', name=None):
+    """Parity: fluid/layers/nn.py nce (parameterized wrapper over the op
+    — operators/nce_op.cc)."""
+    from ..ops import contrib
+    d = input.shape[-1]
+    w = _make_param([num_total_classes, d], input.dtype, attr=param_attr)
+    b = None
+    if bias_attr is not False:
+        b = _make_param([num_total_classes], input.dtype,
+                        initializer=I.Constant(0.0), attr=bias_attr)
+    return contrib.nce(input, label, num_total_classes, w, b,
+                       num_neg_samples=num_neg_samples, sampler=sampler)
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None):
+    """Parity: fluid/layers/nn.py hsigmoid
+    (operators/hierarchical_sigmoid_op.cc, default complete tree)."""
+    from ..ops import contrib
+    d = input.shape[-1]
+    w = _make_param([num_classes - 1, d], input.dtype, attr=param_attr)
+    b = None
+    if bias_attr is not False:
+        b = _make_param([num_classes - 1], input.dtype,
+                        initializer=I.Constant(0.0), attr=bias_attr)
+    return contrib.hsigmoid_loss(input, label, num_classes, w, b)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None,
+             name=None):
+    """Parity: fluid/layers/nn.py row_conv (operators/row_conv_op.cc)."""
+    from ..ops import contrib
+    d = input.shape[-1]
+    w = _make_param([future_context_size + 1, d], input.dtype,
+                    attr=param_attr)
+    out = contrib.row_conv(input, w)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def deformable_conv(input, offset, mask, num_filters, filter_size,
+                    stride=1, padding=0, dilation=1, groups=1,
+                    deformable_groups=1, im2col_step=1, param_attr=None,
+                    bias_attr=None, modulated=True, name=None):
+    """Parity: fluid/layers/nn.py deformable_conv
+    (operators/deformable_conv_op.cc v1/v2)."""
+    from ..vision.detection import deform_conv2d
+    k = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size, filter_size)
+    cin = input.shape[1]
+    w = _make_param([num_filters, cin // groups, k[0], k[1]], input.dtype,
+                    attr=param_attr)
+    b = None
+    if bias_attr is not False:
+        b = _make_param([num_filters], input.dtype,
+                        initializer=I.Constant(0.0), attr=bias_attr)
+    return deform_conv2d(input, offset, w, b, stride=stride,
+                         padding=padding, dilation=dilation,
+                         deformable_groups=deformable_groups,
+                         groups=groups,
+                         mask=mask if modulated else None)
+
+
+def bilinear_tensor_product(x, y, size, act=None, param_attr=None,
+                            bias_attr=None, name=None):
+    """Parity: fluid/layers/nn.py bilinear_tensor_product."""
+    from ..ops import linalg
+    w = _make_param([size, x.shape[-1], y.shape[-1]], x.dtype,
+                    attr=param_attr)
+    b = None
+    if bias_attr is not False:
+        b = _make_param([size], x.dtype, initializer=I.Constant(0.0),
+                        attr=bias_attr)
+    out = linalg.bilinear_tensor_product(x, y, w, b)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    from ..ops import contrib
+    return contrib.spectral_norm(weight, dim=dim, power_iters=power_iters,
+                                 eps=eps)
+
+
+def _reexport():
+    """The rest of the fluid.layers vocabulary records through the shared
+    op layer — re-export so `static.nn.<name>` resolves (fluid/layers
+    nn.py / sequence_lod.py / detection.py / control_flow.py names)."""
+    from ..ops import contrib as _contrib
+    from ..ops import sequence as _seq
+    from ..vision import detection as _det
+    from . import control_flow as _cf
+    g = globals()
+    for mod, names in (
+        (F, ['relu', 'softmax', 'log_softmax', 'sigmoid', 'tanh', 'gelu',
+             'max_pool2d', 'avg_pool2d', 'adaptive_avg_pool2d',
+             'adaptive_max_pool2d', 'one_hot', 'maxout', 'instance_norm',
+             'pad', 'interpolate', 'grid_sample', 'pixel_shuffle',
+             'label_smooth', 'kl_div', 'mse_loss', 'l1_loss',
+             'smooth_l1_loss', 'margin_ranking_loss', 'nll_loss',
+             'binary_cross_entropy', 'binary_cross_entropy_with_logits',
+             'square_error_cost']),
+        (_contrib, ['unpool', 'im2sequence', 'spp']),
+        (_seq, ['sequence_pad', 'sequence_unpad', 'sequence_expand',
+                'sequence_reverse', 'linear_chain_crf', 'crf_decoding',
+                'beam_search']),
+        (_det, ['multiclass_nms', 'bipartite_match', 'iou_similarity',
+                'yolo_box', 'prior_box', 'box_coder', 'box_clip',
+                'anchor_generator', 'generate_proposals', 'matrix_nms']),
+        (_cf, ['while_loop', 'cond', 'switch_case', 'case']),
+    ):
+        for n in names:
+            if hasattr(mod, n) and n not in g:
+                g[n] = getattr(mod, n)
+
+
+_reexport()
+del _reexport

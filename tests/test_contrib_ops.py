@@ -1,0 +1,205 @@
+"""Tier-2/3 remainder ops vs numpy oracles (op_test.py pattern):
+nce / hsigmoid / unpool / im2sequence / spp / row_conv / spectral_norm +
+the static.nn parameterized wrappers.
+"""
+import math
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops import contrib as C
+
+
+def _t(a):
+    return Tensor(jnp.asarray(a))
+
+
+class TestHsigmoid:
+    def test_vs_numpy_complete_tree(self):
+        rng = np.random.RandomState(0)
+        N, D, Cn = 4, 6, 8
+        x = rng.randn(N, D).astype('float32')
+        w = rng.randn(Cn - 1, D).astype('float32') * 0.3
+        b = rng.randn(Cn - 1).astype('float32') * 0.1
+        lb = rng.randint(0, Cn, (N,)).astype('int64')
+        out = C.hsigmoid_loss(_t(x), _t(lb), Cn, _t(w), _t(b))
+
+        def sigmoid(v):
+            return 1 / (1 + np.exp(-v))
+        exp = np.zeros((N, 1), 'float32')
+        for i in range(N):
+            node = lb[i] + Cn
+            loss = 0.0
+            while node > 1:
+                parent = node // 2
+                code = node % 2
+                row = parent - 1
+                z = x[i] @ w[row] + b[row]
+                p = sigmoid(z) if code == 1 else 1 - sigmoid(z)
+                loss += -math.log(max(p, 1e-20))
+                node = parent
+            exp[i, 0] = loss
+        np.testing.assert_allclose(np.asarray(out.data), exp, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_trains(self):
+        """hsigmoid as a classifier head: loss decreases and the tree
+        route identifies the right class."""
+        rng = np.random.RandomState(1)
+        N, D, Cn = 32, 8, 8
+        lb = rng.randint(0, Cn, (N,)).astype('int64')
+        x = np.eye(Cn, D)[lb].astype('float32') + \
+            0.1 * rng.randn(N, D).astype('float32')
+        w = _t(rng.randn(Cn - 1, D).astype('float32') * 0.1)
+        w.stop_gradient = False
+        losses = []
+        for _ in range(200):
+            out = C.hsigmoid_loss(_t(x), _t(lb), Cn, w)
+            loss = paddle.mean(out)
+            loss.backward()
+            w._data = w.data - 1.0 * w.grad.data
+            w.grad = None
+            losses.append(float(loss))
+        assert losses[-1] < 0.35 * losses[0], (losses[0], losses[-1])
+
+
+class TestNce:
+    def test_loss_shape_and_direction(self):
+        rng = np.random.RandomState(2)
+        N, D, Cn = 8, 6, 20
+        x = rng.randn(N, D).astype('float32')
+        lb = rng.randint(0, Cn, (N,)).astype('int64')
+        # weight aligned with the labels → much lower loss than random
+        w_good = np.zeros((Cn, D), 'float32')
+        for c in range(Cn):
+            w_good[c] = 5.0 * np.eye(Cn, D)[c]
+        x_good = np.eye(Cn, D)[lb].astype('float32')
+        paddle.seed(3)
+        l_good = float(paddle.mean(C.nce(_t(x_good), _t(lb), Cn,
+                                         _t(w_good), num_neg_samples=5)))
+        paddle.seed(3)
+        l_rand = float(paddle.mean(C.nce(_t(x), _t(lb), Cn,
+                                         _t(0.01 * w_good),
+                                         num_neg_samples=5)))
+        assert l_good < l_rand
+
+    def test_grad_flows(self):
+        rng = np.random.RandomState(3)
+        x = _t(rng.randn(4, 5).astype('float32'))
+        w = _t(rng.randn(10, 5).astype('float32'))
+        x.stop_gradient = False
+        w.stop_gradient = False
+        lb = _t(rng.randint(0, 10, (4,)).astype('int64'))
+        loss = paddle.mean(C.nce(x, lb, 10, w))
+        loss.backward()
+        assert np.isfinite(np.asarray(x.grad.data)).all()
+        assert np.isfinite(np.asarray(w.grad.data)).all()
+
+
+class TestUnpoolIm2SeqSpp:
+    def test_unpool_inverts_maxpool(self):
+        from paddle_tpu.ops import nn_ops as F
+        rng = np.random.RandomState(4)
+        x = rng.rand(2, 3, 4, 4).astype('float32')
+        pooled, idx = F.max_pool2d(_t(x), 2, stride=2, return_mask=True)
+        out = C.unpool(pooled, idx, 2, stride=2)
+        o = np.asarray(out.data)
+        assert o.shape == (2, 3, 4, 4)
+        # every pooled max lands back at its argmax position
+        p = np.asarray(pooled.data)
+        assert np.allclose(np.sort(o[o != 0]), np.sort(p.reshape(-1)))
+        mask = o != 0
+        np.testing.assert_allclose(o[mask],
+                                   x[mask])
+
+    def test_im2sequence_vs_numpy(self):
+        rng = np.random.RandomState(5)
+        x = rng.rand(2, 3, 4, 4).astype('float32')
+        out = C.im2sequence(_t(x), filter_size=2, stride=2)
+        o = np.asarray(out.data)
+        assert o.shape == (2 * 2 * 2, 3 * 2 * 2)
+        # first patch of first image == top-left 2x2 block
+        exp0 = x[0, :, 0:2, 0:2].reshape(-1)
+        np.testing.assert_allclose(o[0], exp0, rtol=1e-6)
+
+    def test_spp_shapes(self):
+        rng = np.random.RandomState(6)
+        x = rng.rand(2, 5, 8, 8).astype('float32')
+        out = C.spp(_t(x), pyramid_height=3)
+        assert tuple(out.shape) == (2, 5 * (1 + 4 + 16))
+        # level-0 bin is the global max
+        np.testing.assert_allclose(np.asarray(out.data)[:, :5],
+                                   x.max((2, 3)), rtol=1e-6)
+
+
+class TestRowConvSpectral:
+    def test_row_conv_vs_numpy(self):
+        rng = np.random.RandomState(7)
+        x = rng.randn(2, 5, 3).astype('float32')
+        w = rng.randn(3, 3).astype('float32')
+        out = C.row_conv(_t(x), _t(w))
+        exp = np.zeros_like(x)
+        for t in range(5):
+            for i in range(3):
+                if t + i < 5:
+                    exp[:, t] += x[:, t + i] * w[i]
+        np.testing.assert_allclose(np.asarray(out.data), exp, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_spectral_norm_unit_sigma(self):
+        rng = np.random.RandomState(8)
+        w = rng.randn(6, 4).astype('float32')
+        out = C.spectral_norm(_t(w), power_iters=50)
+        sv = np.linalg.svd(np.asarray(out.data), compute_uv=False)
+        np.testing.assert_allclose(sv[0], 1.0, rtol=1e-3)
+
+
+class TestStaticSurface:
+    def test_static_nn_wrappers_record_and_run(self):
+        import paddle_tpu.static as static
+        paddle.enable_static()
+        try:
+            main = static.Program()
+            with static.program_guard(main):
+                x = static.data('x', [4, 1, 8, 8])
+                seqs = static.nn.im2sequence(x, filter_size=2, stride=2)
+                h = static.nn.fc(seqs, 6, activation='relu')
+                ln = static.nn.layer_norm(h)
+                loss = paddle.mean(ln * ln)
+            exe = static.Executor()
+            with static.scope_guard(static.Scope()):
+                r = exe.run(main,
+                            feed={'x': np.random.RandomState(0)
+                                  .rand(4, 1, 8, 8).astype('float32')},
+                            fetch_list=[loss])
+            assert np.isfinite(r[0]).all()
+        finally:
+            paddle.disable_static()
+
+    def test_static_hsigmoid_nce_build(self):
+        import paddle_tpu.static as static
+        paddle.enable_static()
+        try:
+            paddle.seed(0)
+            main = static.Program()
+            with static.program_guard(main):
+                x = static.data('x', [8, 6])
+                lb = static.data('lb', [8], dtype='int64')
+                l1 = static.nn.hsigmoid(x, lb, num_classes=10)
+                l2 = static.nn.nce(x, lb, num_total_classes=10)
+                loss = paddle.mean(l1) + paddle.mean(l2)
+            assert len(main.all_parameters()) == 4  # 2 weights + 2 biases
+            exe = static.Executor()
+            rng = np.random.RandomState(1)
+            with static.scope_guard(static.Scope()):
+                r = exe.run(main,
+                            feed={'x': rng.rand(8, 6).astype('float32'),
+                                  'lb': rng.randint(0, 10, (8,))
+                                  .astype('int64')},
+                            fetch_list=[loss])
+            assert np.isfinite(r[0]).all()
+        finally:
+            paddle.disable_static()
