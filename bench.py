@@ -56,10 +56,12 @@ def bench_gpt_1p3b():
                    for layer in layers for p in layer.parameters())
     opt = paddle.optimizer.SGD(learning_rate=1e-4, parameters=[],
                                multi_precision=False)
-    A, mb = 4, 1
+    A, mb = 4, 2
     eng = SpmdPipelineEngine(embed, blocks, head, opt, accumulate_steps=A,
                              use_remat=True, schedule='1F1B',
                              grad_accum_dtype='param')
+    # A=4 x mb=2 measured best on one v5e chip (58.8% vs 53.9% at mb=1:
+    # bigger per-microbatch matmuls amortize layernorm/transpose overhead)
     # the engine owns device copies; free the eager duplicates (2.6G)
     for layer in layers:
         for p in layer.parameters():
@@ -135,8 +137,8 @@ def bench_bert_config3():
     loss = eng(ids, mlm, nsp)              # compile + warmup
     assert np.isfinite(float(loss))
     n = 5
-    dt = float('inf')                      # best of 3 (time-shared chip)
-    for _ in range(3):
+    dt = float('inf')                      # best of 4 (time-shared chip)
+    for _ in range(4):
         t0 = time.time()
         for _ in range(n):
             loss = eng(ids, mlm, nsp)

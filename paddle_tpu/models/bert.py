@@ -20,7 +20,8 @@ class BertConfig:
     def __init__(self, vocab_size=30522, hidden_size=768, num_layers=12,
                  num_heads=12, intermediate_size=3072, max_seq_len=512,
                  type_vocab_size=2, hidden_dropout=0.1, attn_dropout=0.1,
-                 initializer_range=0.02, layer_norm_eps=1e-12):
+                 initializer_range=0.02, layer_norm_eps=1e-12,
+                 mlm_loss_chunks=16):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -32,6 +33,8 @@ class BertConfig:
         self.attn_dropout = attn_dropout
         self.initializer_range = initializer_range
         self.layer_norm_eps = layer_norm_eps
+        # fused-xent chunk count (16 measured fastest at B=64,L=512 on v5e)
+        self.mlm_loss_chunks = mlm_loss_chunks
 
 
 class BertEmbeddings(nn.Layer):
@@ -111,8 +114,9 @@ class BertForPretraining(nn.Layer):
         w = self.bert.embeddings.word_embeddings.weight
         nsp_logits = self.nsp(pooled)
         if masked_lm_labels is not None:
-            mlm = F.fused_linear_cross_entropy(h, w, masked_lm_labels,
-                                               ignore_index=-100)
+            mlm = F.fused_linear_cross_entropy(
+                h, w, masked_lm_labels, ignore_index=-100,
+                chunks=self.config.mlm_loss_chunks)
             if next_sentence_label is None:
                 return mlm
             nsp = F.cross_entropy(nsp_logits, next_sentence_label)
