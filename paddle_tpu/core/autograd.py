@@ -368,7 +368,15 @@ def run_op(name, fn, tensor_args, static_kwargs=None, n_nondiff=0):
                 full[i] = const_arrs[j]
             return fn(*full, **static_kwargs)
 
-        out, vjp_fn = jax.vjp(closed, *[arrs[i] for i in diff_idx])
+        try:
+            out, vjp_fn = jax.vjp(closed, *[arrs[i] for i in diff_idx])
+        except Exception as e:
+            # flag consulted only on the exception path — zero per-op cost
+            from .flags import flag as _flag_
+            if not _flag_('FLAGS_op_error_context', False):
+                raise
+            from .enforce import op_error_context
+            raise op_error_context(name, e) from e
 
         def full_vjp(ct, _vjp=vjp_fn, _dix=tuple(diff_idx), _n=len(arrs)):
             partial = _vjp(ct)
@@ -377,7 +385,14 @@ def run_op(name, fn, tensor_args, static_kwargs=None, n_nondiff=0):
                 full[i] = partial[j]
             return full
     else:
-        out = fn(*arrs, **static_kwargs)
+        try:
+            out = fn(*arrs, **static_kwargs)
+        except Exception as e:
+            from .flags import flag as _flag_
+            if not _flag_('FLAGS_op_error_context', False):
+                raise
+            from .enforce import op_error_context
+            raise op_error_context(name, e) from e
         full_vjp = None
 
     multi = isinstance(out, (tuple, list))

@@ -1,0 +1,118 @@
+"""Error enforcement machinery.
+
+Reference parity: paddle/fluid/platform/enforce.h (PADDLE_ENFORCE* macros
+→ EnforceNotMet carrying an error summary + context) and
+platform/errors.h's typed taxonomy (InvalidArgument, NotFound,
+OutOfRange, AlreadyExists, PermissionDenied, PreconditionNotMet,
+Unimplemented, Unavailable, Fatal, External).
+
+TPU-native shape: plain Python exception classes (jax/XLA surface their
+own compiled-program errors; this tier covers the framework's own
+argument/state validation) plus `enforce`/`enforce_eq`-style helpers the
+op layer uses to attach op context to failures.
+"""
+
+
+class EnforceNotMet(RuntimeError):
+    """Parity: enforce.h EnforceNotMet — the base enforcement failure."""
+
+    def __init__(self, message, error_type='Error'):
+        super().__init__(message)
+        self.error_type = error_type
+        self.message = message
+
+    def __str__(self):
+        return f"{self.error_type}: {self.message}"
+
+
+class InvalidArgumentError(EnforceNotMet):
+    def __init__(self, message):
+        super().__init__(message, 'InvalidArgumentError')
+
+
+class NotFoundError(EnforceNotMet):
+    def __init__(self, message):
+        super().__init__(message, 'NotFoundError')
+
+
+class OutOfRangeError(EnforceNotMet):
+    def __init__(self, message):
+        super().__init__(message, 'OutOfRangeError')
+
+
+class AlreadyExistsError(EnforceNotMet):
+    def __init__(self, message):
+        super().__init__(message, 'AlreadyExistsError')
+
+
+class PermissionDeniedError(EnforceNotMet):
+    def __init__(self, message):
+        super().__init__(message, 'PermissionDeniedError')
+
+
+class PreconditionNotMetError(EnforceNotMet):
+    def __init__(self, message):
+        super().__init__(message, 'PreconditionNotMetError')
+
+
+class UnimplementedError(EnforceNotMet):
+    def __init__(self, message):
+        super().__init__(message, 'UnimplementedError')
+
+
+class UnavailableError(EnforceNotMet):
+    def __init__(self, message):
+        super().__init__(message, 'UnavailableError')
+
+
+class ExecutionTimeoutError(EnforceNotMet):
+    def __init__(self, message):
+        super().__init__(message, 'ExecutionTimeoutError')
+
+
+class FatalError(EnforceNotMet):
+    def __init__(self, message):
+        super().__init__(message, 'FatalError')
+
+
+class ExternalError(EnforceNotMet):
+    def __init__(self, message):
+        super().__init__(message, 'ExternalError')
+
+
+def enforce(condition, message, error_cls=EnforceNotMet):
+    """Parity: PADDLE_ENFORCE(cond, msg)."""
+    if not condition:
+        raise error_cls(message)
+
+
+def enforce_eq(a, b, message=None, error_cls=InvalidArgumentError):
+    """Parity: PADDLE_ENFORCE_EQ."""
+    if a != b:
+        raise error_cls(message or f"expected {a!r} == {b!r}")
+
+
+def enforce_gt(a, b, message=None, error_cls=InvalidArgumentError):
+    if not a > b:
+        raise error_cls(message or f"expected {a!r} > {b!r}")
+
+
+def enforce_ge(a, b, message=None, error_cls=InvalidArgumentError):
+    if not a >= b:
+        raise error_cls(message or f"expected {a!r} >= {b!r}")
+
+
+def enforce_not_none(v, message=None, error_cls=NotFoundError):
+    if v is None:
+        raise error_cls(message or "value is None")
+    return v
+
+
+def op_error_context(op_name, exc):
+    """Wrap an exception raised inside an op kernel with the op's name —
+    the [operator < name > error] framing of enforce.h's
+    GetCurrentTraceBackString reports."""
+    msg = f"[operator < {op_name} > error] {type(exc).__name__}: {exc}"
+    err = EnforceNotMet(msg)
+    err.__cause__ = exc
+    return err

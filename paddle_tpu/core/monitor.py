@@ -1,0 +1,96 @@
+"""Runtime stats registry.
+
+Reference parity: paddle/fluid/platform/monitor.h — StatRegistry over
+named int64 stats (STAT_INT / DEFINE_INT_STATUS, e.g.
+STAT_total_feasign_num_in_mem) surfaced through
+core.get_int_stats(). Subsystems bump named counters; tooling reads a
+snapshot.
+
+TPU-native shape: one thread-safe registry of int/float stats; the PS
+service, DataLoader and Executor report through it (the reference's
+monitored quantities are PS feasign counts and worker progress).
+"""
+import threading
+
+
+class Stat:
+    __slots__ = ('name', '_value', '_lock')
+
+    def __init__(self, name, value=0):
+        self.name = name
+        self._value = value
+        self._lock = threading.Lock()
+
+    def add(self, delta=1):
+        with self._lock:
+            self._value += delta
+            return self._value
+
+    def set(self, value):
+        with self._lock:
+            self._value = value
+
+    def get(self):
+        with self._lock:
+            return self._value
+
+
+class StatRegistry:
+    """Parity: platform/monitor.h StatRegistry (singleton per value
+    type; here one registry holds both int and float stats)."""
+
+    def __init__(self):
+        self._stats = {}
+        self._lock = threading.Lock()
+
+    def stat(self, name):
+        with self._lock:
+            s = self._stats.get(name)
+            if s is None:
+                s = self._stats[name] = Stat(name)
+            return s
+
+    def add(self, name, delta=1):
+        return self.stat(name).add(delta)
+
+    def set(self, name, value):
+        self.stat(name).set(value)
+
+    def get(self, name, default=0):
+        with self._lock:
+            s = self._stats.get(name)
+        return s.get() if s is not None else default
+
+    def snapshot(self):
+        with self._lock:
+            stats = list(self._stats.values())
+        return {s.name: s.get() for s in stats}
+
+    def reset(self):
+        with self._lock:
+            self._stats.clear()
+
+
+_registry = StatRegistry()
+
+
+def registry():
+    return _registry
+
+
+def stat_add(name, delta=1):
+    return _registry.add(name, delta)
+
+
+def stat_set(name, value):
+    _registry.set(name, value)
+
+
+def get_int_stats():
+    """Parity: core.get_int_stats — integer-valued snapshot."""
+    return {k: int(v) for k, v in _registry.snapshot().items()
+            if isinstance(v, (int, bool))}
+
+
+def get_stats():
+    return _registry.snapshot()

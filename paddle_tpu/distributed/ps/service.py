@@ -390,7 +390,9 @@ class PsClient:
         list(self._pool.map(fn, shard_ids))
 
     def pull(self, table_id, ids, dim):
+        from ...core.monitor import stat_add
         ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        stat_add('STAT_ps_client_pull_ids', len(ids))
         out = np.empty((len(ids), dim), np.float32)
         shards = self._shard(ids)
 
@@ -412,7 +414,9 @@ class PsClient:
         return out
 
     def push(self, table_id, ids, grads, lr):
+        from ...core.monitor import stat_add
         ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        stat_add('STAT_ps_client_push_ids', len(ids))
         grads = np.ascontiguousarray(grads, np.float32)
         shards = self._shard(ids)
 

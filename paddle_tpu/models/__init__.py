@@ -9,3 +9,4 @@ from .gpt import (GPTConfig, GPTModel, GPTForCausalLM,
                   GPTPretrainingCriterion, gpt_tiny, gpt_small, gpt_medium,
                   gpt_1p3b)
 from .bert import BertConfig, BertModel, BertForPretraining
+from .deepfm import DeepFM, deepfm_loss  # noqa: F401,E402

@@ -1,0 +1,69 @@
+"""DeepFM (BASELINE config 5 family, next to wide_deep).
+
+Reference parity: the DeepFM topology the reference's PS configs train —
+shared sparse embeddings feeding (a) a first-order linear term, (b) the
+factorization-machine second-order interaction, (c) a deep MLP tower
+(the CTR model family of the heterPS/pscore examples).
+
+TPU-native: the FM pairwise interaction uses the sum-square trick (one
+reduction, no O(F^2) loop); sparse lookups ride the same
+DistributedEmbedding tape integration wide_deep uses, so the model runs
+against the in-process host table or the remote PS unchanged.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.tensor import Tensor
+from ..core.autograd import run_op
+from ..ops import math as M
+from ..ops import manip
+from ..ops import nn_ops as F
+
+
+class DeepFM(nn.Layer):
+    """fields: number of sparse fields; each sample carries one feature id
+    per field (the classic Criteo layout)."""
+
+    def __init__(self, num_features=1000, fields=10, embed_dim=8,
+                 hidden=(32, 16), use_ps=False):
+        super().__init__()
+        self.fields = fields
+        self.embed_dim = embed_dim
+        if use_ps:
+            from ..distributed.ps.embedding import DistributedEmbedding
+            self.embedding = DistributedEmbedding(num_features, embed_dim)
+            self.linear_embedding = DistributedEmbedding(num_features, 1)
+        else:
+            self.embedding = nn.Embedding(num_features, embed_dim)
+            self.linear_embedding = nn.Embedding(num_features, 1)
+        self.bias = self.create_parameter([1], is_bias=True)
+        mlp = []
+        d = fields * embed_dim
+        for h in hidden:
+            mlp += [nn.Linear(d, h), nn.ReLU()]
+            d = h
+        mlp.append(nn.Linear(d, 1))
+        self.mlp = nn.Sequential(*mlp)
+
+    def forward(self, feat_ids):
+        """feat_ids [N, fields] int → logits [N, 1]."""
+        emb = self.embedding(feat_ids)                  # [N, F, D]
+        first = manip.reshape(self.linear_embedding(feat_ids),
+                              [feat_ids.shape[0], self.fields])
+        first = M.sum(first, axis=1, keepdim=True)      # [N, 1]
+
+        def fm(e):
+            # 0.5 * ((Σ v)^2 − Σ v^2) summed over D — sum-square trick
+            s = e.sum(1)
+            return (0.5 * (s * s - (e * e).sum(1))).sum(-1,
+                                                        keepdims=True)
+        second = run_op('fm_interaction', fm, [emb])
+        deep = self.mlp(manip.reshape(
+            emb, [feat_ids.shape[0], self.fields * self.embed_dim]))
+        return M.add(M.add(M.add(first, second), deep), self.bias)
+
+
+def deepfm_loss(logits, labels):
+    return F.binary_cross_entropy_with_logits(
+        logits, labels.astype('float32'))

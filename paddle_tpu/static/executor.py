@@ -112,6 +112,8 @@ class Executor:
                                                  param_names, fetch_names))
             self._cache[key] = compiled
 
+        from ..core.monitor import stat_add
+        stat_add('STAT_executor_runs')
         fetches, new_params = compiled(
             tuple(feed_arrays), tuple(param_arrays), lr)
         for name, arr in zip(param_names, new_params):

@@ -1,0 +1,199 @@
+"""SSD-style single-shot detector (detection-tier end-to-end model).
+
+Reference parity: the SSD the reference assembles from fluid.layers
+detection ops — multi_box_head + prior_box (layers/detection.py),
+ssd_loss (bipartite_match + target assign + smooth_l1 + softmax CE,
+layers/detection.py ssd_loss), and detection_output
+(box_coder decode + multiclass_nms). The op tier lives in
+paddle_tpu/vision/detection.py; this model wires it into a trainable
+detector the way the reference's SSD configs do.
+
+TPU-native: everything except the final NMS is one fixed-shape jitted
+program; matching runs as the vectorized bipartite/argmax assignment over
+the IoU matrix (no LoD, masks instead).
+"""
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ... import nn
+from ...core.tensor import Tensor
+from ...core.autograd import run_op
+from ...ops import math as M
+from ...ops import manip
+from ...ops import nn_ops as F
+from .. import detection as D
+
+
+class SSDHead(nn.Layer):
+    """Per-feature-map conv predictors: loc [N, P, 4] + conf [N, P, C]."""
+
+    def __init__(self, in_channels, num_priors, num_classes):
+        super().__init__()
+        self.num_classes = num_classes
+        self.loc = nn.Conv2D(in_channels, num_priors * 4, 3, padding=1)
+        self.conf = nn.Conv2D(in_channels, num_priors * num_classes, 3,
+                              padding=1)
+
+    def forward(self, feat):
+        N = feat.shape[0]
+        loc = manip.transpose(self.loc(feat), [0, 2, 3, 1])
+        loc = manip.reshape(loc, [N, -1, 4])
+        conf = manip.transpose(self.conf(feat), [0, 2, 3, 1])
+        conf = manip.reshape(conf, [N, -1, self.num_classes])
+        return loc, conf
+
+
+class TinySSD(nn.Layer):
+    """A compact SSD: conv backbone with two prediction scales — the
+    reference's mobilenet-ssd topology at toy size (the op wiring, loss
+    and decode paths are the full SSD ones)."""
+
+    def __init__(self, num_classes=4, image_size=64):
+        super().__init__()
+        self.num_classes = num_classes      # incl. background class 0
+        self.image_size = image_size
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, 16, 3, stride=2, padding=1), nn.ReLU(),
+            nn.Conv2D(16, 32, 3, stride=2, padding=1), nn.ReLU())
+        self.block1 = nn.Sequential(
+            nn.Conv2D(32, 64, 3, stride=2, padding=1), nn.ReLU())
+        self.block2 = nn.Sequential(
+            nn.Conv2D(64, 64, 3, stride=2, padding=1), nn.ReLU())
+        self._prior_cfg = [
+            # (min_size, max_size, ars)
+            (16.0, 32.0, (2.0,)),
+            (32.0, 56.0, (2.0,)),
+        ]
+        np1 = len(D._prior_wh([16.0], [32.0], [2.0], True, False))
+        np2 = len(D._prior_wh([32.0], [56.0], [2.0], True, False))
+        self.head1 = SSDHead(64, np1, num_classes)
+        self.head2 = SSDHead(64, np2, num_classes)
+
+    def priors(self, feats):
+        """Normalized [P_total, 4] priors + variances for the two maps —
+        shape-static, so computed once per feature geometry and kept OFF
+        the autograd tape (re-recording them each step would drag dead
+        zero-cotangent VJP work through prior_box)."""
+        key = tuple(tuple(f.shape[2:]) for f in feats)
+        cached = getattr(self, '_prior_cache', None)
+        if cached is not None and cached[0] == key:
+            return cached[1], cached[2]
+        img = Tensor(jnp.zeros((1, 3, self.image_size, self.image_size),
+                               jnp.float32))
+        outs, vars_ = [], []
+        for feat, (ms, Ms, ars) in zip(feats, self._prior_cfg):
+            b, v = D.prior_box(feat, img, min_sizes=[ms], max_sizes=[Ms],
+                               aspect_ratios=list(ars), flip=True,
+                               clip=True)
+            outs.append(manip.reshape(b, [-1, 4]))
+            vars_.append(manip.reshape(v, [-1, 4]))
+        pri = Tensor(manip.concat(outs, 0).data)       # detached
+        pvar = Tensor(manip.concat(vars_, 0).data)
+        self._prior_cache = (key, pri, pvar)
+        return pri, pvar
+
+    def forward(self, images):
+        x = self.stem(images)
+        f1 = self.block1(x)
+        f2 = self.block2(f1)
+        l1, c1 = self.head1(f1)
+        l2, c2 = self.head2(f2)
+        loc = manip.concat([l1, l2], 1)      # [N, P, 4]
+        conf = manip.concat([c1, c2], 1)     # [N, P, C]
+        priors, prior_vars = self.priors([f1, f2])
+        return loc, conf, priors, prior_vars
+
+
+def ssd_loss(loc, conf, priors, prior_vars, gt_boxes, gt_labels,
+             overlap_threshold=0.5, neg_pos_ratio=3.0):
+    """Parity: layers/detection.py ssd_loss — match priors to ground truth
+    (best-prior-per-gt forced + IoU threshold), encode regression targets
+    (box_coder encode semantics), smooth_l1 on positives, softmax CE with
+    hard negative mining at neg:pos = 3:1.
+
+    gt_boxes [N, G, 4] normalized (padded with zeros), gt_labels [N, G]
+    (0 = padding/background). Returns scalar loss."""
+    n_cls = conf.shape[-1]
+
+    def fn(loc_a, conf_a, pri, pvar, gb, gl):
+        Nb, P, _ = loc_a.shape
+        G = gb.shape[1]
+
+        def one(loc_i, conf_i, gb_i, gl_i):
+            iou = D._iou_matrix(gb_i, pri)                 # [G, P]
+            valid_gt = (gl_i > 0)
+            iou = jnp.where(valid_gt[:, None], iou, 0.0)
+            best_gt = jnp.argmax(iou, 0)                   # per prior
+            best_iou = jnp.max(iou, 0)
+            # force-match the best prior of each gt (bipartite step);
+            # padding GTs scatter to a dropped out-of-range slot so they
+            # can never collide with a valid GT at prior 0
+            best_prior = jnp.argmax(iou, 1)                # [G]
+            safe_prior = jnp.where(valid_gt, best_prior, P)
+            forced = jnp.zeros((P,), bool) \
+                .at[safe_prior].set(True, mode='drop')
+            forced_gt = jnp.zeros((P,), jnp.int32) \
+                .at[safe_prior].set(jnp.arange(G, dtype=jnp.int32),
+                                    mode='drop')
+            match_gt = jnp.where(forced, forced_gt, best_gt)
+            pos = forced | (best_iou >= overlap_threshold)
+            labels = jnp.where(pos, gl_i[match_gt], 0)     # 0 = bg
+
+            # encode matched gt vs priors (encode_center_size w/ variance)
+            mg = gb_i[match_gt]                            # [P, 4]
+            pw = pri[:, 2] - pri[:, 0]
+            ph = pri[:, 3] - pri[:, 1]
+            pcx = pri[:, 0] + pw / 2
+            pcy = pri[:, 1] + ph / 2
+            gw = jnp.maximum(mg[:, 2] - mg[:, 0], 1e-6)
+            gh = jnp.maximum(mg[:, 3] - mg[:, 1], 1e-6)
+            gcx = (mg[:, 0] + mg[:, 2]) / 2
+            gcy = (mg[:, 1] + mg[:, 3]) / 2
+            t = jnp.stack([(gcx - pcx) / pw, (gcy - pcy) / ph,
+                           jnp.log(gw / pw), jnp.log(gh / ph)], 1) / pvar
+
+            # smooth_l1 on positives
+            d = loc_i - t
+            sl1 = jnp.where(jnp.abs(d) < 1.0, 0.5 * d * d,
+                            jnp.abs(d) - 0.5).sum(-1)
+            n_pos = jnp.maximum(pos.sum(), 1)
+            loss_loc = jnp.where(pos, sl1, 0.0).sum() / n_pos
+
+            # softmax CE + hard negative mining
+            logp = jax.nn.log_softmax(conf_i.astype(jnp.float32), -1)
+            ce = -jnp.take_along_axis(logp, labels[:, None], 1)[:, 0]
+            neg_score = jnp.where(pos, -jnp.inf, ce)       # candidates
+            k = jnp.minimum((neg_pos_ratio * n_pos).astype(jnp.int32),
+                            P - 1)
+            thresh = jnp.sort(neg_score)[::-1][jnp.clip(k, 0, P - 1)]
+            neg = (~pos) & (neg_score > thresh)
+            loss_conf = (jnp.where(pos | neg, ce, 0.0).sum()
+                         / n_pos)
+            return loss_loc + loss_conf
+
+        return jnp.mean(jax.vmap(one)(loc_a, conf_a, gb, gl))
+    return run_op('ssd_loss', fn,
+                  [loc, conf, priors, prior_vars,
+                   gt_boxes, gt_labels], n_nondiff=2)
+
+
+def ssd_detection_output(loc, conf, priors, prior_vars,
+                         score_threshold=0.05, nms_threshold=0.45,
+                         keep_top_k=50, nms_top_k=200):
+    """Parity: layers/detection.py detection_output — decode loc deltas
+    against the priors (box_coder decode_center_size) then per-class
+    multiclass NMS. Returns (out [N, K, 6], index, counts)."""
+    decoded = D.box_coder(priors, prior_vars, loc,
+                          code_type='decode_center_size', axis=0)
+    # axis=0: prior per SECOND target dim (box_coder_op.h axis==0 indexes
+    # prior rows by the column) → decoded [N, P, 4]
+    scores = F.softmax(conf, axis=-1)                      # [N, P, C]
+    scores_t = manip.transpose(scores, [0, 2, 1])          # [N, C, P]
+    return D.multiclass_nms(decoded, scores_t,
+                            score_threshold=score_threshold,
+                            nms_threshold=nms_threshold,
+                            keep_top_k=keep_top_k, nms_top_k=nms_top_k,
+                            background_label=0)

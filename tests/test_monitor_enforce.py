@@ -1,0 +1,82 @@
+"""Monitor stats registry (N5) + enforce machinery (N2).
+
+Reference parity: platform/monitor.h StatRegistry / get_int_stats and
+platform/enforce.h (+errors.h taxonomy)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import monitor, enforce, flags
+
+
+class TestMonitor:
+    def test_registry_counts(self):
+        monitor.registry().reset()
+        monitor.stat_add('STAT_x', 3)
+        monitor.stat_add('STAT_x', 2)
+        monitor.stat_set('STAT_y', 7)
+        snap = monitor.get_int_stats()
+        assert snap['STAT_x'] == 5 and snap['STAT_y'] == 7
+
+    def test_ps_and_executor_report(self):
+        from paddle_tpu.distributed.ps.service import PsServer, PsClient
+        monitor.registry().reset()
+        srv = PsServer(port=0)
+        srv.add_table(0, 4)
+        srv.start()
+        try:
+            cl = PsClient([f'127.0.0.1:{srv.port}'])
+            cl.pull(0, np.arange(6, dtype=np.int64), 4)
+            cl.push(0, np.arange(6, dtype=np.int64),
+                    np.ones((6, 4), np.float32), 0.1)
+            cl.close()
+        finally:
+            srv.stop()
+        stats = monitor.get_int_stats()
+        assert stats['STAT_ps_client_pull_ids'] == 6
+        assert stats['STAT_ps_client_push_ids'] == 6
+
+        import paddle_tpu.static as static
+        paddle.enable_static()
+        try:
+            main = static.Program()
+            with static.program_guard(main):
+                x = static.data('x', [2, 3])
+                y = static.nn.fc(x, 2)
+            exe = static.Executor()
+            with static.scope_guard(static.Scope()):
+                exe.run(main, feed={'x': np.ones((2, 3), 'float32')},
+                        fetch_list=[y])
+        finally:
+            paddle.disable_static()
+        assert monitor.get_int_stats()['STAT_executor_runs'] == 1
+
+
+class TestEnforce:
+    def test_taxonomy(self):
+        with pytest.raises(enforce.InvalidArgumentError):
+            enforce.enforce_eq(1, 2)
+        with pytest.raises(enforce.NotFoundError):
+            enforce.enforce_not_none(None)
+        with pytest.raises(enforce.EnforceNotMet, match='boom'):
+            enforce.enforce(False, 'boom')
+        e = enforce.UnimplementedError('later')
+        assert 'UnimplementedError' in str(e)
+
+    def test_op_error_context_flag(self):
+        from paddle_tpu.core.tensor import Tensor
+        import jax.numpy as jnp
+        a = Tensor(jnp.ones((2, 3)))
+        b = Tensor(jnp.ones((4, 5)))
+        # default: the original exception type surfaces
+        with pytest.raises(Exception) as ei:
+            paddle.matmul(a, b)
+        assert not isinstance(ei.value, enforce.EnforceNotMet)
+        # flag on: wrapped with [operator < name > error] context
+        flags.set_flags({'FLAGS_op_error_context': True})
+        try:
+            with pytest.raises(enforce.EnforceNotMet,
+                               match=r'operator < matmul'):
+                paddle.matmul(a, b)
+        finally:
+            flags.set_flags({'FLAGS_op_error_context': False})
