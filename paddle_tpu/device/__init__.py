@@ -67,3 +67,91 @@ class cuda:
 
 def synchronize():
     cuda.synchronize()
+
+
+class Stream:
+    """Parity: paddle.device.Stream / cuda.Stream — XLA owns ordering on
+    TPU (one compute stream per core; programs are totally ordered), so
+    streams are recorded-no-op handles whose sync points map to
+    block_until_ready."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+        self.priority = priority
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_event(self, event):
+        event.synchronize()
+
+    def wait_stream(self, stream):
+        stream.synchronize()
+
+    def record_event(self, event=None):
+        event = event or Event()
+        event.record(self)
+        return event
+
+    def query(self):
+        return True
+
+
+class Event:
+    """Parity: paddle.device.Event — timestamps via host clock (device
+    programs are serially ordered under XLA, so host timing at sync
+    points is the faithful analogue)."""
+
+    def __init__(self, enable_timing=False, blocking=False,
+                 interprocess=False):
+        self.enable_timing = enable_timing
+        self._t = None
+
+    def record(self, stream=None):
+        import time as _time
+        synchronize()
+        self._t = _time.perf_counter()
+
+    def synchronize(self):
+        synchronize()
+
+    def query(self):
+        return True
+
+    def elapsed_time(self, end_event):
+        if self._t is None or end_event._t is None:
+            raise RuntimeError("Event.record() not called")
+        return (end_event._t - self._t) * 1000.0
+
+
+def current_stream(device=None):
+    return Stream(device)
+
+
+def set_stream(stream):
+    return stream
+
+
+cuda.Stream = Stream
+cuda.Event = Event
+cuda.current_stream = staticmethod(current_stream)
+cuda.stream_guard = None
+
+
+class _StreamGuard:
+    def __init__(self, stream):
+        self.stream = stream
+
+    def __enter__(self):
+        return self.stream
+
+    def __exit__(self, *a):
+        return False
+
+
+def stream_guard(stream):
+    """Parity: paddle.device.stream_guard (no-op scheduling scope)."""
+    return _StreamGuard(stream)
+
+
+cuda.stream_guard = staticmethod(stream_guard)

@@ -721,3 +721,177 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
             out = out + b.reshape(1, Cout, 1, 1)
         return out.astype(xa.dtype)
     return run_op('deformable_conv', fn, tensors)
+
+
+# ---------------------------------------------------------------------------
+# FPN / RCNN remainder
+# ---------------------------------------------------------------------------
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, name=None):
+    """Parity: detection/distribute_fpn_proposals_op.cc — route each RoI
+    to its FPN level by scale: level = floor(refer_level +
+    log2(sqrt(area) / refer_scale)), clamped to [min_level, max_level].
+
+    fpn_rois [R, 4] → (multi_rois: per-level [R, 4] padded arrays,
+    level_counts [L], restore_ind [R]) — fixed-shape (each level array
+    keeps R slots; rows beyond its count are zeros), restore_ind maps the
+    concatenated per-level order back to the input order (the reference's
+    RestoreIndex output)."""
+    fpn_rois = as_tensor(fpn_rois)
+    n_levels = max_level - min_level + 1
+
+    def fn(rois):
+        R = rois.shape[0]
+        off = 1.0 if pixel_offset else 0.0
+        w = rois[:, 2] - rois[:, 0] + off
+        h = rois[:, 3] - rois[:, 1] + off
+        scale = jnp.sqrt(jnp.maximum(w * h, 1e-12))
+        lvl = jnp.floor(refer_level + jnp.log2(scale / refer_scale + 1e-12))
+        lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+        lvl_idx = lvl - min_level                       # [R] in [0, L)
+
+        # stable order: sort by (level, original index)
+        order = jnp.argsort(lvl_idx * R + jnp.arange(R))
+        sorted_lvl = lvl_idx[order]
+        counts = jnp.bincount(lvl_idx, length=n_levels)
+        starts = jnp.cumsum(counts) - counts
+        # position of each sorted roi within its level
+        pos_in_level = jnp.arange(R) - starts[sorted_lvl]
+        multi = jnp.zeros((n_levels, R, 4), rois.dtype)
+        multi = multi.at[sorted_lvl, pos_in_level].set(rois[order])
+        # restore index: for each input roi, its rank in the level-major
+        # concatenation (reference RestoreIndex semantics)
+        rank_of_sorted = starts[sorted_lvl] + pos_in_level
+        restore = jnp.zeros((R,), jnp.int32).at[order].set(
+            rank_of_sorted.astype(jnp.int32))
+        return multi, counts.astype(jnp.int32), restore
+    return run_op('distribute_fpn_proposals', fn, [fpn_rois],
+                  n_nondiff=1)
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, post_nms_top_n,
+                          name=None):
+    """Parity: detection/collect_fpn_proposals_op.cc — concat per-level
+    RoIs, keep the global top post_nms_top_n by score.
+    multi_rois: [L, R, 4] (or list), multi_scores: [L, R] with -inf/0 at
+    padded slots → (rois [K, 4], scores [K], count)."""
+    if isinstance(multi_rois, (list, tuple)):
+        from ..ops import manip as _m
+        multi_rois = _m.concat([_m.unsqueeze(as_tensor(r), [0])
+                                for r in multi_rois], 0)
+        multi_scores = _m.concat([_m.unsqueeze(as_tensor(s), [0])
+                                  for s in multi_scores], 0)
+    multi_rois = as_tensor(multi_rois)
+    multi_scores = as_tensor(multi_scores)
+    K = int(post_nms_top_n)
+
+    def fn(rois, scores):
+        flat_r = rois.reshape(-1, 4)
+        flat_s = scores.reshape(-1).astype(jnp.float32)
+        k = min(K, flat_s.shape[0])
+        top, arg = lax.top_k(flat_s, k)
+        valid = top > -jnp.inf
+        out_r = jnp.where(valid[:, None], flat_r[arg], 0.0)
+        out_s = jnp.where(valid, top, 0.0)
+        if k < K:
+            out_r = jnp.pad(out_r, ((0, K - k), (0, 0)))
+            out_s = jnp.pad(out_s, ((0, K - k),))
+            valid = jnp.pad(valid, ((0, K - k),))
+        return out_r, out_s, jnp.sum(valid).astype(jnp.int32)
+    return run_op('collect_fpn_proposals', fn, [multi_rois, multi_scores],
+                  n_nondiff=1)
+
+
+def psroi_pool(x, boxes, output_channels, spatial_scale, pooled_height,
+               pooled_width, boxes_num=None, name=None):
+    """Parity: operators/psroi_pool_op.cc — position-sensitive RoI
+    pooling: x [N, C=out_c*ph*pw, H, W], boxes [R, 4] (batch 0; extend
+    via boxes_num offsets), each output channel/bin pair (c, i, j)
+    average-pools input channel c*ph*pw + i*pw + j over its bin →
+    [R, out_c, ph, pw]."""
+    x, boxes = as_tensor(x), as_tensor(boxes)
+    ph, pw = int(pooled_height), int(pooled_width)
+    oc = int(output_channels)
+
+    def fn(a, bx):
+        N, C, H, W = a.shape
+        R = bx.shape[0]
+
+        def one(box):
+            x1 = box[0] * spatial_scale
+            y1 = box[1] * spatial_scale
+            x2 = box[2] * spatial_scale
+            y2 = box[3] * spatial_scale
+            rw = jnp.maximum(x2 - x1, 0.1)
+            rh = jnp.maximum(y2 - y1, 0.1)
+            bin_w = rw / pw
+            bin_h = rh / ph
+            # integer bin extents (reference: floor/ceil per bin)
+            ys = jnp.arange(H, dtype=jnp.float32)
+            xs = jnp.arange(W, dtype=jnp.float32)
+
+            out = []
+            for i in range(ph):
+                for j in range(pw):
+                    hs = y1 + i * bin_h
+                    he = y1 + (i + 1) * bin_h
+                    ws = x1 + j * bin_w
+                    we = x1 + (j + 1) * bin_w
+                    mask = ((ys[:, None] >= jnp.floor(hs))
+                            & (ys[:, None] < jnp.ceil(he))
+                            & (xs[None, :] >= jnp.floor(ws))
+                            & (xs[None, :] < jnp.ceil(we)))
+                    area = jnp.maximum(mask.sum(), 1)
+                    ch = jnp.arange(oc) * ph * pw + i * pw + j
+                    vals = (a[0, ch] * mask[None]).sum((1, 2)) / area
+                    out.append(vals)                    # [oc]
+            return jnp.stack(out, 1).reshape(oc, ph, pw)
+        return jax.vmap(one)(bx.astype(jnp.float32))
+    return run_op('psroi_pool', fn, [x, boxes], n_nondiff=1)
+
+
+def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,
+                      variance=(0.1, 0.1, 0.2, 0.2), clip=False,
+                      steps=(0.0, 0.0), offset=0.5, name=None):
+    """Parity: detection/density_prior_box_op.cc — per cell, for each
+    (density, fixed_size) pair and fixed ratio, a density×density grid of
+    shifted boxes of size fixed_size*sqrt(ratio) (the face-detection
+    prior ladder)."""
+    input, image = as_tensor(input), as_tensor(image)
+    H, W = input.shape[2], input.shape[3]
+    Him, Wim = image.shape[2], image.shape[3]
+    step_w = steps[0] if steps and steps[0] > 0 else Wim / W
+    step_h = steps[1] if steps and steps[1] > 0 else Him / H
+    # per-cell (dx, dy, w, h) ladder (densities[k] pairs fixed_sizes[k])
+    ladder = []
+    for fs, dens in zip(fixed_sizes, densities):
+        for ar in fixed_ratios:
+            bw = float(fs) * math.sqrt(ar)
+            bh = float(fs) / math.sqrt(ar)
+            shift = step_w / dens
+            for di in range(dens):
+                for dj in range(dens):
+                    cx_off = (dj + 0.5) * shift - step_w / 2
+                    cy_off = (di + 0.5) * shift - step_h / 2
+                    ladder.append((cx_off, cy_off, bw, bh))
+    P = len(ladder)
+
+    def fn(_x, _im):
+        cx0 = (jnp.arange(W, dtype=jnp.float32) + offset) * step_w
+        cy0 = (jnp.arange(H, dtype=jnp.float32) + offset) * step_h
+        offs = jnp.asarray(ladder, jnp.float32)         # [P, 4]
+        cx = jnp.broadcast_to(cx0[None, :, None]
+                              + offs[None, None, :, 0], (H, W, P))
+        cy = jnp.broadcast_to(cy0[:, None, None]
+                              + offs[None, None, :, 1], (H, W, P))
+        bw = offs[:, 2] / 2
+        bh = offs[:, 3] / 2
+        out = jnp.stack([(cx - bw) / Wim, (cy - bh) / Him,
+                         (cx + bw) / Wim, (cy + bh) / Him], -1)
+        if clip:
+            out = jnp.clip(out, 0.0, 1.0)
+        var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                               (H, W, P, 4))
+        return out, var
+    return run_op('density_prior_box', fn, [input, image])

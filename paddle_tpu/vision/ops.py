@@ -156,7 +156,8 @@ def roi_pool(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0,
 from .detection import (  # noqa: E402,F401
     yolo_box, prior_box, box_coder, anchor_generator, box_clip,
     iou_similarity, bipartite_match, multiclass_nms, matrix_nms,
-    generate_proposals, deform_conv2d)
+    generate_proposals, deform_conv2d, distribute_fpn_proposals,
+    collect_fpn_proposals, psroi_pool, density_prior_box)
 
 
 _DEFORM_CONV_CLS = None

@@ -460,3 +460,77 @@ class TestDeformConv:
         for t in (x, wgt, offset):
             assert t.grad is not None
             assert np.isfinite(np.asarray(t.grad.data)).all()
+
+
+class TestFpnOps:
+    def test_distribute_fpn_proposals(self):
+        rois = np.array([[0, 0, 16, 16],        # small → low level
+                         [0, 0, 112, 112],      # ~refer scale
+                         [0, 0, 450, 450],      # big → high level
+                         [0, 0, 60, 60]], 'float32')
+        multi, counts, restore = D.distribute_fpn_proposals(
+            _t(rois), min_level=2, max_level=5, refer_level=4,
+            refer_scale=224)
+        m = np.asarray(multi.data)
+        c = np.asarray(counts.data)
+        r = np.asarray(restore.data)
+        # numpy oracle of the level rule
+        exp_lvl = []
+        for b in rois:
+            s = np.sqrt((b[2] - b[0]) * (b[3] - b[1]))
+            exp_lvl.append(int(np.clip(np.floor(4 + np.log2(s / 224
+                                                            + 1e-12)),
+                                       2, 5)) - 2)
+        for li in range(4):
+            assert c[li] == exp_lvl.count(li)
+        # each roi appears in its level at the position restore encodes
+        flat = []
+        for li in range(4):
+            flat.extend(m[li][:c[li]].tolist())
+        flat = np.asarray(flat)
+        for i, b in enumerate(rois):
+            np.testing.assert_allclose(flat[r[i]], b)
+
+    def test_collect_fpn_proposals(self):
+        multi_rois = np.zeros((2, 3, 4), 'float32')
+        multi_scores = np.full((2, 3), -np.inf, 'float32')
+        multi_rois[0, 0] = [1, 1, 2, 2]
+        multi_scores[0, 0] = 0.9
+        multi_rois[1, 0] = [3, 3, 4, 4]
+        multi_scores[1, 0] = 0.7
+        multi_rois[1, 1] = [5, 5, 6, 6]
+        multi_scores[1, 1] = 0.95
+        rois, scores, cnt = D.collect_fpn_proposals(
+            _t(multi_rois), _t(multi_scores), post_nms_top_n=2)
+        assert int(np.asarray(cnt.data)) == 2
+        np.testing.assert_allclose(np.asarray(scores.data), [0.95, 0.9])
+        np.testing.assert_allclose(np.asarray(rois.data)[0], [5, 5, 6, 6])
+
+    def test_psroi_pool_position_sensitivity(self):
+        # channel value = its index; a 2x2 psroi over a full-image roi
+        # must read channel c*4+i*2+j in bin (i, j)
+        oc, ph, pw = 3, 2, 2
+        x = np.zeros((1, oc * ph * pw, 4, 4), 'float32')
+        for ch in range(oc * ph * pw):
+            x[0, ch] = ch
+        boxes = np.array([[0, 0, 4, 4]], 'float32')
+        out = D.psroi_pool(_t(x), _t(boxes), oc, 1.0, ph, pw)
+        o = np.asarray(out.data)
+        assert o.shape == (1, oc, ph, pw)
+        for c in range(oc):
+            for i in range(ph):
+                for j in range(pw):
+                    np.testing.assert_allclose(o[0, c, i, j],
+                                               c * 4 + i * 2 + j)
+
+    def test_density_prior_box_shapes_and_centers(self):
+        x = np.zeros((1, 8, 4, 4), 'float32')
+        img = np.zeros((1, 3, 32, 32), 'float32')
+        boxes, var = D.density_prior_box(
+            _t(x), _t(img), densities=[2], fixed_sizes=[8.0],
+            fixed_ratios=[1.0], clip=True)
+        b = np.asarray(boxes.data)
+        assert b.shape == (4, 4, 4, 4)      # 2x2 density grid per cell
+        # the 2x2 sub-centers straddle the cell center symmetrically
+        cx = (b[1, 1, :, 0] + b[1, 1, :, 2]) / 2 * 32
+        assert cx.min() < 12.0 < cx.max()
