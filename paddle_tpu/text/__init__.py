@@ -3,3 +3,5 @@ from . import datasets
 from .datasets import Imdb, Imikolov, UCIHousing, WMT14, Conll05st
 from ..ops.sequence import (viterbi_decode, ViterbiDecoder,
                             linear_chain_crf, crf_decoding, beam_search)
+from . import models  # noqa: F401,E402
+from .models import LSTMSentiment, BoWClassifier  # noqa: F401,E402

@@ -1,0 +1,71 @@
+"""Text model families.
+
+Reference parity: the hapi/text example models the reference ships —
+the BiLSTM sentiment classifier (hapi sentiment/imdb example: embedding →
+(bi)LSTM → pooled FC head) and the bag-of-embeddings text classifier —
+wired over paddle_tpu.nn's scan-based RNN stack (the fused-LSTM analogue
+on TPU: the whole sequence loop is ONE lax.scan inside the jitted step,
+which is what the reference's fused_lstm kernel buys on GPU).
+"""
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.tensor import Tensor
+from ..ops import math as M
+from ..ops import manip
+
+
+class LSTMSentiment(nn.Layer):
+    """Embedding → LSTM (optionally bidirectional) → last-state FC head."""
+
+    def __init__(self, vocab_size=10000, embed_dim=64, hidden=64,
+                 num_classes=2, num_layers=1, direction='forward',
+                 dropout=0.0, padding_idx=0):
+        super().__init__()
+        if dropout:
+            raise NotImplementedError(
+                "inter-layer RNN dropout is not applied by the scan-based "
+                "LSTM stack yet; pass dropout=0")
+        self.embedding = nn.Embedding(vocab_size, embed_dim,
+                                      padding_idx=padding_idx)
+        self.lstm = nn.LSTM(embed_dim, hidden, num_layers=num_layers,
+                            direction=direction)
+        n_dir = 2 if direction in ('bidirect', 'bidirectional') else 1
+        self.head = nn.Linear(hidden * n_dir, num_classes)
+        self.n_dir = n_dir
+        self.padding_idx = padding_idx
+
+    def forward(self, ids):
+        x = self.embedding(ids)                   # [N, T, E]
+        out, (h, c) = self.lstm(x)                # out [N, T, H*dir]
+        # padding-robust mean-pool over valid positions (the last-state
+        # read would fold trailing pad steps into the summary)
+        mask = (ids != self.padding_idx).astype('float32')
+        summed = M.sum(M.multiply(out, manip.unsqueeze(mask, [-1])),
+                       axis=1)
+        denom = manip.unsqueeze(
+            M.maximum(M.sum(mask, axis=1), Tensor(jnp.asarray(1.0))),
+            [-1])
+        return self.head(M.divide(summed, denom))
+
+
+class BoWClassifier(nn.Layer):
+    """Bag-of-embeddings text classifier (the hapi bow example)."""
+
+    def __init__(self, vocab_size=10000, embed_dim=64, num_classes=2,
+                 padding_idx=0):
+        super().__init__()
+        self.embedding = nn.Embedding(vocab_size, embed_dim,
+                                      padding_idx=padding_idx)
+        self.fc = nn.Linear(embed_dim, num_classes)
+        self.padding_idx = padding_idx
+
+    def forward(self, ids):
+        emb = self.embedding(ids)                 # [N, T, E]
+        mask = (ids != self.padding_idx).astype('float32')
+        summed = M.sum(M.multiply(emb, manip.unsqueeze(mask, [-1])),
+                       axis=1)
+        denom = manip.unsqueeze(
+            M.maximum(M.sum(mask, axis=1), Tensor(jnp.asarray(1.0))),
+            [-1])
+        return self.fc(M.divide(summed, denom))

@@ -1,0 +1,57 @@
+"""Text model families (hapi sentiment/bow example parity):
+LSTM classifier with padding-robust pooling + bag-of-embeddings."""
+import numpy as np
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+def _t(a):
+    return Tensor(jnp.asarray(a))
+
+
+class TestTextModels:
+    def _toy_text(self, n=128, T=16, seed=0):
+        """Synthetic sentiment: class 1 iff 'positive' tokens (<50)
+        outnumber 'negative' ones (>=50); 0 is padding."""
+        rng = np.random.RandomState(seed)
+        ids = rng.randint(1, 100, (n, T)).astype('int64')
+        ids[:, T - 4:] = 0                      # padded tail
+        y = ((ids < 50) & (ids > 0)).sum(1) > ((ids >= 50).sum(1))
+        return ids, y.astype('int64')
+
+    def test_lstm_sentiment_trains(self):
+        from paddle_tpu.text import LSTMSentiment
+        paddle.seed(5)
+        ids, y = self._toy_text()
+        m = LSTMSentiment(vocab_size=100, embed_dim=16, hidden=16,
+                          direction='bidirect')
+        opt = paddle.optimizer.Adam(learning_rate=5e-3,
+                                    parameters=m.parameters())
+        losses = []
+        for _ in range(30):
+            logits = m(_t(ids))
+            loss = paddle.nn.functional.cross_entropy(logits, _t(y))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < 0.6 * losses[0], (losses[0], losses[-1])
+        pred = np.argmax(np.asarray(m(_t(ids)).data), -1)
+        assert (pred == y).mean() > 0.8
+
+    def test_bow_classifier_trains(self):
+        from paddle_tpu.text import BoWClassifier
+        paddle.seed(6)
+        ids, y = self._toy_text(seed=1)
+        m = BoWClassifier(vocab_size=100, embed_dim=16)
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=m.parameters())
+        for _ in range(40):
+            loss = paddle.nn.functional.cross_entropy(m(_t(ids)), _t(y))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        pred = np.argmax(np.asarray(m(_t(ids)).data), -1)
+        assert (pred == y).mean() > 0.85
