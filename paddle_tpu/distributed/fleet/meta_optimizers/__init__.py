@@ -318,18 +318,49 @@ class DGCOptimizer(MetaOptimizerBase):
 
 
 class FP16AllReduceOptimizer(MetaOptimizerBase):
-    """Parity: fp16_allreduce_optimizer.py — grads cast to bf16 for
-    allreduce."""
+    """Parity: fp16_allreduce_optimizer.py — each parameter gradient is
+    rounded through bf16 immediately after its producing backward op,
+    BEFORE any collective consumes it, so the replay computes exactly the
+    numerics of a half-width exchange (each rank's contribution rounded,
+    then summed). The down/up pair is one fused op — XLA folds it into
+    the collective's input; the eager DataParallel path puts literal bf16
+    buckets on the wire (parallel.py)."""
 
     def _can_apply(self):
         return bool(self.user_defined_strategy.fp16_allreduce)
 
     def minimize_impl(self, loss, startup_program=None, parameter_list=None,
                       no_grad_set=None):
+        import jax.numpy as jnp
+        from ....static.program import Operator, OpRole
+        out = self.inner_opt.minimize(loss, startup_program,
+                                      parameter_list, no_grad_set)
         prog = loss.block.program
-        prog._fp16_allreduce = True
-        return self.inner_opt.minimize(loss, startup_program,
-                                       parameter_list, no_grad_set)
+        block = prog.global_block()
+        grad_names = {g for g in prog._grad_map.values()
+                      if g in block.vars}
+        COLLECTIVES = {'c_allreduce_sum', 'c_reduce_sum', 'c_broadcast'}
+        new_ops = []
+        pending = set(grad_names)
+        for i, op in enumerate(block.ops):
+            new_ops.append(op)
+            if op.type in COLLECTIVES:
+                continue        # never cast after the exchange
+            for gname in list(pending):
+                if gname in op.output_names and not any(
+                        gname in later.output_names
+                        for later in block.ops[i + 1:]
+                        if later.type not in COLLECTIVES
+                        and not (later.op_role & OpRole.Optimize)):
+                    cast = Operator(
+                        'cast_fp16_allreduce',
+                        lambda g: g.astype(jnp.bfloat16).astype(g.dtype),
+                        [gname], [gname], {'wire_dtype': 'bfloat16'},
+                        op_role=OpRole.Backward)
+                    new_ops.append(cast)
+                    pending.discard(gname)
+        block.ops = new_ops
+        return out
 
 
 class ASPOptimizer(MetaOptimizerBase):

@@ -21,12 +21,15 @@ class DataParallel(Layer):
 
     def __init__(self, layers, strategy=None, comm_buffer_size=25,
                  last_comm_buffer_size=1, find_unused_parameters=False,
-                 group=None):
+                 group=None, fp16_allreduce=False):
         super().__init__()
         self._layers = layers
         self.group = group
         self.comm_buffer_size_mb = comm_buffer_size
         self.find_unused_parameters = find_unused_parameters
+        # compress grads to bf16 on the wire (parity:
+        # fp16_allreduce_optimizer.py; bf16 is the TPU-native half format)
+        self.fp16_allreduce = fp16_allreduce
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)
@@ -60,10 +63,13 @@ class DataParallel(Layer):
         for bucket in buckets:
             flat = jnp.concatenate([p.grad.data.reshape(-1)
                                     for p in bucket])
+            wire_dtype = flat.dtype
+            if self.fp16_allreduce:
+                flat = flat.astype(jnp.bfloat16)
             t = Tensor(flat)
             collective.all_reduce(t, group=self.group)
             scale = 1.0 / get_world_size(self.group)
-            flat = t.data * scale
+            flat = t.data.astype(wire_dtype) * scale
             off = 0
             for p in bucket:
                 n = p.grad.size

@@ -441,3 +441,126 @@ class TestMetaOptimizerGolden:
         s2.load_from_prototxt(path)
         assert s2.amp is True
         assert s2.hybrid_configs['mp_degree'] == 4
+
+
+def test_fp16_allreduce_strategy_rewrites_and_runs():
+    """FP16AllReduce meta-optimizer inserts the bf16 wire-cast per grad
+    (fp16_allreduce_optimizer.py parity) and the program still trains."""
+    import paddle_tpu.distributed.fleet as fleet
+    import os
+    os.environ.setdefault('PADDLE_TRAINER_ID', '0')
+    fleet.fleet._hcg = None
+    paddle.seed(0)
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data('x', [8, 4])
+        label = static.data('label', [8, 1])
+        y = static.nn.fc(x, 1)
+        loss = paddle.mean((y - label) * (y - label))
+    s = fleet.DistributedStrategy()
+    s.fp16_allreduce = True
+    fleet.init(is_collective=True, strategy=s)
+    opt = paddle.optimizer.SGD(learning_rate=0.1)
+    opt = fleet.fleet.distributed_optimizer(opt)
+    fleet.fleet.minimize(loss)
+    types = [op.type for op in main.global_block().ops]
+    n_grads = len(main._grad_map)
+    assert types.count('cast_fp16_allreduce') == n_grads and n_grads >= 2
+    # casts sit after backward, before the first optimize op
+    first_cast = types.index('cast_fp16_allreduce')
+    from paddle_tpu.static.program import OpRole
+    first_opt = next(i for i, op in enumerate(main.global_block().ops)
+                     if op.op_role & OpRole.Optimize)
+    assert first_cast < first_opt
+    exe = static.Executor()
+    rng = np.random.RandomState(0)
+    xs = rng.rand(8, 4).astype('float32')
+    ys = (xs.sum(1, keepdims=True) * 0.5).astype('float32')
+    with static.scope_guard(static.Scope()):
+        losses = [float(exe.run(main, feed={'x': xs, 'label': ys},
+                                fetch_list=[loss])[0])
+                  for _ in range(40)]
+    assert losses[-1] < 0.3 * losses[0]
+
+
+def test_dataparallel_fp16_allreduce_wire_dtype():
+    """DataParallel(fp16_allreduce=True) puts bf16 on the wire and
+    restores the grad dtype."""
+    import jax.numpy as jnp
+    from paddle_tpu.distributed import parallel as par
+    from paddle_tpu.distributed import collective as C
+    paddle.disable_static()    # eager path (module fixture enables static)
+    try:
+        _dp_fp16_allreduce_body()
+    finally:
+        paddle.enable_static()   # restore for the module fixture
+
+
+def _dp_fp16_allreduce_body():
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu.distributed import collective as C
+    paddle.seed(1)
+    model = paddle.nn.Linear(4, 2)
+    dp = paddle.DataParallel(model, fp16_allreduce=True)
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .rand(4, 4).astype('float32'))
+    loss = dp(x).sum()
+    loss.backward()
+    seen = {}
+    orig = C.all_reduce
+
+    def spy(tensor, *a, **k):
+        seen['dtype'] = tensor.data.dtype
+        return orig(tensor, *a, **k)
+    # force the bucket path even at world_size 1
+    import paddle_tpu.distributed.parallel as pmod
+    orig_ws = pmod.get_world_size
+    pmod.get_world_size = lambda g=None: 2
+    C_orig = pmod.collective.all_reduce
+    pmod.collective.all_reduce = spy
+    try:
+        dp.apply_collective_grads()
+    finally:
+        pmod.collective.all_reduce = C_orig
+        pmod.get_world_size = orig_ws
+    assert seen['dtype'] == jnp.bfloat16
+    for p in model.parameters():
+        assert p.grad.data.dtype == jnp.float32
+
+
+def test_fp16_allreduce_casts_precede_collectives():
+    """With sharding rewrites in the chain, the bf16 rounding must land
+    BEFORE the c_reduce/c_allreduce consuming each grad — rounding after
+    the exchange would model the wrong numerics (review r3)."""
+    import paddle_tpu.distributed.fleet as fleet
+    import os
+    os.environ.setdefault('PADDLE_TRAINER_ID', '0')
+    fleet.fleet._hcg = None
+    paddle.seed(0)
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data('x', [8, 4])
+        y = static.nn.fc(x, 4)
+        loss = paddle.mean(y * y)
+    s = fleet.DistributedStrategy()
+    s.fp16_allreduce = True
+    s.sharding = True
+    s.sharding_configs = {'sharding_degree': 2}
+    fleet.init(is_collective=True, strategy=s)
+    opt = paddle.optimizer.SGD(learning_rate=0.1)
+    opt = fleet.fleet.distributed_optimizer(opt)
+    fleet.fleet.minimize(loss)
+    ops = main.global_block().ops
+    checked = 0
+    for gname in main._grad_map.values():
+        cast_i = [i for i, op in enumerate(ops)
+                  if op.type == 'cast_fp16_allreduce'
+                  and gname in op.output_names]
+        coll_i = [i for i, op in enumerate(ops)
+                  if op.type in ('c_allreduce_sum', 'c_reduce_sum')
+                  and gname in op.input_names]
+        if cast_i and coll_i:
+            assert max(cast_i) < min(coll_i), (gname, cast_i, coll_i)
+            checked += 1
+    assert checked >= 1        # the assertion above must not be vacuous
