@@ -326,6 +326,11 @@ class FP16AllReduceOptimizer(MetaOptimizerBase):
     the collective's input; the eager DataParallel path puts literal bf16
     buckets on the wire (parallel.py)."""
 
+    # compose ON TOP of the rewrites that insert the collectives — the
+    # casts must see them to land before the exchange
+    meta_optimizers_white_list = ['ShardingOptimizer', 'RecomputeOptimizer',
+                                  'AMPOptimizer', 'DGCOptimizer']
+
     def _can_apply(self):
         return bool(self.user_defined_strategy.fp16_allreduce)
 
@@ -339,27 +344,34 @@ class FP16AllReduceOptimizer(MetaOptimizerBase):
         block = prog.global_block()
         grad_names = {g for g in prog._grad_map.values()
                       if g in block.vars}
-        COLLECTIVES = {'c_allreduce_sum', 'c_reduce_sum', 'c_broadcast'}
-        new_ops = []
-        pending = set(grad_names)
-        for i, op in enumerate(block.ops):
-            new_ops.append(op)
-            if op.type in COLLECTIVES:
-                continue        # never cast after the exchange
-            for gname in list(pending):
-                if gname in op.output_names and not any(
-                        gname in later.output_names
-                        for later in block.ops[i + 1:]
-                        if later.type not in COLLECTIVES
-                        and not (later.op_role & OpRole.Optimize)):
-                    cast = Operator(
-                        'cast_fp16_allreduce',
-                        lambda g: g.astype(jnp.bfloat16).astype(g.dtype),
-                        [gname], [gname], {'wire_dtype': 'bfloat16'},
-                        op_role=OpRole.Backward)
-                    new_ops.append(cast)
-                    pending.discard(gname)
-        block.ops = new_ops
+        COLLECTIVES = {'c_allreduce_sum', 'c_reduce_sum'}
+
+        def _make_cast(gname):
+            return Operator(
+                'cast_fp16_allreduce',
+                lambda g: g.astype(jnp.bfloat16).astype(g.dtype),
+                [gname], [gname], {'wire_dtype': 'bfloat16'},
+                op_role=OpRole.Backward)
+
+        # insertion point per grad: immediately BEFORE the first
+        # collective consuming it (the exchange); with no collective in
+        # the program, before the first Optimize consumer
+        inserts = []            # (position, gname)
+        for gname in grad_names:
+            pos = None
+            for i, op in enumerate(block.ops):
+                if op.type in COLLECTIVES and gname in op.input_names:
+                    pos = i
+                    break
+            if pos is None:
+                for i, op in enumerate(block.ops):
+                    if (op.op_role & OpRole.Optimize)                             and gname in op.input_names:
+                        pos = i
+                        break
+            if pos is not None:
+                inserts.append((pos, gname))
+        for pos, gname in sorted(inserts, reverse=True):
+            block.ops.insert(pos, _make_cast(gname))
         return out
 
 
