@@ -171,3 +171,23 @@ class TestMHAFlashRouting:
         np.testing.assert_allclose(np.asarray(out.data),
                                    np.asarray(ref.data), rtol=2e-4,
                                    atol=2e-4)
+
+
+class TestBlockFitting:
+    def test_non_divisible_length_correct(self):
+        """L=768 (divisible by 256, not 512): the block must shrink to a
+        divisor — a clamped last slice would silently misalign the causal
+        mask (review r3)."""
+        bh, L, d = 2, 768, 16
+        q, k, v = (jnp.asarray(_rand((bh, L, d), s)) for s in (20, 21, 22))
+        o = fa.flash_attention(q, k, v, causal=True)
+        ref = fa._reference_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_fit_block(self):
+        assert fa._fit_block(512, 2048) == 512
+        assert fa._fit_block(512, 768) == 256
+        assert fa._fit_block(512, 100) == 25 or fa._fit_block(512, 100) in (4, 25, 100)
+        assert 100 % fa._fit_block(512, 100) == 0
+        assert fa._fit_block(512, 7) == 7    # prime: single block
