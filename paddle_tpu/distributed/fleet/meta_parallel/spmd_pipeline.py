@@ -168,13 +168,23 @@ class SpmdPipelineEngine:
 
     def __init__(self, embed, blocks, head, optimizer, accumulate_steps,
                  mesh=None, use_remat=True, schedule='1F1B',
-                 grad_accum_dtype='float32'):
+                 grad_accum_dtype='float32', memory_mode='stash'):
         self.embed = embed
         self.blocks = blocks
         self.head = head
         self.optimizer = optimizer
         self.A = accumulate_steps
         self.use_remat = use_remat
+        # 1F1B backward source: 'stash' (default) keeps each in-flight
+        # microbatch's vjp residuals — the reference SectionWorker's
+        # store-activations schedule (section_worker.cc:147-184) — so
+        # backward never re-runs the stage forward; 'recompute' keeps only
+        # the stage INPUT per in-flight microbatch and re-derives the
+        # residuals inside the backward tick (lower memory, +1 fwd FLOPs).
+        if memory_mode not in ('stash', 'recompute'):
+            raise ValueError(f"memory_mode must be 'stash' or 'recompute', "
+                             f"got {memory_mode!r}")
+        self.memory_mode = memory_mode
         # 1F1B microbatch-grad accumulator dtype: float32 (default) or
         # 'param' to accumulate in the parameter dtype — halves the
         # accumulator HBM for bf16 models when memory-bound (single-chip
@@ -280,11 +290,24 @@ class SpmdPipelineEngine:
         return self._build_fthenb()
 
     # -- shared tail of both schedules ---------------------------------------
-    def _make_stage_forward(self):
-        """(block_params_local, x, key) -> x: scan this stage's blocks."""
+    def _make_stage_forward(self, save_dots=False):
+        """(block_params_local, x, key) -> x: scan this stage's blocks.
+
+        save_dots: instead of full per-block rematerialization, checkpoint
+        with a save-MXU-outputs policy — the backward recomputes only the
+        cheap elementwise tail (layernorm/gelu/softmax), not the matmuls.
+        Used by the activation-stashing 1F1B, whose O(pp) in-flight window
+        makes the bigger residual set affordable (the reference
+        SectionWorker likewise stores, not recomputes)."""
         block_apply = functools.partial(self._block_apply, self.blocks[0])
         if self.use_remat:
-            block_apply = jax.checkpoint(block_apply)
+            if save_dots:
+                policy = getattr(jax.checkpoint_policies, 'dots_saveable',
+                                 None) or \
+                    jax.checkpoint_policies.checkpoint_dots
+                block_apply = jax.checkpoint(block_apply, policy=policy)
+            else:
+                block_apply = jax.checkpoint(block_apply)
 
         def stage_forward(block_params_local, x, key):
             def body(carry, xs):
@@ -367,6 +390,67 @@ class SpmdPipelineEngine:
                            out_specs=out_specs, check_rep=False)
         return jax.jit(mapped, donate_argnums=(0, 1))
 
+    @staticmethod
+    def _split_residuals(fn, args, variant_argnums):
+        """Taint-split the flattened outputs of ``fn(*args)`` into
+        tick-VARIANT ones (those depending on the arguments named in
+        ``variant_argnums``) and tick-INVARIANT ones, and evaluate the
+        invariant ones once by running only their pruned sub-graph (weight
+        casts/transposes — never the stage forward).
+
+        The taint walk is a conservative jaxpr pass: any eqn with a
+        tainted operand taints all its outputs (higher-order primitives
+        are treated atomically — sound because scan/cond/pjit consts are
+        hoisted to explicit invars in final-style jaxprs). Used to split
+        per-microbatch vjp residuals into activation residuals (buffered
+        per in-flight microbatch) and weight-derived residuals (computed
+        once per step, shared by every tick). An output misclassified as
+        variant merely wastes buffer space; it can never produce a wrong
+        gradient.
+
+        Returns ``(variant_flags, values)`` where ``values[i]`` holds the
+        invariant output value, or None at variant positions."""
+        closed = jax.make_jaxpr(fn)(*args)
+        jaxpr = closed.jaxpr
+        variant_flat = []
+        for i, a in enumerate(args):
+            n = len(jax.tree_util.tree_leaves(a))
+            variant_flat += [i in variant_argnums] * n
+        tainted = set()
+        for var, isv in zip(jaxpr.invars, variant_flat):
+            if isv:
+                tainted.add(var)
+
+        def _is_tainted(v):
+            return not hasattr(v, 'val') and v in tainted  # Literal: .val
+
+        for eqn in jaxpr.eqns:
+            if any(_is_tainted(v) for v in eqn.invars):
+                tainted.update(eqn.outvars)
+        flags = [_is_tainted(v) for v in jaxpr.outvars]
+
+        # dead-code-eliminate from the invariant outputs, then evaluate
+        # just that sub-graph (it never touches a variant input, so this
+        # runs no microbatch compute)
+        want = [v for v, f in zip(jaxpr.outvars, flags) if not f]
+        needed = {v for v in want if not hasattr(v, 'val')}
+        keep = []
+        for eqn in reversed(jaxpr.eqns):
+            if any(o in needed for o in eqn.outvars):
+                keep.append(eqn)
+                needed.update(v for v in eqn.invars
+                              if not hasattr(v, 'val'))
+        keep.reverse()
+        pruned = jaxpr.replace(eqns=keep, outvars=want)
+        flat_args = jax.tree_util.tree_leaves(args)
+        inv_vals = jax.core.eval_jaxpr(pruned, closed.consts, *flat_args)
+        values = [None] * len(flags)
+        it = iter(inv_vals)
+        for i, f in enumerate(flags):
+            if not f:
+                values[i] = next(it)
+        return flags, values
+
     def _build_1f1b(self):
         """1F1B steady-state schedule (section_worker.cc:147-184 parity).
 
@@ -377,15 +461,26 @@ class SpmdPipelineEngine:
         masking outside the active windows. Activations flow +1 over the
         'pp' ring and cotangents flow -1, one `lax.ppermute` each per tick.
 
-        Memory: only the stage-INPUT activation of each in-flight
-        microbatch is kept, in a circular buffer of B = min(A, 2*pp-1)
-        slots; backward re-runs the stage from the saved input via a
-        local `jax.vjp` consumed in the same tick (full-remat cost, same
-        as the F-then-B path's jax.checkpoint). Live boundary activations
-        are therefore O(pp), not O(A) — the reference 1F1B's memory
-        property (in-flight <= 2*(pp-1)+1 here vs Megatron's pp: the
-        constant-factor price of every stage doing fwd+bwd each tick in
-        lockstep). Stage 0 embeds each microbatch on its tick — no
+        Memory/compute, per ``memory_mode``:
+          * 'stash' (default — the reference SectionWorker's
+            store-activations 1F1B): the forward sub-step runs under
+            `jax.vjp`, and the pullback — a `jax.tree_util.Partial`, i.e.
+            a real pytree of residual arrays — is flattened; the
+            tick-VARIANT residual leaves (activations; identified by
+            `_split_residuals`) go into a circular buffer of
+            B = min(A, 2*pp-1) slots, while weight-derived leaves are
+            taken from the current tick's forward call (tick-invariant,
+            so bit-identical). The backward sub-step unflattens the
+            pullback from the buffered slot and applies it — the stage
+            forward is never re-run. Stage FLOPs: fwd + bwd.
+          * 'recompute': only the stage-INPUT activation of each
+            in-flight microbatch is buffered; backward re-runs the stage
+            from the saved input via a local `jax.vjp` consumed in the
+            same tick (full-remat cost). Lower memory, +1 fwd FLOPs.
+        Either way live state is O(pp), not O(A) — the reference 1F1B's
+        memory property (in-flight <= 2*(pp-1)+1 here vs Megatron's pp:
+        the constant-factor price of every stage doing fwd+bwd each tick
+        in lockstep). Stage 0 embeds each microbatch on its tick — no
         [A, mb, L, H] up-front buffer.
         """
         A, pp = self.A, self.pp
@@ -394,9 +489,16 @@ class SpmdPipelineEngine:
         opt = self.optimizer
         dp_on = 'dp' in axes and self.mesh.shape['dp'] > 1
         use_scaling = self._use_scaling
+        stash = self.memory_mode == 'stash'
         B = min(A, 2 * pp - 1)
         T = A + 2 * (pp - 1)
-        stage_forward = self._make_stage_forward()
+        # pp=1: backward always consumes the SAME tick's forward (m_b ==
+        # m_f), so nothing crosses ticks — no residual buffering, and full
+        # per-block remat stays the memory-safe choice for the single-chip
+        # memory-bound configs (the save-dots residual set there would
+        # cover the WHOLE model, not one stage)
+        save_dots = stash and pp > 1
+        stage_forward = self._make_stage_forward(save_dots=save_dots)
 
         def step(params, states, lr, scale, key, input_ids, labels):
             with C.spmd_region(axes):
@@ -462,61 +564,206 @@ class SpmdPipelineEngine:
                     lambda a: jnp.zeros(
                         a.shape, a.dtype if acc_param else jnp.float32),
                     (pe, pb, ph))
-                carry0 = (jnp.zeros(act_shape, act_dtype),          # fwd act
-                          jnp.zeros(act_shape, act_dtype),          # cotangent
-                          jnp.zeros((B,) + act_shape, act_dtype),   # inputs buf
-                          gacc0,
-                          jnp.asarray(0.0, jnp.float32))            # loss acc
 
-                def tick(carry, t):
-                    fwd_act, grad_in, buf, gacc, loss_acc = carry
-
-                    # ---- forward sub-step: microbatch m_f = t - stage ----
-                    m_f = t - stage
-                    f_active = (m_f >= 0) & (m_f < A)
-                    m_fc = jnp.clip(m_f, 0, A - 1)
-                    out_f = fwd_only(pe, pb, fwd_act, m_fc,
-                                     jax.random.fold_in(k0, m_fc))
-                    # stash this microbatch's stage input for its backward
-                    slot_f = jnp.mod(m_fc, B)
-                    old = lax.dynamic_index_in_dim(buf, slot_f, 0,
-                                                   keepdims=False)
-                    buf = lax.dynamic_update_index_in_dim(
-                        buf, jnp.where(f_active, fwd_act, old), slot_f, 0)
-
-                    # ---- backward sub-step: m_b = t - (2(pp-1) - stage) --
-                    m_b = t - (2 * (pp - 1) - stage)
-                    b_active = (m_b >= 0) & (m_b < A)
-                    m_bc = jnp.clip(m_b, 0, A - 1)
-                    x_saved = lax.dynamic_index_in_dim(buf, jnp.mod(m_bc, B),
-                                                       0, keepdims=False)
-                    k_b = jax.random.fold_in(k0, m_bc)
-                    (_out_p, loss_p), vjp_fn = jax.vjp(
-                        lambda p3, x: full_fn(p3, x, m_bc, k_b),
-                        (pe, pb, ph), x_saved)
-                    g_out = jnp.where(is_last, jnp.zeros_like(_out_p),
-                                      grad_in.astype(_out_p.dtype))
-                    cot = (scale / A).astype(jnp.float32) \
+                def grad_cot():
+                    return (scale / A).astype(jnp.float32) \
                         if use_scaling else jnp.asarray(1.0 / A,
                                                         jnp.float32)
-                    d_p3, dx = vjp_fn((g_out, cot))
-                    gacc = jax.tree_util.tree_map(
+
+                def accum(gacc, d_p3, b_active):
+                    return jax.tree_util.tree_map(
                         lambda a, g: a + jnp.where(
                             b_active, g.astype(a.dtype),
                             jnp.zeros((), a.dtype)),
                         gacc, d_p3)
-                    loss_acc = loss_acc + jnp.where(b_active, loss_p, 0.0)
-                    dx = jnp.where(b_active, dx, jnp.zeros_like(dx))
 
-                    if pp > 1:
-                        nxt_act = lax.ppermute(
-                            out_f, 'pp',
-                            [(i, (i + 1) % pp) for i in range(pp)])
-                        nxt_grad = lax.ppermute(
-                            dx, 'pp', [(i, (i - 1) % pp) for i in range(pp)])
-                    else:
-                        nxt_act, nxt_grad = out_f, dx
-                    return (nxt_act, nxt_grad, buf, gacc, loss_acc), None
+                if stash:
+                    # -- activation-stashing 1F1B ------------------------
+                    box = {}
+
+                    def fwd_probe(p3, x_in, m, k_mb):
+                        (out, loss), vjp_fn = jax.vjp(
+                            lambda p, xx: full_fn(p, xx, m, k_mb),
+                            p3, x_in)
+                        leaves, treedef = jax.tree_util.tree_flatten(vjp_fn)
+                        box['treedef'] = treedef
+                        return out, loss, leaves
+
+                    probe_args = ((pe, pb, ph),
+                                  jnp.zeros(act_shape, act_dtype),
+                                  jnp.asarray(0, jnp.int32), k0)
+                    shapes = jax.eval_shape(fwd_probe, *probe_args)
+                    leaf_shapes = shapes[2]
+                    flags, inv_vals = self._split_residuals(
+                        fwd_probe, probe_args, {1, 2, 3})
+                    leaf_var = flags[2:]
+                    inv_leaves = inv_vals[2:]
+                    var_idx = [i for i, v in enumerate(leaf_var) if v]
+                    # B real slots + 1 scratch slot: inactive forward ticks
+                    # write to the scratch slot, so the hot path is a pure
+                    # dynamic-update (no read-old + select per leaf, which
+                    # would force XLA to materialize a buffer copy per tick
+                    # instead of updating the loop carry in place).
+                    # pp=1: same-tick consumption — no buffers at all.
+                    bufs0 = tuple(
+                        jnp.zeros((B + 1,) + tuple(leaf_shapes[i].shape),
+                                  leaf_shapes[i].dtype)
+                        for i in var_idx) if pp > 1 else ()
+                    carry0 = (jnp.zeros(act_shape, act_dtype),  # fwd act
+                              jnp.zeros(act_shape, act_dtype),  # cotangent
+                              bufs0,                            # residuals
+                              gacc0,
+                              jnp.asarray(0.0, jnp.float32))    # loss acc
+
+                    def tick(carry, t):
+                        fwd_act, grad_in, bufs, gacc, loss_acc = carry
+
+                        m_f = t - stage
+                        f_active = (m_f >= 0) & (m_f < A)
+                        m_fc = jnp.clip(m_f, 0, A - 1)
+                        m_b = t - (2 * (pp - 1) - stage)
+                        b_active = (m_b >= 0) & (m_b < A)
+                        m_bc = jnp.clip(m_b, 0, A - 1)
+                        slot_b = jnp.mod(m_bc, B)
+
+                        # -- forward sub-step: microbatch m_f = t - stage;
+                        # runs under vjp so its pullback's residuals
+                        # exist. Gated on the tick range in which ANY
+                        # stage still forwards — the predicate is uniform
+                        # across the mesh, so the cond's mp collectives
+                        # see uniform control flow and the bwd-only drain
+                        # ticks pay no forward at all (total work = A+pp-1
+                        # forwards + A+pp-1 backwards, same as F-then-B).
+                        def do_fwd():
+                            out, l_f, leaves = fwd_probe(
+                                (pe, pb, ph), fwd_act, m_fc,
+                                jax.random.fold_in(k0, m_fc))
+                            return (out, l_f,
+                                    [leaves[i] for i in var_idx])
+
+                        def skip_fwd():
+                            return (jnp.zeros(act_shape, act_dtype),
+                                    jnp.asarray(0.0, jnp.float32),
+                                    [jnp.zeros(tuple(leaf_shapes[i].shape),
+                                               leaf_shapes[i].dtype)
+                                     for i in var_idx])
+
+                        out_f, loss_f, vleaves = lax.cond(
+                            t < A + pp - 1, do_fwd, skip_fwd)
+                        slot_f = jnp.where(f_active, jnp.mod(m_fc, B), B)
+                        bufs = tuple(
+                            lax.dynamic_update_index_in_dim(
+                                buf, vl, slot_f, 0)
+                            for buf, vl in zip(bufs, vleaves))
+                        loss_acc = loss_acc + jnp.where(f_active, loss_f,
+                                                        0.0)
+
+                        # Reading after the write is correct: the only
+                        # same-tick producer-consumer is the last stage
+                        # (m_b == m_f), where the just-written slot is
+                        # exactly the wanted fresh data; inactive
+                        # forwards write the scratch slot so they can
+                        # never clobber a pending slot. pp=1 is ALL
+                        # same-tick: take the fresh leaves directly.
+                        gathered = vleaves if pp == 1 else [
+                            lax.dynamic_index_in_dim(
+                                buf, slot_b, 0, keepdims=False)
+                            for buf in bufs]
+
+                        # -- backward sub-step: m_b = t-(2(pp-1)-stage);
+                        # pullback rebuilt from the stashed residuals —
+                        # the stage forward is NOT re-run. Gated on the
+                        # warm-up ticks where no stage has a backward yet.
+                        def do_bwd():
+                            leaves_b = list(inv_leaves)
+                            for g, i in zip(gathered, var_idx):
+                                leaves_b[i] = g
+                            vjp_b = jax.tree_util.tree_unflatten(
+                                box['treedef'], leaves_b)
+                            g_out = jnp.where(
+                                is_last,
+                                jnp.zeros(act_shape, act_dtype),
+                                grad_in.astype(act_dtype))
+                            return vjp_b((g_out, grad_cot()))
+
+                        def skip_bwd():
+                            return (jax.tree_util.tree_map(
+                                jnp.zeros_like, (pe, pb, ph)),
+                                jnp.zeros(act_shape, act_dtype))
+
+                        d_p3, dx = lax.cond(t >= pp - 1, do_bwd, skip_bwd)
+                        gacc = accum(gacc, d_p3, b_active)
+                        dx = jnp.where(b_active, dx, jnp.zeros_like(dx))
+
+                        if pp > 1:
+                            nxt_act = lax.ppermute(
+                                out_f, 'pp',
+                                [(i, (i + 1) % pp) for i in range(pp)])
+                            nxt_grad = lax.ppermute(
+                                dx, 'pp',
+                                [(i, (i - 1) % pp) for i in range(pp)])
+                        else:
+                            nxt_act, nxt_grad = out_f, dx
+                        return (nxt_act, nxt_grad, bufs, gacc,
+                                loss_acc), None
+                else:
+                    # -- recompute 1F1B (stage-input buffer only) --------
+                    carry0 = (jnp.zeros(act_shape, act_dtype),  # fwd act
+                              jnp.zeros(act_shape, act_dtype),  # cotangent
+                              jnp.zeros((B + 1,) + act_shape,
+                                        act_dtype),             # inputs buf
+                              gacc0,
+                              jnp.asarray(0.0, jnp.float32))    # loss acc
+
+                    def tick(carry, t):
+                        fwd_act, grad_in, buf, gacc, loss_acc = carry
+
+                        m_f = t - stage
+                        f_active = (m_f >= 0) & (m_f < A)
+                        m_fc = jnp.clip(m_f, 0, A - 1)
+                        m_b = t - (2 * (pp - 1) - stage)
+                        b_active = (m_b >= 0) & (m_b < A)
+                        m_bc = jnp.clip(m_b, 0, A - 1)
+                        # read-before-write (see stash tick) + same-tick
+                        # select for the last stage
+                        x_read = lax.dynamic_index_in_dim(
+                            buf, jnp.mod(m_bc, B), 0, keepdims=False)
+                        p_same = jnp.logical_and(m_fc == m_bc, f_active)
+                        x_saved = jnp.where(p_same, fwd_act, x_read)
+
+                        # -- forward sub-step: microbatch m_f = t - stage
+                        out_f = fwd_only(pe, pb, fwd_act, m_fc,
+                                         jax.random.fold_in(k0, m_fc))
+                        # stash this microbatch's stage input (scratch slot
+                        # B absorbs inactive ticks — pure in-place update)
+                        slot_f = jnp.where(f_active, jnp.mod(m_fc, B), B)
+                        buf = lax.dynamic_update_index_in_dim(
+                            buf, fwd_act, slot_f, 0)
+
+                        # -- backward sub-step: m_b = t-(2(pp-1)-stage) --
+                        k_b = jax.random.fold_in(k0, m_bc)
+                        (_out_p, loss_p), vjp_fn = jax.vjp(
+                            lambda p3, x: full_fn(p3, x, m_bc, k_b),
+                            (pe, pb, ph), x_saved)
+                        g_out = jnp.where(is_last, jnp.zeros_like(_out_p),
+                                          grad_in.astype(_out_p.dtype))
+                        d_p3, dx = vjp_fn((g_out, grad_cot()))
+                        gacc = accum(gacc, d_p3, b_active)
+                        loss_acc = loss_acc + jnp.where(b_active, loss_p,
+                                                        0.0)
+                        dx = jnp.where(b_active, dx, jnp.zeros_like(dx))
+
+                        if pp > 1:
+                            nxt_act = lax.ppermute(
+                                out_f, 'pp',
+                                [(i, (i + 1) % pp) for i in range(pp)])
+                            nxt_grad = lax.ppermute(
+                                dx, 'pp',
+                                [(i, (i - 1) % pp) for i in range(pp)])
+                        else:
+                            nxt_act, nxt_grad = out_f, dx
+                        return (nxt_act, nxt_grad, buf, gacc,
+                                loss_acc), None
 
                 (_, _, _, gacc, loss_sum), _ = lax.scan(
                     tick, carry0, jnp.arange(T))

@@ -1,16 +1,24 @@
-"""Pipeline schedule overhead measurement (VERDICT r2 #10 evidence).
+"""Pipeline schedule overhead measurement (VERDICT r2 #10 / r3 #1 evidence).
 
 Runs the SAME model through the 1F1B and F-then-B SPMD schedules at pp=4
-on the virtual 8-device CPU mesh and reports steady-state step times plus
-the analytic FLOPs note: this 1F1B recomputes each stage's forward from
-the saved input inside its backward tick (jax.vjp from x_saved —
-spmd_pipeline.py tick()), so its stage FLOPs are fwd + (fwd + bwd) ≈
-1.5× an activation-stashing 1F1B (section_worker.cc:147-184 stores, does
-not recompute); F-then-B here uses jax.checkpoint (same full-remat cost),
-so the schedule comparison isolates schedule overhead, not remat policy.
+on the virtual 8-device CPU mesh and reports steady-state step times.
 
-Usage: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-       python tools/pipeline_bench.py
+The 1F1B default is activation-STASHING (section_worker.cc:147-184 parity:
+SectionWorker stores each microbatch's forward activations and replays
+backward from them): the forward sub-step runs under jax.vjp, the
+pullback's tick-variant residual leaves ride a circular O(pp)-slot buffer,
+and the warm-up/drain ticks cond-skip the absent sub-step — so total work
+is A+pp-1 forwards + A+pp-1 backwards, exactly F-then-B's, with a
+save-dots backward (cheaper than F-then-B's full-remat backward). The
+legacy 'recompute' memory mode (backward re-runs the stage forward from
+the saved stage input, fwd+(fwd+bwd) FLOPs) is measured for comparison.
+
+Two model scales: 'small' (hidden=128, dispatch-bound on CPU — schedule
+overhead shows up as per-tick op count) and 'big' (hidden=512,
+compute-bound — the regime a real TPU slice runs in, where the FLOP
+accounting dominates).
+
+Usage: python tools/pipeline_bench.py
 """
 import json
 import os
@@ -28,8 +36,7 @@ import __graft_entry__ as _graft                            # noqa: E402
 _graft._ensure_virtual_devices(8)
 
 
-def measure(schedule, pp=4, A=8, steps=5):
-    import jax
+def measure(schedule, memory_mode='stash', pp=4, A=8, steps=3, big=True):
     import paddle_tpu as paddle
     from paddle_tpu.core.tensor import Tensor
     from paddle_tpu.distributed import topology_runtime
@@ -41,16 +48,23 @@ def measure(schedule, pp=4, A=8, steps=5):
 
     paddle.seed(0)
     topology_runtime.build_mesh(['dp', 'pp'], [1, pp])
-    cfg = GPTConfig(vocab_size=256, hidden_size=128, num_layers=8,
-                    num_heads=4, max_seq_len=128, hidden_dropout=0.0,
-                    attn_dropout=0.0, use_flash_attention=False)
+    if big:
+        cfg = GPTConfig(vocab_size=512, hidden_size=512, num_layers=8,
+                        num_heads=8, max_seq_len=256, hidden_dropout=0.0,
+                        attn_dropout=0.0, use_flash_attention=False)
+        L, mb = 256, 2
+    else:
+        cfg = GPTConfig(vocab_size=256, hidden_size=128, num_layers=8,
+                        num_heads=4, max_seq_len=128, hidden_dropout=0.0,
+                        attn_dropout=0.0, use_flash_attention=False)
+        L, mb = 128, 1
     embed, blocks, head = build_gpt_pipeline(cfg)
     opt = paddle.optimizer.SGD(learning_rate=1e-3, parameters=[])
     eng = SpmdPipelineEngine(embed, blocks, head, opt,
                              accumulate_steps=A, use_remat=True,
-                             schedule=schedule)
+                             schedule=schedule, memory_mode=memory_mode)
     rng = np.random.RandomState(0)
-    ids = rng.randint(0, cfg.vocab_size, (A, 128)).astype('int32')
+    ids = rng.randint(0, cfg.vocab_size, (A * mb, L)).astype('int32')
     labels = np.roll(ids, -1, 1).astype('int32')
     data = (Tensor(ids), Tensor(labels))
     loss = eng.train_batch(data)       # compile
@@ -64,13 +78,24 @@ def measure(schedule, pp=4, A=8, steps=5):
 
 def main():
     r = {}
-    for sched in ('1F1B', 'F-then-B'):
-        ms, loss = measure(sched)
-        r[sched] = {'ms_per_step': round(ms, 1), 'loss': round(loss, 4)}
-    r['ratio_1f1b_over_fthenb'] = round(
-        r['1F1B']['ms_per_step'] / r['F-then-B']['ms_per_step'], 3)
-    r['note'] = ('recompute-1F1B stage FLOPs ~1.5x activation-stashing '
-                 '1F1B; in-flight window 2*pp-1 vs Megatron pp')
+    for scale, big in (('big', True), ('small', False)):
+        sec = {}
+        for name, sched, mode in (('1F1B', '1F1B', 'stash'),
+                                  ('1F1B_recompute', '1F1B', 'recompute'),
+                                  ('F-then-B', 'F-then-B', 'stash')):
+            ms, loss = measure(sched, memory_mode=mode, big=big,
+                               steps=3 if big else 5)
+            sec[name] = {'ms_per_step': round(ms, 1),
+                         'loss': round(loss, 4)}
+        sec['ratio_1f1b_over_fthenb'] = round(
+            sec['1F1B']['ms_per_step'] / sec['F-then-B']['ms_per_step'], 3)
+        sec['ratio_recompute_over_fthenb'] = round(
+            sec['1F1B_recompute']['ms_per_step']
+            / sec['F-then-B']['ms_per_step'], 3)
+        r[scale] = sec
+    r['note'] = ('stash-1F1B = SectionWorker store-activations schedule: '
+                 'A+pp-1 fwd + A+pp-1 bwd (same totals as F-then-B, '
+                 'save-dots backward), O(pp) in-flight window')
     print(json.dumps(r))
 
 
