@@ -169,24 +169,146 @@ class Fleet:
                                        parameter_list, no_grad_set)
         return opt.minimize(loss)
 
-    # -- save -------------------------------------------------------------------
+    # -- save (parity: fleet_base.py:654-780 — delegates to the runtime:
+    # PS path snapshots server tables via PsClient.save, collective path
+    # saves the scope's persistables; a ZeRO-sharded program saves only
+    # the parameters this rank owns) ------------------------------------------
+    def _ps_client(self):
+        from ..runtime import the_one_ps
+        worker = the_one_ps.runtime()._worker
+        return None if worker is None else worker.client
+
     def save_persistables(self, executor=None, dirname=None,
                           main_program=None, mode=0):
-        from ... import fleet as _  # noqa
-        import paddle_tpu as paddle
-        if main_program is not None and dirname:
-            import os
-            os.makedirs(dirname, exist_ok=True)
+        """Collective: every persistable var of `main_program` found in
+        the scope → `<dirname>/__persistables__.npz` (only owned params
+        for a sharded program; `<dirname>/__persistables__.rank<r>.npz`
+        then). PS: additionally snapshots every server sparse table via
+        PsClient.save. Returns {'vars': n, 'tables': [...]}."""
+        if dirname is None:
+            raise ValueError("fleet.save_persistables needs dirname")
+        os.makedirs(dirname, exist_ok=True)
+        out = {'vars': 0, 'tables': []}
+
+        client = self._ps_client()
+        if client is not None:
+            from ..runtime.the_one_ps import table_configs
+            for cfg in table_configs():
+                tid = int(cfg['table_id'])
+                client.save(tid, os.path.join(dirname,
+                                              f"sparse_table_{tid}"))
+                out['tables'].append(tid)
+
+        from ....static.program import default_main_program, _ConstVar
+        from ....static.executor import global_scope
+        import jax
+        prog = main_program or default_main_program()
+        scope = global_scope()
+        p2r = getattr(prog, '_sharding_param2rank', None)
+        rank = getattr(prog, '_sharding_rank', 0)
+
+        def _owner(name):
+            """ZeRO ownership: a parameter's rank; optimizer-state vars
+            (`<param>_<opt>_<state>_0`) follow their parameter — matched
+            by LONGEST param prefix, so `w` never claims `w_big`'s state;
+            other persistables (counters, LR state) belong to rank 0."""
+            if name in p2r:
+                return p2r[name]
+            best = max((p for p in p2r if name.startswith(p + '_')),
+                       key=len, default=None)
+            return 0 if best is None else p2r[best]
+
+        state = {}
+        for v in prog.list_vars():
+            if not getattr(v, 'persistable', False) \
+                    or isinstance(v, _ConstVar) or v.name == '@LR':
+                continue
+            if p2r is not None and _owner(v.name) != rank:
+                continue            # another shard owns this state
+            arr = scope.find_var(v.name)
+            if arr is not None:
+                state[v.name] = np.asarray(jax.device_get(arr))
+        # a save generation must not mix with the other layout's leftovers
+        # (load_persistables merges every matching file): an unsharded
+        # save clears stale rank files, a sharded save clears the stale
+        # unsharded file
+        import glob
+        if p2r is None:
+            stale = glob.glob(os.path.join(dirname,
+                                           '__persistables__.rank*.npz'))
+        else:
+            stale = glob.glob(os.path.join(dirname,
+                                           '__persistables__.npz'))
+        for f in stale:
+            os.remove(f)
+        fname = '__persistables__.npz' if p2r is None \
+            else f'__persistables__.rank{rank}.npz'
+        np.savez(os.path.join(dirname, fname), **state)
+        out['vars'] = len(state)
+        return out
+
+    def load_persistables(self, executor=None, dirname=None,
+                          main_program=None, mode=0):
+        """Round-trip of save_persistables: stages every saved var (all
+        rank files of a sharded save) back into the scope."""
+        import glob
+        import jax.numpy as jnp
+        from ....static.executor import global_scope
+        scope = global_scope()
+        n = 0
+        for f in sorted(glob.glob(os.path.join(
+                dirname, '__persistables__*.npz'))):
+            with np.load(f) as z:
+                for name in z.files:
+                    scope.set(name, jnp.asarray(z[name]))
+                    n += 1
+        return n
 
     def save(self, dirname, feed=None, fetch=None, **configs):
-        import os
+        """Parity: fleet_base.py save — with feed/fetch targets exports
+        an inference model (pruned forward graph + params); otherwise
+        saves program + persistables (paddle.static.save layout)."""
+        from ....static.program import default_main_program
+        from ....static import serialization as S
         os.makedirs(dirname, exist_ok=True)
+        prog = configs.pop('main_program', None) or default_main_program()
+        prefix = os.path.join(dirname, configs.pop('prefix', 'model'))
+        if feed and fetch:
+            return S.save_inference_model(prefix, feed, fetch,
+                                          program=prog)
+        return S.save(prog, prefix)
 
-    def state_dict(self):
-        return {}
+    def state_dict(self, mode=0, main_program=None):
+        """Persistable name → Tensor for the main program's scope (PS
+        sparse tables live server-side: snapshot them with
+        save_persistables)."""
+        from ....static.program import default_main_program, _ConstVar
+        from ....static.executor import global_scope
+        from ....core.tensor import Tensor
+        prog = main_program or default_main_program()
+        scope = global_scope()
+        sd = {}
+        for v in prog.list_vars():
+            if not getattr(v, 'persistable', False) \
+                    or isinstance(v, _ConstVar) or v.name == '@LR':
+                continue
+            arr = scope.find_var(v.name)
+            if arr is not None:
+                sd[v.name] = Tensor(arr)
+        return sd
 
-    def shrink(self, threshold=None):
-        pass
+    def shrink(self, threshold=0.0):
+        """PS mode: drop sparse rows with L2 norm below threshold on
+        every server (reference: fleet.shrink → table shrink for stale
+        features). Returns rows dropped, or 0 outside PS mode."""
+        client = self._ps_client()
+        if client is None:
+            return 0
+        from ..runtime.the_one_ps import table_configs
+        total = 0
+        for cfg in table_configs():
+            total += client.shrink(int(cfg['table_id']), threshold)
+        return total
 
     @property
     def util(self):

@@ -44,6 +44,15 @@ class MetaOptimizerBase:
     def _enable_strategy(self, dist_strategy, context=None):
         pass
 
+    def _nranks(self):
+        """Worker count from the role maker (1 when unset/unreachable)."""
+        if self.role_maker is not None:
+            try:
+                return int(self.role_maker.worker_num())
+            except Exception:
+                return 1
+        return 1
+
     def apply_gradients(self, params_grads):
         return self.inner_opt.apply_gradients(params_grads)
 
@@ -67,10 +76,12 @@ class MetaOptimizerBase:
 
 
 class RawProgramOptimizer(MetaOptimizerBase):
-    """Parity: raw_program_optimizer.py:28 — inserts c_allreduce_sum per
-    grad (:158). TPU: grads of a dp-replicated Program are allreduced by
-    marking the program's dp-sync flag; the Executor's jitted replay emits
-    one fused XLA AllReduce (the fuse_all_reduce_ops equivalent)."""
+    """Parity: raw_program_optimizer.py:28 — REAL dp grad exchange: the
+    loss cotangent is pre-scaled by 1/nranks (:_insert_loss_grad_ops) and
+    one `c_allreduce_sum` op is inserted per parameter gradient before
+    the optimize ops (:158 _insert_allreduce_ops). Single-process replay
+    runs them as identities; multi-rank semantics execute through the
+    collective resolver (MultiRankShardingSimulator / fleetrun)."""
 
     meta_optimizers_white_list = ['RecomputeOptimizer', 'AMPOptimizer']
 
@@ -79,10 +90,12 @@ class RawProgramOptimizer(MetaOptimizerBase):
 
     def minimize_impl(self, loss, startup_program=None, parameter_list=None,
                       no_grad_set=None):
+        from ....static.meta_passes import insert_dp_grad_sync
         prog = loss.block.program
-        prog._dp_allreduce = True
-        return self.inner_opt.minimize(loss, startup_program,
-                                       parameter_list, no_grad_set)
+        out = self.inner_opt.minimize(loss, startup_program,
+                                      parameter_list, no_grad_set)
+        insert_dp_grad_sync(prog, self._nranks())
+        return out
 
 
 class AMPOptimizer(MetaOptimizerBase):
@@ -115,8 +128,12 @@ class AMPOptimizer(MetaOptimizerBase):
 
 
 class RecomputeOptimizer(MetaOptimizerBase):
-    """Parity: recompute_optimizer.py → fluid RecomputeOptimizer:5402. TPU:
-    checkpoints map to jax.checkpoint boundaries in the jitted replay."""
+    """Parity: recompute_optimizer.py → fluid RecomputeOptimizer:5402
+    (_append_backward_ops_with_checkpoints_). REAL segment-recompute
+    rewrite: forward intermediates between checkpoints are dropped from
+    the backward's live set and recomputed (behind an
+    optimization_barrier so XLA cannot CSE the copy away) right before
+    their grad consumers — see static/recompute_pass.py."""
 
     meta_optimizers_white_list = ['LarsOptimizer', 'LambOptimizer',
                                   'GradientMergeOptimizer',
@@ -127,16 +144,21 @@ class RecomputeOptimizer(MetaOptimizerBase):
 
     def minimize_impl(self, loss, startup_program=None, parameter_list=None,
                       no_grad_set=None):
+        from ....static.recompute_pass import rewrite_recompute
         prog = loss.block.program
-        prog._recompute_checkpoints = list(
-            self.user_defined_strategy.recompute_configs['checkpoints'])
-        return self.inner_opt.minimize(loss, startup_program,
-                                       parameter_list, no_grad_set)
+        out = self.inner_opt.minimize(loss, startup_program,
+                                      parameter_list, no_grad_set)
+        rewrite_recompute(prog, list(
+            self.user_defined_strategy.recompute_configs['checkpoints']))
+        return out
 
 
 class GradientMergeOptimizer(MetaOptimizerBase):
     """Parity: gradient_merge_optimizer.py → fluid GradientMergeOptimizer:
-    6255 — accumulate grads k steps, step conditionally."""
+    6255. REAL rewrite: per-grad persistable `@GradientMerge`
+    accumulators, a step counter, and the Optimize-role ops moved into a
+    conditional_block sub-block firing every k-th step on the averaged
+    accumulators (then zeroed) — see static/meta_passes.py."""
 
     meta_optimizers_white_list = []
 
@@ -145,27 +167,36 @@ class GradientMergeOptimizer(MetaOptimizerBase):
 
     def minimize_impl(self, loss, startup_program=None, parameter_list=None,
                       no_grad_set=None):
+        from ....static.meta_passes import apply_gradient_merge
         prog = loss.block.program
-        prog._gradient_merge_k = \
-            self.user_defined_strategy.gradient_merge_configs['k_steps']
-        return self.inner_opt.minimize(loss, startup_program,
-                                       parameter_list, no_grad_set)
+        cfg = self.user_defined_strategy.gradient_merge_configs
+        out = self.inner_opt.minimize(loss, startup_program,
+                                      parameter_list, no_grad_set)
+        apply_gradient_merge(prog, cfg['k_steps'],
+                             avg=bool(cfg.get('avg', True)))
+        return out
 
 
 class LocalSGDOptimizer(MetaOptimizerBase):
-    """Parity: localsgd_optimizer.py:27 — @SNAPSHOT vars + periodic delta
-    allreduce (A.11)."""
+    """Parity: localsgd_optimizer.py:27,63-79. REAL rewrite: ranks train
+    independently; a step counter + gate and per-parameter
+    c_allreduce_sum/blend ops synchronize every parameter to the
+    cross-rank average on every k-th step (static/meta_passes.py
+    apply_localsgd — arithmetic gate instead of the reference's cond:
+    lockstep XLA prefers a static collective schedule)."""
 
     def _can_apply(self):
         return bool(self.user_defined_strategy.localsgd)
 
     def minimize_impl(self, loss, startup_program=None, parameter_list=None,
                       no_grad_set=None):
+        from ....static.meta_passes import apply_localsgd
         prog = loss.block.program
-        prog._localsgd_k = \
-            self.user_defined_strategy.localsgd_configs['k_steps']
-        return self.inner_opt.minimize(loss, startup_program,
-                                       parameter_list, no_grad_set)
+        k = self.user_defined_strategy.localsgd_configs['k_steps']
+        out = self.inner_opt.minimize(loss, startup_program,
+                                      parameter_list, no_grad_set)
+        apply_localsgd(prog, k, self._nranks())
+        return out
 
 
 class LarsOptimizer(MetaOptimizerBase):
@@ -242,18 +273,37 @@ class PipelineOptimizer(MetaOptimizerBase):
 
 
 class TensorParallelOptimizer(MetaOptimizerBase):
-    """Parity: tensor_parallel_optimizer.py (233 LoC)."""
+    """Parity: tensor_parallel_optimizer.py — validates nranks divides by
+    mp_degree, records the mp/dp ring split, and (when nranks >
+    mp_degree) REALLY transpiles the main program for the outer data
+    parallelism: loss-cotangent scale by 1/dp_degree + per-grad
+    c_allreduce_sum on the dp ring (reference _transpile_main_program /
+    _insert_allreduce_ops). The mp collectives themselves are the
+    recorded c_* ops inside the model (collective.py split/_c_embedding/
+    _c_softmax_with_cross_entropy)."""
+
+    DP_RING = 2              # reference ring convention: mp=0 global=1 dp=2
 
     def _can_apply(self):
         return bool(self.user_defined_strategy.tensor_parallel)
 
     def minimize_impl(self, loss, startup_program=None, parameter_list=None,
                       no_grad_set=None):
+        from ....static.meta_passes import insert_dp_grad_sync
         prog = loss.block.program
-        prog._mp_degree = self.user_defined_strategy \
-            .tensor_parallel_configs['tensor_parallel_degree']
-        return self.inner_opt.minimize(loss, startup_program,
-                                       parameter_list, no_grad_set)
+        mp = int(self.user_defined_strategy
+                 .tensor_parallel_configs['tensor_parallel_degree'])
+        nranks = max(self._nranks(), 1)
+        if nranks % mp != 0:
+            raise ValueError(
+                f"tensor_parallel_degree={mp} must divide the worker "
+                f"count {nranks}")
+        out = self.inner_opt.minimize(loss, startup_program,
+                                      parameter_list, no_grad_set)
+        prog._mp_degree = mp
+        if nranks > mp:
+            insert_dp_grad_sync(prog, nranks // mp, ring_id=self.DP_RING)
+        return out
 
 
 class ShardingOptimizer(MetaOptimizerBase):
@@ -389,18 +439,26 @@ class ASPOptimizer(MetaOptimizerBase):
 
 
 class ParameterServerOptimizer(MetaOptimizerBase):
-    """Parity: parameter_server_optimizer.py (352 LoC) — a_sync PS program
-    split; see paddle_tpu/distributed/ps."""
+    """Parity: parameter_server_optimizer.py _build_trainer_programs →
+    trainer_pass append_send_ops. REAL worker-side rewrite: after the
+    inner minimize records backward ops, every `distributed_lookup`
+    output's cotangent gains a `distributed_push` op carrying it to the
+    parameter server (static/heter_pass.py wire_sparse_grads — the
+    sparse-gradient send half of the PS split); dense params keep local
+    optimize ops per the a_sync geo pattern."""
 
     def _can_apply(self):
         return bool(self.user_defined_strategy.a_sync)
 
     def minimize_impl(self, loss, startup_program=None, parameter_list=None,
                       no_grad_set=None):
+        from ....static.heter_pass import wire_sparse_grads
         prog = loss.block.program
+        out = self.inner_opt.minimize(loss, startup_program,
+                                      parameter_list, no_grad_set)
         prog._ps_mode = dict(self.user_defined_strategy.a_sync_configs)
-        return self.inner_opt.minimize(loss, startup_program,
-                                       parameter_list, no_grad_set)
+        prog._ps_push_count = wire_sparse_grads(prog)
+        return out
 
 
 _ALL_META_OPTIMIZERS = [AMPOptimizer, RecomputeOptimizer,

@@ -408,8 +408,10 @@ class SpmdPipelineEngine:
         variant merely wastes buffer space; it can never produce a wrong
         gradient.
 
-        Returns ``(variant_flags, values)`` where ``values[i]`` holds the
-        invariant output value, or None at variant positions."""
+        Returns ``(variant_flags, values, avals)``: ``values[i]`` holds
+        the invariant output value (None at variant positions); ``avals``
+        are every flattened output's abstract values, so callers need no
+        second abstract trace for shapes."""
         closed = jax.make_jaxpr(fn)(*args)
         jaxpr = closed.jaxpr
         variant_flat = []
@@ -449,7 +451,10 @@ class SpmdPipelineEngine:
         for i, f in enumerate(flags):
             if not f:
                 values[i] = next(it)
-        return flags, values
+        avals = [v.aval if not hasattr(v, 'val')
+                 else jax.core.get_aval(v.val)
+                 for v in jaxpr.outvars]
+        return flags, values, avals
 
     def _build_1f1b(self):
         """1F1B steady-state schedule (section_worker.cc:147-184 parity).
@@ -592,10 +597,9 @@ class SpmdPipelineEngine:
                     probe_args = ((pe, pb, ph),
                                   jnp.zeros(act_shape, act_dtype),
                                   jnp.asarray(0, jnp.int32), k0)
-                    shapes = jax.eval_shape(fwd_probe, *probe_args)
-                    leaf_shapes = shapes[2]
-                    flags, inv_vals = self._split_residuals(
+                    flags, inv_vals, avals = self._split_residuals(
                         fwd_probe, probe_args, {1, 2, 3})
+                    leaf_shapes = avals[2:]
                     leaf_var = flags[2:]
                     inv_leaves = inv_vals[2:]
                     var_idx = [i for i, v in enumerate(leaf_var) if v]

@@ -38,3 +38,10 @@ def runtime():
     if _runtime is None:
         _runtime = TheOnePSRuntime()
     return _runtime
+
+
+def table_configs():
+    """Resolved TableParameter dicts for the active PS deployment
+    (strategy-programmed via set_table_configs, else PADDLE_PS_TABLES)."""
+    from ...ps.ps_runtime import _table_configs
+    return _table_configs()

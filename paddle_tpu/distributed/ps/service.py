@@ -217,6 +217,10 @@ class PsServer:
                         ok()
                     elif op == b'N':
                         ok(struct.pack('<q', len(self._table(tid))))
+                    elif op == b'K':
+                        (thr,) = struct.unpack('<f', _read_n(conn, 4))
+                        n = self._table(tid, dense=False).shrink(thr)
+                        ok(struct.pack('<q', int(n)))
                     else:
                         return
                 except ConnectionError:
@@ -456,6 +460,20 @@ class PsClient:
         for s in range(self.n_servers):
             def req(sock):
                 sock.sendall(b'N' + struct.pack('<I', table_id))
+                _read_status(sock)
+                return struct.unpack('<q', _read_n(sock, 8))[0]
+            total += self._rpc(s, req)
+        return total
+
+    def shrink(self, table_id, threshold):
+        """Drop rows with L2 norm below threshold on every server
+        (reference: fleet.shrink → SSDSparseTable/CommonSparseTable
+        shrink for stale features). Returns total rows dropped."""
+        total = 0
+        for s in range(self.n_servers):
+            def req(sock):
+                sock.sendall(b'K' + struct.pack('<If', table_id,
+                                                float(threshold)))
                 _read_status(sock)
                 return struct.unpack('<q', _read_n(sock, 8))[0]
             total += self._rpc(s, req)

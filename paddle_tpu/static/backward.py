@@ -86,6 +86,13 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
     # -- forward sweep: which vars (transitively) depend on the params ------
     needs = {p.name for p in params if p.name not in no_grad}
     ops = list(block.ops)
+    # distributed_lookup outputs are reads of REMOTE parameters (PS
+    # tables): their cotangents are what distributed_push sends back to
+    # the server, so they are always grad targets even though no local
+    # Parameter backs them (trainer_pass append_send_ops role)
+    for op in ops:
+        if op.type == 'distributed_lookup':
+            needs.update(n for n in op.output_names if n not in no_grad)
     for op in ops:
         if any(n in needs for n in op.input_names):
             needs.update(op.output_names)

@@ -1,0 +1,219 @@
+"""Program rewrites behind the strategy-driven static meta-optimizers.
+
+Reference parity:
+- GradientMerge: fluid GradientMergeOptimizer
+  (/root/reference/python/paddle/fluid/optimizer.py:6255) — per-grad
+  persistable accumulators, a step counter, and the optimize ops moved
+  under a conditional that fires every k steps (then zeroing the
+  accumulators).
+- LocalSGD: fleet/meta_optimizers/localsgd_optimizer.py:27,63-79 —
+  ranks train independently; every k steps parameters are synchronized
+  across the data-parallel group.
+- dp grad sync: raw_program_optimizer.py:158 _insert_allreduce_ops and
+  tensor_parallel_optimizer.py _transpile_main_program — scale the loss
+  cotangent by 1/nranks and c_allreduce_sum every parameter gradient.
+
+TPU-native notes: the conditional apply uses the nested-sub-block
+`conditional_block` op (replayed as lax.cond). LocalSGD's periodic sync
+is expressed arithmetically (allreduce every step + a where-blend on the
+step gate) rather than under a cond: a lockstep XLA program prefers a
+static collective schedule, and the blend reproduces the reference's
+semantics exactly — parameters move only at multiples of k.
+"""
+import jax.numpy as jnp
+
+from .program import Variable, Operator, OpRole
+
+
+def _first_optimize_pos(ops):
+    for i, op in enumerate(ops):
+        if op.op_role & OpRole.Optimize:
+            return i
+    return len(ops)
+
+
+def _make_counter(program, name):
+    """Persistable int32 scalar counter var initialized to 0."""
+    block = program.global_block()
+    if name not in block.vars:
+        v = Variable(block, name, [], 'int32', persistable=True)
+        v.initializer = lambda shape, dtype: jnp.zeros((), jnp.int32)
+        block.vars[name] = v
+        program.startup_ops.append(v)
+    return block.vars[name]
+
+
+def apply_gradient_merge(program, k_steps, avg=True):
+    """Rewrite `program` in place: accumulate each parameter gradient into
+    a persistable `<grad>@GradientMerge` buffer every step and run the
+    Optimize-role ops only every `k_steps`-th step, inside a
+    conditional_block sub-block, on the (optionally averaged) accumulated
+    gradients; the accumulators are zeroed after the apply.
+    """
+    k = int(k_steps)
+    if k < 1:
+        raise ValueError(f"gradient_merge k_steps must be >= 1, got {k}")
+    block = program.global_block()
+    ops = list(block.ops)
+    first_opt = _first_optimize_pos(ops)
+    head, opt_ops = ops[:first_opt], ops[first_opt:]
+    grads = sorted({g for g in program._grad_map.values()
+                    if g in block.vars})
+    if not grads or not opt_ops:
+        raise ValueError("gradient_merge needs recorded backward + "
+                         "optimize ops (call minimize first)")
+
+    # persistable accumulators + step counter
+    acc_of = {}
+    for g in grads:
+        an = g + '@GradientMerge'
+        gv = block.vars[g]
+        av = Variable(block, an, list(gv.shape or []), gv.dtype,
+                      persistable=True)
+        av.initializer = (lambda shape, dtype:
+                          jnp.zeros(tuple(shape), dtype))
+        block.vars[an] = av
+        program.startup_ops.append(av)
+        acc_of[g] = an
+    step = _make_counter(program, '@GM_step')
+
+    new_ops = list(head)
+    for g, a in acc_of.items():
+        new_ops.append(Operator('gm_accumulate', lambda acc, grad:
+                                acc + grad.astype(acc.dtype),
+                                [a, g], [a], {}, op_role=OpRole.Backward))
+    new_ops.append(Operator('increment', lambda s: s + 1,
+                            [step.name], [step.name], {},
+                            op_role=OpRole.Optimize))
+    pred = '@GM_cond'
+    block.vars[pred] = Variable(block, pred, [], 'bool')
+    new_ops.append(Operator('gm_cond',
+                            lambda s, _k=k: (s % _k) == 0,
+                            [step.name], [pred], {'k': k},
+                            op_role=OpRole.Optimize))
+
+    # true branch sub-block: scale accumulators -> optimize ops (grad
+    # inputs rewired to the scaled accumulators) -> zero accumulators
+    tb = program._create_block()
+    program._rollback()
+    fb = program._create_block()
+    program._rollback()
+    scaled_of = {}
+    for g, a in acc_of.items():
+        sn = a + '@AVG'
+        av = block.vars[a]
+        block.vars[sn] = Variable(block, sn, list(av.shape or []),
+                                  av.dtype)
+        factor = (1.0 / k) if avg else 1.0
+        tb.ops.append(Operator('scale',
+                               lambda x, _f=factor: x * _f,
+                               [a], [sn], {'scale': factor},
+                               op_role=OpRole.Optimize))
+        scaled_of[g] = sn
+    touched = []            # vars the branch updates (params/state/accs)
+    for op in opt_ops:
+        op.input_names = [scaled_of.get(n, n) for n in op.input_names]
+        tb.ops.append(op)
+        for o in op.output_names:
+            if o not in touched:
+                touched.append(o)
+    for g, a in acc_of.items():
+        tb.ops.append(Operator('fill_zeros_like',
+                               lambda x: jnp.zeros_like(x),
+                               [a], [a], {}, op_role=OpRole.Optimize))
+        touched.append(a)
+
+    cond_op = Operator(
+        'conditional_block', None, [pred], list(touched),
+        {'sub_block_true': tb.idx, 'sub_block_false': fb.idx,
+         'true_outs': list(touched), 'false_outs': list(touched)},
+        op_role=OpRole.Optimize)
+    new_ops.append(cond_op)
+    block.ops = new_ops
+    program._gradient_merge_k = k
+    program._gradient_merge_avg = bool(avg)
+    return len(acc_of)
+
+
+def apply_localsgd(program, k_steps, nranks, ring_id=0):
+    """Append the LocalSGD parameter-sync tail: every `k_steps`-th step
+    each trainable parameter is replaced by the cross-rank average
+    (c_allreduce_sum + 1/nranks blend on the step gate); other steps the
+    parameters keep their locally-optimized values."""
+    k = int(k_steps)
+    if k < 1:
+        raise ValueError(f"localsgd k_steps must be >= 1, got {k}")
+    block = program.global_block()
+    params = [p for p in program.all_parameters()
+              if p.name in program._grad_map]
+    if not params:
+        raise ValueError("localsgd needs trained parameters "
+                         "(call minimize first)")
+    step = _make_counter(program, '@LOCALSGD_step')
+    gate = '@LOCALSGD_gate'
+    block.vars[gate] = Variable(block, gate, [], 'bool')
+    block.ops.append(Operator('increment', lambda s: s + 1,
+                              [step.name], [step.name], {},
+                              op_role=OpRole.Optimize))
+    block.ops.append(Operator('localsgd_gate',
+                              lambda s, _k=k: (s % _k) == 0,
+                              [step.name], [gate], {'k': k},
+                              op_role=OpRole.Optimize))
+    for p in params:
+        tmp = p.name + '@LOCALSGD_sum'
+        block.vars[tmp] = Variable(block, tmp, list(p.shape or []),
+                                   p.dtype)
+        block.ops.append(Operator('share_data', lambda x: x,
+                                  [p.name], [tmp], {},
+                                  op_role=OpRole.Optimize))
+        block.ops.append(Operator('c_allreduce_sum', lambda x: x,
+                                  [tmp], [tmp],
+                                  {'ring_id': ring_id,
+                                   'use_calc_stream': True},
+                                  op_role=OpRole.Optimize))
+
+        def blend(pv, sv, gv, _n=nranks):
+            avg = (sv.astype(jnp.float32) / _n).astype(pv.dtype)
+            return jnp.where(gv, avg, pv)
+        block.ops.append(Operator('localsgd_blend', blend,
+                                  [p.name, tmp, gate], [p.name],
+                                  {'nranks': nranks},
+                                  op_role=OpRole.Optimize))
+    program._localsgd_k = k
+    program._localsgd_nranks = nranks
+    return len(params)
+
+
+def insert_dp_grad_sync(program, nranks, ring_id=0):
+    """Insert the data-parallel gradient exchange: scale the loss
+    cotangent by 1/nranks right after its seed op, then c_allreduce_sum
+    every parameter gradient before the first Optimize-role op."""
+    if nranks < 2:
+        return 0
+    block = program.global_block()
+    ops = list(block.ops)
+
+    loss = getattr(program, '_loss_var', None)
+    if loss is not None:
+        seed_name = loss.name + '@GRAD'
+        for i, op in enumerate(ops):
+            if seed_name in op.output_names \
+                    and (op.op_role & OpRole.Backward):
+                ops.insert(i + 1, Operator(
+                    'scale', lambda x, _n=nranks: x / _n,
+                    [seed_name], [seed_name],
+                    {'scale': 1.0 / nranks}, op_role=OpRole.Backward))
+                break
+
+    first_opt = _first_optimize_pos(ops)
+    sync = []
+    for g in sorted({g for g in program._grad_map.values()
+                     if g in block.vars}):
+        sync.append(Operator('c_allreduce_sum', lambda x: x, [g], [g],
+                             {'ring_id': ring_id,
+                              'use_calc_stream': True},
+                             op_role=OpRole.Backward))
+    block.ops = ops[:first_opt] + sync + ops[first_opt:]
+    program._dp_allreduce = True
+    program._dp_nranks = nranks
+    return len(sync)

@@ -1,0 +1,168 @@
+"""Static activation-recompute program rewrite.
+
+Reference parity: fluid RecomputeOptimizer
+(/root/reference/python/paddle/fluid/optimizer.py:5402) →
+backward._append_backward_ops_with_checkpoints_: the forward is segmented
+at user-named checkpoint variables; each segment's intermediate
+activations are NOT kept for backward — the segment's forward ops are
+duplicated into the backward region (reading only the stored checkpoint
+inputs) and the grad ops are rewired to the recomputed copies.
+
+TPU-native note: the whole Program replays inside one jax.jit trace, so a
+naive duplicate would be CSE'd away by XLA (it dedupes identical
+subgraphs, reconstructing exactly the memory we tried to free). Each
+recompute segment therefore reads its external inputs through a
+`recompute_barrier` op (`lax.optimization_barrier`) — an opaque boundary
+XLA will not merge across — making the recomputation real: the original
+segment intermediates die at the end of the forward, and live again only
+from the barrier to their grad ops. This is the recorded-program analogue
+of `jax.checkpoint` (which only acts under jax-level AD, not op replay).
+"""
+import jax
+import jax.numpy as jnp
+
+from .program import Variable, Operator, OpRole, _ConstVar
+
+
+def rewrite_recompute(program, checkpoints):
+    """Rewrite `program` in place for activation recompute.
+
+    `checkpoints`: variable names that delimit segments; they (plus
+    params/feeds) are the only forward values kept live into backward.
+    Every other forward intermediate consumed by a Backward-role op is
+    recomputed from the nearest upstream checkpoint right before its
+    first backward consumer. Raises on unknown checkpoint names — a
+    misspelled knob must not silently no-op.
+
+    Returns the number of recompute segments inserted.
+    """
+    block = program.global_block()
+    unknown = [c for c in checkpoints if c not in block.vars]
+    if unknown:
+        raise ValueError(
+            f"recompute checkpoints not found in program: {unknown}; "
+            f"known vars include {sorted(block.vars)[:10]}...")
+
+    ops = list(block.ops)
+    first_bwd = len(ops)
+    for i, op in enumerate(ops):
+        if op.op_role & OpRole.Backward:
+            first_bwd = i
+            break
+    fwd_ops, tail_ops = ops[:first_bwd], ops[first_bwd:]
+
+    produced_at = {}
+    for i, op in enumerate(fwd_ops):
+        for o in op.output_names:
+            produced_at[o] = i
+    cp_positions = sorted({produced_at[c] for c in checkpoints
+                           if c in produced_at})
+    if not cp_positions:
+        return 0
+    stored = set(checkpoints)
+
+    # segments: [0..cp0], (cp0..cp1], ... — the tail after the last
+    # checkpoint is not recomputed (its intermediates die quickly: their
+    # grad ops run first in the reverse sweep)
+    bounds = [-1] + cp_positions
+    segments = [(bounds[j] + 1, bounds[j + 1])
+                for j in range(len(bounds) - 1)]
+
+    n_inserted = 0
+    inserts = {}            # tail position -> [ops to insert before it]
+    for seg_id, (lo, hi) in enumerate(segments):
+        seg_ops = fwd_ops[lo:hi + 1]
+        seg_produced = {o for op in seg_ops for o in op.output_names}
+        # intermediates: produced in-segment, not stored checkpoints
+        inter = seg_produced - stored
+        if not inter:
+            continue
+        # where the recompute must land: before the first backward
+        # consumer of any segment intermediate
+        consumer_pos = None
+        for i, op in enumerate(tail_ops):
+            if (op.op_role & OpRole.Backward) \
+                    and set(op.input_names) & inter:
+                consumer_pos = i
+                break
+        if consumer_pos is None:
+            continue
+
+        # external inputs of the segment (checkpoints/params/feeds/consts)
+        ext = []
+        for op in seg_ops:
+            for n in op.input_names:
+                if n not in seg_produced and n not in ext:
+                    ext.append(n)
+        sfx = f"@RECOMPUTE@{seg_id}"
+
+        def _mapped(n):
+            return n + sfx if n in seg_produced else n
+
+        rc_ops = []
+        # barrier the external inputs feeding the duplicated ops so XLA
+        # cannot CSE the recomputation with the original forward
+        barrier_ext = [n for n in ext
+                       if not isinstance(block.vars.get(n), _ConstVar)]
+        if barrier_ext:
+            b_outs = []
+            for n in barrier_ext:
+                bn = n + sfx + '@B'
+                v = block.vars[n]
+                bv = Variable(block, bn, list(v.shape or []), v.dtype)
+                bv.op_role = OpRole.Backward
+                block.vars[bn] = bv
+                b_outs.append(bn)
+            bop = Operator(
+                'recompute_barrier',
+                lambda *xs: jax.lax.optimization_barrier(tuple(xs)),
+                list(barrier_ext), b_outs, {'segment': seg_id},
+                op_role=OpRole.Backward)
+            bop.multi_out = True
+            rc_ops.append(bop)
+            barrier_of = dict(zip(barrier_ext, b_outs))
+        else:
+            barrier_of = {}
+
+        def _in_name(n):
+            if n in seg_produced:
+                return n + sfx
+            return barrier_of.get(n, n)
+
+        for op in seg_ops:
+            if all(o in stored for o in op.output_names):
+                continue            # its outputs are kept anyway
+            new_outs = []
+            for o in op.output_names:
+                on = _mapped(o)
+                if on not in block.vars:
+                    v = block.vars[o]
+                    nv = Variable(block, on, list(v.shape or []), v.dtype)
+                    nv.op_role = OpRole.Backward
+                    block.vars[on] = nv
+                new_outs.append(on)
+            dup = Operator(op.type + '_recompute', op.fn,
+                           [_in_name(n) for n in op.input_names],
+                           new_outs, dict(op.attrs),
+                           op_role=OpRole.Backward)
+            dup.multi_out = getattr(op, 'multi_out', False)
+            dup.op_device = op.op_device
+            rc_ops.append(dup)
+
+        # rewire every backward consumer of a segment intermediate
+        for op in tail_ops:
+            if not (op.op_role & OpRole.Backward):
+                continue
+            if set(op.input_names) & inter:
+                op.input_names = [n + sfx if n in inter else n
+                                  for n in op.input_names]
+        inserts.setdefault(consumer_pos, []).extend(rc_ops)
+        n_inserted += 1
+
+    new_tail = []
+    for i, op in enumerate(tail_ops):
+        new_tail.extend(inserts.get(i, []))
+        new_tail.append(op)
+    block.ops = fwd_ops + new_tail
+    program._recompute_checkpoints = list(checkpoints)
+    return n_inserted

@@ -392,13 +392,30 @@ class TestMetaOptimizerGolden:
         assert main._amp['init_loss_scaling'] == 1024.0
 
     def test_recompute_strategy(self):
+        """strategy.recompute drives the REAL segment-recompute rewrite
+        (behavioral coverage in tests/test_meta_optimizers.py); an
+        unknown checkpoint name raises instead of silently no-oping."""
         from paddle_tpu.distributed.fleet import DistributedStrategy
-        main, loss = self._toy()
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data('x', [4, 8])
+            h = static.nn.fc(x, 8, activation='relu')
+            y = static.nn.fc(h, 2)
+            loss = paddle.mean(y * y)
         s = DistributedStrategy()
         s.recompute = True
-        s.recompute_configs = {'checkpoints': ['fc_0.tmp']}
+        s.recompute_configs = {'checkpoints': [h.name]}
         self._minimize(s, loss)
-        assert main._recompute_checkpoints == ['fc_0.tmp']
+        assert main._recompute_checkpoints == [h.name]
+        types = [op.type for op in main.global_block().ops]
+        assert 'recompute_barrier' in types
+
+        main2, loss2 = self._toy()
+        s2 = DistributedStrategy()
+        s2.recompute = True
+        s2.recompute_configs = {'checkpoints': ['not_a_var']}
+        with pytest.raises(ValueError, match='not found'):
+            self._minimize(s2, loss2)
 
     def test_pipeline_strategy(self):
         from paddle_tpu.distributed.fleet import DistributedStrategy
