@@ -228,19 +228,33 @@ class Fleet:
             arr = scope.find_var(v.name)
             if arr is not None:
                 state[v.name] = np.asarray(jax.device_get(arr))
-        # a save generation must not mix with the other layout's leftovers
-        # (load_persistables merges every matching file): an unsharded
-        # save clears stale rank files, a sharded save clears the stale
-        # unsharded file
+        # a save generation must not mix with leftovers from a previous
+        # layout (load_persistables merges every matching file): an
+        # unsharded save clears all rank files; a sharded save clears the
+        # stale unsharded file, and rank 0 also clears rank files from a
+        # previous HIGHER sharding degree. Removals tolerate races —
+        # concurrently-saving ranks may target the same stale file.
         import glob
+        import re
+        stale = []
         if p2r is None:
             stale = glob.glob(os.path.join(dirname,
                                            '__persistables__.rank*.npz'))
         else:
             stale = glob.glob(os.path.join(dirname,
                                            '__persistables__.npz'))
+            degree = max(p2r.values(), default=0) + 1
+            if rank == 0:
+                for f in glob.glob(os.path.join(
+                        dirname, '__persistables__.rank*.npz')):
+                    m = re.search(r'\.rank(\d+)\.npz$', f)
+                    if m and int(m.group(1)) >= degree:
+                        stale.append(f)
         for f in stale:
-            os.remove(f)
+            try:
+                os.remove(f)
+            except FileNotFoundError:
+                pass
         fname = '__persistables__.npz' if p2r is None \
             else f'__persistables__.rank{rank}.npz'
         np.savez(os.path.join(dirname, fname), **state)

@@ -52,6 +52,26 @@ class scope_guard:
         _scope_stack.pop()
 
 
+def _program_fingerprint(program):
+    """Structural hash of every block's op list (type, io names, fn
+    identity, attrs) so the jit cache invalidates on ANY program
+    mutation — including in-place op rewrites that keep the op count
+    constant (parity: CompiledProgram invalidation semantics,
+    fluid/compiler.py). O(ops) Python per run, amortized noise next to
+    the jit dispatch itself."""
+    h = 0
+    for b in program.blocks:
+        for op in b.ops:
+            try:
+                attrs = tuple(sorted((k, str(v))
+                              for k, v in op.attrs.items()))
+            except Exception:
+                attrs = ()
+            h = hash((h, op.type, tuple(op.input_names),
+                      tuple(op.output_names), id(op.fn), attrs))
+    return h
+
+
 class Executor:
     """Parity: fluid/executor.py Executor. place is accepted and ignored —
     PJRT owns placement."""
@@ -104,7 +124,7 @@ class Executor:
 
         key = (id(program), feed_names,
                tuple((a.shape, str(a.dtype)) for a in feed_arrays),
-               tuple(fetch_names), len(program.global_block().ops),
+               tuple(fetch_names), _program_fingerprint(program),
                id(opt))
         compiled = self._cache.get(key)
         if compiled is None:
