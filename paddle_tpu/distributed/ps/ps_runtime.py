@@ -74,7 +74,15 @@ class _Server:
         from .service import PsServer
         ep = os.environ.get('PADDLE_CURRENT_ENDPOINT', '0.0.0.0:0')
         port = int(ep.rsplit(':', 1)[1]) if ':' in ep else 0
-        self.server = PsServer(port=port)
+        # durable push-dedup high-water mark (at-most-once across server
+        # restart) when a state dir is provided at launch; namespaced by
+        # endpoint — launchers export one env to every rank, and shard
+        # servers must NOT share dedup marks (a mark recovered from a
+        # co-hosted peer would drop this shard's legitimate replay)
+        state = os.environ.get('PADDLE_PS_STATE_DIR')
+        if state:
+            state = os.path.join(state, ep.replace(':', '_'))
+        self.server = PsServer(port=port, state_dir=state)
         for cfg in _table_configs():
             c = dict(cfg)
             tid = c.pop('table_id')
