@@ -156,6 +156,161 @@ def bench_bert_config3():
     }
 
 
+def bench_lenet_config1():
+    """BASELINE config 1: MNIST LeNet, dygraph + jitted train step."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.vision.models import LeNet
+    from paddle_tpu.jit import TrainStep
+
+    paddle.seed(0)
+    model = LeNet(10)
+    opt = paddle.optimizer.Adam(parameters=model.parameters())
+    step = TrainStep(model, lambda out, lb: nn.functional.cross_entropy(
+        out, lb), opt)
+    B = 256
+    rng = np.random.RandomState(0)
+    imgs = paddle.to_tensor(rng.rand(B, 1, 28, 28).astype('float32'))
+    labels = paddle.to_tensor(rng.randint(0, 10, (B,)).astype('int64'))
+    float(step(imgs, labels))              # compile
+    n = 20
+    dt = float('inf')
+    for _ in range(3):
+        t0 = time.time()
+        for _ in range(n):
+            loss = step(imgs, labels)
+        float(loss)
+        dt = min(dt, (time.time() - t0) / n)
+    return {'images_per_sec': B / dt, 'ms_per_step': dt * 1000,
+            'batch': B}
+
+
+def bench_resnet50_config2():
+    """BASELINE config 2: ResNet-50 ImageNet shape, bf16, dp machinery
+    (degree 1 on one chip — the dp grad sync is the hybrid engine's
+    pmean, exercised multi-device in the dryrun/tests)."""
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.distributed import topology_runtime
+    from paddle_tpu.vision.models import resnet50
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.fleet.meta_parallel.hybrid_engine import (
+        HybridParallelTrainStep)
+    import paddle_tpu.distributed.fleet as fm
+
+    fm.fleet._hcg = None
+    topology_runtime.build_mesh(['dp'], [1])
+    paddle.seed(0)
+    model = resnet50(num_classes=1000)
+    for p in model.parameters():
+        if p.data.dtype == jnp.float32:
+            p.data = p.data.astype(jnp.bfloat16)
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                    parameters=model.parameters())
+    B = 128
+
+    def loss_fn(m, x, y):
+        return nn.functional.cross_entropy(m(x), y)
+
+    eng = HybridParallelTrainStep(model, loss_fn, opt)
+    rng = np.random.RandomState(0)
+    x = Tensor(rng.rand(B, 3, 224, 224).astype('float32')
+               .astype(np.float32))
+    y = Tensor(rng.randint(0, 1000, (B,)).astype('int64'))
+    loss = eng(x, y)                        # compile
+    assert np.isfinite(float(loss))
+    n = 5
+    dt = float('inf')
+    for _ in range(4):
+        t0 = time.time()
+        for _ in range(n):
+            loss = eng(x, y)
+        float(loss)
+        dt = min(dt, (time.time() - t0) / n)
+    # ResNet-50 @224: ~4.1 GFLOPs forward per image; train ~3x forward
+    flops = 3 * 4.1e9 * B
+    return {'images_per_sec': B / dt, 'ms_per_step': dt * 1000,
+            'mfu': flops / dt / 1e12 / V5E_PEAK_TFLOPS,
+            'params': n_params, 'batch': B}
+
+
+def bench_deepfm_ps_config5():
+    """BASELINE config 5: DeepFM over the REAL PS wire (PsServer +
+    PsClient over localhost TCP against csrc/sparse_table): per step,
+    pull the batch's embedding rows, run the jitted dense
+    DeepFM fwd+bwd on the chip, push the row grads back. Reports
+    steps/sec + pull/push latency (the reference's
+    test_model_benchmark.sh role for the PS family)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.distributed.ps.service import PsServer, PsClient
+
+    fields, dim, B = 26, 8, 512
+    srv = PsServer().start()
+    srv.add_table(0, dim=dim, optimizer='adagrad', seed=3)
+    client = PsClient([f'127.0.0.1:{srv.port}'])
+    rng = np.random.RandomState(0)
+    # criteo-ish power-law ids over a large space
+    ids = (rng.pareto(1.2, (B, fields)) * 1000).astype(np.int64) % (10**7)
+
+    w1 = jnp.asarray(rng.randn(fields * dim, 32) * 0.05, jnp.float32)
+    b1 = jnp.zeros((32,), jnp.float32)
+    w2 = jnp.asarray(rng.randn(32, 1) * 0.05, jnp.float32)
+    labels = jnp.asarray(rng.randint(0, 2, (B, 1)), jnp.float32)
+
+    @jax.jit
+    def dense_step(emb, w1, b1, w2, labels):
+        def loss_of(emb, w1, b1, w2):
+            e = emb.reshape(B, fields, dim)
+            s = e.sum(1)
+            fm = 0.5 * (s * s - (e * e).sum(1)).sum(-1, keepdims=True)
+            h = jax.nn.relu(e.reshape(B, -1) @ w1 + b1)
+            logit = h @ w2 + fm
+            return jnp.mean(jnp.clip(logit, 0) - logit * labels
+                            + jnp.log1p(jnp.exp(-jnp.abs(logit))))
+        loss, grads = jax.value_and_grad(loss_of, argnums=(0, 1, 2, 3))(
+            emb, w1, b1, w2)
+        ge, gw1, gb1, gw2 = grads
+        lr = 0.05
+        return loss, ge, w1 - lr * gw1, b1 - lr * gb1, w2 - lr * gw2
+
+    flat = ids.reshape(-1)
+    emb = client.pull(0, flat, dim)         # warm rows + compile
+    loss, ge, w1, b1, w2 = dense_step(jnp.asarray(emb), w1, b1, w2,
+                                      labels)
+    client.push(0, flat, np.asarray(ge), lr=0.05)
+
+    n = 20
+    t_pull = t_push = t_dense = 0.0
+    t0 = time.time()
+    for _ in range(n):
+        tp = time.time()
+        emb = client.pull(0, flat, dim)
+        t_pull += time.time() - tp
+        td = time.time()
+        loss, ge, w1, b1, w2 = dense_step(jnp.asarray(emb), w1, b1, w2,
+                                          labels)
+        ge_np = np.asarray(ge)              # sync + host transfer
+        t_dense += time.time() - td
+        tu = time.time()
+        client.push(0, flat, ge_np, lr=0.05)
+        t_push += time.time() - tu
+    dt = (time.time() - t0) / n
+    rows = B * fields
+    out = {'steps_per_sec': 1.0 / dt, 'ms_per_step': dt * 1000,
+           'pull_ms': t_pull / n * 1000, 'push_ms': t_push / n * 1000,
+           'dense_ms': t_dense / n * 1000,
+           'rows_per_pull': rows,
+           'pull_rows_per_sec': rows / (t_pull / n),
+           'push_rows_per_sec': rows / (t_push / n),
+           'table_rows': int(client.table_size(0))}
+    client.shutdown()
+    client.close()
+    return out
+
+
 def main():
     g = bench_gpt_1p3b()
     detail = {
@@ -175,6 +330,18 @@ def main():
         }
     except Exception as e:           # headline must still print
         detail['bert_base_zero2_bf16'] = {'error': repr(e)[:200]}
+    for key, fn, rounds in (
+            ('lenet_mnist', bench_lenet_config1, 2),
+            ('resnet50_dp_bf16', bench_resnet50_config2, 2),
+            ('deepfm_ps', bench_deepfm_ps_config5, 2),
+    ):
+        try:
+            r = fn()
+            detail[key] = {k: (round(v, rounds)
+                               if isinstance(v, float) else v)
+                           for k, v in r.items()}
+        except Exception as e:
+            detail[key] = {'error': repr(e)[:200]}
     result = {
         'metric': 'gpt1.3b_trainstep_mfu',
         'value': round(g['mfu'], 4),

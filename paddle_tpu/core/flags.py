@@ -25,6 +25,9 @@ _FLAGS = {
     # route eligible nn.MultiHeadAttention through the Pallas flash kernel
     # (parity: the reference's fused_attention op swap-in)
     'FLAGS_use_flash_attention': True,
+    # min sequence length for the flash route; below it XLA's fused dense
+    # attention usually wins on TPU (tunable per model/shape)
+    'FLAGS_flash_min_seq': 1024,
     # wrap op-kernel exceptions with [operator < name > error] context
     # (enforce.h framing; off by default to keep exception types exact)
     'FLAGS_op_error_context': False,

@@ -8,5 +8,6 @@ to the eager tape otherwise.
 """
 from .model import Model
 from .callbacks import (Callback, ProgBarLogger, ModelCheckpoint,
-                        LRSchedulerCallback, EarlyStopping)
+                        LRSchedulerCallback, EarlyStopping,
+                        ReduceLROnPlateau, VisualDL)
 from .summary import summary

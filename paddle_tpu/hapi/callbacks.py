@@ -173,6 +173,60 @@ class EarlyStopping(Callback):
                 self.model.stop_training = True
 
 
+class ReduceLROnPlateau(Callback):
+    """Parity: hapi/callbacks.py:956 — reduce the optimizer's learning
+    rate by `factor` once `monitor` stops improving for `patience`
+    epochs, with a cooldown and a floor."""
+
+    def __init__(self, monitor='loss', factor=0.1, patience=10, verbose=1,
+                 mode='auto', min_delta=1e-4, cooldown=0, min_lr=0.0):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.cooldown_counter = 0
+        self.wait = 0
+        self.best = None
+        if mode == 'max' or (mode == 'auto' and 'acc' in monitor):
+            self.compare = lambda a, b: a > b + self.min_delta
+        else:
+            self.compare = lambda a, b: a < b - self.min_delta
+
+    def on_epoch_end(self, epoch, logs=None):
+        logs = logs or {}
+        current = logs.get(self.monitor)
+        if current is None:
+            current = logs.get('eval_' + self.monitor)
+        if current is None:
+            return
+        if isinstance(current, (list, tuple)):
+            current = current[0]
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        if self.best is None or self.compare(current, self.best):
+            self.best = current
+            self.wait = 0
+        elif self.cooldown_counter <= 0:
+            self.wait += 1
+            if self.wait >= self.patience:
+                opt = getattr(self.model, '_optimizer', None)
+                if opt is not None:
+                    old = float(opt.get_lr())
+                    new = max(old * self.factor, self.min_lr)
+                    if old - new > 1e-12:
+                        opt.set_lr(new)
+                        if self.verbose:
+                            print(f"Epoch {epoch}: ReduceLROnPlateau "
+                                  f"reducing learning rate to {new}.")
+                self.cooldown_counter = self.cooldown
+                self.wait = 0
+
+
 class VisualDL(Callback):
     """Accepted for API parity; logs to stdout in this environment."""
 

@@ -7,4 +7,5 @@ linalg.py. The OP_REGISTRY in common.py is the lookup the static executor
 uses (parity: framework/op_registry.h).
 """
 from . import common, math, manip, creation, nn_ops, linalg, sequence
+from . import recsys
 from .common import OP_REGISTRY

@@ -306,3 +306,182 @@ for _name, _fn in [('sequence_pad', sequence_pad),
                    ('crf_decoding', crf_decoding),
                    ('viterbi_decode', viterbi_decode)]:
     register(_name, _fn)
+
+
+# ---- dense-form sequence_* remainder (fluid/layers/sequence_lod.py) --------
+def _mask_of(x, lengths):
+    L = x.data.shape[1]
+    return (jnp.arange(L)[None, :]
+            < as_tensor(lengths).data.reshape(-1, 1)).astype(x.data.dtype)
+
+
+def sequence_pool(input, pool_type='sum', lengths=None, pad_value=0.0):
+    """sequence_pool_op over padded [B, L, ...] + lengths: sum/average/
+    sqrt/max/min/first/last over each sequence's valid steps."""
+    x = as_tensor(input)
+    if lengths is None:
+        lens = jnp.full((x.data.shape[0],), x.data.shape[1], jnp.int32)
+    else:
+        lens = as_tensor(lengths).data.reshape(-1)
+    L = x.data.shape[1]
+    m = (jnp.arange(L)[None, :] < lens[:, None])
+    me = m.reshape(m.shape + (1,) * (x.data.ndim - 2))
+    pt = pool_type.lower()
+    empty = (lens <= 0).reshape(-1, *([1] * (x.data.ndim - 2)))
+    if pt in ('sum', 'average', 'sqrt'):
+        s = jnp.where(me, x.data, 0).sum(axis=1)
+        if pt == 'average':
+            s = s / jnp.maximum(lens, 1).reshape(-1, *([1] * (s.ndim - 1)))
+        elif pt == 'sqrt':
+            s = s / jnp.sqrt(jnp.maximum(lens, 1)).reshape(
+                -1, *([1] * (s.ndim - 1)))
+        return Tensor(jnp.where(empty, pad_value, s))
+    if pt == 'max':
+        s = jnp.where(me, x.data, -jnp.inf).max(axis=1)
+        return Tensor(jnp.where(empty, pad_value, s))  # no -inf leak
+    if pt == 'min':
+        s = jnp.where(me, x.data, jnp.inf).min(axis=1)
+        return Tensor(jnp.where(empty, pad_value, s))
+    if pt == 'first':
+        return Tensor(x.data[:, 0])
+    if pt == 'last':
+        idx = jnp.maximum(lens - 1, 0)
+        return Tensor(jnp.take_along_axis(
+            x.data, idx.reshape(-1, 1, *([1] * (x.data.ndim - 2))),
+            axis=1).squeeze(1))
+    raise ValueError(f"unknown pool_type {pool_type!r}")
+
+
+def sequence_first_step(input, lengths=None):
+    return sequence_pool(input, 'first', lengths)
+
+
+def sequence_last_step(input, lengths=None):
+    return sequence_pool(input, 'last', lengths)
+
+
+def sequence_softmax(input, lengths=None):
+    """softmax over each sequence's valid steps (padding gets 0)."""
+    x = as_tensor(input)
+    if lengths is None:
+        return Tensor(jax.nn.softmax(x.data, axis=1))
+    m = _mask_of(x, lengths)
+    z = jnp.where(m > 0, x.data, -jnp.inf)
+    out = jax.nn.softmax(z, axis=1)
+    return Tensor(jnp.where(m > 0, out, 0.0))
+
+
+def sequence_concat(inputs, lengths_list=None):
+    """Concatenate along the time axis; with lengths, each output row is
+    the packed concat of the inputs' valid prefixes (re-padded)."""
+    xs = [as_tensor(t) for t in inputs]
+    if lengths_list is None:
+        return Tensor(jnp.concatenate([t.data for t in xs], axis=1))
+    lens = [np.asarray(as_tensor(l).data).reshape(-1)
+            for l in lengths_list]
+    B = xs[0].data.shape[0]
+    total = [int(sum(l[b] for l in lens)) for b in range(B)]
+    ml = max(total) if total else 0
+    rows = []
+    for b in range(B):
+        parts = [np.asarray(t.data[b, :int(l[b])])
+                 for t, l in zip(xs, lens)]
+        row = np.concatenate(parts, axis=0)
+        pad = np.zeros((ml - row.shape[0],) + row.shape[1:],
+                       row.dtype)
+        rows.append(np.concatenate([row, pad], axis=0))
+    return Tensor(jnp.asarray(np.stack(rows))), Tensor(
+        jnp.asarray(np.array(total, np.int64)))
+
+
+def sequence_expand_as(x, y_lengths):
+    """Repeat row b of x[B, ...] lengths[b] times (packed output) —
+    sequence_expand_as_op."""
+    xa = as_tensor(x)
+    lens = np.asarray(as_tensor(y_lengths).data).reshape(-1)
+    idx = np.repeat(np.arange(len(lens)), lens.astype(np.int64))
+    return Tensor(jnp.take(xa.data, jnp.asarray(idx), axis=0))
+
+
+def sequence_enumerate(input, win_size, pad_value=0, lengths=None):
+    """All win_size-grams per step (padded past the end) —
+    sequence_enumerate_op on a padded [B, L] batch."""
+    x = as_tensor(input)
+    B, L = x.data.shape[:2]
+    cols = []
+    for off in range(win_size):
+        sh = jnp.concatenate(
+            [x.data[:, off:],
+             jnp.full((B, off), pad_value, x.data.dtype)], axis=1)
+        cols.append(sh)
+    out = jnp.stack(cols, axis=-1)
+    if lengths is not None:
+        lens = as_tensor(lengths).data.reshape(-1, 1, 1)
+        pos = jnp.arange(L).reshape(1, -1, 1) + jnp.arange(win_size)
+        out = jnp.where(pos < lens, out, pad_value)
+    return Tensor(out)
+
+
+def sequence_reshape(input, new_dim):
+    """[B, L, D] -> [B, L*D/new_dim, new_dim] (sequence_reshape_op)."""
+    x = as_tensor(input)
+    B = x.data.shape[0]
+    return Tensor(x.data.reshape(B, -1, new_dim))
+
+
+def sequence_slice(input, offset, length):
+    """Per-sequence slice [offset[b] : offset[b]+length[b]] re-padded to
+    max(length) (sequence_slice_op)."""
+    x = as_tensor(input)
+    offs = np.asarray(as_tensor(offset).data).reshape(-1)
+    lens = np.asarray(as_tensor(length).data).reshape(-1)
+    ml = int(lens.max()) if lens.size else 0
+    rows = []
+    for b in range(x.data.shape[0]):
+        seg = np.asarray(
+            x.data[b, int(offs[b]):int(offs[b]) + int(lens[b])])
+        pad = np.zeros((ml - seg.shape[0],) + seg.shape[1:], seg.dtype)
+        rows.append(np.concatenate([seg, pad], axis=0))
+    return Tensor(jnp.asarray(np.stack(rows)))
+
+
+def sequence_scatter(input, index, updates):
+    """out[b, index[b, i]] += updates[b, i] (sequence_scatter_op)."""
+    x = as_tensor(input)
+    idx = as_tensor(index).data.astype(jnp.int32)
+    upd = as_tensor(updates).data
+    return Tensor(x.data.at[
+        jnp.arange(x.data.shape[0])[:, None], idx].add(upd))
+
+
+def sequence_conv(input, filter_w, context_length=3, context_start=None,
+                  lengths=None, bias=None):
+    """sequence_conv_op: each step's output = flattened context window
+    (zero past sequence bounds) @ filter [ctx*D, O]."""
+    x = as_tensor(input)
+    w = as_tensor(filter_w)
+    B, L, D = x.data.shape
+    start = -((context_length - 1) // 2) if context_start is None \
+        else context_start
+    cols = []
+    for c in range(context_length):
+        off = start + c
+        if off < 0:
+            sh = jnp.concatenate(
+                [jnp.zeros((B, -off, D), x.data.dtype),
+                 x.data[:, :L + off]], axis=1)
+        elif off > 0:
+            sh = jnp.concatenate(
+                [x.data[:, off:],
+                 jnp.zeros((B, off, D), x.data.dtype)], axis=1)
+        else:
+            sh = x.data
+        cols.append(sh)
+    ctx = jnp.concatenate(cols, axis=-1)          # [B, L, ctx*D]
+    if lengths is not None:
+        m = _mask_of(as_tensor(ctx), lengths)
+        ctx = ctx * m[..., None] if m.ndim < ctx.ndim else ctx * m
+    out = jnp.einsum('bld,do->blo', ctx, w.data)
+    if bias is not None:
+        out = out + as_tensor(bias).data
+    return Tensor(out)

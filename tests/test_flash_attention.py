@@ -191,3 +191,92 @@ class TestBlockFitting:
         assert fa._fit_block(512, 100) == 25 or fa._fit_block(512, 100) in (4, 25, 100)
         assert 100 % fa._fit_block(512, 100) == 0
         assert fa._fit_block(512, 7) == 7    # prime: single block
+
+
+class TestPackedFlash:
+    """Transpose-free packed layout ([B, L, H*D]; the BERT-path kernels —
+    one program per (batch, q-block) runs every head over static column
+    slices, so the [B, nh, L, hd] physical transpose never exists)."""
+
+    def _qkv(self, B=1, L=256, H=2, D=64, seed=0):
+        rng = np.random.RandomState(seed)
+        mk = lambda s: jnp.asarray(rng.randn(B, L, H, D), jnp.float32)
+        return mk(0), mk(1), mk(2), jnp.asarray(
+            np.where(rng.rand(B, L) > 0.25, 0, -1e9), jnp.float32)
+
+    def test_packed_matches_reference_with_bias(self):
+        from paddle_tpu.ops.pallas.flash_attention import (
+            flash_attention_packed, _reference_attention)
+        B, L, H, D = 1, 256, 2, 64
+        q, k, v, bias = self._qkv(B, L, H, D)
+        o = flash_attention_packed(q.reshape(B, L, H * D),
+                                   k.reshape(B, L, H * D),
+                                   v.reshape(B, L, H * D), H, D,
+                                   bias=bias)
+        to = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, L, D)
+        ref = _reference_attention(to(q), to(k), to(v), bias=bias,
+                                   num_heads=H, causal=False)
+        ref = ref.reshape(B, H, L, D).transpose(0, 2, 1, 3) \
+            .reshape(B, L, H * D)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_packed_grads_match_reference(self):
+        from paddle_tpu.ops.pallas.flash_attention import (
+            flash_attention_packed, _reference_attention)
+        B, L, H, D = 1, 256, 2, 64
+        q, k, v, bias = self._qkv(B, L, H, D, seed=3)
+
+        def loss_p(q, k, v):
+            return jnp.sum(flash_attention_packed(
+                q.reshape(B, L, H * D), k.reshape(B, L, H * D),
+                v.reshape(B, L, H * D), H, D, bias=bias) ** 2)
+
+        def loss_r(q, k, v):
+            to = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, L, D)
+            return jnp.sum(_reference_attention(
+                to(q), to(k), to(v), bias=bias, num_heads=H,
+                causal=False) ** 2)
+
+        g1 = jax.grad(loss_p, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-4, atol=5e-5)
+
+    def test_mha_blhd_route_matches_dense(self):
+        """MultiHeadAttention's transpose-free flash route == the dense
+        path, values AND grads (FLAGS_flash_min_seq lowered to force the
+        route at a test-sized L)."""
+        import paddle_tpu as paddle
+        from paddle_tpu.core import flags
+        from paddle_tpu.core.tensor import Tensor
+        paddle.seed(0)
+        mha = paddle.nn.MultiHeadAttention(128, 2, dropout=0.0)
+        x_np = np.random.RandomState(5).randn(2, 256, 128) \
+            .astype('float32') * 0.3
+        mask = np.zeros((2, 1, 1, 256), 'float32')
+        mask[1, :, :, 200:] = -1e9
+
+        def run():
+            x = Tensor(jnp.asarray(x_np))
+            x.stop_gradient = False
+            out = mha(x, attn_mask=Tensor(jnp.asarray(mask)))
+            out.sum().backward()
+            g = np.asarray(x.grad.data)
+            for p in mha.parameters():
+                p.clear_grad() if hasattr(p, 'clear_grad') else None
+            return np.asarray(out.data), g
+
+        old = flags.flag('FLAGS_flash_min_seq')
+        try:
+            flags.set_flags({'FLAGS_flash_min_seq': 4096})
+            dense_out, dense_g = run()
+            flags.set_flags({'FLAGS_flash_min_seq': 256})
+            flash_out, flash_g = run()
+        finally:
+            flags.set_flags({'FLAGS_flash_min_seq': old})
+        np.testing.assert_allclose(flash_out, dense_out, rtol=2e-4,
+                                   atol=2e-5)
+        np.testing.assert_allclose(flash_g, dense_g, rtol=5e-4,
+                                   atol=5e-5)
