@@ -28,6 +28,9 @@ _FLAGS = {
     # min sequence length for the flash route; below it XLA's fused dense
     # attention usually wins on TPU (tunable per model/shape)
     'FLAGS_flash_min_seq': 1024,
+    # causal_attention (GPT path) through the packed transpose-free
+    # kernel; False restores the BHLD-transposing route
+    'FLAGS_flash_packed_causal': True,
     # wrap op-kernel exceptions with [operator < name > error] context
     # (enforce.h framing; off by default to keep exception types exact)
     'FLAGS_op_error_context': False,

@@ -8,6 +8,7 @@ Table configs come from `strategy.sparse_table_configs`-style dicts
 TableParameter dicts), or defaults; server endpoint from
 PADDLE_CURRENT_ENDPOINT.
 """
+import dataclasses
 import json
 import os
 
@@ -16,42 +17,106 @@ import os
 # the DistributedStrategy, the env is the launch-time channel)
 _TABLE_CONFIGS = None
 
-_TABLE_KEYS = {'table_id', 'embedx_dim', 'optimizer', 'init_range',
-               'shard_num', 'seed', 'beta1', 'beta2', 'eps', 'ssd_path',
-               'mem_budget_rows'}
+_OPTIMIZERS = ('sgd', 'adagrad', 'adam')
+
+
+@dataclasses.dataclass
+class TableParameter:
+    """Typed table config (parity: ps.proto TableParameter +
+    CtrCommonAccessor hypers built by the_one_ps._get_fleet_proto:434 —
+    a misspelled key or out-of-range hyper fails HERE, at configuration
+    time, not as a garbage table on the server)."""
+    table_id: int
+    embedx_dim: int
+    optimizer: str = 'adagrad'
+    init_range: float = 0.05
+    shard_num: int = 16
+    seed: int = 0
+    beta1: float = 0.9
+    beta2: float = 0.999
+    eps: float = 1e-8
+    ssd_path: str = None
+    mem_budget_rows: int = 1 << 20
+
+    def __post_init__(self):
+        if not isinstance(self.table_id, int) or self.table_id < 0:
+            raise ValueError(f"table_id must be a non-negative int, got "
+                             f"{self.table_id!r}")
+        if not isinstance(self.embedx_dim, int) or self.embedx_dim <= 0:
+            raise ValueError(f"embedx_dim must be a positive int, got "
+                             f"{self.embedx_dim!r}")
+        if self.optimizer not in _OPTIMIZERS:
+            raise ValueError(f"optimizer must be one of {_OPTIMIZERS}, "
+                             f"got {self.optimizer!r}")
+        if not (0.0 <= self.init_range <= 10.0):
+            raise ValueError(f"init_range out of range: {self.init_range}")
+        if self.shard_num <= 0:
+            raise ValueError(f"shard_num must be positive: "
+                             f"{self.shard_num}")
+        for name in ('beta1', 'beta2'):
+            v = getattr(self, name)
+            if not (0.0 <= v < 1.0):
+                raise ValueError(f"{name} must be in [0, 1): {v}")
+        if self.eps <= 0:
+            raise ValueError(f"eps must be positive: {self.eps}")
+        if self.mem_budget_rows <= 0:
+            raise ValueError(f"mem_budget_rows must be positive: "
+                             f"{self.mem_budget_rows}")
+        if self.ssd_path is not None and not isinstance(self.ssd_path,
+                                                        str):
+            raise ValueError("ssd_path must be a path string")
+
+    @classmethod
+    def from_dict(cls, d):
+        fields = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - fields
+        if unknown:
+            raise ValueError(
+                f"unknown table config keys: {sorted(unknown)}; "
+                f"known: {sorted(fields)}")
+        missing = {'table_id', 'embedx_dim'} - set(d)
+        if missing:
+            raise ValueError(f"table config needs {sorted(missing)}")
+        return cls(**d)
+
+    def to_dict(self):
+        out = dataclasses.asdict(self)
+        if out['ssd_path'] is None:
+            out.pop('ssd_path')
+        return out
 
 
 def set_table_configs(configs):
-    """configs: list of dicts with keys table_id, embedx_dim, optimizer,
-    and optionally init_range/shard_num/seed/beta1/beta2/eps/ssd_path/
-    mem_budget_rows (parity: ps.proto TableParameter + accessor)."""
+    """configs: list of TableParameter instances or dicts (validated
+    through TableParameter — parity: ps.proto TableParameter +
+    accessor)."""
     global _TABLE_CONFIGS
-    for c in configs or []:
-        unknown = set(c) - _TABLE_KEYS
-        if unknown:
-            raise ValueError(f"unknown table config keys: {unknown}")
-        if 'table_id' not in c or 'embedx_dim' not in c:
-            raise ValueError("table config needs table_id and embedx_dim")
-    _TABLE_CONFIGS = list(configs) if configs else None
+    if not configs:
+        _TABLE_CONFIGS = None
+        return
+    parsed = [c if isinstance(c, TableParameter)
+              else TableParameter.from_dict(c) for c in configs]
+    ids = [c.table_id for c in parsed]
+    if len(set(ids)) != len(ids):
+        raise ValueError(f"duplicate table_id in configs: {ids}")
+    _TABLE_CONFIGS = parsed
 
 
 def _table_configs():
-    """→ list of TableParameter dicts."""
+    """→ list of validated table-config dicts."""
     if _TABLE_CONFIGS is not None:
-        return list(_TABLE_CONFIGS)
+        return [c.to_dict() for c in _TABLE_CONFIGS]
     spec = os.environ.get('PADDLE_PS_TABLES', '0:16:adagrad')
     if spec.lstrip().startswith('['):
-        cfgs = json.loads(spec)
-        for c in cfgs:            # validate without caching — the env is
-            unknown = set(c) - _TABLE_KEYS   # re-read on every call
-            if unknown:
-                raise ValueError(f"unknown table config keys: {unknown}")
-        return cfgs
+        # validate on every call — the env is a launch-time channel
+        return [TableParameter.from_dict(c).to_dict()
+                for c in json.loads(spec)]
     out = []
     for part in spec.split(','):
         tid, dim, opt = part.split(':')
-        out.append({'table_id': int(tid), 'embedx_dim': int(dim),
-                    'optimizer': opt})
+        out.append(TableParameter(table_id=int(tid),
+                                  embedx_dim=int(dim),
+                                  optimizer=opt).to_dict())
     return out
 
 

@@ -180,11 +180,13 @@ class GPTAttention(nn.Layer):
                                      sp=topology_runtime.axis_size('sp'),
                                      dropout=self.attn_dropout_p
                                      if self.training else 0.0)
-        elif self.use_flash and L >= 512:
+        elif self.use_flash and L >= 512 and not (
+                self.attn_dropout_p > 0.0 and self.training):
+            # active attention dropout falls back to the dense path —
+            # the flash kernels don't drop probs, and silently training
+            # without the configured regularization would be wrong
             from ..ops.pallas import flash_attention as fa
-            ctx = fa.causal_attention(qkv, nh, hd,
-                                      dropout=self.attn_dropout_p
-                                      if self.training else 0.0)
+            ctx = fa.causal_attention(qkv, nh, hd)
         else:
             ctx = run_op('fused_attention', attn, [qkv])
         out = self.out_proj(ctx)

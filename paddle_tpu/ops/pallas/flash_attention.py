@@ -795,10 +795,30 @@ def flash_attention(q, k, v, bias=None, num_heads=1, causal=True):
 def causal_attention(qkv, num_heads, head_dim, dropout=0.0):
     """Tensor-level entry used by GPTAttention: qkv [B, L, nh*3*hd]
     ((head, 3, hd) Megatron packing — TP-shardable) → context
-    [B, L, nh*hd]."""
+    [B, L, nh*hd]. Default route is the packed transpose-free kernel
+    (q/k/v stay in [B, L, H*D]; only the cheap qkv un-interleave slice
+    remains); FLAGS_flash_packed_causal=False restores the BHLD route.
+
+    The kernels do not drop attention probs: callers with ACTIVE
+    attention dropout must use the dense path (GPTAttention falls back;
+    a nonzero dropout here is a routing bug, so raise loudly)."""
+    from ...core import flags
+    if dropout:
+        raise ValueError(
+            "flash causal_attention does not implement attention-prob "
+            "dropout; route through the dense path when attn dropout "
+            "is active")
+    packed = bool(flags.flag('FLAGS_flash_packed_causal', True))
+
     def fn(a):
         B, L, _ = a.shape
         x = a.reshape(B, L, num_heads, 3, head_dim)
+        if packed:
+            q = x[:, :, :, 0].reshape(B, L, num_heads * head_dim)
+            k = x[:, :, :, 1].reshape(B, L, num_heads * head_dim)
+            v = x[:, :, :, 2].reshape(B, L, num_heads * head_dim)
+            return _flash_attn_packed(True, num_heads, head_dim, q, k, v,
+                                      jnp.zeros((B, L), jnp.float32))
         q = x[:, :, :, 0].transpose(0, 2, 1, 3).reshape(B * num_heads, L,
                                                         head_dim)
         k = x[:, :, :, 1].transpose(0, 2, 1, 3).reshape(B * num_heads, L,

@@ -2,4 +2,5 @@
 from . import lr
 from .optimizer import (Optimizer, SGD, Momentum, Adagrad, RMSProp, Adam,
                         AdamW, Adamax, Lamb, Lars, LarsMomentum,
-                        DGCMomentumOptimizer)
+                        DGCMomentumOptimizer, Adadelta, DecayedAdagrad,
+                        Ftrl)

@@ -613,6 +613,84 @@ class Lamb(Optimizer):
         self._cur_param_name = None
 
 
+class Adadelta(Optimizer):
+    """Parity: operators/optimizers/adadelta_op — accumulated-gradient /
+    accumulated-update RMS ratio rule."""
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-06, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None, **kwargs):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def init_state(self, param):
+        return {'avg_squared_grad': jnp.zeros(param.data.shape,
+                                              jnp.float32),
+                'avg_squared_update': jnp.zeros(param.data.shape,
+                                                jnp.float32)}
+
+    def update(self, param, grad, state, lr):
+        rho, eps = self._rho, self._epsilon
+        g2 = rho * state['avg_squared_grad'] + (1 - rho) * grad * grad
+        upd = grad * jnp.sqrt(state['avg_squared_update'] + eps) \
+            / jnp.sqrt(g2 + eps)
+        u2 = rho * state['avg_squared_update'] + (1 - rho) * upd * upd
+        return param - lr * upd, {'avg_squared_grad': g2,
+                                  'avg_squared_update': u2}
+
+
+class DecayedAdagrad(Optimizer):
+    """Parity: operators/optimizers/decayed_adagrad_op."""
+
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-06,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None, **kwargs):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        self._decay = decay
+        self._epsilon = epsilon
+
+    def init_state(self, param):
+        return {'moment': jnp.zeros(param.data.shape, jnp.float32)}
+
+    def update(self, param, grad, state, lr):
+        m = self._decay * state['moment'] \
+            + (1 - self._decay) * grad * grad
+        return param - lr * grad / (jnp.sqrt(m) + self._epsilon), \
+            {'moment': m}
+
+
+class Ftrl(Optimizer):
+    """Parity: operators/optimizers/ftrl_op — follow-the-regularized-
+    leader (McMahan et al.), the classic sparse-LR CTR optimizer."""
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None, **kwargs):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def init_state(self, param):
+        return {'squared': jnp.zeros(param.data.shape, jnp.float32),
+                'linear': jnp.zeros(param.data.shape, jnp.float32)}
+
+    def update(self, param, grad, state, lr):
+        l1, l2, p = self._l1, self._l2, self._lr_power
+        n, z = state['squared'], state['linear']
+        n_new = n + grad * grad
+        sigma = (jnp.power(n_new, -p) - jnp.power(n, -p)) / lr
+        z_new = z + grad - sigma * param
+        new_p = jnp.where(
+            jnp.abs(z_new) <= l1,
+            jnp.zeros_like(param),
+            (jnp.sign(z_new) * l1 - z_new)
+            / (jnp.power(n_new, -p) / lr + 2 * l2))
+        return new_p, {'squared': n_new, 'linear': z_new}
+
+
 class Lars(Momentum):
     """Parity: operators/optimizers/lars_momentum_op."""
 

@@ -2,6 +2,7 @@
 from . import download
 from . import cpp_extension
 from . import unique_name
+from . import crypto
 from ..core.tensor import Tensor
 
 

@@ -1,0 +1,142 @@
+"""Model-file encryption utilities (N38).
+
+Reference parity: framework/io/crypto — CipherFactory.CreateCipher
+(cipher.cc:23, default "AES_CTR_NoPadding"), AESCipher
+(aes_cipher.h:48), CipherUtils.GenKey/GenKeyToFile/ReadKeyFromFile
+(cipher_utils.cc:25-55). Used to encrypt serialized programs/params for
+deployment (the inference engine decrypts in memory).
+
+TPU-rebuild design: AES-CTR and AES-GCM via the `cryptography` package
+(baked into the image) instead of CryptoPP; the factory keys off the
+same cipher-name strings so `CipherFactory.create_cipher(
+"AES_CTR_NoPadding")` code ports unchanged.
+"""
+import os
+
+from cryptography.hazmat.primitives.ciphers import Cipher as _CCipher
+from cryptography.hazmat.primitives.ciphers import algorithms, modes
+
+__all__ = ['Cipher', 'AESCipher', 'CipherFactory', 'CipherUtils']
+
+
+class Cipher:
+    """Parity: framework/io/crypto/cipher.h Cipher interface."""
+
+    def encrypt(self, plaintext: bytes, key: bytes) -> bytes:
+        raise NotImplementedError
+
+    def decrypt(self, ciphertext: bytes, key: bytes) -> bytes:
+        raise NotImplementedError
+
+    def encrypt_to_file(self, plaintext, key, filename):
+        with open(filename, 'wb') as f:
+            f.write(self.encrypt(plaintext, key))
+
+    def decrypt_from_file(self, key, filename):
+        with open(filename, 'rb') as f:
+            return self.decrypt(f.read(), key)
+
+
+class AESCipher(Cipher):
+    """AES-CTR (default) or AES-GCM, IV/tag framed into the ciphertext —
+    parity: aes_cipher.h AESCipher::Init/BuildCipher."""
+
+    def __init__(self, cipher_name='AES_CTR_NoPadding', iv_size=128,
+                 tag_size=128):
+        if 'AES' not in cipher_name:
+            raise ValueError(f"not an AES cipher: {cipher_name!r}")
+        self._gcm = 'GCM' in cipher_name
+        # CTR requires a 16-byte nonce and GCM tags are 4..16 bytes —
+        # validate configured sizes instead of framing undecryptable
+        # files (cipher_utils.cc enforces the same ranges)
+        if not self._gcm and iv_size != 128:
+            raise ValueError("AES-CTR requires iv_size=128 bits")
+        if self._gcm and not (32 <= tag_size <= 128):
+            raise ValueError("AES-GCM tag_size must be 32..128 bits")
+        if tag_size % 8 or iv_size % 8:
+            raise ValueError("iv_size/tag_size must be multiples of 8")
+        self._iv_bytes = 16 if not self._gcm else 12
+        self._tag_bytes = tag_size // 8
+        self.name = cipher_name
+
+    def _mode(self, iv, tag=None):
+        if self._gcm:
+            if tag is not None:
+                return modes.GCM(iv, tag, min_tag_length=len(tag))
+            return modes.GCM(iv)
+        return modes.CTR(iv)
+
+    def encrypt(self, plaintext, key):
+        if isinstance(plaintext, str):
+            plaintext = plaintext.encode()
+        iv = os.urandom(self._iv_bytes)
+        enc = _CCipher(algorithms.AES(key), self._mode(iv)).encryptor()
+        ct = enc.update(plaintext) + enc.finalize()
+        if self._gcm:
+            return bytes([len(iv)]) + iv + enc.tag[:self._tag_bytes] + ct
+        return bytes([len(iv)]) + iv + ct
+
+    def decrypt(self, ciphertext, key):
+        n_iv = ciphertext[0]
+        iv = ciphertext[1:1 + n_iv]
+        rest = ciphertext[1 + n_iv:]
+        if self._gcm:
+            tag, ct = rest[:self._tag_bytes], rest[self._tag_bytes:]
+            dec = _CCipher(algorithms.AES(key),
+                           self._mode(iv, tag)).decryptor()
+            return dec.update(ct) + dec.finalize()
+        dec = _CCipher(algorithms.AES(key), self._mode(iv)).decryptor()
+        return dec.update(rest) + dec.finalize()
+
+
+class CipherFactory:
+    """Parity: cipher.cc CipherFactory::CreateCipher — config file with
+    `cipher_name: <name>` lines, default AES_CTR_NoPadding."""
+
+    @staticmethod
+    def create_cipher(config_file=None):
+        name = 'AES_CTR_NoPadding'
+        iv_size = tag_size = 128
+        if config_file:
+            cfg = CipherUtils.load_config(config_file)
+            name = cfg.get('cipher_name', name)
+            iv_size = int(cfg.get('iv_size', iv_size))
+            tag_size = int(cfg.get('tag_size', tag_size))
+        if 'AES' in name:
+            return AESCipher(name, iv_size, tag_size)
+        raise ValueError(f"unsupported cipher {name!r}")
+
+
+class CipherUtils:
+    """Parity: cipher_utils.cc."""
+
+    @staticmethod
+    def gen_key(length):
+        """length in BITS (reference GenKey(int length))."""
+        if length % 8:
+            raise ValueError("key length must be a multiple of 8 bits")
+        return os.urandom(length // 8)
+
+    @staticmethod
+    def gen_key_to_file(length, filename):
+        key = CipherUtils.gen_key(length)
+        with open(filename, 'wb') as f:
+            f.write(key)
+        return key
+
+    @staticmethod
+    def read_key_from_file(filename):
+        with open(filename, 'rb') as f:
+            return f.read()
+
+    @staticmethod
+    def load_config(filename):
+        out = {}
+        with open(filename) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith('#'):
+                    continue
+                k, _, v = line.partition(':')
+                out[k.strip()] = v.strip()
+        return out

@@ -280,3 +280,42 @@ class TestPackedFlash:
                                    atol=2e-5)
         np.testing.assert_allclose(flash_g, dense_g, rtol=5e-4,
                                    atol=5e-5)
+
+    def test_packed_causal_matches_reference(self):
+        from paddle_tpu.ops.pallas.flash_attention import (
+            flash_attention_packed, _reference_attention)
+        B, L, H, D = 1, 256, 2, 64
+        rng = np.random.RandomState(7)
+        q = jnp.asarray(rng.randn(B, L, H, D), jnp.float32)
+        k = jnp.asarray(rng.randn(B, L, H, D), jnp.float32)
+        v = jnp.asarray(rng.randn(B, L, H, D), jnp.float32)
+        o = flash_attention_packed(q.reshape(B, L, H * D),
+                                   k.reshape(B, L, H * D),
+                                   v.reshape(B, L, H * D), H, D,
+                                   causal=True)
+        to = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, L, D)
+        ref = _reference_attention(to(q), to(k), to(v), causal=True)
+        ref = ref.reshape(B, H, L, D).transpose(0, 2, 1, 3) \
+            .reshape(B, L, H * D)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_causal_attention_entry_packed_vs_bhld(self):
+        """The GPT qkv entry gives identical results through the packed
+        (default) and BHLD routes."""
+        from paddle_tpu.ops.pallas import flash_attention as FA
+        from paddle_tpu.core import flags
+        from paddle_tpu.core.tensor import Tensor
+        B, L, H, D = 1, 256, 2, 64
+        rng = np.random.RandomState(9)
+        qkv = Tensor(jnp.asarray(rng.randn(B, L, H * 3 * D) * 0.3,
+                                 jnp.float32))
+        old = flags.flag('FLAGS_flash_packed_causal')
+        try:
+            flags.set_flags({'FLAGS_flash_packed_causal': True})
+            a = np.asarray(FA.causal_attention(qkv, H, D).data)
+            flags.set_flags({'FLAGS_flash_packed_causal': False})
+            b = np.asarray(FA.causal_attention(qkv, H, D).data)
+        finally:
+            flags.set_flags({'FLAGS_flash_packed_causal': old})
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-5)

@@ -124,3 +124,181 @@ class TestMultiprocessDataLoader:
 
         with pytest.raises(RuntimeError, match="boom"):
             list(DataLoader(Bad(), batch_size=2, num_workers=2))
+
+
+class TestOptimizerTail:
+    """Adadelta / DecayedAdagrad / Ftrl vs hand-computed update rules
+    (operators/optimizers/*_op parity)."""
+
+    def _one_step(self, opt_cls, **kw):
+        import paddle_tpu as paddle
+        paddle.seed(0)
+        w = paddle.to_tensor(np.array([1.0, -2.0], 'float32'))
+        w.stop_gradient = False
+        opt = opt_cls(learning_rate=0.1, parameters=[w], **kw)
+        loss = (w * w).sum()
+        loss.backward()
+        g = np.asarray(w.grad.data).copy()
+        opt.step()
+        return np.asarray(w.data), g
+
+    def test_adadelta_rule(self):
+        import paddle_tpu as paddle
+        w, g = self._one_step(paddle.optimizer.Adadelta, rho=0.9,
+                              epsilon=1e-6)
+        g2 = 0.1 * g * g
+        upd = g * np.sqrt(1e-6) / np.sqrt(g2 + 1e-6)
+        np.testing.assert_allclose(w, [1.0, -2.0] - 0.1 * upd, rtol=1e-5)
+
+    def test_decayed_adagrad_rule(self):
+        import paddle_tpu as paddle
+        w, g = self._one_step(paddle.optimizer.DecayedAdagrad, decay=0.9,
+                              epsilon=1e-6)
+        m = 0.1 * g * g
+        np.testing.assert_allclose(
+            w, [1.0, -2.0] - 0.1 * g / (np.sqrt(m) + 1e-6), rtol=1e-5)
+
+    def test_ftrl_sparsifies(self):
+        import paddle_tpu as paddle
+        # strong l1 pushes small-coordinate weights exactly to zero
+        paddle.seed(0)
+        w = paddle.to_tensor(np.array([0.01, 5.0], 'float32'))
+        w.stop_gradient = False
+        opt = paddle.optimizer.Ftrl(learning_rate=0.5, l1=10.0, l2=0.0,
+                                    parameters=[w])
+        for _ in range(3):
+            loss = (w * w).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        vals = np.asarray(w.data)
+        assert vals[0] == 0.0                   # l1 zeroed the small one
+
+
+class TestMiscOpTail:
+    def test_center_loss(self):
+        from paddle_tpu.ops import contrib as C
+        from paddle_tpu.core.tensor import Tensor
+        import jax.numpy as jnp
+        x = np.array([[1., 0.], [0., 1.], [2., 0.]], 'float32')
+        c = np.zeros((2, 2), 'float32')
+        y = np.array([0, 1, 0], 'int64')
+        loss, nc = C.center_loss(Tensor(jnp.asarray(x)),
+                                 Tensor(jnp.asarray(y)), 2,
+                                 alpha=0.5,
+                                 centers=Tensor(jnp.asarray(c)))
+        np.testing.assert_allclose(
+            np.asarray(loss.data).reshape(-1),
+            [0.5, 0.5, 2.0], rtol=1e-6)
+        # class 0: residual mean (x0 + x2)/ (2+1) * alpha
+        np.testing.assert_allclose(np.asarray(nc.data)[0],
+                                   0.5 * (x[0] + x[2]) / 3.0, rtol=1e-6)
+
+    def test_hash_op_bounds_and_determinism(self):
+        from paddle_tpu.ops import contrib as C
+        from paddle_tpu.core.tensor import Tensor
+        import jax.numpy as jnp
+        ids = Tensor(jnp.asarray(np.arange(100, dtype='int64')))
+        h1 = np.asarray(C.hash_op(ids, num_hash=4, mod_by=97).data)
+        h2 = np.asarray(C.hash_op(ids, num_hash=4, mod_by=97).data)
+        assert h1.shape == (100, 4)
+        assert (h1 >= 0).all() and (h1 < 97).all()
+        np.testing.assert_array_equal(h1, h2)
+        assert len(np.unique(h1)) > 20          # spreads
+
+    def test_ctc_align(self):
+        from paddle_tpu.ops import contrib as C
+        from paddle_tpu.core.tensor import Tensor
+        import jax.numpy as jnp
+        ids = np.array([[0, 1, 1, 0, 2, 2, 0, 3]], 'int32')
+        out, lens = C.ctc_align(Tensor(jnp.asarray(ids)), blank=0)
+        np.testing.assert_array_equal(np.asarray(out.data)[0][:3],
+                                      [1, 2, 3])
+        assert int(np.asarray(lens.data)[0]) == 3
+        assert (np.asarray(out.data)[0][3:] == 0).all()
+
+    def test_conv_shift_oracle(self):
+        from paddle_tpu.ops import contrib as C
+        from paddle_tpu.core.tensor import Tensor
+        import jax.numpy as jnp
+        rng = np.random.RandomState(0)
+        x = rng.rand(2, 7).astype('float32')
+        y = rng.rand(2, 3).astype('float32')
+        out = np.asarray(C.conv_shift(Tensor(jnp.asarray(x)),
+                                      Tensor(jnp.asarray(y))).data)
+        want = np.zeros_like(x)
+        for b in range(2):
+            for i in range(7):
+                for j in range(3):
+                    want[b, i] += x[b, (i + j - 1) % 7] * y[b, j]
+        np.testing.assert_allclose(out, want, rtol=1e-5)
+
+    def test_filter_by_instag(self):
+        from paddle_tpu.ops import contrib as C
+        from paddle_tpu.core.tensor import Tensor
+        import jax.numpy as jnp
+        x = np.arange(12, dtype='float32').reshape(4, 3)
+        tags = np.array([[1], [2], [1], [3]], 'int64')
+        out, idx, w = C.filter_by_instag(
+            Tensor(jnp.asarray(x)), Tensor(jnp.asarray(tags)),
+            Tensor(jnp.asarray(np.array([1], 'int64'))))
+        np.testing.assert_array_equal(np.asarray(idx.data), [0, 2])
+        np.testing.assert_allclose(np.asarray(out.data), x[[0, 2]])
+        assert np.asarray(w.data).sum() == 2
+
+    def test_chunk_eval_iob(self):
+        from paddle_tpu.ops import contrib as C
+        from paddle_tpu.core.tensor import Tensor
+        import jax.numpy as jnp
+        # tags: B=0 I=1 (single chunk type); tags >= 2*num_chunk_types
+        # are O — recognized WITHOUT manual exclusion
+        lab = np.array([[0, 1, 4, 0, 1, 1]], 'int64')   # 2 chunks
+        inf = np.array([[0, 1, 4, 0, 4, 4]], 'int64')   # 2nd truncated
+        p, r, f1, ni, nl, nc = C.chunk_eval(
+            Tensor(jnp.asarray(inf)), Tensor(jnp.asarray(lab)),
+            num_chunk_types=1)
+        assert int(np.asarray(ni.data)) == 2
+        assert int(np.asarray(nl.data)) == 2
+        assert int(np.asarray(nc.data)) == 1
+        assert abs(float(np.asarray(f1.data)) - 0.5) < 1e-6
+
+
+class TestCrypto:
+    """N38: model-file encryption (framework/io/crypto parity)."""
+
+    def test_ctr_roundtrip_and_file(self, tmp_path):
+        from paddle_tpu.utils.crypto import CipherFactory, CipherUtils
+        key = CipherUtils.gen_key(256)
+        c = CipherFactory.create_cipher()
+        data = b'serialized program bytes' * 100
+        ct = c.encrypt(data, key)
+        assert ct != data and len(ct) > len(data)
+        assert c.decrypt(ct, key) == data
+        c.encrypt_to_file(data, key, str(tmp_path / 'm.enc'))
+        assert c.decrypt_from_file(key, str(tmp_path / 'm.enc')) == data
+
+    def test_gcm_detects_tamper(self, tmp_path):
+        from paddle_tpu.utils.crypto import AESCipher, CipherUtils
+        key = CipherUtils.gen_key(128)
+        c = AESCipher('AES_GCM_NoPadding')
+        ct = bytearray(c.encrypt(b'weights', key))
+        ct[-1] ^= 0xFF
+        with pytest.raises(Exception):
+            c.decrypt(bytes(ct), key)
+
+    def test_gcm_short_tag_roundtrip(self):
+        from paddle_tpu.utils.crypto import AESCipher, CipherUtils
+        key = CipherUtils.gen_key(128)
+        c = AESCipher('AES_GCM_NoPadding', tag_size=96)
+        assert c.decrypt(c.encrypt(b'weights', key), key) == b'weights'
+        with pytest.raises(ValueError):
+            AESCipher('AES_CTR_NoPadding', iv_size=256)
+
+    def test_key_file_and_config(self, tmp_path):
+        from paddle_tpu.utils.crypto import CipherFactory, CipherUtils
+        key = CipherUtils.gen_key_to_file(128, str(tmp_path / 'k'))
+        assert CipherUtils.read_key_from_file(str(tmp_path / 'k')) == key
+        (tmp_path / 'cfg').write_text('cipher_name: AES_GCM_NoPadding\n')
+        c = CipherFactory.create_cipher(str(tmp_path / 'cfg'))
+        assert c.name == 'AES_GCM_NoPadding'
+        assert c.decrypt(c.encrypt(b'x', key), key) == b'x'
