@@ -13,6 +13,7 @@ from .core.dtypes import (bool_ as bool, uint8, int8, int16, int32, int64,  # no
 from .core.tensor import Tensor, to_tensor, _install_operators
 from .core import autograd as _autograd
 from .core.autograd import no_grad, enable_grad
+from .core.lazy import lazy_guard
 from .core.rng import seed, get_rng_state, set_rng_state
 
 from . import ops

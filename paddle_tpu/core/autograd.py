@@ -343,6 +343,12 @@ def run_op(name, fn, tensor_args, static_kwargs=None, n_nondiff=0):
     if STATIC_RECORD_HOOK is not None:
         return STATIC_RECORD_HOOK(name, fn, tensor_args, static_kwargs)
 
+    # Lazy fusion window (core.ops.* fast-path analogue): record
+    # symbolically, one XLA dispatch per materialization
+    from . import lazy as _lazy
+    if _lazy.active():
+        return _lazy.record(name, fn, tensor_args, static_kwargs)
+
     arrs = tuple(t.data for t in tensor_args)
 
     diff_mask = []

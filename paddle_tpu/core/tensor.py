@@ -41,6 +41,14 @@ class Tensor:
     # -- basic properties ---------------------------------------------------
     @property
     def data(self):
+        d = self.__dict__
+        if '_lazy_error' in d:
+            raise RuntimeError(
+                "this tensor's lazy fusion window failed to execute"
+            ) from d['_lazy_error']
+        if d.get('_lazy'):
+            from . import lazy
+            lazy.flush()                # materialize the fusion window
         return self._data
 
     @data.setter
@@ -80,10 +88,10 @@ class Tensor:
 
     # -- conversions --------------------------------------------------------
     def numpy(self):
-        return np.asarray(self._data)
+        return np.asarray(self.data)
 
     def item(self):
-        return self._data.item()
+        return self.data.item()
 
     def tolist(self):
         return self.numpy().tolist()
@@ -99,7 +107,7 @@ class Tensor:
     cast = astype
 
     def cpu(self):
-        return Tensor(jax.device_get(self._data), stop_gradient=self.stop_gradient)
+        return Tensor(jax.device_get(self.data), stop_gradient=self.stop_gradient)
 
     def pin_memory(self):
         return self
@@ -118,7 +126,7 @@ class Tensor:
         self.grad = None
 
     def detach(self):
-        t = Tensor(self._data, stop_gradient=True)
+        t = Tensor(self.data, stop_gradient=True)
         return t
 
     def clone(self):
@@ -145,7 +153,7 @@ class Tensor:
     # -- in-place mutation (eager only) -------------------------------------
     def set_value(self, value):
         if isinstance(value, Tensor):
-            value = value._data
+            value = value.data
         self._data = jnp.asarray(value, dtype=self._data.dtype).reshape(self._data.shape)
         return self
 
@@ -153,34 +161,34 @@ class Tensor:
         return self.set_value(other)
 
     def fill_(self, value):
-        self._data = jnp.full_like(self._data, value)
+        self._data = jnp.full_like(self.data, value)
         return self
 
     def zero_(self):
-        self._data = jnp.zeros_like(self._data)
+        self._data = jnp.zeros_like(self.data)
         return self
 
     def scale_(self, scale):
-        self._data = self._data * scale
+        self._data = self.data * scale
         return self
 
     def add_(self, other):
-        o = other._data if isinstance(other, Tensor) else other
-        self._data = self._data + o
+        o = other.data if isinstance(other, Tensor) else other
+        self._data = self.data + o
         return self
 
     def subtract_(self, other):
-        o = other._data if isinstance(other, Tensor) else other
-        self._data = self._data - o
+        o = other.data if isinstance(other, Tensor) else other
+        self._data = self.data - o
         return self
 
     def multiply_(self, other):
-        o = other._data if isinstance(other, Tensor) else other
-        self._data = self._data * o
+        o = other.data if isinstance(other, Tensor) else other
+        self._data = self.data * o
         return self
 
     def clip_(self, min=None, max=None):
-        self._data = jnp.clip(self._data, min, max)
+        self._data = jnp.clip(self.data, min, max)
         return self
 
     # -- indexing -----------------------------------------------------------
@@ -189,8 +197,8 @@ class Tensor:
         return ops.manip.getitem(self, idx)
 
     def __setitem__(self, idx, value):
-        v = value._data if isinstance(value, Tensor) else value
-        self._data = self._data.at[idx].set(v)
+        v = value.data if isinstance(value, Tensor) else value
+        self._data = self.data.at[idx].set(v)
 
     def __len__(self):
         if not self._data.shape:
@@ -204,21 +212,21 @@ class Tensor:
     # -- repr ---------------------------------------------------------------
     def __repr__(self):
         try:
-            body = repr(np.asarray(self._data))
+            body = repr(np.asarray(self.data))
         except Exception:
             body = f"<traced {self._data.shape} {self._data.dtype}>"
         return (f"Tensor(shape={self.shape}, dtype={dtypes.dtype_name(self.dtype)}, "
                 f"stop_gradient={self.stop_gradient},\n       {body})")
 
     def __bool__(self):
-        return bool(self._data)
+        return bool(self.data)
 
     def __int__(self):
         # paddle semantics: any size-1 tensor converts
-        return int(self._data.item())
+        return int(self.data.item())
 
     def __float__(self):
-        return float(self._data.item())
+        return float(self.data.item())
 
     def __hash__(self):
         return id(self)

@@ -249,7 +249,16 @@ def center_loss(input, label, num_classes, alpha=0.5, centers=None,
                 update_center=True):
     """center_loss_op.cc: loss_i = 0.5 * ||x_i - c_{y_i}||^2; centers
     move toward their class means by alpha * mean-residual. Returns
-    (loss [N, 1], new_centers [C, D])."""
+    (loss [N, 1], new_centers [C, D]); centers default to zeros
+    [num_classes, D]."""
+    if centers is None:
+        d = as_tensor(input).data.shape[-1]
+        centers = jnp.zeros((num_classes, d), jnp.float32)
+    elif as_tensor(centers).data.shape[0] != num_classes:
+        raise ValueError(
+            f"centers has {as_tensor(centers).data.shape[0]} rows but "
+            f"num_classes={num_classes}")
+
     def fn(x, c, y, _alpha=alpha, _upd=update_center):
         y = y.reshape(-1).astype(jnp.int32)
         cy = c[y]
