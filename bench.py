@@ -166,8 +166,8 @@ def bench_lenet_config1():
     paddle.seed(0)
     model = LeNet(10)
     opt = paddle.optimizer.Adam(parameters=model.parameters())
-    step = TrainStep(model, lambda out, lb: nn.functional.cross_entropy(
-        out, lb), opt)
+    step = TrainStep(model, lambda m, img, lb: nn.functional.cross_entropy(
+        m(img), lb), opt)
     B = 256
     rng = np.random.RandomState(0)
     imgs = paddle.to_tensor(rng.rand(B, 1, 28, 28).astype('float32'))
@@ -185,7 +185,7 @@ def bench_lenet_config1():
             'batch': B}
 
 
-def bench_resnet50_config2():
+def bench_resnet50_config2(B=128, steps=5, trials=4):
     """BASELINE config 2: ResNet-50 ImageNet shape, bf16, dp machinery
     (degree 1 on one chip — the dp grad sync is the hybrid engine's
     pmean, exercised multi-device in the dryrun/tests)."""
@@ -209,21 +209,19 @@ def bench_resnet50_config2():
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
                                     parameters=model.parameters())
-    B = 128
 
     def loss_fn(m, x, y):
         return nn.functional.cross_entropy(m(x), y)
 
     eng = HybridParallelTrainStep(model, loss_fn, opt)
     rng = np.random.RandomState(0)
-    x = Tensor(rng.rand(B, 3, 224, 224).astype('float32')
-               .astype(np.float32))
+    x = Tensor(jnp.asarray(rng.rand(B, 3, 224, 224), jnp.bfloat16))
     y = Tensor(rng.randint(0, 1000, (B,)).astype('int64'))
     loss = eng(x, y)                        # compile
     assert np.isfinite(float(loss))
-    n = 5
+    n = steps
     dt = float('inf')
-    for _ in range(4):
+    for _ in range(trials):
         t0 = time.time()
         for _ in range(n):
             loss = eng(x, y)

@@ -353,11 +353,13 @@ def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
         tensors.append(as_tensor(bias))
 
     def fn(a, w, *rest):
+        # no preferred_element_type: jax's conv vjp mixes the preferred
+        # f32 cotangent with bf16 operands and errors; the TPU MXU
+        # accumulates bf16 convs in f32 regardless
         out = jax.lax.conv_general_dilated(
             a, w, window_strides=stride, padding=pad,
             rhs_dilation=dilation, dimension_numbers=dn,
-            feature_group_count=groups,
-            preferred_element_type=jnp.float32 if a.dtype == jnp.bfloat16 else None)
+            feature_group_count=groups)
         out = out.astype(a.dtype)
         if rest:
             b = rest[0]
