@@ -29,8 +29,14 @@ _FLAGS = {
     # attention usually wins on TPU (tunable per model/shape)
     'FLAGS_flash_min_seq': 1024,
     # causal_attention (GPT path) through the packed transpose-free
-    # kernel; False restores the BHLD-transposing route
-    'FLAGS_flash_packed_causal': True,
+    # kernel. Off by default: the packed kernel keeps FULL [L, H*D] K/V
+    # slabs in VMEM — ~16 MB at GPT-1.3B shapes (L=2048, H*D=2048),
+    # over the v5e VMEM budget; enable per-model after measuring (BERT
+    # shapes are fine: 0.75 MB slabs)
+    'FLAGS_flash_packed_causal': False,
+    # MHA encoder flash via the packed transpose-free kernel (True) or
+    # the BHLD-transposing kernel (False) — A/B knob for tuning
+    'FLAGS_flash_packed_mha': True,
     # wrap op-kernel exceptions with [operator < name > error] context
     # (enforce.h framing; off by default to keep exception types exact)
     'FLAGS_op_error_context': False,

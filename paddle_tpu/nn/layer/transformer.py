@@ -170,6 +170,9 @@ class MultiHeadAttention(Layer):
         would materialize costs ~14% of a BERT step); the packed kernel
         runs every head over static column slices. Returns the
         [B, L, nh, hd] context or None to fall back."""
+        from ...core import flags
+        if not flags.flag('FLAGS_flash_packed_mha', True):
+            return None                 # A/B: fall to the BHLD route
         ok, bias = self._flash_eligible(q4.shape[0], q4.shape[1],
                                         k4.shape[1], attn_mask)
         if not ok:

@@ -35,6 +35,8 @@ def run(variant):
         chunks = 32
     if 'flash' in variant:
         flags.set_flags({'FLAGS_flash_min_seq': 512})
+    if 'bhld' in variant:
+        flags.set_flags({'FLAGS_flash_packed_mha': False})
 
     topology_runtime.build_mesh(['dp', 'sharding'], [1, 1])
     paddle.seed(0)
