@@ -419,3 +419,60 @@ def chunk_eval(infer, label, chunk_scheme='IOB', num_chunk_types=1,
 def _np_arr(x):
     import numpy as _np
     return _np.asarray(x.data if isinstance(x, Tensor) else x)
+
+
+def sampled_softmax_with_cross_entropy(logits=None, label=None,
+                                       num_samples=None, seed=0,
+                                       remove_accidental_hits=True, *,
+                                       input=None, weight=None,
+                                       bias=None):
+    """sampled_softmax_with_cross_entropy_op (reference signature:
+    logits [N, C], label [N, 1], num_samples): softmax xent over the
+    true class + num_samples UNIQUE uniformly sampled negatives instead
+    of the full class set. The keyword form (input [N, D] features,
+    weight [C, D], bias [C]) skips materializing full logits — the
+    sampled-FC variant for large vocabularies.
+
+    Negatives resample EVERY call from the functional RNG stream
+    (paddle.seed-reproducible); pass seed!=0 to pin a fixed draw."""
+    fc_mode = logits is None
+    if fc_mode:
+        x = as_tensor(input)
+        w = as_tensor(weight)
+        C = w.data.shape[0]
+    else:
+        x = as_tensor(logits)
+        C = x.data.shape[1]
+    lb = as_tensor(label)
+    S = min(int(num_samples), C)
+    key = jax.random.PRNGKey(seed) if seed else rng.next_key()
+    has_b = bias is not None
+    tensors = [x] + ([w] if fc_mode else []) \
+        + ([as_tensor(bias)] if has_b else []) + [lb]
+
+    def fn(xa, *rest):
+        wa = rest[0] if fc_mode else None
+        ba = rest[1 if fc_mode else 0] if has_b else None
+        y = rest[-1].reshape(-1).astype(jnp.int32)
+        neg = jax.random.permutation(key, C)[:S].astype(jnp.int32)
+        cls = jnp.concatenate(
+            [y[:, None],
+             jnp.broadcast_to(neg, (y.shape[0], S))], axis=1)  # [N,1+S]
+        if fc_mode:
+            wsel = wa[cls]                               # [N, 1+S, D]
+            logit = jnp.einsum('nd,nsd->ns', xa, wsel)
+            if ba is not None:
+                logit = logit + ba[cls]
+        else:
+            logit = jnp.take_along_axis(xa, cls, axis=1)
+        if remove_accidental_hits:
+            # a sampled negative equal to the true class would cancel
+            # the target logit — mask it out (reference semantics)
+            hit = cls[:, 1:] == y[:, None]
+            logit = jnp.concatenate(
+                [logit[:, :1],
+                 jnp.where(hit, -1e30, logit[:, 1:])], axis=1)
+        lse = jax.nn.logsumexp(logit, axis=1, keepdims=True)
+        return lse - logit[:, :1]
+    return run_op('sampled_softmax_with_cross_entropy', fn, tensors,
+                  n_nondiff=1)

@@ -895,3 +895,108 @@ def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,
                                (H, W, P, 4))
         return out, var
     return run_op('density_prior_box', fn, [input, image])
+
+
+class DetectionMAP:
+    """Parity: operators/detection_map_op.cc / fluid.metrics.DetectionMAP
+    — mean average precision over accumulated detections, '11point' or
+    'integral' interpolation, difficult-gt exclusion. Host-side metric
+    (the reference kernel is CPU-only)."""
+
+    def __init__(self, class_num, overlap_threshold=0.5,
+                 evaluate_difficult=False, ap_version='integral'):
+        if ap_version not in ('integral', '11point'):
+            raise ValueError("ap_version must be 'integral' or '11point'")
+        self.class_num = class_num
+        self.iou = overlap_threshold
+        self.eval_difficult = evaluate_difficult
+        self.ap_version = ap_version
+        self.reset()
+
+    def reset(self):
+        self._dets = []     # (img, cls, score, box)
+        self._gts = []      # (img, cls, box, difficult)
+        self._img = 0
+
+    @staticmethod
+    def _iou(a, b):
+        ix = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
+        iy = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+        inter = ix * iy
+        ua = ((a[2] - a[0]) * (a[3] - a[1])
+              + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+        return inter / ua if ua > 0 else 0.0
+
+    def update(self, pred_boxes, pred_scores, pred_labels, gt_boxes,
+               gt_labels, difficult=None):
+        """One image: preds [N,4]/[N]/[N], gts [M,4]/[M], difficult [M]."""
+        pb = np.asarray(pred_boxes, np.float64).reshape(-1, 4)
+        ps = np.asarray(pred_scores, np.float64).reshape(-1)
+        pl = np.asarray(pred_labels).reshape(-1)
+        gb = np.asarray(gt_boxes, np.float64).reshape(-1, 4)
+        gl = np.asarray(gt_labels).reshape(-1)
+        df = (np.zeros(len(gl), bool) if difficult is None
+              else np.asarray(difficult).reshape(-1).astype(bool))
+        i = self._img
+        for b, s, c in zip(pb, ps, pl):
+            self._dets.append((i, int(c), float(s), tuple(b)))
+        for b, c, d in zip(gb, gl, df):
+            self._gts.append((i, int(c), tuple(b), bool(d)))
+        self._img += 1
+
+    def accumulate(self):
+        """→ mAP in [0, 1]."""
+        aps = []
+        for c in range(self.class_num):
+            gts = [(g[0], g[2], g[3]) for g in self._gts if g[1] == c]
+            if self.eval_difficult:
+                npos = len(gts)
+            else:
+                npos = sum(1 for g in gts if not g[2])
+            dets = sorted((d for d in self._dets if d[1] == c),
+                          key=lambda d: -d[2])
+            if npos == 0:
+                continue
+            matched = set()
+            tp = np.zeros(len(dets))
+            fp = np.zeros(len(dets))
+            by_img = {}
+            for gi, (img, box, dif) in enumerate(gts):
+                by_img.setdefault(img, []).append((gi, box, dif))
+            for di, (img, _, _, box) in enumerate(dets):
+                best, best_gi = 0.0, -1
+                for gi, gbox, dif in by_img.get(img, []):
+                    ov = self._iou(box, gbox)
+                    if ov > best:
+                        best, best_gi = ov, gi
+                if best_gi >= 0 and best >= self.iou:
+                    gi = best_gi
+                    dif = gts[gi][2]
+                    if dif and not self.eval_difficult:
+                        continue            # neither tp nor fp
+                    if gi not in matched:
+                        matched.add(gi)
+                        tp[di] = 1
+                    else:
+                        fp[di] = 1
+                else:
+                    fp[di] = 1
+            ctp = np.cumsum(tp)
+            cfp = np.cumsum(fp)
+            rec = ctp / npos
+            prec = ctp / np.maximum(ctp + cfp, 1e-12)
+            if self.ap_version == '11point':
+                ap = 0.0
+                for t in np.linspace(0, 1, 11):
+                    p = prec[rec >= t].max() if (rec >= t).any() else 0.0
+                    ap += p / 11.0
+            else:
+                mrec = np.concatenate([[0.0], rec, [1.0]])
+                mpre = np.concatenate([[0.0], prec, [0.0]])
+                for k in range(len(mpre) - 2, -1, -1):
+                    mpre[k] = max(mpre[k], mpre[k + 1])
+                idx = np.where(mrec[1:] != mrec[:-1])[0]
+                ap = float(np.sum((mrec[idx + 1] - mrec[idx])
+                                  * mpre[idx + 1]))
+            aps.append(ap)
+        return float(min(np.mean(aps), 1.0)) if aps else 0.0

@@ -534,3 +534,71 @@ class TestFpnOps:
         # the 2x2 sub-centers straddle the cell center symmetrically
         cx = (b[1, 1, :, 0] + b[1, 1, :, 2]) / 2 * 32
         assert cx.min() < 12.0 < cx.max()
+
+
+class TestDetectionMAP:
+    def test_perfect_predictions(self):
+        from paddle_tpu.vision.detection import DetectionMAP
+        m = DetectionMAP(class_num=2)
+        gt = np.array([[0, 0, 10, 10], [20, 20, 30, 30]], 'float32')
+        m.update(gt, np.array([0.9, 0.8]), np.array([0, 1]),
+                 gt, np.array([0, 1]))
+        assert abs(m.accumulate() - 1.0) < 1e-6
+
+    def test_false_positive_lowers_map(self):
+        from paddle_tpu.vision.detection import DetectionMAP
+        m = DetectionMAP(class_num=1)
+        gt = np.array([[0, 0, 10, 10]], 'float32')
+        preds = np.array([[50, 50, 60, 60], [0, 0, 10, 10]], 'float32')
+        m.update(preds, np.array([0.9, 0.8]), np.array([0, 0]),
+                 gt, np.array([0]))
+        # the high-score FP precedes the TP: AP = integral with
+        # precision 0.5 at recall 1
+        assert abs(m.accumulate() - 0.5) < 1e-6
+
+    def test_difficult_excluded(self):
+        from paddle_tpu.vision.detection import DetectionMAP
+        m = DetectionMAP(class_num=1)
+        gt = np.array([[0, 0, 10, 10], [20, 20, 30, 30]], 'float32')
+        m.update(np.array([[0, 0, 10, 10]], 'float32'),
+                 np.array([0.9]), np.array([0]),
+                 gt, np.array([0, 0]), difficult=np.array([0, 1]))
+        assert abs(m.accumulate() - 1.0) < 1e-6   # difficult gt ignored
+
+    def test_11point(self):
+        from paddle_tpu.vision.detection import DetectionMAP
+        m = DetectionMAP(class_num=1, ap_version='11point')
+        gt = np.array([[0, 0, 10, 10]], 'float32')
+        m.update(gt, np.array([0.9]), np.array([0]), gt, np.array([0]))
+        assert abs(m.accumulate() - 1.0) < 1e-6
+
+
+def test_sampled_softmax_xent_bounds_full_softmax():
+    from paddle_tpu.ops import contrib as C
+    from paddle_tpu.core.tensor import Tensor
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    N, D, Cn = 8, 16, 100
+    x = rng.randn(N, D).astype('float32') * 0.3
+    w = rng.randn(Cn, D).astype('float32') * 0.3
+    b = rng.randn(Cn).astype('float32') * 0.1
+    y = rng.randint(0, Cn, (N, 1)).astype('int64')
+    loss = C.sampled_softmax_with_cross_entropy(
+        input=Tensor(jnp.asarray(x)), label=Tensor(jnp.asarray(y)),
+        weight=Tensor(jnp.asarray(w)), bias=Tensor(jnp.asarray(b)),
+        num_samples=Cn, seed=1)   # unique sampler covers every class
+    got = np.asarray(loss.data).reshape(-1)
+    # with ALL classes sampled (uniq, S=C) the loss EQUALS full softmax
+    # (the accidental hit of the true class is masked; the true logit
+    # itself occupies column 0)
+    z = x @ w.T + b
+    full = (np.log(np.exp(z).sum(1)) - z[np.arange(N), y.reshape(-1)])
+    np.testing.assert_allclose(got, full, rtol=1e-4, atol=1e-5)
+    # a strict subset only ever lowers the bound
+    loss_sub = C.sampled_softmax_with_cross_entropy(
+        input=Tensor(jnp.asarray(x)), label=Tensor(jnp.asarray(y)),
+        weight=Tensor(jnp.asarray(w)), bias=Tensor(jnp.asarray(b)),
+        num_samples=30, seed=2)
+    got_sub = np.asarray(loss_sub.data).reshape(-1)
+    assert (got_sub <= full + 1e-5).all()
+    assert (got_sub >= 0).all()
