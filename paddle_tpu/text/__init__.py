@@ -1,6 +1,7 @@
 """paddle_tpu.text (parity: python/paddle/text — datasets + viterbi)."""
 from . import datasets
-from .datasets import Imdb, Imikolov, UCIHousing, WMT14, Conll05st
+from .datasets import (Imdb, Imikolov, UCIHousing, WMT14, WMT16,
+                       Conll05st, Movielens)
 from ..ops.sequence import (viterbi_decode, ViterbiDecoder,
                             linear_chain_crf, crf_decoding, beam_search)
 from . import models  # noqa: F401,E402

@@ -148,3 +148,43 @@ class Conll05st(Dataset):
 
     def __len__(self):
         return len(self.sents)
+
+
+class WMT16(WMT14):
+    """Parity: paddle.text.datasets.WMT16 — reference signature
+    (src_dict_size, trg_dict_size, lang); same synthetic pair shape."""
+
+    def __init__(self, data_file=None, mode='train', src_dict_size=1000,
+                 trg_dict_size=1000, lang='en', download=True):
+        super().__init__(data_file=data_file, mode=mode,
+                         dict_size=min(src_dict_size, trg_dict_size),
+                         download=download)
+        self.lang = lang
+
+
+class Movielens(Dataset):
+    """Parity: paddle.text.datasets.Movielens — (user features, movie
+    features, rating) triples; synthetic under zero egress."""
+
+    def __init__(self, data_file=None, mode='train', test_ratio=0.1,
+                 rand_seed=0):
+        n = 2048 if mode == 'train' else 256
+        rng = np.random.RandomState(rand_seed + (0 if mode == 'train'
+                                                 else 1))
+        self.user_id = rng.randint(1, 6041, n).astype(np.int64)
+        self.gender = rng.randint(0, 2, n).astype(np.int64)
+        self.age = rng.randint(0, 7, n).astype(np.int64)
+        self.job = rng.randint(0, 21, n).astype(np.int64)
+        self.movie_id = rng.randint(1, 3953, n).astype(np.int64)
+        self.category = rng.randint(0, 18, (n, 3)).astype(np.int64)
+        self.title = rng.randint(0, 5000, (n, 4)).astype(np.int64)
+        self.rating = (rng.randint(1, 6, n)).astype(np.float32)
+
+    def __getitem__(self, idx):
+        return (self.user_id[idx], self.gender[idx], self.age[idx],
+                self.job[idx], self.movie_id[idx], self.category[idx],
+                self.title[idx],
+                np.asarray([self.rating[idx]], np.float32))
+
+    def __len__(self):
+        return len(self.rating)
