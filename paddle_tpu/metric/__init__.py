@@ -166,3 +166,9 @@ def accuracy(input, label, k=1, correct=None, total=None, name=None):
     order = np.argsort(-pred, axis=-1)[:, :k]
     correct_ = (order == lab[:, None]).any(axis=1)
     return Tensor(np.asarray(correct_.mean(), dtype=np.float32))
+
+
+def mean_iou(input, label, num_classes):
+    """paddle.metric.mean_iou (operators/mean_iou_op.cc)."""
+    from ..ops.contrib import mean_iou as _mi
+    return _mi(input, label, num_classes)

@@ -30,3 +30,8 @@ from ...ops.manip import pad, pixel_shuffle  # noqa
 def diag_embed(*a, **k):
     from ...ops.math import diag_embed as _d
     return _d(*a, **k)
+
+
+def gather_tree(ids, parents):
+    from ...ops.contrib import gather_tree as _gt
+    return _gt(ids, parents)

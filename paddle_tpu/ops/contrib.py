@@ -476,3 +476,395 @@ def sampled_softmax_with_cross_entropy(logits=None, label=None,
         return lse - logit[:, :1]
     return run_op('sampled_softmax_with_cross_entropy', fn, tensors,
                   n_nondiff=1)
+
+
+# ---------------------------------------------------------------------------
+# beam-search backtrace / metric / misc tier (VERDICT r3 op remainder)
+# ---------------------------------------------------------------------------
+
+def gather_tree(ids, parents):
+    """gather_tree_op.cc — backtrace beam-search selections into full
+    sequences. ids/parents: [T, B, W] int; reference semantics
+    (fluid/layers/nn.py:14984): start from the last step's beams and walk
+    parents backwards, gathering ids along the surviving paths.
+
+    TPU-native: one reversed `lax.scan` over time with a per-(batch,beam)
+    gather — no host loop, compiles to a single fused backtrace."""
+    ids = as_tensor(ids)
+    parents = as_tensor(parents, ref=ids)
+
+    def fn(idv, par):
+        T, B, W = idv.shape
+        beams0 = jnp.broadcast_to(jnp.arange(W), (B, W))
+
+        def body(beams, xs):
+            id_t, par_t = xs           # [B, W] each, time t
+            out_t = jnp.take_along_axis(id_t, beams, axis=1)
+            nxt = jnp.take_along_axis(par_t, beams, axis=1)
+            return nxt, out_t
+
+        # t = T-1 down to 0; at each step gather ids at the current beam
+        # set, then hop to those beams' parents for the step below
+        _, outs = lax.scan(body, beams0, (idv[::-1], par[::-1]))
+        return outs[::-1]
+    return run_op('gather_tree', fn, [ids, parents], n_nondiff=2)
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None):
+    """edit_distance_op.cc (oracle: test_edit_distance_op.py Levenshtein)
+    — batched Levenshtein distance over dense padded token rows +
+    lengths (the LoD-free contract, SURVEY N11 disposition).
+
+    TPU-native DP: the row recurrence D[i][j] = min(D[i-1][j]+1,
+    D[i][j-1]+1, D[i-1][j-1]+cost) has a sequential j-dependency only
+    through a min-plus prefix scan: with a_j = min(D[i-1][j]+1,
+    D[i-1][j-1]+cost_ij), D[i][j] = j + cummin(a_k - k)_j — one
+    `lax.associative_scan` per row, `lax.scan` over rows, `vmap` over the
+    batch. Returns (distances [B,1] float32, seq_num int64)."""
+    input = as_tensor(input)
+    label = as_tensor(label, ref=input)
+    B, T1 = input.data.shape[0], input.data.shape[1]
+    T2 = label.data.shape[1]
+    if input_length is None:
+        in_len = jnp.full((B,), T1, jnp.int32)
+    else:
+        in_len = as_tensor(input_length).data.reshape(-1).astype(jnp.int32)
+    if label_length is None:
+        lb_len = jnp.full((B,), T2, jnp.int32)
+    else:
+        lb_len = as_tensor(label_length).data.reshape(-1).astype(jnp.int32)
+    ign = tuple(int(t) for t in (ignored_tokens or ()))
+
+    def compact(row, ln, toks):
+        # drop ignored tokens, keep order (stable sort on is-ignored)
+        keep = jnp.ones(row.shape, bool)
+        for t in toks:
+            keep &= row != t
+        keep &= jnp.arange(row.shape[0]) < ln
+        order = jnp.argsort(~keep, stable=True)
+        return row[order], keep.sum().astype(jnp.int32)
+
+    def fn(hyp, ref):
+        h_len, r_len = in_len, lb_len
+        if ign:
+            hyp, h_len = jax.vmap(lambda r, l: compact(r, l, ign))(hyp,
+                                                                   h_len)
+            ref, r_len = jax.vmap(lambda r, l: compact(r, l, ign))(ref,
+                                                                   r_len)
+
+        def one(h, r, m, n):
+            jj = jnp.arange(T2 + 1, dtype=jnp.float32)
+            row0 = jj                               # D[0][j] = j
+
+            def step(prev, xs):
+                hi, i = xs                          # hyp token, row index
+                cost = jnp.where(hi == r, 0.0, 1.0)  # [T2]
+                a = jnp.concatenate(
+                    [jnp.asarray([i], jnp.float32),  # D[i][0] = i
+                     jnp.minimum(prev[1:] + 1.0, prev[:-1] + cost)])
+                row = jj + lax.associative_scan(jnp.minimum, a - jj)
+                return row, row
+
+            _, rows = lax.scan(
+                step, row0, (h, jnp.arange(1, T1 + 1, dtype=jnp.float32)))
+            rows = jnp.concatenate([row0[None], rows])  # [T1+1, T2+1]
+            d = rows[m, n]
+            # empty-string edge cases match the oracle: D(0,n)=n, D(m,0)=m
+            return d
+
+        d = jax.vmap(one)(hyp, ref, h_len, r_len)
+        if normalized:
+            d = d / jnp.maximum(r_len.astype(jnp.float32), 1.0)
+        return d.reshape(B, 1).astype(jnp.float32)
+
+    out = run_op('edit_distance', fn, [input, label], n_nondiff=2)
+    return out, Tensor(jnp.asarray(np.int64(B)))
+
+
+def mean_iou(input, label, num_classes):
+    """mean_iou_op.cc (oracle: test_mean_iou.py compute_mean_iou) —
+    semantic-segmentation mean intersection-over-union. correct[c] counts
+    pred==label hits; wrong[c] counts both sides of each miss; per-class
+    IOU = correct / (correct + wrong) averaged over classes seen.
+    Returns (mean_iou f32 scalar, out_wrong i32 [C], out_correct i32 [C]).
+    """
+    input = as_tensor(input)
+    label = as_tensor(label, ref=input)
+    C = int(num_classes)
+
+    def fn(pred, lab):
+        pred = pred.reshape(-1).astype(jnp.int32)
+        lab = lab.reshape(-1).astype(jnp.int32)
+        hit = pred == lab
+        correct = jnp.zeros((C,), jnp.int32).at[pred].add(
+            hit.astype(jnp.int32))
+        wrong = jnp.zeros((C,), jnp.int32).at[pred].add(
+            (~hit).astype(jnp.int32)).at[lab].add((~hit).astype(jnp.int32))
+        denom = wrong + correct
+        valid = (denom != 0).sum()
+        iou = correct / jnp.maximum(denom, 1)
+        miou = (iou.sum() / jnp.maximum(valid, 1)).astype(jnp.float32)
+        return miou, wrong, correct
+
+    return run_op('mean_iou', fn, [input, label], n_nondiff=2)
+
+
+def precision_recall(max_probs, indices, labels, cls_num, weights=None,
+                     states=None):
+    """precision_recall_op.cc (oracle: test_precision_recall_op.py) —
+    streaming multi-class precision/recall/F1. Returns (batch_metrics [6]
+    = [macro-P, macro-R, macro-F1, micro-P, micro-R, micro-F1],
+    accum_metrics [6], accum_states [C,4] TP/FP/TN/FN), accumulating into
+    `states` when given."""
+    C = int(cls_num)
+    tens = [as_tensor(indices), as_tensor(labels)]
+    has_w = weights is not None
+    has_st = states is not None
+    if has_w:
+        tens.append(as_tensor(weights))
+    if has_st:
+        tens.append(as_tensor(states))
+
+    def fn(idx, lab, *rest):
+        idx = idx.reshape(-1).astype(jnp.int32)
+        lab = lab.reshape(-1).astype(jnp.int32)
+        N = idx.shape[0]
+        w = (rest[0].reshape(-1).astype(jnp.float32) if has_w
+             else jnp.ones((N,), jnp.float32))
+        hit = idx == lab
+        tp = jnp.zeros((C,), jnp.float32).at[idx].add(
+            jnp.where(hit, w, 0.0))
+        fp = jnp.zeros((C,), jnp.float32).at[idx].add(
+            jnp.where(hit, 0.0, w))
+        fn_ = jnp.zeros((C,), jnp.float32).at[lab].add(
+            jnp.where(hit, 0.0, w))
+        # TN: every instance credits every class, minus those involved
+        tn = jnp.full((C,), w.sum(), jnp.float32)
+        tn = tn.at[idx].add(-w)
+        tn = tn.at[lab].add(jnp.where(hit, 0.0, -w))
+        batch_states = jnp.stack([tp, fp, tn, fn_], axis=1)  # [C,4]
+
+        def metrics(st):
+            tp_, fp_, fn2 = st[:, 0], st[:, 1], st[:, 3]
+
+            def prec(t, f):
+                return jnp.where(t + f > 0,
+                                 t / jnp.maximum(t + f, 1e-30), 1.0)
+
+            def f1(p, r):
+                return jnp.where(p + r > 0, 2 * p * r /
+                                 jnp.maximum(p + r, 1e-30), 0.0)
+            mp = prec(tp_, fp_).mean()
+            mr = prec(tp_, fn2).mean()
+            tpt, fpt, fnt = tp_.sum(), fp_.sum(), fn2.sum()
+            up = prec(tpt, fpt)
+            ur = prec(tpt, fnt)
+            return jnp.stack([mp, mr, f1(mp, mr), up, ur,
+                              f1(up, ur)]).astype(jnp.float32)
+
+        accum = batch_states if not has_st else (
+            batch_states + rest[-1].astype(jnp.float32))
+        return metrics(batch_states), metrics(accum), accum
+
+    # MaxProbs participates only in shape checks in the reference kernel;
+    # the states math keys off indices/labels/weights
+    return run_op('precision_recall', fn, tens, n_nondiff=len(tens))
+
+
+def positive_negative_pair(score, label, query, column=-1, weight=None,
+                           acc_pos=None, acc_neg=None, acc_neu=None):
+    """positive_negative_pair_op.cc (oracle:
+    test_positive_negative_pair_op.py py_pnpair_op) — ranking-order
+    statistics grouped by query id. All same-query (i, j) pairs with
+    differing labels score pos/neg/neutral by whether the score order
+    matches the label order; pair weight = (w_i + w_j) / 2.
+
+    TPU-native: the reference's per-query hash-map + combinations loop is
+    one [N, N] masked pairwise block (upper triangle, query-equality
+    mask) — MXU-trivial and batch-parallel."""
+    tens = [as_tensor(score), as_tensor(label), as_tensor(query)]
+    has_w = weight is not None
+    has_acc = acc_pos is not None
+    if has_w:
+        tens.append(as_tensor(weight))
+    if has_acc:
+        tens += [as_tensor(acc_pos), as_tensor(acc_neg),
+                 as_tensor(acc_neu)]
+
+    def fn(sc, lb, q, *rest):
+        sc = sc[:, int(column)] if sc.ndim > 1 else sc
+        lb = lb.reshape(-1).astype(jnp.float32)
+        q = q.reshape(-1)
+        N = sc.shape[0]
+        w = (rest[0].reshape(-1).astype(jnp.float32) if has_w
+             else jnp.ones((N,), jnp.float32))
+        pair_mask = (q[:, None] == q[None, :]) & \
+            (jnp.arange(N)[:, None] < jnp.arange(N)[None, :]) & \
+            (lb[:, None] != lb[None, :])
+        pw = (w[:, None] + w[None, :]) * 0.5
+        ds = sc[:, None] - sc[None, :]
+        dl = lb[:, None] - lb[None, :]
+        neu = jnp.where(pair_mask & (ds == 0), pw, 0.0).sum()
+        pos = jnp.where(pair_mask & (ds * dl > 0), pw, 0.0).sum()
+        neg = jnp.where(pair_mask & (ds != 0) & (ds * dl <= 0),
+                        pw, 0.0).sum()
+        if has_acc:
+            pos = pos + rest[-3].reshape(())
+            neg = neg + rest[-2].reshape(())
+            neu = neu + rest[-1].reshape(())
+        return (pos.astype(jnp.float32), neg.astype(jnp.float32),
+                neu.astype(jnp.float32))
+
+    return run_op('positive_negative_pair', fn, tens, n_nondiff=len(tens))
+
+
+def affine_channel(x, scale=None, bias=None, data_layout='NCHW', act=None):
+    """affine_channel_op.cc (fluid/layers/nn.py:12691) — per-channel
+    x * scale + bias, differentiable through all three inputs."""
+    x = as_tensor(x)
+    scale = as_tensor(scale, ref=x)
+    bias = as_tensor(bias, ref=x)
+    nchw = data_layout in ('NCHW', 'AnyLayout')
+
+    def fn(xa, sa, ba):
+        shape = ([1, -1] + [1] * (xa.ndim - 2)) if nchw else \
+            ([1] * (xa.ndim - 1) + [-1])
+        out = xa * sa.reshape(shape) + ba.reshape(shape)
+        if act == 'relu':
+            out = jnp.maximum(out, 0)
+        elif act is not None:
+            raise ValueError(f"unsupported act {act!r}")
+        return out
+    return run_op('affine_channel', fn, [x, scale, bias])
+
+
+def row_hash(input, hash_size, num_hash=1, name=None):
+    """hash_op.cc:30-63 — the fluid `hash` layer contract: hash each
+    LAST-DIM row (n-gram) as a unit into `num_hash` buckets in
+    [0, hash_size) (reference: XXH64(row_bytes, seed=i) % hash_size).
+    Here a seeded polynomial rolling hash over per-element mixes — same
+    row-as-unit/seed/mod contract, deterministic and well-mixed, and
+    fully traceable on device (works inside recorded static programs;
+    the reference's element-wise cousin is `hash_op` above).
+    Output: [N, num_hash, 1] int like the reference kernel."""
+    x = as_tensor(input)
+
+    def fn(ids, _n=int(num_hash), _m=int(hash_size)):
+        v = ids.astype(jnp.uint32)
+        if v.ndim == 1:
+            v = v[:, None]
+        v = v.reshape(v.shape[0], -1)                 # [N, D]
+        seeds = (jnp.arange(1, _n + 1, dtype=jnp.uint32)
+                 * jnp.uint32(0x9E3779B1))            # [H]
+        h = v[:, None, :] * seeds[None, :, None] + jnp.uint32(0x85EBCA6B)
+        h = h ^ (h >> 15)
+        h = h * jnp.uint32(0x2545F491)
+        h = h ^ (h >> 13)                             # [N, H, D] mixes
+        D = h.shape[-1]
+        powers = jnp.power(jnp.uint32(31), jnp.arange(
+            D - 1, -1, -1, dtype=jnp.uint32))         # rolling combine
+        rowh = (h * powers).sum(axis=-1, dtype=jnp.uint32)
+        return (rowh % jnp.uint32(_m)).astype(jnp.int32)[..., None]
+    return run_op('hash', fn, [x], n_nondiff=1)
+
+
+def sample_logits(logits, labels, num_samples, uniq=True,
+                  remove_accidental_hits=True, seed=None):
+    """sample_logits_op.cc (oracle: test_sample_logits_op.py) — sampled-
+    softmax front half: draw `num_samples` negatives from the log-uniform
+    (Zipfian) class distribution, gather logits at [true, sampled]
+    columns, and subtract log Q(class) so downstream softmax_xent yields
+    the sampled-softmax estimator. Returns (samples [B, NT+S] int,
+    probabilities [B, NT+S] f32, sampled_logits [B, NT+S],
+    sampled_labels [B, NT] = positions of the true classes).
+
+    `uniq=True` (reference LogUniformSampler unique=true resamples until
+    S distinct classes): here draws stay fixed-shape for XLA — duplicate
+    negative columns beyond the first occurrence are masked out of the
+    softmax (-1e20, like accidental hits) and Probabilities report the
+    unique-sampling inclusion mass 1-(1-q)^S instead of q.
+    `remove_accidental_hits` masks negatives equal to ANY of the row's
+    true labels."""
+    logits = as_tensor(logits)
+    labels = as_tensor(labels, ref=logits)
+    S = int(num_samples)
+    key = rng.next_key() if seed is None else jax.random.PRNGKey(int(seed))
+    NT = int(np.prod(labels.shape)) // int(labels.shape[0])
+
+    def fn(lg, lb):
+        B, C = lg.shape
+        lb2 = lb.reshape(B, NT).astype(jnp.int32)
+        logC1 = jnp.log(jnp.asarray(C + 1.0))
+        u = jax.random.uniform(key, (S,))
+        neg = jnp.floor(jnp.exp(u * logC1)).astype(jnp.int32) - 1
+        neg = jnp.clip(neg, 0, C - 1)                 # shared across rows
+
+        def q(c):                                     # log-uniform mass
+            c = c.astype(jnp.float32)
+            return (jnp.log(c + 2.0) - jnp.log(c + 1.0)) / logC1
+
+        samples = jnp.concatenate(
+            [lb2, jnp.broadcast_to(neg, (B, S))], axis=1)
+        probs = q(samples)
+        if uniq:
+            # inclusion probability of unique sampling (the expected-
+            # count adjustment the reference/TF samplers report)
+            probs = -jnp.expm1(S * jnp.log1p(-jnp.clip(probs, 0, 0.999)))
+        slog = jnp.take_along_axis(lg, samples, axis=1) \
+            - jnp.log(jnp.where(probs > 0, probs, 1.0))
+        dead = jnp.zeros((B, S), bool)
+        if remove_accidental_hits:
+            dead |= (samples[:, NT:, None] == lb2[:, None, :]).any(-1)
+        if uniq:
+            dup = neg[:, None] == neg[None, :]        # [S, S]
+            first = jnp.argmax(dup, axis=1)           # first occurrence
+            dead |= (first != jnp.arange(S))[None, :]
+        slog = jnp.concatenate(
+            [slog[:, :NT],
+             jnp.where(dead, slog[:, NT:] - 1e20, slog[:, NT:])], axis=1)
+        onk = jnp.broadcast_to(jnp.arange(NT, dtype=jnp.int32), (B, NT))
+        return samples, probs.astype(jnp.float32), slog, onk
+
+    return run_op('sample_logits', fn, [logits, labels], n_nondiff=1)
+
+
+def polygon_box_transform(input, name=None):
+    """polygon_box_transform_op.cc (oracle:
+    test_polygon_box_transform.py PolygonBoxRestore) — EAST-style
+    geometry decode: channel pairs hold (w, h) offsets on a 4px grid;
+    out = grid_index * 4 - input."""
+    input = as_tensor(input)
+
+    def fn(x):
+        B, G, H, W = x.shape
+        wi = jnp.broadcast_to(jnp.arange(W), (H, W))
+        hi = jnp.broadcast_to(jnp.arange(H)[:, None], (H, W))
+        pair = jnp.stack([wi, hi])                    # [2, H, W]
+        idx = jnp.tile(pair, (G // 2, 1, 1)).astype(x.dtype)
+        return idx[None] * 4 - x
+    return run_op('polygon_box_transform', fn, [input])
+
+
+def random_crop(x, shape, seed=None):
+    """random_crop_op.cc (fluid/layers/nn.py:8643) — per-instance random
+    crop of the trailing dims to `shape`; one offset draw per instance
+    from the functional RNG stream."""
+    x = as_tensor(x)
+    shape = tuple(int(s) for s in shape)
+    key = rng.next_key() if seed is None else jax.random.PRNGKey(int(seed))
+
+    def fn(arr):
+        lead = arr.shape[:arr.ndim - len(shape)]
+        tail = arr.shape[arr.ndim - len(shape):]
+        flat = arr.reshape((-1,) + tail)
+        keys = jax.random.split(key, flat.shape[0])
+
+        def one(a, k):
+            offs = [jax.random.randint(jax.random.fold_in(k, d), (),
+                                       0, t - s + 1)
+                    for d, (t, s) in enumerate(zip(tail, shape))]
+            return lax.dynamic_slice(a, offs, shape)
+        out = jax.vmap(one)(flat, keys)
+        return out.reshape(lead + shape)
+    return run_op('random_crop', fn, [x])

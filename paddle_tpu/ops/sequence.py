@@ -485,3 +485,8 @@ def sequence_conv(input, filter_w, context_length=3, context_start=None,
     if bias is not None:
         out = out + as_tensor(bias).data
     return Tensor(out)
+
+
+# beam-search backtrace + edit distance live with the sequence tier
+# (fluid/layers names resolve via static.nn._reexport)
+from .contrib import gather_tree, edit_distance  # noqa: E402

@@ -852,7 +852,10 @@ def _reexport():
         (_cr, ['zeros', 'ones', 'zeros_like', 'ones_like', 'eye',
                'linspace', 'arange', 'uniform', 'full', 'full_like',
                'randperm']),
-        (_contrib, ['unpool', 'im2sequence', 'spp']),
+        (_contrib, ['unpool', 'im2sequence', 'spp', 'mean_iou',
+                    'precision_recall', 'positive_negative_pair',
+                    'affine_channel', 'sample_logits', 'random_crop',
+                    'polygon_box_transform']),
         (_seq, ['sequence_pad', 'sequence_unpad', 'sequence_expand',
                 'sequence_reverse', 'linear_chain_crf', 'crf_decoding',
                 'beam_search', 'sequence_concat', 'sequence_conv',
@@ -884,6 +887,7 @@ def _reexport():
     for legacy, mod, modern in (
         ('range', _cr, 'arange'), ('gaussian_random', _cr, 'gaussian'),
         ('uniform_random', _cr, 'uniform'), ('size', manip, 'numel'),
+        ('hash', _contrib, 'row_hash'),
     ):
         if hasattr(mod, modern) and legacy not in g:
             g[legacy] = getattr(mod, modern)

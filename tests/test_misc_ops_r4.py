@@ -1,0 +1,317 @@
+"""Wave-4 misc op tier vs numpy oracles (reference test semantics:
+test_mean_iou.py, test_edit_distance_op.py, test_precision_recall_op.py,
+test_positive_negative_pair_op.py, test_polygon_box_transform.py,
+gather_tree docstring example fluid/layers/nn.py:14984)."""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops import contrib
+
+
+def test_gather_tree_reference_example():
+    ids = np.array([[[2, 2], [6, 1]], [[3, 9], [6, 1]],
+                    [[0, 1], [9, 0]]], np.int64)
+    parents = np.array([[[0, 0], [1, 1]], [[1, 0], [1, 0]],
+                        [[0, 0], [0, 1]]], np.int64)
+    out = contrib.gather_tree(Tensor(ids), Tensor(parents))
+    want = np.array([[[2, 2], [1, 6]], [[3, 3], [6, 1]],
+                     [[0, 1], [9, 0]]], np.int64)
+    np.testing.assert_array_equal(np.asarray(out.data), want)
+
+
+def _levenshtein(hyp, ref):
+    m, n = len(hyp), len(ref)
+    d = np.zeros((m + 1, n + 1), np.float32)
+    d[:, 0] = np.arange(m + 1)
+    d[0, :] = np.arange(n + 1)
+    for i in range(1, m + 1):
+        for j in range(1, n + 1):
+            cost = 0 if hyp[i - 1] == ref[j - 1] else 1
+            d[i][j] = min(d[i - 1][j] + 1, d[i][j - 1] + 1,
+                          d[i - 1][j - 1] + cost)
+    return d[m][n]
+
+
+@pytest.mark.parametrize('normalized', [False, True])
+def test_edit_distance_matches_levenshtein(normalized):
+    rng = np.random.RandomState(0)
+    B, T1, T2 = 5, 9, 7
+    x = rng.randint(1, 20, (B, T1)).astype(np.int64)
+    y = rng.randint(1, 20, (B, T2)).astype(np.int64)
+    l1 = rng.randint(1, T1 + 1, (B,)).astype(np.int64)
+    l2 = rng.randint(1, T2 + 1, (B,)).astype(np.int64)
+    out, seq_num = contrib.edit_distance(
+        Tensor(x), Tensor(y), normalized=normalized,
+        input_length=Tensor(l1), label_length=Tensor(l2))
+    got = np.asarray(out.data).reshape(-1)
+    for b in range(B):
+        want = _levenshtein(list(x[b, :l1[b]]), list(y[b, :l2[b]]))
+        if normalized:
+            want = want / max(float(l2[b]), 1.0)
+        assert abs(got[b] - want) < 1e-5, (b, got[b], want)
+    assert int(seq_num.data) == B
+
+
+def test_edit_distance_ignored_tokens():
+    x = np.array([[12, 3, 0, 5, 8]], np.int64)
+    y = np.array([[12, 0, 3, 5]], np.int64)
+    out, _ = contrib.edit_distance(
+        Tensor(x), Tensor(y), normalized=False, ignored_tokens=[0])
+    # after dropping 0s: [12,3,5,8] vs [12,3,5] -> distance 1
+    assert float(out.data.reshape(())) == 1.0
+
+
+def test_mean_iou_oracle():
+    rng = np.random.RandomState(1)
+    C = 5
+    pred = rng.randint(0, C, (16, 8)).astype(np.int32)
+    lab = rng.randint(0, C, (16, 8)).astype(np.int32)
+    miou, wrong, correct = contrib.mean_iou(Tensor(pred), Tensor(lab), C)
+    ow = np.zeros(C, np.int32)
+    oc = np.zeros(C, np.int32)
+    for p, l in zip(pred.ravel(), lab.ravel()):
+        if p == l:
+            oc[p] += 1
+        else:
+            ow[p] += 1
+            ow[l] += 1
+    denom = ow + oc
+    valid = (denom != 0).sum()
+    want = (oc / np.where(denom > 0, denom, 1)).sum() / valid
+    np.testing.assert_array_equal(np.asarray(wrong.data), ow)
+    np.testing.assert_array_equal(np.asarray(correct.data), oc)
+    assert abs(float(miou.data) - want) < 1e-6
+
+
+def test_precision_recall_oracle():
+    rng = np.random.RandomState(2)
+    N, C = 64, 10
+    idx = rng.randint(0, C, (N, 1)).astype(np.int32)
+    lab = rng.randint(0, C, (N, 1)).astype(np.int32)
+    probs = rng.uniform(0, 1, (N, 1)).astype(np.float32)
+
+    def oracle_states(idxs, labels):
+        st = np.zeros((C, 4), np.float32)
+        for i in range(N):
+            p, l = idxs[i][0], labels[i][0]
+            if p == l:
+                st[p][0] += 1
+                st[:, 2] += 1
+                st[p][2] -= 1
+            else:
+                st[l][3] += 1
+                st[p][1] += 1
+                st[:, 2] += 1
+                st[l][2] -= 1
+                st[p][2] -= 1
+        return st
+
+    def oracle_metrics(st):
+        def prec(t, f):
+            return t / (t + f) if (t > 0 or f > 0) else 1.0
+
+        def f1(p, r):
+            return 2 * p * r / (p + r) if (p > 0 or r > 0) else 0.0
+        mp = np.mean([prec(st[i][0], st[i][1]) for i in range(C)])
+        mr = np.mean([prec(st[i][0], st[i][3]) for i in range(C)])
+        tp, fp, fn = st[:, 0].sum(), st[:, 1].sum(), st[:, 3].sum()
+        up, ur = prec(tp, fp), prec(tp, fn)
+        return np.array([mp, mr, f1(mp, mr), up, ur, f1(up, ur)],
+                        np.float32)
+
+    st = oracle_states(idx, lab)
+    bm, am, accum = contrib.precision_recall(
+        Tensor(probs), Tensor(idx), Tensor(lab), C)
+    np.testing.assert_allclose(np.asarray(accum.data), st, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(bm.data), oracle_metrics(st),
+                               atol=1e-5)
+    # streaming: feeding prior states accumulates
+    bm2, am2, accum2 = contrib.precision_recall(
+        Tensor(probs), Tensor(idx), Tensor(lab), C, states=accum)
+    np.testing.assert_allclose(np.asarray(accum2.data), 2 * st, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(am2.data),
+                               oracle_metrics(2 * st), atol=1e-5)
+
+
+def test_positive_negative_pair_oracle():
+    rng = np.random.RandomState(3)
+    N = 20
+    score = rng.normal(size=(N, 1)).astype(np.float32)
+    label = rng.normal(size=(N, 1)).astype(np.float32)
+    query = rng.randint(0, 5, (N, 1)).astype(np.int64)
+
+    groups = {}
+    for s, l, q in zip(score, label, query):
+        groups.setdefault(int(q[0]), []).append((float(s[-1]),
+                                                 float(l[0])))
+    pos = neg = neu = 0.0
+    for ranks in groups.values():
+        for (s1, l1), (s2, l2) in itertools.combinations(ranks, 2):
+            if l1 == l2:
+                continue
+            if s1 == s2:
+                neu += 1
+            elif (s1 - s2) * (l1 - l2) > 0:
+                pos += 1
+            else:
+                neg += 1
+    p, n, u = contrib.positive_negative_pair(
+        Tensor(score), Tensor(label), Tensor(query))
+    assert (float(p.data), float(n.data), float(u.data)) == (pos, neg, neu)
+
+
+def test_affine_channel_grad():
+    rng = np.random.RandomState(4)
+    x = Tensor(rng.randn(2, 3, 4, 5).astype(np.float32))
+    x.stop_gradient = False
+    scale = Tensor(rng.randn(3).astype(np.float32))
+    scale.stop_gradient = False
+    bias = Tensor(rng.randn(3).astype(np.float32))
+    out = contrib.affine_channel(x, scale, bias)
+    want = np.asarray(x.data) * np.asarray(scale.data).reshape(1, 3, 1, 1) \
+        + np.asarray(bias.data).reshape(1, 3, 1, 1)
+    np.testing.assert_allclose(np.asarray(out.data), want, rtol=1e-6)
+    out.sum().backward()
+    np.testing.assert_allclose(
+        np.asarray(scale.grad.data),
+        np.asarray(x.data).sum(axis=(0, 2, 3)), rtol=1e-4)
+
+
+def test_row_hash_shape_and_determinism():
+    x = np.array([[1, 2, 3], [4, 5, 6], [1, 2, 3]], np.int64)
+    out = contrib.row_hash(Tensor(x), hash_size=1000, num_hash=4)
+    a = np.asarray(out.data)
+    assert a.shape == (3, 4, 1)
+    assert (a >= 0).all() and (a < 1000).all()
+    np.testing.assert_array_equal(a[0], a[2])     # same row, same buckets
+    assert not np.array_equal(a[0], a[1])
+    # row-as-unit: permuting the row changes the bucket (order matters)
+    y = np.array([[3, 2, 1]], np.int64)
+    b = np.asarray(contrib.row_hash(Tensor(y), 1000, num_hash=4).data)
+    assert not np.array_equal(a[0], b[0])
+    # element-wise cousin keeps its original contract
+    e = contrib.hash_op(Tensor(x), num_hash=2, mod_by=97)
+    assert np.asarray(e.data).shape == (3, 3, 2)
+
+
+def test_sample_logits_accidental_hit_masked():
+    # force a collision: tiny class space makes negatives hit the label
+    rng = np.random.RandomState(9)
+    B, C, S = 4, 3, 32
+    logits = rng.randn(B, C).astype(np.float32)
+    labels = np.full((B, 1), 1, np.int64)
+    samples, probs, slog, _ = contrib.sample_logits(
+        Tensor(logits), Tensor(labels), num_samples=S,
+        uniq=False, remove_accidental_hits=True, seed=13)
+    sa, sl = np.asarray(samples.data), np.asarray(slog.data)
+    hits = sa[:, 1:] == 1
+    assert hits.any()                  # collision actually occurred
+    assert (sl[:, 1:][hits] < -1e19).all()
+    assert (sl[:, 1:][~hits] > -1e19).all()
+
+
+def test_sample_logits_uniq_masks_duplicates():
+    rng = np.random.RandomState(10)
+    B, C, S = 2, 4, 16
+    logits = rng.randn(B, C).astype(np.float32)
+    labels = np.zeros((B, 1), np.int64)
+    samples, probs, slog, _ = contrib.sample_logits(
+        Tensor(logits), Tensor(labels), num_samples=S,
+        uniq=True, remove_accidental_hits=False, seed=3)
+    sa, pr, sl = (np.asarray(t.data) for t in (samples, probs, slog))
+    neg = sa[0, 1:]
+    live = sl[0, 1:] > -1e19
+    # at most one live column per distinct sampled class
+    for c in np.unique(neg):
+        assert live[neg == c].sum() <= 1
+    # every distinct class keeps exactly its first occurrence live
+    first_idx = {c: int(np.argmax(neg == c)) for c in np.unique(neg)}
+    for c, i in first_idx.items():
+        assert live[i]
+    # probabilities report the inclusion mass 1-(1-q)^S, in (0, 1]
+    assert ((pr > 0) & (pr <= 1)).all()
+
+
+def test_sample_logits_static_recordable():
+    import paddle_tpu as pd
+    from paddle_tpu import static
+    pd.enable_static()
+    try:
+        main, start = static.Program(), static.Program()
+        with static.program_guard(main, start):
+            lg = static.data('lg', [4, 30], 'float32')
+            lb = static.data('lb', [4, 1], 'int64')
+            _, _, slog, _ = contrib.sample_logits(lg, lb, 8, seed=5)
+            miou, _, _ = contrib.mean_iou(
+                static.data('p', [8, 8], 'int32'),
+                static.data('l', [8, 8], 'int32'), 5)
+        exe = static.Executor()
+        rng = np.random.RandomState(11)
+        out = exe.run(main, feed={
+            'lg': rng.randn(4, 30).astype(np.float32),
+            'lb': rng.randint(0, 30, (4, 1)).astype(np.int64),
+            'p': rng.randint(0, 5, (8, 8)).astype(np.int32),
+            'l': rng.randint(0, 5, (8, 8)).astype(np.int32)},
+            fetch_list=[slog, miou])
+        assert out[0].shape == (4, 9)
+        assert 0.0 <= float(out[1]) <= 1.0
+    finally:
+        pd.disable_static()
+
+
+def test_sample_logits_contract():
+    rng = np.random.RandomState(5)
+    B, C, S = 4, 30, 8
+    logits = rng.randn(B, C).astype(np.float32)
+    labels = rng.randint(0, C, (B, 1)).astype(np.int64)
+    samples, probs, slog, slab = contrib.sample_logits(
+        Tensor(logits), Tensor(labels), num_samples=S,
+        uniq=False, remove_accidental_hits=False, seed=7)
+    sa, pr, sl = (np.asarray(t.data) for t in (samples, probs, slog))
+    assert sa.shape == (B, 1 + S) and sl.shape == (B, 1 + S)
+    np.testing.assert_array_equal(sa[:, 0], labels.reshape(-1))
+    np.testing.assert_array_equal(np.asarray(slab.data),
+                                  np.zeros((B, 1), np.int64))
+    want = np.take_along_axis(logits, sa.astype(np.int64), 1) - np.log(pr)
+    np.testing.assert_allclose(sl, want, rtol=1e-5)
+
+
+def test_polygon_box_transform_oracle():
+    rng = np.random.RandomState(6)
+    x = rng.rand(2, 8, 3, 4).astype(np.float32)
+    out = np.asarray(contrib.polygon_box_transform(Tensor(x)).data)
+    h, w = 3, 4
+    wi = np.tile(np.arange(w), (h, 1))
+    hi = np.tile(np.arange(h)[:, None], (1, w))
+    idx = np.stack([wi, hi])                      # [2, h, w]
+    idx = np.tile(idx, (4, 1, 1))[None]           # [1, 8, h, w]
+    np.testing.assert_allclose(out, idx * 4 - x, rtol=1e-6)
+
+
+def test_random_crop_shape_and_content():
+    rng = np.random.RandomState(7)
+    x = rng.rand(4, 10, 12).astype(np.float32)
+    out = np.asarray(contrib.random_crop(Tensor(x), [6, 7],
+                                         seed=11).data)
+    assert out.shape == (4, 6, 7)
+    # each crop is a contiguous window of its instance
+    for b in range(4):
+        found = any(
+            np.allclose(out[b], x[b, i:i + 6, j:j + 7])
+            for i in range(5) for j in range(6))
+        assert found
+
+
+def test_static_nn_names_resolve():
+    from paddle_tpu.static import nn as snn
+    for n in ['mean_iou', 'precision_recall', 'positive_negative_pair',
+              'affine_channel', 'sample_logits', 'random_crop',
+              'polygon_box_transform', 'hash', 'gather_tree',
+              'edit_distance']:
+        assert callable(getattr(snn, n)), n
+    assert callable(paddle.nn.functional.gather_tree)
+    assert callable(paddle.metric.mean_iou)
