@@ -814,6 +814,83 @@ def search_pyramid_hash(input, num_emb, space_len, pyramid_layer, rand_len,
                            seq_lens=seq_lens, seed=seed)
 
 
+def multi_box_head(inputs, image, base_size, num_classes,
+                   aspect_ratios, min_ratio=None, max_ratio=None,
+                   min_sizes=None, max_sizes=None, steps=None,
+                   step_w=None, step_h=None, offset=0.5,
+                   variance=(0.1, 0.1, 0.2, 0.2), flip=True, clip=False,
+                   kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """Parity: fluid/layers/detection.py multi_box_head — the SSD
+    detection head: per feature map, a prior_box ladder plus 1x1/3x3
+    conv loc & conf predictors, flattened and concatenated across maps.
+    Returns (mbox_locs [N, P, 4], mbox_confs [N, P, C], boxes [P, 4],
+    variances [P, 4])."""
+    from ..vision import detection as _det
+    from ..ops import manip as _m
+    n_in = len(inputs)
+    if min_sizes is None:
+        # the reference's min/max ratio ladder: first map fixed at
+        # 10%/20% of base_size, the rest stepping min_ratio..max_ratio
+        step = int(np.floor((max_ratio - min_ratio) / (n_in - 2))) \
+            if n_in > 2 else 0
+        min_sizes = [base_size * 0.1]
+        max_sizes = [base_size * 0.2]
+        r = min_ratio
+        for _ in range(1, n_in):
+            min_sizes.append(base_size * r / 100.0)
+            max_sizes.append(base_size * (r + step) / 100.0)
+            r += step
+    if not isinstance(min_sizes[0], (list, tuple)):
+        min_sizes = [[m] for m in min_sizes]
+    if max_sizes is not None and not isinstance(max_sizes[0],
+                                                (list, tuple)):
+        max_sizes = [[m] for m in max_sizes]
+    if not isinstance(aspect_ratios[0], (list, tuple)):
+        aspect_ratios = [aspect_ratios] * n_in
+
+    locs, confs, boxes_l, vars_l = [], [], [], []
+    for i, x in enumerate(inputs):
+        mins = [float(v) for v in min_sizes[i]]
+        maxs = [float(v) for v in max_sizes[i]] if max_sizes else None
+        st = (0.0, 0.0)
+        if steps is not None:
+            st = steps[i] if isinstance(steps[i], (list, tuple)) \
+                else [steps[i], steps[i]]
+        elif step_w is not None:
+            st = [step_w[i], step_h[i] if step_h is not None else 0.0]
+        box, var = _det.prior_box(
+            x, image, mins, maxs, aspect_ratios[i], variance=variance,
+            flip=flip, clip=clip, steps=st, offset=offset,
+            min_max_aspect_ratios_order=min_max_aspect_ratios_order)
+        P_i = int(np.prod(box.shape[:-1]))
+        boxes_l.append(_m.reshape(box, [P_i, 4]))
+        vars_l.append(_m.reshape(var, [P_i, 4]))
+        num_priors = P_i // (int(x.shape[2]) * int(x.shape[3]))
+        cin = int(x.shape[1])
+        wl = _make_param([num_priors * 4, cin, kernel_size, kernel_size],
+                         x.dtype)
+        bl = _make_param([num_priors * 4], x.dtype,
+                         initializer=I.Constant(0.0))
+        loc = F.conv2d(x, wl, bl, stride=stride, padding=pad)
+        loc = _m.transpose(loc, [0, 2, 3, 1])
+        locs.append(_m.reshape(loc, [int(x.shape[0]), P_i, 4]))
+        wc = _make_param(
+            [num_priors * num_classes, cin, kernel_size, kernel_size],
+            x.dtype)
+        bc = _make_param([num_priors * num_classes], x.dtype,
+                         initializer=I.Constant(0.0))
+        conf = F.conv2d(x, wc, bc, stride=stride, padding=pad)
+        conf = _m.transpose(conf, [0, 2, 3, 1])
+        confs.append(_m.reshape(conf,
+                                [int(x.shape[0]), P_i, num_classes]))
+    mbox_locs = _m.concat(locs, axis=1)
+    mbox_confs = _m.concat(confs, axis=1)
+    boxes = _m.concat(boxes_l, axis=0)
+    variances = _m.concat(vars_l, axis=0)
+    return mbox_locs, mbox_confs, boxes, variances
+
+
 def _reexport():
     """The rest of the fluid.layers vocabulary records through the shared
     op layer — re-export so `static.nn.<name>` resolves (fluid/layers

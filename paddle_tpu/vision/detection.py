@@ -466,7 +466,8 @@ def multiclass_nms(bboxes, scores, score_threshold=0.0, nms_top_k=-1,
             if background_label >= 0:
                 kept_scores = kept_scores.at[background_label].set(-jnp.inf)
             flat = kept_scores.reshape(-1)               # [C*M]
-            top, arg = lax.top_k(flat, K)
+            k_eff = min(K, flat.shape[0])    # fewer candidates than K:
+            top, arg = lax.top_k(flat, k_eff)  # pad the tail below
             label = (arg // M).astype(jnp.float32)
             box_id = arg % M
             chosen = boxes[box_id]
@@ -476,6 +477,13 @@ def multiclass_nms(bboxes, scores, score_threshold=0.0, nms_top_k=-1,
                 jnp.where(valid, top, 0.0)[:, None],
                 jnp.where(valid[:, None], chosen, 0.0)], axis=1)
             idx_out = jnp.where(valid, box_id, -1).astype(jnp.int32)
+            if k_eff < K:
+                pad = K - k_eff
+                row = jnp.concatenate([
+                    row, jnp.tile(jnp.asarray(
+                        [[-1.0, 0, 0, 0, 0, 0]], row.dtype), (pad, 1))])
+                idx_out = jnp.concatenate(
+                    [idx_out, jnp.full((pad,), -1, jnp.int32)])
             return row, idx_out, jnp.sum(valid).astype(jnp.int32)
         return jax.vmap(one)(bb, sc)
     return run_op('multiclass_nms', fn, [bboxes, scores],
@@ -1000,3 +1008,1079 @@ class DetectionMAP:
                                   * mpre[idx + 1]))
             aps.append(ap)
         return float(min(np.mean(aps), 1.0)) if aps else 0.0
+
+
+# ---------------------------------------------------------------------------
+# detection tail (VERDICT r3 op remainder, wave 2a — device ops)
+# ---------------------------------------------------------------------------
+
+def sigmoid_focal_loss(x, label, fg_num, gamma=2.0, alpha=0.25):
+    """fluid.layers.sigmoid_focal_loss (fluid/layers/detection.py:475,
+    operators/detection/sigmoid_focal_loss_op.cc): x [N, C] logits over C
+    REAL classes, label [N, 1] in [0, C] with 0 = background, fg_num [1]
+    the positive count; per-element focal loss scaled by 1/fg_num.
+    Class j corresponds to label value j+1."""
+    x = as_tensor(x)
+    label = as_tensor(label, ref=x)
+    fg_num = as_tensor(fg_num, ref=x)
+
+    def fn(xv, fg, lab):
+        C = xv.shape[1]
+        pos = lab.reshape(-1, 1) == jnp.arange(1, C + 1)[None, :]
+        # stable log-sigmoid pieces
+        log_sig = jax.nn.log_sigmoid(xv)
+        log_one_minus = jax.nn.log_sigmoid(-xv)
+        sig = jax.nn.sigmoid(xv)
+        fgc = jnp.maximum(fg.reshape(()).astype(xv.dtype), 1.0)
+        loss_pos = -alpha * jnp.power(1.0 - sig, gamma) * log_sig / fgc
+        loss_neg = -(1.0 - alpha) * jnp.power(sig, gamma) \
+            * log_one_minus / fgc
+        return jnp.where(pos, loss_pos, loss_neg)
+    return run_op('sigmoid_focal_loss', fn, [x, fg_num, label],
+                  n_nondiff=1)
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  neg_lod=None, input_lod=None, mismatch_value=0,
+                  name=None):
+    """target_assign_op.cc (oracle: test_target_assign_op.py):
+    input [R, P, K] packed per-gt rows (R = sum of per-image gt counts;
+    `input_lod` = per-image gt counts, default R/B uniform),
+    matched_indices [B, P] (LOCAL gt index per prior, -1 unmatched) →
+      out [B, P, K]         gathered rows (mismatch_value at unmatched),
+      out_weight [B, P, 1]  1.0 at matched priors (and at priors listed
+                            in negative_indices, segmented by neg_lod).
+    LoD-free dense contract: lengths vectors replace the reference's LoD.
+    """
+    input = as_tensor(input)
+    mi = as_tensor(matched_indices, ref=input)
+    neg = None if negative_indices is None \
+        else as_tensor(negative_indices, ref=input)
+    B = int(mi.shape[0])
+    R = int(input.shape[0])
+    if input_lod is not None:
+        counts = np.asarray(input_lod, np.int64).reshape(-1)
+        assert counts.sum() == R and len(counts) == B
+        offsets_np = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    else:
+        assert R % B == 0, "packed rows must divide batch; pass input_lod"
+        offsets_np = np.arange(B) * (R // B)
+    if neg is not None:
+        if neg_lod is None and B > 1:
+            raise ValueError(
+                "target_assign: negative_indices with batch > 1 needs "
+                "neg_lod (per-image counts) — without it every index "
+                "would silently land in image 0")
+        nl = (np.asarray(neg_lod, np.int64).reshape(-1)
+              if neg_lod is not None
+              else np.asarray([int(neg.shape[0])]))
+        seg_np = np.repeat(np.arange(len(nl)), nl).astype(np.int32)
+
+    def fn(inp, m, *rest):
+        P = m.shape[1]
+        K = inp.shape[-1]
+        offsets = jnp.asarray(offsets_np, jnp.int32)
+        matched = m >= 0
+        rows = jnp.clip(m, 0, None).astype(jnp.int32) + offsets[:, None]
+        gathered = inp[rows.reshape(-1),
+                       jnp.tile(jnp.arange(P), B), :].reshape(B, P, K)
+        out = jnp.where(matched[..., None], gathered,
+                        jnp.asarray(mismatch_value, inp.dtype))
+        w = matched.astype(jnp.float32)
+        if rest:
+            nidx = rest[0].reshape(-1).astype(jnp.int32)
+            w = w.at[jnp.asarray(seg_np), nidx].set(1.0)
+        return out, w[..., None]
+
+    tens = [input, mi] + ([neg] if neg is not None else [])
+    return run_op('target_assign', fn, tens, n_nondiff=len(tens) - 1)
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
+                           box_clip=4.135, name=None):
+    """box_decoder_and_assign_op.cc (oracle:
+    test_box_decoder_and_assign_op.py): decode per-class deltas
+    [R, C*4] against priors [R, 4] (+1-width convention), then per row
+    pick the highest-scoring NON-background class's box.
+    Returns (decoded_box [R, C*4], output_assign_box [R, 4])."""
+    prior_box = as_tensor(prior_box)
+    target_box = as_tensor(target_box, ref=prior_box)
+    var = as_tensor(prior_box_var, ref=prior_box)
+    score = as_tensor(box_score, ref=prior_box)
+
+    def fn(p, v, t, s):
+        w = p[:, 2] - p[:, 0] + 1.0
+        h = p[:, 3] - p[:, 1] + 1.0
+        cx = p[:, 0] + 0.5 * w
+        cy = p[:, 1] + 0.5 * h
+        R, C4 = t.shape
+        C = C4 // 4
+        d = t.reshape(R, C, 4) * v.reshape(-1)[None, None, :]
+        dx, dy = d[..., 0], d[..., 1]
+        dw = jnp.minimum(d[..., 2], box_clip)
+        dh = jnp.minimum(d[..., 3], box_clip)
+        pcx = dx * w[:, None] + cx[:, None]
+        pcy = dy * h[:, None] + cy[:, None]
+        pw = jnp.exp(dw) * w[:, None]
+        ph = jnp.exp(dh) * h[:, None]
+        boxes = jnp.stack([pcx - 0.5 * pw, pcy - 0.5 * ph,
+                           pcx + 0.5 * pw - 1, pcy + 0.5 * ph - 1],
+                          axis=-1)                       # [R, C, 4]
+        # argmax score, never class 0 (background)
+        order = jnp.argsort(-s, axis=1)
+        best = jnp.where(order[:, 0] == 0, order[:, 1], order[:, 0])
+        assign = jnp.take_along_axis(
+            boxes, best[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+        return boxes.reshape(R, C4), assign
+    return run_op('box_decoder_and_assign', fn,
+                  [prior_box, var, target_box, score], n_nondiff=3)
+
+
+def prroi_pool(input, rois, spatial_scale=1.0, pooled_height=1,
+               pooled_width=1, rois_num=None, name=None):
+    """prroi_pool_op.cc — Precise RoI pooling (oracle:
+    test_prroi_pool_op.py PyPrRoIPool): the EXACT integral of the
+    bilinearly-interpolated feature over each continuous bin, divided by
+    bin area (no sampling-point approximation).
+
+    TPU-native closed form: bilinear interp is separable —
+    f(u, v) = Σ_ij F[j, i] hat(u-i) hat(v-j) — so the bin integral is
+    Wy @ F @ Wx^T with 1-D hat-integral weight vectors per bin:
+    W[b, i] = G(hi - i) - G(lo - i), G the triangular-kernel CDF. One
+    einsum per roi, fully differentiable through `input`.
+
+    rois: [R, 4] (x1, y1, x2, y2) + rois_num [B] per-image counts
+    (paddle-2.x dense contract; the reference takes LoD)."""
+    input = as_tensor(input)
+    rois = as_tensor(rois, ref=input)
+    if rois_num is None:
+        batch_idx = np.zeros((int(rois.shape[0]),), np.int32)
+    else:
+        rn = np.asarray(as_tensor(rois_num).data).reshape(-1)
+        batch_idx = np.repeat(np.arange(len(rn)), rn).astype(np.int32)
+
+    ph, pw = int(pooled_height), int(pooled_width)
+
+    def fn(x, r):
+        N, C, H, W = x.shape
+
+        def hat_cdf(t):
+            t = jnp.clip(t, -1.0, 1.0)
+            neg = 0.5 * (t + 1.0) ** 2
+            pos = 0.5 + t - 0.5 * t * t
+            return jnp.where(t <= 0, neg, pos)
+
+        def weights(lo, hi, n, bins):
+            # [bins, n] hat-integral of pixel i over each bin
+            edges = lo + (hi - lo) * jnp.arange(bins + 1) / bins
+            i = jnp.arange(n, dtype=x.dtype)
+            cdf = hat_cdf(edges[:, None] - i[None, :])   # [bins+1, n]
+            return cdf[1:] - cdf[:-1]
+
+        def one(roi, b):
+            x1, y1, x2, y2 = (roi * spatial_scale)
+            wx = weights(x1, x2, W, pw)                  # [pw, W]
+            wy = weights(y1, y2, H, ph)                  # [ph, H]
+            area = jnp.maximum((x2 - x1) / pw, 1e-9) * \
+                jnp.maximum((y2 - y1) / ph, 1e-9)
+            feat = x[b]                                  # [C, H, W]
+            out = jnp.einsum('hH,cHW,wW->chw', wy, feat, wx)
+            return out / area
+        return jax.vmap(one)(r, jnp.asarray(batch_idx))
+    return run_op('prroi_pool', fn, [input, rois], n_nondiff=1)
+
+
+def retinanet_detection_output(bboxes, scores, anchors, im_info,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.3,
+                               nms_eta=1.0):
+    """retinanet_detection_output_op.cc (oracle:
+    test_retinanet_detection_output.py): per-FPN-level top-k + anchor
+    decode (+1-width RetinaNet convention, clipped to the rescaled
+    image), then class-wise NMS and global keep_top_k.
+
+    Fixed-shape TPU form: each level keeps its nms_top_k candidates by
+    score-masking instead of dynamic filtering; the cross-level merge is
+    one concatenated padded NMS. Output rows (label, score, x1..y2),
+    label 1-based, -1 past the valid count (+ count tensor), matching
+    multiclass_nms's padded contract in place of LoD."""
+    bboxes = [as_tensor(b) for b in bboxes]
+    scores = [as_tensor(s) for s in scores]
+    anchors = [as_tensor(a) for a in anchors]
+    im_info = as_tensor(im_info)
+    L = len(bboxes)
+    K = int(keep_top_k)
+
+    def fn(im, *flat):
+        bl, sl, al = flat[:L], flat[L:2 * L], flat[2 * L:]
+        C = sl[0].shape[-1]
+        cand_b, cand_s, cand_c = [], [], []
+        im_h, im_w, im_scale = im[0], im[1], im[2]
+        for lvl in range(L):
+            sc = sl[lvl].reshape(-1)                     # [A*C]
+            bb = bl[lvl].reshape(-1, 4)                  # [A, 4]
+            an = al[lvl].reshape(-1, 4)
+            thresh = score_threshold if lvl < L - 1 else 0.0
+            sc = jnp.where(sc > thresh, sc, -jnp.inf)
+            k = min(int(nms_top_k), sc.shape[0]) if nms_top_k > -1 \
+                else sc.shape[0]
+            top, arg = lax.top_k(sc, k)
+            a_id = arg // C
+            cls = arg % C
+            aw = an[a_id, 2] - an[a_id, 0] + 1
+            ah = an[a_id, 3] - an[a_id, 1] + 1
+            acx = an[a_id, 0] + aw / 2
+            acy = an[a_id, 1] + ah / 2
+            d = bb[a_id]
+            cx = d[:, 0] * aw + acx
+            cy = d[:, 1] * ah + acy
+            w = jnp.exp(d[:, 2]) * aw
+            h = jnp.exp(d[:, 3]) * ah
+            box = jnp.stack([cx - w / 2, cy - h / 2,
+                             cx + w / 2 - 1, cy + h / 2 - 1], -1)
+            box = box / im_scale
+            lim_x = jnp.round(im_w / im_scale) - 1
+            lim_y = jnp.round(im_h / im_scale) - 1
+            box = jnp.stack([
+                jnp.clip(box[:, 0], 0, lim_x),
+                jnp.clip(box[:, 1], 0, lim_y),
+                jnp.clip(box[:, 2], 0, lim_x),
+                jnp.clip(box[:, 3], 0, lim_y)], -1)
+            cand_b.append(box)
+            cand_s.append(top)
+            cand_c.append(cls)
+        boxes = jnp.concatenate(cand_b)                  # [M, 4]
+        scs = jnp.concatenate(cand_s)
+        cls = jnp.concatenate(cand_c)
+        C_num = C
+
+        # class-wise NMS over the merged candidates
+        def per_class(c):
+            s_c = jnp.where((cls == c) & (scs > -jnp.inf), scs, -jnp.inf)
+            keep = _greedy_nms_mask(boxes, s_c, nms_threshold,
+                                    normalized=False, eta=nms_eta)
+            return jnp.where(keep & (s_c > -jnp.inf), s_c, -jnp.inf)
+        kept = jax.vmap(per_class)(jnp.arange(C_num))    # [C, M]
+        flat = kept.reshape(-1)
+        top, arg = lax.top_k(flat, min(K, flat.shape[0]))
+        c_id = (arg // boxes.shape[0]).astype(jnp.float32)
+        b_id = arg % boxes.shape[0]
+        valid = top > -jnp.inf
+        rows = jnp.concatenate([
+            jnp.where(valid, c_id + 1.0, -1.0)[:, None],
+            jnp.where(valid, top, 0.0)[:, None],
+            jnp.where(valid[:, None], boxes[b_id], 0.0)], axis=1)
+        return rows, jnp.sum(valid).astype(jnp.int32)
+
+    tens = [im_info] + bboxes + scores + anchors
+    return run_op('retinanet_detection_output', fn, tens,
+                  n_nondiff=len(tens))
+
+
+def locality_aware_nms(bboxes, scores, score_threshold, nms_threshold,
+                       keep_top_k, nms_eta=1.0, name=None):
+    """locality_aware_nms_op.cc (EAST text detection): first a
+    locality-aware pass — consecutive boxes whose IOU exceeds the
+    threshold merge by score-weighted average (scores add) — then
+    standard class-0 greedy NMS + keep_top_k.
+
+    The merge pass is inherently sequential (each box merges into the
+    running candidate); it compiles to one `lax.scan` over the M boxes.
+    bboxes [N, M, 4], scores [N, 1, M] → padded (label, score, x1..y2)
+    rows + count, like multiclass_nms."""
+    bboxes = as_tensor(bboxes)
+    scores = as_tensor(scores, ref=bboxes)
+    K = int(keep_top_k)
+
+    def fn(bb, sc):
+        def one(boxes, s):
+            s = s.reshape(-1)
+            M = boxes.shape[0]
+
+            def iou_pair(a, b):
+                lt = jnp.maximum(a[:2], b[:2])
+                rb = jnp.minimum(a[2:], b[2:])
+                wh = jnp.maximum(rb - lt, 0.0)
+                inter = wh[0] * wh[1]
+                ar_a = jnp.maximum(a[2] - a[0], 0) * \
+                    jnp.maximum(a[3] - a[1], 0)
+                ar_b = jnp.maximum(b[2] - b[0], 0) * \
+                    jnp.maximum(b[3] - b[1], 0)
+                return inter / jnp.maximum(ar_a + ar_b - inter, 1e-9)
+
+            # locality-aware merge scan: carry = (current box, score,
+            # out boxes, out scores, write cursor)
+            out_b0 = jnp.zeros((M, 4), boxes.dtype)
+            out_s0 = jnp.full((M,), -jnp.inf, s.dtype)
+
+            def body(carry, i):
+                cur_b, cur_s, ob, os_, ptr = carry
+                b, sv = boxes[i], s[i]
+                first = cur_s == -jnp.inf
+                mergeable = (~first) & (iou_pair(cur_b, b)
+                                        > nms_threshold)
+                tot = cur_s + sv
+                merged = (cur_b * cur_s + b * sv) / jnp.maximum(tot,
+                                                                1e-9)
+                # flush current candidate when not merging
+                ob = jnp.where(mergeable | first, ob,
+                               ob.at[ptr].set(cur_b))
+                os_ = jnp.where(mergeable | first, os_,
+                                os_.at[ptr].set(cur_s))
+                ptr = jnp.where(mergeable | first, ptr, ptr + 1)
+                cur_b = jnp.where(mergeable, merged, b)
+                cur_s = jnp.where(mergeable, tot, sv)
+                return (cur_b, cur_s, ob, os_, ptr), None
+
+            (cur_b, cur_s, ob, os_, ptr), _ = lax.scan(
+                body, (jnp.zeros((4,), boxes.dtype),
+                       jnp.asarray(-jnp.inf, s.dtype),
+                       out_b0, out_s0, jnp.asarray(0, jnp.int32)),
+                jnp.arange(M))
+            ob = ob.at[ptr].set(cur_b)                  # flush the tail
+            os_ = os_.at[ptr].set(jnp.where(cur_s == -jnp.inf,
+                                            -jnp.inf, cur_s))
+            keep = _greedy_nms_mask(ob, os_, nms_threshold,
+                                    normalized=False,
+                                    score_threshold=score_threshold,
+                                    eta=nms_eta)
+            final = jnp.where(keep & (os_ > -jnp.inf), os_, -jnp.inf)
+            top, arg = lax.top_k(final, min(K, M))
+            valid = top > -jnp.inf
+            rows = jnp.concatenate([
+                jnp.where(valid, 0.0, -1.0)[:, None],
+                jnp.where(valid, top, 0.0)[:, None],
+                jnp.where(valid[:, None], ob[arg], 0.0)], axis=1)
+            return rows, jnp.sum(valid).astype(jnp.int32)
+        return jax.vmap(one)(bb, sc)
+    return run_op('locality_aware_nms', fn, [bboxes, scores],
+                  n_nondiff=2)
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0):
+    """fluid.layers.detection_output (SSD post-process): box_coder
+    decode_center_size against the priors, then multiclass_nms.
+    loc [N, P, 4], scores [N, P, C] (post-softmax), prior_box [P, 4].
+    Returns the multiclass_nms padded triple."""
+    loc = as_tensor(loc)
+    scores = as_tensor(scores, ref=loc)
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type='decode_center_size', axis=0)
+    # [N, P, C] -> [N, C, P] for the NMS contract
+    from ..ops.manip import transpose
+    sc = transpose(scores, [0, 2, 1])
+    return multiclass_nms(decoded, sc,
+                          score_threshold=score_threshold,
+                          nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+                          nms_threshold=nms_threshold, normalized=False,
+                          nms_eta=nms_eta,
+                          background_label=background_label)
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=True, name=None, scale_x_y=1.0):
+    """yolov3_loss_op.cc (oracle: test_yolov3_loss_op.py YOLOv3Loss).
+
+    x [N, A*(5+C), H, W] raw head output, gt_box [N, B, 4] normalized
+    xywh, gt_label [N, B], optional gt_score [N, B] (mixup weights).
+
+    TPU-native: the per-gt python loops become a `lax.scan` over the B
+    gt slots (sequential to preserve the reference's last-writer-wins
+    objectness assignment for duplicate cells) with everything inside
+    vectorized over the batch; the coordinate/class/objectness terms use
+    stable logits-space BCE. Returns (loss [N], objectness_mask
+    [N, A, H, W], gt_match_mask [N, B])."""
+    x = as_tensor(x)
+    gt_box = as_tensor(gt_box, ref=x)
+    gt_label = as_tensor(gt_label, ref=x)
+    gt_score_t = None if gt_score is None else as_tensor(gt_score, ref=x)
+    anchors_l = [float(a) for a in anchors]
+    mask = [int(m) for m in anchor_mask]
+    C = int(class_num)
+    an_num = len(anchors_l) // 2
+    mask_num = len(mask)
+
+    def bce(logit, label):
+        # -label*log(sig) - (1-label)*log(1-sig), stable
+        return jnp.maximum(logit, 0) - logit * label + \
+            jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+    def xywh_iou(a, b):
+        # a [.., 4], b [.., 4] center-size, broadcastable
+        al, ar = a[..., 0] - a[..., 2] / 2, a[..., 0] + a[..., 2] / 2
+        at, ab = a[..., 1] - a[..., 3] / 2, a[..., 1] + a[..., 3] / 2
+        bl, br = b[..., 0] - b[..., 2] / 2, b[..., 0] + b[..., 2] / 2
+        bt, bb = b[..., 1] - b[..., 3] / 2, b[..., 1] + b[..., 3] / 2
+        iw = jnp.clip(jnp.minimum(ar, br) - jnp.maximum(al, bl), 0., 1.)
+        ih = jnp.clip(jnp.minimum(ab, bb) - jnp.maximum(at, bt), 0., 1.)
+        inter = iw * ih
+        union = (ar - al) * (ab - at) + (br - bl) * (bb - bt) - inter
+        return inter / jnp.maximum(union, 1e-10)
+
+    def fn(xv, gb, gl, *rest):
+        N, _, H, W = xv.shape
+        Bc = gb.shape[1]
+        gs = rest[0] if rest else jnp.ones((N, Bc), xv.dtype)
+        input_size = downsample_ratio * H
+        xr = xv.reshape(N, mask_num, 5 + C, H, W) \
+            .transpose(0, 1, 3, 4, 2)                # [N, A, H, W, 5+C]
+        bias_xy = -0.5 * (scale_x_y - 1.0)
+
+        smooth_w = min(1.0 / C, 1.0 / 40)
+        pos_l = 1.0 - smooth_w if use_label_smooth else 1.0
+        neg_l = smooth_w if use_label_smooth else 0.0
+
+        # decoded pred boxes for the ignore mask
+        grid_x = jnp.broadcast_to(jnp.arange(W), (H, W))
+        grid_y = jnp.broadcast_to(jnp.arange(H)[:, None], (H, W))
+        m_anch = jnp.asarray(
+            [[anchors_l[2 * m] / input_size,
+              anchors_l[2 * m + 1] / input_size] for m in mask], xv.dtype)
+        px = (grid_x + jax.nn.sigmoid(xr[..., 0]) * scale_x_y
+              + bias_xy) / W
+        py = (grid_y + jax.nn.sigmoid(xr[..., 1]) * scale_x_y
+              + bias_xy) / H
+        pw = jnp.exp(xr[..., 2]) * m_anch[:, 0][None, :, None, None]
+        phh = jnp.exp(xr[..., 3]) * m_anch[:, 1][None, :, None, None]
+        pred_box = jnp.stack([px, py, pw, phh], -1).reshape(N, -1, 4)
+        pred_obj = xr[..., 4].reshape(N, -1)         # [N, A*H*W]
+
+        ious = xywh_iou(pred_box[:, :, None, :], gb[:, None, :, :])
+        ious_max = ious.max(-1)                      # [N, A*H*W]
+        objness0 = jnp.where(ious_max > ignore_thresh, -1.0, 0.0)
+
+        # gt -> anchor shape matching over ALL an_num anchors
+        all_anch = jnp.asarray(
+            [[0., 0., anchors_l[2 * i] / input_size,
+              anchors_l[2 * i + 1] / input_size]
+             for i in range(an_num)], xv.dtype)      # [an_num, 4]
+        g_shift = gb.at[..., 0].set(0.).at[..., 1].set(0.)
+        sh_iou = xywh_iou(g_shift[:, :, None, :],
+                          all_anch[None, None, :, :])  # [N, B, an_num]
+        best = jnp.argmax(sh_iou, -1)                # [N, B]
+        in_mask = jnp.zeros((an_num,), bool)
+        an_idx_of = jnp.zeros((an_num,), jnp.int32)
+        for k, m in enumerate(mask):
+            in_mask = in_mask.at[m].set(True)
+            an_idx_of = an_idx_of.at[m].set(k)
+        has_box = gb[..., 2] * gb[..., 3] > 0        # w*h > 0
+        valid = has_box & in_mask[best]
+        an_idx = an_idx_of[best]                     # [N, B]
+        gmatch = jnp.where(valid, an_idx, -1).astype(jnp.int32)
+
+        gi = jnp.clip((gb[..., 0] * W).astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip((gb[..., 1] * H).astype(jnp.int32), 0, H - 1)
+        tx = gb[..., 0] * W - gi
+        ty = gb[..., 1] * W - gj                     # oracle uses *w
+        aw = m_anch[:, 0][an_idx]                    # matched anchor w/h
+        ah = m_anch[:, 1][an_idx]
+        tw = jnp.log(jnp.maximum(gb[..., 2], 1e-10) / aw)
+        th = jnp.log(jnp.maximum(gb[..., 3], 1e-10) / ah)
+        box_scale = (2.0 - gb[..., 2] * gb[..., 3]) * gs
+
+        bidx = jnp.arange(N)
+        cell = lambda f, a_i, j_i, i_i: f[bidx, a_i, j_i, i_i]
+
+        # per-gt coordinate + class loss, scan preserves write order of
+        # the objectness assignment (last writer wins, like the oracle)
+        def gt_step(carry, t):
+            loss, obj = carry
+            a_i, j_i, i_i = an_idx[:, t], gj[:, t], gi[:, t]
+            v = valid[:, t]
+            sc = box_scale[:, t]
+            lx = bce(cell(xr[..., 0], a_i, j_i, i_i), tx[:, t]) * sc
+            ly = bce(cell(xr[..., 1], a_i, j_i, i_i), ty[:, t]) * sc
+            lw = jnp.abs(cell(xr[..., 2], a_i, j_i, i_i) - tw[:, t]) * sc
+            lh = jnp.abs(cell(xr[..., 3], a_i, j_i, i_i) - th[:, t]) * sc
+            cls_logits = xr[bidx, a_i, j_i, i_i, 5:]  # [N, C]
+            tgt = jnp.where(
+                jnp.arange(C)[None, :] == gl[:, t][:, None].astype(
+                    jnp.int32), pos_l, neg_l)
+            lc = (bce(cls_logits, tgt).sum(-1)) * gs[:, t]
+            loss = loss + jnp.where(v, lx + ly + lw + lh + lc, 0.0)
+            flat = (a_i * H + j_i) * W + i_i
+            obj = jnp.where(
+                jnp.zeros_like(obj, bool).at[bidx, flat].set(True)
+                & v[:, None], gs[:, t][:, None], obj)
+            return (loss, obj), None
+
+        (loss, objness), _ = lax.scan(
+            gt_step, (jnp.zeros((N,), xv.dtype), objness0),
+            jnp.arange(Bc))
+
+        obj_pos = jnp.where(objness > 0,
+                            bce(pred_obj, 1.0) * objness, 0.0)
+        obj_neg = jnp.where(objness == 0, bce(pred_obj, 0.0), 0.0)
+        loss = loss + (obj_pos + obj_neg).sum(-1)
+        return loss, objness.reshape(N, mask_num, H, W), gmatch
+
+    tens = [x, gt_box, gt_label] + \
+        ([gt_score_t] if gt_score_t is not None else [])
+    return run_op('yolov3_loss', fn, tens, n_nondiff=len(tens) - 1)
+
+
+def deformable_roi_pooling(input, rois, trans, no_trans=False,
+                           spatial_scale=1.0, group_size=(1, 1),
+                           pooled_height=1, pooled_width=1, part_size=None,
+                           sample_per_part=1, trans_std=0.1,
+                           position_sensitive=False, rois_num=None,
+                           name=None):
+    """deformable_psroi_pooling_op.cc (oracle:
+    test_deformable_psroi_pooling.py): each output bin averages
+    `sample_per_part`^2 bilinear samples, shifted by the learned
+    per-part (trans_y, trans_x) offsets; position_sensitive maps output
+    channel + group cell to an input channel (R-FCN style).
+
+    TPU-native: the reference's per-(roi, channel, bin, sample) scalar
+    loop is one vectorized gather — samples out of bounds contribute 0
+    and are excluded from the average via a mask count. Differentiable
+    through `input` and `trans`.
+
+    rois [R, 4] + rois_num [B] (dense batch mapping; reference uses
+    LoD); the +1/round box snapping matches the kernel."""
+    input = as_tensor(input)
+    rois = as_tensor(rois, ref=input)
+    trans = as_tensor(trans, ref=input)
+    if rois_num is None:
+        batch_idx_np = np.zeros((int(rois.shape[0]),), np.int32)
+    else:
+        rn = np.asarray(as_tensor(rois_num).data).reshape(-1)
+        batch_idx_np = np.repeat(np.arange(len(rn)), rn).astype(np.int32)
+    ph, pw = int(pooled_height), int(pooled_width)
+    gh, gw = (int(group_size[0]), int(group_size[1]))
+    if part_size is None:
+        part_size = (ph, pw)
+    part_h, part_w = int(part_size[0]), int(part_size[1])
+    sp = int(sample_per_part)
+
+    def fn(x, r, tr):
+        N, C, H, W = x.shape
+        out_C = C // (gh * gw) if position_sensitive else C
+
+        def bilinear(img, yy, xx):
+            # img [H, W]; sample at clamped (yy, xx) with corner masking
+            y0 = jnp.floor(yy)
+            x0 = jnp.floor(xx)
+            ly, lx = yy - y0, xx - x0
+            y0i = y0.astype(jnp.int32)
+            x0i = x0.astype(jnp.int32)
+
+            def at(yi, xi):
+                ok = (yi >= 0) & (yi <= H - 1) & (xi >= 0) & (xi <= W - 1)
+                v = img[jnp.clip(yi, 0, H - 1), jnp.clip(xi, 0, W - 1)]
+                return jnp.where(ok, v, 0.0)
+            return ((1 - ly) * (1 - lx) * at(y0i, x0i)
+                    + (1 - ly) * lx * at(y0i, x0i + 1)
+                    + ly * (1 - lx) * at(y0i + 1, x0i)
+                    + ly * lx * at(y0i + 1, x0i + 1))
+
+        def one(roi, b, tr_r):
+            x1 = jnp.round(roi[0]) * spatial_scale - 0.5
+            y1 = jnp.round(roi[1]) * spatial_scale - 0.5
+            x2 = jnp.round(roi[2] + 1) * spatial_scale - 0.5
+            y2 = jnp.round(roi[3] + 1) * spatial_scale - 0.5
+            rw = jnp.maximum(x2 - x1, 0.1)
+            rh = jnp.maximum(y2 - y1, 0.1)
+            bin_w, bin_h = rw / pw, rh / ph
+            sub_w, sub_h = bin_w / sp, bin_h / sp
+
+            p_h = jnp.arange(ph)
+            p_w = jnp.arange(pw)
+            # part cell + learned offset per bin
+            prt_h = (p_h * part_h // ph)[:, None]        # [ph, 1]
+            prt_w = (p_w * part_w // pw)[None, :]        # [1, pw]
+            if no_trans:
+                tx = jnp.zeros((ph, pw), x.dtype)
+                ty = jnp.zeros((ph, pw), x.dtype)
+            else:
+                tx = tr_r[0][prt_h, prt_w] * trans_std   # [ph, pw]
+                ty = tr_r[1][prt_h, prt_w] * trans_std
+            wstart = p_w[None, :] * bin_w + x1 + tx * rw
+            hstart = p_h[:, None] * bin_h + y1 + ty * rh
+
+            s = jnp.arange(sp)
+            xs = jnp.broadcast_to(
+                wstart[..., None, None] + s[None, None, None, :] * sub_w,
+                (ph, pw, sp, sp))
+            ys = jnp.broadcast_to(
+                hstart[..., None, None] + s[None, None, :, None] * sub_h,
+                (ph, pw, sp, sp))
+            inb = (xs >= -0.5) & (xs <= W - 0.5) & \
+                (ys >= -0.5) & (ys <= H - 0.5)           # [ph, pw, sp, sp]
+            xs_c = jnp.clip(xs, 0.0, W - 1.0)
+            ys_c = jnp.clip(ys, 0.0, H - 1.0)
+
+            # channel per (out_c, bin): position-sensitive group mapping
+            g_w = jnp.clip(p_w * gh // ph, 0, gh - 1)    # oracle's floor
+            g_h = jnp.clip(p_h * gw // pw, 0, gw - 1)
+            if position_sensitive:
+                c_in = ((jnp.arange(out_C)[:, None, None] * gh
+                         + g_h[None, :, None]) * gw
+                        + g_w[None, None, :])            # [oC, ph, pw]
+            else:
+                c_in = jnp.broadcast_to(
+                    jnp.arange(out_C)[:, None, None], (out_C, ph, pw))
+
+            def per_chan(c_map):
+                def per_bin(i, j):
+                    img = x[b, c_map[i, j]]
+                    vals = jax.vmap(jax.vmap(
+                        lambda yy, xx: bilinear(img, yy, xx)))(
+                            ys_c[i, j], xs_c[i, j])
+                    m = inb[i, j]
+                    cnt = m.sum()
+                    return jnp.where(
+                        cnt > 0, (vals * m).sum() / jnp.maximum(cnt, 1),
+                        0.0)
+                return jax.vmap(lambda i: jax.vmap(
+                    lambda j: per_bin(i, j))(jnp.arange(pw)))(
+                        jnp.arange(ph))
+            return jax.vmap(per_chan)(c_in)              # [oC, ph, pw]
+        return jax.vmap(one)(r, jnp.asarray(batch_idx_np), tr)
+    return run_op('deformable_roi_pooling', fn, [input, rois, trans],
+                  n_nondiff=0 if not no_trans else 1)
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0,
+             overlap_threshold=0.5, neg_pos_ratio=3.0, neg_overlap=0.5,
+             loc_loss_weight=1.0, conf_loss_weight=1.0,
+             match_type='per_prediction', mining_type='max_negative',
+             normalize=True, sample_size=None, gt_valid=None, name=None):
+    """fluid.layers.ssd_loss (fluid/layers/detection.py:1070 pipeline):
+    bipartite/per-prediction matching, conf softmax loss with
+    max-negative hard mining at neg_pos_ratio, smooth-L1 on
+    center-size-encoded localization deltas, normalized by matched
+    count.
+
+    Dense LoD-free contract: gt_box [N, G, 4] / gt_label [N, G] padded;
+    `gt_valid` [N, G] bool (default: nonzero-area boxes). Returns the
+    [N, P, 1] weighted per-prior loss like the reference (so callers
+    reduce it themselves)."""
+    location = as_tensor(location)
+    confidence = as_tensor(confidence, ref=location)
+    gt_box = as_tensor(gt_box, ref=location)
+    gt_label = as_tensor(gt_label, ref=location)
+    prior_box = as_tensor(prior_box, ref=location)
+    var = prior_box_var
+    variance = [0.1, 0.1, 0.2, 0.2] if var is None else None
+    if var is not None:
+        var = as_tensor(var, ref=location)
+
+    def fn(loc, conf, gb, gl, pb, *rest):
+        N, P, _ = loc.shape
+        G = gb.shape[1]
+        C = conf.shape[-1]
+        pv = rest[0] if rest else None
+        valid = (gb[..., 2] - gb[..., 0]) * (gb[..., 3] - gb[..., 1]) > 0 \
+            if gt_valid is None else jnp.asarray(gt_valid)
+
+        # [N, G, P] IOU, invalid gt rows zeroed
+        def iou_one(g, p):
+            lt = jnp.maximum(g[:, None, :2], p[None, :, :2])
+            rb = jnp.minimum(g[:, None, 2:], p[None, :, 2:])
+            wh = jnp.maximum(rb - lt, 0.0)
+            inter = wh[..., 0] * wh[..., 1]
+            ag = jnp.maximum((g[:, 2] - g[:, 0]) * (g[:, 3] - g[:, 1]),
+                             0.)
+            ap = jnp.maximum((p[:, 2] - p[:, 0]) * (p[:, 3] - p[:, 1]),
+                             0.)
+            return inter / jnp.maximum(
+                ag[:, None] + ap[None, :] - inter, 1e-10)
+        iou = jax.vmap(lambda g: iou_one(g, pb))(gb)
+        iou = jnp.where(valid[..., None], iou, 0.0)
+
+        midx, mdist = jax.vmap(_bipartite_match_single)(iou)
+        if match_type == 'per_prediction':
+            best_row = jnp.argmax(iou, axis=1).astype(jnp.int32)
+            best = jnp.max(iou, axis=1)
+            fill = (midx == -1) & (best >= overlap_threshold)
+            midx = jnp.where(fill, best_row, midx)
+            mdist = jnp.where(fill, best, mdist)
+        matched = midx >= 0                                # [N, P]
+        mclip = jnp.clip(midx, 0, G - 1)
+
+        # conf loss per prior vs target label (background at unmatched)
+        tgt_label = jnp.where(
+            matched,
+            jnp.take_along_axis(gl.astype(jnp.int32), mclip, axis=1),
+            background_label)
+        logp = jax.nn.log_softmax(conf, axis=-1)
+        conf_l = -jnp.take_along_axis(
+            logp, tgt_label[..., None], axis=-1)[..., 0]   # [N, P]
+
+        # hard negative mining (max_negative): per image take
+        # neg_pos_ratio * num_pos negatives with highest conf loss among
+        # priors whose match overlap < neg_overlap
+        num_pos = matched.sum(-1)                          # [N]
+        neg_cand = (~matched) & (mdist < neg_overlap)
+        neg_scores = jnp.where(neg_cand, conf_l, -jnp.inf)
+        order = jnp.argsort(-neg_scores, axis=-1)
+        rank = jnp.argsort(order, axis=-1)                 # rank per prior
+        n_neg = jnp.minimum(
+            (neg_pos_ratio * num_pos).astype(jnp.int32)
+            if sample_size is None
+            else jnp.full_like(num_pos, int(sample_size)),
+            neg_cand.sum(-1))
+        neg_sel = neg_cand & (rank < n_neg[:, None])
+        conf_w = matched.astype(loc.dtype) + neg_sel.astype(loc.dtype)
+
+        # localization smooth-L1 against encoded deltas at matched priors
+        gmat = jnp.take_along_axis(
+            gb, mclip[..., None].astype(jnp.int32), axis=1)  # [N, P, 4]
+        pw_ = pb[:, 2] - pb[:, 0]
+        ph_ = pb[:, 3] - pb[:, 1]
+        pcx = (pb[:, 0] + pb[:, 2]) / 2
+        pcy = (pb[:, 1] + pb[:, 3]) / 2
+        gw = gmat[..., 2] - gmat[..., 0]
+        gh = gmat[..., 3] - gmat[..., 1]
+        gcx = (gmat[..., 0] + gmat[..., 2]) / 2
+        gcy = (gmat[..., 1] + gmat[..., 3]) / 2
+        if pv is not None:
+            v0, v1, v2, v3 = (pv[:, 0], pv[:, 1], pv[:, 2], pv[:, 3])
+        else:
+            v0, v1, v2, v3 = variance
+        enc = jnp.stack([
+            (gcx - pcx[None, :]) / pw_[None, :] / v0,
+            (gcy - pcy[None, :]) / ph_[None, :] / v1,
+            jnp.log(jnp.maximum(gw / pw_[None, :], 1e-10)) / v2,
+            jnp.log(jnp.maximum(gh / ph_[None, :], 1e-10)) / v3], -1)
+        diff = loc - enc
+        ad = jnp.abs(diff)
+        sl1 = jnp.where(ad < 1.0, 0.5 * ad * ad, ad - 0.5).sum(-1)
+        loc_l = sl1 * matched.astype(loc.dtype)            # [N, P]
+
+        total = conf_loss_weight * conf_l * conf_w \
+            + loc_loss_weight * loc_l
+        if normalize:
+            denom = jnp.maximum(num_pos.sum().astype(loc.dtype), 1.0)
+            total = total / denom
+        return total[..., None]
+
+    tens = [location, confidence, gt_box, gt_label, prior_box] + \
+        ([var] if var is not None else [])
+    return run_op('ssd_loss', fn, tens, n_nondiff=len(tens) - 2)
+
+
+# ---------------------------------------------------------------------------
+# label-generation ops (host-side data prep, wave 2b)
+# ---------------------------------------------------------------------------
+
+def _np_overlaps(a, b):
+    """+1-convention IOU matrix (oracle _bbox_overlaps)."""
+    w1 = np.maximum(a[:, 2] - a[:, 0] + 1, 0)
+    h1 = np.maximum(a[:, 3] - a[:, 1] + 1, 0)
+    w2 = np.maximum(b[:, 2] - b[:, 0] + 1, 0)
+    h2 = np.maximum(b[:, 3] - b[:, 1] + 1, 0)
+    area1 = w1 * h1
+    area2 = w2 * h2
+    ix = np.maximum(
+        np.minimum(a[:, None, 2], b[None, :, 2])
+        - np.maximum(a[:, None, 0], b[None, :, 0]) + 1, 0)
+    iy = np.maximum(
+        np.minimum(a[:, None, 3], b[None, :, 3])
+        - np.maximum(a[:, None, 1], b[None, :, 1]) + 1, 0)
+    inter = ix * iy
+    return inter / np.maximum(area1[:, None] + area2[None, :] - inter,
+                              1e-10)
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd=None, im_info=None,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True,
+                      gt_num=None):
+    """rpn_target_assign_op.cc (oracle: test_rpn_target_assign_op.py
+    rpn_target_assign): sample an RPN minibatch per image — anchors with
+    max-overlap-per-gt or IOU >= positive_overlap become foreground
+    (capped at fg_fraction * batch_size, random subsample), anchors with
+    IOU < negative_overlap fill the background quota.
+
+    Host-side data-prep op (sampling + data-dependent sizes — same
+    disposition as the recsys tier): returns
+    (predicted_scores [S, 1], predicted_location [L, 4],
+     target_label [S, 1], target_bbox [L, 4],
+     bbox_inside_weight [L, 4]) gathered over the batch, with anchor
+    indices offset per image. gt_boxes [N, G, 4] dense (+ optional
+    gt_num lengths); straddle filtering needs im_info [N, 3]."""
+    from ..ops.recsys import _host_only
+    _host_only('rpn_target_assign')
+    bp = np.asarray(as_tensor(bbox_pred).data)     # [N, A, 4]
+    cl = np.asarray(as_tensor(cls_logits).data)    # [N, A, 1]
+    an = np.asarray(as_tensor(anchor_box).data)    # [A, 4]
+    gbs = np.asarray(as_tensor(gt_boxes).data)     # [N, G, 4]
+    N, A = bp.shape[0], an.shape[0]
+    gn = (np.asarray(as_tensor(gt_num).data).reshape(-1).astype(int)
+          if gt_num is not None else None)
+    im = (np.asarray(as_tensor(im_info).data)
+          if im_info is not None else None)
+    crowd_all = (np.asarray(as_tensor(is_crowd).data)
+                 if is_crowd is not None else None)
+
+    scores, locs, labels, tboxes, inw = [], [], [], [], []
+    for b in range(N):
+        g = gbs[b][:gn[b]] if gn is not None else gbs[b]
+        keep = (g[:, 2] - g[:, 0]) * (g[:, 3] - g[:, 1]) > 0
+        if crowd_all is not None:
+            # crowd regions are excluded from fg/bg assignment entirely
+            cr = crowd_all[b].reshape(-1)[:len(g)].astype(bool)
+            keep = keep & ~cr
+        g = g[keep]
+        if rpn_straddle_thresh >= 0 and im is not None:
+            h, w = im[b, 0], im[b, 1]
+            inside = np.where(
+                (an[:, 0] >= -rpn_straddle_thresh)
+                & (an[:, 1] >= -rpn_straddle_thresh)
+                & (an[:, 2] < w + rpn_straddle_thresh)
+                & (an[:, 3] < h + rpn_straddle_thresh))[0]
+        else:
+            inside = np.arange(A)
+        iou = _np_overlaps(an[inside], g) if len(g) else \
+            np.zeros((len(inside), 1))
+        a2g = iou.argmax(1)
+        a2g_max = iou.max(1) if len(g) else np.zeros(len(inside))
+        g_max = iou.max(0) if len(g) else np.zeros(0)
+        lab = -np.ones(len(inside), np.int32)
+        if len(g):
+            lab[np.where(iou == g_max)[0]] = 1
+        lab[a2g_max >= rpn_positive_overlap] = 1
+        num_fg = int(rpn_fg_fraction * rpn_batch_size_per_im)
+        fg = np.where(lab == 1)[0]
+        if len(fg) > num_fg:
+            off = (np.random.choice(fg, len(fg) - num_fg, replace=False)
+                   if use_random else fg[num_fg:])
+            lab[off] = -1
+        fg = np.where(lab == 1)[0]
+        num_bg = rpn_batch_size_per_im - len(fg)
+        bg = np.where(a2g_max < rpn_negative_overlap)[0]
+        if len(bg) > num_bg:
+            bg = (bg[np.random.randint(len(bg), size=num_bg)]
+                  if use_random else bg[:num_bg])
+        lab[bg] = np.where(lab[bg] == 1, lab[bg], 0)
+        fg = np.where(lab == 1)[0]
+        bgs = np.where(lab == 0)[0]
+        loc_i = inside[fg]
+        sc_i = inside[np.concatenate([fg, bgs])]
+        scores.append(cl[b].reshape(A, -1)[sc_i])
+        locs.append(bp[b][loc_i])
+        labels.append(lab[np.concatenate([fg, bgs])][:, None])
+        t = g[a2g[fg]] if len(g) else np.zeros((0, 4), an.dtype)
+        tboxes.append(t)
+        inw.append(np.ones((len(fg), 4), np.float32))
+
+    import jax.numpy as _jnp
+    return tuple(Tensor(_jnp.asarray(np.concatenate(x)))
+                 for x in (scores, locs, labels, tboxes, inw))
+
+
+def _box_to_delta(ex, gt, weights):
+    """oracle _box_to_delta (+1 convention, weighted)."""
+    ex_w = ex[:, 2] - ex[:, 0] + 1
+    ex_h = ex[:, 3] - ex[:, 1] + 1
+    ex_cx = ex[:, 0] + 0.5 * ex_w
+    ex_cy = ex[:, 1] + 0.5 * ex_h
+    gt_w = gt[:, 2] - gt[:, 0] + 1
+    gt_h = gt[:, 3] - gt[:, 1] + 1
+    gt_cx = gt[:, 0] + 0.5 * gt_w
+    gt_cy = gt[:, 1] + 0.5 * gt_h
+    dx = (gt_cx - ex_cx) / ex_w / weights[0]
+    dy = (gt_cy - ex_cy) / ex_h / weights[1]
+    dw = np.log(gt_w / ex_w) / weights[2]
+    dh = np.log(gt_h / ex_h) / weights[3]
+    return np.stack([dx, dy, dw, dh], 1)
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info, batch_size_per_im=256,
+                             fg_fraction=0.25, fg_thresh=0.5,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=None, use_random=True,
+                             is_cls_agnostic=False, rois_num=None,
+                             gt_num=None):
+    """generate_proposal_labels_op.cc (oracle:
+    test_generate_proposal_labels_op.py _sample_rois): sample a Fast
+    R-CNN head minibatch from proposals + gt — fg above fg_thresh (at
+    most fg_fraction * batch), bg in [bg_thresh_lo, bg_thresh_hi),
+    per-class expanded smooth-L1 targets.
+
+    Host-side data-prep op (random subsampling, per-image variable
+    counts). Dense contract: rpn_rois [R, 4] + rois_num [N], gt arrays
+    [N, G, .] + gt_num. Returns (rois [S, 4], labels_int32 [S, 1],
+    bbox_targets [S, 4C], bbox_inside_weights, bbox_outside_weights,
+    lengths [N])."""
+    from ..ops.recsys import _host_only
+    _host_only('generate_proposal_labels')
+    rois_all = np.asarray(as_tensor(rpn_rois).data)
+    gcls = np.asarray(as_tensor(gt_classes).data)
+    crowd = np.asarray(as_tensor(is_crowd).data)
+    gbs = np.asarray(as_tensor(gt_boxes).data)
+    im = np.asarray(as_tensor(im_info).data)
+    N = gbs.shape[0]
+    C = int(class_nums)
+    rn = (np.asarray(as_tensor(rois_num).data).reshape(-1).astype(int)
+          if rois_num is not None
+          else np.full(N, len(rois_all) // N, int))
+    gn = (np.asarray(as_tensor(gt_num).data).reshape(-1).astype(int)
+          if gt_num is not None else np.full(N, gbs.shape[1], int))
+    r_off = np.concatenate([[0], np.cumsum(rn)[:-1]])
+
+    out_rois, out_lab, out_tgt, out_inw, out_onw, lens = \
+        [], [], [], [], [], []
+    for b in range(N):
+        rois = rois_all[r_off[b]:r_off[b] + rn[b]]
+        g = gbs[b][:gn[b]]
+        gc = gcls[b].reshape(-1)[:gn[b]]
+        cr = crowd[b].reshape(-1)[:gn[b]].astype(bool)
+        im_scale = im[b, 2]
+        boxes = np.vstack([g, rois / im_scale])
+        gt_ov = np.zeros((len(boxes), C))
+        b2g = np.zeros(len(boxes), np.int32)
+        if len(g):
+            ov = _np_overlaps(boxes, g)
+            amax, omax = ov.argmax(1), ov.max(1)
+            nz = np.where(omax > 0)[0]
+            gt_ov[nz, gc[amax[nz]].astype(int)] = omax[nz]
+            b2g[nz] = amax[nz]
+            gt_ov[np.where(cr)[0]] = -1.0
+        mo = gt_ov.max(1)
+        mc = gt_ov.argmax(1)
+        fg_per = int(np.round(fg_fraction * batch_size_per_im))
+        fg = np.where(mo >= fg_thresh)[0]
+        n_fg = min(fg_per, len(fg))
+        if len(fg) > n_fg and use_random:
+            fg = np.random.choice(fg, n_fg, replace=False)
+        fg = fg[:n_fg]
+        bg = np.where((mo < bg_thresh_hi) & (mo >= bg_thresh_lo))[0]
+        n_bg = min(batch_size_per_im - n_fg, len(bg))
+        if len(bg) > n_bg and use_random:
+            bg = np.random.choice(bg, n_bg, replace=False)
+        bg = bg[:n_bg]
+        keep = np.append(fg, bg)
+        lab = mc[keep]
+        lab[n_fg:] = 0
+        sb = boxes[keep]
+        sg = g[b2g[keep]] if len(g) else np.zeros_like(sb)
+        if len(g):
+            sg[n_fg:] = g[0]
+        deltas = _box_to_delta(sb, sg, bbox_reg_weights) \
+            if len(g) else np.zeros_like(sb)
+        tgt = np.zeros((len(keep), 4 * C), np.float32)
+        inw = np.zeros_like(tgt)
+        for i, l in enumerate(lab):
+            if l > 0:
+                c = 1 if is_cls_agnostic else int(l)
+                tgt[i, 4 * c:4 * c + 4] = deltas[i]
+                inw[i, 4 * c:4 * c + 4] = 1.0
+        out_rois.append(sb * im_scale)
+        out_lab.append(lab[:, None].astype(np.int32))
+        out_tgt.append(tgt)
+        out_inw.append(inw)
+        out_onw.append((inw > 0).astype(np.float32))
+        lens.append(len(keep))
+
+    import jax.numpy as _jnp
+    outs = [np.concatenate(x) for x in
+            (out_rois, out_lab, out_tgt, out_inw, out_onw)]
+    return tuple(Tensor(_jnp.asarray(o)) for o in outs) + \
+        (Tensor(_jnp.asarray(np.asarray(lens, np.int32))),)
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         labels_int32, num_classes, resolution,
+                         rois_num=None, gt_num=None):
+    """generate_mask_labels_op.cc: build Mask R-CNN head targets — for
+    each foreground roi, crop its matched instance mask and resize to
+    resolution^2, expanded per class.
+
+    Host-side data-prep op. Deviation from the reference's COCO polygon
+    format: `gt_segms` takes dense binary masks [N, G, H, W] (polygon
+    rasterization belongs to the dataset layer under this framework's
+    zero-egress datasets). Returns (mask_rois [S, 4], roi_has_mask_int32
+    [S, 1], mask_int32 [S, num_classes * resolution^2], lengths [N])."""
+    from ..ops.recsys import _host_only
+    _host_only('generate_mask_labels')
+    im = np.asarray(as_tensor(im_info).data)
+    gcls = np.asarray(as_tensor(gt_classes).data)
+    segms = np.asarray(as_tensor(gt_segms).data)
+    rois_all = np.asarray(as_tensor(rois).data)
+    labs = np.asarray(as_tensor(labels_int32).data).reshape(-1)
+    N = segms.shape[0]
+    R = int(resolution)
+    rn = (np.asarray(as_tensor(rois_num).data).reshape(-1).astype(int)
+          if rois_num is not None
+          else np.full(N, len(rois_all) // N, int))
+    gn = (np.asarray(as_tensor(gt_num).data).reshape(-1).astype(int)
+          if gt_num is not None else np.full(N, segms.shape[1], int))
+    r_off = np.concatenate([[0], np.cumsum(rn)[:-1]])
+
+    crowd_all = np.asarray(as_tensor(is_crowd).data)
+    out_rois, out_has, out_mask, lens = [], [], [], []
+    for b in range(N):
+        rois_b = rois_all[r_off[b]:r_off[b] + rn[b]]
+        labs_b = labs[r_off[b]:r_off[b] + rn[b]]
+        g_masks = segms[b][:gn[b]]
+        gc = gcls[b].reshape(-1)[:gn[b]].astype(int)
+        cr = crowd_all[b].reshape(-1)[:gn[b]].astype(bool)
+        im_scale = im[b, 2]
+        fg = np.where(labs_b > 0)[0]
+        if len(fg) == 0 or gn[b] == 0:
+            lens.append(0)
+            continue
+        gt_boxes_b = []
+        for m in g_masks:
+            ys, xs = np.where(m > 0)
+            if len(xs) == 0:
+                gt_boxes_b.append([0, 0, 0, 0])
+            else:
+                gt_boxes_b.append([xs.min(), ys.min(), xs.max(),
+                                   ys.max()])
+        gt_boxes_b = np.asarray(gt_boxes_b, np.float32)
+        n_fg_used = 0
+        for i in fg:
+            roi = rois_b[i] / im_scale
+            cls = int(labs_b[i])
+            # match only non-crowd gts OF THE ROI'S CLASS (the
+            # reference restricts candidates the same way)
+            cand = np.where((gc == cls) & ~cr)[0]
+            if len(cand) == 0:
+                continue
+            ov = _np_overlaps(roi[None], gt_boxes_b[cand])[0]
+            gi = int(cand[ov.argmax()])
+            x1, y1, x2, y2 = roi
+            H, W = g_masks.shape[1:]
+            x1i = int(np.clip(np.floor(x1), 0, W - 1))
+            y1i = int(np.clip(np.floor(y1), 0, H - 1))
+            x2i = int(np.clip(np.ceil(x2), x1i + 1, W))
+            y2i = int(np.clip(np.ceil(y2), y1i + 1, H))
+            crop = g_masks[gi][y1i:y2i, x1i:x2i].astype(np.float32)
+            # nearest-neighbor resize to [R, R]
+            yy = np.clip((np.arange(R) + 0.5) * crop.shape[0] / R, 0,
+                         crop.shape[0] - 1).astype(int)
+            xx = np.clip((np.arange(R) + 0.5) * crop.shape[1] / R, 0,
+                         crop.shape[1] - 1).astype(int)
+            m = (crop[yy][:, xx] > 0.5).astype(np.int32)
+            full = -np.ones((num_classes, R * R), np.int32)
+            full[cls] = m.reshape(-1)
+            out_rois.append(rois_b[i])
+            out_has.append([1])
+            out_mask.append(full.reshape(-1))
+            n_fg_used += 1
+        lens.append(n_fg_used)
+
+    import jax.numpy as _jnp
+    R2 = int(resolution) ** 2
+    rois_np = (np.asarray(out_rois, np.float32) if out_rois
+               else np.zeros((0, 4), np.float32))
+    has_np = (np.asarray(out_has, np.int32) if out_has
+              else np.zeros((0, 1), np.int32))
+    mask_np = (np.asarray(out_mask, np.int32) if out_mask
+               else np.zeros((0, num_classes * R2), np.int32))
+    return (Tensor(_jnp.asarray(rois_np)),
+            Tensor(_jnp.asarray(has_np)),
+            Tensor(_jnp.asarray(mask_np)),
+            Tensor(_jnp.asarray(np.asarray(lens, np.int32))))

@@ -1,0 +1,407 @@
+"""Wave-4 detection-tail ops vs numpy oracles (reference semantics:
+test_yolov3_loss_op.py, test_prroi_pool_op.py,
+test_box_decoder_and_assign_op.py, test_target_assign_op.py,
+test_retinanet_detection_output.py, fluid/layers/detection.py
+sigmoid_focal_loss:475)."""
+import numpy as np
+import pytest
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.vision import detection as det
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def test_sigmoid_focal_loss_oracle():
+    rng = np.random.RandomState(0)
+    N, C = 12, 6
+    x = rng.randn(N, C).astype(np.float32)
+    label = rng.randint(0, C + 1, (N, 1)).astype(np.int32)
+    fg = np.array([4], np.int32)
+    out = np.asarray(det.sigmoid_focal_loss(
+        Tensor(x), Tensor(label), Tensor(fg), gamma=2.0,
+        alpha=0.25).data)
+    s = _sigmoid(x)
+    want = np.zeros((N, C), np.float32)
+    for i in range(N):
+        for j in range(C):
+            if j + 1 == label[i, 0]:
+                want[i, j] = -0.25 * (1 - s[i, j]) ** 2 \
+                    * np.log(s[i, j]) / 4
+            else:
+                want[i, j] = -0.75 * s[i, j] ** 2 \
+                    * np.log(1 - s[i, j]) / 4
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-6)
+
+
+def test_sigmoid_focal_loss_grad():
+    rng = np.random.RandomState(1)
+    x = Tensor(rng.randn(4, 3).astype(np.float32))
+    x.stop_gradient = False
+    lab = Tensor(rng.randint(0, 4, (4, 1)).astype(np.int32))
+    out = det.sigmoid_focal_loss(x, lab, Tensor(np.array([2], np.int32)))
+    out.sum().backward()
+    assert np.isfinite(np.asarray(x.grad.data)).all()
+
+
+def test_target_assign_oracle():
+    rng = np.random.RandomState(2)
+    B, P, K = 3, 20, 4
+    gt_counts = [2, 3, 1]
+    R = sum(gt_counts)
+    enc = rng.rand(R, P, K).astype(np.float32)
+    mi = -np.ones((B, P), np.int32)
+    offs = np.concatenate([[0], np.cumsum(gt_counts)[:-1]])
+    for b in range(B):
+        ids = rng.choice(P, gt_counts[b], replace=False)
+        mi[b, ids] = np.arange(gt_counts[b])
+    out, w = det.target_assign(Tensor(enc), Tensor(mi),
+                               input_lod=gt_counts, mismatch_value=0)
+    o, wv = np.asarray(out.data), np.asarray(w.data)
+    for b in range(B):
+        for p in range(P):
+            if mi[b, p] >= 0:
+                np.testing.assert_allclose(
+                    o[b, p], enc[offs[b] + mi[b, p], p], rtol=1e-6)
+                assert wv[b, p, 0] == 1.0
+            else:
+                assert (o[b, p] == 0).all() and wv[b, p, 0] == 0.0
+
+
+def test_target_assign_negative_indices():
+    B, P = 2, 10
+    enc = np.ones((2, P, 1), np.float32)
+    mi = -np.ones((B, P), np.int32)
+    mi[0, 3] = 0
+    mi[1, 7] = 0
+    neg = np.array([[1], [2], [5]], np.int32)
+    out, w = det.target_assign(
+        Tensor(enc), Tensor(mi), negative_indices=Tensor(neg),
+        neg_lod=[2, 1], input_lod=[1, 1], mismatch_value=-1)
+    wv = np.asarray(w.data)[..., 0]
+    assert wv[0, 1] == 1.0 and wv[0, 2] == 1.0 and wv[1, 5] == 1.0
+    assert wv[0, 3] == 1.0 and wv[1, 7] == 1.0
+    assert wv[0, 5] == 0.0
+
+
+def test_box_decoder_and_assign_oracle():
+    rng = np.random.RandomState(3)
+    R, C = 10, 5
+    prior = np.abs(rng.rand(R, 4).astype(np.float32)) * 10
+    prior[:, 2:] += prior[:, :2] + 2
+    var = np.array([0.1, 0.1, 0.2, 0.2], np.float32)
+    deltas = rng.randn(R, C * 4).astype(np.float32) * 0.3
+    score = rng.rand(R, C).astype(np.float32)
+    clip = 4.135
+    dec, assign = det.box_decoder_and_assign(
+        Tensor(prior), Tensor(var), Tensor(deltas), Tensor(score), clip)
+    # numpy oracle (test_box_decoder_and_assign_op.py)
+    w = prior[:, 2] - prior[:, 0] + 1.0
+    h = prior[:, 3] - prior[:, 1] + 1.0
+    cx = prior[:, 0] + 0.5 * w
+    cy = prior[:, 1] + 0.5 * h
+    dx = deltas[:, 0::4] * var[0]
+    dy = deltas[:, 1::4] * var[1]
+    dw = np.minimum(deltas[:, 2::4] * var[2], clip)
+    dh = np.minimum(deltas[:, 3::4] * var[3], clip)
+    pcx = dx * w[:, None] + cx[:, None]
+    pcy = dy * h[:, None] + cy[:, None]
+    pw = np.exp(dw) * w[:, None]
+    ph = np.exp(dh) * h[:, None]
+    want = np.zeros_like(deltas)
+    want[:, 0::4] = pcx - 0.5 * pw
+    want[:, 1::4] = pcy - 0.5 * ph
+    want[:, 2::4] = pcx + 0.5 * pw - 1
+    want[:, 3::4] = pcy + 0.5 * ph - 1
+    np.testing.assert_allclose(np.asarray(dec.data), want, rtol=1e-4)
+    av = np.asarray(assign.data)
+    for r in range(R):
+        rank = np.argsort(-score[r])
+        best = rank[0] if rank[0] != 0 else rank[1]
+        np.testing.assert_allclose(av[r], want[r, best * 4:best * 4 + 4],
+                                   rtol=1e-4)
+
+
+def _py_prroi_pool(x, rois, batch_idx, scale, ph, pw):
+    """Exact integral of bilinear interpolation (PyPrRoIPool semantics)."""
+    def cdf(t):
+        t = np.clip(t, -1.0, 1.0)
+        return np.where(t <= 0, 0.5 * (t + 1) ** 2,
+                        0.5 + t - 0.5 * t * t)
+
+    R = rois.shape[0]
+    C, H, W = x.shape[1:]
+    out = np.zeros((R, C, ph, pw), np.float64)
+    for r in range(R):
+        x1, y1, x2, y2 = rois[r] * scale
+        for i in range(ph):
+            for j in range(pw):
+                ax = x1 + (x2 - x1) * j / pw
+                bx = x1 + (x2 - x1) * (j + 1) / pw
+                ay = y1 + (y2 - y1) * i / ph
+                by = y1 + (y2 - y1) * (i + 1) / ph
+                wx = cdf(bx - np.arange(W)) - cdf(ax - np.arange(W))
+                wy = cdf(by - np.arange(H)) - cdf(ay - np.arange(H))
+                area = max((bx - ax), 1e-9) * max((by - ay), 1e-9)
+                out[r, :, i, j] = np.einsum(
+                    'h,chw,w->c', wy, x[batch_idx[r]], wx) / area
+    return out
+
+
+def test_prroi_pool_oracle():
+    rng = np.random.RandomState(4)
+    x = rng.rand(2, 3, 12, 16).astype(np.float32)
+    rois = np.array([[2.0, 2.0, 20.0, 16.0],
+                     [4.0, 4.0, 28.0, 20.0],
+                     [0.0, 0.0, 30.0, 22.0]], np.float32)
+    rois_num = np.array([2, 1], np.int32)
+    out = det.prroi_pool(Tensor(x), Tensor(rois), spatial_scale=0.5,
+                         pooled_height=4, pooled_width=4,
+                         rois_num=Tensor(rois_num))
+    want = _py_prroi_pool(x, rois, [0, 0, 1], 0.5, 4, 4)
+    np.testing.assert_allclose(np.asarray(out.data), want, rtol=1e-3,
+                               atol=1e-5)
+
+
+def test_prroi_pool_grad():
+    rng = np.random.RandomState(5)
+    x = Tensor(rng.rand(1, 2, 8, 8).astype(np.float32))
+    x.stop_gradient = False
+    rois = Tensor(np.array([[0.0, 0.0, 7.0, 7.0]], np.float32))
+    out = det.prroi_pool(x, rois, 1.0, 2, 2)
+    out.sum().backward()
+    g = np.asarray(x.grad.data)
+    assert np.isfinite(g).all() and (np.abs(g) > 0).any()
+
+
+def test_retinanet_detection_output_runs():
+    rng = np.random.RandomState(6)
+    L, A, C = 2, 16, 4
+    boxes = [Tensor(rng.randn(A, 4).astype(np.float32) * 0.1)
+             for _ in range(L)]
+    scores = [Tensor(_sigmoid(rng.randn(A, C)).astype(np.float32) * 0.5)
+              for _ in range(L)]
+    anch = []
+    for _ in range(L):
+        a = rng.rand(A, 4).astype(np.float32) * 50
+        a[:, 2:] += a[:, :2] + 8
+        anch.append(Tensor(a))
+    im_info = Tensor(np.array([128.0, 128.0, 1.0], np.float32))
+    rows, count = det.retinanet_detection_output(
+        boxes, scores, anch, im_info, score_threshold=0.05,
+        nms_top_k=100, keep_top_k=10, nms_threshold=0.3)
+    r = np.asarray(rows.data)
+    n = int(count.data)
+    assert r.shape == (10, 6)
+    assert 0 < n <= 10
+    valid = r[:n]
+    assert (valid[:, 0] >= 1).all()                  # 1-based labels
+    assert (valid[:, 2] <= valid[:, 4] + 1e-3).all()
+    assert (r[n:, 0] == -1).all()
+
+
+def test_locality_aware_nms_merges_adjacent():
+    # two nearly-identical boxes merge (scores add), one distant survives
+    boxes = np.array([[[0., 0., 10., 10.],
+                       [0.5, 0.5, 10.5, 10.5],
+                       [50., 50., 60., 60.]]], np.float32)
+    scores = np.array([[[0.6, 0.8, 0.9]]], np.float32)
+    rows, count = det.locality_aware_nms(
+        Tensor(boxes), Tensor(scores), score_threshold=0.1,
+        nms_threshold=0.3, keep_top_k=5)
+    r = np.asarray(rows.data)[0]
+    n = int(np.asarray(count.data)[0])
+    assert n == 2
+    got_scores = sorted(r[:n, 1].tolist(), reverse=True)
+    # merged pair carries the SUMMED score 1.4
+    assert abs(got_scores[0] - 1.4) < 1e-5
+    assert abs(got_scores[1] - 0.9) < 1e-5
+    merged = r[np.argmax(r[:, 1])]
+    # merged box is the score-weighted average of the pair
+    want = (boxes[0, 0] * 0.6 + boxes[0, 1] * 0.8) / 1.4
+    np.testing.assert_allclose(merged[2:], want, rtol=1e-5)
+
+
+def test_detection_output_composes():
+    rng = np.random.RandomState(7)
+    N, P, C = 2, 8, 3
+    loc = Tensor(rng.randn(N, P, 4).astype(np.float32) * 0.1)
+    prior = np.abs(rng.rand(P, 4).astype(np.float32)) * 0.5
+    prior[:, 2:] += prior[:, :2] + 0.2
+    var = np.full((P, 4), 0.1, np.float32)
+    sc = np.abs(rng.rand(N, P, C).astype(np.float32))
+    sc /= sc.sum(-1, keepdims=True)
+    out, idx, cnt = det.detection_output(
+        loc, Tensor(sc), Tensor(prior), Tensor(var),
+        score_threshold=0.01, keep_top_k=10)
+    o = np.asarray(out.data)
+    assert o.shape == (N, 10, 6)
+    assert (np.asarray(cnt.data) >= 0).all()
+
+
+def _yolo_oracle(x, gtbox, gtlabel, gtscore, attrs):
+    """test_yolov3_loss_op.py YOLOv3Loss, trimmed to loss-only."""
+    from scipy.special import expit
+
+    def sce(v, label):
+        sig = expit(v)
+        return -label * np.log(sig) - (1 - label) * np.log(1 - sig)
+
+    def batch_xywh_box_iou(box1, box2):
+        b1l = box1[:, :, 0] - box1[:, :, 2] / 2
+        b1r = box1[:, :, 0] + box1[:, :, 2] / 2
+        b1t = box1[:, :, 1] - box1[:, :, 3] / 2
+        b1b = box1[:, :, 1] + box1[:, :, 3] / 2
+        b2l = box2[:, :, 0] - box2[:, :, 2] / 2
+        b2r = box2[:, :, 0] + box2[:, :, 2] / 2
+        b2t = box2[:, :, 1] - box2[:, :, 3] / 2
+        b2b = box2[:, :, 1] + box2[:, :, 3] / 2
+        left = np.maximum(b1l[:, :, None], b2l[:, None, :])
+        right = np.minimum(b1r[:, :, None], b2r[:, None, :])
+        top = np.maximum(b1t[:, :, None], b2t[:, None, :])
+        bot = np.minimum(b1b[:, :, None], b2b[:, None, :])
+        iw = np.clip(right - left, 0., 1.)
+        ih = np.clip(bot - top, 0., 1.)
+        inter = iw * ih
+        a1 = (b1r - b1l) * (b1b - b1t)
+        a2 = (b2r - b2l) * (b2b - b2t)
+        return inter / (a1[:, :, None] + a2[:, None, :] - inter)
+
+    n, c, h, w = x.shape
+    b = gtbox.shape[1]
+    anchors = attrs['anchors']
+    an_num = len(anchors) // 2
+    anchor_mask = attrs['anchor_mask']
+    mask_num = len(anchor_mask)
+    class_num = attrs['class_num']
+    ignore_thresh = attrs['ignore_thresh']
+    downsample = attrs['downsample_ratio']
+    scale_x_y = attrs['scale_x_y']
+    bias_x_y = -0.5 * (scale_x_y - 1.)
+    input_size = downsample * h
+    x = x.reshape((n, mask_num, 5 + class_num, h, w)) \
+        .transpose((0, 1, 3, 4, 2))
+    loss = np.zeros((n,), np.float64)
+    smooth_w = min(1. / class_num, 1. / 40)
+    use_ls = attrs['use_label_smooth']
+    pos_l, neg_l = (1 - smooth_w, smooth_w) if use_ls else (1., 0.)
+
+    pred_box = x[:, :, :, :, :4].copy()
+    gx = np.tile(np.arange(w).reshape(1, w), (h, 1))
+    gy = np.tile(np.arange(h).reshape(h, 1), (1, w))
+    pred_box[..., 0] = (gx + expit(pred_box[..., 0]) * scale_x_y
+                        + bias_x_y) / w
+    pred_box[..., 1] = (gy + expit(pred_box[..., 1]) * scale_x_y
+                        + bias_x_y) / h
+    mask_anchors = [(anchors[2 * m], anchors[2 * m + 1])
+                    for m in anchor_mask]
+    an_s = np.array([(aw / input_size, ah / input_size)
+                     for aw, ah in mask_anchors])
+    pred_box[..., 2] = np.exp(pred_box[..., 2]) \
+        * an_s[:, 0].reshape(1, mask_num, 1, 1)
+    pred_box[..., 3] = np.exp(pred_box[..., 3]) \
+        * an_s[:, 1].reshape(1, mask_num, 1, 1)
+    pred_box = pred_box.reshape((n, -1, 4))
+    pred_obj = x[:, :, :, :, 4].reshape((n, -1))
+    objness = np.zeros(pred_box.shape[:2])
+    ious = batch_xywh_box_iou(pred_box, gtbox)
+    objness = np.where(ious.max(-1) > ignore_thresh, -1., objness)
+
+    gt_shift = gtbox.copy()
+    gt_shift[:, :, :2] = 0
+    anchors_p = [(anchors[2 * i], anchors[2 * i + 1])
+                 for i in range(an_num)]
+    all_s = np.array([(aw / input_size, ah / input_size)
+                      for aw, ah in anchors_p])
+    anchor_boxes = np.concatenate([np.zeros_like(all_s), all_s], -1)
+    anchor_boxes = np.tile(anchor_boxes[None], (n, 1, 1))
+    iou2 = batch_xywh_box_iou(gt_shift, anchor_boxes)
+    matches = iou2.argmax(-1)
+    for i in range(n):
+        for j in range(b):
+            if gtbox[i, j, 2:].sum() == 0 or \
+                    matches[i, j] not in anchor_mask:
+                continue
+            an_idx = anchor_mask.index(matches[i, j])
+            gi = int(gtbox[i, j, 0] * w)
+            gj = int(gtbox[i, j, 1] * h)
+            tx = gtbox[i, j, 0] * w - gi
+            ty = gtbox[i, j, 1] * w - gj
+            tw = np.log(gtbox[i, j, 2] * input_size
+                        / mask_anchors[an_idx][0])
+            th = np.log(gtbox[i, j, 3] * input_size
+                        / mask_anchors[an_idx][1])
+            scale = (2. - gtbox[i, j, 2] * gtbox[i, j, 3]) * gtscore[i, j]
+            loss[i] += sce(x[i, an_idx, gj, gi, 0], tx) * scale
+            loss[i] += sce(x[i, an_idx, gj, gi, 1], ty) * scale
+            loss[i] += abs(x[i, an_idx, gj, gi, 2] - tw) * scale
+            loss[i] += abs(x[i, an_idx, gj, gi, 3] - th) * scale
+            objness[i, an_idx * h * w + gj * w + gi] = gtscore[i, j]
+            for li in range(class_num):
+                loss[i] += sce(
+                    x[i, an_idx, gj, gi, 5 + li],
+                    pos_l if li == gtlabel[i, j] else neg_l) \
+                    * gtscore[i, j]
+        for j in range(mask_num * h * w):
+            if objness[i, j] > 0:
+                loss[i] += sce(pred_obj[i, j], 1.0) * objness[i, j]
+            elif objness[i, j] == 0:
+                loss[i] += sce(pred_obj[i, j], 0.0)
+    return loss
+
+
+@pytest.mark.parametrize('label_smooth', [True, False])
+def test_yolov3_loss_oracle(label_smooth):
+    from scipy.special import logit
+    rng = np.random.RandomState(8)
+    attrs = {
+        'anchors': [10, 13, 16, 30, 33, 23],
+        'anchor_mask': [1, 2],
+        'class_num': 5,
+        'ignore_thresh': 0.7,
+        'downsample_ratio': 32,
+        'use_label_smooth': label_smooth,
+        'scale_x_y': 1.0,
+    }
+    n, h, w, B = 2, 5, 5, 4
+    mask_num = len(attrs['anchor_mask'])
+    x = logit(rng.uniform(0.05, 0.95,
+                          (n, mask_num * 10, h, w))).astype(np.float32)
+    gtbox = rng.random((n, B, 4)).astype(np.float32)
+    gtlabel = rng.randint(0, 5, (n, B))
+    gtmask = rng.randint(0, 2, (n, B))
+    gtbox = gtbox * gtmask[:, :, None]
+    gtlabel = (gtlabel * gtmask).astype(np.int32)
+    gtscore = rng.random((n, B)).astype(np.float32)
+
+    loss, obj, match = det.yolov3_loss(
+        Tensor(x), Tensor(gtbox), Tensor(gtlabel),
+        attrs['anchors'], attrs['anchor_mask'], attrs['class_num'],
+        attrs['ignore_thresh'], attrs['downsample_ratio'],
+        gt_score=Tensor(gtscore), use_label_smooth=label_smooth)
+    want = _yolo_oracle(x.astype(np.float64), gtbox.astype(np.float64),
+                        gtlabel, gtscore.astype(np.float64), attrs)
+    np.testing.assert_allclose(np.asarray(loss.data), want, rtol=2e-3)
+
+
+def test_yolov3_loss_grad():
+    rng = np.random.RandomState(9)
+    x = Tensor(rng.randn(1, 2 * 8, 3, 3).astype(np.float32))
+    x.stop_gradient = False
+    gtbox = Tensor(np.array([[[0.5, 0.5, 0.3, 0.4]]], np.float32))
+    gtlabel = Tensor(np.array([[1]], np.int32))
+    loss, _, _ = det.yolov3_loss(
+        x, gtbox, gtlabel, [10, 13, 16, 30], [0, 1], 3, 0.7, 32)
+    loss.sum().backward()
+    assert np.isfinite(np.asarray(x.grad.data)).all()
+
+
+def test_static_nn_detection_names_resolve():
+    from paddle_tpu.static import nn as snn
+    for n in ['sigmoid_focal_loss', 'target_assign',
+              'box_decoder_and_assign', 'prroi_pool',
+              'retinanet_detection_output', 'locality_aware_nms',
+              'detection_output', 'yolov3_loss', 'polygon_box_transform']:
+        assert callable(getattr(snn, n)), n

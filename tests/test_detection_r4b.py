@@ -1,0 +1,345 @@
+"""Wave-4 detection tail, part 2: deformable_roi_pooling vs the
+reference oracle (test_deformable_psroi_pooling.py), ssd_loss pipeline
+behavior (fluid/layers/detection.py ssd_loss), host-side label
+generation (test_rpn_target_assign_op.py,
+test_generate_proposal_labels_op.py), multi_box_head static graph."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.vision import detection as det
+
+
+def _dmc_bilinear(img, H, W, ph_, pw_):
+    hl, wl = int(np.floor(ph_)), int(np.floor(pw_))
+    hh, wh = hl + 1, wl + 1
+    lh, lw = ph_ - hl, pw_ - wl
+    hh_w, hw_w = 1 - lh, 1 - lw
+    v1 = img[hl, wl] if hl >= 0 and wl >= 0 else 0
+    v2 = img[hl, wh] if hl >= 0 and wh <= W - 1 else 0
+    v3 = img[hh, wl] if hh <= H - 1 and wl >= 0 else 0
+    v4 = img[hh, wh] if hh <= H - 1 and wh <= W - 1 else 0
+    return hh_w * hw_w * v1 + hh_w * lw * v2 + lh * hw_w * v3 \
+        + lh * lw * v4
+
+
+def _py_deform_psroi(x, rois, batch_idx, trans, no_trans, scale,
+                     out_c, group, ph, pw, part, sp, trans_std):
+    R = rois.shape[0]
+    _, C, H, W = x.shape
+    out = np.zeros((R, out_c, ph, pw))
+    for n in range(R):
+        roi = rois[n]
+        b = batch_idx[n]
+        x1 = np.round(roi[0]) * scale - 0.5
+        y1 = np.round(roi[1]) * scale - 0.5
+        x2 = np.round(roi[2] + 1) * scale - 0.5
+        y2 = np.round(roi[3] + 1) * scale - 0.5
+        rw, rh = max(x2 - x1, 0.1), max(y2 - y1, 0.1)
+        bw, bh = rw / pw, rh / ph
+        sw, sh = bw / sp, bh / sp
+        for c in range(out_c):
+            for i in range(ph):
+                for j in range(pw):
+                    part_h = int(np.floor(i) / ph * part[0])
+                    part_w = int(np.floor(j) / pw * part[1])
+                    if no_trans:
+                        tx = ty = 0.0
+                    else:
+                        tx = trans[n][0][part_h][part_w] * trans_std
+                        ty = trans[n][1][part_h][part_w] * trans_std
+                    ws = j * bw + x1 + tx * rw
+                    hs = i * bh + y1 + ty * rh
+                    gw = min(max(int(np.floor(j * group[0] / ph)), 0),
+                             group[0] - 1)
+                    gh = min(max(int(np.floor(i * group[1] / pw)), 0),
+                             group[1] - 1)
+                    cs = int((c * group[0] + gh) * group[1] + gw) \
+                        if C != out_c else c
+                    acc, cnt = 0.0, 0
+                    for iw in range(sp):
+                        for ih in range(sp):
+                            wss = ws + iw * sw
+                            hss = hs + ih * sh
+                            if wss < -0.5 or wss > W - 0.5 or \
+                                    hss < -0.5 or hss > H - 0.5:
+                                continue
+                            wss = min(max(wss, 0.), W - 1.)
+                            hss = min(max(hss, 0.), H - 1.)
+                            acc += _dmc_bilinear(x[b, cs], H, W, hss, wss)
+                            cnt += 1
+                    out[n, c, i, j] = acc / cnt if cnt else 0.0
+    return out
+
+
+@pytest.mark.parametrize('ps', [False, True])
+def test_deformable_roi_pooling_oracle(ps):
+    rng = np.random.RandomState(0)
+    group = (2, 2)
+    out_c = 3
+    C = out_c * group[0] * group[1] if ps else out_c
+    x = rng.rand(2, C, 10, 12).astype(np.float32)
+    rois = np.array([[1.0, 1.0, 16.0, 14.0],
+                     [3.0, 2.0, 20.0, 18.0]], np.float32)
+    rois_num = np.array([1, 1], np.int32)
+    ph = pw = 3
+    part = (3, 3)
+    sp = 2
+    trans = rng.rand(2, 2, part[0], part[1]).astype(np.float32)
+    out = det.deformable_roi_pooling(
+        Tensor(x), Tensor(rois), Tensor(trans), no_trans=False,
+        spatial_scale=0.5, group_size=group, pooled_height=ph,
+        pooled_width=pw, part_size=part, sample_per_part=sp,
+        trans_std=0.1, position_sensitive=ps,
+        rois_num=Tensor(rois_num))
+    want = _py_deform_psroi(x, rois, [0, 1], trans, False, 0.5, out_c,
+                            group, ph, pw, part, sp, 0.1)
+    got = np.asarray(out.data)
+    if not ps:
+        want = want[:, :C]  # non-PS keeps every channel
+        assert got.shape[1] == C
+        got_cmp, want_cmp = got[:, :out_c], want[:, :out_c]
+    else:
+        got_cmp, want_cmp = got, want
+    np.testing.assert_allclose(got_cmp, want_cmp, rtol=1e-4, atol=1e-5)
+
+
+def test_deformable_roi_pooling_grad():
+    rng = np.random.RandomState(1)
+    x = Tensor(rng.rand(1, 4, 8, 8).astype(np.float32))
+    x.stop_gradient = False
+    trans = Tensor(rng.rand(1, 2, 2, 2).astype(np.float32))
+    trans.stop_gradient = False
+    rois = Tensor(np.array([[0.0, 0.0, 7.0, 7.0]], np.float32))
+    out = det.deformable_roi_pooling(
+        x, rois, trans, spatial_scale=1.0, group_size=(2, 2),
+        pooled_height=2, pooled_width=2, part_size=(2, 2),
+        sample_per_part=2, position_sensitive=True)
+    out.sum().backward()
+    assert np.isfinite(np.asarray(x.grad.data)).all()
+    assert np.isfinite(np.asarray(trans.grad.data)).all()
+
+
+def test_ssd_loss_behavior():
+    rng = np.random.RandomState(2)
+    N, P, G, C = 2, 16, 3, 4
+    prior = np.sort(rng.rand(P, 4).astype(np.float32), axis=-1)
+    prior = np.stack([prior[:, 0], prior[:, 1],
+                      prior[:, 0] + 0.3, prior[:, 1] + 0.3], -1)
+    # gt overlapping some priors
+    gt = np.stack([prior[1], prior[5], prior[9]])[None] \
+        .repeat(N, 0).astype(np.float32)
+    gl = rng.randint(1, C, (N, G)).astype(np.int64)
+    loc = rng.randn(N, P, 4).astype(np.float32) * 0.1
+    conf = rng.randn(N, P, C).astype(np.float32)
+    out = det.ssd_loss(Tensor(loc), Tensor(conf), Tensor(gt),
+                       Tensor(gl), Tensor(prior))
+    o = np.asarray(out.data)
+    assert o.shape == (N, P, 1)
+    assert np.isfinite(o).all() and (o >= 0).all()
+    # matched priors must carry loss; faraway priors without negative
+    # selection may be zero
+    assert o.sum() > 0
+    # gradient flows to both heads
+    loc_t = Tensor(loc)
+    loc_t.stop_gradient = False
+    conf_t = Tensor(conf)
+    conf_t.stop_gradient = False
+    loss = det.ssd_loss(loc_t, conf_t, Tensor(gt), Tensor(gl),
+                        Tensor(prior))
+    loss.sum().backward()
+    assert np.abs(np.asarray(loc_t.grad.data)).sum() > 0
+    assert np.abs(np.asarray(conf_t.grad.data)).sum() > 0
+
+
+def test_ssd_loss_mining_respects_ratio():
+    # all-background image: with zero positives, loss only counts
+    # matched+mined priors -> total conf weight 0
+    N, P, G, C = 1, 8, 1, 3
+    prior = np.tile(np.array([[0.8, 0.8, 0.9, 0.9]], np.float32),
+                    (P, 1))
+    gt = np.zeros((N, G, 4), np.float32)          # invalid (zero area)
+    gl = np.zeros((N, G), np.int64)
+    loc = np.zeros((N, P, 4), np.float32)
+    conf = np.random.RandomState(3).randn(N, P, C).astype(np.float32)
+    out = np.asarray(det.ssd_loss(
+        Tensor(loc), Tensor(conf), Tensor(gt), Tensor(gl),
+        Tensor(prior)).data)
+    assert out.sum() == 0.0
+
+
+def test_rpn_target_assign_contract():
+    rng = np.random.RandomState(4)
+    N, A, G = 2, 64, 3
+    anchors = np.sort(rng.rand(A, 4).astype(np.float32) * 50, -1)
+    anchors = np.stack([anchors[:, 0], anchors[:, 1],
+                        anchors[:, 0] + 8, anchors[:, 1] + 8], -1)
+    gt = np.stack([anchors[3], anchors[17], anchors[33]])[None] \
+        .repeat(N, 0).astype(np.float32)
+    bbox_pred = rng.randn(N, A, 4).astype(np.float32)
+    cls_logits = rng.randn(N, A, 1).astype(np.float32)
+    im_info = np.tile(np.array([[100.0, 100.0, 1.0]], np.float32),
+                      (N, 1))
+    sc, lc, lab, tb, inw = det.rpn_target_assign(
+        Tensor(bbox_pred), Tensor(cls_logits), Tensor(anchors), None,
+        Tensor(gt), im_info=Tensor(im_info), rpn_batch_size_per_im=32,
+        rpn_straddle_thresh=-1, use_random=False)
+    labv = np.asarray(lab.data).reshape(-1)
+    assert set(np.unique(labv)) <= {0, 1}
+    assert (labv == 1).sum() >= 2 * G        # exact-overlap anchors fg
+    assert np.asarray(lc.data).shape == np.asarray(tb.data).shape
+    assert np.asarray(inw.data).shape == np.asarray(tb.data).shape
+    assert len(labv) == len(np.asarray(sc.data))
+
+
+def test_generate_proposal_labels_contract():
+    rng = np.random.RandomState(5)
+    N, R, G, C = 2, 40, 4, 5
+    rois = rng.rand(N * R, 4).astype(np.float32) * 60
+    rois[:, 2:] += rois[:, :2] + 5
+    gt = rng.rand(N, G, 4).astype(np.float32) * 60
+    gt[..., 2:] += gt[..., :2] + 5
+    # plant exact matches so fg sampling has candidates
+    rois[0] = gt[0, 0]
+    rois[R] = gt[1, 1]
+    gcls = rng.randint(1, C, (N, G)).astype(np.int32)
+    crowd = np.zeros((N, G), np.int32)
+    im_info = np.tile(np.array([[64.0, 64.0, 1.0]], np.float32), (N, 1))
+    out = det.generate_proposal_labels(
+        Tensor(rois), Tensor(gcls), Tensor(crowd), Tensor(gt),
+        Tensor(im_info), batch_size_per_im=16, fg_fraction=0.5,
+        fg_thresh=0.6, bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+        class_nums=C, use_random=False,
+        rois_num=Tensor(np.array([R, R], np.int32)))
+    srois, labs, tgt, inw, onw, lens = out
+    S = np.asarray(srois.data).shape[0]
+    assert S == int(np.asarray(lens.data).sum())
+    labv = np.asarray(labs.data).reshape(-1)
+    assert ((labv >= 0) & (labv < C)).all()
+    assert (labv > 0).any()                     # planted fg sampled
+    t = np.asarray(tgt.data)
+    w = np.asarray(inw.data)
+    assert t.shape == (S, 4 * C) and w.shape == t.shape
+    # targets only at the labeled class's 4-slot
+    for i in range(S):
+        nz = np.where(w[i] > 0)[0]
+        if labv[i] > 0:
+            assert set(nz) == set(range(4 * labv[i], 4 * labv[i] + 4))
+        else:
+            assert len(nz) == 0
+    np.testing.assert_array_equal(np.asarray(onw.data), w > 0)
+
+
+def test_generate_mask_labels_contract():
+    rng = np.random.RandomState(6)
+    N, G, H, W = 1, 2, 32, 32
+    masks = np.zeros((N, G, H, W), np.float32)
+    masks[0, 0, 4:16, 4:16] = 1
+    masks[0, 1, 20:30, 20:30] = 1
+    rois = np.array([[4.0, 4.0, 15.0, 15.0],
+                     [20.0, 20.0, 29.0, 29.0],
+                     [0.0, 0.0, 3.0, 3.0]], np.float32)
+    labels = np.array([2, 3, 0], np.int32)
+    gcls = np.array([[2, 3]], np.int32)
+    crowd = np.zeros((N, G), np.int32)
+    im_info = np.array([[32.0, 32.0, 1.0]], np.float32)
+    mrois, has, m, lens = det.generate_mask_labels(
+        Tensor(im_info), Tensor(gcls), Tensor(crowd), Tensor(masks),
+        Tensor(rois), Tensor(labels), num_classes=4, resolution=8,
+        rois_num=Tensor(np.array([3], np.int32)))
+    assert int(np.asarray(lens.data)[0]) == 2      # two fg rois
+    mv = np.asarray(m.data).reshape(2, 4, 64)
+    # roi 0 (class 2): fully inside its instance -> all ones there
+    assert (mv[0, 2] == 1).all()
+    assert (mv[0, 0] == -1).all()                  # other classes -1
+    assert (mv[1, 3] == 1).all()
+
+
+def test_multi_box_head_static():
+    paddle.enable_static()
+    try:
+        from paddle_tpu import static
+        from paddle_tpu.static import nn as snn
+        main, start = static.Program(), static.Program()
+        with static.program_guard(main, start):
+            f1 = static.data('f1', [2, 8, 8, 8], 'float32')
+            f2 = static.data('f2', [2, 16, 4, 4], 'float32')
+            img = static.data('img', [2, 3, 64, 64], 'float32')
+            locs, confs, boxes, vars_ = snn.multi_box_head(
+                [f1, f2], img, base_size=64, num_classes=5,
+                aspect_ratios=[[2.0], [2.0, 3.0]], min_ratio=20,
+                max_ratio=90, offset=0.5, flip=True)
+        exe = static.Executor()
+        exe.run(start)
+        rng = np.random.RandomState(7)
+        out = exe.run(main, feed={
+            'f1': rng.rand(2, 8, 8, 8).astype(np.float32),
+            'f2': rng.rand(2, 16, 4, 4).astype(np.float32),
+            'img': rng.rand(2, 3, 64, 64).astype(np.float32)},
+            fetch_list=[locs, confs, boxes, vars_])
+        P = out[2].shape[0]
+        assert out[0].shape == (2, P, 4)
+        assert out[1].shape == (2, P, 5)
+        assert out[3].shape == (P, 4)
+        assert P == out[0].shape[1]
+    finally:
+        paddle.disable_static()
+
+
+def test_rpn_target_assign_excludes_crowd():
+    rng = np.random.RandomState(8)
+    A = 32
+    anchors = np.sort(rng.rand(A, 4).astype(np.float32) * 40, -1)
+    anchors = np.stack([anchors[:, 0], anchors[:, 1],
+                        anchors[:, 0] + 8, anchors[:, 1] + 8], -1)
+    gt = np.stack([anchors[3], anchors[17]])[None].astype(np.float32)
+    crowd = np.array([[0, 1]], np.int32)      # second gt is crowd
+    _, _, lab, tb, _ = det.rpn_target_assign(
+        Tensor(rng.randn(1, A, 4).astype(np.float32)),
+        Tensor(rng.randn(1, A, 1).astype(np.float32)),
+        Tensor(anchors), None, Tensor(gt), is_crowd=Tensor(crowd),
+        rpn_batch_size_per_im=16, rpn_straddle_thresh=-1,
+        use_random=False)
+    # only the non-crowd gt's box may appear as a regression target
+    t = np.asarray(tb.data)
+    for row in t:
+        np.testing.assert_allclose(row, gt[0, 0], rtol=1e-6)
+
+
+def test_target_assign_requires_neg_lod_when_batched():
+    enc = np.ones((2, 4, 1), np.float32)
+    mi = -np.ones((2, 4), np.int32)
+    neg = np.array([[0], [1]], np.int32)
+    with pytest.raises(ValueError, match='neg_lod'):
+        det.target_assign(Tensor(enc), Tensor(mi),
+                          negative_indices=Tensor(neg), input_lod=[1, 1])
+
+
+def test_generate_mask_labels_class_aware_and_empty():
+    # roi labeled class 2 overlaps a class-3 mask more; must still take
+    # the class-2 instance
+    N, G, H, W = 1, 2, 32, 32
+    masks = np.zeros((N, G, H, W), np.float32)
+    masks[0, 0, 0:8, 0:8] = 1        # class 2 instance (small)
+    masks[0, 1, 0:28, 0:28] = 1      # class 3 instance (covers roi)
+    gcls = np.array([[2, 3]], np.int32)
+    rois = np.array([[0.0, 0.0, 20.0, 20.0]], np.float32)
+    labels = np.array([2], np.int32)
+    crowd = np.zeros((N, G), np.int32)
+    im_info = np.array([[32.0, 32.0, 1.0]], np.float32)
+    mrois, has, m, lens = det.generate_mask_labels(
+        Tensor(im_info), Tensor(gcls), Tensor(crowd), Tensor(masks),
+        Tensor(rois), Tensor(labels), num_classes=4, resolution=4,
+        rois_num=Tensor(np.array([1], np.int32)))
+    mv = np.asarray(m.data).reshape(1, 4, 16)
+    # mask comes from the class-2 instance: top-left corner on, rest off
+    assert mv[0, 2, 0] == 1 and mv[0, 2, -1] == 0
+    # all-background image -> empty but correctly-shaped outputs
+    mrois2, has2, m2, lens2 = det.generate_mask_labels(
+        Tensor(im_info), Tensor(gcls), Tensor(crowd), Tensor(masks),
+        Tensor(rois), Tensor(np.array([0], np.int32)), num_classes=4,
+        resolution=4, rois_num=Tensor(np.array([1], np.int32)))
+    assert np.asarray(mrois2.data).shape == (0, 4)
+    assert np.asarray(m2.data).shape == (0, 4 * 16)
+    assert int(np.asarray(lens2.data)[0]) == 0
