@@ -3,3 +3,6 @@ from . import optimizer
 from . import asp
 from . import checkpoint
 from .optimizer import LookAhead, ModelAverage
+
+from . import tensor
+from .tensor import (segment_sum, segment_mean, segment_max, segment_min)

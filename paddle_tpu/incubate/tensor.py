@@ -1,0 +1,75 @@
+"""paddle.incubate segment ops (operators/segment_pool_op.cc — the
+segment_pool op with SUM/MEAN/MAX/MIN pooltypes).
+
+TPU-native: jax.ops.segment_* scatter-reductions — one XLA scatter per
+call instead of the reference's sorted-range CPU/CUDA kernels.
+`segment_ids` must be sorted ascending (the reference requires the
+same); the segment count is taken from the last id + 1, so these are
+eager ops (the data-dependent output shape cannot be recorded into a
+static program — use them in the input pipeline or dygraph code).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.autograd import run_op
+from ..ops.common import as_tensor
+
+
+def _segment(data, segment_ids, kind):
+    from ..core.autograd import STATIC_RECORD_HOOK
+    if STATIC_RECORD_HOOK is not None:
+        raise NotImplementedError(
+            f"segment_{kind} has a data-dependent output shape and "
+            "cannot be recorded into a static program — call it eagerly")
+    data = as_tensor(data)
+    ids = as_tensor(segment_ids, ref=data)
+    ids_np = np.asarray(ids.data).reshape(-1)
+    if ids_np.size == 0:
+        raise ValueError("segment_ids must be non-empty")
+    if (np.diff(ids_np) < 0).any():
+        raise ValueError("segment_ids must be sorted ascending")
+    num = int(ids_np[-1]) + 1
+
+    def fn(x, sid):
+        sid = sid.reshape(-1)
+        if kind == 'sum':
+            return jax.ops.segment_sum(x, sid, num_segments=num)
+        if kind in ('max', 'min'):
+            op = jax.ops.segment_max if kind == 'max' \
+                else jax.ops.segment_min
+            out = op(x, sid, num_segments=num)
+            # empty (gap) segments: the reference's pool buffer is
+            # zero-initialized, so they yield 0 — not the scatter
+            # identity (+/-inf) jax uses
+            return jnp.where(jnp.isfinite(out), out,
+                             jnp.zeros((), x.dtype))
+        total = jax.ops.segment_sum(x, sid, num_segments=num)
+        cnt = jax.ops.segment_sum(jnp.ones_like(sid, x.dtype), sid,
+                                  num_segments=num)
+        shape = (num,) + (1,) * (x.ndim - 1)
+        return total / jnp.maximum(cnt.reshape(shape), 1)
+    return run_op(f'segment_{kind}', fn, [data, ids], n_nondiff=1)
+
+
+def segment_sum(data, segment_ids, name=None):
+    """paddle.incubate.segment_sum."""
+    return _segment(data, segment_ids, 'sum')
+
+
+def segment_mean(data, segment_ids, name=None):
+    """paddle.incubate.segment_mean."""
+    return _segment(data, segment_ids, 'mean')
+
+
+def segment_max(data, segment_ids, name=None):
+    """paddle.incubate.segment_max (empty segments yield 0 like the
+    reference's pool init, not -inf)."""
+    out = _segment(data, segment_ids, 'max')
+    return out
+
+
+def segment_min(data, segment_ids, name=None):
+    """paddle.incubate.segment_min."""
+    return _segment(data, segment_ids, 'min')

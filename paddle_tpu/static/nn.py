@@ -814,6 +814,105 @@ def search_pyramid_hash(input, num_emb, space_len, pyramid_layer, rand_len,
                            seq_lens=seq_lens, seed=seed)
 
 
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None):
+    """Parity: fluid/layers/nn.py py_func (operators/py_func_op.cc) —
+    embed a host python callable as an op in the static program. The
+    recorded op runs `func` through `jax.pure_callback` (the XLA host
+    callback — the TPU analogue of the reference's interpreter
+    re-entry), so it executes inside the one-jit Executor replay.
+
+    `out` declares the result spec: a Variable created via
+    `block.create_var(shape=..., dtype=...)`, or a (shape, dtype)
+    tuple, or a list of either. With `backward_func(x..., out...,
+    dout...) -> dx...` the op is differentiable (also via callback);
+    without it, gradients stop.
+
+    Platform note: host callbacks need PJRT send/recv — available on
+    CPU and real TPU hosts, but NOT over the axon dev tunnel
+    (axon_pjrt raises UNIMPLEMENTED). There, run the py_func program
+    eagerly or place its segment under device_guard('cpu')."""
+    import jax
+    xs = list(x) if isinstance(x, (list, tuple)) else [x]
+
+    def _is_spec(o):
+        # a single (shape, dtype) pair, e.g. ([3, 4], 'float32')
+        return (isinstance(o, tuple) and len(o) == 2
+                and isinstance(o[0], (list, tuple))
+                and isinstance(o[1], (str, np.dtype, type)))
+    if _is_spec(out) or not isinstance(out, (list, tuple)):
+        outs = [out]
+        multi_out = False
+    else:
+        outs = list(out)
+        multi_out = True
+
+    def spec_of(o):
+        if _is_spec(o):
+            shape, dt = o
+        else:
+            shape, dt = o.shape, o.dtype
+        import jax.numpy as _jnp
+        if any(d is None or int(d) < 1 for d in shape):
+            raise ValueError(
+                f"py_func out shape {tuple(shape)} has dynamic dims; "
+                "XLA host callbacks need static shapes — declare the "
+                "concrete batch size (the reference's -1 dims rely on "
+                "interpreter-side shape inference this backend "
+                "deliberately does not do)")
+        shape = tuple(int(d) for d in shape)
+        return jax.ShapeDtypeStruct(shape, _jnp.dtype(dt))
+
+    out_specs = [spec_of(o) for o in outs]
+
+    def host_fwd(*arrays):
+        res = func(*[np.asarray(a) for a in arrays])
+        res = res if isinstance(res, (list, tuple)) else [res]
+        return tuple(np.asarray(r, dtype=sp.dtype).reshape(sp.shape)
+                     for r, sp in zip(res, out_specs))
+
+    def fwd_fn(*arrays):
+        res = jax.pure_callback(host_fwd, tuple(out_specs), *arrays)
+        return tuple(res) if multi_out else res[0]
+
+    if backward_func is not None:
+        n_in = len(xs)
+
+        @jax.custom_vjp
+        def op(*arrays):
+            return fwd_fn(*arrays)
+
+        def op_fwd(*arrays):
+            o = fwd_fn(*arrays)
+            return o, (arrays, o if multi_out else (o,))
+
+        def op_bwd(res, cts):
+            arrays, os_ = res
+            cts = cts if isinstance(cts, tuple) else (cts,)
+            in_specs = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                             for a in arrays)
+
+            def host_bwd(*all_args):
+                grads = backward_func(*[np.asarray(a)
+                                        for a in all_args])
+                grads = grads if isinstance(grads, (list, tuple)) \
+                    else [grads]
+                return tuple(
+                    np.asarray(g, dtype=sp.dtype).reshape(sp.shape)
+                    for g, sp in zip(grads, in_specs))
+            return jax.pure_callback(host_bwd, in_specs,
+                                     *arrays, *os_, *cts)
+
+        op.defvjp(op_fwd, op_bwd)
+        run_fn = op
+    else:
+        run_fn = fwd_fn
+
+    from ..core.autograd import run_op as _run_op
+    return _run_op('py_func', run_fn, xs,
+                   n_nondiff=0 if backward_func is not None else len(xs))
+
+
 def multi_box_head(inputs, image, base_size, num_classes,
                    aspect_ratios, min_ratio=None, max_ratio=None,
                    min_sizes=None, max_sizes=None, steps=None,

@@ -1298,14 +1298,16 @@ def locality_aware_nms(bboxes, scores, score_threshold, nms_threshold,
             M = boxes.shape[0]
 
             def iou_pair(a, b):
+                # +1 pixel convention, matching the normalized=False
+                # greedy pass below — one convention for both passes
                 lt = jnp.maximum(a[:2], b[:2])
                 rb = jnp.minimum(a[2:], b[2:])
-                wh = jnp.maximum(rb - lt, 0.0)
+                wh = jnp.maximum(rb - lt + 1.0, 0.0)
                 inter = wh[0] * wh[1]
-                ar_a = jnp.maximum(a[2] - a[0], 0) * \
-                    jnp.maximum(a[3] - a[1], 0)
-                ar_b = jnp.maximum(b[2] - b[0], 0) * \
-                    jnp.maximum(b[3] - b[1], 0)
+                ar_a = jnp.maximum(a[2] - a[0] + 1.0, 0) * \
+                    jnp.maximum(a[3] - a[1] + 1.0, 0)
+                ar_b = jnp.maximum(b[2] - b[0] + 1.0, 0) * \
+                    jnp.maximum(b[3] - b[1] + 1.0, 0)
                 return inter / jnp.maximum(ar_a + ar_b - inter, 1e-9)
 
             # locality-aware merge scan: carry = (current box, score,
@@ -1368,9 +1370,11 @@ def detection_output(loc, scores, prior_box, prior_box_var,
     scores = as_tensor(scores, ref=loc)
     decoded = box_coder(prior_box, prior_box_var, loc,
                         code_type='decode_center_size', axis=0)
-    # [N, P, C] -> [N, C, P] for the NMS contract
+    # the reference layer softmaxes the raw conf logits itself
+    # (fluid/layers/detection.py detection_output: nn.softmax(scores))
+    from ..ops.nn_ops import softmax as _softmax
     from ..ops.manip import transpose
-    sc = transpose(scores, [0, 2, 1])
+    sc = transpose(_softmax(scores, axis=-1), [0, 2, 1])
     return multiclass_nms(decoded, sc,
                           score_threshold=score_threshold,
                           nms_top_k=nms_top_k, keep_top_k=keep_top_k,
@@ -1678,19 +1682,9 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
         valid = (gb[..., 2] - gb[..., 0]) * (gb[..., 3] - gb[..., 1]) > 0 \
             if gt_valid is None else jnp.asarray(gt_valid)
 
-        # [N, G, P] IOU, invalid gt rows zeroed
-        def iou_one(g, p):
-            lt = jnp.maximum(g[:, None, :2], p[None, :, :2])
-            rb = jnp.minimum(g[:, None, 2:], p[None, :, 2:])
-            wh = jnp.maximum(rb - lt, 0.0)
-            inter = wh[..., 0] * wh[..., 1]
-            ag = jnp.maximum((g[:, 2] - g[:, 0]) * (g[:, 3] - g[:, 1]),
-                             0.)
-            ap = jnp.maximum((p[:, 2] - p[:, 0]) * (p[:, 3] - p[:, 1]),
-                             0.)
-            return inter / jnp.maximum(
-                ag[:, None] + ap[None, :] - inter, 1e-10)
-        iou = jax.vmap(lambda g: iou_one(g, pb))(gb)
+        # [N, G, P] IOU (shared normalized-coordinate helper),
+        # invalid gt rows zeroed
+        iou = jax.vmap(lambda g: _iou_matrix(g, pb, normalized=True))(gb)
         iou = jnp.where(valid[..., None], iou, 0.0)
 
         midx, mdist = jax.vmap(_bipartite_match_single)(iou)
@@ -1858,6 +1852,8 @@ def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
         num_bg = rpn_batch_size_per_im - len(fg)
         bg = np.where(a2g_max < rpn_negative_overlap)[0]
         if len(bg) > num_bg:
+            # with-replacement draw IS the reference behavior
+            # (test_rpn_target_assign_op.py:63 uses np.random.randint)
             bg = (bg[np.random.randint(len(bg), size=num_bg)]
                   if use_random else bg[:num_bg])
         lab[bg] = np.where(lab[bg] == 1, lab[bg], 0)

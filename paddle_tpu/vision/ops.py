@@ -205,3 +205,32 @@ def __getattr__(name):
     if name == 'DeformConv2D':
         return _deform_conv_cls()
     raise AttributeError(name)
+
+
+def read_file(filename, name=None):
+    """paddle.vision.ops.read_file (operators/read_file_op.cc): raw file
+    bytes as a 1-D uint8 tensor (host IO — input-pipeline op)."""
+    with open(filename, 'rb') as f:
+        data = f.read()
+    return Tensor(jnp.asarray(np.frombuffer(data, np.uint8)))
+
+
+def decode_jpeg(x, mode='unchanged', name=None):
+    """paddle.vision.ops.decode_jpeg (operators/decode_jpeg_op.cu uses
+    nvJPEG; here PIL on host — same contract): 1-D uint8 encoded bytes →
+    uint8 [C, H, W]. mode: 'unchanged' | 'gray' | 'rgb'."""
+    import io
+    from PIL import Image
+    arr = np.asarray(x.data if isinstance(x, Tensor) else x,
+                     dtype=np.uint8)
+    img = Image.open(io.BytesIO(arr.tobytes()))
+    if mode == 'gray':
+        img = img.convert('L')
+    elif mode in ('rgb', 'RGB'):
+        img = img.convert('RGB')
+    out = np.asarray(img)
+    if out.ndim == 2:
+        out = out[None]                   # [1, H, W]
+    else:
+        out = out.transpose(2, 0, 1)      # [C, H, W]
+    return Tensor(jnp.asarray(out))

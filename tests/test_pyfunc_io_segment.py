@@ -1,0 +1,115 @@
+"""py_func host-callback op, vision IO (read_file/decode_jpeg), and
+incubate segment pooling (reference: py_func_op.cc, read_file_op.cc,
+decode_jpeg_op.cu, segment_pool_op.cc)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+def test_py_func_static_forward_and_backward():
+    paddle.enable_static()
+    try:
+        from paddle_tpu import static
+        from paddle_tpu.static import nn as snn
+        main, start = static.Program(), static.Program()
+        with static.program_guard(main, start):
+            x = static.data('x', [3, 4], 'float32')
+            x.stop_gradient = False
+
+            def double_it(a):
+                return a * 2.0
+
+            def back(a, o, do):
+                return do * 2.0
+
+            y = snn.py_func(double_it, x, ([3, 4], 'float32'),
+                            backward_func=back)
+            loss = paddle.mean(y)
+            grads = static.append_backward(loss)
+        exe = static.Executor()
+        xv = np.arange(12, dtype=np.float32).reshape(3, 4)
+        out = exe.run(main, feed={'x': xv}, fetch_list=[y, loss])
+        np.testing.assert_allclose(out[0], xv * 2, rtol=1e-6)
+        assert abs(float(out[1]) - float((xv * 2).mean())) < 1e-5
+    finally:
+        paddle.disable_static()
+
+
+def test_py_func_eager_no_grad():
+    from paddle_tpu.static import nn as snn
+    x = Tensor(np.ones((2, 2), np.float32))
+    y = snn.py_func(lambda a: a + 1, x, ([2, 2], 'float32'))
+    np.testing.assert_allclose(np.asarray(y.data), 2.0)
+
+
+def test_read_file_decode_jpeg_roundtrip():
+    from PIL import Image
+    from paddle_tpu.vision import ops as vo
+    # smooth gradient — JPEG preserves it closely (noise wouldn't be)
+    yy, xx = np.mgrid[0:16, 0:20]
+    img = np.stack([yy * 8, xx * 8, (yy + xx) * 4], -1).astype(np.uint8)
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, 'x.jpg')
+        Image.fromarray(img).save(path, quality=95)
+        raw = vo.read_file(path)
+        assert raw.data.dtype == np.uint8 and raw.data.ndim == 1
+        dec = vo.decode_jpeg(raw, mode='rgb')
+        a = np.asarray(dec.data)
+        assert a.shape == (3, 16, 20)
+        # lossy codec: content close, not exact
+        assert np.abs(a.transpose(1, 2, 0).astype(int)
+                      - img.astype(int)).mean() < 12
+        g = vo.decode_jpeg(raw, mode='gray')
+        assert np.asarray(g.data).shape == (1, 16, 20)
+
+
+def test_segment_ops():
+    data = Tensor(np.array([[1., 2.], [3., 4.], [10., 20.], [30., 40.]],
+                           np.float32))
+    ids = Tensor(np.array([0, 0, 1, 1], np.int32))
+    s = np.asarray(paddle.incubate.segment_sum(data, ids).data)
+    np.testing.assert_allclose(s, [[4., 6.], [40., 60.]])
+    m = np.asarray(paddle.incubate.segment_mean(data, ids).data)
+    np.testing.assert_allclose(m, [[2., 3.], [20., 30.]])
+    mx = np.asarray(paddle.incubate.segment_max(data, ids).data)
+    np.testing.assert_allclose(mx, [[3., 4.], [30., 40.]])
+    mn = np.asarray(paddle.incubate.segment_min(data, ids).data)
+    np.testing.assert_allclose(mn, [[1., 2.], [10., 20.]])
+
+
+def test_segment_sum_grad_and_validation():
+    data = Tensor(np.ones((4, 2), np.float32))
+    data.stop_gradient = False
+    ids = Tensor(np.array([0, 1, 1, 2], np.int32))
+    out = paddle.incubate.segment_sum(data, ids)
+    out.sum().backward()
+    np.testing.assert_allclose(np.asarray(data.grad.data), 1.0)
+    with pytest.raises(ValueError, match='sorted'):
+        paddle.incubate.segment_sum(
+            Tensor(np.ones((3, 1), np.float32)),
+            Tensor(np.array([1, 0, 2], np.int32)))
+
+
+def test_segment_max_empty_segment_yields_zero():
+    out = paddle.incubate.segment_max(
+        Tensor(np.array([[1.], [2.]], np.float32)),
+        Tensor(np.array([0, 2], np.int32)))
+    np.testing.assert_allclose(np.asarray(out.data),
+                               [[1.], [0.], [2.]])
+    out = paddle.incubate.segment_min(
+        Tensor(np.array([[1.], [2.]], np.float32)),
+        Tensor(np.array([0, 2], np.int32)))
+    np.testing.assert_allclose(np.asarray(out.data),
+                               [[1.], [0.], [2.]])
+
+
+def test_py_func_rejects_dynamic_dims():
+    from paddle_tpu.static import nn as snn
+    x = Tensor(np.ones((2, 2), np.float32))
+    with pytest.raises(ValueError, match='dynamic'):
+        snn.py_func(lambda a: a, x, ([-1, 2], 'float32'))
