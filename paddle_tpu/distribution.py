@@ -119,7 +119,56 @@ class Beta(Distribution):
                       - betaln(a, b))
 
 
+class MultivariateNormalDiag(Distribution):
+    """fluid.layers.distributions MultivariateNormalDiag
+    (distributions.py:531): loc [.., d], scale [.., d, d] with only the
+    diagonal consulted (the reference's contract)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = as_tensor(loc)
+        self.scale = as_tensor(scale)
+
+    def _diag(self):
+        d = self.scale.data
+        return jnp.diagonal(d, axis1=-2, axis2=-1) if d.ndim >= 2 else d
+
+    def sample(self, shape=(), seed=0):
+        key = rng_mod.next_key()
+        shp = tuple(shape) + tuple(self.loc.shape)
+        eps = jax.random.normal(key, shp, self.loc.data.dtype)
+        return Tensor(self.loc.data + self._diag() * eps)
+
+    def log_prob(self, value):
+        v = as_tensor(value).data
+        sig = self._diag()
+        z = (v - self.loc.data) / sig
+        return Tensor((-0.5 * z * z - jnp.log(sig)
+                       - 0.5 * math.log(2 * math.pi)).sum(-1))
+
+    def entropy(self):
+        """Reference formula (distributions.py:598): d/2 (1 + log(2π))
+        + 1/2 log det(diag(σ²))."""
+        sig = self._diag()
+        d = sig.shape[-1]
+        return Tensor(0.5 * d * (1.0 + math.log(2 * math.pi))
+                      + jnp.log(sig * sig).sum(-1) * 0.5)
+
+    def kl_divergence(self, other):
+        """Diag-Gaussian KL (reference distributions.py:616)."""
+        s1, s2 = self._diag(), other._diag()
+        var1, var2 = s1 * s1, s2 * s2
+        dmu = self.loc.data - other.loc.data
+        return Tensor(0.5 * (
+            (var1 / var2).sum(-1)
+            + (dmu * dmu / var2).sum(-1)
+            - s1.shape[-1]
+            + jnp.log(var2).sum(-1) - jnp.log(var1).sum(-1)))
+
+
 def kl_divergence(p, q):
     if isinstance(p, Normal) and isinstance(q, Normal):
+        return p.kl_divergence(q)
+    if isinstance(p, MultivariateNormalDiag) and \
+            isinstance(q, MultivariateNormalDiag):
         return p.kl_divergence(q)
     raise NotImplementedError(type(p))

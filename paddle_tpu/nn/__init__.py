@@ -40,3 +40,5 @@ from .layer.rnn import (RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN,
                         SimpleRNN, LSTM, GRU, BiRNN)
 from .clip import (ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm,
                    clip_grad_norm_)
+
+from .decode import Decoder, BeamSearchDecoder, dynamic_decode  # noqa

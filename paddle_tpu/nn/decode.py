@@ -1,0 +1,199 @@
+"""Seq2seq decoding: Decoder protocol, BeamSearchDecoder, dynamic_decode.
+
+Reference parity: python/paddle/fluid/layers/rnn.py (Decoder:~1040,
+BeamSearchDecoder:~1190, dynamic_decode:~1720) / paddle.nn.dynamic_decode.
+
+TPU-native shape discipline: beams live as one flattened [B*W, ...]
+batch through the cell (one matmul batch, no per-beam loop); the step
+loop runs eagerly with early stop on all-finished — the compiled
+one-dispatch analogue for generation-heavy serving is
+models.gpt.generate_scan (PARITY.md decode section), while this class
+mirrors the reference's modular decoder contract for seq2seq models.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops.common import as_tensor
+
+
+class Decoder:
+    """The reference's decoder contract: initialize() ->
+    (initial_inputs, initial_states, initial_finished); step() ->
+    (outputs, next_states, next_inputs, finished); optional
+    finalize()."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam search over a single-step RNN cell (fluid/layers/rnn.py
+    BeamSearchDecoder). `cell(inputs, states) -> (outputs, states)`;
+    `embedding_fn` maps token ids -> cell inputs; `output_fn` maps cell
+    outputs -> vocab logits.
+
+    Finished beams are frozen: they can only emit `end_token` at
+    log-prob 0, so their cumulative score stops changing (the
+    reference's _mask_probs). Finalize backtraces parent pointers with
+    gather_tree."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- beam/batch reshaping helpers (merge_batch_beams etc.) ----------
+    def _merge(self, x):
+        a = x.data if isinstance(x, Tensor) else x
+        return Tensor(a.reshape((-1,) + tuple(a.shape[2:])))
+
+    def _split(self, x):
+        a = x.data if isinstance(x, Tensor) else x
+        return Tensor(a.reshape((-1, self.beam_size)
+                                + tuple(a.shape[1:])))
+
+    def _expand_to_beams(self, x):
+        a = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+        tiled = jnp.repeat(a[:, None], self.beam_size, axis=1)
+        return Tensor(tiled.reshape((-1,) + tuple(a.shape[1:])))
+
+    def initialize(self, initial_cell_states):
+        states = jax.tree_util.tree_map(
+            self._expand_to_beams, initial_cell_states,
+            is_leaf=lambda t: isinstance(t, Tensor))
+        leaf = jax.tree_util.tree_leaves(states)[0]
+        BW = leaf.data.shape[0] if isinstance(leaf, Tensor) \
+            else leaf.shape[0]
+        B = BW // self.beam_size
+        self._batch = B
+        tokens = jnp.full((BW,), self.start_token, jnp.int32)
+        inputs = self.embedding_fn(Tensor(tokens)) \
+            if self.embedding_fn else Tensor(tokens)
+        # beam 0 starts live, the rest at -inf so step 1 fans out from
+        # a single hypothesis per example
+        lp = jnp.full((B, self.beam_size), -1e9, jnp.float32)
+        lp = lp.at[:, 0].set(0.0)
+        finished = jnp.zeros((B, self.beam_size), bool)
+        return inputs, {'cell': states, 'log_probs': lp,
+                        'finished': finished,
+                        'lengths': jnp.zeros((B, self.beam_size),
+                                             jnp.int32)}, finished
+
+    def step(self, time, inputs, states, **kwargs):
+        B, W = self._batch, self.beam_size
+        cell_out, next_cell = self.cell(inputs, states['cell'])
+        logits = self.output_fn(cell_out) if self.output_fn else cell_out
+        logits = logits.data if isinstance(logits, Tensor) else logits
+        V = logits.shape[-1]
+        step_lp = jax.nn.log_softmax(logits, axis=-1).reshape(B, W, V)
+
+        finished = states['finished']
+        # frozen finished beams: only end_token, at log-prob 0
+        frozen = jnp.full((V,), -1e9, step_lp.dtype) \
+            .at[self.end_token].set(0.0)
+        step_lp = jnp.where(finished[..., None], frozen[None, None, :],
+                            step_lp)
+        total = states['log_probs'][..., None] + step_lp     # [B, W, V]
+        flat = total.reshape(B, W * V)
+        scores, idx = jax.lax.top_k(flat, W)                 # [B, W]
+        parent = (idx // V).astype(jnp.int32)
+        token = (idx % V).astype(jnp.int32)
+
+        # reorder beam state by surviving parents
+        gather = (jnp.arange(B)[:, None] * W + parent).reshape(-1)
+
+        def pick(t):
+            a = t.data if isinstance(t, Tensor) else t
+            return Tensor(a[gather])
+        next_cell = jax.tree_util.tree_map(
+            pick, next_cell, is_leaf=lambda t: isinstance(t, Tensor))
+        was_done = jnp.take_along_axis(finished, parent, axis=1)
+        now_done = was_done | (token == self.end_token)
+        lengths = jnp.take_along_axis(states['lengths'], parent, axis=1)
+        lengths = jnp.where(was_done, lengths, lengths + 1)
+
+        next_inputs = self.embedding_fn(Tensor(token.reshape(-1))) \
+            if self.embedding_fn else Tensor(token.reshape(-1))
+        outputs = {'scores': Tensor(scores), 'predicted_ids':
+                   Tensor(token), 'parent_ids': Tensor(parent)}
+        next_states = {'cell': next_cell, 'log_probs': scores,
+                       'finished': now_done, 'lengths': lengths}
+        return outputs, next_states, next_inputs, now_done
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        from ..ops.contrib import gather_tree
+        ids = outputs['predicted_ids']          # [T, B, W]
+        parents = outputs['parent_ids']
+        seqs = gather_tree(ids, parents)
+        return {'scores': outputs['scores'], 'predicted_ids': seqs}, \
+            final_states
+
+    @property
+    def tracks_own_finished(self):
+        return True
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """fluid/layers/rnn.py dynamic_decode: drive decoder.step until
+    every sequence finishes or `max_step_num`; stack per-step outputs
+    along time and run decoder.finalize. Returns (outputs, final_states
+    [, sequence_lengths])."""
+    inputs, states, finished = decoder.initialize(inits)
+    outputs_per_step = []
+    step = 0
+    max_steps = int(max_step_num) if max_step_num is not None else 256
+    fin = finished.data if isinstance(finished, Tensor) else finished
+    while step < max_steps and not bool(jnp.all(fin)):
+        out, states, inputs, fin = decoder.step(step, inputs, states,
+                                                **kwargs)
+        fin = fin.data if isinstance(fin, Tensor) else fin
+        outputs_per_step.append(out)
+        step += 1
+
+    def stack(*leaves):
+        arrs = [l.data if isinstance(l, Tensor) else l for l in leaves]
+        return Tensor(jnp.stack(arrs, axis=0))     # time-major [T, ...]
+    outputs = jax.tree_util.tree_map(
+        stack, *outputs_per_step,
+        is_leaf=lambda t: isinstance(t, Tensor)) \
+        if outputs_per_step else {}
+
+    seq_len = states.get('lengths') if isinstance(states, dict) else None
+    try:
+        outputs, final_states = decoder.finalize(outputs, states,
+                                                 seq_len)
+    except NotImplementedError:
+        final_states = states
+
+    if not output_time_major:
+        def to_batch_major(t):
+            a = t.data if isinstance(t, Tensor) else t
+            if a.ndim >= 2:
+                perm = (1, 0) + tuple(range(2, a.ndim))
+                return Tensor(a.transpose(perm))
+            return Tensor(a)
+        outputs = jax.tree_util.tree_map(
+            to_batch_major, outputs,
+            is_leaf=lambda t: isinstance(t, Tensor))
+    if return_length:
+        return outputs, final_states, Tensor(seq_len) \
+            if seq_len is not None else None
+    return outputs, final_states

@@ -876,7 +876,12 @@ def py_func(func, x, out, backward_func=None,
         return tuple(res) if multi_out else res[0]
 
     if backward_func is not None:
-        n_in = len(xs)
+        skip = skip_vars_in_backward_input or []
+        skip = skip if isinstance(skip, (list, tuple)) else [skip]
+        # positions of forward inputs the reference drops from
+        # backward_func's argument list (matched by object identity)
+        skip_idx = {i for i, v in enumerate(xs)
+                    if any(v is sv for sv in skip)}
 
         @jax.custom_vjp
         def op(*arrays):
@@ -891,17 +896,28 @@ def py_func(func, x, out, backward_func=None,
             cts = cts if isinstance(cts, tuple) else (cts,)
             in_specs = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
                              for a in arrays)
+            passed = tuple(a for i, a in enumerate(arrays)
+                           if i not in skip_idx)
 
             def host_bwd(*all_args):
                 grads = backward_func(*[np.asarray(a)
                                         for a in all_args])
                 grads = grads if isinstance(grads, (list, tuple)) \
                     else [grads]
-                return tuple(
-                    np.asarray(g, dtype=sp.dtype).reshape(sp.shape)
-                    for g, sp in zip(grads, in_specs))
+                grads = list(grads)
+                # zeros for skipped inputs, in position
+                full = []
+                gi = 0
+                for i, sp in enumerate(in_specs):
+                    if i in skip_idx:
+                        full.append(np.zeros(sp.shape, sp.dtype))
+                    else:
+                        full.append(np.asarray(
+                            grads[gi], dtype=sp.dtype).reshape(sp.shape))
+                        gi += 1
+                return tuple(full)
             return jax.pure_callback(host_bwd, in_specs,
-                                     *arrays, *os_, *cts)
+                                     *passed, *os_, *cts)
 
         op.defvjp(op_fwd, op_bwd)
         run_fn = op
@@ -996,6 +1012,7 @@ def _reexport():
     nn.py / sequence_lod.py / detection.py / control_flow.py names)."""
     from ..ops import contrib as _contrib
     from ..ops import sequence as _seq
+    from . import fluid_layers as _fl
     from ..ops import creation as _cr
     from ..vision import detection as _det
     from ..vision import ops as _vops
@@ -1054,6 +1071,18 @@ def _reexport():
                 'generate_proposal_labels', 'generate_mask_labels',
                 'multi_box_head', 'deformable_roi_pooling']),
         (_cf, ['while_loop', 'cond', 'switch_case', 'case']),
+        (_fl, ['rank', 'is_empty', 'reverse', 'crop_tensor', 'pad2d',
+               'pad_constant_like', 'adaptive_pool2d', 'adaptive_pool3d',
+               'pool3d', 'lrn', 'grid_sampler', 'warpctc',
+               'ctc_greedy_decoder', 'unique_with_counts',
+               'uniform_random_batch_size_like',
+               'gaussian_random_batch_size_like', 'inplace_abn',
+               'similarity_focus', 'noam_decay', 'exponential_decay',
+               'natural_exp_decay', 'inverse_time_decay',
+               'polynomial_decay', 'piecewise_decay', 'cosine_decay',
+               'linear_lr_warmup', 'rnn', 'birnn']),
+        (_contrib, ['center_loss', 'sampled_softmax_with_cross_entropy',
+                    'ctc_align']),
         (_vops, ['roi_align', 'roi_pool']),
     ):
         for n in names:
@@ -1069,5 +1098,82 @@ def _reexport():
             g[legacy] = getattr(mod, modern)
 
 
+def _nn_aliases():
+    from .. import nn as _nnmod
+    g = globals()
+    for fluid_name, modern in (
+        ('RNNCell', 'RNNCellBase'), ('GRUCell', 'GRUCell'),
+        ('LSTMCell', 'LSTMCell'), ('BeamSearchDecoder',
+                                   'BeamSearchDecoder'),
+        ('Decoder', 'Decoder'), ('dynamic_decode', 'dynamic_decode'),
+    ):
+        if hasattr(_nnmod, modern):
+            g.setdefault(fluid_name, getattr(_nnmod, modern))
+
+
+_nn_aliases()
+del _nn_aliases
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """fluid.layers.accuracy (operators/metrics/accuracy_op.cc) —
+    top-k accuracy as a recordable op (works on symbolic Variables,
+    unlike the eager paddle.metric.accuracy helper)."""
+    import jax.numpy as _jnp
+    from ..core.autograd import run_op as _run_op
+    from ..ops.common import as_tensor as _as_t
+    inp = _as_t(input)
+    lab = _as_t(label, ref=inp)
+
+    def fn(p, l):
+        kk = min(int(k), p.shape[-1])
+        _, topi = jax.lax.top_k(p, kk)
+        hit = (topi == l.reshape(-1, 1)).any(axis=-1)
+        return hit.mean(dtype=_jnp.float32)
+    import jax
+    return _run_op('accuracy', fn, [inp, lab], n_nondiff=2)
+
+
+def auc(input, label, curve='ROC', num_thresholds=4095, topk=1,
+        slide_steps=1, name=None):
+    """fluid.layers.auc (operators/metrics/auc_op.cc) — batch ROC-AUC
+    via thresholded TP/FP histograms, recordable (the reference's
+    stateful accumulators live in the metric class for streaming use;
+    this op returns the current batch's AUC like auc_op's BatchAuc)."""
+    import jax
+    import jax.numpy as _jnp
+    from ..core.autograd import run_op as _run_op
+    from ..ops.common import as_tensor as _as_t
+    inp = _as_t(input)
+    lab = _as_t(label, ref=inp)
+    T = int(num_thresholds)
+
+    def fn(p, l):
+        pos_score = p[:, -1] if p.ndim > 1 else p
+        y = l.reshape(-1).astype(_jnp.int32)
+        bins = _jnp.clip((pos_score * T).astype(_jnp.int32), 0, T)
+        tp_h = _jnp.zeros((T + 1,), _jnp.float32).at[bins].add(
+            (y == 1).astype(_jnp.float32))
+        fp_h = _jnp.zeros((T + 1,), _jnp.float32).at[bins].add(
+            (y == 0).astype(_jnp.float32))
+        # cumulate from the top threshold down
+        tp = _jnp.cumsum(tp_h[::-1])
+        fp = _jnp.cumsum(fp_h[::-1])
+        tot_p = _jnp.maximum(tp[-1], 1.0)
+        tot_n = _jnp.maximum(fp[-1], 1.0)
+        tpr = _jnp.concatenate([_jnp.zeros((1,)), tp]) / tot_p
+        fpr = _jnp.concatenate([_jnp.zeros((1,)), fp]) / tot_n
+        return _jnp.trapezoid(tpr, fpr).astype(_jnp.float32)
+    return _run_op('auc', fn, [inp, lab], n_nondiff=2)
+
+
+def _data_alias():
+    g = globals()
+    from .program import data as _data_fn
+    g.setdefault('data', _data_fn)
+
+
+_data_alias()
+del _data_alias
 _reexport()
 del _reexport

@@ -1,0 +1,255 @@
+"""fluid.layers remainder wrappers (static/fluid_layers.py) — every name
+executes with real values and matches its documented semantics
+(reference: python/paddle/fluid/layers __all__ sheet)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.static import fluid_layers as fl
+from paddle_tpu.static import nn as snn
+
+
+def test_rank_is_empty_reverse():
+    x = Tensor(np.ones((2, 3, 4), np.float32))
+    assert int(fl.rank(x).data) == 3
+    assert not bool(fl.is_empty(x).data)
+    assert bool(fl.is_empty(Tensor(np.ones((0, 3), np.float32))).data)
+    r = np.asarray(fl.reverse(Tensor(np.arange(6).reshape(2, 3)), 1).data)
+    np.testing.assert_array_equal(r, [[2, 1, 0], [5, 4, 3]])
+
+
+def test_pad2d_and_pad_constant_like():
+    x = Tensor(np.ones((1, 1, 2, 2), np.float32))
+    out = np.asarray(fl.pad2d(x, [1, 1, 2, 2], pad_value=5.0).data)
+    assert out.shape == (1, 1, 4, 6)
+    assert out[0, 0, 0, 0] == 5.0 and out[0, 0, 1, 2] == 1.0
+    big = Tensor(np.zeros((2, 4), np.float32))
+    small = Tensor(np.ones((1, 2), np.float32))
+    out = np.asarray(fl.pad_constant_like(big, small, -1.0).data)
+    assert out.shape == (2, 4)
+    assert out[0, 0] == 1.0 and out[1, 3] == -1.0
+
+
+def test_adaptive_pools_and_pool3d():
+    rng = np.random.RandomState(0)
+    x = Tensor(rng.rand(2, 3, 8, 8).astype(np.float32))
+    assert fl.adaptive_pool2d(x, 4, 'avg').shape == [2, 3, 4, 4]
+    assert fl.adaptive_pool2d(x, 2, 'max').shape == [2, 3, 2, 2]
+    x3 = Tensor(rng.rand(1, 2, 4, 8, 8).astype(np.float32))
+    o = fl.adaptive_pool3d(x3, 2, 'avg')
+    assert o.shape == [1, 2, 2, 2, 2]
+    om = fl.adaptive_pool3d(x3, 2, 'max')
+    # max pool >= avg pool everywhere
+    assert (np.asarray(om.data) >= np.asarray(o.data) - 1e-6).all()
+    p3 = fl.pool3d(x3, pool_size=2, pool_type='max', pool_stride=2)
+    assert p3.shape == [1, 2, 2, 4, 4]
+    g = fl.pool3d(x3, global_pooling=True, pool_type='avg')
+    np.testing.assert_allclose(
+        np.asarray(g.data).reshape(1, 2),
+        np.asarray(x3.data).mean(axis=(2, 3, 4)), rtol=1e-5)
+
+
+def test_lrn_matches_fluid_formula():
+    rng = np.random.RandomState(1)
+    x = rng.rand(1, 7, 3, 3).astype(np.float32)
+    out = np.asarray(fl.lrn(Tensor(x), n=5, k=1.0, alpha=1e-4,
+                            beta=0.75).data)
+    # fluid formula: x / (k + alpha * sum_window x^2)^beta
+    want = np.zeros_like(x)
+    C = 7
+    for c in range(C):
+        lo, hi = max(0, c - 2), min(C, c + 3)
+        sq = (x[:, lo:hi] ** 2).sum(axis=1)
+        want[:, c] = x[:, c] / (1.0 + 1e-4 * sq) ** 0.75
+    np.testing.assert_allclose(out, want, rtol=1e-4)
+
+
+def test_ctc_greedy_decoder():
+    # logits argmax path: [T=4, steps] -> collapse repeats, drop blank 0
+    probs = np.zeros((1, 5, 4), np.float32)
+    ids = [2, 2, 0, 3]
+    for t, i in enumerate(ids):
+        probs[0, t, i] = 5.0
+    probs[0, 4, 0] = 5.0
+    out, lens = fl.ctc_greedy_decoder(Tensor(probs), blank=0,
+                                      padding_value=-1)
+    o = np.asarray(out.data)[0]
+    assert o[0] == 2 and o[1] == 3
+    assert int(np.asarray(lens.data).reshape(-1)[0]) == 2
+
+
+def test_unique_with_counts():
+    x = Tensor(np.array([2, 3, 3, 1, 5, 3], np.int64))
+    u, idx, cnt = fl.unique_with_counts(x)
+    uv = np.asarray(u.data)
+    cv = np.asarray(cnt.data)
+    assert set(uv.tolist()) == {1, 2, 3, 5}
+    assert cv[uv.tolist().index(3)] == 3
+
+
+def test_batch_size_like_randoms():
+    ref = Tensor(np.zeros((7, 3), np.float32))
+    u = fl.uniform_random_batch_size_like(ref, [0, 4], min=0.0, max=1.0)
+    assert u.shape[0] == 7 and u.shape[1] == 4
+    g = fl.gaussian_random_batch_size_like(ref, [0, 5], mean=0.0,
+                                           std=1.0)
+    assert g.shape == [7, 5]
+
+
+def test_grid_sampler_and_warpctc_alias():
+    rng = np.random.RandomState(2)
+    x = Tensor(rng.rand(1, 1, 4, 4).astype(np.float32))
+    grid = Tensor((rng.rand(1, 3, 3, 2).astype(np.float32) - 0.5) * 2)
+    assert fl.grid_sampler(x, grid).shape == [1, 1, 3, 3]
+    logits = Tensor(rng.randn(6, 2, 5).astype(np.float32))
+    labels = Tensor(np.array([[1, 2, 3], [2, 3, 4]], np.int32))
+    ll = Tensor(np.array([6, 6], np.int64))
+    tl = Tensor(np.array([3, 3], np.int64))
+    loss = fl.warpctc(logits, labels, blank=0, input_length=ll,
+                      label_length=tl)
+    assert np.isfinite(np.asarray(loss.data)).all()
+
+
+def test_similarity_focus_mask():
+    rng = np.random.RandomState(3)
+    x = rng.rand(2, 4, 3, 5).astype(np.float32)
+    out = np.asarray(fl.similarity_focus(Tensor(x), axis=1,
+                                         indexes=[0, 2]).data)
+    assert out.shape == x.shape
+    assert set(np.unique(out)) <= {0.0, 1.0}
+    # every row and column of the selected maps contributes >= 1 hit
+    assert out[0, 0].sum() >= max(3, 5)
+
+
+def test_lr_decay_bridges():
+    s = fl.noam_decay(512, 4000, learning_rate=2.0)
+    assert hasattr(s, 'get_lr') or hasattr(s, '__call__')
+    e = fl.exponential_decay(0.1, 10, 0.5, staircase=True)
+    for _ in range(10):
+        e.step()
+    assert abs(e() - 0.05) < 1e-8
+    p = fl.piecewise_decay([5, 10], [1.0, 0.5, 0.1])
+    for _ in range(6):
+        p.step()
+    assert abs(p() - 0.5) < 1e-8
+    c = fl.cosine_decay(0.1, step_each_epoch=10, epochs=4)
+    assert c() <= 0.1
+    w = fl.linear_lr_warmup(0.1, 5, 0.0, 0.1)
+    assert w() <= 0.1
+    inv = fl.inverse_time_decay(1.0, 1, 1.0)
+    inv.step()
+    assert abs(inv() - 0.5) < 1e-8
+    n = fl.natural_exp_decay(1.0, 1, 1.0)
+    n.step()
+    assert abs(n() - float(np.exp(-1))) < 1e-6
+
+
+def test_static_names_resolve_and_record():
+    paddle.enable_static()
+    try:
+        from paddle_tpu import static
+        main, start = static.Program(), static.Program()
+        with static.program_guard(main, start):
+            x = snn.data('x', [2, 3, 4, 4], 'float32')
+            y = snn.pad2d(x, [1, 1, 1, 1])
+            z = snn.adaptive_pool2d(y, 2, 'avg')
+        exe = static.Executor()
+        out = exe.run(main, feed={
+            'x': np.ones((2, 3, 4, 4), np.float32)},
+            fetch_list=[z])
+        assert out[0].shape == (2, 3, 2, 2)
+    finally:
+        paddle.disable_static()
+    for n in ['accuracy', 'auc', 'data', 'center_loss',
+              'sampled_softmax_with_cross_entropy', 'inplace_abn']:
+        assert callable(getattr(snn, n)), n
+
+
+def test_accuracy_auc_static_recordable():
+    paddle.enable_static()
+    try:
+        from paddle_tpu import static
+        main, start = static.Program(), static.Program()
+        with static.program_guard(main, start):
+            p = snn.data('p', [8, 4], 'float32')
+            l = snn.data('l', [8, 1], 'int64')
+            acc = snn.accuracy(p, l, k=1)
+            a = snn.auc(p, l)
+        exe = static.Executor()
+        rng = np.random.RandomState(0)
+        pv = rng.rand(8, 4).astype(np.float32)
+        lv = rng.randint(0, 4, (8, 1)).astype(np.int64)
+        out = exe.run(main, feed={'p': pv, 'l': lv},
+                      fetch_list=[acc, a])
+        want = (pv.argmax(-1) == lv.reshape(-1)).mean()
+        assert abs(float(out[0]) - want) < 1e-6
+        assert 0.0 <= float(out[1]) <= 1.0
+    finally:
+        paddle.disable_static()
+
+
+def test_auc_orders_scores_correctly():
+    # perfectly separable scores -> AUC 1
+    p = np.array([[0.9], [0.8], [0.2], [0.1]], np.float32)
+    l = np.array([[1], [1], [0], [0]], np.int64)
+    a = float(snn.auc(Tensor(np.concatenate([1 - p, p], 1)),
+                      Tensor(l)).data)
+    assert a > 0.99
+    # inverted -> AUC 0
+    a2 = float(snn.auc(Tensor(np.concatenate([p, 1 - p], 1)),
+                       Tensor(l)).data)
+    assert a2 < 0.01
+
+
+def test_similarity_focus_axis_2_and_validation():
+    rng = np.random.RandomState(4)
+    x = rng.rand(2, 3, 4, 5).astype(np.float32)
+    out = np.asarray(fl.similarity_focus(Tensor(x), axis=2,
+                                         indexes=[1]).data)
+    assert out.shape == x.shape
+    # mask constant along the selected axis (2)
+    assert (out == out[:, :, :1, :]).all()
+    with pytest.raises(ValueError, match='out of range'):
+        fl.similarity_focus(Tensor(x), axis=2, indexes=[9])
+    with pytest.raises(ValueError, match='axis'):
+        fl.similarity_focus(Tensor(x), axis=0, indexes=[0])
+
+
+def test_pool3d_ceil_mode_shape():
+    x = Tensor(np.ones((1, 1, 6, 6, 6), np.float32))
+    flo = fl.pool3d(x, pool_size=3, pool_type='avg', pool_stride=2)
+    cei = fl.pool3d(x, pool_size=3, pool_type='avg', pool_stride=2,
+                    ceil_mode=True)
+    assert flo.shape == [1, 1, 2, 2, 2]
+    assert cei.shape == [1, 1, 3, 3, 3]
+
+
+def test_py_func_skip_vars_in_backward_input():
+    paddle.enable_static()
+    try:
+        from paddle_tpu import static
+        main, start = static.Program(), static.Program()
+        with static.program_guard(main, start):
+            x = snn.data('x', [2, 2], 'float32')
+            x.stop_gradient = False
+            seen_args = []
+
+            def fwd(a):
+                return a * 2.0
+
+            def bwd(o, do):          # x skipped: only (out, dout)
+                seen_args.append(len([o, do]))
+                return do * 2.0
+
+            y = snn.py_func(fwd, x, ([2, 2], 'float32'),
+                            backward_func=bwd,
+                            skip_vars_in_backward_input=[x])
+            loss = paddle.mean(y)
+            static.append_backward(loss)
+        exe = static.Executor()
+        out = exe.run(main, feed={'x': np.ones((2, 2), np.float32)},
+                      fetch_list=[y])
+        np.testing.assert_allclose(out[0], 2.0)
+    finally:
+        paddle.disable_static()
