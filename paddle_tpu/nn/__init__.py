@@ -41,4 +41,7 @@ from .layer.rnn import (RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN,
 from .clip import (ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm,
                    clip_grad_norm_)
 
-from .decode import Decoder, BeamSearchDecoder, dynamic_decode  # noqa
+from .decode import (Decoder, BeamSearchDecoder, dynamic_decode,  # noqa
+                     DecodeHelper, TrainingHelper,
+                     GreedyEmbeddingHelper, SampleEmbeddingHelper,
+                     BasicDecoder)

@@ -197,3 +197,124 @@ def dynamic_decode(decoder, inits=None, max_step_num=None,
         return outputs, final_states, Tensor(seq_len) \
             if seq_len is not None else None
     return outputs, final_states
+
+
+class DecodeHelper:
+    """Helper contract for BasicDecoder (fluid/layers/rnn.py
+    DecodeHelper): initialize() -> (initial_inputs, initial_finished);
+    sample(time, outputs, states) -> sample_ids;
+    next_inputs(time, outputs, states, sample_ids) ->
+    (finished, next_inputs, next_states)."""
+
+    def initialize(self):
+        raise NotImplementedError
+
+    def sample(self, time, outputs, states):
+        raise NotImplementedError
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        raise NotImplementedError
+
+
+class TrainingHelper(DecodeHelper):
+    """Teacher forcing: read the next input from the ground-truth
+    sequence (fluid/layers/rnn.py TrainingHelper)."""
+
+    def __init__(self, inputs, sequence_length, time_major=False):
+        self.inputs = as_tensor(inputs)
+        self.sequence_length = as_tensor(sequence_length)
+        self.time_major = time_major
+        a = self.inputs.data
+        self._seq = a if time_major else jnp.swapaxes(a, 0, 1)  # [T,B,..]
+        self._T = self._seq.shape[0]
+
+    def initialize(self):
+        lens = self.sequence_length.data.reshape(-1)
+        finished = lens <= 0
+        return Tensor(self._seq[0]), finished
+
+    def sample(self, time, outputs, states):
+        o = outputs.data if isinstance(outputs, Tensor) else outputs
+        return Tensor(jnp.argmax(o, axis=-1).astype(jnp.int32))
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        nxt_t = min(time + 1, self._T - 1)
+        lens = self.sequence_length.data.reshape(-1)
+        finished = (time + 1) >= jnp.minimum(lens, self._T)
+        return finished, Tensor(self._seq[nxt_t]), states
+
+
+class GreedyEmbeddingHelper(DecodeHelper):
+    """Inference-time argmax feeding (fluid/layers/rnn.py
+    GreedyEmbeddingHelper)."""
+
+    def __init__(self, embedding_fn, start_tokens, end_token):
+        self.embedding_fn = embedding_fn
+        self.start_tokens = as_tensor(start_tokens)
+        self.end_token = int(end_token)
+
+    def initialize(self):
+        toks = self.start_tokens.data.reshape(-1).astype(jnp.int32)
+        finished = jnp.zeros(toks.shape, bool)
+        return self.embedding_fn(Tensor(toks)), finished
+
+    def sample(self, time, outputs, states):
+        o = outputs.data if isinstance(outputs, Tensor) else outputs
+        return Tensor(jnp.argmax(o, axis=-1).astype(jnp.int32))
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        ids = sample_ids.data if isinstance(sample_ids, Tensor) \
+            else sample_ids
+        finished = ids == self.end_token
+        return finished, self.embedding_fn(Tensor(ids)), states
+
+
+class SampleEmbeddingHelper(GreedyEmbeddingHelper):
+    """Multinomial sampling feeding (fluid/layers/rnn.py
+    SampleEmbeddingHelper)."""
+
+    def __init__(self, embedding_fn, start_tokens, end_token,
+                 softmax_temperature=None, seed=None):
+        super().__init__(embedding_fn, start_tokens, end_token)
+        self.temperature = softmax_temperature
+        self.seed = seed
+
+    def sample(self, time, outputs, states):
+        from ..core import rng as rng_mod
+        o = outputs.data if isinstance(outputs, Tensor) else outputs
+        if self.temperature is not None:
+            o = o / self.temperature
+        key = rng_mod.next_key() if self.seed is None else \
+            jax.random.fold_in(jax.random.PRNGKey(self.seed), time)
+        return Tensor(jax.random.categorical(key, o,
+                                             axis=-1).astype(jnp.int32))
+
+
+class BasicDecoder(Decoder):
+    """Cell + helper -> Decoder (fluid/layers/rnn.py BasicDecoder):
+    each step runs the cell, lets the helper sample ids and produce the
+    next inputs. Outputs dict: cell_outputs + sample_ids."""
+
+    def __init__(self, cell, helper, output_fn=None):
+        self.cell = cell
+        self.helper = helper
+        self.output_fn = output_fn
+
+    def initialize(self, initial_cell_states):
+        inputs, finished = self.helper.initialize()
+        return inputs, initial_cell_states, finished
+
+    def step(self, time, inputs, states, **kwargs):
+        cell_out, next_states = self.cell(inputs, states)
+        if self.output_fn is not None:
+            cell_out = self.output_fn(cell_out)
+        sample_ids = self.helper.sample(time, cell_out, next_states)
+        finished, next_inputs, next_states = self.helper.next_inputs(
+            time, cell_out, next_states, sample_ids)
+        outputs = {'cell_outputs': cell_out
+                   if isinstance(cell_out, Tensor) else Tensor(cell_out),
+                   'sample_ids': sample_ids}
+        return outputs, next_states, next_inputs, finished
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        return outputs, final_states

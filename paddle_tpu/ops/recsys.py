@@ -502,3 +502,39 @@ def pyramid_hash(x, w, num_emb, space_len, pyramid_layer=2, rand_len=16,
     # differentiable tail through the tape — the trainable hash table
     # gets real gradients; hashing is host-side int prep
     return run_op('pyramid_hash', fn, [as_tensor(w)])
+
+
+# ---------------------------------------------------------------------------
+# filter_by_instag
+# ---------------------------------------------------------------------------
+
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod=True,
+                     out_val_if_empty=0):
+    """filter_by_instag_op.cc — keep only the instances whose tag set
+    intersects `filter_tag` (ad-targeting row filter). Dense contract:
+    ins [N, D]; ins_tag [N, T] padded with -1 (the LoD multi-tag rows);
+    filter_tag [F].
+
+    Host-side data-prep op (data-dependent output length). Returns
+    (filtered rows [M, D] — or a single out_val_if_empty row when no
+    instance matches, like the reference — loss_weight [M, 1],
+    index map [M])."""
+    import jax.numpy as jnp
+    _host_only('filter_by_instag')
+    x = _np(ins)
+    tags = _np(ins_tag)
+    if tags.ndim == 1:
+        tags = tags[:, None]
+    fset = set(int(t) for t in _np(filter_tag).reshape(-1))
+    keep = [i for i in range(x.shape[0])
+            if fset & set(int(t) for t in tags[i] if t >= 0)]
+    if keep:
+        rows = x[np.asarray(keep)]
+        lw = np.ones((len(keep), 1), np.float32)
+        idx = np.asarray(keep, np.int64)
+    else:
+        rows = np.full((1,) + x.shape[1:], out_val_if_empty, x.dtype)
+        lw = np.zeros((1, 1), np.float32)
+        idx = np.zeros((1,), np.int64)
+    return (Tensor(jnp.asarray(rows)), Tensor(jnp.asarray(lw)),
+            Tensor(jnp.asarray(idx)))

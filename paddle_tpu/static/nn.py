@@ -677,6 +677,14 @@ def sampling_id(x, min=0.0, max=1.0, seed=0, dtype='int64'):
         x.data + 1e-9), axis=-1))
 
 
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod=True,
+                     out_val_if_empty=0):
+    """Parity: fluid.layers.filter_by_instag (host data-prep)."""
+    from ..ops import recsys as _rec
+    return _rec.filter_by_instag(ins, ins_tag, filter_tag, is_lod,
+                                 out_val_if_empty)
+
+
 # -- recsys / PS tier (fluid.contrib.layers parity) --------------------------
 
 def continuous_value_model(input, cvm, use_cvm=True):
@@ -1058,7 +1066,9 @@ def _reexport():
                 'sequence_slice', 'sequence_scatter', 'sequence_unpad',
                 'edit_distance', 'ctc_greedy_decoder', 'warpctc',
                 'gather_tree']),
-        (_det, ['multiclass_nms', 'bipartite_match', 'iou_similarity',
+        (_det, ['retinanet_target_assign',
+                'roi_perspective_transform',
+                'multiclass_nms', 'bipartite_match', 'iou_similarity',
                 'yolo_box', 'prior_box', 'box_coder', 'box_clip',
                 'anchor_generator', 'generate_proposals', 'matrix_nms',
                 'density_prior_box', 'distribute_fpn_proposals',
@@ -1080,7 +1090,18 @@ def _reexport():
                'similarity_focus', 'noam_decay', 'exponential_decay',
                'natural_exp_decay', 'inverse_time_decay',
                'polynomial_decay', 'piecewise_decay', 'cosine_decay',
-               'linear_lr_warmup', 'rnn', 'birnn']),
+               'linear_lr_warmup', 'rnn', 'birnn',
+               'conv3d_transpose', 'resize_linear', 'resize_trilinear',
+               'image_resize_short', 'gru_unit', 'lstm_unit',
+               'dynamic_lstm', 'dynamic_lstmp', 'dynamic_gru', 'lstm',
+               'beam_search_decode', 'chunk_eval', 'create_array',
+               'array_write', 'array_read', 'array_length',
+               'tensor_array_to_tensor', 'Print', 'Assert', 'While',
+               'Switch', 'IfElse', 'StaticRNN', 'DynamicRNN',
+               'lod_append', 'lod_reset', 'reorder_lod_tensor_by_rank',
+               'get_tensor_from_selected_rows', 'merge_selected_rows',
+               'py_reader', 'double_buffer',
+               'create_py_reader_by_data']),
         (_contrib, ['center_loss', 'sampled_softmax_with_cross_entropy',
                     'ctc_align']),
         (_vops, ['roi_align', 'roi_pool']),
@@ -1161,6 +1182,13 @@ def auc(input, label, curve='ROC', num_thresholds=4095, topk=1,
         fp = _jnp.cumsum(fp_h[::-1])
         tot_p = _jnp.maximum(tp[-1], 1.0)
         tot_n = _jnp.maximum(fp[-1], 1.0)
+        if curve == 'PR':
+            # precision-recall AUC over the same threshold sweep
+            rec = tp / tot_p
+            prec = tp / _jnp.maximum(tp + fp, 1.0)
+            rec = _jnp.concatenate([_jnp.zeros((1,)), rec])
+            prec = _jnp.concatenate([_jnp.ones((1,)), prec])
+            return _jnp.trapezoid(prec, rec).astype(_jnp.float32)
         tpr = _jnp.concatenate([_jnp.zeros((1,)), tp]) / tot_p
         fpr = _jnp.concatenate([_jnp.zeros((1,)), fp]) / tot_n
         return _jnp.trapezoid(tpr, fpr).astype(_jnp.float32)

@@ -2080,3 +2080,159 @@ def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
             Tensor(_jnp.asarray(has_np)),
             Tensor(_jnp.asarray(mask_np)),
             Tensor(_jnp.asarray(np.asarray(lens, np.int32))))
+
+
+def retinanet_target_assign(bbox_pred, cls_logits, anchor_box,
+                            anchor_var, gt_boxes, gt_labels, is_crowd,
+                            im_info, num_classes=1,
+                            positive_overlap=0.5, negative_overlap=0.4,
+                            gt_num=None):
+    """fluid.layers.retinanet_target_assign
+    (operators/detection/retinanet_target_assign_op.cc): focal-loss
+    sample selection — positives are max-overlap-per-gt anchors or
+    IOU >= positive_overlap; negatives IOU < negative_overlap; anchors
+    in between are ignored; NO subsampling (focal loss trains on all).
+    Positive labels are the gt class (1..C), negative labels 0.
+
+    Host-side data-prep (same disposition as rpn_target_assign).
+    Returns (predict_scores [S, C], predict_location [L, 4],
+    target_label [S, 1], target_bbox [L, 4], bbox_inside_weight [L, 4],
+    fg_num [1])."""
+    from ..ops.recsys import _host_only
+    _host_only('retinanet_target_assign')
+    bp = np.asarray(as_tensor(bbox_pred).data)
+    cl = np.asarray(as_tensor(cls_logits).data)
+    an = np.asarray(as_tensor(anchor_box).data)
+    gbs = np.asarray(as_tensor(gt_boxes).data)
+    gls = np.asarray(as_tensor(gt_labels).data)
+    crowd_all = (np.asarray(as_tensor(is_crowd).data)
+                 if is_crowd is not None else None)
+    N, A = bp.shape[0], an.shape[0]
+    gn = (np.asarray(as_tensor(gt_num).data).reshape(-1).astype(int)
+          if gt_num is not None else np.full(N, gbs.shape[1], int))
+
+    scores, locs, labels, tboxes, inw = [], [], [], [], []
+    fg_total = 0
+    for b in range(N):
+        g = gbs[b][:gn[b]]
+        gl = gls[b].reshape(-1)[:gn[b]]
+        keep = (g[:, 2] - g[:, 0]) * (g[:, 3] - g[:, 1]) > 0
+        if crowd_all is not None:
+            keep &= ~crowd_all[b].reshape(-1)[:gn[b]].astype(bool)
+        g, gl = g[keep], gl[keep]
+        if len(g):
+            iou = _np_overlaps(an, g)
+            a2g = iou.argmax(1)
+            a2g_max = iou.max(1)
+            g_max = iou.max(0)
+            lab = -np.ones(A, np.int64)
+            lab[a2g_max < negative_overlap] = 0
+            lab[np.where(iou == g_max)[0]] = 1
+            lab[a2g_max >= positive_overlap] = 1
+        else:
+            a2g = np.zeros(A, int)
+            lab = np.zeros(A, np.int64)
+        fg = np.where(lab == 1)[0]
+        bg = np.where(lab == 0)[0]
+        fg_total += len(fg)
+        sel = np.concatenate([fg, bg])
+        scores.append(cl[b].reshape(A, -1)[sel])
+        # positive target label = gt class; negatives 0
+        tl = np.zeros(len(sel), np.int64)
+        if len(g):
+            tl[:len(fg)] = gl[a2g[fg]]
+        labels.append(tl[:, None])
+        locs.append(bp[b][fg])
+        tboxes.append(g[a2g[fg]] if len(g)
+                      else np.zeros((0, 4), an.dtype))
+        inw.append(np.ones((len(fg), 4), np.float32))
+
+    import jax.numpy as _jnp
+    outs = [np.concatenate(x) if x else np.zeros((0, 1))
+            for x in (scores, locs, labels, tboxes, inw)]
+    return tuple(Tensor(_jnp.asarray(o)) for o in outs) + \
+        (Tensor(_jnp.asarray(np.asarray([max(fg_total, 1)],
+                                        np.int32))),)
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0,
+                              rois_num=None, name=None):
+    """roi_perspective_transform_op.cc (EAST): each roi is a QUAD
+    [x1 y1 x2 y2 x3 y3 x4 y4] (clockwise from top-left); the op warps
+    the quad region to a fixed [H', W'] patch via the homography that
+    maps the output rectangle corners onto the quad, with bilinear
+    sampling and an in-bounds mask.
+
+    TPU-native: homographies solved per roi as one batched 8x8 linear
+    system (jnp.linalg.solve), sampling as one vectorized gather —
+    no per-pixel host loop. Returns (out [R, C, H', W'],
+    mask [R, 1, H', W'], transform_matrix [R, 9])."""
+    import jax
+    input = as_tensor(input)
+    rois = as_tensor(rois, ref=input)
+    if rois_num is None:
+        batch_idx_np = np.zeros((int(rois.shape[0]),), np.int32)
+    else:
+        rn = np.asarray(as_tensor(rois_num).data).reshape(-1)
+        batch_idx_np = np.repeat(np.arange(len(rn)), rn).astype(np.int32)
+    Ht, Wt = int(transformed_height), int(transformed_width)
+
+    def fn(x, r):
+        N, C, H, W = x.shape
+
+        def homography(quad):
+            # solve for h mapping (u, v) in the H'xW' rect to the quad
+            src = jnp.asarray([[0., 0.], [Wt - 1., 0.],
+                               [Wt - 1., Ht - 1.], [0., Ht - 1.]],
+                              x.dtype)
+            dst = quad.reshape(4, 2) * spatial_scale
+            rows = []
+            for i in range(4):
+                u, v = src[i]
+                xx, yy = dst[i]
+                rows.append(jnp.asarray(
+                    [u, v, 1., 0., 0., 0., -u * xx, -v * xx], x.dtype))
+                rows.append(jnp.asarray(
+                    [0., 0., 0., u, v, 1., -u * yy, -v * yy], x.dtype))
+            Amat = jnp.stack(rows)
+            b2 = jnp.stack([dst[0, 0], dst[0, 1], dst[1, 0], dst[1, 1],
+                            dst[2, 0], dst[2, 1], dst[3, 0], dst[3, 1]])
+            h = jnp.linalg.solve(Amat, b2)
+            return jnp.concatenate([h, jnp.ones((1,), x.dtype)])
+
+        def one(quad, b):
+            h = homography(quad)
+            Hm = h.reshape(3, 3)
+            uu = jnp.arange(Wt, dtype=x.dtype)
+            vv = jnp.arange(Ht, dtype=x.dtype)
+            U, V = jnp.meshgrid(uu, vv)              # [Ht, Wt]
+            ones = jnp.ones_like(U)
+            pts = jnp.stack([U, V, ones], 0).reshape(3, -1)
+            mapped = Hm @ pts
+            xs = mapped[0] / jnp.maximum(jnp.abs(mapped[2]), 1e-9) \
+                * jnp.sign(mapped[2])
+            ys = mapped[1] / jnp.maximum(jnp.abs(mapped[2]), 1e-9) \
+                * jnp.sign(mapped[2])
+            inb = (xs >= -0.5) & (xs <= W - 0.5) & (ys >= -0.5) \
+                & (ys <= H - 0.5)
+            xc = jnp.clip(xs, 0, W - 1)
+            yc = jnp.clip(ys, 0, H - 1)
+            x0 = jnp.floor(xc).astype(jnp.int32)
+            y0 = jnp.floor(yc).astype(jnp.int32)
+            x1 = jnp.clip(x0 + 1, 0, W - 1)
+            y1 = jnp.clip(y0 + 1, 0, H - 1)
+            lx = xc - x0
+            ly = yc - y0
+            img = x[b]                                # [C, H, W]
+            val = (img[:, y0, x0] * (1 - ly) * (1 - lx)
+                   + img[:, y0, x1] * (1 - ly) * lx
+                   + img[:, y1, x0] * ly * (1 - lx)
+                   + img[:, y1, x1] * ly * lx)        # [C, Ht*Wt]
+            val = jnp.where(inb[None, :], val, 0.0)
+            return (val.reshape(C, Ht, Wt),
+                    inb.reshape(1, Ht, Wt).astype(jnp.int32), h)
+        outs, masks, hs = jax.vmap(one)(r, jnp.asarray(batch_idx_np))
+        return outs, masks, hs
+    return run_op('roi_perspective_transform', fn, [input, rois],
+                  n_nondiff=1)

@@ -1,0 +1,307 @@
+"""fluid.layers wave-3 tail: conv3d_transpose, resizes, RNN-op
+wrappers, TensorArray, Print/Assert, chunk_eval, decode helpers,
+retinanet_target_assign, roi_perspective_transform, filter_by_instag."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.static import fluid_layers as fl
+from paddle_tpu.vision import detection as det
+from paddle_tpu.ops import recsys
+
+
+def test_conv3d_transpose_shape_and_grad():
+    paddle.enable_static()
+    try:
+        from paddle_tpu import static
+        main, start = static.Program(), static.Program()
+        with static.program_guard(main, start):
+            x = static.data('x', [1, 2, 4, 4, 4], 'float32')
+            y = fl.conv3d_transpose(x, num_filters=3, filter_size=3,
+                                    stride=2, padding=1)
+        exe = static.Executor()
+        exe.run(start)
+        out = exe.run(main, feed={
+            'x': np.random.RandomState(0).rand(1, 2, 4, 4, 4)
+            .astype(np.float32)}, fetch_list=[y])
+        assert out[0].shape == (1, 3, 7, 7, 7)
+    finally:
+        paddle.disable_static()
+
+
+def test_resize_wrappers():
+    rng = np.random.RandomState(1)
+    x3 = Tensor(rng.rand(1, 2, 4, 4, 4).astype(np.float32))
+    out = fl.resize_trilinear(x3, out_shape=[8, 8, 8])
+    assert out.shape == [1, 2, 8, 8, 8]
+    x1 = Tensor(rng.rand(1, 2, 6).astype(np.float32))
+    assert fl.resize_linear(x1, out_shape=[12]).shape == [1, 2, 12]
+    img = Tensor(rng.rand(1, 3, 20, 30).astype(np.float32))
+    short = fl.image_resize_short(img, 10)
+    assert short.shape == [1, 3, 10, 15]
+
+
+def test_rnn_op_wrappers_run():
+    rng = np.random.RandomState(2)
+    x = Tensor(rng.rand(2, 5, 4).astype(np.float32))
+    out = fl.dynamic_gru(x, size=6)
+    assert out.shape == [2, 5, 6]
+    o, _ = fl.dynamic_lstm(x, size=24)     # 4 * hidden(6)
+    assert o.shape == [2, 5, 6]
+    proj, hid = fl.dynamic_lstmp(x, size=24, proj_size=3)
+    assert proj.shape == [2, 5, 3]
+    h0 = Tensor(np.zeros((1, 2, 6), np.float32))
+    c0 = Tensor(np.zeros((1, 2, 6), np.float32))
+    o, h, c = fl.lstm(x, h0, c0, max_len=5, hidden_size=6,
+                      num_layers=1)
+    assert o.shape == [2, 5, 6]
+    ht = Tensor(np.zeros((2, 6), np.float32))
+    nh, _, _ = fl.gru_unit(Tensor(rng.rand(2, 4).astype(np.float32)),
+                           ht, size=18)
+    assert nh.shape == [2, 6]
+    hh, cc = fl.lstm_unit(Tensor(rng.rand(2, 4).astype(np.float32)),
+                          ht, ht)
+    assert hh.shape == [2, 6] and cc.shape == [2, 6]
+
+
+def test_tensor_array_ops():
+    arr = fl.create_array()
+    i0 = Tensor(np.asarray(0))
+    arr = fl.array_write(Tensor(np.ones((2, 3), np.float32)), i0, arr)
+    arr = fl.array_write(Tensor(np.full((2, 3), 2.0, np.float32)),
+                         Tensor(np.asarray(1)), arr)
+    assert int(fl.array_length(arr).data) == 2
+    r = fl.array_read(arr, Tensor(np.asarray(1)))
+    assert float(np.asarray(r.data)[0, 0]) == 2.0
+    cat, sizes = fl.tensor_array_to_tensor(arr, axis=0)
+    assert np.asarray(cat.data).shape == (4, 3)
+    np.testing.assert_array_equal(np.asarray(sizes.data), [2, 2])
+    st, _ = fl.tensor_array_to_tensor(arr, axis=0, use_stack=True)
+    assert np.asarray(st.data).shape == (2, 2, 3)
+
+
+def test_print_assert_eager(capsys):
+    x = Tensor(np.arange(4, dtype=np.float32))
+    y = fl.Print(x, message='dbg')
+    out = capsys.readouterr().out
+    assert 'dbg' in out and 'shape=(4,)' in out
+    np.testing.assert_array_equal(np.asarray(y.data),
+                                  np.asarray(x.data))
+    assert fl.Assert(Tensor(np.asarray(True)))
+    with pytest.raises(ValueError, match='Assert failed'):
+        fl.Assert(Tensor(np.asarray(False)),
+                  data=[Tensor(np.asarray([1.0, 2.0]))])
+
+
+def test_imperative_cf_raisers_guide():
+    with pytest.raises(NotImplementedError, match='while_loop'):
+        fl.While(cond=None)
+    with pytest.raises(NotImplementedError, match='cond'):
+        fl.IfElse(None)
+    with pytest.raises(NotImplementedError, match='RNN'):
+        fl.StaticRNN()
+    with pytest.raises(NotImplementedError, match='DataLoader'):
+        fl.py_reader()
+    with pytest.raises(NotImplementedError, match='lengths'):
+        fl.lod_reset(None, None)
+
+
+def test_chunk_eval_iob():
+    # IOB, 1 chunk type: tags B=0, I=1, O=2
+    lab = np.array([[0, 1, 2, 0, 1, 1]], np.int64)   # chunks (0,1),(3,5)
+    inf = np.array([[0, 1, 2, 0, 2, 2]], np.int64)   # chunks (0,1),(3,3)
+    p, r, f1, ni, nl, nc = fl.chunk_eval(
+        Tensor(inf), Tensor(lab), 'IOB', 1)
+    assert int(ni.data) == 2 and int(nl.data) == 2
+    assert int(nc.data) == 1                     # only (0,1) matches
+    assert abs(float(p.data) - 0.5) < 1e-6
+    assert abs(float(r.data) - 0.5) < 1e-6
+
+
+def test_basic_decoder_with_training_helper():
+    paddle.seed(0)
+    B, T, H, V = 2, 4, 8, 10
+    cell = nn.GRUCell(H, H)
+    proj = nn.Linear(H, V)
+    seq = Tensor(np.random.RandomState(3).rand(B, T, H)
+                 .astype(np.float32))
+    helper = nn.TrainingHelper(seq, Tensor(np.array([4, 3], np.int64)))
+    dec = nn.BasicDecoder(cell, helper, output_fn=proj)
+    h0 = Tensor(np.zeros((B, H), np.float32))
+    out, final = nn.dynamic_decode(dec, inits=h0, max_step_num=T)
+    co = np.asarray(out['cell_outputs'].data)
+    assert co.shape[0] == B and co.shape[2] == V
+    ids = np.asarray(out['sample_ids'].data)
+    assert ((ids >= 0) & (ids < V)).all()
+
+
+def test_greedy_and_sample_helpers():
+    paddle.seed(0)
+    B, H, V = 2, 6, 8
+    emb = nn.Embedding(V, H)
+    cell = nn.GRUCell(H, H)
+    proj = nn.Linear(H, V)
+    for helper_cls in (nn.GreedyEmbeddingHelper,):
+        helper = helper_cls(emb, Tensor(np.full((B,), 1, np.int64)), 2)
+        dec = nn.BasicDecoder(cell, helper, output_fn=proj)
+        out, _ = nn.dynamic_decode(
+            dec, inits=Tensor(np.zeros((B, H), np.float32)),
+            max_step_num=5)
+        assert np.asarray(out['sample_ids'].data).shape[0] == B
+    helper = nn.SampleEmbeddingHelper(
+        emb, Tensor(np.full((B,), 1, np.int64)), 2, seed=7)
+    dec = nn.BasicDecoder(cell, helper, output_fn=proj)
+    out, _ = nn.dynamic_decode(
+        dec, inits=Tensor(np.zeros((B, H), np.float32)),
+        max_step_num=5)
+    assert np.asarray(out['sample_ids'].data).shape[0] == B
+
+
+def test_retinanet_target_assign_contract():
+    rng = np.random.RandomState(5)
+    N, A, G, C = 1, 32, 2, 4
+    anchors = np.sort(rng.rand(A, 4).astype(np.float32) * 40, -1)
+    anchors = np.stack([anchors[:, 0], anchors[:, 1],
+                        anchors[:, 0] + 8, anchors[:, 1] + 8], -1)
+    gt = np.stack([anchors[3], anchors[17]])[None].astype(np.float32)
+    gl = np.array([[1, 3]], np.int64)
+    sc, lc, lab, tb, inw, fg = det.retinanet_target_assign(
+        Tensor(rng.randn(N, A, 4).astype(np.float32)),
+        Tensor(rng.randn(N, A, C).astype(np.float32)),
+        Tensor(anchors), None, Tensor(gt), Tensor(gl), None,
+        Tensor(np.array([[64.0, 64.0, 1.0]], np.float32)))
+    labv = np.asarray(lab.data).reshape(-1)
+    assert int(np.asarray(fg.data)[0]) >= 2
+    # positives carry their gt class labels (1 and 3)
+    pos_labels = labv[labv > 0]
+    assert set(pos_labels.tolist()) <= {1, 3}
+    assert len(pos_labels) >= 2
+    assert np.asarray(lc.data).shape == np.asarray(tb.data).shape
+
+
+def test_roi_perspective_transform_identity_quad():
+    rng = np.random.RandomState(6)
+    x = rng.rand(1, 1, 8, 8).astype(np.float32)
+    # quad == axis-aligned rect covering [1,6]x[1,6]
+    quad = np.array([[1.0, 1.0, 6.0, 1.0, 6.0, 6.0, 1.0, 6.0]],
+                    np.float32)
+    out, mask, h = det.roi_perspective_transform(
+        Tensor(x), Tensor(quad), 6, 6, spatial_scale=1.0)
+    o = np.asarray(out.data)
+    assert o.shape == (1, 1, 6, 6)
+    # axis-aligned identity-scale quad: output == the cropped region
+    np.testing.assert_allclose(o[0, 0], x[0, 0, 1:7, 1:7], atol=1e-4)
+    assert (np.asarray(mask.data) == 1).all()
+
+
+def test_filter_by_instag():
+    x = np.arange(12, dtype=np.float32).reshape(4, 3)
+    tags = np.array([[1, -1], [2, 3], [4, -1], [3, -1]], np.int64)
+    rows, lw, idx = recsys.filter_by_instag(
+        Tensor(x), Tensor(tags), Tensor(np.array([3], np.int64)))
+    np.testing.assert_array_equal(np.asarray(idx.data), [1, 3])
+    np.testing.assert_allclose(np.asarray(rows.data), x[[1, 3]])
+    np.testing.assert_allclose(np.asarray(lw.data), 1.0)
+    # no match: single fill row, zero weight
+    rows2, lw2, _ = recsys.filter_by_instag(
+        Tensor(x), Tensor(tags), Tensor(np.array([99], np.int64)),
+        out_val_if_empty=7)
+    assert np.asarray(rows2.data).shape == (1, 3)
+    assert (np.asarray(rows2.data) == 7).all()
+    assert float(np.asarray(lw2.data).reshape(())) == 0.0
+
+
+def test_beam_search_decode_fn():
+    ids = np.array([[[2, 2], [6, 1]], [[3, 9], [6, 1]],
+                    [[0, 1], [9, 0]]], np.int64)
+    parents = np.array([[[0, 0], [1, 1]], [[1, 0], [1, 0]],
+                        [[0, 0], [0, 1]]], np.int64)
+    seqs, _ = fl.beam_search_decode(Tensor(ids), Tensor(parents))
+    want = np.array([[[2, 2], [1, 6]], [[3, 3], [6, 1]],
+                     [[0, 1], [9, 0]]], np.int64)
+    np.testing.assert_array_equal(np.asarray(seqs.data), want)
+
+
+def test_conv3d_transpose_paddle_shape_convention():
+    paddle.enable_static()
+    try:
+        from paddle_tpu import static
+        for pad, want in ((0, 9), (2, 5)):
+            main, start = static.Program(), static.Program()
+            with static.program_guard(main, start):
+                x = static.data('x', [1, 2, 4, 4, 4], 'float32')
+                y = fl.conv3d_transpose(x, num_filters=3, filter_size=3,
+                                        stride=2, padding=pad)
+            exe = static.Executor()
+            exe.run(start)
+            out = exe.run(main, feed={
+                'x': np.ones((1, 2, 4, 4, 4), np.float32)},
+                fetch_list=[y])
+            # paddle: (in-1)*stride - 2*pad + k
+            assert out[0].shape == (1, 3, want, want, want), \
+                (pad, out[0].shape)
+    finally:
+        paddle.disable_static()
+
+
+def test_dynamic_lstm_cell_sequence_is_distinct():
+    rng = np.random.RandomState(7)
+    x = Tensor(rng.rand(2, 5, 4).astype(np.float32))
+    h_seq, c_seq = fl.dynamic_lstm(x, size=24)
+    hv, cv = np.asarray(h_seq.data), np.asarray(c_seq.data)
+    assert hv.shape == cv.shape == (2, 5, 6)
+    assert not np.allclose(hv, cv)           # cell state != hidden
+    # tanh(c) bounds h: |h| <= |tanh(c)| elementwise for LSTM
+    assert (np.abs(hv) <= np.abs(np.tanh(cv)) + 1e-5).all()
+
+
+def test_pool3d_ceil_exclusive_mean():
+    x = Tensor(np.ones((1, 1, 6, 6, 6), np.float32))
+    out = fl.pool3d(x, pool_size=3, pool_type='avg', pool_stride=2,
+                    ceil_mode=True, exclusive=True)
+    # all-ones input: exclusive mean is exactly 1 even at clipped edges
+    np.testing.assert_allclose(np.asarray(out.data), 1.0, rtol=1e-6)
+
+
+def test_resize_align_corners_endpoints():
+    x = np.arange(4, dtype=np.float32).reshape(1, 1, 4)
+    out = np.asarray(fl.resize_linear(Tensor(x), out_shape=[7]).data)
+    # align_corners=True keeps the endpoints exact and spacing uniform
+    np.testing.assert_allclose(out[0, 0, 0], 0.0, atol=1e-6)
+    np.testing.assert_allclose(out[0, 0, -1], 3.0, atol=1e-6)
+    np.testing.assert_allclose(out[0, 0], np.linspace(0, 3, 7),
+                               atol=1e-5)
+
+
+def test_gru_unit_full_outputs():
+    rng = np.random.RandomState(8)
+    x = Tensor(rng.rand(2, 4).astype(np.float32))
+    h = Tensor(rng.rand(2, 6).astype(np.float32))
+    nh, rhp, gate = fl.gru_unit(x, h, size=18)
+    assert nh.shape == [2, 6]
+    assert rhp.shape == [2, 6]
+    assert gate.shape == [2, 18]             # [u, r, c-hat]
+    g = np.asarray(gate.data)
+    assert ((g[:, :12] >= 0) & (g[:, :12] <= 1)).all()   # sigmoids
+    # reset_hidden_pre = r * h_prev
+    np.testing.assert_allclose(np.asarray(rhp.data),
+                               g[:, 6:12] * np.asarray(h.data),
+                               rtol=1e-5)
+
+
+def test_auc_pr_curve_differs_from_roc():
+    from paddle_tpu.static import nn as snn
+    rng = np.random.RandomState(9)
+    # imbalanced: 10% positives, moderately separable
+    n = 200
+    lab = (rng.rand(n) < 0.1).astype(np.int64)
+    score = np.clip(0.3 * lab + rng.rand(n) * 0.7, 0, 1) \
+        .astype(np.float32)
+    p2 = np.stack([1 - score, score], -1)
+    roc = float(snn.auc(Tensor(p2), Tensor(lab[:, None])).data)
+    pr = float(snn.auc(Tensor(p2), Tensor(lab[:, None]),
+                       curve='PR').data)
+    assert 0 < pr < 1 and 0 < roc < 1
+    assert abs(roc - pr) > 0.05              # genuinely different metrics
