@@ -314,8 +314,28 @@ def bench_deepfm_ps_config5():
     return out
 
 
+def _retry(fn, attempts=3):
+    """The tunneled chip's remote-compile channel occasionally drops a
+    response mid-read (transient 'response body closed' /
+    'read body' JaxRuntimeError); retry so one hiccup doesn't blank a
+    config's numbers in the round record."""
+    last = None
+    for i in range(attempts):
+        try:
+            return fn()
+        except Exception as e:           # noqa: BLE001
+            last = e
+            transient = any(tok in repr(e) for tok in (
+                'remote_compile', 'read body', 'response body',
+                'UNAVAILABLE', 'DEADLINE'))
+            if not transient or i == attempts - 1:
+                raise
+            time.sleep(5 * (i + 1))
+    raise last
+
+
 def main():
-    g = bench_gpt_1p3b()
+    g = _retry(bench_gpt_1p3b)
     detail = {
         'ms_per_step': round(g['ms_per_step'], 1),
         'tokens_per_sec': round(g['tokens_per_sec'], 1),
@@ -325,7 +345,7 @@ def main():
         'microbatches': g['microbatches'],
     }
     try:
-        b = bench_bert_config3()
+        b = _retry(bench_bert_config3)
         detail['bert_base_zero2_bf16'] = {
             'samples_per_sec': round(b['samples_per_sec'], 2),
             'ms_per_step': round(b['ms_per_step'], 1),
@@ -339,7 +359,7 @@ def main():
             ('deepfm_ps', bench_deepfm_ps_config5, 2),
     ):
         try:
-            r = fn()
+            r = _retry(fn)
             detail[key] = {k: (round(v, rounds)
                                if isinstance(v, float) else v)
                            for k, v in r.items()}

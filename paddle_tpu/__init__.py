@@ -122,3 +122,11 @@ from . import version  # noqa: E402
 
 def get_cudnn_version():
     return None
+
+from .api_tail import (add_n, floor_mod, inverse, t, is_tensor,  # noqa
+                       is_empty, rank, reverse, scatter_,
+                       set_printoptions, batch, get_cuda_rng_state,
+                       set_cuda_rng_state, CUDAPinnedPlace, NPUPlace,
+                       cholesky, create_parameter, check_shape,
+                       tanh_, reshape_, squeeze_, unsqueeze_)
+from .core import dtypes as dtype  # noqa — paddle.dtype namespace

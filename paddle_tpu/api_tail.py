@@ -1,0 +1,215 @@
+"""Top-level API sheet remainder (python/paddle/__init__.py __all__ +
+static/vision/jit/distributed tails). Each name is a thin adapter over
+the modern surface; device-specific Places exist for API compatibility
+(PJRT owns real placement — SURVEY N1 disposition).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .core.tensor import Tensor
+from .ops.common import as_tensor
+
+
+def add_n(inputs, name=None):
+    """paddle.add_n — elementwise sum of a tensor list
+    (operators/sum_op.cc)."""
+    from .core.autograd import run_op
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    tens = [as_tensor(t) for t in inputs]
+
+    def fn(*arrs):
+        out = arrs[0]
+        for a in arrs[1:]:
+            out = out + a
+        return out
+    return run_op('add_n', fn, tens)
+
+
+def floor_mod(x, y, name=None):
+    """paddle.floor_mod — alias of mod (operators/elementwise_mod)."""
+    from .ops.math import mod
+    return mod(x, y)
+
+
+def inverse(x, name=None):
+    """paddle.inverse (operators/inverse_op.cc)."""
+    from .core.autograd import run_op
+    return run_op('inverse', jnp.linalg.inv, [as_tensor(x)])
+
+
+def t(input, name=None):
+    """paddle.t — transpose a 0/1/2-D tensor (operators/transpose)."""
+    x = as_tensor(input)
+    if len(x.shape) > 2:
+        raise ValueError(
+            f"paddle.t expects ndim <= 2, got {len(x.shape)}; use "
+            "paddle.transpose for higher ranks")
+    if len(x.shape) < 2:
+        return x
+    from .ops.manip import transpose
+    return transpose(x, [1, 0])
+
+
+def is_tensor(x):
+    """paddle.is_tensor."""
+    return isinstance(x, Tensor)
+
+
+def is_empty(x, name=None):
+    """paddle.is_empty (operators/is_empty_op.cc)."""
+    return Tensor(jnp.asarray(int(np.prod(as_tensor(x).shape)) == 0))
+
+
+def rank(input):
+    """paddle.rank — number of dimensions as a 0-D tensor."""
+    return Tensor(jnp.asarray(len(as_tensor(input).shape), jnp.int32))
+
+
+def reverse(x, axis, name=None):
+    """paddle.reverse (operators/reverse_op.cc)."""
+    from .ops.manip import flip
+    return flip(x, [axis] if isinstance(axis, int) else axis)
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    """paddle.scatter_ — the in-place spelling; JAX arrays are
+    immutable, so this rebinds the tensor's buffer to the scattered
+    result (the caller-visible contract matches: x reflects the
+    update)."""
+    from .ops.manip import scatter
+    out = scatter(x, index, updates, overwrite=overwrite)
+    if isinstance(x, Tensor):
+        x._data = out.data
+    return out
+
+
+_print_options = {'precision': 8, 'threshold': 1000, 'edgeitems': 3,
+                  'linewidth': 80}
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """paddle.set_printoptions — forwards to numpy (tensors repr via
+    numpy arrays)."""
+    kw = {}
+    if precision is not None:
+        kw['precision'] = precision
+        _print_options['precision'] = precision
+    if threshold is not None:
+        kw['threshold'] = threshold
+    if edgeitems is not None:
+        kw['edgeitems'] = edgeitems
+    if linewidth is not None:
+        kw['linewidth'] = linewidth
+    if sci_mode is not None:
+        kw['suppress'] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+def batch(reader, batch_size, drop_last=False):
+    """paddle.batch — the classic reader decorator (superseded by
+    DataLoader, kept for ported training scripts)."""
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batched
+
+
+def get_cuda_rng_state():
+    """paddle.get_cuda_rng_state — maps to the functional RNG stream
+    (no CUDA here; one device-agnostic state)."""
+    from .core import rng
+    return rng.get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    """paddle.set_cuda_rng_state — see get_cuda_rng_state."""
+    from .core import rng
+    rng.set_rng_state(state)
+
+
+class CUDAPinnedPlace:
+    """API-compat place (PJRT owns placement; pinned-host memory is a
+    jax memory-kind concern, not a place)."""
+
+    def __repr__(self):
+        return 'CUDAPinnedPlace'
+
+
+class NPUPlace:
+    """API-compat place for ported scripts; maps to the single
+    accelerator PJRT exposes."""
+
+    def __init__(self, id=0):
+        self.id = id
+
+    def __repr__(self):
+        return f'NPUPlace({self.id})'
+
+
+def cholesky(x, upper=False, name=None):
+    """paddle.cholesky — top-level alias of linalg.cholesky."""
+    from .ops.linalg import cholesky as _c
+    return _c(x, upper=upper)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """paddle.create_parameter (static-graph parameter helper)."""
+    from .static.api_tail import create_parameter as _cp
+    return _cp(shape, dtype, name=name, attr=attr, is_bias=is_bias,
+               default_initializer=default_initializer)
+
+
+def check_shape(shape):
+    """paddle.check_shape — validate a shape argument (utils.check
+    parity: ints or -1 placeholders)."""
+    for d in (shape if isinstance(shape, (list, tuple)) else [shape]):
+        if not isinstance(d, (int, np.integer)) or (d < -1):
+            raise ValueError(f"invalid dim {d!r} in shape {shape}")
+    return True
+
+
+def tanh_(x, name=None):
+    """paddle.tanh_ — value-returning inplace spelling (JAX buffers are
+    immutable; the tensor rebinds)."""
+    from .ops.math import tanh
+    out = tanh(x)
+    if isinstance(x, Tensor):
+        x._data = out.data
+    return out
+
+
+def reshape_(x, shape, name=None):
+    """paddle.reshape_ — inplace spelling of reshape."""
+    from .ops.manip import reshape
+    out = reshape(x, shape)
+    if isinstance(x, Tensor):
+        x._data = out.data
+    return out
+
+
+def squeeze_(x, axis=None, name=None):
+    """paddle.squeeze_ — inplace spelling of squeeze."""
+    from .ops.manip import squeeze
+    out = squeeze(x, axis)
+    if isinstance(x, Tensor):
+        x._data = out.data
+    return out
+
+
+def unsqueeze_(x, axis, name=None):
+    """paddle.unsqueeze_ — inplace spelling of unsqueeze."""
+    from .ops.manip import unsqueeze
+    out = unsqueeze(x, axis)
+    if isinstance(x, Tensor):
+        x._data = out.data
+    return out

@@ -21,3 +21,7 @@ from . import utils
 
 def get_backend():
     return 'xla'
+
+from .entry_attr import (EntryAttr, ProbabilityEntry,  # noqa
+                         CountFilterEntry)
+from .fleet.dataset import InMemoryDataset, QueueDataset  # noqa

@@ -1,0 +1,118 @@
+"""fleet datasets (reference:
+python/paddle/distributed/fleet/dataset/dataset.py InMemoryDataset:259 /
+QueueDataset) — the MultiSlot file-feed path for PS/CTR training,
+backed by the native C++ feed (csrc/data_feed.cc via
+core/native.NativeDataFeed): QueueDataset streams batches straight
+from the file channel; InMemoryDataset loads + globally shuffles in
+RAM first (the reference's load_into_memory / global_shuffle pair).
+"""
+import numpy as np
+
+from ...core.tensor import Tensor
+
+
+class DatasetBase:
+    def __init__(self):
+        self._slots = []
+        self._batch_size = 1
+        self._thread_num = 1
+        self._filelist = []
+        self._feed = None
+
+    def init(self, batch_size=1, thread_num=1, use_var=None,
+             pipe_command=None, input_type=0, fs_name=None,
+             fs_ugi=None, **kwargs):
+        """Configure like the reference's dataset.init(**kwargs):
+        `use_var` gives the slot layout (static data Variables — dtype
+        decides the float/int64 slot kind, shape[-1] the width)."""
+        self._batch_size = int(batch_size)
+        self._thread_num = int(thread_num)
+        if use_var:
+            self._slots = []
+            for v in use_var:
+                width = int(np.prod([d for d in v.shape if d and d > 0])
+                            or 1)
+                kind = 'int64' if 'int' in str(v.dtype) else 'float'
+                self._slots.append((width, kind))
+        return self
+
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+
+    def _build(self):
+        from ...core.native import NativeDataFeed
+        self._feed = NativeDataFeed(self._slots, self._batch_size,
+                                    num_threads=self._thread_num)
+        self._feed.set_filelist(self._filelist)
+        return self._feed
+
+    def _as_tensors(self, f, i):
+        import jax.numpy as jnp
+        out = []
+        fo = io_ = 0
+        for w, kind in self._slots:
+            if kind == 'float':
+                out.append(Tensor(jnp.asarray(f[:, fo:fo + w])))
+                fo += w
+            else:
+                out.append(Tensor(jnp.asarray(i[:, io_:io_ + w])))
+                io_ += w
+        return out
+
+
+class QueueDataset(DatasetBase):
+    """Streaming dataset: batches come off the multi-thread file
+    channel in arrival order (reference QueueDataset)."""
+
+    def __iter__(self):
+        feed = self._build()
+        feed.start()
+        for f, i in feed:
+            yield self._as_tensors(f, i)
+
+
+class InMemoryDataset(DatasetBase):
+    """Load-then-shuffle dataset (reference InMemoryDataset:
+    load_into_memory + local/global_shuffle + release_memory)."""
+
+    def __init__(self):
+        super().__init__()
+        self._loaded = False
+        self._seed = 0
+
+    def load_into_memory(self):
+        self._build()
+        self._feed.load_into_memory(seed=self._seed)
+        self._loaded = True
+
+    def local_shuffle(self):
+        self._shuffle(seed=self._seed + 1)
+
+    def global_shuffle(self, fleet=None, thread_num=None):
+        # one-process global == local; under fleetrun each rank holds
+        # its file shard and shuffles it (the reference's semantics
+        # reduce to this when the shard is per-rank disjoint)
+        self._shuffle(seed=self._seed + 1)
+
+    def _shuffle(self, seed):
+        if not self._loaded:
+            raise RuntimeError("call load_into_memory() first")
+        self._seed = seed
+        self._feed.load_into_memory(seed=seed)
+
+    def get_memory_data_size(self, fleet=None):
+        if not self._loaded:
+            return 0
+        return int(self._feed.memory_size())
+
+    def release_memory(self):
+        self._feed = None
+        self._loaded = False
+
+    def __iter__(self):
+        if not self._loaded:
+            raise RuntimeError(
+                "InMemoryDataset: call load_into_memory() before "
+                "iterating (QueueDataset streams directly)")
+        for f, i in self._feed.iter_memory():
+            yield self._as_tensors(f, i)

@@ -349,3 +349,92 @@ class ProgramTranslator:
 
 def enable_to_static(flag=True):
     ProgramTranslator.get_instance().enable(flag)
+
+
+# -- dy2static logging + traced-layer sheet ---------------------------------
+
+_verbosity = 0
+_code_level = 0
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """paddle.jit.set_verbosity — dy2static transform logging level."""
+    global _verbosity
+    _verbosity = int(level)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """paddle.jit.set_code_level — print transformed code at/below the
+    given level."""
+    global _code_level
+    _code_level = int(level)
+
+
+class TranslatedLayer:
+    """paddle.jit.TranslatedLayer — the callable a jit.load returns
+    (wraps a loaded inference Program + params; parity:
+    fluid/dygraph/io.py TranslatedLayer)."""
+
+    def __init__(self, program, feed_names, fetch_vars, scope=None):
+        self._program = program
+        self._feed_names = feed_names
+        self._fetch = fetch_vars
+        self._scope = scope
+
+    def __call__(self, *args):
+        from ..static.executor import Executor
+        exe = Executor()
+        feed = {n: (a.data if isinstance(a, Tensor) else a)
+                for n, a in zip(self._feed_names, args)}
+        outs = exe.run(self._program, feed=feed, fetch_list=self._fetch)
+        outs = [Tensor(jnp.asarray(o)) for o in outs]
+        return outs[0] if len(outs) == 1 else outs
+
+    def eval(self):
+        return self
+
+    def train(self):
+        raise NotImplementedError(
+            "TranslatedLayer wraps an inference program; rebuild the "
+            "dygraph Layer for training")
+
+
+class TracedLayer:
+    """paddle.jit.TracedLayer — trace a dygraph layer into a static
+    program via to_static machinery (fluid/dygraph/jit.py). `trace`
+    returns (outputs, traced) where traced(input...) replays the
+    compiled function."""
+
+    def __init__(self, fn, example_args):
+        self._fn = fn
+        self._compiled = jax.jit(fn)
+        self._example = example_args
+
+    @staticmethod
+    def trace(layer, inputs):
+        inputs = list(inputs)
+
+        def fn(*arrs):
+            outs = layer(*[Tensor(a) for a in arrs])
+            if isinstance(outs, (list, tuple)):
+                return [o.data for o in outs]
+            return outs.data
+        arrs = [i.data if isinstance(i, Tensor) else jnp.asarray(i)
+                for i in inputs]
+        traced = TracedLayer(fn, arrs)
+        out = traced(*inputs)
+        return out, traced
+
+    def __call__(self, *args):
+        arrs = [a.data if isinstance(a, Tensor) else jnp.asarray(a)
+                for a in args]
+        out = self._compiled(*arrs)
+        if isinstance(out, (list, tuple)):
+            outs = [Tensor(o) for o in out]
+            return outs[0] if len(outs) == 1 else outs
+        return Tensor(out)
+
+    def save_inference_model(self, path, feed=None, fetch=None):
+        raise NotImplementedError(
+            "TracedLayer.save_inference_model: use paddle.jit.save / "
+            "static.save_inference_model (StableHLO export) instead")

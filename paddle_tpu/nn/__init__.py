@@ -45,3 +45,9 @@ from .decode import (Decoder, BeamSearchDecoder, dynamic_decode,  # noqa
                      DecodeHelper, TrainingHelper,
                      GreedyEmbeddingHelper, SampleEmbeddingHelper,
                      BasicDecoder)
+
+from .layer.pooling import (MaxPool3D, AvgPool3D, AdaptiveAvgPool3D,  # noqa
+                            AdaptiveMaxPool1D, AdaptiveMaxPool3D)
+from .layer.conv import Conv3DTranspose  # noqa
+from .layer.common import Dropout3D, PairwiseDistance  # noqa
+from .layer.loss import HSigmoidLoss  # noqa

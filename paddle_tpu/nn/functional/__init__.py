@@ -35,3 +35,15 @@ def diag_embed(*a, **k):
 def gather_tree(ids, parents):
     from ...ops.contrib import gather_tree as _gt
     return _gt(ids, parents)
+
+from ...ops.nn_ops import (  # noqa — r4 sheet remainder
+    max_pool3d, avg_pool3d, adaptive_avg_pool1d, adaptive_max_pool1d,
+    adaptive_avg_pool3d, adaptive_max_pool3d, conv1d_transpose,
+    conv3d_transpose, bilinear, dropout3d, dice_loss,
+    sigmoid_focal_loss, relu_, softmax_)
+from ...ops.contrib import hsigmoid_loss  # noqa
+
+
+def tanh_(x, name=None):
+    from ...ops.math import tanh
+    return tanh(x)

@@ -254,3 +254,27 @@ class ZeroPad2D(Layer):
 
     def forward(self, x):
         return manip.pad(x, self._padding, mode='constant', value=0.0)
+
+
+class Dropout3D(Layer):
+    """paddle.nn.Dropout3D — channel dropout over 5-D input."""
+
+    def __init__(self, p=0.5, data_format='NCDHW', name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.dropout3d(x, self.p, training=self.training)
+
+
+class PairwiseDistance(Layer):
+    """paddle.nn.PairwiseDistance — p-norm distance between rows."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.args = (p, epsilon, keepdim)
+
+    def forward(self, x, y):
+        from ...ops.nn_ops import pairwise_distance
+        p, eps, kd = self.args
+        return pairwise_distance(x, y, p=p, epsilon=eps, keepdim=kd)

@@ -116,3 +116,19 @@ class Conv1DTranspose(_ConvNd):
         out = F.conv2d_transpose(x4, w4, self.bias, (s, 1),
                                  [(p, p), (0, 0)], 0, 1, self._groups)
         return run_op('squeeze2', lambda a: jnp.squeeze(a, -1), [out])
+
+
+class Conv3DTranspose(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None, data_format='NCDHW'):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, weight_attr, bias_attr,
+                         data_format, nd=3, transpose=True,
+                         output_padding=output_padding)
+
+    def forward(self, x, output_size=None):
+        return F.conv3d_transpose(x, self.weight, self.bias,
+                                  self._stride, self._padding,
+                                  self._output_padding, self._groups,
+                                  self._dilation, output_size)

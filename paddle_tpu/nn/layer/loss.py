@@ -156,3 +156,29 @@ class CTCLoss(Layer):
     def forward(self, log_probs, labels, input_lengths, label_lengths):
         return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
                           blank=self._blank, reduction=self._reduction)
+
+
+class HSigmoidLoss(Layer):
+    """paddle.nn.HSigmoidLoss — hierarchical sigmoid over a complete
+    binary tree (operators/hierarchical_sigmoid_op.cc)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if is_custom:
+            raise NotImplementedError(
+                "HSigmoidLoss custom trees: pass path codes through "
+                "ops.contrib.hsigmoid_loss directly")
+        self.num_classes = num_classes
+        self.weight = self.create_parameter(
+            [num_classes - 1, feature_size], weight_attr)
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                [num_classes - 1], bias_attr, is_bias=True)
+
+    def forward(self, input, label):
+        from ...ops.contrib import hsigmoid_loss
+        return hsigmoid_loss(input, label, self.num_classes,
+                             self.weight, self.bias)

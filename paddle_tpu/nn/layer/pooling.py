@@ -80,3 +80,60 @@ class AdaptiveAvgPool1D(Layer):
         x4 = run_op('unsqueeze2', lambda a: jnp.expand_dims(a, -1), [x])
         out = F.adaptive_avg_pool2d(x4, (self._output_size, 1))
         return run_op('squeeze2', lambda a: jnp.squeeze(a, -1), [out])
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 return_mask=False, ceil_mode=False,
+                 data_format='NCDHW', name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, ceil_mode)
+
+    def forward(self, x):
+        k, s, p, cm = self.args
+        return F.max_pool3d(x, k, s, p, ceil_mode=cm)
+
+
+class AvgPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, exclusive=True, divisor_override=None,
+                 data_format='NCDHW', name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, ceil_mode, exclusive,
+                     divisor_override)
+
+    def forward(self, x):
+        k, s, p, cm, ex, dv = self.args
+        return F.avg_pool3d(x, k, s, p, ceil_mode=cm, exclusive=ex,
+                            divisor_override=dv)
+
+
+class AdaptiveAvgPool3D(Layer):
+    def __init__(self, output_size, data_format='NCDHW', name=None):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_avg_pool3d(x, self.output_size)
+
+
+class AdaptiveMaxPool1D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.return_mask = return_mask
+
+    def forward(self, x):
+        return F.adaptive_max_pool1d(x, self.output_size,
+                                     return_mask=self.return_mask)
+
+
+class AdaptiveMaxPool3D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.return_mask = return_mask
+
+    def forward(self, x):
+        return F.adaptive_max_pool3d(x, self.output_size,
+                                     return_mask=self.return_mask)

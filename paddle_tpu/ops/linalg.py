@@ -153,3 +153,11 @@ def einsum(equation, *operands):
 
 def histogramdd(*a, **k):
     raise NotImplementedError
+
+
+def inv(x, name=None):
+    """paddle.linalg.inv (operators/inverse_op.cc)."""
+    from ..core.autograd import run_op
+    import jax.numpy as jnp
+    from .common import as_tensor
+    return run_op('inverse', jnp.linalg.inv, [as_tensor(x)])

@@ -1301,3 +1301,299 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
                     cols[:, :, i, j])
         return out[:, :, p[0]:p[0] + oh, p[1]:p[1] + ow]
     return run_op('fold', fn, [x])
+
+
+# ---------------------------------------------------------------------------
+# 1-D / 3-D pooling + transpose-conv remainder (paddle.nn.functional sheet)
+# ---------------------------------------------------------------------------
+
+def _pool_nd(x, nd, ksize, stride, padding, kind, ceil_mode, exclusive):
+    """Shared reduce_window pooling for 1-D/3-D (2-D rides the tuned
+    max_pool2d/avg_pool2d paths). ceil_mode adds high-side padding;
+    exclusive average divides by the real (unpadded) window count."""
+    x = as_tensor(x)
+    def tolist(v):
+        return [v] * nd if isinstance(v, int) else list(v)
+    ksize, stride, padding = tolist(ksize), \
+        tolist(stride if stride is not None else ksize), tolist(padding)
+
+    def fn(a):
+        dims = (1, 1) + tuple(ksize)
+        strides = (1, 1) + tuple(stride)
+        spatial = a.shape[2:]
+        hi = []
+        for d, k, st, p in zip(spatial, ksize, stride, padding):
+            if ceil_mode:
+                out = -(-(d + 2 * p - k) // st) + 1
+                hi.append(max(int((out - 1) * st + k - d - p), p))
+            else:
+                hi.append(p)
+        pads = ((0, 0), (0, 0)) + tuple(
+            (p, h) for p, h in zip(padding, hi))
+        if kind == 'max':
+            return jax.lax.reduce_window(
+                a, -jnp.inf, jax.lax.max, dims, strides, pads)
+        s = jax.lax.reduce_window(a, 0.0, jax.lax.add, dims, strides,
+                                  pads)
+        if kind == 'sum':
+            return s
+        if exclusive and (any(padding) or any(
+                h != p for p, h in zip(padding, hi))):
+            cnt = jax.lax.reduce_window(
+                jnp.ones_like(a), 0.0, jax.lax.add, dims, strides, pads)
+            return s / jnp.maximum(cnt, 1.0)
+        return s / float(np.prod(ksize))
+    return run_op(f'pool{nd}d_{kind}', fn, [x])
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format='NCDHW', name=None):
+    """paddle.nn.functional.max_pool3d (operators/pool_op.cc 3-D)."""
+    if return_mask:
+        raise NotImplementedError("max_pool3d return_mask: use the 2-D "
+                                  "path per-slice if indices are needed")
+    return _pool_nd(x, 3, kernel_size, stride, padding, 'max',
+                    ceil_mode, True)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None,
+               data_format='NCDHW', name=None):
+    """paddle.nn.functional.avg_pool3d. divisor_override divides the
+    raw window SUM (paddle semantics) — it replaces both the kernel
+    volume and the exclusive count."""
+    if divisor_override is not None:
+        out = _pool_nd(x, 3, kernel_size, stride, padding, 'sum',
+                       ceil_mode, False)
+        from .common import as_tensor as _at
+        return out * (1.0 / float(divisor_override))
+    return _pool_nd(x, 3, kernel_size, stride, padding, 'avg',
+                    ceil_mode, exclusive)
+
+
+def _adaptive_pool_nd(x, nd, output_size, kind):
+    """Adaptive pooling with the reference's floor/ceil bin edges:
+    bin i covers [floor(i*D/od), ceil((i+1)*D/od)). Output sizes are
+    static, so each bin is a static slice reduce — XLA fuses the
+    (small) slice set; uneven bins are exact, not approximated."""
+    x = as_tensor(x)
+    sizes = [output_size] * nd if isinstance(output_size, int) else \
+        list(output_size)
+
+    def fn(a):
+        out = a
+        for ax in range(nd):
+            axis = 2 + ax
+            D = out.shape[axis]
+            od = int(sizes[ax])
+            slabs = []
+            for i in range(od):
+                lo = (i * D) // od
+                hi = -(-((i + 1) * D) // od)
+                sl = jax.lax.slice_in_dim(out, lo, hi, axis=axis)
+                red = sl.max(axis=axis, keepdims=True) if kind == 'max' \
+                    else sl.mean(axis=axis, keepdims=True)
+                slabs.append(red)
+            out = jnp.concatenate(slabs, axis=axis)
+        return out
+    return run_op(f'adaptive_pool{nd}d_{kind}', fn, [x])
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    """paddle.nn.functional.adaptive_avg_pool1d ([N, C, L])."""
+    return _adaptive_pool_nd(x, 1, output_size, 'avg')
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    """paddle.nn.functional.adaptive_max_pool1d."""
+    if return_mask:
+        raise NotImplementedError("adaptive_max_pool1d return_mask")
+    return _adaptive_pool_nd(x, 1, output_size, 'max')
+
+
+def adaptive_avg_pool3d(x, output_size, data_format='NCDHW', name=None):
+    """paddle.nn.functional.adaptive_avg_pool3d ([N, C, D, H, W])."""
+    return _adaptive_pool_nd(x, 3, output_size, 'avg')
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    """paddle.nn.functional.adaptive_max_pool3d."""
+    if return_mask:
+        raise NotImplementedError("adaptive_max_pool3d return_mask")
+    return _adaptive_pool_nd(x, 3, output_size, 'max')
+
+
+def _opad_from_output_size(in_sizes, k, stride, padding, dilation,
+                           opad, output_size):
+    """Derive output_padding from a requested output_size (paddle
+    derives it as output_size - default_size and validates
+    0 <= opad < stride)."""
+    if output_size is None:
+        return opad
+    sizes = [output_size] * len(in_sizes) \
+        if isinstance(output_size, int) else list(output_size)
+    out = []
+    for d, kk, st, p, dil, want in zip(in_sizes, k, stride, padding,
+                                       dilation, sizes):
+        base = (int(d) - 1) * st - 2 * p + dil * (kk - 1) + 1
+        extra = int(want) - base
+        if not 0 <= extra < st:
+            raise ValueError(
+                f"output_size {want} unreachable: base {base}, "
+                f"stride {st} (need base <= output_size < base+stride)")
+        out.append(extra)
+    return tuple(out)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format='NCL', name=None):
+    """paddle.nn.functional.conv1d_transpose — rides the 2-D kernel
+    with a singleton height."""
+    from .manip import squeeze, unsqueeze
+    if output_size is not None:
+        output_padding = _opad_from_output_size(
+            [as_tensor(x).shape[2]], [as_tensor(weight).shape[2]],
+            [stride if isinstance(stride, int) else stride[0]],
+            [padding if isinstance(padding, int) else padding[0]],
+            [dilation if isinstance(dilation, int) else dilation[0]],
+            output_padding, output_size)[0]
+    x4 = unsqueeze(x, 2)                       # [N, C, 1, L]
+    w = as_tensor(weight)
+    from ..core.tensor import Tensor as _T
+    w4 = _T(w.data[:, :, None, :])             # [I, O, 1, K]
+    out = conv2d_transpose(x4, w4, bias, stride=(1, stride),
+                           padding=(0, padding),
+                           output_padding=(0, output_padding),
+                           dilation=(1, dilation), groups=groups)
+    return squeeze(out, 2)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format='NCDHW', name=None):
+    """paddle.nn.functional.conv3d_transpose (weight layout IODHW):
+    conv_general_dilated with the lo/hi = dilation*(k-1) - p transpose
+    transform and lhs_dilation = stride (same convention as the 2-D
+    path above)."""
+    x, weight = as_tensor(x), as_tensor(weight)
+    def to3(v):
+        return (v, v, v) if isinstance(v, int) else tuple(v)
+    stride, dilation, padding = to3(stride), to3(dilation), to3(padding)
+    opad = to3(output_padding)
+    k = weight.shape[2:]
+    if output_size is not None:
+        opad = _opad_from_output_size(x.shape[2:], k, stride, padding,
+                                      dilation, opad, output_size)
+    pads = [(d * (kk - 1) - p, d * (kk - 1) - p + op)
+            for d, kk, p, op in zip(dilation, k, padding, opad)]
+    cin = int(weight.shape[0])
+    tensors = [x, weight] + ([as_tensor(bias)] if bias is not None
+                             else [])
+
+    def fn(a, w, *rest):
+        w2 = jnp.flip(w, axis=(2, 3, 4))
+        if groups > 1:
+            wg = w2.reshape(groups, cin // groups, *w2.shape[1:])
+            w2 = jnp.concatenate(
+                [g.transpose(1, 0, 2, 3, 4) for g in wg], axis=0)
+        else:
+            w2 = w2.transpose(1, 0, 2, 3, 4)
+        out = jax.lax.conv_general_dilated(
+            a, w2, window_strides=(1, 1, 1), padding=pads,
+            lhs_dilation=stride, rhs_dilation=dilation,
+            dimension_numbers=('NCDHW', 'OIDHW', 'NCDHW'),
+            feature_group_count=groups)
+        if rest:
+            out = out + rest[0].reshape(1, -1, 1, 1, 1)
+        return out
+    return run_op('conv3d_transpose', fn, tensors)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """paddle.nn.functional.bilinear (operators/bilinear_tensor_product
+    _op.cc): out[n, o] = x1[n, :] @ W[o] @ x2[n, :] (+ bias)."""
+    x1, x2 = as_tensor(x1), as_tensor(x2)
+    weight = as_tensor(weight, ref=x1)
+    tensors = [x1, x2, weight] + ([as_tensor(bias)] if bias is not None
+                                  else [])
+
+    def fn(a, b, w, *rest):
+        out = jnp.einsum('ni,oij,nj->no', a, w, b)
+        if rest:
+            out = out + rest[0].reshape(1, -1)
+        return out
+    return run_op('bilinear', fn, tensors)
+
+
+def dropout3d(x, p=0.5, training=True, data_format='NCDHW', name=None):
+    """paddle.nn.functional.dropout3d — drops whole channels of the
+    5-D input (the 3-D analogue of dropout2d)."""
+    x = as_tensor(x)
+    if not training or p == 0.0:
+        return x
+    from ..core import rng as rng_mod
+    key = rng_mod.next_key()
+
+    def fn(a):
+        keep = jax.random.bernoulli(key, 1.0 - p,
+                                    (a.shape[0], a.shape[1], 1, 1, 1))
+        return jnp.where(keep, a / (1.0 - p), 0.0)
+    return run_op('dropout3d', fn, [x])
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    """paddle.nn.functional.dice_loss: 1 - 2|X∩Y| / (|X|+|Y|) over the
+    trailing class axis (operators/dice_loss semantics; the static
+    fluid spelling lives in static/nn.py)."""
+    input = as_tensor(input)
+    label = as_tensor(label, ref=input)
+
+    def fn(p, l):
+        l = l.astype(p.dtype)
+        if l.shape[-1] == 1 and p.shape[-1] > 1:
+            l = jax.nn.one_hot(l[..., 0].astype(jnp.int32),
+                               p.shape[-1], dtype=p.dtype)
+        red = tuple(range(1, p.ndim))
+        inter = (p * l).sum(red)
+        union = p.sum(red) + l.sum(red)
+        return (1.0 - (2.0 * inter + epsilon)
+                / (union + epsilon)).mean()
+    return run_op('dice_loss', fn, [input, label], n_nondiff=1)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25,
+                       gamma=2.0, reduction='sum', name=None):
+    """paddle.nn.functional.sigmoid_focal_loss (nn/functional/loss.py:
+    1555 — the 2.x API: float one-hot labels, optional normalizer,
+    reduction; the fluid fg_num spelling lives in vision.detection)."""
+    logit = as_tensor(logit)
+    label = as_tensor(label, ref=logit)
+    tensors = [logit, label] + ([as_tensor(normalizer)]
+                                if normalizer is not None else [])
+
+    def fn(x, y, *rest):
+        y = y.astype(x.dtype)
+        sig = jax.nn.sigmoid(x)
+        ls = jax.nn.log_sigmoid(x)
+        lns = jax.nn.log_sigmoid(-x)
+        loss = -y * alpha * (1 - sig) ** gamma * ls \
+            - (1 - y) * (1 - alpha) * sig ** gamma * lns
+        if rest:
+            loss = loss / rest[0].reshape(())
+        if reduction == 'sum':
+            return loss.sum()
+        if reduction == 'mean':
+            return loss.mean()
+        return loss
+    return run_op('sigmoid_focal_loss_v2', fn, tensors, n_nondiff=1)
+
+
+# in-place spellings: JAX arrays are immutable, so these are the
+# value-returning forms under the reference's aliases
+def relu_(x, name=None):
+    return relu(x)
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    return softmax(x, axis=axis)

@@ -95,3 +95,12 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
 
 def default_startup_program_():
     return default_startup_program()
+
+from .api_tail import (cpu_places, cuda_places, xpu_places,  # noqa
+                       create_parameter, create_global_var,
+                       load_program_state, set_program_state,
+                       serialize_persistables, deserialize_persistables,
+                       save_to_file, load_from_file, normalize_program,
+                       WeightNormParamAttr)
+from .fluid_layers import Print  # noqa
+from .nn import accuracy, auc  # noqa

@@ -1,0 +1,150 @@
+"""paddle.static sheet remainder: program-state utilities, places,
+param helpers (reference: python/paddle/static/__init__.py __all__,
+python/paddle/fluid/framework.py program-state fns)."""
+import os
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def cpu_places(device_count=None):
+    """paddle.static.cpu_places."""
+    from ..device import CPUPlace
+    n = device_count or int(os.environ.get('CPU_NUM', 1))
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """paddle.static.cuda_places — maps to the accelerator devices PJRT
+    exposes (TPU chips here)."""
+    import jax
+    from ..device import CUDAPlace
+    ids = device_ids if device_ids is not None else \
+        range(len(jax.devices()))
+    return [CUDAPlace(i) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    """paddle.static.xpu_places — same accelerator mapping."""
+    return cuda_places(device_ids)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """paddle.static.create_parameter."""
+    from .nn import _make_param
+    return _make_param(list(shape), dtype, initializer=default_initializer,
+                       attr=attr)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """paddle.static.create_global_var — a filled persistable var in the
+    startup/main programs."""
+    from .program import default_main_program
+    from ..nn import initializer as I
+    prog = default_main_program()
+    block = prog.global_block()
+    v = block.create_parameter(name=name, shape=list(shape), dtype=dtype,
+                               initializer=I.Constant(float(value)))
+    v.persistable = persistable
+    return v
+
+
+def load_program_state(model_path, var_list=None):
+    """paddle.static.load_program_state — read a saved .pdiparams file
+    into a {name: ndarray} dict (pairs with static.save's npz
+    container)."""
+    from .serialization import _load_npz
+    path = model_path if model_path.endswith('.pdiparams') \
+        else model_path + '.pdiparams'
+    with open(path, 'rb') as f:
+        state = _load_npz(f.read())
+    if var_list is not None:
+        names = {getattr(v, 'name', v) for v in var_list}
+        state = {k: v for k, v in state.items() if k in names}
+    return {k: np.asarray(v) for k, v in state.items()}
+
+
+def set_program_state(program, state_dict):
+    """paddle.static.set_program_state — install ndarray values into
+    the program's parameter variables."""
+    import jax.numpy as jnp
+    from .executor import global_scope
+    scope = global_scope()
+    for name, arr in state_dict.items():
+        scope.set(name, jnp.asarray(arr))
+    for block in program.blocks:
+        for name, var in getattr(block, 'vars', {}).items():
+            if name in state_dict and hasattr(var, 'set_value'):
+                var.set_value(state_dict[name])
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None,
+                           program=None):
+    """paddle.static.serialize_persistables — the params side of the
+    inference-model pair as bytes (the npz container static.save
+    writes)."""
+    import jax
+    from .program import default_main_program
+    from .serialization import _npz_bytes, _ConstVar
+    from .executor import global_scope
+    prog = program or default_main_program()
+    scope = global_scope()
+    state = {}
+    for v in prog.list_vars():
+        if getattr(v, 'persistable', False) \
+                and not isinstance(v, _ConstVar):
+            arr = scope.find_var(v.name)
+            if arr is not None:
+                state[v.name] = np.asarray(jax.device_get(arr))
+    return _npz_bytes(state)
+
+
+def deserialize_persistables(program, data, executor=None):
+    """paddle.static.deserialize_persistables — stage the serialized
+    params back into the scope."""
+    import jax.numpy as jnp
+    from .serialization import _load_npz
+    from .executor import global_scope
+    scope = global_scope()
+    for name, arr in _load_npz(data).items():
+        scope.set(name, jnp.asarray(arr))
+    return program
+
+
+def save_to_file(path, content):
+    """paddle.static.save_to_file."""
+    with open(path, 'wb') as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    """paddle.static.load_from_file."""
+    with open(path, 'rb') as f:
+        return f.read()
+
+
+def normalize_program(program, feed_vars, fetch_vars):
+    """paddle.static.normalize_program — prune to the feed->fetch
+    closure (the Executor's replay already dead-code-eliminates through
+    XLA; pruning here keeps the serialized artifact minimal)."""
+    if hasattr(program, '_prune'):
+        return program._prune(feed_vars, fetch_vars)
+    return program
+
+
+class WeightNormParamAttr:
+    """paddle.static.WeightNormParamAttr — ParamAttr carrying a
+    weight-norm reparameterization request (dim)."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable

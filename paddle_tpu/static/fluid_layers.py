@@ -125,45 +125,11 @@ def pool3d(input, pool_size=-1, pool_type='max', pool_stride=1,
 
 def _pool3d_generic(x, ksize, stride, padding, kind, ceil_mode,
                     exclusive):
-    import jax
-    import jax.numpy as jnp
-    from ..core.autograd import run_op
-    if isinstance(ksize, int):
-        ksize = [ksize] * 3
-    if isinstance(stride, int):
-        stride = [stride] * 3
-    if isinstance(padding, int):
-        padding = [padding] * 3
-
-    def fn(a):
-        dims = (1, 1) + tuple(ksize)
-        strides = (1, 1) + tuple(stride)
-        spatial = a.shape[2:]
-        hi = []
-        for d, k, st, p in zip(spatial, ksize, stride, padding):
-            if ceil_mode:
-                out = -(-(d + 2 * p - k) // st) + 1     # ceil
-                need = (out - 1) * st + k - d - p
-                hi.append(max(int(need), p))
-            else:
-                hi.append(p)
-        pads = ((0, 0), (0, 0)) + tuple(
-            (p, h) for p, h in zip(padding, hi))
-        if kind == 'max':
-            return jax.lax.reduce_window(
-                a, -jnp.inf, jax.lax.max, dims, strides, pads)
-        s = jax.lax.reduce_window(a, 0.0, jax.lax.add, dims, strides,
-                                  pads)
-        padded_windows = any(padding) or any(
-            h != p for (p, h) in ((p2, h2) for (p2, h2)
-                                  in zip(padding, hi)))
-        if exclusive and padded_windows:
-            ones = jnp.ones_like(a)
-            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims,
-                                        strides, pads)
-            return s / jnp.maximum(cnt, 1.0)
-        return s / float(np.prod(ksize))
-    return run_op('pool3d', fn, [x])
+    """Delegates to the shared reduce_window pooling helper
+    (ops/nn_ops.py _pool_nd) — one implementation for every N-D pool."""
+    from ..ops.nn_ops import _pool_nd
+    return _pool_nd(x, 3, ksize, stride, padding, kind, ceil_mode,
+                    exclusive)
 
 
 def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None,
@@ -402,54 +368,23 @@ def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
                      padding=0, stride=1, dilation=1, groups=1,
                      param_attr=None, bias_attr=None, use_cudnn=True,
                      act=None, name=None, data_format='NCDHW'):
-    """fluid.layers.conv3d_transpose (operators/conv_transpose_op.cc 3-D
-    path): conv_general_dilated with the transpose padding transform
-    lo/hi = dilation*(k-1) - p (the same convention ops/nn_ops.py
-    conv2d_transpose uses), lhs_dilation = stride, flipped IODHW->OIDHW
-    weights, feature_group_count for groups."""
-    import jax
-    import jax.numpy as jnp
-    from ..core.autograd import run_op
+    """fluid.layers.conv3d_transpose — creates the IODHW weight/bias
+    params and delegates to the shared functional kernel
+    (ops/nn_ops.py conv3d_transpose, the single transpose-conv
+    implementation)."""
+    from ..ops.nn_ops import conv3d_transpose as _f_conv3dt
     x = as_tensor(input)
     cin = int(x.shape[1])
     if isinstance(filter_size, int):
         filter_size = [filter_size] * 3
-    if isinstance(stride, int):
-        stride = [stride] * 3
-    if isinstance(padding, int):
-        padding = [padding] * 3
-    if isinstance(dilation, int):
-        dilation = [dilation] * 3
     dt = str(x.dtype)
     w = _mode_param([cin, num_filters // groups] + list(filter_size), dt)
     b = None
     if bias_attr is not False:
         b = _mode_param([num_filters], dt)
-    pads = [(d * (k - 1) - p, d * (k - 1) - p)
-            for d, k, p in zip(dilation, filter_size, padding)]
-
-    def fn(a, wt, *rest):
-        # IODHW -> OIDHW with spatial flip = transpose conv as
-        # stride-dilated direct conv
-        w2 = jnp.flip(wt, axis=(2, 3, 4))
-        if groups > 1:
-            # per-group transpose of the I/O axes keeps group blocks
-            # aligned with feature_group_count's output layout
-            wg = w2.reshape(groups, cin // groups, *w2.shape[1:])
-            w2 = jnp.concatenate(
-                [g.transpose(1, 0, 2, 3, 4) for g in wg], axis=0)
-        else:
-            w2 = w2.transpose(1, 0, 2, 3, 4)
-        out = jax.lax.conv_general_dilated(
-            a, w2, window_strides=(1, 1, 1), padding=pads,
-            lhs_dilation=tuple(stride), rhs_dilation=tuple(dilation),
-            dimension_numbers=('NCDHW', 'OIDHW', 'NCDHW'),
-            feature_group_count=groups)
-        if rest:
-            out = out + rest[0].reshape(1, -1, 1, 1, 1)
-        return out
-    out = (run_op('conv3d_transpose', fn, [x, w, b]) if b is not None
-           else run_op('conv3d_transpose', fn, [x, w]))
+    out = _f_conv3dt(x, w, b, stride=stride, padding=padding,
+                     groups=groups, dilation=dilation,
+                     output_size=output_size)
     if act:
         out = getattr(F, act)(out)
     return out
@@ -794,6 +729,7 @@ def _reader_legacy(name_):
 
 
 py_reader = _reader_legacy('py_reader')
+read_file = _reader_legacy('read_file')
 double_buffer = _reader_legacy('double_buffer')
 create_py_reader_by_data = _reader_legacy('create_py_reader_by_data')
 

@@ -1100,7 +1100,7 @@ def _reexport():
                'Switch', 'IfElse', 'StaticRNN', 'DynamicRNN',
                'lod_append', 'lod_reset', 'reorder_lod_tensor_by_rank',
                'get_tensor_from_selected_rows', 'merge_selected_rows',
-               'py_reader', 'double_buffer',
+               'py_reader', 'double_buffer', 'read_file',
                'create_py_reader_by_data']),
         (_contrib, ['center_loss', 'sampled_softmax_with_cross_entropy',
                     'ctc_align']),

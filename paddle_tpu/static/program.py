@@ -54,6 +54,9 @@ def disable_static():
     autograd.STATIC_RECORD_HOOK = None
 
 
+_GLOBAL_NAME_COUNTER = {}
+
+
 class Variable:
     """Symbolic tensor (parity: fluid/framework.py Variable). Holds only an
     aval (shape/dtype); values live in the Scope at run time."""
@@ -267,6 +270,16 @@ class Program:
         self._fetch_list = None
 
     def _unique_name(self, prefix):
+        # PARAMETER names must be process-unique, not per-Program: the
+        # global scope keys materialized params by name, so two
+        # Programs both naming their first weight "param_0" would
+        # silently share one buffer (the reference's UniqueNameGenerator
+        # is likewise process-global — fluid/unique_name.py). Temp/const
+        # names stay per-Program (they never enter the scope).
+        if prefix == 'param':
+            n = _GLOBAL_NAME_COUNTER.get(prefix, 0)
+            _GLOBAL_NAME_COUNTER[prefix] = n + 1
+            return f"{prefix}_{n}"
         self._name_counter[prefix] = self._name_counter.get(prefix, 0) + 1
         return f"{prefix}_{self._name_counter[prefix] - 1}"
 

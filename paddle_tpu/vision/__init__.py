@@ -3,3 +3,36 @@ from . import models
 from . import transforms
 from . import datasets
 from . import ops
+
+
+_image_backend = 'pil'
+
+
+def set_image_backend(backend):
+    """paddle.vision.set_image_backend ('pil' | 'cv2'; only PIL ships
+    in this environment)."""
+    global _image_backend
+    if backend not in ('pil', 'cv2'):
+        raise ValueError(f"unknown image backend {backend!r}")
+    if backend == 'cv2':
+        try:
+            import cv2  # noqa
+        except ImportError:
+            raise ValueError("cv2 backend requested but OpenCV is not "
+                             "installed; 'pil' is available")
+    _image_backend = backend
+
+
+def get_image_backend():
+    """paddle.vision.get_image_backend."""
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """paddle.vision.image_load — PIL.Image (or cv2 ndarray)."""
+    b = backend or _image_backend
+    if b == 'cv2':
+        import cv2
+        return cv2.imread(path)
+    from PIL import Image
+    return Image.open(path)

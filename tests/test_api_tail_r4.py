@@ -1,0 +1,305 @@
+"""Public API sheet remainder: 3-D pooling family, Conv3DTranspose,
+bilinear, fleet datasets, entry attrs, jit TracedLayer, static program
+state, top-level tail (add_n/t/inverse/...)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.core.tensor import Tensor
+import paddle_tpu.nn.functional as F
+
+
+def test_pool3d_functional_and_layers():
+    rng = np.random.RandomState(0)
+    x = Tensor(rng.rand(1, 2, 6, 6, 6).astype(np.float32))
+    m = F.max_pool3d(x, 2, stride=2)
+    a = F.avg_pool3d(x, 2, stride=2)
+    assert m.shape == a.shape == [1, 2, 3, 3, 3]
+    assert (np.asarray(m.data) >= np.asarray(a.data) - 1e-6).all()
+    assert nn.MaxPool3D(2, 2)(x).shape == [1, 2, 3, 3, 3]
+    assert nn.AvgPool3D(2, 2)(x).shape == [1, 2, 3, 3, 3]
+    assert nn.AdaptiveAvgPool3D(2)(x).shape == [1, 2, 2, 2, 2]
+    assert nn.AdaptiveMaxPool3D(2)(x).shape == [1, 2, 2, 2, 2]
+
+
+def test_adaptive_pool1d_exact_bins():
+    x = Tensor(np.arange(6, dtype=np.float32).reshape(1, 1, 6))
+    avg = np.asarray(F.adaptive_avg_pool1d(x, 3).data)
+    np.testing.assert_allclose(avg[0, 0], [0.5, 2.5, 4.5])
+    mx = np.asarray(F.adaptive_max_pool1d(x, 2).data)
+    np.testing.assert_allclose(mx[0, 0], [2.0, 5.0])
+    assert nn.AdaptiveMaxPool1D(2)(x).shape == [1, 1, 2]
+    # uneven split: bins [0,2),[1,4),[3,5): floor/ceil edges
+    avg5 = np.asarray(F.adaptive_avg_pool1d(
+        Tensor(np.arange(5, dtype=np.float32).reshape(1, 1, 5)), 3).data)
+    np.testing.assert_allclose(avg5[0, 0], [0.5, 2.0, 3.5])
+
+
+def test_conv_transpose_1d_3d():
+    paddle.seed(0)
+    rng = np.random.RandomState(1)
+    m3 = nn.Conv3DTranspose(2, 3, 3, stride=2)
+    x3 = Tensor(rng.rand(1, 2, 4, 4, 4).astype(np.float32))
+    assert m3(x3).shape == [1, 3, 9, 9, 9]
+    x1 = Tensor(rng.rand(1, 2, 5).astype(np.float32))
+    w1 = Tensor(rng.rand(2, 3, 3).astype(np.float32))
+    out = F.conv1d_transpose(x1, w1, stride=2)
+    assert out.shape == [1, 3, 11, ][0:1] + [3, 11] or True
+    assert out.shape == [1, 3, 11]
+
+
+def test_bilinear_matches_einsum():
+    rng = np.random.RandomState(2)
+    x1 = Tensor(rng.rand(4, 3).astype(np.float32))
+    x2 = Tensor(rng.rand(4, 5).astype(np.float32))
+    w = Tensor(rng.rand(2, 3, 5).astype(np.float32))
+    b = Tensor(rng.rand(1, 2).astype(np.float32))
+    out = np.asarray(F.bilinear(x1, x2, w, b).data)
+    want = np.einsum('ni,oij,nj->no', np.asarray(x1.data),
+                     np.asarray(w.data), np.asarray(x2.data)) \
+        + np.asarray(b.data)
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+def test_dropout3d_and_losses():
+    rng = np.random.RandomState(3)
+    x = Tensor(rng.rand(2, 4, 3, 3, 3).astype(np.float32))
+    paddle.seed(5)
+    y = np.asarray(F.dropout3d(x, 0.5).data)
+    # whole channels dropped: each [c] block all-zero or scaled
+    for n in range(2):
+        for c in range(4):
+            blk = y[n, c]
+            assert (blk == 0).all() or (blk > 0).all()
+    assert np.asarray(F.dropout3d(x, 0.5, training=False).data).sum() \
+        == pytest.approx(np.asarray(x.data).sum())
+    # dice loss: perfect prediction -> ~0
+    p = Tensor(np.eye(4, dtype=np.float32)[None])
+    l = Tensor(np.arange(4, dtype=np.int64).reshape(1, 4, 1))
+    d = float(F.dice_loss(p, l).data)
+    assert d < 0.01
+    # modern sigmoid_focal_loss runs with one-hot labels
+    logit = Tensor(rng.randn(6, 3).astype(np.float32))
+    lab = Tensor(np.eye(3, dtype=np.float32)[rng.randint(0, 3, 6)])
+    v = float(F.sigmoid_focal_loss(logit, lab).data)
+    assert np.isfinite(v) and v > 0
+    norm = Tensor(np.asarray([2.0], np.float32))
+    v2 = float(F.sigmoid_focal_loss(logit, lab, normalizer=norm).data)
+    assert abs(v2 - v / 2) < 1e-4
+    assert nn.HSigmoidLoss(8, 6)(
+        Tensor(rng.rand(3, 8).astype(np.float32)),
+        Tensor(rng.randint(0, 6, (3, 1)).astype(np.int64))).shape[0] == 3
+    assert nn.PairwiseDistance()(
+        Tensor(rng.rand(3, 4).astype(np.float32)),
+        Tensor(rng.rand(3, 4).astype(np.float32))).shape == [3]
+    assert nn.Dropout3D(0.5)(x).shape == x.shape
+
+
+def test_top_level_tail():
+    a = Tensor(np.ones((2, 3), np.float32))
+    s = paddle.add_n([a, a, a])
+    assert float(np.asarray(s.data)[0, 0]) == 3.0
+    assert int(paddle.rank(a).data) == 2
+    assert not bool(paddle.is_empty(a).data)
+    assert paddle.is_tensor(a) and not paddle.is_tensor(3)
+    t = np.asarray(paddle.t(a).data)
+    assert t.shape == (3, 2)
+    with pytest.raises(ValueError, match='ndim'):
+        paddle.t(Tensor(np.ones((2, 2, 2), np.float32)))
+    m = np.array([[2.0, 0.0], [0.0, 4.0]], np.float32)
+    inv = np.asarray(paddle.inverse(Tensor(m)).data)
+    np.testing.assert_allclose(inv, np.linalg.inv(m), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(paddle.linalg.inv(Tensor(m)).data),
+        np.linalg.inv(m), rtol=1e-5)
+    fm = np.asarray(paddle.floor_mod(
+        Tensor(np.asarray([7, -7], np.int32)),
+        Tensor(np.asarray([3, 3], np.int32))).data)
+    assert fm[0] == 1
+    r = np.asarray(paddle.reverse(
+        Tensor(np.arange(3, dtype=np.float32)), 0).data)
+    np.testing.assert_allclose(r, [2, 1, 0])
+    # rng state round-trip
+    st = paddle.get_cuda_rng_state()
+    v1 = np.asarray(paddle.rand([3]).data)
+    paddle.set_cuda_rng_state(st)
+    v2 = np.asarray(paddle.rand([3]).data)
+    np.testing.assert_allclose(v1, v2)
+    # batch reader decorator
+    rd = paddle.batch(lambda: iter(range(7)), batch_size=3)
+    chunks = list(rd())
+    assert [len(c) for c in chunks] == [3, 3, 1]
+    assert repr(paddle.NPUPlace(0)) == 'NPUPlace(0)'
+    paddle.set_printoptions(precision=4)
+
+
+def test_scatter_inplace():
+    x = Tensor(np.zeros((4, 2), np.float32))
+    idx = Tensor(np.asarray([1, 3], np.int64))
+    upd = Tensor(np.ones((2, 2), np.float32))
+    out = paddle.scatter_(x, idx, upd)
+    assert np.asarray(x.data)[1].sum() == 2.0   # x itself updated
+    assert np.asarray(out.data)[3].sum() == 2.0
+
+
+def test_entry_attrs():
+    p = paddle.distributed.ProbabilityEntry(0.25)
+    assert p._to_attr() == 'probability_entry:0.25'
+    c = paddle.distributed.CountFilterEntry(10)
+    assert c._to_attr() == 'count_filter_entry:10'
+    with pytest.raises(ValueError):
+        paddle.distributed.ProbabilityEntry(0)
+    with pytest.raises(ValueError):
+        paddle.distributed.CountFilterEntry(-1)
+
+
+def _write_multislot(tmp, rows):
+    path = os.path.join(tmp, 'part-0.txt')
+    rng = np.random.RandomState(0)
+    with open(path, 'w') as f:
+        for _ in range(rows):
+            feats = rng.rand(4)
+            f.write(' '.join(f'{v:.4f}' for v in feats)
+                    + f" | {rng.randint(0, 2)}\n")
+    return [path]
+
+
+class _Var:
+    def __init__(self, shape, dtype):
+        self.shape, self.dtype = shape, dtype
+
+
+def test_queue_and_inmemory_datasets():
+    from paddle_tpu.core.native import load_native
+    if load_native(required=False) is None:
+        pytest.skip('native lib not built')
+    with tempfile.TemporaryDirectory() as tmp:
+        files = _write_multislot(tmp, 50)
+        ds = paddle.distributed.QueueDataset()
+        ds.init(batch_size=16, thread_num=1,
+                use_var=[_Var([4], 'float32'), _Var([1], 'int64')])
+        ds.set_filelist(files)
+        total = 0
+        for feats, label in ds:
+            assert feats.shape[1] == 4 and label.shape[1] == 1
+            total += feats.shape[0]
+        assert total == 50
+
+        mem = paddle.distributed.InMemoryDataset()
+        mem.init(batch_size=16, thread_num=1,
+                 use_var=[_Var([4], 'float32'), _Var([1], 'int64')])
+        mem.set_filelist(files)
+        with pytest.raises(RuntimeError, match='load_into_memory'):
+            next(iter(mem))
+        mem.load_into_memory()
+        assert mem.get_memory_data_size() == 50
+        e1 = np.concatenate([np.asarray(f.data) for f, _ in mem])
+        mem.global_shuffle()
+        e2 = np.concatenate([np.asarray(f.data) for f, _ in mem])
+        assert e1.shape == e2.shape == (50, 4)
+        assert not np.allclose(e1, e2)          # reshuffled order
+        np.testing.assert_allclose(sorted(e1[:, 0]), sorted(e2[:, 0]),
+                                   rtol=1e-6)
+        mem.release_memory()
+
+
+def test_static_program_state_roundtrip(tmp_path):
+    paddle.enable_static()
+    try:
+        from paddle_tpu import static
+        main, start = static.Program(), static.Program()
+        with static.program_guard(main, start):
+            x = static.data('x', [2, 3], 'float32')
+            w = static.create_parameter([3, 4], 'float32')
+            y = paddle.matmul(x, w)
+        exe = static.Executor()
+        exe.run(start)
+        # params materialize into the scope on the first main-program
+        # run (the Executor's lazy-init contract)
+        exe.run(main, feed={'x': np.ones((2, 3), np.float32)},
+                fetch_list=[y])
+        static.save(main, str(tmp_path / 'm'))
+        state = static.load_program_state(str(tmp_path / 'm'))
+        assert any(v.shape == (3, 4) for v in state.values())
+        # perturb then restore
+        static.set_program_state(main, state)
+        blob = static.serialize_persistables([x], [y], program=main)
+        static.save_to_file(str(tmp_path / 'p.bin'), blob)
+        static.deserialize_persistables(
+            main, static.load_from_file(str(tmp_path / 'p.bin')))
+        out = exe.run(main, feed={'x': np.ones((2, 3), np.float32)},
+                      fetch_list=[y])
+        assert out[0].shape == (2, 4)
+        assert static.WeightNormParamAttr(dim=0).dim == 0
+        assert len(static.cpu_places(2)) == 2
+    finally:
+        paddle.disable_static()
+
+
+def test_traced_layer_and_verbosity():
+    lin = nn.Linear(3, 2)
+    out, traced = paddle.jit.TracedLayer.trace(
+        lin, [Tensor(np.ones((2, 3), np.float32))])
+    again = traced(Tensor(np.ones((2, 3), np.float32)))
+    np.testing.assert_allclose(np.asarray(out.data),
+                               np.asarray(again.data), rtol=1e-6)
+    paddle.jit.set_verbosity(3)
+    paddle.jit.set_code_level(50)
+
+
+def test_vision_image_backend(tmp_path):
+    from PIL import Image
+    img = np.zeros((4, 5, 3), np.uint8)
+    Image.fromarray(img).save(str(tmp_path / 'a.png'))
+    assert paddle.vision.get_image_backend() == 'pil'
+    loaded = paddle.vision.image_load(str(tmp_path / 'a.png'))
+    assert loaded.size == (5, 4)
+    with pytest.raises(ValueError):
+        paddle.vision.set_image_backend('bogus')
+
+
+def test_avg_pool3d_divisor_override_is_sum_over_divisor():
+    x = Tensor(np.ones((1, 1, 4, 4, 4), np.float32))
+    out = np.asarray(F.avg_pool3d(x, 2, stride=2, padding=1,
+                                  divisor_override=8).data)
+    # corner window holds exactly 1 real element -> 1/8
+    assert abs(out[0, 0, 0, 0, 0] - 0.125) < 1e-6
+    # interior window holds 8 -> 8/8 = 1
+    assert abs(out[0, 0, 1, 1, 1] - 1.0) < 1e-6
+
+
+def test_conv_transpose_output_size_honored():
+    rng = np.random.RandomState(4)
+    x = Tensor(rng.rand(1, 2, 4, 4, 4).astype(np.float32))
+    w = Tensor(rng.rand(2, 3, 3, 3, 3).astype(np.float32))
+    base = F.conv3d_transpose(x, w, stride=2)
+    assert base.shape == [1, 3, 9, 9, 9]
+    bigger = F.conv3d_transpose(x, w, stride=2,
+                                output_size=[10, 10, 10])
+    assert bigger.shape == [1, 3, 10, 10, 10]
+    with pytest.raises(ValueError, match='unreachable'):
+        F.conv3d_transpose(x, w, stride=2, output_size=[12, 12, 12])
+    x1 = Tensor(rng.rand(1, 2, 5).astype(np.float32))
+    w1 = Tensor(rng.rand(2, 3, 3).astype(np.float32))
+    assert F.conv1d_transpose(x1, w1, stride=2,
+                              output_size=12).shape == [1, 3, 12]
+
+
+def test_params_unique_across_programs():
+    paddle.enable_static()
+    try:
+        from paddle_tpu import static
+        names = []
+        for _ in range(2):
+            main, start = static.Program(), static.Program()
+            with static.program_guard(main, start):
+                static.create_parameter([2, 2], 'float32')
+                names += [v.name for b in main.blocks
+                          for v in b.all_parameters()]
+        assert len(set(names)) == len(names), names
+    finally:
+        paddle.disable_static()
