@@ -130,3 +130,6 @@ from .api_tail import (add_n, floor_mod, inverse, t, is_tensor,  # noqa
                        cholesky, create_parameter, check_shape,
                        tanh_, reshape_, squeeze_, unsqueeze_)
 from .core import dtypes as dtype  # noqa — paddle.dtype namespace
+from . import inference  # noqa
+from . import sysconfig  # noqa
+from . import onnx  # noqa

@@ -1,0 +1,186 @@
+"""paddle.inference — deployment API sheet (reference:
+python/paddle/inference/__init__.py over paddle_infer C++; here the
+StableHLO-AOT Predictor from static/inference.py is the engine, and
+Config carries the knobs that map onto it. GPU/TRT/MKLDNN switches are
+accepted and recorded (PJRT owns device placement) so ported serving
+scripts run unchanged."""
+import enum
+
+import numpy as np
+
+from .core.tensor import Tensor
+
+
+class DataType(enum.Enum):
+    FLOAT32 = 'float32'
+    FLOAT16 = 'float16'
+    INT32 = 'int32'
+    INT64 = 'int64'
+    UINT8 = 'uint8'
+    INT8 = 'int8'
+
+
+class PrecisionType(enum.Enum):
+    Float32 = 'float32'
+    Half = 'float16'
+    Int8 = 'int8'
+
+
+class PlaceType(enum.Enum):
+    CPU = 'cpu'
+    GPU = 'gpu'
+    XPU = 'xpu'
+    UNK = 'unk'
+
+
+def get_num_bytes_of_data_type(dtype):
+    """paddle.inference.get_num_bytes_of_data_type."""
+    return np.dtype(DataType(dtype).value if isinstance(dtype, DataType)
+                    else dtype).itemsize
+
+
+def get_version():
+    """paddle.inference.get_version."""
+    from . import __version__
+    return __version__
+
+
+class Config:
+    """paddle.inference.Config(prog_file?, params_file?) — model path +
+    accepted-but-subsumed device/optimization switches."""
+
+    def __init__(self, prog_file=None, params_file=None):
+        self._path_prefix = None
+        self._params_file = None
+        self._device = 'cpu'
+        self._enabled = {}
+        if prog_file is not None:
+            self.set_model(prog_file, params_file)
+
+    def set_model(self, prog_file, params_file=None):
+        if prog_file.endswith('.pdmodel'):
+            prog_file = prog_file[:-len('.pdmodel')]
+        self._path_prefix = prog_file
+        self._params_file = params_file
+
+    def model_dir(self):
+        return self._path_prefix
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._device = 'gpu'
+
+    def disable_gpu(self):
+        self._device = 'cpu'
+
+    def use_gpu(self):
+        return self._device == 'gpu'
+
+    # accepted switches the XLA path subsumes (fusion, memory planning)
+    def switch_ir_optim(self, flag=True):
+        self._enabled['ir_optim'] = flag
+
+    def enable_memory_optim(self):
+        self._enabled['memory_optim'] = True
+
+    def enable_mkldnn(self):
+        self._enabled['mkldnn'] = True
+
+    def enable_tensorrt_engine(self, *a, **k):
+        self._enabled['trt'] = True
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._enabled['cpu_threads'] = n
+
+    def summary(self):
+        return f"Config(path={self._path_prefix}, device={self._device})"
+
+
+class Predictor:
+    """paddle.inference.Predictor — wraps the StableHLO-AOT predictor
+    (static/inference.py): same get_input_names/get_input_handle/run
+    surface as the reference's paddle_infer::Predictor."""
+
+    def __init__(self, config, _shared_inner=None):
+        from .static.inference import load_predictor
+        self._inner = _shared_inner if _shared_inner is not None \
+            else load_predictor(config.model_dir())
+        # the AOT artifact is positional; expose x0..xn names like the
+        # reference exposes the serialized feed targets
+        self._names = [f'x{i}'
+                       for i in range(len(self._inner.input_specs))]
+        self._feeds = {}
+        self._n_out = None                  # discovered on first run
+
+    def get_input_names(self):
+        return list(self._names)
+
+    def get_output_names(self):
+        if self._n_out is None:
+            raise RuntimeError(
+                "output arity is discovered at the first run(): call "
+                "run() once, then enumerate get_output_names()")
+        return [f'out_{i}' for i in range(self._n_out)]
+
+    def get_input_handle(self, name):
+        return _Handle(self, name)
+
+    def get_output_handle(self, name):
+        return _OutHandle(self, name)
+
+    def run(self, inputs=None):
+        if inputs is None:                  # handle-style call
+            inputs = [self._feeds[n] for n in self._names]
+        outs = self._inner.run(*inputs)
+        self._outputs = list(outs) if isinstance(outs, (list, tuple)) \
+            else [outs]
+        self._n_out = len(self._outputs)
+        return self._outputs
+
+
+class _Handle:
+    def __init__(self, pred, name):
+        self._pred, self._name = pred, name
+
+    def copy_from_cpu(self, arr):
+        self._pred._feeds[self._name] = np.asarray(arr)
+
+    def reshape(self, shape):
+        pass                                 # shapes fixed at export
+
+
+class _OutHandle:
+    def __init__(self, pred, name):
+        self._pred, self._name = pred, name
+
+    def copy_to_cpu(self):
+        outs = getattr(self._pred, '_outputs', None)
+        if outs is None:
+            raise RuntimeError("run() the predictor first")
+        names = self._pred.get_output_names()
+        if self._name not in names:
+            raise KeyError(
+                f"unknown output {self._name!r}; outputs: {names}")
+        o = outs[names.index(self._name)]
+        return np.asarray(o.data if isinstance(o, Tensor) else o)
+
+
+class PredictorPool:
+    """paddle.inference.PredictorPool — N predictors SHARING one loaded
+    model (one StableHLO deserialization, one device copy of the
+    weights — the reference's weight-sharing semantics)."""
+
+    def __init__(self, config, size=1):
+        first = Predictor(config)
+        self._preds = [first] + [
+            Predictor(config, _shared_inner=first._inner)
+            for _ in range(int(size) - 1)]
+
+    def retrive(self, idx):                  # [sic] reference spelling
+        return self._preds[idx]
+
+    retrieve = retrive
+
+
+def create_predictor(config):
+    """paddle.inference.create_predictor."""
+    return Predictor(config)

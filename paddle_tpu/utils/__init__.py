@@ -28,3 +28,30 @@ def deprecated(update_to="", since="", reason=""):
     def decorator(fn):
         return fn
     return decorator
+
+
+def require_version(min_version, max_version=None):
+    """paddle.utils.require_version — validate the installed framework
+    version against [min_version, max_version]."""
+    from .. import __version__
+    import re as _re
+
+    def parse(v):
+        # zero-pad to 3 segments; tolerate rc/dev suffixes ('2.5.0rc0')
+        segs = []
+        for x in str(v).split('.')[:3]:
+            m = _re.match(r'\d+', x)
+            segs.append(int(m.group()) if m else 0)
+        while len(segs) < 3:
+            segs.append(0)
+        return tuple(segs)
+    cur = parse(__version__)
+    if parse(min_version) > cur:
+        raise Exception(
+            f"installed version {__version__} < required {min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"installed version {__version__} > allowed {max_version}")
+    return True
+
+from . import unique_name  # noqa

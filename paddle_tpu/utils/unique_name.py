@@ -1,26 +1,37 @@
-"""Unique name generator (parity: fluid/unique_name.py)."""
-import collections
+"""paddle.utils.unique_name (reference:
+python/paddle/fluid/unique_name.py): the process-global name generator
+behind auto-named parameters, with guard() to scope naming — two
+SPMD ranks building structurally-identical Programs inside separate
+guard() blocks get IDENTICAL names (required for the multi-rank
+collective simulators), while unguarded Programs keep process-unique
+names (required for scope safety — see program.py _unique_name)."""
 import contextlib
-
-_counters = collections.defaultdict(int)
 
 
 def generate(key):
-    _counters[key] += 1
-    return f"{key}_{_counters[key] - 1}"
+    from ..static import program as _prog
+    n = _prog._GLOBAL_NAME_COUNTER.get(key, 0)
+    _prog._GLOBAL_NAME_COUNTER[key] = n + 1
+    return f"{key}_{n}"
 
 
 @contextlib.contextmanager
 def guard(new_generator=None):
-    global _counters
-    saved = _counters
-    _counters = collections.defaultdict(int)
+    """Scope the global name counters: inside the guard, naming starts
+    fresh (or from `new_generator`'s state); on exit the previous
+    counters are restored."""
+    from ..static import program as _prog
+    saved = dict(_prog._GLOBAL_NAME_COUNTER)
+    _prog._GLOBAL_NAME_COUNTER.clear()
     try:
         yield
     finally:
-        _counters = saved
+        _prog._GLOBAL_NAME_COUNTER.clear()
+        _prog._GLOBAL_NAME_COUNTER.update(saved)
 
 
 def switch(new_generator=None):
-    global _counters
-    _counters = collections.defaultdict(int)
+    from ..static import program as _prog
+    old = dict(_prog._GLOBAL_NAME_COUNTER)
+    _prog._GLOBAL_NAME_COUNTER.clear()
+    return old

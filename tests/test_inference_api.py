@@ -1,0 +1,71 @@
+"""paddle.inference deployment sheet over the StableHLO-AOT predictor
+(reference: python/paddle/inference/__init__.py surface)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.core.tensor import Tensor
+
+
+def test_inference_config_predictor_roundtrip(tmp_path):
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    x = Tensor(np.random.RandomState(0).rand(3, 4).astype(np.float32))
+    want = np.asarray(model(x).data)
+
+    from paddle_tpu.static.inference import export_layer
+    prefix = str(tmp_path / 'm')
+    export_layer(prefix, model, [x])
+
+    cfg = paddle.inference.Config(prefix + '.pdmodel')
+    cfg.switch_ir_optim(True)
+    cfg.enable_memory_optim()
+    pred = paddle.inference.create_predictor(cfg)
+    assert pred.get_input_names() == ['x0']
+    with pytest.raises(RuntimeError, match='first'):
+        pred.get_output_names()              # arity known after run()
+
+    # handle-style serving loop (the reference's documented flow)
+    h = pred.get_input_handle('x0')
+    h.copy_from_cpu(np.asarray(x.data))
+    pred.run()
+    assert pred.get_output_names() == ['out_0']
+    out = pred.get_output_handle('out_0').copy_to_cpu()
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+    with pytest.raises(KeyError, match='unknown output'):
+        pred.get_output_handle('bogus').copy_to_cpu()
+
+    # list-style call
+    out2 = pred.run([np.asarray(x.data)])[0]
+    np.testing.assert_allclose(np.asarray(out2), want, rtol=1e-5)
+
+
+def test_predictor_pool_and_dtypes(tmp_path):
+    paddle.seed(1)
+    model = nn.Linear(3, 3)
+    x = Tensor(np.ones((2, 3), np.float32))
+    from paddle_tpu.static.inference import export_layer
+    prefix = str(tmp_path / 'p')
+    export_layer(prefix, model, [x])
+    pool = paddle.inference.PredictorPool(
+        paddle.inference.Config(prefix), size=2)
+    # pool slots share ONE loaded model (reference weight sharing)
+    assert pool.retrive(0)._inner is pool.retrive(1)._inner
+    a = pool.retrive(0).run([np.ones((2, 3), np.float32)])[0]
+    b = pool.retrieve(1).run([np.ones((2, 3), np.float32)])[0]
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    assert paddle.inference.get_num_bytes_of_data_type('int64') == 8
+    assert paddle.inference.get_version() == paddle.__version__
+    assert paddle.inference.PlaceType.CPU.value == 'cpu'
+
+
+def test_utils_sysconfig_onnx():
+    assert paddle.utils.require_version('0.0.1')
+    assert paddle.utils.require_version('0.0.1', max_version='0.1')
+    assert paddle.utils.require_version('0.1.0rc0')
+    with pytest.raises(Exception, match='required'):
+        paddle.utils.require_version('999.0.0')
+    assert paddle.sysconfig.get_include().endswith('csrc')
+    with pytest.raises(NotImplementedError, match='StableHLO'):
+        paddle.onnx.export(None, '/tmp/x')

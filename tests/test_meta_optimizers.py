@@ -191,8 +191,11 @@ class TestGradientMerge:
 
         def run(merge_k, steps):
             paddle.seed(7)
-            main, loss, _, opt = _mlp_program(lr=0.05)
-            opt.minimize(loss)
+            # identical naming across the two independent builds (each
+            # models its own process)
+            with paddle.utils.unique_name.guard():
+                main, loss, _, opt = _mlp_program(lr=0.05)
+                opt.minimize(loss)
             if merge_k:
                 apply_gradient_merge(main, merge_k, avg=True)
             exe = static.Executor()
@@ -254,8 +257,9 @@ class TestLocalSGD:
         progs = []
         pname = None
         for r in range(2):
-            main, loss, _, opt = _mlp_program(lr=0.05)
-            opt.minimize(loss)
+            with paddle.utils.unique_name.guard():
+                main, loss, _, opt = _mlp_program(lr=0.05)
+                opt.minimize(loss)
             apply_localsgd(main, k, nranks=2)
             progs.append(main)
             pname = main.all_parameters()[0].name
@@ -330,7 +334,8 @@ class TestRawProgramDP:
 
         progs = []
         for r in range(2):
-            m, loss = build()
+            with paddle.utils.unique_name.guard():
+                m, loss = build()
             insert_dp_grad_sync(m, 2)
             progs.append(m)
         sim = MultiRankShardingSimulator(progs, seed=9)
@@ -346,7 +351,7 @@ class TestRawProgramDP:
         # full-batch grad == mean of half grads)
         paddle.seed(9)
         m3 = static.Program()
-        with static.program_guard(m3):
+        with paddle.utils.unique_name.guard(), static.program_guard(m3):
             x = static.data('x', [16, 4])
             label = static.data('label', [16, 1])
             h = static.nn.fc(x, 16, activation='relu')

@@ -266,7 +266,8 @@ class TestProgramRewriteGolden:
         rank_progs = []
         loss_name = None
         for r in range(2):
-            m, loss = self._sharding_program()
+            with paddle.utils.unique_name.guard():
+                m, loss = self._sharding_program()
             shard_program(m, r, 2, stage=2)
             rank_progs.append(m)
             loss_name = loss.name
@@ -314,7 +315,8 @@ class TestProgramRewriteGolden:
 
         rank_progs = []
         for r in range(2):
-            m, loss = build()
+            with paddle.utils.unique_name.guard():
+                m, loss = build()
             shard_program(m, r, 2, stage=2)
             rank_progs.append(m)
         sim = MultiRankShardingSimulator(rank_progs, seed=0)
