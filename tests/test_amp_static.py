@@ -73,7 +73,7 @@ class TestAmpRewriteGolden:
     def test_black_varnames_respected(self):
         main, _ = _toy()
         block = main.global_block()
-        w_name = 'param_0'    # fc weight (recorder's param naming)
+        w_name = main.all_parameters()[0].name   # fc weight
         lists = AutoMixedPrecisionLists(custom_black_varnames=[w_name])
         n = rewrite_program_amp(main, lists)
         assert n == 1            # only x cast; w pinned
