@@ -7,7 +7,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .core.tensor import Tensor
+from .core.tensor import Tensor, inplace_rebind
 from .ops.common import as_tensor
 
 
@@ -80,9 +80,7 @@ def scatter_(x, index, updates, overwrite=True, name=None):
     update)."""
     from .ops.manip import scatter
     out = scatter(x, index, updates, overwrite=overwrite)
-    if isinstance(x, Tensor):
-        x._data = out.data
-    return out
+    return inplace_rebind(x, out)
 
 
 _print_options = {'precision': 8, 'threshold': 1000, 'edgeitems': 3,
@@ -183,33 +181,25 @@ def tanh_(x, name=None):
     immutable; the tensor rebinds)."""
     from .ops.math import tanh
     out = tanh(x)
-    if isinstance(x, Tensor):
-        x._data = out.data
-    return out
+    return inplace_rebind(x, out)
 
 
 def reshape_(x, shape, name=None):
     """paddle.reshape_ — inplace spelling of reshape."""
     from .ops.manip import reshape
     out = reshape(x, shape)
-    if isinstance(x, Tensor):
-        x._data = out.data
-    return out
+    return inplace_rebind(x, out)
 
 
 def squeeze_(x, axis=None, name=None):
     """paddle.squeeze_ — inplace spelling of squeeze."""
     from .ops.manip import squeeze
     out = squeeze(x, axis)
-    if isinstance(x, Tensor):
-        x._data = out.data
-    return out
+    return inplace_rebind(x, out)
 
 
 def unsqueeze_(x, axis, name=None):
     """paddle.unsqueeze_ — inplace spelling of unsqueeze."""
     from .ops.manip import unsqueeze
     out = unsqueeze(x, axis)
-    if isinstance(x, Tensor):
-        x._data = out.data
-    return out
+    return inplace_rebind(x, out)

@@ -63,7 +63,7 @@ class Node:
     """
     __slots__ = ('id', 'name', 'vjp_fn', 'inputs', 'input_needs_grad',
                  'outputs', 'out_meta', 'n_outputs', 'primal_fn',
-                 'diff_idx', '__weakref__')
+                 'diff_idx', 'input_versions', '__weakref__')
 
     def __init__(self, name, vjp_fn, inputs, input_needs_grad, outputs,
                  primal_fn=None, diff_idx=None):
@@ -72,8 +72,13 @@ class Node:
         self.id = _node_counter
         self.name = name
         self.vjp_fn = vjp_fn
-        self.inputs = inputs                  # list[Tensor]
+        self.inputs = list(inputs)            # list[Tensor]
         self.input_needs_grad = input_needs_grad  # list[bool]
+        # in-place version stamps: backward() refuses to route a
+        # cotangent through an input that was later rebound in place
+        # (tensor.inplace_rebind bumps _version — the reference's
+        # inplace version-counter contract)
+        self.input_versions = [getattr(t, '_version', 0) for t in inputs]
         self.outputs = [weakref.ref(t) for t in outputs]
         self.out_meta = [(t.data.shape, t.data.dtype) for t in outputs]
         self.n_outputs = len(outputs)
@@ -205,6 +210,15 @@ def backward(tensors, grad_tensors=None, retain_graph=False, capture=None,
             raise RuntimeError(
                 f"autograd: grad graph through op '{node.name}' was already "
                 "released; pass retain_graph=True to backward()")
+        for t_in, v0 in zip(node.inputs, node.input_versions):
+            if getattr(t_in, '_version', 0) != v0:
+                raise RuntimeError(
+                    f"autograd: a tensor needed for the gradient of op "
+                    f"'{node.name}' was modified by an in-place "
+                    f"operation (recorded version {v0}, current "
+                    f"{getattr(t_in, '_version', 0)}); use the "
+                    "out-of-place spelling before reusing a tensor "
+                    "another op has consumed")
         cts = []
         for i, (shape, dt) in enumerate(node.out_meta):
             ct = cotangents[i]

@@ -8,6 +8,8 @@ jax/PJRT; autograd is the tape in core/autograd.py.
 `stop_gradient` defaults to True like paddle's dygraph VarBase; parameters are
 created with stop_gradient=False.
 """
+import weakref
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -318,10 +320,10 @@ def _install_operators():
             setattr(Tensor, name, fn)
 
     # paddle's inplace-suffixed variants: compute then overwrite storage
+    # (inplace_rebind raises under an active autograd graph — see its doc)
     def make_inplace(f):
         def impl(self, *a, **k):
-            out = f(self, *a, **k)
-            self._data = out.data
+            inplace_rebind(self, f(self, *a, **k))
             return self
         return impl
     for name in ('exp', 'sqrt', 'rsqrt', 'reciprocal', 'tanh', 'sigmoid',
@@ -330,6 +332,65 @@ def _install_operators():
         base = method_table.get(name) or getattr(Tensor, name, None)
         if base is not None and not hasattr(Tensor, name + '_'):
             setattr(Tensor, name + '_', make_inplace(base))
+
+
+def inplace_rebind(x, out):
+    """Shared tail of every `op_`-spelled in-place API: JAX buffers are
+    immutable, so the new value is computed out-of-place and the input
+    tensor's buffer is rebound to it. Returns `x` itself (reference
+    parity: the in-place result IS the input variable), so chained
+    in-place calls keep aliasing one tensor.
+
+    Under autograd the alias is grafted into the tape: `x` takes over
+    the op's output slot (later uses of x route cotangents through the
+    op), and a snapshot tensor holding x's pre-op identity takes x's
+    place both as the op's recorded input and as the old producer's
+    output — so the chain x_old -> op -> x stays exact. Two loud-error
+    cases match the reference's eager inplace rules: a grad-requiring
+    LEAF can't be in-placed ("Leaf Var that doesn't stop gradient can't
+    use inplace strategy"), and mutating a tensor some EARLIER op
+    recorded for backward raises at backward() time via version
+    counters (autograd.Node.input_versions).
+    """
+    if not isinstance(x, Tensor):
+        return out
+    node = getattr(out, '_node', None)
+    if node is None:
+        # nothing was traced (no_grad, or x doesn't require grad):
+        # plain buffer swap, but still bump the version so any earlier
+        # recording that DID capture x errors loudly at backward()
+        x._data = out.data
+        x._version = getattr(x, '_version', 0) + 1
+        return x
+    if x._node is None and not x.stop_gradient:
+        raise RuntimeError(
+            "a leaf Tensor that requires grad can't use the in-place "
+            "strategy (reference: the eager inplace leaf check) — use "
+            "the out-of-place spelling (drop the trailing '_'), or "
+            "wrap the call in paddle.no_grad().")
+    snap = Tensor(x._data, stop_gradient=x.stop_gradient)
+    snap._node = x._node
+    snap._version = getattr(x, '_version', 0)
+    if snap._node is not None:
+        # the old producer's output slot now belongs to the snapshot
+        for i, ref in enumerate(snap._node.outputs):
+            if ref() is x:
+                snap._node.outputs[i] = weakref.ref(snap)
+                break
+    # the new op consumed the PRE-op value: its recorded input becomes
+    # the snapshot (node.inputs holds strong refs, keeping snap alive)
+    for i, t in enumerate(node.inputs):
+        if t is x:
+            node.inputs[i] = snap
+    # and x becomes the op's output alias
+    for i, ref in enumerate(node.outputs):
+        if ref() is out:
+            node.outputs[i] = weakref.ref(x)
+            break
+    x._data = out.data
+    x._node = node
+    x._version = snap._version + 1
+    return x
 
 
 def to_tensor(data, dtype=None, place=None, stop_gradient=True):

@@ -10,6 +10,11 @@ from .base.role_maker import (PaddleCloudRoleMaker, UserDefinedRoleMaker,
                               Role)
 from .base.topology import (CommunicateTopology, HybridCommunicateGroup,
                             ParallelMode)
+from .dataset import (DatasetBase, InMemoryDataset, QueueDataset,
+                      FileInstantDataset, BoxPSDataset)
+from .data_generator import (MultiSlotDataGenerator,
+                             MultiSlotStringDataGenerator)
+from . import data_generator
 from . import meta_parallel
 from . import metrics
 from . import meta_optimizers

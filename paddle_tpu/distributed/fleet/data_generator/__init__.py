@@ -1,0 +1,5 @@
+from .data_generator import (DataGenerator, MultiSlotDataGenerator,
+                             MultiSlotStringDataGenerator)
+
+__all__ = ['DataGenerator', 'MultiSlotDataGenerator',
+           'MultiSlotStringDataGenerator']

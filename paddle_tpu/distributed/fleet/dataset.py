@@ -1,11 +1,22 @@
 """fleet datasets (reference:
 python/paddle/distributed/fleet/dataset/dataset.py InMemoryDataset:259 /
-QueueDataset) — the MultiSlot file-feed path for PS/CTR training,
-backed by the native C++ feed (csrc/data_feed.cc via
-core/native.NativeDataFeed): QueueDataset streams batches straight
-from the file channel; InMemoryDataset loads + globally shuffles in
-RAM first (the reference's load_into_memory / global_shuffle pair).
+QueueDataset / FileInstantDataset:1112 / BoxPSDataset:1142) — the
+MultiSlot file-feed path for PS/CTR training, backed by the native C++
+feed (csrc/data_feed.cc via core/native.NativeDataFeed): QueueDataset
+streams batches straight from the file channel; InMemoryDataset loads +
+globally shuffles in RAM first (the reference's load_into_memory /
+global_shuffle pair).
+
+pipe_command is real: like the reference trainer, the dataset spawns
+the command once per input file, streams the raw file through its
+stdin, and parses count-prefixed MultiSlot text (the DataGenerator
+wire protocol) off its stdout — bridged to the native feed's dense
+fixed-width layout (`_multislot_to_dense`).
 """
+import os
+import subprocess
+import tempfile
+
 import numpy as np
 
 from ...core.tensor import Tensor
@@ -18,6 +29,8 @@ class DatasetBase:
         self._thread_num = 1
         self._filelist = []
         self._feed = None
+        self._pipe_command = None
+        self._pipe_tmpdir = None
 
     def init(self, batch_size=1, thread_num=1, use_var=None,
              pipe_command=None, input_type=0, fs_name=None,
@@ -27,6 +40,8 @@ class DatasetBase:
         decides the float/int64 slot kind, shape[-1] the width)."""
         self._batch_size = int(batch_size)
         self._thread_num = int(thread_num)
+        if pipe_command:
+            self._pipe_command = pipe_command
         if use_var:
             self._slots = []
             for v in use_var:
@@ -39,11 +54,75 @@ class DatasetBase:
     def set_filelist(self, filelist):
         self._filelist = list(filelist)
 
+    def set_pipe_command(self, pipe_command):
+        """Reference parity: each input file is streamed through this
+        shell command (usually `python my_generator_script.py` running
+        a DataGenerator subclass); the command's stdout must be
+        count-prefixed MultiSlot text."""
+        self._pipe_command = pipe_command
+
+    def _multislot_to_dense(self, text_lines, out_path):
+        """Bridge the DataGenerator wire protocol to the native feed's
+        dense pipe-separated layout: '<n> v1..vn <m> u1..um' ->
+        'v1..vn | u1..um'. TPU constraint: every slot's count must
+        equal its declared fixed width (no LoD) — mismatch is a loud
+        error, not a silent pad."""
+        widths = [w for w, _ in self._slots]
+        with open(out_path, 'w') as out:
+            for ln, line in enumerate(text_lines, 1):
+                toks = line.split()
+                if not toks:
+                    continue
+                pos, fields = 0, []
+                for si, w in enumerate(widths):
+                    if pos >= len(toks):
+                        raise ValueError(
+                            f"pipe output line {ln}: expected "
+                            f"{len(widths)} slots, ran out at {si}")
+                    n = int(toks[pos])
+                    if n != w:
+                        raise ValueError(
+                            f"pipe output line {ln} slot {si}: count "
+                            f"{n} != declared fixed width {w} (the "
+                            "TPU feed is dense/no-LoD; pad in "
+                            "generate_sample)")
+                    fields.append(' '.join(toks[pos + 1:pos + 1 + n]))
+                    pos += 1 + n
+                if pos != len(toks):
+                    raise ValueError(
+                        f"pipe output line {ln}: {len(toks) - pos} "
+                        "trailing tokens after the declared slots")
+                out.write(' | '.join(fields) + '\n')
+
+    def _run_pipe(self):
+        """Run pipe_command over each input file (the reference
+        trainer's per-file pipe), writing native-format temp files."""
+        self._pipe_tmpdir = tempfile.TemporaryDirectory(
+            prefix='paddle_tpu_pipe_')
+        converted = []
+        for i, path in enumerate(self._filelist):
+            with open(path, 'rb') as src:
+                proc = subprocess.run(
+                    self._pipe_command, shell=True, stdin=src,
+                    capture_output=True)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"pipe_command failed on {path} "
+                    f"(rc={proc.returncode}): "
+                    f"{proc.stderr.decode(errors='replace')[-1000:]}")
+            dst = os.path.join(self._pipe_tmpdir.name, f'part-{i}')
+            self._multislot_to_dense(
+                proc.stdout.decode().splitlines(), dst)
+            converted.append(dst)
+        return converted
+
     def _build(self):
         from ...core.native import NativeDataFeed
+        files = self._run_pipe() if self._pipe_command \
+            else self._filelist
         self._feed = NativeDataFeed(self._slots, self._batch_size,
                                     num_threads=self._thread_num)
-        self._feed.set_filelist(self._filelist)
+        self._feed.set_filelist(files)
         return self._feed
 
     def _as_tensors(self, f, i):
@@ -116,3 +195,49 @@ class InMemoryDataset(DatasetBase):
                 "iterating (QueueDataset streams directly)")
         for f, i in self._feed.iter_memory():
             yield self._as_tensors(f, i)
+
+
+class FileInstantDataset(QueueDataset):
+    """Single-pass instant file feed (reference FileInstantDataset:
+    dataset.py:1112 over InstantDataFeed): batches stream in strict
+    file order with no memory stage and no shuffle. The native channel
+    already preserves arrival order at thread_num=1; init() pins that
+    so ported scripts get the reference's deterministic pass."""
+
+    def init(self, **kwargs):
+        kwargs.setdefault('thread_num', 1)
+        super().init(**kwargs)
+        if self._thread_num != 1:
+            self._thread_num = 1       # instant feed is one ordered pass
+        return self
+
+
+class BoxPSDataset(InMemoryDataset):
+    """BoxPS dataset surface (reference BoxPSDataset: dataset.py:1142).
+    The reference pairs it with the GPU BoxPS embedding cache;
+    this build has no box cache to warm or flush — the PS embedding
+    store is csrc/sparse_table (SSD-spill tier), which serves pulls
+    directly — so the pass-boundary hooks are genuine no-ops here and
+    preload maps onto the in-memory load path."""
+
+    def begin_pass(self):
+        return None
+
+    def end_pass(self, need_save_delta=False):
+        return None
+
+    def preload_into_memory(self, file_num=None):
+        self.load_into_memory()
+
+    def wait_preload_done(self):
+        return None
+
+    def slots_shuffle(self, slots):
+        # reference: shuffles chosen sparse slots' feasigns for feature
+        # ablation; dense fixed-width rows have no per-slot feasign
+        # lists to permute independently, so this stays a loud raiser
+        raise NotImplementedError(
+            "BoxPSDataset.slots_shuffle: per-slot feasign shuffling "
+            "assumes LoD sparse slots; the TPU feed is dense "
+            "fixed-width. Shuffle in generate_sample, or use "
+            "local_shuffle() for whole-row permutation.")

@@ -1,1 +1,2 @@
 """Host-side parameter server (reference: paddle/fluid/distributed — N30)."""
+from .communicator import AsyncCommunicator  # noqa: F401

@@ -1,0 +1,130 @@
+"""Async PS communicator — decouple trainer compute from PS RPCs.
+
+Reference parity: fluid/distributed/service/communicator.h:197
+(AsyncCommunicator: background send/recv threads + bounded queues so the
+trainer never blocks on the wire) and communicator.cc's batch-merged
+push. TPU-native shape: the overlap that matters on a tunneled chip is
+host<->device as much as host<->PS, so the communicator pairs
+
+  * a PULL prefetcher: `pull_ahead(feed)` walks the id stream in a
+    worker thread and keeps up to `depth` pulled (and optionally
+    device-put) embedding batches ready, and
+  * a PUSH drainer: `push_async(ids, grads, lr)` enqueues the (possibly
+    still in-flight jax array) gradient; the worker forces the readback
+    and sends — so the device never waits for the push wire time, and
+    the readback of step t overlaps the compute of step t+1.
+
+Staleness contract matches the reference's async mode: a pull issued at
+step t+depth may miss pushes still queued from steps < t; `flush()` is
+the communicator's barrier (reference Communicator::Clean + the sync-
+mode fences).
+"""
+import queue
+import threading
+
+import numpy as np
+
+__all__ = ['AsyncCommunicator']
+
+
+class _Stop:
+    pass
+
+
+class AsyncCommunicator:
+    def __init__(self, client, table_id, dim, depth=2, device_put=None):
+        """client: PsClient (thread-safe). depth: max in-flight pulled
+        batches / unsent pushes. device_put: optional fn(np_rows) ->
+        device array run inside the prefetch thread, so H2D upload of
+        batch t+1 overlaps compute of batch t."""
+        self.client = client
+        self.table_id = int(table_id)
+        self.dim = int(dim)
+        self.depth = int(depth)
+        self._device_put = device_put
+        self._pull_out = queue.Queue(self.depth)
+        self._push_q = queue.Queue(self.depth)
+        self._push_err = None
+        self._pushed = threading.Event()
+        self._push_thread = threading.Thread(target=self._push_loop,
+                                             daemon=True)
+        self._push_thread.start()
+        self._pull_thread = None
+
+    # -- pull side -----------------------------------------------------------
+    def pull_ahead(self, id_batches):
+        """Start prefetching: `id_batches` is an iterable of int64 id
+        arrays. Returns an iterator of (ids, rows) in order, at most
+        `depth` batches ahead of the consumer."""
+        if self._pull_thread is not None:
+            raise RuntimeError("pull_ahead already active; exhaust the "
+                               "previous iterator first")
+        out = self._pull_out
+
+        def loop():
+            try:
+                for ids in id_batches:
+                    # shape is the client's contract (PsClient.pull
+                    # flattens; a chunk adapter may keep [K, rows])
+                    ids = np.ascontiguousarray(ids, np.int64)
+                    rows = self.client.pull(self.table_id, ids, self.dim)
+                    if self._device_put is not None:
+                        rows = self._device_put(rows)
+                    out.put((ids, rows))
+            except Exception as e:           # surfaced at the consumer
+                out.put(e)
+            finally:
+                out.put(_Stop)
+
+        self._pull_thread = threading.Thread(target=loop, daemon=True)
+        self._pull_thread.start()
+
+        def results():
+            while True:
+                item = out.get()
+                if item is _Stop:
+                    self._pull_thread = None
+                    return
+                if isinstance(item, Exception):
+                    self._pull_thread = None
+                    raise item
+                yield item
+        return results()
+
+    # -- push side -----------------------------------------------------------
+    def push_async(self, ids, grads, lr):
+        """Queue a gradient push and return immediately. `grads` may be
+        a live jax array — the worker thread forces it, so device->host
+        readback overlaps the caller's next dispatch. Raises any error
+        from a PREVIOUS push (at-most-depth delayed, never silent)."""
+        if self._push_err is not None:
+            err, self._push_err = self._push_err, None
+            raise err
+        self._push_q.put((ids, grads, float(lr)))
+
+    def _push_loop(self):
+        while True:
+            item = self._push_q.get()
+            if item is _Stop:
+                return
+            ids, grads, lr = item
+            try:
+                g = np.asarray(grads)        # forces device readback
+                self.client.push(self.table_id, ids, g, lr)
+            except Exception as e:           # noqa: BLE001
+                self._push_err = e
+            finally:
+                self._push_q.task_done()
+
+    def flush(self):
+        """Barrier: wait until every queued push has landed on the
+        servers (reference sync-mode fence). Re-raises a push error."""
+        self._push_q.join()
+        if self._push_err is not None:
+            err, self._push_err = self._push_err, None
+            raise err
+
+    def stop(self):
+        self.flush()
+        self._push_q.put(_Stop)
+        self._push_thread.join(timeout=10)

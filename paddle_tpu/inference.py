@@ -2,9 +2,11 @@
 python/paddle/inference/__init__.py over paddle_infer C++; here the
 StableHLO-AOT Predictor from static/inference.py is the engine, and
 Config carries the knobs that map onto it. GPU/TRT/MKLDNN switches are
-accepted and recorded (PJRT owns device placement) so ported serving
-scripts run unchanged."""
+accepted so ported serving scripts run unchanged — but each inert switch
+warns once, so nobody believes e.g. enable_tensorrt_engine() did
+anything (the knobs' real home is paddle_pass_builder.cc)."""
 import enum
+import warnings
 
 import numpy as np
 
@@ -66,7 +68,19 @@ class Config:
     def model_dir(self):
         return self._path_prefix
 
+    def _warn_inert(self, knob):
+        # once per Config instance per knob
+        if knob not in self._enabled:
+            warnings.warn(
+                f"paddle.inference.Config.{knob} is accepted for script "
+                f"compatibility but has NO effect on this TPU/XLA build: "
+                f"the StableHLO-AOT predictor runs on the PJRT default "
+                f"device with XLA's own fusion/memory planning.",
+                UserWarning, stacklevel=3)
+
     def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._warn_inert('enable_use_gpu')
+        self._enabled['enable_use_gpu'] = True
         self._device = 'gpu'
 
     def disable_gpu(self):
@@ -75,7 +89,8 @@ class Config:
     def use_gpu(self):
         return self._device == 'gpu'
 
-    # accepted switches the XLA path subsumes (fusion, memory planning)
+    # accepted switches the XLA path subsumes (fusion, memory planning) —
+    # these two are genuinely satisfied by XLA, so no warning
     def switch_ir_optim(self, flag=True):
         self._enabled['ir_optim'] = flag
 
@@ -83,9 +98,13 @@ class Config:
         self._enabled['memory_optim'] = True
 
     def enable_mkldnn(self):
+        self._warn_inert('enable_mkldnn')
+        self._enabled['enable_mkldnn'] = True
         self._enabled['mkldnn'] = True
 
     def enable_tensorrt_engine(self, *a, **k):
+        self._warn_inert('enable_tensorrt_engine')
+        self._enabled['enable_tensorrt_engine'] = True
         self._enabled['trt'] = True
 
     def set_cpu_math_library_num_threads(self, n):
@@ -109,7 +128,10 @@ class Predictor:
         self._names = [f'x{i}'
                        for i in range(len(self._inner.input_specs))]
         self._feeds = {}
-        self._n_out = None                  # discovered on first run
+        # output arity comes from the StableHLO module at load time, so
+        # names are enumerable before the first run() (reference parity);
+        # None only for pre-r5 artifacts loaded by an inner without it
+        self._n_out = getattr(self._inner, 'n_outputs', None)
 
     def get_input_names(self):
         return list(self._names)
@@ -131,8 +153,12 @@ class Predictor:
         if inputs is None:                  # handle-style call
             inputs = [self._feeds[n] for n in self._names]
         outs = self._inner.run(*inputs)
-        self._outputs = list(outs) if isinstance(outs, (list, tuple)) \
-            else [outs]
+        # flatten to pytree LEAVES so the run-time arity agrees with the
+        # load-time one (n_outputs = out_tree.num_leaves): a model
+        # returning (logits, (h, c)) serves three arrays, not two slots
+        # one of which is a tuple
+        import jax
+        self._outputs = jax.tree_util.tree_leaves(outs)
         self._n_out = len(self._outputs)
         return self._outputs
 

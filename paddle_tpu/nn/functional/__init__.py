@@ -45,5 +45,6 @@ from ...ops.contrib import hsigmoid_loss  # noqa
 
 
 def tanh_(x, name=None):
-    from ...ops.math import tanh
-    return tanh(x)
+    # single source of the in-place contract: the top-level spelling
+    from ...api_tail import tanh_ as _impl
+    return _impl(x, name=name)

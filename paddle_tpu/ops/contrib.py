@@ -868,3 +868,169 @@ def random_crop(x, shape, seed=None):
         out = jax.vmap(one)(flat, keys)
         return out.reshape(lead + shape)
     return run_op('random_crop', fn, [x])
+
+
+def bilateral_slice(x, guide, grid, has_offset=False):
+    """bilateral_slice_op.cc/.cu (fluid/contrib/layers/nn.py:1499) — HDRNet
+    grid slicing: per-pixel trilinear lookup into a low-res bilateral grid
+    at (x, y, guide[x, y]), the sampled coefficients applied as a per-pixel
+    affine map of the input channels.
+
+    x [N, Cin, H, W], guide [N, H, W] in [0, 1], grid [N, Cg, D, Hg, Wg]
+    with Cg = Cout*(Cin+1) when has_offset else Cout*Cin. TPU-native: the
+    eight trilinear corners become eight dense gathers + weighted sums
+    (one fused XLA program), not a scalar loop. The z tap weight uses the
+    reference's smoothed hat max(1 - sqrt(dz^2 + 1e-8), 0)."""
+    x, guide, grid = as_tensor(x), as_tensor(guide), as_tensor(grid)
+    has_offset = bool(has_offset)
+
+    def fn(xa, ga, gr):
+        N, Cin, H, W = xa.shape
+        _, Cg, D, Hg, Wg = gr.shape
+        stride = Cin + 1 if has_offset else Cin
+        if Cg % stride:
+            raise ValueError(
+                f"grid channels {Cg} not divisible by Cin"
+                f"{'+1' if has_offset else ''}={stride}")
+        Cout = Cg // stride
+        f32 = jnp.float32
+        gx = (jnp.arange(W, dtype=f32) + 0.5) * (Wg / W)      # [W]
+        gy = (jnp.arange(H, dtype=f32) + 0.5) * (Hg / H)      # [H]
+        gz = ga.astype(f32) * D                               # [N, H, W]
+        fx = jnp.floor(gx - 0.5)
+        fy = jnp.floor(gy - 0.5)
+        fz = jnp.floor(gz - 0.5)
+        # grid in gather-friendly layout: [N, D, Hg, Wg, Cg]
+        grt = jnp.transpose(gr, (0, 2, 3, 4, 1)).astype(f32)
+        bb = jnp.arange(N)[:, None, None]
+        acc = jnp.zeros((N, H, W, Cg), f32)
+        for dz in range(2):
+            zz = fz + dz
+            wz = jnp.maximum(
+                1.0 - jnp.sqrt((zz + 0.5 - gz) ** 2 + 1e-8), 0.0)
+            zi = jnp.clip(zz, 0, D - 1).astype(jnp.int32)
+            for dy in range(2):
+                yy = fy + dy
+                wy = jnp.maximum(1.0 - jnp.abs(yy + 0.5 - gy), 0.0)
+                yi = jnp.clip(yy, 0, Hg - 1).astype(jnp.int32)
+                for dx in range(2):
+                    xx = fx + dx
+                    wx = jnp.maximum(1.0 - jnp.abs(xx + 0.5 - gx), 0.0)
+                    xi = jnp.clip(xx, 0, Wg - 1).astype(jnp.int32)
+                    corner = grt[bb, zi,
+                                 yi[None, :, None], xi[None, None, :]]
+                    w = (wz * wy[None, :, None] * wx[None, None, :])
+                    acc = acc + corner * w[..., None]
+        # [N, H, W, Cout, stride]: affine coeffs per output channel
+        co = acc.reshape(N, H, W, Cout, stride)
+        xin = jnp.transpose(xa, (0, 2, 3, 1)).astype(f32)     # [N,H,W,Cin]
+        val = jnp.einsum('nhwoc,nhwc->nhwo', co[..., :Cin], xin)
+        if has_offset:
+            val = val + co[..., Cin]
+        return jnp.transpose(val, (0, 3, 1, 2)).astype(xa.dtype)
+    return run_op('bilateral_slice', fn, [x, guide, grid])
+
+
+def correlation(x, y, pad_size, kernel_size, max_displacement,
+                stride1=1, stride2=1, corr_type_multiply=1):
+    """correlation_op.cc/.cu (fluid/contrib/layers/nn.py:1562) — FlowNet
+    cost volume: for every displacement (k, l) in the (2d+1)^2 window,
+    the mean over a kernel_size^2 x C patch of x * shifted(y).
+
+    Output [N, (2d+1)^2, H, W], channel index l+d + (2d+1)*(k+d). The
+    displacement loop is a static Python unroll — (2d+1)^2 dense
+    elementwise-mul + window-mean ops that XLA fuses; no gather/scatter.
+    stride1/stride2 > 1 subsample query pixels/displacements on CUDA;
+    this build keeps the dense stride-1 form and raises loudly otherwise.
+    """
+    if stride1 != 1 or stride2 != 1:
+        raise NotImplementedError(
+            "correlation: stride1/stride2 > 1 (sparse cost volume) is "
+            "not implemented on the TPU build — compute the dense "
+            "stride-1 volume and subsample the output, which XLA fuses "
+            "to the same work")
+    x, y = as_tensor(x), as_tensor(y)
+    pad, K, d = int(pad_size), int(kernel_size), int(max_displacement)
+    if pad < d + K - 1:
+        raise ValueError(
+            f"correlation: pad_size={pad} must cover max_displacement"
+            f"+kernel_size-1={d + K - 1} so every shifted window stays "
+            "in the padded map")
+    D = 2 * d + 1
+
+    def fn(xa, ya):
+        N, C, H, W = xa.shape
+        f32 = jnp.float32
+        cfg = [(0, 0), (0, 0), (pad, pad), (pad, pad)]
+        x1 = jnp.pad(xa.astype(f32), cfg)
+        y1 = jnp.pad(ya.astype(f32), cfg)
+        chans = []
+        for k in range(-d, d + 1):
+            for l in range(-d, d + 1):
+                prod = jnp.zeros((N, H, W), f32)
+                for ki in range(K):
+                    for kj in range(K):
+                        a = lax.dynamic_slice(
+                            x1, (0, 0, pad + ki, pad + kj),
+                            (N, C, H, W))
+                        b = lax.dynamic_slice(
+                            y1, (0, 0, pad + k + ki, pad + l + kj),
+                            (N, C, H, W))
+                        prod = prod + (a * b).sum(1)
+                chans.append(prod / (K * K * C))
+        out = jnp.stack(chans, 1)          # [(k,l) row-major] == l+d+D*(k+d)
+        return out.astype(xa.dtype)
+    return run_op('correlation', fn, [x, y])
+
+
+def partial_concat(inputs, start_index=0, length=-1):
+    """partial_concat_op.cc (fluid/contrib/layers/nn.py partial_concat) —
+    concat the same column slice [start, start+length) of every 2-D
+    input along axis 1."""
+    ts = [as_tensor(t) for t in inputs]
+
+    def fn(*arrs):
+        outs = []
+        for a in arrs:
+            n = a.shape[1]
+            s = start_index if start_index >= 0 else n + start_index
+            e = n if length < 0 else s + length
+            outs.append(a[:, s:e])
+        return jnp.concatenate(outs, axis=1)
+    return run_op('partial_concat', fn, ts)
+
+
+def partial_sum(inputs, start_index=0, length=-1):
+    """partial_sum_op.cc — elementwise sum of the same column slice of
+    every 2-D input."""
+    ts = [as_tensor(t) for t in inputs]
+
+    def fn(*arrs):
+        acc = None
+        for a in arrs:
+            n = a.shape[1]
+            s = start_index if start_index >= 0 else n + start_index
+            e = n if length < 0 else s + length
+            sl = a[:, s:e]
+            acc = sl if acc is None else acc + sl
+        return acc
+    return run_op('partial_sum', fn, ts)
+
+
+def modified_huber_loss(input, label):
+    """modified_huber_loss_op.cc — binary classification loss on margin
+    z = 2*label-1 times prediction: (max(0, 1-yz))^2 for yz >= -1, else
+    -4*yz."""
+    input, label = as_tensor(input), as_tensor(label)
+
+    def fn(x, y):
+        yz = (2.0 * y.astype(x.dtype) - 1.0) * x
+        sq = jnp.square(jnp.maximum(1.0 - yz, 0.0))
+        return jnp.where(yz >= -1.0, sq, -4.0 * yz)
+    return run_op('modified_huber_loss', fn, [input, label], n_nondiff=1)
+
+
+def l1_norm(x):
+    """l1_norm_op.cc — sum of absolute values (scalar)."""
+    x = as_tensor(x)
+    return run_op('l1_norm', lambda a: jnp.abs(a).sum(), [x])

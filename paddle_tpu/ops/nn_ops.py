@@ -1589,11 +1589,15 @@ def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25,
     return run_op('sigmoid_focal_loss_v2', fn, tensors, n_nondiff=1)
 
 
-# in-place spellings: JAX arrays are immutable, so these are the
-# value-returning forms under the reference's aliases
+# in-place spellings: compute out-of-place (JAX buffers are immutable)
+# and rebind the input tensor's buffer via the shared inplace_rebind,
+# which grafts the alias into the autograd tape (gradients through
+# later uses of x stay exact) — same contract as the api_tail spellings
 def relu_(x, name=None):
-    return relu(x)
+    from ..core.tensor import inplace_rebind
+    return inplace_rebind(x, relu(x))
 
 
 def softmax_(x, axis=-1, dtype=None, name=None):
-    return softmax(x, axis=axis)
+    from ..core.tensor import inplace_rebind
+    return inplace_rebind(x, softmax(x, axis=axis))

@@ -67,7 +67,13 @@ class Optimizer:
 
     def _set_param(self, p, new_master):
         if not self._multi_precision or p.dtype == jnp.float32:
-            p.data = new_master
+            # the update math runs fp32; never let it upcast a
+            # low-precision param's storage (bf16 params with
+            # multi_precision=False is the memory-tight config
+            # moment_dtype exists for — an fp32 write-back would double
+            # param HBM and retrace dtype-keyed jits)
+            p.data = new_master if new_master.dtype == p.dtype \
+                else new_master.astype(p.dtype)
         else:
             self._master_weights[self._param_key(p)] = new_master
             p.data = new_master.astype(p.dtype)
@@ -483,29 +489,38 @@ class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-08, parameters=None, weight_decay=None,
                  grad_clip=None, lazy_mode=False, multi_precision=True,
-                 name=None, **kwargs):
+                 name=None, moment_dtype=None, **kwargs):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          name, multi_precision)
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
+        # moment_dtype='bfloat16' halves optimizer-state HBM: moments are
+        # STORED low-precision but the update math always runs in fp32
+        # (casts fuse into the update kernel, so the fp32 round-trip costs
+        # registers, not bandwidth). This is how 1.3B-param Adam state fits
+        # one 16G v5e chip (fp32 moments alone would be 10.4G).
+        self._moment_dtype = jnp.dtype(moment_dtype) if moment_dtype \
+            else jnp.float32
 
     def init_state(self, param):
-        return {'moment1': jnp.zeros(param.data.shape, jnp.float32),
-                'moment2': jnp.zeros(param.data.shape, jnp.float32),
+        return {'moment1': jnp.zeros(param.data.shape, self._moment_dtype),
+                'moment2': jnp.zeros(param.data.shape, self._moment_dtype),
                 'beta1_pow': jnp.asarray(1.0, jnp.float32),
                 'beta2_pow': jnp.asarray(1.0, jnp.float32)}
 
     def update(self, param, grad, state, lr):
         b1, b2, eps = self._beta1, self._beta2, self._epsilon
-        m1 = b1 * state['moment1'] + (1 - b1) * grad
-        m2 = b2 * state['moment2'] + (1 - b2) * grad * grad
+        mdt = state['moment1'].dtype
+        m1 = b1 * state['moment1'].astype(jnp.float32) + (1 - b1) * grad
+        m2 = b2 * state['moment2'].astype(jnp.float32) \
+            + (1 - b2) * grad * grad
         b1p = state['beta1_pow'] * b1
         b2p = state['beta2_pow'] * b2
         lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
         new_p = param - lr_t * m1 / (jnp.sqrt(m2) + eps)
-        return new_p, {'moment1': m1, 'moment2': m2, 'beta1_pow': b1p,
-                       'beta2_pow': b2p}
+        return new_p, {'moment1': m1.astype(mdt), 'moment2': m2.astype(mdt),
+                       'beta1_pow': b1p, 'beta2_pow': b2p}
 
 
 class AdamW(Adam):
@@ -514,9 +529,11 @@ class AdamW(Adam):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-08, parameters=None, weight_decay=0.01,
                  lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
-                 lazy_mode=False, multi_precision=True, name=None, **kwargs):
+                 lazy_mode=False, multi_precision=True, name=None,
+                 moment_dtype=None, **kwargs):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
-                         None, grad_clip, lazy_mode, multi_precision, name)
+                         None, grad_clip, lazy_mode, multi_precision, name,
+                         moment_dtype=moment_dtype)
         self._coeff = float(weight_decay) if not hasattr(weight_decay,
                                                          '_coeff') \
             else weight_decay._coeff

@@ -82,6 +82,13 @@ class Predictor:
                          for k, v in arrays.items() if k.startswith('b:')}
         self.input_specs = [(tuple(sh), dt)
                             for sh, dt in meta['input_specs']]
+        # output arity is known statically from the exported module, so
+        # serving code can enumerate output names before the first run()
+        # (the reference Predictor exposes fetch targets at load)
+        try:
+            self.n_outputs = int(self._exported.out_tree.num_leaves)
+        except Exception:
+            self.n_outputs = None
 
     def run(self, *inputs):
         arrays = tuple(i.data if isinstance(i, Tensor) else jnp.asarray(i)

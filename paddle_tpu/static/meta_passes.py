@@ -15,10 +15,13 @@ Reference parity:
 
 TPU-native notes: the conditional apply uses the nested-sub-block
 `conditional_block` op (replayed as lax.cond). LocalSGD's periodic sync
-is expressed arithmetically (allreduce every step + a where-blend on the
-step gate) rather than under a cond: a lockstep XLA program prefers a
-static collective schedule, and the blend reproduces the reference's
-semantics exactly — parameters move only at multiples of k.
+keeps its collectives as TOP-LEVEL ops (so multi-rank runners can
+rendezvous on them) but marks the whole tail `localsgd_tail`; the k-step
+boundary is a HOST-side decision — the step counter is persistable
+scope state, so the runner picks between two cached executables
+(sync-step / local-step) by `step % k` and off-boundary steps execute
+zero collectives. In-program where-blend gating is kept as a fallback
+for marker-unaware runners (correct, just not comm-saving).
 """
 import jax.numpy as jnp
 
@@ -139,7 +142,17 @@ def apply_localsgd(program, k_steps, nranks, ring_id=0):
     """Append the LocalSGD parameter-sync tail: every `k_steps`-th step
     each trainable parameter is replaced by the cross-rank average
     (c_allreduce_sum + 1/nranks blend on the step gate); other steps the
-    parameters keep their locally-optimized values."""
+    parameters keep their locally-optimized values.
+
+    The tail ops carry `localsgd_tail: True` and the program records
+    `_localsgd_k`: runners that understand the marker (Executor,
+    MultiRankShardingSimulator) gate the WHOLE tail host-side on the
+    k-step boundary — k-1 of every k steps execute ZERO collectives,
+    which is the communication saving LocalSGD exists for
+    (localsgd_optimizer.py:63-79 syncs only at boundaries). A runner
+    that ignores the marker still trains correctly (allreduce every
+    step, where-blend keeps off-boundary params local) — just without
+    the comm saving."""
     k = int(k_steps)
     if k < 1:
         raise ValueError(f"localsgd k_steps must be >= 1, got {k}")
@@ -159,17 +172,18 @@ def apply_localsgd(program, k_steps, nranks, ring_id=0):
                               lambda s, _k=k: (s % _k) == 0,
                               [step.name], [gate], {'k': k},
                               op_role=OpRole.Optimize))
+    tail = {'localsgd_tail': True}
     for p in params:
         tmp = p.name + '@LOCALSGD_sum'
         block.vars[tmp] = Variable(block, tmp, list(p.shape or []),
                                    p.dtype)
         block.ops.append(Operator('share_data', lambda x: x,
-                                  [p.name], [tmp], {},
+                                  [p.name], [tmp], dict(tail),
                                   op_role=OpRole.Optimize))
         block.ops.append(Operator('c_allreduce_sum', lambda x: x,
                                   [tmp], [tmp],
                                   {'ring_id': ring_id,
-                                   'use_calc_stream': True},
+                                   'use_calc_stream': True, **tail},
                                   op_role=OpRole.Optimize))
 
         def blend(pv, sv, gv, _n=nranks):
@@ -177,10 +191,11 @@ def apply_localsgd(program, k_steps, nranks, ring_id=0):
             return jnp.where(gv, avg, pv)
         block.ops.append(Operator('localsgd_blend', blend,
                                   [p.name, tmp, gate], [p.name],
-                                  {'nranks': nranks},
+                                  {'nranks': nranks, **tail},
                                   op_role=OpRole.Optimize))
     program._localsgd_k = k
     program._localsgd_nranks = nranks
+    program._localsgd_step_var = step.name
     return len(params)
 
 

@@ -287,6 +287,23 @@ def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
                                  eps=eps)
 
 
+def bilateral_slice(x, guide, grid, has_offset, name=None):
+    """Parity: fluid/contrib/layers/nn.py:1499 bilateral_slice
+    (operators/bilateral_slice_op.cc)."""
+    from ..ops import contrib
+    return contrib.bilateral_slice(x, guide, grid, has_offset=has_offset)
+
+
+def correlation(x, y, pad_size, kernel_size, max_displacement, stride1,
+                stride2, corr_type_multiply=1):
+    """Parity: fluid/contrib/layers/nn.py:1562 correlation
+    (operators/correlation_op.cc)."""
+    from ..ops import contrib
+    return contrib.correlation(x, y, pad_size, kernel_size,
+                               max_displacement, stride1, stride2,
+                               corr_type_multiply)
+
+
 # ---------------------------------------------------------------------------
 # fluid.layers legacy surface (VERDICT r3 #10 — fluid/layers/nn.py et al.)
 # Legacy NAMES + legacy SIGNATURES adapted onto the shared op layer; every

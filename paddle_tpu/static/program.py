@@ -55,6 +55,9 @@ def disable_static():
 
 
 _GLOBAL_NAME_COUNTER = {}
+# optional name prefix installed by unique_name.guard(new_generator=str)
+# (reference: fluid/unique_name.py UniqueNameGenerator prefix)
+_GLOBAL_NAME_PREFIX = ''
 
 
 class Variable:
@@ -279,7 +282,7 @@ class Program:
         if prefix == 'param':
             n = _GLOBAL_NAME_COUNTER.get(prefix, 0)
             _GLOBAL_NAME_COUNTER[prefix] = n + 1
-            return f"{prefix}_{n}"
+            return f"{_GLOBAL_NAME_PREFIX}{prefix}_{n}"
         self._name_counter[prefix] = self._name_counter.get(prefix, 0) + 1
         return f"{prefix}_{self._name_counter[prefix] - 1}"
 

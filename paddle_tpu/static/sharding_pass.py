@@ -166,6 +166,10 @@ class MultiRankShardingSimulator:
     def __init__(self, rank_programs, seed=None):
         self.progs = rank_programs
         self.scopes = [{} for _ in rank_programs]
+        # executed cross-rank collectives (one count per rendezvous, not
+        # per rank) — lets tests assert LocalSGD's off-boundary steps
+        # really run zero allreduces
+        self.collective_count = 0
         self._startup(seed)
 
     def _startup(self, seed=None):
@@ -208,6 +212,19 @@ class MultiRankShardingSimulator:
                     env[v.name] = self.scopes[r][v.name]
             envs.append(env)
 
+        # LocalSGD host gating (mirrors Executor.run): off-boundary
+        # steps skip the whole marked sync tail — zero collectives.
+        # The step counter is lockstep across ranks, so skipping is
+        # symmetric and the rendezvous stays aligned.
+        skip_tail = [False] * len(self.progs)
+        for r, prog in enumerate(self.progs):
+            lk = getattr(prog, '_localsgd_k', 0)
+            if lk and lk > 1:
+                cur = self.scopes[r].get(
+                    getattr(prog, '_localsgd_step_var', '@LOCALSGD_step'))
+                cur = int(cur) if cur is not None else 0
+                skip_tail[r] = ((cur + 1) % lk) != 0
+
         # ops run in list position order; collectives synchronize ranks.
         # Rank programs share the pre-optimize prefix and the broadcast
         # tail; the optimize middle differs per rank (pruning), so walk
@@ -222,6 +239,9 @@ class MultiRankShardingSimulator:
                 ops = prog.global_block().ops
                 while cursors[r] < len(ops):
                     op = ops[cursors[r]]
+                    if skip_tail[r] and op.attrs.get('localsgd_tail'):
+                        cursors[r] += 1
+                        continue
                     if op.type in COLLECTIVE:
                         pending[r] = op
                         break
@@ -256,6 +276,7 @@ class MultiRankShardingSimulator:
         run_op_in_env(op, env)
 
     def _run_collective(self, op, envs):
+        self.collective_count += 1
         name = op.input_names[0]
         if op.type == 'c_allreduce_sum':
             total = sum(env[name] for env in envs)

@@ -12,26 +12,67 @@ def generate(key):
     from ..static import program as _prog
     n = _prog._GLOBAL_NAME_COUNTER.get(key, 0)
     _prog._GLOBAL_NAME_COUNTER[key] = n + 1
-    return f"{key}_{n}"
+    return f"{_prog._GLOBAL_NAME_PREFIX}{key}_{n}"
 
 
 @contextlib.contextmanager
 def guard(new_generator=None):
     """Scope the global name counters: inside the guard, naming starts
-    fresh (or from `new_generator`'s state); on exit the previous
-    counters are restored."""
+    fresh. `new_generator`, when given as a str, prefixes every name
+    minted inside the guard (reference: fluid/unique_name.py
+    UniqueNameGenerator prefix) — so twin guarded Programs CAN opt out
+    of name sharing by using distinct prefixes.
+
+    On exit the previous counters are restored, MERGED with the guarded
+    block's high-water marks — so names minted after the guard can never
+    collide with (and silently alias, in the global scope) names minted
+    inside it. The one remaining sharing surface is intentional: two
+    sequential guard() blocks DO repeat names — that is what the
+    multi-rank SPMD simulators need (structurally-identical Programs on
+    every rank get identical parameter names). Only run such twin
+    Programs in separate scopes/processes; in one shared scope they
+    alias one buffer.
+    """
     from ..static import program as _prog
     saved = dict(_prog._GLOBAL_NAME_COUNTER)
+    saved_prefix = _prog._GLOBAL_NAME_PREFIX
     _prog._GLOBAL_NAME_COUNTER.clear()
+    if isinstance(new_generator, (str, bytes)):
+        _prog._GLOBAL_NAME_PREFIX = (
+            new_generator.decode() if isinstance(new_generator, bytes)
+            else new_generator)
+    else:
+        # a plain nested guard() starts a FRESH generator — empty
+        # prefix, like the reference's guard(None)
+        _prog._GLOBAL_NAME_PREFIX = ''
     try:
         yield
     finally:
+        guarded = dict(_prog._GLOBAL_NAME_COUNTER)
+        _prog._GLOBAL_NAME_PREFIX = saved_prefix
         _prog._GLOBAL_NAME_COUNTER.clear()
         _prog._GLOBAL_NAME_COUNTER.update(saved)
+        for k, n in guarded.items():
+            if n > _prog._GLOBAL_NAME_COUNTER.get(k, 0):
+                _prog._GLOBAL_NAME_COUNTER[k] = n
 
 
 def switch(new_generator=None):
+    """Swap the whole name-generator state (counters + prefix) and
+    return the previous state — pass a returned state back in to
+    restore it, or a str to install a fresh generator with that prefix
+    (reference: fluid/unique_name.py switch)."""
     from ..static import program as _prog
-    old = dict(_prog._GLOBAL_NAME_COUNTER)
+    old = {'counters': dict(_prog._GLOBAL_NAME_COUNTER),
+           'prefix': _prog._GLOBAL_NAME_PREFIX}
     _prog._GLOBAL_NAME_COUNTER.clear()
+    _prog._GLOBAL_NAME_PREFIX = ''
+    if isinstance(new_generator, (str, bytes)):
+        _prog._GLOBAL_NAME_PREFIX = (
+            new_generator.decode() if isinstance(new_generator, bytes)
+            else new_generator)
+    elif isinstance(new_generator, dict):
+        _prog._GLOBAL_NAME_COUNTER.update(
+            new_generator.get('counters', new_generator))
+        _prog._GLOBAL_NAME_PREFIX = new_generator.get('prefix', '')
     return old

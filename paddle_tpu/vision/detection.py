@@ -1478,7 +1478,7 @@ def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
         gi = jnp.clip((gb[..., 0] * W).astype(jnp.int32), 0, W - 1)
         gj = jnp.clip((gb[..., 1] * H).astype(jnp.int32), 0, H - 1)
         tx = gb[..., 0] * W - gi
-        ty = gb[..., 1] * W - gj                     # oracle uses *w
+        ty = gb[..., 1] * H - gj
         aw = m_anch[:, 0][an_idx]                    # matched anchor w/h
         ah = m_anch[:, 1][an_idx]
         tw = jnp.log(jnp.maximum(gb[..., 2], 1e-10) / aw)

@@ -1,0 +1,190 @@
+"""Fleet data surface (VERDICT r5 #6): the dataset/data_generator
+export sheet of paddle.distributed.fleet (reference fleet/__init__.py:
+16-38) and the generator -> pipe_command -> InMemoryDataset -> train
+ingestion path (reference fleet/data_generator/data_generator.py:20)."""
+import io
+import os
+import sys
+import types
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_fleet_export_sheet_parity():
+    """Every name the reference exports from paddle.distributed.fleet
+    resolves here (fleet/__init__.py:16-44)."""
+    import paddle_tpu.distributed.fleet as fleet
+    for name in [
+            # classes (reference import block :16-31)
+            'Role', 'UserDefinedRoleMaker', 'PaddleCloudRoleMaker',
+            'DistributedStrategy', 'Fleet', 'UtilBase',
+            'DatasetBase', 'InMemoryDataset', 'QueueDataset',
+            'FileInstantDataset', 'BoxPSDataset',
+            'MultiSlotDataGenerator', 'MultiSlotStringDataGenerator',
+            'metrics', 'CommunicateTopology', 'HybridCommunicateGroup',
+            # singleton re-bindings (:46-80)
+            'fleet', 'init', 'is_first_worker', 'worker_index',
+            'worker_num', 'is_worker', 'worker_endpoints', 'server_num',
+            'server_endpoints', 'is_server', 'barrier_worker',
+            'init_worker', 'init_server', 'run_server', 'stop_worker',
+            'distributed_optimizer', 'save_persistables', 'minimize']:
+        assert hasattr(fleet, name), f"fleet.{name} missing"
+    # the generator submodule import style the reference docs use
+    import paddle_tpu.distributed.fleet.data_generator as dg
+    assert issubclass(dg.MultiSlotDataGenerator, dg.DataGenerator)
+
+
+def test_multislot_generator_wire_protocol():
+    """Byte-parity with the reference protocol: '<n> v1..vn' per slot,
+    one sample per line (data_generator.py _gen_str)."""
+    from paddle_tpu.distributed.fleet import MultiSlotDataGenerator
+
+    class G(MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def it():
+                yield [("words", [1926, 8, 17]), ("label", [1])]
+            return it
+
+    g = G()
+    out = io.StringIO()
+    g._run(['x'], out)
+    assert out.getvalue() == "3 1926 8 17 1 1\n"
+    assert g._proto_info == [("words", "uint64"), ("label", "uint64")]
+    # float promotes the slot kind, mismatched slot set raises
+    out2 = io.StringIO()
+    out2.write(g._gen_str([("words", [1.5, 2, 3]), ("label", [0])]))
+    assert g._proto_info[0] == ("words", "float")
+    with pytest.raises(ValueError, match='inconsistent'):
+        g._gen_str([("words", [1])])
+
+
+def test_multislot_string_generator():
+    from paddle_tpu.distributed.fleet import MultiSlotStringDataGenerator
+
+    class G(MultiSlotStringDataGenerator):
+        def generate_sample(self, line):
+            def it():
+                yield [("q", line.split()), ("label", ["1"])]
+            return it
+
+    g = G()
+    out = io.StringIO()
+    g._run(["ab cd\n"], out)
+    assert out.getvalue() == "2 ab cd 1 1\n"
+
+
+def _slot_vars():
+    return [types.SimpleNamespace(shape=[4], dtype='float32'),
+            types.SimpleNamespace(shape=[1], dtype='int64')]
+
+
+_GEN_SCRIPT = """
+import sys, os
+sys.path.insert(0, {repo!r})
+from paddle_tpu.distributed.fleet import MultiSlotDataGenerator
+
+class CtrGen(MultiSlotDataGenerator):
+    def generate_sample(self, line):
+        def it():
+            parts = line.split(',')
+            yield [("feat", [float(x) for x in parts[1:]]),
+                   ("label", [int(parts[0])])]
+        return it
+
+CtrGen().run_from_stdin()
+"""
+
+
+def test_pipe_command_ingestion_to_training(tmp_path):
+    """The full reference flow: raw CSV file -> pipe_command running a
+    DataGenerator subclass -> InMemoryDataset -> shuffled batches ->
+    a train step (the DeepFM-family ingestion path)."""
+    from paddle_tpu.distributed.fleet import InMemoryDataset
+    from paddle_tpu import nn
+
+    rng = np.random.RandomState(0)
+    raw = tmp_path / 'part-0.csv'
+    rows = []
+    with open(raw, 'w') as f:
+        for _ in range(64):
+            feats = rng.rand(4)
+            label = int(rng.randint(0, 2))
+            rows.append((feats, label))
+            f.write(f"{label}," + ",".join(f"{x:.6f}" for x in feats)
+                    + "\n")
+    script = tmp_path / 'gen.py'
+    script.write_text(_GEN_SCRIPT.format(
+        repo=os.path.dirname(os.path.dirname(os.path.abspath(
+            paddle.__file__)))))
+
+    ds = InMemoryDataset()
+    ds.init(batch_size=16, thread_num=1, use_var=_slot_vars(),
+            pipe_command=f"{sys.executable} {script}")
+    ds.set_filelist([str(raw)])
+    ds.load_into_memory()
+    ds.local_shuffle()
+    assert ds.get_memory_data_size() == 64
+
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    seen = 0
+    for feat, label in ds:
+        loss = nn.functional.cross_entropy(model(feat),
+                                           label.squeeze(-1))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        seen += feat.shape[0]
+        assert np.isfinite(float(loss))
+    assert seen == 64
+    # round-trip integrity: the multiset of labels survives the pipe
+    got = sorted(int(r[1]) for r in rows)
+    ds2 = InMemoryDataset()
+    ds2.init(batch_size=64, thread_num=1, use_var=_slot_vars(),
+             pipe_command=f"{sys.executable} {script}")
+    ds2.set_filelist([str(raw)])
+    ds2.load_into_memory()
+    for feat, label in ds2:
+        assert sorted(np.asarray(label.data).ravel().tolist()) == got
+
+
+def test_pipe_width_mismatch_is_loud(tmp_path):
+    """The TPU feed is dense/no-LoD: a slot count that disagrees with
+    the declared width must error, not silently pad."""
+    from paddle_tpu.distributed.fleet import QueueDataset
+    ds = QueueDataset()
+    ds.init(batch_size=4, use_var=_slot_vars())
+    with pytest.raises(ValueError, match='fixed width'):
+        ds._multislot_to_dense(["3 1.0 2.0 3.0 1 1"], tmp_path / 'o')
+
+
+def test_file_instant_and_boxps_datasets(tmp_path):
+    from paddle_tpu.distributed.fleet import (FileInstantDataset,
+                                              BoxPSDataset)
+    p = tmp_path / 'd.txt'
+    with open(p, 'w') as f:
+        for i in range(8):
+            f.write(f"{i}.0 {i}.5 1.0 2.0 | {i % 2}\n")
+    fi = FileInstantDataset()
+    fi.init(batch_size=4, thread_num=4, use_var=_slot_vars())
+    assert fi._thread_num == 1          # instant = one ordered pass
+    fi.set_filelist([str(p)])
+    feats = np.concatenate([np.asarray(f.data) for f, _ in fi])
+    np.testing.assert_allclose(feats[:, 0], np.arange(8))  # file order
+
+    bx = BoxPSDataset()
+    bx.init(batch_size=4, use_var=_slot_vars())
+    bx.set_filelist([str(p)])
+    bx.begin_pass()
+    bx.preload_into_memory()
+    bx.wait_preload_done()
+    assert bx.get_memory_data_size() == 8
+    n = sum(f.shape[0] for f, _ in bx)
+    assert n == 8
+    bx.end_pass()
+    with pytest.raises(NotImplementedError, match='slots_shuffle'):
+        bx.slots_shuffle(['feat'])

@@ -23,8 +23,9 @@ def test_inference_config_predictor_roundtrip(tmp_path):
     cfg.enable_memory_optim()
     pred = paddle.inference.create_predictor(cfg)
     assert pred.get_input_names() == ['x0']
-    with pytest.raises(RuntimeError, match='first'):
-        pred.get_output_names()              # arity known after run()
+    # arity comes from the StableHLO module at LOAD time (reference
+    # parity: serving code enumerates fetch targets before feeding data)
+    assert pred.get_output_names() == ['out_0']
 
     # handle-style serving loop (the reference's documented flow)
     h = pred.get_input_handle('x0')
@@ -69,3 +70,130 @@ def test_utils_sysconfig_onnx():
     assert paddle.sysconfig.get_include().endswith('csrc')
     with pytest.raises(NotImplementedError, match='StableHLO'):
         paddle.onnx.export(None, '/tmp/x')
+
+
+def test_inert_config_knobs_warn_once():
+    """VERDICT r4 weak #6: accepted-but-inert Config switches must warn
+    so nobody believes enable_tensorrt_engine() did anything."""
+    import warnings as _w
+    cfg = paddle.inference.Config()
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter('always')
+        cfg.enable_tensorrt_engine(workspace_size=1 << 20)
+        cfg.enable_mkldnn()
+        cfg.enable_use_gpu(100, 0)
+        # second call of an already-warned knob stays silent
+        cfg.enable_tensorrt_engine()
+    msgs = [str(w.message) for w in rec]
+    assert len(msgs) == 3
+    assert any('enable_tensorrt_engine' in m and 'NO effect' in m
+               for m in msgs)
+    assert any('enable_mkldnn' in m for m in msgs)
+    assert any('enable_use_gpu' in m for m in msgs)
+    # the XLA-subsumed switches are genuinely satisfied: no warning
+    with _w.catch_warnings(record=True) as rec2:
+        _w.simplefilter('always')
+        cfg.switch_ir_optim(True)
+        cfg.enable_memory_optim()
+    assert not rec2
+
+
+def test_inplace_functional_rebinds_input():
+    """ADVICE r4: F.relu_/tanh_/softmax_ must honor the in-place
+    contract — callers that keep using x see the new value."""
+    from paddle_tpu.nn import functional as F
+    x = Tensor(np.asarray([-1.0, 2.0], np.float32))
+    out = F.relu_(x)
+    np.testing.assert_allclose(np.asarray(x.data), [0.0, 2.0])
+    np.testing.assert_allclose(np.asarray(out.data), np.asarray(x.data))
+    x2 = Tensor(np.asarray([0.5, -0.5], np.float32))
+    F.tanh_(x2)
+    np.testing.assert_allclose(np.asarray(x2.data), np.tanh([0.5, -0.5]),
+                               rtol=1e-6)
+    x3 = Tensor(np.asarray([[1.0, 2.0]], np.float32))
+    F.softmax_(x3)
+    np.testing.assert_allclose(np.asarray(x3.data).sum(), 1.0, rtol=1e-6)
+
+
+def test_unique_name_guard_merges_high_water():
+    """ADVICE r4: names minted after a guard() must not collide with
+    names minted inside it (global-scope alias footgun)."""
+    from paddle_tpu.utils import unique_name
+    inside = []
+    with unique_name.guard():
+        inside.append(unique_name.generate('advtest_param'))
+        inside.append(unique_name.generate('advtest_param'))
+    after = unique_name.generate('advtest_param')
+    assert after not in inside
+
+
+def test_inplace_leaf_raises_under_autograd():
+    """A grad-requiring LEAF can't be in-placed (reference: 'Leaf Var
+    that doesn't stop gradient can't use inplace strategy')."""
+    from paddle_tpu.nn import functional as F
+    x = Tensor(np.asarray([-1.0, 2.0], np.float32), stop_gradient=False)
+    with pytest.raises(RuntimeError, match='leaf'):
+        F.relu_(x)
+    # out-of-place on the same tensor is fine
+    F.relu(x)
+    # and under no_grad the rebind goes through
+    with paddle.no_grad():
+        F.relu_(x)
+    np.testing.assert_allclose(np.asarray(x.data), [0.0, 2.0])
+
+
+def test_inplace_nonleaf_grads_exact():
+    """In-place on a NON-leaf is grafted into the tape: gradients
+    through later uses of the rebound tensor include the op's
+    derivative (h = relu_(h) — the standard paddle memory idiom)."""
+    from paddle_tpu.nn import functional as F
+    x = Tensor(np.asarray([-1.0, 2.0], np.float32), stop_gradient=False)
+    h = x * 2.0
+    out = F.relu_(h)
+    assert out is h                       # the in-place result IS h
+    (h * 3.0).sum().backward()
+    # d/dx 3*relu(2x) = 3 * relu'(2x) * 2 = [0, 6]
+    np.testing.assert_allclose(np.asarray(x.grad.data), [0.0, 6.0])
+
+
+def test_inplace_after_consume_raises_at_backward():
+    """Mutating a tensor an EARLIER op recorded for backward errors
+    loudly at backward() (version-counter contract), instead of
+    silently mis-routing that op's cotangent."""
+    from paddle_tpu.nn import functional as F
+    x = Tensor(np.asarray([-1.0, 2.0], np.float32), stop_gradient=False)
+    h = x * 2.0
+    y = h * 3.0                           # op records h (version 0)
+    F.relu_(h)                            # then h is rebound in place
+    with pytest.raises(RuntimeError, match='in-place'):
+        y.sum().backward()
+
+
+def test_unique_name_guard_prefix():
+    """guard(new_generator=str) prefixes guarded names (reference
+    UniqueNameGenerator prefix) — twin Programs can opt out of the
+    intentional name sharing."""
+    from paddle_tpu.utils import unique_name
+    with unique_name.guard('rankA_'):
+        a = unique_name.generate('w')
+    with unique_name.guard('rankB_'):
+        b = unique_name.generate('w')
+    assert a.startswith('rankA_') and b.startswith('rankB_')
+    assert a != b
+    assert not unique_name.generate('w').startswith('rank')
+
+
+def test_unique_name_nested_guard_and_switch_prefix():
+    """A nested plain guard() resets the prefix (reference guard(None)
+    installs a fresh generator); switch() round-trips prefix state."""
+    from paddle_tpu.utils import unique_name
+    with unique_name.guard('rankA_'):
+        with unique_name.guard():
+            assert not unique_name.generate('w').startswith('rankA_')
+        assert unique_name.generate('w').startswith('rankA_')
+    old = unique_name.switch('pfx_')
+    try:
+        assert unique_name.generate('w').startswith('pfx_')
+    finally:
+        unique_name.switch(old)
+    assert not unique_name.generate('w').startswith('pfx_')

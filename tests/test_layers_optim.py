@@ -181,3 +181,51 @@ def test_grad_scaler():
     scaler.step(opt)
     opt.clear_grad()
     assert scaler._scale >= 2.0
+
+
+def test_adam_bf16_moment_dtype():
+    """VERDICT r5 #1: moment_dtype='bfloat16' stores Adam state
+    low-precision (how 1.3B-param AdamW fits one 16G v5e) while the
+    update math runs fp32 — numerics must track fp32-moment Adam."""
+    import jax.numpy as jnp
+    from paddle_tpu.core.tensor import Tensor
+    opt_b = paddle.optimizer.AdamW(learning_rate=0.01, parameters=[],
+                                   weight_decay=0.01,
+                                   multi_precision=False,
+                                   moment_dtype='bfloat16')
+    opt_f = paddle.optimizer.AdamW(learning_rate=0.01, parameters=[],
+                                   weight_decay=0.01,
+                                   multi_precision=False)
+    rng = np.random.RandomState(0)
+    p = jnp.asarray(rng.randn(64), jnp.float32)
+    g = jnp.asarray(rng.randn(64), jnp.float32)
+    sb, sf = opt_b.init_state(Tensor(p)), opt_f.init_state(Tensor(p))
+    assert sb['moment1'].dtype == jnp.bfloat16
+    assert sf['moment1'].dtype == jnp.float32
+    pb = pf = p
+    lr = jnp.float32(0.01)
+    for _ in range(5):
+        pb, sb = opt_b.update(pb, g, sb, lr)
+        pf, sf = opt_f.update(pf, g, sf, lr)
+    assert sb['moment1'].dtype == jnp.bfloat16   # stays low-precision
+    assert float(jnp.max(jnp.abs(pb - pf))) < 1e-2
+
+
+def test_eager_step_keeps_bf16_param_dtype():
+    """multi_precision=False + bf16 params: the eager step's fp32 update
+    math must not upcast the stored params (that would double HBM and
+    retrace dtype-keyed jits)."""
+    import jax.numpy as jnp
+    m = nn.Linear(4, 4)
+    for p in m.parameters():
+        p.data = p.data.astype(jnp.bfloat16)
+    opt = paddle.optimizer.AdamW(parameters=m.parameters(),
+                                 multi_precision=False,
+                                 moment_dtype='bfloat16')
+    x = paddle.to_tensor(np.random.RandomState(0).rand(2, 4)
+                         .astype(np.float32))
+    m(x).sum().backward()
+    opt.step()
+    opt.clear_grad()
+    for p in m.parameters():
+        assert p.data.dtype == jnp.bfloat16

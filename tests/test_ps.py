@@ -452,3 +452,62 @@ def test_table_parameter_typed_validation():
         set_table_configs([{'table_id': 1, 'embedx_dim': 4},
                            {'table_id': 1, 'embedx_dim': 8}])
     set_table_configs(None)
+
+
+class TestAsyncCommunicator:
+    """reference communicator.h:197 — pull-ahead/push-behind decoupling."""
+
+    def test_pull_ahead_order_and_push_flush(self):
+        import threading
+        from paddle_tpu.distributed.ps.communicator import (
+            AsyncCommunicator)
+
+        calls = {'pull': [], 'push': []}
+        gate = threading.Event()
+
+        class FakeClient:
+            def pull(self, tid, ids, dim):
+                calls['pull'].append(np.array(ids))
+                return np.tile(np.asarray(ids, np.float32)[:, None],
+                               (1, dim))
+
+            def push(self, tid, ids, grads, lr):
+                gate.wait(5)                 # prove push never blocks
+                calls['push'].append((np.array(ids), np.array(grads)))
+
+        comm = AsyncCommunicator(FakeClient(), 0, 4, depth=2)
+        batches = [np.arange(i, i + 3, dtype=np.int64)
+                   for i in range(5)]
+        out = list(comm.pull_ahead(batches))
+        assert len(out) == 5
+        for (ids, rows), want in zip(out, batches):
+            np.testing.assert_array_equal(ids, want)
+            np.testing.assert_allclose(rows[:, 0],
+                                       want.astype(np.float32))
+        # pushes queue without blocking while the wire is stuck
+        t0 = time.time()
+        comm.push_async(batches[0], np.ones((3, 4), np.float32), 0.1)
+        comm.push_async(batches[1], np.ones((3, 4), np.float32), 0.1)
+        assert time.time() - t0 < 1.0
+        assert not calls['push']
+        gate.set()
+        comm.flush()                         # barrier drains the queue
+        assert len(calls['push']) == 2
+        comm.stop()
+
+    def test_push_error_surfaces(self):
+        from paddle_tpu.distributed.ps.communicator import (
+            AsyncCommunicator)
+
+        class BadClient:
+            def pull(self, tid, ids, dim):
+                return np.zeros((len(ids), dim), np.float32)
+
+            def push(self, tid, ids, grads, lr):
+                raise ConnectionError("wire down")
+
+        comm = AsyncCommunicator(BadClient(), 0, 4, depth=2)
+        comm.push_async(np.arange(2, dtype=np.int64),
+                        np.ones((2, 4), np.float32), 0.1)
+        with pytest.raises(ConnectionError, match='wire down'):
+            comm.flush()

@@ -1,0 +1,157 @@
+"""ResNet-50 roofline probe (VERDICT r5 #2): what can this chip do on
+ResNet-50-shaped work, independent of the framework? A minimal pure-JAX
+ResNet-50 (train step: conv+BN(batch-stats)+ReLU, SGD-momentum fused) in
+both layouts and batch sizes, vs the framework bench. The gap between
+the best probe number and paddle_tpu's bench is framework overhead; the
+gap between the probe and the chip's asymptotic 3x3-conv rate (~20-23%
+MFU, PARITY.md) is ResNet's own shape mix (7x7 stem, 1x1 projections,
+small late spatials)."""
+import sys
+import time
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+V5E_PEAK = 197.0
+
+
+def conv(x, w, stride=1, layout='NHWC'):
+    dn = ('NHWC', 'HWIO', 'NHWC') if layout == 'NHWC' \
+        else ('NCHW', 'OIHW', 'NCHW')
+    kh = w.shape[0] if layout == 'NHWC' else w.shape[2]
+    pad = [(kh // 2, kh // 2)] * 2 if kh > 1 else [(0, 0)] * 2
+    return jax.lax.conv_general_dilated(
+        x, w, (stride, stride), pad, dimension_numbers=dn)
+
+
+def bn_relu(x, scale, bias, axis):
+    m = x.mean(axis, keepdims=True)
+    v = x.var(axis, keepdims=True)
+    y = (x - m) * jax.lax.rsqrt(v + 1e-5) * scale + bias
+    return jax.nn.relu(y)
+
+
+def make_resnet50(layout='NHWC', dtype=jnp.bfloat16):
+    """Returns (params, apply_fn). Weights in HWIO/OIHW by layout."""
+    rng = np.random.RandomState(0)
+    cfg = [(3, 64, 256, 1), (4, 128, 512, 2), (6, 256, 1024, 2),
+           (3, 512, 2048, 2)]
+    params = []
+
+    def W(kh, kw, cin, cout):
+        w = rng.randn(kh, kw, cin, cout).astype('float32') \
+            * np.sqrt(2.0 / (kh * kw * cin))
+        if layout == 'NCHW':
+            w = w.transpose(3, 2, 0, 1)
+        return jnp.asarray(w, dtype)
+
+    def S(c):
+        return (jnp.ones((c,), dtype), jnp.zeros((c,), dtype))
+
+    stem = (W(7, 7, 3, 64), *S(64))
+    params.append(stem)
+    strides = []
+    cin = 64
+    for nblk, mid, cout, stride in cfg:
+        for i in range(nblk):
+            s = stride if i == 0 else 1
+            blk = {
+                'c1': (W(1, 1, cin, mid), *S(mid)),
+                'c2': (W(3, 3, mid, mid), *S(mid)),
+                'c3': (W(1, 1, mid, cout), *S(cout)),
+            }
+            if i == 0:
+                blk['proj'] = (W(1, 1, cin, cout), *S(cout))
+            params.append(blk)
+            strides.append(s)
+            cin = cout
+    head = jnp.asarray(rng.randn(2048, 1000).astype('float32') * 0.01,
+                       dtype)
+    params.append(head)
+    caxis = (0, 1, 2) if layout == 'NHWC' else (0, 2, 3)
+
+    def brelu(x, sc, bi):
+        shape = (1, 1, 1, -1) if layout == 'NHWC' else (1, -1, 1, 1)
+        m = x.mean(caxis, keepdims=True)
+        v = ((x - m) ** 2).mean(caxis, keepdims=True)
+        return jax.nn.relu((x - m) * jax.lax.rsqrt(v + 1e-5)
+                           * sc.reshape(shape) + bi.reshape(shape))
+
+    def apply(params, x, labels):
+        (w, sc, bi) = params[0]
+        x = conv(x, w, 2, layout)
+        x = brelu(x, sc, bi)
+        wd = (1, 2) if layout == 'NHWC' else (2, 3)
+        x = jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max,
+            (1, 3, 3, 1) if layout == 'NHWC' else (1, 1, 3, 3),
+            (1, 2, 2, 1) if layout == 'NHWC' else (1, 1, 2, 2),
+            'SAME')
+        sh = (1, 1, 1, -1) if layout == 'NHWC' else (1, -1, 1, 1)
+
+        def bn(t, sc, bi):
+            mm = t.mean(caxis, keepdims=True)
+            vv = ((t - mm) ** 2).mean(caxis, keepdims=True)
+            return (t - mm) * jax.lax.rsqrt(vv + 1e-5) \
+                * sc.reshape(sh) + bi.reshape(sh)
+
+        for blk, stride in zip(params[1:-1], strides):
+            w1, s1, b1 = blk['c1']
+            w2, s2, b2 = blk['c2']
+            w3, s3, b3 = blk['c3']
+            h = brelu(conv(x, w1, 1, layout), s1, b1)
+            h = brelu(conv(h, w2, stride, layout), s2, b2)
+            h = bn(conv(h, w3, 1, layout), s3, b3)
+            if 'proj' in blk:
+                wp, sp, bp = blk['proj']
+                x = bn(conv(x, wp, stride, layout), sp, bp)
+            x = jax.nn.relu(x + h)
+        x = x.mean(wd)
+        logits = (x @ params[-1]).astype(jnp.float32)
+        lse = jax.nn.logsumexp(logits, -1)
+        return (lse - jnp.take_along_axis(
+            logits, labels[:, None], 1)[:, 0]).mean()
+
+    return params, apply
+
+
+def bench(layout, B, dtype=jnp.bfloat16, steps=10, trials=3):
+    params, apply = make_resnet50(layout, dtype)
+    rng = np.random.RandomState(0)
+    shape = (B, 224, 224, 3) if layout == 'NHWC' else (B, 3, 224, 224)
+    x = jnp.asarray(rng.rand(*shape), dtype)
+    y = jnp.asarray(rng.randint(0, 1000, (B,)), jnp.int32)
+
+    vel = jax.tree_util.tree_map(jnp.zeros_like, params)
+
+    @jax.jit
+    def step(params, vel, x, y):
+        loss, g = jax.value_and_grad(apply)(params, x, y)
+        new_vel = jax.tree_util.tree_map(
+            lambda v, gg: 0.9 * v + gg.astype(v.dtype), vel, g)
+        new_p = jax.tree_util.tree_map(
+            lambda p, v: p - jnp.asarray(0.1, p.dtype) * v,
+            params, new_vel)
+        return loss, new_p, new_vel
+
+    loss, params, vel = step(params, vel, x, y)
+    float(loss)
+    dt = float('inf')
+    for _ in range(trials):
+        t0 = time.time()
+        for _ in range(steps):
+            loss, params, vel = step(params, vel, x, y)
+        float(loss)
+        dt = min(dt, (time.time() - t0) / steps)
+    flops = 3 * 4.1e9 * B
+    return {'layout': layout, 'B': B,
+            'img_s': round(B / dt, 1), 'ms': round(dt * 1000, 2),
+            'mfu': round(flops / dt / 1e12 / V5E_PEAK, 4)}
+
+
+if __name__ == '__main__':
+    for layout in ('NHWC', 'NCHW'):
+        for B in (128, 256):
+            print(bench(layout, B, steps=8, trials=2), flush=True)
