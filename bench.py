@@ -515,6 +515,18 @@ def main():
                            for k, v in r.items()}
         except Exception as e:
             detail[key] = {'error': repr(e)[:200]}
+    try:
+        # observability v2: compile seconds / compile counts / device
+        # memory accumulated across all legs, from the telemetry reporter
+        from paddle_tpu.profiler import StepTelemetry
+        snap = StepTelemetry(publish=False).snapshot()
+        detail['telemetry'] = {
+            'compile_seconds_total': round(snap['compile_seconds_total'], 2),
+            'compiles_total': int(snap['compiles_total']),
+            'device_memory': snap['device_memory'],
+        }
+    except Exception as e:
+        detail['telemetry'] = {'error': repr(e)[:200]}
     result = {
         'metric': 'gpt1.3b_adamw_trainstep_mfu',
         'value': round(g['mfu'], 4),

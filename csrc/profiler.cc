@@ -46,6 +46,15 @@ class Profiler {
   void Record(const char* name, uint64_t start_us, uint64_t end_us) {
     if (!enabled_) return;
     std::lock_guard<std::mutex> lk(mu_);
+    // bounded ring (parity with the Python fallback recorder): a
+    // forgotten-enabled profiler must not grow without limit. The
+    // oldest half is dropped in one memmove-ish splice so steady-state
+    // recording stays O(1) amortized.
+    if (events_.size() >= capacity_) {
+      size_t drop = capacity_ / 2;
+      dropped_ += drop;
+      events_.erase(events_.begin(), events_.begin() + drop);
+    }
     events_.push_back({name, start_us, end_us,
                        std::hash<std::thread::id>()(
                            std::this_thread::get_id()) %
@@ -55,6 +64,17 @@ class Profiler {
   void Clear() {
     std::lock_guard<std::mutex> lk(mu_);
     events_.clear();
+    dropped_ = 0;
+  }
+
+  uint64_t Dropped() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return dropped_;
+  }
+
+  void SetCapacity(uint64_t cap) {
+    std::lock_guard<std::mutex> lk(mu_);
+    capacity_ = cap < 2 ? 2 : cap;
   }
 
   size_t Count() {
@@ -113,6 +133,8 @@ class Profiler {
   std::atomic<bool> enabled_{false};
   std::mutex mu_;
   std::vector<Event> events_;
+  size_t capacity_ = 1 << 20;
+  uint64_t dropped_ = 0;
 };
 
 }  // namespace ptpu
@@ -145,5 +167,13 @@ int ptpu_profiler_summary(char* buf, int cap) {
 
 int ptpu_profiler_export(const char* path) {
   return ptpu::Profiler::Get().ExportChromeTrace(path) ? 1 : 0;
+}
+
+uint64_t ptpu_profiler_dropped() {
+  return ptpu::Profiler::Get().Dropped();
+}
+
+void ptpu_profiler_set_capacity(uint64_t cap) {
+  ptpu::Profiler::Get().SetCapacity(cap);
 }
 }

@@ -1,4 +1,4 @@
-"""Runtime stats registry.
+"""Runtime stats + metrics registry.
 
 Reference parity: paddle/fluid/platform/monitor.h — StatRegistry over
 named int64 stats (STAT_INT / DEFINE_INT_STATUS, e.g.
@@ -6,13 +6,21 @@ STAT_total_feasign_num_in_mem) surfaced through
 core.get_int_stats(). Subsystems bump named counters; tooling reads a
 snapshot.
 
-TPU-native shape: one thread-safe registry of int/float stats; the PS
-service, DataLoader and Executor report through it (the reference's
-monitored quantities are PS feasign counts and worker progress).
+TPU-native shape (observability v2): the legacy int/float StatRegistry
+stays as-is (PS feasign counts, executor run counts), and a typed
+metrics layer grows beside it — Counter / Gauge / Histogram with label
+support, a Prometheus text-exposition renderer, a JSON snapshot API and
+an embeddable /metrics HTTP endpoint. The profiler's step-telemetry
+reporter and the hot-path instrumentation (executor, collectives,
+dataloader, jit) all publish here.
 """
+import json
 import threading
 
 
+# ---------------------------------------------------------------------------
+# legacy int/float stats (platform/monitor.h parity) — API unchanged
+# ---------------------------------------------------------------------------
 class Stat:
     __slots__ = ('name', '_value', '_lock')
 
@@ -94,3 +102,345 @@ def get_int_stats():
 
 def get_stats():
     return _registry.snapshot()
+
+
+# ---------------------------------------------------------------------------
+# typed metrics: Counter / Gauge / Histogram with labels
+# ---------------------------------------------------------------------------
+DEFAULT_BUCKETS = (.0001, .0005, .001, .005, .01, .025, .05, .1, .25, .5,
+                   1.0, 2.5, 5.0, 10.0, 30.0, 60.0, float('inf'))
+
+
+def _label_key(labelnames, labels):
+    if set(labels) != set(labelnames):
+        raise ValueError(
+            f"labels {sorted(labels)} != declared {sorted(labelnames)}")
+    return tuple(str(labels[n]) for n in labelnames)
+
+
+class Metric:
+    """One named metric; label-less use goes through the () label set."""
+
+    kind = 'untyped'
+
+    def __init__(self, name, help='', labelnames=()):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._children = {}
+        self._lock = threading.Lock()
+
+    def _child(self, labels):
+        key = _label_key(self.labelnames, labels)
+        with self._lock:
+            c = self._children.get(key)
+            if c is None:
+                c = self._children[key] = self._new_child()
+            return c
+
+    def labels(self, **labels):
+        return self._child(labels)
+
+    def _series(self):
+        with self._lock:
+            return dict(self._children)
+
+
+class _CounterChild:
+    __slots__ = ('_value', '_lock')
+
+    def __init__(self):
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, value=1):
+        if value < 0:
+            raise ValueError("counters only go up")
+        with self._lock:
+            self._value += value
+            return self._value
+
+    def value(self):
+        with self._lock:
+            return self._value
+
+
+class Counter(Metric):
+    kind = 'counter'
+    _new_child = staticmethod(_CounterChild)
+
+    def inc(self, value=1, **labels):
+        return self._child(labels).inc(value)
+
+    def value(self, **labels):
+        return self._child(labels).value()
+
+
+class _GaugeChild(_CounterChild):
+    def inc(self, value=1):
+        with self._lock:
+            self._value += value
+            return self._value
+
+    def dec(self, value=1):
+        return self.inc(-value)
+
+    def set(self, value):
+        with self._lock:
+            self._value = float(value)
+
+
+class Gauge(Metric):
+    kind = 'gauge'
+    _new_child = staticmethod(_GaugeChild)
+
+    def set(self, value, **labels):
+        self._child(labels).set(value)
+
+    def inc(self, value=1, **labels):
+        return self._child(labels).inc(value)
+
+    def dec(self, value=1, **labels):
+        return self._child(labels).dec(value)
+
+    def value(self, **labels):
+        return self._child(labels).value()
+
+
+class _HistogramChild:
+    __slots__ = ('buckets', 'counts', 'sum', 'count', '_lock')
+
+    def __init__(self, buckets):
+        self.buckets = buckets
+        self.counts = [0] * len(buckets)
+        self.sum = 0.0
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value):
+        value = float(value)
+        with self._lock:
+            self.sum += value
+            self.count += 1
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    self.counts[i] += 1
+
+    def value(self):
+        with self._lock:
+            return {'sum': self.sum, 'count': self.count,
+                    'buckets': {str(b): c for b, c in
+                                zip(self.buckets, self.counts)}}
+
+
+class Histogram(Metric):
+    kind = 'histogram'
+
+    def __init__(self, name, help='', labelnames=(), buckets=None):
+        super().__init__(name, help, labelnames)
+        b = sorted(float(x) for x in (buckets or DEFAULT_BUCKETS))
+        if not b or b[-1] != float('inf'):
+            b.append(float('inf'))
+        self.buckets = tuple(b)
+
+    def _new_child(self):
+        return _HistogramChild(self.buckets)
+
+    def observe(self, value, **labels):
+        self._child(labels).observe(value)
+
+    def value(self, **labels):
+        return self._child(labels).value()
+
+
+class MetricsRegistry:
+    """Get-or-create registry of typed metrics, renderable as Prometheus
+    text exposition and as a JSON snapshot."""
+
+    _KINDS = {'counter': Counter, 'gauge': Gauge, 'histogram': Histogram}
+
+    def __init__(self):
+        self._metrics = {}
+        self._lock = threading.Lock()
+        self.epoch = 0      # bumped on reset(); callers caching metric
+                            # handles key their cache on this
+
+    def _get_or_create(self, cls, name, help, labelnames, **kwargs):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, help=help,
+                                              labelnames=labelnames,
+                                              **kwargs)
+                return m
+        if not isinstance(m, cls):
+            raise TypeError(f"metric {name!r} already registered as "
+                            f"{m.kind}, not {cls.kind}")
+        if tuple(labelnames) != m.labelnames:
+            raise ValueError(
+                f"metric {name!r} labelnames {m.labelnames} != "
+                f"{tuple(labelnames)}")
+        return m
+
+    def counter(self, name, help='', labelnames=()):
+        return self._get_or_create(Counter, name, help, labelnames)
+
+    def gauge(self, name, help='', labelnames=()):
+        return self._get_or_create(Gauge, name, help, labelnames)
+
+    def histogram(self, name, help='', labelnames=(), buckets=None):
+        return self._get_or_create(Histogram, name, help, labelnames,
+                                   buckets=buckets)
+
+    def get(self, name):
+        with self._lock:
+            return self._metrics.get(name)
+
+    def reset(self):
+        with self._lock:
+            self._metrics.clear()
+            self.epoch += 1
+
+    # -- renderers -----------------------------------------------------------
+    @staticmethod
+    def _fmt_labels(labelnames, key, extra=()):
+        pairs = [f'{n}="{_escape(v)}"' for n, v in zip(labelnames, key)]
+        pairs.extend(f'{n}="{_escape(v)}"' for n, v in extra)
+        return '{' + ','.join(pairs) + '}' if pairs else ''
+
+    def prometheus_text(self, include_stats=True):
+        """Prometheus text exposition format (0.0.4), legacy STAT_*
+        stats included as untyped gauges."""
+        lines = []
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        for m in metrics:
+            if m.help:
+                lines.append(f'# HELP {m.name} {m.help}')
+            lines.append(f'# TYPE {m.name} {m.kind}')
+            for key, child in sorted(m._series().items()):
+                if m.kind == 'histogram':
+                    v = child.value()
+                    for b, c in v['buckets'].items():
+                        b = '+Inf' if b == 'inf' else b
+                        lbl = self._fmt_labels(m.labelnames, key,
+                                               extra=[('le', b)])
+                        lines.append(f'{m.name}_bucket{lbl} {c}')
+                    lbl = self._fmt_labels(m.labelnames, key)
+                    lines.append(f'{m.name}_sum{lbl} {_num(v["sum"])}')
+                    lines.append(f'{m.name}_count{lbl} {v["count"]}')
+                else:
+                    lbl = self._fmt_labels(m.labelnames, key)
+                    lines.append(f'{m.name}{lbl} {_num(child.value())}')
+        if include_stats:
+            for name, v in sorted(_registry.snapshot().items()):
+                safe = _sanitize(name)
+                lines.append(f'# TYPE {safe} gauge')
+                lines.append(f'{safe} {_num(v)}')
+        return '\n'.join(lines) + '\n'
+
+    def snapshot(self):
+        """JSON-ready nested snapshot: {metric: {kind, series: [{labels,
+        value}]}} plus the legacy stats dict."""
+        out = {}
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            series = []
+            for key, child in sorted(m._series().items()):
+                series.append({'labels': dict(zip(m.labelnames, key)),
+                               'value': child.value()})
+            out[m.name] = {'kind': m.kind, 'series': series}
+        return {'metrics': out, 'stats': _registry.snapshot()}
+
+    def snapshot_json(self, **kwargs):
+        return json.dumps(self.snapshot(), **kwargs)
+
+
+def _escape(v):
+    return str(v).replace('\\', r'\\').replace('"', r'\"').replace(
+        '\n', r'\n')
+
+
+def _num(v):
+    f = float(v)
+    return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+def _sanitize(name):
+    return ''.join(c if c.isalnum() or c == '_' else '_' for c in name)
+
+
+_metrics = MetricsRegistry()
+
+
+def metrics():
+    return _metrics
+
+
+def counter(name, help='', labelnames=()):
+    return _metrics.counter(name, help=help, labelnames=labelnames)
+
+
+def gauge(name, help='', labelnames=()):
+    return _metrics.gauge(name, help=help, labelnames=labelnames)
+
+
+def histogram(name, help='', labelnames=(), buckets=None):
+    return _metrics.histogram(name, help=help, labelnames=labelnames,
+                              buckets=buckets)
+
+
+def prometheus_text():
+    return _metrics.prometheus_text()
+
+
+def metrics_snapshot():
+    return _metrics.snapshot()
+
+
+# ---------------------------------------------------------------------------
+# /metrics HTTP endpoint
+# ---------------------------------------------------------------------------
+class MetricsServer:
+    """Tiny embeddable exporter: GET /metrics → Prometheus text, GET
+    /metrics.json → JSON snapshot. Daemon-threaded; close() to stop."""
+
+    def __init__(self, port=0, addr='127.0.0.1', registry=None):
+        import http.server
+        reg = registry or _metrics
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):          # noqa: N802 (stdlib API name)
+                if self.path.startswith('/metrics.json'):
+                    body = reg.snapshot_json().encode()
+                    ctype = 'application/json'
+                elif self.path.startswith('/metrics'):
+                    body = reg.prometheus_text().encode()
+                    ctype = 'text/plain; version=0.0.4; charset=utf-8'
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header('Content-Type', ctype)
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # silence per-request stderr spam
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer((addr, port), Handler)
+        self.addr, self.port = self._httpd.server_address[:2]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+def start_metrics_server(port=0, addr='127.0.0.1'):
+    return MetricsServer(port=port, addr=addr)

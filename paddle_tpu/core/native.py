@@ -153,6 +153,11 @@ def load_native(required=False):
     lib.ptpu_profiler_summary.argtypes = [ctypes.c_char_p, ctypes.c_int]
     lib.ptpu_profiler_export.restype = ctypes.c_int
     lib.ptpu_profiler_export.argtypes = [ctypes.c_char_p]
+    try:      # post-v2 symbols: tolerate a stale prebuilt .so
+        lib.ptpu_profiler_dropped.restype = ctypes.c_uint64
+        lib.ptpu_profiler_set_capacity.argtypes = [ctypes.c_uint64]
+    except AttributeError:
+        pass
 
     _LIB = lib
     return lib

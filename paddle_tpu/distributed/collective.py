@@ -17,6 +17,8 @@ TPU-native design — the ring_id→ncclComm map becomes a Group→mesh-axis map
     identities, matching the reference's degenerate behavior.
 """
 import contextlib
+import functools
+import time
 
 import numpy as np
 import jax
@@ -119,6 +121,76 @@ _OP_NAMES = {ReduceOp.SUM: 'sum', ReduceOp.MAX: 'max', ReduceOp.MIN: 'min',
              ReduceOp.PROD: 'prod', ReduceOp.AVG: 'avg'}
 
 
+# ---- observability ----------------------------------------------------------
+def _tensor_bytes(*objs):
+    """Payload bytes of the Tensor/array args (tracer-safe: shapes and
+    dtypes are known on abstract values too)."""
+    total = 0
+    for o in objs:
+        if isinstance(o, (list, tuple)):
+            total += _tensor_bytes(*o)
+            continue
+        arr = o.data if isinstance(o, Tensor) else o
+        shape = getattr(arr, 'shape', None)
+        dtype = getattr(arr, 'dtype', None)
+        if shape is None or dtype is None:
+            continue
+        try:
+            total += int(np.prod(shape or (1,))) * jnp.dtype(dtype).itemsize
+        except Exception:
+            pass
+    return total
+
+
+def _instrumented(fn):
+    """Per-collective telemetry: call count + payload bytes into
+    core.monitor counters, a wall-time histogram, and a profiler span.
+    Inside an SPMD trace the span measures TRACE time and the counters
+    count per-trace (the executable replays them on device); eager
+    host-backend collectives measure real wire time."""
+    from ..core import monitor as _m
+    op_name = fn.__name__
+    span_name = f'collective::{op_name}'
+    cache = {'epoch': None}
+
+    def _handles():
+        """Per-series metric children, re-resolved only when the
+        registry was reset — keeps the hot path at one int compare
+        instead of three lock-protected registry lookups per call."""
+        reg = _m.metrics()
+        if cache['epoch'] != reg.epoch:
+            cache['calls'] = reg.counter(
+                'ptpu_collective_calls_total',
+                help='collective API invocations',
+                labelnames=('op',)).labels(op=op_name)
+            cache['bytes'] = reg.counter(
+                'ptpu_collective_bytes_total',
+                help='payload bytes through collective APIs',
+                labelnames=('op',)).labels(op=op_name)
+            cache['seconds'] = reg.histogram(
+                'ptpu_collective_seconds',
+                help='eager collective wall time',
+                labelnames=('op',)).labels(op=op_name)
+            cache['epoch'] = reg.epoch
+        return cache
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        h = _handles()
+        nbytes = _tensor_bytes(*args)
+        h['calls'].inc(1)
+        h['bytes'].inc(nbytes)
+        from .. import profiler as _prof
+        t0 = None if in_spmd_region() else time.perf_counter()
+        with _prof.RecordEvent(span_name, event_type='collective',
+                               bytes=nbytes):
+            out = fn(*args, **kwargs)
+        if t0 is not None:
+            h['seconds'].observe(time.perf_counter() - t0)
+        return out
+    return wrapper
+
+
 def _host_backend(group):
     """Eager (outside-SPMD) multi-PROCESS backend, or None when this job
     is a single process. Keyed on the process count (PADDLE_TRAINERS_NUM),
@@ -210,6 +282,7 @@ def wait(tensor, group=None, use_calc_stream=True):
         tensor.data.block_until_ready()
 
 
+@_instrumented
 def barrier(group=None):
     """Parity: collective.py barrier:167."""
     if in_spmd_region():
@@ -240,6 +313,7 @@ def _psum_like(arr, op, axes):
     raise ValueError(f"bad reduce op {op}")
 
 
+@_instrumented
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
                use_calc_stream=True):
     """Parity: c_allreduce_{sum,max,min,prod} (operators/collective/
@@ -266,6 +340,7 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     return all_reduce(tensor, op=op, group=group)
 
 
+@_instrumented
 def broadcast(tensor, src=0, group=None, sync_op=True, use_calc_stream=True):
     """Parity: c_broadcast. In SPMD: take src's shard via a masked psum."""
     axes = _group_axes(group)
@@ -295,6 +370,7 @@ def _axis_index(axes):
     return idx
 
 
+@_instrumented
 def all_gather(tensor_list, tensor, group=None, sync_op=True,
                use_calc_stream=True):
     """Parity: c_allgather → XLA AllGather. Appends per-rank shards to
@@ -318,6 +394,7 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True,
     return tensor_list
 
 
+@_instrumented
 def all_gather_concat(tensor, axis=0, group=None):
     """XLA-native all_gather returning concatenated tensor (used by mp
     layers; parity with the c_concat op)."""
@@ -329,6 +406,7 @@ def all_gather_concat(tensor, axis=0, group=None):
     return tensor
 
 
+@_instrumented
 def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
                    group=None, sync_op=True):
     """Parity: c_reducescatter → XLA ReduceScatter."""
@@ -355,6 +433,7 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
     return tensor
 
 
+@_instrumented
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     """Parity: c_scatter — each rank takes its slice of src's tensor."""
     axes = _group_axes(group)
@@ -383,6 +462,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     return tensor
 
 
+@_instrumented
 def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
     """Parity: alltoall op → XLA AllToAll."""
     axes = _group_axes(group)
@@ -431,6 +511,7 @@ def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
     return out
 
 
+@_instrumented
 def send(tensor, dst=0, group=None, sync_op=True, use_calc_stream=True):
     """Parity: send_v2. Point-to-point send is inherently per-rank control
     flow; under single-controller SPMD one traced program runs on EVERY
@@ -446,6 +527,7 @@ def send(tensor, dst=0, group=None, sync_op=True, use_calc_stream=True):
     return tensor
 
 
+@_instrumented
 def recv(tensor, src=0, group=None, sync_op=True, use_calc_stream=True):
     """Parity: recv_v2 — see send() for the SPMD p2p story."""
     axes = _group_axes(group)
@@ -465,6 +547,7 @@ def irecv(tensor, src=0, group=None):
     return recv(tensor, src, group, sync_op=False)
 
 
+@_instrumented
 def ppermute(tensor, perm_pairs, group=None):
     """XLA collective-permute (ICI neighbor exchange) — the TPU replacement
     for NCCL p2p send/recv pairs (SURVEY.md §5.8)."""
@@ -476,6 +559,7 @@ def ppermute(tensor, perm_pairs, group=None):
     return tensor
 
 
+@_instrumented
 def shift(tensor, offset=1, group=None):
     """Ring shift along the group axis (pipeline/ring-attention building
     block)."""

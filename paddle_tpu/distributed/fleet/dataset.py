@@ -96,23 +96,57 @@ class DatasetBase:
 
     def _run_pipe(self):
         """Run pipe_command over each input file (the reference
-        trainer's per-file pipe), writing native-format temp files."""
+        trainer's per-file pipe), writing native-format temp files.
+
+        Streams the command's stdout line-by-line into the converter
+        instead of buffering the whole shard in RAM (capture_output
+        would hold stdout AND the decoded split simultaneously — a
+        multi-GB CTR shard exhausts the host). stderr drains in a side
+        thread (only the tail is kept) so a chatty generator can't
+        deadlock the pipe; the returncode check happens after EOF."""
+        import threading
+
         self._pipe_tmpdir = tempfile.TemporaryDirectory(
             prefix='paddle_tpu_pipe_')
         converted = []
         for i, path in enumerate(self._filelist):
-            with open(path, 'rb') as src:
-                proc = subprocess.run(
-                    self._pipe_command, shell=True, stdin=src,
-                    capture_output=True)
-            if proc.returncode != 0:
-                raise RuntimeError(
-                    f"pipe_command failed on {path} "
-                    f"(rc={proc.returncode}): "
-                    f"{proc.stderr.decode(errors='replace')[-1000:]}")
             dst = os.path.join(self._pipe_tmpdir.name, f'part-{i}')
-            self._multislot_to_dense(
-                proc.stdout.decode().splitlines(), dst)
+            with open(path, 'rb') as src:
+                proc = subprocess.Popen(
+                    self._pipe_command, shell=True, stdin=src,
+                    stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+                stderr_tail = []
+
+                def drain(stream=proc.stderr, tail=stderr_tail):
+                    while True:
+                        chunk = stream.read(65536)
+                        if not chunk:
+                            return
+                        tail.append(chunk)
+                        del tail[:-16]       # keep ~1MB of tail
+                t = threading.Thread(target=drain, daemon=True)
+                t.start()
+                parse_err = None
+                try:
+                    lines = (ln.decode(errors='replace')
+                             for ln in proc.stdout)
+                    self._multislot_to_dense(lines, dst)
+                except ValueError as e:
+                    # a command that crashed mid-stream also produces
+                    # garbage/truncated lines — report the rc + stderr
+                    # (below), not the downstream parse symptom
+                    parse_err = e
+                finally:
+                    proc.stdout.close()
+                    rc = proc.wait()
+                    t.join(timeout=10)
+            if rc != 0:
+                err = b''.join(stderr_tail).decode(errors='replace')
+                raise RuntimeError(
+                    f"pipe_command failed on {path} (rc={rc}): "
+                    f"{err[-1000:]}") from parse_err
+            if parse_err is not None:
+                raise parse_err
             converted.append(dst)
         return converted
 

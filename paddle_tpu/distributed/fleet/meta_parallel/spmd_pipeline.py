@@ -349,6 +349,20 @@ class SpmdPipelineEngine:
                  'blocks': sync(grads['blocks'], False),
                  'head': sync(grads['head'], True)}
 
+        # trace-time telemetry: grad-sync payload per compiled step (the
+        # executable replays these psums/pmeans every step)
+        if pp > 1 or dp_on:
+            from ....core.monitor import counter
+            nbytes = sum(
+                int(np.prod(g.shape or (1,))) * jnp.dtype(g.dtype).itemsize
+                for g in jax.tree_util.tree_leaves(grads))
+            counter('ptpu_collective_bytes_total',
+                    help='payload bytes through collective APIs',
+                    labelnames=('op',)).inc(nbytes, op='pipeline_grad_sync')
+            counter('ptpu_collective_calls_total',
+                    help='collective API invocations',
+                    labelnames=('op',)).inc(1, op='pipeline_grad_sync')
+
         found_inf = jnp.asarray(False)
         if scale is not None:
             leaves = jax.tree_util.tree_leaves(grads)
@@ -443,7 +457,11 @@ class SpmdPipelineEngine:
                 needed.update(v for v in eqn.invars
                               if not hasattr(v, 'val'))
         keep.reverse()
-        pruned = jaxpr.replace(eqns=keep, outvars=want)
+        try:     # jax>=0.4.36 asserts debug_info paths match outvars
+            pruned = jaxpr.replace(eqns=keep, outvars=want,
+                                   debug_info=None)
+        except (TypeError, AssertionError):
+            pruned = jaxpr.replace(eqns=keep, outvars=want)
         flat_args = jax.tree_util.tree_leaves(args)
         inv_vals = jax.core.eval_jaxpr(pruned, closed.consts, *flat_args)
         values = [None] * len(flags)
@@ -922,14 +940,21 @@ class SpmdPipelineEngine:
             # recompile the pipeline each switch
             self._compiled = self._compiled_by_mode.get(want_scaling)
             if self._compiled is None:
-                self._compiled = self._build()
+                from .... import profiler as _prof
+                with _prof.RecordEvent('pipeline::build',
+                                       event_type='compile',
+                                       pp=self.pp,
+                                       scaling=want_scaling):
+                    self._compiled = self._build()
                 self._compiled_by_mode[want_scaling] = self._compiled
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         sc = jnp.asarray(1.0 if scale is None else float(scale),
                          jnp.float32)
         key = rng_mod.next_key()
-        loss, self._params, self._states, found = self._compiled(
-            self._params, self._states, lr, sc, key, ii, ll)
+        from .... import profiler as _prof
+        with _prof.RecordEvent('pipeline::train_step', event_type='jit'):
+            loss, self._params, self._states, found = self._compiled(
+                self._params, self._states, lr, sc, key, ii, ll)
         self.last_found_inf = found
         return Tensor(loss)
 

@@ -42,7 +42,6 @@ class AsyncCommunicator:
         self.dim = int(dim)
         self.depth = int(depth)
         self._device_put = device_put
-        self._pull_out = queue.Queue(self.depth)
         self._push_q = queue.Queue(self.depth)
         self._push_err = None
         self._pushed = threading.Event()
@@ -50,6 +49,10 @@ class AsyncCommunicator:
                                              daemon=True)
         self._push_thread.start()
         self._pull_thread = None
+        self._cur_pull = None     # (stop_event, thread, queue) of the
+                                  # ACTIVE pull — cancellation is
+                                  # per-generation, so a stale abandoned
+                                  # iterator can't kill a newer pull
 
     # -- pull side -----------------------------------------------------------
     def pull_ahead(self, id_batches):
@@ -57,39 +60,84 @@ class AsyncCommunicator:
         arrays. Returns an iterator of (ids, rows) in order, at most
         `depth` batches ahead of the consumer."""
         if self._pull_thread is not None:
-            raise RuntimeError("pull_ahead already active; exhaust the "
-                               "previous iterator first")
-        out = self._pull_out
+            raise RuntimeError("pull_ahead already active; exhaust, "
+                               "close() or cancel_pull() the previous "
+                               "iterator first")
+        out = queue.Queue(self.depth)     # per-pull: never shared across
+        stop = threading.Event()          # generations
+
+        def _put(item):
+            """Bounded put that gives up when the consumer cancelled —
+            an abandoned iterator must not wedge this thread forever on
+            a full queue."""
+            while not stop.is_set():
+                try:
+                    out.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def loop():
             try:
                 for ids in id_batches:
+                    if stop.is_set():
+                        return
                     # shape is the client's contract (PsClient.pull
                     # flattens; a chunk adapter may keep [K, rows])
                     ids = np.ascontiguousarray(ids, np.int64)
                     rows = self.client.pull(self.table_id, ids, self.dim)
                     if self._device_put is not None:
                         rows = self._device_put(rows)
-                    out.put((ids, rows))
+                    if not _put((ids, rows)):
+                        return
             except Exception as e:           # surfaced at the consumer
-                out.put(e)
+                _put(e)
             finally:
-                out.put(_Stop)
+                _put(_Stop)
 
-        self._pull_thread = threading.Thread(target=loop, daemon=True)
-        self._pull_thread.start()
+        t = threading.Thread(target=loop, daemon=True)
+        self._pull_thread = t
+        self._cur_pull = (stop, t, out)
+        t.start()
 
         def results():
-            while True:
-                item = out.get()
-                if item is _Stop:
-                    self._pull_thread = None
-                    return
-                if isinstance(item, Exception):
-                    self._pull_thread = None
-                    raise item
-                yield item
+            try:
+                while True:
+                    item = out.get()
+                    if item is _Stop:
+                        return
+                    if isinstance(item, Exception):
+                        raise item
+                    yield item
+            finally:
+                # normal exhaustion, an error, or an abandoned iterator
+                # (GeneratorExit lands here) all release THIS pull's
+                # producer — a newer generation is untouched
+                self._cancel_generation(stop, t, out)
+
         return results()
+
+    def _cancel_generation(self, stop, t, out):
+        """Stop one pull generation's producer and release its slot
+        (only if it still owns the slot). Idempotent."""
+        stop.set()
+        while t.is_alive():
+            try:                     # unblock a producer stuck on put()
+                out.get_nowait()
+            except queue.Empty:
+                pass
+            t.join(timeout=0.05)
+        if self._pull_thread is t:
+            self._pull_thread = None
+            self._cur_pull = None
+
+    def cancel_pull(self):
+        """Cancel the ACTIVE in-flight pull_ahead (if any) so a new one
+        can start. Idempotent."""
+        cur = self._cur_pull
+        if cur is not None:
+            self._cancel_generation(*cur)
 
     # -- push side -----------------------------------------------------------
     def push_async(self, ids, grads, lr):
@@ -125,6 +173,25 @@ class AsyncCommunicator:
             raise err
 
     def stop(self):
-        self.flush()
+        """Graceful close: cancel any in-flight prefetch, fence queued
+        pushes (re-raising a queued push error AFTER the threads are
+        released, so an error can't leave the communicator wedged)."""
+        self.cancel_pull()
+        err = None
+        try:
+            self.flush()
+        except Exception as e:       # noqa: BLE001 — re-raised below
+            err = e
         self._push_q.put(_Stop)
         self._push_thread.join(timeout=10)
+        if err is not None:
+            raise err
+
+    close = stop
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.stop()
+        return False

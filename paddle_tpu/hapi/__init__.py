@@ -9,5 +9,5 @@ to the eager tape otherwise.
 from .model import Model
 from .callbacks import (Callback, ProgBarLogger, ModelCheckpoint,
                         LRSchedulerCallback, EarlyStopping,
-                        ReduceLROnPlateau, VisualDL)
+                        ReduceLROnPlateau, VisualDL, StepTelemetry)
 from .summary import summary

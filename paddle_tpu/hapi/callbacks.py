@@ -236,3 +236,57 @@ class VisualDL(Callback):
 
     def on_epoch_end(self, epoch, logs=None):
         pass
+
+
+class StepTelemetry(Callback):
+    """Observability-v2 reporter: wraps profiler.StepTelemetry around the
+    train loop. Per-batch it measures step latency and examples/sec
+    (batch size inferred from the first input's leading dim) and
+    publishes the gauges into core.monitor; `snapshot()` (also stamped
+    into the epoch logs under 'telemetry') carries compile seconds,
+    compile-cache hit/miss and device memory alongside throughput —
+    the dict bench.py and the /metrics endpoint consume."""
+
+    def __init__(self, window=20, tokens_per_example=None, log_freq=0):
+        super().__init__()
+        from ..profiler import StepTelemetry as _Reporter
+        self.reporter = _Reporter(window=window)
+        self.tokens_per_example = tokens_per_example
+        self.log_freq = log_freq
+        self._batch_examples = None
+
+    def on_batch_begin(self, mode, step, logs=None):
+        if mode == 'train':
+            self.reporter.begin_step()
+
+    def on_batch_end(self, mode, step, logs=None):
+        if mode != 'train':
+            return
+        ex = self._batch_examples
+        if ex is None:
+            ex = (logs or {}).get('batch_size')
+        tokens = None
+        if ex is not None and self.tokens_per_example:
+            tokens = int(ex) * int(self.tokens_per_example)
+        self.reporter.end_step(examples=ex, tokens=tokens)
+        if self.log_freq and (step + 1) % self.log_freq == 0:
+            s = self.reporter.snapshot()
+            print(f"[telemetry] step {step + 1}: "
+                  f"{s['examples_per_sec']:.1f} ex/s, "
+                  f"{s['avg_step_ms']:.1f} ms/step, "
+                  f"compile {s['compile_seconds_total']:.2f}s")
+
+    def observe_batch(self, batch):
+        """Called by Model.fit with the raw batch to size examples/sec."""
+        try:
+            first = batch[0] if isinstance(batch, (list, tuple)) else batch
+            self._batch_examples = int(first.shape[0])
+        except Exception:
+            self._batch_examples = None
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is not None:
+            logs['telemetry'] = self.snapshot()
+
+    def snapshot(self):
+        return self.reporter.snapshot()

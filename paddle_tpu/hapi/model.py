@@ -133,14 +133,21 @@ class Model:
             for m in self._metrics:
                 m.reset()
             logs = {}
+            from .. import profiler as _prof
+            telemetry_cbs = [c for c in cbks.callbacks
+                             if hasattr(c, 'observe_batch')]
             for step, batch in enumerate(train_loader):
                 if num_iters is not None and step >= num_iters:
                     break
+                for tc in telemetry_cbs:
+                    tc.observe_batch(batch)
                 cbks.on_batch_begin('train', step, logs)
                 ins, labs = self._split_batch(batch)
-                result = self.train_batch(ins, labs,
-                                          update=(step + 1) %
-                                          accumulate_grad_batches == 0)
+                with _prof.RecordEvent('hapi::train_batch',
+                                       event_type='train', step=step):
+                    result = self.train_batch(ins, labs,
+                                              update=(step + 1) %
+                                              accumulate_grad_batches == 0)
                 logs = self._update_logs(result, logs, step)
                 cbks.on_batch_end('train', step, logs)
                 if self.stop_training:

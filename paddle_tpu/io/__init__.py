@@ -12,6 +12,7 @@ import itertools
 import os
 import queue as _queue
 import threading
+import time as _time
 
 import numpy as np
 
@@ -315,28 +316,74 @@ class DataLoader:
         raise TypeError("DataLoader over IterableDataset has no len()")
 
     def _gen(self):
+        from .. import profiler as _prof
+        produce_h = self._produce_histogram()
         if self._iterable_ds:
             it = iter(self.dataset)
             bs = getattr(self, 'batch_size', 1)
             while True:
-                batch = list(itertools.islice(it, bs))
-                if not batch:
-                    return
-                if len(batch) < bs and getattr(self, 'drop_last', False):
-                    return
-                yield self.collate_fn(batch)
+                t0 = _time.perf_counter()
+                with _prof.RecordEvent('dataloader::produce',
+                                       event_type='dataloader'):
+                    batch = list(itertools.islice(it, bs))
+                    if not batch:
+                        return
+                    if len(batch) < bs and getattr(self, 'drop_last',
+                                                   False):
+                        return
+                    out = self.collate_fn(batch)
+                produce_h.observe(_time.perf_counter() - t0)
+                yield out
         else:
             for indices in self.batch_sampler:
-                yield self.collate_fn([self.dataset[i] for i in indices])
+                t0 = _time.perf_counter()
+                with _prof.RecordEvent('dataloader::produce',
+                                       event_type='dataloader'):
+                    out = self.collate_fn(
+                        [self.dataset[i] for i in indices])
+                produce_h.observe(_time.perf_counter() - t0)
+                yield out
+
+    @staticmethod
+    def _produce_histogram():
+        from ..core.monitor import histogram
+        return histogram('ptpu_dataloader_produce_seconds',
+                         help='time to read+collate one batch')
+
+    @staticmethod
+    def _wait_histogram():
+        from ..core.monitor import histogram
+        return histogram('ptpu_dataloader_wait_seconds',
+                         help='time the consumer waits for the next batch')
 
     def __iter__(self):
+        """Instrumented batch stream: `dataloader::next` spans measure
+        how long the TRAINING LOOP stalls on data (batch wait), while
+        `dataloader::produce` spans (possibly on a worker thread)
+        measure read+collate time — the wait/produce split the ISSUE's
+        reference StatRegistry surfaces for the feed path."""
+        from .. import profiler as _prof
+        from ..core.monitor import counter
+        wait_h = self._wait_histogram()
+        batches = counter('ptpu_dataloader_batches_total',
+                          help='batches yielded to the consumer')
         if self.num_workers == 0:
-            yield from self._gen()
-            return
-        if self._iterable_ds or self.batch_sampler is None:
-            yield from self._thread_iter()
-            return
-        yield from self._multiprocess_iter()
+            inner = self._gen()
+        elif self._iterable_ds or self.batch_sampler is None:
+            inner = self._thread_iter()
+        else:
+            inner = self._multiprocess_iter()
+        while True:
+            t0 = _time.perf_counter()
+            with _prof.RecordEvent('dataloader::next',
+                                   event_type='dataloader'):
+                try:
+                    batch = next(inner)
+                except StopIteration:
+                    return
+            wait_h.observe(_time.perf_counter() - t0)
+            batches.inc(1)
+            yield batch
 
     def _thread_iter(self):
         """Background-thread prefetch (IterableDataset path)."""

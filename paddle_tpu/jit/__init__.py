@@ -130,6 +130,7 @@ class TrainStep:
         self._compiled = jax.jit(
             self._step,
             donate_argnums=(0, 1, 2) if donate else ())
+        self._exec_cache = {}    # batch signature -> AOT executable
         self._step_i = 0
 
     def _step(self, params, buffers, opt_states, lr, key, batch):
@@ -148,13 +149,35 @@ class TrainStep:
         return loss, new_params, new_buffers, new_states
 
     def __call__(self, *batch):
+        from .. import profiler as _prof
+        from ..core.monitor import stat_add
         arrays = tuple(b.data if isinstance(b, Tensor) else jnp.asarray(b)
                        for b in batch)
         lr = self.optimizer.get_lr()
         key = rng_mod.next_key()
-        loss, self._params, self._buffers, self._opt_states = self._compiled(
-            self._params, self._buffers, self._opt_states,
-            jnp.asarray(lr, jnp.float32), key, arrays)
+        args = (self._params, self._buffers, self._opt_states,
+                jnp.asarray(lr, jnp.float32), key, arrays)
+        sig = tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
+        exe = self._exec_cache.get(sig)
+        if exe is None:
+            # compile split out from the steady-state step (observability
+            # v2): lower/compile spans + compile-seconds/FLOP metrics
+            stat_add('STAT_trainstep_compiles')
+            with _prof.RecordEvent('jit::train_step_compile',
+                                   event_type='compile'):
+                exe, _ = _prof.compile_with_telemetry(
+                    self._compiled, 'train_step', args)
+            self._exec_cache[sig] = exe
+        with _prof.RecordEvent('jit::train_step', event_type='jit'):
+            try:
+                out = exe(*args)
+            except TypeError:
+                # AOT signature drift (e.g. dtype-only change): retrace
+                if exe is self._compiled:
+                    raise
+                self._exec_cache[sig] = self._compiled
+                out = self._compiled(*args)
+        loss, self._params, self._buffers, self._opt_states = out
         self._step_i += 1
         return Tensor(loss)
 
@@ -232,6 +255,8 @@ class StaticFunction:
         self._layer = getattr(function, '__self__', None)
         self.input_spec = input_spec
         self._jit_cache = {}   # static-kwargs snapshot -> jitted trace
+        self._exec_cache = {}  # (skey, shape sig) -> AOT executable
+        self._compiled_sigs = set()
 
     def __call__(self, *args, **kwargs):
         if not ProgramTranslator.get_instance().enable_to_static:
@@ -264,9 +289,15 @@ class StaticFunction:
                 return k
             except TypeError:
                 return tuple((a, repr(b)) for a, b in items)
+        from .. import profiler as _prof
+        from ..core.monitor import counter
         skey = (tuple(spec), _hkey(sorted(static_pos.items())),
                 _hkey(sorted(s_kwargs.items())))
         jitted = self._jit_cache.get(skey)
+        counter('ptpu_jit_cache_total',
+                help='StaticFunction program-cache lookups',
+                labelnames=('result',)).inc(
+                    1, result='hit' if jitted is not None else 'miss')
         if jitted is None:
             fn = self._function
             layer = self._layer
@@ -293,8 +324,32 @@ class StaticFunction:
             buffers = get_buffers(self._layer)
         else:
             params, buffers = {}, {}
-        out = jitted(params, buffers, rng_mod.next_key(), tuple(arrays),
+        call_args = (params, buffers, rng_mod.next_key(), tuple(arrays),
                      {k: v.data for k, v in t_kwargs.items()})
+        # per-shape executable cache: jax.jit retraces internally on new
+        # shapes; tracking it here splits trace/lower/compile into spans
+        # and compile-seconds metrics (jax caches per aval signature)
+        shape_sig = (skey, tuple(
+            (tuple(getattr(l, 'shape', ())), str(getattr(l, 'dtype', '')))
+            for l in jax.tree_util.tree_leaves(
+                (params, buffers, call_args[3], call_args[4]))))
+        if shape_sig not in self._compiled_sigs:
+            self._compiled_sigs.add(shape_sig)
+            with _prof.RecordEvent('dy2static::trace_compile',
+                                   event_type='compile'):
+                exe, ok = _prof.compile_with_telemetry(
+                    jitted, 'dy2static', call_args)
+            if ok:
+                self._exec_cache[shape_sig] = exe
+        exe = self._exec_cache.get(shape_sig, jitted)
+        with _prof.RecordEvent('dy2static::call', event_type='jit'):
+            try:
+                out = exe(*call_args)
+            except TypeError:
+                if exe is jitted:
+                    raise
+                self._exec_cache.pop(shape_sig, None)
+                out = jitted(*call_args)
         return jax.tree_util.tree_map(Tensor, out)
 
 

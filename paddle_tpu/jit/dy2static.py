@@ -941,13 +941,25 @@ def convert_function(fn):
     factory is re-applied to EACH function's own closure cells — two
     closures sharing code get their own values (cell contents are
     snapshotted at conversion time)."""
+    from .. import profiler as _prof
+    from ..core.monitor import counter
     base = getattr(fn, '__func__', fn)
     key = getattr(base, '__code__', None)
     if key in _factory_cache:
         factory = _factory_cache[key]
+        counter('ptpu_dy2static_conversions_total',
+                help='AST control-flow conversions',
+                labelnames=('result',)).inc(1, result='cached')
     else:
-        factory = _build_factory(base)
+        with _prof.RecordEvent('dy2static::ast_transform',
+                               event_type='compile',
+                               fn=getattr(base, '__qualname__', '?')):
+            factory = _build_factory(base)
         _factory_cache[key] = factory
+        counter('ptpu_dy2static_conversions_total',
+                help='AST control-flow conversions',
+                labelnames=('result',)).inc(
+                    1, result='converted' if factory else 'passthrough')
     if factory is None:
         return fn
     try:

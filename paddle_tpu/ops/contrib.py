@@ -951,31 +951,45 @@ def correlation(x, y, pad_size, kernel_size, max_displacement,
             "to the same work")
     x, y = as_tensor(x), as_tensor(y)
     pad, K, d = int(pad_size), int(kernel_size), int(max_displacement)
-    if pad < d + K - 1:
-        raise ValueError(
-            f"correlation: pad_size={pad} must cover max_displacement"
-            f"+kernel_size-1={d + K - 1} so every shifted window stays "
-            "in the padded map")
+    if K < 1 or K % 2 == 0:
+        raise NotImplementedError(
+            f"correlation: kernel_size={K} must be odd — the reference "
+            "kernel taps a centered (2*((K-1)/2)+1)^2 patch "
+            "(correlation_op InferShape uses kernel_radius=(K-1)/2)")
+    if pad < 0:
+        raise ValueError(f"correlation: pad_size={pad} must be >= 0")
+    rad = (K - 1) // 2
+    border = d + rad              # InferShape border_size
     D = 2 * d + 1
 
     def fn(xa, ya):
         N, C, H, W = xa.shape
+        # reference InferShape: out = ceil((H + 2*pad - 2*border)/stride1)
+        Ho, Wo = H + 2 * pad - 2 * border, W + 2 * pad - 2 * border
+        if Ho < 1 or Wo < 1:
+            raise ValueError(
+                f"correlation: pad_size={pad} gives empty output "
+                f"{Ho}x{Wo} (need H+2*pad_size > 2*(max_displacement"
+                f"+(kernel_size-1)//2) = {2 * border})")
         f32 = jnp.float32
         cfg = [(0, 0), (0, 0), (pad, pad), (pad, pad)]
         x1 = jnp.pad(xa.astype(f32), cfg)
         y1 = jnp.pad(ya.astype(f32), cfg)
+        # output pixel o centers at padded coord o + border; a patch tap
+        # (ki, kj) sits at center + ki - rad, so the slice start is
+        # border + ki - rad = d + ki (displacement k shifts y's by k)
         chans = []
         for k in range(-d, d + 1):
             for l in range(-d, d + 1):
-                prod = jnp.zeros((N, H, W), f32)
+                prod = jnp.zeros((N, Ho, Wo), f32)
                 for ki in range(K):
                     for kj in range(K):
                         a = lax.dynamic_slice(
-                            x1, (0, 0, pad + ki, pad + kj),
-                            (N, C, H, W))
+                            x1, (0, 0, d + ki, d + kj),
+                            (N, C, Ho, Wo))
                         b = lax.dynamic_slice(
-                            y1, (0, 0, pad + k + ki, pad + l + kj),
-                            (N, C, H, W))
+                            y1, (0, 0, d + k + ki, d + l + kj),
+                            (N, C, Ho, Wo))
                         prod = prod + (a * b).sum(1)
                 chans.append(prod / (K * K * C))
         out = jnp.stack(chans, 1)          # [(k,l) row-major] == l+d+D*(k+d)

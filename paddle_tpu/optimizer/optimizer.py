@@ -150,9 +150,16 @@ class Optimizer:
         params = self._parameter_list
         if params is None:
             raise ValueError("optimizer created without a parameter list")
-        params_grads = [(p, p.grad) for p in params
-                        if not p.stop_gradient and p.grad is not None]
-        self._apply_params_grads(params_grads)
+        from .. import profiler as _prof
+        from ..core.monitor import counter
+        counter('ptpu_optimizer_steps_total',
+                help='eager optimizer.step() calls',
+                labelnames=('optimizer',)).inc(
+                    1, optimizer=type(self).__name__)
+        with _prof.RecordEvent('optimizer::step', event_type='optimizer'):
+            params_grads = [(p, p.grad) for p in params
+                            if not p.stop_gradient and p.grad is not None]
+            self._apply_params_grads(params_grads)
 
     def _apply_params_grads(self, params_grads):
         if self._grad_clip is not None:

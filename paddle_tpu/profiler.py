@@ -1,35 +1,168 @@
-"""Profiler.
+"""Profiler v2 — unified host tracing + step telemetry.
 
-Reference parity: python/paddle/fluid/profiler.py (profiler:314 context
-manager, RecordEvent markers) over platform/profiler.cc + device_tracer.cc
-(N4). Host events go through the C++ recorder (csrc/profiler.cc, chrome-trace
-export); device-side timing is delegated to jax.profiler (XLA xplane) —
-`start_device_trace`/`stop_device_trace` wrap it so one API drives both, as
-the reference's tracer correlates CUPTI with host events.
+Reference parity: python/paddle/profiler (Profiler:331, make_scheduler,
+RecordEvent, export_chrome_tracing handlers) layered over the fluid-era
+API (profiler:314 context manager) and platform/profiler.cc +
+device_tracer.cc (N4). Two recorders share one API:
+
+  * native fast path — csrc/profiler.cc via ctypes when
+    libpaddle_tpu_native.so is present (the reference's C++ host-event
+    tables; drives the legacy summary()/export_chrome_tracing());
+  * pure-Python fallback — a thread-aware ring buffer of nested spans
+    (parent ids, depth, categories, kwargs args) that the v2 Profiler
+    always records into, so the chrome-trace/JSON exporters can emit
+    nesting and metadata the flat native table can't hold.
+
+Device-side timing is delegated to jax.profiler (XLA xplane), as the
+reference's device_tracer correlates CUPTI with host events —
+`Profiler(targets=[ProfilerTarget.TPU])` brackets the RECORD window
+with jax.profiler.start_trace/stop_trace and stamps the logdir into the
+exported trace metadata.
+
+Step telemetry (`StepTelemetry`) aggregates examples/sec, tokens/sec,
+compile seconds, compile-cache hit rates, live device memory and XLA
+FLOP estimates into core.monitor gauges — consumed by the hapi
+`StepTelemetry` callback and bench.py.
 """
+import collections
 import contextlib
+import json
 import os
+import threading
+import time
 
 from .core.native import load_native
+from .core import monitor as _monitor
+
+_PID = os.getpid()
 
 
+# ---------------------------------------------------------------------------
+# recorder state
+# ---------------------------------------------------------------------------
+class _SpanBuffer:
+    """Pure-Python ring buffer of completed spans (thread-safe)."""
+
+    def __init__(self, capacity=200000):
+        self._spans = collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._next_id = 0
+
+    def new_id(self):
+        with self._lock:
+            self._next_id += 1
+            return self._next_id
+
+    def append(self, span):
+        with self._lock:
+            self._spans.append(span)
+
+    def drain(self):
+        with self._lock:
+            out = list(self._spans)
+            self._spans.clear()
+        return out
+
+    def snapshot(self):
+        with self._lock:
+            return list(self._spans)
+
+    def clear(self):
+        with self._lock:
+            self._spans.clear()
+
+    def __len__(self):
+        with self._lock:
+            return len(self._spans)
+
+
+_buffer = _SpanBuffer()
+_tls = threading.local()                 # per-thread open-span stack
+_legacy_on = False                       # fluid-era start/stop_profiler
+_tracer_depth = 0                        # v2 Profiler RECORD windows
+_force_python = os.environ.get(
+    'PADDLE_TPU_PROFILER_FORCE_PYTHON', '0') == '1'
+
+
+def _native_lib():
+    if _force_python:
+        return None
+    return load_native()
+
+
+def use_native_recorder(flag):
+    """Force the pure-Python recorder off/on (tests exercise the
+    fallback path this way even when the .so is present)."""
+    global _force_python
+    _force_python = not flag
+
+
+def _tracing_on():
+    return _legacy_on or _tracer_depth > 0
+
+
+def _now_us():
+    return time.perf_counter_ns() // 1000
+
+
+def _stack():
+    st = getattr(_tls, 'stack', None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+# ---------------------------------------------------------------------------
+# RecordEvent — nested, thread-aware span marker
+# ---------------------------------------------------------------------------
 class RecordEvent:
-    """Parity: paddle.profiler.RecordEvent / platform::RecordEvent RAII."""
+    """Parity: paddle.profiler.RecordEvent / platform::RecordEvent RAII.
 
-    def __init__(self, name, event_type=None):
+    Extra kwargs are recorded as chrome-trace `args` on the span
+    (byte counts, cache keys, shapes...). Usable as a context manager
+    or via explicit begin()/end().
+    """
+
+    __slots__ = ('name', 'event_type', 'args', '_start', '_id', '_lib')
+
+    def __init__(self, name, event_type=None, **kwargs):
         self.name = name
-        self._lib = load_native()
+        self.event_type = event_type
+        self.args = kwargs or None
         self._start = None
+        self._id = None
+        self._lib = None
 
     def begin(self):
-        if self._lib is not None:
-            self._start = self._lib.ptpu_profiler_now()
+        if not _tracing_on():
+            return
+        self._lib = _native_lib()
+        self._start = _now_us()
+        self._id = _buffer.new_id()
+        _stack().append(self._id)
 
     def end(self):
-        if self._lib is not None and self._start is not None:
-            self._lib.ptpu_profiler_record(self.name.encode(), self._start,
-                                           self._lib.ptpu_profiler_now())
-            self._start = None
+        if self._start is None:
+            return
+        end_us = _now_us()
+        st = _stack()
+        if st and st[-1] == self._id:
+            st.pop()
+        parent = st[-1] if st else 0
+        t = threading.current_thread()
+        _buffer.append({
+            'name': self.name, 'cat': self.event_type or 'python',
+            'ts': self._start, 'dur': end_us - self._start,
+            'tid': t.ident or 0, 'tname': t.name,
+            'id': self._id, 'parent': parent, 'depth': len(st),
+            'args': self.args,
+        })
+        if self._lib is not None and _legacy_on:
+            # native fast path mirrors the flat record (legacy
+            # summary()/export readers)
+            self._lib.ptpu_profiler_record(self.name.encode(),
+                                           self._start, end_us)
+        self._start = None
 
     def __enter__(self):
         self.begin()
@@ -40,43 +173,76 @@ class RecordEvent:
         return False
 
 
+@contextlib.contextmanager
+def record_function(name, **kwargs):
+    """Convenience alias (torch-style name) for RecordEvent."""
+    with RecordEvent(name, **kwargs):
+        yield
+
+
+# ---------------------------------------------------------------------------
+# legacy fluid-era API (kept verbatim in behavior)
+# ---------------------------------------------------------------------------
 def start_profiler(state='All', tracer_option='Default'):
-    lib = load_native()
+    global _legacy_on
+    _legacy_on = True
+    lib = _native_lib()
     if lib is not None:
         lib.ptpu_profiler_enable(1)
 
 
 def stop_profiler(sorted_key=None, profile_path='/tmp/profile'):
-    lib = load_native()
-    if lib is None:
-        return
-    lib.ptpu_profiler_enable(0)
+    global _legacy_on
+    _legacy_on = False
+    lib = _native_lib()
+    if lib is not None:
+        lib.ptpu_profiler_enable(0)
     print(summary())
     if profile_path:
         export_chrome_tracing(profile_path + '.json')
 
 
 def reset_profiler():
-    lib = load_native()
+    _buffer.clear()
+    lib = _native_lib()
     if lib is not None:
         lib.ptpu_profiler_clear()
 
 
 def summary():
-    lib = load_native()
-    if lib is None:
-        return ''
-    import ctypes
-    cap = 1 << 20
-    buf = ctypes.create_string_buffer(cap)
-    lib.ptpu_profiler_summary(buf, cap)
-    return buf.value.decode()
+    """Aggregated name → calls/total/avg/min/max table. Native table
+    when the .so is present (fluid parity), else computed from the
+    Python ring buffer."""
+    lib = _native_lib()
+    if lib is not None:
+        import ctypes
+        cap = 1 << 20
+        buf = ctypes.create_string_buffer(cap)
+        lib.ptpu_profiler_summary(buf, cap)
+        return buf.value.decode()
+    agg = {}
+    for s in _buffer.snapshot():
+        a = agg.setdefault(s['name'], [0, 0, float('inf'), 0])
+        a[0] += 1
+        a[1] += s['dur']
+        a[2] = min(a[2], s['dur'])
+        a[3] = max(a[3], s['dur'])
+    lines = ['name\tcalls\ttotal_ms\tavg_us\tmin_us\tmax_us']
+    for name in sorted(agg):
+        c, tot, mn, mx = agg[name]
+        lines.append(f'{name}\t{c}\t{tot / 1000.0:.3f}\t{tot / c:.1f}'
+                     f'\t{mn}\t{mx}')
+    return '\n'.join(lines) + '\n'
 
 
 def export_chrome_tracing(path):
-    lib = load_native()
+    """Legacy flat export: native recorder's events when present, else
+    the Python buffer rendered to the same chrome-trace shape."""
+    lib = _native_lib()
     if lib is not None:
         lib.ptpu_profiler_export(path.encode())
+        return path
+    _write_chrome_trace(path, _buffer.snapshot())
     return path
 
 
@@ -89,6 +255,16 @@ def profiler(state='All', sorted_key=None, profile_path='/tmp/profile',
         yield
     finally:
         stop_profiler(sorted_key, profile_path)
+
+
+def native_dropped_events():
+    """Events the native ring buffer discarded since the last clear
+    (csrc/profiler.cc caps at ~1M events so a forgotten-enabled
+    profiler can't grow without bound)."""
+    lib = _native_lib()
+    if lib is None or not hasattr(lib, 'ptpu_profiler_dropped'):
+        return 0
+    return int(lib.ptpu_profiler_dropped())
 
 
 # ---- device-side (XLA) trace ------------------------------------------------
@@ -104,28 +280,348 @@ def stop_device_trace():
     jax.profiler.stop_trace()
 
 
+# ---------------------------------------------------------------------------
+# chrome-trace / JSON writers
+# ---------------------------------------------------------------------------
+def _chrome_events(spans, metadata=None):
+    events = []
+    threads = {}
+    for s in spans:
+        threads.setdefault(s.get('tid', 0), s.get('tname', ''))
+        ev = {'name': s['name'], 'ph': 'X', 'pid': _PID,
+              'tid': s.get('tid', 0), 'ts': s['ts'], 'dur': s['dur'],
+              'cat': s.get('cat') or 'python'}
+        args = dict(s.get('args') or {})
+        if s.get('parent'):
+            args['parent_id'] = s['parent']
+        if s.get('depth') is not None:
+            args['depth'] = s['depth']
+        if args:
+            ev['args'] = {k: _jsonable(v) for k, v in args.items()}
+        events.append(ev)
+    events.append({'name': 'process_name', 'ph': 'M', 'pid': _PID,
+                   'args': {'name': 'paddle_tpu host'}})
+    for tid, tname in threads.items():
+        events.append({'name': 'thread_name', 'ph': 'M', 'pid': _PID,
+                       'tid': tid, 'args': {'name': tname or str(tid)}})
+    return events
+
+
+def _jsonable(v):
+    try:
+        json.dumps(v)
+        return v
+    except TypeError:
+        return repr(v)
+
+
+def _device_chrome_events(trace_dir):
+    """Chrome-format device events under a jax.profiler logdir, if the
+    run produced any (older TF profiler versions write
+    *.trace.json.gz beside the xplane protobuf)."""
+    if not trace_dir or not os.path.isdir(trace_dir):
+        return []
+    import glob
+    import gzip
+    events = []
+    pats = (os.path.join(trace_dir, '**', '*.trace.json.gz'),
+            os.path.join(trace_dir, '**', '*.trace.json'))
+    for pat in pats:
+        for fp in glob.glob(pat, recursive=True):
+            try:
+                opener = gzip.open if fp.endswith('.gz') else open
+                with opener(fp, 'rt') as f:
+                    doc = json.load(f)
+                for ev in doc.get('traceEvents', []):
+                    if isinstance(ev, dict):
+                        ev.setdefault('cat', 'device')
+                        events.append(ev)
+            except Exception:
+                continue
+    return events
+
+
+def _write_chrome_trace(path, spans, metadata=None):
+    doc = {'traceEvents': _chrome_events(spans)}
+    if metadata:
+        doc['metadata'] = metadata
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, 'w') as f:
+        json.dump(doc, f)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# scheduler (paddle 2.x make_scheduler parity, torch aliases accepted)
+# ---------------------------------------------------------------------------
+class ProfilerState:
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3       # last RECORD step of a cycle
+
+
+def make_scheduler(*, closed=None, ready=None, record=None, repeat=0,
+                   skip_first=0, wait=None, warmup=None, active=None):
+    """Parity: paddle.profiler.make_scheduler(closed, ready, record,
+    repeat, skip_first); torch-style wait/warmup/active aliases map to
+    closed/ready/record. Returns fn(step)->ProfilerState."""
+    closed = wait if closed is None else closed
+    ready = warmup if ready is None else ready
+    record = active if record is None else record
+    closed = int(closed or 0)
+    ready = int(ready or 0)
+    record = int(record)
+    if record <= 0:
+        raise ValueError("record (active) must be >= 1")
+    if closed < 0 or ready < 0 or skip_first < 0 or repeat < 0:
+        raise ValueError("scheduler windows must be non-negative")
+    cycle = closed + ready + record
+
+    def scheduler(step):
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        step -= skip_first
+        if repeat and step >= repeat * cycle:
+            return ProfilerState.CLOSED
+        pos = step % cycle
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+    scheduler._cycle = (skip_first, closed, ready, record, repeat)
+    return scheduler
+
+
+def _default_scheduler(_step):
+    return ProfilerState.RECORD
+
+
+class ProfilerTarget:
+    CPU = 'cpu'
+    GPU = 'gpu'
+    TPU = 'tpu'
+    CUSTOM_DEVICE = 'custom_device'
+
+
+def export_chrome_tracing_handler(dir_name, worker_name=None):
+    """Parity: paddle.profiler.export_chrome_tracing(dir_name) — an
+    on_trace_ready handler writing one chrome-trace file per collected
+    window into `dir_name`."""
+    os.makedirs(dir_name, exist_ok=True)
+
+    def handler(prof):
+        worker = worker_name or f'host_{_PID}'
+        lo, hi = prof.profiler_result.step_range
+        path = os.path.join(dir_name,
+                            f'{worker}_steps_{lo}_{hi}.paddle_trace.json')
+        prof.profiler_result.export_chrome_tracing(path)
+        return path
+    return handler
+
+
+class ProfilerResult:
+    """Spans collected for one RECORD window, plus metadata."""
+
+    def __init__(self, spans, step_range=(0, 0), device_trace_dir=None,
+                 native_events=None):
+        self.spans = spans
+        self.step_range = tuple(step_range)
+        self.device_trace_dir = device_trace_dir
+        self.native_events = native_events or []
+
+    def events(self):
+        return list(self.spans)
+
+    def _metadata(self):
+        md = {'step_range': list(self.step_range),
+              'schema': 'paddle_tpu.profiler/2'}
+        if self.device_trace_dir:
+            md['device_trace_dir'] = self.device_trace_dir
+        return md
+
+    def export_chrome_tracing(self, path):
+        spans = self.spans + self.native_events
+        doc = {'traceEvents': _chrome_events(spans),
+               'metadata': self._metadata()}
+        # best-effort merge of device-side events: TB/XLA profiler runs
+        # that produced chrome-format dumps (*.trace.json[.gz]) fold in
+        # under their own pids; xplane.pb-only runs stay referenced via
+        # metadata.device_trace_dir (open with TB's profile plugin)
+        for ev in _device_chrome_events(self.device_trace_dir):
+            doc['traceEvents'].append(ev)
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        with open(path, 'w') as f:
+            json.dump(doc, f)
+        return path
+
+    def export_json(self, path):
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        with open(path, 'w') as f:
+            json.dump({'metadata': self._metadata(),
+                       'spans': [dict(s, args=_jsonable(s.get('args')))
+                                 for s in self.spans]}, f)
+        return path
+
+    def summary(self, top=20):
+        agg = {}
+        for s in self.spans:
+            a = agg.setdefault(s['name'], [0, 0])
+            a[0] += 1
+            a[1] += s['dur']
+        rows = sorted(agg.items(), key=lambda kv: -kv[1][1])[:top]
+        lines = ['name\tcalls\ttotal_ms\tavg_us']
+        for name, (c, tot) in rows:
+            lines.append(f'{name}\t{c}\t{tot / 1000.0:.3f}\t{tot / c:.1f}')
+        return '\n'.join(lines) + '\n'
+
+
 class Profiler:
-    """paddle.profiler.Profiler-shaped wrapper (2.x API surface)."""
+    """Parity: paddle.profiler.Profiler (2.x) — scheduler-driven RECORD
+    windows, on_trace_ready handlers, chrome/JSON export. The host
+    tracer is the Python span buffer; `targets` containing TPU/GPU also
+    brackets RECORD windows with jax.profiler device traces."""
 
     def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
-                 timer_only=False):
+                 timer_only=False, record_shapes=False, profile_memory=False,
+                 with_flops=False, device_trace_dir=None):
         self.timer_only = timer_only
+        self.targets = list(targets) if targets else [ProfilerTarget.CPU]
+        if scheduler is None:
+            self._scheduler = _default_scheduler
+        elif callable(scheduler):
+            self._scheduler = scheduler
+        elif isinstance(scheduler, (tuple, list)) and len(scheduler) == 2:
+            start, end = scheduler
+            if end <= start:
+                raise ValueError("scheduler (start, end) needs end > start")
+            self._scheduler = make_scheduler(closed=max(int(start), 0),
+                                             record=int(end) - int(start),
+                                             repeat=1)
+        else:
+            raise TypeError(f"bad scheduler {scheduler!r}")
+        self.on_trace_ready = on_trace_ready
+        self.profiler_result = None
+        self._device_trace_dir = device_trace_dir
+        self._device_tracing = False
+        self.current_state = ProfilerState.CLOSED
+        self._step_num = 0
+        self._window_start = 0
+        self._running = False
+
+    # -- device bracket ------------------------------------------------------
+    def _wants_device(self):
+        return any(t in (ProfilerTarget.TPU, ProfilerTarget.GPU)
+                   for t in self.targets)
+
+    def _device_begin(self):
+        if not self._wants_device() or self._device_tracing:
+            return
+        try:
+            import tempfile
+            self._device_trace_dir = (self._device_trace_dir or
+                                      tempfile.mkdtemp(
+                                          prefix='paddle_tpu_xla_trace_'))
+            start_device_trace(self._device_trace_dir)
+            self._device_tracing = True
+        except Exception:            # device tracer unavailable: host-only
+            self._device_tracing = False
+
+    def _device_end(self):
+        if self._device_tracing:
+            try:
+                stop_device_trace()
+            except Exception:
+                pass
+            self._device_tracing = False
+
+    # -- state machine -------------------------------------------------------
+    def _tracer_enable(self):
+        global _tracer_depth
+        _tracer_depth += 1
+
+    def _tracer_disable(self):
+        global _tracer_depth
+        _tracer_depth = max(0, _tracer_depth - 1)
+
+    def _transition(self, new_state):
+        old = self.current_state
+        rec = (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        if old not in rec and new_state in rec:
+            _buffer.drain()          # discard warmup noise
+            self._window_start = self._step_num
+            self._tracer_enable()
+            self._device_begin()
+        if old == ProfilerState.RECORD_AND_RETURN or \
+                (old in rec and new_state not in rec):
+            self._device_end()
+            self._tracer_disable()
+            self._collect()
+            if new_state in rec:     # back-to-back windows (repeat)
+                self._window_start = self._step_num
+                self._tracer_enable()
+                self._device_begin()
+        self.current_state = new_state
+
+    def _collect(self):
+        self.profiler_result = ProfilerResult(
+            _buffer.drain(),
+            step_range=(self._window_start, self._step_num),
+            device_trace_dir=(self._device_trace_dir
+                              if self._wants_device() else None))
+        if self.on_trace_ready is not None and not self.timer_only:
+            self.on_trace_ready(self)
 
     def start(self):
-        start_profiler()
+        if self._running:
+            return
+        self._running = True
+        self._step_num = 0
+        self._transition(self._scheduler(0))
+
+    def step(self, num_samples=None):
+        """Advance one iteration; drives the scheduler state machine."""
+        if not self._running:
+            raise RuntimeError("Profiler.step() before start()")
+        self._step_num += 1
+        new_state = self._scheduler(self._step_num)
+        if new_state != self.current_state or \
+                self.current_state == ProfilerState.RECORD_AND_RETURN:
+            self._transition(new_state)
 
     def stop(self):
-        stop_profiler(profile_path=None)
+        if not self._running:
+            return
+        rec = (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        if self.current_state in rec:
+            self._device_end()
+            self._tracer_disable()
+            self._collect()
+        self.current_state = ProfilerState.CLOSED
+        self._running = False
 
-    def step(self):
-        pass
+    # -- results -------------------------------------------------------------
+    def export(self, path, format='json'):
+        if self.profiler_result is None:
+            raise RuntimeError("no collected window to export — run a "
+                               "RECORD window (or call stop()) first")
+        chrome = format in ('chrome', 'chrome_trace', 'chrometracing') \
+            or path.endswith(('.trace.json', '.chrome.json'))
+        if chrome:
+            return self.profiler_result.export_chrome_tracing(path)
+        return self.profiler_result.export_json(path)
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit='ms'):
+        if self.profiler_result is not None:
+            return self.profiler_result.summary()
         return summary()
-
-    def export(self, path, format='json'):
-        return export_chrome_tracing(path)
 
     def __enter__(self):
         self.start()
@@ -134,3 +630,186 @@ class Profiler:
     def __exit__(self, *a):
         self.stop()
         return False
+
+
+# ---------------------------------------------------------------------------
+# compile telemetry: instrumented AOT compile for jit call sites
+# ---------------------------------------------------------------------------
+def compile_with_telemetry(jitted, label, args, kwargs=None):
+    """Split trace/lower vs XLA-compile for a `jax.jit`-wrapped fn and
+    publish compile seconds + FLOP estimates. Returns (callable, ok):
+    the AOT-compiled executable when lowering succeeds (ok=True), else
+    the plain jitted fn (ok=False). Callers keep `jitted` as dispatch
+    fallback for signature drift."""
+    kwargs = kwargs or {}
+    c_sec = _monitor.counter('ptpu_compile_seconds_total',
+                             help='cumulative XLA compile seconds',
+                             labelnames=('site',))
+    c_num = _monitor.counter('ptpu_compiles_total',
+                             help='XLA compilations', labelnames=('site',))
+    try:
+        t0 = time.perf_counter()
+        with RecordEvent(f'{label}::lower', event_type='compile'):
+            lowered = jitted.lower(*args, **kwargs)
+        with RecordEvent(f'{label}::compile', event_type='compile'):
+            compiled = lowered.compile()
+        dt = time.perf_counter() - t0
+        c_sec.inc(dt, site=label)
+        c_num.inc(1, site=label)
+        flops = _cost_flops(compiled)
+        if flops is not None:
+            _monitor.gauge('ptpu_xla_flops_per_run',
+                           help='XLA cost-analysis FLOP estimate of the '
+                                'latest compiled executable',
+                           labelnames=('site',)).set(flops, site=label)
+        return compiled, True
+    except Exception:
+        # lowering not supported for this callable/args — fall back to
+        # the opaque jit path (compile time then hides in first call)
+        c_num.inc(1, site=label)
+        return jitted, False
+
+
+def _cost_flops(compiled):
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        v = ca.get('flops')
+        return float(v) if v is not None else None
+    except Exception:
+        return None
+
+
+def device_memory_stats():
+    """Live device memory via JAX (None entries when the backend does
+    not expose memory_stats, e.g. CPU)."""
+    try:
+        import jax
+        dev = jax.local_devices()[0]
+        stats = dev.memory_stats() if hasattr(dev, 'memory_stats') else None
+        if not stats:
+            return None
+        return {'bytes_in_use': stats.get('bytes_in_use'),
+                'peak_bytes_in_use': stats.get('peak_bytes_in_use'),
+                'bytes_limit': stats.get('bytes_limit')}
+    except Exception:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# step telemetry reporter
+# ---------------------------------------------------------------------------
+class StepTelemetry:
+    """Rolling-window step reporter: examples/sec, tokens/sec, step
+    latency, compile totals, cache hit/miss, device memory, FLOP/s.
+    Publishes gauges into core.monitor on every end_step; snapshot()
+    returns the JSON-ready dict bench.py and the hapi callback read."""
+
+    def __init__(self, window=20, publish=True):
+        self.window = int(window)
+        self.publish = publish
+        self._durs = collections.deque(maxlen=self.window)
+        self._examples = collections.deque(maxlen=self.window)
+        self._tokens = collections.deque(maxlen=self.window)
+        self._t0 = None
+        self.steps = 0
+
+    def begin_step(self):
+        self._t0 = time.perf_counter()
+
+    def end_step(self, examples=None, tokens=None):
+        if self._t0 is None:
+            return
+        dt = time.perf_counter() - self._t0
+        self._t0 = None
+        self.steps += 1
+        self._durs.append(dt)
+        self._examples.append(0 if examples is None else int(examples))
+        self._tokens.append(0 if tokens is None else int(tokens))
+        if self.publish:
+            self._publish()
+
+    @contextlib.contextmanager
+    def step(self, examples=None, tokens=None):
+        self.begin_step()
+        try:
+            yield
+        finally:
+            self.end_step(examples=examples, tokens=tokens)
+
+    # -- derived rates -------------------------------------------------------
+    def _rate(self, counts):
+        total_t = sum(self._durs)
+        if not total_t:
+            return 0.0
+        return sum(counts) / total_t
+
+    def examples_per_sec(self):
+        return self._rate(self._examples)
+
+    def tokens_per_sec(self):
+        return self._rate(self._tokens)
+
+    def avg_step_ms(self):
+        return (sum(self._durs) / len(self._durs) * 1000.0) \
+            if self._durs else 0.0
+
+    def _publish(self):
+        g = _monitor.gauge
+        g('ptpu_examples_per_sec',
+          help='rolling-window training throughput').set(
+              self.examples_per_sec())
+        if any(self._tokens):
+            g('ptpu_tokens_per_sec',
+              help='rolling-window token throughput').set(
+                  self.tokens_per_sec())
+        g('ptpu_step_ms', help='rolling mean step latency').set(
+            self.avg_step_ms())
+        g('ptpu_steps_total', help='telemetry steps observed').set(
+            self.steps)
+        mem = device_memory_stats()
+        if mem and mem.get('bytes_in_use') is not None:
+            g('ptpu_device_bytes_in_use',
+              help='live device memory (JAX backend)').set(
+                  mem['bytes_in_use'])
+
+    def snapshot(self):
+        reg = _monitor.metrics()
+
+        def _counter_total(name):
+            m = reg.get(name)
+            if m is None:
+                return 0.0
+            return sum(c.value() for c in m._series().values())
+        stats = _monitor.get_stats()
+        snap = {
+            'steps': self.steps,
+            'avg_step_ms': self.avg_step_ms(),
+            'examples_per_sec': self.examples_per_sec(),
+            'tokens_per_sec': self.tokens_per_sec(),
+            'compile_seconds_total':
+                _counter_total('ptpu_compile_seconds_total'),
+            'compiles_total': _counter_total('ptpu_compiles_total'),
+            'compile_cache_hits':
+                int(stats.get('STAT_executor_cache_hit', 0)),
+            'compile_cache_misses':
+                int(stats.get('STAT_executor_cache_miss', 0)),
+            'device_memory': device_memory_stats(),
+        }
+        flops = reg.get('ptpu_xla_flops_per_run')
+        if flops is not None:
+            snap['xla_flops_per_run'] = {
+                k[0]: c.value() for k, c in flops._series().items()}
+        return snap
+
+
+__all__ = [
+    'RecordEvent', 'record_function', 'Profiler', 'ProfilerState',
+    'ProfilerTarget', 'ProfilerResult', 'make_scheduler',
+    'export_chrome_tracing_handler', 'start_profiler', 'stop_profiler',
+    'reset_profiler', 'summary', 'export_chrome_tracing', 'profiler',
+    'start_device_trace', 'stop_device_trace', 'compile_with_telemetry',
+    'device_memory_stats', 'StepTelemetry', 'use_native_recorder',
+    'native_dropped_events',
+]

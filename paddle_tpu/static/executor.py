@@ -134,21 +134,46 @@ class Executor:
             cur = int(sv) if sv is not None else 0
             skip_tail = ((cur + 1) % lk) != 0
 
+        from .. import profiler as _prof
+        from ..core.monitor import stat_add
+
         key = (id(program), feed_names,
                tuple((a.shape, str(a.dtype)) for a in feed_arrays),
                tuple(fetch_names), _program_fingerprint(program),
                id(opt), skip_tail)
-        compiled = self._cache.get(key)
-        if compiled is None:
-            compiled = jax.jit(self._make_replay(program, feed_names,
-                                                 param_names, fetch_names,
-                                                 skip_tail=skip_tail))
-            self._cache[key] = compiled
+        entry = self._cache.get(key)
+        if entry is None:
+            # compile-cache miss: trace+lower+compile split out from
+            # execution (observability v2) — the AOT executable is the
+            # fast path, the plain jitted fn the signature-drift fallback
+            stat_add('STAT_executor_cache_miss')
+            with _prof.RecordEvent('executor::build_program',
+                                   event_type='compile',
+                                   ops=len(program.global_block().ops)):
+                jitted = jax.jit(self._make_replay(
+                    program, feed_names, param_names, fetch_names,
+                    skip_tail=skip_tail))
+                compiled, _aot = _prof.compile_with_telemetry(
+                    jitted, 'executor',
+                    (tuple(feed_arrays), tuple(param_arrays), lr))
+            entry = self._cache[key] = (compiled, jitted)
+        else:
+            stat_add('STAT_executor_cache_hit')
 
-        from ..core.monitor import stat_add
         stat_add('STAT_executor_runs')
-        fetches, new_params = compiled(
-            tuple(feed_arrays), tuple(param_arrays), lr)
+        compiled, jitted = entry
+        with _prof.RecordEvent('executor::run', event_type='executor'):
+            try:
+                fetches, new_params = compiled(
+                    tuple(feed_arrays), tuple(param_arrays), lr)
+            except TypeError:
+                # AOT signature drift (e.g. param dtype changed without a
+                # program mutation): retrace via the jitted fallback
+                if compiled is jitted:
+                    raise
+                self._cache[key] = (jitted, jitted)
+                fetches, new_params = jitted(
+                    tuple(feed_arrays), tuple(param_arrays), lr)
         for name, arr in zip(param_names, new_params):
             scope.set(name, arr)
         if return_numpy:

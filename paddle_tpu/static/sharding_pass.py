@@ -154,6 +154,26 @@ def shard_program(program, rank, degree, stage=2):
     program._sharding_rank = rank
     program._sharding_degree = degree
     program._sharding_param2rank = param2rank
+
+    # pass-time telemetry: how many collectives this rewrite scheduled
+    # per step and their payload (var shapes are known statically)
+    from ..core.monitor import counter
+
+    def _var_bytes(name):
+        v = block.vars.get(name)
+        shape = getattr(v, 'shape', None) or [1]
+        return int(np.prod([d for d in shape if d and d > 0]) or 1) * 4
+    for op in sync_ops + clip_ops + bcast_ops:
+        if not op.type.startswith('c_'):
+            continue
+        counter('ptpu_sharding_pass_collectives_total',
+                help='collective ops inserted by the sharding rewrite',
+                labelnames=('op',)).inc(1, op=op.type)
+        counter('ptpu_sharding_pass_bytes_total',
+                help='per-step payload bytes the sharding rewrite '
+                     'schedules',
+                labelnames=('op',)).inc(
+                    sum(_var_bytes(n) for n in op.input_names), op=op.type)
     return param2rank
 
 
@@ -277,6 +297,25 @@ class MultiRankShardingSimulator:
 
     def _run_collective(self, op, envs):
         self.collective_count += 1
+        name = op.input_names[0]
+        from ..core.monitor import counter
+        from .. import profiler as _prof
+        arr = envs[0].get(name)
+        nbytes = 0
+        if arr is not None and hasattr(arr, 'shape'):
+            nbytes = int(np.prod(arr.shape or (1,))) * \
+                jnp.dtype(arr.dtype).itemsize * len(envs)
+        counter('ptpu_collective_calls_total',
+                help='collective API invocations',
+                labelnames=('op',)).inc(1, op=op.type)
+        counter('ptpu_collective_bytes_total',
+                help='payload bytes through collective APIs',
+                labelnames=('op',)).inc(nbytes, op=op.type)
+        with _prof.RecordEvent(f'collective::{op.type}',
+                               event_type='collective', bytes=nbytes):
+            self._run_collective_impl(op, envs)
+
+    def _run_collective_impl(self, op, envs):
         name = op.input_names[0]
         if op.type == 'c_allreduce_sum':
             total = sum(env[name] for env in envs)

@@ -13,8 +13,13 @@ same cipher-name strings so `CipherFactory.create_cipher(
 """
 import os
 
-from cryptography.hazmat.primitives.ciphers import Cipher as _CCipher
-from cryptography.hazmat.primitives.ciphers import algorithms, modes
+try:
+    from cryptography.hazmat.primitives.ciphers import Cipher as _CCipher
+    from cryptography.hazmat.primitives.ciphers import algorithms, modes
+    HAVE_CRYPTOGRAPHY = True
+except ImportError:      # image without the cryptography wheel: surface a
+    _CCipher = algorithms = modes = None   # clear error at USE, not import
+    HAVE_CRYPTOGRAPHY = False
 
 __all__ = ['Cipher', 'AESCipher', 'CipherFactory', 'CipherUtils']
 
@@ -43,6 +48,10 @@ class AESCipher(Cipher):
 
     def __init__(self, cipher_name='AES_CTR_NoPadding', iv_size=128,
                  tag_size=128):
+        if not HAVE_CRYPTOGRAPHY:
+            raise RuntimeError(
+                "paddle_tpu.utils.crypto requires the 'cryptography' "
+                "package, which is not installed in this environment")
         if 'AES' not in cipher_name:
             raise ValueError(f"not an AES cipher: {cipher_name!r}")
         self._gcm = 'GCM' in cipher_name

@@ -397,20 +397,27 @@ def test_bilateral_slice_grad_flows():
 # correlation vs an independent numpy oracle
 # ---------------------------------------------------------------------------
 def _np_correlation(x1, x2, pad, K, d):
+    """Reference semantics (correlation_op InferShape + centered kernel):
+    out[o] centers at padded coord o + border, border = d + (K-1)//2,
+    output size H + 2*pad - 2*border."""
     N, C, H, W = x1.shape
     D = 2 * d + 1
+    rad = (K - 1) // 2
+    border = d + rad
+    Ho, Wo = H + 2 * pad - 2 * border, W + 2 * pad - 2 * border
     p1 = np.pad(x1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
     p2 = np.pad(x2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
-    out = np.zeros((N, D * D, H, W), np.float32)
+    out = np.zeros((N, D * D, Ho, Wo), np.float32)
     for b in range(N):
-        for i in range(H):
-            for j in range(W):
+        for i in range(Ho):
+            for j in range(Wo):
+                ci, cj = i + border, j + border       # patch center
                 for k in range(-d, d + 1):
                     for l in range(-d, d + 1):
-                        a = p1[b, :, pad + i:pad + i + K,
-                               pad + j:pad + j + K]
-                        v = p2[b, :, pad + i + k:pad + i + k + K,
-                               pad + j + l:pad + j + l + K]
+                        a = p1[b, :, ci - rad:ci + rad + 1,
+                               cj - rad:cj + rad + 1]
+                        v = p2[b, :, ci + k - rad:ci + k + rad + 1,
+                               cj + l - rad:cj + l + rad + 1]
                         out[b, (l + d) + D * (k + d), i, j] = \
                             (a * v).mean()
     return out
@@ -428,17 +435,28 @@ def test_correlation_matches_oracle():
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
 
 
-def test_correlation_kernel2_and_guards():
+def test_correlation_kernel3_centered_and_guards():
     from paddle_tpu.ops.contrib import correlation
     rng = np.random.RandomState(3)
     x1 = rng.randn(1, 2, 5, 5).astype('float32')
     x2 = rng.randn(1, 2, 5, 5).astype('float32')
+    # K=3: centered window, output size from the InferShape formula
+    # (5 + 2*3 - 2*(2+1) = 5)
     got = np.asarray(correlation(Tensor(x1), Tensor(x2), pad_size=3,
-                                 kernel_size=2, max_displacement=2).data)
-    want = _np_correlation(x1, x2, 3, 2, 2)
+                                 kernel_size=3, max_displacement=2).data)
+    want = _np_correlation(x1, x2, 3, 3, 2)
+    assert got.shape == want.shape == (1, 25, 5, 5)
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    # relaxed pad guard: pad < d + K - 1 is legal while output is
+    # non-empty (5 + 2*1 - 2*2 = 3)
+    got2 = np.asarray(correlation(Tensor(x1), Tensor(x2), pad_size=1,
+                                  kernel_size=1, max_displacement=2).data)
+    np.testing.assert_allclose(got2, _np_correlation(x1, x2, 1, 1, 2),
+                               rtol=1e-5, atol=1e-6)
     with pytest.raises(NotImplementedError, match='stride'):
         correlation(Tensor(x1), Tensor(x2), 4, 1, 4, stride1=2)
+    with pytest.raises(NotImplementedError, match='odd'):
+        correlation(Tensor(x1), Tensor(x2), 3, 2, 2)
     with pytest.raises(ValueError, match='pad_size'):
         correlation(Tensor(x1), Tensor(x2), 1, 1, 4)
 

@@ -266,6 +266,12 @@ class TestMiscOpTail:
 class TestCrypto:
     """N38: model-file encryption (framework/io/crypto parity)."""
 
+    @pytest.fixture(autouse=True)
+    def _need_cryptography(self):
+        from paddle_tpu.utils import crypto
+        if not crypto.HAVE_CRYPTOGRAPHY:
+            pytest.skip("cryptography package not available in this image")
+
     def test_ctr_roundtrip_and_file(self, tmp_path):
         from paddle_tpu.utils.crypto import CipherFactory, CipherUtils
         key = CipherUtils.gen_key(256)

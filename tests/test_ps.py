@@ -511,3 +511,75 @@ class TestAsyncCommunicator:
                         np.ones((2, 4), np.float32), 0.1)
         with pytest.raises(ConnectionError, match='wire down'):
             comm.flush()
+        # a queued push error must not wedge shutdown: stop() re-raises
+        # AFTER releasing the worker threads
+        comm.push_async(np.arange(2, dtype=np.int64),
+                        np.ones((2, 4), np.float32), 0.1)
+        with pytest.raises(ConnectionError, match='wire down'):
+            comm.stop()
+        assert not comm._push_thread.is_alive()
+
+    def test_abandoned_pull_iterator_releases_producer(self):
+        from paddle_tpu.distributed.ps.communicator import (
+            AsyncCommunicator)
+
+        class SlowClient:
+            def pull(self, tid, ids, dim):
+                return np.zeros((len(ids), dim), np.float32)
+
+            def push(self, tid, ids, grads, lr):
+                pass
+
+        comm = AsyncCommunicator(SlowClient(), 0, 4, depth=1)
+        batches = [np.arange(3, dtype=np.int64)] * 50
+        it = comm.pull_ahead(batches)
+        next(it)                      # consume one, abandon the rest
+        it.close()                    # GeneratorExit -> cancel_pull
+        t0 = time.time()
+        while comm._pull_thread is not None and time.time() - t0 < 5:
+            time.sleep(0.01)
+        assert comm._pull_thread is None
+        # the communicator is reusable after cancellation
+        out = list(comm.pull_ahead([np.arange(2, dtype=np.int64)]))
+        assert len(out) == 1
+        comm.stop()
+
+    def test_stale_iterator_close_spares_newer_pull(self):
+        from paddle_tpu.distributed.ps.communicator import (
+            AsyncCommunicator)
+
+        class SlowClient:
+            def pull(self, tid, ids, dim):
+                return np.zeros((len(ids), dim), np.float32)
+
+            def push(self, tid, ids, grads, lr):
+                pass
+
+        comm = AsyncCommunicator(SlowClient(), 0, 4, depth=1)
+        it1 = comm.pull_ahead([np.arange(3, dtype=np.int64)] * 20)
+        next(it1)
+        comm.cancel_pull()            # explicit cancel of generation 1
+        it2 = comm.pull_ahead([np.arange(2, dtype=np.int64)] * 5)
+        it1.close()                   # stale gen-1 finalizer fires late
+        out = list(it2)               # gen 2 must complete, not hang
+        assert len(out) == 5
+        comm.stop()
+
+    def test_stop_cancels_inflight_pull(self):
+        from paddle_tpu.distributed.ps.communicator import (
+            AsyncCommunicator)
+
+        class SlowClient:
+            def pull(self, tid, ids, dim):
+                time.sleep(0.01)
+                return np.zeros((len(ids), dim), np.float32)
+
+            def push(self, tid, ids, grads, lr):
+                pass
+
+        comm = AsyncCommunicator(SlowClient(), 0, 4, depth=1)
+        comm.pull_ahead([np.arange(3, dtype=np.int64)] * 200)
+        t0 = time.time()
+        comm.stop()                   # must not hang on the full queue
+        assert time.time() - t0 < 5
+        assert comm._pull_thread is None

@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""trace_summary — summarize a paddle_tpu.profiler exported trace.
+
+Reads either exporter format (chrome-trace `traceEvents` or the raw
+`spans` JSON) and prints:
+
+  * the top-N spans by total duration (calls, total ms, avg us, share);
+  * a compile-vs-execute breakdown from span categories (compile =
+    trace/lower/XLA-compile spans; execute = executor/jit dispatches;
+    plus dataloader / collective / other buckets).
+
+Usage:
+    python tools/trace_summary.py TRACE.json [--top 15] [--json]
+    python tools/trace_summary.py --selftest    # CI smoke: generate a
+                                                # tiny trace, summarize it
+"""
+import argparse
+import json
+import os
+import sys
+
+
+CATEGORY_BUCKETS = {
+    'compile': 'compile',
+    'executor': 'execute',
+    'jit': 'execute',
+    'train': 'execute',
+    'optimizer': 'execute',
+    'dataloader': 'dataloader',
+    'collective': 'collective',
+}
+
+
+def load_spans(path):
+    """Normalize either export format to [{name, cat, dur, ts}]."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and 'spans' in doc:
+        return [s for s in doc['spans'] if 'dur' in s]
+    events = doc.get('traceEvents', doc) if isinstance(doc, dict) else doc
+    return [{'name': e.get('name', '?'), 'cat': e.get('cat', ''),
+             'dur': e.get('dur', 0), 'ts': e.get('ts', 0)}
+            for e in events if e.get('ph') == 'X']
+
+
+def summarize(spans, top=15):
+    agg, buckets = {}, {}
+    total = 0
+    for s in spans:
+        dur = int(s.get('dur') or 0)
+        total += dur
+        a = agg.setdefault(s['name'], {'calls': 0, 'total_us': 0})
+        a['calls'] += 1
+        a['total_us'] += dur
+        bucket = CATEGORY_BUCKETS.get(s.get('cat') or '', 'other')
+        buckets[bucket] = buckets.get(bucket, 0) + dur
+    rows = sorted(agg.items(), key=lambda kv: -kv[1]['total_us'])[:top]
+    return {
+        'span_count': len(spans),
+        'total_us': total,
+        'top_spans': [
+            {'name': n, 'calls': a['calls'], 'total_us': a['total_us'],
+             'avg_us': a['total_us'] / a['calls'],
+             'share': (a['total_us'] / total) if total else 0.0}
+            for n, a in rows],
+        'buckets_us': dict(sorted(buckets.items(),
+                                  key=lambda kv: -kv[1])),
+    }
+
+
+def render(summary):
+    out = []
+    total = summary['total_us']
+    out.append(f"spans: {summary['span_count']}   "
+               f"total: {total / 1000.0:.3f} ms")
+    out.append('')
+    out.append('-- compile vs execute ' + '-' * 38)
+    for bucket, us in summary['buckets_us'].items():
+        share = (us / total * 100) if total else 0.0
+        out.append(f'{bucket:<12} {us / 1000.0:>12.3f} ms  {share:5.1f}%')
+    out.append('')
+    out.append('-- top spans ' + '-' * 47)
+    out.append(f"{'name':<36} {'calls':>6} {'total_ms':>10} "
+               f"{'avg_us':>9} {'share':>6}")
+    for r in summary['top_spans']:
+        out.append(f"{r['name'][:36]:<36} {r['calls']:>6} "
+                   f"{r['total_us'] / 1000.0:>10.3f} "
+                   f"{r['avg_us']:>9.1f} {r['share'] * 100:>5.1f}%")
+    return '\n'.join(out)
+
+
+def _selftest():
+    """CI smoke: record a trace through the real tracer, export both
+    formats, summarize, and assert the breakdown is sane."""
+    import tempfile
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import paddle_tpu.profiler as prof
+
+    prof.use_native_recorder(False)
+    results = []
+    p = prof.Profiler(on_trace_ready=lambda pr: results.append(
+        pr.profiler_result))
+    p.start()
+    with prof.RecordEvent('executor::build_program', event_type='compile'):
+        with prof.RecordEvent('executor::compile', event_type='compile'):
+            sum(range(20000))
+    for _ in range(3):
+        with prof.RecordEvent('executor::run', event_type='executor'):
+            sum(range(5000))
+        with prof.RecordEvent('dataloader::next', event_type='dataloader'):
+            pass
+    p.stop()
+    prof.use_native_recorder(True)
+
+    with tempfile.TemporaryDirectory() as d:
+        ok = True
+        for fname, export in (
+                ('t.trace.json', results[0].export_chrome_tracing),
+                ('t.json', results[0].export_json)):
+            path = os.path.join(d, fname)
+            export(path)
+            s = summarize(load_spans(path))
+            assert s['span_count'] == 8, s
+            assert s['buckets_us'].get('compile', 0) > 0, s
+            assert s['buckets_us'].get('execute', 0) > 0, s
+            assert s['buckets_us'].get('dataloader', 0) >= 0, s
+            names = [r['name'] for r in s['top_spans']]
+            assert 'executor::run' in names, names
+            ok = ok and bool(render(s))
+        print(render(s))
+    print('trace_summary selftest: OK')
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument('trace', nargs='?', help='exported trace JSON')
+    ap.add_argument('--top', type=int, default=15,
+                    help='how many spans to list')
+    ap.add_argument('--json', action='store_true',
+                    help='machine-readable output')
+    ap.add_argument('--selftest', action='store_true',
+                    help='generate a synthetic trace and summarize it')
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return _selftest()
+    if not args.trace:
+        ap.error('trace path required (or --selftest)')
+    summary = summarize(load_spans(args.trace), top=args.top)
+    print(json.dumps(summary) if args.json else render(summary))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
