@@ -99,6 +99,11 @@ def bench_gpt_1p3b(optimizer='adamw'):
     flops = 6 * n_params * tokens + \
         12 * cfg.num_layers * cfg.hidden_size * L * tokens
     tflops = flops / dt / 1e12
+    # teardown proof (r5 regression): shutdown must actually release the
+    # ~8.5G of params+moments+executables; the post-shutdown census from
+    # the memory accountant goes into the round record
+    before = len(jax.live_arrays())
+    released = eng.shutdown()
     return {
         'mfu': tflops / V5E_PEAK_TFLOPS,
         'ms_per_step': dt * 1000,
@@ -108,6 +113,9 @@ def bench_gpt_1p3b(optimizer='adamw'):
         'seq_len': L,
         'microbatches': A,
         'optimizer': optimizer,
+        'live_buffers_before_shutdown': before,
+        'live_buffers_after_shutdown': released.get('live_buffers'),
+        'live_bytes_after_shutdown': released.get('live_bytes'),
     }
 
 
@@ -166,6 +174,7 @@ def bench_bert_config3():
     tokens = B * L
     flops = 6 * n_params * tokens + \
         12 * cfg.num_layers * cfg.hidden_size * L * tokens
+    eng.shutdown()
     return {
         'samples_per_sec': B / dt,
         'ms_per_step': dt * 1000,
@@ -248,6 +257,7 @@ def bench_resnet50_config2(B=128, steps=20, trials=3):
         dt = min(dt, (time.time() - t0) / n)
     # ResNet-50 @224: ~4.1 GFLOPs forward per image; train ~3x forward
     flops = 3 * 4.1e9 * B
+    eng.shutdown()
     return {'images_per_sec': B / dt, 'ms_per_step': dt * 1000,
             'mfu': flops / dt / 1e12 / V5E_PEAK_TFLOPS,
             'params': n_params, 'batch': B}
@@ -473,8 +483,71 @@ def _retry(fn, attempts=3):
     raise last
 
 
+# ---------------------------------------------------------------------------
+# leg orchestration — each leg runs in a FRESH subprocess (r5 regression:
+# one process accumulated every leg's device state until RESOURCE_EXHAUSTED
+# blanked 4 of 5 BASELINE configs; a leg now gets a clean XLA client and
+# its engines are shut down before it reports)
+# ---------------------------------------------------------------------------
+LEGS = {
+    'gpt_adamw': lambda: bench_gpt_1p3b('adamw'),
+    'gpt_sgd': lambda: bench_gpt_1p3b('sgd'),
+    'bert_base_zero2_bf16': bench_bert_config3,
+    'lenet_mnist': bench_lenet_config1,
+    'resnet50_dp_bf16': bench_resnet50_config2,
+    'deepfm_ps': bench_deepfm_ps_config5,
+    'ps_scale_ssd': bench_ps_scale,
+}
+
+_LEG_SENTINEL = 'LEG_RESULT:'
+
+
+def _attach_telemetry(r):
+    """Per-leg compile/device-memory telemetry (each leg is its own
+    process now, so the numbers are leg-scoped, not accumulated)."""
+    try:
+        from paddle_tpu.profiler import StepTelemetry
+        snap = StepTelemetry(publish=False).snapshot()
+        r['telemetry'] = {
+            'compile_seconds_total': round(snap['compile_seconds_total'],
+                                           2),
+            'compiles_total': int(snap['compiles_total']),
+            'device_memory': snap['device_memory'],
+        }
+    except Exception as e:
+        r['telemetry'] = {'error': repr(e)[:200]}
+    return r
+
+
+def run_leg(name):
+    """Child entry: run one leg, print its JSON on a sentinel line."""
+    r = _attach_telemetry(_retry(LEGS[name]))
+    print(_LEG_SENTINEL + json.dumps(r), flush=True)
+
+
+def _leg_in_subprocess(name, timeout=5400):
+    import subprocess
+    p = subprocess.run(
+        [sys.executable, '-u', os.path.abspath(__file__), '--leg', name],
+        capture_output=True, text=True, timeout=timeout)
+    for line in reversed((p.stdout or '').splitlines()):
+        if line.startswith(_LEG_SENTINEL):
+            return json.loads(line[len(_LEG_SENTINEL):])
+    tail = ((p.stdout or '') + (p.stderr or ''))[-400:]
+    raise RuntimeError(
+        f"bench leg {name} produced no result (rc={p.returncode}): {tail}")
+
+
 def main():
-    g = _retry(lambda: bench_gpt_1p3b('adamw'))
+    # BENCH_INPROC=1 keeps the legacy single-process mode (debugging)
+    inproc = os.environ.get('BENCH_INPROC') == '1'
+
+    def run(name):
+        if inproc:
+            return _attach_telemetry(_retry(LEGS[name]))
+        return _leg_in_subprocess(name)
+
+    g = run('gpt_adamw')
     detail = {
         'ms_per_step': round(g['ms_per_step'], 1),
         'tokens_per_sec': round(g['tokens_per_sec'], 1),
@@ -483,9 +556,12 @@ def main():
         'seq_len': g['seq_len'],
         'microbatches': g['microbatches'],
         'optimizer': 'adamw_bf16_moments',
+        'live_buffers_after_shutdown':
+            g.get('live_buffers_after_shutdown'),
+        'live_bytes_after_shutdown': g.get('live_bytes_after_shutdown'),
     }
     try:
-        s = _retry(lambda: bench_gpt_1p3b('sgd'))
+        s = run('gpt_sgd')
         detail['gpt1.3b_sgd'] = {
             'mfu': round(s['mfu'], 4),
             'ms_per_step': round(s['ms_per_step'], 1),
@@ -494,7 +570,7 @@ def main():
     except Exception as e:           # headline must still print
         detail['gpt1.3b_sgd'] = {'error': repr(e)[:200]}
     try:
-        b = _retry(bench_bert_config3)
+        b = run('bert_base_zero2_bf16')
         detail['bert_base_zero2_bf16'] = {
             'samples_per_sec': round(b['samples_per_sec'], 2),
             'ms_per_step': round(b['ms_per_step'], 1),
@@ -502,31 +578,22 @@ def main():
         }
     except Exception as e:           # headline must still print
         detail['bert_base_zero2_bf16'] = {'error': repr(e)[:200]}
-    for key, fn, rounds in (
-            ('lenet_mnist', bench_lenet_config1, 2),
-            ('resnet50_dp_bf16', bench_resnet50_config2, 2),
-            ('deepfm_ps', bench_deepfm_ps_config5, 2),
-            ('ps_scale_ssd', bench_ps_scale, 2),
+    for key, rounds in (
+            ('lenet_mnist', 2),
+            ('resnet50_dp_bf16', 2),
+            ('deepfm_ps', 2),
+            ('ps_scale_ssd', 2),
     ):
         try:
-            r = _retry(fn)
+            r = run(key)
             detail[key] = {k: (round(v, rounds)
                                if isinstance(v, float) else v)
                            for k, v in r.items()}
         except Exception as e:
             detail[key] = {'error': repr(e)[:200]}
-    try:
-        # observability v2: compile seconds / compile counts / device
-        # memory accumulated across all legs, from the telemetry reporter
-        from paddle_tpu.profiler import StepTelemetry
-        snap = StepTelemetry(publish=False).snapshot()
-        detail['telemetry'] = {
-            'compile_seconds_total': round(snap['compile_seconds_total'], 2),
-            'compiles_total': int(snap['compiles_total']),
-            'device_memory': snap['device_memory'],
-        }
-    except Exception as e:
-        detail['telemetry'] = {'error': repr(e)[:200]}
+    # per-leg compile/memory telemetry comes from the headline child
+    # (each leg is its own process now — no cross-leg accumulation)
+    detail['telemetry'] = g.get('telemetry', {})
     result = {
         'metric': 'gpt1.3b_adamw_trainstep_mfu',
         'value': round(g['mfu'], 4),
@@ -538,4 +605,7 @@ def main():
 
 
 if __name__ == '__main__':
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == '--leg':
+        run_leg(sys.argv[2])
+    else:
+        main()

@@ -1,0 +1,479 @@
+"""Device-memory forensics — phase accounting + structured OOM reports.
+
+Reference parity role: memory/stats.h + the allocator's
+DeviceMemoryStats surface (STAT_gpu_mem alloc/peak counters) and the
+`RESOURCE_EXHAUSTED` enrichment in memory/allocation (the reference
+prints an allocator state table on OOM). On TPU the allocator itself is
+XLA/PJRT's BFC (SURVEY N10) — this module owns the part the framework
+can still see: `device.memory_stats()` snapshots, live-buffer census via
+`jax.live_arrays()`, and the per-phase attribution the raw allocator
+cannot give.
+
+Three layers:
+
+  * `MemoryAccountant.phase(name)` — bracket compile/execute/step/init
+    sites; samples bytes-in-use at entry/exit, tracks per-phase
+    high-water marks and deltas, publishes `ptpu_mem_*` monitor gauges,
+    and attributes newly-live buffers to the phase that allocated them
+    (origin spans for the OOM report).
+  * `oom_report()` — a JSON-ready post-mortem: device limits, per-phase
+    high-water table, recent phase timeline, top live buffers by size
+    with their origin phase, and a suggested culprit phase.
+  * `oom_guard(site)` — wraps hot paths (executor execute, engine
+    steps); on `RESOURCE_EXHAUSTED` it writes the report to the log dir
+    and raises `DeviceOOMError` carrying the rendered report instead of
+    a bare backend traceback.
+
+Bytes sampling is cheap (one `memory_stats()` dict read); the
+live-buffer census walks `jax.live_arrays()` and is taken only at
+explicit `sample(count_buffers=True)` calls, phase exits of *census
+phases*, and report time — never per executor dispatch.
+"""
+import collections
+import contextlib
+import json
+import os
+import threading
+import time
+import weakref
+
+__all__ = [
+    'MemoryAccountant', 'accountant', 'phase', 'sample', 'live_buffers',
+    'live_buffer_count', 'oom_report', 'render_oom_report', 'oom_guard',
+    'is_oom_error', 'DeviceOOMError', 'reset',
+]
+
+_TIMELINE_CAP = 256
+_CENSUS_PHASES = frozenset((
+    'engine.init', 'engine.shutdown', 'pipeline.build', 'bench.leg'))
+
+
+def _env_rank():
+    try:
+        return int(os.environ.get('PADDLE_TRAINER_ID', '0') or 0)
+    except ValueError:
+        return 0
+
+
+def default_report_dir():
+    """Where diagnostics artifacts (OOM reports, watchdog dumps) land."""
+    return (os.environ.get('FLEET_LOG_DIR')
+            or os.environ.get('PADDLE_LOG_DIR') or '/tmp')
+
+
+def _device():
+    try:
+        import jax
+        return jax.local_devices()[0]
+    except Exception:
+        return None
+
+
+def _device_stats():
+    """(bytes_in_use, peak, limit) from the backend, or Nones when the
+    backend does not expose memory_stats (CPU)."""
+    dev = _device()
+    if dev is None or not hasattr(dev, 'memory_stats'):
+        return None, None, None
+    try:
+        stats = dev.memory_stats() or {}
+    except Exception:
+        return None, None, None
+    return (stats.get('bytes_in_use'), stats.get('peak_bytes_in_use'),
+            stats.get('bytes_limit'))
+
+
+def _arr_nbytes(a):
+    try:
+        return int(a.nbytes)
+    except Exception:
+        try:
+            import numpy as np
+            n = 1
+            for d in a.shape:
+                n *= int(d)
+            return n * np.dtype(a.dtype).itemsize
+        except Exception:
+            return 0
+
+
+class DeviceOOMError(RuntimeError):
+    """RESOURCE_EXHAUSTED enriched with the forensics report. `.report`
+    holds the JSON-ready dict; str() renders the human table."""
+
+    def __init__(self, message, report=None, report_path=None):
+        super().__init__(message)
+        self.report = report or {}
+        self.report_path = report_path
+
+
+def is_oom_error(exc):
+    """Backend-agnostic RESOURCE_EXHAUSTED detection (jaxlib raises
+    XlaRuntimeError whose repr carries the grpc status name)."""
+    if exc is None:
+        return False
+    r = repr(exc)
+    return ('RESOURCE_EXHAUSTED' in r or 'Out of memory' in r
+            or 'out of memory' in r)
+
+
+class MemoryAccountant:
+    """Per-process device-memory bookkeeping (thread-safe singleton)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.reset()
+
+    def reset(self):
+        with self._lock:
+            self._phases = collections.OrderedDict()
+            self._timeline = collections.deque(maxlen=_TIMELINE_CAP)
+            self._stack = []            # active phase names (thread-shared
+                                        # hot paths are main-thread only)
+            self._origins = {}          # id(live array) -> phase name
+            self._py_peak = 0           # census-derived fallback peak
+
+    # -- sampling ------------------------------------------------------------
+    def sample(self, count_buffers=False):
+        """One snapshot: {'bytes_in_use','peak_bytes_in_use','bytes_limit',
+        'live_buffers','live_bytes'}. The buffer census (live_buffers /
+        live_bytes and the CPU-backend bytes fallback) only runs when
+        `count_buffers` — it walks every live jax array."""
+        in_use, peak, limit = _device_stats()
+        out = {'bytes_in_use': in_use, 'peak_bytes_in_use': peak,
+               'bytes_limit': limit, 'live_buffers': None,
+               'live_bytes': None}
+        # the census walk is opt-in even when the backend has no
+        # memory_stats (CPU): per-dispatch phases must stay O(1)
+        if count_buffers:
+            try:
+                import jax
+                arrs = jax.live_arrays()
+            except Exception:
+                arrs = []
+            nbytes = sum(_arr_nbytes(a) for a in arrs)
+            out['live_buffers'] = len(arrs)
+            out['live_bytes'] = nbytes
+            if in_use is None:
+                out['bytes_in_use'] = nbytes
+                with self._lock:
+                    self._py_peak = max(self._py_peak, nbytes)
+                    out['peak_bytes_in_use'] = self._py_peak
+        return out
+
+    def live_buffers(self, top=None, with_origin=True):
+        """[(nbytes, shape, dtype, origin_phase)] sorted largest-first."""
+        try:
+            import jax
+            arrs = jax.live_arrays()
+        except Exception:
+            arrs = []
+        rows = []
+        with self._lock:
+            origins = dict(self._origins) if with_origin else {}
+        for a in arrs:
+            rows.append((_arr_nbytes(a), tuple(getattr(a, 'shape', ())),
+                         str(getattr(a, 'dtype', '?')),
+                         self._origin_of(a, origins)))
+        rows.sort(key=lambda r: -r[0])
+        return rows[:top] if top else rows
+
+    @staticmethod
+    def _origin_of(a, origins):
+        """Validated origin lookup: the entry's weakref must still point
+        at THIS object — CPython recycles id()s, and a stale entry would
+        blame a long-gone phase for a brand-new buffer."""
+        ent = origins.get(id(a))
+        if ent is None:
+            return None
+        phase_name, ref = ent
+        if ref is not None and ref() is not a:
+            return None
+        return phase_name
+
+    def live_buffer_count(self):
+        try:
+            import jax
+            return len(jax.live_arrays())
+        except Exception:
+            return 0
+
+    def _live_ids(self):
+        try:
+            import jax
+            return {id(a) for a in jax.live_arrays()}
+        except Exception:
+            return set()
+
+    def _attribute_new(self, phase_name, pre_ids):
+        """Tag arrays that became live BETWEEN this phase's entry and
+        exit (pre_ids is the entry census) and prune origins of freed
+        arrays. Attributing every so-far-untagged array instead would
+        blame the next census phase for buffers allocated long before
+        it (e.g. another engine's per-step param replacements). Entries
+        hold a weakref so an id() recycled onto a new array is detected
+        and re-tagged rather than inheriting the stale phase."""
+        try:
+            import jax
+            arrs = jax.live_arrays()
+        except Exception:
+            return
+        def _ref(a):
+            try:
+                return weakref.ref(a)
+            except TypeError:
+                return None
+
+        live_ids = set()
+        with self._lock:
+            for a in arrs:
+                i = id(a)
+                live_ids.add(i)
+                ent = self._origins.get(i)
+                stale = ent is not None and ent[1] is not None \
+                    and ent[1]() is not a
+                if stale:
+                    # id recycled onto a new array: re-tag with the
+                    # phase in which the new array was first seen
+                    self._origins[i] = (phase_name, _ref(a))
+                elif ent is None and i not in pre_ids:
+                    self._origins[i] = (phase_name, _ref(a))
+            for dead in set(self._origins) - live_ids:
+                del self._origins[dead]
+
+    # -- phases --------------------------------------------------------------
+    @contextlib.contextmanager
+    def phase(self, name, census=None):
+        """Bracket a memory-relevant region. `census=True` forces the
+        live-buffer walk at the boundary (defaults to True only for the
+        coarse lifecycle phases, so per-step sites stay cheap)."""
+        census = (name in _CENSUS_PHASES) if census is None else census
+        pre_ids = self._live_ids() if census else set()
+        enter = self.sample(count_buffers=census)
+        t0 = time.time()
+        self._stack.append(name)
+        try:
+            yield self
+        finally:
+            self._stack.pop()
+            exit_ = self.sample(count_buffers=census)
+            if census:
+                self._attribute_new(name, pre_ids)
+            self._record(name, enter, exit_, t0)
+
+    def current_phase(self):
+        return self._stack[-1] if self._stack else None
+
+    def _record(self, name, enter, exit_, t0):
+        e_in = enter.get('bytes_in_use') or 0
+        x_in = exit_.get('bytes_in_use') or 0
+        with self._lock:
+            ph = self._phases.get(name)
+            if ph is None:
+                ph = self._phases[name] = {
+                    'calls': 0, 'bytes_enter': 0, 'bytes_exit': 0,
+                    'high_water': 0, 'max_delta': 0, 'last_delta': 0,
+                    'live_buffers': None, 'seconds': 0.0}
+            ph['calls'] += 1
+            ph['bytes_enter'] = e_in
+            ph['bytes_exit'] = x_in
+            # high water from THIS phase's boundary samples — the
+            # backend's peak_bytes_in_use is a process-lifetime monotonic
+            # peak, and folding it in would smear the global maximum onto
+            # every phase recorded after it (wrong suspect attribution)
+            ph['high_water'] = max(ph['high_water'], e_in, x_in)
+            ph['last_delta'] = x_in - e_in
+            ph['max_delta'] = max(ph['max_delta'], x_in - e_in)
+            ph['seconds'] += time.time() - t0
+            if exit_.get('live_buffers') is not None:
+                ph['live_buffers'] = exit_['live_buffers']
+            self._timeline.append({
+                'ts': t0, 'phase': name, 'bytes_enter': e_in,
+                'bytes_exit': x_in, 'delta': x_in - e_in,
+                'live_buffers': exit_.get('live_buffers')})
+        self._publish(name, x_in, exit_.get('live_buffers'))
+
+    def _publish(self, name, in_use, nbuf):
+        from . import monitor as _m
+        g = _m.gauge
+        g('ptpu_mem_bytes_in_use',
+          help='device bytes in use at the last phase boundary',
+          labelnames=('phase',)).set(in_use, phase=name)
+        g('ptpu_mem_high_water_bytes',
+          help='per-phase device-memory high-water mark',
+          labelnames=('phase',)).set(
+              self._phases[name]['high_water'], phase=name)
+        if nbuf is not None:
+            g('ptpu_mem_live_buffers',
+              help='live device buffer count (census phases)').set(nbuf)
+
+    def phases(self):
+        with self._lock:
+            return {k: dict(v) for k, v in self._phases.items()}
+
+    def timeline(self):
+        with self._lock:
+            return list(self._timeline)
+
+    # -- OOM report ----------------------------------------------------------
+    def oom_report(self, exc=None, top=20):
+        snap = self.sample(count_buffers=True)
+        phases = self.phases()
+        suspect = None
+        if phases:
+            # attribute by what a phase NETTED (max_delta), not by
+            # boundary usage: when memory accumulates monotonically every
+            # later phase sees higher bytes-in-use than the phase that
+            # actually allocated the bulk of it
+            suspect = max(phases.items(),
+                          key=lambda kv: (kv[1]['max_delta'],
+                                          kv[1]['high_water']))[0]
+        bufs = [{'bytes': b, 'shape': list(s), 'dtype': d,
+                 'origin_phase': o}
+                for b, s, d, o in self.live_buffers(top=top)]
+        dev = _device()
+        report = {
+            'kind': 'oom_report',
+            'time': time.time(),
+            'error': repr(exc)[:2000] if exc is not None else None,
+            'device': str(dev) if dev is not None else None,
+            'rank': _env_rank(),
+            'bytes_in_use': snap['bytes_in_use'],
+            'peak_bytes_in_use': snap['peak_bytes_in_use'],
+            'bytes_limit': snap['bytes_limit'],
+            'live_buffer_count': snap['live_buffers'],
+            'live_bytes': snap['live_bytes'],
+            'top_buffers': bufs,
+            'phases': phases,
+            'timeline': self.timeline(),
+            'suspect_phase': suspect,
+        }
+        return report
+
+
+_accountant = MemoryAccountant()
+
+
+def accountant():
+    return _accountant
+
+
+def phase(name, census=None):
+    return _accountant.phase(name, census=census)
+
+
+def sample(count_buffers=False):
+    return _accountant.sample(count_buffers=count_buffers)
+
+
+def live_buffers(top=None):
+    return _accountant.live_buffers(top=top)
+
+
+def live_buffer_count():
+    return _accountant.live_buffer_count()
+
+
+def oom_report(exc=None, top=20):
+    return _accountant.oom_report(exc=exc, top=top)
+
+
+def reset():
+    _accountant.reset()
+
+
+def _fmt_bytes(n):
+    if n is None:
+        return '?'
+    for unit in ('B', 'KiB', 'MiB', 'GiB', 'TiB'):
+        if abs(n) < 1024 or unit == 'TiB':
+            return f'{n:.1f}{unit}' if unit != 'B' else f'{int(n)}B'
+        n /= 1024.0
+    return str(n)
+
+
+def render_oom_report(report):
+    """Human-readable table of an oom_report() dict (shared by the
+    DeviceOOMError message and tools/health_dump.py)."""
+    out = ['== device OOM report ' + '=' * 39]
+    out.append(f"device: {report.get('device')}   "
+               f"rank: {report.get('rank')}")
+    out.append(
+        f"in_use: {_fmt_bytes(report.get('bytes_in_use'))}   "
+        f"peak: {_fmt_bytes(report.get('peak_bytes_in_use'))}   "
+        f"limit: {_fmt_bytes(report.get('bytes_limit'))}   "
+        f"live buffers: {report.get('live_buffer_count')}")
+    if report.get('suspect_phase'):
+        ph = report['phases'].get(report['suspect_phase'], {})
+        out.append(f"suspect phase: {report['suspect_phase']} "
+                   f"(high-water {_fmt_bytes(ph.get('high_water'))}, "
+                   f"max step delta {_fmt_bytes(ph.get('max_delta'))})")
+    if report.get('phases'):
+        out.append('-- per-phase high water ' + '-' * 36)
+        out.append(f"{'phase':<24} {'calls':>6} {'high_water':>12} "
+                   f"{'last_delta':>12} {'exit':>12}")
+        rows = sorted(report['phases'].items(),
+                      key=lambda kv: -kv[1].get('high_water', 0))
+        for name, ph in rows:
+            out.append(
+                f"{name[:24]:<24} {ph.get('calls', 0):>6} "
+                f"{_fmt_bytes(ph.get('high_water')):>12} "
+                f"{_fmt_bytes(ph.get('last_delta')):>12} "
+                f"{_fmt_bytes(ph.get('bytes_exit')):>12}")
+    if report.get('top_buffers'):
+        out.append('-- top live buffers ' + '-' * 40)
+        out.append(f"{'bytes':>12}  {'dtype':<10} {'origin':<18} shape")
+        for b in report['top_buffers'][:20]:
+            out.append(f"{_fmt_bytes(b['bytes']):>12}  "
+                       f"{b['dtype']:<10} "
+                       f"{str(b.get('origin_phase') or '?'):<18} "
+                       f"{tuple(b['shape'])}")
+    if report.get('timeline'):
+        out.append('-- recent phase timeline ' + '-' * 35)
+        for ev in report['timeline'][-12:]:
+            out.append(f"  {ev['phase']:<24} "
+                       f"delta {_fmt_bytes(ev['delta']):>10}  "
+                       f"exit {_fmt_bytes(ev['bytes_exit']):>10}")
+    return '\n'.join(out)
+
+
+def write_report(report, path=None):
+    path = path or os.path.join(
+        default_report_dir(),
+        f"oom_report.rank{report.get('rank', 0)}.{os.getpid()}.json")
+    try:
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(path, 'w') as f:
+            json.dump(report, f)
+        return path
+    except OSError:
+        return None
+
+
+@contextlib.contextmanager
+def oom_guard(site, report_path=None):
+    """Convert a backend RESOURCE_EXHAUSTED escaping `site` into a
+    DeviceOOMError carrying the forensics report; the JSON report is
+    also written under the log dir for tools/health_dump.py."""
+    try:
+        yield
+    except DeviceOOMError:
+        raise                    # already enriched by an inner guard
+    except Exception as e:       # noqa: BLE001 — filtered by is_oom_error
+        if not is_oom_error(e):
+            raise
+        report = _accountant.oom_report(exc=e)
+        report['site'] = site
+        path = write_report(report, report_path)
+        try:
+            from ..distributed.fleet.utils import log_util
+            log_util.log_json('device_oom', level='error', site=site,
+                              report_path=path,
+                              bytes_in_use=report.get('bytes_in_use'),
+                              suspect_phase=report.get('suspect_phase'))
+        except Exception:
+            pass
+        msg = (f"RESOURCE_EXHAUSTED in {site}"
+               + (f" (full report: {path})" if path else '') + '\n'
+               + render_oom_report(report))
+        raise DeviceOOMError(msg, report=report, report_path=path) from e

@@ -181,10 +181,17 @@ def _instrumented(fn):
         h['calls'].inc(1)
         h['bytes'].inc(nbytes)
         from .. import profiler as _prof
-        t0 = None if in_spmd_region() else time.perf_counter()
-        with _prof.RecordEvent(span_name, event_type='collective',
-                               bytes=nbytes):
-            out = fn(*args, **kwargs)
+        from . import flight_recorder as _fr
+        traced = in_spmd_region()
+        t0 = None if traced else time.perf_counter()
+        grp = kwargs.get('group') or next(
+            (a for a in args if isinstance(a, Group)), None)
+        with _fr.record_span(op_name, nbytes=nbytes,
+                             group=getattr(grp, 'id', 0),
+                             mode='trace' if traced else 'eager'):
+            with _prof.RecordEvent(span_name, event_type='collective',
+                                   bytes=nbytes):
+                out = fn(*args, **kwargs)
         if t0 is not None:
             h['seconds'].observe(time.perf_counter() - t0)
         return out

@@ -13,6 +13,7 @@ import threading
 import time
 
 from ...core.native import TCPStore  # noqa: F401  (re-exported for users)
+from .utils import log_util
 
 
 class LauncherInterface:
@@ -75,6 +76,9 @@ class ElasticManager:
         self._hb_thread = threading.Thread(target=self._heartbeat_loop,
                                            daemon=True)
         self._hb_thread.start()
+        log_util.log_json('elastic_register', logger_name='elastic',
+                          job_id=self.job_id, host=self.host,
+                          np_min=self.np_min, np_max=self.np_max)
 
     def _key(self, host):
         return f"elastic/{self.job_id}/{host}"
@@ -107,9 +111,13 @@ class ElasticManager:
         alive = self.hosts(known_hosts)
         if len(alive) == len(known_hosts):
             return ElasticStatus.HOLD
-        if len(alive) < self.np_min:
-            return ElasticStatus.ERROR
-        return ElasticStatus.RESTART  # scale event → relaunch world
+        dead = [h for h in known_hosts if h not in alive]
+        status = ElasticStatus.ERROR if len(alive) < self.np_min \
+            else ElasticStatus.RESTART  # scale event → relaunch world
+        log_util.log_json('elastic_membership_change', level='warning',
+                          logger_name='elastic', job_id=self.job_id,
+                          alive=alive, dead=dead, status=status)
+        return status
 
     def exit(self, completed=True):
         self._stop.set()

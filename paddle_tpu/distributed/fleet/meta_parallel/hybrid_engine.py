@@ -47,7 +47,10 @@ def _param_spec(p, mesh_axes, zero_axis=None):
     return P(*spec)
 
 
-class HybridParallelTrainStep:
+from .meta_parallel_base import EngineTeardown
+
+
+class HybridParallelTrainStep(EngineTeardown):
     """Compile a full train step over the registered mesh.
 
     loss_fn(model, *batch) -> scalar loss Tensor. Batch tensors are sharded
@@ -95,33 +98,36 @@ class HybridParallelTrainStep:
                            and p.split_axis == 0))
             self._zero_ok[n] = ok
 
-        self._params = {n: self._place(p.data, self._param_specs[n])
-                        for n, p in named}
-        self._states = {}
-        self._state_specs = {}
-        for n, p in named:
-            st = optimizer.init_state(p)
-            if p.data.dtype != jnp.float32 and \
-                    getattr(optimizer, '_multi_precision', True):
-                st['master'] = p.data.astype(jnp.float32)
-            sspec = {}
-            for k, v in st.items():
-                if self._zero_ok[n] and np.ndim(v) >= 1 \
-                        and v.shape == p.data.shape:
-                    # slice the state to this sharding rank
-                    axes0 = list(self._param_specs[n])
-                    axes0[0] = 'sharding'
-                    sspec[k] = P(*axes0)
-                else:
-                    sspec[k] = self._param_specs[n] if (
-                        np.ndim(v) >= 1 and v.shape == p.data.shape) \
-                        else P()
-                st[k] = self._place(v, sspec[k])
-            self._states[n] = st
-            self._state_specs[n] = sspec
+        from ....core import memory as _mem
+        with _mem.phase('engine.init'):
+            self._params = {n: self._place(p.data, self._param_specs[n])
+                            for n, p in named}
+            self._states = {}
+            self._state_specs = {}
+            for n, p in named:
+                st = optimizer.init_state(p)
+                if p.data.dtype != jnp.float32 and \
+                        getattr(optimizer, '_multi_precision', True):
+                    st['master'] = p.data.astype(jnp.float32)
+                sspec = {}
+                for k, v in st.items():
+                    if self._zero_ok[n] and np.ndim(v) >= 1 \
+                            and v.shape == p.data.shape:
+                        # slice the state to this sharding rank
+                        axes0 = list(self._param_specs[n])
+                        axes0[0] = 'sharding'
+                        sspec[k] = P(*axes0)
+                    else:
+                        sspec[k] = self._param_specs[n] if (
+                            np.ndim(v) >= 1 and v.shape == p.data.shape) \
+                            else P()
+                    st[k] = self._place(v, sspec[k])
+                self._states[n] = st
+                self._state_specs[n] = sspec
 
         self._grad_clip = optimizer._grad_clip
         self._compiled = None
+        self._closed = False
         self._step_count = 0
 
     def _place(self, arr, spec):
@@ -283,20 +289,28 @@ class HybridParallelTrainStep:
                     f"divisible by dp*sharding = {self.dp}*"
                     f"{self.sharding_deg} = {ddeg} (ZeRO 'sharding' "
                     f"ranks are data-parallel ranks)")
+        self._ensure_open()
+        from ....core import memory as _mem
+        first = self._compiled is None   # this dispatch will XLA-compile
         if self._compiled is None:
             self._batch_ndims = tuple(a.ndim for a in arrays)
-            self._compiled = self._build()
+            with _mem.phase('pipeline.build'):
+                self._compiled = self._build()
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         key = rng_mod.next_key()
-        loss, self._params, self._states = self._compiled(
-            self._params, self._states, lr, key, *arrays)
+        with self._step_guard(first, 'hybrid.train_step', 'hybrid.step'):
+            loss, self._params, self._states = self._compiled(
+                self._params, self._states, lr, key, *arrays)
         self._step_count += 1
         return Tensor(loss)
 
     def sync_model(self):
         """Write updated params back into the eager Layer."""
+        self._ensure_open()
         for n, arr in self._params.items():
             self._params_by_name[n]._data = arr
+
+    # shutdown()/close() from EngineTeardown
 
     @property
     def params(self):

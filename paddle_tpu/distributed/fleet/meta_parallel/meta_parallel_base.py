@@ -1,6 +1,68 @@
 """Base wrapper for meta-parallel engines (parity:
 fleet/meta_parallel/meta_parallel_base.py)."""
+import contextlib
+
 from ....nn.layer.base import Layer
+
+
+class EngineTeardown:
+    """Shared device-state teardown for the SPMD engines (the r5 bench
+    regression: without it a finished engine pins params + optimizer
+    states + executables in HBM for the process lifetime).
+
+    `shutdown()` (alias `close()`) disarms the watchdog heartbeat, drops
+    the compiled executables and every engine-owned device buffer,
+    records an `engine.shutdown` accounting phase whose census proves
+    the release, and returns a post-release memory sample. Idempotent; a
+    shut-down engine refuses further work via `_ensure_open()`.
+    """
+
+    _closed = False
+
+    def _ensure_open(self):
+        if getattr(self, '_closed', False):
+            raise RuntimeError(
+                f"{type(self).__name__} was shut down; device state is "
+                "gone — build a new engine to keep training (sync_model "
+                "before shutdown to keep a host copy)")
+
+    @contextlib.contextmanager
+    def _step_guard(self, first, site, phase):
+        """Diagnostics bracket for one engine dispatch: flight-recorder
+        journal + step heartbeat + env-gated watchdog on WARM steps
+        only (`first` marks a dispatch that will XLA-compile — minutes
+        at scale — which must not age against the hang deadline), plus
+        the OOM guard and memory phase on every dispatch. Shared by
+        both engines so the cold-start exemption policy can't drift."""
+        from ....core import memory as _mem
+        from ... import flight_recorder as _fr
+        if not first:
+            _fr.start_watchdog()   # no-op unless PADDLE_HANG_TIMEOUT set
+            _fr.heartbeat()
+        span = contextlib.nullcontext() if first else \
+            _fr.record_span(site, mode='exec')
+        with span, _mem.oom_guard(site), _mem.phase(phase):
+            yield
+
+    def shutdown(self):
+        from ....core import memory as _mem
+        from ... import flight_recorder as _fr
+        if getattr(self, '_closed', False):
+            return _mem.sample(count_buffers=True)
+        _fr.engine_teardown()    # a stale heartbeat after a deliberate
+                                 # stop must not fire the hang watchdog
+        with _mem.phase('engine.shutdown'):
+            self._compiled = None
+            if hasattr(self, '_compiled_by_mode'):
+                self._compiled_by_mode = {}
+            self._params = None
+            self._states = None
+            self._closed = True
+            import gc
+            gc.collect()     # the donated-buffer graph can hold cycles
+        return _mem.sample(count_buffers=True)
+
+    close = shutdown
 
 
 class MetaParallelBase(Layer):

@@ -153,7 +153,10 @@ def engine_from_pipeline_layer(pipeline_layer, optimizer, accumulate_steps,
                               use_remat=use_remat, schedule=schedule)
 
 
-class SpmdPipelineEngine:
+from .meta_parallel_base import EngineTeardown
+
+
+class SpmdPipelineEngine(EngineTeardown):
     """Pipelined hybrid train step.
 
     Args:
@@ -221,52 +224,56 @@ class SpmdPipelineEngine:
         block_specs = {n: _spec_for(p, self.axes, extra_leading_pp=True)
                        for n, p in self._block_named}
 
-        stacked = {}
-        for n, p0 in self._block_named:
-            per_layer = []
-            for b in blocks:
-                per_layer.append(dict(b.named_parameters())[n].data)
-            stacked[n] = jnp.stack(per_layer, axis=0)  # [L, ...]
+        from ....core import memory as _mem
+        with _mem.phase('engine.init'):
+            stacked = {}
+            for n, p0 in self._block_named:
+                per_layer = []
+                for b in blocks:
+                    per_layer.append(dict(b.named_parameters())[n].data)
+                stacked[n] = jnp.stack(per_layer, axis=0)  # [L, ...]
 
-        self._specs = {'embed': embed_specs, 'blocks': block_specs,
-                       'head': head_specs}
-        self._params = {
-            'embed': {n: self._place(p.data, embed_specs[n])
-                      for n, p in self._embed_named},
-            'blocks': {n: self._place(stacked[n], block_specs[n])
-                       for n, p0 in self._block_named},
-            'head': {n: self._place(p.data, head_specs[n])
-                     for n, p in self._head_named},
-        }
+            self._specs = {'embed': embed_specs, 'blocks': block_specs,
+                           'head': head_specs}
+            self._params = {
+                'embed': {n: self._place(p.data, embed_specs[n])
+                          for n, p in self._embed_named},
+                'blocks': {n: self._place(stacked[n], block_specs[n])
+                           for n, p0 in self._block_named},
+                'head': {n: self._place(p.data, head_specs[n])
+                         for n, p in self._head_named},
+            }
 
-        # optimizer state mirrors the param tree
-        self._states = {}
-        self._state_specs = {}
-        for grp in ('embed', 'blocks', 'head'):
-            self._states[grp] = {}
-            self._state_specs[grp] = {}
-            for n, arr in self._params[grp].items():
-                st = {}
-                sspec = {}
-                tmpl = optimizer.init_state(Tensor(
-                    jnp.zeros(arr.shape, jnp.float32)))
-                if arr.dtype != jnp.float32 and getattr(
-                        optimizer, '_multi_precision', True):
-                    tmpl['master'] = arr.astype(jnp.float32)
-                for k, v in tmpl.items():
-                    spec = self._specs[grp][n] if (
-                        np.ndim(v) >= 1 and v.shape == arr.shape) else (
-                        P('pp') if grp == 'blocks' and np.ndim(v) >= 1
-                        else P())
-                    if grp == 'blocks' and np.ndim(v) == 0:
-                        # scalars (beta powers) per stacked tree stay scalar
-                        spec = P()
-                    st[k] = self._place(v, spec)
-                    sspec[k] = spec
-                self._states[grp][n] = st
-                self._state_specs[grp][n] = sspec
+            # optimizer state mirrors the param tree
+            self._states = {}
+            self._state_specs = {}
+            for grp in ('embed', 'blocks', 'head'):
+                self._states[grp] = {}
+                self._state_specs[grp] = {}
+                for n, arr in self._params[grp].items():
+                    st = {}
+                    sspec = {}
+                    tmpl = optimizer.init_state(Tensor(
+                        jnp.zeros(arr.shape, jnp.float32)))
+                    if arr.dtype != jnp.float32 and getattr(
+                            optimizer, '_multi_precision', True):
+                        tmpl['master'] = arr.astype(jnp.float32)
+                    for k, v in tmpl.items():
+                        spec = self._specs[grp][n] if (
+                            np.ndim(v) >= 1 and v.shape == arr.shape) else (
+                            P('pp') if grp == 'blocks' and np.ndim(v) >= 1
+                            else P())
+                        if grp == 'blocks' and np.ndim(v) == 0:
+                            # scalars (beta powers) per stacked tree stay
+                            # scalar
+                            spec = P()
+                        st[k] = self._place(v, spec)
+                        sspec[k] = spec
+                    self._states[grp][n] = st
+                    self._state_specs[grp][n] = sspec
 
         self._compiled = None
+        self._closed = False
         self._grad_clip = optimizer._grad_clip
 
     def _place(self, arr, spec):
@@ -926,6 +933,7 @@ class SpmdPipelineEngine:
         step unscales grads, skips the update on non-finite gradients,
         and records `self.last_found_inf` for the scaler's dynamic
         update."""
+        self._ensure_open()
         input_ids, labels = data
         ii = input_ids.data if isinstance(input_ids, Tensor) \
             else jnp.asarray(input_ids)
@@ -934,6 +942,7 @@ class SpmdPipelineEngine:
         want_scaling = scale is not None
         if not hasattr(self, '_compiled_by_mode'):
             self._compiled_by_mode = {}
+        from ....core import memory as _mem
         if want_scaling != self._use_scaling or self._compiled is None:
             self._use_scaling = want_scaling
             # two-slot cache: alternating scaled/unscaled steps must not
@@ -944,7 +953,8 @@ class SpmdPipelineEngine:
                 with _prof.RecordEvent('pipeline::build',
                                        event_type='compile',
                                        pp=self.pp,
-                                       scaling=want_scaling):
+                                       scaling=want_scaling), \
+                        _mem.phase('pipeline.build'):
                     self._compiled = self._build()
                 self._compiled_by_mode[want_scaling] = self._compiled
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
@@ -952,13 +962,23 @@ class SpmdPipelineEngine:
                          jnp.float32)
         key = rng_mod.next_key()
         from .... import profiler as _prof
-        with _prof.RecordEvent('pipeline::train_step', event_type='jit'):
+        # each MODE's executable compiles on its first dispatch (minutes
+        # at GPT scale; a later scaled/unscaled switch compiles again) —
+        # _step_guard journals/heartbeats only warm dispatches
+        if not hasattr(self, '_warm_modes'):
+            self._warm_modes = set()
+        first = want_scaling not in self._warm_modes
+        with _prof.RecordEvent('pipeline::train_step', event_type='jit'), \
+                self._step_guard(first, 'pipeline.train_step',
+                                 'pipeline.step'):
             loss, self._params, self._states, found = self._compiled(
                 self._params, self._states, lr, sc, key, ii, ll)
+        self._warm_modes.add(want_scaling)
         self.last_found_inf = found
         return Tensor(loss)
 
     def sync_model(self):
+        self._ensure_open()
         for n, p in self._embed_named:
             p._data = self._params['embed'][n]
         for n, p in self._head_named:
@@ -967,3 +987,5 @@ class SpmdPipelineEngine:
             lookup = dict(b.named_parameters())
             for n, _ in self._block_named:
                 lookup[n]._data = self._params['blocks'][n][i]
+
+    # shutdown()/close() from EngineTeardown

@@ -1,16 +1,219 @@
-"""Fleet logger (parity: fleet/utils/log_util.py)."""
+"""Fleet logger (parity: fleet/utils/log_util.py) — rank-aware
+structured logging for the distributed stack.
+
+The reference's log_util is a bare logging.Logger; production fleets
+need machine-parseable, per-rank logs (the "when it breaks" layer):
+
+  * every record carries rank / role / step fields — `set_role()` is
+    stamped by launch/elastic/PS roles, `set_step()` by the train loop;
+  * stderr keeps the human format (or JSON with FLEET_LOG_FORMAT=json);
+  * with FLEET_LOG_DIR set, each rank ALSO appends JSON-lines to
+    `<dir>/workerlog.<rank>.jsonl` — the file fleetrun tails and
+    tools/health_dump.py cross-references with hang/OOM reports;
+  * `log_json(event, **fields)` is the structured entry point the
+    watchdog, OOM guard, elastic manager and PS communicator use; extra
+    fields land in the record's `fields` dict, schema below.
+
+JSON-line schema (one object per line):
+  {"ts": epoch_seconds, "iso": iso8601, "level": "INFO", "logger": name,
+   "rank": int, "role": str, "step": int|null, "event": str|null,
+   "msg": str, "fields": {...}}   — `parse_line()` round-trips it.
+"""
+import datetime
+import json
 import logging
 import os
 import sys
+import threading
+
+__all__ = ['logger', 'get_logger', 'log_json', 'set_role', 'set_step',
+           'parse_line', 'JsonLineFormatter', 'configure', 'layer_to_str']
+
+_state = threading.local()
+_role = os.environ.get('PADDLE_TRAINING_ROLE', 'trainer').lower()
+
+
+def _rank():
+    try:
+        return int(os.environ.get('PADDLE_TRAINER_ID', '0') or 0)
+    except ValueError:
+        return 0
+
+
+def set_role(role):
+    """Process-wide role stamped on every record (trainer / launcher /
+    pserver / elastic / watchdog ...)."""
+    global _role
+    _role = str(role)
+
+
+def set_step(step):
+    """Current train step (thread-local; engines stamp it per step)."""
+    _state.step = step
+
+
+def current_step():
+    return getattr(_state, 'step', None)
+
+
+class _ContextFilter(logging.Filter):
+    """Attach rank/role/step to every record (also re-reads the rank
+    env so a logger created before fleetrun's env injection heals)."""
+
+    def filter(self, record):
+        record.rank = _rank()
+        record.role = _role
+        record.step = current_step()
+        if not hasattr(record, 'event'):
+            record.event = None
+        if not hasattr(record, 'fields'):
+            record.fields = None
+        return True
+
+
+class JsonLineFormatter(logging.Formatter):
+    def format(self, record):
+        doc = {
+            'ts': record.created,
+            'iso': datetime.datetime.fromtimestamp(
+                record.created).isoformat(timespec='milliseconds'),
+            'level': record.levelname,
+            'logger': record.name,
+            'rank': getattr(record, 'rank', _rank()),
+            'role': getattr(record, 'role', _role),
+            'step': getattr(record, 'step', None),
+            'event': getattr(record, 'event', None),
+            'msg': record.getMessage(),
+        }
+        fields = getattr(record, 'fields', None)
+        if fields:
+            doc['fields'] = {k: _jsonable(v) for k, v in fields.items()}
+        if record.exc_info and record.exc_info[0] is not None:
+            doc['exc'] = self.formatException(record.exc_info)
+        return json.dumps(doc)
+
+
+def _jsonable(v):
+    try:
+        json.dumps(v)
+        return v
+    except (TypeError, ValueError):
+        return repr(v)
+
+
+class _HumanFormatter(logging.Formatter):
+    def format(self, record):
+        base = (f"{self.formatTime(record, '%Y-%m-%d %H:%M:%S')} "
+                f"{record.levelname} "
+                f"[rank {getattr(record, 'rank', 0)}"
+                f"/{getattr(record, 'role', '?')}"
+                + (f" step {record.step}"
+                   if getattr(record, 'step', None) is not None else '')
+                + f"] {record.getMessage()}")
+        fields = getattr(record, 'fields', None)
+        if fields:
+            base += ' ' + ' '.join(f'{k}={_jsonable(v)}'
+                                   for k, v in fields.items())
+        if record.exc_info and record.exc_info[0] is not None:
+            base += '\n' + self.formatException(record.exc_info)
+        return base
+
+
+def parse_line(line):
+    """Round-trip a JSON log line back into its dict (tests + tooling)."""
+    doc = json.loads(line)
+    if not isinstance(doc, dict) or 'msg' not in doc:
+        raise ValueError(f"not a fleet log line: {line[:80]!r}")
+    return doc
+
+
+_UNSET = object()
+_configured_dir = None
+_explicit_dir = None
+
+
+def configure(logger_obj=None, log_dir=_UNSET, level=None, force=False):
+    """(Re)install handlers: stderr (human or JSON per FLEET_LOG_FORMAT)
+    plus, when a log dir is set, a per-rank JSON-lines file
+    `workerlog.<rank>.jsonl`. Idempotent unless `force` or the dir
+    changed. An EXPLICITLY passed `log_dir` is sticky: the per-record
+    healing path (get_logger/log_json re-reading FLEET_LOG_DIR) must not
+    tear down a handler the caller installed deliberately (pass
+    `log_dir=None` explicitly to clear it)."""
+    global _configured_dir, _explicit_dir
+    lg = logger_obj or logger
+    if log_dir is not _UNSET:
+        _explicit_dir = log_dir
+    log_dir = _explicit_dir if _explicit_dir is not None else \
+        os.environ.get('FLEET_LOG_DIR')
+    if lg.handlers and not force and log_dir == _configured_dir:
+        if level:
+            lg.setLevel(level)
+        return lg
+    for h in list(lg.handlers):
+        lg.removeHandler(h)
+        try:
+            h.close()
+        except Exception:
+            pass
+    # context rides on the HANDLERS: logger-level filters only run on
+    # the originating logger, so records from child loggers
+    # (log_json(..., logger_name=...)) would bypass a logger filter and
+    # lose rank/role/step
+    ctx = _ContextFilter()
+    stream = logging.StreamHandler(sys.stderr)
+    if os.environ.get('FLEET_LOG_FORMAT', 'text').lower() == 'json':
+        stream.setFormatter(JsonLineFormatter())
+    else:
+        stream.setFormatter(_HumanFormatter())
+    stream.addFilter(ctx)
+    lg.addHandler(stream)
+    if log_dir:
+        try:
+            os.makedirs(log_dir, exist_ok=True)
+            # non-trainer roles (launcher, pserver) get their own file:
+            # the launcher shares FLEET_LOG_DIR with its trainers and has
+            # no PADDLE_TRAINER_ID, so a bare rank-keyed name would
+            # interleave it into the rank-0 trainer's log
+            fname = f'workerlog.{_rank()}.jsonl' if _role == 'trainer' \
+                else f'workerlog.{_role}.{_rank()}.jsonl'
+            fh = logging.FileHandler(os.path.join(log_dir, fname))
+            fh.setFormatter(JsonLineFormatter())
+            fh.addFilter(ctx)
+            lg.addHandler(fh)
+        except OSError:
+            pass
+    lg.setLevel(level or os.environ.get('FLEET_LOG_LEVEL', 'INFO'))
+    lg.propagate = False
+    _configured_dir = log_dir
+    return lg
+
 
 logger = logging.getLogger('paddle_tpu.fleet')
-if not logger.handlers:
-    h = logging.StreamHandler(sys.stderr)
-    h.setFormatter(logging.Formatter(
-        '%(asctime)s %(levelname)s [rank '
-        + os.environ.get('PADDLE_TRAINER_ID', '0') + '] %(message)s'))
-    logger.addHandler(h)
-    logger.setLevel(os.environ.get('FLEET_LOG_LEVEL', 'INFO'))
+configure(logger)
+
+
+def get_logger(name=None, level=None):
+    """A child of the fleet logger sharing its handlers/context (pass a
+    dotted suffix, e.g. get_logger('elastic'))."""
+    configure(logger, level=level)   # heal handlers if env changed
+    if not name:
+        return logger
+    return logger.getChild(name)
+
+
+_LEVELS = {'debug': logging.DEBUG, 'info': logging.INFO,
+           'warning': logging.WARNING, 'error': logging.ERROR,
+           'critical': logging.CRITICAL}
+
+
+def log_json(event, level='info', logger_name=None, msg=None, **fields):
+    """Structured log entry: `event` is the machine key, `fields` the
+    payload; msg defaults to the event name."""
+    lg = get_logger(logger_name)
+    lg.log(_LEVELS.get(str(level).lower(), logging.INFO),
+           msg if msg is not None else event,
+           extra={'event': event, 'fields': fields or None})
 
 
 def layer_to_str(base, *args, **kwargs):

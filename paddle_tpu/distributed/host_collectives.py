@@ -34,6 +34,16 @@ class HostCollectiveGroup:
         self.gid = gid
         self._seq = 0
 
+    def _journal(self, op, arr=None):
+        """Flight-record this collective under its GROUP sequence number
+        — the number that must advance in lockstep on every rank, so a
+        hang report can say 'rank R never entered <op> gseq=N'."""
+        from . import flight_recorder as _fr
+        shape = getattr(arr, 'shape', None)
+        nbytes = int(getattr(arr, 'nbytes', 0) or 0)
+        return _fr.record_span(op, group=self.gid, gseq=self._seq,
+                               shape=shape, nbytes=nbytes, mode='host')
+
     # -- plumbing ------------------------------------------------------------
     def _put(self, payload):
         nchunks = max(1, (len(payload) + _CHUNK - 1) // _CHUNK)
@@ -79,10 +89,14 @@ class HostCollectiveGroup:
 
     # -- collectives ---------------------------------------------------------
     def all_gather(self, arr):
-        return self._round(np.asarray(arr))
+        a = np.asarray(arr)
+        with self._journal('all_gather', a):
+            return self._round(a)
 
     def all_reduce(self, arr, op='sum'):
-        vals = self._round(np.asarray(arr))
+        a = np.asarray(arr)
+        with self._journal('all_reduce', a):
+            vals = self._round(a)
         if op == 'sum':
             return sum(vals[1:], vals[0].copy())
         if op == 'avg':
@@ -103,19 +117,22 @@ class HostCollectiveGroup:
         """src uploads once; everyone reads src's slot (1/W the traffic
         of an all-gather round)."""
         a = np.ascontiguousarray(np.asarray(arr))
-        if self.rank == src:
-            self._put(a.tobytes())
-            out = a
-        else:
-            out = np.frombuffer(self._get(src, a.nbytes),
-                                dtype=a.dtype).reshape(a.shape)
-        self.store.barrier(f'hc/b/{self.gid}/{self._seq}', self.world_size)
+        with self._journal('broadcast', a):
+            if self.rank == src:
+                self._put(a.tobytes())
+                out = a
+            else:
+                out = np.frombuffer(self._get(src, a.nbytes),
+                                    dtype=a.dtype).reshape(a.shape)
+            self.store.barrier(f'hc/b/{self.gid}/{self._seq}',
+                               self.world_size)
         self._seq += 1
         return out
 
     def barrier(self):
-        self.store.barrier(f'hc/bar/{self.gid}/{self._seq}',
-                           self.world_size)
+        with self._journal('barrier'):
+            self.store.barrier(f'hc/bar/{self.gid}/{self._seq}',
+                               self.world_size)
         self._seq += 1
 
 

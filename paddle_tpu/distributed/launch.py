@@ -85,6 +85,11 @@ def _trainer_env(args, endpoints):
         'JAX_NUM_PROCESSES': str(args.nnodes),
         'JAX_PROCESS_ID': str(args.node_rank),
     })
+    if args.log_dir:
+        # trainers write rank-aware JSON-lines (fleet.utils.log_util)
+        # plus watchdog/OOM reports next to the launcher's trainer logs;
+        # an explicit --log_dir overrides any inherited FLEET_LOG_DIR
+        env['FLEET_LOG_DIR'] = args.log_dir
     return env
 
 
@@ -112,6 +117,7 @@ def watch_loop(args, endpoints, store):
         proc.send_signal(signum)
     signal.signal(signal.SIGTERM, forward_signal)
 
+    from .fleet.utils import log_util
     while True:
         ret = proc.poll()
         if ret is None:
@@ -124,12 +130,15 @@ def watch_loop(args, endpoints, store):
             return 0
         if args.elastic and restarts < args.max_restarts:
             restarts += 1
-            print(f"[fleetrun] trainer exited {ret}; restart "
-                  f"{restarts}/{args.max_restarts}", file=sys.stderr)
+            log_util.log_json('trainer_restart', level='warning',
+                              logger_name='launch', exit_code=ret,
+                              restart=restarts,
+                              max_restarts=args.max_restarts)
             proc = start_local_trainer(args, endpoints)
             continue
-        print(f"[fleetrun] trainer exited {ret}; aborting pod",
-              file=sys.stderr)
+        log_util.log_json('pod_abort', level='error',
+                          logger_name='launch', exit_code=ret,
+                          node_rank=args.node_rank)
         return ret
 
 
@@ -144,6 +153,14 @@ class _NullStore:
 def launch():
     """Parity: fleet/launch.py launch:396."""
     args = _parse()
+    from .fleet.utils import log_util
+    log_util.set_role('launcher')
+    if args.log_dir:
+        os.environ['FLEET_LOG_DIR'] = args.log_dir
+        log_util.configure(log_dir=args.log_dir, force=True)
+    log_util.log_json('fleetrun_start', logger_name='launch',
+                      nnodes=args.nnodes, node_rank=args.node_rank,
+                      master=args.master, elastic=bool(args.elastic))
     if args.nnodes <= 1:
         if args.elastic:
             ret = watch_loop(args, ['127.0.0.1:6171'], _NullStore())

@@ -21,6 +21,7 @@ mode fences).
 """
 import queue
 import threading
+import time
 
 import numpy as np
 
@@ -120,9 +121,14 @@ class AsyncCommunicator:
 
     def _cancel_generation(self, stop, t, out):
         """Stop one pull generation's producer and release its slot
-        (only if it still owns the slot). Idempotent."""
+        (only if it still owns the slot). Idempotent. Bounded wait: a
+        producer stuck in an in-flight client.pull() RPC (dead server,
+        partition) can't be interrupted — after the deadline the daemon
+        thread is abandoned (it re-checks `stop` before any further
+        put), matching the push side's join(timeout=10)."""
         stop.set()
-        while t.is_alive():
+        deadline = time.time() + 10.0
+        while t.is_alive() and time.time() < deadline:
             try:                     # unblock a producer stuck on put()
                 out.get_nowait()
             except queue.Empty:
@@ -161,6 +167,14 @@ class AsyncCommunicator:
                 self.client.push(self.table_id, ids, g, lr)
             except Exception as e:           # noqa: BLE001
                 self._push_err = e
+                try:
+                    from ..fleet.utils import log_util
+                    log_util.log_json(
+                        'ps_push_failed', level='error',
+                        logger_name='ps', table=self.table_id,
+                        rows=int(getattr(ids, 'size', 0)), error=repr(e))
+                except Exception:
+                    pass
             finally:
                 self._push_q.task_done()
 

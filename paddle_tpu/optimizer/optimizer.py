@@ -156,7 +156,10 @@ class Optimizer:
                 help='eager optimizer.step() calls',
                 labelnames=('optimizer',)).inc(
                     1, optimizer=type(self).__name__)
-        with _prof.RecordEvent('optimizer::step', event_type='optimizer'):
+        from ..core import memory as _mem
+        with _prof.RecordEvent('optimizer::step', event_type='optimizer'), \
+                _mem.oom_guard('optimizer.step'), \
+                _mem.phase('optimizer.step'):
             params_grads = [(p, p.grad) for p in params
                             if not p.stop_gradient and p.grad is not None]
             self._apply_params_grads(params_grads)

@@ -135,6 +135,7 @@ class Executor:
             skip_tail = ((cur + 1) % lk) != 0
 
         from .. import profiler as _prof
+        from ..core import memory as _mem
         from ..core.monitor import stat_add
 
         key = (id(program), feed_names,
@@ -149,7 +150,8 @@ class Executor:
             stat_add('STAT_executor_cache_miss')
             with _prof.RecordEvent('executor::build_program',
                                    event_type='compile',
-                                   ops=len(program.global_block().ops)):
+                                   ops=len(program.global_block().ops)), \
+                    _mem.phase('executor.compile'):
                 jitted = jax.jit(self._make_replay(
                     program, feed_names, param_names, fetch_names,
                     skip_tail=skip_tail))
@@ -162,7 +164,9 @@ class Executor:
 
         stat_add('STAT_executor_runs')
         compiled, jitted = entry
-        with _prof.RecordEvent('executor::run', event_type='executor'):
+        with _prof.RecordEvent('executor::run', event_type='executor'), \
+                _mem.oom_guard('executor.run'), \
+                _mem.phase('executor.execute'):
             try:
                 fetches, new_params = compiled(
                     tuple(feed_arrays), tuple(param_arrays), lr)

@@ -311,9 +311,11 @@ class MultiRankShardingSimulator:
         counter('ptpu_collective_bytes_total',
                 help='payload bytes through collective APIs',
                 labelnames=('op',)).inc(nbytes, op=op.type)
-        with _prof.RecordEvent(f'collective::{op.type}',
-                               event_type='collective', bytes=nbytes):
-            self._run_collective_impl(op, envs)
+        from ..distributed import flight_recorder as _fr
+        with _fr.record_span(op.type, nbytes=nbytes, mode='sim'):
+            with _prof.RecordEvent(f'collective::{op.type}',
+                                   event_type='collective', bytes=nbytes):
+                self._run_collective_impl(op, envs)
 
     def _run_collective_impl(self, op, envs):
         name = op.input_names[0]

@@ -16,22 +16,26 @@ def generate(key):
 
 
 @contextlib.contextmanager
-def guard(new_generator=None):
+def guard(new_generator=None, merge_high_water=False):
     """Scope the global name counters: inside the guard, naming starts
     fresh. `new_generator`, when given as a str, prefixes every name
     minted inside the guard (reference: fluid/unique_name.py
     UniqueNameGenerator prefix) — so twin guarded Programs CAN opt out
     of name sharing by using distinct prefixes.
 
-    On exit the previous counters are restored, MERGED with the guarded
-    block's high-water marks — so names minted after the guard can never
-    collide with (and silently alias, in the global scope) names minted
-    inside it. The one remaining sharing surface is intentional: two
-    sequential guard() blocks DO repeat names — that is what the
-    multi-rank SPMD simulators need (structurally-identical Programs on
-    every rank get identical parameter names). Only run such twin
-    Programs in separate scopes/processes; in one shared scope they
-    alias one buffer.
+    On exit the previous counters are restored EXACTLY (reference
+    semantics): a Program built after the guard mints the same names it
+    would have without the guard, which is what parameter-name-keyed
+    checkpoint compatibility requires, and two sequential guard() blocks
+    repeat names — what the multi-rank SPMD simulators need
+    (structurally-identical Programs on every rank get identical
+    parameter names). The flip side: a name minted AFTER the guard can
+    collide with one minted inside it, and in one shared Scope the two
+    alias one buffer — build twin Programs in separate
+    scopes/processes, or pass `merge_high_water=True` to fold the
+    guarded block's high-water marks into the restored counters
+    (collision-proof, checkpoint-name-shifting; see
+    docs/MIGRATION.md "Checkpoint name compatibility").
     """
     from ..static import program as _prog
     saved = dict(_prog._GLOBAL_NAME_COUNTER)
@@ -52,9 +56,10 @@ def guard(new_generator=None):
         _prog._GLOBAL_NAME_PREFIX = saved_prefix
         _prog._GLOBAL_NAME_COUNTER.clear()
         _prog._GLOBAL_NAME_COUNTER.update(saved)
-        for k, n in guarded.items():
-            if n > _prog._GLOBAL_NAME_COUNTER.get(k, 0):
-                _prog._GLOBAL_NAME_COUNTER[k] = n
+        if merge_high_water:
+            for k, n in guarded.items():
+                if n > _prog._GLOBAL_NAME_COUNTER.get(k, 0):
+                    _prog._GLOBAL_NAME_COUNTER[k] = n
 
 
 def switch(new_generator=None):

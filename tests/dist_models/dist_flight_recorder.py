@@ -1,0 +1,58 @@
+"""2-process forced-hang scenario for the flight recorder + watchdog.
+
+Both ranks run 3 lockstep host-backend all_reduces (journaled under
+group seq 0..2), then rank 0 enters a 4th all_reduce while rank 1 goes
+silent — the classic "one rank never reaches the collective" hang. Each
+rank's HangWatchdog must fire within its deadline, publish its journal
+over the collective TCPStore, gather the peer's, and write a combined
+cross-rank report naming rank 1 as the rank that never entered
+all_reduce gseq=3. `abort=True` turns the wedge into exit code 3 so the
+parent test (and fleetrun's watch loop in production) regains control.
+"""
+import os
+import sys
+import time
+
+import jax
+jax.config.update('jax_platforms', 'cpu')
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import numpy as np                                        # noqa: E402
+from paddle_tpu.distributed import host_collectives as HC  # noqa: E402
+from paddle_tpu.distributed import flight_recorder as fr  # noqa: E402
+
+
+def main():
+    rank = int(os.environ['PADDLE_TRAINER_ID'])
+    dump_dir = os.environ['FLIGHT_DUMP_DIR']
+    group = HC.init_host_collectives(timeout=60)
+    assert group is not None
+
+    dog = fr.HangWatchdog(
+        timeout=2.0, store=group.store, rank=rank, world_size=2,
+        job_id='hangtest', dump_dir=dump_dir, gather_timeout=10.0,
+        abort=True).start()
+
+    x = np.ones(8, np.float32) * (rank + 1)
+    for step in range(3):
+        fr.heartbeat()
+        out = group.all_reduce(x)
+        assert float(out[0]) == 3.0, out
+    print(f'RANK{rank}: 3 lockstep collectives done', flush=True)
+
+    if rank == 0:
+        group.all_reduce(x)          # blocks: rank 1 never arrives
+        print('RANK0: unexpected all_reduce completion', flush=True)
+        dog.stop()
+        sys.exit(9)
+    else:
+        time.sleep(60)               # silent rank: stale heartbeat
+        print('RANK1: unexpected wake', flush=True)
+        dog.stop()
+        sys.exit(9)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,552 @@
+"""Diagnostics layer (ISSUE 2): flight-recorder ring semantics, hang
+watchdog (single-process and true 2-rank forced hang), device-memory
+forensics / structured OOM reports, rank-aware JSON-lines logging, and
+engine teardown verified by the memory accountant."""
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import memory as mem
+from paddle_tpu.distributed import flight_recorder as fr
+from paddle_tpu.distributed.fleet.utils import log_util
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+# ---------------------------------------------------------------------------
+# ring journal semantics
+# ---------------------------------------------------------------------------
+class TestFlightRecorderRing:
+    def test_wraparound_keeps_newest_and_counts_dropped(self):
+        r = fr.FlightRecorder(capacity=8, rank=0)
+        for i in range(20):
+            with r.span('all_reduce', gseq=i, nbytes=4 * i):
+                pass
+        entries = r.entries()
+        assert len(entries) == 8
+        assert [e['gseq'] for e in entries] == list(range(12, 20))
+        assert r.dropped() == 12
+        seqs = [e['seq'] for e in entries]
+        assert seqs == sorted(seqs)              # monotonic
+        assert seqs[-1] == r.seq() == 20
+
+    def test_seq_monotonic_across_threads(self):
+        r = fr.FlightRecorder(capacity=64, rank=0)
+
+        def worker():
+            for _ in range(50):
+                s = r.record_enqueue('barrier')
+                r.record_complete(s)
+        ts = [threading.Thread(target=worker) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert r.seq() == 200
+        seqs = [e['seq'] for e in r.entries()]
+        assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+
+    def test_pending_entries_pinned_against_eviction(self):
+        """An incomplete entry is the hang evidence — later enqueues
+        evict completed entries around it, never the pending one (an
+        evicted pending entry would disarm the watchdog mid-hang)."""
+        r = fr.FlightRecorder(capacity=2, rank=0)
+        s0 = r.record_enqueue('all_gather', gseq=0)      # stays pending
+        for i in range(1, 5):
+            with r.span('all_gather', gseq=i):
+                pass
+        gseqs = [e['gseq'] for e in r.entries()]
+        assert 0 in gseqs                                # pinned
+        pend = r.first_incomplete()
+        assert pend is not None and pend['gseq'] == 0
+        r.record_complete(s0)       # late completion: unpins, monotonic
+        assert r.first_incomplete() is None
+        assert r.last_completed_seq() == 5
+
+    def test_all_pending_still_bounds_memory(self):
+        r = fr.FlightRecorder(capacity=2, rank=0)
+        for g in range(4):
+            r.record_enqueue('barrier', gseq=g)          # none complete
+        assert len(r.entries()) == 2 and r.dropped() == 2
+        r.record_complete(1)         # evicted seq: safe no-op
+        assert len(r.entries()) == 2
+
+    def test_first_incomplete_and_dump_frontier(self):
+        r = fr.FlightRecorder(capacity=16, rank=3)
+        for i in range(3):
+            with r.span('all_reduce', gseq=i):
+                pass
+        r.record_enqueue('broadcast', gseq=3, nbytes=128)
+        pend = r.first_incomplete()
+        assert pend['op'] == 'broadcast' and pend['gseq'] == 3
+        d = r.dump()
+        assert d['rank'] == 3
+        assert d['last_completed_gseq'] == 2
+        assert d['first_incomplete_gseq'] == 3
+        assert d['first_incomplete_op'] == 'broadcast'
+
+    def test_capacity_validated(self):
+        with pytest.raises(ValueError):
+            fr.FlightRecorder(capacity=0)
+
+    def test_collectives_journal_through_public_api(self):
+        """The eager collective API journals into the process recorder."""
+        import paddle_tpu.distributed as dist
+        rec = fr.recorder()
+        before = rec.seq()
+        t = paddle.to_tensor(np.ones(4, 'float32'))
+        dist.all_reduce(t)
+        entries = rec.entries()
+        assert rec.seq() > before
+        assert entries[-1]['op'] == 'all_reduce'
+        assert entries[-1]['t_complete'] is not None
+
+
+# ---------------------------------------------------------------------------
+# cross-rank analysis
+# ---------------------------------------------------------------------------
+class TestAnalyze:
+    def _dumps(self):
+        r0 = fr.FlightRecorder(capacity=8, rank=0)
+        r1 = fr.FlightRecorder(capacity=8, rank=1)
+        for g in range(3):
+            for r in (r0, r1):
+                with r.span('all_reduce', gseq=g):
+                    pass
+        r0.record_enqueue('all_reduce', gseq=3)
+        return {0: r0.dump(), 1: r1.dump()}
+
+    def test_names_stalled_rank_and_missing_seq(self):
+        ana = fr.analyze(self._dumps())
+        assert ana['frontier_gseq'] == 3
+        assert ana['stalled_ranks'] == [1]
+        assert ana['ranks'][0]['first_incomplete_gseq'] == 3
+        assert ana['ranks'][1]['last_completed_gseq'] == 2
+        assert any('rank 1 never entered all_reduce gseq=3' in s
+                   for s in ana['summary'])
+
+    def test_missing_dump_is_reported_dead(self):
+        dumps = self._dumps()
+        dumps[1] = None
+        ana = fr.analyze(dumps)
+        assert 1 in ana['stalled_ranks']
+        assert any('no dump received' in s for s in ana['summary'])
+
+    def test_render_dump_mentions_pending(self):
+        doc = {'kind': 'hang_report', 'reason': 'test',
+               'ranks': {str(k): v for k, v in self._dumps().items()},
+               'analysis': fr.analyze(self._dumps())}
+        text = fr.render_dump(doc)
+        assert 'PENDING' in text and 'never entered' in text
+
+
+# ---------------------------------------------------------------------------
+# watchdog — single process
+# ---------------------------------------------------------------------------
+class TestWatchdogLocal:
+    def test_fires_on_stalled_collective(self, tmp_path):
+        r = fr.FlightRecorder(capacity=8, rank=0)
+        with r.span('all_reduce', gseq=0):
+            pass
+        r.record_enqueue('all_reduce', gseq=1)
+        reports = []
+        dog = fr.HangWatchdog(timeout=0.4, interval=0.1, recorder=r,
+                              world_size=1, dump_dir=str(tmp_path),
+                              on_dump=reports.append).start()
+        try:
+            assert dog.fired.wait(5.0), "watchdog never fired"
+        finally:
+            dog.stop()
+        rep = reports[0]
+        assert rep['reason'].startswith('collective all_reduce gseq=1')
+        assert rep['ranks']['0']['first_incomplete_gseq'] == 1
+        assert any('MainThread' in k for k in
+                   rep['ranks']['0']['stacks'])
+        assert os.path.exists(dog.report_path)
+        with open(dog.report_path) as f:
+            assert json.load(f)['kind'] == 'hang_report'
+
+    def test_fires_on_stale_heartbeat(self):
+        r = fr.FlightRecorder(capacity=8, rank=0)
+        r.heartbeat()
+        reports = []
+        dog = fr.HangWatchdog(timeout=0.4, interval=0.1, recorder=r,
+                              world_size=1, dump_dir='/tmp',
+                              on_dump=reports.append).start()
+        try:
+            assert dog.fired.wait(5.0)
+        finally:
+            dog.stop()
+        assert 'heartbeat stale' in reports[0]['reason']
+
+    def test_quiet_when_progressing(self):
+        r = fr.FlightRecorder(capacity=8, rank=0)
+        dog = fr.HangWatchdog(timeout=0.5, interval=0.1, recorder=r,
+                              world_size=1, dump_dir='/tmp').start()
+        try:
+            for g in range(6):
+                r.heartbeat()
+                with r.span('all_reduce', gseq=g):
+                    pass
+                time.sleep(0.1)
+            assert not dog.fired.is_set()
+        finally:
+            dog.stop()
+
+    def test_daemonized_and_stop_idempotent(self):
+        dog = fr.HangWatchdog(timeout=30, interval=0.1,
+                              recorder=fr.FlightRecorder(8),
+                              world_size=1).start()
+        assert dog._thread.daemon
+        dog.stop()
+        assert dog._thread is None
+        dog.stop()                      # idempotent
+
+    def test_published_dump_bounded_under_store_cap(self):
+        """The cross-rank copy must fit the TCPStore 1 MiB get cap (a
+        truncated JSON would make a HEALTHY rank look dead to peers):
+        stacks stay local-only, the journal tail shrinks to fit."""
+        r = fr.FlightRecorder(capacity=512, rank=0)
+        blob = 'x' * 4000
+        for g in range(512):
+            with r.span(f'all_reduce_{blob}', gseq=g):
+                pass
+        local = r.dump()
+        local['stacks'] = fr._thread_stacks()
+        data = fr.HangWatchdog._publish_payload(local)
+        assert len(data) <= 900_000
+        doc = json.loads(data.decode())
+        assert 'stacks' not in doc
+        assert doc['last_completed_gseq'] == 511
+        assert doc['entries'][-1]['gseq'] == 511
+
+    def test_start_watchdog_env_gated_singleton(self, monkeypatch):
+        fr.stop_watchdog()
+        monkeypatch.delenv('PADDLE_HANG_TIMEOUT', raising=False)
+        assert fr.start_watchdog() is None
+        monkeypatch.setenv('PADDLE_HANG_TIMEOUT', '30')
+        dog = fr.start_watchdog()
+        try:
+            assert dog is not None and dog.timeout == 30.0
+            assert fr.start_watchdog() is dog     # singleton
+        finally:
+            fr.stop_watchdog()
+
+
+# ---------------------------------------------------------------------------
+# watchdog — true 2-rank forced hang (ISSUE 2 acceptance scenario)
+# ---------------------------------------------------------------------------
+class TestWatchdogCrossRank:
+    def test_forced_hang_produces_cross_rank_report(self, tmp_path):
+        """Rank 1 goes silent before the 4th all_reduce; both ranks'
+        watchdogs dump via the TCPStore and the combined report names
+        the last completed and first missing collective seq per rank."""
+        s = socket.socket()
+        s.bind(('127.0.0.1', 0))
+        port = s.getsockname()[1] - 7     # host backend adds +7
+        s.close()
+        procs = []
+        for rank in range(2):
+            env = dict(os.environ)
+            env.update({
+                'PADDLE_TRAINER_ID': str(rank),
+                'PADDLE_TRAINERS_NUM': '2',
+                'PADDLE_MASTER': f'127.0.0.1:{port}',
+                'JAX_PLATFORMS': 'cpu',
+                'FLIGHT_DUMP_DIR': str(tmp_path),
+            })
+            env.pop('XLA_FLAGS', None)
+            procs.append(subprocess.Popen(
+                [sys.executable, '-u',
+                 os.path.join(HERE, 'dist_models',
+                              'dist_flight_recorder.py')],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True))
+        outs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=120)
+            outs.append(out)
+            assert p.returncode == 3, \
+                f"expected watchdog abort (3), got {p.returncode}: {out}"
+        rep_path = [f for f in os.listdir(tmp_path)
+                    if f.startswith('flight_recorder.rank0')]
+        assert rep_path, (os.listdir(tmp_path), outs)
+        with open(os.path.join(tmp_path, rep_path[0])) as f:
+            rep = json.load(f)
+        ana = rep['analysis']
+        # rank 0 entered gseq=3 and is blocked; rank 1 never arrived
+        assert rep['ranks']['0']['first_incomplete_gseq'] == 3
+        assert rep['ranks']['0']['first_incomplete_op'] == 'all_reduce'
+        assert rep['ranks']['1'] is not None, \
+            "rank 1's journal missing from the cross-rank dump"
+        assert rep['ranks']['1']['last_completed_gseq'] == 2
+        assert ana['stalled_ranks'] == [1]
+        assert any('rank 1 never entered all_reduce gseq=3' in s
+                   for s in ana['summary']), ana['summary']
+        # both ranks' journals carry the 3 completed lockstep collectives
+        for rk in ('0', '1'):
+            done = [e for e in rep['ranks'][rk]['entries']
+                    if e['gseq'] is not None and e['t_complete']]
+            assert {e['gseq'] for e in done} >= {0, 1, 2}
+
+
+# ---------------------------------------------------------------------------
+# memory forensics
+# ---------------------------------------------------------------------------
+class TestMemoryAccountant:
+    def test_phase_census_tracks_live_buffers_and_delta(self):
+        import jax.numpy as jnp
+        mem.reset()
+        with mem.phase('engine.init'):          # census phase
+            keep = jnp.ones((64, 64), jnp.float32) * 2
+            float(keep.sum())                   # materialize
+        ph = mem.accountant().phases()['engine.init']
+        assert ph['calls'] == 1
+        assert ph['live_buffers'] >= 1
+        assert ph['high_water'] >= ph['bytes_exit'] > 0
+        tl = mem.accountant().timeline()
+        assert tl[-1]['phase'] == 'engine.init'
+        del keep
+
+    def test_oom_report_structure_and_suspect(self):
+        import jax.numpy as jnp
+        mem.reset()
+        with mem.phase('pipeline.build', census=True):
+            keep = jnp.ones((128, 128), jnp.float32) + 1
+            float(keep.sum())
+        rep = mem.oom_report(RuntimeError('RESOURCE_EXHAUSTED: boom'))
+        assert rep['kind'] == 'oom_report'
+        assert rep['suspect_phase'] == 'pipeline.build'
+        assert rep['live_buffer_count'] >= 1
+        assert rep['top_buffers'][0]['bytes'] > 0
+        text = mem.render_oom_report(rep)
+        assert 'suspect phase: pipeline.build' in text
+        assert 'top live buffers' in text
+        del keep
+
+    def test_oom_guard_converts_resource_exhausted(self, tmp_path):
+        mem.reset()
+        path = str(tmp_path / 'oom.json')
+        with pytest.raises(mem.DeviceOOMError) as ei:
+            with mem.oom_guard('test.site', report_path=path):
+                raise RuntimeError(
+                    'RESOURCE_EXHAUSTED: Out of memory allocating '
+                    '8589934592 bytes')
+        err = ei.value
+        assert err.report['site'] == 'test.site'
+        assert 'device OOM report' in str(err)
+        assert os.path.exists(path)
+        with open(path) as f:
+            assert json.load(f)['kind'] == 'oom_report'
+
+    def test_oom_guard_passes_other_errors_through(self):
+        with pytest.raises(ValueError):
+            with mem.oom_guard('test.site'):
+                raise ValueError('not an oom')
+
+    def test_is_oom_error(self):
+        assert mem.is_oom_error(RuntimeError('RESOURCE_EXHAUSTED: x'))
+        assert not mem.is_oom_error(RuntimeError('bad shape'))
+        assert not mem.is_oom_error(None)
+
+
+class TestEngineShutdown:
+    def test_hybrid_engine_shutdown_releases_buffers(self):
+        import jax
+        from paddle_tpu import nn
+        from paddle_tpu.core.tensor import Tensor
+        from paddle_tpu.distributed import topology_runtime
+        from paddle_tpu.distributed.fleet.meta_parallel.hybrid_engine \
+            import HybridParallelTrainStep
+
+        topology_runtime.build_mesh(['dp'], [1])
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(32, 64), nn.ReLU(),
+                            nn.Linear(64, 1))
+        opt = paddle.optimizer.Adam(parameters=net.parameters())
+
+        def loss_fn(m, x, y):
+            d = m(x) - y
+            return (d * d).mean()
+
+        eng = HybridParallelTrainStep(net, loss_fn, opt)
+        rng = np.random.RandomState(0)
+        x = Tensor(rng.rand(8, 32).astype('float32'))
+        y = Tensor(rng.rand(8, 1).astype('float32'))
+        float(eng(x, y))
+        before = len(jax.live_arrays())
+        sample = eng.shutdown()
+        after = len(jax.live_arrays())
+        assert after < before, (before, after)
+        assert sample['live_buffers'] == after
+        assert eng._params is None and eng._compiled is None
+        # idempotent + closed-engine guards
+        eng.shutdown()
+        with pytest.raises(RuntimeError, match='shut down'):
+            eng(x, y)
+        with pytest.raises(RuntimeError, match='shut down'):
+            eng.sync_model()
+        ph = mem.accountant().phases()
+        assert 'engine.shutdown' in ph
+        # teardown disarms the step heartbeat (no false hang after a
+        # deliberate stop) and stops the env-gated watchdog
+        assert fr.recorder().last_beat() is None
+
+    def test_pipeline_engine_shutdown(self):
+        import jax
+        from paddle_tpu import nn
+        from paddle_tpu.core.tensor import Tensor
+        from paddle_tpu.distributed import topology_runtime
+        from paddle_tpu.distributed.fleet.meta_parallel.spmd_pipeline \
+            import SpmdPipelineEngine
+
+        topology_runtime.build_mesh(['dp', 'pp'], [1, 1])
+        paddle.seed(0)
+        H, V = 16, 11
+
+        class Embed(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.emb = nn.Embedding(V, H)
+
+            def forward(self, ids):
+                return self.emb(ids)
+
+        class Head(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.proj = nn.Linear(H, V)
+
+            def forward(self, h, labels):
+                logits = self.proj(h)
+                return nn.functional.cross_entropy(
+                    logits.reshape([-1, V]), labels.reshape([-1])).mean()
+
+        blocks = [nn.Linear(H, H) for _ in range(2)]
+        embed, head = Embed(), Head()
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[])
+        eng = SpmdPipelineEngine(embed, blocks, head, opt,
+                                 accumulate_steps=2)
+        rng = np.random.RandomState(0)
+        ids = Tensor(rng.randint(0, V, (4, 6)).astype('int32'))
+        labels = Tensor(rng.randint(0, V, (4, 6)).astype('int64'))
+        float(eng.train_batch((ids, labels)).data)
+        before = len(jax.live_arrays())
+        eng.shutdown()
+        assert len(jax.live_arrays()) < before
+        with pytest.raises(RuntimeError, match='shut down'):
+            eng.train_batch((ids, labels))
+        with pytest.raises(RuntimeError, match='shut down'):
+            eng.sync_model()
+
+
+# ---------------------------------------------------------------------------
+# structured JSON-lines logging
+# ---------------------------------------------------------------------------
+class TestJsonLog:
+    def test_schema_round_trip_with_rank_role_step(self, tmp_path,
+                                                   monkeypatch):
+        monkeypatch.setenv('FLEET_LOG_DIR', str(tmp_path))
+        monkeypatch.setenv('PADDLE_TRAINER_ID', '5')
+        log_util.configure(force=True)
+        try:
+            log_util.set_role('trainer')
+            log_util.set_step(42)
+            log_util.log_json('step_done', level='info', loss=0.5,
+                              tokens=1024, shape=(2, 3))
+            log_util.set_step(None)
+            path = tmp_path / 'workerlog.5.jsonl'
+            assert path.exists()
+            lines = path.read_text().strip().splitlines()
+            doc = log_util.parse_line(lines[-1])
+            assert doc['event'] == 'step_done'
+            assert doc['rank'] == 5
+            assert doc['role'] == 'trainer'
+            assert doc['step'] == 42
+            assert doc['level'] == 'INFO'
+            assert doc['fields']['loss'] == 0.5
+            assert doc['fields']['tokens'] == 1024
+            # non-JSON-able values are repr'd, never dropped
+            assert doc['fields']['shape'] in ([2, 3], '(2, 3)')
+            assert isinstance(doc['ts'], float) and 'iso' in doc
+        finally:
+            log_util.configure(force=True)
+
+    def test_child_logger_keeps_rank_role_step(self, tmp_path,
+                                               monkeypatch):
+        """log_json(..., logger_name=...) routes through a CHILD logger;
+        the rank/role/step context must survive propagation (filters on
+        handlers, not the parent logger)."""
+        monkeypatch.setenv('FLEET_LOG_DIR', str(tmp_path))
+        monkeypatch.setenv('PADDLE_TRAINER_ID', '7')
+        log_util.configure(force=True)
+        try:
+            log_util.set_step(9)
+            log_util.log_json('child_event', logger_name='elastic', x=1)
+            log_util.set_step(None)
+            lines = (tmp_path / 'workerlog.7.jsonl').read_text() \
+                .strip().splitlines()
+            doc = log_util.parse_line(lines[-1])
+            assert doc['rank'] == 7
+            assert doc['step'] == 9
+            assert doc['logger'].endswith('elastic')
+        finally:
+            log_util.configure(force=True)
+
+    def test_parse_line_rejects_garbage(self):
+        with pytest.raises(ValueError):
+            log_util.parse_line('{"no_msg": 1}')
+        with pytest.raises(ValueError):
+            log_util.parse_line('not json')
+
+    def test_level_env_filtering(self, tmp_path, monkeypatch):
+        monkeypatch.setenv('FLEET_LOG_DIR', str(tmp_path))
+        monkeypatch.setenv('FLEET_LOG_LEVEL', 'ERROR')
+        monkeypatch.setenv('PADDLE_TRAINER_ID', '0')
+        log_util.configure(force=True)
+        try:
+            log_util.log_json('quiet', level='info')
+            log_util.log_json('loud', level='error')
+            text = (tmp_path / 'workerlog.0.jsonl').read_text()
+            assert 'loud' in text and 'quiet' not in text
+        finally:
+            log_util.configure(force=True)
+
+    def test_layer_to_str_kept(self):
+        assert log_util.layer_to_str('Linear', 4, 8, bias=True) == \
+            'Linear(4, 8, bias=True)'
+
+
+# ---------------------------------------------------------------------------
+# health_dump CLI
+# ---------------------------------------------------------------------------
+class TestHealthDumpCli:
+    def test_renders_hang_and_oom_artifacts(self, tmp_path):
+        sys.path.insert(0, os.path.join(os.path.dirname(HERE), 'tools'))
+        import health_dump
+
+        r = fr.FlightRecorder(capacity=8, rank=0)
+        with r.span('all_reduce', gseq=0):
+            pass
+        p1 = tmp_path / 'dump.json'
+        p1.write_text(json.dumps(r.dump()))
+        out = health_dump.render(json.loads(p1.read_text()))
+        assert 'flight recorder' in out
+
+        mem.reset()
+        p2 = tmp_path / 'oom.json'
+        p2.write_text(json.dumps(mem.oom_report(
+            RuntimeError('RESOURCE_EXHAUSTED'))))
+        out = health_dump.render(json.loads(p2.read_text()))
+        assert 'device OOM report' in out
+
+        with pytest.raises(ValueError):
+            health_dump.render({'something': 'else'})

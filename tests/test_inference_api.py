@@ -115,16 +115,31 @@ def test_inplace_functional_rebinds_input():
     np.testing.assert_allclose(np.asarray(x3.data).sum(), 1.0, rtol=1e-6)
 
 
-def test_unique_name_guard_merges_high_water():
-    """ADVICE r4: names minted after a guard() must not collide with
-    names minted inside it (global-scope alias footgun)."""
+def test_unique_name_guard_exact_restore_and_optin_merge():
+    """ADVICE r5: guard() restores counters EXACTLY (reference
+    semantics — checkpoint-name parity for programs built after a
+    guard); the r4 anti-aliasing high-water merge is opt-in."""
     from paddle_tpu.utils import unique_name
+    before = unique_name.generate('advtest_param')
     inside = []
     with unique_name.guard():
         inside.append(unique_name.generate('advtest_param'))
         inside.append(unique_name.generate('advtest_param'))
     after = unique_name.generate('advtest_param')
-    assert after not in inside
+    # exact restore: the post-guard name continues the pre-guard
+    # sequence as if the guard never ran (and thus repeats a guarded
+    # name — the documented alias tradeoff)
+    b_n = int(before.rsplit('_', 1)[1])
+    assert after == before.replace(f'_{b_n}', f'_{b_n + 1}')
+    assert after in inside
+
+    merged = []
+    with unique_name.guard(merge_high_water=True):
+        merged.append(unique_name.generate('advtest_param'))
+        merged.append(unique_name.generate('advtest_param'))
+        merged.append(unique_name.generate('advtest_param'))
+    after2 = unique_name.generate('advtest_param')
+    assert after2 not in merged
 
 
 def test_inplace_leaf_raises_under_autograd():

@@ -1,0 +1,201 @@
+#!/usr/bin/env python
+"""health_dump — render paddle_tpu diagnostics artifacts.
+
+Reads any of the JSON artifacts the diagnostics layer writes and prints
+the human post-mortem:
+
+  * hang reports (`flight_recorder.rank*.json` from the HangWatchdog):
+    cross-rank journal frontier, per-rank last-completed / first-missing
+    collective seq, stalled-rank verdict, recent journal tail;
+  * bare per-rank flight-recorder dumps (`FlightRecorder.dump()`);
+  * OOM reports (`oom_report.rank*.json` from core.memory.oom_guard):
+    per-phase high-water table, top live buffers with origin phases,
+    suspect phase;
+  * rank-aware JSON-lines logs (`workerlog.<rank>.jsonl`): pretty-print
+    the last events, filterable with --level.
+
+Usage:
+    python tools/health_dump.py ARTIFACT.json [--json] [--level ERROR]
+    python tools/health_dump.py --selftest     # CI smoke
+"""
+import argparse
+import json
+import os
+import sys
+
+
+def _repo_root_on_path():
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def classify(doc):
+    if isinstance(doc, dict):
+        kind = doc.get('kind')
+        if kind in ('hang_report', 'flight_recorder', 'oom_report'):
+            return kind
+        if 'entries' in doc and 'seq' in doc:
+            return 'flight_recorder'
+        if 'ranks' in doc and 'analysis' in doc:
+            return 'hang_report'
+        if 'top_buffers' in doc or 'phases' in doc:
+            return 'oom_report'
+    return None
+
+
+def render(doc):
+    _repo_root_on_path()
+    kind = classify(doc)
+    if kind in ('hang_report', 'flight_recorder'):
+        from paddle_tpu.distributed.flight_recorder import render_dump
+        return render_dump(doc)
+    if kind == 'oom_report':
+        from paddle_tpu.core.memory import render_oom_report
+        return render_oom_report(doc)
+    raise ValueError(
+        "unrecognized artifact: expected a hang report, flight-recorder "
+        "dump, or OOM report (see docs/observability.md#diagnostics)")
+
+
+def render_log(path, level=None, tail=50):
+    _repo_root_on_path()
+    from paddle_tpu.distributed.fleet.utils.log_util import parse_line
+    want = level.upper() if level else None
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                doc = parse_line(line)
+            except ValueError:
+                continue
+            if want and doc.get('level') != want:
+                continue
+            rows.append(doc)
+    out = []
+    for d in rows[-tail:]:
+        fields = d.get('fields') or {}
+        out.append(
+            f"{d.get('iso', '?')} {d.get('level', '?'):<7} "
+            f"rank{d.get('rank')}/{d.get('role')} "
+            + (f"step={d.get('step')} " if d.get('step') is not None
+               else '')
+            + (f"[{d['event']}] " if d.get('event') else '')
+            + str(d.get('msg', ''))
+            + (' ' + ' '.join(f'{k}={v}' for k, v in fields.items())
+               if fields else ''))
+    return '\n'.join(out) if out else '(no matching log lines)'
+
+
+# ---------------------------------------------------------------------------
+def _selftest():
+    """CI smoke: drive the REAL recorder/accountant APIs end to end —
+    journal a hang scenario, synthesize an OOM, write JSON logs — and
+    assert each artifact renders with the load-bearing facts."""
+    import tempfile
+    _repo_root_on_path()
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    from paddle_tpu.distributed import flight_recorder as fr
+    from paddle_tpu.core import memory as mem
+    from paddle_tpu.distributed.fleet.utils import log_util
+
+    # -- hang report: rank 0 blocked in gseq=3, rank 1 never entered it
+    r0 = fr.FlightRecorder(capacity=4, rank=0)
+    r1 = fr.FlightRecorder(capacity=4, rank=1)
+    for g in range(3):
+        for r in (r0, r1):
+            with r.span('all_reduce', gseq=g, nbytes=64):
+                pass
+    r0.record_enqueue('all_reduce', gseq=3, nbytes=64)   # never completes
+    dumps = {0: r0.dump(), 1: r1.dump()}
+    ana = fr.analyze(dumps)
+    assert ana['frontier_gseq'] == 3, ana
+    assert ana['stalled_ranks'] == [1], ana
+    assert any('rank 1 never entered all_reduce gseq=3' in s
+               for s in ana['summary']), ana['summary']
+    report = {'kind': 'hang_report', 'reason': 'selftest',
+              'ranks': {str(k): v for k, v in dumps.items()},
+              'analysis': ana}
+    text = render(report)
+    assert 'never entered all_reduce gseq=3' in text
+    assert 'PENDING' in text
+
+    # ring wraparound is visible in the dump (capacity 4, 4 entries kept)
+    assert len(dumps[0]['entries']) == 4 and dumps[0]['dropped'] == 0
+    for g in range(10):
+        with r1.span('barrier', gseq=4 + g):
+            pass
+    d1 = r1.dump()
+    assert len(d1['entries']) == 4 and d1['dropped'] > 0
+
+    with tempfile.TemporaryDirectory() as td:
+        # -- OOM report from a synthetic RESOURCE_EXHAUSTED
+        mem.reset()
+        import jax.numpy as jnp
+        with mem.phase('engine.init', census=True):
+            keep = jnp.ones((256, 256), jnp.float32)
+        try:
+            with mem.oom_guard('selftest.site',
+                               report_path=os.path.join(td, 'oom.json')):
+                raise RuntimeError(
+                    'RESOURCE_EXHAUSTED: Out of memory allocating '
+                    '8589934592 bytes')
+        except mem.DeviceOOMError as e:
+            oom = e.report
+            assert oom['suspect_phase'] == 'engine.init', oom
+            assert oom['top_buffers'], oom
+        else:
+            raise AssertionError('oom_guard did not convert the error')
+        with open(os.path.join(td, 'oom.json')) as f:
+            text = render(json.load(f))
+        assert 'suspect phase: engine.init' in text, text
+        assert 'RESOURCE_EXHAUSTED' in (oom['error'] or ''), oom
+        del keep
+
+        # -- JSON-lines log round trip through the renderer
+        os.environ['FLEET_LOG_DIR'] = td
+        try:
+            log_util.configure(force=True)
+            log_util.log_json('selftest_event', level='error',
+                              step_ms=12.5)
+            log_path = os.path.join(
+                td, f"workerlog."
+                f"{os.environ.get('PADDLE_TRAINER_ID', '0') or 0}.jsonl")
+            assert os.path.exists(log_path), os.listdir(td)
+            rendered = render_log(log_path, level='error')
+            assert 'selftest_event' in rendered, rendered
+        finally:
+            os.environ.pop('FLEET_LOG_DIR', None)
+            log_util.configure(force=True)
+    print('health_dump selftest: OK')
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument('artifact', nargs='?',
+                    help='hang/OOM report JSON or workerlog .jsonl')
+    ap.add_argument('--json', action='store_true',
+                    help='echo the parsed artifact as JSON')
+    ap.add_argument('--level', default=None,
+                    help='level filter for .jsonl logs (e.g. ERROR)')
+    ap.add_argument('--selftest', action='store_true',
+                    help='exercise recorder/accountant/logs end to end')
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return _selftest()
+    if not args.artifact:
+        ap.error('artifact path required (or --selftest)')
+    if args.artifact.endswith('.jsonl'):
+        print(render_log(args.artifact, level=args.level))
+        return 0
+    with open(args.artifact) as f:
+        doc = json.load(f)
+    print(json.dumps(doc, indent=2) if args.json else render(doc))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
