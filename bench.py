@@ -504,15 +504,25 @@ _LEG_SENTINEL = 'LEG_RESULT:'
 
 def _attach_telemetry(r):
     """Per-leg compile/device-memory telemetry (each leg is its own
-    process now, so the numbers are leg-scoped, not accumulated)."""
+    process now, so the numbers are leg-scoped, not accumulated).
+    With BENCH_NUMERICS=1 the numerics sub-dict carries real grad-norm
+    and nonfinite-count numbers (stat taps add one host sync per step,
+    so the flag is off for headline measurements)."""
     try:
         from paddle_tpu.profiler import StepTelemetry
         snap = StepTelemetry(publish=False).snapshot()
+        numerics = snap.get('numerics') or {}
         r['telemetry'] = {
             'compile_seconds_total': round(snap['compile_seconds_total'],
                                            2),
             'compiles_total': int(snap['compiles_total']),
             'device_memory': snap['device_memory'],
+            'numerics': {
+                'grad_norm_global': numerics.get('grad_norm_global'),
+                'nonfinite_total': numerics.get('nonfinite_total'),
+                'nonfinite_steps': numerics.get('nonfinite_steps'),
+                'amp_skipped_steps': numerics.get('amp_skipped_steps'),
+            },
         }
     except Exception as e:
         r['telemetry'] = {'error': repr(e)[:200]}
@@ -521,6 +531,11 @@ def _attach_telemetry(r):
 
 def run_leg(name):
     """Child entry: run one leg, print its JSON on a sentinel line."""
+    if os.environ.get('BENCH_NUMERICS') == '1':
+        # opt-in: thread numerics taps through the leg's compiled steps
+        # so the record carries per-leg grad-norm / nonfinite telemetry
+        from paddle_tpu.core import flags as _flags
+        _flags.set_flags({'FLAGS_tensor_stats': True})
     r = _attach_telemetry(_retry(LEGS[name]))
     print(_LEG_SENTINEL + json.dumps(r), flush=True)
 

@@ -123,30 +123,62 @@ class GradScaler:
         return M.scale(var, self._scale)
 
     def unscale_(self, optimizer):
-        """Parity: check_finite_and_unscale (operators/amp/...cc:138)."""
+        """Parity: check_finite_and_unscale (operators/amp/...cc:138).
+
+        The finite check is fused device-side: every grad contributes
+        one `any(~isfinite)` scalar, the scalars reduce on device, and
+        a SINGLE host sync reads the verdict (the seed synced once per
+        parameter — a per-step latency cliff at transformer param
+        counts)."""
         if not self._enable or self._unscaled:
             return
         params = optimizer._parameter_list or []
-        found = False
         inv = 1.0 / self._scale
+        flags = []
         for p in params:
             if p.grad is None:
                 continue
             g = p.grad.data.astype(jnp.float32) * inv
-            found = found | bool(jnp.any(~jnp.isfinite(g)))
+            flags.append(jnp.any(~jnp.isfinite(g)))
             p.grad.data = g.astype(p.grad.dtype)
-        self._found_inf = bool(found)
+        self._found_inf = bool(jnp.any(jnp.stack(flags))) if flags \
+            else False
         self._unscaled = True
+
+    def _publish_metrics(self, skipped):
+        from ..core import monitor as _m
+        _m.counter('ptpu_amp_steps_total',
+                   help='GradScaler.step() calls').inc(1)
+        if skipped:
+            _m.counter('ptpu_amp_skipped_steps_total',
+                       help='optimizer updates skipped on nonfinite '
+                            'gradients').inc(1)
+        _m.gauge('ptpu_amp_loss_scale',
+                 help='current dynamic loss scale').set(self._scale)
 
     def step(self, optimizer):
         if not self._enable:
             optimizer.step()
             return
-        self.unscale_(optimizer)
-        if not self._found_inf:
-            optimizer.step()
-        self._update()
-        self._unscaled = False
+        try:
+            self.unscale_(optimizer)
+            if not self._found_inf:
+                optimizer.step()
+            else:
+                # skipped update: optimizer.step() never runs, so the
+                # eager numerics guard's step boundary never flushes —
+                # drop the (deliberately survived) overflow's flag and
+                # journal here, or the NEXT clean step would raise for
+                # THIS one
+                from ..core import numerics as _numerics
+                _numerics.guard().reset()
+            self._update()
+            self._publish_metrics(self._found_inf)
+        finally:
+            # always re-arm: a NumericsError escaping optimizer.step()
+            # must not leave _unscaled latched True, or every later
+            # step would skip unscale_ and apply still-scaled grads
+            self._unscaled = False
 
     def minimize(self, optimizer, scaled_loss):
         self.step(optimizer)
@@ -173,17 +205,41 @@ class GradScaler:
                 self._good_steps = 0
 
     def state_dict(self):
+        """Parity: paddle.amp.GradScaler.state_dict — the loss-scale
+        schedule state a checkpoint must carry (losing it on restore
+        resets the scale to init and replays the warm-up overflows).
+        Uses paddle's incr_count/decr_count key names; good_steps/
+        bad_steps are kept as aliases for older checkpoints."""
         return {'scale': self._scale, 'incr_ratio': self._incr_ratio,
                 'decr_ratio': self._decr_ratio,
                 'incr_every_n_steps': self._incr_every_n,
                 'decr_every_n_nan_or_inf': self._decr_every_n,
+                'incr_count': self._good_steps,
+                'decr_count': self._bad_steps,
                 'good_steps': self._good_steps, 'bad_steps': self._bad_steps,
-                'use_dynamic_loss_scaling': self._dynamic}
+                'use_dynamic_loss_scaling': self._dynamic,
+                'enable': self._enable}
 
     def set_state_dict(self, sd):
-        self._scale = sd.get('scale', self._scale)
-        self._good_steps = sd.get('good_steps', 0)
-        self._bad_steps = sd.get('bad_steps', 0)
+        self._scale = float(sd.get('scale', self._scale))
+        self._incr_ratio = float(sd.get('incr_ratio', self._incr_ratio))
+        self._decr_ratio = float(sd.get('decr_ratio', self._decr_ratio))
+        self._incr_every_n = int(sd.get('incr_every_n_steps',
+                                        self._incr_every_n))
+        self._decr_every_n = int(sd.get('decr_every_n_nan_or_inf',
+                                        self._decr_every_n))
+        self._good_steps = int(sd.get('incr_count',
+                                      sd.get('good_steps', 0)))
+        self._bad_steps = int(sd.get('decr_count', sd.get('bad_steps', 0)))
+        self._dynamic = bool(sd.get('use_dynamic_loss_scaling',
+                                    self._dynamic))
+        # 'enable' is saved for inspection only and deliberately NOT
+        # restored: silently disabling loss scaling on an enabled
+        # scaler (checkpoint from a debug run) would apply unscaled
+        # fp16 grads with no overflow skipping
+
+    # torch-style alias (paddle 2.x accepts both spellings in hapi)
+    load_state_dict = set_state_dict
 
 
 def decorate(models=None, optimizers=None, level='O2', dtype='bfloat16',

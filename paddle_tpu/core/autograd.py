@@ -418,17 +418,17 @@ def run_op(name, fn, tensor_args, static_kwargs=None, n_nondiff=0):
     multi = isinstance(out, (tuple, list))
     outs = list(out) if multi else [out]
 
-    # FLAGS_check_nan_inf: post-kernel scan (parity:
-    # details/nan_inf_utils_detail.cc:299 behind flags.cc:44), eager only.
+    # FLAGS_check_nan_inf: post-kernel guard (parity:
+    # details/nan_inf_utils_detail.cc:299 behind flags.cc:44), eager
+    # only — jit coverage comes from the engines' numerics taps. The
+    # observatory fuses the per-output scans into one device flag and
+    # (with FLAGS_check_nan_inf_deferred) defers the host sync to the
+    # step boundary with replay-based localization (core/numerics.py).
     from .flags import flag as _flag
     if _flag('FLAGS_check_nan_inf') and \
             not isinstance(outs[0], jax.core.Tracer):
-        for i, o in enumerate(outs):
-            if dtypes.is_floating(getattr(o, 'dtype', None) or o.dtype) and \
-                    bool(jnp.any(~jnp.isfinite(o))):
-                raise FloatingPointError(
-                    f"NaN or Inf found in output {i} of op '{name}' "
-                    "(FLAGS_check_nan_inf)")
+        from . import numerics as _num
+        _num.guard().observe(name, fn, static_kwargs, arrs, outs)
 
     out_tensors = [Tensor(o, stop_gradient=not trace) for o in outs]
 

@@ -8,6 +8,19 @@ import os
 
 _FLAGS = {
     'FLAGS_check_nan_inf': False,
+    # numerics observatory (core/numerics.py): defer the NaN/Inf sync to
+    # the step boundary (optimizer.step / numerics.flush) — device-side
+    # flag accumulation + ONE host sync per step, with replay-based op
+    # localization on a trip. Off = legacy raise-at-the-op semantics.
+    'FLAGS_check_nan_inf_deferred': False,
+    # ops kept in the eager replay journal per step (memory bound of the
+    # deferred mode; the oldest ops drop first)
+    'FLAGS_check_nan_inf_max_journal': 4096,
+    # always-on tensor stats: compiled train steps thread grad/param
+    # stat taps as extra outputs and publish ptpu_num_* gauges; the
+    # eager optimizer publishes the same from .grad (one extra host
+    # sync per step either way)
+    'FLAGS_tensor_stats': False,
     'FLAGS_cudnn_deterministic': True,   # XLA is deterministic by default
     'FLAGS_allocator_strategy': 'pjrt',
     'FLAGS_fraction_of_gpu_memory_to_use': 0.92,

@@ -1,0 +1,848 @@
+"""Numerics observatory — fused tensor stats, NaN/Inf localization,
+cross-rank divergence sentinel.
+
+Reference parity role: the `FLAGS_check_nan_inf` debugger
+(framework/details/nan_inf_utils_detail.cc:299 — per-kernel tensor scan
+naming the offending op) plus the tensor-stat printing of
+`check_numerics` tooling, redesigned for TPU execution where a blocking
+host sync per op output (the seed's eager guard, core/autograd.py) is
+the one thing a production step cannot afford and where the hot path is
+a single compiled XLA program the eager guard never sees.
+
+Three layers:
+
+  * **Fused `TensorStats`** — one reduction pass per tensor producing a
+    fixed `float32[N_STATS]` vector (nonfinite/zero/subnormal counts,
+    finite min/max/mean/rms, l2 norm, numel). `stats_vec` is traceable
+    (used as jit taps inside compiled steps); `collect()` batches any
+    number of tensors into ONE host sync.
+  * **Eager guard** — `FLAGS_check_nan_inf` rewritten on device-side
+    flag accumulation: each op ORs a tiny `any(~isfinite)` scalar into a
+    running device flag and journals `(op, fn, inputs)`; `flush()` (the
+    optimizer step boundary) performs the single host sync, and only on
+    a trip replays the journal to localize the FIRST op that produced a
+    nonfinite output from finite inputs — raised as a structured
+    `NumericsError` with a JSON artifact (the `DeviceOOMError` report
+    shape from core/memory.py). `FLAGS_check_nan_inf_deferred=1` opts
+    into the one-sync-per-step mode; the default keeps the legacy
+    raise-at-the-op semantics (one FUSED flag sync per op instead of
+    the seed's one per output, now with full stats in the report).
+  * **Jit taps + divergence sentinel** — compiled train steps
+    (hybrid_engine / spmd_pipeline / jit.TrainStep) thread a stats
+    pytree as extra outputs; `process_jit_taps()` fetches it in one
+    sync, publishes `ptpu_num_*` gauges, and raises on nonfinite grads
+    naming the offending parameter. `DivergenceSentinel` allgathers a
+    per-step fingerprint (grad global-norm + param checksum) across
+    data-parallel ranks and reports the first divergent step and the
+    offending ranks through log_util + the flight recorder.
+"""
+import contextlib
+import functools
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import dtypes
+
+__all__ = [
+    'N_STATS', 'STAT_FIELDS', 'TensorStats', 'stats_vec', 'tensor_stats',
+    'collect', 'NumericsError', 'guard', 'flush', 'reset', 'step_guard',
+    'jit_taps', 'taps_spec', 'process_jit_taps', 'publish_stats',
+    'DivergenceSentinel', 'render_numerics_report',
+    'render_divergence_report', 'write_report', 'enabled', 'taps_enabled',
+]
+
+# ---------------------------------------------------------------------------
+# fused tensor statistics
+# ---------------------------------------------------------------------------
+STAT_FIELDS = ('nan_count', 'inf_count', 'zero_count', 'subnormal_count',
+               'min', 'max', 'mean', 'rms', 'l2_norm', 'numel')
+N_STATS = len(STAT_FIELDS)
+
+
+def stats_vec(x):
+    """Traceable fused reduction: `float32[N_STATS]` for one array.
+
+    Counts are exact up to 2**24 elements (float32 integer range —
+    beyond that they saturate in ULPs, which still distinguishes zero
+    from nonzero, the decision the guards make). min/max/mean/rms/l2
+    are over the FINITE elements so one NaN doesn't erase the rest of
+    the distribution; the nonfinite population is reported by its own
+    counters. Empty tensors produce (0,...,+inf,-inf,0,0,0,0).
+    """
+    x = jnp.asarray(x)
+    n = int(np.prod(x.shape)) if x.ndim else 1
+    if n == 0:
+        return jnp.asarray([0, 0, 0, 0, np.inf, -np.inf, 0, 0, 0, 0],
+                           jnp.float32)
+    if dtypes.is_floating(x.dtype):
+        # jnp.finfo (ml_dtypes-backed) also understands bfloat16
+        tiny = float(jnp.finfo(x.dtype).tiny)
+    else:
+        tiny = 0.0
+    x32 = x.astype(jnp.float32)
+    isnan = jnp.isnan(x32)
+    isinf = jnp.isinf(x32)
+    finite = ~(isnan | isinf)
+    f32 = jnp.float32
+    nan_c = jnp.sum(isnan, dtype=f32)
+    inf_c = jnp.sum(isinf, dtype=f32)
+    ax = jnp.abs(x32)
+    if tiny:
+        # zero derived as (|x| < tiny) - subnormals: XLA backends with
+        # FTZ/DAZ semantics may compare a subnormal equal to zero, which
+        # would otherwise double-count it in both buckets
+        sub_c = jnp.sum((ax > 0) & (ax < tiny), dtype=f32)
+        zero_c = jnp.sum(ax < tiny, dtype=f32) - sub_c
+    else:
+        sub_c = jnp.asarray(0.0, f32)
+        zero_c = jnp.sum(x32 == 0, dtype=f32)
+    fin_n = jnp.maximum(jnp.sum(finite, dtype=f32), 1.0)
+    xf = jnp.where(finite, x32, 0.0)
+    mn = jnp.min(jnp.where(finite, x32, jnp.inf))
+    mx = jnp.max(jnp.where(finite, x32, -jnp.inf))
+    mean = jnp.sum(xf) / fin_n
+    sq = jnp.sum(xf * xf)
+    rms = jnp.sqrt(sq / fin_n)
+    l2 = jnp.sqrt(sq)
+    return jnp.stack([nan_c, inf_c, zero_c, sub_c, mn, mx, mean, rms, l2,
+                      jnp.asarray(float(n), f32)])
+
+
+@functools.lru_cache(maxsize=1)
+def _stats_jit():
+    # one fused XLA kernel per (shape, dtype) signature
+    return jax.jit(stats_vec)
+
+
+class TensorStats:
+    """Host-side view of one stats vector."""
+
+    __slots__ = tuple(STAT_FIELDS) + ('shape', 'dtype')
+
+    def __init__(self, vec, shape=None, dtype=None):
+        vec = np.asarray(vec, np.float64)
+        for i, f in enumerate(STAT_FIELDS):
+            setattr(self, f, float(vec[i]))
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = str(dtype) if dtype is not None else None
+
+    @property
+    def nonfinite_count(self):
+        return self.nan_count + self.inf_count
+
+    def as_dict(self):
+        d = {f: getattr(self, f) for f in STAT_FIELDS}
+        d['shape'] = list(self.shape) if self.shape is not None else None
+        d['dtype'] = self.dtype
+        return d
+
+    def __repr__(self):
+        return (f"TensorStats(nan={int(self.nan_count)} "
+                f"inf={int(self.inf_count)} zero={int(self.zero_count)} "
+                f"sub={int(self.subnormal_count)} min={self.min:.4g} "
+                f"max={self.max:.4g} mean={self.mean:.4g} "
+                f"rms={self.rms:.4g} l2={self.l2_norm:.4g} "
+                f"n={int(self.numel)})")
+
+
+# every host sync the observatory performs funnels through this hook so
+# tests can count them (the "one extra sync per step" budget)
+def _host_fetch(tree):
+    return jax.device_get(tree)
+
+
+def _as_array(x):
+    """Tensor -> its device array; everything else through asarray
+    (NOT getattr(x, 'data'): numpy's .data is a memoryview)."""
+    from .tensor import Tensor
+    if isinstance(x, Tensor):
+        return x.data
+    return jnp.asarray(x)
+
+
+def tensor_stats(x):
+    """Stats for one array/Tensor (one kernel, one sync)."""
+    arr = _as_array(x)
+    return TensorStats(_host_fetch(_stats_jit()(arr)),
+                       shape=arr.shape, dtype=arr.dtype)
+
+
+def collect(named):
+    """{name: array/Tensor} -> {name: TensorStats} — one kernel per
+    tensor dispatched asynchronously, then ONE host sync for the
+    whole batch."""
+    arrs = {k: _as_array(v) for k, v in named.items()}
+    vecs = {k: _stats_jit()(a) for k, a in arrs.items()}
+    host = _host_fetch(vecs)
+    return {k: TensorStats(host[k], shape=arrs[k].shape,
+                           dtype=arrs[k].dtype) for k in arrs}
+
+
+# ---------------------------------------------------------------------------
+# structured error + artifacts
+# ---------------------------------------------------------------------------
+class NumericsError(FloatingPointError):
+    """Nonfinite value caught by the observatory. `.report` holds the
+    JSON-ready artifact (mirrors DeviceOOMError / oom_report);
+    subclasses FloatingPointError for seed-era `except` clauses."""
+
+    def __init__(self, message, report=None, report_path=None):
+        super().__init__(message)
+        self.report = report or {}
+        self.report_path = report_path
+
+
+def _env_rank():
+    try:
+        return int(os.environ.get('PADDLE_TRAINER_ID', '0') or 0)
+    except ValueError:
+        return 0
+
+
+def write_report(report, path=None):
+    """Persist a numerics/divergence artifact under the log dir (the
+    path health_dump renders)."""
+    from .memory import default_report_dir
+    name = ('divergence_report' if report.get('kind') == 'divergence_report'
+            else 'numerics_report')
+    path = path or os.path.join(
+        default_report_dir(),
+        f"{name}.rank{report.get('rank', 0)}.{os.getpid()}.json")
+    try:
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(path, 'w') as f:
+            json.dump(report, f)
+        return path
+    except OSError:
+        return None
+
+
+def _fmt_stats_line(stats):
+    if not stats:
+        return '?'
+    return (f"nan={int(stats.get('nan_count', 0))} "
+            f"inf={int(stats.get('inf_count', 0))} "
+            f"zero={int(stats.get('zero_count', 0))} "
+            f"sub={int(stats.get('subnormal_count', 0))} "
+            f"min={stats.get('min', 0):.4g} max={stats.get('max', 0):.4g} "
+            f"mean={stats.get('mean', 0):.4g} rms={stats.get('rms', 0):.4g} "
+            f"l2={stats.get('l2_norm', 0):.4g}")
+
+
+def render_numerics_report(report):
+    """Human rendering of a numerics_report dict (shared with
+    tools/health_dump.py numerics)."""
+    out = ['== numerics report ' + '=' * 41]
+    out.append(f"site: {report.get('site')}   rank: {report.get('rank')}"
+               + (f"   step: {report.get('step')}"
+                  if report.get('step') is not None else ''))
+    if report.get('op'):
+        o = report.get('output') or {}
+        out.append(f"first nonfinite op: {report['op']} "
+                   f"(output {report.get('output_index', 0)}, "
+                   f"dtype {o.get('dtype')}, shape {tuple(o.get('shape') or ())})")
+        out.append('  output: ' + _fmt_stats_line(o.get('stats')))
+        for i, inp in enumerate(report.get('inputs') or ()):
+            out.append(f"  input[{inp.get('index', i)}] "
+                       f"{inp.get('dtype')} {tuple(inp.get('shape') or ())}: "
+                       + _fmt_stats_line(inp.get('stats')))
+    if report.get('tensors'):
+        out.append('-- nonfinite tensors ' + '-' * 39)
+        for t in report['tensors']:
+            marker = ' <-- first' if t.get('name') == \
+                report.get('first_bad') else ''
+            out.append(f"  {t.get('kind', '?'):<6} {t.get('name')}: "
+                       + _fmt_stats_line(t.get('stats')) + marker)
+    if report.get('journal_dropped'):
+        out.append(f"(journal dropped {report['journal_dropped']} oldest "
+                   "ops — origin may predate the window)")
+    if report.get('message'):
+        out.append(report['message'])
+    return '\n'.join(out)
+
+
+def render_divergence_report(report):
+    out = ['== cross-rank divergence report ' + '=' * 28]
+    out.append(f"first divergent step: {report.get('first_divergent_step')}"
+               f"   detector rank: {report.get('rank')}   world size: "
+               f"{report.get('world_size')}")
+    out.append(f"offending ranks: {report.get('offending_ranks')} "
+               f"(consensus of {report.get('consensus_ranks')})")
+    labels = report.get('fingerprint_labels') or ()
+    out.append('-- per-rank fingerprints ' + '-' * 35)
+    for r, fp in sorted((report.get('ranks') or {}).items(),
+                        key=lambda kv: int(kv[0])):
+        mark = ' <-- divergent' if int(r) in \
+            (report.get('offending_ranks') or ()) else ''
+        pairs = ' '.join(f'{l}={v:.9g}' for l, v in zip(labels, fp))
+        out.append(f"  rank {r}: {pairs}{mark}")
+    return '\n'.join(out)
+
+
+# ---------------------------------------------------------------------------
+# eager guard (FLAGS_check_nan_inf v2)
+# ---------------------------------------------------------------------------
+class EagerNumericsGuard:
+    """Device-side nonfinite-flag accumulation over eager ops.
+
+    `observe()` is the run_op hot path: one fused `any(~isfinite)`
+    scalar per op ORed into a running device flag (no host sync) and a
+    journal entry `(op, fn, kwargs, inputs, out_meta)` kept for replay.
+    `flush()` does the single per-step sync; on a trip the journal is
+    replayed in order (ops are pure jax closures, so the replay is
+    bit-deterministic) and the FIRST op whose output is nonfinite names
+    the origin; its input stats distinguish "op produced the NaN" from
+    "op inherited it".
+    """
+
+    def __init__(self, max_journal=None):
+        self._lock = threading.Lock()
+        self.max_journal = max_journal
+        self.reset()
+
+    def _cap(self):
+        if self.max_journal is not None:
+            return self.max_journal
+        from .flags import flag
+        v = flag('FLAGS_check_nan_inf_max_journal', 4096)
+        # 0 is a legitimate bound (flag accumulation without replay) —
+        # only None falls back to the default
+        return int(4096 if v is None else v)
+
+    def reset(self):
+        with self._lock:
+            self._flag = None        # device bool scalar
+            self._journal = []       # (seq, name, fn, kwargs, arrs, meta)
+            self._dropped = 0
+            self._seq = 0
+
+    def pending_ops(self):
+        with self._lock:
+            return len(self._journal)
+
+    def has_pending(self):
+        """True when a flush has anything to check — the accumulated
+        device flag counts even with an empty journal (journal cap 0 =
+        flag accumulation without replay)."""
+        with self._lock:
+            return self._flag is not None or bool(self._journal)
+
+    # -- hot path ------------------------------------------------------------
+    def observe(self, name, fn, static_kwargs, arrs, outs):
+        flt = [(i, o) for i, o in enumerate(outs)
+               if dtypes.is_floating(getattr(o, 'dtype', None))]
+        if not flt:
+            return
+        bad = functools.reduce(
+            jnp.logical_or,
+            [jnp.any(~jnp.isfinite(o)) for _, o in flt])
+        from .flags import flag
+        if not flag('FLAGS_check_nan_inf_deferred', False):
+            # legacy semantics: sync and raise at the offending op
+            if bool(bad):
+                raise self._error_at_op(
+                    name, static_kwargs, arrs, outs, mode='eager-immediate')
+            return
+        with self._lock:
+            self._flag = bad if self._flag is None else self._flag | bad
+            self._seq += 1
+            self._journal.append(
+                (self._seq, name, fn, dict(static_kwargs or {}),
+                 tuple(arrs),
+                 [(tuple(o.shape), str(o.dtype)) for o in outs]))
+            if len(self._journal) > self._cap():
+                self._journal.pop(0)
+                self._dropped += 1
+
+    # -- step boundary -------------------------------------------------------
+    def flush(self, site='eager', step=None):
+        """One host sync; raises NumericsError when the step tripped.
+        Returns None (clean) — the journal is dropped either way."""
+        with self._lock:
+            dev_flag = self._flag
+            journal = self._journal
+            dropped = self._dropped
+            self._flag = None
+            self._journal = []
+            self._dropped = 0
+        if dev_flag is None:
+            return None
+        tripped = bool(_host_fetch(dev_flag))
+        if not tripped:
+            return None
+        raise self._localize(journal, dropped, site=site, step=step)
+
+    # -- failure path --------------------------------------------------------
+    def _localize(self, journal, dropped, site='eager', step=None):
+        """Replay the journaled ops in order; the first nonfinite output
+        is the origin."""
+        for seq, name, fn, kwargs, arrs, meta in journal:
+            try:
+                outs = fn(*arrs, **kwargs)
+            except Exception:
+                continue
+            outs = list(outs) if isinstance(outs, (tuple, list)) else [outs]
+            flt = [(i, o) for i, o in enumerate(outs)
+                   if dtypes.is_floating(getattr(o, 'dtype', None))]
+            if not flt:
+                continue
+            st = collect({f'out{i}': o for i, o in flt})
+            bad = [(i, st[f'out{i}']) for i, _ in flt
+                   if st[f'out{i}'].nonfinite_count > 0]
+            if bad:
+                return self._error_at_op(
+                    name, kwargs, arrs, outs, mode='eager-deferred',
+                    site=site, step=step, dropped=dropped,
+                    bad_index=bad[0][0], bad_stats=bad[0][1], seq=seq)
+        report = {
+            'kind': 'numerics_report', 'time': time.time(),
+            'rank': _env_rank(), 'site': site, 'step': step,
+            'mode': 'eager-deferred', 'op': None,
+            'journal_dropped': dropped,
+            'message': ('nonfinite flag tripped but the replay found no '
+                        'nonfinite output — the originating op likely '
+                        'predates the journal window'),
+        }
+        path = write_report(report)
+        self._log(report, path)
+        return NumericsError(
+            'NaN or Inf detected this step (FLAGS_check_nan_inf); origin '
+            'outside the op journal window\n' + render_numerics_report(report),
+            report=report, report_path=path)
+
+    def _error_at_op(self, name, kwargs, arrs, outs, mode, site='eager',
+                     step=None, dropped=0, bad_index=None, bad_stats=None,
+                     seq=None):
+        outs = list(outs) if isinstance(outs, (tuple, list)) else [outs]
+        if bad_index is None:
+            st = collect({
+                f'out{i}': o for i, o in enumerate(outs)
+                if dtypes.is_floating(getattr(o, 'dtype', None))})
+            for key, s in st.items():
+                if s.nonfinite_count > 0:
+                    bad_index, bad_stats = int(key[3:]), s
+                    break
+            if bad_index is None:       # flag raced; treat output 0
+                bad_index = 0
+                bad_stats = tensor_stats(outs[0])
+        in_named = {f'in{i}': a for i, a in enumerate(arrs)
+                    if dtypes.is_floating(getattr(a, 'dtype', None))}
+        in_stats = collect(in_named) if in_named else {}
+        inputs = []
+        for i, a in enumerate(arrs):
+            key = f'in{i}'
+            if key in in_stats:
+                inputs.append({'index': i, 'shape': list(a.shape),
+                               'dtype': str(a.dtype),
+                               'stats': in_stats[key].as_dict()})
+        out = outs[bad_index]
+        report = {
+            'kind': 'numerics_report', 'time': time.time(),
+            'rank': _env_rank(), 'site': site, 'step': step, 'mode': mode,
+            'op': name, 'op_seq': seq, 'output_index': bad_index,
+            'output': {'shape': list(out.shape), 'dtype': str(out.dtype),
+                       'stats': bad_stats.as_dict()},
+            'inputs': inputs,
+            'op_kwargs': {k: repr(v)[:80] for k, v in (kwargs or {}).items()},
+            'journal_dropped': dropped,
+        }
+        path = write_report(report)
+        self._log(report, path)
+        _metric_trip(site)
+        return NumericsError(
+            f"NaN or Inf found in output {bad_index} of op '{name}' "
+            f"(FLAGS_check_nan_inf)"
+            + (f" (full report: {path})" if path else '') + '\n'
+            + render_numerics_report(report),
+            report=report, report_path=path)
+
+    @staticmethod
+    def _log(report, path):
+        try:
+            from ..distributed.fleet.utils import log_util
+            log_util.log_json(
+                'numerics_trip', level='error', op=report.get('op'),
+                site=report.get('site'), report_path=path)
+        except Exception:
+            pass
+
+
+_guard = EagerNumericsGuard()
+
+
+def guard():
+    return _guard
+
+
+def flush(site='eager', step=None):
+    """Step-boundary check for the eager guard (one host sync). Raises
+    NumericsError when the step produced a nonfinite value."""
+    return _guard.flush(site=site, step=step)
+
+
+def reset():
+    _guard.reset()
+
+
+@contextlib.contextmanager
+def step_guard(site='eager', step=None):
+    """Bracket one eager train step; flushes (and so checks) at exit.
+    A body that raises resets the guard instead — a half-step's flag
+    and journal must not leak into (and be blamed on) the next step."""
+    try:
+        yield _guard
+    except BaseException:
+        _guard.reset()
+        raise
+    _guard.flush(site=site, step=step)
+
+
+def enabled():
+    from .flags import flag
+    return bool(flag('FLAGS_check_nan_inf'))
+
+
+def taps_enabled():
+    """Stat taps are threaded through compiled steps when either the
+    NaN guard or the always-on stats flag asks for them."""
+    from .flags import flag
+    return bool(flag('FLAGS_check_nan_inf') or flag('FLAGS_tensor_stats'))
+
+
+# ---------------------------------------------------------------------------
+# jit taps (compiled-step numerics)
+# ---------------------------------------------------------------------------
+def jit_taps(grads, params=None, extra_norm_sq=None):
+    """Traceable: build the taps pytree inside a compiled step.
+
+    grads/params: flat {name: array} dicts. `extra_norm_sq` lets the
+    engine supply a mesh-reduced global grad-norm^2 (psum over 'mp'/'pp'
+    for sharded trees); default is the local sum of squares.
+    """
+    gvecs = {n: stats_vec(g) for n, g in (grads or {}).items()}
+    pvecs = {n: stats_vec(p) for n, p in (params or {}).items()}
+    if extra_norm_sq is None:
+        extra_norm_sq = jnp.asarray(0.0, jnp.float32)
+        for n, g in (grads or {}).items():
+            extra_norm_sq = extra_norm_sq + jnp.sum(
+                g.astype(jnp.float32) ** 2)
+    return {'grads': gvecs, 'params': pvecs,
+            'grad_norm_sq': extra_norm_sq.astype(jnp.float32)}
+
+
+def taps_spec(taps):
+    """Replicated PartitionSpec tree matching a jit_taps pytree (for
+    shard_map out_specs)."""
+    from jax.sharding import PartitionSpec as P
+    return jax.tree_util.tree_map(lambda _: P(), taps)
+
+
+def _metric_trip(site):
+    try:
+        from . import monitor as _m
+        _m.counter('ptpu_num_nonfinite_steps_total',
+                   help='steps on which the numerics guard tripped',
+                   labelnames=('site',)).inc(1, site=site)
+    except Exception:
+        pass
+
+
+def publish_stats(named_stats, kind='grad', global_norm=None):
+    """Publish {name: TensorStats} as ptpu_num_* monitor series."""
+    from . import monitor as _m
+    g_norm = _m.gauge('ptpu_num_grad_norm',
+                      help='per-tensor pre-clip gradient l2 norm',
+                      labelnames=('param',))
+    g_rms = _m.gauge('ptpu_num_tensor_rms',
+                     help='per-tensor rms (grads and params)',
+                     labelnames=('kind', 'param'))
+    c_nf = _m.counter('ptpu_num_nonfinite_total',
+                      help='nonfinite elements observed',
+                      labelnames=('kind',))
+    c_sub = _m.counter('ptpu_num_subnormal_total',
+                       help='subnormal elements observed',
+                       labelnames=('kind',))
+    nonfinite = 0.0
+    subnormal = 0.0
+    for name, st in named_stats.items():
+        if kind == 'grad':
+            g_norm.set(st.l2_norm, param=name)
+        g_rms.set(st.rms, kind=kind, param=name)
+        nonfinite += st.nonfinite_count
+        subnormal += st.subnormal_count
+    if nonfinite:
+        c_nf.inc(nonfinite, kind=kind)
+    if subnormal:
+        c_sub.inc(subnormal, kind=kind)
+    if global_norm is not None:
+        _m.gauge('ptpu_num_grad_norm_global',
+                 help='global (all-parameter) gradient l2 norm').set(
+                     global_norm)
+        _m.histogram('ptpu_num_grad_norm_hist',
+                     help='distribution of per-step global grad norms',
+                     buckets=(.001, .01, .1, .3, 1., 3., 10., 30., 100.,
+                              1000.)).observe(global_norm)
+    _m.counter('ptpu_num_checks_total',
+               help='numerics stat collections performed').inc(1)
+
+
+def process_jit_taps(taps, site='jit', step=None, meta=None):
+    """Host side of the compiled-step taps: ONE sync for the whole
+    pytree, gauge publication, and (when FLAGS_check_nan_inf) a
+    NumericsError naming the offending tensors.
+
+    Returns {'grads': {name: TensorStats}, 'params': {...},
+    'grad_norm': float}.
+    """
+    host = _host_fetch(taps)
+    meta = meta or {}
+    out = {'grads': {}, 'params': {}}
+    for kind in ('grads', 'params'):
+        for n, vec in (host.get(kind) or {}).items():
+            m = meta.get(kind, {}).get(n, (None, None))
+            out[kind][n] = TensorStats(vec, shape=m[0], dtype=m[1])
+    gn = float(np.sqrt(max(float(host.get('grad_norm_sq', 0.0)), 0.0)))
+    out['grad_norm'] = gn
+    publish_stats(out['grads'], kind='grad', global_norm=gn)
+    if out['params']:
+        publish_stats(out['params'], kind='param')
+    if enabled():
+        bad = [('grad', n, st) for n, st in out['grads'].items()
+               if st.nonfinite_count > 0]
+        bad += [('param', n, st) for n, st in out['params'].items()
+                if st.nonfinite_count > 0]
+        # the per-tensor taps are shard-LOCAL under mp/pp (out_specs
+        # P() surfaces device 0's shard), but grad_norm_sq is mesh-
+        # reduced — a NaN confined to a non-local shard poisons it, so
+        # it is the check that cannot be evaded by sharding
+        gn_bad = not np.isfinite(gn)
+        if bad or gn_bad:
+            first_bad = bad[0][1] if bad else '<global grad norm>'
+            tensors = [{'kind': k, 'name': n, 'stats': st.as_dict()}
+                       for k, n, st in bad]
+            report = {
+                'kind': 'numerics_report', 'time': time.time(),
+                'rank': _env_rank(), 'site': site, 'step': step,
+                'mode': 'jit', 'op': None, 'tensors': tensors,
+                'first_bad': first_bad, 'grad_norm': gn,
+            }
+            if not bad:
+                report['message'] = (
+                    'the mesh-reduced global grad norm is nonfinite but '
+                    'no locally-visible tensor is — the NaN/Inf lives on '
+                    'another model-parallel shard or pipeline stage')
+            path = write_report(report)
+            EagerNumericsGuard._log(report, path)
+            _metric_trip(site)
+            what = (f"first nonfinite tensor is {bad[0][0]} "
+                    f"'{bad[0][1]}'" if bad else
+                    f"global grad norm is {gn}")
+            raise NumericsError(
+                f"NaN or Inf in compiled step at {site}"
+                + (f" step {step}" if step is not None else '')
+                + f": {what} (FLAGS_check_nan_inf)"
+                + (f" (full report: {path})" if path else '') + '\n'
+                + render_numerics_report(report),
+                report=report, report_path=path)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# cross-rank divergence sentinel
+# ---------------------------------------------------------------------------
+FINGERPRINT_LABELS = ('grad_norm', 'param_sum', 'param_l2')
+
+
+def _is_tensor_leaf(x):
+    from .tensor import Tensor
+    return isinstance(x, Tensor)
+
+
+@functools.lru_cache(maxsize=1)
+def _checksum_jit():
+    def _cks(leaves):
+        s = jnp.asarray(0.0, jnp.float32)
+        sq = jnp.asarray(0.0, jnp.float32)
+        for leaf in leaves:
+            l32 = leaf.astype(jnp.float32)
+            s = s + jnp.sum(l32)
+            sq = sq + jnp.sum(l32 * l32)
+        return s, jnp.sqrt(sq)
+    return jax.jit(_cks)
+
+
+class DivergenceSentinel:
+    """Cheap per-step cross-replica consistency check.
+
+    Data-parallel replicas run the SAME compiled program over reduced
+    grads, so their parameters (and grad global norms) must stay
+    bit-identical; any drift (a flaky chip, a desynced RNG, a missed
+    broadcast after restore) silently corrupts training. Each step the
+    sentinel allgathers a 3-float fingerprint over the host-collective
+    group (journaled by the flight recorder like every host
+    collective), compares within `rtol`, and on the FIRST mismatch
+    writes a divergence report naming the offending ranks — the
+    consensus is the largest agreeing group (ties break toward rank
+    0's value).
+    """
+
+    def __init__(self, group=None, rtol=0.0, atol=0.0, dump_dir=None,
+                 check_every=1):
+        self.group = group
+        self.rtol = float(rtol)
+        self.atol = float(atol)
+        self.dump_dir = dump_dir
+        self.check_every = max(1, int(check_every))
+        self.first_divergent_step = None
+        self.report = None
+        self.report_path = None
+        self.checks = 0
+
+    def _group(self):
+        if self.group is not None:
+            return self.group
+        try:
+            from ..distributed import host_collectives as HC
+            return HC.host_group()
+        except Exception:
+            return None
+
+    def fingerprint(self, grad_norm=None, params=None):
+        """3-float fingerprint; `params` is a {name: array/Tensor} dict
+        (or any pytree) checksummed in one fused kernel + one sync."""
+        s = l2 = 0.0
+        if params is not None:
+            leaves = [_as_array(p) for p in
+                      jax.tree_util.tree_leaves(
+                          params, is_leaf=_is_tensor_leaf)]
+            leaves = tuple(l for l in leaves
+                           if dtypes.is_floating(getattr(l, 'dtype', None)))
+            if leaves:
+                sv, l2v = _host_fetch(_checksum_jit()(leaves))
+                s, l2 = float(sv), float(l2v)
+        gn = 0.0 if grad_norm is None else float(grad_norm)
+        return np.asarray([gn, s, l2], np.float64)
+
+    def check(self, step, grad_norm=None, params=None, fingerprint=None):
+        """Returns the divergence report dict on a (first) mismatch,
+        else None. No-op without an initialized multi-rank host group."""
+        g = self._group()
+        if g is None or g.world_size <= 1:
+            return None
+        if step % self.check_every != 0:
+            return None
+        fp = self.fingerprint(grad_norm=grad_norm, params=params) \
+            if fingerprint is None else np.asarray(fingerprint, np.float64)
+        self.checks += 1
+        from . import monitor as _m
+        _m.counter('ptpu_num_divergence_checks_total',
+                   help='cross-rank fingerprint allgathers').inc(1)
+        all_fps = g.all_gather(fp)       # journaled by the recorder
+        consensus, offending = self._vote(all_fps)
+        if not offending:
+            return None
+        if self.first_divergent_step is None:
+            self.first_divergent_step = step
+        report = {
+            'kind': 'divergence_report', 'time': time.time(),
+            'rank': g.rank, 'world_size': g.world_size, 'step': step,
+            'first_divergent_step': self.first_divergent_step,
+            'fingerprint_labels': list(FINGERPRINT_LABELS),
+            'ranks': {str(r): [float(v) for v in f]
+                      for r, f in enumerate(all_fps)},
+            'offending_ranks': offending,
+            'consensus_ranks': consensus,
+            'rtol': self.rtol, 'atol': self.atol,
+        }
+        self.report = report
+        self.report_path = write_report(
+            report, None if self.dump_dir is None else os.path.join(
+                self.dump_dir,
+                f'divergence_report.rank{g.rank}.{os.getpid()}.json'))
+        _m.counter('ptpu_num_divergence_total',
+                   help='cross-rank divergence events detected').inc(1)
+        try:
+            from ..distributed import flight_recorder as fr
+            rec = fr.recorder()
+            seq = rec.record_enqueue('divergence_detected', group=g.gid,
+                                     mode='numerics')
+            rec.record_complete(seq, ok=False)
+        except Exception:
+            pass
+        try:
+            from ..distributed.fleet.utils import log_util
+            log_util.log_json(
+                'divergence_detected', level='error', step=step,
+                first_divergent_step=self.first_divergent_step,
+                offending_ranks=offending,
+                report_path=self.report_path)
+        except Exception:
+            pass
+        return report
+
+    def _vote(self, all_fps):
+        """Largest agreeing group is the consensus; ties break toward
+        the group containing rank 0."""
+        n = len(all_fps)
+        groups = []          # list[(member ranks)]
+        for r in range(n):
+            placed = False
+            for grp in groups:
+                # equal_nan: every rank hitting the SAME nonfinite step
+                # is agreement (a numerics problem, not divergence)
+                if np.allclose(all_fps[grp[0]], all_fps[r],
+                               rtol=self.rtol, atol=self.atol,
+                               equal_nan=True):
+                    grp.append(r)
+                    placed = True
+                    break
+            if not placed:
+                groups.append([r])
+        if len(groups) <= 1:
+            return list(range(n)), []
+        groups.sort(key=lambda grp: (-len(grp), grp[0]))
+        consensus = groups[0]
+        offending = sorted(r for grp in groups[1:] for r in grp)
+        return consensus, offending
+
+
+# ---------------------------------------------------------------------------
+# telemetry snapshot (StepTelemetry / bench.py)
+# ---------------------------------------------------------------------------
+def snapshot():
+    """JSON-ready numerics telemetry read back from the monitor registry
+    (zeros when the observatory never ran)."""
+    from . import monitor as _m
+    reg = _m.metrics()
+
+    def _total(name):
+        m = reg.get(name)
+        if m is None:
+            return 0.0
+        return sum(c.value() for c in m._series().values())
+
+    def _gauge(name):
+        m = reg.get(name)
+        if m is None:
+            return None
+        series = m._series()
+        if not series:
+            return None
+        return next(iter(series.values())).value()
+
+    return {
+        'grad_norm_global': _gauge('ptpu_num_grad_norm_global'),
+        'nonfinite_total': _total('ptpu_num_nonfinite_total'),
+        'nonfinite_steps': _total('ptpu_num_nonfinite_steps_total'),
+        'checks_total': _total('ptpu_num_checks_total'),
+        'divergence_checks': _total('ptpu_num_divergence_checks_total'),
+        'divergence_events': _total('ptpu_num_divergence_total'),
+        'amp_skipped_steps': _total('ptpu_amp_skipped_steps_total'),
+        'amp_loss_scale': _gauge('ptpu_amp_loss_scale'),
+    }

@@ -141,6 +141,11 @@ class HybridParallelTrainStep(EngineTeardown):
     def _build(self):
         model, loss_fn, opt = self.model, self.loss_fn, self.optimizer
         axes = self.axes
+        # numerics taps (core/numerics.py): latched at build — the taps
+        # change the compiled step's output signature, so flip the flag
+        # BEFORE the first dispatch (a later flip needs a new engine)
+        from ....core import numerics as _num
+        taps_on = self._taps_on = _num.taps_enabled()
         # axes whose shards see different data → loss/grad pmean + distinct
         # dropout keys ('sp' chunks are different tokens, like dp shards)
         dp_axes = tuple(a for a in ('dp', 'sharding', 'sp') if a in axes
@@ -148,6 +153,22 @@ class HybridParallelTrainStep(EngineTeardown):
         zero_ok = self._zero_ok
         s = self.sharding_deg
         use_remat = self.use_remat
+
+        def global_norm_sq(grads):
+            """Mesh-wide global grad-norm^2: mp-sharded params psum
+            their local sum of squares (shared by taps + clip)."""
+            sq_d = jnp.asarray(0.0, jnp.float32)
+            sq_r = jnp.asarray(0.0, jnp.float32)
+            for n, g in grads.items():
+                p = self._params_by_name[n]
+                v = jnp.sum(g.astype(jnp.float32) ** 2)
+                if getattr(p, 'is_distributed', False) and 'mp' in axes:
+                    sq_d = sq_d + v
+                else:
+                    sq_r = sq_r + v
+            if 'mp' in axes and self.mp > 1:
+                sq_d = lax.psum(sq_d, 'mp')
+            return sq_d + sq_r
 
         def step(params, states, lr, key, *batch):
             with C.spmd_region(axes, sp_data_sharded=sp_on):
@@ -172,6 +193,14 @@ class HybridParallelTrainStep(EngineTeardown):
                     grads = {n: lax.pmean(g, dp_axes)
                              for n, g in grads.items()}
 
+                # numerics taps: PRE-CLIP grads (the clip below rebinds
+                # `grads` to a new dict) + the mesh-wide global
+                # grad-norm^2 (same reduction the clip uses)
+                gn_sq = None
+                preclip_grads = grads
+                if taps_on:
+                    gn_sq = global_norm_sq(grads)
+
                 # mesh-aware global-norm clip (parity:
                 # HybridParallelClipGrad, hybrid_parallel_optimizer.py:32)
                 if self._grad_clip is not None:
@@ -182,19 +211,10 @@ class HybridParallelTrainStep(EngineTeardown):
                                             None) or getattr(
                                 getattr(self._grad_clip, '_clip', None),
                                 'clip_norm', 1.0)
-                        sq_d = jnp.asarray(0.0, jnp.float32)
-                        sq_r = jnp.asarray(0.0, jnp.float32)
-                        for n, g in grads.items():
-                            p = self._params_by_name[n]
-                            v = jnp.sum(g.astype(jnp.float32) ** 2)
-                            if getattr(p, 'is_distributed', False) and \
-                                    'mp' in axes:
-                                sq_d = sq_d + v
-                            else:
-                                sq_r = sq_r + v
-                        if 'mp' in axes and self.mp > 1:
-                            sq_d = lax.psum(sq_d, 'mp')
-                        gn = jnp.sqrt(sq_d + sq_r)
+                        # taps (pre-clip, same grads) already built the
+                        # mesh-wide norm^2 — reuse it
+                        gn = jnp.sqrt(gn_sq if gn_sq is not None
+                                      else global_norm_sq(grads))
                         factor = clip_norm / jnp.maximum(gn, clip_norm)
                         grads = {n: (g.astype(jnp.float32) * factor)
                                  .astype(g.dtype)
@@ -220,6 +240,10 @@ class HybridParallelTrainStep(EngineTeardown):
                         p_new, ns = self._update_one(p, g, st, lr)
                     new_params[n] = p_new
                     new_states[n] = ns
+                if taps_on:
+                    taps = _num.jit_taps(preclip_grads, new_params,
+                                         extra_norm_sq=gn_sq)
+                    return loss, new_params, new_states, taps
                 return loss, new_params, new_states
 
         # sequence sharding only for models that declare support (GPT sets
@@ -252,6 +276,12 @@ class HybridParallelTrainStep(EngineTeardown):
         in_specs = (self._param_specs, self._state_specs, P(), P(),
                     *batch_specs)
         out_specs = (P(), self._param_specs, self._state_specs)
+        if taps_on:
+            names = list(self._params)
+            out_specs = out_specs + (_num.taps_spec(
+                {'grads': dict.fromkeys(names, 0),
+                 'params': dict.fromkeys(names, 0),
+                 'grad_norm_sq': 0}),)
         mapped = shard_map(step, mesh=self.mesh, in_specs=in_specs,
                            out_specs=out_specs, check_rep=False)
         return jax.jit(mapped, donate_argnums=(0, 1))
@@ -299,10 +329,27 @@ class HybridParallelTrainStep(EngineTeardown):
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         key = rng_mod.next_key()
         with self._step_guard(first, 'hybrid.train_step', 'hybrid.step'):
-            loss, self._params, self._states = self._compiled(
+            out = self._compiled(
                 self._params, self._states, lr, key, *arrays)
+        if getattr(self, '_taps_on', False):
+            loss, self._params, self._states, taps = out
+            self._process_taps(taps, 'hybrid')
+        else:
+            loss, self._params, self._states = out
         self._step_count += 1
         return Tensor(loss)
+
+    def _process_taps(self, taps, site):
+        """One host sync for the step's stats pytree; publishes
+        ptpu_num_* gauges and raises NumericsError on nonfinite grads
+        (FLAGS_check_nan_inf) naming the offending parameter."""
+        from ....core import numerics as _num
+        meta = {'grads': {n: (p.data.shape, p.data.dtype)
+                          for n, p in self._params_by_name.items()},
+                'params': {n: (p.data.shape, p.data.dtype)
+                           for n, p in self._params_by_name.items()}}
+        self.last_numerics = _num.process_jit_taps(
+            taps, site=site, step=self._step_count, meta=meta)
 
     def sync_model(self):
         """Write updated params back into the eager Layer."""

@@ -387,6 +387,28 @@ class SpmdPipelineEngine(EngineTeardown):
                 lambda g: (g.astype(jnp.float32) * inv).astype(g.dtype),
                 grads)
 
+        # numerics taps: post-unscale, pre-update grad stats + the
+        # global grad-norm^2. Block grads are stage-LOCAL (never psum'd
+        # over pp) so their sum-of-squares reduces over 'pp'; embed/head
+        # are already fully reduced. Per-tensor stats for blocks cover
+        # the local stage's slice under pp>1 (the global norm is exact).
+        taps_on = getattr(self, '_taps_on', False)
+        flat_grads = gn_sq = None
+        if taps_on:
+            sq_eh = jnp.asarray(0.0, jnp.float32)
+            for grp in ('embed', 'head'):
+                for g in grads[grp].values():
+                    sq_eh = sq_eh + jnp.sum(g.astype(jnp.float32) ** 2)
+            sq_b = jnp.asarray(0.0, jnp.float32)
+            for g in grads['blocks'].values():
+                sq_b = sq_b + jnp.sum(g.astype(jnp.float32) ** 2)
+            if pp > 1:
+                sq_b = lax.psum(sq_b, 'pp')
+            gn_sq = sq_eh + sq_b
+            flat_grads = {f'{grp}/{n}': g
+                          for grp in ('embed', 'blocks', 'head')
+                          for n, g in grads[grp].items()}
+
         new_params, new_states = {}, {}
         for grp in ('embed', 'blocks', 'head'):
             new_params[grp], new_states[grp] = {}, {}
@@ -400,6 +422,14 @@ class SpmdPipelineEngine(EngineTeardown):
                         ns, dict(states[grp][n]))
                 new_params[grp][n] = np_
                 new_states[grp][n] = ns
+        if taps_on:
+            from ....core import numerics as _num
+            flat_params = {f'{grp}/{n}': p
+                           for grp in ('embed', 'blocks', 'head')
+                           for n, p in new_params[grp].items()}
+            taps = _num.jit_taps(flat_grads, flat_params,
+                                 extra_norm_sq=gn_sq)
+            return loss, new_params, new_states, found_inf, taps
         return loss, new_params, new_states, found_inf
 
     def _finalize(self, step, dp_on):
@@ -407,6 +437,14 @@ class SpmdPipelineEngine(EngineTeardown):
         in_specs = (self._specs, self._state_specs, P(), P(), P(), dp_sp,
                     dp_sp)
         out_specs = (P(), self._specs, self._state_specs, P())
+        if getattr(self, '_taps_on', False):
+            from ....core import numerics as _num
+            keys = [f'{grp}/{n}' for grp in ('embed', 'blocks', 'head')
+                    for n in self._params[grp]]
+            out_specs = out_specs + (_num.taps_spec(
+                {'grads': dict.fromkeys(keys, 0),
+                 'params': dict.fromkeys(keys, 0),
+                 'grad_norm_sq': 0}),)
         mapped = shard_map(step, mesh=self.mesh, in_specs=in_specs,
                            out_specs=out_specs, check_rep=False)
         return jax.jit(mapped, donate_argnums=(0, 1))
@@ -943,6 +981,11 @@ class SpmdPipelineEngine(EngineTeardown):
         if not hasattr(self, '_compiled_by_mode'):
             self._compiled_by_mode = {}
         from ....core import memory as _mem
+        if not hasattr(self, '_taps_on'):
+            # latched at first build (taps change the compiled output
+            # signature — set FLAGS before the first train_batch)
+            from ....core import numerics as _num
+            self._taps_on = _num.taps_enabled()
         if want_scaling != self._use_scaling or self._compiled is None:
             self._use_scaling = want_scaling
             # two-slot cache: alternating scaled/unscaled steps must not
@@ -971,11 +1014,44 @@ class SpmdPipelineEngine(EngineTeardown):
         with _prof.RecordEvent('pipeline::train_step', event_type='jit'), \
                 self._step_guard(first, 'pipeline.train_step',
                                  'pipeline.step'):
-            loss, self._params, self._states, found = self._compiled(
+            out = self._compiled(
                 self._params, self._states, lr, sc, key, ii, ll)
+        self._pp_step = getattr(self, '_pp_step', 0) + 1
+        if self._taps_on:
+            loss, self._params, self._states, found, taps = out
+            found = self._process_taps(taps, found)
+        else:
+            loss, self._params, self._states, found = out
         self._warm_modes.add(want_scaling)
         self.last_found_inf = found
         return Tensor(loss)
+
+    def _process_taps(self, taps, found):
+        """Fetch found_inf + the taps pytree in ONE host sync; returns
+        the host-side found flag for last_found_inf."""
+        from ....core import numerics as _num
+        found_host, taps_host = _num._host_fetch((found, taps))
+        if bool(found_host):
+            # loss-scale overflow the compiled step already survived
+            # (update skipped via found_inf): the post-unscale grads are
+            # nonfinite BY DESIGN — raising NumericsError here, or
+            # folding inf into the grad-norm gauges/histogram, would
+            # punish the GradScaler's routine scale probe (the eager AMP
+            # skip path drops the guard state for the same reason)
+            self.last_numerics = None
+            return found_host
+        taps = taps_host    # already on host: the fetch inside
+                            # process_jit_taps is a free no-op
+        meta = {}
+        for kind in ('grads', 'params'):
+            meta[kind] = {
+                f'{grp}/{n}': (a.shape, a.dtype)
+                for grp in ('embed', 'blocks', 'head')
+                for n, a in self._params[grp].items()}
+        self.last_numerics = _num.process_jit_taps(
+            taps, site='pipeline', step=getattr(self, '_pp_step', None),
+            meta=meta)
+        return found_host
 
     def sync_model(self):
         self._ensure_open()

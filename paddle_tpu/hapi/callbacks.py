@@ -271,10 +271,17 @@ class StepTelemetry(Callback):
         self.reporter.end_step(examples=ex, tokens=tokens)
         if self.log_freq and (step + 1) % self.log_freq == 0:
             s = self.reporter.snapshot()
-            print(f"[telemetry] step {step + 1}: "
-                  f"{s['examples_per_sec']:.1f} ex/s, "
-                  f"{s['avg_step_ms']:.1f} ms/step, "
-                  f"compile {s['compile_seconds_total']:.2f}s")
+            line = (f"[telemetry] step {step + 1}: "
+                    f"{s['examples_per_sec']:.1f} ex/s, "
+                    f"{s['avg_step_ms']:.1f} ms/step, "
+                    f"compile {s['compile_seconds_total']:.2f}s")
+            numerics = s.get('numerics') or {}
+            if numerics.get('grad_norm_global') is not None:
+                line += f", |g|={numerics['grad_norm_global']:.3g}"
+            if numerics.get('nonfinite_steps'):
+                line += (f", nonfinite_steps="
+                         f"{int(numerics['nonfinite_steps'])}")
+            print(line)
 
     def observe_batch(self, batch):
         """Called by Model.fit with the raw batch to size examples/sec."""

@@ -127,6 +127,10 @@ class TrainStep:
                 # stable across steps (lax.scan carry requirement)
                 st['master'] = lookup[n].data.astype(jnp.float32)
             self._opt_states[n] = st
+        # numerics taps (core/numerics.py): latched here — they change
+        # the compiled step's output tree, so set FLAGS before building
+        from ..core import numerics as _num
+        self._taps_on = _num.taps_enabled()
         self._compiled = jax.jit(
             self._step,
             donate_argnums=(0, 1, 2) if donate else ())
@@ -146,6 +150,10 @@ class TrainStep:
             loss_of, has_aux=True)(params, buffers)
         new_params, new_states = opt.functional_apply(params, grads,
                                                       opt_states, lr)
+        if self._taps_on:
+            from ..core import numerics as _num
+            taps = _num.jit_taps(grads, new_params)
+            return loss, new_params, new_buffers, new_states, taps
         return loss, new_params, new_buffers, new_states
 
     def __call__(self, *batch):
@@ -177,7 +185,17 @@ class TrainStep:
                     raise
                 self._exec_cache[sig] = self._compiled
                 out = self._compiled(*args)
-        loss, self._params, self._buffers, self._opt_states = out
+        if self._taps_on:
+            (loss, self._params, self._buffers, self._opt_states,
+             taps) = out
+            from ..core import numerics as _num
+            meta = {k: {n: (a.shape, a.dtype)
+                        for n, a in self._params.items()}
+                    for k in ('grads', 'params')}
+            self.last_numerics = _num.process_jit_taps(
+                taps, site='jit', step=self._step_i, meta=meta)
+        else:
+            loss, self._params, self._buffers, self._opt_states = out
         self._step_i += 1
         return Tensor(loss)
 
@@ -198,7 +216,9 @@ class TrainStep:
                 p, b, s = carry
                 key = xs[0]
                 batch = xs[1]
-                loss, p2, b2, s2 = step(p, b, s, lr, key, batch)
+                # [:4] drops the numerics taps when enabled (per-step
+                # taps don't escape a scanned multi-step; XLA DCEs them)
+                loss, p2, b2, s2 = step(p, b, s, lr, key, batch)[:4]
                 return (p2, b2, s2), loss
             (p, b, s), losses = jax.lax.scan(
                 body, (params, buffers, opt_states), (keys, batch_stack))

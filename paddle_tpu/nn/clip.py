@@ -4,9 +4,36 @@ Reference parity: python/paddle/fluid/clip.py — ClipGradByValue,
 ClipGradByNorm, ClipGradByGlobalNorm; applied by optimizers over
 params_grads before the update (optimizer.py _create_optimization_pass).
 """
+import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
+
+
+def _publish_preclip_norm(norm, site):
+    """Numerics observatory: the pre-clip global grad norm is the
+    canonical training-health signal — publish it whenever it is a
+    concrete value (never under a jit trace) and stats are asked for."""
+    if isinstance(norm, jax.core.Tracer):
+        return None
+    from ..core.flags import flag
+    if not (flag('FLAGS_tensor_stats') or flag('FLAGS_check_nan_inf')):
+        return None
+    if flag('FLAGS_tensor_stats'):
+        # inside optimizer.step the numerics boundary already published
+        # this step's pre-clip global norm from its batched sync —
+        # publishing again here would add a SECOND host sync per step
+        from ..core import memory as _mem
+        if _mem.accountant().current_phase() == 'optimizer.step':
+            return None
+    val = float(norm)       # the one host sync this publication costs
+    from ..core import monitor as _m
+    _m.gauge('ptpu_num_grad_norm_global',
+             help='global (all-parameter) gradient l2 norm').set(val)
+    _m.gauge('ptpu_num_grad_norm_preclip',
+             help='pre-clip global gradient norm per clip site',
+             labelnames=('site',)).set(val, site=site)
+    return val
 
 
 class ClipGradBase:
@@ -66,6 +93,7 @@ class ClipGradByGlobalNorm(ClipGradBase):
 
     def __call__(self, params_grads):
         gn = self.global_norm(params_grads)
+        _publish_preclip_norm(gn, 'global_norm_clip')
         factor = self.clip_norm / jnp.maximum(gn, self.clip_norm)
         out = []
         for p, g in params_grads:
@@ -79,6 +107,10 @@ class ClipGradByGlobalNorm(ClipGradBase):
 
 def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
                     error_if_nonfinite=False):
+    """Parity: paddle.nn.utils.clip_grad_norm_ — in-place global-norm
+    clip returning the pre-clip total norm. With `error_if_nonfinite`
+    a NaN/Inf total norm raises instead of silently scaling every grad
+    to NaN (paddle 2.x behavior)."""
     if isinstance(parameters, Tensor):
         parameters = [parameters]
     grads = [p.grad for p in parameters if p.grad is not None]
@@ -91,6 +123,14 @@ def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
             sum(jnp.sum(jnp.power(jnp.abs(g.data.astype(jnp.float32)),
                                   norm_type)) for g in grads),
             1.0 / norm_type)
+    if error_if_nonfinite and not isinstance(total, jax.core.Tracer) \
+            and not bool(jnp.isfinite(total)):
+        raise RuntimeError(
+            f"The total norm of order {norm_type} for gradients from "
+            "`parameters` is non-finite, so it cannot be clipped. To "
+            "disable this error and scale the gradients by the "
+            "non-finite norm anyway, set `error_if_nonfinite=False`")
+    _publish_preclip_norm(total, 'clip_grad_norm_')
     factor = jnp.minimum(max_norm / jnp.maximum(total, 1e-6), 1.0)
     for p in parameters:
         if p.grad is not None:

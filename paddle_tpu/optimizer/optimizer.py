@@ -12,6 +12,7 @@ Master-weight (fp32) handling mirrors operators/optimizers' multi-precision
 mode: when a param is bf16/fp16, state (and the update) is kept in fp32 and the
 param is re-cast after the update.
 """
+import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -162,7 +163,30 @@ class Optimizer:
                 _mem.phase('optimizer.step'):
             params_grads = [(p, p.grad) for p in params
                             if not p.stop_gradient and p.grad is not None]
+            self._numerics_boundary(params_grads)
             self._apply_params_grads(params_grads)
+
+    def _numerics_boundary(self, params_grads):
+        """Numerics-observatory step boundary: flush the eager
+        NaN/Inf guard (its one deferred host sync — BEFORE the update,
+        so a poisoned grad is caught before it corrupts params) and,
+        with FLAGS_tensor_stats, publish per-param grad stats + the
+        global grad norm as ptpu_num_* gauges (one batched sync)."""
+        from ..core import numerics as _num
+        if _num.guard().has_pending():
+            _num.flush(site='optimizer.step', step=self._step_count)
+        from ..core.flags import flag as _flag
+        if _flag('FLAGS_tensor_stats') and params_grads:
+            named = {}
+            for i, (p, g) in enumerate(params_grads):
+                if g is None:
+                    continue
+                named[getattr(p, 'name', None) or f'param_{i}'] = g.data
+            if named:
+                stats = _num.collect(named)
+                gn = float(np.sqrt(sum(s.l2_norm ** 2
+                                       for s in stats.values())))
+                _num.publish_stats(stats, kind='grad', global_norm=gn)
 
     def _apply_params_grads(self, params_grads):
         if self._grad_clip is not None:

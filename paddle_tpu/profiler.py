@@ -801,6 +801,13 @@ class StepTelemetry:
         if flops is not None:
             snap['xla_flops_per_run'] = {
                 k[0]: c.value() for k, c in flops._series().items()}
+        # numerics observatory (grad norms, nonfinite/divergence
+        # counters, AMP loss scale) — zeros when it never ran
+        try:
+            from .core import numerics as _numerics
+            snap['numerics'] = _numerics.snapshot()
+        except Exception:
+            snap['numerics'] = None
         return snap
 
 

@@ -1,0 +1,62 @@
+"""2-process forced-desync scenario for the divergence sentinel.
+
+Both ranks hold bit-identical params and check a per-step fingerprint
+(grad global-norm + param checksum) through the DivergenceSentinel's
+host-collective allgather. Before step 2 rank 1 perturbs one parameter
+— the silent data-parallel drift the sentinel exists to catch. Both
+ranks must detect the mismatch at step 2, name rank 1 as the offender
+(consensus ties break toward rank 0), write a divergence_report
+artifact, and journal the event in the flight recorder.
+"""
+import os
+import sys
+
+import jax
+jax.config.update('jax_platforms', 'cpu')
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import numpy as np                                         # noqa: E402
+from paddle_tpu.distributed import host_collectives as HC  # noqa: E402
+from paddle_tpu.distributed import flight_recorder as fr   # noqa: E402
+from paddle_tpu.core import numerics as num                # noqa: E402
+
+
+def main():
+    rank = int(os.environ['PADDLE_TRAINER_ID'])
+    dump_dir = os.environ['DIVERGENCE_DUMP_DIR']
+    group = HC.init_host_collectives(timeout=60)
+    assert group is not None
+
+    sentinel = num.DivergenceSentinel(group=group, dump_dir=dump_dir)
+    params = {'w': np.full((8,), 1.5, np.float32),
+              'b': np.zeros((4,), np.float32)}
+    for step in range(4):
+        if step == 2 and rank == 1:
+            params['w'] = params['w'] + 0.125      # the silent desync
+        rep = sentinel.check(step, grad_norm=0.5, params=params)
+        if step < 2:
+            assert rep is None, f'false positive at step {step}: {rep}'
+        elif rep is None:
+            print(f'RANK{rank}: divergence NOT detected at step {step}',
+                  flush=True)
+            sys.exit(9)
+
+    assert sentinel.first_divergent_step == 2, \
+        sentinel.first_divergent_step
+    rep = sentinel.report
+    assert rep['offending_ranks'] == [1], rep
+    assert rep['consensus_ranks'] == [0], rep
+    assert sentinel.report_path and os.path.exists(sentinel.report_path)
+    # the mismatch is journaled beside the allgathers that found it
+    ops = [e['op'] for e in fr.recorder().entries()]
+    assert 'divergence_detected' in ops, ops
+    assert 'all_gather' in ops, ops
+    print(f'RANK{rank}: OK first_divergent_step='
+          f'{sentinel.first_divergent_step}', flush=True)
+    sys.exit(0)
+
+
+if __name__ == '__main__':
+    main()

@@ -11,12 +11,18 @@ the human post-mortem:
   * OOM reports (`oom_report.rank*.json` from core.memory.oom_guard):
     per-phase high-water table, top live buffers with origin phases,
     suspect phase;
+  * numerics artifacts (`numerics_report.rank*.json` from
+    core.numerics — NaN/Inf localization with op/tensor stats — and
+    `divergence_report.rank*.json` from the cross-rank divergence
+    sentinel);
   * rank-aware JSON-lines logs (`workerlog.<rank>.jsonl`): pretty-print
     the last events, filterable with --level.
 
 Usage:
     python tools/health_dump.py ARTIFACT.json [--json] [--level ERROR]
-    python tools/health_dump.py --selftest     # CI smoke
+    python tools/health_dump.py numerics ARTIFACT.json [--json]
+    python tools/health_dump.py --selftest           # CI smoke
+    python tools/health_dump.py numerics --selftest  # numerics CI smoke
 """
 import argparse
 import json
@@ -32,7 +38,8 @@ def _repo_root_on_path():
 def classify(doc):
     if isinstance(doc, dict):
         kind = doc.get('kind')
-        if kind in ('hang_report', 'flight_recorder', 'oom_report'):
+        if kind in ('hang_report', 'flight_recorder', 'oom_report',
+                    'numerics_report', 'divergence_report'):
             return kind
         if 'entries' in doc and 'seq' in doc:
             return 'flight_recorder'
@@ -40,6 +47,10 @@ def classify(doc):
             return 'hang_report'
         if 'top_buffers' in doc or 'phases' in doc:
             return 'oom_report'
+        if 'fingerprint_labels' in doc:
+            return 'divergence_report'
+        if 'op' in doc and ('output' in doc or 'tensors' in doc):
+            return 'numerics_report'
     return None
 
 
@@ -52,9 +63,16 @@ def render(doc):
     if kind == 'oom_report':
         from paddle_tpu.core.memory import render_oom_report
         return render_oom_report(doc)
+    if kind == 'numerics_report':
+        from paddle_tpu.core.numerics import render_numerics_report
+        return render_numerics_report(doc)
+    if kind == 'divergence_report':
+        from paddle_tpu.core.numerics import render_divergence_report
+        return render_divergence_report(doc)
     raise ValueError(
         "unrecognized artifact: expected a hang report, flight-recorder "
-        "dump, or OOM report (see docs/observability.md#diagnostics)")
+        "dump, OOM report, numerics report, or divergence report (see "
+        "docs/observability.md#diagnostics)")
 
 
 def render_log(path, level=None, tail=50):
@@ -173,7 +191,82 @@ def _selftest():
     return 0
 
 
+def _numerics_selftest():
+    """CI smoke for the numerics observatory: fused stats vs numpy, an
+    eager deferred-guard trip with op localization, and both artifact
+    kinds through classify/render."""
+    import numpy as np
+    _repo_root_on_path()
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    import paddle_tpu as paddle
+    from paddle_tpu.core import numerics as num
+
+    # -- fused stats agree with numpy
+    a = np.array([1.0, -2.0, 0.0, np.nan, np.inf, 3.0], np.float32)
+    st = num.tensor_stats(a)
+    assert st.nan_count == 1 and st.inf_count == 1 and st.zero_count == 1
+    fin = a[np.isfinite(a)]
+    assert abs(st.l2_norm - np.sqrt((fin ** 2).sum())) < 1e-4, st
+
+    # -- deferred eager guard: one sync at flush, replay names the op
+    paddle.set_flags({'FLAGS_check_nan_inf': True,
+                      'FLAGS_check_nan_inf_deferred': True})
+    try:
+        x = paddle.to_tensor([0.5, 2.0])
+        y = paddle.log(x - 1.0)          # log(-0.5) -> nan
+        _ = y * 3.0
+        try:
+            num.flush(site='selftest', step=1)
+        except num.NumericsError as e:
+            report = e.report
+        else:
+            raise AssertionError('deferred guard did not trip')
+    finally:
+        paddle.set_flags({'FLAGS_check_nan_inf': False,
+                          'FLAGS_check_nan_inf_deferred': False})
+        num.reset()
+    assert report['op'] == 'log', report
+    assert classify(report) == 'numerics_report'
+    text = render(report)
+    assert 'first nonfinite op: log' in text, text
+
+    # -- divergence artifact renders with the offending rank
+    div = {'kind': 'divergence_report', 'step': 4,
+           'first_divergent_step': 4, 'rank': 0, 'world_size': 2,
+           'fingerprint_labels': list(num.FINGERPRINT_LABELS),
+           'ranks': {'0': [1.0, 2.0, 3.0], '1': [1.0, 2.5, 3.0]},
+           'offending_ranks': [1], 'consensus_ranks': [0]}
+    assert classify(div) == 'divergence_report'
+    text = render(div)
+    assert 'first divergent step: 4' in text
+    assert 'rank 1' in text and 'divergent' in text
+    print('health_dump numerics selftest: OK')
+    return 0
+
+
+def numerics_main(argv):
+    ap = argparse.ArgumentParser(
+        prog='health_dump.py numerics',
+        description='render numerics / divergence artifacts')
+    ap.add_argument('artifact', nargs='?',
+                    help='numerics_report / divergence_report JSON')
+    ap.add_argument('--json', action='store_true')
+    ap.add_argument('--selftest', action='store_true')
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return _numerics_selftest()
+    if not args.artifact:
+        ap.error('artifact path required (or --selftest)')
+    with open(args.artifact) as f:
+        doc = json.load(f)
+    print(json.dumps(doc, indent=2) if args.json else render(doc))
+    return 0
+
+
 def main(argv=None):
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == 'numerics':
+        return numerics_main(argv[1:])
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument('artifact', nargs='?',
                     help='hang/OOM report JSON or workerlog .jsonl')

@@ -10,11 +10,14 @@ TIER="${1:-all}"
 case "$TIER" in
   fast)   python -m pytest tests/test_ops.py tests/test_autograd.py \
             tests/test_layers_optim.py tests/test_controlflow_dist.py \
-            tests/test_profiler_trace.py tests/test_diagnostics.py -q
+            tests/test_profiler_trace.py tests/test_diagnostics.py \
+            tests/test_numerics.py -q
           # observability tooling smoke: tracer -> export -> summary CLI
           python tools/trace_summary.py --selftest
           # diagnostics smoke: flight recorder -> hang/OOM reports -> CLI
-          python tools/health_dump.py --selftest ;;
+          python tools/health_dump.py --selftest
+          # numerics smoke: fused stats -> guard trip -> artifact render
+          python tools/health_dump.py numerics --selftest ;;
   dist)   python -m pytest tests/test_distributed.py \
             tests/test_launch_elastic.py tests/test_bert_zero_asp.py -q ;;
   native) python -m pytest tests/test_native.py tests/test_ps.py -q ;;
@@ -22,6 +25,7 @@ case "$TIER" in
             tests/test_checkpoint_book.py tests/test_inference_dy2static.py -q ;;
   all)    python -m pytest tests/ -q
           python tools/trace_summary.py --selftest
-          python tools/health_dump.py --selftest ;;
+          python tools/health_dump.py --selftest
+          python tools/health_dump.py numerics --selftest ;;
   *) echo "usage: $0 [fast|dist|native|e2e|all]"; exit 1 ;;
 esac
