@@ -523,9 +523,28 @@ def _attach_telemetry(r):
                 'nonfinite_steps': numerics.get('nonfinite_steps'),
                 'amp_skipped_steps': numerics.get('amp_skipped_steps'),
             },
+            # gradient-comm model from the bucketed engines + persistent
+            # compile cache (docs/performance.md) — the ISSUE 4
+            # comm-bytes-drop acceptance number lives under
+            # comm.comm_bytes_drop_vs_per_param_psum
+            'comm': snap.get('comm'),
+            'compile_cache': snap.get('compile_cache'),
         }
     except Exception as e:
         r['telemetry'] = {'error': repr(e)[:200]}
+    try:
+        # per-leg memory census: per-phase high-water marks + live-buffer
+        # walk — the optimizer-state-sharding savings show up here
+        from paddle_tpu.core import memory as _mem
+        acct = _mem.accountant()
+        r['memory'] = {
+            'sample': acct.sample(count_buffers=True),
+            'phases': {k: {f: v.get(f) for f in
+                           ('high_water', 'max_delta', 'calls')}
+                       for k, v in acct.phases().items()},
+        }
+    except Exception as e:
+        r['memory'] = {'error': repr(e)[:200]}
     return r
 
 
@@ -574,6 +593,7 @@ def main():
         'live_buffers_after_shutdown':
             g.get('live_buffers_after_shutdown'),
         'live_bytes_after_shutdown': g.get('live_bytes_after_shutdown'),
+        'memory': g.get('memory'),
     }
     try:
         s = run('gpt_sgd')
@@ -581,6 +601,7 @@ def main():
             'mfu': round(s['mfu'], 4),
             'ms_per_step': round(s['ms_per_step'], 1),
             'tokens_per_sec': round(s['tokens_per_sec'], 1),
+            'memory': s.get('memory'),
         }
     except Exception as e:           # headline must still print
         detail['gpt1.3b_sgd'] = {'error': repr(e)[:200]}
@@ -590,6 +611,7 @@ def main():
             'samples_per_sec': round(b['samples_per_sec'], 2),
             'ms_per_step': round(b['ms_per_step'], 1),
             'mfu': round(b['mfu'], 4),
+            'memory': b.get('memory'),
         }
     except Exception as e:           # headline must still print
         detail['bert_base_zero2_bf16'] = {'error': repr(e)[:200]}

@@ -125,24 +125,36 @@ class GradScaler:
     def unscale_(self, optimizer):
         """Parity: check_finite_and_unscale (operators/amp/...cc:138).
 
-        The finite check is fused device-side: every grad contributes
-        one `any(~isfinite)` scalar, the scalars reduce on device, and
-        a SINGLE host sync reads the verdict (the seed synced once per
+        Bucketed (ISSUE 4): grads flatten into the dtype-homogeneous
+        buckets of core/bucketing.py, the unscale multiply and the
+        finite check run per BUCKET (a handful of fused kernels instead
+        of one chain per parameter), and a SINGLE host sync — routed
+        through the numerics observatory's fetch hook so tests can
+        count it — reads the verdict (the seed synced once per
         parameter — a per-step latency cliff at transformer param
         counts)."""
         if not self._enable or self._unscaled:
             return
         params = optimizer._parameter_list or []
+        grads = [p.grad for p in params if p.grad is not None]
+        if not grads:
+            self._found_inf = False
+            self._unscaled = True
+            return
+        from ..core import bucketing as B
+        from ..core import numerics as _num
         inv = 1.0 / self._scale
-        flags = []
-        for p in params:
-            if p.grad is None:
-                continue
-            g = p.grad.data.astype(jnp.float32) * inv
-            flags.append(jnp.any(~jnp.isfinite(g)))
-            p.grad.data = g.astype(p.grad.dtype)
-        self._found_inf = bool(jnp.any(jnp.stack(flags))) if flags \
-            else False
+        layout, flats = B.flatten_grad_list(grads)
+        flags, out = [], []
+        for f in flats:
+            f32 = f.astype(jnp.float32) * inv
+            flags.append(jnp.any(~jnp.isfinite(f32)))
+            out.append(f32)
+        unflat = layout.unflatten(out)
+        for i, g in enumerate(grads):
+            g.data = unflat[str(i)].astype(g.data.dtype)
+        self._found_inf = bool(_num._host_fetch(
+            jnp.any(jnp.stack(flags))))
         self._unscaled = True
 
     def _publish_metrics(self, skipped):

@@ -1,0 +1,114 @@
+"""Persistent XLA compilation cache (ISSUE 4 satellite).
+
+Enables JAX's on-disk compilation cache behind `PTPU_COMPILE_CACHE_DIR`
+and surfaces its traffic as `ptpu_compile_cache_*` metrics beside the
+executor's in-process fingerprint-cache counters
+(STAT_executor_cache_hit/miss): at GPT scale one warm cache turns the
+minutes-long first dispatch into a disk read, and the gauges make the
+saving visible in StepTelemetry / bench records / health_dump.
+
+jax 0.4.x emits monitoring events for the cache
+(`/jax/compilation_cache/compile_requests_use_cache`, `.../cache_hits`,
+and the `.../compile_time_saved_sec` duration); there is no miss event,
+so misses are derived as requests - hits.
+"""
+import os
+import threading
+
+_lock = threading.Lock()
+_installed = False
+_enabled_dir = None
+
+
+def _install_listeners():
+    global _installed
+    with _lock:
+        if _installed:
+            return
+        _installed = True
+    from jax import monitoring
+    from . import monitor as _m
+
+    def on_event(event, **kwargs):
+        if event == '/jax/compilation_cache/compile_requests_use_cache':
+            _m.counter('ptpu_compile_cache_requests_total',
+                       help='XLA compiles that consulted the persistent '
+                            'cache').inc(1)
+        elif event == '/jax/compilation_cache/cache_hits':
+            _m.counter('ptpu_compile_cache_hits_total',
+                       help='persistent compilation cache hits').inc(1)
+
+    def on_duration(event, duration, **kwargs):
+        if event == '/jax/compilation_cache/compile_time_saved_sec':
+            _m.counter('ptpu_compile_cache_seconds_saved_total',
+                       help='compile seconds avoided via the persistent '
+                            'cache').inc(max(float(duration), 0.0))
+
+    monitoring.register_event_listener(on_event)
+    monitoring.register_event_duration_secs_listener(on_duration)
+
+
+def enable(cache_dir, min_compile_seconds=0.0):
+    """Point jax at an on-disk compilation cache and install the
+    metric listeners. `min_compile_seconds=0` caches every program
+    (jax's default of 1s would skip the small ones tests compile)."""
+    global _enabled_dir
+    import jax
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update('jax_compilation_cache_dir', str(cache_dir))
+    try:
+        jax.config.update('jax_persistent_cache_min_compile_time_secs',
+                          float(min_compile_seconds))
+        jax.config.update('jax_persistent_cache_min_entry_size_bytes', -1)
+    except Exception:
+        pass   # older jax: defaults still cache the big programs
+    _install_listeners()
+    _enabled_dir = str(cache_dir)
+    return True
+
+
+def enable_from_env():
+    """Called at `import paddle_tpu`; no-op unless
+    PTPU_COMPILE_CACHE_DIR is set. A bad dir or malformed min-seconds
+    must not kill every `import paddle_tpu` over an optional perf
+    feature — warn and run uncached instead."""
+    d = os.environ.get('PTPU_COMPILE_CACHE_DIR')
+    if not d:
+        return False
+    try:
+        mins = float(os.environ.get('PTPU_COMPILE_CACHE_MIN_COMPILE_SECS',
+                                    0.0) or 0.0)
+        return enable(d, min_compile_seconds=mins)
+    except Exception as e:   # noqa: BLE001
+        import warnings
+        warnings.warn(
+            f'PTPU_COMPILE_CACHE_DIR={d!r}: persistent compile cache '
+            f'disabled ({e!r})', RuntimeWarning)
+        return False
+
+
+def enabled():
+    return _enabled_dir is not None
+
+
+def snapshot():
+    """JSON-ready cache-traffic view (StepTelemetry / bench /
+    health_dump)."""
+    from . import monitor as _m
+
+    def total(name):
+        m = _m.metrics().get(name)
+        if m is None:
+            return 0.0
+        return sum(c.value() for c in m._series().values())
+    requests = int(total('ptpu_compile_cache_requests_total'))
+    hits = int(total('ptpu_compile_cache_hits_total'))
+    return {
+        'enabled': enabled(),
+        'dir': _enabled_dir,
+        'requests': requests,
+        'hits': hits,
+        'misses': max(requests - hits, 0),
+        'seconds_saved': round(
+            total('ptpu_compile_cache_seconds_saved_total'), 3),
+    }

@@ -32,10 +32,13 @@ from jax.experimental.shard_map import shard_map
 
 from ....core import rng as rng_mod
 from ....core import autograd
+from ....core import bucketing as B
 from ....core.tensor import Tensor
 from ....jit import bind_arrays
 from ... import collective as C
 from ... import topology_runtime
+
+
 
 
 def _param_spec(p, mesh_axes, zero_axis=None):
@@ -63,7 +66,8 @@ class HybridParallelTrainStep(EngineTeardown):
     """
 
     def __init__(self, model, loss_fn, optimizer, mesh=None,
-                 accumulate_steps=1, use_remat=False, sp_shard_args=None):
+                 accumulate_steps=1, use_remat=False, sp_shard_args=None,
+                 use_buckets=None, comm_dtype=None, bucket_mb=None):
         self.sp_shard_args = sp_shard_args
         self.model = model
         self.loss_fn = loss_fn
@@ -98,13 +102,51 @@ class HybridParallelTrainStep(EngineTeardown):
                            and p.split_axis == 0))
             self._zero_ok[n] = ok
 
+        # -- bucketed rs/ag weight-update sharding (arXiv:2004.13336) ------
+        # data-parallel replication axes: every rank along them holds the
+        # same params and a different batch shard — grads mean-reduce over
+        # them and the weight update can shard 1/n per rank.
+        self._rs_axes = tuple(a for a in ('dp', 'sharding', 'sp')
+                              if a in self.axes and self.mesh.shape[a] > 1)
+        self._n_shards = int(np.prod([self.mesh.shape[a]
+                                      for a in self._rs_axes] or [1]))
+        self.comm_dtype, self._bucket_bytes = B.resolve_comm_config(
+            comm_dtype, bucket_mb)
+        # mp-sharded params are already distributed (their state shards
+        # with them); they keep the per-param path
+        bucketable = [n for n, p in named
+                      if not (getattr(p, 'is_distributed', False)
+                              and 'mp' in self.axes and self.mp > 1)]
+        self._layout = None
+        if bucketable and B.elementwise(optimizer):
+            self._layout = B.BucketLayout.build(
+                {n: (self._params_by_name[n].data.shape,
+                     self._params_by_name[n].data.dtype)
+                 for n in bucketable},
+                bucket_bytes=self._bucket_bytes,
+                pad_to=max(self._n_shards, 1) * 8)
+        self._bucketed = bool(
+            self._layout is not None and self._n_shards > 1
+            and use_buckets is not False)
+        if self._layout is not None:
+            B.publish_comm_gauges(self._layout, engine='hybrid',
+                                  n_shards=max(self._n_shards, 1),
+                                  comm_dtype=self.comm_dtype,
+                                  enabled=self._bucketed)
+        if not self._bucketed:
+            self._layout = None
+
         from ....core import memory as _mem
         with _mem.phase('engine.init'):
             self._params = {n: self._place(p.data, self._param_specs[n])
                             for n, p in named}
-            self._states = {}
-            self._state_specs = {}
+            self._states = {'named': {}, 'buckets': []}
+            self._state_specs = {'named': {}, 'buckets': []}
+            legacy_names = set(self._names) if not self._bucketed else \
+                set(self._names) - set(self._layout.slots)
             for n, p in named:
+                if n not in legacy_names:
+                    continue
                 st = optimizer.init_state(p)
                 if p.data.dtype != jnp.float32 and \
                         getattr(optimizer, '_multi_precision', True):
@@ -122,13 +164,48 @@ class HybridParallelTrainStep(EngineTeardown):
                             np.ndim(v) >= 1 and v.shape == p.data.shape) \
                             else P()
                     st[k] = self._place(v, sspec[k])
-                self._states[n] = st
-                self._state_specs[n] = sspec
+                self._states['named'][n] = st
+                self._state_specs['named'][n] = sspec
+            if self._bucketed:
+                self._init_flat_states()
 
         self._grad_clip = optimizer._grad_clip
         self._compiled = None
         self._closed = False
         self._step_count = 0
+
+    def _init_flat_states(self):
+        """Sharded flat optimizer state, one entry per bucket: vector
+        states (moments, fp32 master) are GLOBAL 1-D arrays of the
+        bucket's padded length sharded over the dp axes — each rank
+        materializes only its 1/n shard (ZeRO-1); scalars (beta powers)
+        replicate. Built via make_array_from_callback so no device ever
+        holds a full fp32 replica."""
+        opt = self.optimizer
+        shard_spec = P(self._rs_axes)
+        for b in self._layout.buckets:
+            flat32 = np.zeros((b.size,), np.float32)
+            for s in b.slots:
+                flat32[s.offset:s.offset + s.size] = np.asarray(
+                    jax.device_get(self._params_by_name[s.name].data),
+                    np.float32).reshape(-1)
+            st = B.init_bucket_state(opt, b, flat32)
+            placed, sspec = {}, {}
+            for k, v in st.items():
+                if np.ndim(v) >= 1:
+                    placed[k] = self._place_flat(v, shard_spec)
+                    sspec[k] = shard_spec
+                else:
+                    placed[k] = self._place(v, P())
+                    sspec[k] = P()
+            self._states['buckets'].append(placed)
+            self._state_specs['buckets'].append(sspec)
+
+    def _place_flat(self, host_arr, spec):
+        host_arr = np.asarray(host_arr)
+        sh = NamedSharding(self.mesh, spec)
+        return jax.make_array_from_callback(
+            host_arr.shape, sh, lambda idx: host_arr[idx])
 
     def _place(self, arr, spec):
         # copy before placing: device_put to a (partially) replicated
@@ -147,9 +224,10 @@ class HybridParallelTrainStep(EngineTeardown):
         from ....core import numerics as _num
         taps_on = self._taps_on = _num.taps_enabled()
         # axes whose shards see different data → loss/grad pmean + distinct
-        # dropout keys ('sp' chunks are different tokens, like dp shards)
-        dp_axes = tuple(a for a in ('dp', 'sharding', 'sp') if a in axes
-                        and self.mesh.shape[a] > 1)
+        # dropout keys ('sp' chunks are different tokens, like dp shards).
+        # Must stay the SAME axis set the bucket reduce_scatter and the
+        # P(_rs_axes) flat-state sharding use, or grads and params desync.
+        dp_axes = self._rs_axes
         zero_ok = self._zero_ok
         s = self.sharding_deg
         use_remat = self.use_remat
@@ -170,6 +248,29 @@ class HybridParallelTrainStep(EngineTeardown):
                 sq_d = lax.psum(sq_d, 'mp')
             return sq_d + sq_r
 
+        bucketed = self._bucketed
+        layout = self._layout
+        rs_axes = self._rs_axes
+        n_shards = self._n_shards
+        comm_dtype = self.comm_dtype
+
+        def clip_factor(gn_sq_val):
+            from ....nn.clip import ClipGradByGlobalNorm
+            if self._grad_clip is None:
+                return None
+            if not (isinstance(self._grad_clip, ClipGradByGlobalNorm)
+                    or hasattr(self._grad_clip, '_clip')):
+                return None
+            clip_norm = getattr(self._grad_clip, 'clip_norm',
+                                None) or getattr(
+                    getattr(self._grad_clip, '_clip', None),
+                    'clip_norm', 1.0)
+            gn = jnp.sqrt(gn_sq_val)
+            return factor_from(gn, clip_norm)
+
+        def factor_from(gn, clip_norm):
+            return clip_norm / jnp.maximum(gn, clip_norm)
+
         def step(params, states, lr, key, *batch):
             with C.spmd_region(axes, sp_data_sharded=sp_on):
                 def loss_of(ps):
@@ -187,59 +288,149 @@ class HybridParallelTrainStep(EngineTeardown):
                     return loss.data.astype(jnp.float32)
 
                 lf = jax.checkpoint(loss_of) if use_remat else loss_of
-                loss, grads = jax.value_and_grad(lf)(params)
+                loss, raw_grads = jax.value_and_grad(lf)(params)
                 if dp_axes:
                     loss = lax.pmean(loss, dp_axes)
-                    grads = {n: lax.pmean(g, dp_axes)
-                             for n, g in grads.items()}
 
-                # numerics taps: PRE-CLIP grads (the clip below rebinds
-                # `grads` to a new dict) + the mesh-wide global
-                # grad-norm^2 (same reduction the clip uses)
-                gn_sq = None
-                preclip_grads = grads
-                if taps_on:
-                    gn_sq = global_norm_sq(grads)
+                named_states = states['named']
+                if not bucketed:
+                    grads = raw_grads
+                    if dp_axes:
+                        grads = {n: lax.pmean(g, dp_axes)
+                                 for n, g in grads.items()}
 
-                # mesh-aware global-norm clip (parity:
-                # HybridParallelClipGrad, hybrid_parallel_optimizer.py:32)
-                if self._grad_clip is not None:
-                    from ....nn.clip import ClipGradByGlobalNorm
-                    if isinstance(self._grad_clip, ClipGradByGlobalNorm) or \
-                            hasattr(self._grad_clip, '_clip'):
-                        clip_norm = getattr(self._grad_clip, 'clip_norm',
-                                            None) or getattr(
-                                getattr(self._grad_clip, '_clip', None),
-                                'clip_norm', 1.0)
-                        # taps (pre-clip, same grads) already built the
-                        # mesh-wide norm^2 — reuse it
-                        gn = jnp.sqrt(gn_sq if gn_sq is not None
-                                      else global_norm_sq(grads))
-                        factor = clip_norm / jnp.maximum(gn, clip_norm)
+                    # numerics taps: PRE-CLIP grads (the clip below rebinds
+                    # `grads` to a new dict) + the mesh-wide global
+                    # grad-norm^2 (same reduction the clip uses)
+                    gn_sq = None
+                    preclip_grads = grads
+                    if taps_on:
+                        gn_sq = global_norm_sq(grads)
+
+                    # mesh-aware global-norm clip (parity:
+                    # HybridParallelClipGrad,
+                    # hybrid_parallel_optimizer.py:32)
+                    factor = clip_factor(
+                        gn_sq if gn_sq is not None
+                        else global_norm_sq(grads)) \
+                        if self._grad_clip is not None else None
+                    if factor is not None:
                         grads = {n: (g.astype(jnp.float32) * factor)
                                  .astype(g.dtype)
                                  for n, g in grads.items()}
 
-                new_params, new_states = {}, {}
-                for n, p in params.items():
-                    g = grads[n]
-                    st = dict(states[n])
+                    new_params, new_named = {}, {}
+                    for n, p in params.items():
+                        g = grads[n]
+                        st = dict(named_states[n])
+                        if zero_ok[n] and 'sharding' in axes and s > 1:
+                            # ZeRO-1: reduce-scatter grad, update local
+                            # shard, all-gather updated param.
+                            rows = p.shape[0] // s
+                            idx = lax.axis_index('sharding')
+                            g_shard = lax.dynamic_slice_in_dim(
+                                g, idx * rows, rows, axis=0)
+                            p_shard = lax.dynamic_slice_in_dim(
+                                p, idx * rows, rows, axis=0)
+                            np_, ns = self._update_one(p_shard, g_shard,
+                                                       st, lr)
+                            p_new = lax.all_gather(np_, 'sharding', axis=0,
+                                                   tiled=True)
+                        else:
+                            p_new, ns = self._update_one(p, g, st, lr)
+                        new_params[n] = p_new
+                        new_named[n] = ns
+                    new_states = {'named': new_named, 'buckets': []}
+                    if taps_on:
+                        taps = _num.jit_taps(preclip_grads, new_params,
+                                             extra_norm_sq=gn_sq)
+                        return loss, new_params, new_states, taps
+                    return loss, new_params, new_states
+
+                # -- bucketed path (arXiv:2004.13336): flatten grads into
+                # dtype-homogeneous buckets, ONE reduce_scatter per bucket
+                # over the dp axes (compressed wire under comm_dtype),
+                # sharded optimizer update on this rank's 1/n slice, ONE
+                # all_gather per bucket for the updated params -----------
+                legacy = {n: g for n, g in raw_grads.items()
+                          if n not in layout.slots}
+                if dp_axes:
+                    legacy = {n: lax.pmean(g, dp_axes)
+                              for n, g in legacy.items()}
+                flat_grads = layout.flatten(
+                    {n: raw_grads[n] for n in layout.slots})
+                shards32 = [B.reduce_scatter(f, rs_axes, n_shards,
+                                             comm_dtype=comm_dtype,
+                                             mean=True)
+                            for f in flat_grads]
+
+                # taps diagnostics mode pays an extra pmean to surface
+                # fully-reduced per-param grads (the bucketed hot path
+                # never materializes them)
+                gn_sq = None
+                preclip_grads = None
+                if taps_on:
+                    preclip_grads = dict(legacy)
+                    preclip_grads.update(
+                        {n: (lax.pmean(raw_grads[n], dp_axes)
+                             if dp_axes else raw_grads[n])
+                         for n in layout.slots})
+                    gn_sq = global_norm_sq(preclip_grads)
+
+                factor = None
+                if self._grad_clip is not None:
+                    # global grad-norm^2 from the bucket shards: shards
+                    # are disjoint over the dp axes, so one psum restores
+                    # the full sum; legacy (mp-sharded) params add their
+                    # psum('mp') contribution exactly as the per-param
+                    # path does
+                    sq_local = sum(jnp.sum(g * g) for g in shards32) \
+                        if shards32 else jnp.asarray(0.0, jnp.float32)
+                    sq_b = lax.psum(sq_local, rs_axes) if rs_axes \
+                        else sq_local
+                    sq_b = sq_b + (global_norm_sq(legacy) if legacy
+                                   else jnp.asarray(0.0, jnp.float32))
+                    factor = clip_factor(sq_b)
+                if factor is not None:
+                    shards32 = [g * factor for g in shards32]
+                    legacy = {n: (g.astype(jnp.float32) * factor)
+                              .astype(g.dtype)
+                              for n, g in legacy.items()}
+
+                flat_params = layout.flatten(params)
+                new_params, new_named = {}, {}
+                new_buckets = []
+                gathered = []
+                for b, pf, g32, st in zip(layout.buckets, flat_params,
+                                          shards32, states['buckets']):
+                    p_shard = B.take_shard(pf, rs_axes, n_shards)
+                    np_, ns = B.shard_update(self.optimizer, p_shard,
+                                             g32, st, lr)
+                    gathered.append(B.all_gather(np_, rs_axes))
+                    new_buckets.append(ns)
+                new_params.update(layout.unflatten(gathered))
+                for n, g in legacy.items():
+                    p = params[n]
+                    st = dict(named_states[n])
                     if zero_ok[n] and 'sharding' in axes and s > 1:
-                        # ZeRO-1: reduce-scatter grad, update local shard,
-                        # all-gather updated param.
+                        # mp-sharded params keep the per-param ZeRO-1
+                        # slice over 'sharding' (their states were
+                        # created with that spec)
                         rows = p.shape[0] // s
                         idx = lax.axis_index('sharding')
                         g_shard = lax.dynamic_slice_in_dim(
                             g, idx * rows, rows, axis=0)
                         p_shard = lax.dynamic_slice_in_dim(
                             p, idx * rows, rows, axis=0)
-                        np_, ns = self._update_one(p_shard, g_shard, st, lr)
-                        p_new = lax.all_gather(np_, 'sharding', axis=0,
-                                               tiled=True)
+                        np_, ns = self._update_one(p_shard, g_shard,
+                                                   st, lr)
+                        np_ = lax.all_gather(np_, 'sharding', axis=0,
+                                             tiled=True)
                     else:
-                        p_new, ns = self._update_one(p, g, st, lr)
-                    new_params[n] = p_new
-                    new_states[n] = ns
+                        np_, ns = self._update_one(p, g, st, lr)
+                    new_params[n] = np_
+                    new_named[n] = ns
+                new_states = {'named': new_named, 'buckets': new_buckets}
                 if taps_on:
                     taps = _num.jit_taps(preclip_grads, new_params,
                                          extra_norm_sq=gn_sq)
@@ -366,25 +557,54 @@ class HybridParallelTrainStep(EngineTeardown):
     # -- checkpoint (parity: fleet.save/set_state_dict re-broadcast flow,
     # SURVEY.md §5.4) --------------------------------------------------------
     def state_dict(self):
+        """Checkpoint in the stable PER-PARAMETER schema regardless of
+        the runtime state layout: flat sharded bucket states are
+        converted back through the layout map, so a checkpoint written
+        by a bucketed engine restores into a legacy one and vice
+        versa."""
         import numpy as _np
         import jax as _jax
         out = {'params': {}, 'states': {}}
         for n, a in self._params.items():
             out['params'][n] = _np.asarray(_jax.device_get(a))
-        for n, st in self._states.items():
+        for n, st in self._states['named'].items():
             out['states'][n] = {k: _np.asarray(_jax.device_get(v))
                                 for k, v in st.items()}
+        if self._bucketed:
+            host_flat = [{k: _np.asarray(_jax.device_get(v))
+                          for k, v in st.items()}
+                         for st in self._states['buckets']]
+            out['states'].update(
+                B.flat_states_to_named(self._layout, host_flat))
         out['step'] = self._step_count
         return out
 
     def set_state_dict(self, sd):
+        import numpy as _np
+        import jax as _jax
         for n, a in sd['params'].items():
             if n in self._params:
                 self._params[n] = self._place(a, self._param_specs[n])
-        for n, st in sd.get('states', {}).items():
-            if n in self._states:
+        named_sd = dict(sd.get('states', {}))
+        if self._bucketed:
+            template = [{k: _np.asarray(_jax.device_get(v))
+                         for k, v in st.items()}
+                        for st in self._states['buckets']]
+            flat = B.named_states_to_flat(
+                self._layout,
+                {n: named_sd.pop(n) for n in list(named_sd)
+                 if n in self._layout.slots},
+                template)
+            for i, st in enumerate(flat):
                 for k, v in st.items():
-                    if k in self._state_specs[n]:
-                        self._states[n][k] = self._place(
-                            v, self._state_specs[n][k])
+                    spec = self._state_specs['buckets'][i][k]
+                    self._states['buckets'][i][k] = (
+                        self._place_flat(v, spec) if _np.ndim(v) >= 1
+                        else self._place(v, spec))
+        for n, st in named_sd.items():
+            if n in self._states['named']:
+                for k, v in st.items():
+                    if k in self._state_specs['named'][n]:
+                        self._states['named'][n][k] = self._place(
+                            v, self._state_specs['named'][n][k])
         self._step_count = sd.get('step', 0)

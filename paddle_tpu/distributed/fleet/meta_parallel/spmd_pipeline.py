@@ -40,6 +40,7 @@ from jax.experimental.shard_map import shard_map
 
 from ....core import rng as rng_mod
 from ....core import autograd
+from ....core import bucketing as B
 from ....core.tensor import Tensor
 from ....jit import bind_arrays
 from ... import collective as C
@@ -171,7 +172,8 @@ class SpmdPipelineEngine(EngineTeardown):
 
     def __init__(self, embed, blocks, head, optimizer, accumulate_steps,
                  mesh=None, use_remat=True, schedule='1F1B',
-                 grad_accum_dtype='float32', memory_mode='stash'):
+                 grad_accum_dtype='float32', memory_mode='stash',
+                 use_buckets=None, comm_dtype=None, bucket_mb=None):
         self.embed = embed
         self.blocks = blocks
         self.head = head
@@ -244,13 +246,62 @@ class SpmdPipelineEngine(EngineTeardown):
                          for n, p in self._head_named},
             }
 
-            # optimizer state mirrors the param tree
+            # -- bucketed rs/ag weight-update sharding over 'dp'
+            # (arXiv:2004.13336): grads coalesce into flat buckets, each
+            # dp rank owns a 1/dp shard of params+moments. Blocks are
+            # stage-LOCAL (their buckets key separately and their flat
+            # states carry a leading pp dim); mp-sharded params keep the
+            # per-param path.
+            self.comm_dtype, self._bucket_bytes = B.resolve_comm_config(
+                comm_dtype, bucket_mb)
+            dp_on_init = 'dp' in self.axes and self.mesh.shape['dp'] > 1
+            self._pp_layout = None
+            mp_on = 'mp' in self.axes and self.mesh.shape['mp'] > 1
+            if B.elementwise(optimizer):
+                local_shapes = {}
+                for grp, named_list in (('embed', self._embed_named),
+                                        ('blocks', self._block_named),
+                                        ('head', self._head_named)):
+                    for n, p in named_list:
+                        if getattr(p, 'is_distributed', False) and mp_on:
+                            continue
+                        shp = tuple(p.data.shape)
+                        if grp == 'blocks':
+                            shp = (len(blocks) // max(self.pp, 1),) + shp
+                        local_shapes[f'{grp}/{n}'] = (shp, p.data.dtype)
+                if local_shapes:
+                    self._pp_layout = B.BucketLayout.build(
+                        local_shapes, bucket_bytes=self._bucket_bytes,
+                        pad_to=max(self.dp, 1) * 8,
+                        group_fn=lambda name, shape, dtype:
+                            'blocks' if name.startswith('blocks/')
+                            else 'repl')
+            self._pp_bucketed = bool(
+                self._pp_layout is not None and dp_on_init
+                and use_buckets is not False)
+            if self._pp_layout is not None:
+                accum_fp32 = self.grad_accum_dtype != 'param'
+                B.publish_comm_gauges(
+                    self._pp_layout, engine='pipeline',
+                    n_shards=max(self.dp, 1),
+                    comm_dtype=self.comm_dtype or (
+                        jnp.float32 if accum_fp32 else None),
+                    enabled=self._pp_bucketed)
+            if not self._pp_bucketed:
+                self._pp_layout = None
+
+            # optimizer state mirrors the param tree (per-param states
+            # only for params outside the bucket layout)
             self._states = {}
             self._state_specs = {}
+            in_layout = set(self._pp_layout.slots) if self._pp_bucketed \
+                else set()
             for grp in ('embed', 'blocks', 'head'):
                 self._states[grp] = {}
                 self._state_specs[grp] = {}
                 for n, arr in self._params[grp].items():
+                    if f'{grp}/{n}' in in_layout:
+                        continue
                     st = {}
                     sspec = {}
                     tmpl = optimizer.init_state(Tensor(
@@ -271,10 +322,59 @@ class SpmdPipelineEngine(EngineTeardown):
                         sspec[k] = spec
                     self._states[grp][n] = st
                     self._state_specs[grp][n] = sspec
+            self._states['_buckets'] = []
+            self._state_specs['_buckets'] = []
+            if self._pp_bucketed:
+                self._init_flat_states(stacked)
 
         self._compiled = None
         self._closed = False
         self._grad_clip = optimizer._grad_clip
+
+    def _init_flat_states(self, stacked):
+        """Flat sharded optimizer state per bucket. Every vector state is
+        a GLOBAL [pp, bucket_size] array sharded P('pp' on dim 0, 'dp'
+        on dim 1): each device holds the [1, size/dp] shard it updates.
+        Stage-local (blocks) buckets genuinely differ along pp;
+        replicated (embed/head) buckets carry identical rows — same
+        per-device bytes either way, and one uniform spec."""
+        opt = self.optimizer
+        pp = max(self.pp, 1)
+        pp_ax = 'pp' if 'pp' in self.axes else None
+        vec_spec = P(pp_ax, 'dp')
+        for b in self._pp_layout.buckets:
+            # host-side initial fp32 values, per stage row
+            flat32 = np.zeros((pp, b.size), np.float32)
+            for s in b.slots:
+                grp, n = s.name.split('/', 1)
+                if grp == 'blocks':
+                    arr = np.asarray(jax.device_get(stacked[n]), np.float32)
+                    per = arr.shape[0] // pp
+                    for k in range(pp):
+                        flat32[k, s.offset:s.offset + s.size] = \
+                            arr[k * per:(k + 1) * per].reshape(-1)
+                else:
+                    named = dict(self._embed_named if grp == 'embed'
+                                 else self._head_named)
+                    row = np.asarray(jax.device_get(named[n].data),
+                                     np.float32).reshape(-1)
+                    flat32[:, s.offset:s.offset + s.size] = row
+            st = B.init_bucket_state(opt, b, flat32[0])
+            placed, sspec = {}, {}
+            for k, v in st.items():
+                if np.ndim(v) >= 1:
+                    host = flat32 if k == 'master' else np.broadcast_to(
+                        np.asarray(v), (pp, b.size))
+                    sharding = NamedSharding(self.mesh, vec_spec)
+                    placed[k] = jax.make_array_from_callback(
+                        host.shape, sharding,
+                        lambda idx, _h=host: _h[idx])
+                    sspec[k] = vec_spec
+                else:
+                    placed[k] = self._place(v, P())
+                    sspec[k] = P()
+            self._states['_buckets'].append(placed)
+            self._state_specs['_buckets'].append(sspec)
 
     def _place(self, arr, spec):
         # copy before placing: device_put to a (partially) replicated
@@ -337,6 +437,9 @@ class SpmdPipelineEngine(EngineTeardown):
         hybrid_parallel_gradscaler.py — found_inf is global after the
         psum/pmean sync, since an inf on any rank infects the reduced
         value)."""
+        if getattr(self, '_pp_bucketed', False):
+            return self._bucketed_reduce_and_update(
+                params, states, loss, grads, lr, dp_on, scale=scale)
         pp = self.pp
         if pp > 1:
             loss = lax.psum(loss, 'pp')  # only last stage ≠ 0
@@ -409,7 +512,7 @@ class SpmdPipelineEngine(EngineTeardown):
                           for grp in ('embed', 'blocks', 'head')
                           for n, g in grads[grp].items()}
 
-        new_params, new_states = {}, {}
+        new_params, new_states = {}, {'_buckets': []}
         for grp in ('embed', 'blocks', 'head'):
             new_params[grp], new_states[grp] = {}, {}
             for n, p in params[grp].items():
@@ -428,6 +531,158 @@ class SpmdPipelineEngine(EngineTeardown):
                            for grp in ('embed', 'blocks', 'head')
                            for n, p in new_params[grp].items()}
             taps = _num.jit_taps(flat_grads, flat_params,
+                                 extra_norm_sq=gn_sq)
+            return loss, new_params, new_states, found_inf, taps
+        return loss, new_params, new_states, found_inf
+
+    def _bucketed_reduce_and_update(self, params, states, loss, grads, lr,
+                                    dp_on, scale=None):
+        """Bucketed twin of `_reduce_and_update` (arXiv:2004.13336):
+        embed/head grads still psum over 'pp' (tied-weight sync), then
+        every eligible grad coalesces into flat buckets, each bucket
+        moves through ONE reduce_scatter over 'dp' (compressed wire
+        under `comm_dtype`), this rank updates its 1/dp shard of params
+        + optimizer moments, and ONE all_gather per bucket rebuilds the
+        updated params. mp-sharded params fall back to the per-param
+        path; a nonfinite gradient anywhere still skips the whole
+        update (found_inf pmax over dp and pp — shards differ per dp
+        rank, so the dp reduction is load-bearing here)."""
+        pp = self.pp
+        layout = self._pp_layout
+        if pp > 1:
+            loss = lax.psum(loss, 'pp')  # only last stage ≠ 0
+        if dp_on:
+            loss = lax.pmean(loss, 'dp')
+
+        def pp_sync(tree):
+            if pp > 1:
+                return jax.tree_util.tree_map(
+                    lambda g: lax.psum(g, 'pp'), tree)
+            return tree
+
+        grads = {'embed': pp_sync(grads['embed']),
+                 'blocks': grads['blocks'],
+                 'head': pp_sync(grads['head'])}
+        flat_named = {f'{grp}/{n}': g
+                      for grp in ('embed', 'blocks', 'head')
+                      for n, g in grads[grp].items()}
+        accum_fp32 = self.grad_accum_dtype != 'param'
+        legacy = {k: v for k, v in flat_named.items()
+                  if k not in layout.slots}
+        if dp_on:
+            legacy = {k: lax.pmean(v, 'dp') for k, v in legacy.items()}
+        flat_grads = layout.flatten(
+            {k: flat_named[k] for k in layout.slots},
+            cast=jnp.float32 if accum_fp32 else None)
+        shards32 = [B.reduce_scatter(f, ('dp',), self.dp,
+                                     comm_dtype=self.comm_dtype,
+                                     mean=True)
+                    for f in flat_grads]
+
+        # trace-time telemetry: rs+ag payload replayed every step
+        from ....core.monitor import counter
+        nbytes = sum(b.nbytes(self.comm_dtype or (
+            jnp.float32 if accum_fp32 else None)) + b.nbytes()
+            for b in layout.buckets)
+        counter('ptpu_collective_bytes_total',
+                help='payload bytes through collective APIs',
+                labelnames=('op',)).inc(nbytes, op='pipeline_bucket_rs_ag')
+        counter('ptpu_collective_calls_total',
+                help='collective API invocations',
+                labelnames=('op',)).inc(2 * len(layout.buckets),
+                                        op='pipeline_bucket_rs_ag')
+
+        found_inf = jnp.asarray(False)
+        inv = None
+        if scale is not None:
+            flags = [jnp.any(~jnp.isfinite(g)) for g in shards32]
+            flags += [jnp.any(~jnp.isfinite(v)) for v in legacy.values()]
+            f = (jnp.any(jnp.stack(flags)) if flags
+                 else jnp.asarray(False)).astype(jnp.int32)
+            if dp_on:
+                f = lax.pmax(f, 'dp')
+            if pp > 1:
+                f = lax.pmax(f, 'pp')
+            found_inf = f > 0
+            inv = (1.0 / scale).astype(jnp.float32)
+            shards32 = [g * inv for g in shards32]
+            legacy = {k: (v.astype(jnp.float32) * inv).astype(v.dtype)
+                      for k, v in legacy.items()}
+
+        # numerics taps (diagnostics mode): the hot path never
+        # materializes fully-reduced per-param grads, so pay one extra
+        # pmean per param to surface them — observation only, the
+        # update below still consumes the bucket shards
+        taps_on = getattr(self, '_taps_on', False)
+        tap_grads = gn_sq = None
+        if taps_on:
+            tap_grads = {}
+            for k in layout.slots:
+                g = flat_named[k]
+                g = lax.pmean(g, 'dp') if dp_on else g
+                if inv is not None:
+                    g = (g.astype(jnp.float32) * inv).astype(g.dtype)
+                tap_grads[k] = g
+            tap_grads.update(legacy)
+            sq_eh = jnp.asarray(0.0, jnp.float32)
+            sq_b = jnp.asarray(0.0, jnp.float32)
+            for k, g in tap_grads.items():
+                v = jnp.sum(g.astype(jnp.float32) ** 2)
+                if k.startswith('blocks/'):
+                    sq_b = sq_b + v
+                else:
+                    sq_eh = sq_eh + v
+            if pp > 1:
+                sq_b = lax.psum(sq_b, 'pp')
+            gn_sq = sq_eh + sq_b
+
+        slot_params = {k: params[k.split('/', 1)[0]][k.split('/', 1)[1]]
+                       for k in layout.slots}
+        flat_params = layout.flatten(slot_params)
+        new_flat, new_buckets = [], []
+        for b, pf, g32, st_in in zip(layout.buckets, flat_params,
+                                     shards32, states['_buckets']):
+            # local vector-state view is [1, shard]: drop/restore the
+            # leading pp dim around the flat update
+            st = {k: (v[0] if getattr(v, 'ndim', 0) >= 2 else v)
+                  for k, v in st_in.items()}
+            p_shard = B.take_shard(pf, ('dp',), self.dp)
+            np_, ns = B.shard_update(self.optimizer, p_shard, g32, st, lr)
+            if scale is not None:
+                np_ = jnp.where(found_inf, p_shard, np_)
+                ns = jax.tree_util.tree_map(
+                    lambda new, old: jnp.where(found_inf, old, new),
+                    ns, st)
+            new_buckets.append(
+                {k: (v[None] if getattr(v, 'ndim', 0) >= 1 else v)
+                 for k, v in ns.items()})
+            new_flat.append(B.all_gather(np_, ('dp',)))
+
+        new_params = {'embed': {}, 'blocks': {}, 'head': {}}
+        new_states = {'embed': {}, 'blocks': {}, 'head': {},
+                      '_buckets': new_buckets}
+        for k, v in layout.unflatten(new_flat).items():
+            grp, n = k.split('/', 1)
+            new_params[grp][n] = v
+        for k, g in legacy.items():
+            grp, n = k.split('/', 1)
+            p = params[grp][n]
+            old = dict(states[grp][n])
+            np_, ns = self._update_one(p, g, dict(old), lr)
+            if scale is not None:
+                np_ = jnp.where(found_inf, p, np_)
+                ns = jax.tree_util.tree_map(
+                    lambda new, old_: jnp.where(found_inf, old_, new),
+                    ns, old)
+            new_params[grp][n] = np_
+            new_states[grp][n] = ns
+
+        if taps_on:
+            from ....core import numerics as _num
+            flat_params_tap = {f'{grp}/{n}': p
+                               for grp in ('embed', 'blocks', 'head')
+                               for n, p in new_params[grp].items()}
+            taps = _num.jit_taps(tap_grads, flat_params_tap,
                                  extra_norm_sq=gn_sq)
             return loss, new_params, new_states, found_inf, taps
         return loss, new_params, new_states, found_inf

@@ -105,7 +105,9 @@ class TrainStep:
     loss_fn(model, *batch_tensors) -> scalar loss Tensor.
     """
 
-    def __init__(self, model, loss_fn, optimizer, donate=True):
+    def __init__(self, model, loss_fn, optimizer, donate=True,
+                 use_buckets=None):
+        from ..core import bucketing as B
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -118,15 +120,43 @@ class TrainStep:
         self._buffers = {n: jnp.array(a, copy=True)
                          for n, a in get_buffers(model).items()}
         lookup = dict(_named_params(model))
-        self._opt_states = {}
-        for n in self._param_names:
-            st = optimizer.init_state(lookup[n])
-            if lookup[n].data.dtype != jnp.float32 and \
-                    getattr(optimizer, '_multi_precision', True):
-                # pre-seed the fp32 master so the state pytree structure is
-                # stable across steps (lax.scan carry requirement)
-                st['master'] = lookup[n].data.astype(jnp.float32)
-            self._opt_states[n] = st
+        # bucketed optimizer phase (core/bucketing.py): elementwise
+        # optimizers update a handful of flat dtype-homogeneous buckets
+        # instead of one kernel chain per parameter — same math (the
+        # update is per-element), fewer/larger fused kernels
+        self._use_buckets = (use_buckets is not False
+                             and B.elementwise(optimizer)
+                             and bool(self._param_names))
+        if self._use_buckets:
+            _, bucket_bytes = B.resolve_comm_config()
+            self._layout = B.BucketLayout.build(
+                {n: (lookup[n].data.shape, lookup[n].data.dtype)
+                 for n in self._param_names},
+                bucket_bytes=bucket_bytes, pad_to=8)
+            self._opt_states = []
+            for b in self._layout.buckets:
+                flat32 = np.zeros((b.size,), np.float32)
+                for s in b.slots:
+                    flat32[s.offset:s.offset + s.size] = np.asarray(
+                        jax.device_get(lookup[s.name].data),
+                        np.float32).reshape(-1)
+                st = B.init_bucket_state(optimizer, b, flat32)
+                self._opt_states.append(
+                    {k: jnp.asarray(v) for k, v in st.items()})
+            B.publish_comm_gauges(self._layout, engine='jit', n_shards=1,
+                                  enabled=False)
+        else:
+            self._layout = None
+            self._opt_states = {}
+            for n in self._param_names:
+                st = optimizer.init_state(lookup[n])
+                if lookup[n].data.dtype != jnp.float32 and \
+                        getattr(optimizer, '_multi_precision', True):
+                    # pre-seed the fp32 master so the state pytree
+                    # structure is stable across steps (lax.scan carry
+                    # requirement)
+                    st['master'] = lookup[n].data.astype(jnp.float32)
+                self._opt_states[n] = st
         # numerics taps (core/numerics.py): latched here — they change
         # the compiled step's output tree, so set FLAGS before building
         from ..core import numerics as _num
@@ -148,8 +178,13 @@ class TrainStep:
 
         (loss, new_buffers), grads = jax.value_and_grad(
             loss_of, has_aux=True)(params, buffers)
-        new_params, new_states = opt.functional_apply(params, grads,
-                                                      opt_states, lr)
+        if self._use_buckets:
+            from ..core import bucketing as B
+            new_params, new_states = B.flat_functional_apply(
+                opt, self._layout, params, grads, opt_states, lr)
+        else:
+            new_params, new_states = opt.functional_apply(params, grads,
+                                                          opt_states, lr)
         if self._taps_on:
             from ..core import numerics as _num
             taps = _num.jit_taps(grads, new_params)

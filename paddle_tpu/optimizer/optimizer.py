@@ -24,6 +24,12 @@ from .lr import LRScheduler
 
 
 class Optimizer:
+    # True when `update` is strictly per-element (no per-PARAMETER
+    # norms/quantiles), so the bucketed/sharded flat update paths
+    # (core/bucketing.py) are bit-equivalent to per-param application.
+    # Lamb/LARS/DGC override to False and keep the per-param path.
+    _elementwise = False
+
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, name=None, multi_precision=True):
         self._learning_rate = learning_rate
@@ -386,6 +392,8 @@ class Optimizer:
 class SGD(Optimizer):
     """Parity: operators/optimizers/sgd_op."""
 
+    _elementwise = True
+
     def __init__(self, learning_rate=0.001, parameters=None,
                  weight_decay=None, grad_clip=None, multi_precision=True,
                  name=None, **kwargs):
@@ -399,6 +407,8 @@ class SGD(Optimizer):
 
 class Momentum(Optimizer):
     """Parity: operators/optimizers/momentum_op (use_nesterov supported)."""
+
+    _elementwise = True
 
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  use_nesterov=False, weight_decay=None, grad_clip=None,
@@ -427,6 +437,8 @@ class DGCMomentumOptimizer(Momentum):
     accumulated locally (u/v buffers) until it crosses the threshold.
     On TPU the win is DCN-only (ICI is fast); rampup delays compression
     like the reference (`rampup_begin_step`)."""
+
+    _elementwise = False   # top-k quantile is per-parameter
 
     def __init__(self, learning_rate=0.001, momentum=0.9,
                  rampup_begin_step=0, rampup_step=1, sparsity=(0.999,),
@@ -467,6 +479,8 @@ class DGCMomentumOptimizer(Momentum):
 
 
 class Adagrad(Optimizer):
+    _elementwise = True
+
     def __init__(self, learning_rate, epsilon=1e-06, parameters=None,
                  weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
                  name=None, **kwargs):
@@ -486,6 +500,8 @@ class Adagrad(Optimizer):
 
 
 class RMSProp(Optimizer):
+    _elementwise = True
+
     def __init__(self, learning_rate, rho=0.95, epsilon=1e-06, momentum=0.0,
                  centered=False, parameters=None, weight_decay=None,
                  grad_clip=None, name=None, **kwargs):
@@ -519,6 +535,8 @@ class RMSProp(Optimizer):
 
 class Adam(Optimizer):
     """Parity: operators/optimizers/adam_op (with beta-power accumulators)."""
+
+    _elementwise = True
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-08, parameters=None, weight_decay=None,
@@ -595,6 +613,8 @@ class AdamW(Adam):
 
 
 class Adamax(Optimizer):
+    _elementwise = True
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-08, parameters=None, weight_decay=None,
                  grad_clip=None, name=None, **kwargs):
@@ -668,6 +688,8 @@ class Adadelta(Optimizer):
     """Parity: operators/optimizers/adadelta_op — accumulated-gradient /
     accumulated-update RMS ratio rule."""
 
+    _elementwise = True
+
     def __init__(self, learning_rate=0.001, epsilon=1e-06, rho=0.95,
                  parameters=None, weight_decay=None, grad_clip=None,
                  name=None, **kwargs):
@@ -695,6 +717,8 @@ class Adadelta(Optimizer):
 class DecayedAdagrad(Optimizer):
     """Parity: operators/optimizers/decayed_adagrad_op."""
 
+    _elementwise = True
+
     def __init__(self, learning_rate, decay=0.95, epsilon=1e-06,
                  parameters=None, weight_decay=None, grad_clip=None,
                  name=None, **kwargs):
@@ -716,6 +740,8 @@ class DecayedAdagrad(Optimizer):
 class Ftrl(Optimizer):
     """Parity: operators/optimizers/ftrl_op — follow-the-regularized-
     leader (McMahan et al.), the classic sparse-LR CTR optimizer."""
+
+    _elementwise = True
 
     def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5,
                  parameters=None, weight_decay=None, grad_clip=None,
@@ -744,6 +770,8 @@ class Ftrl(Optimizer):
 
 class Lars(Momentum):
     """Parity: operators/optimizers/lars_momentum_op."""
+
+    _elementwise = False   # layerwise trust ratio is per-parameter
 
     def __init__(self, learning_rate=0.001, momentum=0.9,
                  lars_coeff=0.001, lars_weight_decay=0.0005, parameters=None,

@@ -808,6 +808,18 @@ class StepTelemetry:
             snap['numerics'] = _numerics.snapshot()
         except Exception:
             snap['numerics'] = None
+        # gradient-comm model (ptpu_comm_* gauges from the bucketed
+        # engines) + persistent compile cache — docs/performance.md
+        try:
+            from .core import bucketing as _bucketing
+            snap['comm'] = _bucketing.comm_snapshot() or None
+        except Exception:
+            snap['comm'] = None
+        try:
+            from .core import compile_cache as _cc
+            snap['compile_cache'] = _cc.snapshot()
+        except Exception:
+            snap['compile_cache'] = None
         return snap
 
 

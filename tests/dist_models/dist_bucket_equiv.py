@@ -1,0 +1,98 @@
+"""2-rank sharded-vs-replicated weight-update equivalence (ISSUE 4).
+
+Runs in a subprocess with XLA_FLAGS=--xla_force_host_platform_device_count=2
+so the dp mesh is exactly 2 ranks. Trains the same model three ways over a
+dp=2 mesh:
+
+  * legacy per-param psum path (`use_buckets=False`) — the reference;
+  * bucketed reduce-scatter + sharded update + all-gather
+    (`use_buckets=True`): must be BIT-IDENTICAL in fp32 — over 2 ranks
+    every reduction is a single commutative addition, and the optimizer
+    update is per-element, so flat-shard application can't drift;
+  * bucketed with `comm_dtype='bfloat16'` (compressed wire, fp32
+    accumulate): tolerance-level equivalence.
+
+Exits 0 on success; prints the failing comparison otherwise.
+"""
+import os
+import sys
+
+os.environ['JAX_PLATFORMS'] = 'cpu'
+os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS', '')
+                           + ' --xla_force_host_platform_device_count=2')
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import numpy as np                                         # noqa: E402
+import jax                                                 # noqa: E402
+
+
+def main():
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.distributed import topology_runtime
+    from paddle_tpu.distributed.fleet.meta_parallel.hybrid_engine import (
+        HybridParallelTrainStep)
+
+    assert len(jax.devices()) == 2, jax.devices()
+
+    def loss_fn(m, x, y):
+        d = m(x) - y
+        return (d * d).mean()
+
+    rng = np.random.RandomState(0)
+    X = Tensor(rng.rand(8, 16).astype('float32'))
+    Y = Tensor(rng.rand(8, 1).astype('float32'))
+
+    def run(use_buckets, comm_dtype=None, steps=4):
+        topology_runtime.build_mesh(['dp'], [2])
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(16, 32), nn.Tanh(),
+                            nn.Linear(32, 1))
+        opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                    parameters=net.parameters())
+        eng = HybridParallelTrainStep(net, loss_fn, opt,
+                                      use_buckets=use_buckets,
+                                      comm_dtype=comm_dtype)
+        assert eng._bucketed == bool(use_buckets), (
+            use_buckets, eng._bucketed)
+        losses = [float(eng(X, Y)) for _ in range(steps)]
+        params = {n: np.asarray(jax.device_get(a))
+                  for n, a in eng._params.items()}
+        states = eng.state_dict()['states']
+        return losses, params, states
+
+    ref_l, ref_p, ref_s = run(False)
+    got_l, got_p, got_s = run(True)
+
+    # fp32 sharded vs replicated: BIT-level
+    assert got_l == ref_l, f'losses differ: {got_l} vs {ref_l}'
+    for n in ref_p:
+        if not np.array_equal(got_p[n], ref_p[n]):
+            diff = np.abs(got_p[n].astype(np.float64)
+                          - ref_p[n].astype(np.float64)).max()
+            print(f'param {n} not bit-identical (max abs diff {diff})',
+                  flush=True)
+            sys.exit(3)
+    for n in ref_s:
+        for k in ('moment1', 'moment2'):
+            if not np.array_equal(np.asarray(got_s[n][k]),
+                                  np.asarray(ref_s[n][k])):
+                print(f'state {n}.{k} not bit-identical', flush=True)
+                sys.exit(4)
+
+    # bf16 compressed wire: tolerance-level
+    bf_l, bf_p, _ = run(True, comm_dtype='bfloat16')
+    np.testing.assert_allclose(bf_l, ref_l, rtol=5e-2, atol=1e-3)
+    for n in ref_p:
+        np.testing.assert_allclose(bf_p[n], ref_p[n], rtol=5e-2,
+                                   atol=2e-3, err_msg=n)
+    print('OK: sharded==replicated (fp32 bit-level), '
+          'bf16 comm within tolerance', flush=True)
+    sys.exit(0)
+
+
+if __name__ == '__main__':
+    main()

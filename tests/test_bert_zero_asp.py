@@ -40,9 +40,16 @@ def test_bert_tiny_bf16_zero_trains():
     nsp = Tensor(rng.randint(0, 2, (8,)).astype('int64'))
     losses = [float(eng(ids, mlm, nsp)) for _ in range(6)]
     assert losses[-1] < losses[0], losses
-    # ZeRO: adam moments for eligible params are sharded over 'sharding'
+    # ZeRO: adam moments (and the fp32 masters) shard 1/n over the dp
+    # axes — since ISSUE 4 as flat bucket states partitioned over
+    # ('dp','sharding') on dim 0 (core/bucketing.py), not per-param
+    # 'sharding' slices
+    assert eng._bucketed and 'sharding' in eng._rs_axes
     name = 'bert.encoder.layers.0.linear1.weight'
-    assert eng._state_specs[name]['moment1'][0] == 'sharding'
+    slot = eng._layout.slots[name]
+    spec = eng._state_specs['buckets'][slot.bucket]
+    assert tuple(spec['moment1'])[0] == eng._rs_axes
+    assert tuple(spec['master'])[0] == eng._rs_axes   # bf16 -> fp32 master
 
 
 def test_asp_2_4_masks():

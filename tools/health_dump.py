@@ -16,13 +16,18 @@ the human post-mortem:
     `divergence_report.rank*.json` from the cross-rank divergence
     sentinel);
   * rank-aware JSON-lines logs (`workerlog.<rank>.jsonl`): pretty-print
-    the last events, filterable with --level.
+    the last events, filterable with --level;
+  * gradient-comm gauges + compile-cache traffic (`comm` subcommand)
+    from a StepTelemetry snapshot or bench record
+    (docs/performance.md).
 
 Usage:
     python tools/health_dump.py ARTIFACT.json [--json] [--level ERROR]
     python tools/health_dump.py numerics ARTIFACT.json [--json]
+    python tools/health_dump.py comm SNAPSHOT.json [--json]
     python tools/health_dump.py --selftest           # CI smoke
     python tools/health_dump.py numerics --selftest  # numerics CI smoke
+    python tools/health_dump.py comm --selftest      # comm CI smoke
 """
 import argparse
 import json
@@ -244,6 +249,146 @@ def _numerics_selftest():
     return 0
 
 
+def _find_comm(doc):
+    """Accepts a StepTelemetry snapshot, a bench leg record, or a bench
+    round record; returns (comm dict, compile_cache dict)."""
+    if not isinstance(doc, dict):
+        return None, None
+    for path in ((), ('telemetry',), ('detail', 'telemetry'),
+                 ('parsed', 'detail', 'telemetry')):
+        d = doc
+        ok = True
+        for k in path:
+            d = d.get(k) if isinstance(d, dict) else None
+            if d is None:
+                ok = False
+                break
+        if ok and isinstance(d, dict) and (d.get('comm')
+                                           or d.get('compile_cache')):
+            return d.get('comm'), d.get('compile_cache')
+    return None, None
+
+
+def _fmt_bytes(n):
+    for unit in ('B', 'KB', 'MB', 'GB', 'TB'):
+        if abs(n) < 1024 or unit == 'TB':
+            return f'{n:.1f}{unit}' if unit != 'B' else f'{int(n)}B'
+        n /= 1024.0
+    return f'{n:.1f}TB'
+
+
+def render_comm(comm, cache=None):
+    """Human rendering of the ptpu_comm_* gauges + compile-cache
+    traffic (the per-step comm model of docs/performance.md)."""
+    out = ['gradient-communication model (per rank, per step)']
+    comm = comm or {}
+    buckets = comm.get('ptpu_comm_buckets') or {}
+    shards = comm.get('ptpu_comm_shards') or {}
+    en = comm.get('ptpu_comm_enabled') or {}
+    pads = comm.get('ptpu_comm_bucket_pad_elements') or {}
+    per_op = comm.get('ptpu_comm_bytes_per_step') or {}
+    modeled = comm.get('ptpu_comm_modeled_bytes_per_step') or {}
+    frac = comm.get('ptpu_comm_compressed_fraction') or {}
+    drop = comm.get('comm_bytes_drop_vs_per_param_psum') or {}
+    engines = sorted({k.split(',')[0].split('=', 1)[1]
+                      for k in list(buckets) + list(modeled)
+                      if '=' in k})
+    if not engines:
+        out.append('  (no ptpu_comm_* gauges in this snapshot)')
+    for eng in engines:
+        key = f'engine={eng}'
+        out.append(f'  engine {eng}: '
+                   f'{int(buckets.get(key, 0))} buckets, '
+                   f'{int(shards.get(key, 0))} shards'
+                   + (' [rs/ag compiled in]' if en.get(key)
+                      else ' [modeled only]'))
+        rs = per_op.get(f'{key},op=reduce_scatter')
+        ag = per_op.get(f'{key},op=all_gather')
+        if rs is not None:
+            out.append(f'    reduce_scatter {_fmt_bytes(rs)}  '
+                       f'all_gather {_fmt_bytes(ag or 0)}  '
+                       f'pad {int(pads.get(key, 0))} elems')
+        base = modeled.get(f'{key},scheme=per_param_psum_fp32')
+        new = modeled.get(f'{key},scheme=bucketed')
+        if base and new is not None:
+            out.append(f'    wire bytes: per-param psum(fp32) '
+                       f'{_fmt_bytes(base)} -> bucketed '
+                       f'{_fmt_bytes(new)} '
+                       f'({100 * drop.get(eng, 1 - new / base):.1f}% '
+                       'drop)')
+        if key in frac:
+            out.append(f'    compressed fraction: {frac[key]:.2f}')
+    if cache:
+        out.append('persistent compile cache: '
+                   + ('enabled at ' + str(cache.get('dir'))
+                      if cache.get('enabled') else 'disabled'))
+        out.append(f"  requests {cache.get('requests', 0)}  "
+                   f"hits {cache.get('hits', 0)}  "
+                   f"misses {cache.get('misses', 0)}  "
+                   f"compile-seconds saved "
+                   f"{cache.get('seconds_saved', 0.0)}")
+    return '\n'.join(out)
+
+
+def _comm_selftest():
+    """CI smoke: publish real gauges through core.bucketing, snapshot
+    via StepTelemetry, render, and assert the load-bearing numbers."""
+    _repo_root_on_path()
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    import jax.numpy as jnp
+    from paddle_tpu.core import bucketing as B
+    from paddle_tpu.profiler import StepTelemetry
+
+    layout = B.BucketLayout.build(
+        {'w': ((2048,), jnp.bfloat16), 'b': ((512,), jnp.bfloat16)},
+        pad_to=8)
+    B.publish_comm_gauges(layout, engine='selftest', n_shards=8,
+                          comm_dtype=jnp.bfloat16, enabled=True)
+    snap = StepTelemetry(publish=False).snapshot()
+    comm, cache = _find_comm({'telemetry': {
+        'comm': snap['comm'], 'compile_cache': snap['compile_cache']}})
+    assert comm, 'StepTelemetry snapshot carries no comm section'
+    drop = comm['comm_bytes_drop_vs_per_param_psum']['selftest']
+    assert drop >= 0.40, drop   # the ISSUE 4 acceptance bar at bf16
+    text = render_comm(comm, cache)
+    assert 'engine selftest' in text, text
+    assert 'drop' in text and 'reduce_scatter' in text, text
+    assert 'compile cache' in text, text
+    print(text)
+    print('health_dump comm selftest: OK')
+    return 0
+
+
+def comm_main(argv):
+    ap = argparse.ArgumentParser(
+        prog='health_dump.py comm',
+        description='render ptpu_comm_* gauges / compile-cache traffic '
+                    'from a StepTelemetry snapshot or bench record')
+    ap.add_argument('artifact', nargs='?',
+                    help='StepTelemetry snapshot / bench record JSON')
+    ap.add_argument('--json', action='store_true')
+    ap.add_argument('--selftest', action='store_true')
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return _comm_selftest()
+    if not args.artifact:
+        ap.error('artifact path required (or --selftest)')
+    with open(args.artifact) as f:
+        doc = json.load(f)
+    comm, cache = _find_comm(doc)
+    if comm is None and cache is None:
+        raise ValueError(
+            'no comm/compile_cache telemetry in this artifact (expected '
+            'a StepTelemetry snapshot or a bench record with '
+            'detail.telemetry.comm — see docs/performance.md)')
+    if args.json:
+        print(json.dumps({'comm': comm, 'compile_cache': cache},
+                         indent=2))
+    else:
+        print(render_comm(comm, cache))
+    return 0
+
+
 def numerics_main(argv):
     ap = argparse.ArgumentParser(
         prog='health_dump.py numerics',
@@ -267,6 +412,8 @@ def main(argv=None):
     argv = sys.argv[1:] if argv is None else list(argv)
     if argv and argv[0] == 'numerics':
         return numerics_main(argv[1:])
+    if argv and argv[0] == 'comm':
+        return comm_main(argv[1:])
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument('artifact', nargs='?',
                     help='hang/OOM report JSON or workerlog .jsonl')

@@ -4,6 +4,8 @@
 #   dist    - virtual-mesh SPMD engines + multi-process launch (EXCLUSIVE)
 #   native  - C++ runtime through ctypes
 #   e2e     - convergence/book tests (slow)
+#   --comm-selftest - 2-rank sharded-vs-replicated weight-update
+#                     equivalence + comm-gauge CLI smoke (ISSUE 4)
 set -e
 cd "$(dirname "$0")/.."
 TIER="${1:-all}"
@@ -11,21 +13,30 @@ case "$TIER" in
   fast)   python -m pytest tests/test_ops.py tests/test_autograd.py \
             tests/test_layers_optim.py tests/test_controlflow_dist.py \
             tests/test_profiler_trace.py tests/test_diagnostics.py \
-            tests/test_numerics.py -q
+            tests/test_numerics.py tests/test_bucketing.py -q
           # observability tooling smoke: tracer -> export -> summary CLI
           python tools/trace_summary.py --selftest
           # diagnostics smoke: flight recorder -> hang/OOM reports -> CLI
           python tools/health_dump.py --selftest
           # numerics smoke: fused stats -> guard trip -> artifact render
-          python tools/health_dump.py numerics --selftest ;;
+          python tools/health_dump.py numerics --selftest
+          # comm smoke: bucket gauges -> snapshot -> render
+          python tools/health_dump.py comm --selftest ;;
   dist)   python -m pytest tests/test_distributed.py \
             tests/test_launch_elastic.py tests/test_bert_zero_asp.py -q ;;
   native) python -m pytest tests/test_native.py tests/test_ps.py -q ;;
   e2e)    python -m pytest tests/test_e2e_train.py tests/test_static.py \
             tests/test_checkpoint_book.py tests/test_inference_dy2static.py -q ;;
+  --comm-selftest)
+          # true 2-rank mesh: bucketed sharded update must be
+          # bit-identical (fp32) to the replicated one, bf16 wire within
+          # tolerance (docs/performance.md)
+          python tests/dist_models/dist_bucket_equiv.py
+          python tools/health_dump.py comm --selftest ;;
   all)    python -m pytest tests/ -q
           python tools/trace_summary.py --selftest
           python tools/health_dump.py --selftest
-          python tools/health_dump.py numerics --selftest ;;
-  *) echo "usage: $0 [fast|dist|native|e2e|all]"; exit 1 ;;
+          python tools/health_dump.py numerics --selftest
+          python tools/health_dump.py comm --selftest ;;
+  *) echo "usage: $0 [fast|dist|native|e2e|all|--comm-selftest]"; exit 1 ;;
 esac
