@@ -50,6 +50,11 @@ _FLAGS = {
     # MHA encoder flash via the packed transpose-free kernel (True) or
     # the BHLD-transposing kernel (False) — A/B knob for tuning
     'FLAGS_flash_packed_mha': True,
+    # serving: ragged paged-attention route. None = auto (Pallas kernel
+    # on TPU, dense lax fallback on CPU — transformer.py's flash-routing
+    # pattern); True/False force a route (tests force True to run the
+    # kernel body under interpret mode on the CPU mesh)
+    'FLAGS_paged_attention_kernel': None,
     # wrap op-kernel exceptions with [operator < name > error] context
     # (enforce.h framing; off by default to keep exception types exact)
     'FLAGS_op_error_context': False,

@@ -110,6 +110,24 @@ class Config:
     def set_cpu_math_library_num_threads(self, n):
         self._enabled['cpu_threads'] = n
 
+    def enable_serving_engine(self, model, max_new_tokens=32,
+                              eos_token_id=None, temperature=1.0,
+                              top_k=0, pad_token_id=0, **engine_knobs):
+        """Route this Config's Predictor through the continuous-batching
+        serving engine (serving/engine.py: paged KV pool + batched
+        decode) instead of a StableHLO-AOT artifact. `model` is a
+        GPTForCausalLM (or compatible) instance; `engine_knobs` are
+        ServingConfig knobs (page_size, max_batch_size, prefill_chunk,
+        num_pages, ...). Predictor.run then takes token-id prompts and
+        returns generated ids — see docs/serving.md#predictor."""
+        self._serving_model = model
+        self._serving_gen = {'max_new_tokens': max_new_tokens,
+                             'eos_token_id': eos_token_id,
+                             'temperature': temperature, 'top_k': top_k}
+        self._serving_pad = int(pad_token_id)
+        self._serving_knobs = dict(engine_knobs)
+        self._enabled['serving_engine'] = True
+
     def summary(self):
         return f"Config(path={self._path_prefix}, device={self._device})"
 
@@ -120,6 +138,22 @@ class Predictor:
     surface as the reference's paddle_infer::Predictor."""
 
     def __init__(self, config, _shared_inner=None):
+        self._engine = None
+        if getattr(config, '_serving_model', None) is not None:
+            # serving-engine route (Config.enable_serving_engine): the
+            # engine owns the paged KV pool and the batched decode loop
+            from .serving import ServingEngine, ServingConfig
+            self._engine = (_shared_inner if _shared_inner is not None
+                            else ServingEngine(
+                                config._serving_model,
+                                ServingConfig(**config._serving_knobs)))
+            self._inner = self._engine
+            self._gen_kw = dict(config._serving_gen)
+            self._pad = config._serving_pad
+            self._names = ['input_ids']
+            self._feeds = {}
+            self._n_out = 1
+            return
         from .static.inference import load_predictor
         self._inner = _shared_inner if _shared_inner is not None \
             else load_predictor(config.model_dir())
@@ -152,6 +186,8 @@ class Predictor:
     def run(self, inputs=None):
         if inputs is None:                  # handle-style call
             inputs = [self._feeds[n] for n in self._names]
+        if self._engine is not None:
+            return self._run_serving(inputs[0])
         outs = self._inner.run(*inputs)
         # flatten to pytree LEAVES so the run-time arity agrees with the
         # load-time one (n_outputs = out_tree.num_leaves): a model
@@ -160,6 +196,39 @@ class Predictor:
         import jax
         self._outputs = jax.tree_util.tree_leaves(outs)
         self._n_out = len(self._outputs)
+        return self._outputs
+
+    def _run_serving(self, prompts):
+        """Serving-engine run: `prompts` is a list of ragged token-id
+        sequences or a padded [B, L] int array (rows trimmed of
+        trailing pad_token_id). Returns ONE output: generated ids
+        padded back to [B, L_max] with pad_token_id."""
+        if isinstance(prompts, np.ndarray) and prompts.ndim == 2:
+            rows = []
+            for row in prompts:
+                row = list(np.asarray(row).astype(np.int64))
+                while row and row[-1] == self._pad:
+                    row.pop()
+                rows.append(row)
+            prompts = rows
+        prompts = list(prompts)
+        empty = [i for i, p in enumerate(prompts) if len(p) == 0]
+        if empty:
+            raise ValueError(
+                f"prompt rows {empty} are empty"
+                f"{' after pad trimming' if self._pad is not None else ''}"
+                " — the engine needs at least one token per request")
+        if not prompts:
+            self._outputs = [np.zeros((0, 0), np.int32)]
+            self._n_out = 1
+            return self._outputs
+        outs = self._engine.generate(prompts, **self._gen_kw)
+        n = max(len(o) for o in outs)
+        padded = np.full((len(outs), n), self._pad, np.int32)
+        for i, o in enumerate(outs):
+            padded[i, :len(o)] = o
+        self._outputs = [padded]
+        self._n_out = 1
         return self._outputs
 
 

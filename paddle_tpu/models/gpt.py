@@ -227,6 +227,37 @@ class GPTAttention(nn.Layer):
         out = self.out_proj(ctx)
         return self.dropout(out), (kc2, vc2)
 
+    def forward_paged(self, x, kv, page_tables, seq_lens, q_lens):
+        """Serving-engine path: x [B, T, H] (T new tokens per row,
+        right-padded to q_lens); kv = (k_pages, v_pages) Tensors
+        [num_pages, page_size, local_heads*hd] from the shared pool.
+        Writes the new tokens' k/v into the sequences' pages and runs
+        ragged paged attention over each row's page table (causal
+        within the sequence). page_tables/seq_lens/q_lens are plain
+        int32 arrays (non-diff, captured like cache_len above)."""
+        B, T, _ = x.shape
+        qkv = self.qkv_proj(x)
+        hd = self.head_dim
+        nh = qkv.shape[-1] // (3 * hd)
+        k_pages, v_pages = kv
+        from ..ops.pallas import paged_attention as pa
+
+        def fn(a, kp, vp):
+            x5 = a.reshape(B, T, nh, 3, hd)
+            q = x5[:, :, :, 0].reshape(B, T, nh * hd)
+            k = x5[:, :, :, 1].reshape(B, T, nh * hd)
+            v = x5[:, :, :, 2].reshape(B, T, nh * hd)
+            kp2, vp2 = pa.write_kv_pages(kp, vp, k, v, page_tables,
+                                         seq_lens, q_lens)
+            ctx = pa.ragged_paged_attention(
+                q, kp2, vp2, page_tables, seq_lens, q_lens,
+                num_heads=nh, head_dim=hd)
+            return ctx, kp2, vp2
+        ctx, kp2, vp2 = run_op('paged_attention', fn,
+                               [qkv, k_pages, v_pages])
+        out = self.out_proj(ctx)
+        return self.dropout(out), (kp2, vp2)
+
 
 class GPTMLP(nn.Layer):
     def __init__(self, config):
@@ -270,6 +301,14 @@ class GPTDecoderLayer(nn.Layer):
         x = M.add(x, self.mlp(self.ln2(x)))
         return x
 
+    def forward_paged(self, x, kv, page_tables, seq_lens, q_lens):
+        a, new_kv = self.attn.forward_paged(self.ln1(x), kv,
+                                            page_tables, seq_lens,
+                                            q_lens)
+        x = M.add(x, a)
+        x = M.add(x, self.mlp(self.ln2(x)))
+        return x, new_kv
+
 
 class GPTModel(nn.Layer):
     _supports_sequence_parallel = True
@@ -295,6 +334,19 @@ class GPTModel(nn.Layer):
         for layer in self.layers:
             x = layer(x)
         return self.final_norm(x)
+
+    def forward_paged(self, input_ids, position_ids, kv_list,
+                      page_tables, seq_lens, q_lens):
+        """Serving-engine forward over the paged KV pool: kv_list is the
+        per-layer [(k_pages, v_pages)] Tensors; returns (hidden,
+        new_kv_list). See serving/engine.py for the step around it."""
+        x = self.embeddings(input_ids, position_ids)
+        new_kv = []
+        for layer, c in zip(self.layers, kv_list):
+            x, nc = layer.forward_paged(x, c, page_tables, seq_lens,
+                                        q_lens)
+            new_kv.append(nc)
+        return self.final_norm(x), new_kv
 
     def init_caches(self, batch, max_len, dtype=None):
         import jax.numpy as _jnp
@@ -357,6 +409,11 @@ class GPTForCausalLM(nn.Layer):
         from ..core.autograd import no_grad
         ids = np_.asarray(input_ids.data if isinstance(input_ids, Tensor)
                           else input_ids)
+        # early-exit once EVERY row has emitted EOS at least once (rows
+        # that finish early keep emitting until the laggards catch up,
+        # so the tokens that ARE emitted are step-for-step identical to
+        # the run-to-max_new_tokens output)
+        done = np_.zeros(ids.shape[0], bool)
         with no_grad():
             for _ in range(max_new_tokens):
                 window = ids[:, -self.config.max_seq_len:]
@@ -364,9 +421,45 @@ class GPTForCausalLM(nn.Layer):
                 nxt = self._sample_next(np_.asarray(logits.data)[:, -1, :],
                                         temperature, top_k)
                 ids = np_.concatenate([ids, nxt[:, None]], axis=1)
-                if eos_token_id is not None and (nxt == eos_token_id).all():
-                    break
+                if eos_token_id is not None:
+                    done |= (nxt == eos_token_id)
+                    if done.all():
+                        break
         return Tensor(ids)
+
+    def generate_batch(self, prompts, max_new_tokens=32, temperature=1.0,
+                       top_k=0, eos_token_id=None, serving_config=None,
+                       engine=None, **engine_kw):
+        """Continuous-batching decode over the serving engine: `prompts`
+        is a LIST of ragged token-id sequences (mixed lengths welcome —
+        that is the point). Returns a list of full token lists (prompt +
+        generated) in submission order. The engine (paged KV pool +
+        batched one-token decode, serving/engine.py) is cached on the
+        model and reused across same-config calls; a different config
+        replaces it (the old engine is shut down — each pins a device
+        KV pool). Pass `engine=` to share one across models of the
+        same weights, `serving_config=`/knobs to size it."""
+        from ..serving import ServingEngine, ServingConfig
+        eng = engine
+        if eng is None:
+            cfg = serving_config or ServingConfig(**engine_kw)
+            # key on the resolved config's CONTENTS — two calls with
+            # different knobs must not share an engine
+            key = tuple(sorted((k, repr(v))
+                               for k, v in vars(cfg).items()))
+            eng = getattr(self, '_serving_engines', {}).get(key)
+            if eng is None:
+                # ONE live engine per model: each pins a full device KV
+                # pool, so a config change evicts (and shuts down) the
+                # old engine rather than growing an unbounded cache
+                for old in getattr(self, '_serving_engines',
+                                   {}).values():
+                    old.shutdown()
+                eng = ServingEngine(self, cfg)
+                self._serving_engines = {key: eng}
+        return eng.generate(prompts, max_new_tokens=max_new_tokens,
+                            eos_token_id=eos_token_id,
+                            temperature=temperature, top_k=top_k)
 
     def generate_scan(self, input_ids, max_new_tokens=32, temperature=1.0,
                       top_k=0, seed=0):
@@ -496,6 +589,11 @@ class GPTForCausalLM(nn.Layer):
                     cache_arrays)
 
             out = ids
+            # per-row EOS bookkeeping: stop as soon as every row has
+            # emitted its EOS (not only when all rows emit it on the
+            # SAME step) — emitted tokens stay identical, the loop just
+            # skips the steps where everyone was already finished
+            done = np_.zeros(B, bool)
             for i in range(max_new_tokens):
                 pos = L0 + i
                 if pos >= max_len:
@@ -504,8 +602,10 @@ class GPTForCausalLM(nn.Layer):
                                         temperature, top_k)
                 out = np_.concatenate([out, nxt[:, None].astype('int32')],
                                       axis=1)
-                if eos_token_id is not None and (nxt == eos_token_id).all():
-                    break
+                if eos_token_id is not None:
+                    done |= (nxt == eos_token_id)
+                    if done.all():
+                        break
                 last_logits, cache_arrays = jit_step(
                     params, out[:, -1:], jnp.asarray(pos, jnp.int32),
                     cache_arrays)

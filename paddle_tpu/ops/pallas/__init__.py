@@ -6,3 +6,4 @@ job; Pallas covers the blockwise-algorithm cases (flash attention's online
 softmax) that XLA cannot derive.
 """
 from . import flash_attention
+from . import paged_attention

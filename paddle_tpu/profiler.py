@@ -820,6 +820,13 @@ class StepTelemetry:
             snap['compile_cache'] = _cc.snapshot()
         except Exception:
             snap['compile_cache'] = None
+        # serving engine (ptpu_serve_* gauges: decode tokens/sec, TTFT,
+        # batch/page occupancy, preemptions) — docs/serving.md
+        try:
+            from .serving import metrics as _sm
+            snap['serve'] = _sm.serve_snapshot() or None
+        except Exception:
+            snap['serve'] = None
         return snap
 
 

@@ -1,0 +1,21 @@
+"""paddle_tpu.serving — TPU-native LLM serving engine.
+
+The inference counterpart of the fleet training engines: a block-paged
+KV-cache pool shared by every in-flight request (`kv_pool.py`), a
+continuous-batching scheduler that admits / chunk-prefills / batch-
+decodes / preempts requests across fixed-shape jitted steps
+(`scheduler.py` + `engine.py`), and the ragged paged-attention Pallas
+kernel (`ops/pallas/paged_attention.py`) those steps call. Metrics
+publish as `ptpu_serve_*` gauges through core.monitor (`metrics.py`),
+surfaced in `profiler.StepTelemetry.snapshot()['serve']` and rendered
+by `tools/health_dump.py serve`. See docs/serving.md.
+"""
+from .kv_pool import KVPagePool, PoolExhausted
+from .scheduler import Request, RequestState, Scheduler
+from .engine import ServingConfig, ServingEngine
+from . import metrics
+
+__all__ = [
+    'KVPagePool', 'PoolExhausted', 'Request', 'RequestState',
+    'Scheduler', 'ServingConfig', 'ServingEngine', 'metrics',
+]

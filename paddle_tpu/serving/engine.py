@@ -1,0 +1,452 @@
+"""Serving engine: fixed-shape jitted steps over the paged KV pool.
+
+Two compiled step shapes serve every request mix (the continuous-
+batching contract — the device never recompiles as traffic changes):
+
+  * chunked prefill  — B=1, T=prefill_chunk: one prompt chunk streams
+    through the model, its K/V landing in the sequence's pool pages;
+  * batched decode   — B=max_batch_size, T=1: every RUNNING request
+    advances one token in ONE dispatch.
+
+Both run `GPTModel.forward_paged` (ragged paged attention +
+`write_kv_pages` scatter) under `jit` with the KV pool donated, sample
+the next token ON DEVICE (greedy argmax or temperature/top-k via
+jax.random), and fetch only the sampled token ids — the single
+per-token host round-trip. Idle decode slots ride along with q_len=0:
+their K/V writes are dropped by the scatter and their outputs ignored,
+so occupancy is a pure scheduling concern.
+
+Scheduling (admit / chunk order / preempt-youngest) lives in
+scheduler.py; page accounting in kv_pool.py; ptpu_serve_* metrics in
+metrics.py. docs/serving.md covers tuning the knobs.
+"""
+import math
+import time
+
+import numpy as np
+
+from .kv_pool import KVPagePool, PoolExhausted
+from .scheduler import Request, RequestState, Scheduler
+from . import metrics as _metrics
+
+
+class ServingConfig:
+    """Knobs (docs/serving.md#tuning):
+
+    page_size        tokens per KV page (TPU lane-friendly: >= 8)
+    max_batch_size   decode slots = max concurrent requests
+    num_pages        pool capacity; default fits every slot at
+                     max_pages_per_seq (no preemption pressure)
+    max_pages_per_seq  page-table width; default covers max_seq_len
+    prefill_chunk    prompt tokens per prefill dispatch
+    kv_dtype         pool dtype (default: model param dtype)
+    seed             device sampling stream seed
+    """
+
+    def __init__(self, page_size=16, max_batch_size=4, num_pages=None,
+                 max_pages_per_seq=None, prefill_chunk=32,
+                 kv_dtype=None, seed=0):
+        if page_size <= 0 or max_batch_size <= 0 or prefill_chunk <= 0:
+            raise ValueError("page_size, max_batch_size and "
+                             "prefill_chunk must be positive")
+        self.page_size = int(page_size)
+        self.max_batch_size = int(max_batch_size)
+        self.num_pages = num_pages
+        self.max_pages_per_seq = max_pages_per_seq
+        self.prefill_chunk = int(prefill_chunk)
+        self.kv_dtype = kv_dtype
+        self.seed = int(seed)
+
+
+class ServingEngine:
+    """Continuous-batching inference over a GPTForCausalLM."""
+
+    def __init__(self, model, config=None, **cfg_kw):
+        import jax
+        import jax.numpy as jnp
+        if config is None:
+            config = ServingConfig(**cfg_kw)
+        elif cfg_kw:
+            raise ValueError("pass either config or knobs, not both")
+        self.model = model
+        self.config = config
+        mcfg = model.config
+        ps = config.page_size
+        self.max_pages_per_seq = int(
+            config.max_pages_per_seq
+            or math.ceil(mcfg.max_seq_len / ps))
+        num_pages = int(config.num_pages
+                        or config.max_batch_size * self.max_pages_per_seq)
+        attn0 = model.gpt.layers[0].attn
+        dtype = (config.kv_dtype
+                 or model.gpt.embeddings.word_embeddings.weight.dtype)
+        self.pool = KVPagePool(
+            num_pages, ps, num_layers=mcfg.num_layers,
+            num_heads=attn0.local_heads, head_dim=attn0.head_dim,
+            dtype=dtype)
+        self.pool.materialize()
+        self.scheduler = Scheduler(config.max_batch_size)
+        self._params = {n: p.data for n, p in model.named_parameters()}
+        self._step_fns = {}
+        self._key = jax.random.key(config.seed)
+        self._jnp = jnp
+        self._jax = jax
+        # lifetime accounting for stats()/metrics
+        self._decode_time = 0.0
+        self._decode_tokens = 0
+        self._decode_steps = 0
+        self._occupancy_sum = 0.0
+        self._util_sum = 0.0
+        self._prefill_tokens = 0
+        self._prefill_chunks = 0
+        self._submitted = 0
+        self._completed = 0
+        self._ttfts_s = []
+        self._new_ttfts_s = []
+        self._last_publish = 0.0
+
+    # seconds between periodic gauge publishes on a busy engine —
+    # publishing rebuilds stats and touches ~20 monitor gauges, which
+    # is host work the per-token decode path shouldn't pay every step
+    # (retire and drain always publish immediately)
+    PUBLISH_INTERVAL_S = 0.5
+
+    # -- request intake ------------------------------------------------------
+    def submit(self, prompt_ids, max_new_tokens=32, eos_token_id=None,
+               temperature=1.0, top_k=0):
+        req = Request(prompt_ids, max_new_tokens=max_new_tokens,
+                      eos_token_id=eos_token_id, temperature=temperature,
+                      top_k=top_k)
+        total = len(req.prompt) + req.max_new_tokens
+        if total > self.max_pages_per_seq * self.pool.page_size:
+            raise ValueError(
+                f"request needs {total} tokens; page table holds "
+                f"{self.max_pages_per_seq} pages of {self.pool.page_size}")
+        if self.pool.pages_for(total) > self.pool.num_pages:
+            # reject NOW: admission's page budget would skip it forever
+            # (no amount of preemption frees pages the pool doesn't have)
+            raise PoolExhausted(
+                f"KV pool ({self.pool.num_pages} pages x "
+                f"{self.pool.page_size}) cannot hold one request of "
+                f"{total} tokens — raise num_pages")
+        if total > self.model.config.max_seq_len:
+            raise ValueError(
+                f"prompt({len(req.prompt)}) + max_new_tokens"
+                f"({req.max_new_tokens}) exceeds max_seq_len"
+                f"({self.model.config.max_seq_len})")
+        self.scheduler.submit(req)
+        self._submitted += 1
+        return req
+
+    def generate(self, prompts, max_new_tokens=32, eos_token_id=None,
+                 temperature=1.0, top_k=0, max_steps=None):
+        """Batch convenience: submit every prompt, drive step() until
+        drained, return per-prompt token lists (prompt + generated) in
+        submission order."""
+        reqs = [self.submit(p, max_new_tokens=max_new_tokens,
+                            eos_token_id=eos_token_id,
+                            temperature=temperature, top_k=top_k)
+                for p in prompts]
+        guard = max_steps or 16 * (max_new_tokens + 4) * max(
+            1, math.ceil(len(reqs) / self.config.max_batch_size))
+        steps = 0
+        while self.scheduler.has_work:
+            self.step()
+            steps += 1
+            if steps > guard:
+                raise RuntimeError(
+                    f"serving loop did not drain in {guard} steps")
+        return [r.output_ids() for r in reqs]
+
+    # -- engine iteration ----------------------------------------------------
+    def step(self):
+        """One scheduler iteration: admit waiting requests, advance one
+        prefill chunk per prefilling request, then one batched decode
+        step for the running set. Publishes metrics."""
+        completed_before = self._completed
+        self._admit()
+        prefilling = [r for r in self.scheduler.slots
+                      if r is not None and r.state == RequestState.PREFILL]
+        for req in prefilling:
+            self._prefill_chunk_step(req)
+        running = [r for r in self.scheduler.slots
+                   if r is not None and r.state == RequestState.RUNNING]
+        if running:
+            self._decode_step()
+        if (self._completed != completed_before
+                or not self.scheduler.has_work
+                or (time.perf_counter() - self._last_publish
+                    >= self.PUBLISH_INTERVAL_S)):
+            self.publish_metrics()
+
+    def _admit(self):
+        """Admit waiting requests one at a time against a free-page
+        budget: each admission reserves its FIRST chunk's pages (the
+        pool doesn't allocate until the prefill step runs, so the
+        budget, not pool.free_pages, is what shrinks here) — admitting
+        more than the pool can first-chunk just manufactures
+        preemption churn."""
+        sched = self.scheduler
+        budget = self.pool.free_pages
+        while sched.waiting and None in sched.slots:
+            need = self.pool.pages_for(
+                min(len(sched.waiting[0].tokens),
+                    self.config.prefill_chunk))
+            if budget < need:
+                break
+            if not sched.admit(limit=1):
+                break
+            budget -= need
+
+    def _ensure_or_preempt(self, req, n_tokens):
+        """Grow req's pages, preempting the youngest other in-flight
+        request until the allocation fits."""
+        while True:
+            try:
+                self.pool.ensure_capacity(req.id, n_tokens)
+                return
+            except PoolExhausted:
+                victim = self.scheduler.preempt_victim(exclude=req)
+                if victim is None:
+                    raise PoolExhausted(
+                        f"KV pool ({self.pool.num_pages} pages x "
+                        f"{self.pool.page_size}) cannot hold one request "
+                        f"of {n_tokens} tokens — raise num_pages")
+                self.pool.release(victim.id)
+                self.scheduler.preempt(victim)
+
+    # -- jitted steps --------------------------------------------------------
+    def _step_fn(self, B, T, sample):
+        """sample=False compiles a greedy-argmax step — the common
+        serving mode must not pay _device_sample's full-vocab sort on
+        every decode dispatch (top_ks is traced, XLA can't elide it)."""
+        fn = self._step_fns.get((B, T, sample))
+        if fn is None:
+            fn = self._build_step(B, T, sample)
+            self._step_fns[(B, T, sample)] = fn
+        return fn
+
+    def _build_step(self, B, T, sample):
+        jax, jnp = self._jax, self._jnp
+        model = self.model
+        from ..core.tensor import Tensor
+        from ..core.autograd import no_grad
+        from ..jit import bind_arrays
+        max_pos = model.config.max_seq_len - 1
+
+        def step(params, kv, tokens, page_tables, seq_lens, q_lens, key,
+                 temps, top_ks):
+            cts = [(Tensor(k), Tensor(v)) for k, v in kv]
+            with bind_arrays(model, params):
+                pos = (seq_lens[:, None] - q_lens[:, None]
+                       + jnp.arange(T, dtype=jnp.int32)[None, :])
+                pos = jnp.clip(pos, 0, max_pos)
+                h, new_kv = model.gpt.forward_paged(
+                    Tensor(tokens), Tensor(pos), cts, page_tables,
+                    seq_lens, q_lens)
+                idx = jnp.clip(q_lens - 1, 0, T - 1).astype(jnp.int32)
+                h_last = jnp.take_along_axis(
+                    h.data, idx[:, None, None], axis=1)[:, 0, :]
+                w = model.gpt.embeddings.word_embeddings.weight
+                logits = jnp.einsum(
+                    'bh,vh->bv', h_last, w.data,
+                    preferred_element_type=jnp.float32)
+                if sample:
+                    nxt = _device_sample(logits.astype(jnp.float32),
+                                         key, temps, top_ks)
+                else:
+                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return nxt, [(c[0].data, c[1].data) for c in new_kv]
+
+        # donation updates the pool pages in place; CPU jax has no
+        # donation support and would warn every call
+        donate = (1,) if jax.default_backend() != 'cpu' else ()
+        jitted = jax.jit(step, donate_argnums=donate)
+
+        def run(*args):
+            was = model.training
+            model.eval()
+            try:
+                with no_grad():
+                    return jitted(*args)
+            finally:
+                if was:
+                    model.train()
+        return run
+
+    def _page_row(self, req):
+        row = self.pool.page_table(req.id)
+        return row + [0] * (self.max_pages_per_seq - len(row))
+
+    def _prefill_chunk_step(self, req):
+        jnp = self._jnp
+        C = self.config.prefill_chunk
+        if req.state != RequestState.PREFILL:
+            return          # preempted by an earlier request in this
+                            # same step() sweep: it re-queued slotless,
+                            # allocating pages to it now would bleed the
+                            # pool (and preempt live work) for a request
+                            # that isn't scheduled
+        toks = req.tokens
+        start = req.prefilled
+        n = min(C, len(toks) - start)
+        self._ensure_or_preempt(req, start + n)
+        chunk = toks[start:start + n] + [0] * (C - n)
+        fn = self._step_fn(1, C, req.top_k > 0)
+        self._key, sub = self._jax.random.split(self._key)
+        nxt, new_kv = fn(
+            self._params, self.pool.kv,
+            jnp.asarray([chunk], jnp.int32),
+            jnp.asarray([self._page_row(req)], jnp.int32),
+            jnp.asarray([start + n], jnp.int32),
+            jnp.asarray([n], jnp.int32),
+            sub,
+            jnp.asarray([req.temperature], jnp.float32),
+            jnp.asarray([req.top_k], jnp.int32))
+        self.pool.kv = new_kv
+        req.prefilled = start + n
+        self._prefill_tokens += n
+        self._prefill_chunks += 1
+        if req.prefilled == len(toks):
+            if req.max_new_tokens <= 0:
+                self._retire(req)   # prefill-only request (scoring):
+                return              # the budget says emit nothing
+            tok = int(np.asarray(nxt)[0])       # the sampled-token fetch
+            req.generated.append(tok)
+            if req.first_token_time is None:
+                req.first_token_time = time.perf_counter()
+                ttft = req.first_token_time - req.submit_time
+                self._ttfts_s.append(ttft)
+                self._new_ttfts_s.append(ttft)
+            if req.done:
+                self._retire(req)
+            else:
+                req.state = RequestState.RUNNING
+
+    def _decode_step(self):
+        jnp = self._jnp
+        sched = self.scheduler
+        # capacity first (may preempt); then snapshot the running set
+        for req in list(sched.slots):
+            if req is not None and req.state == RequestState.RUNNING:
+                self._ensure_or_preempt(req, req.context_len)
+        B = self.config.max_batch_size
+        tokens = np.zeros((B, 1), np.int32)
+        page_tables = np.zeros((B, self.max_pages_per_seq), np.int32)
+        seq_lens = np.ones((B,), np.int32)
+        q_lens = np.zeros((B,), np.int32)
+        temps = np.zeros((B,), np.float32)
+        top_ks = np.zeros((B,), np.int32)
+        active = []
+        for i, req in enumerate(sched.slots):
+            if req is None or req.state != RequestState.RUNNING:
+                continue
+            active.append((i, req))
+            tokens[i, 0] = req.tokens[-1]
+            row = self._page_row(req)
+            page_tables[i, :] = row
+            seq_lens[i] = req.context_len
+            q_lens[i] = 1
+            temps[i] = req.temperature
+            top_ks[i] = req.top_k
+        if not active:
+            return
+        fn = self._step_fn(B, 1, any(r.top_k > 0 for _, r in active))
+        self._key, sub = self._jax.random.split(self._key)
+        t0 = time.perf_counter()
+        nxt, new_kv = fn(
+            self._params, self.pool.kv,
+            jnp.asarray(tokens), jnp.asarray(page_tables),
+            jnp.asarray(seq_lens), jnp.asarray(q_lens), sub,
+            jnp.asarray(temps), jnp.asarray(top_ks))
+        self.pool.kv = new_kv
+        nxt = np.asarray(nxt)                   # the sampled-token fetch
+        dt = time.perf_counter() - t0
+        self._decode_time += dt
+        self._decode_steps += 1
+        self._decode_tokens += len(active)
+        self._occupancy_sum += len(active) / B
+        self._util_sum += self.pool.utilization()
+        for i, req in active:
+            req.generated.append(int(nxt[i]))
+            if req.done:
+                self._retire(req)
+
+    def _retire(self, req):
+        self.pool.release(req.id)
+        self.scheduler.retire(req)
+        self._completed += 1
+
+    # -- stats / metrics -----------------------------------------------------
+    def stats(self):
+        steps = max(self._decode_steps, 1)
+        s = {
+            'decode_tokens_per_sec':
+                (self._decode_tokens / self._decode_time
+                 if self._decode_time else 0.0),
+            'ttft_ms_mean':
+                (1000.0 * sum(self._ttfts_s) / len(self._ttfts_s)
+                 if self._ttfts_s else None),
+            'batch_occupancy': self._occupancy_sum / steps,
+            'kv_page_utilization': self._util_sum / steps,
+            'slots': self.config.max_batch_size,
+            'in_flight': len(self.scheduler.running()),
+            'waiting': len(self.scheduler.waiting),
+            'pool': self.pool.stats(),
+            'requests_submitted_total': self._submitted,
+            'requests_completed_total': self._completed,
+            'preemptions_total': self.scheduler.preemptions,
+            'decode_steps_total': self._decode_steps,
+            'decode_tokens_total': self._decode_tokens,
+            'prefill_tokens_total': self._prefill_tokens,
+            'prefill_chunks_total': self._prefill_chunks,
+        }
+        return s
+
+    def reset_stats(self):
+        """Zero the rate/occupancy accounting (NOT the pool or queue) —
+        bench legs call this after compile warmup so steady-state
+        numbers aren't polluted by the first-dispatch compiles."""
+        self._decode_time = 0.0
+        self._decode_tokens = 0
+        self._decode_steps = 0
+        self._occupancy_sum = 0.0
+        self._util_sum = 0.0
+        self._prefill_tokens = 0
+        self._prefill_chunks = 0
+        self._ttfts_s = []
+        self._new_ttfts_s = []
+
+    def publish_metrics(self):
+        s = self.stats()
+        s['_new_ttfts_s'] = list(self._new_ttfts_s)
+        self._new_ttfts_s.clear()
+        self._last_publish = time.perf_counter()
+        _metrics.publish(s)
+
+    def shutdown(self):
+        """Drop the pool's device pages and the compiled steps."""
+        self.pool.drop_arrays()
+        self._step_fns.clear()
+        self._params = {}
+        return {'released': True}
+
+
+def _device_sample(logits, key, temps, top_ks):
+    """On-device next-token choice, [B, V] fp32 logits -> [B] int32.
+
+    Matches GPTForCausalLM._sample_next semantics: top_k <= 0 means
+    GREEDY argmax (temperature ignored); top_k > 0 samples from the
+    temperature-scaled top-k renormalized distribution."""
+    import jax
+    import jax.numpy as jnp
+    V = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temps[:, None], 1e-6)
+    k = jnp.clip(top_ks, 1, V)
+    srt = jnp.sort(scaled, axis=-1)             # ascending
+    kth = jnp.take_along_axis(srt, (V - k)[:, None], axis=-1)
+    masked = jnp.where(scaled < kth, -1e30, scaled)
+    sampled = jax.random.categorical(key, masked, axis=-1).astype(
+        jnp.int32)
+    return jnp.where(top_ks > 0, sampled, greedy)

@@ -1,0 +1,151 @@
+"""Block-paged KV-cache pool (vLLM-style paging, TPU-shaped).
+
+One fixed device tensor pair per decoder layer — `[num_pages,
+page_size, local_heads * head_dim]` — shared by every in-flight
+request. Sequences own pages through per-sequence page tables; a
+host-side free-list allocator hands pages out and takes them back, so
+KV memory is O(pages actually in use) instead of the dense cache's
+O(batch * max_seq_len). The ragged paged-attention kernel gathers a
+row's pages straight from this layout (`ops/pallas/paged_attention.py`
+module docstring has the exact shapes).
+
+The allocator is deliberately host-side and dumb-simple: serving
+decisions (admit / grow / preempt) happen between jitted steps, where
+Python cost is amortized over a whole batch step. Invariants it
+enforces (tested in tests/test_serving.py):
+
+  * a page has exactly one owner (no double-mapping);
+  * free + in-use partitions the pool at all times;
+  * release returns every page of a sequence exactly once.
+"""
+import math
+import threading
+
+
+class PoolExhausted(RuntimeError):
+    """No free pages — the scheduler's cue to stop admitting or to
+    preempt a victim (engine.py)."""
+
+
+class KVPagePool:
+    """Free-list page allocator + the paged device arrays.
+
+    Device arrays are created lazily (`materialize()`) so pure
+    allocator tests never touch jax; the engine materializes once at
+    build. `kv[l]` is the (k_pages, v_pages) pair of layer l.
+    """
+
+    def __init__(self, num_pages, page_size, num_layers=0, num_heads=0,
+                 head_dim=0, dtype=None):
+        if num_pages <= 0 or page_size <= 0:
+            raise ValueError("num_pages and page_size must be positive")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.dtype = dtype
+        self.kv = None                      # [(k_pages, v_pages)] per layer
+        self._free = list(range(self.num_pages - 1, -1, -1))
+        self._owner = {}                    # page id -> seq id
+        self._seq_pages = {}                # seq id -> [page ids]
+        self._lock = threading.Lock()
+        self.alloc_total = 0
+        self.free_total = 0
+        self.high_water = 0
+
+    # -- device arrays -------------------------------------------------------
+    def materialize(self):
+        if self.kv is not None:
+            return self.kv
+        import jax.numpy as jnp
+        dt = self.dtype or jnp.float32
+        hd = self.num_heads * self.head_dim
+        self.kv = [
+            (jnp.zeros((self.num_pages, self.page_size, hd), dt),
+             jnp.zeros((self.num_pages, self.page_size, hd), dt))
+            for _ in range(self.num_layers)]
+        return self.kv
+
+    def drop_arrays(self):
+        """Release the device buffers (engine shutdown)."""
+        self.kv = None
+
+    # -- allocator -----------------------------------------------------------
+    def pages_for(self, n_tokens):
+        return max(1, math.ceil(n_tokens / self.page_size))
+
+    @property
+    def pages_in_use(self):
+        return self.num_pages - len(self._free)
+
+    @property
+    def free_pages(self):
+        return len(self._free)
+
+    def utilization(self):
+        return self.pages_in_use / self.num_pages
+
+    def capacity_tokens(self, seq_id):
+        """Tokens the sequence can hold without another allocation."""
+        return len(self._seq_pages.get(seq_id, ())) * self.page_size
+
+    def page_table(self, seq_id):
+        return list(self._seq_pages.get(seq_id, ()))
+
+    def owned_sequences(self):
+        return list(self._seq_pages)
+
+    def _take_page(self, seq_id):
+        if not self._free:
+            raise PoolExhausted(
+                f"KV pool exhausted: {self.num_pages} pages of "
+                f"{self.page_size} tokens all in use")
+        page = self._free.pop()
+        assert page not in self._owner, f"page {page} double-mapped"
+        self._owner[page] = seq_id
+        self._seq_pages.setdefault(seq_id, []).append(page)
+        self.alloc_total += 1
+        self.high_water = max(self.high_water, self.pages_in_use)
+        return page
+
+    def ensure_capacity(self, seq_id, n_tokens):
+        """Grow seq_id's page list to hold n_tokens. Raises
+        PoolExhausted (after rolling back nothing — partial growth is
+        kept, the caller preempts and retries)."""
+        need = self.pages_for(n_tokens)
+        with self._lock:
+            while len(self._seq_pages.get(seq_id, ())) < need:
+                self._take_page(seq_id)
+        return self._seq_pages[seq_id]
+
+    def release(self, seq_id):
+        """Return every page of seq_id to the free list."""
+        with self._lock:
+            pages = self._seq_pages.pop(seq_id, [])
+            for page in pages:
+                owner = self._owner.pop(page, None)
+                assert owner == seq_id, \
+                    f"page {page} owned by {owner}, freed by {seq_id}"
+                self._free.append(page)
+                self.free_total += 1
+        return len(pages)
+
+    def reset(self):
+        with self._lock:
+            self._free = list(range(self.num_pages - 1, -1, -1))
+            self._owner.clear()
+            self._seq_pages.clear()
+
+    def stats(self):
+        return {
+            'num_pages': self.num_pages,
+            'page_size': self.page_size,
+            'pages_in_use': self.pages_in_use,
+            'free_pages': self.free_pages,
+            'utilization': self.utilization(),
+            'high_water': self.high_water,
+            'alloc_total': self.alloc_total,
+            'free_total': self.free_total,
+            'sequences': len(self._seq_pages),
+        }

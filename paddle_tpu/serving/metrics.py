@@ -1,0 +1,99 @@
+"""ptpu_serve_* metrics — the serving engine's observability surface.
+
+Published through core.monitor (same registry the training telemetry
+uses), read back by `serve_snapshot()` for
+`profiler.StepTelemetry.snapshot()['serve']`, bench records, and
+`tools/health_dump.py serve`. Gauge table in docs/serving.md.
+"""
+from ..core import monitor as _m
+
+TTFT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+                2.5, 5.0, 10.0, 30.0, float('inf'))
+
+_GAUGE_NAMES = (
+    'ptpu_serve_decode_tokens_per_sec',
+    'ptpu_serve_ttft_ms',
+    'ptpu_serve_batch_occupancy',
+    'ptpu_serve_kv_page_utilization',
+    'ptpu_serve_kv_pages_total',
+    'ptpu_serve_kv_pages_in_use',
+    'ptpu_serve_kv_pages_high_water',
+    'ptpu_serve_batch_slots',
+    'ptpu_serve_requests_in_flight',
+    'ptpu_serve_requests_waiting',
+)
+_COUNTER_NAMES = (
+    'ptpu_serve_requests_submitted_total',
+    'ptpu_serve_requests_completed_total',
+    'ptpu_serve_preemptions_total',
+    'ptpu_serve_decode_steps_total',
+    'ptpu_serve_decode_tokens_total',
+    'ptpu_serve_prefill_tokens_total',
+    'ptpu_serve_prefill_chunks_total',
+)
+
+
+def publish(stats):
+    """Publish an engine stats dict (ServingEngine.stats()) as
+    ptpu_serve_* gauges. Counters are published as gauges set to the
+    engine's lifetime totals — the engine owns the monotonic state, the
+    registry just mirrors it (monitor counters can't be set)."""
+    g = _m.gauge
+    g('ptpu_serve_decode_tokens_per_sec',
+      help='batched decode throughput (generated tokens/sec)').set(
+          stats.get('decode_tokens_per_sec', 0.0))
+    g('ptpu_serve_ttft_ms',
+      help='mean time-to-first-token over completed requests').set(
+          stats.get('ttft_ms_mean') or 0.0)
+    g('ptpu_serve_batch_occupancy',
+      help='mean running slots / decode slots over decode steps').set(
+          stats.get('batch_occupancy', 0.0))
+    g('ptpu_serve_kv_page_utilization',
+      help='KV pool pages in use / total').set(
+          stats.get('kv_page_utilization', 0.0))
+    pool = stats.get('pool') or {}
+    g('ptpu_serve_kv_pages_total', help='KV pool size in pages').set(
+        pool.get('num_pages', 0))
+    g('ptpu_serve_kv_pages_in_use', help='KV pages mapped right now').set(
+        pool.get('pages_in_use', 0))
+    g('ptpu_serve_kv_pages_high_water',
+      help='max KV pages simultaneously mapped').set(
+          pool.get('high_water', 0))
+    g('ptpu_serve_batch_slots', help='decode batch slots').set(
+        stats.get('slots', 0))
+    g('ptpu_serve_requests_in_flight',
+      help='requests holding a decode slot').set(
+          stats.get('in_flight', 0))
+    g('ptpu_serve_requests_waiting', help='queued requests').set(
+        stats.get('waiting', 0))
+    for name in _COUNTER_NAMES:
+        key = name[len('ptpu_serve_'):-len('_total')]
+        g(name, help=f'serving {key.replace("_", " ")} (lifetime)').set(
+            stats.get(key + '_total', 0))
+    h = _m.histogram('ptpu_serve_ttft_seconds',
+                     help='per-request time to first token',
+                     buckets=TTFT_BUCKETS)
+    for t in stats.pop('_new_ttfts_s', ()):
+        h.observe(t)
+
+
+def serve_snapshot():
+    """JSON-ready view of every ptpu_serve_* metric (None-able: {} when
+    the engine never published — StepTelemetry drops it to None)."""
+    reg = _m.metrics()
+    out = {}
+    for name in _GAUGE_NAMES + _COUNTER_NAMES:
+        m = reg.get(name)
+        if m is None:
+            continue
+        out[name] = m.value()
+    h = reg.get('ptpu_serve_ttft_seconds')
+    if h is not None:
+        v = h.value()
+        out['ptpu_serve_ttft_seconds'] = {
+            'count': v['count'],
+            'sum': v['sum'],
+            'mean_ms': (v['sum'] / v['count'] * 1000.0) if v['count']
+            else None,
+        }
+    return out

@@ -1,0 +1,151 @@
+"""Continuous-batching scheduler (host side).
+
+Orca-style iteration-level scheduling: requests join a FCFS queue,
+claim a decode slot when one frees up, chunk-prefill their prompt, then
+ride the batched one-token decode step until EOS / length, at which
+point the slot is immediately re-filled — no waiting for the rest of
+the batch. When the KV pool runs dry the YOUNGEST running request is
+preempted: its pages are released and it re-queues at the front with
+its generated tokens kept, so resume is a re-prefill of
+prompt+generated (recompute beats reserving swap space at these sizes).
+
+All of this is pure host bookkeeping between fixed-shape jitted steps
+(engine.py) — the device never sees a dynamic shape.
+"""
+import itertools
+import time
+
+
+class RequestState:
+    WAITING = 'waiting'
+    PREFILL = 'prefill'
+    RUNNING = 'running'
+    FINISHED = 'finished'
+
+
+_ids = itertools.count()
+
+
+class Request:
+    """One generation request. `tokens` is the full device-visible
+    context (prompt + generated so far); `prefilled` counts how many of
+    them already sit in KV pages."""
+
+    def __init__(self, prompt_ids, max_new_tokens=32, eos_token_id=None,
+                 temperature=0.0, top_k=0):
+        self.id = next(_ids)
+        self.prompt = [int(t) for t in prompt_ids]
+        if not self.prompt:
+            raise ValueError("empty prompt")
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_token_id = eos_token_id
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.generated = []
+        self.prefilled = 0
+        self.state = RequestState.WAITING
+        self.submit_time = None
+        self.first_token_time = None
+        self.finish_time = None
+        self.preemptions = 0
+
+    @property
+    def tokens(self):
+        return self.prompt + self.generated
+
+    @property
+    def context_len(self):
+        return len(self.prompt) + len(self.generated)
+
+    @property
+    def done(self):
+        if len(self.generated) >= self.max_new_tokens:
+            return True
+        return (self.eos_token_id is not None and self.generated
+                and self.generated[-1] == self.eos_token_id)
+
+    def ttft_ms(self):
+        if self.submit_time is None or self.first_token_time is None:
+            return None
+        return (self.first_token_time - self.submit_time) * 1000.0
+
+    def output_ids(self):
+        return list(self.tokens)
+
+
+class Scheduler:
+    """Slot table + FCFS queue. The engine drives it: `admit()` between
+    steps, `preempt_victim()` when the pool is dry, `retire()` on
+    completion."""
+
+    def __init__(self, num_slots):
+        self.num_slots = int(num_slots)
+        self.slots = [None] * self.num_slots
+        self.waiting = []
+        self.finished = []
+        self.preemptions = 0
+
+    def submit(self, request):
+        request.submit_time = time.perf_counter()
+        request.state = RequestState.WAITING
+        self.waiting.append(request)
+        return request.id
+
+    def running(self):
+        return [r for r in self.slots if r is not None]
+
+    def occupancy(self):
+        return len(self.running()) / self.num_slots
+
+    @property
+    def has_work(self):
+        return bool(self.waiting or self.running())
+
+    def admit(self, limit=None):
+        """Fill free slots from the queue (FCFS), at most `limit` of
+        them (None = all). Returns the admitted requests; the engine
+        admits one at a time against its page budget and allocates
+        first pages at the prefill step (bouncing a request back via
+        `preempt()` if even that fails)."""
+        admitted = []
+        for i in range(self.num_slots):
+            if limit is not None and len(admitted) >= limit:
+                break
+            if self.slots[i] is None and self.waiting:
+                req = self.waiting.pop(0)
+                req.state = RequestState.PREFILL
+                # resume after preemption re-prefills prompt+generated
+                req.prefilled = 0
+                self.slots[i] = req
+                admitted.append(req)
+        return admitted
+
+    def slot_of(self, request):
+        return self.slots.index(request)
+
+    def preempt_victim(self, exclude=None):
+        """Youngest running/prefilling request (highest id ≈ last
+        admitted), excluding `exclude`. None if there is nobody to
+        preempt."""
+        candidates = [r for r in self.slots
+                      if r is not None and r is not exclude]
+        if not candidates:
+            return None
+        return max(candidates, key=lambda r: r.id)
+
+    def preempt(self, request):
+        """Release the slot and push the request to the FRONT of the
+        queue (it keeps FCFS priority over never-started work)."""
+        i = self.slot_of(request)
+        self.slots[i] = None
+        request.state = RequestState.WAITING
+        request.preemptions += 1
+        self.preemptions += 1
+        self.waiting.insert(0, request)
+
+    def retire(self, request):
+        i = self.slot_of(request)
+        self.slots[i] = None
+        request.state = RequestState.FINISHED
+        request.finish_time = time.perf_counter()
+        self.finished.append(request)

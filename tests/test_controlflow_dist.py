@@ -93,6 +93,52 @@ def test_gpt_kv_cache_decode_matches_full():
     assert cached.numpy().tolist() == full.numpy().tolist()
 
 
+def test_gpt_generate_eos_early_exit_per_row():
+    """generate(eos_token_id=...) must stop once EVERY row has emitted
+    EOS at least once — not only when all rows emit it on the same step
+    — while keeping the emitted tokens identical to the prefix of a
+    run-to-max_new_tokens decode (ISSUE 5 satellite)."""
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    paddle.seed(0)
+    m = GPTForCausalLM(GPTConfig(vocab_size=64, hidden_size=32,
+                                 num_layers=2, num_heads=4, max_seq_len=64,
+                                 hidden_dropout=0.0, attn_dropout=0.0,
+                                 use_flash_attention=False))
+    m.eval()
+    EOS = 63
+    # script the sampler: row 0 emits EOS at step 1, row 1 at step 2 —
+    # never simultaneously, so the old `.all()`-on-one-step check would
+    # run all 8 steps; the per-row check must stop after step 2
+    script = [np.array([5, 7]), np.array([EOS, 9]), np.array([3, EOS]),
+              np.array([1, 1]), np.array([1, 1]), np.array([1, 1]),
+              np.array([1, 1]), np.array([1, 1])]
+    calls = []
+
+    def scripted_sample(step_logits, temperature, top_k):
+        calls.append(1)
+        return script[len(calls) - 1]
+
+    m._sample_next = scripted_sample       # instance shadows staticmethod
+    prompt = paddle.to_tensor(np.array([[5, 9, 2], [7, 1, 4]], 'int32'))
+    out = m.generate(prompt, max_new_tokens=8, eos_token_id=EOS,
+                     use_cache=True)
+    # stopped after 3 sampled steps (row 1's EOS), tokens = the scripted
+    # prefix — rows that finished early kept emitting until the break
+    assert len(calls) == 3
+    assert out.numpy()[:, 3:].tolist() == [[5, EOS, 3], [7, 9, EOS]]
+    # uncached path: same early-exit contract
+    calls.clear()
+    out2 = m.generate(prompt, max_new_tokens=8, eos_token_id=EOS,
+                      use_cache=False)
+    assert len(calls) == 3
+    assert out2.numpy().tolist() == out.numpy().tolist()
+    del m._sample_next
+    # no EOS in the stream -> still runs to max_new_tokens
+    out3 = m.generate(prompt, max_new_tokens=4, eos_token_id=62,
+                      use_cache=True)
+    assert out3.shape[-1] == 3 + 4
+
+
 def test_gpt_generate_scan_matches_greedy():
     from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
     paddle.seed(0)

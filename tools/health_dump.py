@@ -21,13 +21,19 @@ the human post-mortem:
     from a StepTelemetry snapshot or bench record
     (docs/performance.md).
 
+  * serving-engine gauges (`serve` subcommand): ptpu_serve_* decode
+    throughput / TTFT / batch+page occupancy / preemptions from a
+    StepTelemetry snapshot or bench record (docs/serving.md).
+
 Usage:
     python tools/health_dump.py ARTIFACT.json [--json] [--level ERROR]
     python tools/health_dump.py numerics ARTIFACT.json [--json]
     python tools/health_dump.py comm SNAPSHOT.json [--json]
+    python tools/health_dump.py serve SNAPSHOT.json [--json]
     python tools/health_dump.py --selftest           # CI smoke
     python tools/health_dump.py numerics --selftest  # numerics CI smoke
     python tools/health_dump.py comm --selftest      # comm CI smoke
+    python tools/health_dump.py serve --selftest     # serving CI smoke
 """
 import argparse
 import json
@@ -389,6 +395,132 @@ def comm_main(argv):
     return 0
 
 
+def _find_serve(doc):
+    """Accepts a StepTelemetry snapshot, a bench record, or a bare
+    serve_snapshot dict; returns the ptpu_serve_* dict or None."""
+    if not isinstance(doc, dict):
+        return None
+    if any(k.startswith('ptpu_serve_') for k in doc):
+        return doc
+    for path in (('serve',), ('telemetry', 'serve'),
+                 ('detail', 'telemetry', 'serve'),
+                 ('parsed', 'detail', 'telemetry', 'serve'),
+                 ('legs', 'gpt_serve_throughput', 'telemetry_serve'),
+                 ('parsed', 'legs', 'gpt_serve_throughput',
+                  'telemetry_serve')):
+        d = doc
+        for k in path:
+            d = d.get(k) if isinstance(d, dict) else None
+        if isinstance(d, dict) and any(k.startswith('ptpu_serve_')
+                                       for k in d):
+            return d
+    return None
+
+
+def render_serve(s):
+    """Human rendering of the ptpu_serve_* gauges (docs/serving.md
+    metrics table)."""
+    def v(name, default=0):
+        return s.get(f'ptpu_serve_{name}', default)
+    out = ['serving engine (continuous batching over the paged KV pool)']
+    out.append(
+        f"  decode throughput: {v('decode_tokens_per_sec'):.1f} tok/s "
+        f"over {int(v('decode_steps_total'))} batched steps "
+        f"({int(v('decode_tokens_total'))} tokens)")
+    ttft = s.get('ptpu_serve_ttft_seconds') or {}
+    mean_ms = ttft.get('mean_ms')
+    out.append(
+        f"  time-to-first-token: "
+        + (f"{mean_ms:.1f} ms mean over {ttft.get('count', 0)} requests"
+           if mean_ms is not None else
+           f"{v('ttft_ms'):.1f} ms (gauge)"))
+    out.append(
+        f"  batch occupancy: {100 * v('batch_occupancy'):.1f}% of "
+        f"{int(v('batch_slots'))} decode slots; "
+        f"{int(v('requests_in_flight'))} in flight, "
+        f"{int(v('requests_waiting'))} waiting")
+    out.append(
+        f"  KV pool: {int(v('kv_pages_in_use'))}/"
+        f"{int(v('kv_pages_total'))} pages in use "
+        f"({100 * v('kv_page_utilization'):.1f}% mean), "
+        f"high water {int(v('kv_pages_high_water'))}")
+    out.append(
+        f"  lifetime: {int(v('requests_completed_total'))}/"
+        f"{int(v('requests_submitted_total'))} requests completed, "
+        f"{int(v('preemptions_total'))} preemptions, "
+        f"{int(v('prefill_tokens_total'))} prefill tokens in "
+        f"{int(v('prefill_chunks_total'))} chunks")
+    return '\n'.join(out)
+
+
+def _serve_selftest():
+    """CI smoke: drive the REAL serving engine end to end on the CPU
+    fallback path — mixed-length prompts through continuous batching —
+    then assert the gauges flow through StepTelemetry and render."""
+    _repo_root_on_path()
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving import ServingEngine, ServingConfig
+    from paddle_tpu.profiler import StepTelemetry
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=2, max_seq_len=64, hidden_dropout=0.0,
+                    attn_dropout=0.0, use_flash_attention=False)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(0)
+    prompts = [list(rng.randint(1, 64, n)) for n in (3, 7, 5)]
+    eng = ServingEngine(model, ServingConfig(page_size=8,
+                                             max_batch_size=2,
+                                             prefill_chunk=8))
+    outs = eng.generate(prompts, max_new_tokens=4, top_k=0)
+    assert all(len(o) == len(p) + 4 for o, p in zip(outs, prompts))
+    snap = StepTelemetry(publish=False).snapshot()
+    serve = _find_serve({'telemetry': {'serve': snap['serve']}})
+    assert serve, 'StepTelemetry snapshot carries no serve section'
+    assert serve['ptpu_serve_requests_completed_total'] == 3, serve
+    assert serve['ptpu_serve_decode_tokens_per_sec'] > 0, serve
+    text = render_serve(serve)
+    assert 'decode throughput' in text and 'time-to-first-token' in text
+    assert '3/3 requests completed' in text, text
+    eng.shutdown()
+    print(text)
+    print('health_dump serve selftest: OK')
+    return 0
+
+
+def serve_main(argv):
+    ap = argparse.ArgumentParser(
+        prog='health_dump.py serve',
+        description='render ptpu_serve_* serving gauges from a '
+                    'StepTelemetry snapshot or bench record')
+    ap.add_argument('artifact', nargs='?',
+                    help='StepTelemetry snapshot / bench record JSON')
+    ap.add_argument('--json', action='store_true')
+    ap.add_argument('--selftest', action='store_true')
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return _serve_selftest()
+    if not args.artifact:
+        ap.error('artifact path required (or --selftest)')
+    with open(args.artifact) as f:
+        doc = json.load(f)
+    serve = _find_serve(doc)
+    if serve is None:
+        raise ValueError(
+            'no serving telemetry in this artifact (expected a '
+            'StepTelemetry snapshot with a serve section or a bench '
+            'record with legs.gpt_serve_throughput — docs/serving.md)')
+    if args.json:
+        print(json.dumps(serve, indent=2))
+    else:
+        print(render_serve(serve))
+    return 0
+
+
 def numerics_main(argv):
     ap = argparse.ArgumentParser(
         prog='health_dump.py numerics',
@@ -414,6 +546,8 @@ def main(argv=None):
         return numerics_main(argv[1:])
     if argv and argv[0] == 'comm':
         return comm_main(argv[1:])
+    if argv and argv[0] == 'serve':
+        return serve_main(argv[1:])
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument('artifact', nargs='?',
                     help='hang/OOM report JSON or workerlog .jsonl')

@@ -6,6 +6,8 @@
 #   e2e     - convergence/book tests (slow)
 #   --comm-selftest - 2-rank sharded-vs-replicated weight-update
 #                     equivalence + comm-gauge CLI smoke (ISSUE 4)
+#   --serve-selftest - serving engine end-to-end on the CPU fallback
+#                      path + serve-gauge CLI smoke (ISSUE 5)
 set -e
 cd "$(dirname "$0")/.."
 TIER="${1:-all}"
@@ -13,7 +15,8 @@ case "$TIER" in
   fast)   python -m pytest tests/test_ops.py tests/test_autograd.py \
             tests/test_layers_optim.py tests/test_controlflow_dist.py \
             tests/test_profiler_trace.py tests/test_diagnostics.py \
-            tests/test_numerics.py tests/test_bucketing.py -q
+            tests/test_numerics.py tests/test_bucketing.py \
+            tests/test_serving.py -q
           # observability tooling smoke: tracer -> export -> summary CLI
           python tools/trace_summary.py --selftest
           # diagnostics smoke: flight recorder -> hang/OOM reports -> CLI
@@ -21,7 +24,9 @@ case "$TIER" in
           # numerics smoke: fused stats -> guard trip -> artifact render
           python tools/health_dump.py numerics --selftest
           # comm smoke: bucket gauges -> snapshot -> render
-          python tools/health_dump.py comm --selftest ;;
+          python tools/health_dump.py comm --selftest
+          # serving smoke: engine -> serve gauges -> render
+          python tools/health_dump.py serve --selftest ;;
   dist)   python -m pytest tests/test_distributed.py \
             tests/test_launch_elastic.py tests/test_bert_zero_asp.py -q ;;
   native) python -m pytest tests/test_native.py tests/test_ps.py -q ;;
@@ -33,10 +38,16 @@ case "$TIER" in
           # tolerance (docs/performance.md)
           python tests/dist_models/dist_bucket_equiv.py
           python tools/health_dump.py comm --selftest ;;
+  --serve-selftest)
+          # serving engine end to end on the CPU fallback path (paged
+          # pool + continuous batching), then the gauge CLI smoke
+          python -m pytest tests/test_serving.py -q
+          python tools/health_dump.py serve --selftest ;;
   all)    python -m pytest tests/ -q
           python tools/trace_summary.py --selftest
           python tools/health_dump.py --selftest
           python tools/health_dump.py numerics --selftest
-          python tools/health_dump.py comm --selftest ;;
-  *) echo "usage: $0 [fast|dist|native|e2e|all|--comm-selftest]"; exit 1 ;;
+          python tools/health_dump.py comm --selftest
+          python tools/health_dump.py serve --selftest ;;
+  *) echo "usage: $0 [fast|dist|native|e2e|all|--comm-selftest|--serve-selftest]"; exit 1 ;;
 esac
