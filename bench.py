@@ -531,12 +531,31 @@ def bench_gpt_serve():
         page_size=page_size, max_batch_size=batch, prefill_chunk=chunk,
         max_pages_per_seq=pages_per_seq))
     eng.generate([prompts[0]], max_new_tokens=2, top_k=0)  # compile warmup
-    eng.reset_stats()
+    eng.reset_stats()       # also clears the request journals/timeline
     t0 = time.time()
     outs = eng.generate(prompts, max_new_tokens=max_new, top_k=0)
     serve_dt = time.time() - t0
     serve_tokens = sum(len(o) - len(p) for o, p in zip(outs, prompts))
     st = eng.stats()
+
+    # per-request SLO percentiles from the lifecycle journals (EXACT
+    # per-request values for the measured stream — the monitor
+    # histograms in telemetry_serve are bucket-interpolated and include
+    # warmup; these are the headline numbers)
+    from paddle_tpu.serving.request_trace import percentile_of
+    table = eng.request_table()
+    slo = {}
+    for key, label in (('ttft_s', 'ttft_ms'), ('tpot_s', 'tpot_ms'),
+                       ('queue_wait_s', 'queue_wait_ms'),
+                       ('e2e_s', 'e2e_ms')):
+        vals = [r[key] for r in table.values()]
+        slo[label] = {
+            f'p{q}': (round(p * 1000.0, 3)
+                      if (p := percentile_of(vals, q)) is not None
+                      else None)
+            for q in (50, 90, 99)}
+    timeline = eng.timeline.summary()
+
     dense_cache_tokens = n_req * cfg.max_seq_len
     paged_tokens = st['pool']['high_water'] * page_size
     eng.shutdown()
@@ -546,6 +565,8 @@ def bench_gpt_serve():
         'speedup_vs_sequential': (serve_tokens / serve_dt) / seq_tps,
         'decode_tokens_per_sec': st['decode_tokens_per_sec'],
         'ttft_ms_mean': st['ttft_ms_mean'],
+        'slo': slo,
+        'timeline': timeline,
         'batch_occupancy': st['batch_occupancy'],
         'kv_page_utilization': st['kv_page_utilization'],
         'kv_pages_high_water': st['pool']['high_water'],

@@ -232,6 +232,37 @@ class _HistogramChild:
                     'buckets': {str(b): c for b, c in
                                 zip(self.buckets, self.counts)}}
 
+    def percentile(self, q):
+        """Bucket-interpolated quantile estimate (Prometheus
+        histogram_quantile semantics): find the bucket holding the
+        q-th observation and interpolate linearly inside it, assuming
+        uniform spread. The +Inf bucket degrades to its lower bound —
+        an estimator can't see past the last finite boundary. None
+        when the histogram is empty."""
+        if not 0 <= q <= 100:
+            raise ValueError(f"percentile {q} not in [0, 100]")
+        with self._lock:
+            total = self.count
+            if total == 0:
+                return None
+            counts = list(self.counts)      # cumulative (le semantics)
+        rank = q / 100.0 * total
+        for i, c in enumerate(counts):
+            if c >= rank:
+                lo = self.buckets[i - 1] if i > 0 else 0.0
+                hi = self.buckets[i]
+                if hi == float('inf'):
+                    return lo
+                below = counts[i - 1] if i > 0 else 0
+                in_bucket = c - below
+                if in_bucket <= 0:
+                    return hi
+                return lo + (hi - lo) * (rank - below) / in_bucket
+        return self.buckets[-2] if len(self.buckets) > 1 else 0.0
+
+    def percentiles(self, qs=(50, 90, 99)):
+        return {f'p{g}': self.percentile(g) for g in qs}
+
 
 class Histogram(Metric):
     kind = 'histogram'
@@ -251,6 +282,12 @@ class Histogram(Metric):
 
     def value(self, **labels):
         return self._child(labels).value()
+
+    def percentile(self, q, **labels):
+        return self._child(labels).percentile(q)
+
+    def percentiles(self, qs=(50, 90, 99), **labels):
+        return self._child(labels).percentiles(qs)
 
 
 class MetricsRegistry:

@@ -284,11 +284,21 @@ def stop_device_trace():
 # chrome-trace / JSON writers
 # ---------------------------------------------------------------------------
 def _chrome_events(spans, metadata=None):
+    # spans may carry an explicit 'pid'/'pname' (synthetic track
+    # groups — the serving request tracer puts each request on its own
+    # virtual thread of a 'serving requests' pseudo-process so request
+    # tracks render as a group beside the host's engine spans)
     events = []
     threads = {}
+    procs = {_PID: 'paddle_tpu host'}
     for s in spans:
-        threads.setdefault(s.get('tid', 0), s.get('tname', ''))
-        ev = {'name': s['name'], 'ph': 'X', 'pid': _PID,
+        pid = s.get('pid', _PID)
+        if s.get('pname'):
+            procs[pid] = s['pname']
+        elif pid not in procs:
+            procs[pid] = f'paddle_tpu pid {pid}'
+        threads.setdefault((pid, s.get('tid', 0)), s.get('tname', ''))
+        ev = {'name': s['name'], 'ph': 'X', 'pid': pid,
               'tid': s.get('tid', 0), 'ts': s['ts'], 'dur': s['dur'],
               'cat': s.get('cat') or 'python'}
         args = dict(s.get('args') or {})
@@ -299,10 +309,11 @@ def _chrome_events(spans, metadata=None):
         if args:
             ev['args'] = {k: _jsonable(v) for k, v in args.items()}
         events.append(ev)
-    events.append({'name': 'process_name', 'ph': 'M', 'pid': _PID,
-                   'args': {'name': 'paddle_tpu host'}})
-    for tid, tname in threads.items():
-        events.append({'name': 'thread_name', 'ph': 'M', 'pid': _PID,
+    for pid, pname in procs.items():
+        events.append({'name': 'process_name', 'ph': 'M', 'pid': pid,
+                       'args': {'name': pname}})
+    for (pid, tid), tname in threads.items():
+        events.append({'name': 'thread_name', 'ph': 'M', 'pid': pid,
                        'tid': tid, 'args': {'name': tname or str(tid)}})
     return events
 

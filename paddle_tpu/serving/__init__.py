@@ -6,16 +6,25 @@ continuous-batching scheduler that admits / chunk-prefills / batch-
 decodes / preempts requests across fixed-shape jitted steps
 (`scheduler.py` + `engine.py`), and the ragged paged-attention Pallas
 kernel (`ops/pallas/paged_attention.py`) those steps call. Metrics
-publish as `ptpu_serve_*` gauges through core.monitor (`metrics.py`),
-surfaced in `profiler.StepTelemetry.snapshot()['serve']` and rendered
-by `tools/health_dump.py serve`. See docs/serving.md.
+publish as `ptpu_serve_*` gauges + SLO percentile histograms through
+core.monitor (`metrics.py`), surfaced in
+`profiler.StepTelemetry.snapshot()['serve']` and rendered by
+`tools/health_dump.py serve`; per-request lifecycle journals, the
+scheduler timeline, and the stalled-request watchdog live in
+`request_trace.py` + `scheduler.SchedulerTimeline`. See
+docs/serving.md.
 """
 from .kv_pool import KVPagePool, PoolExhausted
-from .scheduler import Request, RequestState, Scheduler
+from .scheduler import (Request, RequestState, Scheduler,
+                        SchedulerTimeline)
 from .engine import ServingConfig, ServingEngine
+from .request_trace import (RequestTracer, load_trace, reconstruct,
+                            render_serve_report)
 from . import metrics
 
 __all__ = [
     'KVPagePool', 'PoolExhausted', 'Request', 'RequestState',
-    'Scheduler', 'ServingConfig', 'ServingEngine', 'metrics',
+    'Scheduler', 'SchedulerTimeline', 'ServingConfig', 'ServingEngine',
+    'RequestTracer', 'load_trace', 'reconstruct',
+    'render_serve_report', 'metrics',
 ]

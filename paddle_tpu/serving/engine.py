@@ -18,7 +18,10 @@ so occupancy is a pure scheduling concern.
 
 Scheduling (admit / chunk order / preempt-youngest) lives in
 scheduler.py; page accounting in kv_pool.py; ptpu_serve_* metrics in
-metrics.py. docs/serving.md covers tuning the knobs.
+metrics.py; per-request lifecycle tracing in request_trace.py — every
+host-side scheduling decision the engine makes lands in the request's
+journal and the scheduler timeline, with zero extra device syncs.
+docs/serving.md covers tuning the knobs.
 """
 import math
 import time
@@ -26,8 +29,20 @@ import time
 import numpy as np
 
 from .kv_pool import KVPagePool, PoolExhausted
-from .scheduler import Request, RequestState, Scheduler
+from .scheduler import (Request, RequestState, Scheduler,
+                        SchedulerTimeline)
+from .request_trace import (RequestTracer, build_serve_report,
+                            write_serve_report)
 from . import metrics as _metrics
+from ..profiler import RecordEvent
+
+
+def _host_fetch(x):
+    """Every host sync the engine performs (the per-step sampled-token
+    fetch) funnels through this hook so tests can count them — the
+    PR-3 numerics._host_fetch convention. Tracing must not add calls
+    here (asserted in tests/test_serving_trace.py)."""
+    return np.asarray(x)
 
 
 class ServingConfig:
@@ -41,14 +56,32 @@ class ServingConfig:
     prefill_chunk    prompt tokens per prefill dispatch
     kv_dtype         pool dtype (default: model param dtype)
     seed             device sampling stream seed
+    trace            per-request lifecycle journal on/off (host-only
+                     bookkeeping; default on — docs/serving.md)
+    trace_events_per_request / trace_requests   journal caps
+    timeline_capacity  scheduler-timeline ring size (iterations)
+    request_deadline_s stalled-request watchdog deadline (None = off):
+                       a request older than this produces a
+                       serve_report artifact
+    deadline_action  'report' (default) or 'abort' (also drop it)
+    report_dir       serve_report directory (default:
+                     $PTPU_SERVE_REPORT_DIR, then $FLEET_LOG_DIR)
+    clock            monotonic clock for ALL request timing
+                     (tests inject a deterministic one)
     """
 
     def __init__(self, page_size=16, max_batch_size=4, num_pages=None,
                  max_pages_per_seq=None, prefill_chunk=32,
-                 kv_dtype=None, seed=0):
+                 kv_dtype=None, seed=0, trace=True,
+                 trace_events_per_request=512, trace_requests=512,
+                 timeline_capacity=2048, request_deadline_s=None,
+                 deadline_action='report', report_dir=None, clock=None):
         if page_size <= 0 or max_batch_size <= 0 or prefill_chunk <= 0:
             raise ValueError("page_size, max_batch_size and "
                              "prefill_chunk must be positive")
+        if deadline_action not in ('report', 'abort'):
+            raise ValueError("deadline_action must be 'report' or "
+                             "'abort'")
         self.page_size = int(page_size)
         self.max_batch_size = int(max_batch_size)
         self.num_pages = num_pages
@@ -56,6 +89,14 @@ class ServingConfig:
         self.prefill_chunk = int(prefill_chunk)
         self.kv_dtype = kv_dtype
         self.seed = int(seed)
+        self.trace = bool(trace)
+        self.trace_events_per_request = int(trace_events_per_request)
+        self.trace_requests = int(trace_requests)
+        self.timeline_capacity = int(timeline_capacity)
+        self.request_deadline_s = request_deadline_s
+        self.deadline_action = deadline_action
+        self.report_dir = report_dir
+        self.clock = clock
 
 
 class ServingEngine:
@@ -85,7 +126,18 @@ class ServingEngine:
             num_heads=attn0.local_heads, head_dim=attn0.head_dim,
             dtype=dtype)
         self.pool.materialize()
-        self.scheduler = Scheduler(config.max_batch_size)
+        self._clock = config.clock or time.perf_counter
+        self.scheduler = Scheduler(config.max_batch_size,
+                                   clock=self._clock)
+        # request observatory: lifecycle journals + iteration timeline
+        # (host-only bookkeeping on data the scheduler already holds)
+        self.tracer = RequestTracer(
+            capacity_requests=config.trace_requests,
+            events_per_request=config.trace_events_per_request,
+            clock=self._clock) if config.trace else None
+        self.timeline = SchedulerTimeline(config.timeline_capacity)
+        self.last_serve_report = None
+        self._stall_reported = set()        # req ids already reported
         self._params = {n: p.data for n, p in model.named_parameters()}
         self._step_fns = {}
         self._key = jax.random.key(config.seed)
@@ -101,8 +153,12 @@ class ServingEngine:
         self._prefill_chunks = 0
         self._submitted = 0
         self._completed = 0
+        self._aborted = 0
         self._ttfts_s = []
         self._new_ttfts_s = []
+        # per-retire SLO samples pending the next histogram publish
+        self._new_slo = {'queue_wait_s': [], 'tpot_s': [], 'e2e_s': [],
+                         'preemptions': []}
         self._last_publish = 0.0
 
     # seconds between periodic gauge publishes on a busy engine —
@@ -136,7 +192,14 @@ class ServingEngine:
                 f"({self.model.config.max_seq_len})")
         self.scheduler.submit(req)
         self._submitted += 1
+        self._trace(req, 'submit', t=req.submit_time,
+                    prompt_tokens=len(req.prompt),
+                    max_new_tokens=req.max_new_tokens)
         return req
+
+    def _trace(self, req, event, t=None, **fields):
+        if self.tracer is not None:
+            self.tracer.record(req.id, event, t=t, **fields)
 
     def generate(self, prompts, max_new_tokens=32, eos_token_id=None,
                  temperature=1.0, top_k=0, max_steps=None):
@@ -162,20 +225,45 @@ class ServingEngine:
     def step(self):
         """One scheduler iteration: admit waiting requests, advance one
         prefill chunk per prefilling request, then one batched decode
-        step for the running set. Publishes metrics."""
+        step for the running set. Records a timeline entry, runs the
+        stalled-request watchdog, publishes metrics."""
         completed_before = self._completed
-        self._admit()
+        preempt_before = self.scheduler.preemptions
+        with RecordEvent('serve::schedule', event_type='serve'):
+            self._check_stalled()
+            admitted = self._admit()
         prefilling = [r for r in self.scheduler.slots
                       if r is not None and r.state == RequestState.PREFILL]
+        prefill_tokens = 0
         for req in prefilling:
-            self._prefill_chunk_step(req)
+            with RecordEvent('serve::prefill_chunk', event_type='serve',
+                             req=req.id):
+                prefill_tokens += self._prefill_chunk_step(req)
         running = [r for r in self.scheduler.slots
                    if r is not None and r.state == RequestState.RUNNING]
+        decode_tokens = 0
         if running:
-            self._decode_step()
+            with RecordEvent('serve::decode', event_type='serve'):
+                decode_tokens = self._decode_step()
+        self.timeline.record(
+            t=self._clock(),
+            # POST-preemption count: _decode_step may preempt members
+            # of `running` under pool pressure, and each surviving row
+            # decodes exactly one token — so decode_tokens IS the
+            # occupied-slot count, matching the engine's own
+            # batch_occupancy accounting on pressure iterations
+            decode_slots_occupied=decode_tokens,
+            decode_slots=self.config.max_batch_size,
+            prefill_tokens=prefill_tokens,
+            decode_tokens=decode_tokens,
+            admissions=admitted,
+            preemptions=self.scheduler.preemptions - preempt_before,
+            waiting=len(self.scheduler.waiting),
+            pool_pages_in_use=self.pool.pages_in_use,
+            pool_pages_total=self.pool.num_pages)
         if (self._completed != completed_before
                 or not self.scheduler.has_work
-                or (time.perf_counter() - self._last_publish
+                or (self._clock() - self._last_publish
                     >= self.PUBLISH_INTERVAL_S)):
             self.publish_metrics()
 
@@ -188,15 +276,26 @@ class ServingEngine:
         preemption churn."""
         sched = self.scheduler
         budget = self.pool.free_pages
+        n_admitted = 0
         while sched.waiting and None in sched.slots:
             need = self.pool.pages_for(
                 min(len(sched.waiting[0].tokens),
                     self.config.prefill_chunk))
             if budget < need:
                 break
-            if not sched.admit(limit=1):
+            got = sched.admit(limit=1)
+            if not got:
                 break
             budget -= need
+            n_admitted += len(got)
+            for req in got:
+                self._trace(req,
+                            'resume' if req.preemptions else 'admit',
+                            t=(req.admit_time
+                               if not req.preemptions else None),
+                            slot=sched.slot_of(req),
+                            waiting=len(sched.waiting))
+        return n_admitted
 
     def _ensure_or_preempt(self, req, n_tokens):
         """Grow req's pages, preempting the youngest other in-flight
@@ -212,8 +311,11 @@ class ServingEngine:
                         f"KV pool ({self.pool.num_pages} pages x "
                         f"{self.pool.page_size}) cannot hold one request "
                         f"of {n_tokens} tokens — raise num_pages")
-                self.pool.release(victim.id)
+                released = self.pool.release(victim.id)
                 self.scheduler.preempt(victim)
+                self._trace(victim, 'preempt', pages_released=released,
+                            for_req=req.id,
+                            tokens_generated=len(victim.generated))
 
     # -- jitted steps --------------------------------------------------------
     def _step_fn(self, B, T, sample):
@@ -282,7 +384,7 @@ class ServingEngine:
         jnp = self._jnp
         C = self.config.prefill_chunk
         if req.state != RequestState.PREFILL:
-            return          # preempted by an earlier request in this
+            return 0        # preempted by an earlier request in this
                             # same step() sweep: it re-queued slotless,
                             # allocating pages to it now would bleed the
                             # pool (and preempt live work) for a request
@@ -294,34 +396,43 @@ class ServingEngine:
         chunk = toks[start:start + n] + [0] * (C - n)
         fn = self._step_fn(1, C, req.top_k > 0)
         self._key, sub = self._jax.random.split(self._key)
-        nxt, new_kv = fn(
-            self._params, self.pool.kv,
-            jnp.asarray([chunk], jnp.int32),
-            jnp.asarray([self._page_row(req)], jnp.int32),
-            jnp.asarray([start + n], jnp.int32),
-            jnp.asarray([n], jnp.int32),
-            sub,
-            jnp.asarray([req.temperature], jnp.float32),
-            jnp.asarray([req.top_k], jnp.int32))
+        with RecordEvent('serve::compiled_step', event_type='serve',
+                         shape='prefill'):
+            nxt, new_kv = fn(
+                self._params, self.pool.kv,
+                jnp.asarray([chunk], jnp.int32),
+                jnp.asarray([self._page_row(req)], jnp.int32),
+                jnp.asarray([start + n], jnp.int32),
+                jnp.asarray([n], jnp.int32),
+                sub,
+                jnp.asarray([req.temperature], jnp.float32),
+                jnp.asarray([req.top_k], jnp.int32))
         self.pool.kv = new_kv
         req.prefilled = start + n
         self._prefill_tokens += n
         self._prefill_chunks += 1
+        self._trace(req, 'prefill_chunk', tokens=n, prefilled=start + n,
+                    pages=len(self.pool.page_table(req.id)))
         if req.prefilled == len(toks):
             if req.max_new_tokens <= 0:
                 self._retire(req)   # prefill-only request (scoring):
-                return              # the budget says emit nothing
-            tok = int(np.asarray(nxt)[0])       # the sampled-token fetch
+                return n            # the budget says emit nothing
+            with RecordEvent('serve::sample_fetch', event_type='serve'):
+                tok = int(_host_fetch(nxt)[0])  # the sampled-token fetch
             req.generated.append(tok)
             if req.first_token_time is None:
-                req.first_token_time = time.perf_counter()
+                req.first_token_time = self._clock()
                 ttft = req.first_token_time - req.submit_time
                 self._ttfts_s.append(ttft)
                 self._new_ttfts_s.append(ttft)
+                self._trace(req, 'first_token',
+                            t=req.first_token_time, tokens_generated=1,
+                            pages=len(self.pool.page_table(req.id)))
             if req.done:
                 self._retire(req)
             else:
                 req.state = RequestState.RUNNING
+        return n
 
     def _decode_step(self):
         jnp = self._jnp
@@ -331,36 +442,40 @@ class ServingEngine:
             if req is not None and req.state == RequestState.RUNNING:
                 self._ensure_or_preempt(req, req.context_len)
         B = self.config.max_batch_size
-        tokens = np.zeros((B, 1), np.int32)
-        page_tables = np.zeros((B, self.max_pages_per_seq), np.int32)
-        seq_lens = np.ones((B,), np.int32)
-        q_lens = np.zeros((B,), np.int32)
-        temps = np.zeros((B,), np.float32)
-        top_ks = np.zeros((B,), np.int32)
-        active = []
-        for i, req in enumerate(sched.slots):
-            if req is None or req.state != RequestState.RUNNING:
-                continue
-            active.append((i, req))
-            tokens[i, 0] = req.tokens[-1]
-            row = self._page_row(req)
-            page_tables[i, :] = row
-            seq_lens[i] = req.context_len
-            q_lens[i] = 1
-            temps[i] = req.temperature
-            top_ks[i] = req.top_k
+        with RecordEvent('serve::prepare', event_type='serve'):
+            tokens = np.zeros((B, 1), np.int32)
+            page_tables = np.zeros((B, self.max_pages_per_seq), np.int32)
+            seq_lens = np.ones((B,), np.int32)
+            q_lens = np.zeros((B,), np.int32)
+            temps = np.zeros((B,), np.float32)
+            top_ks = np.zeros((B,), np.int32)
+            active = []
+            for i, req in enumerate(sched.slots):
+                if req is None or req.state != RequestState.RUNNING:
+                    continue
+                active.append((i, req))
+                tokens[i, 0] = req.tokens[-1]
+                row = self._page_row(req)
+                page_tables[i, :] = row
+                seq_lens[i] = req.context_len
+                q_lens[i] = 1
+                temps[i] = req.temperature
+                top_ks[i] = req.top_k
         if not active:
-            return
+            return 0
         fn = self._step_fn(B, 1, any(r.top_k > 0 for _, r in active))
         self._key, sub = self._jax.random.split(self._key)
         t0 = time.perf_counter()
-        nxt, new_kv = fn(
-            self._params, self.pool.kv,
-            jnp.asarray(tokens), jnp.asarray(page_tables),
-            jnp.asarray(seq_lens), jnp.asarray(q_lens), sub,
-            jnp.asarray(temps), jnp.asarray(top_ks))
+        with RecordEvent('serve::compiled_step', event_type='serve',
+                         shape='decode', batch=len(active)):
+            nxt, new_kv = fn(
+                self._params, self.pool.kv,
+                jnp.asarray(tokens), jnp.asarray(page_tables),
+                jnp.asarray(seq_lens), jnp.asarray(q_lens), sub,
+                jnp.asarray(temps), jnp.asarray(top_ks))
         self.pool.kv = new_kv
-        nxt = np.asarray(nxt)                   # the sampled-token fetch
+        with RecordEvent('serve::sample_fetch', event_type='serve'):
+            nxt = _host_fetch(nxt)              # the sampled-token fetch
         dt = time.perf_counter() - t0
         self._decode_time += dt
         self._decode_steps += 1
@@ -369,13 +484,104 @@ class ServingEngine:
         self._util_sum += self.pool.utilization()
         for i, req in active:
             req.generated.append(int(nxt[i]))
+            self._trace(req, 'decode',
+                        tokens_generated=len(req.generated),
+                        seq_len=req.context_len,
+                        pages=len(self.pool.page_table(req.id)))
             if req.done:
                 self._retire(req)
+        return len(active)
 
     def _retire(self, req):
         self.pool.release(req.id)
         self.scheduler.retire(req)
         self._completed += 1
+        self._observe_slo(req)
+        self._trace(req, 'retire', t=req.finish_time,
+                    tokens_generated=len(req.generated),
+                    preemptions=req.preemptions)
+
+    def abort(self, req, reason='aborted'):
+        """Drop a request wherever it sits: pages released, slot/queue
+        entry cleared, journal closed with an `abort` event. The
+        watchdog's deadline_action='abort' path and operator kill.
+        No-op (returns False) on an already-retired/aborted request —
+        double accounting would poison the SLO histograms."""
+        if not self.scheduler.abort(req):
+            return False
+        self.pool.release(req.id)
+        self._aborted += 1
+        self._observe_slo(req)
+        self._trace(req, 'abort', t=req.finish_time, reason=reason,
+                    tokens_generated=len(req.generated),
+                    preemptions=req.preemptions)
+        return True
+
+    def _observe_slo(self, req):
+        """Queue the per-request SLO samples (queue-wait, TPOT, e2e,
+        preemption count) for the next histogram publish — host floats
+        the scheduler already stamped, no device work."""
+        slo = self._new_slo
+        if req.submit_time is not None and req.admit_time is not None:
+            slo['queue_wait_s'].append(req.admit_time - req.submit_time)
+        if (req.first_token_time is not None
+                and req.finish_time is not None
+                and len(req.generated) > 1):
+            slo['tpot_s'].append(
+                (req.finish_time - req.first_token_time)
+                / (len(req.generated) - 1))
+        if req.submit_time is not None and req.finish_time is not None:
+            slo['e2e_s'].append(req.finish_time - req.submit_time)
+        slo['preemptions'].append(req.preemptions)
+
+    # -- stalled-request watchdog --------------------------------------------
+    def _check_stalled(self):
+        """Requests older than config.request_deadline_s produce a
+        structured serve_report artifact (trace + timeline tail + pool
+        census) once, instead of silently sitting in the queue."""
+        deadline = self.config.request_deadline_s
+        if not deadline:
+            return
+        now = self._clock()
+        stalled = [r for r in (list(self.scheduler.waiting)
+                               + [s for s in self.scheduler.slots
+                                  if s is not None])
+                   if r.submit_time is not None
+                   and now - r.submit_time > deadline
+                   and r.id not in self._stall_reported]
+        for req in stalled:
+            self._stall_reported.add(req.id)
+            self.last_serve_report = self._build_report(
+                req, age_s=now - req.submit_time)
+            self.last_serve_report['path'] = write_serve_report(
+                self.last_serve_report, self.config.report_dir)
+            if self.config.deadline_action == 'abort':
+                self.abort(req, reason='deadline_exceeded')
+
+    def _build_report(self, req, age_s):
+        events = (self.tracer.events(req.id)
+                  if self.tracer is not None else [])
+        return build_serve_report(
+            reason=f'request exceeded deadline '
+                   f'({self.config.request_deadline_s}s)',
+            request_summary={
+                'req': req.id, 'state': req.state, 'age_s': age_s,
+                'deadline_s': self.config.request_deadline_s,
+                'prompt_tokens': len(req.prompt),
+                'tokens_generated': len(req.generated),
+                'preemptions': req.preemptions,
+            },
+            trace_events=events,
+            timeline_tail=self.timeline.tail(32),
+            pool_stats=self.pool.stats(),
+            pool_census=self.pool.census(),
+            engine_stats={
+                'in_flight': len(self.scheduler.running()),
+                'waiting': len(self.scheduler.waiting),
+                'submitted': self._submitted,
+                'completed': self._completed,
+                'aborted': self._aborted,
+            })
 
     # -- stats / metrics -----------------------------------------------------
     def stats(self):
@@ -395,6 +601,7 @@ class ServingEngine:
             'pool': self.pool.stats(),
             'requests_submitted_total': self._submitted,
             'requests_completed_total': self._completed,
+            'requests_aborted_total': self._aborted,
             'preemptions_total': self.scheduler.preemptions,
             'decode_steps_total': self._decode_steps,
             'decode_tokens_total': self._decode_tokens,
@@ -404,9 +611,10 @@ class ServingEngine:
         return s
 
     def reset_stats(self):
-        """Zero the rate/occupancy accounting (NOT the pool or queue) —
-        bench legs call this after compile warmup so steady-state
-        numbers aren't polluted by the first-dispatch compiles."""
+        """Zero the rate/occupancy accounting AND the trace/timeline
+        observatory (NOT the pool or queue) — bench legs call this
+        after compile warmup so steady-state numbers aren't polluted by
+        the first-dispatch compiles."""
         self._decode_time = 0.0
         self._decode_tokens = 0
         self._decode_steps = 0
@@ -416,13 +624,47 @@ class ServingEngine:
         self._prefill_chunks = 0
         self._ttfts_s = []
         self._new_ttfts_s = []
+        for v in self._new_slo.values():
+            v.clear()
+        if self.tracer is not None:
+            self.tracer.reset()
+        self.timeline.reset()
 
     def publish_metrics(self):
         s = self.stats()
         s['_new_ttfts_s'] = list(self._new_ttfts_s)
         self._new_ttfts_s.clear()
-        self._last_publish = time.perf_counter()
+        s['_new_slo'] = {k: list(v) for k, v in self._new_slo.items()}
+        for v in self._new_slo.values():
+            v.clear()
+        s['timeline'] = self.timeline.summary()
+        self._last_publish = self._clock()
         _metrics.publish(s)
+
+    def request_table(self):
+        """Per-request SLO reconstruction from the lifecycle journals
+        (request_trace.reconstruct) — empty when tracing is off."""
+        return self.tracer.request_table() if self.tracer else {}
+
+    def export_trace(self, jsonl_path=None, chrome_path=None):
+        """Export the request journals: JSON-lines (schema header +
+        one event per line) and/or chrome-trace. The chrome export
+        folds in any serve::* engine-phase spans sitting in the
+        profiler's span buffer, so requests render as tracks next to
+        the engine steps that served them (Perfetto-loadable)."""
+        if self.tracer is None:
+            raise RuntimeError("tracing is off — build the engine with "
+                               "ServingConfig(trace=True)")
+        out = {}
+        if jsonl_path:
+            out['jsonl'] = self.tracer.export_jsonl(jsonl_path)
+        if chrome_path:
+            from .. import profiler as _prof
+            spans = [s for s in _prof._buffer.snapshot()
+                     if s.get('cat') == 'serve']
+            out['chrome'] = self.tracer.export_chrome_tracing(
+                chrome_path, extra_spans=spans)
+        return out
 
     def shutdown(self):
         """Drop the pool's device pages and the compiled steps."""

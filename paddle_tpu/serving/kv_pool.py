@@ -137,6 +137,14 @@ class KVPagePool:
             self._owner.clear()
             self._seq_pages.clear()
 
+    def census(self):
+        """{seq_id: pages held} — who is sitting on the pool right now
+        (the serve_report watchdog artifact embeds this so a stalled
+        request's report names the page hogs)."""
+        with self._lock:
+            return {seq: len(pages)
+                    for seq, pages in self._seq_pages.items()}
+
     def stats(self):
         return {
             'num_pages': self.num_pages,

@@ -4,11 +4,38 @@ Published through core.monitor (same registry the training telemetry
 uses), read back by `serve_snapshot()` for
 `profiler.StepTelemetry.snapshot()['serve']`, bench records, and
 `tools/health_dump.py serve`. Gauge table in docs/serving.md.
+
+The SLO layer (ISSUE 6): per-request queue-wait / TTFT / TPOT / e2e /
+preemption-count histograms with bucket-interpolated p50/p90/p99
+(core.monitor.Histogram.percentiles) in the snapshot, plus the
+scheduler-timeline summary — the occupancy-feedback signal the future
+disaggregated router consumes.
 """
 from ..core import monitor as _m
 
 TTFT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
                 2.5, 5.0, 10.0, 30.0, float('inf'))
+TPOT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                0.25, 0.5, 1.0, float('inf'))
+E2E_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+               5.0, 10.0, 30.0, 60.0, 120.0, float('inf'))
+PREEMPT_BUCKETS = (0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, float('inf'))
+
+# SLO histograms: monitor name -> (engine _new_slo key, buckets, help)
+_SLO_HISTOGRAMS = {
+    'ptpu_serve_queue_wait_seconds': (
+        'queue_wait_s', TTFT_BUCKETS,
+        'per-request submit -> first admit wait'),
+    'ptpu_serve_tpot_seconds': (
+        'tpot_s', TPOT_BUCKETS,
+        'per-request mean inter-token latency (time per output token)'),
+    'ptpu_serve_e2e_seconds': (
+        'e2e_s', E2E_BUCKETS,
+        'per-request submit -> retire latency'),
+    'ptpu_serve_preemptions_per_request': (
+        'preemptions', PREEMPT_BUCKETS,
+        'preemptions suffered per retired request'),
+}
 
 _GAUGE_NAMES = (
     'ptpu_serve_decode_tokens_per_sec',
@@ -25,6 +52,7 @@ _GAUGE_NAMES = (
 _COUNTER_NAMES = (
     'ptpu_serve_requests_submitted_total',
     'ptpu_serve_requests_completed_total',
+    'ptpu_serve_requests_aborted_total',
     'ptpu_serve_preemptions_total',
     'ptpu_serve_decode_steps_total',
     'ptpu_serve_decode_tokens_total',
@@ -32,18 +60,28 @@ _COUNTER_NAMES = (
     'ptpu_serve_prefill_chunks_total',
 )
 
+# scheduler-timeline summary from the engine's last publish — a dict,
+# not registry gauges: it is a windowed aggregate that the snapshot
+# passes through whole (the router-feedback signal)
+_last_timeline = None
+
 
 def publish(stats):
     """Publish an engine stats dict (ServingEngine.stats()) as
     ptpu_serve_* gauges. Counters are published as gauges set to the
     engine's lifetime totals — the engine owns the monotonic state, the
     registry just mirrors it (monitor counters can't be set)."""
+    global _last_timeline
     g = _m.gauge
     g('ptpu_serve_decode_tokens_per_sec',
       help='batched decode throughput (generated tokens/sec)').set(
           stats.get('decode_tokens_per_sec', 0.0))
+    # DEPRECATED (ISSUE 6): superseded by the ptpu_serve_ttft_seconds
+    # histogram percentiles; kept publishing for one release so
+    # existing dashboards don't blank
     g('ptpu_serve_ttft_ms',
-      help='mean time-to-first-token over completed requests').set(
+      help='DEPRECATED: mean TTFT over completed requests — use '
+           'ptpu_serve_ttft_seconds percentiles').set(
           stats.get('ttft_ms_mean') or 0.0)
     g('ptpu_serve_batch_occupancy',
       help='mean running slots / decode slots over decode steps').set(
@@ -75,11 +113,39 @@ def publish(stats):
                      buckets=TTFT_BUCKETS)
     for t in stats.pop('_new_ttfts_s', ()):
         h.observe(t)
+    slo = stats.pop('_new_slo', None) or {}
+    for name, (key, buckets, help_) in _SLO_HISTOGRAMS.items():
+        vals = slo.get(key)
+        if not vals:
+            continue
+        hh = _m.histogram(name, help=help_, buckets=buckets)
+        for v in vals:
+            hh.observe(v)
+    tl = stats.pop('timeline', None)
+    if tl is not None:
+        _last_timeline = tl
+
+
+def _histogram_view(h, scale_ms=True):
+    """JSON-ready histogram summary: count/sum/mean + interpolated
+    p50/p90/p99 (seconds scaled to ms when scale_ms)."""
+    v = h.value()
+    pct = h.percentiles((50, 90, 99))
+    k = 1000.0 if scale_ms else 1.0
+    unit = '_ms' if scale_ms else ''
+    out = {'count': v['count'], 'sum': v['sum'],
+           f'mean{unit}': (v['sum'] / v['count'] * k) if v['count']
+           else None}
+    for name, val in pct.items():
+        out[f'{name}{unit}'] = val * k if val is not None else None
+    return out
 
 
 def serve_snapshot():
     """JSON-ready view of every ptpu_serve_* metric (None-able: {} when
-    the engine never published — StepTelemetry drops it to None)."""
+    the engine never published — StepTelemetry drops it to None).
+    Histograms carry bucket-interpolated p50/p90/p99; `timeline` is the
+    scheduler-timeline summary from the engine's last publish."""
     reg = _m.metrics()
     out = {}
     for name in _GAUGE_NAMES + _COUNTER_NAMES:
@@ -89,11 +155,12 @@ def serve_snapshot():
         out[name] = m.value()
     h = reg.get('ptpu_serve_ttft_seconds')
     if h is not None:
-        v = h.value()
-        out['ptpu_serve_ttft_seconds'] = {
-            'count': v['count'],
-            'sum': v['sum'],
-            'mean_ms': (v['sum'] / v['count'] * 1000.0) if v['count']
-            else None,
-        }
+        out['ptpu_serve_ttft_seconds'] = _histogram_view(h)
+    for name, (key, _b, _h) in _SLO_HISTOGRAMS.items():
+        m = reg.get(name)
+        if m is not None:
+            out[name] = _histogram_view(
+                m, scale_ms=(key != 'preemptions'))
+    if out and _last_timeline is not None:
+        out['timeline'] = dict(_last_timeline)
     return out

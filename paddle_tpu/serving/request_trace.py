@@ -1,0 +1,415 @@
+"""Per-request lifecycle tracing for the serving engine.
+
+Answers "why was THIS request slow": every request carries a capped
+event journal — submit / admit / prefill_chunk / first_token / decode
+(per-iteration participation) / preempt / resume / retire / abort —
+with monotonic timestamps and the request's page-table size at the
+time. All of it is pure host bookkeeping on data the scheduler already
+holds: tracing adds ZERO device work and zero extra host syncs
+(asserted in tests/test_serving_trace.py).
+
+Exports:
+
+  * JSON-lines (`RequestTracer.export_jsonl`) — one event per line,
+    schema header first; `load_trace()` round-trips it and
+    `reconstruct()` derives the per-request SLO table (queue-wait,
+    TTFT, TPOT, e2e, preemptions, pages high-water) that
+    tools/trace_summary.py renders;
+  * chrome-trace (`RequestTracer.export_chrome_tracing`) via the PR-1
+    profiler writers — each request renders as its own track (synthetic
+    tid) next to the engine's serve::* step spans, so "request 7 sat
+    preempted while the batch decoded" is visible in Perfetto.
+
+The stalled-request watchdog (engine.py) snapshots a request's journal
+plus the scheduler-timeline tail and a pool census into a structured
+`serve_report` JSON artifact through the PR-2 log_util conventions;
+`render_serve_report()` is the human renderer health_dump.py uses.
+"""
+import collections
+import json
+import os
+import threading
+import time
+
+SCHEMA = 'paddle_tpu.serve_trace/1'
+
+# lifecycle event vocabulary (docs/serving.md#request-traces)
+EVENTS = ('submit', 'admit', 'prefill_chunk', 'first_token', 'decode',
+          'preempt', 'resume', 'retire', 'abort')
+
+# chrome-trace: request tracks live on a 'serving requests'
+# pseudo-process (one virtual thread per request) beside the host
+# process's engine spans — same timeline, clearly grouped
+_TRACK_PID = 1 << 22
+_TRACK_PNAME = 'serving requests'
+_TRACK_TID_BASE = 1 << 24
+
+
+class RequestTrace:
+    """Capped per-request event journal. Events beyond `cap` are
+    counted in `dropped` instead of appended — a runaway decode can't
+    grow host memory without bound."""
+
+    __slots__ = ('req_id', 'events', 'cap', 'dropped')
+
+    def __init__(self, req_id, cap=512):
+        self.req_id = req_id
+        self.events = []
+        self.cap = max(1, int(cap))   # room for the terminal event
+        self.dropped = 0
+
+    def add(self, event, t, **fields):
+        if len(self.events) >= self.cap:
+            if event in ('retire', 'abort'):
+                # the terminal event is load-bearing (end state, e2e,
+                # authoritative token count) — evict the newest
+                # interior event instead of dropping the end of life
+                if self.events:
+                    self.events.pop()
+                    self.dropped += 1
+            else:
+                self.dropped += 1
+                return
+        e = {'req': self.req_id, 'event': event, 't': float(t)}
+        if fields:
+            e.update(fields)
+        self.events.append(e)
+
+
+class RequestTracer:
+    """Journal registry: live requests plus a ring of the most recently
+    retired ones (`capacity_requests`), so a long-lived engine's trace
+    memory is bounded. `clock` is injectable for deterministic tests —
+    the engine shares ONE clock between tracer, scheduler and SLO
+    accounting so cross-source timestamps compare exactly."""
+
+    def __init__(self, capacity_requests=512, events_per_request=512,
+                 clock=None):
+        self.capacity_requests = int(capacity_requests)
+        self.events_per_request = int(events_per_request)
+        self.clock = clock or time.perf_counter
+        self._live = {}                        # req_id -> RequestTrace
+        self._done = collections.deque(maxlen=self.capacity_requests)
+        self._lock = threading.Lock()
+        self.dropped_requests = 0
+
+    # -- recording -----------------------------------------------------------
+    def record(self, req_id, event, t=None, **fields):
+        """Append an event; pass `t` when the caller already stamped
+        the moment (engine submit/first-token/finish times) so the
+        journal's timestamp is bit-identical to the engine's — the
+        reconstruction-equals-engine tests rely on it."""
+        if t is None:
+            t = self.clock()
+        with self._lock:
+            tr = self._live.get(req_id)
+            if tr is None:
+                tr = self._live[req_id] = RequestTrace(
+                    req_id, cap=self.events_per_request)
+            tr.add(event, t, **fields)
+            if event in ('retire', 'abort'):
+                self._live.pop(req_id, None)
+                if len(self._done) == self._done.maxlen:
+                    self.dropped_requests += 1
+                self._done.append(tr)
+        return t
+
+    def reset(self):
+        with self._lock:
+            self._live.clear()
+            self._done.clear()
+            self.dropped_requests = 0
+
+    # -- reading -------------------------------------------------------------
+    def traces(self):
+        """Every journal (retired ring first, then live), oldest first."""
+        with self._lock:
+            return list(self._done) + list(self._live.values())
+
+    def events(self, req_id=None):
+        out = []
+        for tr in self.traces():
+            if req_id is None or tr.req_id == req_id:
+                out.extend(tr.events)
+        out.sort(key=lambda e: e['t'])
+        return out
+
+    def request_table(self):
+        return reconstruct(self.events())
+
+    # -- exporters -----------------------------------------------------------
+    def export_jsonl(self, path):
+        """JSON-lines: a schema header line, then one event per line in
+        timestamp order."""
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        dropped = sum(tr.dropped for tr in self.traces())
+        with open(path, 'w') as f:
+            f.write(json.dumps({'schema': SCHEMA,
+                                'dropped_events': dropped,
+                                'dropped_requests':
+                                    self.dropped_requests}) + '\n')
+            for e in self.events():
+                f.write(json.dumps(e) + '\n')
+        return path
+
+    def chrome_spans(self):
+        """Profiler-internal span dicts (one virtual thread per
+        request): lifecycle segments between consecutive events —
+        queued / prefill / decode / preempted — plus zero-duration
+        markers for first_token and retire/abort. Feed them to
+        profiler's chrome writer next to the engine's serve::* spans."""
+        spans = []
+        for tr in self.traces():
+            tid = _TRACK_TID_BASE + tr.req_id
+            tname = f'req {tr.req_id}'
+            evs = tr.events
+            for i, e in enumerate(evs):
+                t_us = int(e['t'] * 1e6)
+                nxt_us = (int(evs[i + 1]['t'] * 1e6)
+                          if i + 1 < len(evs) else t_us)
+                ev, seg = e['event'], None
+                if ev in ('submit', 'preempt'):
+                    seg = 'queued' if ev == 'submit' else 'preempted'
+                elif ev in ('admit', 'resume'):
+                    seg = 'prefill'
+                elif ev in ('prefill_chunk', 'first_token', 'decode'):
+                    seg = ev
+                if seg is not None and nxt_us > t_us:
+                    spans.append({
+                        'name': f'{tr.req_id}:{seg}',
+                        'cat': 'serve_request', 'ts': t_us,
+                        'dur': nxt_us - t_us, 'tid': tid, 'tname': tname,
+                        'pid': _TRACK_PID, 'pname': _TRACK_PNAME,
+                        'args': {k: v for k, v in e.items()
+                                 if k not in ('t',)}})
+                if ev in ('first_token', 'retire', 'abort'):
+                    spans.append({
+                        'name': f'{tr.req_id}:{ev}',
+                        'cat': 'serve_request', 'ts': t_us, 'dur': 0,
+                        'tid': tid, 'tname': tname,
+                        'pid': _TRACK_PID, 'pname': _TRACK_PNAME,
+                        'args': {k: v for k, v in e.items()
+                                 if k not in ('t',)}})
+        return spans
+
+    def export_chrome_tracing(self, path, extra_spans=None):
+        """Chrome-trace export through the profiler's writer; pass the
+        profiler span buffer (engine serve::* phases) as `extra_spans`
+        to see requests as tracks next to engine steps."""
+        from .. import profiler as _prof
+        spans = self.chrome_spans() + list(extra_spans or ())
+        return _prof._write_chrome_trace(
+            path, spans, metadata={'schema': SCHEMA})
+
+
+# ---------------------------------------------------------------------------
+# reconstruction — trace events -> per-request SLO table
+# ---------------------------------------------------------------------------
+def reconstruct(events):
+    """Derive the per-request lifecycle summary from a flat event list
+    (live tracer or a loaded JSON-lines file). Returns {req_id: {...}}
+    with queue_wait_s / ttft_s / tpot_s / e2e_s, token counts,
+    preemptions, prefill chunks, decode steps, pages high-water —
+    exactly the numbers the engine reports, re-derived from the journal
+    (the equivalence is asserted in tests)."""
+    out = {}
+    for e in sorted(events, key=lambda x: x['t']):
+        r = out.setdefault(e['req'], {
+            'req': e['req'], 'submit_t': None, 'admit_t': None,
+            'first_token_t': None, 'end_t': None, 'state': None,
+            'prompt_tokens': None, 'tokens_generated': 0,
+            'preemptions': 0, 'prefill_chunks': 0, 'decode_steps': 0,
+            'pages_high_water': 0, 'last_token_t': None,
+        })
+        ev, t = e['event'], e['t']
+        if 'pages' in e:
+            r['pages_high_water'] = max(r['pages_high_water'],
+                                        int(e['pages']))
+        if ev == 'submit':
+            r['submit_t'] = t
+            r['prompt_tokens'] = e.get('prompt_tokens')
+        elif ev == 'admit' and r['admit_t'] is None:
+            r['admit_t'] = t
+        elif ev == 'resume':
+            pass                         # re-admit after preempt
+        elif ev == 'prefill_chunk':
+            r['prefill_chunks'] += 1
+        elif ev == 'first_token':
+            r['first_token_t'] = t
+            r['tokens_generated'] = max(r['tokens_generated'],
+                                        e.get('tokens_generated', 1))
+            r['last_token_t'] = t
+        elif ev == 'decode':
+            r['decode_steps'] += 1
+            r['tokens_generated'] = max(r['tokens_generated'],
+                                        e.get('tokens_generated',
+                                              r['tokens_generated'] + 1))
+            r['last_token_t'] = t
+        elif ev == 'preempt':
+            r['preemptions'] += 1
+        elif ev in ('retire', 'abort'):
+            r['end_t'] = t
+            r['state'] = 'aborted' if ev == 'abort' else 'finished'
+            if 'tokens_generated' in e:
+                r['tokens_generated'] = e['tokens_generated']
+    for r in out.values():
+        sub, adm = r['submit_t'], r['admit_t']
+        ft, end = r['first_token_t'], r['end_t']
+        last = r.pop('last_token_t')
+        n = r['tokens_generated']
+        r['queue_wait_s'] = (adm - sub) if sub is not None \
+            and adm is not None else None
+        r['ttft_s'] = (ft - sub) if sub is not None \
+            and ft is not None else None
+        # the terminal stamp closes the last token interval — the SAME
+        # formula engine._observe_slo feeds the TPOT histogram, so the
+        # journal-derived value matches the engine's exactly; fall back
+        # to the last decode stamp for still-live requests
+        stop = end if end is not None else last
+        r['tpot_s'] = ((stop - ft) / (n - 1)) if ft is not None \
+            and stop is not None and n > 1 else None
+        r['e2e_s'] = (end - sub) if sub is not None \
+            and end is not None else None
+    return out
+
+
+def percentile_of(vals, q):
+    """Linear-interpolated percentile of a value list (None entries
+    dropped; None when nothing remains). The one implementation both
+    bench.py and tools/trace_summary.py aggregate request tables with."""
+    vals = sorted(v for v in vals if v is not None)
+    if not vals:
+        return None
+    pos = q / 100.0 * (len(vals) - 1)
+    i = int(pos)
+    frac = pos - i
+    hi = vals[min(i + 1, len(vals) - 1)]
+    return vals[i] * (1 - frac) + hi * frac
+
+
+def load_trace(path):
+    """Read an export_jsonl file back into (header, events)."""
+    header, events = {}, []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            doc = json.loads(line)
+            if 'schema' in doc and 'event' not in doc:
+                header = doc
+            elif 'event' in doc and 'req' in doc:
+                events.append(doc)
+    return header, events
+
+
+# ---------------------------------------------------------------------------
+# stalled-request watchdog artifact (serve_report)
+# ---------------------------------------------------------------------------
+def build_serve_report(reason, request_summary, trace_events,
+                       timeline_tail, pool_stats, pool_census,
+                       engine_stats=None):
+    """Structured serve_report dict — the serving pillar's counterpart
+    of the PR-2 hang/OOM reports (kind-tagged, health_dump-renderable)."""
+    return {
+        'kind': 'serve_report',
+        'schema': SCHEMA,
+        'reason': reason,
+        'request': request_summary,
+        'trace': list(trace_events),
+        'timeline_tail': list(timeline_tail),
+        'pool': dict(pool_stats or {}),
+        'pool_census': dict(pool_census or {}),
+        'engine': dict(engine_stats or {}),
+    }
+
+
+def write_serve_report(report, report_dir=None):
+    """Persist a serve_report; directory resolution follows the PR-2
+    artifact conventions (explicit dir > PTPU_SERVE_REPORT_DIR >
+    FLEET_LOG_DIR > cwd). Also emits a structured log_util event so the
+    fleet log cross-references the artifact. Returns the path (None if
+    the write failed — the report still reached the log)."""
+    d = (report_dir or os.environ.get('PTPU_SERVE_REPORT_DIR')
+         or os.environ.get('FLEET_LOG_DIR'))
+    req = report.get('request') or {}
+    path = None
+    if d:       # no dir configured -> artifact stays on the engine
+                # (last_serve_report) and in the structured log only
+        path = os.path.join(d,
+                            f"serve_report.req{req.get('req', 'X')}.json")
+        try:
+            os.makedirs(d, exist_ok=True)
+            with open(path, 'w') as f:
+                json.dump(report, f, indent=1)
+        except OSError:
+            path = None
+    try:
+        from ..distributed.fleet.utils.log_util import log_json
+        log_json('serve_request_stalled', level='error',
+                 msg=f"serving request {req.get('req')} exceeded its "
+                     f"deadline ({report.get('reason')})",
+                 request=req.get('req'), state=req.get('state'),
+                 age_s=req.get('age_s'), deadline_s=req.get('deadline_s'),
+                 report_path=path)
+    except Exception:
+        pass
+    return path
+
+
+def render_serve_report(doc):
+    """Human rendering of a serve_report artifact (health_dump.py)."""
+    req = doc.get('request') or {}
+    out = [f"SERVE REPORT — {doc.get('reason', '?')}"]
+    out.append(
+        f"  request {req.get('req')}: state={req.get('state')} "
+        f"age={_ms(req.get('age_s'))} deadline={_ms(req.get('deadline_s'))}")
+    out.append(
+        f"  prompt {req.get('prompt_tokens')} tokens, "
+        f"{req.get('tokens_generated', 0)} generated, "
+        f"{req.get('preemptions', 0)} preemptions")
+    table = reconstruct(doc.get('trace') or [])
+    r = table.get(req.get('req'))
+    if r:
+        out.append(
+            f"  queue-wait {_ms(r['queue_wait_s'])}  "
+            f"ttft {_ms(r['ttft_s'])}  tpot {_ms(r['tpot_s'])}  "
+            f"pages high-water {r['pages_high_water']}")
+    evs = doc.get('trace') or []
+    out.append(f"  trace tail ({len(evs)} events):")
+    for e in evs[-8:]:
+        extra = ' '.join(f'{k}={v}' for k, v in e.items()
+                         if k not in ('req', 'event', 't'))
+        out.append(f"    t={e['t']:.6f} {e['event']}"
+                   + (f' {extra}' if extra else ''))
+    tl = doc.get('timeline_tail') or []
+    if tl:
+        out.append(f"  scheduler timeline tail ({len(tl)} iterations):")
+        for it in tl[-5:]:
+            out.append(
+                f"    iter {it.get('iter')}: "
+                f"slots {it.get('decode_slots_occupied')}/"
+                f"{it.get('decode_slots')} "
+                f"prefill {it.get('prefill_tokens')}t "
+                f"decode {it.get('decode_tokens')}t "
+                f"pool {it.get('pool_pages_in_use')}/"
+                f"{it.get('pool_pages_total')} "
+                f"waiting {it.get('waiting')} "
+                f"admit {it.get('admissions')} "
+                f"preempt {it.get('preemptions')}")
+    pool = doc.get('pool') or {}
+    out.append(
+        f"  pool: {pool.get('pages_in_use')}/{pool.get('num_pages')} "
+        f"pages in use, high water {pool.get('high_water')}")
+    census = doc.get('pool_census') or {}
+    if census:
+        rows = ', '.join(f'req {k}: {v} pages'
+                         for k, v in sorted(census.items(),
+                                            key=lambda kv: -kv[1])[:8])
+        out.append(f"  pool census: {rows}")
+    return '\n'.join(out)
+
+
+def _ms(v):
+    return f'{v * 1000.0:.1f}ms' if isinstance(v, (int, float)) else '?'

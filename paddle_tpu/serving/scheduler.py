@@ -11,7 +11,16 @@ prompt+generated (recompute beats reserving swap space at these sizes).
 
 All of this is pure host bookkeeping between fixed-shape jitted steps
 (engine.py) — the device never sees a dynamic shape.
+
+`SchedulerTimeline` is the iteration-level flight record: a ring
+buffer of each engine sweep's batch composition (slots occupied,
+prefill vs decode tokens, pool occupancy, admissions/preemptions) —
+the per-replica occupancy-feedback signal the future disaggregated
+router consumes (ROADMAP serve_scale), and the context a request
+trace is read against ("request 7 stalled because iterations 40-60
+ran the pool at 100%").
 """
+import collections
 import itertools
 import time
 
@@ -21,6 +30,7 @@ class RequestState:
     PREFILL = 'prefill'
     RUNNING = 'running'
     FINISHED = 'finished'
+    ABORTED = 'aborted'
 
 
 _ids = itertools.count()
@@ -45,6 +55,7 @@ class Request:
         self.prefilled = 0
         self.state = RequestState.WAITING
         self.submit_time = None
+        self.admit_time = None           # first admit (queue-wait end)
         self.first_token_time = None
         self.finish_time = None
         self.preemptions = 0
@@ -78,15 +89,16 @@ class Scheduler:
     steps, `preempt_victim()` when the pool is dry, `retire()` on
     completion."""
 
-    def __init__(self, num_slots):
+    def __init__(self, num_slots, clock=None):
         self.num_slots = int(num_slots)
         self.slots = [None] * self.num_slots
         self.waiting = []
         self.finished = []
         self.preemptions = 0
+        self.clock = clock or time.perf_counter
 
     def submit(self, request):
-        request.submit_time = time.perf_counter()
+        request.submit_time = self.clock()
         request.state = RequestState.WAITING
         self.waiting.append(request)
         return request.id
@@ -116,6 +128,8 @@ class Scheduler:
                 req.state = RequestState.PREFILL
                 # resume after preemption re-prefills prompt+generated
                 req.prefilled = 0
+                if req.admit_time is None:
+                    req.admit_time = self.clock()
                 self.slots[i] = req
                 admitted.append(req)
         return admitted
@@ -147,5 +161,85 @@ class Scheduler:
         i = self.slot_of(request)
         self.slots[i] = None
         request.state = RequestState.FINISHED
-        request.finish_time = time.perf_counter()
+        request.finish_time = self.clock()
         self.finished.append(request)
+
+    def abort(self, request):
+        """Drop a request wherever it sits (queue or slot) — the
+        watchdog's deadline_action='abort' path and operator kill.
+        No-op on a request that already reached a terminal state (a
+        double abort must not re-append to `finished` or restamp
+        finish_time). Returns True if the request was aborted here."""
+        if request.state in (RequestState.FINISHED,
+                             RequestState.ABORTED):
+            return False
+        if request in self.waiting:
+            self.waiting.remove(request)
+        elif request in self.slots:
+            self.slots[self.slots.index(request)] = None
+        request.state = RequestState.ABORTED
+        request.finish_time = self.clock()
+        self.finished.append(request)
+        return True
+
+
+class SchedulerTimeline:
+    """Ring buffer of per-iteration batch-composition records — what
+    the engine actually ran each sweep. One dict per engine.step():
+
+      iter, t, decode_slots_occupied, decode_slots, prefill_tokens,
+      decode_tokens, admissions, preemptions, waiting,
+      pool_pages_in_use, pool_pages_total
+
+    `summary()` aggregates it into the occupancy-feedback numbers the
+    bench leg and serve_snapshot() surface."""
+
+    def __init__(self, capacity=2048):
+        self._ring = collections.deque(maxlen=int(capacity))
+        self.iterations = 0         # lifetime count (ring may be full)
+
+    def record(self, **entry):
+        entry['iter'] = self.iterations
+        self.iterations += 1
+        self._ring.append(entry)
+
+    def tail(self, n=32):
+        n = int(n)
+        return list(self._ring)[-n:] if n else []
+
+    def snapshot(self):
+        return list(self._ring)
+
+    def reset(self):
+        self._ring.clear()
+        self.iterations = 0
+
+    def summary(self):
+        rows = list(self._ring)
+        if not rows:
+            return {'iterations': 0}
+        n = len(rows)
+        slots = max(rows[-1].get('decode_slots', 1), 1)
+        pool = max(rows[-1].get('pool_pages_total', 1), 1)
+        decode_rows = [r for r in rows if r.get('decode_tokens')]
+        return {
+            'iterations': self.iterations,
+            'window': n,
+            'mean_decode_slots_occupied':
+                sum(r.get('decode_slots_occupied', 0)
+                    for r in rows) / n,
+            'mean_occupancy':
+                sum(r.get('decode_slots_occupied', 0)
+                    for r in decode_rows) / (len(decode_rows) * slots)
+                if decode_rows else 0.0,
+            'mean_pool_utilization':
+                sum(r.get('pool_pages_in_use', 0) for r in rows)
+                / (n * pool),
+            'prefill_tokens': sum(r.get('prefill_tokens', 0)
+                                  for r in rows),
+            'decode_tokens': sum(r.get('decode_tokens', 0)
+                                 for r in rows),
+            'admissions': sum(r.get('admissions', 0) for r in rows),
+            'preemptions': sum(r.get('preemptions', 0) for r in rows),
+            'max_waiting': max(r.get('waiting', 0) for r in rows),
+        }

@@ -22,8 +22,14 @@ the human post-mortem:
     (docs/performance.md).
 
   * serving-engine gauges (`serve` subcommand): ptpu_serve_* decode
-    throughput / TTFT / batch+page occupancy / preemptions from a
-    StepTelemetry snapshot or bench record (docs/serving.md).
+    throughput / TTFT / batch+page occupancy / preemptions plus the
+    SLO percentile histograms (queue-wait / TTFT / TPOT / e2e
+    p50/p90/p99) and the scheduler-timeline summary, from a
+    StepTelemetry snapshot or bench record (docs/serving.md);
+  * stalled-request watchdog artifacts (`serve_report.req*.json` from
+    the serving engine's deadline watchdog): request journal tail,
+    scheduler-timeline tail, pool census — rendered via the default
+    ARTIFACT.json path.
 
 Usage:
     python tools/health_dump.py ARTIFACT.json [--json] [--level ERROR]
@@ -50,7 +56,8 @@ def classify(doc):
     if isinstance(doc, dict):
         kind = doc.get('kind')
         if kind in ('hang_report', 'flight_recorder', 'oom_report',
-                    'numerics_report', 'divergence_report'):
+                    'numerics_report', 'divergence_report',
+                    'serve_report'):
             return kind
         if 'entries' in doc and 'seq' in doc:
             return 'flight_recorder'
@@ -62,6 +69,8 @@ def classify(doc):
             return 'divergence_report'
         if 'op' in doc and ('output' in doc or 'tensors' in doc):
             return 'numerics_report'
+        if 'timeline_tail' in doc and 'trace' in doc:
+            return 'serve_report'
     return None
 
 
@@ -80,10 +89,13 @@ def render(doc):
     if kind == 'divergence_report':
         from paddle_tpu.core.numerics import render_divergence_report
         return render_divergence_report(doc)
+    if kind == 'serve_report':
+        from paddle_tpu.serving.request_trace import render_serve_report
+        return render_serve_report(doc)
     raise ValueError(
         "unrecognized artifact: expected a hang report, flight-recorder "
-        "dump, OOM report, numerics report, or divergence report (see "
-        "docs/observability.md#diagnostics)")
+        "dump, OOM report, numerics report, divergence report, or "
+        "serving serve_report (see docs/observability.md#diagnostics)")
 
 
 def render_log(path, level=None, tail=50):
@@ -450,19 +462,57 @@ def render_serve(s):
         f"{int(v('preemptions_total'))} preemptions, "
         f"{int(v('prefill_tokens_total'))} prefill tokens in "
         f"{int(v('prefill_chunks_total'))} chunks")
+    # SLO percentile section (bucket-interpolated p50/p90/p99 from the
+    # ptpu_serve_* histograms — docs/serving.md#slo-metrics)
+    slo_rows = []
+    for name, label in (('queue_wait_seconds', 'queue wait'),
+                        ('ttft_seconds', 'ttft'),
+                        ('tpot_seconds', 'tpot'),
+                        ('e2e_seconds', 'e2e')):
+        h = s.get(f'ptpu_serve_{name}') or {}
+        if h.get('count') and h.get('p50_ms') is not None:
+            slo_rows.append(
+                f"    {label:<12} p50 {h['p50_ms']:>9.2f}  "
+                f"p90 {h['p90_ms']:>9.2f}  p99 {h['p99_ms']:>9.2f}  "
+                f"(n={h['count']})")
+    if slo_rows:
+        out.append('  SLO percentiles (ms, bucket-interpolated):')
+        out.extend(slo_rows)
+    pre = s.get('ptpu_serve_preemptions_per_request') or {}
+    if pre.get('count'):
+        out.append(
+            f"  preemptions/request: p50 {pre.get('p50', 0):.1f} "
+            f"p90 {pre.get('p90', 0):.1f} p99 {pre.get('p99', 0):.1f}")
+    tl = s.get('timeline') or {}
+    if tl.get('iterations'):
+        out.append(
+            f"  scheduler timeline (last {tl.get('window', 0)} of "
+            f"{tl['iterations']} iterations): "
+            f"occupancy {100 * tl.get('mean_occupancy', 0):.1f}%, "
+            f"pool {100 * tl.get('mean_pool_utilization', 0):.1f}%, "
+            f"{tl.get('prefill_tokens', 0)} prefill + "
+            f"{tl.get('decode_tokens', 0)} decode tokens, "
+            f"{tl.get('admissions', 0)} admissions, "
+            f"{tl.get('preemptions', 0)} preemptions, "
+            f"max waiting {tl.get('max_waiting', 0)}")
     return '\n'.join(out)
 
 
 def _serve_selftest():
     """CI smoke: drive the REAL serving engine end to end on the CPU
     fallback path — mixed-length prompts through continuous batching —
-    then assert the gauges flow through StepTelemetry and render."""
+    then assert the full observatory: gauges + SLO percentiles +
+    timeline through StepTelemetry, JSON-lines/chrome trace export
+    with engine-equivalent reconstruction, and the stalled-request
+    watchdog's serve_report artifact (ISSUE 6)."""
+    import tempfile
     _repo_root_on_path()
     os.environ.setdefault('JAX_PLATFORMS', 'cpu')
     import numpy as np
     import paddle_tpu as paddle
     from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
     from paddle_tpu.serving import ServingEngine, ServingConfig
+    from paddle_tpu.serving.request_trace import load_trace, reconstruct
     from paddle_tpu.profiler import StepTelemetry
 
     paddle.seed(0)
@@ -483,10 +533,62 @@ def _serve_selftest():
     assert serve, 'StepTelemetry snapshot carries no serve section'
     assert serve['ptpu_serve_requests_completed_total'] == 3, serve
     assert serve['ptpu_serve_decode_tokens_per_sec'] > 0, serve
+    assert serve['ptpu_serve_ttft_seconds'].get('p99_ms') is not None
+    assert serve['ptpu_serve_e2e_seconds']['count'] == 3, serve
+    assert serve['timeline']['iterations'] > 0, serve
     text = render_serve(serve)
     assert 'decode throughput' in text and 'time-to-first-token' in text
     assert '3/3 requests completed' in text, text
+    assert 'SLO percentiles' in text and 'scheduler timeline' in text
+
+    # -- trace export round-trips and reconstructs the engine's truth
+    with tempfile.TemporaryDirectory() as td:
+        paths = eng.export_trace(
+            jsonl_path=os.path.join(td, 'serve.jsonl'),
+            chrome_path=os.path.join(td, 'serve.trace.json'))
+        _hdr, events = load_trace(paths['jsonl'])
+        table = reconstruct(events)
+        assert len(table) == 3, table
+        for req in eng.scheduler.finished:
+            r = table[req.id]
+            assert r['tokens_generated'] == len(req.generated), r
+            assert r['preemptions'] == req.preemptions, r
+            assert abs(r['ttft_s'] - (req.first_token_time
+                                      - req.submit_time)) < 1e-9, r
+        with open(paths['chrome']) as f:
+            doc = json.load(f)
+        assert any(e.get('cat') == 'serve_request'
+                   for e in doc['traceEvents']), 'no request tracks'
     eng.shutdown()
+
+    # -- stalled-request watchdog: deterministic clock, a request aged
+    # past the deadline produces a serve_report that classifies/renders
+    t = {'now': 0.0}
+
+    def fake_clock():
+        t['now'] += 1e-6
+        return t['now']
+
+    with tempfile.TemporaryDirectory() as td:
+        eng2 = ServingEngine(model, ServingConfig(
+            page_size=8, max_batch_size=2, prefill_chunk=8,
+            request_deadline_s=5.0, report_dir=td, clock=fake_clock))
+        eng2.submit(prompts[0], max_new_tokens=2)
+        t['now'] += 10.0                 # age it past the deadline
+        eng2.step()                      # watchdog fires this sweep
+        report = eng2.last_serve_report
+        assert report and report['kind'] == 'serve_report', report
+        assert report['request']['age_s'] > 5.0, report['request']
+        assert report['trace'] and report['pool'], report
+        assert classify(report) == 'serve_report'
+        rendered = render(report)
+        assert 'SERVE REPORT' in rendered and 'deadline' in rendered
+        assert report['path'] and os.path.exists(report['path']), report
+        with open(report['path']) as f:
+            assert classify(json.load(f)) == 'serve_report'
+        while eng2.scheduler.has_work:   # drain; request still finishes
+            eng2.step()
+        eng2.shutdown()
     print(text)
     print('health_dump serve selftest: OK')
     return 0

@@ -7,7 +7,8 @@
 #   --comm-selftest - 2-rank sharded-vs-replicated weight-update
 #                     equivalence + comm-gauge CLI smoke (ISSUE 4)
 #   --serve-selftest - serving engine end-to-end on the CPU fallback
-#                      path + serve-gauge CLI smoke (ISSUE 5)
+#                      path + serve-gauge/percentile CLI smoke, request
+#                      trace export, stalled-request watchdog (ISSUE 5/6)
 set -e
 cd "$(dirname "$0")/.."
 TIER="${1:-all}"
@@ -16,7 +17,7 @@ case "$TIER" in
             tests/test_layers_optim.py tests/test_controlflow_dist.py \
             tests/test_profiler_trace.py tests/test_diagnostics.py \
             tests/test_numerics.py tests/test_bucketing.py \
-            tests/test_serving.py -q
+            tests/test_serving.py tests/test_serving_trace.py -q
           # observability tooling smoke: tracer -> export -> summary CLI
           python tools/trace_summary.py --selftest
           # diagnostics smoke: flight recorder -> hang/OOM reports -> CLI
@@ -40,9 +41,14 @@ case "$TIER" in
           python tools/health_dump.py comm --selftest ;;
   --serve-selftest)
           # serving engine end to end on the CPU fallback path (paged
-          # pool + continuous batching), then the gauge CLI smoke
-          python -m pytest tests/test_serving.py -q
-          python tools/health_dump.py serve --selftest ;;
+          # pool + continuous batching + request observatory), then the
+          # CLI smokes: serve gauges/percentiles + trace export +
+          # stalled-request watchdog (health_dump) and the per-request
+          # SLO table from an exported trace (trace_summary)
+          python -m pytest tests/test_serving.py \
+            tests/test_serving_trace.py -q
+          python tools/health_dump.py serve --selftest
+          python tools/trace_summary.py --selftest ;;
   all)    python -m pytest tests/ -q
           python tools/trace_summary.py --selftest
           python tools/health_dump.py --selftest

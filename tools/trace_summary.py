@@ -7,10 +7,18 @@ Reads either exporter format (chrome-trace `traceEvents` or the raw
   * the top-N spans by total duration (calls, total ms, avg us, share);
   * a compile-vs-execute breakdown from span categories (compile =
     trace/lower/XLA-compile spans; execute = executor/jit dispatches;
-    plus dataloader / collective / other buckets).
+    plus dataloader / collective / serve / other buckets).
+
+It also reads SERVING request traces (the JSON-lines files
+`ServingEngine.export_trace` writes, schema paddle_tpu.serve_trace/1)
+and prints the per-request SLO table: queue-wait, TTFT, TPOT, e2e,
+preemptions, pages high-water — plus cross-request percentiles.
+Serve traces are detected by their schema header (content sniff, not
+file extension); `--serve` forces that mode.
 
 Usage:
     python tools/trace_summary.py TRACE.json [--top 15] [--json]
+    python tools/trace_summary.py SERVE_TRACE.jsonl [--json]
     python tools/trace_summary.py --selftest    # CI smoke: generate a
                                                 # tiny trace, summarize it
 """
@@ -28,6 +36,8 @@ CATEGORY_BUCKETS = {
     'optimizer': 'execute',
     'dataloader': 'dataloader',
     'collective': 'collective',
+    'serve': 'serve',
+    'serve_request': 'serve',
 }
 
 
@@ -89,6 +99,117 @@ def render(summary):
     return '\n'.join(out)
 
 
+# ---------------------------------------------------------------------------
+# serving request traces (JSON-lines, paddle_tpu.serve_trace/1)
+# ---------------------------------------------------------------------------
+def summarize_serve(path):
+    """Per-request table + cross-request SLO percentiles from a
+    serve-trace JSON-lines file."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from paddle_tpu.serving.request_trace import (load_trace,
+                                                  percentile_of,
+                                                  reconstruct)
+    header, events = load_trace(path)
+    table = reconstruct(events)
+    rows = sorted(table.values(), key=lambda r: r['req'])
+    pct = {}
+    for key in ('queue_wait_s', 'ttft_s', 'tpot_s', 'e2e_s'):
+        vals = [r[key] for r in rows]
+        pct[key] = {f'p{q}': percentile_of(vals, q) for q in (50, 90, 99)}
+    return {'schema': header.get('schema'),
+            'dropped_events': header.get('dropped_events', 0),
+            'requests': rows, 'percentiles': pct}
+
+
+def _fmt_ms(v):
+    return f'{v * 1000.0:.2f}' if v is not None else '-'
+
+
+def render_serve(s):
+    rows = s['requests']
+    out = [f"serve trace: {len(rows)} requests"
+           + (f"   ({s['dropped_events']} events dropped at cap)"
+              if s.get('dropped_events') else '')]
+    out.append('')
+    out.append(f"{'req':>5} {'state':<9} {'prompt':>6} {'gen':>5} "
+               f"{'queue_ms':>9} {'ttft_ms':>9} {'tpot_ms':>9} "
+               f"{'e2e_ms':>9} {'preempt':>7} {'pages_hw':>8}")
+    for r in rows:
+        out.append(
+            f"{r['req']:>5} {r['state'] or '?':<9} "
+            f"{r['prompt_tokens'] if r['prompt_tokens'] is not None else '?':>6} "
+            f"{r['tokens_generated']:>5} "
+            f"{_fmt_ms(r['queue_wait_s']):>9} {_fmt_ms(r['ttft_s']):>9} "
+            f"{_fmt_ms(r['tpot_s']):>9} {_fmt_ms(r['e2e_s']):>9} "
+            f"{r['preemptions']:>7} {r['pages_high_water']:>8}")
+    out.append('')
+    out.append('-- SLO percentiles (ms) ' + '-' * 36)
+    for key, label in (('queue_wait_s', 'queue wait'),
+                       ('ttft_s', 'ttft'), ('tpot_s', 'tpot'),
+                       ('e2e_s', 'e2e')):
+        p = s['percentiles'][key]
+        out.append(f"{label:<12} p50 {_fmt_ms(p['p50']):>9}  "
+                   f"p90 {_fmt_ms(p['p90']):>9}  "
+                   f"p99 {_fmt_ms(p['p99']):>9}")
+    return '\n'.join(out)
+
+
+def _looks_like_serve_trace(path):
+    # content sniff, NOT extension: fleet workerlogs are .jsonl too and
+    # must not render as an empty "serve trace: 0 requests" table
+    try:
+        with open(path) as f:
+            first = f.readline().strip()
+        doc = json.loads(first)
+        return isinstance(doc, dict) and (
+            doc.get('schema', '').startswith('paddle_tpu.serve_trace')
+            or ('event' in doc and 'req' in doc))
+    except (OSError, ValueError):
+        return False
+
+
+def _serve_selftest():
+    """Drive a deterministic-clock tracer through a preempt/resume
+    lifecycle, export, summarize, assert the derived SLOs."""
+    import tempfile
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from paddle_tpu.serving.request_trace import RequestTracer
+
+    t = [0.0]
+
+    def clock():
+        t[0] += 0.001
+        return t[0]
+
+    tr = RequestTracer(clock=clock)
+    tr.record(7, 'submit', t=1.0, prompt_tokens=5, max_new_tokens=4)
+    tr.record(7, 'admit', t=1.5, slot=0)
+    tr.record(7, 'prefill_chunk', t=1.6, tokens=5, prefilled=5, pages=1)
+    tr.record(7, 'first_token', t=2.0, tokens_generated=1, pages=1)
+    tr.record(7, 'preempt', t=2.1, pages_released=1,
+              tokens_generated=1)
+    tr.record(7, 'resume', t=2.5, slot=1)
+    tr.record(7, 'prefill_chunk', t=2.6, tokens=6, prefilled=6, pages=2)
+    for i, td in enumerate((2.8, 3.0, 3.2)):
+        tr.record(7, 'decode', t=td, tokens_generated=2 + i, pages=2)
+    tr.record(7, 'retire', t=3.2, tokens_generated=4, preemptions=1)
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, 'serve.jsonl')
+        tr.export_jsonl(p)
+        assert _looks_like_serve_trace(p)
+        s = summarize_serve(p)
+    (r,) = s['requests']
+    assert r['queue_wait_s'] == 0.5 and r['ttft_s'] == 1.0, r
+    assert r['preemptions'] == 1 and r['tokens_generated'] == 4, r
+    assert abs(r['tpot_s'] - (3.2 - 2.0) / 3) < 1e-12, r
+    assert r['e2e_s'] == 2.2 and r['pages_high_water'] == 2, r
+    assert abs(s['percentiles']['ttft_s']['p50'] - 1.0) < 1e-12
+    print(render_serve(s))
+    print('trace_summary serve selftest: OK')
+
+
 def _selftest():
     """CI smoke: record a trace through the real tracer, export both
     formats, summarize, and assert the breakdown is sane."""
@@ -129,17 +250,21 @@ def _selftest():
             assert 'executor::run' in names, names
             ok = ok and bool(render(s))
         print(render(s))
+    _serve_selftest()
     print('trace_summary selftest: OK')
     return 0
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument('trace', nargs='?', help='exported trace JSON')
+    ap.add_argument('trace', nargs='?', help='exported trace JSON '
+                    '(profiler spans/chrome, or a serve-trace .jsonl)')
     ap.add_argument('--top', type=int, default=15,
                     help='how many spans to list')
     ap.add_argument('--json', action='store_true',
                     help='machine-readable output')
+    ap.add_argument('--serve', action='store_true',
+                    help='force serve-trace (per-request SLO) mode')
     ap.add_argument('--selftest', action='store_true',
                     help='generate a synthetic trace and summarize it')
     args = ap.parse_args(argv)
@@ -147,6 +272,10 @@ def main(argv=None):
         return _selftest()
     if not args.trace:
         ap.error('trace path required (or --selftest)')
+    if args.serve or _looks_like_serve_trace(args.trace):
+        s = summarize_serve(args.trace)
+        print(json.dumps(s) if args.json else render_serve(s))
+        return 0
     summary = summarize(load_spans(args.trace), top=args.top)
     print(json.dumps(summary) if args.json else render(summary))
     return 0
