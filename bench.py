@@ -575,6 +575,12 @@ def bench_gpt_serve():
         'max_new_tokens': max_new,
         'decode_slots': batch,
         'page_size': page_size,
+        # quantized-KV capacity accounting (ISSUE 7): the pool's dtype
+        # and real byte footprint, so the round record shows the
+        # tokens-per-byte win when kv_dtype='int8' legs land
+        'kv_dtype': st['pool']['kv_dtype'],
+        'kv_pool_bytes': st['pool']['pool_bytes'],
+        'kv_bytes_per_token': st['pool']['bytes_per_token'],
         'prompt_lens': [int(n) for n in lens],
         'kv_tokens_dense_vs_paged': [dense_cache_tokens, paged_tokens],
         'backend': jax.default_backend(),

@@ -14,10 +14,16 @@ Update in Data-Parallel Training" (arXiv:2004.13336):
   * every rank owns a 1/dp **shard** of each bucket's parameters and
     optimizer moments (ZeRO-1/2 semantics), applies the optimizer update
     on its shard only, and `all_gather`s the updated parameters;
-  * an opt-in compressed-collective mode (`comm_dtype='bfloat16'`,
-    EQuARX, arXiv:2506.17615) sends the reduce-scatter payload in bf16
-    but ACCUMULATES in fp32 (all_to_all + local fp32 sum — the paper's
-    accuracy note: the wire is compressed, the reduction is not).
+  * an opt-in compressed-collective mode (EQuARX, arXiv:2506.17615)
+    sends the reduce-scatter payload compressed but ACCUMULATES in
+    fp32 (all_to_all + local fp32 sum — the paper's accuracy note: the
+    wire is compressed, the reduction is not). `comm_dtype='bfloat16'`
+    is a plain cast; `comm_dtype='int8'` is BLOCK-SCALED: per-block
+    abs-max fp32 scales ride beside the int8 payload on the wire
+    (`quantize_blocks`), the param refresh all-gathers int8 shards +
+    scales the same way, and the `ptpu_comm_*` gauges count the real
+    wire bytes — payload, scales and padding reported separately
+    (docs/performance.md#int8-wire).
 
 Everything here is either host-side layout bookkeeping or pure
 traced-code helpers used inside the engines' `shard_map` bodies; the
@@ -29,6 +35,15 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+# int8 symmetric range: scale = blockmax / 127, values clipped to ±127
+INT8_BIN = 127.0
+# per-block scale granularity for the int8 wire (elements); must
+# divide the per-rank shard length, so the effective block per bucket
+# is the largest divisor of shard_len <= this (env PTPU_COMM_BLOCK)
+DEFAULT_COMM_BLOCK = 256
+# scales travel as fp32 beside the int8 payload
+SCALE_ITEMSIZE = 4
 
 
 def resolve_comm_config(comm_dtype=None, bucket_mb=None):
@@ -54,6 +69,28 @@ def resolve_comm_config(comm_dtype=None, bucket_mb=None):
     if bucket_mb is None:
         bucket_mb = 32.0
     return comm_dtype, int(bucket_mb * 1024 * 1024)
+
+
+def resolve_comm_block(block=None):
+    """Block-scale granularity for the int8 wire, kwarg -> env ->
+    default."""
+    import os
+    if block is None:
+        block = int(os.environ.get('PTPU_COMM_BLOCK', 0) or 0) or None
+    if block is None:
+        block = DEFAULT_COMM_BLOCK
+    return max(int(block), 1)
+
+
+def block_len(n, want):
+    """Largest divisor of `n` that is <= `want` — the effective scale
+    block for a flat array of length n (blocks must tile the array and
+    must not cross shard boundaries, so callers pass the SHARD
+    length)."""
+    b = min(int(want), int(n))
+    while b > 1 and n % b:
+        b -= 1
+    return max(b, 1)
 
 
 # ---------------------------------------------------------------------------
@@ -193,6 +230,31 @@ class BucketLayout:
 
 
 # ---------------------------------------------------------------------------
+# block-scaled int8 quantization (pure; used inside shard_map bodies)
+# ---------------------------------------------------------------------------
+def quantize_blocks(flat, block):
+    """Symmetric abs-max int8 quantization of a 1-D array in blocks of
+    `block` elements (must divide len(flat)). Returns (int8 [L],
+    fp32 scales [L // block]); dequantized value = q * scale."""
+    blk = flat.astype(jnp.float32).reshape(-1, block)
+    amax = jnp.max(jnp.abs(blk), axis=1, keepdims=True)
+    scale = jnp.maximum(amax, 1e-30) / INT8_BIN
+    q = jnp.clip(jnp.round(blk / scale), -INT8_BIN, INT8_BIN) \
+        .astype(jnp.int8)
+    return q.reshape(-1), scale.reshape(-1)
+
+
+def dequantize_blocks(q, scales, block):
+    """Inverse of quantize_blocks (fp32 result)."""
+    blk = q.reshape(-1, block).astype(jnp.float32)
+    return (blk * scales.reshape(-1, 1)).reshape(-1)
+
+
+def _is_int8(comm_dtype):
+    return comm_dtype is not None and jnp.dtype(comm_dtype) == jnp.int8
+
+
+# ---------------------------------------------------------------------------
 # collectives over buckets (call inside shard_map bodies)
 # ---------------------------------------------------------------------------
 def axes_size(mesh, axes):
@@ -219,23 +281,41 @@ def take_shard(flat, axes, n_shards):
         flat, shard_index(axes) * shard_len, shard_len, axis=0)
 
 
-def reduce_scatter(flat, axes, n_shards, comm_dtype=None, mean=True):
+def reduce_scatter(flat, axes, n_shards, comm_dtype=None, mean=True,
+                   block=None):
     """SUM-reduce a flat bucket over `axes` and keep this rank's 1/n
     shard. With `comm_dtype` narrower than fp32 the payload moves
     compressed but the reduction runs in fp32 (all_to_all + local fp32
     accumulate — EQuARX's compressed-wire / uncompressed-math split);
-    otherwise a native `psum_scatter`. Returns an fp32 shard (the
+    otherwise a native `psum_scatter`. `comm_dtype='int8'` is
+    block-scaled: per-block abs-max fp32 scales are computed on the
+    flat bucket (block = largest divisor of the shard length <=
+    `block`, default DEFAULT_COMM_BLOCK) and travel beside the int8
+    payload in a second all_to_all. Returns an fp32 shard (the
     optimizer update math dtype) scaled to the mean when `mean`."""
     axes = tuple(axes)
-    if comm_dtype is not None and jnp.dtype(comm_dtype) != flat.dtype:
-        flat = flat.astype(comm_dtype)
-    if comm_dtype is not None and \
+    if _is_int8(comm_dtype):
+        shard_len = flat.shape[0] // n_shards
+        b = block_len(shard_len, resolve_comm_block(block))
+        q, scales = quantize_blocks(flat, b)
+        q_ch = lax.all_to_all(q.reshape(n_shards, shard_len), axes,
+                              split_axis=0, concat_axis=0)
+        s_ch = lax.all_to_all(scales.reshape(n_shards, -1), axes,
+                              split_axis=0, concat_axis=0)
+        deq = q_ch.reshape(n_shards, -1, b).astype(jnp.float32) \
+            * s_ch[:, :, None]
+        shard = jnp.sum(deq.reshape(n_shards, shard_len), axis=0)
+    elif comm_dtype is not None and \
             jnp.dtype(comm_dtype) != jnp.float32:
+        if jnp.dtype(comm_dtype) != flat.dtype:
+            flat = flat.astype(comm_dtype)
         # compress -> all_to_all (wire in comm_dtype) -> fp32 accumulate
         chunks = lax.all_to_all(flat.reshape(n_shards, -1), axes,
                                 split_axis=0, concat_axis=0)
         shard = jnp.sum(chunks.astype(jnp.float32), axis=0)
     else:
+        if comm_dtype is not None and jnp.dtype(comm_dtype) != flat.dtype:
+            flat = flat.astype(comm_dtype)
         shard = lax.psum_scatter(flat, axes, scatter_dimension=0,
                                  tiled=True).astype(jnp.float32)
     if mean:
@@ -243,12 +323,27 @@ def reduce_scatter(flat, axes, n_shards, comm_dtype=None, mean=True):
     return shard
 
 
-def all_gather(shard, axes):
+def all_gather(shard, axes, comm_dtype=None, block=None):
     """Reassemble the full flat bucket from per-rank shards (reverse
-    axis order of the matching reduce_scatter/take_shard)."""
-    for a in reversed(tuple(axes)):
-        shard = lax.all_gather(shard, a, axis=0, tiled=True)
-    return shard
+    axis order of the matching reduce_scatter/take_shard). With
+    `comm_dtype='int8'` the param refresh is scale-carrying: each rank
+    quantizes its updated shard block-wise, int8 payload + fp32 scales
+    all-gather together, and every rank dequantizes — all ranks see
+    the SAME (quantized) params, and the sharded optimizer state keeps
+    the fp32 master, so the rounding does not accumulate step over
+    step. Result dtype follows the input shard."""
+    axes = tuple(axes)
+    if not _is_int8(comm_dtype):
+        for a in reversed(axes):
+            shard = lax.all_gather(shard, a, axis=0, tiled=True)
+        return shard
+    dt = shard.dtype
+    b = block_len(shard.shape[0], resolve_comm_block(block))
+    q, scales = quantize_blocks(shard, b)
+    for a in reversed(axes):
+        q = lax.all_gather(q, a, axis=0, tiled=True)
+        scales = lax.all_gather(scales, a, axis=0, tiled=True)
+    return dequantize_blocks(q, scales, b).astype(dt)
 
 
 # ---------------------------------------------------------------------------
@@ -262,18 +357,25 @@ def elementwise(optimizer):
     return bool(getattr(optimizer, '_elementwise', False))
 
 
-def init_bucket_state(optimizer, bucket, param_flat32):
+def init_bucket_state(optimizer, bucket, param_flat32, force_master=False):
     """Flat optimizer state for one bucket (host-side arrays).
 
     param_flat32: the bucket's initial parameter values, flattened to
     fp32 (numpy). Returns {state_key: np.ndarray}; adds the fp32
-    'master' copy for low-precision buckets under multi_precision."""
+    'master' copy for low-precision buckets under multi_precision.
+    `force_master` adds it for fp32 buckets too — required when the
+    param all-gather wire is quantized (comm_dtype='int8'): the
+    sharded master stays the exact trajectory and only the gathered
+    working copy is rounded, so wire error never feeds back into the
+    optimizer state. It therefore overrides multi_precision=False —
+    without the master the int8-rounded params would BE the state and
+    the invariant would silently break."""
     from .tensor import Tensor
     st = optimizer.init_state(Tensor(jnp.zeros((bucket.size,),
                                                jnp.float32)))
     st = {k: np.asarray(v) for k, v in st.items()}
-    if bucket.dtype != jnp.float32 and \
-            getattr(optimizer, '_multi_precision', True):
+    if force_master or (bucket.dtype != jnp.float32
+                        and getattr(optimizer, '_multi_precision', True)):
         st['master'] = np.asarray(param_flat32, np.float32)
     return st
 
@@ -396,8 +498,44 @@ def named_states_to_flat(layout, named_states, template):
 # ---------------------------------------------------------------------------
 # telemetry: ptpu_comm_* gauges
 # ---------------------------------------------------------------------------
+def wire_bytes(layout, n_shards, comm_dtype=None, block=None):
+    """Real per-rank wire bytes per step for a bucket layout, split
+    into parameter payload vs overhead (the ISSUE-7 accounting audit):
+
+      {'reduce_scatter'|'all_gather':
+          {'payload': <real-parameter bytes on the wire>,
+           'scale':   <block-scale sidecar bytes (int8 mode only)>,
+           'pad':     <zero-padding bytes>,
+           'total':   payload + scale + pad}}
+
+    reduce_scatter moves gradients in `comm_dtype` (param/bucket dtype
+    when None); all_gather moves updated params in their storage dtype,
+    except int8 mode where both legs move int8 + fp32 block scales."""
+    int8 = _is_int8(comm_dtype)
+    want = resolve_comm_block(block)
+    out = {'reduce_scatter': {'payload': 0, 'scale': 0, 'pad': 0},
+           'all_gather': {'payload': 0, 'scale': 0, 'pad': 0}}
+    for b in layout.buckets:
+        rs_item = (1 if int8
+                   else jnp.dtype(comm_dtype or b.dtype).itemsize)
+        ag_item = 1 if int8 else b.dtype.itemsize
+        scale_bytes = 0
+        if int8:
+            eb = block_len(max(b.size // max(n_shards, 1), 1), want)
+            scale_bytes = (b.size // eb) * SCALE_ITEMSIZE
+        out['reduce_scatter']['payload'] += b.used * rs_item
+        out['reduce_scatter']['pad'] += b.pad * rs_item
+        out['reduce_scatter']['scale'] += scale_bytes
+        out['all_gather']['payload'] += b.used * ag_item
+        out['all_gather']['pad'] += b.pad * ag_item
+        out['all_gather']['scale'] += scale_bytes
+    for op in out.values():
+        op['total'] = op['payload'] + op['scale'] + op['pad']
+    return out
+
+
 def publish_comm_gauges(layout, engine, n_shards, comm_dtype=None,
-                        enabled=True):
+                        enabled=True, block=None):
     """Publish the per-step communication model for a bucket layout.
 
     Byte convention (docs/performance.md): a ring allreduce moves
@@ -406,13 +544,17 @@ def publish_comm_gauges(layout, engine, n_shards, comm_dtype=None,
     baseline scheme is the per-parameter psum of fp32 gradients — the
     dtype the reduction math runs in, which is what the compressed mode
     preserves (EQuARX) — so `bucketed` vs `per_param_psum_fp32` is an
-    equal-accuracy comparison. Gauges are modeled at trace/build time
-    (the compiled step replays the same collectives every step)."""
+    equal-accuracy comparison. Wire bytes are REAL bytes: int8 mode
+    counts the fp32 block-scale sidecars and the bucket zero-padding,
+    reported separately from the parameter payload so the compression
+    claim is auditable. Gauges are modeled at trace/build time (the
+    compiled step replays the same collectives every step)."""
     from . import monitor as _m
     elems = layout.total_elements()
     padded = layout.total_padded()
-    rs_bytes = sum(b.nbytes(comm_dtype) for b in layout.buckets)
-    ag_bytes = sum(b.nbytes() for b in layout.buckets)
+    wires = wire_bytes(layout, n_shards, comm_dtype, block)
+    rs_bytes = wires['reduce_scatter']['total']
+    ag_bytes = wires['all_gather']['total']
     baseline = 2 * elems * 4    # per-param fp32 allreduce, 2x payload
     g = _m.gauge
     g('ptpu_comm_buckets', help='gradient buckets per step',
@@ -423,12 +565,40 @@ def publish_comm_gauges(layout, engine, n_shards, comm_dtype=None,
     g('ptpu_comm_shards', help='weight-update shard count (dp degree)',
       labelnames=('engine',)).set(n_shards, engine=engine)
     g('ptpu_comm_bytes_per_step',
-      help='modeled per-rank payload bytes per step, by collective',
+      help='modeled per-rank wire bytes per step, by collective '
+           '(payload + block scales + padding)',
       labelnames=('engine', 'op')).set(rs_bytes, engine=engine,
                                        op='reduce_scatter')
     g('ptpu_comm_bytes_per_step',
       labelnames=('engine', 'op')).set(ag_bytes, engine=engine,
                                        op='all_gather')
+    for op in ('reduce_scatter', 'all_gather'):
+        g('ptpu_comm_payload_bytes_per_step',
+          help='real-parameter bytes on the wire per rank per step '
+               '(scales and padding excluded)',
+          labelnames=('engine', 'op')).set(
+              wires[op]['payload'], engine=engine, op=op)
+        for kind in ('scale', 'pad'):
+            g('ptpu_comm_overhead_bytes_per_step',
+              help='non-payload wire bytes per rank per step: block '
+                   'scales (int8 mode) and bucket zero-padding',
+              labelnames=('engine', 'op', 'kind')).set(
+                  wires[op][kind], engine=engine, op=op, kind=kind)
+    # report the EFFECTIVE block (smallest across buckets), not the
+    # requested one: block_len() shrinks to a divisor of the shard
+    # length, and an honest gauge is what keeps the scale-overhead
+    # numbers auditable (engine layouts pad to n_shards*8, so this
+    # never collapses below 8)
+    eff_block = 0
+    if _is_int8(comm_dtype) and layout.buckets:
+        want = resolve_comm_block(block)
+        eff_block = min(
+            block_len(max(b.size // max(n_shards, 1), 1), want)
+            for b in layout.buckets)
+    g('ptpu_comm_block_elements',
+      help='int8 block-scale granularity in elements — smallest '
+           'EFFECTIVE block across buckets (0 = not block-scaled)',
+      labelnames=('engine',)).set(eff_block, engine=engine)
     g('ptpu_comm_modeled_bytes_per_step',
       help='modeled per-rank wire bytes per step, by scheme '
            '(allreduce counted 2x payload)',
@@ -438,9 +608,10 @@ def publish_comm_gauges(layout, engine, n_shards, comm_dtype=None,
       labelnames=('engine', 'scheme')).set(
           rs_bytes + ag_bytes, engine=engine, scheme='bucketed')
     g('ptpu_comm_compressed_fraction',
-      help='1 - reduce_scatter payload / fp32 payload',
+      help='1 - reduce_scatter parameter payload / fp32 payload',
       labelnames=('engine',)).set(
-          1.0 - rs_bytes / max(elems * 4, 1), engine=engine)
+          1.0 - wires['reduce_scatter']['payload'] / max(elems * 4, 1),
+          engine=engine)
     g('ptpu_comm_enabled',
       help='1 when the bucketed rs/ag path is compiled into the step '
            '(0: modeled only — dp degree 1 or legacy path)',
@@ -460,6 +631,9 @@ def comm_snapshot():
     out = {}
     for name in ('ptpu_comm_buckets', 'ptpu_comm_bucket_pad_elements',
                  'ptpu_comm_shards', 'ptpu_comm_bytes_per_step',
+                 'ptpu_comm_payload_bytes_per_step',
+                 'ptpu_comm_overhead_bytes_per_step',
+                 'ptpu_comm_block_elements',
                  'ptpu_comm_modeled_bytes_per_step',
                  'ptpu_comm_compressed_fraction', 'ptpu_comm_enabled'):
         m = reg.get(name)
@@ -478,6 +652,8 @@ def comm_snapshot():
     # modeled-only drop as realized wire savings.
     modeled = out.get('ptpu_comm_modeled_bytes_per_step') or {}
     enabled = out.get('ptpu_comm_enabled') or {}
+    payload = out.get('ptpu_comm_payload_bytes_per_step') or {}
+    overhead = out.get('ptpu_comm_overhead_bytes_per_step') or {}
     for eng in {k.split(',')[0].split('=', 1)[1]
                 for k in modeled if k.startswith('engine=')}:
         base = modeled.get(f'engine={eng},scheme=per_param_psum_fp32')
@@ -487,6 +663,27 @@ def comm_snapshot():
                 eng] = round(1.0 - new / base, 4)
             out.setdefault('comm_bytes_drop_enabled', {})[eng] = bool(
                 enabled.get(f'engine={eng}'))
+        # wire-byte audit (ISSUE 7): real-parameter payload vs scale /
+        # padding overhead, and the payload-vs-payload compression
+        # factor — the "4x" claim measured on like bytes, with the
+        # sidecar cost visible right beside it instead of hidden in it
+        pay = sum(v for k, v in payload.items()
+                  if k.startswith(f'engine={eng},'))
+        ov_scale = sum(v for k, v in overhead.items()
+                       if k.startswith(f'engine={eng},')
+                       and k.endswith('kind=scale'))
+        ov_pad = sum(v for k, v in overhead.items()
+                     if k.startswith(f'engine={eng},')
+                     and k.endswith('kind=pad'))
+        if pay:
+            out.setdefault('comm_wire_breakdown', {})[eng] = {
+                'payload_bytes': pay, 'scale_bytes': ov_scale,
+                'pad_bytes': ov_pad,
+                'total_bytes': pay + ov_scale + ov_pad}
+            if base:
+                out.setdefault(
+                    'comm_payload_factor_vs_per_param_psum', {})[
+                    eng] = round(base / pay, 4)
     return out
 
 

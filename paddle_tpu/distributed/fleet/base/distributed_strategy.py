@@ -68,8 +68,10 @@ _DEFAULTS = {
     'sync_batch_norm': False,
     'fuse_all_reduce_ops': True,
     # gradient-collective wire dtype for the bucketed SPMD engines:
-    # None = native; 'bfloat16' = compressed wire with fp32 accumulate
-    # (EQuARX-style; see docs/performance.md)
+    # None = native; 'bfloat16' = compressed wire with fp32 accumulate;
+    # 'int8' = block-scaled int8 wire (per-block abs-max fp32 scales
+    # travel beside the payload) with fp32 accumulate (EQuARX-style;
+    # see docs/performance.md)
     'comm_dtype': None,
     'fuse_grad_size_in_MB': 32,
     'fuse_grad_size_in_TFLOPS': 50,

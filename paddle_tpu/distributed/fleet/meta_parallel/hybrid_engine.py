@@ -67,7 +67,8 @@ class HybridParallelTrainStep(EngineTeardown):
 
     def __init__(self, model, loss_fn, optimizer, mesh=None,
                  accumulate_steps=1, use_remat=False, sp_shard_args=None,
-                 use_buckets=None, comm_dtype=None, bucket_mb=None):
+                 use_buckets=None, comm_dtype=None, bucket_mb=None,
+                 comm_block=None):
         self.sp_shard_args = sp_shard_args
         self.model = model
         self.loss_fn = loss_fn
@@ -112,6 +113,7 @@ class HybridParallelTrainStep(EngineTeardown):
                                       for a in self._rs_axes] or [1]))
         self.comm_dtype, self._bucket_bytes = B.resolve_comm_config(
             comm_dtype, bucket_mb)
+        self._comm_block = B.resolve_comm_block(comm_block)
         # mp-sharded params are already distributed (their state shards
         # with them); they keep the per-param path
         bucketable = [n for n, p in named
@@ -132,7 +134,8 @@ class HybridParallelTrainStep(EngineTeardown):
             B.publish_comm_gauges(self._layout, engine='hybrid',
                                   n_shards=max(self._n_shards, 1),
                                   comm_dtype=self.comm_dtype,
-                                  enabled=self._bucketed)
+                                  enabled=self._bucketed,
+                                  block=self._comm_block)
         if not self._bucketed:
             self._layout = None
 
@@ -189,7 +192,9 @@ class HybridParallelTrainStep(EngineTeardown):
                 flat32[s.offset:s.offset + s.size] = np.asarray(
                     jax.device_get(self._params_by_name[s.name].data),
                     np.float32).reshape(-1)
-            st = B.init_bucket_state(opt, b, flat32)
+            st = B.init_bucket_state(
+                opt, b, flat32,
+                force_master=B._is_int8(self.comm_dtype))
             placed, sspec = {}, {}
             for k, v in st.items():
                 if np.ndim(v) >= 1:
@@ -253,6 +258,7 @@ class HybridParallelTrainStep(EngineTeardown):
         rs_axes = self._rs_axes
         n_shards = self._n_shards
         comm_dtype = self.comm_dtype
+        comm_block = self._comm_block
 
         def clip_factor(gn_sq_val):
             from ....nn.clip import ClipGradByGlobalNorm
@@ -361,7 +367,8 @@ class HybridParallelTrainStep(EngineTeardown):
                     {n: raw_grads[n] for n in layout.slots})
                 shards32 = [B.reduce_scatter(f, rs_axes, n_shards,
                                              comm_dtype=comm_dtype,
-                                             mean=True)
+                                             mean=True,
+                                             block=comm_block)
                             for f in flat_grads]
 
                 # taps diagnostics mode pays an extra pmean to surface
@@ -406,7 +413,9 @@ class HybridParallelTrainStep(EngineTeardown):
                     p_shard = B.take_shard(pf, rs_axes, n_shards)
                     np_, ns = B.shard_update(self.optimizer, p_shard,
                                              g32, st, lr)
-                    gathered.append(B.all_gather(np_, rs_axes))
+                    gathered.append(B.all_gather(np_, rs_axes,
+                                                 comm_dtype=comm_dtype,
+                                                 block=comm_block))
                     new_buckets.append(ns)
                 new_params.update(layout.unflatten(gathered))
                 for n, g in legacy.items():

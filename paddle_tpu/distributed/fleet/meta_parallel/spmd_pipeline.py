@@ -173,7 +173,8 @@ class SpmdPipelineEngine(EngineTeardown):
     def __init__(self, embed, blocks, head, optimizer, accumulate_steps,
                  mesh=None, use_remat=True, schedule='1F1B',
                  grad_accum_dtype='float32', memory_mode='stash',
-                 use_buckets=None, comm_dtype=None, bucket_mb=None):
+                 use_buckets=None, comm_dtype=None, bucket_mb=None,
+                 comm_block=None):
         self.embed = embed
         self.blocks = blocks
         self.head = head
@@ -254,6 +255,7 @@ class SpmdPipelineEngine(EngineTeardown):
             # per-param path.
             self.comm_dtype, self._bucket_bytes = B.resolve_comm_config(
                 comm_dtype, bucket_mb)
+            self._comm_block = B.resolve_comm_block(comm_block)
             dp_on_init = 'dp' in self.axes and self.mesh.shape['dp'] > 1
             self._pp_layout = None
             mp_on = 'mp' in self.axes and self.mesh.shape['mp'] > 1
@@ -286,7 +288,8 @@ class SpmdPipelineEngine(EngineTeardown):
                     n_shards=max(self.dp, 1),
                     comm_dtype=self.comm_dtype or (
                         jnp.float32 if accum_fp32 else None),
-                    enabled=self._pp_bucketed)
+                    enabled=self._pp_bucketed,
+                    block=self._comm_block)
             if not self._pp_bucketed:
                 self._pp_layout = None
 
@@ -359,7 +362,9 @@ class SpmdPipelineEngine(EngineTeardown):
                     row = np.asarray(jax.device_get(named[n].data),
                                      np.float32).reshape(-1)
                     flat32[:, s.offset:s.offset + s.size] = row
-            st = B.init_bucket_state(opt, b, flat32[0])
+            st = B.init_bucket_state(
+                opt, b, flat32[0],
+                force_master=B._is_int8(self.comm_dtype))
             placed, sspec = {}, {}
             for k, v in st.items():
                 if np.ndim(v) >= 1:
@@ -576,14 +581,19 @@ class SpmdPipelineEngine(EngineTeardown):
             cast=jnp.float32 if accum_fp32 else None)
         shards32 = [B.reduce_scatter(f, ('dp',), self.dp,
                                      comm_dtype=self.comm_dtype,
-                                     mean=True)
+                                     mean=True,
+                                     block=self._comm_block)
                     for f in flat_grads]
 
-        # trace-time telemetry: rs+ag payload replayed every step
+        # trace-time telemetry: rs+ag wire bytes (scales + padding
+        # included) replayed every step
         from ....core.monitor import counter
-        nbytes = sum(b.nbytes(self.comm_dtype or (
-            jnp.float32 if accum_fp32 else None)) + b.nbytes()
-            for b in layout.buckets)
+        wires = B.wire_bytes(layout, max(self.dp, 1),
+                             self.comm_dtype or (
+                                 jnp.float32 if accum_fp32 else None),
+                             self._comm_block)
+        nbytes = (wires['reduce_scatter']['total']
+                  + wires['all_gather']['total'])
         counter('ptpu_collective_bytes_total',
                 help='payload bytes through collective APIs',
                 labelnames=('op',)).inc(nbytes, op='pipeline_bucket_rs_ag')
@@ -656,7 +666,9 @@ class SpmdPipelineEngine(EngineTeardown):
             new_buckets.append(
                 {k: (v[None] if getattr(v, 'ndim', 0) >= 1 else v)
                  for k, v in ns.items()})
-            new_flat.append(B.all_gather(np_, ('dp',)))
+            new_flat.append(B.all_gather(np_, ('dp',),
+                                         comm_dtype=self.comm_dtype,
+                                         block=self._comm_block))
 
         new_params = {'embed': {}, 'blocks': {}, 'head': {}}
         new_states = {'embed': {}, 'blocks': {}, 'head': {},

@@ -118,8 +118,13 @@ class Config:
         decode) instead of a StableHLO-AOT artifact. `model` is a
         GPTForCausalLM (or compatible) instance; `engine_knobs` are
         ServingConfig knobs (page_size, max_batch_size, prefill_chunk,
-        num_pages, ...). Predictor.run then takes token-id prompts and
-        returns generated ids — see docs/serving.md#predictor."""
+        num_pages, ...) — including the quantization pair
+        `kv_dtype='int8'` (block-paged int8 KV with in-kernel dequant)
+        and `weight_dtype='int8'` (weight-only-quantized decode via
+        quantization.quantize_to_int8; the PrecisionType.Int8 story
+        for the engine route — docs/serving.md#weight-only).
+        Predictor.run then takes token-id prompts and returns
+        generated ids — see docs/serving.md#predictor."""
         self._serving_model = model
         self._serving_gen = {'max_new_tokens': max_new_tokens,
                              'eos_token_id': eos_token_id,

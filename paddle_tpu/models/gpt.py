@@ -230,23 +230,50 @@ class GPTAttention(nn.Layer):
     def forward_paged(self, x, kv, page_tables, seq_lens, q_lens):
         """Serving-engine path: x [B, T, H] (T new tokens per row,
         right-padded to q_lens); kv = (k_pages, v_pages) Tensors
-        [num_pages, page_size, local_heads*hd] from the shared pool.
-        Writes the new tokens' k/v into the sequences' pages and runs
-        ragged paged attention over each row's page table (causal
-        within the sequence). page_tables/seq_lens/q_lens are plain
-        int32 arrays (non-diff, captured like cache_len above)."""
+        [num_pages, page_size, local_heads*hd] from the shared pool —
+        or the int8 pool's 4-tuple (k_pages, v_pages, k_scales,
+        v_scales), in which case new K/V quantize at scatter time and
+        attention dequantizes inside the kernel (kv_dtype='int8',
+        docs/serving.md#quantized-kv). Writes the new tokens' k/v into
+        the sequences' pages and runs ragged paged attention over each
+        row's page table (causal within the sequence).
+        page_tables/seq_lens/q_lens are plain int32 arrays (non-diff,
+        captured like cache_len above)."""
         B, T, _ = x.shape
         qkv = self.qkv_proj(x)
         hd = self.head_dim
         nh = qkv.shape[-1] // (3 * hd)
-        k_pages, v_pages = kv
         from ..ops.pallas import paged_attention as pa
 
-        def fn(a, kp, vp):
+        def _split(a):
             x5 = a.reshape(B, T, nh, 3, hd)
-            q = x5[:, :, :, 0].reshape(B, T, nh * hd)
-            k = x5[:, :, :, 1].reshape(B, T, nh * hd)
-            v = x5[:, :, :, 2].reshape(B, T, nh * hd)
+            return (x5[:, :, :, 0].reshape(B, T, nh * hd),
+                    x5[:, :, :, 1].reshape(B, T, nh * hd),
+                    x5[:, :, :, 2].reshape(B, T, nh * hd))
+
+        if len(kv) == 4:
+            k_pages, v_pages, k_scales, v_scales = kv
+
+            def fnq(a, kp, vp, ks, vs):
+                q, k, v = _split(a)
+                kp2, vp2, ks2, vs2 = pa.write_kv_pages_quantized(
+                    kp, vp, ks, vs, k, v, page_tables, seq_lens,
+                    q_lens, num_heads=nh)
+                ctx = pa.ragged_paged_attention(
+                    q, kp2, vp2, page_tables, seq_lens, q_lens,
+                    num_heads=nh, head_dim=hd, k_scales=ks2,
+                    v_scales=vs2)
+                return ctx, kp2, vp2, ks2, vs2
+            ctx, kp2, vp2, ks2, vs2 = run_op(
+                'paged_attention', fnq,
+                [qkv, k_pages, v_pages, k_scales, v_scales])
+            out = self.out_proj(ctx)
+            return self.dropout(out), (kp2, vp2, ks2, vs2)
+
+        k_pages, v_pages = kv
+
+        def fn(a, kp, vp):
+            q, k, v = _split(a)
             kp2, vp2 = pa.write_kv_pages(kp, vp, k, v, page_tables,
                                          seq_lens, q_lens)
             ctx = pa.ragged_paged_attention(

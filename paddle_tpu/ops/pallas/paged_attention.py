@@ -37,6 +37,16 @@ Layouts:
 
 Query t of row b sits at global position seq_lens[b] - q_lens[b] + t and
 attends keys at positions <= its own (causal) and < seq_lens[b].
+
+Quantized pages (ISSUE 7, `kv_dtype='int8'`): k_pages/v_pages are int8
+and carry sibling fp32 scale buffers `[N_pages, page_size, H]` — one
+abs-max scale per (token slot, head). `write_kv_pages_quantized`
+quantizes each new token's per-head K/V row at scatter time;
+dequantization happens INSIDE the kernel (per-page VMEM block, one
+multiply per head slice — free next to the MXU dot) and inside the
+dense fallback, so attention math stays fp32 while the pool pays 1
+byte/element + 4 bytes/head/slot. On TPU note the int8 min tile is
+(32, 128): page_size >= 32 keeps the int8 page blocks tile-aligned.
 """
 import functools
 import math
@@ -53,9 +63,9 @@ def _interpret():
     return jax.default_backend() == 'cpu'
 
 
-def _ragged_paged_kernel(pt_ref, ln_ref, q_ref, k_ref, v_ref, o_ref,
-                         m_s, l_s, acc_s, *, page_size, num_heads,
-                         head_dim, pages_per_seq):
+def _ragged_paged_kernel(pt_ref, ln_ref, q_ref, k_ref, v_ref, *rest,
+                         page_size, num_heads, head_dim, pages_per_seq,
+                         quantized=False):
     """One (batch_row, page) program.
 
     pt_ref/ln_ref are scalar-prefetched (page tables, [B, 2] lens); the
@@ -63,7 +73,16 @@ def _ragged_paged_kernel(pt_ref, ln_ref, q_ref, k_ref, v_ref, o_ref,
     v_ref hold one [page_size, H*D] page in VMEM. Scratch carries the
     online-softmax state across a row's page steps (the page grid
     iterates fastest, so p==0 re-arms and the last page finalizes).
+    With `quantized` the K/V blocks are int8 and two extra refs hold
+    this page's [page_size, H] fp32 scales; dequantization is one
+    broadcast multiply per head slice, fused into the fp32 upcast the
+    kernel already pays.
     """
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_s, l_s, acc_s = rest
+    else:
+        ks_ref = vs_ref = None
+        o_ref, m_s, l_s, acc_s = rest
     b = pl.program_id(0)
     p = pl.program_id(1)
     T = q_ref.shape[0]
@@ -92,6 +111,9 @@ def _ragged_paged_kernel(pt_ref, ln_ref, q_ref, k_ref, v_ref, o_ref,
             q = q_ref[:, h * D:(h + 1) * D].astype(jnp.float32) * scale
             k = k_ref[:, h * D:(h + 1) * D].astype(jnp.float32)
             v = v_ref[:, h * D:(h + 1) * D].astype(jnp.float32)
+            if quantized:
+                k = k * ks_ref[:, h:h + 1]
+                v = v * vs_ref[:, h:h + 1]
             s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32)
             s = jnp.where(valid, s, NEG_INF)
@@ -120,28 +142,37 @@ def _ragged_paged_kernel(pt_ref, ln_ref, q_ref, k_ref, v_ref, o_ref,
 
 def ragged_paged_attention_pallas(q, k_pages, v_pages, page_tables,
                                   seq_lens, q_lens, *, num_heads,
-                                  head_dim, interpret=None):
+                                  head_dim, k_scales=None,
+                                  v_scales=None, interpret=None):
     """Pallas route (interpret-mode on CPU). See module docstring for
-    layouts."""
+    layouts; k_scales/v_scales engage the int8 dequantizing body."""
     B, T, HD = q.shape
     ps = k_pages.shape[1]
     P = page_tables.shape[1]
+    quantized = k_scales is not None
     lens = jnp.stack([seq_lens.astype(jnp.int32),
                       q_lens.astype(jnp.int32)], axis=1)       # [B, 2]
     # unused page-table slots may carry sentinels; the index map still
     # fetches them, so clamp to valid pool ids (compute is masked off)
     pt = jnp.clip(page_tables.astype(jnp.int32), 0,
                   k_pages.shape[0] - 1)
+    page_spec = pl.BlockSpec((None, ps, HD),
+                             lambda b, p, pt, ln: (pt[b, p], 0, 0))
+    in_specs = [
+        pl.BlockSpec((None, T, HD), lambda b, p, pt, ln: (b, 0, 0)),
+        page_spec,
+        page_spec,
+    ]
+    inputs = [pt, lens, q, k_pages, v_pages]
+    if quantized:
+        scale_spec = pl.BlockSpec(
+            (None, ps, num_heads), lambda b, p, pt, ln: (pt[b, p], 0, 0))
+        in_specs += [scale_spec, scale_spec]
+        inputs += [k_scales, v_scales]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, P),
-        in_specs=[
-            pl.BlockSpec((None, T, HD), lambda b, p, pt, ln: (b, 0, 0)),
-            pl.BlockSpec((None, ps, HD),
-                         lambda b, p, pt, ln: (pt[b, p], 0, 0)),
-            pl.BlockSpec((None, ps, HD),
-                         lambda b, p, pt, ln: (pt[b, p], 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((None, T, HD),
                                lambda b, p, pt, ln: (b, 0, 0)),
         scratch_shapes=[
@@ -152,30 +183,48 @@ def ragged_paged_attention_pallas(q, k_pages, v_pages, page_tables,
     )
     kernel = functools.partial(
         _ragged_paged_kernel, page_size=ps, num_heads=num_heads,
-        head_dim=head_dim, pages_per_seq=P)
+        head_dim=head_dim, pages_per_seq=P, quantized=quantized)
+    out_dtype = q.dtype
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, T, HD), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, T, HD), out_dtype),
         interpret=_interpret() if interpret is None else interpret,
-    )(pt, lens, q, k_pages, v_pages)
+    )(*inputs)
+
+
+def _dequant_gathered(pages, scales, H):
+    """[B, P, ps, H*D] int8 + [B, P, ps, H] fp32 -> fp32 pages."""
+    B, P, ps, HD = pages.shape
+    D = HD // H
+    return (pages.astype(jnp.float32).reshape(B, P, ps, H, D)
+            * scales.astype(jnp.float32)[..., None]) \
+        .reshape(B, P, ps, HD)
 
 
 def ragged_paged_attention_dense(q, k_pages, v_pages, page_tables,
                                  seq_lens, q_lens, *, num_heads,
-                                 head_dim):
+                                 head_dim, k_scales=None, v_scales=None):
     """Dense lax fallback: gather each row's pages into a [B, P*ps, H*D]
     context and run masked attention. O(B * pages_per_seq * page_size)
     memory — correct everywhere (the CPU serving path and the numerics
-    oracle for the kernel), not the TPU hot path."""
+    oracle for the kernel), not the TPU hot path. Int8 pages are
+    dequantized right after the gather (same per-(slot, head) scales
+    the kernel applies in VMEM)."""
     B, T, HD = q.shape
     ps = k_pages.shape[1]
     P = page_tables.shape[1]
     D = head_dim
     pt = jnp.clip(page_tables.astype(jnp.int32), 0,
                   k_pages.shape[0] - 1)
-    k = k_pages[pt].reshape(B, P * ps, HD).astype(jnp.float32)
-    v = v_pages[pt].reshape(B, P * ps, HD).astype(jnp.float32)
+    if k_scales is not None:
+        k = _dequant_gathered(k_pages[pt], k_scales[pt], num_heads) \
+            .reshape(B, P * ps, HD)
+        v = _dequant_gathered(v_pages[pt], v_scales[pt], num_heads) \
+            .reshape(B, P * ps, HD)
+    else:
+        k = k_pages[pt].reshape(B, P * ps, HD).astype(jnp.float32)
+        v = v_pages[pt].reshape(B, P * ps, HD).astype(jnp.float32)
     scale = 1.0 / math.sqrt(D)
     q_pos = (seq_lens[:, None] - q_lens[:, None]
              + jnp.arange(T, dtype=jnp.int32)[None, :])        # [B, T]
@@ -208,29 +257,24 @@ def use_pallas_route():
 
 
 def ragged_paged_attention(q, k_pages, v_pages, page_tables, seq_lens,
-                           q_lens=None, *, num_heads, head_dim):
+                           q_lens=None, *, num_heads, head_dim,
+                           k_scales=None, v_scales=None):
     """Auto-routed entry (array-level; used inside the serving engine's
-    jitted steps)."""
+    jitted steps). Pass k_scales/v_scales for int8 pages."""
     if q_lens is None:
         q_lens = jnp.full((q.shape[0],), q.shape[1], jnp.int32)
     fn = (ragged_paged_attention_pallas if use_pallas_route()
           else ragged_paged_attention_dense)
     return fn(q, k_pages, v_pages, page_tables, seq_lens, q_lens,
-              num_heads=num_heads, head_dim=head_dim)
+              num_heads=num_heads, head_dim=head_dim,
+              k_scales=k_scales, v_scales=v_scales)
 
 
-def write_kv_pages(k_pages, v_pages, k_new, v_new, page_tables,
-                   seq_lens, q_lens):
-    """Scatter this step's new K/V rows into the paged pool (pure array
-    op, jit/donation-friendly).
-
-    k_new/v_new: [B, T, H*D] right-padded like q. Token t of row b lands
-    at global position seq_lens[b] - q_lens[b] + t, i.e. flat slot
-    page_tables[b, pos // ps] * ps + pos % ps; padded tokens are routed
-    to an out-of-range index and dropped by the scatter.
-    """
-    N, ps, HD = k_pages.shape
-    B, T, _ = k_new.shape
+def _flat_slots(page_tables, seq_lens, q_lens, T, N, ps):
+    """[B*T] flat pool slot per new token (OOB sentinel for padding —
+    dropped by the scatter). Token t of row b lands at global position
+    seq_lens[b] - q_lens[b] + t, i.e. flat slot
+    page_tables[b, pos // ps] * ps + pos % ps."""
     pos = (seq_lens[:, None] - q_lens[:, None]
            + jnp.arange(T, dtype=jnp.int32)[None, :])          # [B, T]
     valid = jnp.arange(T, dtype=jnp.int32)[None, :] < q_lens[:, None]
@@ -238,9 +282,61 @@ def write_kv_pages(k_pages, v_pages, k_new, v_new, page_tables,
         jnp.clip(page_tables, 0, N - 1), pos // ps, axis=1)    # [B, T]
     flat = page_idx * ps + pos % ps
     flat = jnp.where(valid, flat, N * ps)      # OOB -> dropped
-    flat = flat.reshape(-1)
+    return flat.reshape(-1)
+
+
+def write_kv_pages(k_pages, v_pages, k_new, v_new, page_tables,
+                   seq_lens, q_lens):
+    """Scatter this step's new K/V rows into the paged pool (pure array
+    op, jit/donation-friendly).
+
+    k_new/v_new: [B, T, H*D] right-padded like q; padded tokens are
+    routed to an out-of-range index and dropped by the scatter.
+    """
+    N, ps, HD = k_pages.shape
+    B, T, _ = k_new.shape
+    flat = _flat_slots(page_tables, seq_lens, q_lens, T, N, ps)
     k2 = k_pages.reshape(N * ps, HD).at[flat].set(
         k_new.reshape(B * T, HD).astype(k_pages.dtype), mode='drop')
     v2 = v_pages.reshape(N * ps, HD).at[flat].set(
         v_new.reshape(B * T, HD).astype(v_pages.dtype), mode='drop')
     return k2.reshape(N, ps, HD), v2.reshape(N, ps, HD)
+
+
+def quantize_kv_rows(x, num_heads):
+    """[B, T, H*D] float -> (int8 [B, T, H*D], fp32 scales [B, T, H]):
+    symmetric abs-max per (token, head) — the granularity the pool's
+    scale buffers store, chosen so a token's scales are final the
+    moment it is written (no rescaling of already-resident slots)."""
+    B, T, HD = x.shape
+    D = HD // num_heads
+    xf = x.astype(jnp.float32).reshape(B, T, num_heads, D)
+    amax = jnp.max(jnp.abs(xf), axis=-1)                       # [B,T,H]
+    scale = jnp.maximum(amax, 1e-30) / 127.0
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127) \
+        .astype(jnp.int8)
+    return q.reshape(B, T, HD), scale
+
+
+def write_kv_pages_quantized(k_pages, v_pages, k_scales, v_scales,
+                             k_new, v_new, page_tables, seq_lens,
+                             q_lens, *, num_heads):
+    """Quantizing twin of write_kv_pages for int8 pools: each new
+    token's K/V row is abs-max-quantized per head and scattered as int8
+    + fp32 scales into the sibling scale buffers (same flat slots)."""
+    N, ps, HD = k_pages.shape
+    B, T, _ = k_new.shape
+    H = num_heads
+    flat = _flat_slots(page_tables, seq_lens, q_lens, T, N, ps)
+    kq, ks = quantize_kv_rows(k_new, H)
+    vq, vs = quantize_kv_rows(v_new, H)
+    k2 = k_pages.reshape(N * ps, HD).at[flat].set(
+        kq.reshape(B * T, HD), mode='drop')
+    v2 = v_pages.reshape(N * ps, HD).at[flat].set(
+        vq.reshape(B * T, HD), mode='drop')
+    ks2 = k_scales.reshape(N * ps, H).at[flat].set(
+        ks.reshape(B * T, H).astype(k_scales.dtype), mode='drop')
+    vs2 = v_scales.reshape(N * ps, H).at[flat].set(
+        vs.reshape(B * T, H).astype(v_scales.dtype), mode='drop')
+    return (k2.reshape(N, ps, HD), v2.reshape(N, ps, HD),
+            ks2.reshape(N, ps, H), vs2.reshape(N, ps, H))

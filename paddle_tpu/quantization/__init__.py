@@ -19,6 +19,14 @@ separate quant/dequant CUDA kernels fuse away in XLA); moving-average
 scales are ordinary buffers threaded through jit; int8 export stores
 int8 weights + fp32 scales in the same data-only container
 static/inference.py uses.
+
+Load-bearing consumers (ISSUE 7): the serving engine's weight-only-
+quantized decode (`ServingConfig(weight_dtype='int8')` — per-channel
+`quantize_to_int8` with fused in-step dequant, docs/serving.md
+#weight-only); the block-scaled int8 collective wire and int8 KV-cache
+pages reuse the same symmetric abs-max scheme in their own layouts
+(`core/bucketing.quantize_blocks`,
+`ops/pallas/paged_attention.quantize_kv_rows`).
 """
 import functools
 import math

@@ -9,6 +9,17 @@ O(batch * max_seq_len). The ragged paged-attention kernel gathers a
 row's pages straight from this layout (`ops/pallas/paged_attention.py`
 module docstring has the exact shapes).
 
+Quantized pages (`kv_dtype='int8'`, ISSUE 7): each layer's entry
+becomes a 4-tuple `(k_pages int8, v_pages int8, k_scales fp32,
+v_scales fp32)` with scales of shape `[num_pages, page_size,
+local_heads]` — one abs-max scale per (token slot, head), computed
+when the token's K/V row is scattered in (`write_kv_pages_quantized`)
+so already-written slots never rescale. Attention dequantizes inside
+the kernel (or the dense fallback), so the math stays fp32 while the
+pool holds ~4x (vs fp32) / ~2x (vs bf16) more tokens per byte; the
+exact per-token byte math is `bytes_per_token()` below and
+docs/serving.md#quantized-kv.
+
 The allocator is deliberately host-side and dumb-simple: serving
 decisions (admit / grow / preempt) happen between jitted steps, where
 Python cost is amortized over a whole batch step. Invariants it
@@ -20,6 +31,18 @@ enforces (tested in tests/test_serving.py):
 """
 import math
 import threading
+
+import numpy as _np
+
+
+def _np_dtype(dt):
+    """np.dtype of a string / numpy / jnp dtype spec without importing
+    jax for the common cases (pure-allocator tests stay jax-free)."""
+    try:
+        return _np.dtype(dt)
+    except TypeError:
+        import jax.numpy as jnp
+        return _np.dtype(jnp.dtype(dt))
 
 
 class PoolExhausted(RuntimeError):
@@ -55,17 +78,52 @@ class KVPagePool:
         self.high_water = 0
 
     # -- device arrays -------------------------------------------------------
+    @property
+    def quantized(self):
+        """True when pages store int8 + per-(slot, head) fp32 scales."""
+        if self.dtype is None:
+            return False
+        return _np_dtype(self.dtype) == _np.int8
+
     def materialize(self):
         if self.kv is not None:
             return self.kv
         import jax.numpy as jnp
-        dt = self.dtype or jnp.float32
         hd = self.num_heads * self.head_dim
+        if self.quantized:
+            shape = (self.num_pages, self.page_size, hd)
+            sshape = (self.num_pages, self.page_size, self.num_heads)
+            self.kv = [
+                (jnp.zeros(shape, jnp.int8),
+                 jnp.zeros(shape, jnp.int8),
+                 jnp.zeros(sshape, jnp.float32),
+                 jnp.zeros(sshape, jnp.float32))
+                for _ in range(self.num_layers)]
+            return self.kv
+        dt = self.dtype or jnp.float32
         self.kv = [
             (jnp.zeros((self.num_pages, self.page_size, hd), dt),
              jnp.zeros((self.num_pages, self.page_size, hd), dt))
             for _ in range(self.num_layers)]
         return self.kv
+
+    def bytes_per_token(self):
+        """Device bytes one token's K+V occupies across all layers —
+        the capacity math of docs/serving.md#quantized-kv: int8 pages
+        cost heads*head_dim*1 + heads*4 (scale) per K and per V, dense
+        pages heads*head_dim*itemsize."""
+        hd = self.num_heads * self.head_dim
+        if self.quantized:
+            per = hd * 1 + self.num_heads * 4
+        else:
+            item = _np_dtype(self.dtype).itemsize if self.dtype else 4
+            per = hd * item
+        return 2 * per * self.num_layers
+
+    def pool_bytes(self):
+        """Total device bytes of the materialized (or to-be-
+        materialized) pool arrays."""
+        return self.num_pages * self.page_size * self.bytes_per_token()
 
     def drop_arrays(self):
         """Release the device buffers (engine shutdown)."""
@@ -149,6 +207,11 @@ class KVPagePool:
         return {
             'num_pages': self.num_pages,
             'page_size': self.page_size,
+            'kv_dtype': ('int8' if self.quantized
+                         else str(_np_dtype(self.dtype))
+                         if self.dtype is not None else 'float32'),
+            'bytes_per_token': self.bytes_per_token(),
+            'pool_bytes': self.pool_bytes(),
             'pages_in_use': self.pages_in_use,
             'free_pages': self.free_pages,
             'utilization': self.utilization(),

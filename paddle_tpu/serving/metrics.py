@@ -39,12 +39,13 @@ _SLO_HISTOGRAMS = {
 
 _GAUGE_NAMES = (
     'ptpu_serve_decode_tokens_per_sec',
-    'ptpu_serve_ttft_ms',
     'ptpu_serve_batch_occupancy',
     'ptpu_serve_kv_page_utilization',
     'ptpu_serve_kv_pages_total',
     'ptpu_serve_kv_pages_in_use',
     'ptpu_serve_kv_pages_high_water',
+    'ptpu_serve_kv_pool_bytes',
+    'ptpu_serve_kv_bytes_per_token',
     'ptpu_serve_batch_slots',
     'ptpu_serve_requests_in_flight',
     'ptpu_serve_requests_waiting',
@@ -76,13 +77,9 @@ def publish(stats):
     g('ptpu_serve_decode_tokens_per_sec',
       help='batched decode throughput (generated tokens/sec)').set(
           stats.get('decode_tokens_per_sec', 0.0))
-    # DEPRECATED (ISSUE 6): superseded by the ptpu_serve_ttft_seconds
-    # histogram percentiles; kept publishing for one release so
-    # existing dashboards don't blank
-    g('ptpu_serve_ttft_ms',
-      help='DEPRECATED: mean TTFT over completed requests — use '
-           'ptpu_serve_ttft_seconds percentiles').set(
-          stats.get('ttft_ms_mean') or 0.0)
+    # ptpu_serve_ttft_ms (deprecated mean gauge) was REMOVED in ISSUE 7
+    # after its one-release grace: use the ptpu_serve_ttft_seconds
+    # histogram percentiles
     g('ptpu_serve_batch_occupancy',
       help='mean running slots / decode slots over decode steps').set(
           stats.get('batch_occupancy', 0.0))
@@ -97,6 +94,13 @@ def publish(stats):
     g('ptpu_serve_kv_pages_high_water',
       help='max KV pages simultaneously mapped').set(
           pool.get('high_water', 0))
+    g('ptpu_serve_kv_pool_bytes',
+      help='device bytes of the paged KV pool (scale buffers '
+           'included for int8 pools)').set(pool.get('pool_bytes', 0))
+    g('ptpu_serve_kv_bytes_per_token',
+      help='K+V device bytes per cached token across layers '
+           '(docs/serving.md#quantized-kv capacity math)').set(
+          pool.get('bytes_per_token', 0))
     g('ptpu_serve_batch_slots', help='decode batch slots').set(
         stats.get('slots', 0))
     g('ptpu_serve_requests_in_flight',

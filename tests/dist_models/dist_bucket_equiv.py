@@ -10,7 +10,16 @@ dp=2 mesh:
     every reduction is a single commutative addition, and the optimizer
     update is per-element, so flat-shard application can't drift;
   * bucketed with `comm_dtype='bfloat16'` (compressed wire, fp32
-    accumulate): tolerance-level equivalence.
+    accumulate): tolerance-level equivalence;
+  * bucketed with `comm_dtype='int8'` (block-scaled int8 wire +
+    scale-carrying param all-gather, fp32 accumulate): tolerance-level
+    equivalence — the stated ISSUE-7 bar (docs/performance.md#int8-wire):
+    losses within rtol 5e-2 / atol 5e-3 and params within rtol 5e-2 /
+    atol 5e-2 of the fp32 reference after 4 Adam steps. The sharded
+    fp32 master keeps the update itself exact; the forward runs on the
+    int8-rounded working copy, so a few chaotic elements drift by
+    several grid steps while losses track closely (the wire math is
+    unit-bounded in tests/test_bucketing.py).
 
 Exits 0 on success; prints the failing comparison otherwise.
 """
@@ -89,8 +98,38 @@ def main():
     for n in ref_p:
         np.testing.assert_allclose(bf_p[n], ref_p[n], rtol=5e-2,
                                    atol=2e-3, err_msg=n)
+
+    # int8 block-scaled wire: tolerance-level (the forward consumes
+    # the int8-rounded working copy from the scale-carrying all-gather,
+    # so the bound is looser than bf16 — stated in docs/performance.md)
+    i8_l, i8_p, i8_s = run(True, comm_dtype='int8')
+    np.testing.assert_allclose(i8_l, ref_l, rtol=5e-2, atol=5e-3)
+    for n in ref_p:
+        np.testing.assert_allclose(i8_p[n], ref_p[n], rtol=5e-2,
+                                   atol=5e-2, err_msg=n)
+    # int8 comm forces the sharded fp32 master even for fp32 buckets
+    # (wire rounding must never feed back into the optimizer state)
+    assert any('master' in st for st in i8_s.values()), \
+        'int8 comm ran without a sharded fp32 master'
+
+    # the comm gauges must show the compression: int8 payload is 4x
+    # smaller than the fp32 per-param psum baseline, with the scale +
+    # pad overhead reported separately (ISSUE-7 acceptance)
+    from paddle_tpu.core import bucketing as B
+    snap = B.comm_snapshot()
+    factor = snap['comm_payload_factor_vs_per_param_psum']['hybrid']
+    assert factor >= 4.0, f'payload factor {factor} < 4x'
+    wb = snap['comm_wire_breakdown']['hybrid']
+    assert wb['scale_bytes'] > 0 and wb['total_bytes'] > \
+        wb['payload_bytes'], wb
+    assert snap['comm_bytes_drop_enabled']['hybrid'] is True
+    total_drop = snap['comm_bytes_drop_vs_per_param_psum']['hybrid']
+    assert total_drop >= 0.70, total_drop
+
     print('OK: sharded==replicated (fp32 bit-level), '
-          'bf16 comm within tolerance', flush=True)
+          'bf16 comm within tolerance, int8 block-scaled comm within '
+          f'tolerance (payload {factor:.2f}x below fp32 psum)',
+          flush=True)
     sys.exit(0)
 
 

@@ -157,6 +157,102 @@ class TestCommGauges:
             'engine=testeng'] == 0.5
 
 
+class TestInt8Wire:
+    """ISSUE 7: block-scaled int8 quantization helpers and the real
+    wire-byte accounting (payload vs scale vs pad)."""
+
+    def test_block_len_divides(self):
+        assert B.block_len(592, 256) == 148    # 592 = 4 * 148
+        assert B.block_len(1024, 256) == 256
+        assert B.block_len(296, 32) == 8
+        assert B.block_len(7, 256) == 7
+        for n, want in ((592, 256), (1024, 256), (296, 32), (11, 4)):
+            b = B.block_len(n, want)
+            assert n % b == 0 and b <= max(want, 1)
+
+    def test_quantize_blocks_roundtrip_bound(self):
+        rng = np.random.RandomState(0)
+        flat = jnp.asarray((rng.randn(1024) * 3).astype('float32'))
+        q, s = B.quantize_blocks(flat, 128)
+        assert q.dtype == jnp.int8 and s.shape == (8,)
+        back = np.asarray(B.dequantize_blocks(q, s, 128))
+        # per-block bound: half a bin of that block's abs-max scale
+        err = np.abs(back - np.asarray(flat)).reshape(8, 128).max(1)
+        bound = np.asarray(s) / 2 + 1e-7
+        assert (err <= bound).all(), (err, bound)
+
+    def test_int8_gauges_payload_factor_and_breakdown(self):
+        # deliberately pad-heavy layout so the pad accounting shows
+        layout = B.BucketLayout.build(
+            {'w': ((1000,), jnp.float32), 'v': ((500,), jnp.float32)},
+            pad_to=64)
+        B.publish_comm_gauges(layout, engine='int8eng', n_shards=8,
+                              comm_dtype='int8', enabled=True,
+                              block=256)
+        snap = B.comm_snapshot()
+        elems, padded = 1500, layout.total_padded()
+        rs = snap['ptpu_comm_bytes_per_step'][
+            'engine=int8eng,op=reduce_scatter']
+        ag = snap['ptpu_comm_bytes_per_step'][
+            'engine=int8eng,op=all_gather']
+        wb = snap['comm_wire_breakdown']['int8eng']
+        # payload: 1 byte/elem on BOTH legs; overhead carries the fp32
+        # block scales and the zero-padding
+        assert wb['payload_bytes'] == 2 * elems
+        assert wb['pad_bytes'] == 2 * (padded - elems)
+        assert wb['scale_bytes'] > 0
+        assert wb['total_bytes'] == rs + ag
+        # the ISSUE-7 acceptance bar: >= 4x payload drop vs the fp32
+        # per-param psum (2x payload ring convention), overhead visible
+        factor = snap['comm_payload_factor_vs_per_param_psum'][
+            'int8eng']
+        assert factor >= 4.0, factor
+        assert snap['comm_bytes_drop_vs_per_param_psum'][
+            'int8eng'] >= 0.70
+        assert snap['ptpu_comm_block_elements']['engine=int8eng'] > 0
+        assert snap['ptpu_comm_compressed_fraction'][
+            'engine=int8eng'] == 0.75
+
+    def test_wire_bytes_bf16_matches_legacy_model(self):
+        layout = B.BucketLayout.build(
+            {'w': ((2048,), jnp.bfloat16)}, pad_to=8)
+        wires = B.wire_bytes(layout, 8, jnp.bfloat16)
+        assert wires['reduce_scatter']['total'] == 2048 * 2
+        assert wires['all_gather']['total'] == 2048 * 2
+        assert wires['reduce_scatter']['scale'] == 0
+
+    def test_force_master_overrides_multi_precision_off(self):
+        # int8 comm NEEDS the sharded fp32 master even when the
+        # optimizer opts out of multi_precision: without it the
+        # int8-rounded gathered params would BE the optimizer state
+        # and wire rounding would compound into the trajectory
+        layout = B.BucketLayout.build({'w': ((64,), jnp.float32)},
+                                      pad_to=8)
+        opt = paddle.optimizer.Adam(learning_rate=0.01)
+        opt._multi_precision = False
+        st = B.init_bucket_state(opt, layout.buckets[0],
+                                 np.zeros(layout.buckets[0].size,
+                                          np.float32),
+                                 force_master=True)
+        assert 'master' in st
+        # and fp32 buckets without the int8 wire still skip it
+        st2 = B.init_bucket_state(opt, layout.buckets[0],
+                                  np.zeros(layout.buckets[0].size,
+                                           np.float32))
+        assert 'master' not in st2
+
+    def test_effective_block_gauge_honest(self):
+        # shard_len 16 has no divisor of 256 above 16 — the gauge must
+        # report the EFFECTIVE block (16), not the requested 256
+        layout = B.BucketLayout.build({'w': ((120,), jnp.float32)},
+                                      pad_to=16)   # size 128, 8 shards
+        B.publish_comm_gauges(layout, engine='blkeng', n_shards=8,
+                              comm_dtype='int8', enabled=True,
+                              block=256)
+        snap = B.comm_snapshot()
+        assert snap['ptpu_comm_block_elements']['engine=blkeng'] == 16
+
+
 def _mesh(axes, sizes):
     from paddle_tpu.distributed import topology_runtime
     return topology_runtime.build_mesh(axes, sizes)

@@ -2,6 +2,8 @@
 criterion; VERDICT r1 #9). Both legs run on CPU here for determinism; the
 tools/loss_parity.py script runs the same harness on the TPU chip."""
 import os
+
+import pytest
 import sys
 
 import numpy as np
@@ -10,6 +12,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))), 'tools'))
 
 
+@pytest.mark.slow       # ~45s 30-step curve: run_tests.sh tiers
 def test_bf16_curve_tracks_fp32():
     from loss_parity import compare
     report = compare(steps=30, rel_tol=0.05)

@@ -91,6 +91,106 @@ class TestFakeQuantOps:
         assert np.max(np.abs(back - a)) < np.max(np.abs(a)) / 100
 
 
+class TestQuantNumerics:
+    """ISSUE-7 coverage for the (previously dormant) op numerics: STE
+    gradients against finite differences, moving-average scale-state
+    round-trip, and the int8 export inverse bound."""
+
+    def test_ste_gradient_matches_finite_difference(self):
+        # STE says d(fake_qdq)/dx == 1 inside the clip range, 0 outside.
+        # The true function is a staircase, so finite-difference with a
+        # step MUCH larger than one quantization bin (s/127) recovers
+        # the envelope slope the STE claims. A fixed sentinel (4.0)
+        # pins the abs-max scale so perturbing other elements never
+        # moves it.
+        a = np.array([4.0, -3.3, 0.3, -1.7, 2.2, 1.5, 0.0,
+                      -0.01], 'float32')
+        x = _t(a)
+        x.stop_gradient = False
+        out, scale = Q.fake_quantize_dequantize_abs_max(x)
+        paddle.sum(out).backward()
+        analytic = np.asarray(x.grad.data)
+        # h spans ~16 bins (bin = 4/127 ~ 0.03), so the staircase FD
+        # quantizes the slope to multiples of bin/2h ~ 0.03; x +- h
+        # stays inside the clip range for every perturbed element
+        h = 0.5
+        fd = np.zeros_like(a)
+        for i in range(1, len(a)):        # skip the scale sentinel
+            ap, am = a.copy(), a.copy()
+            ap[i] += h
+            am[i] -= h
+            op, _ = Q.fake_quantize_dequantize_abs_max(_t(ap))
+            om, _ = Q.fake_quantize_dequantize_abs_max(_t(am))
+            fd[i] = (float(paddle.sum(op)) - float(paddle.sum(om))) \
+                / (2 * h)
+        np.testing.assert_allclose(analytic[1:], fd[1:], atol=0.05)
+        assert analytic[0] == 1.0         # sentinel inside clip range
+
+    def test_channel_wise_ste_gradient_matches_finite_difference(self):
+        # per-channel scales: same envelope argument, one sentinel per
+        # channel row (quant_axis=0)
+        a = np.array([[4.0, -1.3, 0.7, 2.2],
+                      [8.0, 3.1, -5.5, 0.4]], 'float32')
+        x = _t(a)
+        x.stop_gradient = False
+        out, _ = Q.fake_channel_wise_quantize_dequantize_abs_max(
+            x, quant_axis=0)
+        paddle.sum(out).backward()
+        analytic = np.asarray(x.grad.data)
+        h = 0.5
+        for (i, j) in ((0, 1), (0, 2), (1, 1), (1, 2), (1, 3)):
+            ap, am = a.copy(), a.copy()
+            ap[i, j] += h
+            am[i, j] -= h
+            op, _ = Q.fake_channel_wise_quantize_dequantize_abs_max(
+                _t(ap), quant_axis=0)
+            om, _ = Q.fake_channel_wise_quantize_dequantize_abs_max(
+                _t(am), quant_axis=0)
+            fd = (float(paddle.sum(op)) - float(paddle.sum(om))) \
+                / (2 * h)
+            np.testing.assert_allclose(analytic[i, j], fd, atol=0.08,
+                                       err_msg=f'({i},{j})')
+
+    def test_moving_average_state_roundtrip(self):
+        # the EMA scale is an ordinary buffer: exporting it to numpy
+        # and rebuilding the Tensor must continue the schedule exactly
+        rng = np.random.RandomState(5)
+        batches = [rng.randn(16).astype('float32') * (1 + k)
+                   for k in range(6)]
+        st_cont = _t(np.zeros((), 'float32'))
+        for b in batches:
+            _, st_cont = \
+                Q.fake_quantize_dequantize_moving_average_abs_max(
+                    _t(b), st_cont, moving_rate=0.9)
+        st_rt = _t(np.zeros((), 'float32'))
+        for k, b in enumerate(batches):
+            _, st_rt = \
+                Q.fake_quantize_dequantize_moving_average_abs_max(
+                    _t(b), st_rt, moving_rate=0.9)
+            if k == 2:   # checkpoint round-trip mid-schedule
+                st_rt = _t(np.asarray(st_rt.data).copy())
+        np.testing.assert_allclose(float(st_rt), float(st_cont),
+                                   rtol=1e-6)
+
+    def test_int8_inverse_within_half_bin(self):
+        # |dequant(quant(a)) - a| <= scale/(2*127) elementwise — the
+        # tightest bound symmetric round-to-nearest can promise
+        rng = np.random.RandomState(7)
+        a = (rng.randn(32, 24) * 2.5).astype('float32')
+        for axis in (None, 0, 1):
+            q, s = Q.quantize_to_int8(a, quant_axis=axis)
+            back = Q.dequantize_from_int8(q, s, quant_axis=axis)
+            step = np.asarray(s, np.float32) / 127.0
+            if axis is None:
+                bound = np.full_like(a, step / 2)
+            else:
+                shape = [1, 1]
+                shape[axis] = a.shape[axis]
+                bound = np.broadcast_to(step.reshape(shape) / 2,
+                                        a.shape)
+            assert (np.abs(back - a) <= bound + 1e-7).all(), axis
+
+
 class TestStaticQuantPass:
     def test_golden_rewrite(self):
         import paddle_tpu.static as static

@@ -351,6 +351,166 @@ class TestContinuousBatching:
 
 
 # ---------------------------------------------------------------------------
+# int8 KV pages + weight-only-quantized decode (ISSUE 7)
+# ---------------------------------------------------------------------------
+class TestQuantizedKV:
+    def test_quantized_kernel_and_fallback_match_oracle(self):
+        # quantize fp32 pages per (slot, head); the dequantizing kernel
+        # and dense fallback must agree with each other to fp32
+        # precision and sit within the int8 rounding envelope of the
+        # unquantized oracle — across page boundaries (rows span 1..4
+        # pages, shuffled tables)
+        q, kp, vp, pt, sl, ql, H, D = _mixed_case(seed=2)
+        N, ps, HD = kp.shape
+        kq, ks = pa.quantize_kv_rows(jnp.asarray(kp), H)
+        vq, vs = pa.quantize_kv_rows(jnp.asarray(vp), H)
+        kq, vq = kq.reshape(N, ps, HD), vq.reshape(N, ps, HD)
+        ks, vs = ks.reshape(N, ps, H), vs.reshape(N, ps, H)
+        o_k = pa.ragged_paged_attention_pallas(
+            jnp.asarray(q), kq, vq, jnp.asarray(pt), jnp.asarray(sl),
+            jnp.asarray(ql), num_heads=H, head_dim=D,
+            k_scales=ks, v_scales=vs)
+        o_d = pa.ragged_paged_attention_dense(
+            jnp.asarray(q), kq, vq, jnp.asarray(pt), jnp.asarray(sl),
+            jnp.asarray(ql), num_heads=H, head_dim=D,
+            k_scales=ks, v_scales=vs)
+        ref = _oracle(q, kp, vp, pt, sl, ql, H, D)
+        for b in range(q.shape[0]):
+            np.testing.assert_allclose(
+                np.asarray(o_k)[b, :ql[b]], np.asarray(o_d)[b, :ql[b]],
+                rtol=2e-4, atol=2e-5)
+            np.testing.assert_allclose(
+                np.asarray(o_d)[b, :ql[b]], ref[b, :ql[b]],
+                rtol=5e-2, atol=5e-2)
+
+    def test_write_kv_pages_quantized_scatter(self):
+        ps, H, D, N = 4, 2, 3, 5
+        HD = H * D
+        kp = jnp.zeros((N, ps, HD), jnp.int8)
+        vp = jnp.zeros((N, ps, HD), jnp.int8)
+        ks = jnp.zeros((N, ps, H))
+        vs = jnp.zeros((N, ps, H))
+        k_new = jnp.asarray(
+            np.arange(2 * 3 * HD, dtype=np.float32).reshape(2, 3, HD)
+            + 1.0)
+        pt = jnp.asarray([[3, 1, 0, 0], [2, 2, 2, 2]], jnp.int32)
+        sl = jnp.asarray([7, 1], jnp.int32)
+        ql = jnp.asarray([2, 0], jnp.int32)    # row 1 idle: no writes
+        kp2, vp2, ks2, vs2 = pa.write_kv_pages_quantized(
+            kp, vp, ks, vs, k_new, 2 * k_new, pt, sl, ql, num_heads=H)
+        kp2, ks2 = np.asarray(kp2), np.asarray(ks2)
+        # positions 5, 6 of row 0 -> page_table[1] slots 1, 2; the
+        # dequantized rows must match the written values within half a
+        # bin of the per-(slot, head) scale
+        want = np.asarray(k_new)[0, :2].reshape(2, H, D)
+        for slot, tok in ((1, 0), (2, 1)):
+            deq = (kp2[1, slot].reshape(H, D).astype(np.float32)
+                   * ks2[1, slot][:, None])
+            bound = ks2[1, slot][:, None] / 2 + 1e-6
+            assert (np.abs(deq - want[tok]) <= bound).all()
+        # nothing else written (idle row dropped by the scatter)
+        assert (np.abs(kp2).sum(-1) > 0).sum() == 2
+        assert (ks2 > 0).sum() == 2 * H
+        vdeq = (np.asarray(vp2)[1, 1].reshape(H, D).astype(np.float32)
+                * np.asarray(vs2)[1, 1][:, None])
+        assert (np.abs(vdeq - 2 * want[0])
+                <= np.asarray(vs2)[1, 1][:, None] / 2 + 1e-6).all()
+
+    def test_int8_kv_engine_matches_fp32_greedy(
+            self, tiny_lm, mixed_prompts, sequential_greedy):
+        # acceptance: int8-KV continuous batching == fp32-KV greedy
+        # outputs across page boundaries, on BOTH routes
+        eng = ServingEngine(tiny_lm, ServingConfig(
+            page_size=8, max_batch_size=3, prefill_chunk=8,
+            kv_dtype='int8'))
+        outs = eng.generate(mixed_prompts, max_new_tokens=6, top_k=0)
+        assert outs == sequential_greedy
+        assert eng.pool.quantized
+        assert eng.pool.stats()['kv_dtype'] == 'int8'
+        eng.shutdown()
+        flags.set_flags({'FLAGS_paged_attention_kernel': True})
+        try:
+            eng_k = ServingEngine(tiny_lm, ServingConfig(
+                page_size=8, max_batch_size=3, prefill_chunk=8,
+                kv_dtype='int8'))
+            outs_k = eng_k.generate(mixed_prompts, max_new_tokens=6,
+                                    top_k=0)
+            eng_k.shutdown()
+        finally:
+            flags.set_flags({'FLAGS_paged_attention_kernel': None})
+        assert outs_k == sequential_greedy
+
+    def test_int8_kv_preemption_resume_equivalence(
+            self, tiny_lm, mixed_prompts, sequential_greedy):
+        # pool pressure exercises preempt/re-prefill on quantized
+        # pages: slots re-quantize on resume, outputs must not change
+        eng = ServingEngine(tiny_lm, ServingConfig(
+            page_size=8, max_batch_size=3, prefill_chunk=8,
+            num_pages=4, kv_dtype='int8'))
+        outs = eng.generate(mixed_prompts, max_new_tokens=6, top_k=0)
+        assert outs == sequential_greedy
+        assert eng.stats()['preemptions_total'] > 0
+        eng.shutdown()
+
+    def test_int8_pool_capacity_at_least_2x(self, tiny_lm):
+        # acceptance: the int8 pool fits >= 2x the in-flight tokens at
+        # the same byte budget vs the default (fp32 on CPU) pool
+        dense = ServingEngine(tiny_lm, ServingConfig(
+            page_size=8, max_batch_size=2))
+        quant = ServingEngine(tiny_lm, ServingConfig(
+            page_size=8, max_batch_size=2, kv_dtype='int8'))
+        d, qs = dense.pool.stats(), quant.pool.stats()
+        assert d['num_pages'] == qs['num_pages']
+        ratio = d['bytes_per_token'] / qs['bytes_per_token']
+        assert ratio >= 2.0, ratio
+        assert qs['pool_bytes'] * 2 <= d['pool_bytes']
+        # byte math is exact: int8 pages + fp32 per-(slot, head) scales
+        attn = tiny_lm.gpt.layers[0].attn
+        hd = attn.local_heads * attn.head_dim
+        per_tok = 2 * (hd + attn.local_heads * 4) * \
+            tiny_lm.config.num_layers
+        assert qs['bytes_per_token'] == per_tok
+        dense.shutdown()
+        quant.shutdown()
+
+
+class TestWeightOnlyQuantizedDecode:
+    def test_predictor_decode_top1_equivalent(
+            self, tiny_lm, mixed_prompts, sequential_greedy):
+        # acceptance: weight-only-quantized decode through the
+        # inference.Predictor produces top-1-equivalent greedy output
+        from paddle_tpu import inference
+        cfg = inference.Config()
+        cfg.enable_serving_engine(tiny_lm, max_new_tokens=6, top_k=0,
+                                  page_size=8, max_batch_size=3,
+                                  prefill_chunk=8, weight_dtype='int8')
+        pred = inference.create_predictor(cfg)
+        outs = pred.run([mixed_prompts])[0]
+        for i, want in enumerate(sequential_greedy):
+            assert outs[i, :len(want)].tolist() == want
+        st = pred._engine.stats()
+        assert st['weight_dtype'] == 'int8'
+        # every 2-D non-embedding matmul weight quantized: qkv/out +
+        # fc1/fc2 per layer = 4 * num_layers
+        assert st['quantized_params'] == 4 * tiny_lm.config.num_layers
+        pred._engine.shutdown()
+
+    def test_weight_and_kv_quantized_together(self, tiny_lm,
+                                              mixed_prompts,
+                                              sequential_greedy):
+        eng = ServingEngine(tiny_lm, ServingConfig(
+            page_size=8, max_batch_size=3, prefill_chunk=8,
+            kv_dtype='int8', weight_dtype='int8'))
+        outs = eng.generate(mixed_prompts, max_new_tokens=6, top_k=0)
+        assert outs == sequential_greedy
+        eng.shutdown()
+
+    def test_invalid_weight_dtype_rejected(self):
+        with pytest.raises(ValueError, match='weight_dtype'):
+            ServingConfig(weight_dtype='int4')
+
+
+# ---------------------------------------------------------------------------
 # metrics + predictor wiring
 # ---------------------------------------------------------------------------
 class TestServingSurface:

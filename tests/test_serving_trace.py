@@ -74,8 +74,9 @@ class TestHistogramPercentiles:
         assert snap['ptpu_serve_tpot_seconds']['count'] == 1
         assert snap['ptpu_serve_preemptions_per_request']['p99'] >= 1.0
         assert snap['timeline']['iterations'] == 3
-        # deprecated mean gauge still publishes (one-release grace)
-        assert 'ptpu_serve_ttft_ms' in snap
+        # the deprecated ptpu_serve_ttft_ms mean gauge is GONE (its
+        # one-release grace ended with ISSUE 7) — percentiles only
+        assert 'ptpu_serve_ttft_ms' not in snap
 
 
 # ---------------------------------------------------------------------------

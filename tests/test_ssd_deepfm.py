@@ -49,6 +49,7 @@ class TestSSD:
         assert (pr >= 0).all() and (pr <= 1).all()     # normalized, clipped
         assert tuple(np.asarray(pvars.data).shape) == (P, 4)
 
+    @pytest.mark.slow   # ~35s convergence run: run_tests.sh tiers
     def test_loss_decreases(self):
         paddle.seed(1)
         m = TinySSD(num_classes=4)

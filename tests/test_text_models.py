@@ -1,6 +1,7 @@
 """Text model families (hapi sentiment/bow example parity):
 LSTM classifier with padding-robust pooling + bag-of-embeddings."""
 import numpy as np
+import pytest
 import jax.numpy as jnp
 
 import paddle_tpu as paddle
@@ -21,6 +22,7 @@ class TestTextModels:
         y = ((ids < 50) & (ids > 0)).sum(1) > ((ids >= 50).sum(1))
         return ids, y.astype('int64')
 
+    @pytest.mark.slow   # ~70s convergence run: run_tests.sh tiers
     def test_lstm_sentiment_trains(self):
         from paddle_tpu.text import LSTMSentiment
         paddle.seed(5)

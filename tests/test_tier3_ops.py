@@ -100,6 +100,7 @@ class TestCRF:
                     best, best_s = p, s
             np.testing.assert_array_equal(_np(path)[b, :L], best)
 
+    @pytest.mark.slow   # ~70s convergence run: run_tests.sh tiers
     def test_crf_trains(self):
         """linear_chain_crf is differentiable: transitions learn a forced
         tag pattern."""

@@ -308,6 +308,9 @@ def render_comm(comm, cache=None):
     modeled = comm.get('ptpu_comm_modeled_bytes_per_step') or {}
     frac = comm.get('ptpu_comm_compressed_fraction') or {}
     drop = comm.get('comm_bytes_drop_vs_per_param_psum') or {}
+    breakdown = comm.get('comm_wire_breakdown') or {}
+    pay_factor = comm.get('comm_payload_factor_vs_per_param_psum') or {}
+    blocks = comm.get('ptpu_comm_block_elements') or {}
     engines = sorted({k.split(',')[0].split('=', 1)[1]
                       for k in list(buckets) + list(modeled)
                       if '=' in k})
@@ -336,6 +339,19 @@ def render_comm(comm, cache=None):
                        'drop)')
         if key in frac:
             out.append(f'    compressed fraction: {frac[key]:.2f}')
+        wb = breakdown.get(eng)
+        if wb:
+            blk = int(blocks.get(key, 0))
+            out.append(
+                f"    wire breakdown: payload "
+                f"{_fmt_bytes(wb['payload_bytes'])} + scales "
+                f"{_fmt_bytes(wb['scale_bytes'])} + pad "
+                f"{_fmt_bytes(wb['pad_bytes'])} = "
+                f"{_fmt_bytes(wb['total_bytes'])}"
+                + (f'  (block {blk} elems)' if blk else ''))
+            if eng in pay_factor:
+                out.append(f'    payload factor vs per-param '
+                           f'psum(fp32): {pay_factor[eng]:.2f}x')
     if cache:
         out.append('persistent compile cache: '
                    + ('enabled at ' + str(cache.get('dir'))
@@ -362,15 +378,27 @@ def _comm_selftest():
         pad_to=8)
     B.publish_comm_gauges(layout, engine='selftest', n_shards=8,
                           comm_dtype=jnp.bfloat16, enabled=True)
+    # int8 block-scaled wire (ISSUE 7): payload 4x below the fp32
+    # psum baseline, scale + pad overhead reported beside it
+    B.publish_comm_gauges(layout, engine='selftest_int8', n_shards=8,
+                          comm_dtype='int8', enabled=True, block=256)
     snap = StepTelemetry(publish=False).snapshot()
     comm, cache = _find_comm({'telemetry': {
         'comm': snap['comm'], 'compile_cache': snap['compile_cache']}})
     assert comm, 'StepTelemetry snapshot carries no comm section'
     drop = comm['comm_bytes_drop_vs_per_param_psum']['selftest']
     assert drop >= 0.40, drop   # the ISSUE 4 acceptance bar at bf16
+    factor = comm['comm_payload_factor_vs_per_param_psum'][
+        'selftest_int8']
+    assert factor >= 4.0, factor   # the ISSUE 7 acceptance bar at int8
+    wb = comm['comm_wire_breakdown']['selftest_int8']
+    assert wb['scale_bytes'] > 0, wb
+    assert wb['total_bytes'] == wb['payload_bytes'] \
+        + wb['scale_bytes'] + wb['pad_bytes'], wb
     text = render_comm(comm, cache)
     assert 'engine selftest' in text, text
     assert 'drop' in text and 'reduce_scatter' in text, text
+    assert 'wire breakdown' in text and 'payload factor' in text, text
     assert 'compile cache' in text, text
     print(text)
     print('health_dump comm selftest: OK')
@@ -444,8 +472,7 @@ def render_serve(s):
     out.append(
         f"  time-to-first-token: "
         + (f"{mean_ms:.1f} ms mean over {ttft.get('count', 0)} requests"
-           if mean_ms is not None else
-           f"{v('ttft_ms'):.1f} ms (gauge)"))
+           if mean_ms is not None else "(no completed requests)"))
     out.append(
         f"  batch occupancy: {100 * v('batch_occupancy'):.1f}% of "
         f"{int(v('batch_slots'))} decode slots; "
@@ -456,6 +483,11 @@ def render_serve(s):
         f"{int(v('kv_pages_total'))} pages in use "
         f"({100 * v('kv_page_utilization'):.1f}% mean), "
         f"high water {int(v('kv_pages_high_water'))}")
+    if v('kv_pool_bytes'):
+        out.append(
+            f"  KV pool bytes: {_fmt_bytes(v('kv_pool_bytes'))} "
+            f"({_fmt_bytes(v('kv_bytes_per_token'))}/token across "
+            f"layers — int8 pools carry scale buffers in this number)")
     out.append(
         f"  lifetime: {int(v('requests_completed_total'))}/"
         f"{int(v('requests_submitted_total'))} requests completed, "

@@ -9,6 +9,10 @@
 #   --serve-selftest - serving engine end-to-end on the CPU fallback
 #                      path + serve-gauge/percentile CLI smoke, request
 #                      trace export, stalled-request watchdog (ISSUE 5/6)
+#   --quant-selftest - quantization subsystem: fake-quant op numerics,
+#                      int8-KV serving parity + capacity, weight-only-
+#                      quantized Predictor decode, int8 comm gauge
+#                      breakdown (ISSUE 7)
 set -e
 cd "$(dirname "$0")/.."
 TIER="${1:-all}"
@@ -39,6 +43,15 @@ case "$TIER" in
           # tolerance (docs/performance.md)
           python tests/dist_models/dist_bucket_equiv.py
           python tools/health_dump.py comm --selftest ;;
+  --quant-selftest)
+          # dormant-op numerics (STE grads vs finite differences,
+          # moving-average scale state, int8 round-trip), the int8
+          # KV-pool + weight-only-quantized decode paths, and the
+          # wire-byte breakdown rendering
+          python -m pytest tests/test_quantization.py -q
+          python -m pytest tests/test_serving.py -q \
+            -k 'int8 or quant'
+          python tools/health_dump.py comm --selftest ;;
   --serve-selftest)
           # serving engine end to end on the CPU fallback path (paged
           # pool + continuous batching + request observatory), then the
@@ -55,5 +68,5 @@ case "$TIER" in
           python tools/health_dump.py numerics --selftest
           python tools/health_dump.py comm --selftest
           python tools/health_dump.py serve --selftest ;;
-  *) echo "usage: $0 [fast|dist|native|e2e|all|--comm-selftest|--serve-selftest]"; exit 1 ;;
+  *) echo "usage: $0 [fast|dist|native|e2e|all|--comm-selftest|--serve-selftest|--quant-selftest]"; exit 1 ;;
 esac
