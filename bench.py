@@ -104,6 +104,12 @@ def bench_gpt_1p3b(optimizer='adamw'):
     # the memory accountant goes into the round record
     before = len(jax.live_arrays())
     released = eng.shutdown()
+    # which fused Pallas primitives the compiled step actually routed to
+    # (ISSUE 8): BENCH_r06+ attributes ms_per_step deltas to these. On a
+    # CPU-only bench run the optimizer/norm kernels auto-fall back, so
+    # the routes dict is the honest evidence either way (interpret-mode
+    # parity lives in tests/test_fused_primitives.py).
+    from paddle_tpu.ops.pallas import scaffold as _scaffold
     return {
         'mfu': tflops / V5E_PEAK_TFLOPS,
         'ms_per_step': dt * 1000,
@@ -113,6 +119,8 @@ def bench_gpt_1p3b(optimizer='adamw'):
         'seq_len': L,
         'microbatches': A,
         'optimizer': optimizer,
+        'fused_primitives': {'active': _scaffold.active_primitives(),
+                             'routes': _scaffold.routes_snapshot()},
         'live_buffers_before_shutdown': before,
         'live_buffers_after_shutdown': released.get('live_buffers'),
         'live_bytes_after_shutdown': released.get('live_bytes'),
@@ -656,6 +664,8 @@ def _attach_telemetry(r):
             'compile_cache': snap.get('compile_cache'),
             # ptpu_serve_* view — only the serving leg publishes these
             'serve': snap.get('serve'),
+            # fused-primitive routing counters (ISSUE 8)
+            'pallas': snap.get('pallas'),
         }
     except Exception as e:
         r['telemetry'] = {'error': repr(e)[:200]}
@@ -748,6 +758,9 @@ def main():
         'seq_len': g['seq_len'],
         'microbatches': g['microbatches'],
         'optimizer': 'adamw_bf16_moments',
+        # ISSUE 8: which fused Pallas primitives were active in the
+        # headline step (health_dump pallas renders this)
+        'fused_primitives': g.get('fused_primitives'),
         'live_buffers_after_shutdown':
             g.get('live_buffers_after_shutdown'),
         'live_bytes_after_shutdown': g.get('live_bytes_after_shutdown'),

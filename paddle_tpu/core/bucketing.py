@@ -380,16 +380,50 @@ def init_bucket_state(optimizer, bucket, param_flat32, force_master=False):
     return st
 
 
-def shard_update(optimizer, p_shard, g32_shard, st, lr):
+def grad_stats(flat):
+    """One-pass (sum of squares, nonfinite count) of a flat gradient
+    array — the two scalars the step needs before touching params
+    (global-clip contribution + GradScaler found-inf). Routes to the
+    fused Pallas kernel (ops/pallas/fused_optimizer.py) on TPU, one
+    fused XLA reduction pair on the reference path. Both return fp32
+    scalars; nonfinite gradients poison the sum exactly like
+    jnp.sum(g*g) does."""
+    from ..ops.pallas import fused_optimizer as FO
+    if FO.use_fused_stats():
+        return FO.grad_stats_pallas(flat)
+    x = flat.astype(jnp.float32)
+    return jnp.sum(x * x), jnp.sum((~jnp.isfinite(x))
+                                   .astype(jnp.float32))
+
+
+def shard_update(optimizer, p_shard, g32_shard, st, lr, prefactor=None,
+                 found_inf=None):
     """One bucket-shard optimizer update with fp32-master handling —
     the flat twin of the engines' `_update_one` (same rule order:
-    decay-into-grad, update in fp32, master ride-along). `p_shard` is
-    the shard in PARAMETER dtype; returns (new_p_shard, new_state)."""
+    prefactor multiply, decay-into-grad, update in fp32, master
+    ride-along). `p_shard` is the shard in PARAMETER dtype; returns
+    (new_p_shard, new_state).
+
+    `prefactor` (optional scalar) is the combined unscale x global-clip
+    multiplier applied to the gradient first; `found_inf` (optional
+    bool scalar) makes the whole update a no-op (params and every state
+    entry keep their old values — the GradScaler skip). Both fold into
+    the SAME pass on the fused route (ops/pallas/fused_optimizer.py,
+    one Pallas kernel per bucket shard: unscale + clip + moments +
+    param step + master cast in one read/write per operand); the
+    reference path below applies them as the familiar XLA op chain."""
+    from ..ops.pallas import fused_optimizer as FO
+    if FO.use_fused_update(optimizer):
+        return FO.fused_shard_update(optimizer, p_shard, g32_shard, st,
+                                     lr, prefactor=prefactor,
+                                     found_inf=found_inf)
     low = p_shard.dtype != jnp.float32
     st = dict(st)
     master = st.pop('master', None)
     p32 = master if master is not None else (
         p_shard.astype(jnp.float32) if low else p_shard)
+    if prefactor is not None:
+        g32_shard = g32_shard * prefactor
     wd = getattr(optimizer, '_weight_decay', None)
     if wd and optimizer._decay_into_grad():
         g32_shard = g32_shard + wd * p32
@@ -398,7 +432,17 @@ def shard_update(optimizer, p_shard, g32_shard, st, lr):
     if master is not None or (low and getattr(optimizer,
                                               '_multi_precision', True)):
         ns['master'] = new32
-    return new32.astype(p_shard.dtype), ns
+    new_p = new32.astype(p_shard.dtype)
+    if found_inf is not None:
+        old = dict(st)
+        if master is not None:
+            old['master'] = master
+        new_p = jnp.where(found_inf, p_shard, new_p)
+        ns = {k: (jnp.where(found_inf, old[k], v) if k in old else v)
+              for k, v in ns.items()}
+        if 'master' in ns and master is None:
+            ns['master'] = jnp.where(found_inf, p32, ns['master'])
+    return new_p, ns
 
 
 def flat_functional_apply(optimizer, layout, params, grads, flat_states,
@@ -425,11 +469,13 @@ def flat_functional_apply(optimizer, layout, params, grads, flat_states,
 
     flat_grads = [g.astype(jnp.float32)
                   for g in layout.flatten(grads, cast=jnp.float32)]
+    factor = None
     if isinstance(clip, ClipGradByGlobalNorm):
-        sq = sum(jnp.sum(g * g) for g in flat_grads)
+        # one fused stats pass per bucket (Pallas on TPU) feeds the
+        # clip factor; the multiply itself fuses into the update pass
+        sq = sum(grad_stats(g)[0] for g in flat_grads)
         gn = jnp.sqrt(sq)
         factor = clip.clip_norm / jnp.maximum(gn, clip.clip_norm)
-        flat_grads = [g * factor for g in flat_grads]
     elif isinstance(clip, ClipGradByValue):
         flat_grads = [jnp.clip(g, clip.min, clip.max) for g in flat_grads]
 
@@ -437,7 +483,8 @@ def flat_functional_apply(optimizer, layout, params, grads, flat_states,
     new_flats, new_states = [], []
     for b, pf, gf, st in zip(layout.buckets, flat_params, flat_grads,
                              flat_states):
-        np_, ns = shard_update(optimizer, pf, gf, st, lr)
+        np_, ns = shard_update(optimizer, pf, gf, st, lr,
+                               prefactor=factor)
         new_flats.append(np_)
         new_states.append(ns)
     new_params = {}

@@ -55,6 +55,18 @@ _FLAGS = {
     # pattern); True/False force a route (tests force True to run the
     # kernel body under interpret mode on the CPU mesh)
     'FLAGS_paged_attention_kernel': None,
+    # fused Pallas primitives (ops/pallas/, TPP arXiv:2104.05755) —
+    # same route convention as the paged kernel: None = auto (fused
+    # Pallas kernel on TPU, reference jnp path on CPU), True/False
+    # force (tests force True: the kernels run under interpret mode on
+    # the CPU mesh). Route decisions are counted in
+    # ptpu_pallas_{kernel,fallback}_invocations_total.
+    # one-pass optimizer step + grad stats over flat buckets
+    'FLAGS_fused_optimizer': None,
+    # fused LayerNorm fwd+bwd (last-axis, affine)
+    'FLAGS_fused_layer_norm': None,
+    # fused bias+GELU and dropout+residual-add blocks
+    'FLAGS_fused_elementwise': None,
     # wrap op-kernel exceptions with [operator < name > error] context
     # (enforce.h framing; off by default to keep exception types exact)
     'FLAGS_op_error_context': False,

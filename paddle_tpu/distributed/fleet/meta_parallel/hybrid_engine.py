@@ -390,8 +390,10 @@ class HybridParallelTrainStep(EngineTeardown):
                     # are disjoint over the dp axes, so one psum restores
                     # the full sum; legacy (mp-sharded) params add their
                     # psum('mp') contribution exactly as the per-param
-                    # path does
-                    sq_local = sum(jnp.sum(g * g) for g in shards32) \
+                    # path does. Each shard's contribution is ONE fused
+                    # stats pass (Pallas kernel on TPU — the first leg
+                    # of the fused optimizer step).
+                    sq_local = sum(B.grad_stats(g)[0] for g in shards32) \
                         if shards32 else jnp.asarray(0.0, jnp.float32)
                     sq_b = lax.psum(sq_local, rs_axes) if rs_axes \
                         else sq_local
@@ -399,7 +401,6 @@ class HybridParallelTrainStep(EngineTeardown):
                                    else jnp.asarray(0.0, jnp.float32))
                     factor = clip_factor(sq_b)
                 if factor is not None:
-                    shards32 = [g * factor for g in shards32]
                     legacy = {n: (g.astype(jnp.float32) * factor)
                               .astype(g.dtype)
                               for n, g in legacy.items()}
@@ -411,8 +412,12 @@ class HybridParallelTrainStep(EngineTeardown):
                 for b, pf, g32, st in zip(layout.buckets, flat_params,
                                           shards32, states['buckets']):
                     p_shard = B.take_shard(pf, rs_axes, n_shards)
+                    # the clip multiply rides into the one-pass fused
+                    # update as `prefactor` instead of a separate
+                    # bucket-sized elementwise op
                     np_, ns = B.shard_update(self.optimizer, p_shard,
-                                             g32, st, lr)
+                                             g32, st, lr,
+                                             prefactor=factor)
                     gathered.append(B.all_gather(np_, rs_axes,
                                                  comm_dtype=comm_dtype,
                                                  block=comm_block))

@@ -91,11 +91,24 @@ class ColumnParallelLinear(Layer):
             if self.bias is not None:
                 _mark(self.bias, 0)
 
-    def forward(self, x):
+    def forward(self, x, with_bias=True):
+        """`with_bias=False` returns the pre-bias matmul so callers can
+        fuse the bias-add into the next op (GPTMLP routes it into the
+        bias+GELU Pallas kernel). Only valid with gather_output=False:
+        the output then stays column-local like the bias shard, so the
+        deferred add is mp-degree-transparent; a gathered output is
+        full-width while self.bias is the local shard, and the deferred
+        add would be shape-wrong — refuse it."""
         spmd = self.world_size > 1 and C.in_spmd_region()
+        if not with_bias and spmd and self.gather_output:
+            raise ValueError(
+                "ColumnParallelLinear(with_bias=False) with "
+                "gather_output=True under mp>1: the gathered output is "
+                "full-width but self.bias is the local column shard — "
+                "apply the bias in-layer (with_bias=True) instead")
         if spmd:
             x = C._c_identity(x, group=self.group)
-        out = F.linear(x, self.weight, self.bias)
+        out = F.linear(x, self.weight, self.bias if with_bias else None)
         if spmd and self.gather_output:
             out = C._c_concat(out, group=self.group)
         return out

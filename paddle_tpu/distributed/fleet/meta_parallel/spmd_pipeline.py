@@ -604,8 +604,12 @@ class SpmdPipelineEngine(EngineTeardown):
 
         found_inf = jnp.asarray(False)
         inv = None
+        fi_guard = None
         if scale is not None:
-            flags = [jnp.any(~jnp.isfinite(g)) for g in shards32]
+            # per-bucket found-inf from the same one-pass stats kernel
+            # the fused optimizer step uses (nonfinite COUNT > 0 ==
+            # any(~isfinite)); legacy params keep the per-param check
+            flags = [B.grad_stats(g)[1] > 0 for g in shards32]
             flags += [jnp.any(~jnp.isfinite(v)) for v in legacy.values()]
             f = (jnp.any(jnp.stack(flags)) if flags
                  else jnp.asarray(False)).astype(jnp.int32)
@@ -614,8 +618,8 @@ class SpmdPipelineEngine(EngineTeardown):
             if pp > 1:
                 f = lax.pmax(f, 'pp')
             found_inf = f > 0
+            fi_guard = found_inf
             inv = (1.0 / scale).astype(jnp.float32)
-            shards32 = [g * inv for g in shards32]
             legacy = {k: (v.astype(jnp.float32) * inv).astype(v.dtype)
                       for k, v in legacy.items()}
 
@@ -657,12 +661,12 @@ class SpmdPipelineEngine(EngineTeardown):
             st = {k: (v[0] if getattr(v, 'ndim', 0) >= 2 else v)
                   for k, v in st_in.items()}
             p_shard = B.take_shard(pf, ('dp',), self.dp)
-            np_, ns = B.shard_update(self.optimizer, p_shard, g32, st, lr)
-            if scale is not None:
-                np_ = jnp.where(found_inf, p_shard, np_)
-                ns = jax.tree_util.tree_map(
-                    lambda new, old: jnp.where(found_inf, old, new),
-                    ns, st)
+            # unscale multiply + found-inf no-op guard fold into the
+            # one-pass fused update (prefactor/found_inf); the
+            # reference route applies the same ops in the same order
+            np_, ns = B.shard_update(self.optimizer, p_shard, g32, st,
+                                     lr, prefactor=inv,
+                                     found_inf=fi_guard)
             new_buckets.append(
                 {k: (v[None] if getattr(v, 'ndim', 0) >= 1 else v)
                  for k, v in ns.items()})

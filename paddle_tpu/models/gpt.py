@@ -133,7 +133,9 @@ class GPTAttention(nn.Layer):
             config.hidden_size, config.hidden_size,
             weight_attr=nn.ParamAttr(initializer=out_init),
             input_is_parallel=True)
-        self.dropout = nn.Dropout(config.hidden_dropout)
+        # NOTE: the hidden dropout that used to follow out_proj now
+        # lives in GPTDecoderLayer's residual join (F.dropout_add), so
+        # it fuses with the add; the RNG draw order is unchanged.
 
     def forward(self, x, cache=None, cache_len=None):
         """cache: optional (k, v) Tensors [B, nh, max_len, hd] (fixed-size,
@@ -188,9 +190,11 @@ class GPTAttention(nn.Layer):
             from ..ops.pallas import flash_attention as fa
             ctx = fa.causal_attention(qkv, nh, hd)
         else:
+            from ..ops.pallas import scaffold as _scaffold
+            _scaffold.record_route('flash_attention', False)
             ctx = run_op('fused_attention', attn, [qkv])
         out = self.out_proj(ctx)
-        return self.dropout(out)
+        return out
 
     def _forward_cached(self, x, cache, cache_len):
         """Single-step decode: x [B, 1, H]; write this token's k/v at
@@ -225,7 +229,7 @@ class GPTAttention(nn.Layer):
         ctx, kc2, vc2 = run_op('cached_attention', fn,
                                [qkv, k_cache, v_cache])
         out = self.out_proj(ctx)
-        return self.dropout(out), (kc2, vc2)
+        return out, (kc2, vc2)
 
     def forward_paged(self, x, kv, page_tables, seq_lens, q_lens):
         """Serving-engine path: x [B, T, H] (T new tokens per row,
@@ -268,7 +272,7 @@ class GPTAttention(nn.Layer):
                 'paged_attention', fnq,
                 [qkv, k_pages, v_pages, k_scales, v_scales])
             out = self.out_proj(ctx)
-            return self.dropout(out), (kp2, vp2, ks2, vs2)
+            return out, (kp2, vp2, ks2, vs2)
 
         k_pages, v_pages = kv
 
@@ -283,10 +287,16 @@ class GPTAttention(nn.Layer):
         ctx, kp2, vp2 = run_op('paged_attention', fn,
                                [qkv, k_pages, v_pages])
         out = self.out_proj(ctx)
-        return self.dropout(out), (kp2, vp2)
+        return out, (kp2, vp2)
 
 
 class GPTMLP(nn.Layer):
+    """FFN. The fc1 bias-add fuses into the GELU (F.bias_gelu — the
+    Pallas bias+GELU kernel on TPU, the identical jnp expression on
+    CPU), and the trailing hidden dropout moved UP into the decoder
+    layer's residual join (F.dropout_add) so it fuses with the add —
+    same ops, same RNG draw order, kernel-fusable boundaries."""
+
     def __init__(self, config):
         super().__init__()
         init = I.Normal(0.0, config.initializer_range)
@@ -299,14 +309,22 @@ class GPTMLP(nn.Layer):
             config.ffn_hidden_size, config.hidden_size,
             weight_attr=nn.ParamAttr(initializer=out_init),
             input_is_parallel=True)
-        self.dropout = nn.Dropout(config.hidden_dropout)
 
     def forward(self, x):
-        return self.dropout(self.fc2(F.gelu(self.fc1(x), approximate=True)))
+        if self.fc1.bias is not None:
+            h = F.bias_gelu(self.fc1(x, with_bias=False), self.fc1.bias,
+                            approximate=True)
+        else:
+            h = F.gelu(self.fc1(x), approximate=True)
+        return self.fc2(h)
 
 
 class GPTDecoderLayer(nn.Layer):
-    """Pre-LN transformer block."""
+    """Pre-LN transformer block. Both residual joins run through
+    F.dropout_add (the sublayers' trailing hidden dropout fused with
+    the residual add — one Pallas pass on TPU, the identical dropout →
+    add expression and RNG stream on the reference route; eval and
+    dropout=0 degrade to the plain add)."""
 
     def __init__(self, config):
         super().__init__()
@@ -316,24 +334,29 @@ class GPTDecoderLayer(nn.Layer):
         self.ln2 = nn.LayerNorm(config.hidden_size,
                                 epsilon=config.layer_norm_eps)
         self.mlp = GPTMLP(config)
+        self.hidden_dropout = config.hidden_dropout
+
+    def _join(self, sub_out, residual):
+        return F.dropout_add(sub_out, residual, p=self.hidden_dropout,
+                             training=self.training)
 
     def forward(self, x, cache=None, cache_len=None):
         if cache is not None:
             a, new_cache = self.attn(self.ln1(x), cache=cache,
                                      cache_len=cache_len)
-            x = M.add(x, a)
-            x = M.add(x, self.mlp(self.ln2(x)))
+            x = self._join(a, x)
+            x = self._join(self.mlp(self.ln2(x)), x)
             return x, new_cache
-        x = M.add(x, self.attn(self.ln1(x)))
-        x = M.add(x, self.mlp(self.ln2(x)))
+        x = self._join(self.attn(self.ln1(x)), x)
+        x = self._join(self.mlp(self.ln2(x)), x)
         return x
 
     def forward_paged(self, x, kv, page_tables, seq_lens, q_lens):
         a, new_kv = self.attn.forward_paged(self.ln1(x), kv,
                                             page_tables, seq_lens,
                                             q_lens)
-        x = M.add(x, a)
-        x = M.add(x, self.mlp(self.ln2(x)))
+        x = self._join(a, x)
+        x = self._join(self.mlp(self.ln2(x)), x)
         return x, new_kv
 
 

@@ -23,6 +23,10 @@ from ...ops.nn_ops import (  # noqa
     triplet_margin_loss, cosine_embedding_loss, soft_margin_loss,
     multi_margin_loss, ctc_loss, glu, pairwise_distance, pixel_unshuffle,
     channel_shuffle, fold)
+from ...ops.nn_ops import bias_gelu, dropout_add  # noqa — fused Pallas
+# primitives (docs/performance.md#fused-primitives): transformer blocks
+# route through these so the bias+GELU / dropout+residual fusions engage
+# on TPU without model changes
 from ...ops.math import sigmoid, tanh  # noqa
 from ...ops.manip import pad, pixel_shuffle  # noqa
 

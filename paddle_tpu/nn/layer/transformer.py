@@ -113,6 +113,8 @@ class MultiHeadAttention(Layer):
         flash = self._try_flash(q, k, v, attn_mask)
         if flash is not None:
             return flash, None
+        from ...ops.pallas import scaffold as _scaffold
+        _scaffold.record_route('flash_attention', False)
         scale = self.head_dim ** -0.5
         product = M.matmul(M.scale(q, scale), k, transpose_y=True)
         if attn_mask is not None:
@@ -248,15 +250,28 @@ class TransformerEncoderLayer(Layer):
         else:
             src, incremental_cache = self.self_attn(src, src, src, src_mask,
                                                     cache)
-        src = M.add(residual, self.dropout1(src))
+        # residual joins and the FFN bias+GELU route through the fused
+        # Pallas primitives (ops/pallas/fused_elementwise.py): same ops
+        # and RNG stream as dropout-then-add / linear-then-gelu on the
+        # reference route, one kernel pass each on TPU
+        src = F.dropout_add(src, residual, p=self.dropout1.p,
+                            training=self.training,
+                            mode=self.dropout1.mode)
         if not self.normalize_before:
             src = self.norm1(src)
 
         residual = src
         if self.normalize_before:
             src = self.norm2(src)
-        src = self.linear2(self.dropout(self.activation(self.linear1(src))))
-        src = M.add(residual, self.dropout2(src))
+        if self.activation is F.gelu and self.linear1.bias is not None:
+            h = F.bias_gelu(F.linear(src, self.linear1.weight),
+                            self.linear1.bias)
+        else:
+            h = self.activation(self.linear1(src))
+        src = self.linear2(self.dropout(h))
+        src = F.dropout_add(src, residual, p=self.dropout2.p,
+                            training=self.training,
+                            mode=self.dropout2.mode)
         if not self.normalize_before:
             src = self.norm2(src)
         return src if cache is None else (src, incremental_cache)

@@ -38,6 +38,57 @@ def gelu(x, approximate=False, name=None):
     return run_op('gelu', lambda a: jax.nn.gelu(a, approximate=approximate), [x])
 
 
+def bias_gelu(x, bias=None, approximate=False, name=None):
+    """Fused bias-add + GELU (TPP, ops/pallas/fused_elementwise.py):
+    y = gelu(x + bias). Transformer FFNs call this with the first
+    linear's bias left unapplied so the add fuses into the activation
+    kernel on TPU; the reference route runs the identical jnp
+    expression (nn.Linear's `matmul + bias` then `gelu`), so routing is
+    a pure performance choice. bias=None degrades to plain gelu."""
+    x = as_tensor(x)
+    if bias is None:
+        return gelu(x, approximate=approximate)
+    bias = as_tensor(bias)
+    from .pallas import fused_elementwise as _fe
+    if _fe.use_fused('bias_gelu'):
+        fn = lambda a, b: _fe.bias_gelu(a, b, approximate)
+    else:
+        fn = lambda a, b: _fe.bias_gelu_reference(a, b, approximate)
+    return run_op('bias_gelu', fn, [x, bias])
+
+
+def dropout_add(x, residual, p=0.5, training=True,
+                mode='upscale_in_train', name=None):
+    """Fused dropout + residual add (TPP): the transformer residual
+    join `residual + dropout(x)`. Draws the SAME bernoulli key/shape
+    the plain `dropout` op would at this point in the RNG stream, so
+    replacing `add(residual, dropout(x))` call sites is bit-exact on
+    the reference route; the Pallas route fuses select + upscale + add
+    into one pass (ops/pallas/fused_elementwise.py)."""
+    x, residual = as_tensor(x), as_tensor(residual)
+    if not training or p == 0.0:
+        if mode == 'upscale_in_train':
+            return run_op('dropout_add', lambda a, r: a + r,
+                          [x, residual])
+        return run_op('dropout_add', lambda a, r: a * (1 - p) + r,
+                      [x, residual])
+    if mode != 'upscale_in_train':
+        from . import math as _m
+        return _m.add(dropout(x, p=p, training=training, mode=mode),
+                      residual)
+    key = rng.next_key()
+    from .pallas import fused_elementwise as _fe
+    fused = _fe.use_fused('dropout_add')
+
+    def fn(a, r):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        m = keep.astype(jnp.float32)
+        if fused:
+            return _fe.dropout_add(a, r, m, p)
+        return _fe.dropout_add_reference(a, r, m, p)
+    return run_op('dropout_add', fn, [x, residual])
+
+
 def elu(x, alpha=1.0, name=None):
     x = as_tensor(x)
     return run_op('elu', lambda a: jax.nn.elu(a, alpha=alpha), [x])
@@ -159,6 +210,24 @@ def layer_norm(x, normalized_shape=None, weight=None, bias=None, epsilon=1e-05,
         tensors.append(as_tensor(weight))
     if has_b:
         tensors.append(as_tensor(bias))
+
+    # fused Pallas route (ops/pallas/fused_norm.py): the GPT/BERT shape —
+    # last-axis normalization with affine — runs the one-pass fwd/bwd
+    # kernels on TPU (reference jnp below on CPU; FLAGS_fused_layer_norm
+    # forces either way and tests force the kernel under interpret mode)
+    from .pallas import fused_norm as _fln
+    # dtype gate: the reference path PROMOTES when weight/bias are wider
+    # than x (bf16 xhat * fp32 w -> fp32 out); the kernel stores in
+    # x.dtype, so mixed dtypes keep the jnp path
+    fused_ok = (n_axes == 1 and has_w and has_b and x.ndim >= 2
+                and tuple(normalized_shape) == (x.shape[-1],)
+                and tensors[1].data.dtype == x.data.dtype
+                and tensors[2].data.dtype == x.data.dtype)
+    if _fln.use_fused(supported=fused_ok):
+        return run_op('layer_norm',
+                      lambda a, w, b: _fln.fused_layer_norm(a, w, b,
+                                                            epsilon),
+                      tensors)
 
     def fn(*args):
         a = args[0]

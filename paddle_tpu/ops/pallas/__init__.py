@@ -1,9 +1,21 @@
-"""Pallas TPU kernels — custom kernels where XLA fusion isn't enough.
+"""Pallas TPU primitives library (TPP, arXiv:2104.05755).
 
 Reference parity: the role of operators/fused/ (fused_attention,
-fused_softmax_mask, multihead_matmul — N27) — on TPU most fusions are XLA's
-job; Pallas covers the blockwise-algorithm cases (flash attention's online
-softmax) that XLA cannot derive.
+fused_softmax_mask, multihead_matmul — N27) — on TPU most fusions are
+XLA's job; Pallas covers the blockwise-algorithm cases XLA cannot derive
+(flash attention's online softmax) and the bandwidth-bound chains worth
+one-pass treatment (the flat-bucket optimizer step, LayerNorm fwd+bwd,
+bias+GELU, dropout+residual).
+
+Every primitive sits on the shared scaffolding in `scaffold.py`:
+auto-route (Pallas on TPU, reference jnp on CPU, FLAGS_* force),
+interpret-mode CI coverage, block/grid helpers, and routing counters
+(`ptpu_pallas_{kernel,fallback}_invocations_total`). See
+docs/performance.md#fused-primitives for how to add a kernel.
 """
+from . import scaffold
 from . import flash_attention
 from . import paged_attention
+from . import fused_optimizer
+from . import fused_norm
+from . import fused_elementwise

@@ -35,6 +35,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ...core.tensor import Tensor
 from ...core.autograd import run_op
+from . import scaffold
 
 NEG_INF = -1e30
 
@@ -56,22 +57,12 @@ _BLOCK_Q = _env_block('PTPU_FLASH_BLOCK_Q', 512)
 _BLOCK_K = _env_block('PTPU_FLASH_BLOCK_K', 512)
 
 
-def _fit_block(block, L):
-    """Largest power-of-two shrink of `block` that divides L — a block
-    that does not divide L would make pl.ds clamp the last slice start
-    while the in-kernel position iota keeps counting, silently
-    misaligning the mask (true for ANY block size, including the old 256
-    default)."""
-    block = min(block, L)
-    while block > 1 and L % block:
-        block //= 2
-    return block if block >= 1 and L % block == 0 else L
-
-
-def _interpret():
-    # Pallas TPU kernels only lower on TPU; under the CPU test mesh run the
-    # same kernel bodies in interpret mode so CI covers them.
-    return jax.default_backend() == 'cpu'
+# tile fitting + interpret-mode forcing live in the shared scaffolding
+# (scaffold.py) — a block that does not divide L would make pl.ds clamp
+# the last slice start while the in-kernel position iota keeps counting,
+# silently misaligning the mask (true for ANY block size)
+_fit_block = scaffold.fit_block
+_interpret = scaffold.interpret_mode
 
 
 def _flash_fwd_kernel(*refs, block_k, seq_len, scale, causal, has_bias):
@@ -769,6 +760,7 @@ def mha_flash_attention_blhd(q, k, v, key_bias=None, causal=False):
     if key_bias is not None:
         bias_arr = key_bias.data if isinstance(key_bias, Tensor) \
             else jnp.asarray(key_bias)
+    scaffold.record_route('flash_attention', True)
 
     def fn(qa, ka, va):
         B, L, H, D = qa.shape
@@ -808,6 +800,7 @@ def causal_attention(qkv, num_heads, head_dim, dropout=0.0):
             "flash causal_attention does not implement attention-prob "
             "dropout; route through the dense path when attn dropout "
             "is active")
+    scaffold.record_route('flash_attention', True)
     packed = bool(flags.flag('FLAGS_flash_packed_causal', True))
 
     def fn(a):
@@ -840,6 +833,7 @@ def mha_flash_attention(q, k, v, key_bias=None, causal=False):
     if key_bias is not None:
         bias_arr = key_bias.data if isinstance(key_bias, Tensor) \
             else jnp.asarray(key_bias)
+    scaffold.record_route('flash_attention', True)
 
     def fn(qa, ka, va):
         B, H, L, D = qa.shape

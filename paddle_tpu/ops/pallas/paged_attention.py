@@ -56,11 +56,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from . import scaffold
+
 NEG_INF = -1e30
 
-
-def _interpret():
-    return jax.default_backend() == 'cpu'
+# interpret-mode forcing shared with every primitive in this package
+_interpret = scaffold.interpret_mode
 
 
 def _ragged_paged_kernel(pt_ref, ln_ref, q_ref, k_ref, v_ref, *rest,
@@ -245,15 +246,13 @@ def ragged_paged_attention_dense(q, k_pages, v_pages, page_tables,
 
 
 def use_pallas_route():
-    """Auto-selection, mirroring transformer.py's flash routing: the
-    Pallas kernel on TPU, the dense fallback on CPU (interpret-mode
+    """Auto-selection through the shared scaffolding (scaffold.py):
+    the Pallas kernel on TPU, the dense fallback on CPU (interpret-mode
     per-token decode is test machinery, not a serving path). Force with
-    FLAGS_paged_attention_kernel=True/False."""
-    from ...core import flags
-    forced = flags.flag('FLAGS_paged_attention_kernel', None)
-    if forced is not None:
-        return bool(forced)
-    return jax.default_backend() == 'tpu'
+    FLAGS_paged_attention_kernel=True/False; decisions are counted in
+    ptpu_pallas_{kernel,fallback}_invocations_total."""
+    return scaffold.use_kernel('paged_attention',
+                               'FLAGS_paged_attention_kernel')
 
 
 def ragged_paged_attention(q, k_pages, v_pages, page_tables, seq_lens,

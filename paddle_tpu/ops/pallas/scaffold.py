@@ -1,0 +1,194 @@
+"""Shared scaffolding for the Pallas primitives library (TPP,
+arXiv:2104.05755).
+
+Every fused primitive in this package — flash/paged attention, the fused
+optimizer step, LayerNorm, bias+GELU, dropout+residual — shares the same
+skeleton:
+
+  * an AUTO-ROUTE: the Pallas kernel on TPU, a pure-`jnp` reference path
+    on CPU, force-overridable per primitive with a `FLAGS_*` flag (tests
+    force the kernel on the CPU mesh, where it runs under Pallas
+    interpret mode so CI exercises the body that lowers on TPU);
+  * 1-D -> lane-tiled 2-D reshaping for flat-buffer kernels (the fused
+    optimizer step streams [rows, 128] blocks of a bucket shard);
+  * row-grid BlockSpec builders for "grid over row blocks, broadcast
+    row for weights, (1, 1) accumulator" kernels;
+  * routing OBSERVABILITY: every route decision bumps
+    `ptpu_pallas_{kernel,fallback}_invocations_total{primitive=...}`
+    through core.monitor, so a silently-degraded fallback (e.g. a flag
+    typo sending the optimizer step back to the XLA op chain) is
+    visible in StepTelemetry.snapshot()['pallas'] and
+    `tools/health_dump.py pallas`. Routes are decided at TRACE time
+    (the compiled step replays the chosen route every step), so the
+    counters count routing decisions, not per-step executions — same
+    convention as the trace-time ptpu_comm_* byte model.
+
+Adding a kernel on this scaffolding costs the kernel body plus a
+~20-line wrapper: pick a primitive name, call `use_kernel(name, flag)`
+to route, `to_rows`/`from_rows` or `row_spec`/`bcast_spec` for layout,
+and pass `interpret=interpret_mode()` to `pl.pallas_call`
+(docs/performance.md#fused-primitives walks through one).
+"""
+import jax
+import jax.numpy as jnp
+
+from jax.experimental import pallas as pl
+
+# f32 VPU lane width; flat-buffer kernels reshape 1-D buckets to
+# [rows, LANES] so blocks are tile-aligned on TPU
+LANES = 128
+# default rows per grid step for flat-buffer kernels: 256 x 128 f32
+# blocks = 128 KB per operand ref — comfortably inside VMEM with the
+# ~10 operand/output refs the fused optimizer step carries
+ROW_BLOCK = 256
+
+KERNEL = 'kernel'
+FALLBACK = 'fallback'
+
+
+def interpret_mode():
+    """Pallas TPU kernels only lower on TPU; under the CPU test mesh the
+    same kernel bodies run in interpret mode so CI covers them."""
+    return jax.default_backend() == 'cpu'
+
+
+def fit_block(block, n):
+    """Largest power-of-two shrink of `block` that divides `n` (shared by
+    the flash kernels' tile fitting — a block that does not divide the
+    sequence length would silently misalign in-kernel position iotas
+    against pl.ds clamping)."""
+    block = min(block, n)
+    while block > 1 and n % block:
+        block //= 2
+    return block if block >= 1 and n % block == 0 else n
+
+
+def record_route(primitive, used_kernel):
+    """Count one routing decision for `primitive` (trace-time)."""
+    from ...core import monitor as _m
+    name = ('ptpu_pallas_kernel_invocations_total' if used_kernel
+            else 'ptpu_pallas_fallback_invocations_total')
+    _m.counter(
+        name,
+        help='Pallas-primitive routing decisions (trace-time), by '
+             'primitive: kernel = fused Pallas body, fallback = '
+             'reference jnp/XLA path',
+        labelnames=('primitive',)).inc(1, primitive=primitive)
+
+
+def use_kernel(primitive, flag=None, supported=True, record=True):
+    """The flash/paged-style auto-route: Pallas kernel on TPU, reference
+    path on CPU; `flag` (a FLAGS_* name, None = auto) forces either way;
+    `supported=False` pins the fallback (unsupported shape/optimizer)
+    regardless of the flag. Records the decision unless `record=False`.
+    """
+    use = False
+    if supported:
+        forced = None
+        if flag is not None:
+            from ...core import flags as _flags
+            forced = _flags.flag(flag, None)
+        use = bool(forced) if forced is not None \
+            else jax.default_backend() == 'tpu'
+    if record:
+        record_route(primitive, use)
+    return use
+
+
+# ---------------------------------------------------------------------------
+# layout helpers
+# ---------------------------------------------------------------------------
+def to_rows(flat, block_rows=ROW_BLOCK, lanes=LANES):
+    """Zero-pad a 1-D array and reshape to [rows, lanes] with rows a
+    multiple of `block_rows` — the flat-buffer kernel layout. Zero pad
+    is safe for every current kernel: stats add 0, optimizer updates of
+    (p=0, g=0, m=0) stay 0, and callers slice the pad off with
+    `from_rows`."""
+    n = flat.shape[0]
+    rows = -(-n // lanes)
+    # zero-size inputs still get one (all-pad) block so the grid is
+    # never empty; callers slice the pad off, so the result is exact
+    rows = max(-(-rows // block_rows) * block_rows, block_rows)
+    pad = rows * lanes - n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    return flat.reshape(rows, lanes)
+
+
+def from_rows(arr2d, n):
+    """Inverse of `to_rows`: back to 1-D, pad dropped."""
+    return arr2d.reshape(-1)[:n]
+
+
+def pad_rows(x2d, block_rows):
+    """Zero-pad a [R, N] array so R divides into `block_rows` blocks
+    (R = 0 still yields one all-pad block — the grid is never empty;
+    pad rows are inert in every kernel and sliced off by callers)."""
+    r = x2d.shape[0]
+    rows = max(-(-r // block_rows) * block_rows, block_rows)
+    if rows != r:
+        x2d = jnp.concatenate(
+            [x2d, jnp.zeros((rows - r,) + x2d.shape[1:], x2d.dtype)])
+    return x2d
+
+
+def pick_block_rows(ncols, want):
+    """Rows per grid block for a [R, ncols] kernel, shrunk so one block
+    stays around `want` x LANES elements regardless of the feature dim
+    (a fixed row count would grow VMEM use linearly with ncols — at
+    ffn_hidden 32k a 128-row fp32 block is 16 MB per ref). Floor of 8
+    keeps f32 sublane tiling."""
+    return min(want, max(8, (want * LANES) // max(ncols, 1)))
+
+
+def row_spec(block_rows, ncols):
+    """Grid-blocked rows: program i sees rows [i*block_rows, ...)."""
+    return pl.BlockSpec((block_rows, ncols), lambda i: (i, 0))
+
+
+def bcast_spec(nrows, ncols):
+    """Same block for every program (weights, packed scalars)."""
+    return pl.BlockSpec((nrows, ncols), lambda i: (0, 0))
+
+
+def acc_spec():
+    """(1, 1) accumulator output revisited by every program (the
+    sequential TPU grid keeps it resident; interpret mode matches)."""
+    return pl.BlockSpec((1, 1), lambda i: (0, 0))
+
+
+# ---------------------------------------------------------------------------
+# telemetry
+# ---------------------------------------------------------------------------
+def routes_snapshot():
+    """{primitive: {'kernel': n, 'fallback': n}} from the monitor
+    counters (JSON-ready; bench legs and StepTelemetry embed it)."""
+    from ...core import monitor as _m
+    reg = _m.metrics()
+    out = {}
+    for name, key in (('ptpu_pallas_kernel_invocations_total', KERNEL),
+                      ('ptpu_pallas_fallback_invocations_total',
+                       FALLBACK)):
+        m = reg.get(name)
+        if m is None:
+            continue
+        for labels, child in m._series().items():
+            prim = labels[0] if labels else ''
+            out.setdefault(prim, {KERNEL: 0, FALLBACK: 0})[key] = \
+                int(child.value())
+    return out
+
+
+def active_primitives():
+    """Primitives whose Pallas kernel route was taken at least once —
+    the bench record's `detail.fused_primitives` evidence list."""
+    return sorted(p for p, c in routes_snapshot().items()
+                  if c.get(KERNEL, 0) > 0)
+
+
+def snapshot():
+    """StepTelemetry.snapshot()['pallas'] payload."""
+    routes = routes_snapshot()
+    if not routes:
+        return None
+    return {'routes': routes, 'active': active_primitives()}

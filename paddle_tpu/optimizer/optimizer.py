@@ -29,6 +29,12 @@ class Optimizer:
     # (core/bucketing.py) are bit-equivalent to per-param application.
     # Lamb/LARS/DGC override to False and keep the per-param path.
     _elementwise = False
+    # True when `update` is additionally pure jnp elementwise code with
+    # only SCALAR side states (beta powers), so the fused one-pass
+    # Pallas optimizer-step kernel (ops/pallas/fused_optimizer.py) can
+    # trace the rule directly into its body. Untagged optimizers keep
+    # the XLA op chain (counted as a fallback route).
+    _pallas_fusible = False
 
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, name=None, multi_precision=True):
@@ -393,6 +399,7 @@ class SGD(Optimizer):
     """Parity: operators/optimizers/sgd_op."""
 
     _elementwise = True
+    _pallas_fusible = True
 
     def __init__(self, learning_rate=0.001, parameters=None,
                  weight_decay=None, grad_clip=None, multi_precision=True,
@@ -409,6 +416,7 @@ class Momentum(Optimizer):
     """Parity: operators/optimizers/momentum_op (use_nesterov supported)."""
 
     _elementwise = True
+    _pallas_fusible = True
 
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  use_nesterov=False, weight_decay=None, grad_clip=None,
@@ -480,6 +488,7 @@ class DGCMomentumOptimizer(Momentum):
 
 class Adagrad(Optimizer):
     _elementwise = True
+    _pallas_fusible = True
 
     def __init__(self, learning_rate, epsilon=1e-06, parameters=None,
                  weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
@@ -501,6 +510,7 @@ class Adagrad(Optimizer):
 
 class RMSProp(Optimizer):
     _elementwise = True
+    _pallas_fusible = True
 
     def __init__(self, learning_rate, rho=0.95, epsilon=1e-06, momentum=0.0,
                  centered=False, parameters=None, weight_decay=None,
@@ -537,6 +547,7 @@ class Adam(Optimizer):
     """Parity: operators/optimizers/adam_op (with beta-power accumulators)."""
 
     _elementwise = True
+    _pallas_fusible = True
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-08, parameters=None, weight_decay=None,
@@ -614,6 +625,7 @@ class AdamW(Adam):
 
 class Adamax(Optimizer):
     _elementwise = True
+    _pallas_fusible = True
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-08, parameters=None, weight_decay=None,
@@ -689,6 +701,7 @@ class Adadelta(Optimizer):
     accumulated-update RMS ratio rule."""
 
     _elementwise = True
+    _pallas_fusible = True
 
     def __init__(self, learning_rate=0.001, epsilon=1e-06, rho=0.95,
                  parameters=None, weight_decay=None, grad_clip=None,
@@ -718,6 +731,7 @@ class DecayedAdagrad(Optimizer):
     """Parity: operators/optimizers/decayed_adagrad_op."""
 
     _elementwise = True
+    _pallas_fusible = True
 
     def __init__(self, learning_rate, decay=0.95, epsilon=1e-06,
                  parameters=None, weight_decay=None, grad_clip=None,

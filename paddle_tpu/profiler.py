@@ -838,6 +838,14 @@ class StepTelemetry:
             snap['serve'] = _sm.serve_snapshot() or None
         except Exception:
             snap['serve'] = None
+        # Pallas primitive routing (ptpu_pallas_* counters): which fused
+        # kernels vs reference fallbacks the traces picked — a silently
+        # degraded route shows up here (docs/performance.md#fused-primitives)
+        try:
+            from .ops.pallas import scaffold as _scaffold
+            snap['pallas'] = _scaffold.snapshot()
+        except Exception:
+            snap['pallas'] = None
         return snap
 
 

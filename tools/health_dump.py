@@ -31,15 +31,22 @@ the human post-mortem:
     scheduler-timeline tail, pool census — rendered via the default
     ARTIFACT.json path.
 
+  * Pallas fused-primitive routing (`pallas` subcommand):
+    ptpu_pallas_{kernel,fallback}_invocations_total per primitive —
+    which fused kernels the compiled steps actually picked vs
+    reference fallbacks (docs/performance.md#fused-primitives).
+
 Usage:
     python tools/health_dump.py ARTIFACT.json [--json] [--level ERROR]
     python tools/health_dump.py numerics ARTIFACT.json [--json]
     python tools/health_dump.py comm SNAPSHOT.json [--json]
     python tools/health_dump.py serve SNAPSHOT.json [--json]
+    python tools/health_dump.py pallas SNAPSHOT.json [--json]
     python tools/health_dump.py --selftest           # CI smoke
     python tools/health_dump.py numerics --selftest  # numerics CI smoke
     python tools/health_dump.py comm --selftest      # comm CI smoke
     python tools/health_dump.py serve --selftest     # serving CI smoke
+    python tools/health_dump.py pallas --selftest    # pallas CI smoke
 """
 import argparse
 import json
@@ -655,6 +662,121 @@ def serve_main(argv):
     return 0
 
 
+def _find_pallas(doc):
+    """Locate the pallas routing section in a StepTelemetry snapshot or
+    bench record ({'routes': {...}, 'active': [...]})."""
+    if not isinstance(doc, dict):
+        return None
+    if 'routes' in doc and 'active' in doc:
+        return doc
+    for key in ('pallas', 'fused_primitives', 'telemetry', 'detail'):
+        sub = doc.get(key)
+        found = _find_pallas(sub)
+        if found is not None:
+            return found
+    if 'legs' in doc:
+        for leg in (doc['legs'] or {}).values():
+            found = _find_pallas(leg)
+            if found is not None:
+                return found
+    return None
+
+
+def render_pallas(pallas):
+    """Human view of the Pallas primitive routing counters — which
+    fused kernels the traces picked vs reference fallbacks, so a
+    silently-degraded route (e.g. the fused optimizer step falling back
+    to the XLA chain) is one glance away."""
+    out = ['Pallas fused primitives (trace-time routing decisions)']
+    routes = pallas.get('routes') or {}
+    for prim in sorted(routes):
+        c = routes[prim]
+        k, f = int(c.get('kernel', 0)), int(c.get('fallback', 0))
+        verdict = 'KERNEL' if k and not f else \
+            ('fallback' if f and not k else 'mixed')
+        out.append(f'  {prim:<18} kernel {k:<6} fallback {f:<6} '
+                   f'[{verdict}]')
+    active = pallas.get('active') or []
+    out.append('active (kernel route taken at least once): '
+               + (', '.join(active) if active else '(none)'))
+    return '\n'.join(out)
+
+
+def _pallas_selftest():
+    """CI smoke: force the fused routes on the CPU mesh (interpret
+    mode), run one fused primitive of each family, and assert the
+    routing counters + renderer show them as active."""
+    _repo_root_on_path()
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    import jax.numpy as jnp
+    from paddle_tpu.core import flags
+    from paddle_tpu.core import bucketing as B
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.ops.pallas import fused_norm, scaffold
+    from paddle_tpu.profiler import StepTelemetry
+    import paddle_tpu as paddle
+
+    flags.set_flags({'FLAGS_fused_optimizer': True,
+                     'FLAGS_fused_layer_norm': True})
+    try:
+        x = jnp.ones((8, 33), jnp.float32)
+        fused_norm.use_fused()          # route decision
+        fused_norm.fused_layer_norm(x, jnp.ones((33,)),
+                                    jnp.zeros((33,)), 1e-5)
+        opt = paddle.optimizer.AdamW(learning_rate=0.01, parameters=[])
+        p = jnp.ones((200,), jnp.float32)
+        st = {k: jnp.asarray(v) for k, v in opt.init_state(
+            Tensor(jnp.zeros((200,), jnp.float32))).items()}
+        assert B.shard_update(opt, p, p * 0.1, st,
+                              jnp.asarray(0.01))[0].shape == (200,)
+        B.grad_stats(p)
+    finally:
+        flags.set_flags({'FLAGS_fused_optimizer': None,
+                         'FLAGS_fused_layer_norm': None})
+    snap = StepTelemetry(publish=False).snapshot()
+    pallas = _find_pallas({'telemetry': {'pallas': snap['pallas']}})
+    assert pallas, 'StepTelemetry snapshot carries no pallas section'
+    for prim in ('layer_norm', 'optimizer_step', 'grad_stats'):
+        assert prim in pallas['active'], (prim, pallas)
+    text = render_pallas(pallas)
+    assert 'optimizer_step' in text and 'KERNEL' in text, text
+    assert 'active' in text, text
+    print(text)
+    print('health_dump pallas selftest: OK')
+    return 0
+
+
+def pallas_main(argv):
+    ap = argparse.ArgumentParser(
+        prog='health_dump.py pallas',
+        description='render ptpu_pallas_* fused-primitive routing '
+                    'counters from a StepTelemetry snapshot or bench '
+                    'record (docs/performance.md#fused-primitives)')
+    ap.add_argument('artifact', nargs='?',
+                    help='StepTelemetry snapshot / bench record JSON')
+    ap.add_argument('--json', action='store_true')
+    ap.add_argument('--selftest', action='store_true')
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return _pallas_selftest()
+    if not args.artifact:
+        ap.error('artifact path required (or --selftest)')
+    with open(args.artifact) as f:
+        doc = json.load(f)
+    pallas = _find_pallas(doc)
+    if pallas is None:
+        raise ValueError(
+            'no pallas routing telemetry in this artifact (expected a '
+            'StepTelemetry snapshot with a pallas section or a bench '
+            'record with detail.fused_primitives — '
+            'docs/performance.md#fused-primitives)')
+    if args.json:
+        print(json.dumps(pallas, indent=2))
+    else:
+        print(render_pallas(pallas))
+    return 0
+
+
 def numerics_main(argv):
     ap = argparse.ArgumentParser(
         prog='health_dump.py numerics',
@@ -682,6 +804,8 @@ def main(argv=None):
         return comm_main(argv[1:])
     if argv and argv[0] == 'serve':
         return serve_main(argv[1:])
+    if argv and argv[0] == 'pallas':
+        return pallas_main(argv[1:])
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument('artifact', nargs='?',
                     help='hang/OOM report JSON or workerlog .jsonl')

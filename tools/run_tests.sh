@@ -13,6 +13,11 @@
 #                      int8-KV serving parity + capacity, weight-only-
 #                      quantized Predictor decode, int8 comm gauge
 #                      breakdown (ISSUE 7)
+#   --pallas-selftest - fused Pallas primitives library: interpret-mode
+#                      parity for the fused optimizer step / LayerNorm /
+#                      bias+GELU / dropout+residual kernels vs jnp
+#                      references, fused-vs-unfused engine equivalence,
+#                      routing-counter CLI smoke (ISSUE 8)
 set -e
 cd "$(dirname "$0")/.."
 TIER="${1:-all}"
@@ -21,6 +26,7 @@ case "$TIER" in
             tests/test_layers_optim.py tests/test_controlflow_dist.py \
             tests/test_profiler_trace.py tests/test_diagnostics.py \
             tests/test_numerics.py tests/test_bucketing.py \
+            tests/test_fused_primitives.py \
             tests/test_serving.py tests/test_serving_trace.py -q
           # observability tooling smoke: tracer -> export -> summary CLI
           python tools/trace_summary.py --selftest
@@ -31,7 +37,9 @@ case "$TIER" in
           # comm smoke: bucket gauges -> snapshot -> render
           python tools/health_dump.py comm --selftest
           # serving smoke: engine -> serve gauges -> render
-          python tools/health_dump.py serve --selftest ;;
+          python tools/health_dump.py serve --selftest
+          # pallas smoke: fused primitives -> route counters -> render
+          python tools/health_dump.py pallas --selftest ;;
   dist)   python -m pytest tests/test_distributed.py \
             tests/test_launch_elastic.py tests/test_bert_zero_asp.py -q ;;
   native) python -m pytest tests/test_native.py tests/test_ps.py -q ;;
@@ -52,6 +60,12 @@ case "$TIER" in
           python -m pytest tests/test_serving.py -q \
             -k 'int8 or quant'
           python tools/health_dump.py comm --selftest ;;
+  --pallas-selftest)
+          # fused-primitive parity (interpret-mode kernels vs jnp
+          # references, incl. grad checks and the engine-step
+          # equivalences) + routing-counter rendering
+          python -m pytest tests/test_fused_primitives.py -q
+          python tools/health_dump.py pallas --selftest ;;
   --serve-selftest)
           # serving engine end to end on the CPU fallback path (paged
           # pool + continuous batching + request observatory), then the
@@ -67,6 +81,7 @@ case "$TIER" in
           python tools/health_dump.py --selftest
           python tools/health_dump.py numerics --selftest
           python tools/health_dump.py comm --selftest
-          python tools/health_dump.py serve --selftest ;;
-  *) echo "usage: $0 [fast|dist|native|e2e|all|--comm-selftest|--serve-selftest|--quant-selftest]"; exit 1 ;;
+          python tools/health_dump.py serve --selftest
+          python tools/health_dump.py pallas --selftest ;;
+  *) echo "usage: $0 [fast|dist|native|e2e|all|--comm-selftest|--serve-selftest|--quant-selftest|--pallas-selftest]"; exit 1 ;;
 esac
