@@ -567,6 +567,76 @@ def bench_gpt_serve():
     dense_cache_tokens = n_req * cfg.max_seq_len
     paged_tokens = st['pool']['high_water'] * page_size
     eng.shutdown()
+
+    # -- shared-prefix stream (ISSUE 9): N requests with a common
+    # system prompt, served by the PR-5 config (no prefix cache, no
+    # speculation) and by the prefix+spec engine. TTFT should drop by
+    # the cached prefill chunks, decode tokens/sec should rise by the
+    # accepted drafts per verify dispatch — greedy outputs identical.
+    sys_len = 256 if on_tpu else 16
+    spec_k = 4
+    n_shared = 8 if on_tpu else 4
+    system = list(rng.randint(1, cfg.vocab_size, sys_len))
+    shared_prompts = [system + list(rng.randint(
+        1, cfg.vocab_size, int(n)))
+        for n in rng.randint(lo, hi + 1, n_shared)]
+    pages_shared = -(-(sys_len + hi + max_new) // page_size)
+
+    def _run_shared(**knobs):
+        e = ServingEngine(model, ServingConfig(
+            page_size=page_size, max_batch_size=batch,
+            prefill_chunk=chunk, max_pages_per_seq=pages_shared,
+            **knobs))
+        # warm every compiled shape this engine will hit: prefill +
+        # decode via the stream head, the verify shape via a
+        # repetitive prompt the n-gram proposer fires on
+        e.generate([shared_prompts[0]], max_new_tokens=2, top_k=0)
+        if knobs.get('spec_k'):
+            e.generate([[7, 8, 9] * 4], max_new_tokens=4, top_k=0)
+        e.reset_stats()
+        t0 = time.time()
+        outs = e.generate(shared_prompts, max_new_tokens=max_new,
+                          top_k=0)
+        dt = time.time() - t0
+        toks = sum(len(o) - len(p)
+                   for o, p in zip(outs, shared_prompts))
+        stl = e.stats()
+        ttft = percentile_of(
+            [r['ttft_s'] for r in e.request_table().values()], 50)
+        e.shutdown()
+        return {
+            'tokens_per_sec': toks / dt,
+            'decode_tokens_per_sec': stl['decode_tokens_per_sec'],
+            'ttft_p50_ms': (round(ttft * 1000.0, 3)
+                            if ttft is not None else None),
+            'prefill_tokens': stl['prefill_tokens_total'],
+            'decode_steps': stl['decode_steps_total'],
+            'decode_tokens': stl['decode_tokens_total'],
+            'prefix_hits': stl['prefix_hits_total'],
+            'prefix_hit_tokens': stl['prefix_hit_tokens_total'],
+            'spec_proposed': stl['spec_proposed_tokens_total'],
+            'spec_accepted': stl['spec_accepted_tokens_total'],
+            'spec_acceptance_rate': stl['spec_acceptance_rate'],
+        }, outs
+
+    base_rec, base_outs = _run_shared(prefix_cache=False, spec_k=0)
+    opt_rec, opt_outs = _run_shared(prefix_cache=True, spec_k=spec_k)
+    shared_prefix = {
+        'requests': n_shared,
+        'system_prompt_tokens': sys_len,
+        'spec_k': spec_k,
+        'baseline_pr5': base_rec,
+        'prefix_spec': opt_rec,
+        'outputs_identical': base_outs == opt_outs,
+        'ttft_speedup_vs_pr5':
+            (base_rec['ttft_p50_ms'] / opt_rec['ttft_p50_ms']
+             if base_rec['ttft_p50_ms'] and opt_rec['ttft_p50_ms']
+             else None),
+        'decode_speedup_vs_pr5':
+            (opt_rec['decode_tokens_per_sec']
+             / base_rec['decode_tokens_per_sec']
+             if base_rec['decode_tokens_per_sec'] else None),
+    }
     return {
         'serve_tokens_per_sec': serve_tokens / serve_dt,
         'sequential_tokens_per_sec': seq_tps,
@@ -591,6 +661,7 @@ def bench_gpt_serve():
         'kv_bytes_per_token': st['pool']['bytes_per_token'],
         'prompt_lens': [int(n) for n in lens],
         'kv_tokens_dense_vs_paged': [dense_cache_tokens, paged_tokens],
+        'shared_prefix': shared_prefix,
         'backend': jax.default_backend(),
     }
 

@@ -38,6 +38,17 @@ Layouts:
 Query t of row b sits at global position seq_lens[b] - q_lens[b] + t and
 attends keys at positions <= its own (causal) and < seq_lens[b].
 
+Multi-query verify rows (ISSUE 9, speculative decoding): the serving
+engine's [max_batch, spec_k+1] verify step feeds each greedy request's
+last token plus its k draft tokens as one ragged row — q_len = 1+k,
+seq_len = context+k. That is exactly the chunked-prefill shape this
+kernel (and the dense fallback) already serves: the
+causal-within-sequence mask scores every draft against the real
+context plus the earlier drafts in ONE dispatch, so no verify-specific
+kernel body exists. Rejected drafts leave stale K/V in their slots;
+the seq_len mask keeps them invisible until the step that overwrites
+them (engine._decode_step documents the rollback invariant).
+
 Quantized pages (ISSUE 7, `kv_dtype='int8'`): k_pages/v_pages are int8
 and carry sibling fp32 scale buffers `[N_pages, page_size, H]` — one
 abs-max scale per (token slot, head). `write_kv_pages_quantized`

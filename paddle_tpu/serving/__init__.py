@@ -4,8 +4,13 @@ The inference counterpart of the fleet training engines: a block-paged
 KV-cache pool shared by every in-flight request (`kv_pool.py`), a
 continuous-batching scheduler that admits / chunk-prefills / batch-
 decodes / preempts requests across fixed-shape jitted steps
-(`scheduler.py` + `engine.py`), and the ragged paged-attention Pallas
-kernel (`ops/pallas/paged_attention.py`) those steps call. Metrics
+(`scheduler.py` + `engine.py`), copy-on-write prefix caching over
+refcounted pages (requests sharing a system prompt map the same
+physical pages and skip its prefill) plus n-gram speculative decoding
+(a `[max_batch, spec_k+1]` verify step advances greedy requests
+several tokens per dispatch, token-identically), and the ragged
+paged-attention Pallas kernel (`ops/pallas/paged_attention.py`) those
+steps call. Metrics
 publish as `ptpu_serve_*` gauges + SLO percentile histograms through
 core.monitor (`metrics.py`), surfaced in
 `profiler.StepTelemetry.snapshot()['serve']` and rendered by

@@ -1,12 +1,24 @@
 """Serving engine: fixed-shape jitted steps over the paged KV pool.
 
-Two compiled step shapes serve every request mix (the continuous-
+Three compiled step shapes serve every request mix (the continuous-
 batching contract — the device never recompiles as traffic changes):
 
   * chunked prefill  — B=1, T=prefill_chunk: one prompt chunk streams
     through the model, its K/V landing in the sequence's pool pages;
   * batched decode   — B=max_batch_size, T=1: every RUNNING request
-    advances one token in ONE dispatch.
+    advances one token in ONE dispatch;
+  * batched verify   — B=max_batch_size, T=spec_k+1 (only with
+    speculative decoding, spec_k > 0): each greedy request carries its
+    n-gram-proposed draft tokens as extra ragged query rows — the same
+    causal-within-sequence masking chunked prefill uses — and the
+    accept-longest-agreeing-prefix rule plus a bonus token advances a
+    request up to spec_k+1 tokens per dispatch, token-identical to the
+    one-token path.
+
+Prefix caching (ISSUE 9) rides in the pool: prompts sharing a prefix
+map the same physical pages (kv_pool.py refcounts + hash-chained
+index), so cache hits skip whole prefill chunks and TTFT drops to the
+uncached tail's cost.
 
 Both run `GPTModel.forward_paged` (ragged paged attention +
 `write_kv_pages` scatter) under `jit` with the KV pool donated, sample
@@ -74,6 +86,21 @@ class ServingConfig:
                      bandwidth, not total HBM; drop the model's params
                      yourself (or load via load_quantized_predictor)
                      to reclaim the memory
+    prefix_cache     copy-on-write prefix sharing over the paged pool
+                     (default on): requests whose prompts share a
+                     prefix map the same physical pages and skip the
+                     prefill compute for them; granularity is one page
+                     (page_size tokens) — docs/serving.md#prefix-cache
+    spec_k           speculative decoding draft length (default 0 =
+                     off): an n-gram proposer drafts up to k tokens
+                     per greedy request and a third compiled step
+                     shape [max_batch, spec_k+1] verifies them all in
+                     ONE dispatch (accept-longest-agreeing-prefix +
+                     bonus token; greedy output is token-identical to
+                     spec_k=0 — docs/serving.md#speculative-decode)
+    spec_ngram       proposer match length: the trailing n-gram looked
+                     up in the request's own token history (prompt +
+                     generated) to source draft continuations
     seed             device sampling stream seed
     trace            per-request lifecycle journal on/off (host-only
                      bookkeeping; default on — docs/serving.md)
@@ -91,13 +118,16 @@ class ServingConfig:
 
     def __init__(self, page_size=16, max_batch_size=4, num_pages=None,
                  max_pages_per_seq=None, prefill_chunk=32,
-                 kv_dtype=None, weight_dtype=None, seed=0, trace=True,
+                 kv_dtype=None, weight_dtype=None, prefix_cache=True,
+                 spec_k=0, spec_ngram=2, seed=0, trace=True,
                  trace_events_per_request=512, trace_requests=512,
                  timeline_capacity=2048, request_deadline_s=None,
                  deadline_action='report', report_dir=None, clock=None):
         if page_size <= 0 or max_batch_size <= 0 or prefill_chunk <= 0:
             raise ValueError("page_size, max_batch_size and "
                              "prefill_chunk must be positive")
+        if spec_k < 0 or spec_ngram < 1:
+            raise ValueError("spec_k must be >= 0 and spec_ngram >= 1")
         if deadline_action not in ('report', 'abort'):
             raise ValueError("deadline_action must be 'report' or "
                              "'abort'")
@@ -112,6 +142,9 @@ class ServingConfig:
             raise ValueError("weight_dtype must be None or 'int8', got "
                              f"{weight_dtype!r}")
         self.weight_dtype = weight_dtype
+        self.prefix_cache = bool(prefix_cache)
+        self.spec_k = int(spec_k)
+        self.spec_ngram = int(spec_ngram)
         self.seed = int(seed)
         self.trace = bool(trace)
         self.trace_events_per_request = int(trace_events_per_request)
@@ -148,7 +181,7 @@ class ServingEngine:
         self.pool = KVPagePool(
             num_pages, ps, num_layers=mcfg.num_layers,
             num_heads=attn0.local_heads, head_dim=attn0.head_dim,
-            dtype=dtype)
+            dtype=dtype, prefix_cache=config.prefix_cache)
         self.pool.materialize()
         self._clock = config.clock or time.perf_counter
         self.scheduler = Scheduler(config.max_batch_size,
@@ -197,6 +230,11 @@ class ServingEngine:
         self._util_sum = 0.0
         self._prefill_tokens = 0
         self._prefill_chunks = 0
+        # speculative decoding accounting (draft tokens proposed by
+        # the n-gram proposer vs accepted by the verify step)
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._spec_steps = 0
         self._submitted = 0
         self._completed = 0
         self._aborted = 0
@@ -287,18 +325,17 @@ class ServingEngine:
                 prefill_tokens += self._prefill_chunk_step(req)
         running = [r for r in self.scheduler.slots
                    if r is not None and r.state == RequestState.RUNNING]
-        decode_tokens = 0
+        decode_slots = decode_tokens = 0
         if running:
             with RecordEvent('serve::decode', event_type='serve'):
-                decode_tokens = self._decode_step()
+                # POST-preemption counts: _decode_step may preempt
+                # members of `running` under pool pressure; slots are
+                # the surviving rows, tokens what they emitted (> slots
+                # when speculative decoding accepts drafts)
+                decode_slots, decode_tokens = self._decode_step()
         self.timeline.record(
             t=self._clock(),
-            # POST-preemption count: _decode_step may preempt members
-            # of `running` under pool pressure, and each surviving row
-            # decodes exactly one token — so decode_tokens IS the
-            # occupied-slot count, matching the engine's own
-            # batch_occupancy accounting on pressure iterations
-            decode_slots_occupied=decode_tokens,
+            decode_slots_occupied=decode_slots,
             decode_slots=self.config.max_batch_size,
             prefill_tokens=prefill_tokens,
             decode_tokens=decode_tokens,
@@ -319,14 +356,24 @@ class ServingEngine:
         pool doesn't allocate until the prefill step runs, so the
         budget, not pool.free_pages, is what shrinks here) — admitting
         more than the pool can first-chunk just manufactures
-        preemption churn."""
+        preemption churn.
+
+        Prefix-cache hits shrink the bill (ISSUE 9 satellite: the
+        PR-5 estimate over-counted and refused admissible requests):
+        pages a live sibling already maps cost the budget NOTHING,
+        and cached-resurrect pages cost a page but no prefill compute
+        — so the need is the first chunk's page-table size minus the
+        live-shared pages."""
         sched = self.scheduler
         budget = self.pool.free_pages
         n_admitted = 0
         while sched.waiting and None in sched.slots:
-            need = self.pool.pages_for(
-                min(len(sched.waiting[0].tokens),
-                    self.config.prefill_chunk))
+            head = sched.waiting[0]
+            cached, live, _ = self.pool.peek_prefix(
+                head.tokens, limit=len(head.tokens) - 1)
+            need = max(self.pool.pages_for(
+                min(len(head.tokens),
+                    cached + self.config.prefill_chunk)) - live, 0)
             if budget < need:
                 break
             got = sched.admit(limit=1)
@@ -345,7 +392,11 @@ class ServingEngine:
 
     def _ensure_or_preempt(self, req, n_tokens):
         """Grow req's pages, preempting the youngest other in-flight
-        request until the allocation fits."""
+        request until the allocation fits. Refcount-aware: a victim's
+        release only reclaims pages no live sibling still maps — a
+        victim whose pages are all shared frees nothing, so the loop
+        keeps preempting (older victims) rather than spinning on one,
+        and a sharer's prefix is never yanked out from under it."""
         while True:
             try:
                 self.pool.ensure_capacity(req.id, n_tokens)
@@ -364,17 +415,20 @@ class ServingEngine:
                             tokens_generated=len(victim.generated))
 
     # -- jitted steps --------------------------------------------------------
-    def _step_fn(self, B, T, sample):
+    def _step_fn(self, B, T, sample, verify=False):
         """sample=False compiles a greedy-argmax step — the common
         serving mode must not pay _device_sample's full-vocab sort on
-        every decode dispatch (top_ks is traced, XLA can't elide it)."""
-        fn = self._step_fns.get((B, T, sample))
+        every decode dispatch (top_ks is traced, XLA can't elide it).
+        verify=True compiles the speculative-decode step shape
+        [max_batch, spec_k+1]: greedy argmax at EVERY query position
+        (the per-draft verdicts) instead of just the last."""
+        fn = self._step_fns.get((B, T, sample, verify))
         if fn is None:
-            fn = self._build_step(B, T, sample)
-            self._step_fns[(B, T, sample)] = fn
+            fn = self._build_step(B, T, sample, verify)
+            self._step_fns[(B, T, sample, verify)] = fn
         return fn
 
-    def _build_step(self, B, T, sample):
+    def _build_step(self, B, T, sample, verify=False):
         jax, jnp = self._jax, self._jnp
         model = self.model
         from ..core.tensor import Tensor
@@ -407,10 +461,33 @@ class ServingEngine:
                 h, new_kv = model.gpt.forward_paged(
                     Tensor(tokens), Tensor(pos), cts, page_tables,
                     seq_lens, q_lens)
+                w = model.gpt.embeddings.word_embeddings.weight
+                if verify:
+                    # multi-query verify: greedy next-token at every
+                    # draft position in one dispatch; padding positions
+                    # (t >= q_len) produce garbage the host ignores.
+                    # Rows that sample ride along via an extra column
+                    # so the step still costs ONE host fetch.
+                    logits_all = jnp.einsum(
+                        'bth,vh->btv', h.data, w.data,
+                        preferred_element_type=jnp.float32)
+                    nxt = jnp.argmax(logits_all, axis=-1) \
+                        .astype(jnp.int32)                  # [B, T]
+                    if sample:
+                        idx = jnp.clip(q_lens - 1, 0,
+                                       T - 1).astype(jnp.int32)
+                        last = jnp.take_along_axis(
+                            logits_all, idx[:, None, None],
+                            axis=1)[:, 0, :]
+                        samp = _device_sample(
+                            last.astype(jnp.float32), key, temps,
+                            top_ks)
+                        nxt = jnp.concatenate([nxt, samp[:, None]], 1)
+                    return nxt, [tuple(t.data for t in c)
+                                 for c in new_kv]
                 idx = jnp.clip(q_lens - 1, 0, T - 1).astype(jnp.int32)
                 h_last = jnp.take_along_axis(
                     h.data, idx[:, None, None], axis=1)[:, 0, :]
-                w = model.gpt.embeddings.word_embeddings.weight
                 logits = jnp.einsum(
                     'bh,vh->bv', h_last, w.data,
                     preferred_element_type=jnp.float32)
@@ -451,6 +528,17 @@ class ServingEngine:
                             # pool (and preempt live work) for a request
                             # that isn't scheduled
         toks = req.tokens
+        if req.prefilled == 0 and self.pool.prefix_cache:
+            # first chunk of a fresh admit (or a resume): map the
+            # longest indexed prefix — full pages only, capped one
+            # short of the context so the step still computes the
+            # logits the first sampled token needs
+            cached = self.pool.match_and_map(req.id, toks,
+                                             limit=len(toks) - 1)
+            if cached:
+                req.prefilled = cached
+                self._trace(req, 'prefix_hit', cached_tokens=cached,
+                            pages=len(self.pool.page_table(req.id)))
         start = req.prefilled
         n = min(C, len(toks) - start)
         self._ensure_or_preempt(req, start + n)
@@ -472,6 +560,9 @@ class ServingEngine:
         req.prefilled = start + n
         self._prefill_tokens += n
         self._prefill_chunks += 1
+        # every prefilled token's K/V is resident: index the newly
+        # completed full pages so siblings (and our own resume) share
+        self.pool.register_prefix(req.id, toks, req.prefilled)
         self._trace(req, 'prefill_chunk', tokens=n, prefilled=start + n,
                     pages=len(self.pool.page_table(req.id)))
         if req.prefilled == len(toks):
@@ -496,15 +587,45 @@ class ServingEngine:
         return n
 
     def _decode_step(self):
+        """One batched decode dispatch. With spec_k=0 every running
+        request advances exactly one token ([B, 1] step). With spec_k
+        > 0, greedy requests whose history yields an n-gram proposal
+        carry up to k draft tokens into the [B, spec_k+1] verify step:
+        every draft position's greedy argmax comes back in the one
+        fetch, the longest agreeing draft prefix is accepted plus the
+        bonus token, and pages grown for rejected drafts are handed
+        back (their slots are overwritten in place by later writes —
+        the ragged kernel's seq_len mask never exposes a stale slot
+        before the step that rewrites it). Returns (rows, tokens
+        emitted)."""
         jnp = self._jnp
         sched = self.scheduler
+        K = self.config.spec_k
+        proposals = {}
+        if K > 0:
+            for req in sched.slots:
+                if req is None or req.state != RequestState.RUNNING \
+                        or req.top_k > 0:
+                    continue        # spec verify is greedy-only
+                budget = req.max_new_tokens - len(req.generated) - 1
+                drafts = _ngram_propose(req.tokens,
+                                        self.config.spec_ngram,
+                                        min(K, budget))
+                if drafts:
+                    proposals[req.id] = drafts
         # capacity first (may preempt); then snapshot the running set
         for req in list(sched.slots):
             if req is not None and req.state == RequestState.RUNNING:
-                self._ensure_or_preempt(req, req.context_len)
+                self._ensure_or_preempt(
+                    req, req.context_len
+                    + len(proposals.get(req.id, ())))
         B = self.config.max_batch_size
+        verify = any(
+            req is not None and req.state == RequestState.RUNNING
+            and req.id in proposals for req in sched.slots)
+        T = K + 1 if verify else 1
         with RecordEvent('serve::prepare', event_type='serve'):
-            tokens = np.zeros((B, 1), np.int32)
+            tokens = np.zeros((B, T), np.int32)
             page_tables = np.zeros((B, self.max_pages_per_seq), np.int32)
             seq_lens = np.ones((B,), np.int32)
             q_lens = np.zeros((B,), np.int32)
@@ -514,21 +635,27 @@ class ServingEngine:
             for i, req in enumerate(sched.slots):
                 if req is None or req.state != RequestState.RUNNING:
                     continue
-                active.append((i, req))
-                tokens[i, 0] = req.tokens[-1]
+                drafts = proposals.get(req.id, ()) if verify else ()
+                active.append((i, req, list(drafts)))
+                tokens[i, 0] = (req.generated[-1] if req.generated
+                                else req.prompt[-1])
+                if drafts:
+                    tokens[i, 1:1 + len(drafts)] = drafts
                 row = self._page_row(req)
                 page_tables[i, :] = row
-                seq_lens[i] = req.context_len
-                q_lens[i] = 1
+                seq_lens[i] = req.context_len + len(drafts)
+                q_lens[i] = 1 + len(drafts)
                 temps[i] = req.temperature
                 top_ks[i] = req.top_k
         if not active:
-            return 0
-        fn = self._step_fn(B, 1, any(r.top_k > 0 for _, r in active))
+            return 0, 0
+        sample = any(r.top_k > 0 for _, r, _ in active)
+        fn = self._step_fn(B, T, sample, verify=verify)
         self._key, sub = self._jax.random.split(self._key)
         t0 = time.perf_counter()
         with RecordEvent('serve::compiled_step', event_type='serve',
-                         shape='decode', batch=len(active)):
+                         shape='verify' if verify else 'decode',
+                         batch=len(active)):
             nxt, new_kv = fn(
                 self._params, self.pool.kv,
                 jnp.asarray(tokens), jnp.asarray(page_tables),
@@ -540,18 +667,49 @@ class ServingEngine:
         dt = time.perf_counter() - t0
         self._decode_time += dt
         self._decode_steps += 1
-        self._decode_tokens += len(active)
         self._occupancy_sum += len(active) / B
         self._util_sum += self.pool.utilization()
-        for i, req in active:
-            req.generated.append(int(nxt[i]))
+        emitted_total = 0
+        for i, req, drafts in active:
+            if verify:
+                if req.top_k > 0:
+                    appended = [int(nxt[i, T])]     # sampled column
+                else:
+                    g = nxt[i]
+                    m = 0
+                    while m < len(drafts) and int(g[m]) == drafts[m]:
+                        m += 1
+                    appended = drafts[:m] + [int(g[m])]
+                    if drafts:
+                        self._spec_proposed += len(drafts)
+                        self._spec_accepted += m
+                        self._spec_steps += 1
+                        self._trace(req, 'spec_verify',
+                                    proposed=len(drafts), accepted=m)
+            else:
+                appended = [int(nxt[i])]
+            # emit in order, honoring eos mid-burst exactly like the
+            # one-token path would have (nothing after eos escapes)
+            for tok in appended:
+                req.generated.append(tok)
+                emitted_total += 1
+                if req.done:
+                    break
+            if drafts:
+                # speculative rollback: hand back pages grown for
+                # rejected drafts beyond the accepted context
+                self.pool.trim(req.id, req.context_len)
+            # K/V is resident for everything but the newest token
+            self.pool.register_prefix(req.id, req.tokens,
+                                      req.context_len - 1)
             self._trace(req, 'decode',
                         tokens_generated=len(req.generated),
                         seq_len=req.context_len,
                         pages=len(self.pool.page_table(req.id)))
             if req.done:
                 self._retire(req)
-        return len(active)
+        self._decode_tokens += emitted_total
+        return len(active), emitted_total
 
     def _retire(self, req):
         self.pool.release(req.id)
@@ -671,6 +829,21 @@ class ServingEngine:
             'weight_dtype': (str(self.config.weight_dtype)
                              if self.config.weight_dtype else None),
             'quantized_params': len(self._qparam_dtypes),
+            # prefix cache (pool-owned counters) + speculative decode
+            'prefix_cache': self.pool.prefix_cache,
+            'prefix_hits_total': self.pool.prefix_hits,
+            'prefix_misses_total': self.pool.prefix_misses,
+            'prefix_hit_tokens_total': self.pool.prefix_hit_tokens,
+            'prefix_shared_pages': self.pool.shared_pages,
+            'prefix_cached_pages': self.pool.cached_pages,
+            'prefix_evictions_total': self.pool.prefix_evictions,
+            'spec_k': self.config.spec_k,
+            'spec_proposed_tokens_total': self._spec_proposed,
+            'spec_accepted_tokens_total': self._spec_accepted,
+            'spec_steps_total': self._spec_steps,
+            'spec_acceptance_rate':
+                (self._spec_accepted / self._spec_proposed
+                 if self._spec_proposed else None),
         }
         return s
 
@@ -686,6 +859,9 @@ class ServingEngine:
         self._util_sum = 0.0
         self._prefill_tokens = 0
         self._prefill_chunks = 0
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._spec_steps = 0
         self._ttfts_s = []
         self._new_ttfts_s = []
         for v in self._new_slo.values():
@@ -736,6 +912,32 @@ class ServingEngine:
         self._step_fns.clear()
         self._params = {}
         return {'released': True}
+
+
+def _ngram_propose(tokens, ngram, k):
+    """Prompt-lookup draft proposer (the model-free speculator): find
+    the most recent earlier occurrence of the context's trailing
+    n-gram and propose the up-to-k tokens that followed it. Backs off
+    to shorter n-grams; returns [] when nothing matches — the request
+    then just decodes one token this step. Pure host work on the token
+    list the scheduler already holds."""
+    L = len(tokens)
+    if k <= 0 or L < 2:
+        return []
+    for n in range(min(int(ngram), L - 1), 0, -1):
+        # rightmost candidate ends one short of the trailing gram, so
+        # the continuation (which may overlap the suffix — that is how
+        # repetition loops propose) is never empty. Compared in place:
+        # this runs per greedy row per decode step, so no per-position
+        # slice allocations on the miss path.
+        first = tokens[L - n]
+        for j in range(L - n - 1, -1, -1):
+            if tokens[j] != first:
+                continue
+            if all(tokens[j + t] == tokens[L - n + t]
+                   for t in range(1, n)):
+                return [int(t) for t in tokens[j + n:j + n + k]]
+    return []
 
 
 def _device_sample(logits, key, temps, top_ks):

@@ -20,15 +20,39 @@ pool holds ~4x (vs fp32) / ~2x (vs bf16) more tokens per byte; the
 exact per-token byte math is `bytes_per_token()` below and
 docs/serving.md#quantized-kv.
 
+Copy-on-write prefix caching (ISSUE 9, `prefix_cache=True`): physical
+pages are REFCOUNTED and a hash-chained prefix index maps token blocks
+(granularity = page_size tokens) to the physical page that already
+holds their K/V, so requests whose prompts share a prefix map their
+page tables onto the same pages and skip the prefill compute for them.
+The index key is `(parent_page, tuple(block_tokens))` — a radix chain
+keyed by the previous block's *index* page, so a key identifies the
+entire token prefix exactly (no hash-collision risk, and a block's K/V
+is a pure function of the whole prefix, so dedup across requests is
+sound). Only FULL pages are ever shared; a request diverging from a
+cached prefix mid-page simply recomputes from the last shared page
+boundary into a private page — that recompute IS the fork-on-write
+(shared pages are append-only-immutable and never written: a request
+always has >= 1 privately-prefilled token, so every page it scatters
+into is private). Released pages whose content is still indexed park
+in an LRU "cached" set: allocatable like free pages (eviction drops
+the index subtree under them so a recycled page id can never satisfy a
+stale chain), but a later matching prompt — including a preempted
+request resuming — resurrects them for free. Int8 pools share scale
+buffers automatically: scales are addressed by the same page id.
+
 The allocator is deliberately host-side and dumb-simple: serving
 decisions (admit / grow / preempt) happen between jitted steps, where
 Python cost is amortized over a whole batch step. Invariants it
 enforces (tested in tests/test_serving.py):
 
-  * a page has exactly one owner (no double-mapping);
-  * free + in-use partitions the pool at all times;
-  * release returns every page of a sequence exactly once.
+  * a page's refcount equals the number of sequences mapping it
+    (exactly one owner unless prefix sharing maps it again);
+  * free + cached + mapped partitions the pool at all times;
+  * release drops every page of a sequence exactly once — a page
+    returns to the free/cached set only when its LAST mapper lets go.
 """
+import collections
 import math
 import threading
 
@@ -59,7 +83,7 @@ class KVPagePool:
     """
 
     def __init__(self, num_pages, page_size, num_layers=0, num_heads=0,
-                 head_dim=0, dtype=None):
+                 head_dim=0, dtype=None, prefix_cache=False):
         if num_pages <= 0 or page_size <= 0:
             raise ValueError("num_pages and page_size must be positive")
         self.num_pages = int(num_pages)
@@ -68,14 +92,28 @@ class KVPagePool:
         self.num_heads = int(num_heads)
         self.head_dim = int(head_dim)
         self.dtype = dtype
+        self.prefix_cache = bool(prefix_cache)
         self.kv = None                      # [(k_pages, v_pages)] per layer
         self._free = list(range(self.num_pages - 1, -1, -1))
-        self._owner = {}                    # page id -> seq id
+        self._ref = {}                      # page id -> mapper count
+        self._owners = {}                   # page id -> set of seq ids
         self._seq_pages = {}                # seq id -> [page ids]
+        # prefix index: (parent index page | -1, block token tuple) ->
+        # physical page; _cached is the LRU set of ref-0-but-indexed
+        # pages (allocatable, resurrectable)
+        self._index = {}
+        self._page_key = {}                 # page id -> its index key
+        self._children = {}                 # page id -> child page ids
+        self._cached = collections.OrderedDict()
+        self._registered_upto = {}          # seq id -> tokens indexed
         self._lock = threading.Lock()
         self.alloc_total = 0
         self.free_total = 0
         self.high_water = 0
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.prefix_hit_tokens = 0
+        self.prefix_evictions = 0
 
     # -- device arrays -------------------------------------------------------
     @property
@@ -135,11 +173,23 @@ class KVPagePool:
 
     @property
     def pages_in_use(self):
-        return self.num_pages - len(self._free)
+        """Pages mapped by at least one sequence. Cached (indexed but
+        unmapped) pages are reclaimable and count as free."""
+        return self.num_pages - len(self._free) - len(self._cached)
 
     @property
     def free_pages(self):
-        return len(self._free)
+        """Allocatable pages: truly free + cached-evictable."""
+        return len(self._free) + len(self._cached)
+
+    @property
+    def cached_pages(self):
+        return len(self._cached)
+
+    @property
+    def shared_pages(self):
+        """Physical pages currently mapped by more than one sequence."""
+        return sum(1 for r in self._ref.values() if r > 1)
 
     def utilization(self):
         return self.pages_in_use / self.num_pages
@@ -154,18 +204,61 @@ class KVPagePool:
     def owned_sequences(self):
         return list(self._seq_pages)
 
+    def _evict_subtree(self, page):
+        """Drop `page` and every index descendant from the prefix
+        index, returning the cached (ref-0) ones to the free list.
+        Dropping descendants with the parent is a correctness
+        requirement, not just hygiene: the freed page id will be
+        recycled, and a surviving child keyed on it could satisfy a
+        stale chain. A descendant a live sequence still maps (possible
+        when registration dedup chained it through a canonical page
+        its owner never mapped) is only DE-indexed — it lives on as a
+        plain private page and frees normally at release. Iterative:
+        chains grow one node per page of a sequence, which at small
+        page sizes is deeper than Python's recursion limit."""
+        stack = [page]
+        while stack:
+            p = stack.pop()
+            stack.extend(self._children.pop(p, ()))
+            key = self._page_key.pop(p)
+            del self._index[key]
+            parent = key[0]
+            if parent != -1 and parent in self._children:
+                self._children[parent].discard(p)
+            if p in self._cached:
+                del self._cached[p]
+                self._free.append(p)
+                self.prefix_evictions += 1
+
     def _take_page(self, seq_id):
+        if not self._free and self._cached:
+            # evict the least-recently-used cached prefix subtree
+            self._evict_subtree(next(iter(self._cached)))
         if not self._free:
             raise PoolExhausted(
                 f"KV pool exhausted: {self.num_pages} pages of "
                 f"{self.page_size} tokens all in use")
         page = self._free.pop()
-        assert page not in self._owner, f"page {page} double-mapped"
-        self._owner[page] = seq_id
+        assert page not in self._ref, f"page {page} double-mapped"
+        self._ref[page] = 1
+        self._owners[page] = {seq_id}
         self._seq_pages.setdefault(seq_id, []).append(page)
         self.alloc_total += 1
         self.high_water = max(self.high_water, self.pages_in_use)
         return page
+
+    def _map_existing(self, page, seq_id):
+        """Map an already-resident page into seq_id's table: incref a
+        live page, or resurrect a cached one (ref 0 -> 1)."""
+        if page in self._cached:
+            del self._cached[page]
+            self._ref[page] = 1
+            self._owners[page] = {seq_id}
+        else:
+            self._ref[page] += 1
+            self._owners[page].add(seq_id)
+        self._seq_pages.setdefault(seq_id, []).append(page)
+        self.high_water = max(self.high_water, self.pages_in_use)
 
     def ensure_capacity(self, seq_id, n_tokens):
         """Grow seq_id's page list to hold n_tokens. Raises
@@ -178,22 +271,160 @@ class KVPagePool:
         return self._seq_pages[seq_id]
 
     def release(self, seq_id):
-        """Return every page of seq_id to the free list."""
+        """Drop seq_id's mapping of every page it holds, exactly once
+        per page. A page whose refcount reaches zero becomes
+        reclaimable: indexed pages park in the cached (LRU,
+        resurrectable) set, unindexed ones return to the free list.
+        Pages a sibling still references stay mapped — preemption can
+        never evict a live sharer's prefix. Returns the number of
+        pages made reclaimable."""
         with self._lock:
             pages = self._seq_pages.pop(seq_id, [])
+            self._registered_upto.pop(seq_id, None)
+            reclaimed = 0
             for page in pages:
-                owner = self._owner.pop(page, None)
-                assert owner == seq_id, \
-                    f"page {page} owned by {owner}, freed by {seq_id}"
-                self._free.append(page)
+                owners = self._owners.get(page)
+                assert owners is not None and seq_id in owners, \
+                    f"page {page} owned by {owners}, freed by {seq_id}"
+                owners.discard(seq_id)
+                self._ref[page] -= 1
+                if self._ref[page] > 0:
+                    continue
+                del self._ref[page]
+                del self._owners[page]
+                if page in self._page_key:
+                    self._cached[page] = None       # LRU newest
+                else:
+                    self._free.append(page)
+                reclaimed += 1
                 self.free_total += 1
-        return len(pages)
+        return reclaimed
+
+    def trim(self, seq_id, n_tokens):
+        """Give back trailing pages beyond what n_tokens needs — the
+        speculative-decode rollback: the verify step grows the table
+        for k drafts, rejected ones hand their pages straight back.
+        Only private unindexed tail pages are trimmed (shared or
+        indexed pages stay; their slots are overwritten in place by
+        later writes). Returns the number of pages freed."""
+        keep = self.pages_for(n_tokens)
+        with self._lock:
+            pages = self._seq_pages.get(seq_id, [])
+            freed = 0
+            while len(pages) > keep:
+                page = pages[-1]
+                if self._ref.get(page) != 1 or page in self._page_key:
+                    break
+                pages.pop()
+                del self._ref[page]
+                del self._owners[page]
+                self._free.append(page)
+                freed += 1
+                self.free_total += 1
+        return freed
 
     def reset(self):
         with self._lock:
             self._free = list(range(self.num_pages - 1, -1, -1))
-            self._owner.clear()
+            self._ref.clear()
+            self._owners.clear()
             self._seq_pages.clear()
+            self._index.clear()
+            self._page_key.clear()
+            self._children.clear()
+            self._cached.clear()
+            self._registered_upto.clear()
+
+    # -- prefix index --------------------------------------------------------
+    def _match_pages(self, tokens, limit=None):
+        """Walk the index chain over full token blocks; returns the
+        matched physical pages (longest indexed prefix, in order)."""
+        ps = self.page_size
+        n = len(tokens) if limit is None else min(len(tokens),
+                                                  max(int(limit), 0))
+        pages, parent = [], -1
+        for i in range(n // ps):
+            page = self._index.get(
+                (parent, tuple(tokens[i * ps:(i + 1) * ps])))
+            if page is None:
+                break
+            pages.append(page)
+            parent = page
+        return pages
+
+    def peek_prefix(self, tokens, limit=None):
+        """Non-mutating admission probe: (cached_tokens, live_pages,
+        resurrect_pages). Live pages are mapped by a sibling and cost
+        the page budget nothing; resurrect pages sit in the cached set
+        and cost one allocatable page each (they just skip the prefill
+        compute)."""
+        if not self.prefix_cache:
+            return 0, 0, 0
+        with self._lock:
+            pages = self._match_pages(tokens, limit)
+            live = sum(1 for p in pages if self._ref.get(p, 0) > 0)
+        return len(pages) * self.page_size, live, len(pages) - live
+
+    def match_and_map(self, seq_id, tokens, limit=None):
+        """Map the longest indexed prefix of `tokens` (full blocks,
+        capped at `limit` tokens) into seq_id's page table, increffing
+        live pages and resurrecting cached ones. Returns the number of
+        prefix tokens now covered — the caller skips prefilling them.
+        Counted as one hit (or miss) per lookup."""
+        if not self.prefix_cache:
+            return 0
+        with self._lock:
+            if self._seq_pages.get(seq_id):
+                # the seq already allocated (e.g. a prior prefill
+                # attempt grew partial pages before PoolExhausted and
+                # the caller retried): shared pages must sit at the
+                # FRONT of the table, so just prefill privately
+                return 0
+            pages = self._match_pages(tokens, limit)
+            if not pages:
+                self.prefix_misses += 1
+                return 0
+            for page in pages:
+                self._map_existing(page, seq_id)
+            cached = len(pages) * self.page_size
+            self.prefix_hits += 1
+            self.prefix_hit_tokens += cached
+            self._registered_upto[seq_id] = cached
+        return cached
+
+    def register_prefix(self, seq_id, tokens, written):
+        """Index seq_id's newly completed full pages (first `written`
+        tokens of `tokens` have K/V resident) so later requests can
+        share them. A block already indexed elsewhere is NOT
+        re-registered — the chain advances through the canonical page
+        (dedup), and this sequence's private twin stays unindexed.
+        The walk starts from the chain root every call (cheap: a few
+        dict hits per resident block) so a chain broken by eviction
+        self-heals from this sequence's own pages instead of chaining
+        onto a stale — possibly recycled — parent id."""
+        if not self.prefix_cache:
+            return
+        ps = self.page_size
+        with self._lock:
+            blocks = min(int(written), len(tokens)) // ps
+            if blocks * ps <= self._registered_upto.get(seq_id, 0):
+                return
+            seq_pages = self._seq_pages.get(seq_id, [])
+            parent = -1
+            for i in range(min(blocks, len(seq_pages))):
+                key = (parent, tuple(tokens[i * ps:(i + 1) * ps]))
+                page = self._index.get(key)
+                if page is None:
+                    page = seq_pages[i]
+                    if page in self._page_key:      # already chained
+                        break                       # under another key
+                    self._index[key] = page
+                    self._page_key[page] = key
+                    if parent != -1:
+                        self._children.setdefault(parent,
+                                                  set()).add(page)
+                parent = page
+            self._registered_upto[seq_id] = blocks * ps
 
     def census(self):
         """{seq_id: pages held} — who is sitting on the pool right now
@@ -219,4 +450,11 @@ class KVPagePool:
             'alloc_total': self.alloc_total,
             'free_total': self.free_total,
             'sequences': len(self._seq_pages),
+            'prefix_cache': self.prefix_cache,
+            'cached_pages': self.cached_pages,
+            'shared_pages': self.shared_pages,
+            'prefix_hits_total': self.prefix_hits,
+            'prefix_misses_total': self.prefix_misses,
+            'prefix_hit_tokens_total': self.prefix_hit_tokens,
+            'prefix_evictions_total': self.prefix_evictions,
         }

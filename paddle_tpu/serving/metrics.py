@@ -49,6 +49,12 @@ _GAUGE_NAMES = (
     'ptpu_serve_batch_slots',
     'ptpu_serve_requests_in_flight',
     'ptpu_serve_requests_waiting',
+    # prefix cache (ISSUE 9): lifetime hit/miss lookups, pages mapped
+    # by >1 request right now, ref-0 pages parked for resurrection
+    'ptpu_serve_prefix_hits',
+    'ptpu_serve_prefix_misses',
+    'ptpu_serve_prefix_shared_pages',
+    'ptpu_serve_prefix_cached_pages',
 )
 _COUNTER_NAMES = (
     'ptpu_serve_requests_submitted_total',
@@ -59,6 +65,9 @@ _COUNTER_NAMES = (
     'ptpu_serve_decode_tokens_total',
     'ptpu_serve_prefill_tokens_total',
     'ptpu_serve_prefill_chunks_total',
+    'ptpu_serve_prefix_hit_tokens_total',
+    'ptpu_serve_spec_proposed_tokens_total',
+    'ptpu_serve_spec_accepted_tokens_total',
 )
 
 # scheduler-timeline summary from the engine's last publish — a dict,
@@ -108,6 +117,19 @@ def publish(stats):
           stats.get('in_flight', 0))
     g('ptpu_serve_requests_waiting', help='queued requests').set(
         stats.get('waiting', 0))
+    g('ptpu_serve_prefix_hits',
+      help='prefix-cache lookups that mapped shared pages '
+           '(lifetime)').set(stats.get('prefix_hits_total', 0))
+    g('ptpu_serve_prefix_misses',
+      help='prefix-cache lookups that found nothing (lifetime)').set(
+          stats.get('prefix_misses_total', 0))
+    g('ptpu_serve_prefix_shared_pages',
+      help='physical KV pages currently mapped by >1 request').set(
+          stats.get('prefix_shared_pages', 0))
+    g('ptpu_serve_prefix_cached_pages',
+      help='ref-0 pages retained by the prefix index '
+           '(evictable, resurrectable)').set(
+          stats.get('prefix_cached_pages', 0))
     for name in _COUNTER_NAMES:
         key = name[len('ptpu_serve_'):-len('_total')]
         g(name, help=f'serving {key.replace("_", " ")} (lifetime)').set(
@@ -165,6 +187,17 @@ def serve_snapshot():
         if m is not None:
             out[name] = _histogram_view(
                 m, scale_ms=(key != 'preemptions'))
+    # derived rates (ISSUE 9): prefix hit-rate over lookups, spec
+    # acceptance over proposed drafts — None until there is traffic
+    if 'ptpu_serve_prefix_hits' in out:
+        hits = out['ptpu_serve_prefix_hits']
+        total = hits + out.get('ptpu_serve_prefix_misses', 0)
+        out['prefix_hit_rate'] = hits / total if total else None
+    if 'ptpu_serve_spec_proposed_tokens_total' in out:
+        prop = out['ptpu_serve_spec_proposed_tokens_total']
+        out['spec_acceptance_rate'] = (
+            out.get('ptpu_serve_spec_accepted_tokens_total', 0) / prop
+            if prop else None)
     if out and _last_timeline is not None:
         out['timeline'] = dict(_last_timeline)
     return out

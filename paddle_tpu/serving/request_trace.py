@@ -33,9 +33,12 @@ import time
 
 SCHEMA = 'paddle_tpu.serve_trace/1'
 
-# lifecycle event vocabulary (docs/serving.md#request-traces)
-EVENTS = ('submit', 'admit', 'prefill_chunk', 'first_token', 'decode',
-          'preempt', 'resume', 'retire', 'abort')
+# lifecycle event vocabulary (docs/serving.md#request-traces);
+# prefix_hit = cached pages mapped at prefill start (ISSUE 9),
+# spec_verify = one speculative verify outcome (k proposed, m accepted)
+EVENTS = ('submit', 'admit', 'prefix_hit', 'prefill_chunk',
+          'first_token', 'decode', 'spec_verify', 'preempt', 'resume',
+          'retire', 'abort')
 
 # chrome-trace: request tracks live on a 'serving requests'
 # pseudo-process (one virtual thread per request) beside the host
@@ -221,6 +224,8 @@ def reconstruct(events):
             'prompt_tokens': None, 'tokens_generated': 0,
             'preemptions': 0, 'prefill_chunks': 0, 'decode_steps': 0,
             'pages_high_water': 0, 'last_token_t': None,
+            'prefix_cached_tokens': 0, 'spec_proposed': 0,
+            'spec_accepted': 0,
         })
         ev, t = e['event'], e['t']
         if 'pages' in e:
@@ -233,6 +238,13 @@ def reconstruct(events):
             r['admit_t'] = t
         elif ev == 'resume':
             pass                         # re-admit after preempt
+        elif ev == 'prefix_hit':
+            # one hit per (re-)prefill start; resumes can hit again on
+            # their own released pages, so cached tokens accumulate
+            r['prefix_cached_tokens'] += int(e.get('cached_tokens', 0))
+        elif ev == 'spec_verify':
+            r['spec_proposed'] += int(e.get('proposed', 0))
+            r['spec_accepted'] += int(e.get('accepted', 0))
         elif ev == 'prefill_chunk':
             r['prefill_chunks'] += 1
         elif ev == 'first_token':

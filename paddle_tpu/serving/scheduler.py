@@ -2,12 +2,16 @@
 
 Orca-style iteration-level scheduling: requests join a FCFS queue,
 claim a decode slot when one frees up, chunk-prefill their prompt, then
-ride the batched one-token decode step until EOS / length, at which
+ride the batched decode step (one token per iteration, or up to
+spec_k+1 with speculative decoding) until EOS / length, at which
 point the slot is immediately re-filled — no waiting for the rest of
 the batch. When the KV pool runs dry the YOUNGEST running request is
-preempted: its pages are released and it re-queues at the front with
-its generated tokens kept, so resume is a re-prefill of
-prompt+generated (recompute beats reserving swap space at these sizes).
+preempted: its page mappings are dropped — pages a prefix-sharing
+sibling still references survive untouched (kv_pool.py refcounts) —
+and it re-queues at the front with its generated tokens kept, so
+resume is a re-prefill of prompt+generated that itself prefix-hits
+any of its pages still cached (recompute of the rest beats reserving
+swap space at these sizes).
 
 All of this is pure host bookkeeping between fixed-shape jitted steps
 (engine.py) — the device never sees a dynamic shape.

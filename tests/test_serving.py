@@ -1,7 +1,8 @@
 """Serving engine: page-allocator invariants, ragged paged-attention
 parity (Pallas interpret mode + dense fallback vs a per-sequence
-oracle), continuous-batching equivalence with sequential generate, and
-preemption/resume correctness (ISSUE 5)."""
+oracle), continuous-batching equivalence with sequential generate,
+preemption/resume correctness (ISSUE 5), copy-on-write prefix-cache
+invariants and speculative-decode equivalence (ISSUE 9)."""
 import math
 
 import numpy as np
@@ -13,8 +14,8 @@ import paddle_tpu as paddle
 from paddle_tpu.core import flags
 from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.ops.pallas import paged_attention as pa
-from paddle_tpu.serving import (KVPagePool, PoolExhausted, ServingConfig,
-                                ServingEngine)
+from paddle_tpu.serving import (KVPagePool, PoolExhausted, RequestState,
+                                ServingConfig, ServingEngine)
 
 
 # ---------------------------------------------------------------------------
@@ -68,6 +69,147 @@ class TestPageAllocator:
         pool.release('a')
         pool.ensure_capacity('b', 12)
         assert len(pool.page_table('b')) == 3
+
+
+# ---------------------------------------------------------------------------
+# copy-on-write prefix cache: allocator-level invariants (ISSUE 9)
+# ---------------------------------------------------------------------------
+def _partition_ok(pool):
+    """free + cached + mapped partitions the pool at all times."""
+    return (len(pool._free) + len(pool._cached) + len(pool._ref)
+            == pool.num_pages)
+
+
+class TestPrefixCacheAllocator:
+    def test_refcount_share_and_exact_once_release(self):
+        pool = KVPagePool(num_pages=8, page_size=4, prefix_cache=True)
+        toks = list(range(100, 112))           # 3 full blocks
+        pool.ensure_capacity('a', 12)
+        pool.register_prefix('a', toks, written=12)
+        # b maps all 3 indexed pages — same physical pages, ref 2
+        assert pool.match_and_map('b', toks + [7, 8]) == 12
+        assert pool.page_table('b') == pool.page_table('a')
+        assert pool.shared_pages == 3
+        assert pool.pages_in_use == 3 and _partition_ok(pool)
+        # a releases: pages stay mapped for b (nothing reclaimed)
+        assert pool.release('a') == 0
+        assert pool.shared_pages == 0 and pool.pages_in_use == 3
+        # b releases: indexed pages park in the cached set, not free
+        assert pool.release('b') == 3
+        assert pool.pages_in_use == 0 and pool.cached_pages == 3
+        assert pool.free_pages == 8 and _partition_ok(pool)
+        # double release stays a no-op
+        assert pool.release('a') == 0 and pool.release('b') == 0
+        # a third request resurrects them from the cached set
+        assert pool.match_and_map('c', toks) == 12
+        assert pool.cached_pages == 0 and pool.pages_in_use == 3
+        assert pool.prefix_hits == 2 and pool.prefix_hit_tokens == 24
+
+    def test_fork_on_divergence_shares_only_common_blocks(self):
+        pool = KVPagePool(num_pages=16, page_size=4, prefix_cache=True)
+        common = [1, 2, 3, 4, 5, 6, 7, 8]      # 2 full blocks
+        pool.ensure_capacity('a', 12)
+        pool.register_prefix('a', common + [9, 10, 11, 12], written=12)
+        # b shares the first 2 blocks then DIVERGES at token 9: the
+        # divergent tail must land in private pages (fork-on-write =
+        # recompute from the page boundary, never touch shared pages)
+        b_toks = common + [99, 98, 97, 96]
+        assert pool.match_and_map('b', b_toks) == 8
+        pool.ensure_capacity('b', 12)
+        ta, tb = pool.page_table('a'), pool.page_table('b')
+        assert tb[:2] == ta[:2]                # shared prefix blocks
+        assert tb[2] != ta[2]                  # private divergent page
+        assert pool.shared_pages == 2
+        # b's divergent block registers under its own chain and is
+        # matchable by a third request; a's block 2 stays distinct
+        pool.register_prefix('b', b_toks, written=12)
+        assert pool._match_pages(b_toks) == tb[:3]
+        assert pool._match_pages(common + [9, 10, 11, 12]) == ta[:3]
+
+    def test_match_is_capped_and_block_granular(self):
+        pool = KVPagePool(num_pages=8, page_size=4, prefix_cache=True)
+        toks = list(range(50, 58))             # 2 full blocks
+        pool.ensure_capacity('a', 8)
+        pool.register_prefix('a', toks, written=8)
+        # limit (engine passes len-1 so one token stays to compute):
+        # 7 tokens -> only the first full block matches
+        assert pool.peek_prefix(toks, limit=7) == (4, 1, 0)
+        assert pool.match_and_map('b', toks, limit=7) == 4
+        # partial block never matches: 6 tokens -> 1 block
+        assert pool.peek_prefix(toks[:6]) == (4, 1, 0)
+        # disabled pool: no matching, no counting
+        off = KVPagePool(num_pages=4, page_size=4)
+        off.ensure_capacity('x', 4)
+        off.register_prefix('x', [1, 2, 3, 4], written=4)
+        assert off.peek_prefix([1, 2, 3, 4]) == (0, 0, 0)
+        assert off.match_and_map('y', [1, 2, 3, 4]) == 0
+        assert off.prefix_misses == 0
+
+    def test_eviction_reclaims_cached_subtree_lru(self):
+        pool = KVPagePool(num_pages=4, page_size=4, prefix_cache=True)
+        chain = list(range(10, 22))            # 3 blocks
+        pool.ensure_capacity('a', 12)
+        pool.register_prefix('a', chain, written=12)
+        pool.release('a')
+        assert pool.cached_pages == 3 and pool.free_pages == 4
+        # allocating 2 pages: 1 free + evicting the LRU root drops the
+        # WHOLE chain (descendants keyed on a recycled parent id would
+        # be a stale-chain hazard), so everything is allocatable
+        pool.ensure_capacity('b', 8)
+        assert pool.pages_in_use == 2
+        assert pool.prefix_evictions == 3
+        assert pool._match_pages(chain) == []  # index fully dropped
+        assert _partition_ok(pool)
+        # pool can still be filled to the brim
+        pool.ensure_capacity('b', 16)
+        assert pool.free_pages == 0
+        with pytest.raises(PoolExhausted):
+            pool.ensure_capacity('c', 4)
+
+    def test_match_after_partial_allocation_is_noop(self):
+        # review fix: a prefill retried after PoolExhausted kept its
+        # partial pages; the lookup must degrade to a miss (shared
+        # pages go at the FRONT of the table), not crash
+        pool = KVPagePool(num_pages=8, page_size=4, prefix_cache=True)
+        toks = list(range(40, 48))
+        pool.ensure_capacity('a', 8)
+        pool.register_prefix('a', toks, written=8)
+        pool.ensure_capacity('b', 4)           # partial growth kept
+        assert pool.match_and_map('b', toks) == 0
+        assert len(pool.page_table('b')) == 1
+
+    def test_deep_chain_eviction_is_iterative(self):
+        # review fix: chains grow one node per page; at page_size=1
+        # they get deeper than Python's recursion limit — eviction
+        # must not blow the stack (or half-mutate the index)
+        n = 1200
+        pool = KVPagePool(num_pages=n, page_size=1, prefix_cache=True)
+        toks = list(range(n))
+        pool.ensure_capacity('a', n)
+        pool.register_prefix('a', toks, written=n)
+        pool.release('a')
+        assert pool.cached_pages == n
+        pool.ensure_capacity('b', 2)           # evicts the LRU chain
+        assert pool.prefix_evictions == n
+        assert pool._match_pages(toks) == []
+        assert _partition_ok(pool)
+
+    def test_trim_returns_private_tail_only(self):
+        pool = KVPagePool(num_pages=8, page_size=4, prefix_cache=True)
+        toks = list(range(60, 68))
+        pool.ensure_capacity('a', 16)          # 4 pages
+        pool.register_prefix('a', toks, written=8)
+        # trim to 9 tokens: pages 3 and... keep=3, page 3 freed; the
+        # indexed pages (0, 1) and page 2 stay
+        assert pool.trim('a', 9) == 1
+        assert len(pool.page_table('a')) == 3
+        # shared page is never trimmed even when trailing
+        pool2 = KVPagePool(num_pages=8, page_size=4, prefix_cache=True)
+        pool2.ensure_capacity('x', 8)
+        pool2.register_prefix('x', toks, written=8)
+        pool2.match_and_map('y', toks, limit=None)
+        assert pool2.trim('y', 1) == 0         # both pages indexed
+        assert len(pool2.page_table('y')) == 2
 
 
 # ---------------------------------------------------------------------------
@@ -347,6 +489,267 @@ class TestContinuousBatching:
         assert all(len(o) in (len(p) + 1, len(p) + 5)
                    or len(p) < len(o) <= len(p) + 5
                    for o, p in zip(outs, [[3, 4, 5], [9, 8]]))
+        eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# prefix caching + speculative decoding through the engine (ISSUE 9)
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope='module')
+def shared_prefix_prompts(tiny_lm):
+    """Requests sharing a 24-token system prompt + distinct tails."""
+    rng = np.random.RandomState(11)
+    system = list(rng.randint(1, 128, 24))
+    return [system + list(rng.randint(1, 128, n)) for n in (4, 7, 5, 9)]
+
+
+class TestPrefixCacheEngine:
+    def _run(self, tiny_lm, prompts, max_new=5, **cfg):
+        eng = ServingEngine(tiny_lm, ServingConfig(
+            page_size=8, max_batch_size=2, prefill_chunk=8, **cfg))
+        outs = eng.generate(prompts, max_new_tokens=max_new, top_k=0)
+        st = eng.stats()
+        return eng, outs, st
+
+    def test_shared_prefix_identical_outputs_fewer_prefill_tokens(
+            self, tiny_lm, shared_prefix_prompts):
+        eng0, ref, st0 = self._run(tiny_lm, shared_prefix_prompts,
+                                   prefix_cache=False)
+        eng0.shutdown()
+        eng, outs, st = self._run(tiny_lm, shared_prefix_prompts)
+        # acceptance: token-identical to the PR-5 path, and cache hits
+        # skipped whole prefill chunks (the TTFT win)
+        assert outs == ref
+        assert st['prefix_hits_total'] >= 3
+        # a sibling admitted mid-prefill only matches the blocks
+        # registered so far, so the floor is one block for the
+        # concurrent hit plus full 3-block (24-token) hits after
+        assert st['prefix_hit_tokens_total'] >= 8 + 2 * 24
+        assert st['prefill_tokens_total'] < st0['prefill_tokens_total']
+        # every page released exactly once even through sharing: the
+        # drained pool has nothing mapped, only resurrectable cache
+        assert eng.pool.pages_in_use == 0
+        assert eng.pool.cached_pages > 0
+        assert eng.pool.free_pages == eng.pool.num_pages
+        eng.shutdown()
+
+    def test_concurrent_sharing_maps_same_physical_pages(
+            self, tiny_lm, shared_prefix_prompts):
+        # submit two shared-prefix requests and step just past both
+        # prefills: the live page tables must overlap physically
+        eng = ServingEngine(tiny_lm, ServingConfig(
+            page_size=8, max_batch_size=2, prefill_chunk=32))
+        r1 = eng.submit(shared_prefix_prompts[0], max_new_tokens=8)
+        r2 = eng.submit(shared_prefix_prompts[1], max_new_tokens=8)
+        for _ in range(3):
+            eng.step()
+        t1, t2 = eng.pool.page_table(r1.id), eng.pool.page_table(r2.id)
+        assert t1[:3] == t2[:3]            # 24-token system prompt
+        assert eng.pool.shared_pages >= 3
+        # and the serve gauges see it
+        eng.publish_metrics()
+        from paddle_tpu.serving import metrics as sm
+        snap = sm.serve_snapshot()
+        assert snap['ptpu_serve_prefix_shared_pages'] >= 3
+        assert snap['prefix_hit_rate'] is not None
+        while eng.scheduler.has_work:
+            eng.step()
+        eng.shutdown()
+
+    def test_preempt_resume_with_sharing_keeps_outputs(
+            self, tiny_lm, shared_prefix_prompts):
+        # pool pressure on a shared-prefix stream: preempting the
+        # youngest must not yank pages its sibling still references,
+        # and resume (which may prefix-hit its own cached pages) must
+        # not change outputs
+        eng0, ref, _ = self._run(tiny_lm, shared_prefix_prompts,
+                                 max_new=6, prefix_cache=False,
+                                 num_pages=64)
+        eng0.shutdown()
+        eng = ServingEngine(tiny_lm, ServingConfig(
+            page_size=8, max_batch_size=3, prefill_chunk=8,
+            num_pages=7))
+        outs = eng.generate(shared_prefix_prompts, max_new_tokens=6,
+                            top_k=0)
+        assert outs == ref
+        assert eng.stats()['preemptions_total'] > 0
+        assert eng.pool.pages_in_use == 0
+        eng.shutdown()
+
+    def test_admission_budget_counts_shared_pages_once(
+            self, tiny_lm, shared_prefix_prompts):
+        # the ISSUE 9 satellite fix: with most of the first chunk
+        # covered by live shared pages, a second request must be
+        # admitted even when the free budget alone could not hold its
+        # whole first chunk (the PR-5 estimate charged every chunk
+        # page and refused)
+        eng = ServingEngine(tiny_lm, ServingConfig(
+            page_size=8, max_batch_size=2, prefill_chunk=32,
+            num_pages=6))
+        first = eng.submit(shared_prefix_prompts[0], max_new_tokens=4)
+        while first.state != RequestState.RUNNING:
+            eng.step()
+        # 4 pages mapped (25+ tokens); 2 free. A sibling's first chunk
+        # is fully covered by the shared system prompt -> need 0 new
+        eng.submit(shared_prefix_prompts[1], max_new_tokens=4)
+        assert eng._admit() == 1
+        while eng.scheduler.has_work:
+            eng.step()
+        eng.shutdown()
+
+    def test_int8_kv_pages_share_scales(self, tiny_lm,
+                                        shared_prefix_prompts):
+        # quantized pools share pages AND their sibling scale buffers
+        # (same page id addresses both); outputs stay identical to the
+        # unshared int8 engine
+        eng0, ref, _ = self._run(tiny_lm, shared_prefix_prompts,
+                                 prefix_cache=False, kv_dtype='int8')
+        eng0.shutdown()
+        eng, outs, st = self._run(tiny_lm, shared_prefix_prompts,
+                                  kv_dtype='int8')
+        assert outs == ref
+        assert st['prefix_hits_total'] >= 3
+        assert eng.pool.quantized
+        eng.shutdown()
+
+
+class TestSpeculativeDecode:
+    def test_ngram_proposer(self):
+        from paddle_tpu.serving.engine import _ngram_propose
+        # trailing bigram [3, 4] last recurs at position 2 -> proposes
+        # the continuation that followed it
+        t = [1, 2, 3, 4, 5, 6, 3, 4]
+        assert _ngram_propose(t, 2, 3) == [5, 6, 3]
+        # no recurrence of [5, 6] and no [6]: nothing to propose
+        assert _ngram_propose([1, 2, 5, 6], 2, 3) == []
+        # backoff to the unigram (most recent occurrence wins) when
+        # the bigram never recurred
+        assert _ngram_propose([7, 1, 2, 7, 3, 7], 2, 2) == [3, 7]
+        # repetition loop proposes through the overlap
+        assert _ngram_propose([9, 9, 9], 2, 4) == [9]
+        assert _ngram_propose([5], 2, 4) == []
+        assert _ngram_propose(t, 2, 0) == []
+
+    def test_greedy_equivalence_with_spec_on(self, tiny_lm,
+                                             mixed_prompts,
+                                             sequential_greedy):
+        # acceptance: speculation ON is token-identical to OFF, across
+        # page boundaries (page_size 8, contexts grow past 16)
+        eng = ServingEngine(tiny_lm, ServingConfig(
+            page_size=8, max_batch_size=3, prefill_chunk=8, spec_k=4))
+        outs = eng.generate(mixed_prompts, max_new_tokens=6, top_k=0)
+        assert outs == sequential_greedy
+        assert eng.pool.pages_in_use == 0
+        eng.shutdown()
+
+    def test_spec_accepts_drafts_and_advances_multitoken(
+            self, tiny_lm, mixed_prompts):
+        eng0 = ServingEngine(tiny_lm, ServingConfig(
+            page_size=8, max_batch_size=3, prefill_chunk=8,
+            prefix_cache=False))
+        ref = eng0.generate(mixed_prompts, max_new_tokens=16, top_k=0)
+        st0 = eng0.stats()
+        eng0.shutdown()
+        eng = ServingEngine(tiny_lm, ServingConfig(
+            page_size=8, max_batch_size=3, prefill_chunk=8, spec_k=4))
+        outs = eng.generate(mixed_prompts, max_new_tokens=16, top_k=0)
+        st = eng.stats()
+        assert outs == ref
+        # the tiny model settles into repetition, so the n-gram
+        # proposer fires and the verify step accepts drafts: more than
+        # one token per decode dispatch (deterministic: fixed seeds)
+        assert st['spec_proposed_tokens_total'] > 0
+        assert st['spec_accepted_tokens_total'] > 0
+        assert st['decode_steps_total'] < st0['decode_steps_total']
+        assert st['decode_tokens_total'] == st0['decode_tokens_total']
+        assert 0 < st['spec_acceptance_rate'] <= 1
+        eng.shutdown()
+
+    def test_spec_eos_early_exit_token_identical(self, tiny_lm,
+                                                 mixed_prompts):
+        # pick an eos that actually occurs mid-stream in the baseline
+        # output, then require speculation to stop at exactly the same
+        # token — nothing after eos may escape a multi-token burst
+        eng0 = ServingEngine(tiny_lm, ServingConfig(
+            page_size=8, max_batch_size=3, prefill_chunk=8,
+            prefix_cache=False))
+        base = eng0.generate(mixed_prompts, max_new_tokens=12, top_k=0)
+        eng0.shutdown()
+        gen0 = [o[len(p):] for o, p in zip(base, mixed_prompts)]
+        eos = gen0[0][len(gen0[0]) // 2]       # fires mid-generation
+        ref = []
+        for g, p in zip(gen0, mixed_prompts):
+            cut = g.index(eos) + 1 if eos in g else len(g)
+            ref.append(p + g[:cut])
+        eng = ServingEngine(tiny_lm, ServingConfig(
+            page_size=8, max_batch_size=3, prefill_chunk=8, spec_k=4))
+        outs = eng.generate(mixed_prompts, max_new_tokens=12,
+                            eos_token_id=int(eos), top_k=0)
+        assert outs == ref
+        for o, p in zip(outs, mixed_prompts):
+            gen = o[len(p):]
+            assert eos not in gen[:-1]         # eos only terminal
+        eng.shutdown()
+
+    def test_spec_respects_max_new_tokens(self, tiny_lm):
+        eng = ServingEngine(tiny_lm, ServingConfig(
+            page_size=8, max_batch_size=2, prefill_chunk=8, spec_k=4))
+        outs = eng.generate([[1, 2, 3, 1, 2, 3, 1, 2]],
+                            max_new_tokens=3, top_k=0)
+        assert len(outs[0]) == 8 + 3
+        eng.shutdown()
+
+    def test_spec_with_sampling_rows_mixed_batch(self, tiny_lm):
+        # greedy rows speculate; a top-k row rides the same verify
+        # dispatch through the sampled column — both must complete
+        eng = ServingEngine(tiny_lm, ServingConfig(
+            page_size=8, max_batch_size=2, prefill_chunk=8, spec_k=3,
+            seed=5))
+        greedy = eng.submit([1, 2, 3, 1, 2, 3, 1], max_new_tokens=8,
+                            top_k=0)
+        sampled = eng.submit([4, 5, 6, 7], max_new_tokens=8, top_k=4,
+                             temperature=0.9)
+        while eng.scheduler.has_work:
+            eng.step()
+        assert len(greedy.generated) == 8
+        assert 1 <= len(sampled.generated) <= 8
+        eng.shutdown()
+
+    def test_spec_with_prefix_and_preemption_pressure(
+            self, tiny_lm, shared_prefix_prompts):
+        # everything on at once under pool pressure: outputs must
+        # still match the plain PR-5 engine
+        eng0 = ServingEngine(tiny_lm, ServingConfig(
+            page_size=8, max_batch_size=3, prefill_chunk=8,
+            prefix_cache=False))
+        ref = eng0.generate(shared_prefix_prompts, max_new_tokens=8,
+                            top_k=0)
+        eng0.shutdown()
+        eng = ServingEngine(tiny_lm, ServingConfig(
+            page_size=8, max_batch_size=3, prefill_chunk=8, spec_k=4,
+            num_pages=9))
+        outs = eng.generate(shared_prefix_prompts, max_new_tokens=8,
+                            top_k=0)
+        assert outs == ref
+        assert eng.pool.pages_in_use == 0
+        eng.shutdown()
+
+    def test_spec_trace_and_gauges(self, tiny_lm, mixed_prompts):
+        eng = ServingEngine(tiny_lm, ServingConfig(
+            page_size=8, max_batch_size=3, prefill_chunk=8, spec_k=4))
+        eng.generate(mixed_prompts, max_new_tokens=16, top_k=0)
+        eng.publish_metrics()
+        from paddle_tpu.serving import metrics as sm
+        snap = sm.serve_snapshot()
+        assert snap['ptpu_serve_spec_proposed_tokens_total'] > 0
+        assert snap['spec_acceptance_rate'] is not None
+        # journals carry spec_verify events; reconstruct() aggregates
+        # per-request proposed/accepted
+        table = eng.request_table()
+        assert sum(r['spec_proposed'] for r in table.values()) == \
+            eng.stats()['spec_proposed_tokens_total']
+        assert sum(r['spec_accepted'] for r in table.values()) == \
+            eng.stats()['spec_accepted_tokens_total']
         eng.shutdown()
 
 

@@ -501,6 +501,27 @@ def render_serve(s):
         f"{int(v('preemptions_total'))} preemptions, "
         f"{int(v('prefill_tokens_total'))} prefill tokens in "
         f"{int(v('prefill_chunks_total'))} chunks")
+    # prefix cache + speculative decode (ISSUE 9)
+    hits, misses = int(v('prefix_hits')), int(v('prefix_misses'))
+    if hits or misses:
+        rate = s.get('prefix_hit_rate')
+        if rate is None and hits + misses:
+            rate = hits / (hits + misses)
+        out.append(
+            f"  prefix cache: {hits} hits / {misses} misses "
+            f"({100 * (rate or 0):.1f}% hit-rate), "
+            f"{int(v('prefix_hit_tokens_total'))} prompt tokens served "
+            f"from cache; {int(v('prefix_shared_pages'))} shared + "
+            f"{int(v('prefix_cached_pages'))} cached pages now")
+    prop = int(v('spec_proposed_tokens_total'))
+    if prop:
+        acc = int(v('spec_accepted_tokens_total'))
+        rate = s.get('spec_acceptance_rate')
+        if rate is None:
+            rate = acc / prop
+        out.append(
+            f"  speculative decode: {acc}/{prop} draft tokens accepted "
+            f"({100 * rate:.1f}% acceptance)")
     # SLO percentile section (bucket-interpolated p50/p90/p99 from the
     # ptpu_serve_* histograms — docs/serving.md#slo-metrics)
     slo_rows = []
@@ -561,10 +582,14 @@ def _serve_selftest():
     model = GPTForCausalLM(cfg)
     model.eval()
     rng = np.random.RandomState(0)
-    prompts = [list(rng.randint(1, 64, n)) for n in (3, 7, 5)]
+    # shared system prompt so the prefix cache hits, and speculative
+    # decoding on so acceptance shows up in gauges/rendering (ISSUE 9)
+    system = list(rng.randint(1, 64, 8))
+    prompts = [system + list(rng.randint(1, 64, n)) for n in (3, 7, 5)]
     eng = ServingEngine(model, ServingConfig(page_size=8,
                                              max_batch_size=2,
-                                             prefill_chunk=8))
+                                             prefill_chunk=8,
+                                             spec_k=4))
     outs = eng.generate(prompts, max_new_tokens=4, top_k=0)
     assert all(len(o) == len(p) + 4 for o, p in zip(outs, prompts))
     snap = StepTelemetry(publish=False).snapshot()
@@ -575,10 +600,17 @@ def _serve_selftest():
     assert serve['ptpu_serve_ttft_seconds'].get('p99_ms') is not None
     assert serve['ptpu_serve_e2e_seconds']['count'] == 3, serve
     assert serve['timeline']['iterations'] > 0, serve
+    # ISSUE 9: prefix hit-rate + spec acceptance reach the snapshot
+    assert serve['ptpu_serve_prefix_hits'] >= 2, serve
+    assert serve['prefix_hit_rate'] is not None, serve
+    assert serve['ptpu_serve_prefix_hit_tokens_total'] >= 16, serve
     text = render_serve(serve)
     assert 'decode throughput' in text and 'time-to-first-token' in text
     assert '3/3 requests completed' in text, text
     assert 'SLO percentiles' in text and 'scheduler timeline' in text
+    assert 'prefix cache:' in text and 'hit-rate' in text, text
+    if serve.get('ptpu_serve_spec_proposed_tokens_total'):
+        assert 'speculative decode:' in text, text
 
     # -- trace export round-trips and reconstructs the engine's truth
     with tempfile.TemporaryDirectory() as td:

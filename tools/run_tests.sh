@@ -8,7 +8,9 @@
 #                     equivalence + comm-gauge CLI smoke (ISSUE 4)
 #   --serve-selftest - serving engine end-to-end on the CPU fallback
 #                      path + serve-gauge/percentile CLI smoke, request
-#                      trace export, stalled-request watchdog (ISSUE 5/6)
+#                      trace export, stalled-request watchdog (ISSUE 5/6),
+#                      COW prefix-cache invariants + speculative-decode
+#                      equivalence and hit/acceptance rendering (ISSUE 9)
 #   --quant-selftest - quantization subsystem: fake-quant op numerics,
 #                      int8-KV serving parity + capacity, weight-only-
 #                      quantized Predictor decode, int8 comm gauge
@@ -68,10 +70,12 @@ case "$TIER" in
           python tools/health_dump.py pallas --selftest ;;
   --serve-selftest)
           # serving engine end to end on the CPU fallback path (paged
-          # pool + continuous batching + request observatory), then the
-          # CLI smokes: serve gauges/percentiles + trace export +
-          # stalled-request watchdog (health_dump) and the per-request
-          # SLO table from an exported trace (trace_summary)
+          # pool + continuous batching + COW prefix caching +
+          # speculative decoding + request observatory), then the CLI
+          # smokes: serve gauges/percentiles incl. prefix hit-rate and
+          # spec acceptance + trace export + stalled-request watchdog
+          # (health_dump) and the per-request SLO table with
+          # cached/spec columns from an exported trace (trace_summary)
           python -m pytest tests/test_serving.py \
             tests/test_serving_trace.py -q
           python tools/health_dump.py serve --selftest

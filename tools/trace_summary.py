@@ -134,15 +134,35 @@ def render_serve(s):
     out.append('')
     out.append(f"{'req':>5} {'state':<9} {'prompt':>6} {'gen':>5} "
                f"{'queue_ms':>9} {'ttft_ms':>9} {'tpot_ms':>9} "
-               f"{'e2e_ms':>9} {'preempt':>7} {'pages_hw':>8}")
+               f"{'e2e_ms':>9} {'preempt':>7} {'pages_hw':>8} "
+               f"{'cached':>6} {'spec':>9}")
     for r in rows:
+        prop = r.get('spec_proposed', 0)
+        spec = (f"{r.get('spec_accepted', 0)}/{prop}" if prop else '-')
         out.append(
             f"{r['req']:>5} {r['state'] or '?':<9} "
             f"{r['prompt_tokens'] if r['prompt_tokens'] is not None else '?':>6} "
             f"{r['tokens_generated']:>5} "
             f"{_fmt_ms(r['queue_wait_s']):>9} {_fmt_ms(r['ttft_s']):>9} "
             f"{_fmt_ms(r['tpot_s']):>9} {_fmt_ms(r['e2e_s']):>9} "
-            f"{r['preemptions']:>7} {r['pages_high_water']:>8}")
+            f"{r['preemptions']:>7} {r['pages_high_water']:>8} "
+            f"{r.get('prefix_cached_tokens', 0):>6} {spec:>9}")
+    # cross-request prefix/spec aggregates (ISSUE 9): prompt tokens
+    # served from cache, and draft-token acceptance over the stream
+    cached = sum(r.get('prefix_cached_tokens', 0) for r in rows)
+    prompt = sum(r['prompt_tokens'] or 0 for r in rows)
+    prop = sum(r.get('spec_proposed', 0) for r in rows)
+    acc = sum(r.get('spec_accepted', 0) for r in rows)
+    if cached:
+        out.append('')
+        out.append(f"prefix cache: {cached}/{prompt} prompt tokens "
+                   f"served from cache "
+                   f"({100.0 * cached / max(prompt, 1):.1f}% hit-rate)")
+    if prop:
+        if not cached:
+            out.append('')
+        out.append(f"speculative decode: {acc}/{prop} draft tokens "
+                   f"accepted ({100.0 * acc / prop:.1f}% acceptance)")
     out.append('')
     out.append('-- SLO percentiles (ms) ' + '-' * 36)
     for key, label in (('queue_wait_s', 'queue wait'),
@@ -186,14 +206,17 @@ def _serve_selftest():
     tr = RequestTracer(clock=clock)
     tr.record(7, 'submit', t=1.0, prompt_tokens=5, max_new_tokens=4)
     tr.record(7, 'admit', t=1.5, slot=0)
+    tr.record(7, 'prefix_hit', t=1.55, cached_tokens=4, pages=1)
     tr.record(7, 'prefill_chunk', t=1.6, tokens=5, prefilled=5, pages=1)
     tr.record(7, 'first_token', t=2.0, tokens_generated=1, pages=1)
     tr.record(7, 'preempt', t=2.1, pages_released=1,
               tokens_generated=1)
     tr.record(7, 'resume', t=2.5, slot=1)
     tr.record(7, 'prefill_chunk', t=2.6, tokens=6, prefilled=6, pages=2)
-    for i, td in enumerate((2.8, 3.0, 3.2)):
+    for i, td in enumerate((2.8, 3.0)):
         tr.record(7, 'decode', t=td, tokens_generated=2 + i, pages=2)
+    tr.record(7, 'spec_verify', t=3.1, proposed=3, accepted=1)
+    tr.record(7, 'decode', t=3.2, tokens_generated=4, pages=2)
     tr.record(7, 'retire', t=3.2, tokens_generated=4, preemptions=1)
     with tempfile.TemporaryDirectory() as d:
         p = os.path.join(d, 'serve.jsonl')
@@ -205,8 +228,13 @@ def _serve_selftest():
     assert r['preemptions'] == 1 and r['tokens_generated'] == 4, r
     assert abs(r['tpot_s'] - (3.2 - 2.0) / 3) < 1e-12, r
     assert r['e2e_s'] == 2.2 and r['pages_high_water'] == 2, r
+    assert r['prefix_cached_tokens'] == 4, r
+    assert r['spec_proposed'] == 3 and r['spec_accepted'] == 1, r
     assert abs(s['percentiles']['ttft_s']['p50'] - 1.0) < 1e-12
-    print(render_serve(s))
+    text = render_serve(s)
+    assert 'prefix cache: 4/5' in text, text
+    assert 'speculative decode: 1/3' in text, text
+    print(text)
     print('trace_summary serve selftest: OK')
 
 
