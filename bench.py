@@ -732,6 +732,11 @@ def _attach_telemetry(r):
             # comm-bytes-drop acceptance number lives under
             # comm.comm_bytes_drop_vs_per_param_psum
             'comm': snap.get('comm'),
+            # overlap schedule view (ISSUE 10): exposed vs hidden comm
+            # seconds, groups/prefetch/chunk — also inside comm, but
+            # surfaced top-level so the legs contract can assert it
+            'comm_overlap': (snap.get('comm') or {}).get(
+                'comm_overlap'),
             'compile_cache': snap.get('compile_cache'),
             # ptpu_serve_* view — only the serving leg publishes these
             'serve': snap.get('serve'),
@@ -799,6 +804,48 @@ def _leg_in_subprocess(name, timeout=5400, attempts=3):
     raise RuntimeError(
         f"bench leg {name} produced no result (rc={p.returncode}): "
         f"{last_tail}")
+
+
+# the top-level legs every round record must carry (r5 regression +
+# the ISSUE 10 self-check: the r05 record buried satellite results —
+# and their errors — inside the headline leg's detail dict)
+EXPECTED_LEGS = ('gpt1.3b_adamw', 'gpt1.3b_sgd', 'bert_base_zero2_bf16',
+                 'lenet_mnist', 'resnet50_dp_bf16', 'deepfm_ps',
+                 'ps_scale_ssd', 'gpt_serve_throughput')
+
+
+def _check_legs(result):
+    """Leg self-check (ISSUE 10): every result lands TOP-level under
+    result.legs — never nested under another leg's detail — and the
+    headline leg carries telemetry.comm_overlap. Raises on violation
+    so a regressed record shape fails the round loudly instead of
+    silently burying legs again."""
+    legs = result.get('legs')
+    assert isinstance(legs, dict), 'result.legs missing'
+    missing = [k for k in EXPECTED_LEGS if k not in legs]
+    assert not missing, f'legs missing from result.legs: {missing}'
+
+    def _no_nested_legs(d, path):
+        for k, v in d.items():
+            assert k != 'legs', \
+                f'leg buried under {"/".join(path)}/legs'
+            if isinstance(v, dict):
+                _no_nested_legs(v, path + (k,))
+
+    for name, leg in legs.items():
+        assert isinstance(leg, dict), f'leg {name} is not a dict'
+        _no_nested_legs(leg, (name,))
+    detail = result.get('detail')
+    if isinstance(detail, dict):
+        _no_nested_legs(detail, ('detail',))
+    # headline telemetry carries the overlap view (dryrun twin asserts
+    # exposed < total; at dp=1 the gauges report the modeled schedule
+    # with enabled=false — presence is the contract here). A telemetry
+    # collection error is its own visible record, not a shape bug.
+    tel = legs['gpt1.3b_adamw'].get('telemetry') or {}
+    assert 'comm_overlap' in tel or 'error' in tel, \
+        'headline leg telemetry lacks comm_overlap'
+    return True
 
 
 def _round_floats(r, ndigits=2):
@@ -884,6 +931,7 @@ def main():
         'legs': legs,
         'detail': detail,
     }
+    _check_legs(result)
     print(json.dumps(result))
 
 

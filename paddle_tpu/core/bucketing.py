@@ -28,8 +28,28 @@ Update in Data-Parallel Training" (arXiv:2004.13336):
 Everything here is either host-side layout bookkeeping or pure
 traced-code helpers used inside the engines' `shard_map` bodies; the
 only state is the monitor gauges (`ptpu_comm_*`).
+
+Communication/compute overlap (ISSUE 10, arXiv:2004.13336 §overlap +
+arXiv:2112.01075 chunked collectives):
+
+  * **layer-grouped buckets** — `layer_group_fn` keys buckets on the
+    model-layer index parsed from the parameter name, so a bucket's
+    gradients are complete as soon as its layers' backward finishes
+    and its reduce-scatter is schedulable under the remaining
+    backward compute (one dtype-global blob serializes everything
+    behind the full backward);
+  * **chunked collectives** — `reduce_scatter`/`all_gather` accept a
+    `chunk` element cap (`PTPU_COMM_CHUNK`) that decomposes an
+    oversized bucket's collective into schedulable pieces along the
+    shard dimension; piece results concatenate to the EXACT unchunked
+    shard/gather layout (bit-identical for uncompressed wires);
+  * **overlap telemetry** — `publish_overlap_gauges` models per-step
+    exposed vs hidden comm seconds (`ptpu_comm_overlap_*`), emits one
+    profiler span per group, and `comm_snapshot()['comm_overlap']`
+    is the JSON view the bench/dryrun records carry.
 """
 import math
+import re
 
 import numpy as np
 import jax
@@ -44,6 +64,13 @@ INT8_BIN = 127.0
 DEFAULT_COMM_BLOCK = 256
 # scales travel as fp32 beside the int8 payload
 SCALE_ITEMSIZE = 4
+# deferred-gather prefetch window: how many param groups may be
+# in flight (gathered but not yet consumed) ahead of first use
+DEFAULT_PREFETCH_DEPTH = 2
+# modeled per-rank interconnect bandwidth for the exposed/hidden comm
+# model (v5e ICI-class, one direction) — a MODEL constant like the
+# byte gauges, not a measurement
+MODELED_ICI_BYTES_PER_S = 4.5e10
 
 
 def resolve_comm_config(comm_dtype=None, bucket_mb=None):
@@ -80,6 +107,92 @@ def resolve_comm_block(block=None):
     if block is None:
         block = DEFAULT_COMM_BLOCK
     return max(int(block), 1)
+
+
+def resolve_overlap_config(overlap=None, prefetch=None, chunk=None):
+    """Communication-overlap knobs, resolved kwarg -> env -> fleet
+    strategy -> default:
+
+      overlap  : bool — layer-grouped buckets + eager reduce-scatter +
+                 deferred/prefetched param all-gather
+                 (`PTPU_COMM_OVERLAP` / sharding_configs['comm_overlap']
+                 / engine kwarg `comm_overlap`);
+      prefetch : int — deferred-gather prefetch depth, groups in
+                 flight ahead of first use (`PTPU_COMM_PREFETCH` /
+                 sharding_configs['comm_overlap_prefetch'] /
+                 engine kwarg `prefetch_depth`);
+      chunk    : int — max full-bucket elements per collective
+                 (`PTPU_COMM_CHUNK` / sharding_configs['comm_chunk'] /
+                 engine kwarg `comm_chunk`; 0 = unchunked).
+    """
+    import os
+    sc = {}
+    try:
+        from ..distributed.fleet import fleet as _fleet
+        strategy = _fleet._user_defined_strategy
+        if strategy is not None:
+            sc = strategy.sharding_configs or {}
+    except Exception:
+        sc = {}
+    if overlap is None:
+        v = os.environ.get('PTPU_COMM_OVERLAP')
+        if v is not None and v != '':
+            overlap = v.lower() in ('1', 'true', 'yes')
+    if overlap is None:
+        overlap = sc.get('comm_overlap', False)
+    # a PRESENT env var wins over the strategy even when its value is
+    # falsy — PTPU_COMM_CHUNK=0 must be able to switch chunking off
+    if prefetch is None:
+        v = os.environ.get('PTPU_COMM_PREFETCH')
+        if v is not None and v != '':
+            prefetch = int(v)
+    if prefetch is None:
+        prefetch = sc.get('comm_overlap_prefetch')
+    if prefetch is None:
+        prefetch = DEFAULT_PREFETCH_DEPTH
+    if chunk is None:
+        v = os.environ.get('PTPU_COMM_CHUNK')
+        if v is not None and v != '':
+            chunk = int(v)
+    if chunk is None:
+        chunk = sc.get('comm_chunk')
+    if chunk is None:
+        chunk = 0
+    return bool(overlap), max(int(prefetch), 1), max(int(chunk), 0)
+
+
+# model-layer index: first numeric dotted path component of the
+# parameter name ('gpt.decoder.layers.3.linear1.weight' -> 3)
+_LAYER_IDX_RE = re.compile(r'(?:^|\.)(\d+)(?:\.|$)')
+
+
+def layer_group_fn(name, shape=None, dtype=None):
+    """Bucket grouping key for layer-grouped buckets: the FIRST numeric
+    path component of the dotted parameter name (model layer / block
+    order), 'stem' when the name carries none (embeddings, final
+    norms, heads). Zero-padded so group keys sort in layer order."""
+    m = _LAYER_IDX_RE.search(name)
+    return f'layer{int(m.group(1)):05d}' if m else 'stem'
+
+
+def ensure_overlap_xla_flags():
+    """Best-effort XLA scheduling flags for comm/compute overlap: the
+    latency-hiding scheduler + async collective fusion. XLA_FLAGS is
+    read once at backend initialization and engine builds run after
+    it, so for the flags to reach THIS process's compiler the
+    launcher must export PTPU_COMM_OVERLAP=1 — core/flags.py honors
+    that at first import, before any backend exists. This call (from
+    an engine build) records the intent in the flags registry and
+    updates the env for CHILD processes; explicit user settings (True
+    or False) are respected and never overridden."""
+    from . import flags as _flags
+    want = {}
+    for k in ('FLAGS_xla_latency_hiding_scheduler',
+              'FLAGS_xla_async_collectives'):
+        if _flags.flag(k) is None:
+            want[k] = True
+    if want:
+        _flags.set_flags(want)
 
 
 def block_len(n, want):
@@ -281,8 +394,26 @@ def take_shard(flat, axes, n_shards):
         flat, shard_index(axes) * shard_len, shard_len, axis=0)
 
 
+def _chunk_spans(shard_len, n_shards, chunk):
+    """Split points for chunked collectives (arXiv:2112.01075): `chunk`
+    caps the FULL-bucket elements per collective, so the piece width
+    along the shard dimension is chunk // n_shards. Returns a list of
+    (start, width) spans over [0, shard_len), or None when chunking is
+    off / the bucket already fits one chunk."""
+    if not chunk or n_shards < 1:
+        return None
+    w = max(int(chunk) // max(int(n_shards), 1), 1)
+    if shard_len <= w:
+        return None
+    spans, s = [], 0
+    while s < shard_len:
+        spans.append((s, min(w, shard_len - s)))
+        s += spans[-1][1]
+    return spans
+
+
 def reduce_scatter(flat, axes, n_shards, comm_dtype=None, mean=True,
-                   block=None):
+                   block=None, chunk=None):
     """SUM-reduce a flat bucket over `axes` and keep this rank's 1/n
     shard. With `comm_dtype` narrower than fp32 the payload moves
     compressed but the reduction runs in fp32 (all_to_all + local fp32
@@ -292,8 +423,24 @@ def reduce_scatter(flat, axes, n_shards, comm_dtype=None, mean=True,
     flat bucket (block = largest divisor of the shard length <=
     `block`, default DEFAULT_COMM_BLOCK) and travel beside the int8
     payload in a second all_to_all. Returns an fp32 shard (the
-    optimizer update math dtype) scaled to the mean when `mean`."""
+    optimizer update math dtype) scaled to the mean when `mean`.
+
+    `chunk` (elements, `PTPU_COMM_CHUNK`) decomposes an oversized
+    bucket into multiple collectives over shard-dimension slices —
+    schedulable pieces the latency-hiding scheduler can interleave
+    with compute. Each element is still reduced across the same ranks
+    in the same order, and pieces concatenate to the exact unchunked
+    shard layout, so the uncompressed result is bit-identical."""
     axes = tuple(axes)
+    spans = _chunk_spans(flat.shape[0] // n_shards, n_shards, chunk)
+    if spans:
+        view = flat.reshape(n_shards, -1)
+        return jnp.concatenate([
+            reduce_scatter(
+                lax.slice_in_dim(view, s, s + w, axis=1).reshape(-1),
+                axes, n_shards, comm_dtype=comm_dtype, mean=mean,
+                block=block)
+            for s, w in spans])
     if _is_int8(comm_dtype):
         shard_len = flat.shape[0] // n_shards
         b = block_len(shard_len, resolve_comm_block(block))
@@ -323,7 +470,8 @@ def reduce_scatter(flat, axes, n_shards, comm_dtype=None, mean=True,
     return shard
 
 
-def all_gather(shard, axes, comm_dtype=None, block=None):
+def all_gather(shard, axes, comm_dtype=None, block=None, chunk=None,
+               n_shards=None):
     """Reassemble the full flat bucket from per-rank shards (reverse
     axis order of the matching reduce_scatter/take_shard). With
     `comm_dtype='int8'` the param refresh is scale-carrying: each rank
@@ -331,8 +479,23 @@ def all_gather(shard, axes, comm_dtype=None, block=None):
     all-gather together, and every rank dequantizes — all ranks see
     the SAME (quantized) params, and the sharded optimizer state keeps
     the fp32 master, so the rounding does not accumulate step over
-    step. Result dtype follows the input shard."""
+    step. Result dtype follows the input shard.
+
+    `chunk` + `n_shards` enable the chunked variant (mirror of
+    reduce_scatter's): gather shard slices piecewise, then interleave
+    the [n_shards, w] pieces back into the exact rank-major flat
+    layout the unchunked gather produces."""
     axes = tuple(axes)
+    if n_shards:
+        spans = _chunk_spans(shard.shape[0], n_shards, chunk)
+        if spans:
+            pieces = [all_gather(
+                lax.slice_in_dim(shard, s, s + w), axes,
+                comm_dtype=comm_dtype, block=block)
+                for s, w in spans]
+            return jnp.concatenate(
+                [p.reshape(n_shards, -1) for p in pieces],
+                axis=1).reshape(-1)
     if not _is_int8(comm_dtype):
         for a in reversed(axes):
             shard = lax.all_gather(shard, a, axis=0, tiled=True)
@@ -344,6 +507,25 @@ def all_gather(shard, axes, comm_dtype=None, block=None):
         q = lax.all_gather(q, a, axis=0, tiled=True)
         scales = lax.all_gather(scales, a, axis=0, tiled=True)
     return dequantize_blocks(q, scales, b).astype(dt)
+
+
+def gather_groups(shards, axes, n_shards, comm_dtype=None, block=None,
+                  chunk=None, prefetch=None):
+    """Deferred/prefetched param all-gather over a list of 1-D bucket
+    shards (call inside shard_map bodies): gathers groups IN ORDER,
+    and with `prefetch` chains gather g behind gather g-prefetch via
+    `optimization_barrier`, so at most `prefetch` full groups are in
+    flight beyond the shards. The ONE home of the overlap gather
+    contract — both engines' step-top materialization and their
+    taps-mode re-gathers go through here."""
+    out = []
+    for gi, sh in enumerate(shards):
+        if prefetch and gi >= prefetch:
+            sh = lax.optimization_barrier((sh, out[gi - prefetch]))[0]
+        out.append(all_gather(sh, axes, comm_dtype=comm_dtype,
+                              block=block, chunk=chunk,
+                              n_shards=n_shards))
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -545,6 +727,29 @@ def named_states_to_flat(layout, named_states, template):
 # ---------------------------------------------------------------------------
 # telemetry: ptpu_comm_* gauges
 # ---------------------------------------------------------------------------
+def _bucket_wire(b, n_shards, comm_dtype=None, block=None):
+    """Per-bucket wire-byte split, the ONE home of the byte
+    convention (wire_bytes totals and the overlap seconds model both
+    read it): reduce_scatter moves gradients in `comm_dtype`
+    (param/bucket dtype when None); all_gather moves updated params
+    in their storage dtype; int8 mode moves int8 + fp32 block scales
+    on both legs."""
+    int8 = _is_int8(comm_dtype)
+    rs_item = 1 if int8 else jnp.dtype(comm_dtype or b.dtype).itemsize
+    ag_item = 1 if int8 else b.dtype.itemsize
+    scale_bytes = 0
+    if int8:
+        eb = block_len(max(b.size // max(n_shards, 1), 1),
+                       resolve_comm_block(block))
+        scale_bytes = (b.size // eb) * SCALE_ITEMSIZE
+    return {'reduce_scatter': {'payload': b.used * rs_item,
+                               'scale': scale_bytes,
+                               'pad': b.pad * rs_item},
+            'all_gather': {'payload': b.used * ag_item,
+                           'scale': scale_bytes,
+                           'pad': b.pad * ag_item}}
+
+
 def wire_bytes(layout, n_shards, comm_dtype=None, block=None):
     """Real per-rank wire bytes per step for a bucket layout, split
     into parameter payload vs overhead (the ISSUE-7 accounting audit):
@@ -554,28 +759,14 @@ def wire_bytes(layout, n_shards, comm_dtype=None, block=None):
            'scale':   <block-scale sidecar bytes (int8 mode only)>,
            'pad':     <zero-padding bytes>,
            'total':   payload + scale + pad}}
-
-    reduce_scatter moves gradients in `comm_dtype` (param/bucket dtype
-    when None); all_gather moves updated params in their storage dtype,
-    except int8 mode where both legs move int8 + fp32 block scales."""
-    int8 = _is_int8(comm_dtype)
-    want = resolve_comm_block(block)
+    """
     out = {'reduce_scatter': {'payload': 0, 'scale': 0, 'pad': 0},
            'all_gather': {'payload': 0, 'scale': 0, 'pad': 0}}
     for b in layout.buckets:
-        rs_item = (1 if int8
-                   else jnp.dtype(comm_dtype or b.dtype).itemsize)
-        ag_item = 1 if int8 else b.dtype.itemsize
-        scale_bytes = 0
-        if int8:
-            eb = block_len(max(b.size // max(n_shards, 1), 1), want)
-            scale_bytes = (b.size // eb) * SCALE_ITEMSIZE
-        out['reduce_scatter']['payload'] += b.used * rs_item
-        out['reduce_scatter']['pad'] += b.pad * rs_item
-        out['reduce_scatter']['scale'] += scale_bytes
-        out['all_gather']['payload'] += b.used * ag_item
-        out['all_gather']['pad'] += b.pad * ag_item
-        out['all_gather']['scale'] += scale_bytes
+        per = _bucket_wire(b, n_shards, comm_dtype, block)
+        for op, parts in out.items():
+            for k in parts:
+                parts[k] += per[op][k]
     for op in out.values():
         op['total'] = op['payload'] + op['scale'] + op['pad']
     return out
@@ -670,6 +861,94 @@ def publish_comm_gauges(layout, engine, n_shards, comm_dtype=None,
                    op='bucket_rs_ag')
 
 
+def _bucket_wire_totals(b, n_shards, comm_dtype=None, block=None):
+    """(reduce_scatter bytes, all_gather bytes) for ONE bucket —
+    payload + block scales + padding, straight from _bucket_wire so
+    the overlap seconds model can never drift from the byte gauges."""
+    per = _bucket_wire(b, n_shards, comm_dtype, block)
+    return (sum(per['reduce_scatter'].values()),
+            sum(per['all_gather'].values()))
+
+
+def overlap_seconds(layout, n_shards, comm_dtype=None, block=None,
+                    enabled=True):
+    """Trace-time exposed/hidden comm model for a bucket layout:
+    (total_s, exposed_s, hidden_s) at MODELED_ICI_BYTES_PER_S.
+
+    With overlap compiled in, a group's reduce-scatter hides under the
+    backward of the layers still to come, and its next-step all-gather
+    hides under the forward of the groups before it — EXCEPT group 0
+    (layer order): its grads complete last (backward ends at layer 0),
+    so its reduce-scatter has no compute left to hide under, and its
+    params are the first the forward needs, so its gather is on the
+    critical path. Exposed = group 0's rs+ag; hidden = the rest. With
+    overlap off (or a single group) every byte is exposed."""
+    per = [_bucket_wire_totals(b, n_shards, comm_dtype, block)
+           for b in layout.buckets]
+    total = sum(rs + ag for rs, ag in per) / MODELED_ICI_BYTES_PER_S
+    if not enabled or len(per) <= 1:
+        return total, total, 0.0
+    exposed = sum(per[0]) / MODELED_ICI_BYTES_PER_S
+    return total, exposed, total - exposed
+
+
+def publish_overlap_gauges(layout, engine, n_shards, comm_dtype=None,
+                           enabled=True, prefetch=None, chunk=0,
+                           block=None):
+    """Publish the ptpu_comm_overlap_* gauges for a bucket layout and
+    emit one profiler span per group (modeled bytes/seconds ride as
+    span args — the compiled step replays the same collectives every
+    step, so the model is trace-time like the byte gauges)."""
+    from . import monitor as _m
+    from .. import profiler as _prof
+    prefetch = int(prefetch or DEFAULT_PREFETCH_DEPTH)
+    groups = len(layout.buckets)
+    total_s, exposed_s, hidden_s = overlap_seconds(
+        layout, n_shards, comm_dtype, block, enabled=enabled)
+    g = _m.gauge
+    g('ptpu_comm_overlap_enabled',
+      help='1 when the overlapped (layer-grouped, deferred-gather) '
+           'comm schedule is compiled into the step',
+      labelnames=('engine',)).set(1 if enabled else 0, engine=engine)
+    g('ptpu_comm_overlap_groups',
+      help='layer-grouped gradient buckets per step',
+      labelnames=('engine',)).set(groups, engine=engine)
+    g('ptpu_comm_overlap_groups_in_flight',
+      help='param groups gathered ahead of first use (prefetch window '
+           'actually achievable with this layout)',
+      labelnames=('engine',)).set(
+          min(prefetch, groups) if enabled else 0, engine=engine)
+    g('ptpu_comm_overlap_prefetch_depth',
+      help='deferred-gather prefetch depth knob',
+      labelnames=('engine',)).set(prefetch, engine=engine)
+    g('ptpu_comm_overlap_chunk_elements',
+      help='PTPU_COMM_CHUNK collective decomposition cap '
+           '(0 = unchunked)',
+      labelnames=('engine',)).set(int(chunk or 0), engine=engine)
+    g('ptpu_comm_overlap_total_comm_seconds',
+      help='modeled per-step collective seconds at the ICI model '
+           'bandwidth',
+      labelnames=('engine',)).set(total_s, engine=engine)
+    g('ptpu_comm_overlap_exposed_comm_seconds',
+      help='modeled comm seconds NOT hidden under compute (group 0 '
+           'rs+ag when overlapped; everything when not)',
+      labelnames=('engine',)).set(exposed_s, engine=engine)
+    g('ptpu_comm_overlap_hidden_comm_seconds',
+      help='modeled comm seconds hidden under backward/forward '
+           'compute',
+      labelnames=('engine',)).set(hidden_s, engine=engine)
+    for b in layout.buckets:
+        rs_b, ag_b = _bucket_wire_totals(b, n_shards, comm_dtype, block)
+        with _prof.RecordEvent(
+                f'comm::group{b.index}', event_type='comm',
+                engine=engine, group=str(b.group), bucket=b.index,
+                rs_bytes=rs_b, ag_bytes=ag_b,
+                modeled_seconds=round(
+                    (rs_b + ag_b) / MODELED_ICI_BYTES_PER_S, 9),
+                hidden=bool(enabled and groups > 1 and b.index != 0)):
+            pass
+
+
 def comm_snapshot():
     """JSON-ready view of every ptpu_comm_* gauge (for
     StepTelemetry.snapshot / bench records / health_dump)."""
@@ -682,7 +961,14 @@ def comm_snapshot():
                  'ptpu_comm_overhead_bytes_per_step',
                  'ptpu_comm_block_elements',
                  'ptpu_comm_modeled_bytes_per_step',
-                 'ptpu_comm_compressed_fraction', 'ptpu_comm_enabled'):
+                 'ptpu_comm_compressed_fraction', 'ptpu_comm_enabled',
+                 'ptpu_comm_overlap_enabled', 'ptpu_comm_overlap_groups',
+                 'ptpu_comm_overlap_groups_in_flight',
+                 'ptpu_comm_overlap_prefetch_depth',
+                 'ptpu_comm_overlap_chunk_elements',
+                 'ptpu_comm_overlap_total_comm_seconds',
+                 'ptpu_comm_overlap_exposed_comm_seconds',
+                 'ptpu_comm_overlap_hidden_comm_seconds'):
         m = reg.get(name)
         if m is None:
             continue
@@ -731,6 +1017,30 @@ def comm_snapshot():
                 out.setdefault(
                     'comm_payload_factor_vs_per_param_psum', {})[
                     eng] = round(base / pay, 4)
+    # overlap headline (ISSUE 10): per-engine exposed vs hidden comm
+    # seconds + schedule shape — the dryrun/bench acceptance reads
+    # exposed_comm_seconds < total_comm_seconds here. A trace-time
+    # MODEL like the byte gauges; `enabled` says whether the overlapped
+    # schedule is actually compiled into the step.
+    ov_en = out.get('ptpu_comm_overlap_enabled') or {}
+    for key in ov_en:
+        eng = key.split('=', 1)[1]
+
+        def _ov(name, default=0):
+            return (out.get(f'ptpu_comm_overlap_{name}') or {}).get(
+                key, default)
+
+        out.setdefault('comm_overlap', {})[eng] = {
+            'enabled': bool(_ov('enabled')),
+            'groups': int(_ov('groups')),
+            'groups_in_flight': int(_ov('groups_in_flight')),
+            'prefetch_depth': int(_ov('prefetch_depth')),
+            'chunk_elements': int(_ov('chunk_elements')),
+            'total_comm_seconds': round(_ov('total_comm_seconds'), 9),
+            'exposed_comm_seconds': round(
+                _ov('exposed_comm_seconds'), 9),
+            'hidden_comm_seconds': round(_ov('hidden_comm_seconds'), 9),
+        }
     return out
 
 

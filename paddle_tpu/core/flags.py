@@ -70,7 +70,58 @@ _FLAGS = {
     # wrap op-kernel exceptions with [operator < name > error] context
     # (enforce.h framing; off by default to keep exception types exact)
     'FLAGS_op_error_context': False,
+    # XLA scheduling knobs for communication/compute overlap (ISSUE 10,
+    # docs/performance.md#comm-overlap). None = leave the compiler
+    # default; True/False edit XLA_FLAGS in the environment on set —
+    # effective only BEFORE backend initialization, so launchers export
+    # PTPU_COMM_OVERLAP=1 (honored at this module's import, below) or
+    # set FLAGS_xla_*/the env tokens directly. Engine builds also call
+    # bucketing.ensure_overlap_xla_flags(), which records intent and
+    # updates the env for child processes; user pins are respected.
+    'FLAGS_xla_latency_hiding_scheduler': None,
+    'FLAGS_xla_async_collectives': None,
 }
+
+# FLAGS_* -> the xla option tokens they drive in XLA_FLAGS
+_XLA_FLAG_TOKENS = {
+    'FLAGS_xla_latency_hiding_scheduler': (
+        'xla_tpu_enable_latency_hiding_scheduler',),
+    'FLAGS_xla_async_collectives': (
+        'xla_tpu_enable_async_collective_fusion',),
+}
+
+
+def _tpu_plausible():
+    """True when this process could plausibly initialize a TPU backend.
+    The xla_tpu_* option names only exist in TPU-enabled XLA builds —
+    a CPU-only jaxlib ABORTS the process on unknown XLA_FLAGS tokens,
+    and the env is inherited by every subprocess, so exporting them
+    unconditionally would be a landmine."""
+    plat = os.environ.get('JAX_PLATFORMS', '')
+    if plat:
+        return 'tpu' in plat.lower()
+    try:
+        import importlib.util
+        return importlib.util.find_spec('libtpu') is not None
+    except Exception:
+        return False
+
+
+def _apply_xla_flag(name, value):
+    """Reflect a True/False XLA flag into the XLA_FLAGS environment
+    (replacing any prior token for the same option). The backend reads
+    XLA_FLAGS once at initialization; a set after init is recorded in
+    the registry but cannot reach the already-built client. On a
+    non-TPU platform the registry records the value but the TPU-only
+    tokens are NOT exported (see _tpu_plausible)."""
+    if value is None or not _tpu_plausible():
+        return
+    val = 'true' if value else 'false'
+    toks = [t for t in os.environ.get('XLA_FLAGS', '').split()
+            if not any(t.startswith(f'--{opt}=')
+                       for opt in _XLA_FLAG_TOKENS[name])]
+    toks += [f'--{opt}={val}' for opt in _XLA_FLAG_TOKENS[name]]
+    os.environ['XLA_FLAGS'] = ' '.join(toks)
 
 
 def _seed_from_env():
@@ -84,17 +135,38 @@ def _seed_from_env():
                 _FLAGS[k] = int(v)
             elif isinstance(cur, float):
                 _FLAGS[k] = float(v)
+            elif cur is None and v.lower() in ('1', 'true', 'yes',
+                                               '0', 'false', 'no'):
+                # tri-state flags (None = auto): env seeds a real bool
+                _FLAGS[k] = v.lower() in ('1', 'true', 'yes')
             else:
                 _FLAGS[k] = v
+            if k in _XLA_FLAG_TOKENS:
+                _apply_xla_flag(k, _FLAGS[k])
 
 
 _seed_from_env()
+
+# comm/compute overlap (ISSUE 10): the XLA scheduling flags only reach
+# the compiler when exported BEFORE backend initialization, and engine
+# builds necessarily run after it — so the launcher contract
+# `PTPU_COMM_OVERLAP=1` is honored HERE, at first import of this
+# module, flipping any still-unset scheduling flag. Explicit
+# FLAGS_xla_* env settings were seeded above and take precedence.
+if os.environ.get('PTPU_COMM_OVERLAP', '').lower() in ('1', 'true',
+                                                       'yes'):
+    for _k in _XLA_FLAG_TOKENS:
+        if _FLAGS.get(_k) is None:
+            _FLAGS[_k] = True
+            _apply_xla_flag(_k, True)
 
 
 def set_flags(flags):
     """Parity: paddle.set_flags({'FLAGS_x': v})."""
     for k, v in flags.items():
         _FLAGS[k] = v
+        if k in _XLA_FLAG_TOKENS:
+            _apply_xla_flag(k, v)
 
 
 def get_flags(keys):

@@ -97,6 +97,19 @@ def _arr_nbytes(a):
             return 0
 
 
+def device_nbytes(a):
+    """Bytes `a` actually occupies across its addressable devices —
+    the census view that distinguishes a REPLICATED array (ndev x
+    logical bytes) from a sharded one (1 x). `a.nbytes` is the global
+    LOGICAL size either way, which hides exactly the resident-set win
+    the deferred-gather engines buy (docs/performance.md#comm-overlap),
+    so the overlap acceptance tests measure with this."""
+    try:
+        return int(sum(int(s.data.nbytes) for s in a.addressable_shards))
+    except Exception:
+        return _arr_nbytes(a)
+
+
 class DeviceOOMError(RuntimeError):
     """RESOURCE_EXHAUSTED enriched with the forensics report. `.report`
     holds the JSON-ready dict; str() renders the human table."""
@@ -154,6 +167,10 @@ class MemoryAccountant:
             nbytes = sum(_arr_nbytes(a) for a in arrs)
             out['live_buffers'] = len(arrs)
             out['live_bytes'] = nbytes
+            # replication-aware twin: what the buffers occupy across
+            # the addressable devices (live_bytes counts logical size)
+            out['live_device_bytes'] = sum(device_nbytes(a)
+                                           for a in arrs)
             if in_use is None:
                 out['bytes_in_use'] = nbytes
                 with self._lock:

@@ -32,7 +32,17 @@ _DEFAULTS = {
         'sharding_degree': 8, 'mp_degree': 1, 'pp_degree': 1, 'dp_degree': 1,
         'hybrid_dp': False, 'gradient_merge_acc_step': 1,
         'optimize_offload': False, 'stage': 1,
-        'pp_allreduce_in_optimize': False, 'optimize_cast': False},
+        'pp_allreduce_in_optimize': False, 'optimize_cast': False,
+        # communication/compute overlap for the bucketed SPMD engines
+        # (ISSUE 10, docs/performance.md#comm-overlap): layer-grouped
+        # buckets + eager reduce-scatter + deferred/prefetched param
+        # all-gather; 'comm_overlap_prefetch' bounds the param groups
+        # gathered ahead of first use; 'comm_chunk' (elements, 0=off)
+        # decomposes oversized bucket collectives into schedulable
+        # pieces (PTPU_COMM_OVERLAP / PTPU_COMM_PREFETCH /
+        # PTPU_COMM_CHUNK env twins)
+        'comm_overlap': False, 'comm_overlap_prefetch': 2,
+        'comm_chunk': 0},
     'tensor_parallel': False,
     'tensor_parallel_configs': {'tensor_parallel_degree': 1,
                                 'tensor_init_seed': -1},

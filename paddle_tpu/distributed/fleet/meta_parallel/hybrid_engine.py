@@ -68,7 +68,8 @@ class HybridParallelTrainStep(EngineTeardown):
     def __init__(self, model, loss_fn, optimizer, mesh=None,
                  accumulate_steps=1, use_remat=False, sp_shard_args=None,
                  use_buckets=None, comm_dtype=None, bucket_mb=None,
-                 comm_block=None):
+                 comm_block=None, comm_overlap=None, prefetch_depth=None,
+                 comm_chunk=None):
         self.sp_shard_args = sp_shard_args
         self.model = model
         self.loss_fn = loss_fn
@@ -114,6 +115,13 @@ class HybridParallelTrainStep(EngineTeardown):
         self.comm_dtype, self._bucket_bytes = B.resolve_comm_config(
             comm_dtype, bucket_mb)
         self._comm_block = B.resolve_comm_block(comm_block)
+        # comm/compute overlap (ISSUE 10): layer-grouped buckets +
+        # eager reduce-scatter + deferred/prefetched param all-gather.
+        # Grouping only engages when there is real comm to overlap
+        # (n_shards > 1) so the dp=1 compiled program stays unchanged.
+        overlap_req, self._prefetch_depth, self._comm_chunk = \
+            B.resolve_overlap_config(comm_overlap, prefetch_depth,
+                                     comm_chunk)
         # mp-sharded params are already distributed (their state shards
         # with them); they keep the per-param path
         bucketable = [n for n, p in named
@@ -126,23 +134,54 @@ class HybridParallelTrainStep(EngineTeardown):
                      self._params_by_name[n].data.dtype)
                  for n in bucketable},
                 bucket_bytes=self._bucket_bytes,
-                pad_to=max(self._n_shards, 1) * 8)
+                pad_to=max(self._n_shards, 1) * 8,
+                group_fn=(B.layer_group_fn
+                          if overlap_req and self._n_shards > 1
+                          else None))
         self._bucketed = bool(
             self._layout is not None and self._n_shards > 1
             and use_buckets is not False)
+        self._overlap = bool(overlap_req and self._bucketed)
+        if self._overlap:
+            B.ensure_overlap_xla_flags()
         if self._layout is not None:
             B.publish_comm_gauges(self._layout, engine='hybrid',
                                   n_shards=max(self._n_shards, 1),
                                   comm_dtype=self.comm_dtype,
                                   enabled=self._bucketed,
                                   block=self._comm_block)
+            B.publish_overlap_gauges(self._layout, engine='hybrid',
+                                     n_shards=max(self._n_shards, 1),
+                                     comm_dtype=self.comm_dtype,
+                                     enabled=self._overlap,
+                                     prefetch=self._prefetch_depth,
+                                     chunk=self._comm_chunk,
+                                     block=self._comm_block)
         if not self._bucketed:
             self._layout = None
 
         from ....core import memory as _mem
         with _mem.phase('engine.init'):
+            # deferred gather: bucketed params live as flat 1/n SHARDS
+            # between steps (ZeRO-3-style resident set); the full
+            # replica only exists transiently inside the step, gathered
+            # group-by-group just before first use
+            slot_names = set(self._layout.slots) if self._overlap \
+                else set()
             self._params = {n: self._place(p.data, self._param_specs[n])
-                            for n, p in named}
+                            for n, p in named if n not in slot_names}
+            self._param_shards = []
+            if self._overlap:
+                shard_spec = P(self._rs_axes)
+                for b in self._layout.buckets:
+                    host = np.zeros((b.size,), b.dtype)
+                    for s in b.slots:
+                        host[s.offset:s.offset + s.size] = np.asarray(
+                            jax.device_get(
+                                self._params_by_name[s.name].data)
+                        ).reshape(-1).astype(b.dtype)
+                    self._param_shards.append(
+                        self._place_flat(host, shard_spec))
             self._states = {'named': {}, 'buckets': []}
             self._state_specs = {'named': {}, 'buckets': []}
             legacy_names = set(self._names) if not self._bucketed else \
@@ -259,6 +298,9 @@ class HybridParallelTrainStep(EngineTeardown):
         n_shards = self._n_shards
         comm_dtype = self.comm_dtype
         comm_block = self._comm_block
+        overlap = self._overlap
+        prefetch_depth = self._prefetch_depth
+        comm_chunk = self._comm_chunk
 
         def clip_factor(gn_sq_val):
             from ....nn.clip import ClipGradByGlobalNorm
@@ -279,6 +321,25 @@ class HybridParallelTrainStep(EngineTeardown):
 
         def step(params, states, lr, key, *batch):
             with C.spmd_region(axes, sp_data_sharded=sp_on):
+                # -- deferred/prefetched param all-gather (overlap
+                # mode): bucketed params arrive as 1/n shards; rebuild
+                # the working replica group-by-group IN LAYER ORDER at
+                # the top of the step, where the latency-hiding
+                # scheduler can run group g's gather under the forward
+                # compute of groups < g. `prefetch_depth` bounds the
+                # in-flight window: an optimization_barrier makes
+                # gather g data-depend on gather g-depth, so at most
+                # `depth` full groups are live beyond the shards.
+                shards_in = None
+                if overlap:
+                    shards_in = params['shards']
+                    gathered_p = B.gather_groups(
+                        shards_in, rs_axes, n_shards,
+                        comm_dtype=comm_dtype, block=comm_block,
+                        chunk=comm_chunk, prefetch=prefetch_depth)
+                    params = dict(params['named'])
+                    params.update(layout.unflatten(gathered_p))
+
                 def loss_of(ps):
                     with bind_arrays(model, ps):
                         # fold data-parallel position into the key so dp
@@ -363,12 +424,18 @@ class HybridParallelTrainStep(EngineTeardown):
                 if dp_axes:
                     legacy = {n: lax.pmean(g, dp_axes)
                               for n, g in legacy.items()}
+                # layer-grouped buckets: each flat bucket depends only
+                # on ITS layers' grads, so its reduce-scatter is
+                # emitted as soon as those grads exist instead of
+                # serializing behind the full backward; `chunk` splits
+                # oversized buckets into schedulable pieces
                 flat_grads = layout.flatten(
                     {n: raw_grads[n] for n in layout.slots})
                 shards32 = [B.reduce_scatter(f, rs_axes, n_shards,
                                              comm_dtype=comm_dtype,
                                              mean=True,
-                                             block=comm_block)
+                                             block=comm_block,
+                                             chunk=comm_chunk)
                             for f in flat_grads]
 
                 # taps diagnostics mode pays an extra pmean to surface
@@ -405,24 +472,39 @@ class HybridParallelTrainStep(EngineTeardown):
                               .astype(g.dtype)
                               for n, g in legacy.items()}
 
-                flat_params = layout.flatten(params)
+                flat_params = None if overlap else layout.flatten(params)
                 new_params, new_named = {}, {}
                 new_buckets = []
-                gathered = []
-                for b, pf, g32, st in zip(layout.buckets, flat_params,
-                                          shards32, states['buckets']):
-                    p_shard = B.take_shard(pf, rs_axes, n_shards)
+                new_shards, gathered = [], []
+                for gi, (b, g32, st) in enumerate(
+                        zip(layout.buckets, shards32,
+                            states['buckets'])):
+                    # overlap: this rank's param shard IS the engine
+                    # state (same values take_shard would slice out of
+                    # the gathered replica — fp32/bf16 wires gather
+                    # exactly, and under int8 the forced master makes
+                    # the update independent of the working copy)
+                    p_shard = shards_in[gi] if overlap else \
+                        B.take_shard(flat_params[gi], rs_axes, n_shards)
                     # the clip multiply rides into the one-pass fused
                     # update as `prefactor` instead of a separate
                     # bucket-sized elementwise op
                     np_, ns = B.shard_update(self.optimizer, p_shard,
                                              g32, st, lr,
                                              prefactor=factor)
-                    gathered.append(B.all_gather(np_, rs_axes,
-                                                 comm_dtype=comm_dtype,
-                                                 block=comm_block))
+                    if overlap:
+                        # deferred gather: the updated shard goes back
+                        # out as engine state; its all-gather moves to
+                        # the NEXT step's forward, just before first use
+                        new_shards.append(np_)
+                    else:
+                        gathered.append(B.all_gather(
+                            np_, rs_axes, comm_dtype=comm_dtype,
+                            block=comm_block, chunk=comm_chunk,
+                            n_shards=n_shards))
                     new_buckets.append(ns)
-                new_params.update(layout.unflatten(gathered))
+                if not overlap:
+                    new_params.update(layout.unflatten(gathered))
                 for n, g in legacy.items():
                     p = params[n]
                     st = dict(named_states[n])
@@ -445,11 +527,25 @@ class HybridParallelTrainStep(EngineTeardown):
                     new_params[n] = np_
                     new_named[n] = ns
                 new_states = {'named': new_named, 'buckets': new_buckets}
+                out_params = {'named': new_params,
+                              'shards': new_shards} if overlap \
+                    else new_params
                 if taps_on:
-                    taps = _num.jit_taps(preclip_grads, new_params,
+                    tap_params = new_params
+                    if overlap:
+                        # diagnostics mode pays the gather the hot path
+                        # deferred, so per-param stats see full params
+                        tap_params = dict(new_params)
+                        tap_params.update(layout.unflatten(
+                            B.gather_groups(new_shards, rs_axes,
+                                            n_shards,
+                                            comm_dtype=comm_dtype,
+                                            block=comm_block,
+                                            chunk=comm_chunk)))
+                    taps = _num.jit_taps(preclip_grads, tap_params,
                                          extra_norm_sq=gn_sq)
-                    return loss, new_params, new_states, taps
-                return loss, new_params, new_states
+                    return loss, out_params, new_states, taps
+                return loss, out_params, new_states
 
         # sequence sharding only for models that declare support (GPT sets
         # _supports_sequence_parallel; others would silently attend within
@@ -478,11 +574,18 @@ class HybridParallelTrainStep(EngineTeardown):
         batch_specs = tuple(_bspec(i, nd)
                             for i, nd in enumerate(self._batch_ndims))
         self._batch_specs = batch_specs
-        in_specs = (self._param_specs, self._state_specs, P(), P(),
+        if self._overlap:
+            pspecs = {'named': {n: self._param_specs[n]
+                                for n in self._params},
+                      'shards': [P(self._rs_axes)
+                                 for _ in self._layout.buckets]}
+        else:
+            pspecs = self._param_specs
+        in_specs = (pspecs, self._state_specs, P(), P(),
                     *batch_specs)
-        out_specs = (P(), self._param_specs, self._state_specs)
+        out_specs = (P(), pspecs, self._state_specs)
         if taps_on:
-            names = list(self._params)
+            names = list(self._names)
             out_specs = out_specs + (_num.taps_spec(
                 {'grads': dict.fromkeys(names, 0),
                  'params': dict.fromkeys(names, 0),
@@ -533,14 +636,22 @@ class HybridParallelTrainStep(EngineTeardown):
                 self._compiled = self._build()
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         key = rng_mod.next_key()
+        p_arg = {'named': self._params, 'shards': self._param_shards} \
+            if self._overlap else self._params
         with self._step_guard(first, 'hybrid.train_step', 'hybrid.step'):
             out = self._compiled(
-                self._params, self._states, lr, key, *arrays)
+                p_arg, self._states, lr, key, *arrays)
         if getattr(self, '_taps_on', False):
-            loss, self._params, self._states, taps = out
-            self._process_taps(taps, 'hybrid')
+            loss, p_out, self._states, taps = out
         else:
-            loss, self._params, self._states = out
+            loss, p_out, self._states = out
+        if self._overlap:
+            self._params = p_out['named']
+            self._param_shards = p_out['shards']
+        else:
+            self._params = p_out
+        if getattr(self, '_taps_on', False):
+            self._process_taps(taps, 'hybrid')
         self._step_count += 1
         return Tensor(loss)
 
@@ -556,11 +667,30 @@ class HybridParallelTrainStep(EngineTeardown):
         self.last_numerics = _num.process_jit_taps(
             taps, site=site, step=self._step_count, meta=meta)
 
+    def _host_bucket_params(self):
+        """{name: host array} for bucketed slots, reconstructed from
+        the flat param shards (overlap mode). These are the EXACT
+        updated values — under an int8 wire the compiled forward sees
+        the block-rounded gathered copy, but the shards (backed by the
+        sharded fp32 master) are the trajectory, so checkpoints and
+        sync_model round-trip without wire rounding
+        (docs/performance.md#comm-overlap)."""
+        out = {}
+        for b, sh in zip(self._layout.buckets, self._param_shards):
+            host = np.asarray(jax.device_get(sh))
+            for s in b.slots:
+                out[s.name] = host[s.offset:s.offset + s.size] \
+                    .reshape(s.shape)
+        return out
+
     def sync_model(self):
         """Write updated params back into the eager Layer."""
         self._ensure_open()
         for n, arr in self._params.items():
             self._params_by_name[n]._data = arr
+        if self._overlap:
+            for n, arr in self._host_bucket_params().items():
+                self._params_by_name[n]._data = jnp.asarray(arr)
 
     # shutdown()/close() from EngineTeardown
 
@@ -581,6 +711,9 @@ class HybridParallelTrainStep(EngineTeardown):
         out = {'params': {}, 'states': {}}
         for n, a in self._params.items():
             out['params'][n] = _np.asarray(_jax.device_get(a))
+        if self._overlap:
+            for n, a in self._host_bucket_params().items():
+                out['params'][n] = _np.asarray(a)
         for n, st in self._states['named'].items():
             out['states'][n] = {k: _np.asarray(_jax.device_get(v))
                                 for k, v in st.items()}
@@ -599,6 +732,23 @@ class HybridParallelTrainStep(EngineTeardown):
         for n, a in sd['params'].items():
             if n in self._params:
                 self._params[n] = self._place(a, self._param_specs[n])
+        if self._overlap:
+            # rebuild the flat param shards from the per-param schema
+            # (missing params keep their current shard values)
+            shard_spec = P(self._rs_axes)
+            for i, b in enumerate(self._layout.buckets):
+                host = _np.array(
+                    _jax.device_get(self._param_shards[i]), copy=True)
+                touched = False
+                for s in b.slots:
+                    if s.name in sd['params']:
+                        host[s.offset:s.offset + s.size] = _np.asarray(
+                            sd['params'][s.name]).reshape(-1) \
+                            .astype(host.dtype)
+                        touched = True
+                if touched:
+                    self._param_shards[i] = self._place_flat(
+                        host, shard_spec)
         named_sd = dict(sd.get('states', {}))
         if self._bucketed:
             template = [{k: _np.asarray(_jax.device_get(v))

@@ -57,6 +57,10 @@ class EngineTeardown:
                 self._compiled_by_mode = {}
             self._params = None
             self._states = None
+            if hasattr(self, '_param_shards'):
+                # deferred-gather engines (comm_overlap) keep bucketed
+                # params as flat shards beside _params
+                self._param_shards = None
             self._closed = True
             import gc
             gc.collect()     # the donated-buffer graph can hold cycles

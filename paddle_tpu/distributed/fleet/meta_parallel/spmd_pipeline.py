@@ -174,7 +174,8 @@ class SpmdPipelineEngine(EngineTeardown):
                  mesh=None, use_remat=True, schedule='1F1B',
                  grad_accum_dtype='float32', memory_mode='stash',
                  use_buckets=None, comm_dtype=None, bucket_mb=None,
-                 comm_block=None):
+                 comm_block=None, comm_overlap=None, prefetch_depth=None,
+                 comm_chunk=None):
         self.embed = embed
         self.blocks = blocks
         self.head = head
@@ -246,6 +247,12 @@ class SpmdPipelineEngine(EngineTeardown):
                 'head': {n: self._place(p.data, head_specs[n])
                          for n, p in self._head_named},
             }
+            # shapes snapshot for taps meta (overlap mode later moves
+            # bucketed slots out of the group trees)
+            self._tap_shapes = {
+                f'{grp}/{n}': (tuple(a.shape), a.dtype)
+                for grp in ('embed', 'blocks', 'head')
+                for n, a in self._params[grp].items()}
 
             # -- bucketed rs/ag weight-update sharding over 'dp'
             # (arXiv:2004.13336): grads coalesce into flat buckets, each
@@ -256,6 +263,15 @@ class SpmdPipelineEngine(EngineTeardown):
             self.comm_dtype, self._bucket_bytes = B.resolve_comm_config(
                 comm_dtype, bucket_mb)
             self._comm_block = B.resolve_comm_block(comm_block)
+            # comm/compute overlap (ISSUE 10): deferred/prefetched param
+            # all-gather + chunked collectives over 'dp' (the pipeline's
+            # grads only complete at scan end, so the eager-rs leg of
+            # the overlap story is the hybrid engine's; here the win is
+            # the gather moved under the next step's forward + the
+            # sharded resident param set)
+            overlap_req, self._prefetch_depth, self._comm_chunk = \
+                B.resolve_overlap_config(comm_overlap, prefetch_depth,
+                                         comm_chunk)
             dp_on_init = 'dp' in self.axes and self.mesh.shape['dp'] > 1
             self._pp_layout = None
             mp_on = 'mp' in self.axes and self.mesh.shape['mp'] > 1
@@ -281,6 +297,9 @@ class SpmdPipelineEngine(EngineTeardown):
             self._pp_bucketed = bool(
                 self._pp_layout is not None and dp_on_init
                 and use_buckets is not False)
+            self._pp_overlap = bool(overlap_req and self._pp_bucketed)
+            if self._pp_overlap:
+                B.ensure_overlap_xla_flags()
             if self._pp_layout is not None:
                 accum_fp32 = self.grad_accum_dtype != 'param'
                 B.publish_comm_gauges(
@@ -290,8 +309,22 @@ class SpmdPipelineEngine(EngineTeardown):
                         jnp.float32 if accum_fp32 else None),
                     enabled=self._pp_bucketed,
                     block=self._comm_block)
+                B.publish_overlap_gauges(
+                    self._pp_layout, engine='pipeline',
+                    n_shards=max(self.dp, 1),
+                    comm_dtype=self.comm_dtype or (
+                        jnp.float32 if accum_fp32 else None),
+                    enabled=self._pp_overlap,
+                    prefetch=self._prefetch_depth,
+                    chunk=self._comm_chunk,
+                    block=self._comm_block)
             if not self._pp_bucketed:
                 self._pp_layout = None
+            if self._pp_overlap:
+                # deferred gather: bucketed params live as [pp, size/dp]
+                # shards between steps; the full trees only exist inside
+                # the step (materialized group-by-group before use)
+                self._build_param_shards(stacked)
 
             # optimizer state mirrors the param tree (per-param states
             # only for params outside the bucket layout)
@@ -380,6 +413,67 @@ class SpmdPipelineEngine(EngineTeardown):
                     sspec[k] = P()
             self._states['_buckets'].append(placed)
             self._state_specs['_buckets'].append(sspec)
+
+    def _build_param_shards(self, stacked):
+        """Overlap mode: move every bucketed param out of the group
+        trees into flat [pp, bucket_size] arrays sharded P('pp','dp')
+        — each device keeps only the [1, size/dp] slice it updates.
+        Blocks rows are stage-local; embed/head rows replicate (same
+        per-device bytes, one uniform spec — the flat-state layout)."""
+        pp = max(self.pp, 1)
+        pp_ax = 'pp' if 'pp' in self.axes else None
+        spec = P(pp_ax, 'dp')
+        layout = self._pp_layout
+        shards = []
+        for b in layout.buckets:
+            host = np.zeros((pp, b.size), b.dtype)
+            for s in b.slots:
+                grp, n = s.name.split('/', 1)
+                if grp == 'blocks':
+                    arr = np.asarray(jax.device_get(stacked[n]))
+                    per = arr.shape[0] // pp
+                    for k in range(pp):
+                        host[k, s.offset:s.offset + s.size] = \
+                            arr[k * per:(k + 1) * per].reshape(-1) \
+                            .astype(b.dtype)
+                else:
+                    named = dict(self._embed_named if grp == 'embed'
+                                 else self._head_named)
+                    row = np.asarray(
+                        jax.device_get(named[n].data)).reshape(-1) \
+                        .astype(b.dtype)
+                    host[:, s.offset:s.offset + s.size] = row
+            sharding = NamedSharding(self.mesh, spec)
+            shards.append(jax.make_array_from_callback(
+                host.shape, sharding, lambda idx, _h=host: _h[idx]))
+        for s in layout.slots.values():
+            grp, n = s.name.split('/', 1)
+            self._params[grp].pop(n, None)
+            self._specs[grp].pop(n, None)
+        self._params['_shards'] = shards
+        self._specs['_shards'] = [spec] * len(shards)
+
+    def _materialize_params(self, params):
+        """Deferred/prefetched param all-gather (overlap): rebuild the
+        full embed/blocks/head trees from the [1, size/dp] local shard
+        views at the top of the step, group by group, chaining gather g
+        behind gather g-prefetch_depth via optimization_barrier so at
+        most `prefetch_depth` full groups are in flight beyond the
+        shards. Passthrough when overlap is off."""
+        if not getattr(self, '_pp_overlap', False):
+            return params
+        layout = self._pp_layout
+        gathered = B.gather_groups(
+            [sh[0] for sh in params['_shards']], ('dp',), self.dp,
+            comm_dtype=self.comm_dtype, block=self._comm_block,
+            chunk=self._comm_chunk, prefetch=self._prefetch_depth)
+        out = {grp: dict(params[grp])
+               for grp in ('embed', 'blocks', 'head')}
+        for k, v in layout.unflatten(gathered).items():
+            grp, n = k.split('/', 1)
+            out[grp][n] = v
+        out['_shards'] = params['_shards']
+        return out
 
     def _place(self, arr, spec):
         # copy before placing: device_put to a (partially) replicated
@@ -582,7 +676,8 @@ class SpmdPipelineEngine(EngineTeardown):
         shards32 = [B.reduce_scatter(f, ('dp',), self.dp,
                                      comm_dtype=self.comm_dtype,
                                      mean=True,
-                                     block=self._comm_block)
+                                     block=self._comm_block,
+                                     chunk=self._comm_chunk)
                     for f in flat_grads]
 
         # trace-time telemetry: rs+ag wire bytes (scales + padding
@@ -650,17 +745,23 @@ class SpmdPipelineEngine(EngineTeardown):
                 sq_b = lax.psum(sq_b, 'pp')
             gn_sq = sq_eh + sq_b
 
-        slot_params = {k: params[k.split('/', 1)[0]][k.split('/', 1)[1]]
-                       for k in layout.slots}
-        flat_params = layout.flatten(slot_params)
-        new_flat, new_buckets = [], []
-        for b, pf, g32, st_in in zip(layout.buckets, flat_params,
-                                     shards32, states['_buckets']):
+        overlap = getattr(self, '_pp_overlap', False)
+        if not overlap:
+            slot_params = {k: params[k.split('/', 1)[0]]
+                           [k.split('/', 1)[1]]
+                           for k in layout.slots}
+            flat_params = layout.flatten(slot_params)
+        new_flat, new_shards, new_buckets = [], [], []
+        for gi, (b, g32, st_in) in enumerate(
+                zip(layout.buckets, shards32, states['_buckets'])):
             # local vector-state view is [1, shard]: drop/restore the
             # leading pp dim around the flat update
             st = {k: (v[0] if getattr(v, 'ndim', 0) >= 2 else v)
                   for k, v in st_in.items()}
-            p_shard = B.take_shard(pf, ('dp',), self.dp)
+            # overlap: this rank's stored param shard IS the slice
+            # take_shard would cut out of the materialized replica
+            p_shard = params['_shards'][gi][0] if overlap else \
+                B.take_shard(flat_params[gi], ('dp',), self.dp)
             # unscale multiply + found-inf no-op guard fold into the
             # one-pass fused update (prefactor/found_inf); the
             # reference route applies the same ops in the same order
@@ -670,16 +771,27 @@ class SpmdPipelineEngine(EngineTeardown):
             new_buckets.append(
                 {k: (v[None] if getattr(v, 'ndim', 0) >= 1 else v)
                  for k, v in ns.items()})
-            new_flat.append(B.all_gather(np_, ('dp',),
-                                         comm_dtype=self.comm_dtype,
-                                         block=self._comm_block))
+            if overlap:
+                # deferred gather: the updated shard is the engine
+                # state; its all-gather runs at the NEXT step's top,
+                # under that step's early forward compute
+                new_shards.append(np_[None])
+            else:
+                new_flat.append(B.all_gather(np_, ('dp',),
+                                             comm_dtype=self.comm_dtype,
+                                             block=self._comm_block,
+                                             chunk=self._comm_chunk,
+                                             n_shards=self.dp))
 
         new_params = {'embed': {}, 'blocks': {}, 'head': {}}
         new_states = {'embed': {}, 'blocks': {}, 'head': {},
                       '_buckets': new_buckets}
-        for k, v in layout.unflatten(new_flat).items():
-            grp, n = k.split('/', 1)
-            new_params[grp][n] = v
+        if overlap:
+            new_params['_shards'] = new_shards
+        else:
+            for k, v in layout.unflatten(new_flat).items():
+                grp, n = k.split('/', 1)
+                new_params[grp][n] = v
         for k, g in legacy.items():
             grp, n = k.split('/', 1)
             p = params[grp][n]
@@ -698,6 +810,15 @@ class SpmdPipelineEngine(EngineTeardown):
             flat_params_tap = {f'{grp}/{n}': p
                                for grp in ('embed', 'blocks', 'head')
                                for n, p in new_params[grp].items()}
+            if overlap:
+                # diagnostics mode pays the gather the hot path
+                # deferred, so per-param stats see full params
+                flat_params_tap.update(layout.unflatten(
+                    B.gather_groups([s2[0] for s2 in new_shards],
+                                    ('dp',), self.dp,
+                                    comm_dtype=self.comm_dtype,
+                                    block=self._comm_block,
+                                    chunk=self._comm_chunk)))
             taps = _num.jit_taps(tap_grads, flat_params_tap,
                                  extra_norm_sq=gn_sq)
             return loss, new_params, new_states, found_inf, taps
@@ -710,8 +831,11 @@ class SpmdPipelineEngine(EngineTeardown):
         out_specs = (P(), self._specs, self._state_specs, P())
         if getattr(self, '_taps_on', False):
             from ....core import numerics as _num
-            keys = [f'{grp}/{n}' for grp in ('embed', 'blocks', 'head')
-                    for n in self._params[grp]]
+            # ALL trainable params (overlap mode keeps bucketed slots
+            # out of the group trees, but taps still cover them)
+            keys = [f'embed/{n}' for n, _ in self._embed_named] \
+                + [f'blocks/{n}' for n, _ in self._block_named] \
+                + [f'head/{n}' for n, _ in self._head_named]
             out_specs = out_specs + (_num.taps_spec(
                 {'grads': dict.fromkeys(keys, 0),
                  'params': dict.fromkeys(keys, 0),
@@ -841,6 +965,7 @@ class SpmdPipelineEngine(EngineTeardown):
 
         def step(params, states, lr, scale, key, input_ids, labels):
             with C.spmd_region(axes):
+                params = self._materialize_params(params)
                 stage = lax.axis_index('pp') if pp > 1 else 0
                 is_last = stage == pp - 1
                 mb = input_ids.shape[0] // A
@@ -1123,6 +1248,7 @@ class SpmdPipelineEngine(EngineTeardown):
 
         def step(params, states, lr, scale, key, input_ids, labels):
             with C.spmd_region(axes):
+                params = self._materialize_params(params)
                 stage = lax.axis_index('pp') if pp > 1 else 0
                 mb = input_ids.shape[0] // A
 
@@ -1313,12 +1439,8 @@ class SpmdPipelineEngine(EngineTeardown):
             return found_host
         taps = taps_host    # already on host: the fetch inside
                             # process_jit_taps is a free no-op
-        meta = {}
-        for kind in ('grads', 'params'):
-            meta[kind] = {
-                f'{grp}/{n}': (a.shape, a.dtype)
-                for grp in ('embed', 'blocks', 'head')
-                for n, a in self._params[grp].items()}
+        meta = {kind: dict(self._tap_shapes)
+                for kind in ('grads', 'params')}
         self.last_numerics = _num.process_jit_taps(
             taps, site='pipeline', step=getattr(self, '_pp_step', None),
             meta=meta)
@@ -1327,12 +1449,45 @@ class SpmdPipelineEngine(EngineTeardown):
     def sync_model(self):
         self._ensure_open()
         for n, p in self._embed_named:
-            p._data = self._params['embed'][n]
+            if n in self._params['embed']:
+                p._data = self._params['embed'][n]
         for n, p in self._head_named:
-            p._data = self._params['head'][n]
+            if n in self._params['head']:
+                p._data = self._params['head'][n]
         for i, b in enumerate(self.blocks):
             lookup = dict(b.named_parameters())
             for n, _ in self._block_named:
-                lookup[n]._data = self._params['blocks'][n][i]
+                if n in self._params['blocks']:
+                    lookup[n]._data = self._params['blocks'][n][i]
+        if getattr(self, '_pp_overlap', False):
+            # reconstruct bucketed params from the [pp, size] flat
+            # shards: blocks rows are stage-local slices in stage
+            # order; embed/head rows replicate (row 0 is the value).
+            # These are the EXACT updated values — under an int8 wire
+            # the compiled forward sees the block-rounded gathered
+            # copy, but the shards are the trajectory
+            # (docs/performance.md#comm-overlap).
+            pp = max(self.pp, 1)
+            blk_lookup = [dict(b.named_parameters())
+                          for b in self.blocks]
+            for b, sh in zip(self._pp_layout.buckets,
+                             self._params['_shards']):
+                host = np.asarray(jax.device_get(sh))  # [pp, size]
+                for s in b.slots:
+                    grp, n = s.name.split('/', 1)
+                    if grp == 'blocks':
+                        per = s.shape[0]
+                        for k in range(pp):
+                            rows = host[k, s.offset:s.offset + s.size] \
+                                .reshape(s.shape)
+                            for j in range(per):
+                                blk_lookup[k * per + j][n]._data = \
+                                    jnp.asarray(rows[j])
+                    else:
+                        named = dict(self._embed_named if grp == 'embed'
+                                     else self._head_named)
+                        named[n]._data = jnp.asarray(
+                            host[0, s.offset:s.offset + s.size]
+                            .reshape(s.shape))
 
     # shutdown()/close() from EngineTeardown

@@ -106,7 +106,8 @@ class TrainStep:
     """
 
     def __init__(self, model, loss_fn, optimizer, donate=True,
-                 use_buckets=None):
+                 use_buckets=None, comm_overlap=None, prefetch_depth=None,
+                 comm_chunk=None):
         from ..core import bucketing as B
         self.model = model
         self.loss_fn = loss_fn
@@ -127,6 +128,14 @@ class TrainStep:
         self._use_buckets = (use_buckets is not False
                              and B.elementwise(optimizer)
                              and bool(self._param_names))
+        # comm-overlap knobs are accepted for engine-API uniformity and
+        # recorded in the gauges, but the single-program path has NO
+        # collectives to overlap (n_shards=1) — grouping stays off so
+        # the compiled program is unchanged with the knob on (the
+        # ISSUE-10 dp=1 acceptance invariant)
+        self._comm_overlap, self._prefetch_depth, self._comm_chunk = \
+            B.resolve_overlap_config(comm_overlap, prefetch_depth,
+                                     comm_chunk)
         if self._use_buckets:
             _, bucket_bytes = B.resolve_comm_config()
             self._layout = B.BucketLayout.build(
@@ -145,6 +154,10 @@ class TrainStep:
                     {k: jnp.asarray(v) for k, v in st.items()})
             B.publish_comm_gauges(self._layout, engine='jit', n_shards=1,
                                   enabled=False)
+            B.publish_overlap_gauges(self._layout, engine='jit',
+                                     n_shards=1, enabled=False,
+                                     prefetch=self._prefetch_depth,
+                                     chunk=self._comm_chunk)
         else:
             self._layout = None
             self._opt_states = {}

@@ -1,8 +1,10 @@
-"""2-rank sharded-vs-replicated weight-update equivalence (ISSUE 4).
+"""2-rank sharded-vs-replicated weight-update equivalence (ISSUE 4 +
+ISSUE 10 overlap).
 
 Runs in a subprocess with XLA_FLAGS=--xla_force_host_platform_device_count=2
-so the dp mesh is exactly 2 ranks. Trains the same model three ways over a
-dp=2 mesh:
+so the dp mesh is exactly 2 ranks. Legs (``--leg base|overlap|all``):
+
+``base`` — trains the same model over a dp=2 mesh:
 
   * legacy per-param psum path (`use_buckets=False`) — the reference;
   * bucketed reduce-scatter + sharded update + all-gather
@@ -15,11 +17,25 @@ dp=2 mesh:
     scale-carrying param all-gather, fp32 accumulate): tolerance-level
     equivalence — the stated ISSUE-7 bar (docs/performance.md#int8-wire):
     losses within rtol 5e-2 / atol 5e-3 and params within rtol 5e-2 /
-    atol 5e-2 of the fp32 reference after 4 Adam steps. The sharded
-    fp32 master keeps the update itself exact; the forward runs on the
-    int8-rounded working copy, so a few chaotic elements drift by
-    several grid steps while losses track closely (the wire math is
-    unit-bounded in tests/test_bucketing.py).
+    atol 5e-2 of the fp32 reference after 4 Adam steps.
+
+``overlap`` (ISSUE 10, docs/performance.md#comm-overlap):
+
+  * `comm_overlap=True` (layer-grouped buckets + eager reduce-scatter +
+    deferred/prefetched param all-gather) must be BIT-IDENTICAL in fp32
+    to the barrier bucketed path — the gathers move, the arithmetic
+    does not;
+  * the chunked-collective variant (`comm_chunk`) is bit-identical too
+    (pieces reduce the same elements across the same ranks);
+  * bf16 / int8 wires under overlap: tolerance legs vs the fp32
+    reference (same bars as the barrier wires);
+  * peak-param-memory: the deferred-gather engine's resident param
+    state (flat 1/dp shards) must occupy FEWER device bytes than the
+    barrier engine's replicated params — measured with the
+    core/memory census (`device_nbytes`: replication-aware, which is
+    exactly what `.nbytes` hides);
+  * `comm_snapshot()['comm_overlap']['hybrid']`: enabled, >1 group,
+    exposed-comm < total-comm seconds.
 
 Exits 0 on success; prints the failing comparison otherwise.
 """
@@ -37,13 +53,10 @@ import numpy as np                                         # noqa: E402
 import jax                                                 # noqa: E402
 
 
-def main():
+def _setup():
     import paddle_tpu as paddle
     from paddle_tpu import nn
     from paddle_tpu.core.tensor import Tensor
-    from paddle_tpu.distributed import topology_runtime
-    from paddle_tpu.distributed.fleet.meta_parallel.hybrid_engine import (
-        HybridParallelTrainStep)
 
     assert len(jax.devices()) == 2, jax.devices()
 
@@ -55,7 +68,11 @@ def main():
     X = Tensor(rng.rand(8, 16).astype('float32'))
     Y = Tensor(rng.rand(8, 1).astype('float32'))
 
-    def run(use_buckets, comm_dtype=None, steps=4):
+    def run(use_buckets, comm_dtype=None, steps=4, **engine_kw):
+        from paddle_tpu.core import memory as M
+        from paddle_tpu.distributed import topology_runtime
+        from paddle_tpu.distributed.fleet.meta_parallel.hybrid_engine \
+            import HybridParallelTrainStep
         topology_runtime.build_mesh(['dp'], [2])
         paddle.seed(0)
         net = nn.Sequential(nn.Linear(16, 32), nn.Tanh(),
@@ -64,17 +81,27 @@ def main():
                                     parameters=net.parameters())
         eng = HybridParallelTrainStep(net, loss_fn, opt,
                                       use_buckets=use_buckets,
-                                      comm_dtype=comm_dtype)
+                                      comm_dtype=comm_dtype,
+                                      **engine_kw)
         assert eng._bucketed == bool(use_buckets), (
             use_buckets, eng._bucketed)
         losses = [float(eng(X, Y)) for _ in range(steps)]
-        params = {n: np.asarray(jax.device_get(a))
-                  for n, a in eng._params.items()}
-        states = eng.state_dict()['states']
-        return losses, params, states
+        sd = eng.state_dict()
+        # resident param-state census: replication-aware device bytes
+        # of everything the engine keeps alive BETWEEN steps for params
+        # (full replicas on the barrier path, flat 1/dp shards +
+        # legacy on the deferred-gather path)
+        pbytes = sum(M.device_nbytes(a) for a in eng._params.values())
+        pbytes += sum(M.device_nbytes(a)
+                      for a in getattr(eng, '_param_shards', None) or [])
+        return losses, sd['params'], sd['states'], pbytes, eng
 
-    ref_l, ref_p, ref_s = run(False)
-    got_l, got_p, got_s = run(True)
+    return run, X, Y
+
+
+def leg_base(run):
+    ref_l, ref_p, ref_s, _, _ = run(False)
+    got_l, got_p, got_s, _, _ = run(True)
 
     # fp32 sharded vs replicated: BIT-level
     assert got_l == ref_l, f'losses differ: {got_l} vs {ref_l}'
@@ -93,7 +120,7 @@ def main():
                 sys.exit(4)
 
     # bf16 compressed wire: tolerance-level
-    bf_l, bf_p, _ = run(True, comm_dtype='bfloat16')
+    bf_l, bf_p, _, _, _ = run(True, comm_dtype='bfloat16')
     np.testing.assert_allclose(bf_l, ref_l, rtol=5e-2, atol=1e-3)
     for n in ref_p:
         np.testing.assert_allclose(bf_p[n], ref_p[n], rtol=5e-2,
@@ -102,7 +129,7 @@ def main():
     # int8 block-scaled wire: tolerance-level (the forward consumes
     # the int8-rounded working copy from the scale-carrying all-gather,
     # so the bound is looser than bf16 — stated in docs/performance.md)
-    i8_l, i8_p, i8_s = run(True, comm_dtype='int8')
+    i8_l, i8_p, i8_s, _, _ = run(True, comm_dtype='int8')
     np.testing.assert_allclose(i8_l, ref_l, rtol=5e-2, atol=5e-3)
     for n in ref_p:
         np.testing.assert_allclose(i8_p[n], ref_p[n], rtol=5e-2,
@@ -130,6 +157,89 @@ def main():
           'bf16 comm within tolerance, int8 block-scaled comm within '
           f'tolerance (payload {factor:.2f}x below fp32 psum)',
           flush=True)
+
+
+def leg_overlap(run):
+    ref_l, ref_p, ref_s, _, _ = run(False)
+    bar_l, bar_p, bar_s, bar_bytes, _ = run(True)
+    ov_l, ov_p, ov_s, ov_bytes, ov_eng = run(True, comm_overlap=True,
+                                             prefetch_depth=1)
+    assert ov_eng._overlap, 'comm_overlap=True did not engage'
+    assert len(ov_eng._layout.buckets) > 1, \
+        'layer grouping produced a single bucket — nothing to overlap'
+
+    # fp32 overlap == barrier == replicated: BIT-level (the deferred
+    # gather only moves the all-gather; fp32 collectives are exact)
+    assert ov_l == bar_l == ref_l, (ov_l, bar_l, ref_l)
+    for n in ref_p:
+        if not np.array_equal(ov_p[n], ref_p[n]):
+            print(f'overlap param {n} not bit-identical', flush=True)
+            sys.exit(5)
+    for n in ref_s:
+        for k in ('moment1', 'moment2'):
+            if not np.array_equal(np.asarray(ov_s[n][k]),
+                                  np.asarray(ref_s[n][k])):
+                print(f'overlap state {n}.{k} not bit-identical',
+                      flush=True)
+                sys.exit(6)
+
+    # chunked collectives: still bit-identical (same elements reduced
+    # across the same ranks, pieces concatenate to the same layout)
+    ch_l, ch_p, _, _, _ = run(True, comm_overlap=True, comm_chunk=64)
+    assert ch_l == ref_l, (ch_l, ref_l)
+    for n in ref_p:
+        assert np.array_equal(ch_p[n], ref_p[n]), n
+
+    # deferred-gather peak param memory: the overlap engine's resident
+    # param state (1/dp shards) must be strictly smaller than the
+    # barrier engine's replicated params (census-measured, ISSUE-10
+    # acceptance)
+    assert ov_bytes < bar_bytes, (ov_bytes, bar_bytes)
+    ratio = ov_bytes / max(bar_bytes, 1)
+
+    # compressed wires under overlap: same bars as the barrier wires
+    bf_l, bf_p, _, _, _ = run(True, comm_dtype='bfloat16',
+                              comm_overlap=True)
+    np.testing.assert_allclose(bf_l, ref_l, rtol=5e-2, atol=1e-3)
+    for n in ref_p:
+        np.testing.assert_allclose(bf_p[n], ref_p[n], rtol=5e-2,
+                                   atol=2e-3, err_msg=n)
+    i8_l, i8_p, _, _, _ = run(True, comm_dtype='int8',
+                              comm_overlap=True)
+    np.testing.assert_allclose(i8_l, ref_l, rtol=5e-2, atol=5e-3)
+    for n in ref_p:
+        np.testing.assert_allclose(i8_p[n], ref_p[n], rtol=5e-2,
+                                   atol=5e-2, err_msg=n)
+
+    # overlap telemetry: enabled, exposed < total modeled comm seconds
+    from paddle_tpu.core import bucketing as B
+    co = B.comm_snapshot()['comm_overlap']['hybrid']
+    assert co['enabled'] and co['groups'] > 1, co
+    assert co['exposed_comm_seconds'] < co['total_comm_seconds'], co
+    assert co['hidden_comm_seconds'] > 0, co
+
+    print('OK: overlap==barrier (fp32 bit-level, chunked too), '
+          'bf16/int8 overlap wires within tolerance, resident param '
+          f'bytes {ov_bytes} < barrier {bar_bytes} '
+          f'({ratio:.2f}x), exposed '
+          f"{co['exposed_comm_seconds']:.2e}s < total "
+          f"{co['total_comm_seconds']:.2e}s", flush=True)
+
+
+def main():
+    leg = 'all'
+    if '--leg' in sys.argv:
+        leg = sys.argv[sys.argv.index('--leg') + 1]
+    if leg not in ('base', 'overlap', 'all'):
+        # a typo must not become a zero-assertion silent pass
+        print(f'unknown --leg {leg!r}: expected base|overlap|all',
+              flush=True)
+        sys.exit(2)
+    run, _, _ = _setup()
+    if leg in ('base', 'all'):
+        leg_base(run)
+    if leg in ('overlap', 'all'):
+        leg_overlap(run)
     sys.exit(0)
 
 

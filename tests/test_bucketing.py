@@ -400,7 +400,9 @@ class TestTwoRankSubprocess:
                               'dist_bucket_equiv.py')
         env = dict(os.environ)
         env.pop('XLA_FLAGS', None)   # script pins its own device count
-        p = subprocess.run([sys.executable, '-u', script], env=env,
+        # base leg only: the overlap leg runs from tests/test_overlap.py
+        p = subprocess.run([sys.executable, '-u', script,
+                            '--leg', 'base'], env=env,
                            capture_output=True, text=True, timeout=600)
         assert p.returncode == 0, (p.stdout or '') + (p.stderr or '')
         assert 'OK: sharded==replicated' in p.stdout

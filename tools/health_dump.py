@@ -359,6 +359,29 @@ def render_comm(comm, cache=None):
             if eng in pay_factor:
                 out.append(f'    payload factor vs per-param '
                            f'psum(fp32): {pay_factor[eng]:.2f}x')
+        # comm/compute overlap (ISSUE 10): schedule shape + the
+        # modeled exposed-vs-hidden split (docs/performance.md
+        # #comm-overlap)
+        co = (comm.get('comm_overlap') or {}).get(eng)
+        if co:
+            if co.get('enabled'):
+                out.append(
+                    f"    comm overlap: ON — {co.get('groups', 0)} "
+                    f"groups, {co.get('groups_in_flight', 0)} in "
+                    f"flight (prefetch {co.get('prefetch_depth', 0)}"
+                    + (f", chunk {co['chunk_elements']} elems"
+                       if co.get('chunk_elements') else '') + ')')
+            else:
+                out.append('    comm overlap: off (every comm byte '
+                           'exposed)')
+            tot = co.get('total_comm_seconds', 0.0)
+            out.append(
+                f"    modeled comm: exposed "
+                f"{co.get('exposed_comm_seconds', 0.0):.2e}s / hidden "
+                f"{co.get('hidden_comm_seconds', 0.0):.2e}s of "
+                f"{tot:.2e}s"
+                + (f"  ({100 * co.get('hidden_comm_seconds', 0.0) / tot:.0f}% hidden)"
+                   if tot else ''))
     if cache:
         out.append('persistent compile cache: '
                    + ('enabled at ' + str(cache.get('dir'))
@@ -389,6 +412,16 @@ def _comm_selftest():
     # psum baseline, scale + pad overhead reported beside it
     B.publish_comm_gauges(layout, engine='selftest_int8', n_shards=8,
                           comm_dtype='int8', enabled=True, block=256)
+    # overlapped schedule (ISSUE 10): layer-grouped buckets, modeled
+    # exposed < total comm seconds when enabled with >1 group
+    ov_layout = B.BucketLayout.build(
+        {'l.0.w': ((2048,), jnp.bfloat16),
+         'l.1.w': ((2048,), jnp.bfloat16),
+         'head.w': ((512,), jnp.bfloat16)},
+        group_fn=B.layer_group_fn, pad_to=8)
+    B.publish_overlap_gauges(ov_layout, engine='selftest', n_shards=8,
+                             comm_dtype=jnp.bfloat16, enabled=True,
+                             prefetch=2, chunk=1024)
     snap = StepTelemetry(publish=False).snapshot()
     comm, cache = _find_comm({'telemetry': {
         'comm': snap['comm'], 'compile_cache': snap['compile_cache']}})
@@ -402,10 +435,14 @@ def _comm_selftest():
     assert wb['scale_bytes'] > 0, wb
     assert wb['total_bytes'] == wb['payload_bytes'] \
         + wb['scale_bytes'] + wb['pad_bytes'], wb
+    co = comm['comm_overlap']['selftest']
+    assert co['enabled'] and co['groups'] == 3, co
+    assert co['exposed_comm_seconds'] < co['total_comm_seconds'], co
     text = render_comm(comm, cache)
     assert 'engine selftest' in text, text
     assert 'drop' in text and 'reduce_scatter' in text, text
     assert 'wire breakdown' in text and 'payload factor' in text, text
+    assert 'comm overlap: ON' in text and 'hidden' in text, text
     assert 'compile cache' in text, text
     print(text)
     print('health_dump comm selftest: OK')

@@ -20,6 +20,11 @@
 #                      bias+GELU / dropout+residual kernels vs jnp
 #                      references, fused-vs-unfused engine equivalence,
 #                      routing-counter CLI smoke (ISSUE 8)
+#   --overlap-selftest - comm/compute overlap (ISSUE 10): 2-rank
+#                      overlap==barrier bit-level fp32 + compressed-wire
+#                      tolerance + deferred-gather memory win, chunked
+#                      collectives, layer grouping, dp=1 no-op
+#                      invariant, exposed/hidden comm gauge rendering
 set -e
 cd "$(dirname "$0")/.."
 TIER="${1:-all}"
@@ -28,7 +33,7 @@ case "$TIER" in
             tests/test_layers_optim.py tests/test_controlflow_dist.py \
             tests/test_profiler_trace.py tests/test_diagnostics.py \
             tests/test_numerics.py tests/test_bucketing.py \
-            tests/test_fused_primitives.py \
+            tests/test_fused_primitives.py tests/test_overlap.py \
             tests/test_serving.py tests/test_serving_trace.py -q
           # observability tooling smoke: tracer -> export -> summary CLI
           python tools/trace_summary.py --selftest
@@ -68,6 +73,15 @@ case "$TIER" in
           # equivalences) + routing-counter rendering
           python -m pytest tests/test_fused_primitives.py -q
           python tools/health_dump.py pallas --selftest ;;
+  --overlap-selftest)
+          # true 2-rank mesh: overlapped schedule bit-identical to the
+          # barrier path (fp32, chunked too), compressed wires within
+          # tolerance, deferred-gather resident-param-memory win
+          # (census-measured) + the in-process overlap units and the
+          # exposed/hidden comm rendering
+          python tests/dist_models/dist_bucket_equiv.py --leg overlap
+          python -m pytest tests/test_overlap.py -q
+          python tools/health_dump.py comm --selftest ;;
   --serve-selftest)
           # serving engine end to end on the CPU fallback path (paged
           # pool + continuous batching + COW prefix caching +
@@ -87,5 +101,5 @@ case "$TIER" in
           python tools/health_dump.py comm --selftest
           python tools/health_dump.py serve --selftest
           python tools/health_dump.py pallas --selftest ;;
-  *) echo "usage: $0 [fast|dist|native|e2e|all|--comm-selftest|--serve-selftest|--quant-selftest|--pallas-selftest]"; exit 1 ;;
+  *) echo "usage: $0 [fast|dist|native|e2e|all|--comm-selftest|--serve-selftest|--quant-selftest|--pallas-selftest|--overlap-selftest]"; exit 1 ;;
 esac
