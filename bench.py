@@ -666,6 +666,146 @@ def bench_gpt_serve():
     }
 
 
+def bench_gpt_serve_cluster():
+    """gpt_serve_cluster (ISSUE 11): a 2-replica dp serving cluster
+    behind the prefix-affinity router vs the single PR-9 engine on the
+    SAME sustained mixed-length stream (two system-prompt families +
+    random tails). Records per-replica AND aggregate SLO percentiles
+    from the lifecycle journals, router placement stats (affinity /
+    least-loaded / spills / rejects), and the aggregate decode
+    throughput. On the CPU dryrun the replicas interleave on one core,
+    so the wall clock can't show the dp speedup — the aggregate of
+    per-replica decode rates (each measured over its OWN decode time,
+    the same clock the 1-chip leg uses) is the scaling signal, and the
+    wall numbers ride along for hardware rounds."""
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving import ServingEngine, ServingConfig
+    from paddle_tpu.serving.cluster import ClusterRouter, LocalReplica
+    from paddle_tpu.serving.request_trace import percentile_of
+
+    paddle.seed(0)
+    on_tpu = jax.default_backend() == 'tpu'
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=50304, hidden_size=768,
+                        num_layers=12, num_heads=12, max_seq_len=1024,
+                        hidden_dropout=0.0, attn_dropout=0.0,
+                        use_flash_attention=True)
+        n_req, max_new, batch, page_size, chunk = 24, 48, 8, 16, 128
+        sys_len, lo, hi = 128, 16, 256
+    else:
+        cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                        num_heads=2, max_seq_len=128,
+                        hidden_dropout=0.0, attn_dropout=0.0,
+                        use_flash_attention=False)
+        n_req, max_new, batch, page_size, chunk = 10, 8, 3, 8, 16
+        sys_len, lo, hi = 16, 2, 24
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(0)
+    families = [list(rng.randint(1, cfg.vocab_size, sys_len))
+                for _ in range(2)]
+    prompts = [families[i % 2]
+               + list(rng.randint(1, cfg.vocab_size,
+                                  int(rng.randint(lo, hi + 1))))
+               for i in range(n_req)]
+    pages_per_seq = -(-(sys_len + hi + max_new) // page_size)
+
+    def _mk_config():
+        return ServingConfig(page_size=page_size,
+                             max_batch_size=batch,
+                             prefill_chunk=chunk,
+                             max_pages_per_seq=pages_per_seq)
+
+    def _slo(table):
+        out = {}
+        for key, label in (('ttft_s', 'ttft_ms'),
+                           ('tpot_s', 'tpot_ms'),
+                           ('queue_wait_s', 'queue_wait_ms'),
+                           ('e2e_s', 'e2e_ms')):
+            vals = [r[key] for r in table.values()]
+            out[label] = {
+                f'p{q}': (round(p * 1000.0, 3)
+                          if (p := percentile_of(vals, q)) is not None
+                          else None)
+                for q in (50, 90, 99)}
+        return out
+
+    # -- 1-chip baseline: the PR-9 engine on the whole stream --------------
+    single = ServingEngine(model, _mk_config())
+    single.generate([prompts[0]], max_new_tokens=2, top_k=0)  # warmup
+    single.reset_stats()
+    t0 = time.time()
+    ref_outs = single.generate(prompts, max_new_tokens=max_new,
+                               top_k=0)
+    single_dt = time.time() - t0
+    sstats = single.stats()
+    single_rec = {
+        'tokens_per_sec': sum(len(o) - len(p) for o, p in
+                              zip(ref_outs, prompts)) / single_dt,
+        'decode_tokens_per_sec': sstats['decode_tokens_per_sec'],
+        'slo': _slo(single.request_table()),
+        'prefill_tokens': sstats['prefill_tokens_total'],
+        'prefix_hits': sstats['prefix_hits_total'],
+    }
+    single.shutdown()
+
+    # -- 2-replica cluster on the SAME stream ------------------------------
+    replicas = [LocalReplica(ServingEngine(model, _mk_config()), rid)
+                for rid in ('r0', 'r1')]
+    for r in replicas:      # same warmup the single engine got
+        r.engine.generate([prompts[0]], max_new_tokens=2, top_k=0)
+        r.engine.reset_stats()
+    router = ClusterRouter(replicas, page_size=page_size,
+                           max_queue=2 * n_req)
+    t0 = time.time()
+    outs = router.serve(prompts, max_new_tokens=max_new, top_k=0,
+                        timeout_s=600)
+    cluster_dt = time.time() - t0
+    gen_tokens = sum(len(o) - len(p) for o, p in zip(outs, prompts))
+    per_replica = {}
+    agg_decode_tps = 0.0
+    all_tables = {}
+    for r in replicas:
+        st = r.engine.stats()
+        table = r.engine.request_table()
+        all_tables.update({f'{r.replica_id}:{k}': v
+                           for k, v in table.items()})
+        agg_decode_tps += st['decode_tokens_per_sec']
+        per_replica[r.replica_id] = {
+            'requests': len(table),
+            'decode_tokens_per_sec': st['decode_tokens_per_sec'],
+            'prefill_tokens': st['prefill_tokens_total'],
+            'prefix_hits': st['prefix_hits_total'],
+            'batch_occupancy': st['batch_occupancy'],
+            'slo': _slo(table),
+        }
+    snap = router.snapshot()
+    router.shutdown()
+    return {
+        'requests': n_req,
+        'replicas': len(replicas),
+        'max_new_tokens': max_new,
+        'decode_slots_per_replica': batch,
+        'page_size': page_size,
+        'single_engine': single_rec,
+        'cluster': {
+            'wall_tokens_per_sec': gen_tokens / cluster_dt,
+            'aggregate_decode_tokens_per_sec': agg_decode_tps,
+            'slo': _slo(all_tables),
+            'per_replica': per_replica,
+            'router': snap,
+        },
+        'aggregate_decode_speedup_vs_single':
+            (agg_decode_tps / single_rec['decode_tokens_per_sec']
+             if single_rec['decode_tokens_per_sec'] else None),
+        'affinity_hit_rate': snap['affinity_hit_rate'],
+        'outputs_identical_to_single': outs == ref_outs,
+        'backend': jax.default_backend(),
+    }
+
+
 def _retry(fn, attempts=3):
     """The tunneled chip's remote-compile channel occasionally drops a
     response mid-read (transient 'response body closed' /
@@ -701,6 +841,7 @@ LEGS = {
     'deepfm_ps': bench_deepfm_ps_config5,
     'ps_scale_ssd': bench_ps_scale,
     'gpt_serve_throughput': bench_gpt_serve,
+    'gpt_serve_cluster': bench_gpt_serve_cluster,
 }
 
 _LEG_SENTINEL = 'LEG_RESULT:'
@@ -811,7 +952,8 @@ def _leg_in_subprocess(name, timeout=5400, attempts=3):
 # and their errors — inside the headline leg's detail dict)
 EXPECTED_LEGS = ('gpt1.3b_adamw', 'gpt1.3b_sgd', 'bert_base_zero2_bf16',
                  'lenet_mnist', 'resnet50_dp_bf16', 'deepfm_ps',
-                 'ps_scale_ssd', 'gpt_serve_throughput')
+                 'ps_scale_ssd', 'gpt_serve_throughput',
+                 'gpt_serve_cluster')
 
 
 def _check_legs(result):
@@ -896,6 +1038,7 @@ def main():
             ('deepfm_ps', 'deepfm_ps'),
             ('ps_scale_ssd', 'ps_scale_ssd'),
             ('gpt_serve_throughput', 'gpt_serve_throughput'),
+            ('gpt_serve_cluster', 'gpt_serve_cluster'),
     ):
         try:
             r = run(src)
@@ -914,7 +1057,8 @@ def main():
                 r.pop('memory', None)
             legs[key] = _round_floats(
                 r, 4 if src in ('gpt_sgd', 'bert_base_zero2_bf16',
-                                'gpt_serve_throughput') else 2)
+                                'gpt_serve_throughput',
+                                'gpt_serve_cluster') else 2)
         except Exception as e:       # headline must still print
             legs[key] = {'error': repr(e)[:200]}
     # per-leg compile/memory telemetry comes from the headline child

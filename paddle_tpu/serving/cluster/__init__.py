@@ -1,0 +1,38 @@
+"""Disaggregated multi-chip serving cluster (ISSUE 11).
+
+The PR-5/9 engine scaled one device slice; this package scales the
+mesh (ROADMAP serve_scale item 1):
+
+  * `replica.py`  — dp serving replicas: each runs the existing
+    engine over its own device slice, either in-process
+    (`LocalReplica`) or as a fleet-launched worker process
+    (`ReplicaWorker` + `RemoteReplica`) behind a TCP control channel
+    (submit / poll / abort / drain / status), with a PR-2-style hang
+    watchdog that diagnoses and dumps a wedged step loop;
+  * `router.py`   — the async front-end: prefix-affinity placement
+    (radix-chain hashes vs each replica's published prefix digest),
+    least-occupancy fallback on the PR-6 SchedulerTimeline feedback,
+    per-replica backpressure + reject-early, and drain (a hung
+    replica's in-flight requests re-prefill on a peer via the PR-9
+    resurrect path);
+  * mp sharding   — `ServingEngine(..., mesh=...)` (engine.py) splits
+    heads + KV pages over an 'mp' axis inside one replica;
+  * `disagg.py`   — prefill/decode disaggregation behind a config
+    flag: chunked prefill on a dedicated engine, finished KV pages
+    streamed into the decode engine's pool (`page_stream.py`, int8
+    scale buffers ride along) and the request adopted into a decode
+    slot.
+
+docs/serving.md#disaggregated-serving has the topology diagram, knob
+tables and drain semantics.
+"""
+from .router import (ClusterRouter, RouterRejected, RoutedRequest,
+                     cluster_snapshot)
+from .replica import LocalReplica, RemoteReplica, ReplicaWorker
+from .disagg import DisaggregatedEngine, build_engine
+from .page_stream import stream_kv_pages
+
+__all__ = ['ClusterRouter', 'RouterRejected', 'RoutedRequest',
+           'cluster_snapshot', 'LocalReplica', 'RemoteReplica',
+           'ReplicaWorker', 'DisaggregatedEngine', 'build_engine',
+           'stream_kv_pages']

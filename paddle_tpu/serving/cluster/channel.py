@@ -1,0 +1,153 @@
+"""Length-prefixed JSON control channel between router and replicas.
+
+The cluster's CONTROL plane only: submissions, token polls, status
+probes, drains. Token ids are small JSON ints; the DATA plane (KV
+pages) never crosses this socket — pages move device-to-device via
+page_stream.py. One request per message, strictly ordered per
+connection; the client serializes calls under a lock, so a replica
+can serve several routers (or a router several probes) without
+interleaving frames.
+
+Deliberately dependency-free (stdlib sockets): the fleetrun TCPStore
+is a rendezvous KV, not an RPC duplex, and serving control needs
+request/response with per-call timeouts — a stale-status timeout is
+the router's hang signal (router.py), so timeouts must be cheap and
+per-call.
+"""
+import json
+import socket
+import struct
+import threading
+
+_HDR = struct.Struct('<I')
+MAX_MSG = 64 * 1024 * 1024
+
+
+def send_msg(sock, obj):
+    data = json.dumps(obj).encode()
+    if len(data) > MAX_MSG:
+        raise ValueError(f"control message of {len(data)} bytes "
+                         f"exceeds the {MAX_MSG} cap")
+    sock.sendall(_HDR.pack(len(data)) + data)
+
+
+def _recv_exact(sock, n):
+    buf = b''
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("control channel closed")
+        buf += chunk
+    return buf
+
+
+def recv_msg(sock):
+    (n,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    if n > MAX_MSG:
+        raise ValueError(f"control frame of {n} bytes exceeds cap")
+    return json.loads(_recv_exact(sock, n).decode())
+
+
+class ControlServer:
+    """Accept-loop + per-connection handler threads. `handler(msg)`
+    returns the reply dict; exceptions become {'error': repr} replies
+    so a bad request can't kill the worker's control plane."""
+
+    def __init__(self, handler, host='127.0.0.1', port=0):
+        self.handler = handler
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, int(port)))
+        self._sock.listen(16)
+        self.host, self.port = self._sock.getsockname()
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        name='cluster-control',
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def _accept_loop(self):
+        self._sock.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn):
+        with conn:
+            while not self._stop.is_set():
+                try:
+                    msg = recv_msg(conn)
+                except (ConnectionError, OSError, ValueError):
+                    return
+                try:
+                    reply = self.handler(msg) or {}
+                except Exception as e:          # noqa: BLE001
+                    reply = {'error': repr(e)[:500]}
+                try:
+                    send_msg(conn, reply)
+                except OSError:
+                    return
+
+    def close(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class ControlClient:
+    """One persistent connection; `call()` is request/response with a
+    per-call timeout (socket.timeout propagates — the router reads it
+    as 'replica unresponsive').
+
+    Frames carry no request ids, so a connection that failed MID-CALL
+    is desynced: a late reply to the timed-out request would be read
+    as the NEXT call's reply. Any send/recv failure therefore drops
+    the connection; the next call dials fresh (the server's stale
+    per-connection thread dies writing to the closed socket)."""
+
+    def __init__(self, host, port, timeout=10.0):
+        self._addr = (host, int(port))
+        self._lock = threading.Lock()
+        self._timeout = timeout
+        self._sock = socket.create_connection(self._addr,
+                                              timeout=timeout)
+
+    def call(self, msg, timeout=None):
+        with self._lock:
+            if self._sock is None:
+                self._sock = socket.create_connection(
+                    self._addr, timeout=timeout or self._timeout)
+            try:
+                self._sock.settimeout(timeout or self._timeout)
+                send_msg(self._sock, msg)
+                reply = recv_msg(self._sock)
+            except (OSError, ValueError, ConnectionError):
+                # desynced or dead: never reuse this connection
+                try:
+                    self._sock.close()
+                finally:
+                    self._sock = None
+                raise
+        if isinstance(reply, dict) and reply.get('error'):
+            raise RuntimeError(f"replica error: {reply['error']}")
+        return reply
+
+    def close(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None

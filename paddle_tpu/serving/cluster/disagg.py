@@ -1,0 +1,252 @@
+"""Prefill/decode disaggregation (ISSUE 11 tentpole part 4).
+
+Two engines over one model, each with its own paged pool:
+
+  * the PREFILL engine runs chunked prefill only — requests are
+    submitted here, stream through prefill chunks, and emit their
+    first token exactly like the unified engine;
+  * the moment a request finishes prefill (state RUNNING), its KV
+    pages are STREAMED into the decode pool (page_stream.py — chunked
+    gather/scatter on the page axis, int8 scale buffers ride along,
+    bit-identical rows) and the request is ADOPTED into a decode slot
+    (`ServingEngine.adopt_request`); its prefill-side pages release.
+
+On a real cluster the two pools live on different device slices, so
+the stream is the prefill→decode page handoff of disaggregated
+serving; in one process it is a device copy with the same layout —
+which is what makes the bit-exactness testable on CPU.
+
+Decode-side prefix sharing still works: pages the decode pool already
+holds for a shared prefix are mapped instead of re-streamed (only the
+uncovered tail pages move), and streamed pages join the decode pool's
+prefix index, so the second request behind a system prompt streams
+almost nothing.
+
+The unified engine's semantics are preserved: greedy outputs are
+token-identical to a single `ServingEngine` on the same stream
+(asserted in tests/test_serving_cluster.py). Preemption on the decode
+side falls back to re-prefill ON the decode engine (the PR-9 resurrect
+path) — correctness first; a re-handoff would need cross-pool
+eviction coordination for zero benefit at preemption rates worth
+having.
+"""
+import math
+
+from ..engine import ServingConfig, ServingEngine
+from ..scheduler import RequestState
+from ...core import monitor as _m
+from .page_stream import stream_kv_pages
+
+
+def build_engine(model, config=None, mesh=None, **cfg_kw):
+    """ServingEngine, or DisaggregatedEngine when
+    config.disaggregate — the one constructor replicas use."""
+    if config is None:
+        config = ServingConfig(**cfg_kw)
+    elif cfg_kw:
+        raise ValueError("pass either config or knobs, not both")
+    if config.disaggregate:
+        return DisaggregatedEngine(model, config, mesh=mesh)
+    return ServingEngine(model, config, mesh=mesh)
+
+
+class DisaggregatedEngine:
+    """Drop-in engine facade: submit/step/generate/abort/stats/...
+    match ServingEngine's surface, dispatching prefill work to the
+    prefill engine and decode work to the decode engine."""
+
+    def __init__(self, model, config=None, mesh=None, **cfg_kw):
+        if config is None:
+            config = ServingConfig(**cfg_kw)
+        elif cfg_kw:
+            raise ValueError("pass either config or knobs, not both")
+        self.model = model
+        self.config = config
+        self.decode = ServingEngine(model, _variant(config,
+                                                   disaggregate=False),
+                                    mesh=mesh)
+        # prefill side: its own (smaller) slot table and pool; trace
+        # off at build, then SHARE the decode tracer + clock so a
+        # request's journal is one stream across the handoff
+        pcfg = _variant(config, disaggregate=False,
+                        max_batch_size=config.prefill_slots,
+                        trace=False,
+                        clock=self.decode._clock)
+        self.prefill = ServingEngine(model, pcfg, mesh=mesh)
+        self.prefill.tracer = self.decode.tracer
+        # one publisher: the global ptpu_serve_* gauges reflect the
+        # decode engine (where requests retire and most SLO samples
+        # land); the prefill side's pending histogram samples (TTFT is
+        # stamped during prefill!) forward into the decode engine's
+        # buffers so the cluster-wide histograms still see them
+        def _forward_publish(eng=self.prefill):
+            self.decode._new_ttfts_s.extend(eng._new_ttfts_s)
+            eng._new_ttfts_s.clear()
+            for k, v in eng._new_slo.items():
+                self.decode._new_slo[k].extend(v)
+                v.clear()
+            eng._last_publish = eng._clock()
+        self.prefill.publish_metrics = _forward_publish
+        self._pending = []          # prefilled, waiting for a slot
+        self._handoffs = 0
+        self._streamed_pages = 0
+
+    # -- engine surface ------------------------------------------------------
+    @property
+    def pool(self):
+        return self.decode.pool
+
+    @property
+    def timeline(self):
+        return self.decode.timeline
+
+    @property
+    def tracer(self):
+        return self.decode.tracer
+
+    @property
+    def scheduler(self):
+        # the decode scheduler is "the" scheduler for occupancy views;
+        # queue state lives prefill-side (see has_work / waiting)
+        return self.decode.scheduler
+
+    @property
+    def has_work(self):
+        return (self.prefill.scheduler.has_work or bool(self._pending)
+                or self.decode.scheduler.has_work)
+
+    def waiting_requests(self):
+        return list(self.prefill.scheduler.waiting)
+
+    def live_requests(self):
+        return ([r for r in self.prefill.scheduler.slots
+                 if r is not None] + list(self._pending)
+                + [r for r in self.decode.scheduler.slots
+                   if r is not None])
+
+    def submit(self, prompt_ids, **kw):
+        return self.prefill.submit(prompt_ids, **kw)
+
+    def step(self):
+        """One cluster-internal iteration: a prefill sweep, then the
+        handoff scan, then a decode sweep."""
+        if self.prefill.scheduler.has_work:
+            self.prefill.step()
+        for req in list(self.prefill.scheduler.slots):
+            if req is not None and req.state == RequestState.RUNNING:
+                self._handoff(req)
+        while self._pending:
+            if not self.decode.adopt_request(self._pending[0]):
+                break
+            self._pending.pop(0)
+        if self.decode.scheduler.has_work:
+            self.decode.step()
+
+    def _handoff(self, req):
+        """Stream req's finished prefill pages into the decode pool and
+        queue it for adoption. Decode-resident shared-prefix pages are
+        mapped, not re-streamed — only the uncovered tail moves."""
+        src_pool, dst_pool = self.prefill.pool, self.decode.pool
+        ps = src_pool.page_size
+        L = len(req.prompt)
+        src_pages = src_pool.page_table(req.id)
+        cached = dst_pool.match_and_map(req.id, req.tokens, limit=L)
+        n_cached = cached // ps
+        # decode-pool pressure preempts decode-side victims, exactly
+        # like a local prefill allocation would
+        self.decode._ensure_or_preempt(req, L)
+        dst_pages = dst_pool.page_table(req.id)
+        n = min(len(src_pages), len(dst_pages))
+        if n > n_cached:
+            self.decode.pool.kv = stream_kv_pages(
+                src_pool.kv, dst_pool.kv,
+                src_pages[n_cached:n], dst_pages[n_cached:n],
+                chunk_pages=self.config.stream_chunk_pages)
+            self._streamed_pages += n - n_cached
+        # release the prefill side WITHOUT retiring: the request lives
+        # on, its journal continues on the decode engine
+        i = self.prefill.scheduler.slot_of(req)
+        self.prefill.scheduler.slots[i] = None
+        src_pool.release(req.id)
+        self._handoffs += 1
+        _m.counter('ptpu_serve_pd_handoffs_total',
+                   help='prefill->decode request handoffs '
+                        '(lifetime)').inc()
+        self._pending.append(req)
+
+    def generate(self, prompts, max_new_tokens=32, eos_token_id=None,
+                 temperature=1.0, top_k=0, max_steps=None):
+        reqs = [self.submit(p, max_new_tokens=max_new_tokens,
+                            eos_token_id=eos_token_id,
+                            temperature=temperature, top_k=top_k)
+                for p in prompts]
+        guard = max_steps or 16 * (max_new_tokens + 4) * max(
+            1, math.ceil(len(reqs) / self.config.max_batch_size))
+        steps = 0
+        while self.has_work:
+            self.step()
+            steps += 1
+            if steps > guard:
+                raise RuntimeError(
+                    f"disaggregated loop did not drain in {guard} steps")
+        return [r.output_ids() for r in reqs]
+
+    def abort(self, req, reason='aborted'):
+        if req in self._pending:
+            self._pending.remove(req)
+            return self.decode.abort(req, reason=reason)
+        if req in self.prefill.scheduler.waiting \
+                or req in self.prefill.scheduler.slots:
+            return self.prefill.abort(req, reason=reason)
+        return self.decode.abort(req, reason=reason)
+
+    def stats(self):
+        s = self.decode.stats()
+        ps = self.prefill.stats()
+        s['pd_disaggregated'] = True
+        s['pd_handoffs_total'] = self._handoffs
+        s['pd_streamed_pages_total'] = self._streamed_pages
+        s['pd_pending'] = len(self._pending)
+        # prefill work happens on the other engine — surface its side
+        s['prefill_tokens_total'] = ps['prefill_tokens_total']
+        s['prefill_chunks_total'] = ps['prefill_chunks_total']
+        s['prefix_hits_total'] += ps['prefix_hits_total']
+        s['prefix_misses_total'] += ps['prefix_misses_total']
+        s['prefix_hit_tokens_total'] += ps['prefix_hit_tokens_total']
+        s['pd_prefill_pool'] = {
+            'pages_in_use': ps['pool']['pages_in_use'],
+            'high_water': ps['pool']['high_water'],
+            'num_pages': ps['pool']['num_pages'],
+        }
+        return s
+
+    def request_table(self):
+        return self.decode.request_table()
+
+    def publish_metrics(self):
+        self.decode.publish_metrics()
+
+    def reset_stats(self):
+        self.prefill.reset_stats()
+        self.decode.reset_stats()
+
+    def export_trace(self, jsonl_path=None, chrome_path=None):
+        return self.decode.export_trace(jsonl_path=jsonl_path,
+                                        chrome_path=chrome_path)
+
+    def shutdown(self):
+        self.prefill.shutdown()
+        return self.decode.shutdown()
+
+
+def _variant(config, **overrides):
+    """Copy a ServingConfig with overrides (configs are plain
+    attribute bags — rebuild through __init__ so validation runs)."""
+    import inspect
+    kw = {}
+    for name in inspect.signature(ServingConfig.__init__).parameters:
+        if name == 'self':
+            continue
+        kw[name] = getattr(config, name)
+    kw.update(overrides)
+    return ServingConfig(**kw)

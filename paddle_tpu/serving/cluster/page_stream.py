@@ -1,0 +1,56 @@
+"""KV-page streaming between pools (prefill→decode disaggregation).
+
+Copies whole page rows from a source pool's device arrays into chosen
+pages of a destination pool — the handoff of arXiv:2112.01075's
+portable collective-based redistribution applied to KV pages: the
+transfer is expressed as gather→scatter on the page axis, chunked so
+the staging footprint is bounded (`core.bucketing._chunk_spans`, the
+same chunking the PR-10 chunked collectives use). On one host this
+lowers to a device copy; across device slices XLA lowers the same
+expression to an ICI transfer. Int8 pools need no special casing:
+each layer's buffer TUPLE is streamed element-wise, so the fp32 scale
+siblings travel with their int8 pages (same page ids address both —
+kv_pool.py docstring).
+
+Bit-exactness is the contract (tested in test_serving_cluster.py):
+a streamed page equals the locally-written page byte for byte,
+because nothing is recomputed or re-quantized — rows move as stored.
+"""
+from ...core import monitor as _m
+from ...core.bucketing import _chunk_spans
+
+
+def stream_kv_pages(src_kv, dst_kv, src_pages, dst_pages,
+                    chunk_pages=0):
+    """Copy page rows `src_pages[i] -> dst_pages[i]` for every layer
+    buffer. Returns the NEW dst_kv list (functional — callers assign
+    it back to their pool, like the engine does with step outputs).
+
+    chunk_pages caps pages moved per copy op (0 = one shot)."""
+    import jax.numpy as jnp
+    if len(src_pages) != len(dst_pages):
+        raise ValueError(f"page list mismatch: {len(src_pages)} src "
+                         f"vs {len(dst_pages)} dst")
+    n = len(src_pages)
+    if n == 0:
+        return dst_kv
+    spans = _chunk_spans(n, 1, chunk_pages) or [(0, n)]
+    src_idx = jnp.asarray(list(src_pages), jnp.int32)
+    dst_idx = jnp.asarray(list(dst_pages), jnp.int32)
+    out = []
+    nbytes = 0
+    for layer_src, layer_dst in zip(src_kv, dst_kv):
+        bufs = []
+        for s, d in zip(layer_src, layer_dst):
+            for (st, w) in spans:
+                d = d.at[dst_idx[st:st + w]].set(s[src_idx[st:st + w]])
+            nbytes += n * int(s.nbytes) // s.shape[0]
+            bufs.append(d)
+        out.append(tuple(bufs))
+    _m.counter('ptpu_serve_pd_streamed_pages_total',
+               help='KV pages streamed prefill->decode '
+                    '(lifetime)').inc(n)
+    _m.counter('ptpu_serve_pd_streamed_bytes_total',
+               help='device bytes streamed prefill->decode, scale '
+                    'buffers included (lifetime)').inc(nbytes)
+    return out

@@ -1,0 +1,600 @@
+"""Prefix-affinity request router — the cluster's async front-end.
+
+Placement runs two signals the single-engine PRs built:
+
+  * PREFIX AFFINITY (first): the prompt's full pages hash into a
+    radix chain (`kv_pool.chain_hashes` — the same link hash every
+    replica derives from its own prefix index via
+    `prefix_chain_hashes`); the replica whose published digest holds
+    the DEEPEST chain prefix already has those KV pages resident, so
+    routing there turns whole prefill chunks into page maps (PR-9).
+    The router also adds a placed prompt's hashes to its local view of
+    the target's digest immediately, so a burst of shared-prefix
+    requests lands together without waiting for the next status
+    refresh.
+  * LEAST OCCUPANCY (fallback): each replica's published
+    SchedulerTimeline summary + queue depth (PR-6's occupancy-feedback
+    signal) — fewest (waiting + in-flight), ties to lowest mean
+    occupancy.
+
+Backpressure and overload: a replica whose queue exceeds `max_queue`
+is skipped — an affinity hit that would land on a saturated replica
+SPILLS to the least-loaded one (counted separately: spills measure
+affinity broken by load). When EVERY healthy replica is over the
+bound — or `deadline_bound_s` is set and the fastest replica's
+estimated queue drain exceeds it — the router REJECTS at submit
+(RouterRejected) instead of queueing forever: reject-early beats
+blowing every request's deadline at the back of a hopeless queue.
+
+Health + drain: replicas publish a heartbeat with status; a stale
+heartbeat / unresponsive channel / worker-watchdog flag marks the
+replica HUNG (its own watchdog has dumped diagnostics by then —
+replica.py), the router stops placement and DRAINS it: every
+in-flight request is resubmitted to a peer as prompt + tokens
+generated so far (the PR-9 resurrect path — re-prefill prefix-hits
+the peer's cache, and greedy continuations are token-identical), so a
+wedged replica costs latency, not requests.
+
+Counters: ptpu_route_{affinity_hits,least_loaded,spills,rejects,
+drains}_total through core.monitor; `cluster_snapshot()` is the
+health_dump/bench view.
+"""
+import collections
+import itertools
+import time
+
+from ..kv_pool import chain_hashes
+from ...core import monitor as _m
+
+
+class RouterRejected(RuntimeError):
+    """All replicas over their backpressure/deadline bound — retry
+    later (the cluster is telling you now, not after the deadline)."""
+
+
+_route_ids = itertools.count()
+
+_COUNTERS = {
+    'affinity': ('ptpu_route_affinity_hits_total',
+                 'placements on the replica already holding the '
+                 'prompt prefix pages'),
+    'least_loaded': ('ptpu_route_least_loaded_total',
+                     'placements by occupancy fallback (no prefix '
+                     'affinity)'),
+    'spill': ('ptpu_route_spills_total',
+              'affinity placements diverted by backpressure'),
+    'reject': ('ptpu_route_rejects_total',
+               'submissions rejected early (all replicas over bound)'),
+    'drain': ('ptpu_route_drains_total',
+              'replicas drained (hung or operator-requested)'),
+    'resubmit': ('ptpu_route_resubmits_total',
+                 'in-flight requests moved to a peer by a drain'),
+}
+
+
+class RoutedRequest:
+    """The router-side record of one request: where it went and every
+    token streamed back so far. Survives drains — `tokens` accumulates
+    across resubmissions, so `output_ids()` is the same contract as
+    the engine's Request."""
+
+    def __init__(self, prompt, opts):
+        self.id = next(_route_ids)
+        self.prompt = list(prompt)
+        self.opts = dict(opts)
+        self.tokens = []                # generated, across replicas
+        self.replica_id = None
+        self.remote_rid = None
+        self.decision = None
+        self.resubmits = 0
+        # tokens generated BEFORE the current dispatch: a resubmitted
+        # request's replica reports only its own continuation, which
+        # appends after this prefix
+        self._dispatch_base = 0
+        self.done = False
+        self.submit_t = None
+        self.finish_t = None
+
+    @property
+    def budget_left(self):
+        return self.opts.get('max_new_tokens', 32) - len(self.tokens)
+
+    def output_ids(self):
+        return self.prompt + self.tokens
+
+
+class ClusterRouter:
+    def __init__(self, replicas, page_size, max_queue=8,
+                 deadline_bound_s=None, hang_timeout_s=10.0,
+                 refresh_interval_s=0.25, clock=None):
+        if not replicas:
+            raise ValueError("a cluster needs at least one replica")
+        self.page_size = int(page_size)
+        self.max_queue = int(max_queue)
+        self.deadline_bound_s = deadline_bound_s
+        self.hang_timeout_s = float(hang_timeout_s)
+        self.refresh_interval_s = float(refresh_interval_s)
+        self._clock = clock or time.perf_counter
+        self._replicas = {r.replica_id: r for r in replicas}
+        if len(self._replicas) != len(replicas):
+            raise ValueError("duplicate replica ids")
+        # affinity signal: the digest each replica PUBLISHED last
+        # (replaced wholesale every refresh, so pool evictions age
+        # out) plus a short-lived optimistic overlay for prompts the
+        # router placed that the replica hasn't indexed/published yet
+        # — entries survive OPTIMISTIC_GENERATIONS refreshes, then
+        # drop (re-added on the next same-prefix submit if still hot)
+        self._digest = {rid: set() for rid in self._replicas}
+        self._optimistic = {rid: {} for rid in self._replicas}
+        self._refresh_gen = {rid: 0 for rid in self._replicas}
+        self._status = {rid: {} for rid in self._replicas}
+        self._drained = set()
+        self._hung = set()
+        # request bookkeeping is BOUNDED for a long-lived front-end:
+        # open requests only in _open/_by_replica (pruned the moment
+        # they finish), a capped ring of finished ones for the SLO
+        # view, lifetime counters for the snapshot
+        self._open = {}                 # route id -> RoutedRequest
+        self._recent = collections.deque(maxlen=1024)
+        self._by_replica = {rid: {} for rid in self._replicas}
+        self._routed_count = {rid: 0 for rid in self._replicas}
+        self._total_requests = 0
+        self._done_requests = 0
+        self._unplaced = []             # drain resubmits whose
+                                        # dispatch failed; pump retries
+        self._pump_progressed = False
+        self._last_refresh = None
+        self.drain_events = []
+        self.decisions = {k: 0 for k in _COUNTERS if k != 'reject'}
+        self.rejects = 0
+
+    OPTIMISTIC_GENERATIONS = 2
+
+    # -- bookkeeping ---------------------------------------------------------
+    def _count(self, kind):
+        name, help_ = _COUNTERS[kind]
+        _m.counter(name, help=help_).inc()
+        if kind == 'reject':
+            self.rejects += 1
+        else:
+            self.decisions[kind] = self.decisions.get(kind, 0) + 1
+
+    def healthy_replicas(self):
+        return [rid for rid in self._replicas
+                if rid not in self._drained and rid not in self._hung]
+
+    def _queue_depth(self, rid):
+        # the replica's own view vs the router's dispatch record —
+        # whichever is larger (a just-routed burst may not be in the
+        # last status yet; a drained request may not be out of it)
+        st = self._status.get(rid) or {}
+        routed = sum(1 for r in self._by_replica[rid].values()
+                     if not r.done)
+        return max(st.get('waiting', 0) + st.get('in_flight', 0),
+                   routed)
+
+    def _load_key(self, rid):
+        st = self._status.get(rid) or {}
+        tl = st.get('timeline') or {}
+        return (self._queue_depth(rid),
+                tl.get('mean_occupancy', 0.0), str(rid))
+
+    def _over_bound(self, rid):
+        if self._queue_depth(rid) >= self.max_queue:
+            return True
+        if self.deadline_bound_s is not None:
+            st = self._status.get(rid) or {}
+            rate = st.get('decode_tokens_per_sec') or 0.0
+            if rate > 0.0:
+                pending = st.get('pending_tokens', 0)
+                if pending / rate > self.deadline_bound_s:
+                    return True
+        return False
+
+    # -- placement -----------------------------------------------------------
+    def _affinity_depth(self, hashes, rid):
+        digest = self._digest.get(rid) or ()
+        opt = self._optimistic.get(rid) or ()
+        depth = 0
+        for h in hashes:
+            if h not in digest and h not in opt:
+                break
+            depth += 1
+        return depth
+
+    def place(self, prompt, count_reject=True, _hashes=None):
+        """(decision, replica_id) for a prompt — affinity first,
+        least-occupancy fallback, spill under backpressure, reject
+        when everyone is saturated. `_hashes` lets submit() reuse the
+        chain hashes it computes anyway for the digest update (one
+        blake2b pass per prompt, not two)."""
+        healthy = self.healthy_replicas()
+        if not healthy:
+            if count_reject:
+                self._count('reject')
+            raise RouterRejected("no healthy replicas")
+        hashes = _hashes if _hashes is not None else chain_hashes(
+            prompt, self.page_size, limit=len(prompt) - 1)
+        depths = {rid: self._affinity_depth(hashes, rid)
+                  for rid in healthy}
+        open_replicas = [r for r in healthy if not self._over_bound(r)]
+        if not open_replicas:
+            if count_reject:
+                self._count('reject')
+            raise RouterRejected(
+                f"all {len(healthy)} replicas over the backpressure "
+                f"bound (max_queue={self.max_queue}"
+                + (f", deadline_bound_s={self.deadline_bound_s}"
+                   if self.deadline_bound_s is not None else '') + ")")
+        maxdepth = max(depths.values())
+        if maxdepth > 0:
+            # deepest shared prefix wins; ties go to the lighter one
+            best = min((r for r in healthy if depths[r] == maxdepth),
+                       key=self._load_key)
+            if best in open_replicas:
+                return 'affinity', best
+            # affinity target saturated: spill to the best OPEN
+            # replica — deepest remaining prefix first (a partial
+            # prefix hit still beats re-prefilling everything), load
+            # as the tiebreak
+            return 'spill', min(
+                open_replicas,
+                key=lambda r: (-depths[r],) + self._load_key(r))
+        return 'least_loaded', min(open_replicas, key=self._load_key)
+
+    def submit(self, prompt, **opts):
+        """Place + submit one request; returns the RoutedRequest (or
+        raises RouterRejected). Refreshes stale replica status first
+        so placement never runs on a dead signal."""
+        self.refresh(max_age_s=self.refresh_interval_s)
+        hashes = chain_hashes(prompt, self.page_size,
+                              limit=len(prompt) - 1)
+        req = RoutedRequest(prompt, opts)
+        req.submit_t = self._clock()
+        while True:
+            decision, rid = self.place(prompt, _hashes=hashes)
+            try:
+                self._dispatch(req, rid, decision, hashes=hashes)
+            except Exception as e:          # noqa: BLE001
+                # the chosen replica died between refresh and
+                # dispatch: drain it (its other in-flight requests
+                # move too) and re-place — place() raises
+                # RouterRejected once nobody healthy remains, with
+                # nothing of THIS request stranded anywhere
+                self._hung.add(rid)
+                self.drain(rid, reason=f'submit dispatch failed: '
+                                       f'{repr(e)[:120]}')
+                continue
+            self._count(decision)
+            return req
+
+    def _dispatch(self, req, rid, decision, hashes=None):
+        replica = self._replicas[rid]
+        prompt = req.prompt + req.tokens        # resubmit = resurrect
+        req._dispatch_base = len(req.tokens)
+        opts = dict(req.opts)
+        opts['max_new_tokens'] = req.budget_left
+        remote = replica.submit(prompt, opts, route_meta={
+            'replica_id': str(rid), 'router_decision': decision})
+        req.replica_id, req.remote_rid = rid, remote
+        req.decision = decision if req.decision is None else req.decision
+        self._open[req.id] = req
+        self._by_replica[rid][remote] = req
+        self._routed_count[rid] += 1
+        self._total_requests += 1 if req.resubmits == 0 else 0
+        # optimistic digest overlay: the pages this prompt will index
+        # land on rid — siblings submitted before the replica indexes
+        # and publishes them still route there (aged out after
+        # OPTIMISTIC_GENERATIONS refreshes; the published digest is
+        # the durable signal)
+        if hashes is None:
+            hashes = chain_hashes(prompt, self.page_size,
+                                  limit=len(prompt) - 1)
+        gen = self._refresh_gen[rid]
+        self._optimistic[rid].update(dict.fromkeys(hashes, gen))
+
+    # -- health / status -----------------------------------------------------
+    def refresh(self, max_age_s=0.0):
+        """Pull status from every live replica (digest, queue depth,
+        timeline summary, heartbeat). An unresponsive or self-reported
+        hung replica is drained."""
+        now = self._clock()
+        if (self._last_refresh is not None
+                and now - self._last_refresh < max_age_s):
+            return
+        self._last_refresh = now
+        for rid, replica in list(self._replicas.items()):
+            if rid in self._drained:
+                continue
+            try:
+                st = replica.status()
+            except Exception as e:          # noqa: BLE001
+                self._hung.add(rid)
+                self.drain(rid, reason=f'status unreachable: '
+                                       f'{repr(e)[:120]}')
+                continue
+            self._status[rid] = st
+            tl = st.get('timeline') or {}
+            _m.gauge('ptpu_cluster_replica_queue_depth',
+                     help='per-replica waiting + in-flight requests '
+                          '(router view)',
+                     labelnames=('replica',)).set(
+                self._queue_depth(rid), replica=str(rid))
+            _m.gauge('ptpu_cluster_replica_occupancy',
+                     help='per-replica mean decode-slot occupancy '
+                          '(SchedulerTimeline window)',
+                     labelnames=('replica',)).set(
+                tl.get('mean_occupancy') or 0.0, replica=str(rid))
+            digest = st.get('prefix_digest')
+            if digest is not None:
+                # REPLACE with what the replica actually holds — a
+                # union would keep routing to pages the pool LRU
+                # evicted long ago. Optimistic entries live in their
+                # own overlay and age out by refresh generation.
+                self._digest[rid] = {int(h) for h in digest}
+                gen = self._refresh_gen[rid] = \
+                    self._refresh_gen[rid] + 1
+                horizon = gen - self.OPTIMISTIC_GENERATIONS
+                self._optimistic[rid] = {
+                    h: g for h, g in self._optimistic[rid].items()
+                    if g > horizon}
+            if st.get('hung') or (
+                    st.get('beat_age_s') is not None
+                    and st['beat_age_s'] > self.hang_timeout_s):
+                self._hung.add(rid)
+                self.drain(rid, reason=st.get(
+                    'hang_reason') or
+                    f"heartbeat stale {st.get('beat_age_s'):.1f}s")
+
+    def drain(self, rid, reason='operator drain'):
+        """Stop placement on `rid` and move its in-flight requests to
+        peers. Safe on an unresponsive replica: the router's own
+        records say what was running there and how many tokens each
+        request already streamed back."""
+        if rid in self._drained:
+            return []
+        self._drained.add(rid)
+        self._count('drain')
+        event = {'replica_id': str(rid), 'reason': reason,
+                 't': self._clock(), 'resubmitted': 0}
+        self.drain_events.append(event)
+        # best-effort remote snapshot: a replica whose STEP loop is
+        # wedged still answers on the control thread and reports
+        # tokens the router's poll may not have seen yet
+        snapshots = {}
+        try:
+            for snap in self._replicas[rid].drain():
+                snapshots[snap['rid']] = snap
+        except Exception:                   # noqa: BLE001
+            pass
+        moved = []
+        for remote, req in list(self._by_replica[rid].items()):
+            if req.done:
+                continue
+            snap = snapshots.get(remote)
+            if snap is not None:
+                self._merge_tokens(req, snap.get('generated', ()))
+            self._finish_if_done(req)
+            if req.done:
+                continue
+            req.resubmits += 1
+            self._count('resubmit')
+            if self._resubmit(req):
+                moved.append(req)
+        self._by_replica[rid] = {}
+        event['resubmitted'] = len(moved)
+        return moved
+
+    def _resubmit(self, req):
+        """Re-place one drained request on a peer. Never raises: a
+        failed dispatch (peer channel hiccup, peer itself draining,
+        nobody healthy right now) parks the request in `_unplaced`
+        and pump() keeps retrying — a drain must move EVERY request
+        it can and strand none on a transient error."""
+        try:
+            try:
+                decision, peer = self.place(req.prompt + req.tokens,
+                                            count_reject=False)
+            except RouterRejected:
+                # drained work is NOT new admission — it was already
+                # accepted once and must land somewhere. Bypass the
+                # backpressure bound onto the least-loaded healthy
+                # peer (reject-early guards the front door, not
+                # requests mid-flight).
+                healthy = self.healthy_replicas()
+                if not healthy:
+                    raise
+                decision = 'spill'
+                peer = min(healthy, key=self._load_key)
+            self._dispatch(req, peer, decision)
+            return True
+        except Exception:                   # noqa: BLE001
+            if req not in self._unplaced:
+                self._unplaced.append(req)
+            return False
+
+    @staticmethod
+    def _merge_tokens(req, generated):
+        """Fold a replica's reported continuation into the routed
+        request: the replica only knows tokens since ITS dispatch, so
+        they append after the pre-dispatch prefix."""
+        if len(generated) > len(req.tokens) - req._dispatch_base:
+            req.tokens = (req.tokens[:req._dispatch_base]
+                          + [int(t) for t in generated])
+
+    def _mark_done(self, req):
+        """Terminal bookkeeping: prune from the open/by-replica maps
+        (the router is long-lived — done requests must not accumulate)
+        and keep the request in the capped recent ring for the SLO
+        view. The caller's own RoutedRequest reference stays valid."""
+        req.done = True
+        if req.finish_t is None:
+            req.finish_t = self._clock()
+        if self._open.pop(req.id, None) is not None:
+            self._done_requests += 1
+            self._recent.append(req)
+        by = self._by_replica.get(req.replica_id)
+        if by is not None:
+            by.pop(req.remote_rid, None)
+
+    def _finish_if_done(self, req):
+        eos = req.opts.get('eos_token_id')
+        if req.budget_left <= 0 or (
+                eos is not None and req.tokens
+                and req.tokens[-1] == eos):
+            self._mark_done(req)
+
+    # -- progress ------------------------------------------------------------
+    def pump(self):
+        """Drive in-process replicas one engine step and fold every
+        replica's poll into the routed requests. Returns True while
+        anything is still in flight."""
+        live = False
+        self._pump_progressed = False
+        for req in list(self._unplaced):    # drain leftovers retry
+            if req.done or self._resubmit(req):
+                self._unplaced.remove(req)
+        for rid, replica in self._replicas.items():
+            if rid in self._drained:
+                continue
+            try:
+                if replica.pump():
+                    self._pump_progressed = True
+                polled = replica.poll()
+            except Exception as e:          # noqa: BLE001
+                self.drain(rid, reason=f'poll failed: {repr(e)[:120]}')
+                continue
+            for remote, view in polled.items():
+                req = self._by_replica[rid].get(remote)
+                if req is None or req.done:
+                    continue
+                before = len(req.tokens)
+                self._merge_tokens(req, view.get('generated', ()))
+                if len(req.tokens) != before:
+                    self._pump_progressed = True
+                if view.get('done'):
+                    self._mark_done(req)
+                    self._pump_progressed = True
+                else:
+                    live = True
+        return live or bool(self._open) or bool(self._unplaced)
+
+    def run(self, timeout_s=120.0, poll_interval_s=None):
+        """Pump until every routed request finishes (health-checked
+        every refresh_interval_s). A pass that neither stepped a local
+        replica nor saw new tokens backs off `poll_interval_s`
+        (default 5ms) instead of hot-looping TCP polls against worker
+        control threads that are busy decoding."""
+        if poll_interval_s is None:
+            poll_interval_s = 0.005
+        t0 = self._clock()
+        while self._open or self._unplaced:
+            self.refresh(max_age_s=self.refresh_interval_s)
+            self.pump()
+            if self._clock() - t0 > timeout_s:
+                raise RuntimeError(
+                    f"cluster did not drain in {timeout_s}s "
+                    f"(open: {sorted(self._open)})")
+            if poll_interval_s and not self._pump_progressed:
+                time.sleep(poll_interval_s)
+        self.refresh(max_age_s=0.0)     # snapshot() sees final state
+        return list(self._recent)
+
+    def serve(self, prompts, timeout_s=120.0, **opts):
+        """Submit a prompt list, run to completion, return outputs in
+        submission order — the cluster-wide `engine.generate`.
+
+        Unlike raw `submit()` (the reject-early surface for callers
+        who can retry), serve() THROTTLES on RouterRejected: it pumps
+        the replicas until queues drain below the bound and retries,
+        so a long batch never strands its already-placed prefix
+        mid-submission. A rejection with no progress possible (no
+        healthy replicas) still escapes via the timeout."""
+        t0 = self._clock()
+        reqs = []
+        for p in prompts:
+            while True:
+                try:
+                    reqs.append(self.submit(p, **opts))
+                    break
+                except RouterRejected:
+                    if self._clock() - t0 > timeout_s:
+                        raise
+                    self.refresh(max_age_s=self.refresh_interval_s)
+                    self.pump()
+        self.run(timeout_s=max(timeout_s - (self._clock() - t0), 1.0))
+        return [r.output_ids() for r in reqs]
+
+    # -- views ---------------------------------------------------------------
+    def snapshot(self):
+        """JSON-ready router view: placement counters, per-replica
+        load/digest sizes, drain events — what `tools/health_dump.py
+        cluster` renders and the bench leg records."""
+        per_replica = {}
+        for rid in self._replicas:
+            st = self._status.get(rid) or {}
+            tl = st.get('timeline') or {}
+            per_replica[str(rid)] = {
+                'drained': rid in self._drained,
+                'hung': rid in self._hung or bool(st.get('hung')),
+                'queue_depth': self._queue_depth(rid),
+                'waiting': st.get('waiting', 0),
+                'in_flight': st.get('in_flight', 0),
+                'mean_occupancy': tl.get('mean_occupancy'),
+                'decode_tokens': tl.get('decode_tokens'),
+                'prefill_tokens': tl.get('prefill_tokens'),
+                'preemptions': tl.get('preemptions'),
+                'digest_size': len(self._digest.get(rid) or ())
+                + len(self._optimistic.get(rid) or ()),
+                'requests_routed': self._routed_count[rid],
+            }
+        total = sum(self.decisions.get(k, 0)
+                    for k in ('affinity', 'least_loaded', 'spill'))
+        return {
+            'replicas': per_replica,
+            'placements': dict(self.decisions),
+            'rejects': self.rejects,
+            'affinity_hit_rate':
+                (self.decisions.get('affinity', 0) / total
+                 if total else None),
+            'drain_events': list(self.drain_events),
+            'requests': self._total_requests,
+            'requests_done': self._done_requests,
+        }
+
+    def request_slo(self):
+        """Router-side per-request latency view (submit→finish as the
+        ROUTER saw it — includes channel + drain resubmission time the
+        engine-side traces can't see). Open requests plus the capped
+        ring of recently finished ones."""
+        out = {}
+        for r in list(self._recent) + list(self._open.values()):
+            out[r.id] = {
+                'req': r.id, 'replica_id': str(r.replica_id),
+                'router_decision': r.decision,
+                'resubmits': r.resubmits,
+                'tokens_generated': len(r.tokens),
+                'e2e_s': (r.finish_t - r.submit_t)
+                if r.done and r.submit_t is not None else None,
+            }
+        return out
+
+    def shutdown(self):
+        for replica in self._replicas.values():
+            try:
+                replica.shutdown()
+            except Exception:               # noqa: BLE001
+                pass
+
+
+def cluster_snapshot():
+    """The ptpu_route_* counters currently in the monitor registry
+    (None-able mirror of the last router's activity) — the
+    StepTelemetry / health_dump pickup point."""
+    reg = _m.metrics()
+    out = {}
+    for kind, (name, _h) in _COUNTERS.items():
+        m = reg.get(name)
+        if m is not None:
+            out[name] = m.value()
+    return out or None

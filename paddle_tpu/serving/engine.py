@@ -114,6 +114,17 @@ class ServingConfig:
                      $PTPU_SERVE_REPORT_DIR, then $FLEET_LOG_DIR)
     clock            monotonic clock for ALL request timing
                      (tests inject a deterministic one)
+    disaggregate     prefill/decode disaggregation (ISSUE 11, default
+                     off): chunked prefill runs on a dedicated prefill
+                     engine whose finished KV pages STREAM into the
+                     decode engine's pool, where the request is
+                     adopted into a decode slot
+                     (serving/cluster/disagg.py,
+                     docs/serving.md#disaggregated-serving)
+    prefill_slots    prefill-engine slot count under disaggregation
+    stream_chunk_pages  pages per streamed copy op (0 = one shot) —
+                     bounds the handoff's staging footprint like the
+                     PR-10 chunked collectives
     """
 
     def __init__(self, page_size=16, max_batch_size=4, num_pages=None,
@@ -122,7 +133,9 @@ class ServingConfig:
                  spec_k=0, spec_ngram=2, seed=0, trace=True,
                  trace_events_per_request=512, trace_requests=512,
                  timeline_capacity=2048, request_deadline_s=None,
-                 deadline_action='report', report_dir=None, clock=None):
+                 deadline_action='report', report_dir=None, clock=None,
+                 disaggregate=False, prefill_slots=2,
+                 stream_chunk_pages=0):
         if page_size <= 0 or max_batch_size <= 0 or prefill_chunk <= 0:
             raise ValueError("page_size, max_batch_size and "
                              "prefill_chunk must be positive")
@@ -154,18 +167,39 @@ class ServingConfig:
         self.deadline_action = deadline_action
         self.report_dir = report_dir
         self.clock = clock
+        self.disaggregate = bool(disaggregate)
+        self.prefill_slots = int(prefill_slots)
+        self.stream_chunk_pages = int(stream_chunk_pages)
 
 
 class ServingEngine:
-    """Continuous-batching inference over a GPTForCausalLM."""
+    """Continuous-batching inference over a GPTForCausalLM.
 
-    def __init__(self, model, config=None, **cfg_kw):
+    `mesh`: an optional replica-local jax Mesh with an 'mp' axis — the
+    mp-sharded serving route (ISSUE 11): attention heads (and the KV
+    pool's pages) split over 'mp' exactly like the training flash
+    route, so one replica can span several chips when the model's KV
+    doesn't fit one. The model must have been built under a fleet hcg
+    whose mp degree equals the mesh's 'mp' size (mp_layers then mark
+    qkv/out/vocab params with their split axes and emit the Megatron
+    collectives inside the traced step). docs/serving.md#mp-sharding.
+    """
+
+    def __init__(self, model, config=None, mesh=None, **cfg_kw):
         import jax
         import jax.numpy as jnp
         if config is None:
             config = ServingConfig(**cfg_kw)
         elif cfg_kw:
             raise ValueError("pass either config or knobs, not both")
+        if config.disaggregate:
+            # the flag selects a DIFFERENT engine class — silently
+            # serving unified under a disaggregate config would lie
+            raise ValueError(
+                "config.disaggregate=True needs the disaggregated "
+                "engine: build via serving.cluster.build_engine(...) "
+                "or serving.cluster.DisaggregatedEngine(...) "
+                "(docs/serving.md#disaggregated-serving)")
         self.model = model
         self.config = config
         mcfg = model.config
@@ -178,11 +212,34 @@ class ServingEngine:
         attn0 = model.gpt.layers[0].attn
         dtype = (config.kv_dtype
                  or model.gpt.embeddings.word_embeddings.weight.dtype)
+        self.mesh = mesh
+        self._mp = int(mesh.shape['mp']) if (
+            mesh is not None and 'mp' in mesh.shape) else 1
+        if self._mp > 1:
+            if attn0.world_size != self._mp:
+                raise ValueError(
+                    f"mesh mp={self._mp} but the model was built with "
+                    f"mp degree {attn0.world_size} — fleet.init (or a "
+                    f"minimal hcg) with model-parallel degree "
+                    f"{self._mp} BEFORE constructing the model")
+            if config.weight_dtype is not None:
+                raise ValueError(
+                    "weight_dtype='int8' is not supported on the "
+                    "mp-sharded serving route yet (per-out-channel "
+                    "scales would need their own split specs)")
+        # the pool holds GLOBAL heads; under mp the arrays are sharded
+        # on the trailing heads*hd axis so each shard owns its local
+        # heads' pages — the same layout the column-sharded qkv writes
         self.pool = KVPagePool(
             num_pages, ps, num_layers=mcfg.num_layers,
-            num_heads=attn0.local_heads, head_dim=attn0.head_dim,
+            num_heads=attn0.local_heads * self._mp,
+            head_dim=attn0.head_dim,
             dtype=dtype, prefix_cache=config.prefix_cache)
-        self.pool.materialize()
+        self._kv_sharding = None
+        if self._mp > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            self._kv_sharding = NamedSharding(mesh, P(None, None, 'mp'))
+        self.pool.materialize(sharding=self._kv_sharding)
         self._clock = config.clock or time.perf_counter
         self.scheduler = Scheduler(config.max_batch_size,
                                    clock=self._clock)
@@ -218,6 +275,22 @@ class ServingEngine:
                 self._params[n] = {'q': jnp.asarray(q),
                                    's': jnp.asarray(s)}
                 self._qparam_dtypes[n] = a.dtype
+        # mp-sharded params: split specs from the mp_layers marks
+        # (split_axis over 'mp', everything else replicated); placed
+        # once here so the jitted step never reshards weights
+        self._param_specs = None
+        if self._mp > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            specs = {}
+            for n, p in model.named_parameters():
+                spec = [None] * len(p.data.shape)
+                if getattr(p, 'is_distributed', False):
+                    spec[p.split_axis] = 'mp'
+                specs[n] = P(*spec)
+            self._param_specs = specs
+            self._params = {
+                n: jax.device_put(a, NamedSharding(mesh, specs[n]))
+                for n, a in self._params.items()}
         self._step_fns = {}
         self._key = jax.random.key(config.seed)
         self._jnp = jnp
@@ -244,6 +317,11 @@ class ServingEngine:
         self._new_slo = {'queue_wait_s': [], 'tpot_s': [], 'e2e_s': [],
                          'preemptions': []}
         self._last_publish = 0.0
+
+    # followers a budget-blocked queue head tolerates being admitted
+    # past it before the admission sweep reverts to blocking at the
+    # head (head-of-line fairness with a starvation bound)
+    HOL_BYPASS_LIMIT = 8
 
     # seconds between periodic gauge publishes on a busy engine —
     # publishing rebuilds stats and touches ~20 monitor gauges, which
@@ -363,32 +441,82 @@ class ServingEngine:
         pages a live sibling already maps cost the budget NOTHING,
         and cached-resurrect pages cost a page but no prefill compute
         — so the need is the first chunk's page-table size minus the
-        live-shared pages."""
+        live-shared pages.
+
+        Head-of-line fairness (ISSUE 11 satellite): a head whose first
+        chunk exceeds this sweep's budget no longer blocks the sweep —
+        the scan continues down the queue and admits any follower that
+        DOES fit (FCFS order among the admissible). The skipped head
+        keeps its queue position; and so that a stream of small
+        requests can't starve it forever (every retire's freed pages
+        going straight to a new follower), each follower admitted past
+        it counts against HOL_BYPASS_LIMIT — once spent, the sweep
+        reverts to blocking at the head, freed pages accumulate across
+        sweeps, and the head admits as soon as they cover its chunk."""
         sched = self.scheduler
         budget = self.pool.free_pages
         n_admitted = 0
-        while sched.waiting and None in sched.slots:
-            head = sched.waiting[0]
+        n_bypassed = 0          # admissions AFTER the head blocked —
+                                # only those are bypasses (a request
+                                # admitted while it was itself the
+                                # head passed nobody)
+        blocked_head = None
+        for req in list(sched.waiting):
+            if None not in sched.slots:
+                break
             cached, live, _ = self.pool.peek_prefix(
-                head.tokens, limit=len(head.tokens) - 1)
+                req.tokens, limit=len(req.tokens) - 1)
             need = max(self.pool.pages_for(
-                min(len(head.tokens),
+                min(len(req.tokens),
                     cached + self.config.prefill_chunk)) - live, 0)
             if budget < need:
-                break
-            got = sched.admit(limit=1)
-            if not got:
-                break
+                if req is sched.waiting[0]:
+                    if req.admit_bypasses >= self.HOL_BYPASS_LIMIT:
+                        break       # starvation bound reached: stop
+                                    # bypassing, let pages accumulate
+                    blocked_head = req
+                continue        # oversized for THIS sweep's budget:
+                                # skip, keep scanning for a fit
+            if sched.admit_request(req) is None:
+                continue
             budget -= need
-            n_admitted += len(got)
-            for req in got:
-                self._trace(req,
-                            'resume' if req.preemptions else 'admit',
-                            t=(req.admit_time
-                               if not req.preemptions else None),
-                            slot=sched.slot_of(req),
-                            waiting=len(sched.waiting))
+            n_admitted += 1
+            if blocked_head is not None:
+                n_bypassed += 1
+            self._trace(req,
+                        'resume' if req.preemptions else 'admit',
+                        t=(req.admit_time
+                           if not req.preemptions else None),
+                        slot=sched.slot_of(req),
+                        waiting=len(sched.waiting))
+        if blocked_head is not None:
+            blocked_head.admit_bypasses += n_bypassed
         return n_admitted
+
+    def adopt_request(self, req):
+        """Adopt a request prefilled ELSEWHERE (prefill→decode
+        disaggregation, serving/cluster/disagg.py): its KV pages were
+        already allocated in this engine's pool under req.id and their
+        contents streamed in, its first token is already in
+        req.generated — it goes straight to a RUNNING decode slot.
+        Returns False when no slot is free (caller keeps it pending).
+        The streamed pages join this pool's prefix index so decode-side
+        siblings share them like locally-prefilled ones."""
+        if self.scheduler.adopt(req) is None:
+            return False
+        req.prefilled = len(req.tokens)
+        self._submitted += 1
+        # everything but the newest token has K/V resident (the next
+        # decode step writes that one) — same invariant _decode_step
+        # maintains
+        self.pool.register_prefix(req.id, req.tokens,
+                                  req.context_len - 1)
+        self._trace(req, 'admit', slot=self.scheduler.slot_of(req),
+                    handoff=True,
+                    pages=len(self.pool.page_table(req.id)))
+        if req.done:
+            self._retire(req)
+        return True
 
     def _ensure_or_preempt(self, req, n_tokens):
         """Grow req's pages, preempting the youngest other in-flight
@@ -430,6 +558,7 @@ class ServingEngine:
 
     def _build_step(self, B, T, sample, verify=False):
         jax, jnp = self._jax, self._jnp
+        import contextlib
         model = self.model
         from ..core.tensor import Tensor
         from ..core.autograd import no_grad
@@ -437,6 +566,28 @@ class ServingEngine:
         max_pos = model.config.max_seq_len - 1
 
         qdtypes = dict(self._qparam_dtypes)
+        mp = self._mp
+
+        def _spmd():
+            # mp_layers key their collectives off the spmd region —
+            # without it a >1-degree model would silently run the
+            # degenerate single-rank math on sharded weights
+            if mp > 1:
+                from ..distributed import collective as C
+                return C.spmd_region(('mp',))
+            return contextlib.nullcontext()
+
+        def _full_logits(lg):
+            """Vocab-parallel logits -> full vocab: the tied LM head is
+            the VocabParallelEmbedding weight, so under mp each shard
+            computes [., V/mp] logits for its vocab rows; argmax /
+            sampling need the whole vocab, so gather over 'mp' (shard
+            i's rows are vocab block i — concat order is the identity)."""
+            if mp <= 1:
+                return lg
+            g = jax.lax.all_gather(lg, 'mp')        # [mp, ..., V/mp]
+            g = jnp.moveaxis(g, 0, -2)              # [..., mp, V/mp]
+            return g.reshape(lg.shape[:-1] + (lg.shape[-1] * mp,))
 
         def step(params, kv, tokens, page_tables, seq_lens, q_lens, key,
                  temps, top_ks):
@@ -454,7 +605,7 @@ class ServingEngine:
                                * s.reshape(shape)).astype(qdtypes[n])
                 else:
                     arrs[n] = v
-            with bind_arrays(model, arrs):
+            with bind_arrays(model, arrs), _spmd():
                 pos = (seq_lens[:, None] - q_lens[:, None]
                        + jnp.arange(T, dtype=jnp.int32)[None, :])
                 pos = jnp.clip(pos, 0, max_pos)
@@ -468,9 +619,9 @@ class ServingEngine:
                     # (t >= q_len) produce garbage the host ignores.
                     # Rows that sample ride along via an extra column
                     # so the step still costs ONE host fetch.
-                    logits_all = jnp.einsum(
+                    logits_all = _full_logits(jnp.einsum(
                         'bth,vh->btv', h.data, w.data,
-                        preferred_element_type=jnp.float32)
+                        preferred_element_type=jnp.float32))
                     nxt = jnp.argmax(logits_all, axis=-1) \
                         .astype(jnp.int32)                  # [B, T]
                     if sample:
@@ -488,9 +639,9 @@ class ServingEngine:
                 idx = jnp.clip(q_lens - 1, 0, T - 1).astype(jnp.int32)
                 h_last = jnp.take_along_axis(
                     h.data, idx[:, None, None], axis=1)[:, 0, :]
-                logits = jnp.einsum(
+                logits = _full_logits(jnp.einsum(
                     'bh,vh->bv', h_last, w.data,
-                    preferred_element_type=jnp.float32)
+                    preferred_element_type=jnp.float32))
                 if sample:
                     nxt = _device_sample(logits.astype(jnp.float32),
                                          key, temps, top_ks)
@@ -501,6 +652,22 @@ class ServingEngine:
         # donation updates the pool pages in place; CPU jax has no
         # donation support and would warn every call
         donate = (1,) if jax.default_backend() != 'cpu' else ()
+        if mp > 1:
+            # one jit(shard_map(step)) over the replica-local mesh —
+            # the hybrid train step's layout applied to serving: params
+            # at their split axes, KV pages on the heads axis, all the
+            # tiny host-built operands (tokens/tables/lens/key)
+            # replicated; the sampled tokens come back replicated
+            # (every shard gathers the full vocab)
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec as P
+            kv_specs = [tuple(P(None, None, 'mp') for _ in layer)
+                        for layer in self.pool.kv]
+            in_specs = (dict(self._param_specs), kv_specs,
+                        P(), P(), P(), P(), P(), P(), P())
+            out_specs = (P(), kv_specs)
+            step = shard_map(step, mesh=self.mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_rep=False)
         jitted = jax.jit(step, donate_argnums=donate)
 
         def run(*args):

@@ -53,10 +53,40 @@ enforces (tested in tests/test_serving.py):
     returns to the free/cached set only when its LAST mapper lets go.
 """
 import collections
+import hashlib
 import math
+import struct
 import threading
 
 import numpy as _np
+
+
+def chain_hash(parent_hash, block_tokens):
+    """64-bit hash of one radix-chain link: the parent chain hash plus
+    this block's tokens. Stable across processes (blake2b, fixed
+    little-endian packing) — the disaggregated router hashes a prompt's
+    block chain with exactly this function and compares against the
+    digests each replica publishes from its own prefix index
+    (cluster/router.py prefix-affinity placement)."""
+    h = hashlib.blake2b(digest_size=8)
+    h.update(struct.pack('<Q',
+                         int(parent_hash) & 0xFFFFFFFFFFFFFFFF))
+    h.update(_np.asarray(list(block_tokens), '<i4').tobytes())
+    return int.from_bytes(h.digest(), 'little')
+
+
+def chain_hashes(tokens, page_size, limit=None):
+    """Chain hashes of every FULL page_size-token block of `tokens`
+    (capped at `limit` tokens), in chain order — h[i] identifies the
+    whole prefix up to block i, matching the pool's radix-index
+    identity (kv_pool docstring)."""
+    n = len(tokens) if limit is None else min(len(tokens),
+                                              max(int(limit), 0))
+    out, h = [], -1
+    for i in range(n // int(page_size)):
+        h = chain_hash(h, tokens[i * page_size:(i + 1) * page_size])
+        out.append(h)
+    return out
 
 
 def _np_dtype(dt):
@@ -106,6 +136,11 @@ class KVPagePool:
         self._children = {}                 # page id -> child page ids
         self._cached = collections.OrderedDict()
         self._registered_upto = {}          # seq id -> tokens indexed
+        self._digest_cache = None           # (limit, hashes) memo —
+                                            # invalidated on any index
+                                            # mutation; status() polls
+                                            # this several times a
+                                            # second per replica
         self._lock = threading.Lock()
         self.alloc_total = 0
         self.free_total = 0
@@ -123,25 +158,37 @@ class KVPagePool:
             return False
         return _np_dtype(self.dtype) == _np.int8
 
-    def materialize(self):
+    def materialize(self, sharding=None):
+        """Create the device arrays. `sharding` (a NamedSharding whose
+        spec splits the trailing heads*hd axis, e.g. P(None, None,
+        'mp')) places the pool sharded over a replica-local mesh for
+        the mp-sharded serving route — each mp shard then holds its
+        local heads' pages, exactly the layout forward_paged's
+        column-sharded qkv writes (docs/serving.md#mp-sharding)."""
         if self.kv is not None:
             return self.kv
         import jax.numpy as jnp
+
+        def _z(shape, dt):
+            arr = jnp.zeros(shape, dt)
+            if sharding is not None:
+                import jax
+                arr = jax.device_put(arr, sharding)
+            return arr
+
         hd = self.num_heads * self.head_dim
         if self.quantized:
             shape = (self.num_pages, self.page_size, hd)
             sshape = (self.num_pages, self.page_size, self.num_heads)
             self.kv = [
-                (jnp.zeros(shape, jnp.int8),
-                 jnp.zeros(shape, jnp.int8),
-                 jnp.zeros(sshape, jnp.float32),
-                 jnp.zeros(sshape, jnp.float32))
+                (_z(shape, jnp.int8), _z(shape, jnp.int8),
+                 _z(sshape, jnp.float32), _z(sshape, jnp.float32))
                 for _ in range(self.num_layers)]
             return self.kv
         dt = self.dtype or jnp.float32
         self.kv = [
-            (jnp.zeros((self.num_pages, self.page_size, hd), dt),
-             jnp.zeros((self.num_pages, self.page_size, hd), dt))
+            (_z((self.num_pages, self.page_size, hd), dt),
+             _z((self.num_pages, self.page_size, hd), dt))
             for _ in range(self.num_layers)]
         return self.kv
 
@@ -216,6 +263,7 @@ class KVPagePool:
         plain private page and frees normally at release. Iterative:
         chains grow one node per page of a sequence, which at small
         page sizes is deeper than Python's recursion limit."""
+        self._digest_cache = None
         stack = [page]
         while stack:
             p = stack.pop()
@@ -334,6 +382,7 @@ class KVPagePool:
             self._children.clear()
             self._cached.clear()
             self._registered_upto.clear()
+            self._digest_cache = None
 
     # -- prefix index --------------------------------------------------------
     def _match_pages(self, tokens, limit=None):
@@ -420,11 +469,44 @@ class KVPagePool:
                         break                       # under another key
                     self._index[key] = page
                     self._page_key[page] = key
+                    self._digest_cache = None
                     if parent != -1:
                         self._children.setdefault(parent,
                                                   set()).add(page)
                 parent = page
             self._registered_upto[seq_id] = blocks * ps
+
+    def prefix_chain_hashes(self, limit=4096):
+        """Chain hashes (chain_hash above) of every chain indexed in
+        the prefix index, capped at `limit` entries — the affinity
+        digest a serving replica publishes so the cluster router can
+        route a prompt to the replica that already holds its prefix
+        pages. A hash is present exactly when the corresponding token
+        chain would prefix-hit here (match_and_map walks the same
+        radix links). Memoized: the replica status loop reads this
+        several times a second, and re-hashing thousands of chains
+        under the pool lock would stall the allocator — the memo
+        invalidates whenever the index gains or loses a chain."""
+        if not self.prefix_cache:
+            return []
+        out = []
+        with self._lock:
+            memo = self._digest_cache
+            if memo is not None and memo[0] == limit:
+                return list(memo[1])
+            roots = [(key, page) for key, page in self._index.items()
+                     if key[0] == -1]
+            stack = [(-1, key, page) for key, page in roots]
+            while stack and len(out) < int(limit):
+                parent_hash, key, page = stack.pop()
+                h = chain_hash(parent_hash, key[1])
+                out.append(h)
+                for child in self._children.get(page, ()):
+                    ckey = self._page_key.get(child)
+                    if ckey is not None:
+                        stack.append((h, ckey, child))
+            self._digest_cache = (limit, list(out))
+        return out
 
     def census(self):
         """{seq_id: pages held} — who is sitting on the pool right now

@@ -31,12 +31,18 @@ import os
 import threading
 import time
 
-SCHEMA = 'paddle_tpu.serve_trace/1'
+SCHEMA = 'paddle_tpu.serve_trace/2'
+# v1 files (no route events) still load — load_trace accepts both
+SCHEMAS = ('paddle_tpu.serve_trace/1', SCHEMA)
 
 # lifecycle event vocabulary (docs/serving.md#request-traces);
 # prefix_hit = cached pages mapped at prefill start (ISSUE 9),
-# spec_verify = one speculative verify outcome (k proposed, m accepted)
-EVENTS = ('submit', 'admit', 'prefix_hit', 'prefill_chunk',
+# spec_verify = one speculative verify outcome (k proposed, m accepted),
+# route = cluster-router placement (ISSUE 11, schema v2: replica_id +
+# router_decision affinity|least_loaded|spill — stamped by the replica
+# worker right after submit so per-replica trace files say who placed
+# the request here and why)
+EVENTS = ('submit', 'route', 'admit', 'prefix_hit', 'prefill_chunk',
           'first_token', 'decode', 'spec_verify', 'preempt', 'resume',
           'retire', 'abort')
 
@@ -225,7 +231,8 @@ def reconstruct(events):
             'preemptions': 0, 'prefill_chunks': 0, 'decode_steps': 0,
             'pages_high_water': 0, 'last_token_t': None,
             'prefix_cached_tokens': 0, 'spec_proposed': 0,
-            'spec_accepted': 0,
+            'spec_accepted': 0, 'replica_id': None,
+            'router_decision': None,
         })
         ev, t = e['event'], e['t']
         if 'pages' in e:
@@ -234,6 +241,13 @@ def reconstruct(events):
         if ev == 'submit':
             r['submit_t'] = t
             r['prompt_tokens'] = e.get('prompt_tokens')
+        elif ev == 'route':
+            # schema v2: which replica got this request and why; the
+            # FIRST placement wins (a drain-resubmit lands in the
+            # peer's own trace file under a new request id)
+            if r['replica_id'] is None:
+                r['replica_id'] = e.get('replica_id')
+                r['router_decision'] = e.get('router_decision')
         elif ev == 'admit' and r['admit_t'] is None:
             r['admit_t'] = t
         elif ev == 'resume':
@@ -301,7 +315,11 @@ def percentile_of(vals, q):
 
 
 def load_trace(path):
-    """Read an export_jsonl file back into (header, events)."""
+    """Read an export_jsonl file back into (header, events). Both
+    schema versions load — v1 traces simply carry no route events, so
+    reconstruct() leaves replica_id/router_decision at None. An
+    unknown serve_trace version raises rather than silently
+    mis-reading a future layout."""
     header, events = {}, []
     with open(path) as f:
         for line in f:
@@ -310,6 +328,12 @@ def load_trace(path):
                 continue
             doc = json.loads(line)
             if 'schema' in doc and 'event' not in doc:
+                schema = doc.get('schema', '')
+                if schema.startswith('paddle_tpu.serve_trace/') \
+                        and schema not in SCHEMAS:
+                    raise ValueError(
+                        f"unsupported serve trace schema {schema!r} "
+                        f"(this build reads {SCHEMAS})")
                 header = doc
             elif 'event' in doc and 'req' in doc:
                 events.append(doc)

@@ -60,6 +60,11 @@ class Request:
         self.state = RequestState.WAITING
         self.submit_time = None
         self.admit_time = None           # first admit (queue-wait end)
+        self.admit_bypasses = 0          # followers admitted past this
+                                         # request while it sat at the
+                                         # queue head over-budget
+                                         # (engine._admit starvation
+                                         # bound)
         self.first_token_time = None
         self.finish_time = None
         self.preemptions = 0
@@ -119,24 +124,54 @@ class Scheduler:
 
     def admit(self, limit=None):
         """Fill free slots from the queue (FCFS), at most `limit` of
-        them (None = all). Returns the admitted requests; the engine
-        admits one at a time against its page budget and allocates
-        first pages at the prefill step (bouncing a request back via
-        `preempt()` if even that fails)."""
+        them (None = all). One body with `admit_request` below — this
+        is the unconditional head-first loop; the engine's budgeted
+        sweep picks specific requests via admit_request directly."""
         admitted = []
-        for i in range(self.num_slots):
-            if limit is not None and len(admitted) >= limit:
+        while self.waiting and (limit is None
+                                or len(admitted) < limit):
+            req = self.admit_request(self.waiting[0])
+            if req is None:
                 break
-            if self.slots[i] is None and self.waiting:
-                req = self.waiting.pop(0)
-                req.state = RequestState.PREFILL
-                # resume after preemption re-prefills prompt+generated
-                req.prefilled = 0
-                if req.admit_time is None:
-                    req.admit_time = self.clock()
-                self.slots[i] = req
-                admitted.append(req)
+            admitted.append(req)
         return admitted
+
+    def admit_request(self, request):
+        """Admit one SPECIFIC waiting request into a free slot — the
+        engine's head-of-line fairness path (ISSUE 11 satellite): when
+        the queue head's first chunk exceeds the page budget this
+        sweep, admissible followers behind it are admitted in FCFS
+        order instead of starving behind the blocked head (which keeps
+        its queue position and first claim on next sweep's budget).
+        Returns the request, or None if it isn't waiting / no slot."""
+        if request not in self.waiting:
+            return None
+        for i in range(self.num_slots):
+            if self.slots[i] is None:
+                self.waiting.remove(request)
+                request.state = RequestState.PREFILL
+                request.prefilled = 0
+                if request.admit_time is None:
+                    request.admit_time = self.clock()
+                self.slots[i] = request
+                return request
+        return None
+
+    def adopt(self, request):
+        """Place an externally-prefilled request straight into a free
+        slot in RUNNING state — the prefill→decode disaggregation
+        handoff (serving/cluster/disagg.py): its KV pages were
+        streamed into this engine's pool, so there is nothing to
+        prefill. Returns the slot index, or None when no slot is
+        free (the caller keeps it pending and retries)."""
+        for i in range(self.num_slots):
+            if self.slots[i] is None:
+                request.state = RequestState.RUNNING
+                if request.admit_time is None:
+                    request.admit_time = self.clock()
+                self.slots[i] = request
+                return i
+        return None
 
     def slot_of(self, request):
         return self.slots.index(request)

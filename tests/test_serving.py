@@ -415,6 +415,61 @@ class TestContinuousBatching:
         assert len(eng.scheduler.running()) == 1
         assert len(eng.scheduler.waiting) == 2
 
+    def test_admit_oversized_head_does_not_starve_followers(
+            self, tiny_lm):
+        # ISSUE 11 satellite: the queue HEAD needs 2 pages but only 1
+        # is free — the old sweep broke at the head and left an
+        # admissible 1-page follower starving behind it. The head must
+        # be skipped (keeping its queue position) and the follower
+        # admitted in the SAME sweep.
+        eng = ServingEngine(tiny_lm, ServingConfig(
+            page_size=8, max_batch_size=3, prefill_chunk=16,
+            num_pages=3, prefix_cache=False))
+        blocker = eng.submit([9] * 6, max_new_tokens=10)
+        eng.step()                      # holds 1 page, decodes on
+        assert blocker.state == RequestState.RUNNING
+        head = eng.submit(list(range(1, 18)), max_new_tokens=2)
+        follower = eng.submit([7] * 6, max_new_tokens=2)
+        assert eng.pool.free_pages == 2     # head's chunk needs 2,
+        eng.pool.ensure_capacity('pin', 8)  # pin one -> budget 1
+        assert eng._admit() == 1
+        assert follower.state == RequestState.PREFILL
+        assert eng.scheduler.waiting == [head]   # kept FCFS position
+        eng.pool.release('pin')
+        # next sweep's budget fits the head again
+        assert eng._admit() == 1
+        assert head.state == RequestState.PREFILL
+        while eng.scheduler.has_work:
+            eng.step()
+        eng.shutdown()
+
+    def test_admit_bypass_bound_prevents_head_starvation(
+            self, tiny_lm):
+        # the fairness scan is BOUNDED: once HOL_BYPASS_LIMIT
+        # followers have been admitted past a budget-blocked head, the
+        # sweep reverts to blocking at the head so freed pages can
+        # accumulate for it instead of feeding a small-request stream
+        # forever
+        eng = ServingEngine(tiny_lm, ServingConfig(
+            page_size=8, max_batch_size=2, prefill_chunk=16,
+            num_pages=3, prefix_cache=False))
+        eng.pool.ensure_capacity('pin', 16)     # 1 page budget left
+        head = eng.submit(list(range(1, 18)), max_new_tokens=2)
+        eng.submit([7] * 6, max_new_tokens=2)
+        assert eng._admit() == 1                # follower bypasses
+        assert eng.scheduler.waiting == [head]
+        assert head.admit_bypasses == 1
+        head.admit_bypasses = ServingEngine.HOL_BYPASS_LIMIT
+        follower2 = eng.submit([8] * 6, max_new_tokens=2)
+        assert eng._admit() == 0                # bound hit: sweep
+        assert follower2.state == RequestState.WAITING  # blocks at head
+        eng.pool.release('pin')
+        # head fits now and takes the one remaining slot FIRST
+        assert eng._admit() == 1
+        assert head.state == RequestState.PREFILL
+        assert eng.scheduler.waiting == [follower2]
+        eng.shutdown()
+
     def test_generate_batch_config_change_replaces_engine(
             self, tiny_lm):
         tiny_lm.generate_batch([[1, 2, 3]], max_new_tokens=2, top_k=0,

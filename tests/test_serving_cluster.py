@@ -1,0 +1,634 @@
+"""Disaggregated serving cluster (ISSUE 11): prefix-affinity router
+placement, dp replicas (in-process + true subprocess workers),
+prefill→decode page streaming, mp-sharded engine equivalence, and the
+forced-hang replica drain path."""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+
+import paddle_tpu as paddle
+from paddle_tpu.serving import ServingConfig, ServingEngine
+from paddle_tpu.serving.kv_pool import (KVPagePool, chain_hash,
+                                        chain_hashes)
+from paddle_tpu.serving.cluster import (ClusterRouter, LocalReplica,
+                                        RemoteReplica, RouterRejected)
+from paddle_tpu.serving.cluster.disagg import DisaggregatedEngine
+
+MODEL_KW = dict(vocab_size=128, hidden_size=32, num_layers=2,
+                num_heads=4, max_seq_len=128, hidden_dropout=0.0,
+                attn_dropout=0.0, use_flash_attention=False)
+ENGINE_KW = dict(page_size=8, max_batch_size=3, prefill_chunk=16)
+
+
+@pytest.fixture(scope='module')
+def tiny_lm():
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    paddle.seed(0)
+    model = GPTForCausalLM(GPTConfig(**MODEL_KW))
+    model.eval()
+    return model
+
+
+@pytest.fixture()
+def mixed_prompts():
+    rng = np.random.RandomState(1)
+    fam = [list(rng.randint(1, 128, 24)) for _ in range(2)]
+    order = [0, 1, 0, 1, 1, 0, 0, 1, 0, 1]
+    return [fam[f] + list(rng.randint(1, 128,
+                                      int(rng.randint(2, 10))))
+            for f in order]
+
+
+def _single_reference(model, prompts, max_new=8, **kw):
+    eng = ServingEngine(model, ServingConfig(**{**ENGINE_KW, **kw}))
+    out = eng.generate(prompts, max_new_tokens=max_new, top_k=0)
+    eng.shutdown()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# chain hashes: the router and the pool must derive the SAME digest
+# ---------------------------------------------------------------------------
+class TestChainHashes:
+    def test_pool_digest_matches_router_hashes(self):
+        pool = KVPagePool(8, 4, prefix_cache=True)
+        toks = list(range(100, 114))            # 3 full pages + tail
+        pool.ensure_capacity(7, len(toks))
+        pool.register_prefix(7, toks, len(toks))
+        assert set(pool.prefix_chain_hashes()) == \
+            set(chain_hashes(toks, 4))
+        # the chain identifies the WHOLE prefix: same block behind a
+        # different parent hashes differently
+        other = [1, 2, 3, 4] + toks[4:8]
+        assert chain_hashes(other, 4)[1] != chain_hashes(toks, 4)[1]
+
+    def test_chain_hash_is_stable(self):
+        # cross-process stability: fixed bytes, not Python hash()
+        assert chain_hash(-1, (1, 2, 3, 4)) == \
+            chain_hash(-1, (1, 2, 3, 4))
+        assert chain_hashes([5, 6, 7, 8, 9], 4, limit=4) == \
+            chain_hashes([5, 6, 7, 8, 1000], 4, limit=4)
+
+    def test_limit_caps_full_blocks(self):
+        toks = list(range(16))
+        assert len(chain_hashes(toks, 4)) == 4
+        assert len(chain_hashes(toks, 4, limit=15)) == 3
+        assert chain_hashes(toks, 4, limit=3) == []
+
+
+# ---------------------------------------------------------------------------
+# router placement units over a fake status feed (no engines)
+# ---------------------------------------------------------------------------
+class FakeReplica:
+    def __init__(self, rid, digest=(), waiting=0, in_flight=0,
+                 occupancy=0.0, hung=False, beat_age=0.0):
+        self.replica_id = rid
+        self.feed = {'replica_id': rid, 'beat_age_s': beat_age,
+                     'hung': hung, 'hang_reason': None,
+                     'draining': False, 'waiting': waiting,
+                     'in_flight': in_flight, 'pending_tokens': 0,
+                     'decode_tokens_per_sec': 0.0,
+                     'timeline': {'mean_occupancy': occupancy},
+                     'pool': {}, 'prefix_digest': list(digest)}
+        self.submitted = []
+        self._next = 0
+
+    def submit(self, prompt, opts, route_meta=None):
+        self.submitted.append((list(prompt), dict(opts),
+                               dict(route_meta or {})))
+        self._next += 1
+        return f'{self.replica_id}-{self._next}'
+
+    def status(self):
+        return dict(self.feed)
+
+    def poll(self):
+        return {}
+
+    def pump(self):
+        return False
+
+    def drain(self):
+        return []
+
+    def shutdown(self):
+        pass
+
+
+class TestRouterPlacement:
+    def _router(self, replicas, **kw):
+        kw.setdefault('page_size', 4)
+        kw.setdefault('max_queue', 4)
+        return ClusterRouter(replicas, **kw)
+
+    def test_affinity_beats_least_loaded(self):
+        prompt = list(range(1, 13))
+        hot = FakeReplica('hot', digest=chain_hashes(prompt, 4),
+                          waiting=3)          # busier, but has pages
+        cold = FakeReplica('cold', waiting=0)
+        router = self._router([hot, cold])
+        req = router.submit(prompt, max_new_tokens=4)
+        assert req.replica_id == 'hot' and req.decision == 'affinity'
+        assert hot.submitted[0][2]['router_decision'] == 'affinity'
+
+    def test_deepest_prefix_wins(self):
+        prompt = list(range(1, 17))
+        h = chain_hashes(prompt, 4)
+        shallow = FakeReplica('shallow', digest=h[:1])
+        deep = FakeReplica('deep', digest=h[:3])
+        router = self._router([shallow, deep])
+        assert router.submit(prompt).replica_id == 'deep'
+
+    def test_least_loaded_fallback_uses_timeline(self):
+        # equal queue depth: the fake timeline feed breaks the tie
+        busy = FakeReplica('busy', waiting=1, occupancy=0.9)
+        idle = FakeReplica('idle', waiting=1, occupancy=0.1)
+        router = self._router([busy, idle])
+        req = router.submit(list(range(1, 9)))
+        assert req.replica_id == 'idle'
+        assert req.decision == 'least_loaded'
+
+    def test_optimistic_digest_routes_burst_together(self):
+        a = FakeReplica('a')
+        b = FakeReplica('b')
+        router = self._router([a, b], refresh_interval_s=3600.0)
+        prompt = list(range(1, 13))
+        first = router.submit(prompt + [77])
+        second = router.submit(prompt + [88])     # before any refresh
+        assert second.replica_id == first.replica_id
+        assert second.decision == 'affinity'
+
+    def test_published_digest_replaces_stale_entries(self):
+        # a replica that LRU-evicted its cached chains publishes a
+        # smaller digest — the router must stop routing 'affinity'
+        # there once the optimistic overlay ages out, not keep a
+        # forever-union of everything it ever saw
+        prompt = list(range(1, 13))
+        a = FakeReplica('a', digest=chain_hashes(prompt, 4))
+        b = FakeReplica('b')
+        router = self._router([a, b], refresh_interval_s=0.0)
+        assert router.submit(prompt + [50]).replica_id == 'a'
+        a.feed['prefix_digest'] = []        # pool evicted everything
+        for _ in range(router.OPTIMISTIC_GENERATIONS + 2):
+            router.refresh()
+        req = router.submit(prompt + [60])
+        assert req.decision == 'least_loaded', (req.decision,
+                                                req.replica_id)
+
+    def test_backpressure_spills_affinity(self):
+        prompt = list(range(1, 13))
+        hot = FakeReplica('hot', digest=chain_hashes(prompt, 4),
+                          waiting=9)
+        cold = FakeReplica('cold')
+        router = self._router([hot, cold], max_queue=4)
+        req = router.submit(prompt)
+        assert req.replica_id == 'cold' and req.decision == 'spill'
+
+    def test_spill_prefers_partial_affinity_among_open(self):
+        # saturated full-prefix target: the spill should land on the
+        # open replica holding PART of the prefix, not the marginally
+        # less-loaded one with none of it
+        prompt = list(range(1, 17))
+        h = chain_hashes(prompt, 4)
+        hot = FakeReplica('hot', digest=h, waiting=9)
+        warm = FakeReplica('warm', digest=h[:2], waiting=2)
+        cold = FakeReplica('cold', waiting=1)
+        router = self._router([hot, warm, cold], max_queue=4)
+        req = router.submit(prompt)
+        assert req.replica_id == 'warm' and req.decision == 'spill'
+
+    def test_reject_early_when_all_saturated(self):
+        reps = [FakeReplica(r, waiting=9) for r in ('a', 'b')]
+        router = self._router(reps, max_queue=4)
+        with pytest.raises(RouterRejected, match='backpressure'):
+            router.submit(list(range(1, 9)))
+        assert router.snapshot()['rejects'] == 1
+
+    def test_deadline_bound_rejects_slow_queue(self):
+        slow = FakeReplica('slow')
+        slow.feed['decode_tokens_per_sec'] = 10.0
+        slow.feed['pending_tokens'] = 1000     # 100s of queue
+        router = self._router([slow], deadline_bound_s=5.0)
+        router.refresh()
+        with pytest.raises(RouterRejected):
+            router.submit(list(range(1, 9)))
+
+    def test_hung_flag_set_on_stale_heartbeat(self):
+        a = FakeReplica('a', beat_age=99.0)
+        b = FakeReplica('b')
+        router = self._router([a, b], hang_timeout_s=2.0)
+        router.refresh()
+        snap = router.snapshot()
+        assert snap['replicas']['a']['hung'], snap
+        assert snap['replicas']['a']['drained'], snap
+        assert not snap['replicas']['b']['hung'], snap
+
+    def test_stale_heartbeat_drains_and_resubmits(self):
+        prompt = list(range(1, 13))
+        a = FakeReplica('a', digest=chain_hashes(prompt, 4))
+        b = FakeReplica('b')
+        router = self._router([a, b], hang_timeout_s=2.0,
+                              refresh_interval_s=0.0)
+        req = router.submit(prompt, max_new_tokens=8)
+        assert req.replica_id == 'a'
+        a.feed['beat_age_s'] = 9.9              # wedged step loop
+        router.refresh()
+        snap = router.snapshot()
+        assert snap['replicas']['a']['drained'], snap
+        assert snap['placements']['drain'] == 1
+        assert snap['placements']['resubmit'] == 1
+        # resubmitted to the healthy peer, budget preserved
+        assert req.replica_id == 'b' and req.resubmits == 1
+        assert b.submitted[-1][1]['max_new_tokens'] == 8
+        assert len(snap['drain_events']) == 1
+
+    def test_drain_resubmit_bypasses_backpressure(self):
+        # drained work is not new admission: even with the only peer
+        # over max_queue, in-flight requests must land there rather
+        # than strand (and the reject counter must not count it)
+        a = FakeReplica('a')
+        b = FakeReplica('b', waiting=9)
+        router = self._router([a, b], max_queue=4)
+        req = router.submit(list(range(1, 9)), max_new_tokens=8)
+        assert req.replica_id == 'a'
+        router.drain('a', reason='test')
+        assert req.replica_id == 'b' and req.resubmits == 1
+        snap = router.snapshot()
+        assert snap['rejects'] == 0, snap
+        assert snap['placements']['resubmit'] == 1
+
+    def test_drain_survives_peer_dispatch_failure(self):
+        # a transient channel error on the resubmission target must
+        # not strand the request or escape the drain — pump() retries
+        prompt = list(range(1, 13))
+        a = FakeReplica('a', digest=chain_hashes(prompt, 4))
+        flaky = FakeReplica('b')
+        orig = flaky.submit
+        calls = {'n': 0}
+
+        def flaky_submit(p, opts, route_meta=None):
+            calls['n'] += 1
+            if calls['n'] == 1:
+                raise OSError('channel hiccup')
+            return orig(p, opts, route_meta)
+
+        flaky.submit = flaky_submit
+        router = self._router([a, flaky])
+        req = router.submit(prompt, max_new_tokens=8)
+        assert req.replica_id == 'a'
+        router.drain('a', reason='test')        # dispatch fails once
+        assert req.replica_id == 'a'            # parked, not crashed
+        router.pump()                           # retry succeeds
+        assert req.replica_id == 'b'
+        assert not router._unplaced
+
+    def test_drained_replica_not_placed(self):
+        a, b = FakeReplica('a'), FakeReplica('b')
+        router = self._router([a, b])
+        router.drain('a', reason='operator')
+        for _ in range(3):
+            assert router.submit(list(range(1, 9))).replica_id == 'b'
+
+
+# ---------------------------------------------------------------------------
+# control channel: timeout desync protection
+# ---------------------------------------------------------------------------
+class TestControlChannel:
+    def test_timeout_drops_connection_no_stale_replies(self):
+        from paddle_tpu.serving.cluster.channel import (ControlClient,
+                                                        ControlServer)
+
+        def handler(msg):
+            if msg.get('op') == 'slow':
+                time.sleep(1.0)
+                return {'which': 'slow'}
+            return {'which': 'fast'}
+
+        server = ControlServer(handler).start()
+        try:
+            client = ControlClient('127.0.0.1', server.port,
+                                   timeout=5.0)
+            import socket as _socket
+            with pytest.raises((_socket.timeout, OSError)):
+                client.call({'op': 'slow'}, timeout=0.2)
+            # the late 'slow' reply must NOT surface as this reply —
+            # the client reconnected after the timeout
+            for _ in range(3):
+                assert client.call({'op': 'fast'},
+                                   timeout=5.0) == {'which': 'fast'}
+            client.close()
+        finally:
+            server.close()
+
+
+# ---------------------------------------------------------------------------
+# schema v2: route events
+# ---------------------------------------------------------------------------
+class TestTraceSchemaV2:
+    def test_route_event_reconstructs(self, tmp_path):
+        from paddle_tpu.serving.request_trace import (RequestTracer,
+                                                      SCHEMA,
+                                                      load_trace,
+                                                      reconstruct)
+        tr = RequestTracer()
+        tr.record(3, 'submit', t=1.0, prompt_tokens=4)
+        tr.record(3, 'route', t=1.1, replica_id='r1',
+                  router_decision='affinity')
+        tr.record(3, 'retire', t=2.0, tokens_generated=2)
+        p = str(tmp_path / 't.jsonl')
+        tr.export_jsonl(p)
+        header, events = load_trace(p)
+        assert header['schema'] == SCHEMA == 'paddle_tpu.serve_trace/2'
+        r = reconstruct(events)[3]
+        assert r['replica_id'] == 'r1'
+        assert r['router_decision'] == 'affinity'
+
+    def test_load_trace_accepts_v1_rejects_unknown(self, tmp_path):
+        from paddle_tpu.serving.request_trace import load_trace
+        v1 = tmp_path / 'v1.jsonl'
+        v1.write_text(
+            json.dumps({'schema': 'paddle_tpu.serve_trace/1'}) + '\n'
+            + json.dumps({'req': 0, 'event': 'submit', 't': 1.0})
+            + '\n')
+        header, events = load_trace(str(v1))
+        assert header['schema'].endswith('/1') and len(events) == 1
+        v9 = tmp_path / 'v9.jsonl'
+        v9.write_text(
+            json.dumps({'schema': 'paddle_tpu.serve_trace/9'}) + '\n')
+        with pytest.raises(ValueError, match='unsupported serve'):
+            load_trace(str(v9))
+
+
+# ---------------------------------------------------------------------------
+# in-process 2-replica cluster over real engines
+# ---------------------------------------------------------------------------
+def _round_robin_affinity_hits(prompts, n_replicas, page_size):
+    """How many requests pure round-robin placement would land on a
+    replica already holding their prefix chain — the baseline the
+    router must beat."""
+    digests = [set() for _ in range(n_replicas)]
+    hits = 0
+    for i, p in enumerate(prompts):
+        h = chain_hashes(p, page_size, limit=len(p) - 1)
+        r = i % n_replicas
+        if h and h[0] in digests[r]:
+            hits += 1
+        digests[r].update(h)
+    return hits
+
+
+class TestLocalCluster:
+    def test_shared_prefix_identical_outputs_and_affinity(
+            self, tiny_lm, mixed_prompts):
+        ref = _single_reference(tiny_lm, mixed_prompts)
+        reps = [LocalReplica(
+            ServingEngine(tiny_lm, ServingConfig(**ENGINE_KW)), rid)
+            for rid in ('r0', 'r1')]
+        router = ClusterRouter(reps, page_size=ENGINE_KW['page_size'],
+                               max_queue=32)
+        outs = router.serve(mixed_prompts, max_new_tokens=8, top_k=0)
+        assert outs == ref
+        snap = router.snapshot()
+        hits = snap['placements']['affinity']
+        rr = _round_robin_affinity_hits(mixed_prompts, 2,
+                                        ENGINE_KW['page_size'])
+        assert hits > rr, (hits, rr, snap['placements'])
+        # both prefix families actually split across the replicas
+        routed = [v['requests_routed']
+                  for v in snap['replicas'].values()]
+        assert all(n > 0 for n in routed), snap
+        # route events landed in the per-replica journals (schema v2)
+        table = reps[0].engine.request_table()
+        assert any(r.get('router_decision') for r in table.values())
+        router.shutdown()
+
+    def test_serve_throttles_instead_of_stranding(self, tiny_lm,
+                                                  mixed_prompts):
+        # tight backpressure bound: serve() must pump-and-retry on
+        # RouterRejected rather than raise mid-batch and orphan the
+        # already-placed requests
+        ref = _single_reference(tiny_lm, mixed_prompts)
+        reps = [LocalReplica(
+            ServingEngine(tiny_lm, ServingConfig(**ENGINE_KW)), rid)
+            for rid in ('r0', 'r1')]
+        router = ClusterRouter(reps, page_size=ENGINE_KW['page_size'],
+                               max_queue=2)
+        outs = router.serve(mixed_prompts, max_new_tokens=8, top_k=0,
+                            timeout_s=120)
+        assert outs == ref
+        router.shutdown()
+
+    def test_drain_midstream_completes_on_peer(self, tiny_lm,
+                                               mixed_prompts):
+        ref = _single_reference(tiny_lm, mixed_prompts, max_new=12)
+        reps = [LocalReplica(
+            ServingEngine(tiny_lm, ServingConfig(**ENGINE_KW)), rid)
+            for rid in ('r0', 'r1')]
+        router = ClusterRouter(reps, page_size=ENGINE_KW['page_size'],
+                               max_queue=32)
+        reqs = [router.submit(p, max_new_tokens=12, top_k=0)
+                for p in mixed_prompts]
+        for _ in range(6):              # partial progress
+            router.pump()
+        drained = reqs[0].replica_id
+        router.drain(drained, reason='test drain')
+        router.run(timeout_s=120)
+        assert [r.output_ids() for r in reqs] == ref
+        snap = router.snapshot()
+        assert snap['placements']['drain'] == 1
+        assert snap['replicas'][str(drained)]['drained']
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# prefill→decode disaggregation
+# ---------------------------------------------------------------------------
+class TestDisaggregation:
+    @pytest.mark.parametrize('kv_dtype', [None, 'int8'])
+    def test_streamed_pages_bit_identical(self, tiny_lm, kv_dtype):
+        rng = np.random.RandomState(3)
+        prompt = list(rng.randint(1, 128, 29))
+        ref = ServingEngine(tiny_lm, ServingConfig(
+            **{**ENGINE_KW, 'kv_dtype': kv_dtype}))
+        req_r = ref.submit(prompt, max_new_tokens=4)
+        from paddle_tpu.serving.scheduler import RequestState
+        while req_r.state != RequestState.RUNNING:
+            ref.step()
+        ref_pages = ref.pool.page_table(req_r.id)
+
+        d = DisaggregatedEngine(tiny_lm, ServingConfig(
+            **{**ENGINE_KW, 'kv_dtype': kv_dtype,
+               'disaggregate': True, 'stream_chunk_pages': 2}))
+        req_d = d.submit(prompt, max_new_tokens=4)
+        while req_d.state != RequestState.RUNNING:
+            d.step()
+        dst_pages = d.decode.pool.page_table(req_d.id)
+        assert len(dst_pages) == len(ref_pages)
+        # full prompt pages must be byte-equal after the stream —
+        # int8 pools compare quantized payload AND scale siblings
+        n_full = len(prompt) // ENGINE_KW['page_size']
+        for lr, ld in zip(ref.pool.kv, d.decode.pool.kv):
+            for br, bd in zip(lr, ld):
+                for pr, pd_ in zip(ref_pages[:n_full],
+                                   dst_pages[:n_full]):
+                    np.testing.assert_array_equal(
+                        np.asarray(br[pr]), np.asarray(bd[pd_]))
+        st = d.stats()
+        assert st['pd_handoffs_total'] == 1
+        assert st['pd_streamed_pages_total'] >= n_full
+        ref.shutdown()
+        d.shutdown()
+
+    def test_serving_engine_refuses_disaggregate_config(self,
+                                                        tiny_lm):
+        with pytest.raises(ValueError, match='disaggregate'):
+            ServingEngine(tiny_lm, ServingConfig(
+                **{**ENGINE_KW, 'disaggregate': True}))
+
+    def test_disagg_outputs_identical(self, tiny_lm, mixed_prompts):
+        ref = _single_reference(tiny_lm, mixed_prompts)
+        d = DisaggregatedEngine(tiny_lm, ServingConfig(
+            **{**ENGINE_KW, 'disaggregate': True}))
+        outs = d.generate(mixed_prompts, max_new_tokens=8, top_k=0)
+        assert outs == ref
+        st = d.stats()
+        assert st['pd_handoffs_total'] == len(mixed_prompts)
+        d.shutdown()
+
+    def test_decode_side_prefix_sharing_skips_streaming(
+            self, tiny_lm, mixed_prompts):
+        d = DisaggregatedEngine(tiny_lm, ServingConfig(
+            **{**ENGINE_KW, 'disaggregate': True}))
+        d.generate(mixed_prompts, max_new_tokens=4, top_k=0)
+        st = d.stats()
+        ps = ENGINE_KW['page_size']
+        full_pages = sum(len(p) // ps for p in mixed_prompts)
+        # shared system-prompt pages resurrect decode-side instead of
+        # re-streaming — strictly fewer pages moved than exist
+        assert st['pd_streamed_pages_total'] < full_pages, st
+        d.shutdown()
+
+    def test_cluster_of_disaggregated_replicas(self, tiny_lm,
+                                               mixed_prompts):
+        from paddle_tpu.serving.cluster.disagg import build_engine
+        ref = _single_reference(tiny_lm, mixed_prompts)
+        reps = [LocalReplica(build_engine(tiny_lm, ServingConfig(
+            **{**ENGINE_KW, 'disaggregate': True})), rid)
+            for rid in ('d0', 'd1')]
+        router = ClusterRouter(reps, page_size=ENGINE_KW['page_size'],
+                               max_queue=32)
+        outs = router.serve(mixed_prompts, max_new_tokens=8, top_k=0)
+        assert outs == ref
+        assert router.snapshot()['placements']['affinity'] > 0
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# mp-sharded engine: heads + KV pages split over an 'mp' mesh axis
+# ---------------------------------------------------------------------------
+class TestMpSharding:
+    def test_mp2_token_identical(self, tiny_lm, mixed_prompts):
+        import paddle_tpu.distributed.fleet as fleet_mod
+        from paddle_tpu.distributed import topology_runtime
+        from paddle_tpu.distributed.fleet.base.topology import (
+            CommunicateTopology, HybridCommunicateGroup)
+        from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+        os.environ.setdefault('PADDLE_TRAINER_ID', '0')
+        ref = _single_reference(tiny_lm, mixed_prompts[:4])
+        topo = CommunicateTopology(
+            ["data", "pipe", "sharding", "model"], [1, 1, 1, 2])
+        fleet_mod.fleet._topology = topo
+        fleet_mod.fleet._hcg = HybridCommunicateGroup(topo)
+        try:
+            mesh = topology_runtime.build_mesh(['mp'], [2])
+            paddle.seed(0)          # same init stream as tiny_lm
+            mp_model = GPTForCausalLM(GPTConfig(**MODEL_KW))
+            mp_model.eval()
+            eng = ServingEngine(mp_model,
+                                ServingConfig(**ENGINE_KW), mesh=mesh)
+            # the pool spans GLOBAL heads, sharded over the mesh
+            assert eng.pool.num_heads == MODEL_KW['num_heads']
+            outs = eng.generate(mixed_prompts[:4], max_new_tokens=8,
+                                top_k=0)
+            assert outs == ref
+            eng.shutdown()
+        finally:
+            fleet_mod.fleet._hcg = None
+            fleet_mod.fleet._topology = None
+
+    def test_mesh_degree_mismatch_raises(self, tiny_lm):
+        from paddle_tpu.distributed import topology_runtime
+        mesh = topology_runtime.build_mesh(['mp'], [2])
+        with pytest.raises(ValueError, match='mp degree'):
+            ServingEngine(tiny_lm, ServingConfig(**ENGINE_KW),
+                          mesh=mesh)
+
+
+# ---------------------------------------------------------------------------
+# true 2-replica subprocess cluster: identity, affinity, forced-hang
+# drain (watchdog fires -> router drains -> requests finish on peer)
+# ---------------------------------------------------------------------------
+class TestSubprocessCluster:
+    def test_subprocess_cluster_end_to_end(self, tiny_lm,
+                                           mixed_prompts, tmp_path):
+        ref = _single_reference(tiny_lm, mixed_prompts)
+        reps = []
+        try:
+            reps = [RemoteReplica.spawn(
+                rid, MODEL_KW, ENGINE_KW, seed=0, hang_timeout_s=2.0,
+                env={'PTPU_SERVE_REPORT_DIR': str(tmp_path)})
+                for rid in ('w0', 'w1')]
+            router = ClusterRouter(reps,
+                                   page_size=ENGINE_KW['page_size'],
+                                   max_queue=32, hang_timeout_s=5.0)
+            outs = router.serve(mixed_prompts, max_new_tokens=8,
+                                top_k=0, timeout_s=180)
+            assert outs == ref
+            snap = router.snapshot()
+            rr = _round_robin_affinity_hits(
+                mixed_prompts, 2, ENGINE_KW['page_size'])
+            assert snap['placements']['affinity'] > rr, snap
+
+            # forced hang: wedge one worker's step loop mid-stream;
+            # its watchdog dumps, the router drains, every in-flight
+            # request completes on the peer — token-identically
+            rng = np.random.RandomState(9)
+            fam = mixed_prompts[0][:24]
+            long_prompts = [fam + list(rng.randint(1, 128, 4))
+                            for _ in range(4)]
+            ref2 = _single_reference(tiny_lm, long_prompts,
+                                     max_new=16)
+            reqs = [router.submit(p, max_new_tokens=16, top_k=0)
+                    for p in long_prompts]
+            hung = router._replicas[reqs[0].replica_id]
+            hung.inject_hang()
+            router.run(timeout_s=180)
+            assert [r.output_ids() for r in reqs] == ref2
+            snap = router.snapshot()
+            assert snap['placements']['drain'] >= 1, snap
+            assert any(e['resubmitted'] > 0
+                       for e in snap['drain_events']), snap
+            # the worker-side watchdog wrote its diagnosis artifact
+            deadline = time.time() + 10
+            report = None
+            while time.time() < deadline and report is None:
+                cands = list(tmp_path.glob('replica_hang.*.json'))
+                report = cands[0] if cands else None
+                time.sleep(0.2)
+            assert report is not None, list(tmp_path.iterdir())
+            doc = json.loads(report.read_text())
+            assert doc['kind'] == 'replica_hang_report'
+            assert 'stacks' in doc and 'flight_recorder' in doc, \
+                sorted(doc)
+            router.shutdown()
+        finally:
+            for r in reps:
+                try:
+                    r.shutdown()
+                except Exception:           # noqa: BLE001
+                    pass

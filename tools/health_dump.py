@@ -46,6 +46,7 @@ Usage:
     python tools/health_dump.py numerics --selftest  # numerics CI smoke
     python tools/health_dump.py comm --selftest      # comm CI smoke
     python tools/health_dump.py serve --selftest     # serving CI smoke
+    python tools/health_dump.py cluster --selftest   # cluster CI smoke
     python tools/health_dump.py pallas --selftest    # pallas CI smoke
 """
 import argparse
@@ -846,6 +847,153 @@ def pallas_main(argv):
     return 0
 
 
+def _find_cluster(doc):
+    """Locate a cluster-router snapshot ({'placements': ..,
+    'replicas': ..}) in a bench record / telemetry artifact."""
+    if not isinstance(doc, dict):
+        return None
+    if 'placements' in doc and 'replicas' in doc:
+        return doc
+    for key in ('router', 'cluster', 'telemetry', 'detail'):
+        found = _find_cluster(doc.get(key))
+        if found is not None:
+            return found
+    if 'legs' in doc:
+        for leg in (doc['legs'] or {}).values():
+            found = _find_cluster(leg)
+            if found is not None:
+                return found
+    return None
+
+
+def render_cluster(c):
+    """Human view of a router snapshot: placement counters (the
+    ptpu_route_* family), per-replica occupancy, drain events —
+    docs/serving.md#disaggregated-serving."""
+    out = ['CLUSTER ROUTER — placement decisions']
+    pl = c.get('placements') or {}
+    hr = c.get('affinity_hit_rate')
+    out.append(f"  affinity      {pl.get('affinity', 0):<6}"
+               + (f" (hit-rate {100.0 * hr:.1f}%)"
+                  if hr is not None else ''))
+    out.append(f"  least_loaded  {pl.get('least_loaded', 0)}")
+    out.append(f"  spills        {pl.get('spill', 0)}")
+    out.append(f"  rejects       {c.get('rejects', 0)}")
+    out.append(f"  drains        {pl.get('drain', 0)}  "
+               f"(resubmitted {pl.get('resubmit', 0)} requests)")
+    reqs = c.get('requests')
+    if reqs is not None:
+        out.append(f"  requests      {c.get('requests_done', 0)}"
+                   f"/{reqs} done")
+    out.append('replicas:')
+    for rid, r in sorted((c.get('replicas') or {}).items()):
+        occ = r.get('mean_occupancy')
+        flags = []
+        if r.get('hung'):
+            flags.append('HUNG')
+        if r.get('drained'):
+            flags.append('DRAINED')
+        line = (f"  {rid}: queue {r.get('queue_depth', 0)} "
+                f"(waiting {r.get('waiting', 0)}, in-flight "
+                f"{r.get('in_flight', 0)})  ")
+        if occ is not None:
+            line += f"occupancy {occ:.2f}  "
+        line += (f"decode {r.get('decode_tokens') or 0}t "
+                 f"prefill {r.get('prefill_tokens') or 0}t  "
+                 f"digest {r.get('digest_size', 0)} chains  "
+                 f"routed {r.get('requests_routed', 0)}"
+                 + (('  [' + ' '.join(flags) + ']') if flags else ''))
+        out.append(line)
+    evs = c.get('drain_events') or []
+    if evs:
+        out.append('drain events:')
+        for e in evs:
+            out.append(f"  replica {e.get('replica_id')}: "
+                       f"{e.get('reason')} — resubmitted "
+                       f"{e.get('resubmitted', 0)} in-flight")
+    return '\n'.join(out)
+
+
+def _cluster_selftest():
+    """CI smoke: a 2-replica in-process cluster on the tiny GPT, a
+    shared-prefix stream through the prefix-affinity router, then the
+    renderer — asserts the affinity hit-rate is real (> 0) and the
+    ptpu_route_* counters landed in the registry."""
+    _repo_root_on_path()
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving import ServingConfig, ServingEngine
+    from paddle_tpu.serving.cluster import (ClusterRouter,
+                                            LocalReplica,
+                                            cluster_snapshot)
+
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                    num_heads=4, max_seq_len=128, hidden_dropout=0.0,
+                    attn_dropout=0.0, use_flash_attention=False)
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(0)
+    sys_a = list(rng.randint(1, 128, 16))
+    sys_b = list(rng.randint(1, 128, 16))
+    prompts = [(sys_a if i % 2 == 0 else sys_b)
+               + list(rng.randint(1, 128, 4)) for i in range(8)]
+    replicas = [
+        LocalReplica(ServingEngine(model, ServingConfig(
+            page_size=8, max_batch_size=2, prefill_chunk=16)), rid)
+        for rid in ('r0', 'r1')]
+    router = ClusterRouter(replicas, page_size=8, max_queue=16)
+    outs = router.serve(prompts, max_new_tokens=4, top_k=0)
+    assert len(outs) == len(prompts)
+    snap = router.snapshot()
+    assert snap['affinity_hit_rate'] and snap['affinity_hit_rate'] > 0, \
+        snap
+    text = render_cluster(_find_cluster({'legs': {
+        'gpt_serve_cluster': {'router': snap}}}))
+    assert 'affinity' in text and 'hit-rate' in text, text
+    assert 'r0' in text and 'r1' in text, text
+    reg = cluster_snapshot()
+    assert reg and reg.get('ptpu_route_affinity_hits_total', 0) > 0, reg
+    router.shutdown()
+    print(text)
+    print('health_dump cluster selftest: OK')
+    return 0
+
+
+def cluster_main(argv):
+    ap = argparse.ArgumentParser(
+        prog='health_dump.py cluster',
+        description='render cluster-router placement counters, '
+                    'per-replica occupancy and drain events from a '
+                    'router snapshot or bench record '
+                    '(docs/serving.md#disaggregated-serving)')
+    ap.add_argument('artifact', nargs='?',
+                    help='router snapshot / bench record JSON')
+    ap.add_argument('--json', action='store_true')
+    ap.add_argument('--selftest', action='store_true')
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return _cluster_selftest()
+    if not args.artifact:
+        ap.error('artifact path required (or --selftest)')
+    with open(args.artifact) as f:
+        doc = json.load(f)
+    cluster = _find_cluster(doc)
+    if cluster is None:
+        raise ValueError(
+            'no cluster-router snapshot in this artifact (expected '
+            'a ClusterRouter.snapshot() dict or a bench record with '
+            'legs.gpt_serve_cluster.router — '
+            'docs/serving.md#disaggregated-serving)')
+    if args.json:
+        print(json.dumps(cluster, indent=2))
+    else:
+        print(render_cluster(cluster))
+    return 0
+
+
 def numerics_main(argv):
     ap = argparse.ArgumentParser(
         prog='health_dump.py numerics',
@@ -873,6 +1021,8 @@ def main(argv=None):
         return comm_main(argv[1:])
     if argv and argv[0] == 'serve':
         return serve_main(argv[1:])
+    if argv and argv[0] == 'cluster':
+        return cluster_main(argv[1:])
     if argv and argv[0] == 'pallas':
         return pallas_main(argv[1:])
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])

@@ -25,6 +25,13 @@
 #                      tolerance + deferred-gather memory win, chunked
 #                      collectives, layer grouping, dp=1 no-op
 #                      invariant, exposed/hidden comm gauge rendering
+#   --cluster-selftest - disaggregated serving cluster (ISSUE 11):
+#                      prefix-affinity router placement units, true
+#                      2-replica subprocess cluster (token-identity +
+#                      affinity > round-robin + forced-hang drain),
+#                      prefill->decode page-stream bit-equivalence,
+#                      mp-sharded engine equivalence, router counter
+#                      rendering + cross-replica trace merge
 set -e
 cd "$(dirname "$0")/.."
 TIER="${1:-all}"
@@ -34,7 +41,8 @@ case "$TIER" in
             tests/test_profiler_trace.py tests/test_diagnostics.py \
             tests/test_numerics.py tests/test_bucketing.py \
             tests/test_fused_primitives.py tests/test_overlap.py \
-            tests/test_serving.py tests/test_serving_trace.py -q
+            tests/test_serving.py tests/test_serving_trace.py \
+            tests/test_serving_cluster.py -q
           # observability tooling smoke: tracer -> export -> summary CLI
           python tools/trace_summary.py --selftest
           # diagnostics smoke: flight recorder -> hang/OOM reports -> CLI
@@ -45,6 +53,8 @@ case "$TIER" in
           python tools/health_dump.py comm --selftest
           # serving smoke: engine -> serve gauges -> render
           python tools/health_dump.py serve --selftest
+          # cluster smoke: 2-replica router -> placement counters
+          python tools/health_dump.py cluster --selftest
           # pallas smoke: fused primitives -> route counters -> render
           python tools/health_dump.py pallas --selftest ;;
   dist)   python -m pytest tests/test_distributed.py \
@@ -94,12 +104,22 @@ case "$TIER" in
             tests/test_serving_trace.py -q
           python tools/health_dump.py serve --selftest
           python tools/trace_summary.py --selftest ;;
+  --cluster-selftest)
+          # the disaggregated cluster end to end: router placement
+          # units, 2-replica subprocess cluster with forced-hang
+          # drain, page-stream equivalence, mp-sharded engine, then
+          # the CLI smokes (placement-counter rendering + the
+          # cross-replica serve-trace merge)
+          python -m pytest tests/test_serving_cluster.py -q
+          python tools/health_dump.py cluster --selftest
+          python tools/trace_summary.py --selftest ;;
   all)    python -m pytest tests/ -q
           python tools/trace_summary.py --selftest
           python tools/health_dump.py --selftest
           python tools/health_dump.py numerics --selftest
           python tools/health_dump.py comm --selftest
           python tools/health_dump.py serve --selftest
+          python tools/health_dump.py cluster --selftest
           python tools/health_dump.py pallas --selftest ;;
-  *) echo "usage: $0 [fast|dist|native|e2e|all|--comm-selftest|--serve-selftest|--quant-selftest|--pallas-selftest|--overlap-selftest]"; exit 1 ;;
+  *) echo "usage: $0 [fast|dist|native|e2e|all|--comm-selftest|--serve-selftest|--quant-selftest|--pallas-selftest|--overlap-selftest|--cluster-selftest]"; exit 1 ;;
 esac

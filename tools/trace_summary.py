@@ -10,15 +10,22 @@ Reads either exporter format (chrome-trace `traceEvents` or the raw
     plus dataloader / collective / serve / other buckets).
 
 It also reads SERVING request traces (the JSON-lines files
-`ServingEngine.export_trace` writes, schema paddle_tpu.serve_trace/1)
-and prints the per-request SLO table: queue-wait, TTFT, TPOT, e2e,
-preemptions, pages high-water — plus cross-request percentiles.
+`ServingEngine.export_trace` writes, schema paddle_tpu.serve_trace/1
+or /2) and prints the per-request SLO table: queue-wait, TTFT, TPOT,
+e2e, preemptions, pages high-water — plus cross-request percentiles.
 Serve traces are detected by their schema header (content sniff, not
 file extension); `--serve` forces that mode.
 
+Several serve-trace files MERGE into one cross-replica table (ISSUE
+11): pass each replica's export and requests render prefixed with
+their replica id (the v2 `route` events name it; older files fall
+back to the file stem), with SLO percentiles over the whole cluster:
+
+    python tools/trace_summary.py --serve r0.jsonl r1.jsonl
+
 Usage:
     python tools/trace_summary.py TRACE.json [--top 15] [--json]
-    python tools/trace_summary.py SERVE_TRACE.jsonl [--json]
+    python tools/trace_summary.py SERVE_TRACE.jsonl [...] [--json]
     python tools/trace_summary.py --selftest    # CI smoke: generate a
                                                 # tiny trace, summarize it
 """
@@ -100,25 +107,42 @@ def render(summary):
 
 
 # ---------------------------------------------------------------------------
-# serving request traces (JSON-lines, paddle_tpu.serve_trace/1)
+# serving request traces (JSON-lines, paddle_tpu.serve_trace/1 or /2)
 # ---------------------------------------------------------------------------
-def summarize_serve(path):
-    """Per-request table + cross-request SLO percentiles from a
-    serve-trace JSON-lines file."""
+def summarize_serve(paths):
+    """Per-request table + cross-request SLO percentiles from one or
+    several serve-trace JSON-lines files. Multiple files are merged
+    into one cross-replica table: request ids prefix with the replica
+    (route-event replica_id, else the file stem — per-replica files
+    restart ids at 0, so the prefix IS the disambiguator), and the
+    percentiles aggregate the whole cluster's requests."""
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     from paddle_tpu.serving.request_trace import (load_trace,
                                                   percentile_of,
                                                   reconstruct)
-    header, events = load_trace(path)
-    table = reconstruct(events)
-    rows = sorted(table.values(), key=lambda r: r['req'])
+    if isinstance(paths, str):
+        paths = [paths]
+    multi = len(paths) > 1
+    rows, dropped, schema = [], 0, None
+    for i, path in enumerate(paths):
+        header, events = load_trace(path)
+        schema = schema or header.get('schema')
+        dropped += header.get('dropped_events', 0)
+        fallback = os.path.splitext(os.path.basename(path))[0]
+        for r in sorted(reconstruct(events).values(),
+                        key=lambda r: r['req']):
+            if multi and r.get('replica_id') is None:
+                r['replica_id'] = fallback
+            if multi:
+                r['req'] = f"{r['replica_id']}:{r['req']}"
+            rows.append(r)
     pct = {}
     for key in ('queue_wait_s', 'ttft_s', 'tpot_s', 'e2e_s'):
         vals = [r[key] for r in rows]
         pct[key] = {f'p{q}': percentile_of(vals, q) for q in (50, 90, 99)}
-    return {'schema': header.get('schema'),
-            'dropped_events': header.get('dropped_events', 0),
+    return {'schema': schema, 'files': len(paths),
+            'dropped_events': dropped,
             'requests': rows, 'percentiles': pct}
 
 
@@ -129,24 +153,33 @@ def _fmt_ms(v):
 def render_serve(s):
     rows = s['requests']
     out = [f"serve trace: {len(rows)} requests"
+           + (f" across {s['files']} replica files"
+              if s.get('files', 1) > 1 else '')
            + (f"   ({s['dropped_events']} events dropped at cap)"
               if s.get('dropped_events') else '')]
     out.append('')
-    out.append(f"{'req':>5} {'state':<9} {'prompt':>6} {'gen':>5} "
+    # cluster columns only when any request was router-placed
+    # (schema v2 route events / merged per-replica files)
+    routed = any(r.get('replica_id') is not None for r in rows)
+    extra_hdr = f" {'replica':>8} {'routed':>12}" if routed else ''
+    out.append(f"{'req':>8} {'state':<9} {'prompt':>6} {'gen':>5} "
                f"{'queue_ms':>9} {'ttft_ms':>9} {'tpot_ms':>9} "
                f"{'e2e_ms':>9} {'preempt':>7} {'pages_hw':>8} "
-               f"{'cached':>6} {'spec':>9}")
+               f"{'cached':>6} {'spec':>9}" + extra_hdr)
     for r in rows:
         prop = r.get('spec_proposed', 0)
         spec = (f"{r.get('spec_accepted', 0)}/{prop}" if prop else '-')
+        extra = (f" {str(r.get('replica_id') or '-'):>8} "
+                 f"{str(r.get('router_decision') or '-'):>12}"
+                 if routed else '')
         out.append(
-            f"{r['req']:>5} {r['state'] or '?':<9} "
+            f"{r['req']:>8} {r['state'] or '?':<9} "
             f"{r['prompt_tokens'] if r['prompt_tokens'] is not None else '?':>6} "
             f"{r['tokens_generated']:>5} "
             f"{_fmt_ms(r['queue_wait_s']):>9} {_fmt_ms(r['ttft_s']):>9} "
             f"{_fmt_ms(r['tpot_s']):>9} {_fmt_ms(r['e2e_s']):>9} "
             f"{r['preemptions']:>7} {r['pages_high_water']:>8} "
-            f"{r.get('prefix_cached_tokens', 0):>6} {spec:>9}")
+            f"{r.get('prefix_cached_tokens', 0):>6} {spec:>9}" + extra)
     # cross-request prefix/spec aggregates (ISSUE 9): prompt tokens
     # served from cache, and draft-token acceptance over the stream
     cached = sum(r.get('prefix_cached_tokens', 0) for r in rows)
@@ -235,6 +268,34 @@ def _serve_selftest():
     assert 'prefix cache: 4/5' in text, text
     assert 'speculative decode: 1/3' in text, text
     print(text)
+
+    # cross-replica merge (ISSUE 11): two per-replica exports with v2
+    # route events fold into one table, req ids replica-prefixed
+    tr2 = RequestTracer(clock=clock)
+    for rid, replica, decision in ((0, 'r0', 'affinity'),
+                                   (0, 'r1', 'least_loaded')):
+        t_ = tr2 if replica == 'r1' else RequestTracer(clock=clock)
+        if replica == 'r0':
+            tr0 = t_
+        t_.record(rid, 'submit', t=1.0, prompt_tokens=3)
+        t_.record(rid, 'route', t=1.01, replica_id=replica,
+                  router_decision=decision)
+        t_.record(rid, 'admit', t=1.2)
+        t_.record(rid, 'first_token', t=1.5, tokens_generated=1)
+        t_.record(rid, 'retire', t=1.8, tokens_generated=2)
+    with tempfile.TemporaryDirectory() as d:
+        p0 = os.path.join(d, 'r0.jsonl')
+        p1 = os.path.join(d, 'r1.jsonl')
+        tr0.export_jsonl(p0)
+        tr2.export_jsonl(p1)
+        m = summarize_serve([p0, p1])
+    assert m['files'] == 2 and len(m['requests']) == 2, m
+    assert {r['req'] for r in m['requests']} == {'r0:0', 'r1:0'}, m
+    assert {r['router_decision'] for r in m['requests']} == \
+        {'affinity', 'least_loaded'}, m
+    mtext = render_serve(m)
+    assert 'replica' in mtext and 'r0' in mtext and 'r1' in mtext, mtext
+    print(mtext)
     print('trace_summary serve selftest: OK')
 
 
@@ -285,8 +346,10 @@ def _selftest():
 
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument('trace', nargs='?', help='exported trace JSON '
-                    '(profiler spans/chrome, or a serve-trace .jsonl)')
+    ap.add_argument('trace', nargs='*', help='exported trace JSON '
+                    '(profiler spans/chrome, or serve-trace .jsonl '
+                    'files — several serve traces merge into one '
+                    'cross-replica table)')
     ap.add_argument('--top', type=int, default=15,
                     help='how many spans to list')
     ap.add_argument('--json', action='store_true',
@@ -300,11 +363,14 @@ def main(argv=None):
         return _selftest()
     if not args.trace:
         ap.error('trace path required (or --selftest)')
-    if args.serve or _looks_like_serve_trace(args.trace):
+    if args.serve or all(_looks_like_serve_trace(p)
+                         for p in args.trace):
         s = summarize_serve(args.trace)
         print(json.dumps(s) if args.json else render_serve(s))
         return 0
-    summary = summarize(load_spans(args.trace), top=args.top)
+    if len(args.trace) > 1:
+        ap.error('multiple trace files only merge in --serve mode')
+    summary = summarize(load_spans(args.trace[0]), top=args.top)
     print(json.dumps(summary) if args.json else render(summary))
     return 0
 
