@@ -84,8 +84,11 @@ def bench_gpt_1p3b(optimizer='adamw'):
     ids = rng.randint(0, cfg.vocab_size, (A * mb, L)).astype('int32')
     labels = np.roll(ids, -1, 1).astype('int32')
     data = (Tensor(ids), Tensor(labels))
+    from paddle_tpu.core import memory as _mem
+    census_before = _mem.sample(count_buffers=True)
     loss = eng.train_batch(data)          # compile + warmup
     assert np.isfinite(float(loss))
+    census_after = _mem.sample(count_buffers=True)
     n = 5
     dt = float('inf')                      # best of 3 trials (the tunneled
     for _ in range(3):                     # chip is time-shared; min is the
@@ -110,6 +113,8 @@ def bench_gpt_1p3b(optimizer='adamw'):
     # the routes dict is the honest evidence either way (interpret-mode
     # parity lives in tests/test_fused_primitives.py).
     from paddle_tpu.ops.pallas import scaffold as _scaffold
+    from paddle_tpu.distributed.fleet.utils.recompute import (
+        boundary_counts as _remat_boundaries)
     return {
         'mfu': tflops / V5E_PEAK_TFLOPS,
         'ms_per_step': dt * 1000,
@@ -121,6 +126,23 @@ def bench_gpt_1p3b(optimizer='adamw'):
         'optimizer': optimizer,
         'fused_primitives': {'active': _scaffold.active_primitives(),
                              'routes': _scaffold.routes_snapshot()},
+        # tuned-remat evidence (ISSUE 12): the resolved policy, the
+        # checkpoint_name boundaries the trace carried, and the
+        # activation census around the compile (the compiled-program
+        # temp bytes ride in telemetry.remat.activation_bytes +
+        # memory.sample.activation_bytes)
+        'remat': {
+            'policy': eng._remat_policy or (
+                'full' if eng.use_remat else 'none'),
+            'boundaries': _remat_boundaries(),
+            'census_before': {k: census_before.get(k) for k in
+                              ('bytes_in_use', 'live_bytes',
+                               'live_buffers')},
+            'census_after': {k: census_after.get(k) for k in
+                             ('bytes_in_use', 'live_bytes',
+                              'live_buffers')},
+            'activation_bytes': census_after.get('activation_bytes'),
+        },
         'live_buffers_before_shutdown': before,
         'live_buffers_after_shutdown': released.get('live_buffers'),
         'live_bytes_after_shutdown': released.get('live_bytes'),
@@ -883,6 +905,9 @@ def _attach_telemetry(r):
             'serve': snap.get('serve'),
             # fused-primitive routing counters (ISSUE 8)
             'pallas': snap.get('pallas'),
+            # tuned-remat view (ISSUE 12): active policy per engine,
+            # boundary-tag counts, per-site activation bytes
+            'remat': snap.get('remat'),
         }
     except Exception as e:
         r['telemetry'] = {'error': repr(e)[:200]}
@@ -987,6 +1012,13 @@ def _check_legs(result):
     tel = legs['gpt1.3b_adamw'].get('telemetry') or {}
     assert 'comm_overlap' in tel or 'error' in tel, \
         'headline leg telemetry lacks comm_overlap'
+    # the activation-economy view (ISSUE 12): the headline leg must
+    # carry the remat record (policy + boundary counts + census) both
+    # in detail and in telemetry
+    assert 'remat' in tel or 'error' in tel, \
+        'headline leg telemetry lacks remat'
+    assert 'remat' in legs['gpt1.3b_adamw'] or 'error' in \
+        legs['gpt1.3b_adamw'], 'headline leg lacks the remat record'
     return True
 
 

@@ -40,7 +40,8 @@ import weakref
 __all__ = [
     'MemoryAccountant', 'accountant', 'phase', 'sample', 'live_buffers',
     'live_buffer_count', 'oom_report', 'render_oom_report', 'oom_guard',
-    'is_oom_error', 'DeviceOOMError', 'reset',
+    'is_oom_error', 'DeviceOOMError', 'reset', 'record_compiled_memory',
+    'activation_bytes',
 ]
 
 _TIMELINE_CAP = 256
@@ -145,6 +146,8 @@ class MemoryAccountant:
                                         # hot paths are main-thread only)
             self._origins = {}          # id(live array) -> phase name
             self._py_peak = 0           # census-derived fallback peak
+            self._activation = collections.OrderedDict()  # site ->
+                                        # compiled-program buffer stats
 
     # -- sampling ------------------------------------------------------------
     def sample(self, count_buffers=False):
@@ -155,7 +158,13 @@ class MemoryAccountant:
         in_use, peak, limit = _device_stats()
         out = {'bytes_in_use': in_use, 'peak_bytes_in_use': peak,
                'bytes_limit': limit, 'live_buffers': None,
-               'live_bytes': None}
+               'live_bytes': None,
+               # per-site compiled-program activation (temp-buffer)
+               # bytes — XLA's buffer-assignment view of what the step
+               # keeps resident BETWEEN forward and backward, which the
+               # live-array census cannot see (those buffers live inside
+               # the executable). Filled by record_compiled_memory().
+               'activation_bytes': self.activation_bytes()}
         # the census walk is opt-in even when the backend has no
         # memory_stats (CPU): per-dispatch phases must stay O(1)
         if count_buffers:
@@ -177,6 +186,50 @@ class MemoryAccountant:
                     self._py_peak = max(self._py_peak, nbytes)
                     out['peak_bytes_in_use'] = self._py_peak
         return out
+
+    # -- compiled-program activation bytes (ISSUE 12) ------------------------
+    def record_compiled_memory(self, site, compiled):
+        """Record a compiled executable's buffer-assignment stats under
+        `site` (engines call this right after AOT compile). The
+        interesting number is temp_size_in_bytes: the scratch/residual
+        buffers XLA keeps live inside the program — i.e. the step's
+        resident ACTIVATION bytes, the quantity remat policies and
+        sequence-parallel sharding shrink. Published as the
+        ptpu_mem_activation_bytes gauge; returns the stats dict (or
+        None when the backend exposes no memory analysis)."""
+        try:
+            ma = compiled.memory_analysis()
+            stats = {
+                'activation_bytes': int(ma.temp_size_in_bytes),
+                'argument_bytes': int(ma.argument_size_in_bytes),
+                'output_bytes': int(ma.output_size_in_bytes),
+            }
+        except Exception:
+            return None
+        with self._lock:
+            self._activation[site] = stats
+        try:
+            from . import monitor as _m
+            _m.gauge(
+                'ptpu_mem_activation_bytes',
+                help='compiled-program temp (activation/workspace) '
+                     'bytes from XLA buffer assignment, by compile site',
+                labelnames=('site',)).set(stats['activation_bytes'],
+                                          site=site)
+        except Exception:
+            pass
+        return stats
+
+    def activation_bytes(self):
+        """{site: temp bytes} of every recorded compiled program."""
+        with self._lock:
+            return {k: v['activation_bytes']
+                    for k, v in self._activation.items()}
+
+    def compiled_memory(self):
+        """Full per-site buffer-assignment stats."""
+        with self._lock:
+            return {k: dict(v) for k, v in self._activation.items()}
 
     def live_buffers(self, top=None, with_origin=True):
         """[(nbytes, shape, dtype, origin_phase)] sorted largest-first."""
@@ -397,6 +450,14 @@ def oom_report(exc=None, top=20):
 
 def reset():
     _accountant.reset()
+
+
+def record_compiled_memory(site, compiled):
+    return _accountant.record_compiled_memory(site, compiled)
+
+
+def activation_bytes():
+    return _accountant.activation_bytes()
 
 
 def _fmt_bytes(n):

@@ -79,26 +79,63 @@ _group_map = {}
 # ---- SPMD region bookkeeping ------------------------------------------------
 _spmd_axes = []       # stack of tuples of active mesh axis names
 _sp_data_sharded = []  # stack of bools: is the BATCH sharded over 'sp'?
+_mp_seq_parallel = []  # stack of bools: elementwise-segment activations
+                       # sequence-sharded over the mp group (Megatron-SP)
 
 
 @contextlib.contextmanager
-def spmd_region(axis_names, sp_data_sharded=False):
+def spmd_region(axis_names, sp_data_sharded=False, mp_seq_parallel=False):
     """Mark that we are tracing inside shard_map over `axis_names`. The fleet
     engines enter this around their per-device step functions.
     `sp_data_sharded` declares that batch tensors are sequence-sharded over
     the 'sp' axis — models key sequence-parallel behavior off THIS, not off
-    mere axis presence (an sp axis may exist for other tensors)."""
+    mere axis presence (an sp axis may exist for other tensors).
+    `mp_seq_parallel` declares Megatron-style sequence-parallel activation
+    sharding: the LayerNorm/dropout/residual segments BETWEEN mp regions
+    run on token slices scattered over the mp group (row-parallel outputs
+    psum_scatter along the sequence instead of allreduce, column-parallel
+    inputs all_gather back — docs/performance.md#sequence-parallel-
+    activations)."""
     _spmd_axes.append(tuple(axis_names))
     _sp_data_sharded.append(bool(sp_data_sharded))
+    _mp_seq_parallel.append(bool(mp_seq_parallel))
     try:
         yield
     finally:
         _spmd_axes.pop()
         _sp_data_sharded.pop()
+        _mp_seq_parallel.pop()
 
 
 def sp_data_sharded():
     return bool(_sp_data_sharded and _sp_data_sharded[-1])
+
+
+def mp_seq_sharded():
+    """True when the engine declared sequence-parallel activation
+    sharding over the mp group for this traced region."""
+    return bool(_mp_seq_parallel and _mp_seq_parallel[-1])
+
+
+def resolve_sequence_parallel(flag=None):
+    """Sequence-parallel activation sharding knob, resolved engine kwarg
+    -> PTPU_SEQUENCE_PARALLEL env -> fleet strategy
+    tensor_parallel_configs['sequence_parallel'] -> False."""
+    import os
+    if flag is None:
+        v = os.environ.get('PTPU_SEQUENCE_PARALLEL')
+        if v is not None and v != '':
+            flag = v.lower() in ('1', 'true', 'yes')
+    if flag is None:
+        try:
+            from .fleet import fleet as _fleet_mod
+            strategy = _fleet_mod._user_defined_strategy
+            if strategy is not None:
+                flag = (strategy.tensor_parallel_configs or {}).get(
+                    'sequence_parallel')
+        except Exception:
+            flag = None
+    return bool(flag)
 
 
 def in_spmd_region():
@@ -644,6 +681,130 @@ def _c_split(tensor, group=None):
         size = a.shape[-1] // n
         return lax.dynamic_slice_in_dim(a, idx * size, size, axis=a.ndim - 1)
     return run_op('c_split', fn, [tensor])
+
+
+# ---- sequence-parallel activation sharding (Megatron-SP, ISSUE 12) ---------
+# The LayerNorm/dropout/residual segments between mp regions are
+# token-local, so they can run on sequence slices scattered over the mp
+# group: the row-parallel allreduce becomes a psum_scatter along the
+# token dim (same wire bytes, 1/mp resident activation bytes in the
+# segment), and the next column-parallel input all_gathers back. Both
+# primitives are jax-transposable (RS <-> AG), so grads are identical to
+# the allreduce path (tests/test_remat.py pins loss AND per-device grads
+# against the replicated route).
+
+def _seq_axis(tensor):
+    """Token dim of an activation: axis 1 for [B, L, H], axis 0 for
+    unbatched [L, H]."""
+    return 1 if tensor.ndim >= 3 else 0
+
+
+def _c_reduce_scatter_seq(tensor, group=None):
+    """Row-parallel output under sequence parallelism: sum over the mp
+    group, each rank keeping its token slice of the full sum."""
+    axes = _group_axes(group)
+    if not (in_spmd_region() and axes):
+        return tensor
+
+    def fn(a):
+        return lax.psum_scatter(a, axes, scatter_dimension=_seq_axis(a),
+                                tiled=True)
+    return run_op('c_reduce_scatter_seq', fn, [tensor])
+
+
+def _c_allgather_seq(tensor, group=None):
+    """Column-parallel input under sequence parallelism: rebuild the full
+    token dim from the scattered slices (transpose of the RS above)."""
+    axes = _group_axes(group)
+    if not (in_spmd_region() and axes):
+        return tensor
+
+    def fn(a):
+        ax = _seq_axis(a)
+        out = a
+        for name in reversed(axes):
+            out = lax.all_gather(out, name, axis=ax, tiled=True)
+        return out
+    return run_op('c_allgather_seq', fn, [tensor])
+
+
+def _c_slice_seq(tensor, group=None):
+    """This rank's token slice of a REPLICATED activation (entry into a
+    sequence-parallel segment from replicated compute — e.g. the
+    embedding output): a static slice, no forward wire traffic.
+
+    Custom VJP: the backward all_gathers the cotangent slices back to
+    the full token dim, so everything upstream (embedding tables) sees
+    the SAME full-token cotangent it sees on the replicated route — the
+    default slice transpose would zero out the other ranks' tokens and
+    starve the embedding grads of 1-1/mp of the batch."""
+    axes = _group_axes(group)
+    if not (in_spmd_region() and axes):
+        return tensor
+
+    @jax.custom_vjp
+    def slice_seq(a):
+        return _slice_local(a, axes)
+
+    def fwd(a):
+        return _slice_local(a, axes), None
+
+    def bwd(_, ct):
+        ax = _seq_axis(ct)
+        out = ct
+        for name in reversed(axes):
+            out = lax.all_gather(out, name, axis=ax, tiled=True)
+        return (out,)
+    slice_seq.defvjp(fwd, bwd)
+    return run_op('c_slice_seq', slice_seq, [tensor])
+
+
+def _slice_local(a, axes):
+    ax = _seq_axis(a)
+    n = 1
+    idx = 0
+    for name in axes:      # outer-to-inner, the tuple-axis order
+        n = n * lax.psum(1, name)
+        idx = idx * lax.psum(1, name) + lax.axis_index(name)
+    L = a.shape[ax]
+    if L % int(n) != 0:
+        raise ValueError(
+            f"sequence length {L} does not divide the "
+            f"sequence-parallel group size {int(n)} (axes {axes})")
+    size = L // int(n)
+    return lax.dynamic_slice_in_dim(a, idx * size, size, axis=ax)
+
+
+def _c_gather_seq_replicated(tensor, group=None):
+    """Exit of the sequence-parallel region back into REPLICATED compute
+    (the final-norm → LM-head boundary): all_gather forward, and a
+    custom backward that takes this rank's token SLICE of the cotangent.
+    The replicated downstream hands every rank the same full-token
+    cotangent, so slicing is its exact inverse; the default
+    psum_scatter transpose would over-count it by the group size."""
+    axes = _group_axes(group)
+    if not (in_spmd_region() and axes):
+        return tensor
+
+    @jax.custom_vjp
+    def gather_seq(a):
+        return _gather_full(a, axes)
+
+    def fwd(a):
+        return _gather_full(a, axes), None
+
+    def bwd(_, ct):
+        return (_slice_local(ct, axes),)
+    gather_seq.defvjp(fwd, bwd)
+    return run_op('c_gather_seq_replicated', gather_seq, [tensor])
+
+
+def _gather_full(a, axes):
+    ax = _seq_axis(a)
+    out = a
+    for name in reversed(axes):
+        out = lax.all_gather(out, name, axis=ax, tiled=True)
+    return out
 
 
 def _c_softmax_with_cross_entropy(logits, label, group=None,

@@ -20,8 +20,12 @@ _DEFAULTS = {
         'custom_black_list': [], 'custom_black_varnames': [],
         'use_pure_fp16': False, 'use_fp16_guard': True, 'dtype': 'bfloat16'},
     'recompute': False,
+    # 'policy' picks the tuned trace-level remat policy for the compiled
+    # engines (docs/performance.md#remat-policy): None = engine default,
+    # or 'none' | 'full' | 'attn_mlp_boundaries' | 'dots'
+    # (PTPU_REMAT_POLICY env twin; engine kwarg `remat_policy` wins)
     'recompute_configs': {'checkpoints': [], 'enable_offload': False,
-                          'checkpoint_shape': []},
+                          'checkpoint_shape': [], 'policy': None},
     'pipeline': False,
     'pipeline_configs': {'micro_batch_size': 1, 'accumulate_steps': 1,
                          'schedule_mode': '1F1B', 'p2p_cache_shape': True},
@@ -44,8 +48,14 @@ _DEFAULTS = {
         'comm_overlap': False, 'comm_overlap_prefetch': 2,
         'comm_chunk': 0},
     'tensor_parallel': False,
+    # 'sequence_parallel' shards the LayerNorm/dropout/residual
+    # activations between mp regions along the sequence dim
+    # (Megatron-style RS/AG in place of the row allreduce —
+    # docs/performance.md#sequence-parallel-activations;
+    # PTPU_SEQUENCE_PARALLEL env twin; engine kwarg wins)
     'tensor_parallel_configs': {'tensor_parallel_degree': 1,
-                                'tensor_init_seed': -1},
+                                'tensor_init_seed': -1,
+                                'sequence_parallel': False},
     'hybrid_configs': {'dp_degree': -1, 'mp_degree': 1, 'pp_degree': 1,
                        'sharding_degree': 1, 'sep_degree': 1},
     'gradient_merge': False,

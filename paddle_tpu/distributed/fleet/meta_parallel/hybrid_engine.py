@@ -69,7 +69,8 @@ class HybridParallelTrainStep(EngineTeardown):
                  accumulate_steps=1, use_remat=False, sp_shard_args=None,
                  use_buckets=None, comm_dtype=None, bucket_mb=None,
                  comm_block=None, comm_overlap=None, prefetch_depth=None,
-                 comm_chunk=None):
+                 comm_chunk=None, remat_policy=None,
+                 sequence_parallel=None):
         self.sp_shard_args = sp_shard_args
         self.model = model
         self.loss_fn = loss_fn
@@ -82,11 +83,33 @@ class HybridParallelTrainStep(EngineTeardown):
         if 'pp' in self.axes and self.mesh.shape['pp'] > 1:
             raise ValueError("pp>1: use SpmdPipelineEngine")
         self.accumulate_steps = accumulate_steps
-        self.use_remat = use_remat
+        # tuned remat (docs/performance.md#remat-policy): kwarg -> env ->
+        # strategy; the legacy `use_remat` bool only sets the default
+        from ..utils.recompute import resolve_policy as _resolve_remat
+        self._remat_policy = _resolve_remat(
+            remat_policy, default='full' if use_remat else 'none')
+        self.use_remat = self._remat_policy != 'none'
         self.dp = self.mesh.shape.get('dp', 1)
         self.sharding_deg = self.mesh.shape.get('sharding', 1)
         self.mp = self.mesh.shape.get('mp', 1)
         self.sp = self.mesh.shape.get('sp', 1)
+        # Megatron-style sequence-parallel activation sharding
+        # (docs/performance.md#sequence-parallel-activations): the
+        # LayerNorm/dropout/residual segments between mp regions run on
+        # token slices scattered over the mp group — only meaningful
+        # with a live mp axis and a model that declares support
+        self._seq_parallel = bool(
+            C.resolve_sequence_parallel(sequence_parallel)
+            and 'mp' in self.axes and self.mp > 1
+            and getattr(model, '_supports_sequence_parallel', False))
+        # params the model consumes on the SCATTERED token stream
+        # (LayerNorms, row-parallel biases): their per-rank grads cover
+        # only the local token slice, so the step psums them over 'mp'
+        # to restore the full-token gradient the replicated route gets
+        self._seq_grad_names = frozenset(
+            n for n, p in model.named_parameters()
+            if getattr(p, 'sequence_parallel_grad', False)
+        ) if self._seq_parallel else frozenset()
 
         named = [(n, p) for n, p in model.named_parameters()
                  if not p.stop_gradient]
@@ -213,6 +236,7 @@ class HybridParallelTrainStep(EngineTeardown):
 
         self._grad_clip = optimizer._grad_clip
         self._compiled = None
+        self._exec = None
         self._closed = False
         self._step_count = 0
 
@@ -274,7 +298,9 @@ class HybridParallelTrainStep(EngineTeardown):
         dp_axes = self._rs_axes
         zero_ok = self._zero_ok
         s = self.sharding_deg
-        use_remat = self.use_remat
+        from ..utils.recompute import apply_policy as _apply_remat
+        remat_policy = self._remat_policy
+        seq_parallel = self._seq_parallel
 
         def global_norm_sq(grads):
             """Mesh-wide global grad-norm^2: mp-sharded params psum
@@ -320,7 +346,8 @@ class HybridParallelTrainStep(EngineTeardown):
             return clip_norm / jnp.maximum(gn, clip_norm)
 
         def step(params, states, lr, key, *batch):
-            with C.spmd_region(axes, sp_data_sharded=sp_on):
+            with C.spmd_region(axes, sp_data_sharded=sp_on,
+                               mp_seq_parallel=seq_parallel):
                 # -- deferred/prefetched param all-gather (overlap
                 # mode): bucketed params arrive as 1/n shards; rebuild
                 # the working replica group-by-group IN LAYER ORDER at
@@ -354,8 +381,16 @@ class HybridParallelTrainStep(EngineTeardown):
                                                     for b in batch])
                     return loss.data.astype(jnp.float32)
 
-                lf = jax.checkpoint(loss_of) if use_remat else loss_of
+                lf = _apply_remat(loss_of, remat_policy,
+                                             engine='hybrid')
                 loss, raw_grads = jax.value_and_grad(lf)(params)
+                if seq_parallel and self._seq_grad_names:
+                    # scattered-segment params: sum the per-token-slice
+                    # grads over the mp group (full-token gradient)
+                    raw_grads = {
+                        n: (lax.psum(g, 'mp')
+                            if n in self._seq_grad_names else g)
+                        for n, g in raw_grads.items()}
                 if dp_axes:
                     loss = lax.pmean(loss, dp_axes)
 
@@ -638,9 +673,25 @@ class HybridParallelTrainStep(EngineTeardown):
         key = rng_mod.next_key()
         p_arg = {'named': self._params, 'shards': self._param_shards} \
             if self._overlap else self._params
+        args = (p_arg, self._states, lr, key) + arrays
+        if first:
+            # explicit AOT compile: lower/compile spans + compile
+            # seconds AND the buffer-assignment activation census
+            # (ptpu_mem_activation_bytes — the resident bytes the remat
+            # policy shrinks; docs/performance.md#remat-policy)
+            from .... import profiler as _prof
+            self._exec, _ = _prof.compile_with_telemetry(
+                self._compiled, 'hybrid.step', args)
         with self._step_guard(first, 'hybrid.train_step', 'hybrid.step'):
-            out = self._compiled(
-                p_arg, self._states, lr, key, *arrays)
+            try:
+                out = self._exec(*args)
+            except TypeError:
+                # AOT signature drift (e.g. a new batch shape): fall
+                # back to the jitted fn, which retraces per signature
+                if self._exec is self._compiled:
+                    raise
+                self._exec = self._compiled
+                out = self._exec(*args)
         if getattr(self, '_taps_on', False):
             loss, p_out, self._states, taps = out
         else:

@@ -55,6 +55,10 @@ class EngineTeardown:
             self._compiled = None
             if hasattr(self, '_compiled_by_mode'):
                 self._compiled_by_mode = {}
+            if hasattr(self, '_exec'):
+                self._exec = None        # AOT executables pin buffers too
+            if hasattr(self, '_exec_by_mode'):
+                self._exec_by_mode = {}
             self._params = None
             self._states = None
             if hasattr(self, '_param_shards'):

@@ -107,7 +107,14 @@ class ColumnParallelLinear(Layer):
                 "full-width but self.bias is the local column shard — "
                 "apply the bias in-layer (with_bias=True) instead")
         if spmd:
-            x = C._c_identity(x, group=self.group)
+            if C.mp_seq_sharded():
+                # sequence-parallel segment ends here: rebuild the full
+                # token dim from the scattered slices (the AG half of
+                # the Megatron RS/AG pair — docs/performance.md
+                # #sequence-parallel-activations)
+                x = C._c_allgather_seq(x, group=self.group)
+            else:
+                x = C._c_identity(x, group=self.group)
         out = F.linear(x, self.weight, self.bias if with_bias else None)
         if spmd and self.gather_output:
             out = C._c_concat(out, group=self.group)
@@ -143,7 +150,15 @@ class RowParallelLinear(Layer):
         if not self.input_is_parallel:
             x = C._c_split(x, group=self.group)
         out = F.linear(x, self.weight)
-        out = C._mp_allreduce(out, group=self.group)
+        if C.mp_seq_sharded():
+            # sequence-parallel segment starts here: the partial sums
+            # psum_scatter along the token dim (same wire bytes as the
+            # allreduce, 1/mp resident bytes in the elementwise segment
+            # that follows); the bias is per-feature, so adding it to
+            # the token slice is exact
+            out = C._c_reduce_scatter_seq(out, group=self.group)
+        else:
+            out = C._mp_allreduce(out, group=self.group)
         if self.bias is not None:
             from .....ops import math as M
             out = M.add(out, self.bias)

@@ -158,6 +158,11 @@ class PipelineLayer(Layer):
         return self._layers_desc
 
     def forward(self, input, chunk_id=None):
+        # eager-parity path: every recompute_interval-th layer re-forwards
+        # in its backward (fleet.utils.recompute). The compiled twin
+        # (engine_from_pipeline_layer) honors a nonzero interval by
+        # forcing trace-level remat on, with the resolved policy deciding
+        # the save/recompute split (docs/performance.md#remat-policy).
         x = input
         for i, f in enumerate(self.run_function):
             if self._recompute_interval > 0 and \

@@ -97,7 +97,8 @@ class _HeadWrapper(_Layer):
 
 
 def engine_from_pipeline_layer(pipeline_layer, optimizer, accumulate_steps,
-                               mesh=None, use_remat=True, schedule='1F1B'):
+                               mesh=None, use_remat=True, schedule='1F1B',
+                               remat_policy=None):
     """Build a SpmdPipelineEngine from a PipelineLayer's descs (parity: the
     dygraph PipelineParallel engine construction from pp_layers).
 
@@ -149,9 +150,17 @@ def engine_from_pipeline_layer(pipeline_layer, optimizer, accumulate_steps,
     blocks = funcs[1:end]
     tail = funcs[end:]
     head = _HeadWrapper(tail, pipeline_layer._loss_fn)
+    # honor the PipelineLayer's recompute_interval: a nonzero interval is
+    # the dygraph-parity opt-in for activation recompute, so it forces
+    # remat ON for the compiled engine (the trace-level twin of wrapping
+    # every k-th layer in fleet.utils.recompute) — the resolved policy
+    # then decides what is saved vs recomputed
+    if getattr(pipeline_layer, '_recompute_interval', 0):
+        use_remat = True
     return SpmdPipelineEngine(embed, blocks, head, optimizer,
                               accumulate_steps, mesh=mesh,
-                              use_remat=use_remat, schedule=schedule)
+                              use_remat=use_remat, schedule=schedule,
+                              remat_policy=remat_policy)
 
 
 from .meta_parallel_base import EngineTeardown
@@ -175,12 +184,21 @@ class SpmdPipelineEngine(EngineTeardown):
                  grad_accum_dtype='float32', memory_mode='stash',
                  use_buckets=None, comm_dtype=None, bucket_mb=None,
                  comm_block=None, comm_overlap=None, prefetch_depth=None,
-                 comm_chunk=None):
+                 comm_chunk=None, remat_policy=None):
         self.embed = embed
         self.blocks = blocks
         self.head = head
         self.optimizer = optimizer
         self.A = accumulate_steps
+        # tuned remat (docs/performance.md#remat-policy): a resolved
+        # policy (kwarg -> PTPU_REMAT_POLICY -> strategy) overrides the
+        # schedule-specific legacy split (full remat / save-dots) that
+        # `use_remat=True` alone picks in _make_stage_forward
+        from ..utils.recompute import resolve_policy as _resolve_remat
+        self._remat_policy = _resolve_remat(remat_policy,
+                                                       default=None)
+        if self._remat_policy is not None:
+            use_remat = self._remat_policy != 'none'
         self.use_remat = use_remat
         # 1F1B backward source: 'stash' (default) keeps each in-flight
         # microbatch's vjp residuals — the reference SectionWorker's
@@ -506,14 +524,19 @@ class SpmdPipelineEngine(EngineTeardown):
         makes the bigger residual set affordable (the reference
         SectionWorker likewise stores, not recomputes)."""
         block_apply = functools.partial(self._block_apply, self.blocks[0])
-        if self.use_remat:
+        from ..utils.recompute import apply_policy as _apply_remat
+        if self._remat_policy is not None:
+            # tuned policy (docs/performance.md#remat-policy) replaces
+            # the legacy schedule-specific split below
+            block_apply = _apply_remat(
+                block_apply, self._remat_policy, engine='pipeline')
+        elif self.use_remat:
             if save_dots:
-                policy = getattr(jax.checkpoint_policies, 'dots_saveable',
-                                 None) or \
-                    jax.checkpoint_policies.checkpoint_dots
-                block_apply = jax.checkpoint(block_apply, policy=policy)
+                block_apply = _apply_remat(
+                    block_apply, 'dots', engine='pipeline')
             else:
-                block_apply = jax.checkpoint(block_apply)
+                block_apply = _apply_remat(
+                    block_apply, 'full', engine='pipeline')
 
         def stage_forward(block_params_local, x, key):
             def body(carry, xs):
@@ -1408,11 +1431,29 @@ class SpmdPipelineEngine(EngineTeardown):
         if not hasattr(self, '_warm_modes'):
             self._warm_modes = set()
         first = want_scaling not in self._warm_modes
+        args = (self._params, self._states, lr, sc, key, ii, ll)
+        if not hasattr(self, '_exec_by_mode'):
+            self._exec_by_mode = {}
+        exe = self._exec_by_mode.get(want_scaling)
+        if exe is None:
+            # explicit AOT compile: lower/compile telemetry + the
+            # buffer-assignment activation census
+            # (ptpu_mem_activation_bytes; docs/performance.md
+            # #remat-policy) for the pipeline step program
+            exe, _ = _prof.compile_with_telemetry(
+                self._compiled, 'pipeline.step', args)
+            self._exec_by_mode[want_scaling] = exe
         with _prof.RecordEvent('pipeline::train_step', event_type='jit'), \
                 self._step_guard(first, 'pipeline.train_step',
                                  'pipeline.step'):
-            out = self._compiled(
-                self._params, self._states, lr, sc, key, ii, ll)
+            try:
+                out = exe(*args)
+            except TypeError:
+                # AOT signature drift: fall back to the jitted fn
+                if exe is self._compiled:
+                    raise
+                self._exec_by_mode[want_scaling] = self._compiled
+                out = self._compiled(*args)
         self._pp_step = getattr(self, '_pp_step', 0) + 1
         if self._taps_on:
             loss, self._params, self._states, found, taps = out

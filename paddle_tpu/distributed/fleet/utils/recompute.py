@@ -1,11 +1,40 @@
-"""Activation recompute (gradient checkpointing).
+"""Activation recompute (gradient checkpointing) + tuned remat policies.
 
 Reference parity: fleet/utils/recompute.py RecomputeFunction(PyLayer):63 —
 drop activations in forward, re-forward inside backward with saved RNG
 state. TPU-native: `jax.checkpoint` (remat) IS this transform, applied at
 trace level so XLA rematerializes inside the fused backward; the eager tape
 path uses the PyLayer re-forward for parity semantics.
+
+Policy layer (ISSUE 12, docs/performance.md#remat-policy): models tag
+contraction outputs with `checkpoint_name` (`tag_tensor` below) and the
+engines wrap their traced loss/block functions in `apply_policy`, so the
+save/recompute split is TUNED instead of all-or-nothing (TPP
+arXiv:2104.05755: contractions are worth saving, elementwise chains are
+cheap to recompute). Named policies:
+
+  * 'none'                — no remat; XLA keeps every residual live;
+  * 'full'                — `jax.checkpoint` with the default policy:
+                            save nothing, recompute everything in the
+                            backward (the pre-ISSUE-12 use_remat=True);
+  * 'attn_mlp_boundaries' — save ONLY the tagged contraction outputs
+                            (qkv/attention-context/out-proj, fc1/fc2,
+                            the attn/MLP boundary set); layernorm, GELU,
+                            dropout joins, softmax internals and the
+                            embedding gather recompute in the backward;
+  * 'dots'                — `jax.checkpoint_policies.dots_saveable`
+                            (save every matmul output, tagged or not —
+                            the stashing-1F1B engine default).
+
+Resolution order (resolve_policy): explicit engine kwarg → the
+`PTPU_REMAT_POLICY` env var → fleet strategy
+`recompute_configs['policy']` (when `strategy.recompute` is enabled) →
+the engine's own default. Remat is a pure scheduling transform: loss and
+gradients are BIT-identical with any policy (tests/test_remat.py pins
+this for all three engines).
 """
+import os
+
 import jax
 
 from ....core import rng as rng_mod
@@ -136,3 +165,161 @@ def recompute_jax(function):
     """The trace-level transform: jax.checkpoint / remat for jitted steps —
     the preferred TPU path (XLA rematerializes inside the fused backward)."""
     return jax.checkpoint(function)
+
+
+# ---------------------------------------------------------------------------
+# remat policy layer (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+# checkpoint_name tags the models emit at contraction boundaries. The
+# attn_mlp_boundaries policy saves exactly these; anything else is
+# recomputed in the backward (TPP: cheap elementwise loops re-fuse).
+BOUNDARY_NAMES = ('attn_qkv', 'attn_ctx', 'attn_out',
+                  'mlp_fc1', 'mlp_out', 'embed_out')
+
+POLICY_NAMES = ('none', 'full', 'attn_mlp_boundaries', 'dots')
+
+
+def checkpoint_policy(name):
+    """(remat_on, jax_policy_or_None) for a named policy."""
+    if name in (None, 'none', False):
+        return False, None
+    if name in ('full', True):
+        return True, None
+    if name == 'attn_mlp_boundaries':
+        return True, jax.checkpoint_policies.save_only_these_names(
+            *BOUNDARY_NAMES)
+    if name == 'dots':
+        pol = getattr(jax.checkpoint_policies, 'dots_saveable', None) \
+            or jax.checkpoint_policies.checkpoint_dots
+        return True, pol
+    raise ValueError(
+        f"unknown remat policy {name!r}; expected one of {POLICY_NAMES}")
+
+
+def resolve_policy(policy=None, default='none'):
+    """Resolve the remat policy: engine kwarg -> PTPU_REMAT_POLICY env ->
+    fleet strategy recompute_configs['policy'] (when strategy.recompute
+    is on) -> `default`. Returns the policy NAME (validated) — or None
+    when `default` is None and nothing was specified anywhere (the
+    engine keeps its own legacy behavior, e.g. the stashing 1F1B's
+    save-dots split)."""
+    if policy is None:
+        v = os.environ.get('PTPU_REMAT_POLICY')
+        if v:
+            policy = v
+    if policy is None:
+        try:
+            from .. import fleet as _fleet_mod
+            strategy = _fleet_mod._user_defined_strategy
+            if strategy is not None and strategy.recompute:
+                policy = (strategy.recompute_configs or {}).get('policy')
+        except Exception:
+            policy = None
+    if policy is None:
+        policy = default
+    if policy is None:
+        return None
+    if policy is True:
+        policy = 'full'
+    if policy is False:
+        policy = 'none'
+    checkpoint_policy(policy)   # validate early, not at first dispatch
+    return policy
+
+
+def apply_policy(fn, policy, engine=None):
+    """Wrap a traced function in `jax.checkpoint` per the named policy
+    ('none' returns fn unchanged) and publish the decision gauge."""
+    name = policy if isinstance(policy, str) else (
+        'full' if policy else 'none')
+    on, jax_policy = checkpoint_policy(name)
+    if engine is not None:
+        _publish_policy(engine, name)
+    if not on:
+        return fn
+    if jax_policy is None:
+        return jax.checkpoint(fn)
+    return jax.checkpoint(fn, policy=jax_policy)
+
+
+def tag(x, name):
+    """`checkpoint_name` on a raw array (trace-time identity; counted so
+    the bench can report how many boundaries a trace carries)."""
+    from jax.ad_checkpoint import checkpoint_name
+    _count_boundary(name)
+    return checkpoint_name(x, name)
+
+
+def tag_tensor(t, name):
+    """`checkpoint_name` on a Tensor through the op tape (the transform
+    is an identity with a trivial vjp, so the eager path is a no-op
+    passthrough and the traced path carries the name)."""
+    from ....core.autograd import run_op
+    from jax.ad_checkpoint import checkpoint_name
+    _count_boundary(name)
+    return run_op('checkpoint_name',
+                  lambda a: checkpoint_name(a, name), [t])
+
+
+def _count_boundary(name):
+    try:
+        from ....core.monitor import counter
+        counter('ptpu_remat_boundaries_total',
+                help='checkpoint_name boundary tags applied (trace-time), '
+                     'by tag name',
+                labelnames=('name',)).inc(1, name=name)
+    except Exception:
+        pass
+
+
+def _publish_policy(engine, policy):
+    try:
+        from ....core.monitor import gauge
+        g = gauge('ptpu_remat_policy_info',
+                  help='active remat policy per engine (value 1; the '
+                       'policy rides in the label)',
+                  labelnames=('engine', 'policy'))
+        # zero the engine's OTHER policy series so a rebuilt engine
+        # (e.g. an in-process policy sweep) never leaves a stale series
+        # that snapshot() could misreport as active
+        for other in POLICY_NAMES:
+            if other != policy:
+                g.set(0, engine=engine, policy=other)
+        g.set(1, engine=engine, policy=policy)
+    except Exception:
+        pass
+
+
+def boundary_counts():
+    """{tag name: trace-time count} from the monitor counter."""
+    try:
+        from ....core import monitor as _m
+        m = _m.metrics().get('ptpu_remat_boundaries_total')
+        if m is None:
+            return {}
+        return {labels[0] if labels else '': int(child.value())
+                for labels, child in m._series().items()}
+    except Exception:
+        return {}
+
+
+def snapshot():
+    """StepTelemetry.snapshot()['remat'] payload: active policies per
+    engine + the boundary-tag counts (None when nothing recorded)."""
+    try:
+        from ....core import monitor as _m
+        reg = _m.metrics()
+        policies = {}
+        g = reg.get('ptpu_remat_policy_info')
+        if g is not None:
+            for labels, child in g._series().items():
+                if child.value():
+                    policies[labels[0]] = labels[1]
+        bounds = boundary_counts()
+        if not policies and not bounds:
+            return None
+        return {'policies': policies, 'boundaries': bounds,
+                'boundary_total': int(sum(bounds.values()))}
+    except Exception:
+        return None

@@ -107,11 +107,19 @@ class TrainStep:
 
     def __init__(self, model, loss_fn, optimizer, donate=True,
                  use_buckets=None, comm_overlap=None, prefetch_depth=None,
-                 comm_chunk=None):
+                 comm_chunk=None, remat_policy=None):
         from ..core import bucketing as B
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
+        # tuned remat (docs/performance.md#remat-policy): kwarg ->
+        # PTPU_REMAT_POLICY -> strategy.recompute_configs['policy'];
+        # the single-program step historically ran without remat, so the
+        # default stays 'none'
+        from ..distributed.fleet.utils.recompute import (
+            resolve_policy as _resolve_remat)
+        self._remat_policy = _resolve_remat(remat_policy,
+                                                       default='none')
         self._param_names = [n for n, p in _named_params(model)
                              if not p.stop_gradient]
         # copies, not views: the compiled step DONATES these buffers and the
@@ -189,6 +197,10 @@ class TrainStep:
                     loss = loss_fn(model, *[Tensor(b) for b in batch])
             return loss.data.astype(jnp.float32), dict(out_bufs)
 
+        from ..distributed.fleet.utils.recompute import (
+            apply_policy as _apply_remat)
+        loss_of = _apply_remat(loss_of, self._remat_policy,
+                                          engine='jit')
         (loss, new_buffers), grads = jax.value_and_grad(
             loss_of, has_aux=True)(params, buffers)
         if self._use_buckets:

@@ -64,7 +64,12 @@ class BertEmbeddings(nn.Layer):
         x = M.add(M.add(self.word_embeddings(input_ids),
                         self.position_embeddings(position_ids)),
                   self.token_type_embeddings(token_type_ids))
-        return self.dropout(self.layer_norm(x))
+        # remat boundary (docs/performance.md#remat-policy): saved under
+        # attn_mlp_boundaries so the backward never replays the three
+        # embedding gathers; the LN/dropout tail recomputes
+        from ..distributed.fleet.utils.recompute import tag_tensor
+        return self.dropout(self.layer_norm(
+            tag_tensor(x, 'embed_out')))
 
 
 class BertModel(nn.Layer):

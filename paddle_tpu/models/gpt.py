@@ -29,6 +29,7 @@ from ..nn import initializer as I
 from ..distributed.fleet.meta_parallel.parallel_layers.mp_layers import (
     VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear,
     ParallelCrossEntropy, _mp_info)
+from ..distributed.fleet.utils.recompute import tag_tensor as _remat_tag
 
 
 class GPTConfig:
@@ -79,6 +80,15 @@ def _sp_active():
             and topology_runtime.axis_size('sp') > 1)
 
 
+def _mp_seq_active():
+    """True when the engine declared Megatron-style sequence-parallel
+    activation sharding: the residual stream between mp regions runs on
+    token slices scattered over the mp group
+    (docs/performance.md#sequence-parallel-activations)."""
+    from ..distributed import collective as C
+    return C.in_spmd_region() and C.mp_seq_sharded()
+
+
 class GPTEmbeddings(nn.Layer):
     """Token (vocab-parallel) + learned position embeddings. Under sequence
     parallelism the local chunk's positions are offset by the sp rank."""
@@ -104,7 +114,8 @@ class GPTEmbeddings(nn.Layer):
             position_ids = Tensor(pos)
         tok = self.word_embeddings(input_ids)
         pos = self.position_embeddings(position_ids)
-        return self.dropout(M.add(tok, pos))
+        return self.dropout(
+            _remat_tag(M.add(tok, pos), 'embed_out'))
 
 
 class GPTAttention(nn.Layer):
@@ -142,8 +153,14 @@ class GPTAttention(nn.Layer):
         position-indexed by cache_len) enabling O(1)-per-token decode."""
         if cache is not None:
             return self._forward_cached(x, cache, cache_len)
-        B, L, _ = x.shape
-        qkv = self.qkv_proj(x)  # [B, L, 3*H/mp]
+        # remat boundary tags (docs/performance.md#remat-policy): the
+        # attn_mlp_boundaries policy saves these contraction outputs and
+        # recomputes the cheap elementwise chains between them
+        qkv = _remat_tag(self.qkv_proj(x), 'attn_qkv')
+        # under sequence-parallel activation sharding the input x is a
+        # token SLICE and qkv_proj gathered it back to the full token
+        # dim — take B/L from qkv, not x
+        B, L = qkv.shape[0], qkv.shape[1]
         hd, nh = self.head_dim, qkv.shape[-1] // (3 * self.head_dim)
 
         # out-dim layout is (head, 3, hd): column-sharding then hands each
@@ -182,18 +199,25 @@ class GPTAttention(nn.Layer):
                                      sp=topology_runtime.axis_size('sp'),
                                      dropout=self.attn_dropout_p
                                      if self.training else 0.0)
-        elif self.use_flash and L >= 512 and not (
-                self.attn_dropout_p > 0.0 and self.training):
-            # active attention dropout falls back to the dense path —
-            # the flash kernels don't drop probs, and silently training
-            # without the configured regularization would be wrong
+        elif self.use_flash and L >= 512:
+            # active attention dropout no longer forces the dense path:
+            # the keep mask is drawn OUTSIDE the kernel at the exact
+            # RNG-stream point the dense path draws (attn_key above), so
+            # the dropout-fused flash route is same-seed/same-mask
+            # comparable with the dense reference (ISSUE 12)
             from ..ops.pallas import flash_attention as fa
-            ctx = fa.causal_attention(qkv, nh, hd)
+            ctx = fa.causal_attention(
+                qkv, nh, hd,
+                dropout=self.attn_dropout_p if attn_key is not None
+                else 0.0,
+                dropout_key=attn_key)
         else:
             from ..ops.pallas import scaffold as _scaffold
-            _scaffold.record_route('flash_attention', False)
+            _scaffold.record_route('flash_dropout' if attn_key is not None
+                                   else 'flash_attention', False)
             ctx = run_op('fused_attention', attn, [qkv])
-        out = self.out_proj(ctx)
+        ctx = _remat_tag(ctx, 'attn_ctx')
+        out = _remat_tag(self.out_proj(ctx), 'attn_out')
         return out
 
     def _forward_cached(self, x, cache, cache_len):
@@ -312,11 +336,14 @@ class GPTMLP(nn.Layer):
 
     def forward(self, x):
         if self.fc1.bias is not None:
-            h = F.bias_gelu(self.fc1(x, with_bias=False), self.fc1.bias,
-                            approximate=True)
+            h = F.bias_gelu(
+                _remat_tag(self.fc1(x, with_bias=False),
+                                  'mlp_fc1'),
+                self.fc1.bias, approximate=True)
         else:
-            h = F.gelu(self.fc1(x), approximate=True)
-        return self.fc2(h)
+            h = F.gelu(_remat_tag(self.fc1(x), 'mlp_fc1'),
+                       approximate=True)
+        return _remat_tag(self.fc2(h), 'mlp_out')
 
 
 class GPTDecoderLayer(nn.Layer):
@@ -335,6 +362,17 @@ class GPTDecoderLayer(nn.Layer):
                                 epsilon=config.layer_norm_eps)
         self.mlp = GPTMLP(config)
         self.hidden_dropout = config.hidden_dropout
+        # params consumed while the residual stream is sequence-
+        # scattered (docs/performance.md#sequence-parallel-activations):
+        # their per-rank grads cover only the local token slice, so the
+        # engine psums them over 'mp' when sequence_parallel is on
+        # (Megatron marks its LN params the same way). Inert otherwise.
+        for p in (list(self.ln1.parameters()) + list(self.ln2.parameters())
+                  + ([self.attn.out_proj.bias]
+                     if self.attn.out_proj.bias is not None else [])
+                  + ([self.mlp.fc2.bias]
+                     if self.mlp.fc2.bias is not None else [])):
+            p.sequence_parallel_grad = True
 
     def _join(self, sub_out, residual):
         return F.dropout_add(sub_out, residual, p=self.hidden_dropout,
@@ -371,6 +409,9 @@ class GPTModel(nn.Layer):
             [GPTDecoderLayer(config) for _ in range(config.num_layers)])
         self.final_norm = nn.LayerNorm(config.hidden_size,
                                        epsilon=config.layer_norm_eps)
+        for p in self.final_norm.parameters():
+            # the final norm also runs on the scattered stream
+            p.sequence_parallel_grad = True
 
     def forward(self, input_ids, position_ids=None, caches=None,
                 cache_len=None):
@@ -381,9 +422,26 @@ class GPTModel(nn.Layer):
                 x, nc = layer(x, cache=c, cache_len=cache_len)
                 new_caches.append(nc)
             return self.final_norm(x), new_caches
+        qkv = self.layers[0].attn.qkv_proj if self.layers else None
+        seqp = (_mp_seq_active() and qkv is not None
+                and qkv.world_size > 1)
+        if seqp:
+            # sequence-parallel activation sharding: the residual
+            # stream drops to this rank's token slice here (a static
+            # slice — the embed output is replicated over mp) and stays
+            # scattered through every LayerNorm/dropout/residual
+            # segment; the qkv/fc1 entries gather, the out-proj/fc2
+            # exits re-scatter (mp_layers), and the stream is gathered
+            # back to full ONLY after the final norm below.
+            from ..distributed import collective as C
+            x = C._c_slice_seq(x, group=qkv.group)
         for layer in self.layers:
             x = layer(x)
-        return self.final_norm(x)
+        x = self.final_norm(x)
+        if seqp:
+            from ..distributed import collective as C
+            x = C._c_gather_seq_replicated(x, group=qkv.group)
+        return x
 
     def forward_paged(self, input_ids, position_ids, kv_list,
                       page_tables, seq_lens, q_lens):
@@ -422,6 +480,18 @@ class GPTForCausalLM(nn.Layer):
 
     def forward(self, input_ids, position_ids=None):
         hidden = self.gpt(input_ids, position_ids)
+        # Megatron "copy to tensor-parallel region" (f op) in front of
+        # the vocab-parallel head matmul: identity forward, psum('mp')
+        # backward. Without it each mp rank's backward carries only its
+        # own vocab shard's PARTIAL cotangent into final_norm and the
+        # last decoder segment, so replicated-param grads there diverge
+        # per rank (ColumnParallelLinear heads get this via their own
+        # _c_identity; the tied-matmul path was missing it).
+        from ..distributed import collective as C
+        if self.gpt.embeddings.word_embeddings.world_size > 1 \
+                and C.in_spmd_region():
+            hidden = C._c_identity(
+                hidden, group=self.gpt.embeddings.word_embeddings.group)
         w = self.gpt.embeddings.word_embeddings.weight  # [V(/mp local), H]
         logits = M.matmul(hidden, w, transpose_y=True)
         return logits  # class dim vocab-parallel under mp

@@ -250,6 +250,12 @@ class TransformerEncoderLayer(Layer):
         else:
             src, incremental_cache = self.self_attn(src, src, src, src_mask,
                                                     cache)
+        # remat boundary tag (docs/performance.md#remat-policy): the
+        # attention output is a contraction boundary — saved under the
+        # attn_mlp_boundaries policy, the joins/norms recompute
+        from ...distributed.fleet.utils.recompute import (
+            tag_tensor as _remat_tag)
+        src = _remat_tag(src, 'attn_out')
         # residual joins and the FFN bias+GELU route through the fused
         # Pallas primitives (ops/pallas/fused_elementwise.py): same ops
         # and RNG stream as dropout-then-add / linear-then-gelu on the
@@ -264,11 +270,14 @@ class TransformerEncoderLayer(Layer):
         if self.normalize_before:
             src = self.norm2(src)
         if self.activation is F.gelu and self.linear1.bias is not None:
-            h = F.bias_gelu(F.linear(src, self.linear1.weight),
-                            self.linear1.bias)
+            h = F.bias_gelu(
+                _remat_tag(F.linear(src, self.linear1.weight),
+                           'mlp_fc1'),
+                self.linear1.bias)
         else:
-            h = self.activation(self.linear1(src))
-        src = self.linear2(self.dropout(h))
+            h = self.activation(
+                _remat_tag(self.linear1(src), 'mlp_fc1'))
+        src = _remat_tag(self.linear2(self.dropout(h)), 'mlp_out')
         src = F.dropout_add(src, residual, p=self.dropout2.p,
                             training=self.training,
                             mode=self.dropout2.mode)

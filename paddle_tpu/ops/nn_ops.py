@@ -64,7 +64,18 @@ def dropout_add(x, residual, p=0.5, training=True,
     the plain `dropout` op would at this point in the RNG stream, so
     replacing `add(residual, dropout(x))` call sites is bit-exact on
     the reference route; the Pallas route fuses select + upscale + add
-    into one pass (ops/pallas/fused_elementwise.py)."""
+    into one pass (ops/pallas/fused_elementwise.py).
+
+    The attention-prob analogue lives in
+    ops/pallas/flash_attention.causal_attention(dropout=..., key=...):
+    the keep mask is likewise drawn OUTSIDE the kernel at the dense
+    path's RNG-stream point and streamed through the fused fwd/bwd
+    kernels (docs/performance.md#fused-primitives). Under
+    sequence-parallel activation sharding
+    (docs/performance.md#sequence-parallel-activations) this join runs
+    on the local token slice: the draw folds the mp rank into the
+    stream key so slices get INDEPENDENT masks — deterministic, but
+    not mask-identical to the replicated route when p > 0."""
     x, residual = as_tensor(x), as_tensor(residual)
     if not training or p == 0.0:
         if mode == 'upscale_in_train':
@@ -77,6 +88,16 @@ def dropout_add(x, residual, p=0.5, training=True,
         return _m.add(dropout(x, p=p, training=training, mode=mode),
                       residual)
     key = rng.next_key()
+    from ..distributed import collective as _C
+    if _C.mp_seq_sharded() and 'mp' in _C.current_spmd_axes():
+        # sequence-parallel activation sharding: this join runs on a
+        # DISTINCT token slice per mp rank — fold the rank into the key
+        # so slices draw independent masks (the shared key would stamp
+        # the same pattern onto every slice, a cross-slice correlation
+        # the replicated route never has). Replicated-region draws
+        # (e.g. the pre-slice embedding dropout) keep the shared key.
+        from jax import lax as _lax
+        key = jax.random.fold_in(key, _lax.axis_index('mp'))
     from .pallas import fused_elementwise as _fe
     fused = _fe.use_fused('dropout_add')
 

@@ -65,18 +65,23 @@ _fit_block = scaffold.fit_block
 _interpret = scaffold.interpret_mode
 
 
-def _flash_fwd_kernel(*refs, block_k, seq_len, scale, causal, has_bias):
+def _flash_fwd_kernel(*refs, block_k, seq_len, scale, causal, has_bias,
+                      has_dropout=False, inv_keep=1.0):
     """One (batch*head, q_block) program: stream K/V blocks, online softmax.
 
     q_ref: [block_q, d]; k_ref/v_ref: [seq_len, d]; bias_ref (optional):
-    [1, seq_len] additive key bias for this batch row; o_ref: [block_q, d];
-    lse_ref: [block_q, 1] per-row logsumexp (saved for the fused backward).
+    [1, seq_len] additive key bias for this batch row; mask_ref (optional,
+    attention-prob dropout): [block_q, seq_len] int8 keep mask for this
+    q block — the softmax normalizer uses the UNdropped probs (standard
+    attention-dropout semantics: the mask applies to the softmax output,
+    upscaled by 1/keep); o_ref: [block_q, d]; lse_ref: [block_q, 1]
+    per-row logsumexp (saved for the fused backward).
     """
-    if has_bias:
-        q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref = refs
-    else:
-        q_ref, k_ref, v_ref, o_ref, lse_ref = refs
-        bias_ref = None
+    it = iter(refs)
+    q_ref, k_ref, v_ref = next(it), next(it), next(it)
+    bias_ref = next(it) if has_bias else None
+    mask_ref = next(it) if has_dropout else None
+    o_ref, lse_ref = next(it), next(it)
     block_q = q_ref.shape[0]
     d = q_ref.shape[1]
     qi = pl.program_id(1)
@@ -114,8 +119,12 @@ def _flash_fwd_kernel(*refs, block_k, seq_len, scale, causal, has_bias):
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
         l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        pv = p
+        if mask_ref is not None:
+            mblk = mask_ref[:, pl.ds(k_start, block_k)]
+            pv = p * jnp.where(mblk != 0, inv_keep, 0.0)
         acc_new = acc * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            pv, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
@@ -125,15 +134,17 @@ def _flash_fwd_kernel(*refs, block_k, seq_len, scale, causal, has_bias):
     lse_ref[:] = m + jnp.log(l_safe)
 
 
-def _flash_bwd_dq_kernel(*refs, block_k, seq_len, scale, causal, has_bias):
+def _flash_bwd_dq_kernel(*refs, block_k, seq_len, scale, causal, has_bias,
+                         has_dropout=False, inv_keep=1.0):
     """dq for one (bh, q_block): stream K/V blocks.
-    ds = p * (dP - D); dq = scale * ds @ k."""
-    if has_bias:
-        (q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
-         dq_ref) = refs
-    else:
-        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref = refs
-        bias_ref = None
+    ds = p * (d*dP - delta); dq = scale * ds @ k (d = dropout keep
+    factor; delta = rowsum(dO*O) already carries the dropped probs)."""
+    it = iter(refs)
+    q_ref, k_ref, v_ref = next(it), next(it), next(it)
+    bias_ref = next(it) if has_bias else None
+    mask_ref = next(it) if has_dropout else None
+    do_ref, lse_ref, delta_ref, dq_ref = (next(it), next(it), next(it),
+                                          next(it))
     block_q = q_ref.shape[0]
     qi = pl.program_id(1)
     q_offset = qi * block_q
@@ -164,6 +175,9 @@ def _flash_bwd_dq_kernel(*refs, block_k, seq_len, scale, causal, has_bias):
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if mask_ref is not None:
+            mblk = mask_ref[:, pl.ds(k_start, block_k)]
+            dp = dp * jnp.where(mblk != 0, inv_keep, 0.0)
         ds = p * (dp - delta)
         return dq + scale * jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
@@ -174,16 +188,16 @@ def _flash_bwd_dq_kernel(*refs, block_k, seq_len, scale, causal, has_bias):
     dq_ref[:] = dq.astype(dq_ref.dtype)
 
 
-def _flash_bwd_dkv_kernel(*refs, block_q, seq_len, scale, causal, has_bias):
+def _flash_bwd_dkv_kernel(*refs, block_q, seq_len, scale, causal, has_bias,
+                          has_dropout=False, inv_keep=1.0):
     """dk/dv for one (bh, kv_block): stream Q blocks.
-    dv = p^T @ do; dk = scale * ds^T @ q."""
-    if has_bias:
-        (q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
-         dk_ref, dv_ref) = refs
-    else:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-         dk_ref, dv_ref) = refs
-        bias_ref = None
+    dv = (p*d)^T @ do; dk = scale * ds^T @ q (d = dropout keep factor)."""
+    it = iter(refs)
+    q_ref, k_ref, v_ref = next(it), next(it), next(it)
+    bias_ref = next(it) if has_bias else None
+    mask_ref = next(it) if has_dropout else None
+    do_ref, lse_ref, delta_ref, dk_ref, dv_ref = (
+        next(it), next(it), next(it), next(it), next(it))
     block_k = k_ref.shape[0]
     ki = pl.program_id(1)
     k_start = ki * block_k
@@ -215,11 +229,19 @@ def _flash_bwd_dkv_kernel(*refs, block_q, seq_len, scale, causal, has_bias):
                                             (block_q, block_k), 1) + k_start
             s = jnp.where(rows >= cols, s, NEG_INF)
         p = jnp.exp(s - lse)  # [bq, bk]
+        if mask_ref is not None:
+            mblk = mask_ref[pl.ds(q_offset, block_q), :]
+            d_keep = jnp.where(mblk != 0, inv_keep, 0.0)
+        else:
+            d_keep = None
         dv_new = dv + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p if d_keep is None else p * d_keep, do,
+            (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if d_keep is not None:
+            dp = dp * d_keep
         ds = p * (dp - delta)
         dk_new = dk + scale * jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
@@ -431,8 +453,12 @@ def _flash_bwd_dkv_kernel_packed(*refs, block_q, seq_len, scale, causal,
 
 
 def _flash_forward(q, k, v, bias=None, num_heads=1, causal=True,
-                   block_q=None, block_k=None, with_lse=False):
-    """q/k/v: [BH, L, D]; bias: optional [B, L_k] additive key bias
+                   block_q=None, block_k=None, with_lse=False,
+                   dropout_mask=None, dropout=0.0):
+    """q/k/v: [BH, L, D]; bias: optional [B, L_k] additive key bias;
+    dropout_mask: optional [BH, L, L] int8 keep mask (attention-prob
+    dropout at `dropout`, mask drawn by the caller OUTSIDE the kernel so
+    the RNG-stream point matches the dense path)
     → [BH, L, D] (+ optional [BH, L] logsumexp)."""
     bh, L, d = q.shape
     block_q = _fit_block(block_q or _BLOCK_Q, L)
@@ -440,11 +466,13 @@ def _flash_forward(q, k, v, bias=None, num_heads=1, causal=True,
     scale = 1.0 / math.sqrt(d)
     grid = (bh, pl.cdiv(L, block_q))
     has_bias = bias is not None
+    has_dropout = dropout_mask is not None
     if has_bias:
         bias = bias.reshape(bias.shape[0], 1, bias.shape[-1])
-    kernel = functools.partial(_flash_fwd_kernel, block_k=block_k,
-                               seq_len=L, scale=scale, causal=causal,
-                               has_bias=has_bias)
+    kernel = functools.partial(
+        _flash_fwd_kernel, block_k=block_k, seq_len=L, scale=scale,
+        causal=causal, has_bias=has_bias, has_dropout=has_dropout,
+        inv_keep=1.0 / (1.0 - dropout) if has_dropout else 1.0)
     in_specs = [
         pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
         pl.BlockSpec((None, L, d), lambda b, i: (b, 0, 0)),
@@ -454,6 +482,10 @@ def _flash_forward(q, k, v, bias=None, num_heads=1, causal=True,
     if has_bias:
         in_specs.append(_bias_spec(num_heads, L))
         args.append(bias)
+    if has_dropout:
+        in_specs.append(pl.BlockSpec((None, block_q, L),
+                                     lambda b, i: (b, i, 0)))
+        args.append(dropout_mask)
     o, lse = pl.pallas_call(
         kernel,
         out_shape=(jax.ShapeDtypeStruct((bh, L, d), q.dtype),
@@ -580,13 +612,19 @@ def _flash_backward_packed(q, k, v, o, lse, do, bias=None, num_heads=1,
 
 
 def _flash_backward(q, k, v, o, lse, do, bias=None, num_heads=1,
-                    causal=True, block_q=None, block_k=None):
-    """Fused flash backward: no [L, L] materialization."""
+                    causal=True, block_q=None, block_k=None,
+                    dropout_mask=None, dropout=0.0):
+    """Fused flash backward: no [L, L] score materialization.
+    `dropout_mask`/`dropout` mirror the forward (attention-prob dropout
+    folded into the kernels); delta = rowsum(dO*O) already carries the
+    dropped probs, so the outer pass is unchanged."""
     bh, L, d = q.shape
     block_q = _fit_block(block_q or _BLOCK_Q, L)
     block_k = _fit_block(block_k or _BLOCK_K, L)
     scale = 1.0 / math.sqrt(d)
     has_bias = bias is not None
+    has_dropout = dropout_mask is not None
+    inv_keep = 1.0 / (1.0 - dropout) if has_dropout else 1.0
     if has_bias:
         bias = bias.reshape(bias.shape[0], 1, bias.shape[-1])
     # D_i = rowsum(dO * O) — tiny elementwise pass, leave it to XLA
@@ -602,6 +640,10 @@ def _flash_backward(q, k, v, o, lse, do, bias=None, num_heads=1,
     if has_bias:
         dq_in_specs.append(_bias_spec(num_heads, L))
         dq_args.append(bias)
+    if has_dropout:
+        dq_in_specs.append(pl.BlockSpec((None, block_q, L),
+                                        lambda b, i: (b, i, 0)))
+        dq_args.append(dropout_mask)
     dq_in_specs += [
         pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
         pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0)),
@@ -611,7 +653,8 @@ def _flash_backward(q, k, v, o, lse, do, bias=None, num_heads=1,
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_k=block_k, seq_len=L,
-                          scale=scale, causal=causal, has_bias=has_bias),
+                          scale=scale, causal=causal, has_bias=has_bias,
+                          has_dropout=has_dropout, inv_keep=inv_keep),
         out_shape=jax.ShapeDtypeStruct((bh, L, d), q.dtype),
         grid=(bh, pl.cdiv(L, block_q)),
         in_specs=dq_in_specs,
@@ -628,6 +671,10 @@ def _flash_backward(q, k, v, o, lse, do, bias=None, num_heads=1,
     if has_bias:
         dkv_in_specs.append(_bias_spec(num_heads, L))
         dkv_args.append(bias)
+    if has_dropout:
+        dkv_in_specs.append(pl.BlockSpec((None, L, block_k),
+                                         lambda b, j: (b, 0, j)))
+        dkv_args.append(dropout_mask)
     dkv_in_specs += [
         pl.BlockSpec((None, L, d), lambda b, j: (b, 0, 0)),
         pl.BlockSpec((None, L, 1), lambda b, j: (b, 0, 0)),
@@ -637,7 +684,8 @@ def _flash_backward(q, k, v, o, lse, do, bias=None, num_heads=1,
 
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, block_q=block_q, seq_len=L,
-                          scale=scale, causal=causal, has_bias=has_bias),
+                          scale=scale, causal=causal, has_bias=has_bias,
+                          has_dropout=has_dropout, inv_keep=inv_keep),
         out_shape=(jax.ShapeDtypeStruct((bh, L, d), k.dtype),
                    jax.ShapeDtypeStruct((bh, L, d), v.dtype)),
         grid=(bh, pl.cdiv(L, block_k)),
@@ -687,6 +735,36 @@ def _fa_bwd(res, g):
 
 
 flash_attention_bhld.defvjp(_fa_fwd, _fa_bwd)
+
+
+# -- causal + attention-prob dropout (GPT training path, ISSUE 12) -----------
+# The int8 keep mask is drawn OUTSIDE the kernel (same RNG-stream point
+# and shape as the dense path's bernoulli draw) and streamed through the
+# fwd/bwd kernels in [block, L] slabs — the fp32 probs still never
+# materialize, and the 1-byte mask is the only O(L^2) residual. The mask
+# is non-differentiable: its cotangent is float0.
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _flash_attn_dropout(rate, q, k, v, mask8):
+    return _flash_forward(q, k, v, causal=True, dropout_mask=mask8,
+                          dropout=rate)
+
+
+def _fad_fwd(rate, q, k, v, mask8):
+    o, lse = _flash_forward(q, k, v, causal=True, dropout_mask=mask8,
+                            dropout=rate, with_lse=True)
+    return o, (q, k, v, mask8, o, lse)
+
+
+def _fad_bwd(rate, res, g):
+    import numpy as _np
+    q, k, v, mask8, o, lse = res
+    dq, dk, dv = _flash_backward(q, k, v, o, lse, g, causal=True,
+                                 dropout_mask=mask8, dropout=rate)
+    return dq, dk, dv, _np.zeros(mask8.shape, jax.dtypes.float0)
+
+
+_flash_attn_dropout.defvjp(_fad_fwd, _fad_bwd)
 
 
 # -- general: optional [B, L_k] additive key bias, causal flag ----------------
@@ -784,22 +862,50 @@ def flash_attention(q, k, v, bias=None, num_heads=1, causal=True):
                               bias.astype(jnp.float32))
 
 
-def causal_attention(qkv, num_heads, head_dim, dropout=0.0):
+def causal_attention(qkv, num_heads, head_dim, dropout=0.0,
+                     dropout_key=None):
     """Tensor-level entry used by GPTAttention: qkv [B, L, nh*3*hd]
     ((head, 3, hd) Megatron packing — TP-shardable) → context
     [B, L, nh*hd]. Default route is the packed transpose-free kernel
     (q/k/v stay in [B, L, H*D]; only the cheap qkv un-interleave slice
     remains); FLAGS_flash_packed_causal=False restores the BHLD route.
 
-    The kernels do not drop attention probs: callers with ACTIVE
-    attention dropout must use the dense path (GPTAttention falls back;
-    a nonzero dropout here is a routing bug, so raise loudly)."""
+    Nonzero `dropout` routes through the dropout-fused BHLD kernels
+    (ISSUE 12): the int8 keep mask is drawn HERE with `dropout_key` —
+    the same bernoulli draw (key, rate, [B, nh, L, L] shape) the dense
+    path makes at this RNG-stream point, so same-seed outputs are
+    directly comparable. A clear error remains only when no route
+    exists: dropout without the key (the RNG point cannot be
+    reproduced) or a rate outside [0, 1)."""
     from ...core import flags
     if dropout:
-        raise ValueError(
-            "flash causal_attention does not implement attention-prob "
-            "dropout; route through the dense path when attn dropout "
-            "is active")
+        if not (0.0 < dropout < 1.0):
+            raise ValueError(
+                f"attention dropout rate must be in [0, 1), got "
+                f"{dropout}")
+        if dropout_key is None:
+            raise ValueError(
+                "flash causal_attention with attention-prob dropout "
+                "needs dropout_key (the dense path's RNG-stream draw "
+                "point); without it no route can reproduce the mask")
+        scaffold.record_route('flash_dropout', True)
+
+        def fn_drop(a):
+            B, L, _ = a.shape
+            x = a.reshape(B, L, num_heads, 3, head_dim)
+            q = x[:, :, :, 0].transpose(0, 2, 1, 3).reshape(
+                B * num_heads, L, head_dim)
+            k = x[:, :, :, 1].transpose(0, 2, 1, 3).reshape(
+                B * num_heads, L, head_dim)
+            v = x[:, :, :, 2].transpose(0, 2, 1, 3).reshape(
+                B * num_heads, L, head_dim)
+            keep = jax.random.bernoulli(dropout_key, 1.0 - dropout,
+                                        (B, num_heads, L, L))
+            mask8 = keep.reshape(B * num_heads, L, L).astype(jnp.int8)
+            o = _flash_attn_dropout(float(dropout), q, k, v, mask8)
+            o = o.reshape(B, num_heads, L, head_dim).transpose(0, 2, 1, 3)
+            return o.reshape(B, L, num_heads * head_dim)
+        return run_op('flash_attention', fn_drop, [qkv])
     scaffold.record_route('flash_attention', True)
     packed = bool(flags.flag('FLAGS_flash_packed_causal', True))
 

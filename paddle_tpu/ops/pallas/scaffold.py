@@ -21,7 +21,10 @@ skeleton:
     `tools/health_dump.py pallas`. Routes are decided at TRACE time
     (the compiled step replays the chosen route every step), so the
     counters count routing decisions, not per-step executions — same
-    convention as the trace-time ptpu_comm_* byte model.
+    convention as the trace-time ptpu_comm_* byte model. Primitives:
+    flash_attention, flash_dropout (the dropout-fused causal kernels —
+    ISSUE 12), paged_attention, optimizer_step, grad_stats,
+    layer_norm, bias_gelu, dropout_add.
 
 Adding a kernel on this scaffolding costs the kernel body plus a
 ~20-line wrapper: pick a primitive name, call `use_kernel(name, flag)`

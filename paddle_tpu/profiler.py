@@ -667,6 +667,14 @@ def compile_with_telemetry(jitted, label, args, kwargs=None):
         dt = time.perf_counter() - t0
         c_sec.inc(dt, site=label)
         c_num.inc(1, site=label)
+        # buffer-assignment census: the executable's temp (activation)
+        # bytes — the resident set remat policies shrink (ISSUE 12;
+        # core/memory.record_compiled_memory publishes the gauge)
+        try:
+            from .core import memory as _mem
+            _mem.record_compiled_memory(label, compiled)
+        except Exception:
+            pass
         flops = _cost_flops(compiled)
         if flops is not None:
             _monitor.gauge('ptpu_xla_flops_per_run',
@@ -846,6 +854,21 @@ class StepTelemetry:
             snap['pallas'] = _scaffold.snapshot()
         except Exception:
             snap['pallas'] = None
+        # tuned-remat view (ptpu_remat_* gauges/counters): active policy
+        # per engine + checkpoint_name boundary counts, beside the
+        # per-site activation-byte census — docs/performance.md#remat-policy
+        try:
+            from .distributed.fleet.utils.recompute import (
+                snapshot as _remat_snapshot)
+            from .core import memory as _mem
+            remat = _remat_snapshot()
+            acts = _mem.activation_bytes()
+            if remat is not None or acts:
+                remat = dict(remat or {})
+                remat['activation_bytes'] = acts or None
+            snap['remat'] = remat
+        except Exception:
+            snap['remat'] = None
         return snap
 
 

@@ -36,18 +36,27 @@ the human post-mortem:
     which fused kernels the compiled steps actually picked vs
     reference fallbacks (docs/performance.md#fused-primitives).
 
+  * memory census (`mem` subcommand): per-phase high-water table plus
+    the compiled-program ACTIVATION bytes line
+    (ptpu_mem_activation_bytes — XLA buffer-assignment temp bytes per
+    compile site, the resident set remat policies shrink;
+    docs/performance.md#remat-policy) from a bench record's `memory`
+    section.
+
 Usage:
     python tools/health_dump.py ARTIFACT.json [--json] [--level ERROR]
     python tools/health_dump.py numerics ARTIFACT.json [--json]
     python tools/health_dump.py comm SNAPSHOT.json [--json]
     python tools/health_dump.py serve SNAPSHOT.json [--json]
     python tools/health_dump.py pallas SNAPSHOT.json [--json]
+    python tools/health_dump.py mem RECORD.json [--json]
     python tools/health_dump.py --selftest           # CI smoke
     python tools/health_dump.py numerics --selftest  # numerics CI smoke
     python tools/health_dump.py comm --selftest      # comm CI smoke
     python tools/health_dump.py serve --selftest     # serving CI smoke
     python tools/health_dump.py cluster --selftest   # cluster CI smoke
     python tools/health_dump.py pallas --selftest    # pallas CI smoke
+    python tools/health_dump.py mem --selftest       # mem CI smoke
 """
 import argparse
 import json
@@ -1013,8 +1022,121 @@ def numerics_main(argv):
     return 0
 
 
+def _find_mem(doc):
+    """Locate a memory-census section: a bench leg's `memory` dict
+    ({'sample': ..., 'phases': ...}) or an accountant-style snapshot."""
+    if not isinstance(doc, dict):
+        return None
+    if 'sample' in doc and 'phases' in doc:
+        return doc
+    for key in ('memory', 'detail', 'telemetry'):
+        found = _find_mem(doc.get(key))
+        if found is not None:
+            return found
+    if 'legs' in doc:
+        for leg in (doc['legs'] or {}).values():
+            found = _find_mem(leg)
+            if found is not None:
+                return found
+    return None
+
+
+def render_mem(memdoc):
+    """Human view of a memory census: per-phase high-water + the
+    compiled-program activation-bytes line (docs/performance.md
+    #remat-policy)."""
+    out = ['Memory census']
+    sample = memdoc.get('sample') or {}
+    out.append(
+        f"  in_use {_fmt_bytes(sample.get('bytes_in_use'))}   "
+        f"live buffers {sample.get('live_buffers')}   "
+        f"live bytes {_fmt_bytes(sample.get('live_bytes'))}")
+    phases = memdoc.get('phases') or {}
+    if phases:
+        out.append(f"  {'phase':<24} {'calls':>6} {'high_water':>12} "
+                   f"{'max_delta':>12}")
+        for name, ph in sorted(phases.items(),
+                               key=lambda kv: -(kv[1].get('high_water')
+                                                or 0)):
+            out.append(
+                f"  {name[:24]:<24} {ph.get('calls') or 0:>6} "
+                f"{_fmt_bytes(ph.get('high_water')):>12} "
+                f"{_fmt_bytes(ph.get('max_delta')):>12}")
+    acts = sample.get('activation_bytes') or memdoc.get(
+        'activation_bytes') or {}
+    if acts:
+        out.append('  activation bytes (compiled-program temp buffers, '
+                   'XLA buffer assignment):')
+        for site, n in acts.items():
+            out.append(f"    {site:<24} {_fmt_bytes(n)}")
+    else:
+        out.append('  activation bytes: (none recorded — no AOT '
+                   'compile site ran)')
+    return '\n'.join(out)
+
+
+def _mem_selftest():
+    """CI smoke: phase brackets + an AOT compile -> activation-bytes
+    gauge -> renderer."""
+    _repo_root_on_path()
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.core import memory as mem
+    mem.reset()
+    with mem.phase('engine.init', census=True):
+        x = jnp.ones((64, 64))
+    exe = jax.jit(lambda a: (a @ a).sum()).lower(x).compile()
+    stats = mem.record_compiled_memory('selftest.step', exe)
+    assert stats and stats['activation_bytes'] >= 0, stats
+    assert mem.activation_bytes().get('selftest.step') == \
+        stats['activation_bytes']
+    s = mem.sample(count_buffers=True)
+    assert 'activation_bytes' in s and 'selftest.step' in \
+        s['activation_bytes'], s
+    doc = {'memory': {'sample': s, 'phases': mem.accountant().phases()}}
+    found = _find_mem(doc)
+    assert found is not None
+    text = render_mem(found)
+    assert 'activation bytes' in text and 'selftest.step' in text, text
+    print(text)
+    print('health_dump mem selftest: OK')
+    return 0
+
+
+def mem_main(argv):
+    ap = argparse.ArgumentParser(
+        prog='health_dump.py mem',
+        description='render the memory census (per-phase high water + '
+                    'compiled-program activation bytes) from a bench '
+                    'record (docs/performance.md#remat-policy)')
+    ap.add_argument('artifact', nargs='?',
+                    help='bench record / telemetry JSON')
+    ap.add_argument('--json', action='store_true')
+    ap.add_argument('--selftest', action='store_true')
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return _mem_selftest()
+    if not args.artifact:
+        ap.error('artifact path required (or --selftest)')
+    with open(args.artifact) as f:
+        doc = json.load(f)
+    memdoc = _find_mem(doc)
+    if memdoc is None:
+        raise ValueError(
+            'no memory census in this artifact (expected a bench record '
+            "with a 'memory' section — bench.py attaches one per leg)")
+    if args.json:
+        print(json.dumps(memdoc, indent=2))
+    else:
+        print(render_mem(memdoc))
+    return 0
+
+
 def main(argv=None):
     argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == 'mem':
+        return mem_main(argv[1:])
     if argv and argv[0] == 'numerics':
         return numerics_main(argv[1:])
     if argv and argv[0] == 'comm':

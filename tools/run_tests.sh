@@ -32,6 +32,13 @@
 #                      prefill->decode page-stream bit-equivalence,
 #                      mp-sharded engine equivalence, router counter
 #                      rendering + cross-replica trace merge
+#   --remat-selftest - activation economy (ISSUE 12): remat-policy
+#                      loss bit-identity (TrainStep/hybrid/pipeline) +
+#                      resolution units, sequence-parallel LayerNorm/
+#                      dropout sharding == replicated on the 8-dev
+#                      mesh, dropout-fused flash fwd+VJP parity vs the
+#                      dense reference, activation-byte census drop,
+#                      mem/pallas CLI smokes
 set -e
 cd "$(dirname "$0")/.."
 TIER="${1:-all}"
@@ -42,7 +49,7 @@ case "$TIER" in
             tests/test_numerics.py tests/test_bucketing.py \
             tests/test_fused_primitives.py tests/test_overlap.py \
             tests/test_serving.py tests/test_serving_trace.py \
-            tests/test_serving_cluster.py -q
+            tests/test_serving_cluster.py tests/test_remat.py -q
           # observability tooling smoke: tracer -> export -> summary CLI
           python tools/trace_summary.py --selftest
           # diagnostics smoke: flight recorder -> hang/OOM reports -> CLI
@@ -113,6 +120,13 @@ case "$TIER" in
           python -m pytest tests/test_serving_cluster.py -q
           python tools/health_dump.py cluster --selftest
           python tools/trace_summary.py --selftest ;;
+  --remat-selftest)
+          # tuned remat + sequence-parallel activations + dropout-fused
+          # flash (ISSUE 12), then the census/routing CLI smokes
+          XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+          python -m pytest tests/test_remat.py -q
+          python tools/health_dump.py mem --selftest
+          python tools/health_dump.py pallas --selftest ;;
   all)    python -m pytest tests/ -q
           python tools/trace_summary.py --selftest
           python tools/health_dump.py --selftest
@@ -120,6 +134,7 @@ case "$TIER" in
           python tools/health_dump.py comm --selftest
           python tools/health_dump.py serve --selftest
           python tools/health_dump.py cluster --selftest
-          python tools/health_dump.py pallas --selftest ;;
-  *) echo "usage: $0 [fast|dist|native|e2e|all|--comm-selftest|--serve-selftest|--quant-selftest|--pallas-selftest|--overlap-selftest|--cluster-selftest]"; exit 1 ;;
+          python tools/health_dump.py pallas --selftest
+          python tools/health_dump.py mem --selftest ;;
+  *) echo "usage: $0 [fast|dist|native|e2e|all|--comm-selftest|--serve-selftest|--quant-selftest|--pallas-selftest|--overlap-selftest|--cluster-selftest|--remat-selftest]"; exit 1 ;;
 esac
