@@ -196,6 +196,25 @@ class GradScaler:
         self.step(optimizer)
         return [], []
 
+    def update_from_found(self, found):
+        """Deferred found-inf accounting for the async engine path
+        (ISSUE 13, docs/performance.md#async-dispatch): one drained
+        step's verdict drives the dynamic-scale schedule, applied in
+        window-drain (= submission) order — the same sequence the
+        per-step path (`scaler._found_inf = ...; scaler._update()`)
+        applies for the scales actually dispatched, just read at the
+        drain point instead of blocking the dispatch hot loop. Note the
+        documented lag: a scale CHANGE only reaches steps dispatched
+        after its drain (up to `window` steps later than the per-step
+        path), so scale-induced overflows can resolve one window late.
+        The compiled step already skipped the update device-side; this
+        is only the host bookkeeping."""
+        if not self._enable:
+            return
+        self._found_inf = bool(found)
+        self._update()
+        self._publish_metrics(self._found_inf)
+
     def update(self):
         pass  # folded into step() like AmpScaler.minimize
 

@@ -32,6 +32,7 @@ from jax.experimental.shard_map import shard_map
 
 from ....core import rng as rng_mod
 from ....core import autograd
+from ....core import async_step as A_
 from ....core import bucketing as B
 from ....core.tensor import Tensor
 from ....jit import bind_arrays
@@ -53,7 +54,7 @@ def _param_spec(p, mesh_axes, zero_axis=None):
 from .meta_parallel_base import EngineTeardown
 
 
-class HybridParallelTrainStep(EngineTeardown):
+class HybridParallelTrainStep(A_.AsyncDispatchMixin, EngineTeardown):
     """Compile a full train step over the registered mesh.
 
     loss_fn(model, *batch) -> scalar loss Tensor. Batch tensors are sharded
@@ -70,7 +71,8 @@ class HybridParallelTrainStep(EngineTeardown):
                  use_buckets=None, comm_dtype=None, bucket_mb=None,
                  comm_block=None, comm_overlap=None, prefetch_depth=None,
                  comm_chunk=None, remat_policy=None,
-                 sequence_parallel=None):
+                 sequence_parallel=None, dispatch_window=None,
+                 device_lr=None):
         self.sp_shard_args = sp_shard_args
         self.model = model
         self.loss_fn = loss_fn
@@ -239,6 +241,24 @@ class HybridParallelTrainStep(EngineTeardown):
         self._exec = None
         self._closed = False
         self._step_count = 0
+
+        # -- async step pipeline (ISSUE 13,
+        # docs/performance.md#async-dispatch): bounded in-flight dispatch
+        # window + host-gap instrumentation + on-device LR schedule ------
+        self._inflight = A_.DispatchWindow(
+            A_.resolve_dispatch_window(dispatch_window))
+        self._gap = A_.HostGapMonitor('hybrid')
+        # batch input specs are init-time facts (DeviceLoader asks for
+        # them before the first dispatch)
+        self._sp_on = ('sp' in self.axes and self.sp > 1
+                       and getattr(model, '_supports_sequence_parallel',
+                                   False))
+        self._batch_axes = tuple(a for a in ('dp', 'sharding')
+                                 if a in self.axes
+                                 and self.mesh.shape[a] > 1)
+        from ....optimizer import device_lr as _dlr
+        self._lr = _dlr.LrFeed(optimizer, device_lr,
+                               place=lambda a: self._place(a, P()))
 
     def _init_flat_states(self):
         """Sharded flat optimizer state, one entry per bucket: vector
@@ -585,28 +605,13 @@ class HybridParallelTrainStep(EngineTeardown):
         # sequence sharding only for models that declare support (GPT sets
         # _supports_sequence_parallel; others would silently attend within
         # chunks) — the mesh may still carry an sp axis for other tensors.
-        sp_on = ('sp' in axes and self.sp > 1
-                 and getattr(self.model, '_supports_sequence_parallel',
-                             False))
+        sp_on = self._sp_on
         if 'sp' in axes and self.sp > 1 and not sp_on:
             raise ValueError(
                 "mesh has sp>1 but the model does not declare "
                 "_supports_sequence_parallel; sequence-sharding it would "
                 "silently train wrong")
-        # batch is data-parallel over BOTH 'dp' and 'sharding': ZeRO ranks
-        # ARE data-parallel ranks (parity: dygraph_sharding_optimizer.py:27
-        # shards the optimizer over the DP group) — replicating data over
-        # 'sharding' would buy state memory but zero throughput.
-        batch_axes = tuple(a for a in ('dp', 'sharding') if a in axes
-                           and self.mesh.shape[a] > 1)
-        dp_name = batch_axes if batch_axes else None
-        def _bspec(idx, nd):
-            shard_seq = sp_on and nd >= 2 and (
-                self.sp_shard_args is None or idx in self.sp_shard_args)
-            if shard_seq:
-                return P(dp_name, 'sp')
-            return P(dp_name) if dp_name else P()
-        batch_specs = tuple(_bspec(i, nd)
+        batch_specs = tuple(self._input_spec(i, nd)
                             for i, nd in enumerate(self._batch_ndims))
         self._batch_specs = batch_specs
         if self._overlap:
@@ -616,9 +621,24 @@ class HybridParallelTrainStep(EngineTeardown):
                                  for _ in self._layout.buckets]}
         else:
             pspecs = self._param_specs
+        # on-device LR schedule: the lr argument becomes a device int32
+        # step counter; the compiled step derives lr = fn(counter) and
+        # returns counter+1 — no per-step host LR compute or H2D feed
+        lr_fn = self._lr.fn
+        if lr_fn is not None:
+            base_step = step
+
+            def step(params, states, step_c, key, *batch):
+                out = base_step(params, states,
+                                lr_fn(step_c).astype(jnp.float32),
+                                key, *batch)
+                return out[:3] + (step_c + 1,) + out[3:]
+
         in_specs = (pspecs, self._state_specs, P(), P(),
                     *batch_specs)
         out_specs = (P(), pspecs, self._state_specs)
+        if lr_fn is not None:
+            out_specs = out_specs + (P(),)
         if taps_on:
             names = list(self._names)
             out_specs = out_specs + (_num.taps_spec(
@@ -651,7 +671,9 @@ class HybridParallelTrainStep(EngineTeardown):
         return np_.astype(p.dtype), ns
 
     # -- public ---------------------------------------------------------------
-    def __call__(self, *batch):
+    def _dispatch(self, batch):
+        """Dispatch one compiled step; returns an AsyncResult holding
+        the device-resident loss (+ taps) — no host fetch."""
         arrays = tuple(b.data if isinstance(b, Tensor) else jnp.asarray(b)
                        for b in batch)
         ddeg = self.dp * self.sharding_deg
@@ -663,13 +685,17 @@ class HybridParallelTrainStep(EngineTeardown):
                     f"{self.sharding_deg} = {ddeg} (ZeRO 'sharding' "
                     f"ranks are data-parallel ranks)")
         self._ensure_open()
+        # gap bracket opens BEFORE any jax client call (key fold-in, lr
+        # placement can serialize behind in-flight compute — that time
+        # belongs to the dispatch, not the inter-dispatch host gap)
+        self._gap.dispatch_begin()
         from ....core import memory as _mem
         first = self._compiled is None   # this dispatch will XLA-compile
         if self._compiled is None:
             self._batch_ndims = tuple(a.ndim for a in arrays)
             with _mem.phase('pipeline.build'):
                 self._compiled = self._build()
-        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        lr = self._lr.arg()
         key = rng_mod.next_key()
         p_arg = {'named': self._params, 'shards': self._param_shards} \
             if self._overlap else self._params
@@ -692,21 +718,60 @@ class HybridParallelTrainStep(EngineTeardown):
                     raise
                 self._exec = self._compiled
                 out = self._exec(*args)
-        if getattr(self, '_taps_on', False):
-            loss, p_out, self._states, taps = out
-        else:
-            loss, p_out, self._states = out
+        self._gap.dispatch_end(depth=len(self._inflight) + 1)
+        loss, p_out, self._states = out[:3]
+        i = 3
+        if self._lr.fn is not None:
+            self._lr.carry = out[i]
+            i += 1
+        taps = out[i] if getattr(self, '_taps_on', False) else None
         if self._overlap:
             self._params = p_out['named']
             self._param_shards = p_out['shards']
         else:
             self._params = p_out
-        if getattr(self, '_taps_on', False):
-            self._process_taps(taps, 'hybrid')
+        step_no = self._step_count
         self._step_count += 1
-        return Tensor(loss)
+        on_drain = None
+        if taps is not None:
+            def on_drain(res, _t=taps, _s=step_no):
+                self._process_taps(_t, 'hybrid', step=_s)
+        return A_.AsyncResult(loss, step_no, taps=taps,
+                              on_drain=on_drain, monitor=self._gap)
 
-    def _process_taps(self, taps, site):
+    def __call__(self, *batch):
+        if len(self._inflight):
+            # mixed APIs: drain queued async steps FIRST so deferred
+            # work (taps/scaler accounting) keeps submission order
+            self.flush()
+        res = self._dispatch(batch)
+        res.wait()     # legacy per-step semantics: taps processed now
+        return Tensor(res.loss)
+
+    def train_step(self, *batch):
+        """Async dispatch (docs/performance.md#async-dispatch): returns
+        an AsyncResult (device-resident loss, no host fetch); a bounded
+        in-flight window (PTPU_DISPATCH_WINDOW) lets the host run ahead,
+        draining the oldest step — and its deferred taps work — as the
+        window fills. `flush()` drains everything."""
+        return self._inflight.push(self._dispatch(batch))
+
+    # -- DeviceLoader contract ------------------------------------------------
+    def _input_spec(self, idx, nd):
+        dp_name = self._batch_axes if self._batch_axes else None
+        shard_seq = self._sp_on and nd >= 2 and (
+            self.sp_shard_args is None or idx in self.sp_shard_args)
+        if shard_seq:
+            return P(dp_name, 'sp')
+        return P(dp_name) if dp_name else P()
+
+    def input_sharding(self, index, ndim):
+        """NamedSharding for batch argument `index` — the spec the
+        compiled step expects, so DeviceLoader's background H2D lands
+        batches pre-sharded."""
+        return NamedSharding(self.mesh, self._input_spec(index, ndim))
+
+    def _process_taps(self, taps, site, step=None):
         """One host sync for the step's stats pytree; publishes
         ptpu_num_* gauges and raises NumericsError on nonfinite grads
         (FLAGS_check_nan_inf) naming the offending parameter."""
@@ -716,7 +781,8 @@ class HybridParallelTrainStep(EngineTeardown):
                 'params': {n: (p.data.shape, p.data.dtype)
                            for n, p in self._params_by_name.items()}}
         self.last_numerics = _num.process_jit_taps(
-            taps, site=site, step=self._step_count, meta=meta)
+            taps, site=site,
+            step=self._step_count if step is None else step, meta=meta)
 
     def _host_bucket_params(self):
         """{name: host array} for bucketed slots, reconstructed from
@@ -735,8 +801,11 @@ class HybridParallelTrainStep(EngineTeardown):
         return out
 
     def sync_model(self):
-        """Write updated params back into the eager Layer."""
+        """Write updated params back into the eager Layer. Drains the
+        async dispatch window first so every dispatched step is
+        reflected (docs/performance.md#async-dispatch drain semantics)."""
         self._ensure_open()
+        self.flush()
         for n, arr in self._params.items():
             self._params_by_name[n]._data = arr
         if self._overlap:
@@ -759,6 +828,7 @@ class HybridParallelTrainStep(EngineTeardown):
         versa."""
         import numpy as _np
         import jax as _jax
+        self.flush()        # checkpoints see every dispatched step
         out = {'params': {}, 'states': {}}
         for n, a in self._params.items():
             out['params'][n] = _np.asarray(_jax.device_get(a))
@@ -823,3 +893,8 @@ class HybridParallelTrainStep(EngineTeardown):
                         self._states['named'][n][k] = self._place(
                             v, self._state_specs['named'][n][k])
         self._step_count = sd.get('step', 0)
+        if self._lr.fn is not None:
+            # re-sync the device LR counter to the (restored) host
+            # scheduler's epoch — resume mid-schedule lands on the same
+            # lr the host path would feed next
+            self._lr.reset_carry()

@@ -51,6 +51,17 @@ class EngineTeardown:
             return _mem.sample(count_buffers=True)
         _fr.engine_teardown()    # a stale heartbeat after a deliberate
                                  # stop must not fire the hang watchdog
+        inflight = getattr(self, '_inflight', None)
+        if inflight is not None:
+            # drop (not drain) the async dispatch window: the results'
+            # device buffers must not outlive the engine
+            inflight.clear()
+        gap = getattr(self, '_gap', None)
+        if gap is not None:
+            # stop telemetry from reporting a dead engine's host-gap
+            # stats (host_snapshot walks the registry)
+            from ....core import async_step as _async_step
+            _async_step.unregister_monitor(gap)
         with _mem.phase('engine.shutdown'):
             self._compiled = None
             if hasattr(self, '_compiled_by_mode'):

@@ -40,6 +40,7 @@ from jax.experimental.shard_map import shard_map
 
 from ....core import rng as rng_mod
 from ....core import autograd
+from ....core import async_step as A_
 from ....core import bucketing as B
 from ....core.tensor import Tensor
 from ....jit import bind_arrays
@@ -166,7 +167,7 @@ def engine_from_pipeline_layer(pipeline_layer, optimizer, accumulate_steps,
 from .meta_parallel_base import EngineTeardown
 
 
-class SpmdPipelineEngine(EngineTeardown):
+class SpmdPipelineEngine(A_.AsyncDispatchMixin, EngineTeardown):
     """Pipelined hybrid train step.
 
     Args:
@@ -184,7 +185,8 @@ class SpmdPipelineEngine(EngineTeardown):
                  grad_accum_dtype='float32', memory_mode='stash',
                  use_buckets=None, comm_dtype=None, bucket_mb=None,
                  comm_block=None, comm_overlap=None, prefetch_depth=None,
-                 comm_chunk=None, remat_policy=None):
+                 comm_chunk=None, remat_policy=None,
+                 dispatch_window=None, device_lr=None):
         self.embed = embed
         self.blocks = blocks
         self.head = head
@@ -384,6 +386,15 @@ class SpmdPipelineEngine(EngineTeardown):
         self._compiled = None
         self._closed = False
         self._grad_clip = optimizer._grad_clip
+
+        # -- async step pipeline (ISSUE 13,
+        # docs/performance.md#async-dispatch) --------------------------------
+        self._inflight = A_.DispatchWindow(
+            A_.resolve_dispatch_window(dispatch_window))
+        self._gap = A_.HostGapMonitor('pipeline')
+        from ....optimizer import device_lr as _dlr
+        self._lr = _dlr.LrFeed(optimizer, device_lr,
+                               place=lambda a: self._place(a, P()))
 
     def _init_flat_states(self, stacked):
         """Flat sharded optimizer state per bucket. Every vector state is
@@ -848,10 +859,25 @@ class SpmdPipelineEngine(EngineTeardown):
         return loss, new_params, new_states, found_inf
 
     def _finalize(self, step, dp_on):
+        # on-device LR schedule: the lr slot carries a device int32
+        # step counter; the compiled step derives lr = fn(counter) and
+        # returns counter+1 (no per-step host LR compute or H2D feed)
+        lr_fn = self._lr.fn
+        if lr_fn is not None:
+            base_step = step
+
+            def step(params, states, step_c, scale, key, ii, ll):
+                out = base_step(params, states,
+                                lr_fn(step_c).astype(jnp.float32),
+                                scale, key, ii, ll)
+                return out[:4] + (step_c + 1,) + out[4:]
+
         dp_sp = P('dp') if dp_on else P()
         in_specs = (self._specs, self._state_specs, P(), P(), P(), dp_sp,
                     dp_sp)
         out_specs = (P(), self._specs, self._state_specs, P())
+        if lr_fn is not None:
+            out_specs = out_specs + (P(),)
         if getattr(self, '_taps_on', False):
             from ....core import numerics as _num
             # ALL trainable params (overlap mode keeps bucketed slots
@@ -1385,13 +1411,19 @@ class SpmdPipelineEngine(EngineTeardown):
         return np_.astype(p.dtype), ns
 
     # ------------------------------------------------------------------------
-    def train_batch(self, data, scale=None):
-        """data = (input_ids, labels) covering dp_degree × A × micro_bs.
-        `scale`: optional loss-scaling factor (fp16 GradScaler path); the
-        step unscales grads, skips the update on non-finite gradients,
-        and records `self.last_found_inf` for the scaler's dynamic
-        update."""
+    def _dispatch(self, data, scale=None, scaler=None):
+        """Dispatch one pipelined step; returns an AsyncResult holding
+        the device-resident loss + found-inf flag (+ taps). Deferred
+        drain work: taps processing and — when a GradScaler rides along
+        — its found-inf accounting, applied in submission order."""
         self._ensure_open()
+        # gap bracket opens BEFORE any jax client call (batch asarray,
+        # key fold-in, scale placement can serialize behind in-flight
+        # compute — dispatch time, not inter-dispatch host gap)
+        self._gap.dispatch_begin()
+        if scaler is not None and scale is None \
+                and scaler.is_enable():
+            scale = scaler._scale
         input_ids, labels = data
         ii = input_ids.data if isinstance(input_ids, Tensor) \
             else jnp.asarray(input_ids)
@@ -1420,7 +1452,7 @@ class SpmdPipelineEngine(EngineTeardown):
                         _mem.phase('pipeline.build'):
                     self._compiled = self._build()
                 self._compiled_by_mode[want_scaling] = self._compiled
-        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        lr = self._lr.arg()
         sc = jnp.asarray(1.0 if scale is None else float(scale),
                          jnp.float32)
         key = rng_mod.next_key()
@@ -1454,17 +1486,69 @@ class SpmdPipelineEngine(EngineTeardown):
                     raise
                 self._exec_by_mode[want_scaling] = self._compiled
                 out = self._compiled(*args)
-        self._pp_step = getattr(self, '_pp_step', 0) + 1
-        if self._taps_on:
-            loss, self._params, self._states, found, taps = out
-            found = self._process_taps(taps, found)
-        else:
-            loss, self._params, self._states, found = out
+        self._gap.dispatch_end(depth=len(self._inflight) + 1)
+        step_no = self._pp_step = getattr(self, '_pp_step', 0) + 1
+        loss, self._params, self._states, found = out[:4]
+        i = 4
+        if self._lr.fn is not None:
+            self._lr.carry = out[i]
+            i += 1
+        taps = out[i] if self._taps_on else None
         self._warm_modes.add(want_scaling)
         self.last_found_inf = found
-        return Tensor(loss)
+        on_drain = None
+        if taps is not None or scaler is not None:
+            def on_drain(res, _t=taps, _s=step_no, _scaler=scaler):
+                found_host = None
+                if _t is not None:
+                    found_host = self._process_taps(res.found_inf, _t,
+                                                    step=_s)
+                    self.last_found_inf = found_host
+                if _scaler is not None:
+                    if found_host is None:
+                        from ....core import numerics as _num
+                        found_host = bool(np.asarray(
+                            _num._host_fetch(res.found_inf)))
+                    # deferred found-inf accounting (ISSUE 13): same
+                    # sequence the per-step path applies, at drain
+                    _scaler.update_from_found(bool(found_host))
+        return A_.AsyncResult(loss, step_no, found_inf=found, taps=taps,
+                              on_drain=on_drain, monitor=self._gap)
 
-    def _process_taps(self, taps, found):
+    def train_batch(self, data, scale=None):
+        """data = (input_ids, labels) covering dp_degree × A × micro_bs.
+        `scale`: optional loss-scaling factor (fp16 GradScaler path); the
+        step unscales grads, skips the update on non-finite gradients,
+        and records `self.last_found_inf` for the scaler's dynamic
+        update."""
+        if len(self._inflight):
+            # mixed APIs: drain queued async steps FIRST so deferred
+            # work (taps/scaler accounting) keeps submission order
+            self.flush()
+        res = self._dispatch(data, scale=scale)
+        res.wait()     # legacy per-step semantics (taps processed now)
+        return Tensor(res.loss)
+
+    def train_step(self, data, scaler=None):
+        """Async dispatch (docs/performance.md#async-dispatch): returns
+        an AsyncResult with the device-resident loss and found-inf flag
+        — no host fetch. A GradScaler passed here has its found-inf read
+        and dynamic-scale update deferred to the window-drain point, in
+        submission order: the skip accounting is exact for the scales
+        actually dispatched, but a scale CHANGE only reaches steps
+        dispatched after its drain (up to `window` steps later than the
+        per-step path — scale-induced overflows can therefore resolve
+        one window later; docs/performance.md#async-dispatch).
+        `flush()` drains everything."""
+        return self._inflight.push(self._dispatch(data, scaler=scaler))
+
+    def input_sharding(self, index, ndim):
+        """DeviceLoader contract: batch tensors are dp-sharded on axis 0
+        (replicated when dp=1)."""
+        dp_on = 'dp' in self.axes and self.mesh.shape['dp'] > 1
+        return NamedSharding(self.mesh, P('dp') if dp_on else P())
+
+    def _process_taps(self, found, taps, step=None):
         """Fetch found_inf + the taps pytree in ONE host sync; returns
         the host-side found flag for last_found_inf."""
         from ....core import numerics as _num
@@ -1483,12 +1567,15 @@ class SpmdPipelineEngine(EngineTeardown):
         meta = {kind: dict(self._tap_shapes)
                 for kind in ('grads', 'params')}
         self.last_numerics = _num.process_jit_taps(
-            taps, site='pipeline', step=getattr(self, '_pp_step', None),
+            taps, site='pipeline',
+            step=getattr(self, '_pp_step', None) if step is None
+            else step,
             meta=meta)
         return found_host
 
     def sync_model(self):
         self._ensure_open()
+        self.flush()    # every dispatched step lands before the copy-out
         for n, p in self._embed_named:
             if n in self._params['embed']:
                 p._data = self._params['embed'][n]

@@ -517,3 +517,8 @@ class DataLoader:
 
 def get_worker_info():
     return None
+
+
+# device-side input prefetch (ISSUE 13): background-thread H2D staging
+# onto the mesh, overlapping batch t+1's transfer with step t's compute
+from .device_loader import DeviceLoader  # noqa: E402

@@ -99,7 +99,10 @@ def write_back(layer, param_arrays=None, buffer_arrays=None):
                 lookup[n]._data = arr
 
 
-class TrainStep:
+from ..core.async_step import AsyncDispatchMixin as _AsyncDispatchMixin
+
+
+class TrainStep(_AsyncDispatchMixin):
     """One fully-jitted train step: forward, backward, clip, optimizer.
 
     loss_fn(model, *batch_tensors) -> scalar loss Tensor.
@@ -107,7 +110,9 @@ class TrainStep:
 
     def __init__(self, model, loss_fn, optimizer, donate=True,
                  use_buckets=None, comm_overlap=None, prefetch_depth=None,
-                 comm_chunk=None, remat_policy=None):
+                 comm_chunk=None, remat_policy=None, dispatch_window=None,
+                 device_lr=None):
+        from ..core import async_step as A_
         from ..core import bucketing as B
         self.model = model
         self.loss_fn = loss_fn
@@ -182,6 +187,14 @@ class TrainStep:
         # the compiled step's output tree, so set FLAGS before building
         from ..core import numerics as _num
         self._taps_on = _num.taps_enabled()
+        # -- async step pipeline (ISSUE 13,
+        # docs/performance.md#async-dispatch): bounded in-flight window,
+        # host-gap instrumentation, on-device LR schedule ----------------
+        self._inflight = A_.DispatchWindow(
+            A_.resolve_dispatch_window(dispatch_window))
+        self._gap = A_.HostGapMonitor('jit')
+        from ..optimizer import device_lr as _dlr
+        self._lr = _dlr.LrFeed(optimizer, device_lr)
         self._compiled = jax.jit(
             self._step,
             donate_argnums=(0, 1, 2) if donate else ())
@@ -190,6 +203,13 @@ class TrainStep:
 
     def _step(self, params, buffers, opt_states, lr, key, batch):
         model, loss_fn, opt = self.model, self.loss_fn, self.optimizer
+        # on-device LR schedule: `lr` carries the device int32 step
+        # counter; the traced schedule derives this step's lr and the
+        # incremented counter rides out as an extra output
+        step_c = None
+        if self._lr.fn is not None:
+            step_c = lr
+            lr = self._lr.fn(step_c).astype(jnp.float32)
 
         def loss_of(ps, bufs):
             with bind_arrays(model, ps, bufs) as out_bufs:
@@ -210,21 +230,28 @@ class TrainStep:
         else:
             new_params, new_states = opt.functional_apply(params, grads,
                                                           opt_states, lr)
+        out = (loss, new_params, new_buffers, new_states)
+        if step_c is not None:
+            out = out + (step_c + 1,)
         if self._taps_on:
             from ..core import numerics as _num
             taps = _num.jit_taps(grads, new_params)
-            return loss, new_params, new_buffers, new_states, taps
-        return loss, new_params, new_buffers, new_states
+            return out + (taps,)
+        return out
 
-    def __call__(self, *batch):
+    def _dispatch(self, batch):
         from .. import profiler as _prof
+        from ..core import async_step as A_
         from ..core.monitor import stat_add
+        # gap bracket opens BEFORE any jax client call (asarray/key
+        # fold-in can serialize behind in-flight compute — dispatch
+        # time, not inter-dispatch host gap)
+        self._gap.dispatch_begin()
         arrays = tuple(b.data if isinstance(b, Tensor) else jnp.asarray(b)
                        for b in batch)
-        lr = self.optimizer.get_lr()
         key = rng_mod.next_key()
         args = (self._params, self._buffers, self._opt_states,
-                jnp.asarray(lr, jnp.float32), key, arrays)
+                self._lr.arg(), key, arrays)
         sig = tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
         exe = self._exec_cache.get(sig)
         if exe is None:
@@ -245,22 +272,51 @@ class TrainStep:
                     raise
                 self._exec_cache[sig] = self._compiled
                 out = self._compiled(*args)
-        if self._taps_on:
-            (loss, self._params, self._buffers, self._opt_states,
-             taps) = out
-            from ..core import numerics as _num
-            meta = {k: {n: (a.shape, a.dtype)
-                        for n, a in self._params.items()}
-                    for k in ('grads', 'params')}
-            self.last_numerics = _num.process_jit_taps(
-                taps, site='jit', step=self._step_i, meta=meta)
-        else:
-            loss, self._params, self._buffers, self._opt_states = out
+        self._gap.dispatch_end(depth=len(self._inflight) + 1)
+        loss, self._params, self._buffers, self._opt_states = out[:4]
+        i = 4
+        if self._lr.fn is not None:
+            self._lr.carry = out[i]
+            i += 1
+        taps = out[i] if self._taps_on else None
+        step_no = self._step_i
         self._step_i += 1
-        return Tensor(loss)
+        on_drain = None
+        if taps is not None:
+            def on_drain(res, _t=taps, _s=step_no):
+                from ..core import numerics as _num
+                meta = {k: {n: (a.shape, a.dtype)
+                            for n, a in self._params.items()}
+                        for k in ('grads', 'params')}
+                self.last_numerics = _num.process_jit_taps(
+                    _t, site='jit', step=_s, meta=meta)
+        return A_.AsyncResult(loss, step_no, taps=taps,
+                              on_drain=on_drain, monitor=self._gap)
+
+    def __call__(self, *batch):
+        if len(self._inflight):
+            # mixed APIs: drain queued async steps FIRST so deferred
+            # work (taps processing) keeps submission order
+            self.flush()
+        res = self._dispatch(batch)
+        res.wait()     # legacy per-step semantics: taps processed now
+        return Tensor(res.loss)
+
+    def train_step(self, *batch):
+        """Async dispatch (docs/performance.md#async-dispatch): returns
+        an AsyncResult; the bounded in-flight window
+        (PTPU_DISPATCH_WINDOW) drains the oldest step as it fills."""
+        return self._inflight.push(self._dispatch(batch))
+
+    def input_sharding(self, index, ndim):
+        """DeviceLoader contract: single-program step — batches go to
+        the default device whole."""
+        return None
 
     def sync_model(self):
-        """Write jitted state back into the eager Layer (for save/eval)."""
+        """Write jitted state back into the eager Layer (for save/eval).
+        Drains the async dispatch window first."""
+        self.flush()
         write_back(self.model, self._params, self._buffers)
 
     # -- multi-step: k steps per dispatch (amortizes host→device launch; on
@@ -270,33 +326,45 @@ class TrainStep:
         if getattr(self, '_multi', None) is not None:
             return  # jax.jit caches per input shape — one jit covers all k
         step = self._step
+        device_lr = self._lr.fn is not None
 
         def many(params, buffers, opt_states, lr, keys, batch_stack):
             def body(carry, xs):
-                p, b, s = carry
+                p, b, s, c = carry
                 key = xs[0]
                 batch = xs[1]
-                # [:4] drops the numerics taps when enabled (per-step
-                # taps don't escape a scanned multi-step; XLA DCEs them)
-                loss, p2, b2, s2 = step(p, b, s, lr, key, batch)[:4]
-                return (p2, b2, s2), loss
-            (p, b, s), losses = jax.lax.scan(
-                body, (params, buffers, opt_states), (keys, batch_stack))
-            return losses, p, b, s
+                # trailing outputs (numerics taps) don't escape a
+                # scanned multi-step; XLA DCEs them. Under on-device LR
+                # the step counter advances through the scan carry.
+                out = step(p, b, s, c, key, batch)
+                c2 = out[4] if device_lr else c
+                return (out[1], out[2], out[3], c2), out[0]
+            (p, b, s, c), losses = jax.lax.scan(
+                body, (params, buffers, opt_states, lr),
+                (keys, batch_stack))
+            return losses, p, b, s, c
 
         self._multi = jax.jit(many, donate_argnums=(0, 1, 2))
 
     def run_steps(self, *batch_stacks):
         """Each arg: array with leading dim k (one slice per step). Returns
         the k per-step losses as one Tensor."""
+        if len(self._inflight):
+            # mixed APIs: drain queued async steps FIRST so deferred
+            # work keeps submission order (same rule as __call__)
+            self.flush()
         arrays = tuple(b.data if isinstance(b, Tensor) else jnp.asarray(b)
                        for b in batch_stacks)
         k = arrays[0].shape[0]
         self.compile_multi_step()
-        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        lr = self._lr.arg()
         keys = jax.random.split(rng_mod.next_key(), k)
-        losses, self._params, self._buffers, self._opt_states = self._multi(
-            self._params, self._buffers, self._opt_states, lr, keys, arrays)
+        (losses, self._params, self._buffers, self._opt_states,
+         lr_out) = self._multi(
+            self._params, self._buffers, self._opt_states, lr, keys,
+            arrays)
+        if self._lr.fn is not None:
+            self._lr.carry = lr_out
         self._step_i += k
         return Tensor(losses)
 

@@ -1,0 +1,228 @@
+"""On-device LR schedules (ISSUE 13, docs/performance.md#async-dispatch).
+
+The compiled engines historically fed `optimizer.get_lr()` to the device
+as a fresh fp32 scalar every step — a per-step host compute + H2D feed
+in the dispatch hot path. For the common schedulers (constant, linear
+warmup+decay, cosine, inverse-sqrt/Noam, polynomial/exponential decays)
+the schedule is a pure function of the step index, so it traces directly
+into the compiled step as `lr = fn(step_counter)` where the counter is a
+device-resident int32 carried (and incremented) by the step itself — no
+per-step host work at all.
+
+`device_lr_fn(schedule)` returns that traceable fn, or None for
+schedules whose value depends on host-side state (ReduceOnPlateau,
+LambdaDecay, user subclasses...) — those keep the legacy scalar-feed
+path. Exact-type checks on purpose: a subclass overriding `get_lr()`
+must fall back to the host feed, not silently trace the parent's rule.
+
+The host mirror: `get_lr()` keeps reporting the host scheduler's value
+(the user still drives `scheduler.step()`); the device counter starts
+from `scheduler.last_epoch` at engine build / `set_state_dict`, so both
+agree whenever the loop steps the scheduler once per train step (the
+opt-in contract — see core/async_step.resolve_device_lr).
+"""
+import math
+
+from .lr import (LRScheduler, NoamDecay, CosineAnnealingDecay,
+                 PolynomialDecay, LinearWarmup, InverseTimeDecay,
+                 ExponentialDecay, NaturalExpDecay, StepDecay,
+                 MultiStepDecay)
+
+
+def lr_epoch(schedule):
+    """The device counter's start value: the host scheduler's current
+    epoch (schedulers step() once at init, so a fresh one sits at 0)."""
+    return max(int(getattr(schedule, 'last_epoch', 0)), 0)
+
+
+class LrFeed:
+    """Dispatch-side LR plumbing shared by the three compiled engines.
+
+    Resolves the on-device-LR knob against the optimizer's schedule and
+    then serves the lr slot's dispatch argument with zero per-step host
+    work: the device int32 step counter under on-device LR (`fn` set;
+    the compiled step returns it incremented — engines write it back to
+    `carry`), else a cached device scalar re-placed only when
+    `get_lr()` changed (feed-on-change — a constant lr feeds exactly
+    once). `place` is the engine's device-placement callable (mesh
+    engines replicate via their `_place`; the single-program step uses
+    plain `jnp.asarray`).
+    """
+
+    def __init__(self, optimizer, flag=None, place=None):
+        from ..core.async_step import resolve_device_lr
+        self._optimizer = optimizer
+        self._place = place
+        sched = optimizer._learning_rate
+        self.fn = None
+        if isinstance(sched, LRScheduler) and resolve_device_lr(flag):
+            self.fn = device_lr_fn(sched)
+        self.carry = None       # device int32 step counter (device LR)
+        self._host = None       # feed-on-change cache (legacy path)
+        self._dev = None
+
+    def _put(self, value, dtype):
+        import numpy as np
+        import jax.numpy as jnp
+        arr = np.asarray(value, dtype)
+        return self._place(arr) if self._place is not None \
+            else jnp.asarray(arr)
+
+    def arg(self):
+        import numpy as np
+        if self.fn is not None:
+            if self.carry is None:
+                self.reset_carry()
+            return self.carry
+        v = float(self._optimizer.get_lr())
+        if self._dev is None or v != self._host:
+            self._host = v
+            self._dev = self._put(v, np.float32)
+        return self._dev
+
+    def reset_carry(self):
+        """(Re)sync the device step counter to the host scheduler's
+        current epoch (engine build, set_state_dict) — resume
+        mid-schedule lands on the lr the host path would feed next."""
+        import numpy as np
+        self.carry = self._put(lr_epoch(self._optimizer._learning_rate),
+                               np.int32)
+
+
+def describe(schedule):
+    if isinstance(schedule, (int, float)):
+        return 'constant'
+    return type(schedule).__name__
+
+
+def device_lr_fn(schedule):
+    """Traceable fp32 `fn(step_int32) -> lr` for `schedule`, or None.
+
+    All math runs in fp32 jnp ops, so the value is deterministic across
+    dispatches (the windowed-vs-sync bit-identity bar); it matches the
+    host's float64 compute to fp32 rounding (~1e-7 rel), which is the
+    documented equivalence, not bit equality.
+    """
+    import jax.numpy as jnp
+
+    if isinstance(schedule, (int, float)):
+        v = float(schedule)
+
+        def const_fn(step):
+            return jnp.full((), v, jnp.float32)
+        return const_fn
+
+    if not isinstance(schedule, LRScheduler):
+        return None
+
+    t = type(schedule)
+    if t is NoamDecay:
+        base = float(schedule.base_lr)
+        d = float(schedule.d_model)
+        warm = float(schedule.warmup_steps)
+
+        def noam_fn(step):
+            s = step.astype(jnp.float32)
+            a = jnp.where(s > 0, s, 1.0) ** -0.5
+            b = warm ** -1.5 * s
+            lr = base * (d ** -0.5) * jnp.minimum(a, b)
+            return jnp.where(s == 0, 0.0, lr).astype(jnp.float32)
+        return noam_fn
+
+    if t is CosineAnnealingDecay:
+        base = float(schedule.base_lr)
+        eta = float(schedule.eta_min)
+        tmax = float(schedule.T_max)
+
+        def cos_fn(step):
+            s = step.astype(jnp.float32)
+            return (eta + (base - eta)
+                    * (1.0 + jnp.cos(math.pi * s / tmax)) / 2.0) \
+                .astype(jnp.float32)
+        return cos_fn
+
+    if t is PolynomialDecay:
+        base = float(schedule.base_lr)
+        end = float(schedule.end_lr)
+        decay = float(schedule.decay_steps)
+        power = float(schedule.power)
+        cycle = bool(schedule.cycle)
+
+        def poly_fn(step):
+            s = step.astype(jnp.float32)
+            if cycle:
+                div = jnp.where(s > 0, jnp.ceil(s / decay), 1.0)
+                ds = decay * jnp.maximum(div, 1.0)
+            else:
+                ds = jnp.full((), decay, jnp.float32)
+                s = jnp.minimum(s, ds)
+            return ((base - end) * (1.0 - s / ds) ** power + end) \
+                .astype(jnp.float32)
+        return poly_fn
+
+    if t is InverseTimeDecay:
+        base = float(schedule.base_lr)
+        gamma = float(schedule.gamma)
+
+        def inv_fn(step):
+            s = step.astype(jnp.float32)
+            return (base / (1.0 + gamma * s)).astype(jnp.float32)
+        return inv_fn
+
+    if t is ExponentialDecay:
+        base = float(schedule.base_lr)
+        gamma = float(schedule.gamma)
+
+        def exp_fn(step):
+            s = step.astype(jnp.float32)
+            return (base * gamma ** s).astype(jnp.float32)
+        return exp_fn
+
+    if t is NaturalExpDecay:
+        base = float(schedule.base_lr)
+        gamma = float(schedule.gamma)
+
+        def nexp_fn(step):
+            s = step.astype(jnp.float32)
+            return (base * jnp.exp(-gamma * s)).astype(jnp.float32)
+        return nexp_fn
+
+    if t is StepDecay:
+        base = float(schedule.base_lr)
+        gamma = float(schedule.gamma)
+        size = int(schedule.step_size)
+
+        def stepdecay_fn(step):
+            n = (step // size).astype(jnp.float32)
+            return (base * gamma ** n).astype(jnp.float32)
+        return stepdecay_fn
+
+    if t is MultiStepDecay:
+        base = float(schedule.base_lr)
+        gamma = float(schedule.gamma)
+        miles = [int(m) for m in schedule.milestones]
+
+        def multistep_fn(step):
+            n = sum((step >= m).astype(jnp.float32) for m in miles)
+            return (base * gamma ** n).astype(jnp.float32)
+        return multistep_fn
+
+    if t is LinearWarmup:
+        # linear warmup into a constant or any traceable inner schedule
+        # (the "linear warmup + decay" composition)
+        inner = device_lr_fn(schedule.lr)
+        if inner is None:
+            return None
+        warm = int(schedule.warmup_steps)
+        start = float(schedule.start_lr)
+        end = float(schedule.end_lr)
+
+        def warmup_fn(step):
+            s = step.astype(jnp.float32)
+            ramp = (end - start) * s / max(warm, 1) + start
+            after = inner(jnp.maximum(step - warm, 0))
+            return jnp.where(step < warm, ramp, after) \
+                .astype(jnp.float32)
+        return warmup_fn
+
+    return None

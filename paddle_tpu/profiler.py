@@ -854,6 +854,17 @@ class StepTelemetry:
             snap['pallas'] = _scaffold.snapshot()
         except Exception:
             snap['pallas'] = None
+        # async step pipeline (ptpu_host_* gauges): per-site dispatch
+        # gap/depth + host_bound_fraction and DeviceLoader prefetch
+        # totals — docs/performance.md#async-dispatch
+        try:
+            from .core import async_step as _async_step
+            host = _async_step.host_snapshot()
+            snap['host'] = host if (host.get('sites')
+                                    or host['prefetch']['batches']) \
+                else None
+        except Exception:
+            snap['host'] = None
         # tuned-remat view (ptpu_remat_* gauges/counters): active policy
         # per engine + checkpoint_name boundary counts, beside the
         # per-site activation-byte census — docs/performance.md#remat-policy

@@ -1,0 +1,636 @@
+"""Async step pipeline (ISSUE 13, docs/performance.md#async-dispatch).
+
+Covers the DeviceLoader's sharded background prefetch (spec correctness
+on the 8-dev mesh, staging-ring reuse without aliasing under donation),
+the engines' windowed dispatch (window=1 == window=4 loss bit-identity
+on all three engines, zero per-step host syncs in the fp32 hot loop via
+the PR-3 sync-count harness), on-device LR schedules (traceable-fn vs
+host get_lr equivalence incl. resume from state_dict mid-schedule), and
+the GradScaler's deferred found-inf accounting (a NaN at step k skips
+exactly step k's update with window=2, scaler state identical to the
+per-step path).
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P, NamedSharding
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.core import async_step as A
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed import topology_runtime
+from paddle_tpu.io import DeviceLoader
+import paddle_tpu.distributed.fleet as fm
+
+
+def _mesh(axes, sizes):
+    fm.fleet._hcg = None
+    return topology_runtime.build_mesh(axes, sizes)
+
+
+def _mlp():
+    return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+
+
+def _mlp_loss(m, x, y):
+    return nn.functional.cross_entropy(m(x), y)
+
+
+def _batches(n, b=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return [(rng.rand(b, 8).astype('float32'),
+             rng.randint(0, 4, (b,)).astype('int64')) for _ in range(n)]
+
+
+class TestKnobs:
+    def test_dispatch_window_resolution(self, monkeypatch):
+        monkeypatch.delenv('PTPU_DISPATCH_WINDOW', raising=False)
+        assert A.resolve_dispatch_window() == 2
+        monkeypatch.setenv('PTPU_DISPATCH_WINDOW', '5')
+        assert A.resolve_dispatch_window() == 5
+        assert A.resolve_dispatch_window(3) == 3     # kwarg beats env
+        assert A.resolve_dispatch_window(0) == 1     # clamped
+
+    def test_prefetch_depth_resolution(self, monkeypatch):
+        monkeypatch.delenv('PTPU_DEVICE_PREFETCH', raising=False)
+        assert A.resolve_prefetch_depth() == 2
+        monkeypatch.setenv('PTPU_DEVICE_PREFETCH', '3')
+        assert A.resolve_prefetch_depth() == 3
+        assert A.resolve_prefetch_depth(1) == 1
+
+    def test_device_lr_resolution(self, monkeypatch):
+        monkeypatch.delenv('PTPU_DEVICE_LR', raising=False)
+        assert A.resolve_device_lr() is False        # opt-in
+        monkeypatch.setenv('PTPU_DEVICE_LR', '1')
+        assert A.resolve_device_lr() is True
+        assert A.resolve_device_lr(False) is False   # kwarg beats env
+
+
+class TestDeviceLoader:
+    def test_sharded_prefetch_dp2_mp2(self):
+        """dp2×mp2 mesh: batches land dp-sharded on axis 0, replicated
+        over mp — the hybrid engine's input spec."""
+        mesh = _mesh(['dp', 'mp'], [2, 2])
+        batches = _batches(3)
+        loader = DeviceLoader(batches, mesh=mesh,
+                              specs=[P('dp'), P('dp')])
+        got = list(loader)
+        assert len(got) == 3
+        for (hx, hy), (dx, dy) in zip(batches, got):
+            assert dx.sharding.is_equivalent_to(
+                NamedSharding(mesh, P('dp')), dx.ndim)
+            np.testing.assert_array_equal(np.asarray(jax.device_get(dx)),
+                                          hx)
+            np.testing.assert_array_equal(np.asarray(jax.device_get(dy)),
+                                          hy)
+            # dp shards are halves; mp replicas see the same rows
+            shards = {d.device.id: np.asarray(d.data)
+                      for d in dx.addressable_shards}
+            assert all(s.shape[0] == hx.shape[0] // 2
+                       for s in shards.values())
+
+    def test_engine_spec_sources(self):
+        """input_sharding contract across the three engines."""
+        from paddle_tpu.distributed.fleet.meta_parallel.hybrid_engine \
+            import HybridParallelTrainStep
+        from paddle_tpu.jit import TrainStep
+        mesh = _mesh(['dp', 'sharding'], [2, 2])
+        paddle.seed(0)
+        m = _mlp()
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=m.parameters())
+        eng = HybridParallelTrainStep(m, _mlp_loss, opt)
+        sh = eng.input_sharding(0, 2)
+        assert sh.is_equivalent_to(
+            NamedSharding(mesh, P(('dp', 'sharding'))), 2)
+        eng.shutdown()
+        step = TrainStep(_mlp(), _mlp_loss, paddle.optimizer.SGD(
+            learning_rate=0.1, parameters=m.parameters()))
+        assert step.input_sharding(0, 2) is None
+
+    def test_pipeline_spec(self):
+        mesh = _mesh(['dp', 'pp'], [2, 2])
+        batches = _batches(2)
+        loader = DeviceLoader(batches, mesh=mesh, specs=[P('dp'), P()])
+        (dx, dy) = next(iter(loader))
+        assert dx.sharding.is_equivalent_to(
+            NamedSharding(mesh, P('dp')), dx.ndim)
+        assert dy.sharding.is_equivalent_to(
+            NamedSharding(mesh, P()), dy.ndim)
+
+    def test_staging_ring_reuse_no_aliasing(self):
+        """More batches than ring slots: the wrap reuses staging buffers
+        but must never mutate a batch already delivered (the delivered
+        arrays may sit in a donating engine's in-flight window)."""
+        _mesh(['dp'], [2])
+        batches = _batches(7, seed=3)
+        loader = DeviceLoader(batches, depth=2)   # ring of 3 slots
+        got = list(loader)
+        st = loader.stats()
+        assert st['batches'] == 7
+        assert st['ring_reuses'] >= 4             # the ring really wrapped
+        assert st['h2d_bytes'] > 0
+        for (hx, hy), (dx, dy) in zip(batches, got):
+            np.testing.assert_array_equal(np.asarray(jax.device_get(dx)),
+                                          hx)
+            np.testing.assert_array_equal(np.asarray(jax.device_get(dy)),
+                                          hy)
+
+    def test_reiteration_after_early_break(self):
+        """An abandoned iteration's producer must stop (not race the
+        next iteration's producer on the shared staging ring): break
+        early, then re-iterate the same loader and get clean batches."""
+        batches = _batches(6, seed=5)
+        loader = DeviceLoader(batches, depth=2)
+        for i, b in enumerate(loader):
+            if i == 1:
+                break
+        got = list(loader)            # fresh full iteration
+        assert len(got) == 6
+        for (hx, hy), (dx, dy) in zip(batches, got):
+            np.testing.assert_array_equal(np.asarray(jax.device_get(dx)),
+                                          hx)
+            np.testing.assert_array_equal(np.asarray(jax.device_get(dy)),
+                                          hy)
+
+    def test_close_unblocks_waiting_consumer(self):
+        """close() from another thread must end a consumer blocked on
+        an empty prefetch queue instead of deadlocking it (the stop
+        signal suppresses the producer's sentinel)."""
+        import threading
+        import time as _t
+        release = threading.Event()
+
+        def slow_gen():
+            yield _batches(1, seed=9)[0]
+            release.wait(10)       # upstream stalls until released
+
+        loader = DeviceLoader(slow_gen())
+        it = iter(loader)
+        next(it)
+        done = threading.Event()
+
+        def consume():
+            list(it)               # blocks: upstream never yields again
+            done.set()
+        th = threading.Thread(target=consume, daemon=True)
+        th.start()
+        _t.sleep(0.3)
+        loader.close()
+        release.set()              # let the stalled producer exit too
+        assert done.wait(timeout=5), 'consumer deadlocked after close()'
+
+    def test_upstream_error_surfaces(self):
+        def gen():
+            yield _batches(1)[0]
+            raise RuntimeError('boom')
+        loader = DeviceLoader(gen())
+        it = iter(loader)
+        next(it)
+        with pytest.raises(RuntimeError, match='boom'):
+            list(it)
+
+
+class TestWindowedBitIdentity:
+    """fp32 windowed loop (DeviceLoader on) produces a loss sequence
+    bit-identical to the synchronous loop — window changes when the host
+    looks, not what the device computes."""
+
+    N = 5
+
+    def _run_jit(self, window):
+        paddle.seed(0)
+        from paddle_tpu.jit import TrainStep
+        m = _mlp()
+        opt = paddle.optimizer.Adam(parameters=m.parameters(),
+                                    learning_rate=1e-2)
+        step = TrainStep(m, _mlp_loss, opt, dispatch_window=window)
+        data = _batches(self.N)
+        if window is None:
+            return [float(step(Tensor(x), Tensor(y))) for x, y in data]
+        loader = DeviceLoader(data, engine=step)
+        rs = [step.train_step(*b) for b in loader]
+        step.flush()
+        return [r.result() for r in rs]
+
+    def test_jit_trainstep(self):
+        sync = self._run_jit(None)
+        w1 = self._run_jit(1)
+        w4 = self._run_jit(4)
+        assert sync == w1 == w4
+
+    def _run_hybrid(self, window):
+        from paddle_tpu.distributed.fleet.meta_parallel.hybrid_engine \
+            import HybridParallelTrainStep
+        _mesh(['dp', 'sharding'], [2, 2])
+        paddle.seed(0)
+        m = _mlp()
+        opt = paddle.optimizer.Adam(parameters=m.parameters(),
+                                    learning_rate=1e-2)
+        eng = HybridParallelTrainStep(m, _mlp_loss, opt,
+                                      dispatch_window=window)
+        data = _batches(self.N)
+        try:
+            if window is None:
+                return [float(eng(Tensor(x), Tensor(y)))
+                        for x, y in data]
+            loader = DeviceLoader(data, engine=eng)
+            rs = [eng.train_step(*b) for b in loader]
+            eng.flush()
+            return [r.result() for r in rs]
+        finally:
+            eng.shutdown()
+
+    def test_hybrid(self):
+        sync = self._run_hybrid(None)
+        w1 = self._run_hybrid(1)
+        w4 = self._run_hybrid(4)
+        assert sync == w1 == w4
+
+    def _run_pipeline(self, window):
+        from paddle_tpu.models.gpt import GPTConfig, build_gpt_pipeline
+        from paddle_tpu.distributed.fleet.meta_parallel.spmd_pipeline \
+            import SpmdPipelineEngine
+        _mesh(['dp', 'pp'], [1, 2])
+        paddle.seed(5)
+        cfg = GPTConfig(vocab_size=64, hidden_size=16, num_layers=2,
+                        num_heads=2, max_seq_len=32, hidden_dropout=0.0,
+                        attn_dropout=0.0, use_flash_attention=False)
+        embed, blocks, head = build_gpt_pipeline(cfg)
+        opt = paddle.optimizer.SGD(learning_rate=1e-2, parameters=[])
+        eng = SpmdPipelineEngine(embed, blocks, head, opt,
+                                 accumulate_steps=2, use_remat=False,
+                                 schedule='1F1B', dispatch_window=window)
+        rng = np.random.RandomState(0)
+        data = []
+        for _ in range(3):
+            ids = rng.randint(0, 64, (2, 32)).astype('int32')
+            data.append((ids, np.roll(ids, -1, 1).astype('int32')))
+        try:
+            if window is None:
+                return [float(eng.train_batch((Tensor(i), Tensor(l))))
+                        for i, l in data]
+            loader = DeviceLoader(data, engine=eng)
+            rs = [eng.train_step(b) for b in loader]
+            eng.flush()
+            return [r.result() for r in rs]
+        finally:
+            eng.shutdown()
+
+    def test_pipeline(self):
+        sync = self._run_pipeline(None)
+        w1 = self._run_pipeline(1)
+        w4 = self._run_pipeline(4)
+        assert sync == w1 == w4
+
+
+class TestZeroHostSyncs:
+    def test_windowed_loop_adds_no_host_syncs(self, monkeypatch):
+        """The PR-3 sync-count harness: an fp32 windowed hot loop
+        (DeviceLoader + train_step + flush) performs ZERO host fetches;
+        the one fetch happens when the caller reads a loss."""
+        from paddle_tpu.core import numerics as num
+        from paddle_tpu.distributed.fleet.meta_parallel.hybrid_engine \
+            import HybridParallelTrainStep
+        _mesh(['dp', 'sharding'], [2, 2])
+        paddle.seed(0)
+        m = _mlp()
+        opt = paddle.optimizer.Adam(parameters=m.parameters(),
+                                    learning_rate=1e-2)
+        eng = HybridParallelTrainStep(m, _mlp_loss, opt,
+                                      dispatch_window=2)
+        data = _batches(4)
+        loader = DeviceLoader(data, engine=eng)
+        real = num._host_fetch
+        calls = []
+        monkeypatch.setattr(num, '_host_fetch',
+                            lambda t: (calls.append(1), real(t))[1])
+        rs = [eng.train_step(*b) for b in loader]
+        eng.flush()
+        assert calls == [], f'hot loop performed {len(calls)} host syncs'
+        losses = [r.result() for r in rs]
+        assert len(calls) == len(rs)       # exactly one fetch per read
+        assert all(np.isfinite(losses))
+        eng.shutdown()
+
+
+class TestDeviceLR:
+    def _host_values(self, sched, n):
+        out = []
+        for _ in range(n):
+            out.append(float(sched()))
+            sched.step()
+        return out
+
+    def test_fn_matches_host_schedulers(self):
+        from paddle_tpu.optimizer import device_lr as dlr
+        from paddle_tpu.optimizer import lr as L
+        scheds = [
+            L.CosineAnnealingDecay(learning_rate=0.01, T_max=7),
+            L.NoamDecay(d_model=64, warmup_steps=4, learning_rate=1.0),
+            L.PolynomialDecay(learning_rate=0.01, decay_steps=6,
+                              end_lr=1e-4, power=2.0),
+            L.PolynomialDecay(learning_rate=0.01, decay_steps=4,
+                              end_lr=1e-4, cycle=True),
+            L.InverseTimeDecay(learning_rate=0.01, gamma=0.5),
+            L.ExponentialDecay(learning_rate=0.01, gamma=0.9),
+            L.NaturalExpDecay(learning_rate=0.01, gamma=0.1),
+            L.StepDecay(learning_rate=0.01, step_size=3, gamma=0.5),
+            L.MultiStepDecay(learning_rate=0.01, milestones=[2, 5]),
+            L.LinearWarmup(learning_rate=0.02, warmup_steps=3,
+                           start_lr=0.0, end_lr=0.02),
+            L.LinearWarmup(
+                learning_rate=L.CosineAnnealingDecay(
+                    learning_rate=0.02, T_max=5),
+                warmup_steps=3, start_lr=0.0, end_lr=0.02),
+        ]
+        for sched in scheds:
+            fn = dlr.device_lr_fn(sched)
+            assert fn is not None, type(sched).__name__
+            host = self._host_values(sched, 10)
+            dev = [float(fn(jnp.asarray(s, jnp.int32)))
+                   for s in range(10)]
+            np.testing.assert_allclose(dev, host, rtol=1e-5, atol=1e-9,
+                                       err_msg=type(sched).__name__)
+        # constant lr traces too
+        fn = dlr.device_lr_fn(0.125)
+        assert float(fn(jnp.asarray(3, jnp.int32))) == 0.125
+
+    def test_exotic_schedulers_fall_back(self):
+        from paddle_tpu.optimizer import device_lr as dlr
+        from paddle_tpu.optimizer import lr as L
+        assert dlr.device_lr_fn(
+            L.LambdaDecay(0.01, lambda e: 1.0 / (e + 1))) is None
+        assert dlr.device_lr_fn(
+            L.ReduceOnPlateau(learning_rate=0.01)) is None
+
+        class MyCosine(L.CosineAnnealingDecay):   # overridden get_lr?
+            def get_lr(self):
+                return 0.5
+        # subclasses must NOT silently trace the parent's rule
+        assert dlr.device_lr_fn(
+            MyCosine(learning_rate=0.01, T_max=5)) is None
+
+    def test_engine_device_lr_matches_host_feed(self):
+        """TrainStep with the schedule traced on device vs the legacy
+        host feed (scheduler stepped once per train step): same loss
+        curve to fp32 schedule rounding."""
+        from paddle_tpu.jit import TrainStep
+        from paddle_tpu.optimizer.lr import CosineAnnealingDecay
+
+        def run(device_lr):
+            paddle.seed(0)
+            m = _mlp()
+            sched = CosineAnnealingDecay(learning_rate=0.05, T_max=6)
+            opt = paddle.optimizer.SGD(learning_rate=sched,
+                                       parameters=m.parameters())
+            step = TrainStep(m, _mlp_loss, opt, device_lr=device_lr)
+            assert (step._lr.fn is not None) == device_lr
+            out = []
+            for x, y in _batches(6):
+                out.append(float(step(Tensor(x), Tensor(y))))
+                sched.step()
+            return out
+        np.testing.assert_allclose(run(True), run(False), rtol=1e-5)
+
+    def test_hybrid_resume_mid_schedule(self):
+        """state_dict/set_state_dict resume: the device LR counter
+        re-syncs to the restored host scheduler, so a resumed run
+        replays the uninterrupted schedule exactly."""
+        from paddle_tpu.distributed.fleet.meta_parallel.hybrid_engine \
+            import HybridParallelTrainStep
+        from paddle_tpu.optimizer.lr import CosineAnnealingDecay
+        data = _batches(6)
+
+        def fresh(sched_state=None):
+            paddle.seed(0)
+            m = _mlp()
+            sched = CosineAnnealingDecay(learning_rate=0.05, T_max=6)
+            if sched_state is not None:
+                sched.set_state_dict(sched_state)
+            opt = paddle.optimizer.SGD(learning_rate=sched,
+                                       parameters=m.parameters())
+            eng = HybridParallelTrainStep(m, _mlp_loss, opt,
+                                          device_lr=True)
+            assert eng._lr.fn is not None
+            return eng, sched
+
+        _mesh(['dp'], [2])
+        eng, sched = fresh()
+        uninterrupted = []
+        for x, y in data:
+            uninterrupted.append(float(eng(Tensor(x), Tensor(y))))
+            sched.step()
+        eng.shutdown()
+
+        eng, sched = fresh()
+        resumed = []
+        for x, y in data[:3]:
+            resumed.append(float(eng(Tensor(x), Tensor(y))))
+            sched.step()
+        sd = eng.state_dict()
+        sched_sd = sched.state_dict()
+        eng.shutdown()
+        eng2, sched2 = fresh(sched_state=sched_sd)
+        eng2.set_state_dict(sd)
+        assert int(np.asarray(jax.device_get(eng2._lr.carry))) == 3
+        for x, y in data[3:]:
+            resumed.append(float(eng2(Tensor(x), Tensor(y))))
+            sched2.step()
+        eng2.shutdown()
+        np.testing.assert_allclose(resumed, uninterrupted, rtol=1e-6)
+
+
+class TestGradScalerDeferred:
+    """Deferred found-inf accounting at window drain == the per-step
+    path: a NaN injected at step k skips exactly step k's update with
+    window=2, and the scaler's dynamic schedule lands on the same state."""
+
+    class _Emb(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(4, 8)
+
+        def forward(self, x):
+            return self.lin(x)
+
+    class _Blk(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(8, 8)
+
+        def forward(self, x):
+            return nn.functional.relu(self.lin(x)) + x
+
+    class _Head(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(8, 1)
+
+        def forward(self, h, y):
+            diff = self.lin(h) - y
+            return (diff * diff).mean()
+
+    def _engine(self, window=None):
+        from paddle_tpu.distributed.fleet.meta_parallel.spmd_pipeline \
+            import SpmdPipelineEngine
+        _mesh(['dp', 'pp'], [1, 1])
+        paddle.seed(7)
+        embed = self._Emb()
+        blocks = [self._Blk(), self._Blk()]
+        head = self._Head()
+        opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=[])
+        return SpmdPipelineEngine(embed, blocks, head, opt,
+                                  accumulate_steps=2, use_remat=False,
+                                  schedule='1F1B',
+                                  dispatch_window=window)
+
+    def _data(self, nan_at=2, n=5):
+        rng = np.random.RandomState(0)
+        out = []
+        for i in range(n):
+            x = rng.rand(4, 6, 4).astype('float32')
+            y = rng.rand(4, 6, 1).astype('float32')
+            if i == nan_at:
+                x = x.copy()
+                x[0, 0, 0] = np.nan
+            out.append((x, y))
+        return out
+
+    @staticmethod
+    def _params_host(eng):
+        out = {}
+        for grp in ('embed', 'blocks', 'head'):
+            for n, v in eng._params[grp].items():
+                out[f'{grp}/{n}'] = np.asarray(jax.device_get(v))
+        return out
+
+    def test_nan_at_step_k_skips_exactly_step_k(self):
+        # decr_every_n=2 with ONE injected NaN keeps the scale constant,
+        # so the windowed and per-step paths feed identical scales and
+        # the whole trajectory must match BIT-exactly. (A scale change
+        # lands on the first step dispatched after its drain — up to
+        # `window` steps later than the per-step path; the skip
+        # accounting itself is what must be exact. docs/performance.md
+        # #async-dispatch.)
+        from paddle_tpu.amp import GradScaler
+        data = self._data()
+
+        # per-step reference (the pipeline_parallel.py driver sequence)
+        eng = self._engine()
+        scaler_s = GradScaler(init_loss_scaling=256.0,
+                              decr_every_n_nan_or_inf=2,
+                              incr_every_n_steps=1000)
+        found_seq = []
+        for x, y in data:
+            eng.train_batch((Tensor(x), Tensor(y)),
+                            scale=scaler_s._scale)
+            f = bool(np.asarray(eng.last_found_inf))
+            found_seq.append(f)
+            scaler_s._found_inf = f
+            scaler_s._update()
+        ref_params = self._params_host(eng)
+        eng.shutdown()
+        assert found_seq == [False, False, True, False, False]
+
+        # windowed: scaler accounting deferred to window drain
+        eng2 = self._engine(window=2)
+        scaler_a = GradScaler(init_loss_scaling=256.0,
+                              decr_every_n_nan_or_inf=2,
+                              incr_every_n_steps=1000)
+        rs = [eng2.train_step((x, y), scaler=scaler_a)
+              for x, y in data]
+        eng2.flush()
+        async_params = self._params_host(eng2)
+        eng2.shutdown()
+
+        # step k (and only step k) tripped found_inf
+        founds = [bool(np.asarray(jax.device_get(r.found_inf)))
+                  for r in rs]
+        assert founds == found_seq
+        # scaler schedule state identical to the per-step path
+        assert scaler_a._scale == scaler_s._scale
+        assert scaler_a._good_steps == scaler_s._good_steps
+        assert scaler_a._bad_steps == scaler_s._bad_steps
+        # the whole trajectory (skip at k, updates elsewhere) matches
+        assert ref_params.keys() == async_params.keys()
+        for k in ref_params:
+            np.testing.assert_array_equal(ref_params[k],
+                                          async_params[k], err_msg=k)
+
+
+class TestHostGapObservability:
+    def test_snapshot_and_telemetry(self):
+        from paddle_tpu.jit import TrainStep
+        A.reset_prefetch_totals()
+        paddle.seed(0)
+        m = _mlp()
+        opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                   parameters=m.parameters())
+        step = TrainStep(m, _mlp_loss, opt, dispatch_window=2)
+        loader = DeviceLoader(_batches(4), engine=step)
+        for b in loader:
+            step.train_step(*b)
+        step.flush()
+        snap = step.host_gap_snapshot()
+        assert snap['steps'] == 4 and snap['drained'] == 4
+        assert snap['host_gap_seconds'] >= 0.0
+        assert snap['host_bound_fraction'] is None or \
+            0.0 <= snap['host_bound_fraction'] <= 1.0
+        assert snap['dispatch_depth_max'] <= 2 + 1
+        host = A.host_snapshot()
+        assert 'jit' in host['sites']
+        assert host['prefetch']['batches'] >= 4
+        # the StepTelemetry contract: snapshot()['host'] carries it
+        from paddle_tpu.profiler import StepTelemetry
+        tel = StepTelemetry(publish=False).snapshot()
+        assert tel['host'] and 'jit' in tel['host']['sites']
+
+    def test_legacy_call_drains_pending_async_steps_first(self):
+        """Mixing APIs: a legacy __call__ must drain queued async steps
+        before dispatching, so deferred drain work keeps submission
+        order."""
+        paddle.seed(0)
+        from paddle_tpu.jit import TrainStep
+        m = _mlp()
+        opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                   parameters=m.parameters())
+        step = TrainStep(m, _mlp_loss, opt, dispatch_window=4)
+        data = _batches(3)
+        r1 = step.train_step(Tensor(data[0][0]), Tensor(data[0][1]))
+        r2 = step.train_step(Tensor(data[1][0]), Tensor(data[1][1]))
+        assert not r1.done() and not r2.done()   # window holds both
+        step(Tensor(data[2][0]), Tensor(data[2][1]))
+        assert r1.done() and r2.done()
+
+    def test_shutdown_unregisters_monitor(self):
+        from paddle_tpu.distributed.fleet.meta_parallel.hybrid_engine \
+            import HybridParallelTrainStep
+        _mesh(['dp'], [2])
+        paddle.seed(0)
+        m = _mlp()
+        opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                   parameters=m.parameters())
+        eng = HybridParallelTrainStep(m, _mlp_loss, opt)
+        x, y = _batches(1)[0]
+        eng(Tensor(x), Tensor(y))
+        assert 'hybrid' in A.host_snapshot()['sites']
+        eng.shutdown()
+        assert 'hybrid' not in A.host_snapshot()['sites']
+
+    def test_async_result_repr_and_tensor(self):
+        paddle.seed(0)
+        from paddle_tpu.jit import TrainStep
+        m = _mlp()
+        opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                   parameters=m.parameters())
+        step = TrainStep(m, _mlp_loss, opt)
+        x, y = _batches(1)[0]
+        res = step.train_step(Tensor(x), Tensor(y))
+        assert 'in-flight' in repr(res) or 'drained' in repr(res)
+        t = res.tensor()
+        assert float(t) == res.result()
+        step.flush()
+        assert res.done()

@@ -43,6 +43,12 @@ the human post-mortem:
     docs/performance.md#remat-policy) from a bench record's `memory`
     section.
 
+  * async-dispatch host-gap view (`host` subcommand): dispatch window /
+    DeviceLoader prefetch depth knobs, per-site host gap + dispatch
+    depth, host_bound_fraction, and the bench legs' sync-vs-windowed
+    comparison (docs/performance.md#async-dispatch) from a bench record
+    or telemetry snapshot.
+
 Usage:
     python tools/health_dump.py ARTIFACT.json [--json] [--level ERROR]
     python tools/health_dump.py numerics ARTIFACT.json [--json]
@@ -50,6 +56,7 @@ Usage:
     python tools/health_dump.py serve SNAPSHOT.json [--json]
     python tools/health_dump.py pallas SNAPSHOT.json [--json]
     python tools/health_dump.py mem RECORD.json [--json]
+    python tools/health_dump.py host RECORD.json [--json]
     python tools/health_dump.py --selftest           # CI smoke
     python tools/health_dump.py numerics --selftest  # numerics CI smoke
     python tools/health_dump.py comm --selftest      # comm CI smoke
@@ -57,6 +64,7 @@ Usage:
     python tools/health_dump.py cluster --selftest   # cluster CI smoke
     python tools/health_dump.py pallas --selftest    # pallas CI smoke
     python tools/health_dump.py mem --selftest       # mem CI smoke
+    python tools/health_dump.py host --selftest      # async CI smoke
 """
 import argparse
 import json
@@ -1133,8 +1141,188 @@ def mem_main(argv):
     return 0
 
 
+def _find_host(doc):
+    """Locate an async-dispatch section: a bench leg's `host` record
+    ({'dispatch_window', 'windowed', 'sync_loop', ...}) or the
+    telemetry 'host' snapshot ({'sites', 'prefetch'})."""
+    if not isinstance(doc, dict):
+        return None
+    if 'dispatch_window' in doc and ('windowed' in doc
+                                     or 'sync_loop' in doc):
+        return doc
+    if 'sites' in doc and 'prefetch' in doc:
+        return doc
+    for key in ('host', 'detail', 'telemetry'):
+        found = _find_host(doc.get(key))
+        if found is not None:
+            return found
+    if 'legs' in doc:
+        for leg in (doc['legs'] or {}).values():
+            found = _find_host(leg)
+            if found is not None:
+                return found
+    return None
+
+
+def _fmt_gap(v):
+    if v is None:
+        return '-'
+    return f'{v * 1000.0:.3f}ms' if isinstance(v, (int, float)) else str(v)
+
+
+def render_host(h):
+    """Human view of the async step pipeline: dispatch window /
+    prefetch depth knobs, the sync-vs-windowed host-gap comparison, and
+    host_bound_fraction (docs/performance.md#async-dispatch)."""
+    out = ['Async step pipeline (host-gap view)']
+    if 'dispatch_window' in h:          # bench detail.host shape
+        out.append(f"  dispatch window {h.get('dispatch_window')}   "
+                   f"device_lr {h.get('device_lr', False)}")
+        pf = h.get('prefetch') or {}
+        out.append(
+            f"  prefetch depth {pf.get('depth')}   batches "
+            f"{pf.get('batches')}   stalls {pf.get('stalls')}   "
+            f"h2d {_fmt_bytes(pf.get('h2d_bytes'))}   ring reuses "
+            f"{pf.get('ring_reuses')}")
+        win = h.get('windowed') or {}
+        sync = h.get('sync_loop') or {}
+        out.append(f"  {'loop':<10} {'steps':>6} {'host_gap':>10} "
+                   f"{'host_bound':>11} {'depth':>6}")
+        out.append(
+            f"  {'sync':<10} {sync.get('steps') or 0:>6} "
+            f"{_fmt_gap(sync.get('host_gap_seconds')):>10} "
+            f"{_fmt_frac(sync.get('host_bound_fraction')):>11} "
+            f"{'1':>6}")
+        out.append(
+            f"  {'windowed':<10} {win.get('steps') or 0:>6} "
+            f"{_fmt_gap(win.get('host_gap_seconds')):>10} "
+            f"{_fmt_frac(win.get('host_bound_fraction')):>11} "
+            f"{win.get('dispatch_depth_mean') or 0:>6.2f}")
+        reduced = h.get('host_gap_reduced')
+        if reduced is not None:
+            out.append(f"  host gap reduced vs sync loop: "
+                       f"{'YES' if reduced else 'NO'}")
+        return '\n'.join(out)
+    # telemetry snapshot shape: per-site monitors + prefetch totals
+    sites = h.get('sites') or {}
+    if sites:
+        out.append(f"  {'site':<10} {'steps':>6} {'host_gap':>10} "
+                   f"{'host_bound':>11} {'depth':>6}")
+        for site, s in sorted(sites.items()):
+            out.append(
+                f"  {site:<10} {s.get('steps') or 0:>6} "
+                f"{_fmt_gap(s.get('host_gap_seconds')):>10} "
+                f"{_fmt_frac(s.get('host_bound_fraction')):>11} "
+                f"{s.get('dispatch_depth_mean') or 0:>6.2f}")
+    else:
+        out.append('  (no engine dispatched asynchronously)')
+    pf = h.get('prefetch') or {}
+    out.append(
+        f"  prefetch: loaders {pf.get('loaders')}   batches "
+        f"{pf.get('batches')}   stalls {pf.get('stalls')}   "
+        f"h2d {_fmt_bytes(pf.get('h2d_bytes'))}   ring reuses "
+        f"{pf.get('ring_reuses')}")
+    return '\n'.join(out)
+
+
+def _fmt_frac(v):
+    if v is None:
+        return '-'
+    return f'{v:.3f}'
+
+
+def _host_selftest():
+    """CI smoke: a windowed TrainStep loop fed by a DeviceLoader ->
+    host-gap monitor + prefetch gauges -> renderer."""
+    _repo_root_on_path()
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.core import async_step as A
+    from paddle_tpu.core.tensor import Tensor  # noqa: F401
+    from paddle_tpu.io import DeviceLoader
+    from paddle_tpu.jit import TrainStep
+
+    A.reset_prefetch_totals()
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=model.parameters())
+    step = TrainStep(model,
+                     lambda m, x, y: nn.functional.cross_entropy(
+                         m(x), y),
+                     opt, dispatch_window=2)
+    rng = np.random.RandomState(0)
+    batches = [(rng.rand(8, 8).astype('float32'),
+                rng.randint(0, 4, (8,)).astype('int64'))
+               for _ in range(4)]
+    loader = DeviceLoader(batches, engine=step)
+    last = None
+    for b in loader:
+        last = step.train_step(*b)
+    step.flush()
+    assert np.isfinite(last.result())
+    snap = A.host_snapshot()
+    assert snap['sites'].get('jit', {}).get('steps') == 4, snap
+    assert snap['prefetch']['batches'] >= 4, snap
+    text = render_host(snap)
+    assert 'jit' in text and 'prefetch' in text, text
+    print(text)
+    bench_shape = {
+        'dispatch_window': 2, 'device_lr': False,
+        'prefetch': loader.stats(),
+        'windowed': dict(snap['sites']['jit']),
+        'sync_loop': {'steps': 3, 'host_gap_seconds': 0.01,
+                      'host_bound_fraction': 0.9, 'ms_per_step': 12.0},
+        'host_gap_reduced': True,
+    }
+    doc = {'legs': {'gpt1.3b_adamw': {'host': bench_shape}}}
+    found = _find_host(doc)
+    assert found is bench_shape
+    text = render_host(found)
+    assert 'host gap reduced' in text and 'windowed' in text, text
+    print(text)
+    print('health_dump host selftest: OK')
+    return 0
+
+
+def host_main(argv):
+    ap = argparse.ArgumentParser(
+        prog='health_dump.py host',
+        description='render the async-dispatch host-gap view (dispatch '
+                    'window, prefetch depth, sync-vs-windowed host gap, '
+                    'host_bound_fraction) from a bench record or '
+                    'telemetry snapshot '
+                    '(docs/performance.md#async-dispatch)')
+    ap.add_argument('artifact', nargs='?',
+                    help='bench record / telemetry JSON')
+    ap.add_argument('--json', action='store_true')
+    ap.add_argument('--selftest', action='store_true')
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return _host_selftest()
+    if not args.artifact:
+        ap.error('artifact path required (or --selftest)')
+    with open(args.artifact) as f:
+        doc = json.load(f)
+    hostdoc = _find_host(doc)
+    if hostdoc is None:
+        raise ValueError(
+            'no async-dispatch section in this artifact (expected a '
+            "bench record with detail.host or a telemetry snapshot "
+            "with a 'host' section — ISSUE 13 bench legs attach one)")
+    if args.json:
+        print(json.dumps(hostdoc, indent=2))
+    else:
+        print(render_host(hostdoc))
+    return 0
+
+
 def main(argv=None):
     argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == 'host':
+        return host_main(argv[1:])
     if argv and argv[0] == 'mem':
         return mem_main(argv[1:])
     if argv and argv[0] == 'numerics':

@@ -39,6 +39,13 @@
 #                      mesh, dropout-fused flash fwd+VJP parity vs the
 #                      dense reference, activation-byte census drop,
 #                      mem/pallas CLI smokes
+#   --async-selftest - async step pipeline (ISSUE 13): DeviceLoader
+#                      sharded prefetch + staging-ring no-aliasing,
+#                      windowed-dispatch loss bit-identity on all three
+#                      engines + zero-host-sync assertion, on-device LR
+#                      schedule equivalence incl. mid-schedule resume,
+#                      GradScaler deferred found-inf accounting,
+#                      host-gap gauge rendering
 set -e
 cd "$(dirname "$0")/.."
 TIER="${1:-all}"
@@ -49,7 +56,8 @@ case "$TIER" in
             tests/test_numerics.py tests/test_bucketing.py \
             tests/test_fused_primitives.py tests/test_overlap.py \
             tests/test_serving.py tests/test_serving_trace.py \
-            tests/test_serving_cluster.py tests/test_remat.py -q
+            tests/test_serving_cluster.py tests/test_remat.py \
+            tests/test_async_step.py -q
           # observability tooling smoke: tracer -> export -> summary CLI
           python tools/trace_summary.py --selftest
           # diagnostics smoke: flight recorder -> hang/OOM reports -> CLI
@@ -63,7 +71,9 @@ case "$TIER" in
           # cluster smoke: 2-replica router -> placement counters
           python tools/health_dump.py cluster --selftest
           # pallas smoke: fused primitives -> route counters -> render
-          python tools/health_dump.py pallas --selftest ;;
+          python tools/health_dump.py pallas --selftest
+          # async smoke: windowed loop -> host-gap gauges -> render
+          python tools/health_dump.py host --selftest ;;
   dist)   python -m pytest tests/test_distributed.py \
             tests/test_launch_elastic.py tests/test_bert_zero_asp.py -q ;;
   native) python -m pytest tests/test_native.py tests/test_ps.py -q ;;
@@ -127,6 +137,14 @@ case "$TIER" in
           python -m pytest tests/test_remat.py -q
           python tools/health_dump.py mem --selftest
           python tools/health_dump.py pallas --selftest ;;
+  --async-selftest)
+          # the async step pipeline end to end (ISSUE 13): DeviceLoader
+          # prefetch/sharding, windowed-dispatch bit-identity + the
+          # zero-host-sync harness, on-device LR schedules, deferred
+          # GradScaler accounting, then the host-gap CLI smoke
+          XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+          python -m pytest tests/test_async_step.py -q
+          python tools/health_dump.py host --selftest ;;
   all)    python -m pytest tests/ -q
           python tools/trace_summary.py --selftest
           python tools/health_dump.py --selftest
@@ -135,6 +153,7 @@ case "$TIER" in
           python tools/health_dump.py serve --selftest
           python tools/health_dump.py cluster --selftest
           python tools/health_dump.py pallas --selftest
-          python tools/health_dump.py mem --selftest ;;
-  *) echo "usage: $0 [fast|dist|native|e2e|all|--comm-selftest|--serve-selftest|--quant-selftest|--pallas-selftest|--overlap-selftest|--cluster-selftest|--remat-selftest]"; exit 1 ;;
+          python tools/health_dump.py mem --selftest
+          python tools/health_dump.py host --selftest ;;
+  *) echo "usage: $0 [fast|dist|native|e2e|all|--comm-selftest|--serve-selftest|--quant-selftest|--pallas-selftest|--overlap-selftest|--cluster-selftest|--remat-selftest|--async-selftest]"; exit 1 ;;
 esac
