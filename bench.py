@@ -977,6 +977,9 @@ def _attach_telemetry(r):
             # async-dispatch view (ISSUE 13): per-site host gap/depth +
             # DeviceLoader prefetch totals
             'host': snap.get('host'),
+            # pipeline schedule census (ISSUE 14): active schedule /
+            # virtual stages / modeled bubble fraction
+            'pipeline': snap.get('pipeline'),
         }
     except Exception as e:
         r['telemetry'] = {'error': repr(e)[:200]}
@@ -1088,6 +1091,35 @@ def _check_legs(result):
         'headline leg telemetry lacks remat'
     assert 'remat' in legs['gpt1.3b_adamw'] or 'error' in \
         legs['gpt1.3b_adamw'], 'headline leg lacks the remat record'
+    # the pipeline-schedule record shape (ISSUE 14): any leg or detail
+    # carrying a `pipeline` record — the schedule census bench legs and
+    # telemetry attach — must look like schedule_model()/
+    # pipeline_snapshot() output, so a future pipeline leg is validated
+    # like the host/remat records
+    def _check_pipeline_record(rec, where):
+        assert isinstance(rec, dict), \
+            f'{where}: pipeline record is not a dict'
+        for key in ('schedule', 'virtual_stages', 'accumulate_steps',
+                    'ticks', 'chunk_ticks', 'bubble_fraction'):
+            assert key in rec, f'{where}: pipeline record lacks {key}'
+        assert rec['schedule'] in ('1F1B', 'F-then-B', 'interleaved'), \
+            f"{where}: unknown schedule {rec['schedule']!r}"
+        assert 0.0 <= rec['bubble_fraction'] < 1.0, \
+            f"{where}: bubble_fraction out of range"
+        assert int(rec['virtual_stages']) >= 1, where
+
+    for name, leg in legs.items():
+        for holder, where in ((leg, f'legs.{name}'),
+                              (leg.get('telemetry') or {},
+                               f'legs.{name}.telemetry'),
+                              (leg.get('detail') or {},
+                               f'legs.{name}.detail')):
+            rec = holder.get('pipeline') if isinstance(holder, dict) \
+                else None
+            if rec is not None:
+                _check_pipeline_record(rec, where)
+    if isinstance(detail, dict) and detail.get('pipeline') is not None:
+        _check_pipeline_record(detail['pipeline'], 'detail')
     # the async-dispatch view (ISSUE 13): the headline leg must carry
     # detail.host with the dispatch window, prefetch depth, and the
     # sync-vs-windowed host-gap comparison incl. host_bound_fraction

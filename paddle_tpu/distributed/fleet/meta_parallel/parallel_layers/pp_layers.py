@@ -98,6 +98,15 @@ class PipelineLayer(Layer):
         self._loss_fn = loss_fn
         self._topo = topology
         self._recompute_interval = recompute_interval
+        # honored by the SPMD engine (engine_from_pipeline_layer ->
+        # schedule='interleaved'); was accepted-and-dropped before
+        if num_virtual_pipeline_stages is not None:
+            num_virtual_pipeline_stages = int(num_virtual_pipeline_stages)
+            if num_virtual_pipeline_stages < 1:
+                raise ValueError(
+                    "num_virtual_pipeline_stages must be >= 1, got "
+                    f"{num_virtual_pipeline_stages}")
+        self._num_virtual_pipeline_stages = num_virtual_pipeline_stages
         if num_stages is None and topology is None:
             num_stages = 1
         from ... import fleet as fleet_singleton

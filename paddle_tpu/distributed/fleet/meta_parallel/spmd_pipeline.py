@@ -18,18 +18,24 @@ part (a)):
     CollectivePermute replacement for send_v2/recv_v2 NCCL pairs;
   * stage-dependent behavior (ingest on stage 0, loss on last stage) is
     `jnp.where` masking — SPMD-uniform code, XLA-friendly;
-  * two schedules, matching section_worker.cc:134-185's schedule_mode pair:
-    '1F1B' (default) hand-interleaves one forward + one backward sub-step
-    per tick with a circular O(pp) stage-input buffer and per-tick local
-    `jax.vjp` (see _build_1f1b); 'F-then-B' takes `jax.grad` through the
-    whole tick scan — scan transposition yields the reverse pipeline
-    automatically, at O(A) boundary-activation cost — with
-    `jax.checkpoint` on the block fn for activation recompute;
+  * three schedules: '1F1B' (default) and 'F-then-B' match
+    section_worker.cc:134-185's schedule_mode pair — '1F1B'
+    hand-interleaves one forward + one backward sub-step per tick with a
+    circular O(pp) stage-input buffer and per-tick local `jax.vjp` (see
+    _build_1f1b); 'F-then-B' takes `jax.grad` through the whole tick
+    scan — scan transposition yields the reverse pipeline automatically,
+    at O(A) boundary-activation cost — with `jax.checkpoint` on the
+    block fn for activation recompute; 'interleaved' is the Megatron
+    virtual-stage schedule (arXiv:2104.04473): each physical stage holds
+    `virtual_stages` model chunks split round-robin, so every masked
+    warm-up/drain tick burns 1/v of a stage and the bubble shrinks
+    ~1/v (see _build_interleaved + schedule_model);
   * embedding/head weights are replicated over 'pp'; their grads get
     psum('pp') — exactly allreduce_shared_weight_gradients;
   * dp grad sync = pmean over 'dp'; mp collectives run inside blocks.
 """
 import functools
+import os
 
 import numpy as np
 import jax
@@ -56,6 +62,230 @@ def _spec_for(p, axes, extra_leading_pp=False):
     if getattr(p, 'is_distributed', False) and 'mp' in axes:
         spec[p.split_axis + (1 if extra_leading_pp else 0)] = 'mp'
     return P(*spec)
+
+
+class PipelineScheduleError(ValueError):
+    """A pipeline-schedule configuration the engine cannot honor
+    (layer/chunk divisibility, virtual stages on a schedule without
+    them, accumulate_steps not forming whole microbatch groups)."""
+
+
+class PipelineBatchError(ValueError):
+    """A batch whose shape cannot be microbatched by the engine
+    (size not divisible by dp x accumulate_steps, or an input/label
+    leading-dimension mismatch)."""
+
+
+def resolve_virtual_stages(virtual_stages=None, from_layer=None):
+    """Virtual-stage count resolution (docs/performance.md
+    #pipeline-schedules): explicit kwarg -> PTPU_PP_VIRTUAL env ->
+    PipelineLayer(num_virtual_pipeline_stages=) -> None (unset)."""
+    if virtual_stages is not None:
+        return int(virtual_stages)
+    env = os.environ.get('PTPU_PP_VIRTUAL')
+    if env:
+        try:
+            return int(env)
+        except ValueError:
+            raise PipelineScheduleError(
+                f"PTPU_PP_VIRTUAL={env!r} is not an integer")
+    if from_layer is not None:
+        return int(from_layer)
+    return None
+
+
+def chunk_layer_order(num_layers, pp, virtual_stages):
+    """Round-robin layer -> (stage, chunk) assignment (arXiv:2104.04473
+    interleaved schedule): global model chunk g = c*pp + s holds layers
+    [g*per, (g+1)*per) with per = num_layers/(pp*v). Returns the
+    STACKING order: row i of the [num_layers, ...] stacked block tree
+    holds original layer order[i], so the P('pp') shard of device s is
+    exactly its v chunks, chunk-major. Identity when v == 1."""
+    pp = max(int(pp), 1)
+    v = max(int(virtual_stages or 1), 1)
+    if num_layers % (pp * v) or num_layers < pp * v:
+        raise PipelineScheduleError(
+            f"{num_layers} layers cannot split round-robin into "
+            f"pp({pp}) x virtual_stages({v}) = {pp * v} non-empty "
+            f"chunks; pick num_layers divisible by pp*virtual_stages "
+            f"(PipelineLayer(num_virtual_pipeline_stages=) / "
+            f"virtual_stages= / PTPU_PP_VIRTUAL)")
+    per = num_layers // (pp * v)
+    return [(c * pp + s) * per + i
+            for s in range(pp) for c in range(v) for i in range(per)]
+
+
+def _sim_inflight(pp, A, v):
+    """Walk the interleaved-1F1B tick table: per-chunk residual slots
+    needed (closed write..read interval, the same-tick write-then-read
+    counts as live) and the peak in-flight microbatch count per device.
+    v=1 reproduces the classic 1F1B window min(A, 2*pp-1). Each
+    chunk's live set is a contiguous ascending-m window (both job
+    streams are monotone in m), so a two-pointer per chunk plus one
+    event sweep per stage does it in O(pp * (A*v + T))."""
+    ppv = pp * v
+    D = 2 * (pp - 1) + (v - 1) * pp
+    T = A * v + D
+
+    slots = 1
+    peak = 0
+    for s in range(pp):
+        def t_fwd(c, m):
+            r, q = divmod(m, pp)
+            return s + r * ppv + c * pp + q
+
+        def t_bwd(c, m):
+            r, q = divmod(m, pp)
+            return (D - s) + r * ppv + (v - 1 - c) * pp + q
+
+        delta = [0] * (T + 2)
+        for c in range(v):
+            m0 = 0
+            for m in range(A):
+                delta[t_fwd(c, m)] += 1
+                delta[t_bwd(c, m) + 1] -= 1
+                while t_bwd(c, m0) < t_fwd(c, m):
+                    m0 += 1
+                slots = max(slots, m - m0 + 1)
+        live = 0
+        for d in delta:
+            live += d
+            peak = max(peak, live)
+    return slots, peak
+
+
+def schedule_model(schedule, pp, accumulate_steps, virtual_stages=1,
+                   memory_mode=None):
+    """Static schedule model of ONE compiled pipeline step: tick count,
+    executed chunk sub-steps per device, and the modeled bubble
+    fraction (masked warm-up/drain work as a fraction of executed
+    work). One tick = one chunk forward + one chunk backward sub-step
+    per stage in lockstep; a chunk is 1/v of a stage, so interleaving
+    shrinks the (pp-1)-tick ramp cost by ~1/v (arXiv:2104.04473):
+
+        bubble_fraction = (pp - 1) / (A*v + pp - 1)
+
+    The forward/backward cond windows in the compiled scan match
+    fwd_window/bwd_window exactly; ticks is the lax.scan length."""
+    if schedule in ('FThenB', 'F-then-B'):
+        schedule = 'F-then-B'
+    pp = max(int(pp), 1)
+    A = int(accumulate_steps)
+    v = max(int(virtual_stages or 1), 1) if schedule == 'interleaved' \
+        else 1
+    if schedule == 'F-then-B':
+        ticks = A + pp - 1          # fwd scan; bwd is its transposition
+        warmup = pp - 1
+        fwd_w = bwd_w = A + pp - 1
+        slots, peak = A, A          # O(A) boundary activations stored
+    else:                           # '1F1B' / 'interleaved'
+        D = 2 * (pp - 1) + (v - 1) * pp
+        ticks = A * v + D
+        warmup = D - (pp - 1)       # ticks before the first bwd anywhere
+        fwd_w = bwd_w = A * v + pp - 1
+        slots, peak = _sim_inflight(pp, A, v)
+        slots = min(slots, A)
+    useful = 2 * A * v
+    chunk_ticks = fwd_w + bwd_w
+    model = {
+        'schedule': schedule,
+        'pp': pp,
+        'virtual_stages': v,
+        'accumulate_steps': A,
+        'ticks': ticks,
+        'warmup_ticks': warmup,
+        'fwd_window': fwd_w,
+        'bwd_window': bwd_w,
+        'chunk_ticks': chunk_ticks,
+        'useful_chunk_ticks': useful,
+        'bubble_fraction': 1.0 - useful / chunk_ticks,
+        'inflight_peak': peak,
+        'slots_per_chunk': slots,
+        # wire-traffic model: two lax.ppermute ring hops per tick (act
+        # +1, cotangent -1) — interleaving trades ~v x more boundary
+        # crossings for the 1/v ramp (docs/performance.md
+        # #pipeline-schedules)
+        'ppermute_steps': 2 * ticks if pp > 1 else 0,
+    }
+    if memory_mode is not None:
+        model['memory_mode'] = memory_mode
+    return model
+
+
+def publish_schedule_gauges(model, engine='pipeline'):
+    """ptpu_pp_* gauges from a schedule_model() dict through
+    core.monitor — StepTelemetry.snapshot()['pipeline'] and
+    `tools/health_dump.py pp` read these back."""
+    try:
+        from ....core.monitor import gauge
+    except Exception:
+        return
+    lbl = {'engine': engine}
+    for name, key, help_ in (
+            ('ptpu_pp_ticks', 'ticks', 'pipeline scan ticks per step'),
+            ('ptpu_pp_chunk_ticks', 'chunk_ticks',
+             'executed chunk fwd+bwd sub-steps per device per step'),
+            ('ptpu_pp_useful_chunk_ticks', 'useful_chunk_ticks',
+             'unmasked chunk sub-steps per device per step'),
+            ('ptpu_pp_bubble_fraction', 'bubble_fraction',
+             'modeled masked-work fraction of the schedule'),
+            ('ptpu_pp_inflight_peak', 'inflight_peak',
+             'peak in-flight microbatches per device'),
+            ('ptpu_pp_virtual_stages', 'virtual_stages',
+             'model chunks per physical stage (v)'),
+            ('ptpu_pp_stages', 'pp', 'pipeline-parallel degree'),
+            ('ptpu_pp_accumulate_steps', 'accumulate_steps',
+             'microbatches per step (A)')):
+        gauge(name, help=help_, labelnames=('engine',)).set(
+            float(model[key]), **lbl)
+    g = gauge('ptpu_pp_schedule_info',
+              help='active pipeline schedule (value 1; the schedule '
+                   'rides in the label)',
+              labelnames=('engine', 'schedule'))
+    for other in ('1F1B', 'F-then-B', 'interleaved'):
+        g.set(1 if other == model['schedule'] else 0,
+              engine=engine, schedule=other)
+
+
+def pipeline_snapshot(engine='pipeline'):
+    """StepTelemetry.snapshot()['pipeline'] payload: the published
+    schedule census read back from the ptpu_pp_* gauges (None when no
+    pipeline engine has been built)."""
+    try:
+        from ....core import monitor as _m
+        reg = _m.metrics()
+        if reg.get('ptpu_pp_ticks') is None:
+            return None
+
+        def val(name):
+            m = reg.get(name)
+            if m is None:
+                return None
+            for labels, child in m._series().items():
+                if labels and labels[0] == engine:
+                    return child.value()
+            return None
+
+        snap = {
+            'ticks': int(val('ptpu_pp_ticks') or 0),
+            'chunk_ticks': int(val('ptpu_pp_chunk_ticks') or 0),
+            'useful_chunk_ticks':
+                int(val('ptpu_pp_useful_chunk_ticks') or 0),
+            'bubble_fraction': val('ptpu_pp_bubble_fraction'),
+            'inflight_peak': int(val('ptpu_pp_inflight_peak') or 0),
+            'virtual_stages': int(val('ptpu_pp_virtual_stages') or 1),
+            'pp': int(val('ptpu_pp_stages') or 1),
+            'accumulate_steps':
+                int(val('ptpu_pp_accumulate_steps') or 0),
+        }
+        info = reg.get('ptpu_pp_schedule_info')
+        if info is not None:
+            for labels, child in info._series().items():
+                if labels and labels[0] == engine and child.value():
+                    snap['schedule'] = labels[1]
+        return snap
+    except Exception:
+        return None
 
 
 from ....nn.layer.base import Layer as _Layer
@@ -99,13 +329,18 @@ class _HeadWrapper(_Layer):
 
 def engine_from_pipeline_layer(pipeline_layer, optimizer, accumulate_steps,
                                mesh=None, use_remat=True, schedule='1F1B',
-                               remat_policy=None):
+                               remat_policy=None, virtual_stages=None):
     """Build a SpmdPipelineEngine from a PipelineLayer's descs (parity: the
     dygraph PipelineParallel engine construction from pp_layers).
 
     Convention: desc[0] is the embedding/input stage, the trailing
     non-uniform descs (e.g. final norm) plus the PipelineLayer's loss_fn
     form the head, and the uniform middle run becomes the stacked blocks.
+
+    `PipelineLayer(num_virtual_pipeline_stages=)` is honored here: a
+    value > 1 (or virtual_stages=/PTPU_PP_VIRTUAL) selects the
+    interleaved schedule; values the uniform block run cannot split
+    into pp*v non-empty chunks raise PipelineScheduleError.
     """
     funcs, shared = pipeline_layer.build_full_model()
     if pipeline_layer._loss_fn is None:
@@ -158,10 +393,18 @@ def engine_from_pipeline_layer(pipeline_layer, optimizer, accumulate_steps,
     # then decides what is saved vs recomputed
     if getattr(pipeline_layer, '_recompute_interval', 0):
         use_remat = True
+    # wire the long-silently-ignored num_virtual_pipeline_stages
+    # (kwarg -> PTPU_PP_VIRTUAL -> the PipelineLayer's own value); the
+    # engine validates divisibility and schedule compatibility
+    v = resolve_virtual_stages(
+        virtual_stages,
+        from_layer=getattr(pipeline_layer,
+                           '_num_virtual_pipeline_stages', None))
     return SpmdPipelineEngine(embed, blocks, head, optimizer,
                               accumulate_steps, mesh=mesh,
                               use_remat=use_remat, schedule=schedule,
-                              remat_policy=remat_policy)
+                              remat_policy=remat_policy,
+                              virtual_stages=v)
 
 
 from .meta_parallel_base import EngineTeardown
@@ -186,7 +429,8 @@ class SpmdPipelineEngine(A_.AsyncDispatchMixin, EngineTeardown):
                  use_buckets=None, comm_dtype=None, bucket_mb=None,
                  comm_block=None, comm_overlap=None, prefetch_depth=None,
                  comm_chunk=None, remat_policy=None,
-                 dispatch_window=None, device_lr=None):
+                 dispatch_window=None, device_lr=None,
+                 virtual_stages=None):
         self.embed = embed
         self.blocks = blocks
         self.head = head
@@ -219,9 +463,30 @@ class SpmdPipelineEngine(A_.AsyncDispatchMixin, EngineTeardown):
         self.grad_accum_dtype = grad_accum_dtype
         if schedule in ('FThenB', 'F-then-B'):
             schedule = 'F-then-B'
-        elif schedule != '1F1B':
+        elif schedule not in ('1F1B', 'interleaved'):
             raise ValueError(f"unknown pipeline schedule {schedule!r}; "
-                             "expected '1F1B' or 'F-then-B'")
+                             "expected '1F1B', 'F-then-B' or "
+                             "'interleaved'")
+        # virtual stages (arXiv:2104.04473 interleaved schedule):
+        # kwarg -> PTPU_PP_VIRTUAL -> PipelineLayer wiring (via
+        # engine_from_pipeline_layer). v > 1 upgrades the default 1F1B
+        # to 'interleaved'; F-then-B has no virtual-stage formulation.
+        vv = resolve_virtual_stages(virtual_stages)
+        if vv is not None and vv < 1:
+            raise PipelineScheduleError(
+                f"virtual_stages must be >= 1, got {vv}")
+        if schedule == 'interleaved':
+            self.vp = vv if vv is not None else 2
+        elif vv is not None and vv > 1:
+            if schedule == 'F-then-B':
+                raise PipelineScheduleError(
+                    f"schedule 'F-then-B' cannot honor virtual_stages="
+                    f"{vv} (num_virtual_pipeline_stages/PTPU_PP_VIRTUAL"
+                    "); use schedule='interleaved' or '1F1B'")
+            schedule = 'interleaved'
+            self.vp = vv
+        else:
+            self.vp = 1
         self.schedule = schedule
         self._use_scaling = False     # fp16 GradScaler path (compile-time)
         self.mesh = mesh if mesh is not None else topology_runtime.get_mesh()
@@ -230,8 +495,28 @@ class SpmdPipelineEngine(A_.AsyncDispatchMixin, EngineTeardown):
         self.axes = tuple(self.mesh.axis_names)
         self.pp = self.mesh.shape.get('pp', 1)
         self.dp = self.mesh.shape.get('dp', 1)
-        assert len(blocks) % max(self.pp, 1) == 0, \
-            "num_layers must divide pp_degree"
+        # stacking order: row i of the stacked [L, ...] block trees
+        # holds blocks[self._layer_order[i]] — identity for 1F1B /
+        # F-then-B, round-robin chunk-major for interleaved so each
+        # P('pp') shard is its stage's v chunks back to back. Raises
+        # PipelineScheduleError (naming the knobs) when the layers
+        # cannot split into pp*v non-empty chunks.
+        self._layer_order = chunk_layer_order(
+            len(blocks), self.pp, self.vp)
+        if self.vp > 1 and accumulate_steps % max(self.pp, 1):
+            raise PipelineScheduleError(
+                f"interleaved schedule needs accumulate_steps("
+                f"{accumulate_steps}) divisible by pp("
+                f"{max(self.pp, 1)}): microbatches advance in groups "
+                "of pp per model chunk (arXiv:2104.04473)")
+        # static schedule model + census (ptpu_pp_* gauges ->
+        # StepTelemetry.snapshot()['pipeline'], health_dump pp): the
+        # compiled scan's tick count and cond windows follow this model
+        # exactly, so the bubble shrink is a measured number
+        self._sched_model = schedule_model(
+            self.schedule, self.pp, self.A, self.vp,
+            memory_mode=memory_mode)
+        publish_schedule_gauges(self._sched_model, engine='pipeline')
 
         # -- parameter pytrees ------------------------------------------------
         self._embed_named = [(n, p) for n, p in embed.named_parameters()
@@ -253,8 +538,9 @@ class SpmdPipelineEngine(A_.AsyncDispatchMixin, EngineTeardown):
             stacked = {}
             for n, p0 in self._block_named:
                 per_layer = []
-                for b in blocks:
-                    per_layer.append(dict(b.named_parameters())[n].data)
+                for j in self._layer_order:
+                    per_layer.append(
+                        dict(blocks[j].named_parameters())[n].data)
                 stacked[n] = jnp.stack(per_layer, axis=0)  # [L, ...]
 
             self._specs = {'embed': embed_specs, 'blocks': block_specs,
@@ -522,6 +808,8 @@ class SpmdPipelineEngine(A_.AsyncDispatchMixin, EngineTeardown):
     def _build(self):
         if self.schedule == '1F1B':
             return self._build_1f1b()
+        if self.schedule == 'interleaved':
+            return self._build_interleaved()
         return self._build_fthenb()
 
     # -- shared tail of both schedules ---------------------------------------
@@ -1287,6 +1575,420 @@ class SpmdPipelineEngine(A_.AsyncDispatchMixin, EngineTeardown):
 
         return self._finalize(step, dp_on)
 
+    def _build_interleaved(self):
+        """Interleaved virtual-stage 1F1B (arXiv:2104.04473; Megatron's
+        num_model_chunks schedule).
+
+        Each physical stage holds v model chunks; global virtual stage
+        g = c*pp + s runs chunk c on device s. ONE `lax.scan` over
+        T = A*v + D ticks, D = 2*(pp-1) + (v-1)*pp: every tick each
+        device runs ONE chunk-forward (its job stream index
+        j_f = t - stage; job j -> chunk c = (j mod pp*v) // pp,
+        microbatch m = (j // (pp*v))*pp + j mod pp — microbatches
+        advance in groups of pp per chunk, hence A % pp == 0) and ONE
+        chunk-backward (j_b = t - (D - stage); reversed chunk order
+        within each group). Activations still move +1 and cotangents
+        -1 over the SAME 'pp' ring, one `lax.ppermute` each per tick:
+        the ring wrap pp-1 -> 0 carries a microbatch from chunk c-1
+        into chunk c, so boundary crossings scale ~v x while every
+        masked warm-up/drain tick now burns 1/v of a stage — the
+        modeled bubble shrinks from (pp-1)/(A+pp-1) to
+        (pp-1)/(A*v+pp-1) (see schedule_model).
+
+        The O(pp) residual machinery generalizes to per-(chunk,
+        in-flight-microbatch) slots: `memory_mode='stash'` buffers the
+        tick-variant vjp residual leaves in slots_per_chunk slots per
+        chunk (weight-derived leaves are evaluated once per chunk and
+        selected by c_b inside the scan); 'recompute' buffers only the
+        chunk-input activation per slot. Tied/replicated grads keep
+        their pp-psum semantics unchanged (_reduce_and_update).
+        v == 1 degenerates to the classic 1F1B tick table."""
+        A, pp, v = self.A, self.pp, self.vp
+        axes = self.axes
+        embed, head = self.embed, self.head
+        dp_on = 'dp' in axes and self.mesh.shape['dp'] > 1
+        use_scaling = self._use_scaling
+        stash = self.memory_mode == 'stash'
+        ppv = pp * v
+        D = 2 * (pp - 1) + (v - 1) * pp
+        T = A * v + D
+        K = min(self._sched_model['slots_per_chunk'], A)
+        nslots = v * K
+        per = len(self.blocks) // ppv       # layers per chunk
+        # pp*v == 1: every backward consumes the same tick's forward —
+        # full per-block remat stays the memory-safe single-chip choice
+        # (the v=1 1F1B rationale)
+        save_dots = stash and ppv > 1
+        stage_forward = self._make_stage_forward(save_dots=save_dots)
+
+        def step(params, states, lr, scale, key, input_ids, labels):
+            with C.spmd_region(axes):
+                params = self._materialize_params(params)
+                stage = lax.axis_index('pp') if pp > 1 else 0
+                mb = input_ids.shape[0] // A
+                pe, pb, ph = params['embed'], params['blocks'], params['head']
+                k0 = key
+                if dp_on:
+                    k0 = jax.random.fold_in(k0, lax.axis_index('dp'))
+
+                ids_mb = input_ids.reshape(A, mb, *input_ids.shape[1:])
+                labels_mb = labels.reshape(A, mb, *labels.shape[1:])
+
+                def embed_apply(pe_, ids_m, k):
+                    with bind_arrays(embed, pe_):
+                        with rng_mod.rng_guard(k), autograd.no_grad():
+                            return embed(Tensor(ids_m)).data
+
+                def head_apply(ph_, out, lab, k):
+                    with bind_arrays(head, ph_):
+                        with rng_mod.rng_guard(k), autograd.no_grad():
+                            return head(Tensor(out), Tensor(lab)).data \
+                                .astype(jnp.float32)
+
+                emb_shape = jax.eval_shape(
+                    embed_apply, pe, ids_mb[0], k0)
+                act_shape, act_dtype = emb_shape.shape, emb_shape.dtype
+
+                def chunk_slice(tree, c):
+                    """This device's rows for chunk c: local leaves are
+                    [v*per, ...] chunk-major (chunk_layer_order)."""
+                    return jax.tree_util.tree_map(
+                        lambda l: lax.dynamic_slice_in_dim(
+                            l, c * per, per, 0), tree)
+
+                def fwd_only(pe_, pbc_, x_in, m, c, k_mb):
+                    """One chunk-forward: embed feeds virtual stage 0
+                    (device 0, chunk 0); everyone else consumes the
+                    ring. Keys derive from (microbatch, GLOBAL virtual
+                    stage) — identical to the v=1 keys when v == 1."""
+                    ke = jax.random.fold_in(k_mb, 17)
+                    ks = jax.random.fold_in(
+                        jax.random.fold_in(k_mb, 31), c * pp + stage)
+                    if ppv > 1:
+                        x = lax.cond(
+                            jnp.logical_and(stage == 0, c == 0),
+                            lambda: embed_apply(pe_, ids_mb[m], ke),
+                            lambda: x_in)
+                    else:
+                        x = embed_apply(pe_, ids_mb[m], ke)
+                    return stage_forward(pbc_, x, ks)
+
+                def full_fn(p3, x_in, m, c, k_mb):
+                    """fwd_only + head loss on the LAST virtual stage
+                    (device pp-1, chunk v-1) — what backward
+                    differentiates. p3 carries the CHUNK's block rows
+                    so the pullback yields chunk-shaped cotangents."""
+                    pe_, pbc_, ph_ = p3
+                    out = fwd_only(pe_, pbc_, x_in, m, c, k_mb)
+                    kh = jax.random.fold_in(k_mb, 7919)
+                    if ppv > 1:
+                        loss = lax.cond(
+                            jnp.logical_and(stage == pp - 1, c == v - 1),
+                            lambda: head_apply(ph_, out, labels_mb[m],
+                                               kh),
+                            lambda: jnp.asarray(0.0, jnp.float32))
+                    else:
+                        loss = head_apply(ph_, out, labels_mb[m], kh)
+                    return out, loss
+
+                acc_param = self.grad_accum_dtype == 'param'
+                gacc0 = jax.tree_util.tree_map(
+                    lambda a: jnp.zeros(
+                        a.shape, a.dtype if acc_param else jnp.float32),
+                    (pe, pb, ph))
+
+                def grad_cot():
+                    return (scale / A).astype(jnp.float32) \
+                        if use_scaling else jnp.asarray(1.0 / A,
+                                                        jnp.float32)
+
+                def accum_full(acc, d, active):
+                    return jax.tree_util.tree_map(
+                        lambda a, g: a + jnp.where(
+                            active, g.astype(a.dtype),
+                            jnp.zeros((), a.dtype)),
+                        acc, d)
+
+                def accum_chunk(acc, d_chunk, c, active):
+                    """Add a chunk-shaped block cotangent into rows
+                    [c*per, (c+1)*per) of the local accumulator."""
+                    def one(a, g):
+                        cur = lax.dynamic_slice_in_dim(a, c * per, per, 0)
+                        upd = cur + jnp.where(
+                            active, g.astype(a.dtype),
+                            jnp.zeros((), a.dtype))
+                        return lax.dynamic_update_slice_in_dim(
+                            a, upd, c * per, 0)
+                    return jax.tree_util.tree_map(one, acc, d_chunk)
+
+                def fwd_job(t):
+                    """tick -> (active, chunk, microbatch) of this
+                    device's forward job stream."""
+                    j = t - stage
+                    active = (j >= 0) & (j < A * v)
+                    jc = jnp.clip(j, 0, A * v - 1)
+                    q = jnp.mod(jc, ppv)
+                    c = q // pp
+                    m = (jc // ppv) * pp + jnp.mod(q, pp)
+                    return active, c, m
+
+                def bwd_job(t):
+                    """Backward stream: reversed chunk order within
+                    each pp-microbatch group."""
+                    j = t - (D - stage)
+                    active = (j >= 0) & (j < A * v)
+                    jc = jnp.clip(j, 0, A * v - 1)
+                    q = jnp.mod(jc, ppv)
+                    c = (v - 1) - q // pp
+                    m = (jc // ppv) * pp + jnp.mod(q, pp)
+                    return active, c, m
+
+                if stash:
+                    # -- activation-stashing interleaved 1F1B ------------
+                    box = {}
+
+                    def fwd_probe(p3, x_in, m, c, k_mb):
+                        (out, loss), vjp_fn = jax.vjp(
+                            lambda p, xx: full_fn(p, xx, m, c, k_mb),
+                            p3, x_in)
+                        leaves, treedef = jax.tree_util.tree_flatten(vjp_fn)
+                        box['treedef'] = treedef
+                        return out, loss, leaves
+
+                    # taint split per chunk: x_in/m/k are tick-variant
+                    # (buffered per slot); the chunk id + its weight
+                    # rows are per-chunk constants, so the pruned
+                    # weight-derived residual graph is evaluated ONCE
+                    # per chunk and stacked for in-scan selection
+                    flags = avals = None
+                    inv_per_c = []
+                    for c in range(v):
+                        pbc = jax.tree_util.tree_map(
+                            lambda l: lax.slice_in_dim(
+                                l, c * per, (c + 1) * per, axis=0), pb)
+                        probe_args = ((pe, pbc, ph),
+                                      jnp.zeros(act_shape, act_dtype),
+                                      jnp.asarray(0, jnp.int32),
+                                      jnp.asarray(c, jnp.int32), k0)
+                        fl, inv_vals, avs = self._split_residuals(
+                            fwd_probe, probe_args, {1, 2, 4})
+                        if flags is None:
+                            flags, avals = fl, avs
+                        else:
+                            assert fl == flags, \
+                                "chunk residual split diverged"
+                        inv_per_c.append(inv_vals)
+                    leaf_shapes = avals[2:]
+                    leaf_var = flags[2:]
+                    var_idx = [i for i, f in enumerate(leaf_var) if f]
+                    inv_idx = [i for i, f in enumerate(leaf_var)
+                               if not f]
+                    inv_stacks = [
+                        jnp.stack([inv_per_c[c][2 + i]
+                                   for c in range(v)])
+                        for i in inv_idx]
+                    # v*K real slots (chunk-major) + 1 scratch slot for
+                    # inactive forwards — the same pure
+                    # dynamic-update-in-place trick as v=1
+                    bufs0 = tuple(
+                        jnp.zeros(
+                            (nslots + 1,) + tuple(leaf_shapes[i].shape),
+                            leaf_shapes[i].dtype)
+                        for i in var_idx)
+                    carry0 = (jnp.zeros(act_shape, act_dtype),  # fwd act
+                              jnp.zeros(act_shape, act_dtype),  # cotangent
+                              bufs0,                            # residuals
+                              gacc0,
+                              jnp.asarray(0.0, jnp.float32))    # loss acc
+
+                    def tick(carry, t):
+                        fwd_act, grad_in, bufs, gacc, loss_acc = carry
+                        f_active, c_f, m_f = fwd_job(t)
+                        b_active, c_b, m_b = bwd_job(t)
+                        slot_b = c_b * K + jnp.mod(m_b, K)
+
+                        # -- forward sub-step: ONE chunk (1/v stage) —
+                        # cond-gated on the global window so drain
+                        # ticks pay nothing
+                        def do_fwd():
+                            out, l_f, leaves = fwd_probe(
+                                (pe, chunk_slice(pb, c_f), ph),
+                                fwd_act, m_f, c_f,
+                                jax.random.fold_in(k0, m_f))
+                            return (out, l_f,
+                                    [leaves[i] for i in var_idx])
+
+                        def skip_fwd():
+                            return (jnp.zeros(act_shape, act_dtype),
+                                    jnp.asarray(0.0, jnp.float32),
+                                    [jnp.zeros(
+                                        tuple(leaf_shapes[i].shape),
+                                        leaf_shapes[i].dtype)
+                                     for i in var_idx])
+
+                        out_f, loss_f, vleaves = lax.cond(
+                            t < A * v + pp - 1, do_fwd, skip_fwd)
+                        slot_f = jnp.where(
+                            f_active, c_f * K + jnp.mod(m_f, K), nslots)
+                        bufs = tuple(
+                            lax.dynamic_update_index_in_dim(
+                                buf, vl, slot_f, 0)
+                            for buf, vl in zip(bufs, vleaves))
+                        loss_acc = loss_acc + jnp.where(f_active, loss_f,
+                                                        0.0)
+
+                        # read AFTER the write: the only same-tick
+                        # producer-consumer is the last virtual stage
+                        # (same job), whose just-written slot holds
+                        # exactly the wanted fresh residuals
+                        gathered = [
+                            lax.dynamic_index_in_dim(
+                                buf, slot_b, 0, keepdims=False)
+                            for buf in bufs]
+
+                        # -- backward sub-step: pullback rebuilt from
+                        # the slot + the chunk's weight-derived stack
+                        def do_bwd():
+                            leaves_b = [None] * len(leaf_var)
+                            for stk, i in zip(inv_stacks, inv_idx):
+                                leaves_b[i] = lax.dynamic_index_in_dim(
+                                    stk, c_b, 0, keepdims=False)
+                            for g, i in zip(gathered, var_idx):
+                                leaves_b[i] = g
+                            vjp_b = jax.tree_util.tree_unflatten(
+                                box['treedef'], leaves_b)
+                            g_out = jnp.where(
+                                jnp.logical_and(stage == pp - 1,
+                                                c_b == v - 1),
+                                jnp.zeros(act_shape, act_dtype),
+                                grad_in.astype(act_dtype))
+                            return vjp_b((g_out, grad_cot()))
+
+                        def skip_bwd():
+                            return ((jax.tree_util.tree_map(
+                                jnp.zeros_like, pe),
+                                jax.tree_util.tree_map(
+                                    lambda l: jnp.zeros(
+                                        (per,) + l.shape[1:], l.dtype),
+                                    pb),
+                                jax.tree_util.tree_map(
+                                    jnp.zeros_like, ph)),
+                                jnp.zeros(act_shape, act_dtype))
+
+                        (d_pe, d_pbc, d_ph), dx = lax.cond(
+                            t >= D - (pp - 1), do_bwd, skip_bwd)
+                        gacc = (accum_full(gacc[0], d_pe, b_active),
+                                accum_chunk(gacc[1], d_pbc, c_b,
+                                            b_active),
+                                accum_full(gacc[2], d_ph, b_active))
+                        dx = jnp.where(b_active, dx, jnp.zeros_like(dx))
+
+                        if pp > 1:
+                            nxt_act = lax.ppermute(
+                                out_f, 'pp',
+                                [(i, (i + 1) % pp) for i in range(pp)])
+                            nxt_grad = lax.ppermute(
+                                dx, 'pp',
+                                [(i, (i - 1) % pp) for i in range(pp)])
+                        else:
+                            nxt_act, nxt_grad = out_f, dx
+                        return (nxt_act, nxt_grad, bufs, gacc,
+                                loss_acc), None
+                else:
+                    # -- recompute interleaved (chunk-input buffer) ------
+                    carry0 = (jnp.zeros(act_shape, act_dtype),  # fwd act
+                              jnp.zeros(act_shape, act_dtype),  # cotangent
+                              jnp.zeros((nslots + 1,) + act_shape,
+                                        act_dtype),             # inputs buf
+                              gacc0,
+                              jnp.asarray(0.0, jnp.float32))    # loss acc
+
+                    def tick(carry, t):
+                        fwd_act, grad_in, buf, gacc, loss_acc = carry
+                        f_active, c_f, m_f = fwd_job(t)
+                        b_active, c_b, m_b = bwd_job(t)
+                        # read-before-write + same-JOB same-tick select
+                        x_read = lax.dynamic_index_in_dim(
+                            buf, c_b * K + jnp.mod(m_b, K), 0,
+                            keepdims=False)
+                        p_same = jnp.logical_and(
+                            jnp.logical_and(m_f == m_b, c_f == c_b),
+                            f_active)
+                        x_saved = jnp.where(p_same, fwd_act, x_read)
+
+                        def do_fwd():
+                            return fwd_only(
+                                pe, chunk_slice(pb, c_f), fwd_act,
+                                m_f, c_f, jax.random.fold_in(k0, m_f))
+
+                        out_f = lax.cond(
+                            t < A * v + pp - 1, do_fwd,
+                            lambda: jnp.zeros(act_shape, act_dtype))
+                        slot_f = jnp.where(
+                            f_active, c_f * K + jnp.mod(m_f, K), nslots)
+                        buf = lax.dynamic_update_index_in_dim(
+                            buf, fwd_act, slot_f, 0)
+
+                        # -- backward: re-run the chunk from its saved
+                        # input via a local vjp consumed this tick
+                        def do_bwd():
+                            k_b = jax.random.fold_in(k0, m_b)
+                            (_out_p, loss_p), vjp_fn = jax.vjp(
+                                lambda p3, x: full_fn(p3, x, m_b, c_b,
+                                                      k_b),
+                                (pe, chunk_slice(pb, c_b), ph), x_saved)
+                            g_out = jnp.where(
+                                jnp.logical_and(stage == pp - 1,
+                                                c_b == v - 1),
+                                jnp.zeros_like(_out_p),
+                                grad_in.astype(_out_p.dtype))
+                            d_p3, dx = vjp_fn((g_out, grad_cot()))
+                            return d_p3, dx, loss_p
+
+                        def skip_bwd():
+                            return ((jax.tree_util.tree_map(
+                                jnp.zeros_like, pe),
+                                jax.tree_util.tree_map(
+                                    lambda l: jnp.zeros(
+                                        (per,) + l.shape[1:], l.dtype),
+                                    pb),
+                                jax.tree_util.tree_map(
+                                    jnp.zeros_like, ph)),
+                                jnp.zeros(act_shape, act_dtype),
+                                jnp.asarray(0.0, jnp.float32))
+
+                        (d_pe, d_pbc, d_ph), dx, loss_p = lax.cond(
+                            t >= D - (pp - 1), do_bwd, skip_bwd)
+                        gacc = (accum_full(gacc[0], d_pe, b_active),
+                                accum_chunk(gacc[1], d_pbc, c_b,
+                                            b_active),
+                                accum_full(gacc[2], d_ph, b_active))
+                        loss_acc = loss_acc + jnp.where(b_active, loss_p,
+                                                        0.0)
+                        dx = jnp.where(b_active, dx, jnp.zeros_like(dx))
+
+                        if pp > 1:
+                            nxt_act = lax.ppermute(
+                                out_f, 'pp',
+                                [(i, (i + 1) % pp) for i in range(pp)])
+                            nxt_grad = lax.ppermute(
+                                dx, 'pp',
+                                [(i, (i - 1) % pp) for i in range(pp)])
+                        else:
+                            nxt_act, nxt_grad = out_f, dx
+                        return (nxt_act, nxt_grad, buf, gacc,
+                                loss_acc), None
+
+                (_, _, _, gacc, loss_sum), _ = lax.scan(
+                    tick, carry0, jnp.arange(T))
+                grads = {'embed': gacc[0], 'blocks': gacc[1],
+                         'head': gacc[2]}
+                return self._reduce_and_update(
+                    params, states, loss_sum / A, grads, lr, dp_on,
+                    scale=scale if use_scaling else None)
+
+        return self._finalize(step, dp_on)
+
     def _build_fthenb(self):
         A, pp = self.A, self.pp
         axes = self.axes
@@ -1429,6 +2131,22 @@ class SpmdPipelineEngine(A_.AsyncDispatchMixin, EngineTeardown):
             else jnp.asarray(input_ids)
         ll = labels.data if isinstance(labels, Tensor) \
             else jnp.asarray(labels)
+        # microbatching contract, checked up front: the step reshapes
+        # each dp rank's slice to [A, mb, ...] — a bad batch size used
+        # to surface as an opaque reshape traceback from inside the
+        # compiled step trace
+        n = int(ii.shape[0]) if ii.ndim else 0
+        if ll.ndim == 0 or int(ll.shape[0]) != n:
+            raise PipelineBatchError(
+                f"inputs and labels disagree on the batch dimension: "
+                f"{tuple(ii.shape)} vs {tuple(ll.shape)}")
+        dp = max(self.dp, 1)
+        if n == 0 or n % (dp * self.A):
+            raise PipelineBatchError(
+                f"batch size {n} is not divisible by dp({dp}) x "
+                f"accumulate_steps({self.A}); feed dp * A * "
+                "micro_batch_size rows per step (adjust "
+                "accumulate_steps or pipeline_configs)")
         want_scaling = scale is not None
         if not hasattr(self, '_compiled_by_mode'):
             self._compiled_by_mode = {}
@@ -1582,11 +2300,13 @@ class SpmdPipelineEngine(A_.AsyncDispatchMixin, EngineTeardown):
         for n, p in self._head_named:
             if n in self._params['head']:
                 p._data = self._params['head'][n]
-        for i, b in enumerate(self.blocks):
-            lookup = dict(b.named_parameters())
+        # stacked row i holds blocks[self._layer_order[i]] (chunk-major
+        # under the interleaved schedule; identity otherwise)
+        for row, j in enumerate(self._layer_order):
+            lookup = dict(self.blocks[j].named_parameters())
             for n, _ in self._block_named:
                 if n in self._params['blocks']:
-                    lookup[n]._data = self._params['blocks'][n][i]
+                    lookup[n]._data = self._params['blocks'][n][row]
         if getattr(self, '_pp_overlap', False):
             # reconstruct bucketed params from the [pp, size] flat
             # shards: blocks rows are stage-local slices in stage
@@ -1609,7 +2329,8 @@ class SpmdPipelineEngine(A_.AsyncDispatchMixin, EngineTeardown):
                             rows = host[k, s.offset:s.offset + s.size] \
                                 .reshape(s.shape)
                             for j in range(per):
-                                blk_lookup[k * per + j][n]._data = \
+                                blk_lookup[self._layer_order[
+                                    k * per + j]][n]._data = \
                                     jnp.asarray(rows[j])
                     else:
                         named = dict(self._embed_named if grp == 'embed'

@@ -880,6 +880,19 @@ class StepTelemetry:
             snap['remat'] = remat
         except Exception:
             snap['remat'] = None
+        # pipeline schedule census (ptpu_pp_* gauges): active schedule,
+        # virtual stages, tick counts and the modeled bubble fraction —
+        # docs/performance.md#pipeline-schedules. Gauge presence is
+        # checked first so sessions without a pipeline engine never pay
+        # the fleet import.
+        try:
+            snap['pipeline'] = None
+            if _monitor.metrics().get('ptpu_pp_ticks') is not None:
+                from .distributed.fleet.meta_parallel.spmd_pipeline \
+                    import pipeline_snapshot
+                snap['pipeline'] = pipeline_snapshot()
+        except Exception:
+            snap['pipeline'] = None
         return snap
 
 

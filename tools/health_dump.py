@@ -65,6 +65,7 @@ Usage:
     python tools/health_dump.py pallas --selftest    # pallas CI smoke
     python tools/health_dump.py mem --selftest       # mem CI smoke
     python tools/health_dump.py host --selftest      # async CI smoke
+    python tools/health_dump.py pp --selftest        # pipeline CI smoke
 """
 import argparse
 import json
@@ -1319,8 +1320,143 @@ def host_main(argv):
     return 0
 
 
+def _find_pp(doc):
+    """Locate a pipeline-schedule census: a schedule_model()/
+    pipeline_snapshot() record ({'schedule', 'bubble_fraction', ...})
+    in a bench leg's `pipeline` section, telemetry, or a
+    tools/pipeline_bench.py record (scale legs and `sweep` list
+    entries)."""
+    if isinstance(doc, list):
+        for v in doc:
+            found = _find_pp(v)
+            if found is not None:
+                return found
+        return None
+    if not isinstance(doc, dict):
+        return None
+    if 'bubble_fraction' in doc and 'schedule' in doc:
+        return doc
+    for key in ('pipeline', 'detail', 'telemetry'):
+        found = _find_pp(doc.get(key))
+        if found is not None:
+            return found
+    if 'legs' in doc:
+        for leg in (doc['legs'] or {}).values():
+            found = _find_pp(leg)
+            if found is not None:
+                return found
+    # pipeline_bench.py record: scale legs / sweep entries
+    for v in doc.values():
+        if isinstance(v, (dict, list)):
+            found = _find_pp(v)
+            if found is not None:
+                return found
+    return None
+
+
+def render_pp(p):
+    """Human view of the pipeline schedule census: schedule/virtual
+    stages, tick counts and the modeled bubble fraction
+    (docs/performance.md#pipeline-schedules)."""
+    v = int(p.get('virtual_stages') or 1)
+    out = ['Pipeline schedule (bubble view)']
+    out.append(
+        f"  schedule {p.get('schedule')}   pp {p.get('pp')}   "
+        f"virtual stages {v}   A {p.get('accumulate_steps')}"
+        + (f"   memory {p['memory_mode']}" if p.get('memory_mode')
+           else ''))
+    out.append(
+        f"  scan ticks {p.get('ticks')}   warmup "
+        f"{p.get('warmup_ticks', '-')}   chunk sub-steps "
+        f"{p.get('chunk_ticks')} (useful {p.get('useful_chunk_ticks')})")
+    bf = p.get('bubble_fraction')
+    out.append(
+        f"  modeled bubble fraction "
+        f"{_fmt_frac(bf)}   in-flight peak "
+        f"{p.get('inflight_peak', '-')} microbatches/device")
+    if p.get('ppermute_steps'):
+        out.append(
+            f"  ring traffic {p['ppermute_steps']} ppermute hops/step "
+            f"(~{v}x boundary crossings vs v=1)")
+    if p.get('ms_per_step') is not None:
+        out.append(
+            f"  measured {p['ms_per_step']}ms/step   "
+            f"{p.get('ms_per_tick')}ms/tick steady-state")
+    return '\n'.join(out)
+
+
+def _pp_selftest():
+    """CI smoke: schedule model -> ptpu_pp_* gauges -> snapshot ->
+    renderer, and the interleaved bubble shrink at iso (pp, A)."""
+    _repo_root_on_path()
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    from paddle_tpu.distributed.fleet.meta_parallel.spmd_pipeline import (
+        schedule_model, publish_schedule_gauges, pipeline_snapshot)
+
+    m1 = schedule_model('1F1B', 4, 8)
+    m2 = schedule_model('interleaved', 4, 8, 2)
+    assert m1['ticks'] == 8 + 2 * 3 and m1['slots_per_chunk'] == 7, m1
+    assert m2['bubble_fraction'] < m1['bubble_fraction'], (m1, m2)
+    # monotone in v at iso (pp, A)
+    m4 = schedule_model('interleaved', 4, 8, 4)
+    assert m4['bubble_fraction'] < m2['bubble_fraction']
+    publish_schedule_gauges(m2, engine='pipeline')
+    snap = pipeline_snapshot()
+    assert snap and snap['schedule'] == 'interleaved' \
+        and snap['virtual_stages'] == 2, snap
+    assert abs(snap['bubble_fraction'] - m2['bubble_fraction']) < 1e-9
+    text = render_pp(snap)
+    assert 'bubble fraction' in text and 'interleaved' in text, text
+    print(text)
+    # bench-record shape: the leg's pipeline section is found and
+    # rendered the same way
+    doc = {'legs': {'pp_sched': {'ms_per_step': 12.0,
+                                 'ms_per_tick': 0.5,
+                                 'pipeline': m2}}}
+    found = _find_pp(doc)
+    assert found is m2, found
+    text = render_pp({**found, 'ms_per_step': 12.0, 'ms_per_tick': 0.5})
+    assert 'ms/step' in text, text
+    print(text)
+    print('health_dump pp selftest: OK')
+    return 0
+
+
+def pp_main(argv):
+    ap = argparse.ArgumentParser(
+        prog='health_dump.py pp',
+        description='render the pipeline schedule census (schedule, '
+                    'virtual stages, tick counts, modeled bubble '
+                    'fraction) from a bench record or telemetry '
+                    'snapshot (docs/performance.md#pipeline-schedules)')
+    ap.add_argument('artifact', nargs='?',
+                    help='bench record / telemetry JSON')
+    ap.add_argument('--json', action='store_true')
+    ap.add_argument('--selftest', action='store_true')
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return _pp_selftest()
+    if not args.artifact:
+        ap.error('artifact path required (or --selftest)')
+    with open(args.artifact) as f:
+        doc = json.load(f)
+    ppdoc = _find_pp(doc)
+    if ppdoc is None:
+        raise ValueError(
+            'no pipeline-schedule census in this artifact (expected a '
+            "record with a 'pipeline' section — pipeline engines "
+            'publish one; tools/pipeline_bench.py records one per leg)')
+    if args.json:
+        print(json.dumps(ppdoc, indent=2))
+    else:
+        print(render_pp(ppdoc))
+    return 0
+
+
 def main(argv=None):
     argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == 'pp':
+        return pp_main(argv[1:])
     if argv and argv[0] == 'host':
         return host_main(argv[1:])
     if argv and argv[0] == 'mem':

@@ -46,6 +46,13 @@
 #                      schedule equivalence incl. mid-schedule resume,
 #                      GradScaler deferred found-inf accounting,
 #                      host-gap gauge rendering
+#   --pp-selftest - interleaved virtual-stage pipeline schedule
+#                      (ISSUE 14): round-robin chunk partition units,
+#                      interleaved v2 == 1F1B bit-identity (pp2 +
+#                      dp2xpp2, stash/recompute memory modes, scaler
+#                      found-inf path, remat composition, sync_model
+#                      cross-restore), bubble-model census + ptpu_pp_*
+#                      gauge rendering, true 2-rank subprocess leg
 set -e
 cd "$(dirname "$0")/.."
 TIER="${1:-all}"
@@ -57,7 +64,7 @@ case "$TIER" in
             tests/test_fused_primitives.py tests/test_overlap.py \
             tests/test_serving.py tests/test_serving_trace.py \
             tests/test_serving_cluster.py tests/test_remat.py \
-            tests/test_async_step.py -q
+            tests/test_async_step.py tests/test_pipeline_schedule.py -q
           # observability tooling smoke: tracer -> export -> summary CLI
           python tools/trace_summary.py --selftest
           # diagnostics smoke: flight recorder -> hang/OOM reports -> CLI
@@ -73,7 +80,9 @@ case "$TIER" in
           # pallas smoke: fused primitives -> route counters -> render
           python tools/health_dump.py pallas --selftest
           # async smoke: windowed loop -> host-gap gauges -> render
-          python tools/health_dump.py host --selftest ;;
+          python tools/health_dump.py host --selftest
+          # pipeline smoke: schedule model -> pp gauges -> render
+          python tools/health_dump.py pp --selftest ;;
   dist)   python -m pytest tests/test_distributed.py \
             tests/test_launch_elastic.py tests/test_bert_zero_asp.py -q ;;
   native) python -m pytest tests/test_native.py tests/test_ps.py -q ;;
@@ -145,6 +154,13 @@ case "$TIER" in
           XLA_FLAGS="--xla_force_host_platform_device_count=8" \
           python -m pytest tests/test_async_step.py -q
           python tools/health_dump.py host --selftest ;;
+  --pp-selftest)
+          # the interleaved schedule end to end (ISSUE 14): partition/
+          # bubble-model units, v2==v1 bit-identity legs incl. the
+          # true 2-rank subprocess leg, then the census CLI smoke
+          XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+          python -m pytest tests/test_pipeline_schedule.py -q
+          python tools/health_dump.py pp --selftest ;;
   all)    python -m pytest tests/ -q
           python tools/trace_summary.py --selftest
           python tools/health_dump.py --selftest
@@ -154,6 +170,7 @@ case "$TIER" in
           python tools/health_dump.py cluster --selftest
           python tools/health_dump.py pallas --selftest
           python tools/health_dump.py mem --selftest
-          python tools/health_dump.py host --selftest ;;
-  *) echo "usage: $0 [fast|dist|native|e2e|all|--comm-selftest|--serve-selftest|--quant-selftest|--pallas-selftest|--overlap-selftest|--cluster-selftest|--remat-selftest|--async-selftest]"; exit 1 ;;
+          python tools/health_dump.py host --selftest
+          python tools/health_dump.py pp --selftest ;;
+  *) echo "usage: $0 [fast|dist|native|e2e|all|--comm-selftest|--serve-selftest|--quant-selftest|--pallas-selftest|--overlap-selftest|--cluster-selftest|--remat-selftest|--async-selftest|--pp-selftest]"; exit 1 ;;
 esac
