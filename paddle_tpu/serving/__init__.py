@@ -8,9 +8,12 @@ decodes / preempts requests across fixed-shape jitted steps
 refcounted pages (requests sharing a system prompt map the same
 physical pages and skip its prefill) plus n-gram speculative decoding
 (a `[max_batch, spec_k+1]` verify step advances greedy requests
-several tokens per dispatch, token-identically), and the ragged
-paged-attention Pallas kernel (`ops/pallas/paged_attention.py`) those
-steps call. Metrics
+several tokens per dispatch, token-identically), the multi-tenant
+SLO layer (priority classes, token-bucket quotas, deadline-aware
+admission, charged preemption, and the graceful-degradation ladder —
+`ServingConfig(tenants=...)`, docs/serving.md#multi-tenant), and the
+ragged paged-attention Pallas kernel
+(`ops/pallas/paged_attention.py`) those steps call. Metrics
 publish as `ptpu_serve_*` gauges + SLO percentile histograms through
 core.monitor (`metrics.py`), surfaced in
 `profiler.StepTelemetry.snapshot()['serve']` and rendered by
@@ -20,8 +23,9 @@ scheduler timeline, and the stalled-request watchdog live in
 docs/serving.md.
 """
 from .kv_pool import KVPagePool, PoolExhausted
-from .scheduler import (Request, RequestState, Scheduler,
-                        SchedulerTimeline)
+from .scheduler import (AdmissionRejected, DegradeLadder, Request,
+                        RequestState, Scheduler, SchedulerTimeline,
+                        TenantTable, TokenBucket)
 from .engine import ServingConfig, ServingEngine
 from .request_trace import (RequestTracer, load_trace, reconstruct,
                             render_serve_report)
@@ -30,6 +34,7 @@ from . import metrics
 __all__ = [
     'KVPagePool', 'PoolExhausted', 'Request', 'RequestState',
     'Scheduler', 'SchedulerTimeline', 'ServingConfig', 'ServingEngine',
+    'AdmissionRejected', 'DegradeLadder', 'TenantTable', 'TokenBucket',
     'RequestTracer', 'load_trace', 'reconstruct',
     'render_serve_report', 'metrics',
 ]

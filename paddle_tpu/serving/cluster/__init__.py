@@ -32,7 +32,11 @@ from .replica import LocalReplica, RemoteReplica, ReplicaWorker
 from .disagg import DisaggregatedEngine, build_engine
 from .page_stream import stream_kv_pages
 
-__all__ = ['ClusterRouter', 'RouterRejected', 'RoutedRequest',
-           'cluster_snapshot', 'LocalReplica', 'RemoteReplica',
-           'ReplicaWorker', 'DisaggregatedEngine', 'build_engine',
-           'stream_kv_pages']
+# the router's descriptive name (ISSUE 15 forwards tenancy through
+# it); ClusterRouter remains the historical alias
+PrefixAffinityRouter = ClusterRouter
+
+__all__ = ['ClusterRouter', 'PrefixAffinityRouter', 'RouterRejected',
+           'RoutedRequest', 'cluster_snapshot', 'LocalReplica',
+           'RemoteReplica', 'ReplicaWorker', 'DisaggregatedEngine',
+           'build_engine', 'stream_kv_pages']

@@ -33,7 +33,8 @@ having.
 import math
 
 from ..engine import ServingConfig, ServingEngine
-from ..scheduler import RequestState
+from ..kv_pool import PoolExhausted
+from ..scheduler import AdmissionRejected, RequestState
 from ...core import monitor as _m
 from .page_stream import stream_kv_pages
 
@@ -74,6 +75,17 @@ class DisaggregatedEngine:
                         clock=self.decode._clock)
         self.prefill = ServingEngine(model, pcfg, mesh=mesh)
         self.prefill.tracer = self.decode.tracer
+        # the facade checks deadline admission itself (combined
+        # backlogs at the decode rate, submit() below) — the prefill
+        # engine's local re-check would see neither and could
+        # spuriously reject once its own decode rate turns nonzero
+        self.prefill.deadline_admission = False
+        # ONE degradation ladder for the pipeline: both engines feed
+        # it their pressure and read one consistent stage, so the
+        # prefill-side lever (chunk shrink) and the decode-side one
+        # (spec shed) move together and each transition traces once
+        if self.decode._ladder is not None:
+            self.prefill._ladder = self.decode._ladder
         # one publisher: the global ptpu_serve_* gauges reflect the
         # decode engine (where requests retire and most SLO samples
         # land); the prefill side's pending histogram samples (TTFT is
@@ -85,6 +97,15 @@ class DisaggregatedEngine:
             for k, v in eng._new_slo.items():
                 self.decode._new_slo[k].extend(v)
                 v.clear()
+            # tenant-labeled samples too (ISSUE 15): a tenanted
+            # request aborted prefill-side must still reach the
+            # ptpu_serve_tenant_* histograms
+            for tid, d in eng._new_tenant_slo.items():
+                dst = self.decode._new_tenant_slo.setdefault(
+                    tid, {'queue_wait_s': [], 'e2e_s': []})
+                for k, v in d.items():
+                    dst[k].extend(v)
+                    v.clear()
             eng._last_publish = eng._clock()
         self.prefill.publish_metrics = _forward_publish
         self._pending = []          # prefilled, waiting for a slot
@@ -125,6 +146,31 @@ class DisaggregatedEngine:
                    if r is not None])
 
     def submit(self, prompt_ids, **kw):
+        # deadline-aware admission (ISSUE 15) against the WHOLE
+        # pipeline: the prefill engine's own estimate only sees its
+        # side (and its decode rate is unrepresentative — requests
+        # hand off right after prefill), so estimate here with the
+        # decode engine's observed rate over both backlogs; the
+        # prefill engine's own check is disabled (deadline_admission
+        # = False above), so this is the ONE gate
+        deadline = kw.get('deadline_s')
+        if deadline is not None:
+            rate = self.decode.decode_rate()
+            if rate > 0.0:
+                bill = len(prompt_ids) + int(kw.get('max_new_tokens',
+                                                    32))
+                est = (self.prefill.pending_tokens()
+                       + self.decode.pending_tokens() + bill) / rate
+                if est > deadline:
+                    self.decode._deadline_rejects += 1
+                    tid = kw.get('tenant_id')
+                    if tid is not None:
+                        self.decode._tstat(tid)['deadline_rejects'] \
+                            += 1
+                    raise AdmissionRejected(
+                        'deadline_unmet',
+                        retry_after_s=est - deadline,
+                        estimated_s=est, deadline_s=deadline)
         return self.prefill.submit(prompt_ids, **kw)
 
     def step(self):
@@ -153,8 +199,23 @@ class DisaggregatedEngine:
         cached = dst_pool.match_and_map(req.id, req.tokens, limit=L)
         n_cached = cached // ps
         # decode-pool pressure preempts decode-side victims, exactly
-        # like a local prefill allocation would
-        self.decode._ensure_or_preempt(req, L)
+        # like a local prefill allocation would. With tenants, every
+        # decode resident may outrank this request (no victim, and the
+        # engine's yield path can't fire — req holds a PREFILL slot,
+        # not a decode one): DEFER the handoff instead of letting
+        # PoolExhausted crash the step loop — req keeps its prefill
+        # slot and pages, and this scan retries next sweep once decode
+        # residents retire. An empty decode slot table means nobody
+        # will ever free pages — that is the genuine too-big case and
+        # still raises.
+        try:
+            self.decode._ensure_or_preempt(req, L)
+        except PoolExhausted:
+            if not any(r is not None
+                       for r in self.decode.scheduler.slots):
+                raise
+            dst_pool.release(req.id)    # drop the mapped/partial pages
+            return
         dst_pages = dst_pool.page_table(req.id)
         n = min(len(src_pages), len(dst_pages))
         if n > n_cached:
@@ -213,6 +274,20 @@ class DisaggregatedEngine:
         s['prefix_hits_total'] += ps['prefix_hits_total']
         s['prefix_misses_total'] += ps['prefix_misses_total']
         s['prefix_hit_tokens_total'] += ps['prefix_hit_tokens_total']
+        # tenancy accounting happens where admission runs — the
+        # PREFILL engine (quota debits/deferrals, deadline misses);
+        # decode-side rows carry charged preemptions from handoff
+        # pressure. Merge both so the published gauges see the truth.
+        for key in ('quota_deferrals_total', 'preemptions_charged_total',
+                    'deadline_rejects_total', 'deadline_misses_total'):
+            s[key] += ps[key]
+        for tid, row in ps['tenancy'].get('tenants', {}).items():
+            dst = s['tenancy']['tenants'].setdefault(tid, {})
+            for k, v in row.items():
+                if k in ServingEngine._blank_tstat():
+                    dst[k] = dst.get(k, 0) + v
+                else:
+                    dst.setdefault(k, v)
         s['pd_prefill_pool'] = {
             'pages_in_use': ps['pool']['pages_in_use'],
             'high_water': ps['pool']['high_water'],

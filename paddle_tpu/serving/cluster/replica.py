@@ -32,7 +32,7 @@ import sys
 import threading
 import time
 
-from ..scheduler import RequestState
+from ..scheduler import AdmissionRejected, RequestState
 from .channel import ControlClient, ControlServer
 from .disagg import DisaggregatedEngine, build_engine
 
@@ -171,6 +171,7 @@ class _EngineHost:
                 'in_flight': len(live),
                 'pending_tokens': pending_tokens,
                 'decode_tokens_per_sec': rate,
+                'degrade_stage': eng.degrade_stage(),
                 'timeline': eng.timeline.summary(),
                 'pool': {'pages_in_use': eng.pool.pages_in_use,
                          'num_pages': eng.pool.num_pages},
@@ -249,9 +250,21 @@ class ReplicaWorker(_EngineHost):
     def _handle(self, msg):
         op = msg.get('op')
         if op == 'submit':
-            return {'rid': self.submit(msg['prompt'],
-                                       msg.get('opts') or {},
-                                       msg.get('route'))}
+            try:
+                return {'rid': self.submit(msg['prompt'],
+                                           msg.get('opts') or {},
+                                           msg.get('route'))}
+            except AdmissionRejected as e:
+                # structured refusal, NOT a channel error: the engine
+                # turned the request away (deadline-aware admission,
+                # ISSUE 15) — the router must re-raise it as a
+                # RouterRejected with the hint, not drain a healthy
+                # replica
+                return {'rejected': {
+                    'reason': e.reason,
+                    'retry_after_s': e.retry_after_s,
+                    'estimated_s': e.estimated_s,
+                    'deadline_s': e.deadline_s}}
         if op == 'poll':
             return {'reqs': self.poll()}
         if op == 'status':
@@ -295,6 +308,7 @@ class ReplicaWorker(_EngineHost):
                               if r.state not in _TERMINAL]),
             'pending_tokens': 0,
             'decode_tokens_per_sec': 0.0,
+            'degrade_stage': 0,
             'timeline': {},
             'pool': {},
             'prefix_digest': None,      # keep the router's last view
@@ -471,10 +485,18 @@ class RemoteReplica:
         return cls(replica_id, '127.0.0.1', port, proc=proc)
 
     def submit(self, prompt, opts, route_meta=None):
-        return self.client.call({'op': 'submit',
-                                 'prompt': [int(t) for t in prompt],
-                                 'opts': opts,
-                                 'route': route_meta})['rid']
+        reply = self.client.call({'op': 'submit',
+                                  'prompt': [int(t) for t in prompt],
+                                  'opts': opts,
+                                  'route': route_meta})
+        rej = reply.get('rejected')
+        if rej is not None:
+            raise AdmissionRejected(
+                rej.get('reason', 'rejected'),
+                retry_after_s=rej.get('retry_after_s'),
+                estimated_s=rej.get('estimated_s'),
+                deadline_s=rej.get('deadline_s'))
+        return reply['rid']
 
     def poll(self):
         return self.client.call({'op': 'poll'}, timeout=30.0)['reqs']

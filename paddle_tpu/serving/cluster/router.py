@@ -44,12 +44,29 @@ import itertools
 import time
 
 from ..kv_pool import chain_hashes
+from ..scheduler import AdmissionRejected
 from ...core import monitor as _m
 
 
 class RouterRejected(RuntimeError):
     """All replicas over their backpressure/deadline bound — retry
-    later (the cluster is telling you now, not after the deadline)."""
+    later (the cluster is telling you now, not after the deadline).
+
+    Structured (ISSUE 15 satellite): `reason` is machine-readable
+    ('backpressure' | 'deadline_unmet' | 'no_healthy_replicas'),
+    `retry_after_s` the router's own estimate of when a retry can
+    land — computed from observed per-replica decode rates and queue
+    depths (time until the fastest replica finishes one queued
+    request), or forwarded from an engine-side AdmissionRejected.
+    None when nothing is known (cold cluster / no healthy replicas).
+    serve() backs off by the hint instead of a fixed sleep; the bench
+    leg records hint accuracy."""
+
+    def __init__(self, message, reason='backpressure',
+                 retry_after_s=None):
+        super().__init__(message)
+        self.reason = reason
+        self.retry_after_s = retry_after_s
 
 
 _route_ids = itertools.count()
@@ -147,6 +164,10 @@ class ClusterRouter:
         self.drain_events = []
         self.decisions = {k: 0 for k in _COUNTERS if k != 'reject'}
         self.rejects = 0
+        # per-tenant spill accounting (ISSUE 15): affinity placements
+        # a tenant lost to backpressure — a heavy tenant saturating
+        # its affinity replica shows up here, not in global spills
+        self.tenant_spills = {}
 
     OPTIMISTIC_GENERATIONS = 2
 
@@ -191,6 +212,25 @@ class ClusterRouter:
                     return True
         return False
 
+    def _retry_hint(self):
+        """The structured RouterRejected back-off hint: for each
+        healthy replica, pending_tokens / observed decode rate is its
+        backlog's drain time, and one queue slot frees after roughly
+        backlog / queue_depth of it — take the fastest replica's
+        estimate. None on a cold cluster (no decode rate observed
+        yet); the bench leg records how accurate this is against the
+        actually-measured wait."""
+        best = None
+        for rid in self.healthy_replicas():
+            st = self._status.get(rid) or {}
+            rate = st.get('decode_tokens_per_sec') or 0.0
+            if rate <= 0.0:
+                continue
+            depth = max(self._queue_depth(rid), 1)
+            t = st.get('pending_tokens', 0) / rate / depth
+            best = t if best is None else min(best, t)
+        return best
+
     # -- placement -----------------------------------------------------------
     def _affinity_depth(self, hashes, rid):
         digest = self._digest.get(rid) or ()
@@ -212,7 +252,8 @@ class ClusterRouter:
         if not healthy:
             if count_reject:
                 self._count('reject')
-            raise RouterRejected("no healthy replicas")
+            raise RouterRejected("no healthy replicas",
+                                 reason='no_healthy_replicas')
         hashes = _hashes if _hashes is not None else chain_hashes(
             prompt, self.page_size, limit=len(prompt) - 1)
         depths = {rid: self._affinity_depth(hashes, rid)
@@ -221,11 +262,15 @@ class ClusterRouter:
         if not open_replicas:
             if count_reject:
                 self._count('reject')
+            hint = self._retry_hint()
             raise RouterRejected(
                 f"all {len(healthy)} replicas over the backpressure "
                 f"bound (max_queue={self.max_queue}"
                 + (f", deadline_bound_s={self.deadline_bound_s}"
-                   if self.deadline_bound_s is not None else '') + ")")
+                   if self.deadline_bound_s is not None else '')
+                + (f"; retry in ~{hint:.3f}s" if hint is not None
+                   else '') + ")",
+                reason='backpressure', retry_after_s=hint)
         maxdepth = max(depths.values())
         if maxdepth > 0:
             # deepest shared prefix wins; ties go to the lighter one
@@ -245,7 +290,14 @@ class ClusterRouter:
     def submit(self, prompt, **opts):
         """Place + submit one request; returns the RoutedRequest (or
         raises RouterRejected). Refreshes stale replica status first
-        so placement never runs on a dead signal."""
+        so placement never runs on a dead signal.
+
+        Tenancy flows THROUGH the router (ISSUE 15): tenant_id /
+        priority / deadline_s ride in `opts` to the replica's engine
+        untouched. An engine-side deadline rejection (AdmissionRejected
+        — the replica is healthy, the deadline just can't be met)
+        re-raises as a structured RouterRejected carrying the engine's
+        own retry hint, WITHOUT draining the replica."""
         self.refresh(max_age_s=self.refresh_interval_s)
         hashes = chain_hashes(prompt, self.page_size,
                               limit=len(prompt) - 1)
@@ -255,6 +307,12 @@ class ClusterRouter:
             decision, rid = self.place(prompt, _hashes=hashes)
             try:
                 self._dispatch(req, rid, decision, hashes=hashes)
+            except AdmissionRejected as e:
+                self._count('reject')
+                raise RouterRejected(
+                    f"replica {rid} rejected at admission: {e}",
+                    reason=e.reason,
+                    retry_after_s=e.retry_after_s) from e
             except Exception as e:          # noqa: BLE001
                 # the chosen replica died between refresh and
                 # dispatch: drain it (its other in-flight requests
@@ -266,6 +324,11 @@ class ClusterRouter:
                                        f'{repr(e)[:120]}')
                 continue
             self._count(decision)
+            if decision == 'spill':
+                tid = opts.get('tenant_id')
+                if tid is not None:
+                    self.tenant_spills[str(tid)] = \
+                        self.tenant_spills.get(str(tid), 0) + 1
             return req
 
     def _dispatch(self, req, rid, decision, hashes=None):
@@ -506,10 +569,12 @@ class ClusterRouter:
 
         Unlike raw `submit()` (the reject-early surface for callers
         who can retry), serve() THROTTLES on RouterRejected: it pumps
-        the replicas until queues drain below the bound and retries,
-        so a long batch never strands its already-placed prefix
-        mid-submission. A rejection with no progress possible (no
-        healthy replicas) still escapes via the timeout."""
+        the replicas and retries, backing off by the rejection's OWN
+        `retry_after_s` hint (ISSUE 15 — pump until the hinted window
+        elapses, then re-place) instead of hammering resubmits every
+        pump; a hint-less rejection retries after one pump as before.
+        A rejection with no progress possible (no healthy replicas)
+        still escapes via the timeout."""
         t0 = self._clock()
         reqs = []
         for p in prompts:
@@ -517,13 +582,31 @@ class ClusterRouter:
                 try:
                     reqs.append(self.submit(p, **opts))
                     break
-                except RouterRejected:
+                except RouterRejected as rej:
                     if self._clock() - t0 > timeout_s:
                         raise
-                    self.refresh(max_age_s=self.refresh_interval_s)
-                    self.pump()
+                    self._backoff(rej.retry_after_s,
+                                  deadline=t0 + timeout_s)
         self.run(timeout_s=max(timeout_s - (self._clock() - t0), 1.0))
         return [r.output_ids() for r in reqs]
+
+    def _backoff(self, retry_after_s, deadline):
+        """Pump the cluster through a rejection's back-off window:
+        local replicas keep stepping (their queues ARE the reason for
+        the rejection), remote ones get polled, and an unproductive
+        pass sleeps briefly instead of hot-looping the control plane.
+        Returns once the hinted window elapses (one pump minimum) or
+        the caller's deadline arrives."""
+        t0 = self._clock()
+        while True:
+            self.refresh(max_age_s=self.refresh_interval_s)
+            self.pump()
+            now = self._clock()
+            if retry_after_s is None or now - t0 >= retry_after_s \
+                    or now >= deadline:
+                return
+            if not self._pump_progressed:
+                time.sleep(min(retry_after_s, 0.005))
 
     # -- views ---------------------------------------------------------------
     def snapshot(self):
@@ -544,6 +627,7 @@ class ClusterRouter:
                 'decode_tokens': tl.get('decode_tokens'),
                 'prefill_tokens': tl.get('prefill_tokens'),
                 'preemptions': tl.get('preemptions'),
+                'degrade_stage': st.get('degrade_stage', 0),
                 'digest_size': len(self._digest.get(rid) or ())
                 + len(self._optimistic.get(rid) or ()),
                 'requests_routed': self._routed_count[rid],
@@ -560,6 +644,7 @@ class ClusterRouter:
             'drain_events': list(self.drain_events),
             'requests': self._total_requests,
             'requests_done': self._done_requests,
+            'tenant_spills': dict(self.tenant_spills),
         }
 
     def request_slo(self):

@@ -41,10 +41,11 @@ import time
 import numpy as np
 
 from .kv_pool import KVPagePool, PoolExhausted, _np_dtype
-from .scheduler import (Request, RequestState, Scheduler,
-                        SchedulerTimeline)
-from .request_trace import (RequestTracer, build_serve_report,
-                            write_serve_report)
+from .scheduler import (AdmissionRejected, DegradeLadder, Request,
+                        RequestState, Scheduler, SchedulerTimeline,
+                        TenantTable)
+from .request_trace import (ENGINE_REQ, RequestTracer,
+                            build_serve_report, write_serve_report)
 from . import metrics as _metrics
 from ..profiler import RecordEvent
 
@@ -125,6 +126,28 @@ class ServingConfig:
     stream_chunk_pages  pages per streamed copy op (0 = one shot) —
                      bounds the handoff's staging footprint like the
                      PR-10 chunked collectives
+    tenants          multi-tenant policy map (ISSUE 15, default None):
+                     {tenant_id: {priority, quota_tokens_per_s,
+                     burst_tokens, weight}}. priority (int, larger =
+                     more important) orders admission and bounds
+                     preemption; quota_tokens_per_s feeds a refillable
+                     token bucket debited at admit (over-quota tenants
+                     DEFER, never drop); weight drives stage-3
+                     prefix-cache eviction. Unknown/anonymous tenants
+                     get priority 0, no quota, weight 1.0. With no
+                     tenants declared scheduling is IDENTICAL to the
+                     untenanted engine (docs/serving.md#multi-tenant)
+    degrade          graceful-degradation ladder: None (default) =
+                     auto (on exactly when `tenants` is set), or an
+                     explicit bool. Stages under sustained pressure:
+                     1 sheds speculative decoding, 2 halves the
+                     prefill chunk, 3 evicts prefix-cache subtrees by
+                     tenant weight; walks back down hysteretically
+    degrade_window   pressure-signal window (iterations)
+    degrade_up       stage up-thresholds (windowed mean pressure)
+    degrade_down     stage down-thresholds (must sit below their
+                     up-threshold — the hysteresis band)
+    degrade_hold     consecutive calm iterations before stepping down
     """
 
     def __init__(self, page_size=16, max_batch_size=4, num_pages=None,
@@ -135,7 +158,9 @@ class ServingConfig:
                  timeline_capacity=2048, request_deadline_s=None,
                  deadline_action='report', report_dir=None, clock=None,
                  disaggregate=False, prefill_slots=2,
-                 stream_chunk_pages=0):
+                 stream_chunk_pages=0, tenants=None, degrade=None,
+                 degrade_window=8, degrade_up=(0.85, 0.92, 0.97),
+                 degrade_down=(0.60, 0.70, 0.80), degrade_hold=4):
         if page_size <= 0 or max_batch_size <= 0 or prefill_chunk <= 0:
             raise ValueError("page_size, max_batch_size and "
                              "prefill_chunk must be positive")
@@ -170,6 +195,28 @@ class ServingConfig:
         self.disaggregate = bool(disaggregate)
         self.prefill_slots = int(prefill_slots)
         self.stream_chunk_pages = int(stream_chunk_pages)
+        if tenants is not None and not isinstance(tenants, dict):
+            raise ValueError("tenants must be a {tenant_id: policy} "
+                             "dict or None")
+        self.tenants = dict(tenants) if tenants is not None else None
+        if degrade not in (None, True, False):
+            raise ValueError("degrade must be None (auto), True or "
+                             "False")
+        self.degrade = degrade
+        self.degrade_window = int(degrade_window)
+        self.degrade_up = tuple(degrade_up)
+        self.degrade_down = tuple(degrade_down)
+        self.degrade_hold = int(degrade_hold)
+
+    @property
+    def degrade_enabled(self):
+        """The ladder's effective switch: explicit bool wins, None
+        means on exactly when tenants are declared — the untenanted
+        default must keep today's behavior (and compiled step shapes)
+        bit-for-bit."""
+        if self.degrade is None:
+            return self.tenants is not None
+        return self.degrade
 
 
 class ServingEngine:
@@ -317,6 +364,30 @@ class ServingEngine:
         self._new_slo = {'queue_wait_s': [], 'tpot_s': [], 'e2e_s': [],
                          'preemptions': []}
         self._last_publish = 0.0
+        # multi-tenant SLO layer (ISSUE 15): policy table (priority /
+        # quota buckets / eviction weights), the degradation ladder,
+        # and per-tenant lifetime accounting. All None/zero when no
+        # tenants are configured — the default engine pays one
+        # attribute check per sweep and nothing else.
+        self._tenants = (TenantTable(config.tenants, clock=self._clock)
+                         if config.tenants is not None else None)
+        self._ladder = (DegradeLadder(
+            window=config.degrade_window, up=config.degrade_up,
+            down=config.degrade_down, hold=config.degrade_hold,
+            clock=self._clock) if config.degrade_enabled else None)
+        self._quota_deferrals = 0
+        self._preemptions_charged = 0
+        self._deadline_rejects = 0
+        self._deadline_misses = 0
+        self._tenant_stats = {}
+        # per-tenant SLO samples pending the next histogram publish
+        # (tenant-labeled ptpu_serve_tenant_* histograms)
+        self._new_tenant_slo = {}
+        # deadline-aware admission switch: the disaggregated facade
+        # turns it OFF on its prefill engine (whose local backlog and
+        # decode rate misrepresent the pipeline) and checks the
+        # combined estimate itself before forwarding the submit
+        self.deadline_admission = True
 
     # followers a budget-blocked queue head tolerates being admitted
     # past it before the admission sweep reverts to blocking at the
@@ -329,12 +400,101 @@ class ServingEngine:
     # (retire and drain always publish immediately)
     PUBLISH_INTERVAL_S = 0.5
 
+    # -- tenancy helpers -----------------------------------------------------
+    @staticmethod
+    def _blank_tstat():
+        return {'submitted': 0, 'completed': 0, 'aborted': 0,
+                'quota_deferrals': 0, 'preemptions_charged': 0,
+                'charge_tokens': 0, 'deadline_rejects': 0,
+                'deadline_misses': 0, 'tokens_billed': 0}
+
+    def _tstat(self, tenant_id):
+        """Per-tenant lifetime accounting row (created on first use —
+        WRITE paths only; read paths use _tenant_stats.get so a stats
+        call never materializes rows for traffic that never came)."""
+        tid = str(tenant_id)
+        st = self._tenant_stats.get(tid)
+        if st is None:
+            st = self._tenant_stats[tid] = self._blank_tstat()
+        return st
+
+    def decode_rate(self):
+        """Observed decode throughput (generated tokens/sec), 0.0 until
+        the first measured decode step."""
+        return (self._decode_tokens / self._decode_time
+                if self._decode_time else 0.0)
+
+    def pending_tokens(self):
+        """Tokens of work already accepted but not yet computed:
+        un-prefilled prompt + remaining generation budget across the
+        queue and the slots — the backlog a new request queues behind
+        (the replica status() math, shared with deadline admission)."""
+        reqs = ([r for r in self.scheduler.slots if r is not None]
+                + list(self.scheduler.waiting))
+        return sum(max(r.max_new_tokens - len(r.generated), 0)
+                   + max(len(r.tokens) - r.prefilled, 0)
+                   for r in reqs)
+
+    def _estimate_completion_s(self, extra_tokens):
+        """Estimated seconds until a request of `extra_tokens` total
+        work would complete behind the current backlog — the PR-11
+        router deadline_bound_s math moved down into the engine. None
+        while no decode rate has been observed (a cold engine admits;
+        rejecting on zero data would refuse the first request)."""
+        rate = self.decode_rate()
+        if rate <= 0.0:
+            return None
+        return (self.pending_tokens() + extra_tokens) / rate
+
+    def degrade_stage(self):
+        return self._ladder.stage if self._ladder is not None else 0
+
+    def _effective_spec_k(self):
+        """Ladder stage 1+ sheds speculative decoding — a pure-
+        throughput optimization whose draft verify columns cost pool
+        pages and step FLOPs the overloaded engine needs elsewhere
+        (outputs are spec-invariant by the PR-9 bar, so shedding is
+        invisible in tokens)."""
+        if self._ladder is not None and self._ladder.stage >= 1:
+            return 0
+        return self.config.spec_k
+
+    def _effective_prefill_chunk(self):
+        """Ladder stage 2+ halves the prefill chunk (floor: one page):
+        new requests trade TTFT for the running set's TPOT — each
+        sweep spends less of the step on prefill FLOPs. A distinct
+        compiled shape (1, chunk//2), warmed on first use and gauged
+        via the stage transition."""
+        C = self.config.prefill_chunk
+        if self._ladder is not None and self._ladder.stage >= 2:
+            # never LARGER than the configured chunk: with page_size >
+            # prefill_chunk the floor would otherwise grow the chunk
+            # (and compile a never-warmed bigger shape) mid-overload
+            return min(C, max(self.pool.page_size, C // 2))
+        return C
+
+    def ladder_history(self):
+        """Stage-transition events [{t, from, to, pressure}] — the
+        bench leg's ladder timeline."""
+        return list(self._ladder.history) if self._ladder else []
+
     # -- request intake ------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens=32, eos_token_id=None,
-               temperature=1.0, top_k=0):
+               temperature=1.0, top_k=0, tenant_id=None, priority=None,
+               deadline_s=None):
+        """Queue one request. `tenant_id`/`priority`/`deadline_s` are
+        the multi-tenant knobs (ISSUE 15): priority defaults to the
+        tenant's policy class (explicit values override), and a
+        deadline the backlog already makes unmeetable REJECTS here with
+        a structured AdmissionRejected (retry_after_s hint) instead of
+        queueing to certain failure."""
+        if priority is None:
+            priority = (self._tenants.priority_of(tenant_id)
+                        if self._tenants is not None else 0)
         req = Request(prompt_ids, max_new_tokens=max_new_tokens,
                       eos_token_id=eos_token_id, temperature=temperature,
-                      top_k=top_k)
+                      top_k=top_k, tenant_id=tenant_id,
+                      priority=priority, deadline_s=deadline_s)
         total = len(req.prompt) + req.max_new_tokens
         if total > self.max_pages_per_seq * self.pool.page_size:
             raise ValueError(
@@ -352,11 +512,30 @@ class ServingEngine:
                 f"prompt({len(req.prompt)}) + max_new_tokens"
                 f"({req.max_new_tokens}) exceeds max_seq_len"
                 f"({self.model.config.max_seq_len})")
+        if req.deadline_s is not None and self.deadline_admission:
+            est = self._estimate_completion_s(total)
+            if est is not None and est > req.deadline_s:
+                self._deadline_rejects += 1
+                if req.tenant_id is not None:
+                    self._tstat(req.tenant_id)['deadline_rejects'] += 1
+                raise AdmissionRejected(
+                    'deadline_unmet',
+                    retry_after_s=est - req.deadline_s,
+                    estimated_s=est, deadline_s=req.deadline_s)
         self.scheduler.submit(req)
         self._submitted += 1
+        if req.tenant_id is not None:
+            self._tstat(req.tenant_id)['submitted'] += 1
+        fields = {}
+        if req.tenant_id is not None:
+            fields['tenant_id'] = req.tenant_id
+        if req.priority:
+            fields['priority'] = req.priority
+        if req.deadline_s is not None:
+            fields['deadline_s'] = req.deadline_s
         self._trace(req, 'submit', t=req.submit_time,
                     prompt_tokens=len(req.prompt),
-                    max_new_tokens=req.max_new_tokens)
+                    max_new_tokens=req.max_new_tokens, **fields)
         return req
 
     def _trace(self, req, event, t=None, **fields):
@@ -411,6 +590,7 @@ class ServingEngine:
                 # the surviving rows, tokens what they emitted (> slots
                 # when speculative decoding accepts drafts)
                 decode_slots, decode_tokens = self._decode_step()
+        self._observe_pressure()
         self.timeline.record(
             t=self._clock(),
             decode_slots_occupied=decode_slots,
@@ -421,12 +601,41 @@ class ServingEngine:
             preemptions=self.scheduler.preemptions - preempt_before,
             waiting=len(self.scheduler.waiting),
             pool_pages_in_use=self.pool.pages_in_use,
-            pool_pages_total=self.pool.num_pages)
+            pool_pages_total=self.pool.num_pages,
+            degrade_stage=self.degrade_stage())
         if (self._completed != completed_before
                 or not self.scheduler.has_work
                 or (self._clock() - self._last_publish
                     >= self.PUBLISH_INTERVAL_S)):
             self.publish_metrics()
+
+    def _observe_pressure(self):
+        """Feed the degradation ladder this iteration's pressure and
+        apply any stage transition: gauge set immediately, an engine-
+        scope `degrade_stage` trace event, and the stage-3 weighted-
+        eviction lever armed/disarmed on the pool. Stage 1 (spec shed)
+        and 2 (prefill shrink) act through _effective_spec_k /
+        _effective_prefill_chunk at their use sites."""
+        if self._ladder is None:
+            return
+        ev = self._ladder.observe(self.pool.utilization(),
+                                  len(self.scheduler.waiting),
+                                  self.config.max_batch_size)
+        if ev is None:
+            return
+        _metrics.publish_degrade_stage(self._ladder.stage,
+                                       self._ladder.pressure())
+        if self.tracer is not None:
+            self.tracer.record(
+                ENGINE_REQ, 'degrade_stage', t=ev['t'],
+                from_stage=ev['from'], stage=ev['to'],
+                stage_name=DegradeLadder.STAGE_NAMES[ev['to']],
+                pressure=ev['pressure'])
+        if ev['to'] >= 3 and self._tenants is not None:
+            self.pool.set_eviction_weights(
+                self._tenants.eviction_weights())
+        elif ev['from'] >= 3 > ev['to']:
+            self.pool.set_eviction_weights(None)
 
     def _admit(self):
         """Admit waiting requests one at a time against a free-page
@@ -452,7 +661,17 @@ class ServingEngine:
         going straight to a new follower), each follower admitted past
         it counts against HOL_BYPASS_LIMIT — once spent, the sweep
         reverts to blocking at the head, freed pages accumulate across
-        sweeps, and the head admits as soon as they cover its chunk."""
+        sweeps, and the head admits as soon as they cover its chunk.
+
+        Tenancy (ISSUE 15): the sweep runs in priority-then-FCFS
+        order (scheduler.admission_order — arrival order when no
+        tenants are configured), and a quota'd tenant's request debits
+        its whole token bill from the tenant bucket at FIRST admit.
+        Insufficient quota DEFERS the request (skipped this sweep, a
+        `quota_defer` trace event on the defer edge) — it admits once
+        the bucket refills; the defer does not spend the HOL bypass
+        bound (quota is the tenant's own backpressure, not page
+        starvation). Resume after preemption never re-debits."""
         sched = self.scheduler
         budget = self.pool.free_pages
         n_admitted = 0
@@ -461,24 +680,60 @@ class ServingEngine:
                                 # admitted while it was itself the
                                 # head passed nobody)
         blocked_head = None
-        for req in list(sched.waiting):
+        skipped_before = False  # "req is the live queue head" ⟺ every
+                                # earlier entry of the sweep admitted —
+                                # the order-list twin of the old
+                                # `req is waiting[0]` check
+        for req in sched.admission_order():
+            victim = None
             if None not in sched.slots:
-                break
+                # slot-pressure preemption (tenancy only): a waiting
+                # request strictly ABOVE some running tenant's class
+                # displaces the youngest of the lowest class below it
+                # — the admitting request's victim rule — instead of
+                # waiting out the victim's whole decode. Charged like
+                # any preemption; the victim re-queues at the front of
+                # its class and, being lower-priority, cannot churn
+                # back in. Untenanted engines break here exactly as
+                # before (FCFS never preempts for admission).
+                if self._tenants is None:
+                    break
+                victim = sched.preempt_victim(
+                    below_priority=req.priority)
+                if victim is None:
+                    break       # order is priority-sorted: nobody
+                                # later outranks the running set either
             cached, live, _ = self.pool.peek_prefix(
                 req.tokens, limit=len(req.tokens) - 1)
             need = max(self.pool.pages_for(
                 min(len(req.tokens),
-                    cached + self.config.prefill_chunk)) - live, 0)
-            if budget < need:
-                if req is sched.waiting[0]:
+                    cached + self._effective_prefill_chunk())) - live,
+                0)
+            # feasibility BEFORE any side effect: nothing is billed
+            # and no victim's work is destroyed for an admit the page
+            # budget still wouldn't cover (a victim whose pages are
+            # all shared reclaims nothing — count only what its
+            # release would actually free)
+            avail = budget + (self.pool.reclaimable_pages(victim.id)
+                              if victim is not None else 0)
+            if avail < need:
+                if not skipped_before:
                     if req.admit_bypasses >= self.HOL_BYPASS_LIMIT:
                         break       # starvation bound reached: stop
                                     # bypassing, let pages accumulate
                     blocked_head = req
+                skipped_before = True
                 continue        # oversized for THIS sweep's budget:
                                 # skip, keep scanning for a fit
+            if not self._try_debit_quota(req):
+                skipped_before = True
+                continue        # over quota: deferred, not dropped
+            if victim is not None:
+                budget += self._charge_and_preempt(req, victim)
             if sched.admit_request(req) is None:
+                skipped_before = True
                 continue
+            req.quota_deferred = False
             budget -= need
             n_admitted += 1
             if blocked_head is not None:
@@ -492,6 +747,35 @@ class ServingEngine:
         if blocked_head is not None:
             blocked_head.admit_bypasses += n_bypassed
         return n_admitted
+
+    def _try_debit_quota(self, req):
+        """Debit req's token bill (prompt + generation budget) from
+        its tenant's bucket at first admit. True = admit may proceed
+        (no tenancy / no quota / already charged / debit succeeded);
+        False = defer this sweep. The defer EDGE (not every deferred
+        sweep) counts in the quota_deferrals gauges and emits one
+        quota_defer trace event carrying the bucket's own retry
+        estimate."""
+        if self._tenants is None or req.quota_charged:
+            return True
+        bucket = self._tenants.bucket(req.tenant_id)
+        if bucket is None:
+            return True
+        bill = len(req.prompt) + req.max_new_tokens
+        if bucket.try_debit(bill):
+            req.quota_charged = True
+            if req.tenant_id is not None:
+                self._tstat(req.tenant_id)['tokens_billed'] += bill
+            return True
+        if not req.quota_deferred:
+            req.quota_deferred = True
+            req.quota_defers += 1
+            self._quota_deferrals += 1
+            self._tstat(req.tenant_id)['quota_deferrals'] += 1
+            self._trace(req, 'quota_defer', tenant_id=req.tenant_id,
+                        bill_tokens=bill,
+                        retry_after_s=bucket.seconds_until(bill))
+        return False
 
     def adopt_request(self, req):
         """Adopt a request prefilled ELSEWHERE (prefill→decode
@@ -510,7 +794,8 @@ class ServingEngine:
         # decode step writes that one) — same invariant _decode_step
         # maintains
         self.pool.register_prefix(req.id, req.tokens,
-                                  req.context_len - 1)
+                                  req.context_len - 1,
+                                  owner=req.tenant_id)
         self._trace(req, 'admit', slot=self.scheduler.slot_of(req),
                     handoff=True,
                     pages=len(self.pool.page_table(req.id)))
@@ -519,28 +804,91 @@ class ServingEngine:
         return True
 
     def _ensure_or_preempt(self, req, n_tokens):
-        """Grow req's pages, preempting the youngest other in-flight
-        request until the allocation fits. Refcount-aware: a victim's
-        release only reclaims pages no live sibling still maps — a
-        victim whose pages are all shared frees nothing, so the loop
-        keeps preempting (older victims) rather than spinning on one,
-        and a sharer's prefix is never yanked out from under it."""
+        """Grow req's pages, preempting other in-flight requests until
+        the allocation fits. Refcount-aware: a victim's release only
+        reclaims pages no live sibling still maps — a victim whose
+        pages are all shared frees nothing, so the loop keeps
+        preempting (older victims) rather than spinning on one, and a
+        sharer's prefix is never yanked out from under it.
+
+        Victim choice (ISSUE 15): with tenants configured the victim
+        is the youngest request of the lowest priority class STRICTLY
+        below req's — falling back to req's own class (youngest peer,
+        the untenanted rule restricted to <= req.priority) only when
+        nobody below holds a slot, so the engine never deadlocks on a
+        same-priority pool squeeze but also never preempts upward.
+        When every OTHER slot-holder outranks req, req YIELDS instead
+        (its own pages release and it re-queues at the front of its
+        class, returning False) — the untenanted engine would have
+        preempted upward here; raising would crash the serve loop on
+        a recoverable pressure condition. Every tenancy-mode
+        preemption is CHARGED to the preemptor's quota bucket (the
+        victim's prefilled tokens — the work the preemption destroys
+        and the pool must recompute), so a high-priority tenant can't
+        churn the pool for free. Returns True when capacity was
+        ensured, False when req itself was preempted (the caller must
+        not touch its pages this sweep)."""
+        sched = self.scheduler
         while True:
             try:
                 self.pool.ensure_capacity(req.id, n_tokens)
-                return
+                return True
             except PoolExhausted:
-                victim = self.scheduler.preempt_victim(exclude=req)
+                if self._tenants is not None:
+                    victim = sched.preempt_victim(
+                        exclude=req, below_priority=req.priority)
+                    if victim is None:
+                        victim = sched.preempt_victim(
+                            exclude=req,
+                            below_priority=req.priority + 1)
+                else:
+                    victim = sched.preempt_victim(exclude=req)
                 if victim is None:
+                    if (self._tenants is not None
+                            and req in sched.slots
+                            and any(r is not None and r is not req
+                                    for r in sched.slots)):
+                        released = self.pool.release(req.id)
+                        sched.preempt(req)
+                        self._trace(
+                            req, 'preempt', pages_released=released,
+                            tokens_generated=len(req.generated),
+                            reason='yield_to_higher_priority')
+                        return False
                     raise PoolExhausted(
                         f"KV pool ({self.pool.num_pages} pages x "
                         f"{self.pool.page_size}) cannot hold one request "
                         f"of {n_tokens} tokens — raise num_pages")
-                released = self.pool.release(victim.id)
-                self.scheduler.preempt(victim)
-                self._trace(victim, 'preempt', pages_released=released,
-                            for_req=req.id,
-                            tokens_generated=len(victim.generated))
+                self._charge_and_preempt(req, victim)
+
+    def _charge_and_preempt(self, req, victim):
+        """Preempt `victim` on behalf of `req`: charge the victim's
+        destroyed prefill work to req's tenant bucket (tenancy mode),
+        release the victim's pages and re-queue it at the front of its
+        class. Returns the pages released (the admission sweep's
+        budget gain). One body for both preemption sites — pool
+        exhaustion (_ensure_or_preempt) and slot pressure (_admit) —
+        so the charging rule can't drift between them."""
+        charge = 0
+        if self._tenants is not None:
+            charge = max(victim.prefilled, 1)
+            bucket = self._tenants.bucket(req.tenant_id)
+            if bucket is not None:
+                bucket.charge(charge)
+            self._preemptions_charged += 1
+            if req.tenant_id is not None:
+                st = self._tstat(req.tenant_id)
+                st['preemptions_charged'] += 1
+                st['charge_tokens'] += charge
+        released = self.pool.release(victim.id)
+        self.scheduler.preempt(victim)
+        self._trace(victim, 'preempt', pages_released=released,
+                    for_req=req.id,
+                    tokens_generated=len(victim.generated),
+                    **({'charged_to': req.tenant_id,
+                        'charge_tokens': charge}
+                       if self._tenants is not None else {}))
+        return released
 
     # -- jitted steps --------------------------------------------------------
     def _step_fn(self, B, T, sample, verify=False):
@@ -687,7 +1035,7 @@ class ServingEngine:
 
     def _prefill_chunk_step(self, req):
         jnp = self._jnp
-        C = self.config.prefill_chunk
+        C = self._effective_prefill_chunk()
         if req.state != RequestState.PREFILL:
             return 0        # preempted by an earlier request in this
                             # same step() sweep: it re-queued slotless,
@@ -708,7 +1056,9 @@ class ServingEngine:
                             pages=len(self.pool.page_table(req.id)))
         start = req.prefilled
         n = min(C, len(toks) - start)
-        self._ensure_or_preempt(req, start + n)
+        if not self._ensure_or_preempt(req, start + n):
+            return 0        # yielded to higher-priority pool pressure:
+                            # re-queued, resumes when pressure clears
         chunk = toks[start:start + n] + [0] * (C - n)
         fn = self._step_fn(1, C, req.top_k > 0)
         self._key, sub = self._jax.random.split(self._key)
@@ -729,7 +1079,8 @@ class ServingEngine:
         self._prefill_chunks += 1
         # every prefilled token's K/V is resident: index the newly
         # completed full pages so siblings (and our own resume) share
-        self.pool.register_prefix(req.id, toks, req.prefilled)
+        self.pool.register_prefix(req.id, toks, req.prefilled,
+                                  owner=req.tenant_id)
         self._trace(req, 'prefill_chunk', tokens=n, prefilled=start + n,
                     pages=len(self.pool.page_table(req.id)))
         if req.prefilled == len(toks):
@@ -767,7 +1118,7 @@ class ServingEngine:
         emitted)."""
         jnp = self._jnp
         sched = self.scheduler
-        K = self.config.spec_k
+        K = self._effective_spec_k()
         proposals = {}
         if K > 0:
             for req in sched.slots:
@@ -780,12 +1131,15 @@ class ServingEngine:
                                         min(K, budget))
                 if drafts:
                     proposals[req.id] = drafts
-        # capacity first (may preempt); then snapshot the running set
+        # capacity first (may preempt, or yield the request itself);
+        # then snapshot the running set — a yielded request left its
+        # slot, so the batch build below skips it naturally
         for req in list(sched.slots):
             if req is not None and req.state == RequestState.RUNNING:
-                self._ensure_or_preempt(
-                    req, req.context_len
-                    + len(proposals.get(req.id, ())))
+                if not self._ensure_or_preempt(
+                        req, req.context_len
+                        + len(proposals.get(req.id, ()))):
+                    proposals.pop(req.id, None)
         B = self.config.max_batch_size
         verify = any(
             req is not None and req.state == RequestState.RUNNING
@@ -868,7 +1222,8 @@ class ServingEngine:
                 self.pool.trim(req.id, req.context_len)
             # K/V is resident for everything but the newest token
             self.pool.register_prefix(req.id, req.tokens,
-                                      req.context_len - 1)
+                                      req.context_len - 1,
+                                      owner=req.tenant_id)
             self._trace(req, 'decode',
                         tokens_generated=len(req.generated),
                         seq_len=req.context_len,
@@ -882,6 +1237,8 @@ class ServingEngine:
         self.pool.release(req.id)
         self.scheduler.retire(req)
         self._completed += 1
+        if req.tenant_id is not None:
+            self._tstat(req.tenant_id)['completed'] += 1
         self._observe_slo(req)
         self._trace(req, 'retire', t=req.finish_time,
                     tokens_generated=len(req.generated),
@@ -897,6 +1254,8 @@ class ServingEngine:
             return False
         self.pool.release(req.id)
         self._aborted += 1
+        if req.tenant_id is not None:
+            self._tstat(req.tenant_id)['aborted'] += 1
         self._observe_slo(req)
         self._trace(req, 'abort', t=req.finish_time, reason=reason,
                     tokens_generated=len(req.generated),
@@ -906,10 +1265,17 @@ class ServingEngine:
     def _observe_slo(self, req):
         """Queue the per-request SLO samples (queue-wait, TPOT, e2e,
         preemption count) for the next histogram publish — host floats
-        the scheduler already stamped, no device work."""
+        the scheduler already stamped, no device work. Requests with a
+        tenant also queue tenant-labeled queue-wait/e2e samples, and a
+        finish past the request's own deadline records a deadline_miss
+        (counter + trace event) — the admission estimate was wrong or
+        pressure grew after admit; either way the SLO view must say
+        so."""
         slo = self._new_slo
+        qw = e2e = None
         if req.submit_time is not None and req.admit_time is not None:
-            slo['queue_wait_s'].append(req.admit_time - req.submit_time)
+            qw = req.admit_time - req.submit_time
+            slo['queue_wait_s'].append(qw)
         if (req.first_token_time is not None
                 and req.finish_time is not None
                 and len(req.generated) > 1):
@@ -917,8 +1283,23 @@ class ServingEngine:
                 (req.finish_time - req.first_token_time)
                 / (len(req.generated) - 1))
         if req.submit_time is not None and req.finish_time is not None:
-            slo['e2e_s'].append(req.finish_time - req.submit_time)
+            e2e = req.finish_time - req.submit_time
+            slo['e2e_s'].append(e2e)
         slo['preemptions'].append(req.preemptions)
+        if req.tenant_id is not None:
+            ts = self._new_tenant_slo.setdefault(
+                req.tenant_id, {'queue_wait_s': [], 'e2e_s': []})
+            if qw is not None:
+                ts['queue_wait_s'].append(qw)
+            if e2e is not None:
+                ts['e2e_s'].append(e2e)
+        if (req.deadline_s is not None and e2e is not None
+                and e2e > req.deadline_s):
+            self._deadline_misses += 1
+            if req.tenant_id is not None:
+                self._tstat(req.tenant_id)['deadline_misses'] += 1
+            self._trace(req, 'deadline_miss', t=req.finish_time,
+                        e2e_s=e2e, deadline_s=req.deadline_s)
 
     # -- stalled-request watchdog --------------------------------------------
     def _check_stalled(self):
@@ -1011,8 +1392,48 @@ class ServingEngine:
             'spec_acceptance_rate':
                 (self._spec_accepted / self._spec_proposed
                  if self._spec_proposed else None),
+            # multi-tenant SLO layer (ISSUE 15): always present so the
+            # snapshot shape is stable — zeros/empty when untenanted
+            'quota_deferrals_total': self._quota_deferrals,
+            'preemptions_charged_total': self._preemptions_charged,
+            'deadline_rejects_total': self._deadline_rejects,
+            'deadline_misses_total': self._deadline_misses,
+            'degrade_stage': self.degrade_stage(),
+            'tenancy': self._tenancy_stats(),
         }
         return s
+
+    def _tenancy_stats(self):
+        """Per-tenant lifetime view for stats()/serve_snapshot() and
+        health_dump tenants: policy (priority/quota/weight), live
+        bucket level, and the accounting rows."""
+        out = {
+            'enabled': self._tenants is not None,
+            'degrade_enabled': self._ladder is not None,
+            'degrade_stage': self.degrade_stage(),
+            'pressure': (round(self._ladder.pressure(), 4)
+                         if self._ladder is not None else 0.0),
+            'stage_transitions': (self._ladder.transitions
+                                  if self._ladder is not None else 0),
+            'tenants': {},
+        }
+        tids = set(self._tenant_stats)
+        if self._tenants is not None:
+            tids.update(self._tenants.tenants())
+        for tid in sorted(tids):
+            row = dict(self._tenant_stats.get(tid)
+                       or self._blank_tstat())
+            if self._tenants is not None:
+                pol = self._tenants.policy(tid)
+                if pol is not None:
+                    row['priority'] = pol['priority']
+                    row['quota_tokens_per_s'] = pol['quota_tokens_per_s']
+                    row['weight'] = pol['weight']
+                bucket = self._tenants.bucket(tid)
+                if bucket is not None:
+                    row['bucket_level'] = round(bucket.level, 3)
+            out['tenants'][tid] = row
+        return out
 
     def reset_stats(self):
         """Zero the rate/occupancy accounting AND the trace/timeline
@@ -1033,6 +1454,9 @@ class ServingEngine:
         self._new_ttfts_s = []
         for v in self._new_slo.values():
             v.clear()
+        for d in self._new_tenant_slo.values():
+            for v in d.values():
+                v.clear()
         if self.tracer is not None:
             self.tracer.reset()
         self.timeline.reset()
@@ -1044,6 +1468,11 @@ class ServingEngine:
         s['_new_slo'] = {k: list(v) for k, v in self._new_slo.items()}
         for v in self._new_slo.values():
             v.clear()
+        s['_new_tenant_slo'] = {t: {k: list(v) for k, v in d.items()}
+                                for t, d in self._new_tenant_slo.items()}
+        for d in self._new_tenant_slo.values():
+            for v in d.values():
+                v.clear()
         s['timeline'] = self.timeline.summary()
         self._last_publish = self._clock()
         _metrics.publish(s)

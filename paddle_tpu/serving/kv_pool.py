@@ -136,6 +136,14 @@ class KVPagePool:
         self._children = {}                 # page id -> child page ids
         self._cached = collections.OrderedDict()
         self._registered_upto = {}          # seq id -> tokens indexed
+        # weighted eviction (ISSUE 15): indexed pages remember the
+        # tenant whose request first registered them; when the
+        # degradation ladder reaches stage 3 the engine installs
+        # per-tenant weights and cached-subtree eviction picks the
+        # LIGHTEST tenant's LRU root instead of the global LRU —
+        # a heavy tenant under overload loses its own cache first
+        self._page_tenant = {}              # page id -> tenant id|None
+        self._evict_weights = None          # tenant id -> weight|None
         self._digest_cache = None           # (limit, hashes) memo —
                                             # invalidated on any index
                                             # mutation; status() polls
@@ -245,6 +253,16 @@ class KVPagePool:
         """Tokens the sequence can hold without another allocation."""
         return len(self._seq_pages.get(seq_id, ())) * self.page_size
 
+    def reclaimable_pages(self, seq_id):
+        """Pages release(seq_id) would actually free right now (the
+        seq is their only mapper) — the admission sweep's preemption-
+        feasibility estimate: preempting a victim whose pages are all
+        shared reclaims nothing, so the sweep must not destroy its
+        work for a budget that still won't cover the admit."""
+        with self._lock:
+            return sum(1 for p in self._seq_pages.get(seq_id, ())
+                       if self._ref.get(p) == 1)
+
     def page_table(self, seq_id):
         return list(self._seq_pages.get(seq_id, ()))
 
@@ -273,15 +291,38 @@ class KVPagePool:
             parent = key[0]
             if parent != -1 and parent in self._children:
                 self._children[parent].discard(p)
+            self._page_tenant.pop(p, None)
             if p in self._cached:
                 del self._cached[p]
                 self._free.append(p)
                 self.prefix_evictions += 1
 
+    def set_eviction_weights(self, weights):
+        """Install (or clear, with None) per-tenant eviction weights.
+        While set, cached-subtree eviction under allocation pressure
+        picks the root whose owning tenant has the LOWEST weight
+        (LRU order within a weight class; unowned pages weigh 1.0)
+        instead of pure LRU — the degradation ladder's stage-3 lever
+        (docs/serving.md#multi-tenant)."""
+        self._evict_weights = (None if weights is None
+                               else {str(k): float(v)
+                                     for k, v in weights.items()})
+
+    def _pick_eviction_root(self):
+        """The cached page eviction starts from: global LRU normally;
+        under weighted eviction, the LRU cached page of the lightest-
+        weight owning tenant."""
+        if self._evict_weights is None:
+            return next(iter(self._cached))
+        w = self._evict_weights
+        return min(self._cached,
+                   key=lambda p: w.get(self._page_tenant.get(p), 1.0))
+
     def _take_page(self, seq_id):
         if not self._free and self._cached:
             # evict the least-recently-used cached prefix subtree
-            self._evict_subtree(next(iter(self._cached)))
+            # (weight-ordered when eviction weights are installed)
+            self._evict_subtree(self._pick_eviction_root())
         if not self._free:
             raise PoolExhausted(
                 f"KV pool exhausted: {self.num_pages} pages of "
@@ -382,6 +423,7 @@ class KVPagePool:
             self._children.clear()
             self._cached.clear()
             self._registered_upto.clear()
+            self._page_tenant.clear()
             self._digest_cache = None
 
     # -- prefix index --------------------------------------------------------
@@ -441,7 +483,7 @@ class KVPagePool:
             self._registered_upto[seq_id] = cached
         return cached
 
-    def register_prefix(self, seq_id, tokens, written):
+    def register_prefix(self, seq_id, tokens, written, owner=None):
         """Index seq_id's newly completed full pages (first `written`
         tokens of `tokens` have K/V resident) so later requests can
         share them. A block already indexed elsewhere is NOT
@@ -450,7 +492,12 @@ class KVPagePool:
         The walk starts from the chain root every call (cheap: a few
         dict hits per resident block) so a chain broken by eviction
         self-heals from this sequence's own pages instead of chaining
-        onto a stale — possibly recycled — parent id."""
+        onto a stale — possibly recycled — parent id.
+
+        `owner` (a tenant id) tags newly indexed pages for weighted
+        eviction — the tenant whose request FIRST registered a page
+        owns it for eviction purposes (shared pages keep their
+        original owner; re-registration never re-tags)."""
         if not self.prefix_cache:
             return
         ps = self.page_size
@@ -469,6 +516,8 @@ class KVPagePool:
                         break                       # under another key
                     self._index[key] = page
                     self._page_key[page] = key
+                    if owner is not None:
+                        self._page_tenant[page] = str(owner)
                     self._digest_cache = None
                     if parent != -1:
                         self._children.setdefault(parent,
@@ -539,4 +588,5 @@ class KVPagePool:
             'prefix_misses_total': self.prefix_misses,
             'prefix_hit_tokens_total': self.prefix_hit_tokens,
             'prefix_evictions_total': self.prefix_evictions,
+            'weighted_eviction': self._evict_weights is not None,
         }

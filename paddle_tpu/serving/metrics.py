@@ -10,6 +10,12 @@ preemption-count histograms with bucket-interpolated p50/p90/p99
 (core.monitor.Histogram.percentiles) in the snapshot, plus the
 scheduler-timeline summary — the occupancy-feedback signal the future
 disaggregated router consumes.
+
+The multi-tenant layer (ISSUE 15): tenant-labeled
+ptpu_serve_tenant_{queue_wait,e2e}_seconds histograms (one series per
+tenant), the quota/preemption/deadline counters-as-gauges, and the
+degradation-ladder stage/pressure gauges — `serve_snapshot()['tenants']`
+is the per-tenant SLO table `tools/health_dump.py tenants` renders.
 """
 from ..core import monitor as _m
 
@@ -55,7 +61,29 @@ _GAUGE_NAMES = (
     'ptpu_serve_prefix_misses',
     'ptpu_serve_prefix_shared_pages',
     'ptpu_serve_prefix_cached_pages',
+    # multi-tenant SLO layer (ISSUE 15): lifetime quota deferral /
+    # charged-preemption / deadline-reject counts (engine-owned
+    # monotonic state mirrored as gauges, like the _total block) and
+    # the degradation ladder's current stage + windowed pressure
+    'ptpu_serve_quota_deferrals',
+    'ptpu_serve_preemptions_charged',
+    'ptpu_serve_deadline_rejects',
+    'ptpu_serve_deadline_misses',
+    'ptpu_serve_degrade_stage',
+    'ptpu_serve_degrade_pressure',
 )
+
+# tenant-labeled SLO histograms: name -> (engine tenant-slo key,
+# buckets, help). One labeled series per tenant in the one registry
+# metric — serve_snapshot()['tenants'] renders per-tenant percentiles.
+_TENANT_HISTOGRAMS = {
+    'ptpu_serve_tenant_queue_wait_seconds': (
+        'queue_wait_s', TTFT_BUCKETS,
+        'per-request submit -> first admit wait, by tenant'),
+    'ptpu_serve_tenant_e2e_seconds': (
+        'e2e_s', E2E_BUCKETS,
+        'per-request submit -> retire latency, by tenant'),
+}
 _COUNTER_NAMES = (
     'ptpu_serve_requests_submitted_total',
     'ptpu_serve_requests_completed_total',
@@ -74,6 +102,23 @@ _COUNTER_NAMES = (
 # not registry gauges: it is a windowed aggregate that the snapshot
 # passes through whole (the router-feedback signal)
 _last_timeline = None
+# per-tenant accounting table from the engine's last publish
+# (engine._tenancy_stats()) — passed through whole like the timeline
+_last_tenancy = None
+
+
+def publish_degrade_stage(stage, pressure):
+    """Gauge a degradation-ladder transition the moment it happens —
+    every stage change must be visible even between periodic publishes
+    (the 'explicit, gauged, traced event' bar of ISSUE 15)."""
+    _m.gauge('ptpu_serve_degrade_stage',
+             help='graceful-degradation ladder stage (0 = normal, '
+                  '1 = spec shed, 2 = prefill shrink, 3 = weighted '
+                  'prefix eviction)').set(int(stage))
+    _m.gauge('ptpu_serve_degrade_pressure',
+             help='windowed scheduler pressure signal (pool occupancy '
+                  '+ waiting depth) driving the ladder').set(
+        float(pressure))
 
 
 def publish(stats):
@@ -81,7 +126,7 @@ def publish(stats):
     ptpu_serve_* gauges. Counters are published as gauges set to the
     engine's lifetime totals — the engine owns the monotonic state, the
     registry just mirrors it (monitor counters can't be set)."""
-    global _last_timeline
+    global _last_timeline, _last_tenancy
     g = _m.gauge
     g('ptpu_serve_decode_tokens_per_sec',
       help='batched decode throughput (generated tokens/sec)').set(
@@ -147,6 +192,40 @@ def publish(stats):
         hh = _m.histogram(name, help=help_, buckets=buckets)
         for v in vals:
             hh.observe(v)
+    # multi-tenant layer (ISSUE 15): counters-as-gauges + the ladder
+    # stage/pressure, and one labeled series per tenant in the
+    # queue-wait/e2e histograms
+    g('ptpu_serve_quota_deferrals',
+      help='requests deferred by a tenant token-rate quota '
+           '(defer episodes, lifetime)').set(
+        stats.get('quota_deferrals_total', 0))
+    g('ptpu_serve_preemptions_charged',
+      help='preemptions debited against the preempting tenant\'s '
+           'quota (lifetime)').set(
+        stats.get('preemptions_charged_total', 0))
+    g('ptpu_serve_deadline_rejects',
+      help='requests rejected at submit because their deadline was '
+           'already unmeetable (lifetime)').set(
+        stats.get('deadline_rejects_total', 0))
+    g('ptpu_serve_deadline_misses',
+      help='requests finished past their own deadline (lifetime)').set(
+        stats.get('deadline_misses_total', 0))
+    tenancy = stats.pop('tenancy', None)
+    publish_degrade_stage(
+        stats.get('degrade_stage', 0),
+        (tenancy or {}).get('pressure', 0.0))
+    tslo = stats.pop('_new_tenant_slo', None) or {}
+    for tid, samples in tslo.items():
+        for name, (key, buckets, help_) in _TENANT_HISTOGRAMS.items():
+            vals = samples.get(key)
+            if not vals:
+                continue
+            hh = _m.histogram(name, help=help_, buckets=buckets,
+                              labelnames=('tenant',))
+            for v in vals:
+                hh.observe(v, tenant=str(tid))
+    if tenancy is not None:
+        _last_tenancy = tenancy
     tl = stats.pop('timeline', None)
     if tl is not None:
         _last_timeline = tl
@@ -198,6 +277,26 @@ def serve_snapshot():
         out['spec_acceptance_rate'] = (
             out.get('ptpu_serve_spec_accepted_tokens_total', 0) / prop
             if prop else None)
+    # per-tenant view (ISSUE 15): the engine's accounting table from
+    # the last publish merged with per-tenant histogram percentiles —
+    # what health_dump tenants renders
+    if out:
+        tenants = {}
+        if _last_tenancy is not None:
+            out['tenancy'] = {k: v for k, v in _last_tenancy.items()
+                              if k != 'tenants'}
+            tenants = {tid: dict(row) for tid, row in
+                       (_last_tenancy.get('tenants') or {}).items()}
+        for name, (key, _b, _h) in _TENANT_HISTOGRAMS.items():
+            m = reg.get(name)
+            if m is None:
+                continue
+            label = key[:-2]            # queue_wait_s -> queue_wait
+            for lkey, child in m._series().items():
+                tenants.setdefault(lkey[0], {})[label] = \
+                    _histogram_view(child)
+        if tenants:
+            out['tenants'] = tenants
     if out and _last_timeline is not None:
         out['timeline'] = dict(_last_timeline)
     return out

@@ -31,9 +31,11 @@ import os
 import threading
 import time
 
-SCHEMA = 'paddle_tpu.serve_trace/2'
-# v1 files (no route events) still load — load_trace accepts both
-SCHEMAS = ('paddle_tpu.serve_trace/1', SCHEMA)
+SCHEMA = 'paddle_tpu.serve_trace/3'
+# older files still load — load_trace accepts /1 (no route events),
+# /2 (no tenancy/degradation events) and /3
+SCHEMAS = ('paddle_tpu.serve_trace/1', 'paddle_tpu.serve_trace/2',
+           SCHEMA)
 
 # lifecycle event vocabulary (docs/serving.md#request-traces);
 # prefix_hit = cached pages mapped at prefill start (ISSUE 9),
@@ -41,10 +43,20 @@ SCHEMAS = ('paddle_tpu.serve_trace/1', SCHEMA)
 # route = cluster-router placement (ISSUE 11, schema v2: replica_id +
 # router_decision affinity|least_loaded|spill — stamped by the replica
 # worker right after submit so per-replica trace files say who placed
-# the request here and why)
+# the request here and why). Schema v3 (ISSUE 15): submit carries
+# tenant_id/priority/deadline_s, quota_defer marks a quota-deferred
+# admission episode, deadline_miss a finish past the request's own
+# deadline, and degrade_stage — recorded under the engine-scope
+# pseudo-request ENGINE_REQ — a degradation-ladder transition.
 EVENTS = ('submit', 'route', 'admit', 'prefix_hit', 'prefill_chunk',
           'first_token', 'decode', 'spec_verify', 'preempt', 'resume',
+          'quota_defer', 'deadline_miss', 'degrade_stage',
           'retire', 'abort')
+
+# engine-scope events (ladder transitions) journal under this pseudo
+# request id: they export/load like any event but reconstruct() skips
+# negative ids — they describe the ENGINE's state, not a request's
+ENGINE_REQ = -1
 
 # chrome-trace: request tracks live on a 'serving requests'
 # pseudo-process (one virtual thread per request) beside the host
@@ -170,8 +182,10 @@ class RequestTracer:
         profiler's chrome writer next to the engine's serve::* spans."""
         spans = []
         for tr in self.traces():
-            tid = _TRACK_TID_BASE + tr.req_id
-            tname = f'req {tr.req_id}'
+            tid = _TRACK_TID_BASE + (tr.req_id if tr.req_id >= 0
+                                     else (1 << 23))
+            tname = (f'req {tr.req_id}' if tr.req_id >= 0
+                     else 'engine (degradation ladder)')
             evs = tr.events
             for i, e in enumerate(evs):
                 t_us = int(e['t'] * 1e6)
@@ -192,7 +206,9 @@ class RequestTracer:
                         'pid': _TRACK_PID, 'pname': _TRACK_PNAME,
                         'args': {k: v for k, v in e.items()
                                  if k not in ('t',)}})
-                if ev in ('first_token', 'retire', 'abort'):
+                if ev in ('first_token', 'retire', 'abort',
+                          'quota_defer', 'deadline_miss',
+                          'degrade_stage'):
                     spans.append({
                         'name': f'{tr.req_id}:{ev}',
                         'cat': 'serve_request', 'ts': t_us, 'dur': 0,
@@ -224,6 +240,9 @@ def reconstruct(events):
     (the equivalence is asserted in tests)."""
     out = {}
     for e in sorted(events, key=lambda x: x['t']):
+        if isinstance(e['req'], int) and e['req'] < 0:
+            continue        # engine-scope event (degrade_stage) — not
+                            # a request lifecycle; see ENGINE_REQ
         r = out.setdefault(e['req'], {
             'req': e['req'], 'submit_t': None, 'admit_t': None,
             'first_token_t': None, 'end_t': None, 'state': None,
@@ -233,6 +252,10 @@ def reconstruct(events):
             'prefix_cached_tokens': 0, 'spec_proposed': 0,
             'spec_accepted': 0, 'replica_id': None,
             'router_decision': None,
+            # schema v3 tenancy/degradation columns (ISSUE 15): v1/v2
+            # traces simply leave the defaults
+            'tenant_id': None, 'priority': 0, 'deadline_s': None,
+            'quota_defers': 0, 'deadline_miss': False,
         })
         ev, t = e['event'], e['t']
         if 'pages' in e:
@@ -241,6 +264,9 @@ def reconstruct(events):
         if ev == 'submit':
             r['submit_t'] = t
             r['prompt_tokens'] = e.get('prompt_tokens')
+            r['tenant_id'] = e.get('tenant_id')
+            r['priority'] = e.get('priority', 0)
+            r['deadline_s'] = e.get('deadline_s')
         elif ev == 'route':
             # schema v2: which replica got this request and why; the
             # FIRST placement wins (a drain-resubmit lands in the
@@ -272,6 +298,10 @@ def reconstruct(events):
                                         e.get('tokens_generated',
                                               r['tokens_generated'] + 1))
             r['last_token_t'] = t
+        elif ev == 'quota_defer':
+            r['quota_defers'] += 1
+        elif ev == 'deadline_miss':
+            r['deadline_miss'] = True
         elif ev == 'preempt':
             r['preemptions'] += 1
         elif ev in ('retire', 'abort'):
@@ -315,9 +345,10 @@ def percentile_of(vals, q):
 
 
 def load_trace(path):
-    """Read an export_jsonl file back into (header, events). Both
-    schema versions load — v1 traces simply carry no route events, so
-    reconstruct() leaves replica_id/router_decision at None. An
+    """Read an export_jsonl file back into (header, events). All
+    three schema versions load — v1 traces carry no route events (so
+    reconstruct() leaves replica_id/router_decision at None), v1/v2
+    carry no tenancy/degradation events (tenant columns default). An
     unknown serve_trace version raises rather than silently
     mis-reading a future layout."""
     header, events = {}, []

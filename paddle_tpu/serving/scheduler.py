@@ -1,17 +1,35 @@
 """Continuous-batching scheduler (host side).
 
-Orca-style iteration-level scheduling: requests join a FCFS queue,
-claim a decode slot when one frees up, chunk-prefill their prompt, then
-ride the batched decode step (one token per iteration, or up to
-spec_k+1 with speculative decoding) until EOS / length, at which
+Orca-style iteration-level scheduling: requests join an admission
+queue, claim a decode slot when one frees up, chunk-prefill their
+prompt, then ride the batched decode step (one token per iteration, or
+up to spec_k+1 with speculative decoding) until EOS / length, at which
 point the slot is immediately re-filled — no waiting for the rest of
-the batch. When the KV pool runs dry the YOUNGEST running request is
-preempted: its page mappings are dropped — pages a prefix-sharing
-sibling still references survive untouched (kv_pool.py refcounts) —
-and it re-queues at the front with its generated tokens kept, so
-resume is a re-prefill of prompt+generated that itself prefix-hits
-any of its pages still cached (recompute of the rest beats reserving
-swap space at these sizes).
+the batch. When the KV pool runs dry a victim is preempted: its page
+mappings are dropped — pages a prefix-sharing sibling still references
+survive untouched (kv_pool.py refcounts) — and it re-queues at the
+front with its generated tokens kept, so resume is a re-prefill of
+prompt+generated that itself prefix-hits any of its pages still cached
+(recompute of the rest beats reserving swap space at these sizes).
+
+Multi-tenant SLO layer (ISSUE 15): a `Request` carries `tenant_id`,
+`priority` (small int class, larger = more important) and an optional
+`deadline_s`; `TenantTable` maps tenants to (priority, token-rate
+quota via a refillable `TokenBucket`, prefix-cache weight). Admission
+order is priority-then-FCFS-within-class (`admission_order()`), and
+the preemption victim under pool pressure is the youngest request of
+the LOWEST priority class strictly below the admitting request
+(`preempt_victim(below_priority=)`). With no tenants configured every
+request sits in the default class 0 and both rules degrade EXACTLY to
+the original FCFS / preempt-youngest behavior (token-identity asserted
+in tests/test_serving_tenants.py).
+
+`DegradeLadder` is the graceful-overload controller: a windowed
+pressure signal (pool occupancy + waiting depth) walks the engine up
+three degradation stages — shed speculative decoding, shrink prefill
+chunks, evict prefix-cache subtrees by tenant weight — and back down
+hysteretically (lower down-thresholds + a dwell count) when pressure
+clears, so a noisy signal never oscillates the ladder.
 
 All of this is pure host bookkeeping between fixed-shape jitted steps
 (engine.py) — the device never sees a dynamic shape.
@@ -27,6 +45,228 @@ ran the pool at 100%").
 import collections
 import itertools
 import time
+
+
+class AdmissionRejected(RuntimeError):
+    """Deadline-aware admission turned a request away AT SUBMIT: its
+    estimated completion (pending tokens / observed decode rate — the
+    PR-11 router `deadline_bound_s` math moved down into the engine)
+    already exceeds its `deadline_s`, so queueing it would only burn
+    pool pages on certain failure. Structured so callers can back off
+    by the hint instead of a fixed sleep (the cluster router re-raises
+    it as a structured RouterRejected)."""
+
+    def __init__(self, reason, retry_after_s=None, estimated_s=None,
+                 deadline_s=None, message=None):
+        super().__init__(
+            message or f"admission rejected ({reason}): estimated "
+                       f"completion {_fmt_s(estimated_s)} exceeds "
+                       f"deadline {_fmt_s(deadline_s)} — retry in "
+                       f"~{_fmt_s(retry_after_s)}")
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+        self.estimated_s = estimated_s
+        self.deadline_s = deadline_s
+
+
+def _fmt_s(v):
+    return f'{v:.3f}s' if isinstance(v, (int, float)) else '?'
+
+
+class TokenBucket:
+    """Refillable token-rate quota. `rate` tokens/s stream in up to a
+    `burst` cap; admission debits a request's whole token bill at
+    once. The level may go NEGATIVE (debt) in two cases: a request
+    bigger than the burst admits when the bucket is full (over-quota
+    tenants are deferrable, never unservable), and charged preemptions
+    (`charge()`) debit unconditionally — the tenant then waits out its
+    debt before the next admit. Refill is lazy (computed from the
+    injected clock at read time), so deterministic-clock tests step it
+    exactly."""
+
+    def __init__(self, rate, burst=None, clock=None):
+        self.rate = float(rate)
+        self.burst = float(burst if burst is not None
+                           else max(self.rate, 1.0))
+        self.clock = clock or time.perf_counter
+        self._level = self.burst
+        self._t = self.clock()
+
+    def _refill(self):
+        now = self.clock()
+        dt = max(now - self._t, 0.0)
+        self._t = now
+        self._level = min(self._level + dt * self.rate, self.burst)
+
+    @property
+    def level(self):
+        self._refill()
+        return self._level
+
+    def try_debit(self, cost):
+        """Debit `cost` tokens if the tenant has quota NOW: the bucket
+        must hold min(cost, burst) — a bill larger than the burst cap
+        admits from a full bucket and leaves debt. Returns True when
+        debited (admit), False when the caller should defer."""
+        self._refill()
+        if self._level < min(float(cost), self.burst):
+            return False
+        self._level -= float(cost)
+        return True
+
+    def charge(self, cost):
+        """Unconditional debit (may go negative) — the charged-
+        preemption path: churning the pool spends the preemptor's own
+        quota."""
+        self._refill()
+        self._level -= float(cost)
+
+    def seconds_until(self, cost):
+        """Time until try_debit(cost) would succeed (0.0 when it would
+        succeed now) — the quota-defer retry hint."""
+        self._refill()
+        need = min(float(cost), self.burst) - self._level
+        if need <= 0.0:
+            return 0.0
+        return need / self.rate if self.rate > 0 else float('inf')
+
+
+class TenantTable:
+    """The `ServingConfig.tenants` policy map resolved into runtime
+    state: per-tenant priority class, optional `TokenBucket` quota and
+    prefix-cache eviction weight. Unknown tenants (and tenant_id=None)
+    fall into the default class: priority 0, no quota, weight 1.0 —
+    declaring tenants must never break anonymous traffic."""
+
+    def __init__(self, tenants, clock=None):
+        self.clock = clock or time.perf_counter
+        self._policies = {}
+        self._buckets = {}
+        for tid, pol in (tenants or {}).items():
+            pol = dict(pol or {})
+            unknown = set(pol) - {'priority', 'quota_tokens_per_s',
+                                  'burst_tokens', 'weight'}
+            if unknown:
+                raise ValueError(
+                    f"tenant {tid!r}: unknown policy keys "
+                    f"{sorted(unknown)} (allowed: priority, "
+                    f"quota_tokens_per_s, burst_tokens, weight)")
+            self._policies[str(tid)] = {
+                'priority': int(pol.get('priority', 0)),
+                'quota_tokens_per_s': pol.get('quota_tokens_per_s'),
+                'burst_tokens': pol.get('burst_tokens'),
+                'weight': float(pol.get('weight', 1.0)),
+            }
+            rate = pol.get('quota_tokens_per_s')
+            if rate is not None:
+                self._buckets[str(tid)] = TokenBucket(
+                    rate, pol.get('burst_tokens'), clock=self.clock)
+
+    def __contains__(self, tenant_id):
+        return str(tenant_id) in self._policies
+
+    def tenants(self):
+        return list(self._policies)
+
+    def policy(self, tenant_id):
+        return self._policies.get(str(tenant_id))
+
+    def priority_of(self, tenant_id):
+        pol = self._policies.get(str(tenant_id))
+        return pol['priority'] if pol else 0
+
+    def bucket(self, tenant_id):
+        return self._buckets.get(str(tenant_id))
+
+    def weight_of(self, tenant_id):
+        pol = self._policies.get(str(tenant_id))
+        return pol['weight'] if pol else 1.0
+
+    def eviction_weights(self):
+        """{tenant_id: weight} for kv_pool.set_eviction_weights —
+        lower weight evicts first at degradation stage 3."""
+        return {tid: pol['weight']
+                for tid, pol in self._policies.items()}
+
+
+class DegradeLadder:
+    """Graceful-degradation controller (ISSUE 15): a windowed pressure
+    signal walks an integer stage 0..3 up eagerly and down
+    hysteretically.
+
+    Pressure per iteration = max(pool utilization, waiting/(2*slots))
+    clamped to [0, 1] — either a full pool or a deep queue is
+    overload — averaged over the last `window` observations. The stage
+    steps UP (one stage per observation) when the mean crosses
+    `up[stage]`, and steps DOWN only after the mean has sat below
+    `down[stage-1]` for `hold` consecutive observations — the up/down
+    threshold gap plus the dwell count is the hysteresis that keeps a
+    noisy signal from oscillating the ladder (asserted in
+    tests/test_serving_tenants.py).
+
+    Stage semantics live in the engine (0 = normal, 1 = shed
+    speculative decoding, 2 = shrink prefill chunks, 3 = weighted
+    prefix-cache eviction); the ladder only decides WHEN. Every
+    transition lands in `history` — the engine turns each into a gauge
+    update + trace event."""
+
+    STAGE_NAMES = ('normal', 'shed_spec', 'shrink_prefill',
+                   'weighted_evict')
+
+    def __init__(self, window=8, up=(0.85, 0.92, 0.97),
+                 down=(0.60, 0.70, 0.80), hold=4, clock=None):
+        if len(up) != 3 or len(down) != 3:
+            raise ValueError("up/down need one threshold per stage "
+                             "transition (3 each)")
+        if any(d >= u for u, d in zip(up, down)):
+            raise ValueError(
+                f"each down-threshold must sit below its up-threshold "
+                f"for hysteresis (up={up}, down={down})")
+        self.window = int(window)
+        self.up = tuple(float(u) for u in up)
+        self.down = tuple(float(d) for d in down)
+        self.hold = int(hold)
+        self.clock = clock or time.perf_counter
+        self.stage = 0
+        self._ring = collections.deque(maxlen=self.window)
+        self._calm = 0                  # consecutive below-threshold
+        self.history = []               # [{t, from, to, pressure}]
+        self.transitions = 0
+
+    @staticmethod
+    def pressure_of(pool_utilization, waiting, slots):
+        q = min(float(waiting) / max(2.0 * slots, 1.0), 1.0)
+        return min(max(float(pool_utilization), q), 1.0)
+
+    def pressure(self):
+        """Windowed mean of the observed pressure (0.0 when empty)."""
+        return (sum(self._ring) / len(self._ring)
+                if self._ring else 0.0)
+
+    def observe(self, pool_utilization, waiting, slots):
+        """Feed one iteration's raw signals; returns the transition
+        dict when the stage changed this observation, else None."""
+        self._ring.append(self.pressure_of(pool_utilization, waiting,
+                                           slots))
+        p = self.pressure()
+        prev = self.stage
+        if self.stage < 3 and p >= self.up[self.stage]:
+            self.stage += 1
+            self._calm = 0
+        elif self.stage > 0 and p < self.down[self.stage - 1]:
+            self._calm += 1
+            if self._calm >= self.hold:
+                self.stage -= 1
+                self._calm = 0
+        else:
+            self._calm = 0
+        if self.stage == prev:
+            return None
+        ev = {'t': self.clock(), 'from': prev, 'to': self.stage,
+              'pressure': round(p, 4)}
+        self.history.append(ev)
+        self.transitions += 1
+        return ev
 
 
 class RequestState:
@@ -46,7 +286,8 @@ class Request:
     them already sit in KV pages."""
 
     def __init__(self, prompt_ids, max_new_tokens=32, eos_token_id=None,
-                 temperature=0.0, top_k=0):
+                 temperature=0.0, top_k=0, tenant_id=None, priority=0,
+                 deadline_s=None):
         self.id = next(_ids)
         self.prompt = [int(t) for t in prompt_ids]
         if not self.prompt:
@@ -55,6 +296,20 @@ class Request:
         self.eos_token_id = eos_token_id
         self.temperature = float(temperature)
         self.top_k = int(top_k)
+        # tenancy (ISSUE 15): tenant_id groups quota/SLO accounting,
+        # priority orders admission and bounds preemption, deadline_s
+        # (relative to submit) drives deadline-aware admission
+        self.tenant_id = (str(tenant_id) if tenant_id is not None
+                          else None)
+        self.priority = int(priority)
+        self.deadline_s = (float(deadline_s) if deadline_s is not None
+                           else None)
+        self.quota_charged = False       # token bill debited at first
+                                         # admit only (resume is free —
+                                         # the preemptor paid)
+        self.quota_defers = 0
+        self.quota_deferred = False      # edge-detect for the
+                                         # quota_defer trace event
         self.generated = []
         self.prefilled = 0
         self.state = RequestState.WAITING
@@ -94,9 +349,12 @@ class Request:
 
 
 class Scheduler:
-    """Slot table + FCFS queue. The engine drives it: `admit()` between
-    steps, `preempt_victim()` when the pool is dry, `retire()` on
-    completion."""
+    """Slot table + admission queue. The engine drives it: `admit()`
+    between steps, `preempt_victim()` when the pool is dry, `retire()`
+    on completion. `self.waiting` stays in arrival order (preempts
+    re-insert at the front); `admission_order()` is the priority view
+    the engine sweeps — identical to arrival order when every request
+    sits in the default class."""
 
     def __init__(self, num_slots, clock=None):
         self.num_slots = int(num_slots)
@@ -122,16 +380,28 @@ class Scheduler:
     def has_work(self):
         return bool(self.waiting or self.running())
 
+    def admission_order(self):
+        """The queue in admission order: priority classes high to low,
+        FCFS (arrival order, preempts first) within a class. A stable
+        sort on -priority over the arrival-ordered list — with no
+        tenants configured every priority is 0 and this IS the arrival
+        order, so default scheduling is unchanged."""
+        return sorted(self.waiting, key=lambda r: -r.priority)
+
     def admit(self, limit=None):
-        """Fill free slots from the queue (FCFS), at most `limit` of
-        them (None = all). One body with `admit_request` below — this
-        is the unconditional head-first loop; the engine's budgeted
-        sweep picks specific requests via admit_request directly."""
+        """Fill free slots from the queue (priority-then-FCFS), at
+        most `limit` of them (None = all). One body with
+        `admit_request` below — this is the unconditional head-first
+        loop; the engine's budgeted sweep picks specific requests via
+        admit_request directly. The order is sorted ONCE per call:
+        admitting never reorders the remaining queue (stable key), so
+        re-sorting per admission would be pure waste on the host hot
+        path."""
         admitted = []
-        while self.waiting and (limit is None
-                                or len(admitted) < limit):
-            req = self.admit_request(self.waiting[0])
-            if req is None:
+        for req in self.admission_order():
+            if limit is not None and len(admitted) >= limit:
+                break
+            if self.admit_request(req) is None:
                 break
             admitted.append(req)
         return admitted
@@ -176,15 +446,29 @@ class Scheduler:
     def slot_of(self, request):
         return self.slots.index(request)
 
-    def preempt_victim(self, exclude=None):
-        """Youngest running/prefilling request (highest id ≈ last
-        admitted), excluding `exclude`. None if there is nobody to
-        preempt."""
+    def preempt_victim(self, exclude=None, below_priority=None):
+        """Preemption victim among running/prefilling requests,
+        excluding `exclude`. With `below_priority` set (tenancy
+        active), the victim is the YOUNGEST request (highest id ≈ last
+        admitted) of the LOWEST priority class strictly below it — a
+        high-priority admit displaces the least important, most
+        recently started work first, and never a peer or better. With
+        `below_priority` None (no tenants), the victim is the youngest
+        overall — the original behavior, bit-for-bit. None if nobody
+        qualifies."""
         candidates = [r for r in self.slots
                       if r is not None and r is not exclude]
         if not candidates:
             return None
-        return max(candidates, key=lambda r: r.id)
+        if below_priority is None:
+            return max(candidates, key=lambda r: r.id)
+        candidates = [r for r in candidates
+                      if r.priority < below_priority]
+        if not candidates:
+            return None
+        lowest = min(r.priority for r in candidates)
+        return max((r for r in candidates if r.priority == lowest),
+                   key=lambda r: r.id)
 
     def preempt(self, request):
         """Release the slot and push the request to the FRONT of the
@@ -281,4 +565,7 @@ class SchedulerTimeline:
             'admissions': sum(r.get('admissions', 0) for r in rows),
             'preemptions': sum(r.get('preemptions', 0) for r in rows),
             'max_waiting': max(r.get('waiting', 0) for r in rows),
+            'degrade_stage': rows[-1].get('degrade_stage', 0),
+            'max_degrade_stage': max(r.get('degrade_stage', 0)
+                                     for r in rows),
         }

@@ -49,6 +49,13 @@ the human post-mortem:
     comparison (docs/performance.md#async-dispatch) from a bench record
     or telemetry snapshot.
 
+  * multi-tenant serving (`tenants` subcommand): per-tenant SLO table
+    (priority, quota deferrals, charged preemptions, deadline
+    rejects/misses, tenant-labeled queue-wait/e2e percentiles) plus
+    the graceful-degradation ladder's current stage and pressure
+    (docs/serving.md#multi-tenant), from a serve snapshot or bench
+    record.
+
 Usage:
     python tools/health_dump.py ARTIFACT.json [--json] [--level ERROR]
     python tools/health_dump.py numerics ARTIFACT.json [--json]
@@ -61,6 +68,7 @@ Usage:
     python tools/health_dump.py numerics --selftest  # numerics CI smoke
     python tools/health_dump.py comm --selftest      # comm CI smoke
     python tools/health_dump.py serve --selftest     # serving CI smoke
+    python tools/health_dump.py tenants --selftest   # tenancy CI smoke
     python tools/health_dump.py cluster --selftest   # cluster CI smoke
     python tools/health_dump.py pallas --selftest    # pallas CI smoke
     python tools/health_dump.py mem --selftest       # mem CI smoke
@@ -747,6 +755,182 @@ def serve_main(argv):
         print(json.dumps(serve, indent=2))
     else:
         print(render_serve(serve))
+    return 0
+
+
+def _find_tenants(doc):
+    """Locate a serve section that carries the multi-tenant layer
+    (ISSUE 15): serve_snapshot()['tenants'] / ['tenancy'] or the
+    bench gpt_serve_tenants leg's telemetry."""
+    s = _find_serve(doc)
+    if s is not None and ('tenants' in s or 'tenancy' in s
+                          or 'ptpu_serve_degrade_stage' in s):
+        return s
+    if not isinstance(doc, dict):
+        return None
+    for path in (('legs', 'gpt_serve_tenants', 'telemetry_serve'),
+                 ('parsed', 'legs', 'gpt_serve_tenants',
+                  'telemetry_serve')):
+        d = doc
+        for k in path:
+            d = d.get(k) if isinstance(d, dict) else None
+        if isinstance(d, dict) and ('tenants' in d or 'tenancy' in d):
+            return d
+    return None
+
+
+def render_tenants(s):
+    """Human rendering of the per-tenant SLO layer: current ladder
+    stage + pressure, then one row per tenant (policy, lifetime
+    accounting, queue-wait/e2e percentiles from the tenant-labeled
+    histograms) — docs/serving.md#multi-tenant."""
+    ten = s.get('tenancy') or {}
+    stage = int(s.get('ptpu_serve_degrade_stage',
+                      ten.get('degrade_stage', 0)))
+    names = ('normal', 'shed_spec', 'shrink_prefill', 'weighted_evict')
+    out = ['multi-tenant serving (SLO-aware scheduler)']
+    out.append(
+        f"  degradation ladder: stage {stage} "
+        f"({names[stage] if 0 <= stage < 4 else '?'}), pressure "
+        f"{s.get('ptpu_serve_degrade_pressure', ten.get('pressure', 0.0)):.3f}, "
+        f"{int(ten.get('stage_transitions', 0))} transitions")
+    out.append(
+        f"  quota deferrals {int(s.get('ptpu_serve_quota_deferrals', 0))}, "
+        f"charged preemptions "
+        f"{int(s.get('ptpu_serve_preemptions_charged', 0))}, "
+        f"deadline rejects {int(s.get('ptpu_serve_deadline_rejects', 0))}"
+        f" / misses {int(s.get('ptpu_serve_deadline_misses', 0))}")
+    tenants = s.get('tenants') or {}
+    if not tenants:
+        out.append('  (no per-tenant traffic recorded)')
+        return '\n'.join(out)
+    out.append(
+        f"  {'tenant':<12} {'prio':>4} {'done/sub':>9} {'defer':>5} "
+        f"{'chg':>4} {'dl-rej':>6} {'dl-miss':>7} "
+        f"{'qwait p99':>10} {'e2e p99':>10} {'bucket':>8}")
+    for tid in sorted(tenants):
+        row = tenants[tid]
+        qw = (row.get('queue_wait') or {}).get('p99_ms')
+        e2e = (row.get('e2e') or {}).get('p99_ms')
+        lvl = row.get('bucket_level')
+        out.append(
+            f"  {tid[:12]:<12} {row.get('priority', 0):>4} "
+            f"{row.get('completed', 0):>4}/{row.get('submitted', 0):<4} "
+            f"{row.get('quota_deferrals', 0):>5} "
+            f"{row.get('preemptions_charged', 0):>4} "
+            f"{row.get('deadline_rejects', 0):>6} "
+            f"{row.get('deadline_misses', 0):>7} "
+            f"{(f'{qw:.1f}ms' if qw is not None else '-'):>10} "
+            f"{(f'{e2e:.1f}ms' if e2e is not None else '-'):>10} "
+            f"{(f'{lvl:.1f}' if lvl is not None else '-'):>8}")
+    return '\n'.join(out)
+
+
+def _tenants_selftest():
+    """CI smoke: drive the REAL engine with a tenant policy map on a
+    deterministic clock — a quota'd bulk tenant deferring behind its
+    bucket while a priority tenant admits — then assert the tenant
+    gauges/histograms reach serve_snapshot() and render, and walk a
+    DegradeLadder through its stages to check the transition gauges."""
+    _repo_root_on_path()
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving import (ServingEngine, ServingConfig,
+                                    DegradeLadder)
+    from paddle_tpu.serving import metrics as serve_metrics
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=2, max_seq_len=64, hidden_dropout=0.0,
+                    attn_dropout=0.0, use_flash_attention=False)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    t = {'now': 0.0}
+
+    def clk():
+        t['now'] += 1e-5
+        return t['now']
+
+    rng = np.random.RandomState(0)
+    eng = ServingEngine(model, ServingConfig(
+        page_size=8, max_batch_size=2, prefill_chunk=8, clock=clk,
+        tenants={'bulk': {'priority': 0, 'quota_tokens_per_s': 1.0,
+                          'burst_tokens': 12.0, 'weight': 0.2},
+                 'gold': {'priority': 2, 'weight': 2.0}}))
+    reqs = [eng.submit(list(rng.randint(1, 64, 6)), max_new_tokens=4,
+                       top_k=0, tenant_id=tid)
+            for tid in ('bulk', 'bulk', 'gold')]
+    steps = 0
+    while eng.scheduler.has_work and steps < 400:
+        eng.step()
+        steps += 1
+        if steps == 50:
+            t['now'] += 30.0        # refill bulk's bucket mid-run
+    assert all(r.state == 'finished' for r in reqs), \
+        [r.state for r in reqs]
+    st = eng.stats()
+    assert st['quota_deferrals_total'] >= 1, st['quota_deferrals_total']
+    assert st['tenancy']['tenants']['bulk']['quota_deferrals'] >= 1
+    snap = serve_metrics.serve_snapshot()
+    assert 'tenants' in snap and 'bulk' in snap['tenants'], \
+        sorted(snap)
+    assert snap['ptpu_serve_quota_deferrals'] >= 1, snap
+    assert snap['tenants']['bulk'].get('e2e', {}).get('count') == 2, \
+        snap['tenants']['bulk']
+    text = render_tenants(snap)
+    assert 'bulk' in text and 'gold' in text, text
+    assert 'degradation ladder' in text, text
+    eng.shutdown()
+
+    # ladder walk-up/down with the transition gauge
+    lad = DegradeLadder(window=2, up=(0.5, 0.7, 0.9),
+                        down=(0.3, 0.5, 0.7), hold=2, clock=clk)
+    for _ in range(8):
+        lad.observe(1.0, 10, 2)
+    assert lad.stage == 3, lad.stage
+    serve_metrics.publish_degrade_stage(lad.stage, lad.pressure())
+    snap = serve_metrics.serve_snapshot()
+    assert snap['ptpu_serve_degrade_stage'] == 3, snap
+    for _ in range(3 * 2 + 4):
+        lad.observe(0.0, 0, 2)
+    assert lad.stage == 0, lad.stage
+    assert lad.transitions >= 6, lad.transitions
+    text = render_tenants(snap)
+    assert 'stage 3' in text, text
+    print(text)
+    print('health_dump tenants selftest: OK')
+    return 0
+
+
+def tenants_main(argv):
+    ap = argparse.ArgumentParser(
+        prog='health_dump.py tenants',
+        description='render the per-tenant SLO table + degradation '
+                    'ladder stage from a serve snapshot or bench '
+                    'record (docs/serving.md#multi-tenant)')
+    ap.add_argument('artifact', nargs='?',
+                    help='StepTelemetry snapshot / bench record JSON')
+    ap.add_argument('--json', action='store_true')
+    ap.add_argument('--selftest', action='store_true')
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return _tenants_selftest()
+    if not args.artifact:
+        ap.error('artifact path required (or --selftest)')
+    with open(args.artifact) as f:
+        doc = json.load(f)
+    s = _find_tenants(doc)
+    if s is None:
+        raise ValueError(
+            'no multi-tenant serving telemetry in this artifact '
+            '(expected a serve section with tenants/tenancy keys — '
+            'docs/serving.md#multi-tenant)')
+    if args.json:
+        print(json.dumps(s, indent=2))
+    else:
+        print(render_tenants(s))
     return 0
 
 
@@ -1467,6 +1651,8 @@ def main(argv=None):
         return comm_main(argv[1:])
     if argv and argv[0] == 'serve':
         return serve_main(argv[1:])
+    if argv and argv[0] == 'tenants':
+        return tenants_main(argv[1:])
     if argv and argv[0] == 'cluster':
         return cluster_main(argv[1:])
     if argv and argv[0] == 'pallas':

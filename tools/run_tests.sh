@@ -53,6 +53,13 @@
 #                      found-inf path, remat composition, sync_model
 #                      cross-restore), bubble-model census + ptpu_pp_*
 #                      gauge rendering, true 2-rank subprocess leg
+#   --tenant-selftest - multi-tenant SLO-aware serving (ISSUE 15):
+#                      priority/quota/deadline admission units over a
+#                      deterministic clock, charged-preemption
+#                      accounting, degradation-ladder hysteresis with
+#                      stage-transition trace events, weighted prefix
+#                      eviction, no-tenant token-identity, adversarial
+#                      heavy+light mix, per-tenant SLO rendering
 set -e
 cd "$(dirname "$0")/.."
 TIER="${1:-all}"
@@ -63,7 +70,8 @@ case "$TIER" in
             tests/test_numerics.py tests/test_bucketing.py \
             tests/test_fused_primitives.py tests/test_overlap.py \
             tests/test_serving.py tests/test_serving_trace.py \
-            tests/test_serving_cluster.py tests/test_remat.py \
+            tests/test_serving_cluster.py tests/test_serving_tenants.py \
+            tests/test_remat.py \
             tests/test_async_step.py tests/test_pipeline_schedule.py -q
           # observability tooling smoke: tracer -> export -> summary CLI
           python tools/trace_summary.py --selftest
@@ -77,6 +85,8 @@ case "$TIER" in
           python tools/health_dump.py serve --selftest
           # cluster smoke: 2-replica router -> placement counters
           python tools/health_dump.py cluster --selftest
+          # tenancy smoke: quota/priority engine -> tenant SLO table
+          python tools/health_dump.py tenants --selftest
           # pallas smoke: fused primitives -> route counters -> render
           python tools/health_dump.py pallas --selftest
           # async smoke: windowed loop -> host-gap gauges -> render
@@ -161,16 +171,25 @@ case "$TIER" in
           XLA_FLAGS="--xla_force_host_platform_device_count=8" \
           python -m pytest tests/test_pipeline_schedule.py -q
           python tools/health_dump.py pp --selftest ;;
+  --tenant-selftest)
+          # the multi-tenant SLO scheduler end to end (ISSUE 15):
+          # admission/quota/deadline units, charged preemption,
+          # ladder hysteresis, weighted eviction, token-identity and
+          # the adversarial mix, then the tenant SLO CLI smokes
+          python -m pytest tests/test_serving_tenants.py -q
+          python tools/health_dump.py tenants --selftest
+          python tools/trace_summary.py --selftest ;;
   all)    python -m pytest tests/ -q
           python tools/trace_summary.py --selftest
           python tools/health_dump.py --selftest
           python tools/health_dump.py numerics --selftest
           python tools/health_dump.py comm --selftest
           python tools/health_dump.py serve --selftest
+          python tools/health_dump.py tenants --selftest
           python tools/health_dump.py cluster --selftest
           python tools/health_dump.py pallas --selftest
           python tools/health_dump.py mem --selftest
           python tools/health_dump.py host --selftest
           python tools/health_dump.py pp --selftest ;;
-  *) echo "usage: $0 [fast|dist|native|e2e|all|--comm-selftest|--serve-selftest|--quant-selftest|--pallas-selftest|--overlap-selftest|--cluster-selftest|--remat-selftest|--async-selftest|--pp-selftest]"; exit 1 ;;
+  *) echo "usage: $0 [fast|dist|native|e2e|all|--comm-selftest|--serve-selftest|--quant-selftest|--pallas-selftest|--overlap-selftest|--cluster-selftest|--remat-selftest|--async-selftest|--pp-selftest|--tenant-selftest]"; exit 1 ;;
 esac

@@ -107,7 +107,7 @@ def render(summary):
 
 
 # ---------------------------------------------------------------------------
-# serving request traces (JSON-lines, paddle_tpu.serve_trace/1 or /2)
+# serving request traces (JSON-lines, paddle_tpu.serve_trace/1 – /3)
 # ---------------------------------------------------------------------------
 def summarize_serve(paths):
     """Per-request table + cross-request SLO percentiles from one or
@@ -115,7 +115,10 @@ def summarize_serve(paths):
     into one cross-replica table: request ids prefix with the replica
     (route-event replica_id, else the file stem — per-replica files
     restart ids at 0, so the prefix IS the disambiguator), and the
-    percentiles aggregate the whole cluster's requests."""
+    percentiles aggregate the whole cluster's requests. Schema-v3
+    traces (ISSUE 15) additionally group the percentile table BY
+    TENANT (`percentiles_by_tenant`) — the per-tenant SLO view the
+    multi-tenant scheduler is judged on."""
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     from paddle_tpu.serving.request_trace import (load_trace,
@@ -141,9 +144,28 @@ def summarize_serve(paths):
     for key in ('queue_wait_s', 'ttft_s', 'tpot_s', 'e2e_s'):
         vals = [r[key] for r in rows]
         pct[key] = {f'p{q}': percentile_of(vals, q) for q in (50, 90, 99)}
+    by_tenant = {}
+    if any(r.get('tenant_id') is not None for r in rows):
+        tenants = sorted({r.get('tenant_id') or '-' for r in rows})
+        for tid in tenants:
+            trows = [r for r in rows
+                     if (r.get('tenant_id') or '-') == tid]
+            by_tenant[tid] = {
+                'requests': len(trows),
+                'quota_defers': sum(r.get('quota_defers', 0)
+                                    for r in trows),
+                'deadline_misses': sum(1 for r in trows
+                                       if r.get('deadline_miss')),
+            }
+            for key in ('queue_wait_s', 'e2e_s'):
+                vals = [r[key] for r in trows]
+                by_tenant[tid][key] = {
+                    f'p{q}': percentile_of(vals, q)
+                    for q in (50, 90, 99)}
     return {'schema': schema, 'files': len(paths),
             'dropped_events': dropped,
-            'requests': rows, 'percentiles': pct}
+            'requests': rows, 'percentiles': pct,
+            'percentiles_by_tenant': by_tenant}
 
 
 def _fmt_ms(v):
@@ -161,7 +183,9 @@ def render_serve(s):
     # cluster columns only when any request was router-placed
     # (schema v2 route events / merged per-replica files)
     routed = any(r.get('replica_id') is not None for r in rows)
-    extra_hdr = f" {'replica':>8} {'routed':>12}" if routed else ''
+    tenanted = any(r.get('tenant_id') is not None for r in rows)
+    extra_hdr = (f" {'tenant':>8} {'prio':>4}" if tenanted else '') \
+        + (f" {'replica':>8} {'routed':>12}" if routed else '')
     out.append(f"{'req':>8} {'state':<9} {'prompt':>6} {'gen':>5} "
                f"{'queue_ms':>9} {'ttft_ms':>9} {'tpot_ms':>9} "
                f"{'e2e_ms':>9} {'preempt':>7} {'pages_hw':>8} "
@@ -169,9 +193,11 @@ def render_serve(s):
     for r in rows:
         prop = r.get('spec_proposed', 0)
         spec = (f"{r.get('spec_accepted', 0)}/{prop}" if prop else '-')
-        extra = (f" {str(r.get('replica_id') or '-'):>8} "
-                 f"{str(r.get('router_decision') or '-'):>12}"
-                 if routed else '')
+        extra = (f" {str(r.get('tenant_id') or '-'):>8} "
+                 f"{r.get('priority', 0):>4}" if tenanted else '') \
+            + (f" {str(r.get('replica_id') or '-'):>8} "
+               f"{str(r.get('router_decision') or '-'):>12}"
+               if routed else '')
         out.append(
             f"{r['req']:>8} {r['state'] or '?':<9} "
             f"{r['prompt_tokens'] if r['prompt_tokens'] is not None else '?':>6} "
@@ -205,6 +231,23 @@ def render_serve(s):
         out.append(f"{label:<12} p50 {_fmt_ms(p['p50']):>9}  "
                    f"p90 {_fmt_ms(p['p90']):>9}  "
                    f"p99 {_fmt_ms(p['p99']):>9}")
+    # per-tenant SLO grouping (schema v3, ISSUE 15)
+    by_tenant = s.get('percentiles_by_tenant') or {}
+    if by_tenant:
+        out.append('')
+        out.append('-- SLO percentiles by tenant (ms) ' + '-' * 26)
+        out.append(f"{'tenant':<12} {'n':>4} {'defer':>5} "
+                   f"{'dl-miss':>7} "
+                   f"{'qwait p50':>10} {'qwait p99':>10} "
+                   f"{'e2e p50':>9} {'e2e p99':>9}")
+        for tid, row in sorted(by_tenant.items()):
+            qw, e2e = row['queue_wait_s'], row['e2e_s']
+            out.append(
+                f"{tid[:12]:<12} {row['requests']:>4} "
+                f"{row['quota_defers']:>5} "
+                f"{row['deadline_misses']:>7} "
+                f"{_fmt_ms(qw['p50']):>10} {_fmt_ms(qw['p99']):>10} "
+                f"{_fmt_ms(e2e['p50']):>9} {_fmt_ms(e2e['p99']):>9}")
     return '\n'.join(out)
 
 
@@ -296,6 +339,39 @@ def _serve_selftest():
     mtext = render_serve(m)
     assert 'replica' in mtext and 'r0' in mtext and 'r1' in mtext, mtext
     print(mtext)
+
+    # tenant grouping (schema v3, ISSUE 15): tenant columns on the
+    # per-request table, percentile block grouped by tenant, engine-
+    # scope degrade_stage events skipped by reconstruction
+    tr3 = RequestTracer(clock=clock)
+    for rid, tid, prio in ((0, 'heavy', 0), (1, 'light', 2)):
+        tr3.record(rid, 'submit', t=1.0 + rid, prompt_tokens=3,
+                   tenant_id=tid, priority=prio)
+        if tid == 'heavy':
+            tr3.record(rid, 'quota_defer', t=1.1, tenant_id=tid,
+                       bill_tokens=8, retry_after_s=0.5)
+        tr3.record(rid, 'admit', t=1.2 + rid)
+        tr3.record(rid, 'first_token', t=1.5 + rid,
+                   tokens_generated=1)
+        tr3.record(rid, 'deadline_miss', t=1.7 + rid, e2e_s=0.8,
+                   deadline_s=0.5)
+        tr3.record(rid, 'retire', t=1.8 + rid, tokens_generated=2)
+    tr3.record(-1, 'degrade_stage', t=1.05, from_stage=0, stage=1,
+               stage_name='shed_spec', pressure=0.9)
+    with tempfile.TemporaryDirectory() as d:
+        p3 = os.path.join(d, 'tenants.jsonl')
+        tr3.export_jsonl(p3)
+        s3 = summarize_serve(p3)
+    assert len(s3['requests']) == 2, s3      # engine event skipped
+    byt = s3['percentiles_by_tenant']
+    assert set(byt) == {'heavy', 'light'}, byt
+    assert byt['heavy']['quota_defers'] == 1, byt
+    assert byt['light']['deadline_misses'] == 1, byt
+    assert abs(byt['light']['e2e_s']['p50'] - 0.8) < 1e-12, byt
+    ttext = render_serve(s3)
+    assert 'tenant' in ttext and 'by tenant' in ttext, ttext
+    assert 'heavy' in ttext and 'light' in ttext, ttext
+    print(ttext)
     print('trace_summary serve selftest: OK')
 
 
