@@ -27,6 +27,26 @@ import numpy as np
 
 V5E_PEAK_TFLOPS = 197.0
 TARGET_MFU = 0.45
+# record schema (ISSUE 16): v2 = top-level legs + schema_version/round
+# stamps + the headline ledger record (r04/r05 artifacts predate this
+# and nest legs inside detail — bench_compare normalizes both shapes)
+BENCH_SCHEMA_VERSION = 2
+
+
+def _next_round_id():
+    """rNN one past the newest BENCH_r*.json beside this script (the
+    artifact naming the driver uses); BENCH_ROUND env overrides."""
+    import re
+    here = os.path.dirname(os.path.abspath(__file__))
+    rounds = []
+    try:
+        for f in os.listdir(here):
+            m = re.match(r'BENCH_r(\d+)\.json$', f)
+            if m:
+                rounds.append(int(m.group(1)))
+    except OSError:
+        pass
+    return f'r{(max(rounds) + 1 if rounds else 6):02d}'
 
 
 
@@ -148,6 +168,12 @@ def bench_gpt_1p3b(optimizer='adamw'):
     # headline ms_per_step now comes from the DeviceLoader + windowed
     # dispatch loop, with the host-synchronous discipline measured on
     # the same engine for the host-gap comparison
+    # step-time ledger (ISSUE 16): name the arch facts the engine can't
+    # infer so the ledger's analytic FLOPs match the bench formula below
+    from paddle_tpu.core import ledger as _ledger_mod
+    _ledger_mod.configure('pipeline', layers=cfg.num_layers,
+                          hidden=cfg.hidden_size, seq_len=L,
+                          n_params=n_params, arch='gpt')
     host, dt = _host_gap_record(
         eng,
         sync_step=lambda: float(
@@ -155,6 +181,9 @@ def bench_gpt_1p3b(optimizer='adamw'):
         make_batches=lambda k: [(ids, labels)] * k,
         dispatch=eng.train_step,
         n_sync=3, sync_trials=2, n=5, trials=3)
+    # the reconciled where-did-the-step-go account, published by the
+    # flush inside the windowed loop (health_dump ledger renders this)
+    ledger_rec = eng._ledger.account()
 
     tokens = A * mb * L
     flops = 6 * n_params * tokens + \
@@ -205,6 +234,10 @@ def bench_gpt_1p3b(optimizer='adamw'):
         # depth + host-gap before/after — BENCH_r06's instrument for
         # telling compute-bound from host-bound
         'host': host,
+        # step-time ledger (ISSUE 16): compute/exposed-comm/bubble/
+        # host-gap/residue decomposition + model TFLOP/s with the remat
+        # recompute factor reflected (MFU only on real TPU peaks)
+        'ledger': ledger_rec,
         'live_buffers_before_shutdown': before,
         'live_buffers_after_shutdown': released.get('live_buffers'),
         'live_bytes_after_shutdown': released.get('live_bytes'),
@@ -1203,6 +1236,9 @@ def _attach_telemetry(r):
             # pipeline schedule census (ISSUE 14): active schedule /
             # virtual stages / modeled bubble fraction
             'pipeline': snap.get('pipeline'),
+            # step-time ledger (ISSUE 16): reconciled wall decomposition
+            # + model/hardware TFLOP/s + MFU per engine
+            'ledger': snap.get('ledger'),
         }
     except Exception as e:
         r['telemetry'] = {'error': repr(e)[:200]}
@@ -1381,6 +1417,33 @@ def _check_legs(result):
             'detail.host.windowed lacks host_bound_fraction'
         assert 'sync_loop' in hostrec, \
             'detail.host lacks the sync_loop comparison record'
+    # the step-time ledger (ISSUE 16): the headline leg must carry the
+    # reconciled decomposition — components sum to within 10% of the
+    # measured wall (residue is one of them, surfaced separately) —
+    # and the model-TFLOP/s account with the remat recompute factor
+    if 'error' not in headline:
+        led = headline.get('ledger')
+        assert isinstance(led, dict), 'headline leg lacks detail.ledger'
+        comps = led.get('components')
+        assert isinstance(comps, dict), 'detail.ledger lacks components'
+        for key in ('compute', 'exposed_comm', 'bubble', 'host_gap',
+                    'residue'):
+            assert key in comps, f'detail.ledger.components lacks {key}'
+        wall = led.get('wall_seconds') or 0.0
+        assert wall > 0.0, 'detail.ledger lacks wall_seconds'
+        total = sum(comps.values())
+        assert abs(total - wall) <= 0.10 * wall, \
+            f'ledger components sum {total:.6f}s vs wall {wall:.6f}s ' \
+            f'(off by more than 10%)'
+        assert 'model_tflops' in led, 'detail.ledger lacks model_tflops'
+        assert 'recompute_factor' in (led.get('flops') or {}), \
+            'detail.ledger lacks the remat recompute factor'
+        assert 'ledger' in (headline.get('telemetry') or {}) \
+            or 'error' in (headline.get('telemetry') or {}), \
+            'headline leg telemetry lacks ledger'
+    # record stamps (ISSUE 16): schema version + round id at top level
+    assert result.get('schema_version'), 'result lacks schema_version'
+    assert result.get('round'), 'result lacks round id'
     return True
 
 
@@ -1416,6 +1479,9 @@ def main():
         # + host-gap before (sync_loop) vs after (windowed) + the
         # host_bound_fraction BENCH_r06 reads (health_dump host)
         'host': g.get('host'),
+        # ISSUE 16: the reconciled step-wall ledger + MFU account
+        # (bench_compare renders two rounds of these side by side)
+        'ledger': g.get('ledger'),
         # ISSUE 8: which fused Pallas primitives were active in the
         # headline step (health_dump pallas renders this)
         'fused_primitives': g.get('fused_primitives'),
@@ -1469,6 +1535,11 @@ def main():
     # the top-level contract says every leg carries its own
     legs['gpt1.3b_adamw']['telemetry'] = detail['telemetry']
     result = {
+        # record contract (ISSUE 16): schema_version gates what
+        # bench_compare may assume about the shape; round identifies
+        # the bench round without relying on the artifact filename
+        'schema_version': BENCH_SCHEMA_VERSION,
+        'round': os.environ.get('BENCH_ROUND') or _next_round_id(),
         'metric': 'gpt1.3b_adamw_trainstep_mfu',
         'value': round(g['mfu'], 4),
         'unit': 'fraction_of_v5e_peak',

@@ -432,6 +432,12 @@ class AsyncDispatchMixin:
         drained = self._inflight.flush()
         self._gap.drain_point()
         self._gap.publish()
+        led = getattr(self, '_ledger', None)
+        if led is not None:
+            try:
+                led.publish()   # ledger rides the same drain point
+            except Exception:
+                pass
         return drained
 
     def host_gap_snapshot(self):

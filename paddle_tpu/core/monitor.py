@@ -246,7 +246,9 @@ class _HistogramChild:
             if total == 0:
                 return None
             counts = list(self.counts)      # cumulative (le semantics)
-        rank = q / 100.0 * total
+        # q=0 must land in the first OCCUPIED bucket (rank 0 would match
+        # any empty leading bucket and report its upper bound)
+        rank = max(q / 100.0 * total, 1e-12)
         for i, c in enumerate(counts):
             if c >= rank:
                 lo = self.buckets[i - 1] if i > 0 else 0.0

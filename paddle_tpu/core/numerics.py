@@ -209,7 +209,9 @@ def write_report(report, path=None):
     """Persist a numerics/divergence artifact under the log dir (the
     path health_dump renders)."""
     from .memory import default_report_dir
-    name = ('divergence_report' if report.get('kind') == 'divergence_report'
+    name = (report.get('kind')
+            if report.get('kind') in ('divergence_report',
+                                      'straggler_report')
             else 'numerics_report')
     path = path or os.path.join(
         default_report_dir(),

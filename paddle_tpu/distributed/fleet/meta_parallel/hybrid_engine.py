@@ -248,6 +248,14 @@ class HybridParallelTrainStep(A_.AsyncDispatchMixin, EngineTeardown):
         self._inflight = A_.DispatchWindow(
             A_.resolve_dispatch_window(dispatch_window))
         self._gap = A_.HostGapMonitor('hybrid')
+        # step-time ledger (ISSUE 16): reconciled wall decomposition +
+        # model-FLOPs accounting, published from flush()
+        from ....core import ledger as _led
+        self._ledger = _led.StepLedger(
+            'hybrid', gap=self._gap,
+            params_fn=lambda: _led.count_params(
+                list(self._params_by_name.values())),
+            remat_policy=self._remat_policy)
         # batch input specs are init-time facts (DeviceLoader asks for
         # them before the first dispatch)
         self._sp_on = ('sp' in self.axes and self.sp > 1
@@ -685,6 +693,8 @@ class HybridParallelTrainStep(A_.AsyncDispatchMixin, EngineTeardown):
                     f"{self.sharding_deg} = {ddeg} (ZeRO 'sharding' "
                     f"ranks are data-parallel ranks)")
         self._ensure_open()
+        if arrays:
+            self._ledger.observe_batch(arrays[0].shape)
         # gap bracket opens BEFORE any jax client call (key fold-in, lr
         # placement can serialize behind in-flight compute — that time
         # belongs to the dispatch, not the inter-dispatch host gap)

@@ -678,6 +678,20 @@ class SpmdPipelineEngine(A_.AsyncDispatchMixin, EngineTeardown):
         self._inflight = A_.DispatchWindow(
             A_.resolve_dispatch_window(dispatch_window))
         self._gap = A_.HostGapMonitor('pipeline')
+        # step-time ledger (ISSUE 16): wall decomposition (incl. the
+        # modeled schedule bubble) + model-FLOPs accounting. The FLOPs
+        # remat factor: a resolved policy wins; else the legacy split —
+        # 'recompute' memory mode re-runs stage forwards ('full'),
+        # stash-1F1B keeps residuals with a save-dots backward ('dots')
+        from ....core import ledger as _led
+        self._ledger = _led.StepLedger(
+            'pipeline', gap=self._gap,
+            params_fn=lambda: _led.count_params(self._params),
+            remat_policy=self._remat_policy or (
+                'full' if self.memory_mode == 'recompute'
+                else ('dots' if self.use_remat else 'none')),
+            bubble_fraction_fn=lambda: self._sched_model.get(
+                'bubble_fraction', 0.0))
         from ....optimizer import device_lr as _dlr
         self._lr = _dlr.LrFeed(optimizer, device_lr,
                                place=lambda a: self._place(a, P()))
@@ -2131,6 +2145,7 @@ class SpmdPipelineEngine(A_.AsyncDispatchMixin, EngineTeardown):
             else jnp.asarray(input_ids)
         ll = labels.data if isinstance(labels, Tensor) \
             else jnp.asarray(labels)
+        self._ledger.observe_batch(ii.shape)
         # microbatching contract, checked up front: the step reshapes
         # each dp rank's slice to [A, mb, ...] — a bad batch size used
         # to surface as an opaque reshape traceback from inside the

@@ -193,6 +193,13 @@ class TrainStep(_AsyncDispatchMixin):
         self._inflight = A_.DispatchWindow(
             A_.resolve_dispatch_window(dispatch_window))
         self._gap = A_.HostGapMonitor('jit')
+        # step-time ledger (ISSUE 16): wall decomposition + model-FLOPs
+        # accounting, published from flush()
+        from ..core import ledger as _led
+        self._ledger = _led.StepLedger(
+            'jit', gap=self._gap,
+            params_fn=lambda: _led.count_params(self._params),
+            remat_policy=self._remat_policy)
         from ..optimizer import device_lr as _dlr
         self._lr = _dlr.LrFeed(optimizer, device_lr)
         self._compiled = jax.jit(
@@ -249,6 +256,8 @@ class TrainStep(_AsyncDispatchMixin):
         self._gap.dispatch_begin()
         arrays = tuple(b.data if isinstance(b, Tensor) else jnp.asarray(b)
                        for b in batch)
+        if arrays:
+            self._ledger.observe_batch(arrays[0].shape)
         key = rng_mod.next_key()
         args = (self._params, self._buffers, self._opt_states,
                 self._lr.arg(), key, arrays)

@@ -893,6 +893,13 @@ class StepTelemetry:
                 snap['pipeline'] = pipeline_snapshot()
         except Exception:
             snap['pipeline'] = None
+        # step-time ledger (ISSUE 16): the reconciled wall decomposition
+        # + MFU account, read back from the ptpu_ledger_* gauges
+        try:
+            from .core.ledger import ledger_snapshot
+            snap['ledger'] = ledger_snapshot()
+        except Exception:
+            snap['ledger'] = None
         return snap
 
 

@@ -86,6 +86,11 @@ class DisaggregatedEngine:
         # (spec shed) move together and each transition traces once
         if self.decode._ladder is not None:
             self.prefill._ladder = self.decode._ladder
+            # stage-3 weighted eviction arms on BOTH pools no matter
+            # which side observes the transition (ISSUE 16 satellite:
+            # the observing engine used to arm only its own pool)
+            self.decode._stage3_pools = (self.prefill.pool,)
+            self.prefill._stage3_pools = (self.decode.pool,)
         # one publisher: the global ptpu_serve_* gauges reflect the
         # decode engine (where requests retire and most SLO samples
         # land); the prefill side's pending histogram samples (TTFT is

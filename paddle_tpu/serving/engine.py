@@ -378,6 +378,11 @@ class ServingEngine:
         self._quota_deferrals = 0
         self._preemptions_charged = 0
         self._deadline_rejects = 0
+        # pools co-armed with self.pool on stage-3 transitions: under a
+        # SHARED ladder (disaggregated prefill+decode) whichever engine
+        # observes the transition must arm/disarm weighted eviction on
+        # BOTH pools, not just its own (ISSUE 16 satellite)
+        self._stage3_pools = ()
         self._deadline_misses = 0
         self._tenant_stats = {}
         # per-tenant SLO samples pending the next histogram publish
@@ -632,10 +637,12 @@ class ServingEngine:
                 stage_name=DegradeLadder.STAGE_NAMES[ev['to']],
                 pressure=ev['pressure'])
         if ev['to'] >= 3 and self._tenants is not None:
-            self.pool.set_eviction_weights(
-                self._tenants.eviction_weights())
+            weights = self._tenants.eviction_weights()
+            for pool in (self.pool, *self._stage3_pools):
+                pool.set_eviction_weights(weights)
         elif ev['from'] >= 3 > ev['to']:
-            self.pool.set_eviction_weights(None)
+            for pool in (self.pool, *self._stage3_pools):
+                pool.set_eviction_weights(None)
 
     def _admit(self):
         """Admit waiting requests one at a time against a free-page

@@ -1,0 +1,65 @@
+"""2-process slow-rank scenario for the straggler detector.
+
+Both ranks drive a HostGapMonitor through the same number of simulated
+dispatch intervals; rank 1's intervals carry an injected sleep ~3x the
+base, the slow-chip / noisy-neighbor profile the detector exists to
+catch. At the periodic check both ranks allgather their rolling mean
+step wall; rank 1 lands past threshold x median, so BOTH ranks must
+flag it, write a straggler_report artifact naming rank 1, and journal
+the event in the flight recorder.
+"""
+import os
+import sys
+import time
+
+import jax
+jax.config.update('jax_platforms', 'cpu')
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from paddle_tpu.distributed import host_collectives as HC  # noqa: E402
+from paddle_tpu.distributed import flight_recorder as fr   # noqa: E402
+from paddle_tpu.core import async_step as A_               # noqa: E402
+from paddle_tpu.core import ledger as L                    # noqa: E402
+
+
+def main():
+    rank = int(os.environ['PADDLE_TRAINER_ID'])
+    dump_dir = os.environ['STRAGGLER_DUMP_DIR']
+    group = HC.init_host_collectives(timeout=60)
+    assert group is not None
+
+    det = L.StragglerDetector(engine='test', group=group,
+                              threshold=1.25, check_every=4,
+                              dump_dir=dump_dir)
+    gap = A_.HostGapMonitor('test')
+    base = 0.02
+    sleep = base * (3.0 if rank == 1 else 1.0)   # the injected slowdown
+    report = None
+    for step in range(1, 9):
+        gap.dispatch_begin()
+        time.sleep(sleep)                        # the "step"
+        gap.dispatch_end(depth=1)
+        rep = det.maybe_check(step, gap)
+        if rep is not None:
+            report = rep
+    assert det.checks >= 1, 'periodic check never ran'
+    if report is None:
+        print(f'RANK{rank}: straggler NOT detected', flush=True)
+        sys.exit(9)
+    assert report['offending_ranks'] == [1], report
+    assert report['world_size'] == 2, report
+    assert report['relative_wall']['1'] > 1.25, report
+    assert det.report_path and os.path.exists(det.report_path)
+    # the event is journaled beside the allgathers that found it
+    ops = [e['op'] for e in fr.recorder().entries()]
+    assert 'straggler_detected' in ops, ops
+    assert 'all_gather' in ops, ops
+    print(f'RANK{rank}: OK offending={report["offending_ranks"]}',
+          flush=True)
+    sys.exit(0)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,96 @@
+"""Gauge-registry <-> docs consistency (ISSUE 16 satellite): the
+metrics-reference appendix in docs/observability.md must list exactly
+the set of `ptpu_*` names the code publishes — a metric added without
+a docs row (or a docs row for a metric that no longer exists) fails
+here, not in review.
+"""
+import os
+import re
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+DOCS = os.path.join(REPO, 'docs', 'observability.md')
+PKG = os.path.join(REPO, 'paddle_tpu')
+
+# quoted full metric names; a trailing underscore marks a PREFIX
+# (startswith checks, reader-side f-string stems) — not a metric
+_CODE_RE = re.compile(r"""['"](ptpu_[a-z0-9_]+)['"]""")
+_DOCS_RE = re.compile(r'`(ptpu_[a-z0-9_]+)`')
+_BEGIN = '<!-- metrics-reference:begin -->'
+_END = '<!-- metrics-reference:end -->'
+
+# names the docs mention as REMOVED — allowed in prose, banned from
+# the reference table
+_RETIRED = {'ptpu_serve_ttft_ms'}
+
+
+def _code_names():
+    names = set()
+    for root, _dirs, files in os.walk(PKG):
+        for fn in files:
+            if not fn.endswith('.py'):
+                continue
+            with open(os.path.join(root, fn)) as f:
+                for m in _CODE_RE.findall(f.read()):
+                    if not m.endswith('_'):
+                        names.add(m)
+    return names
+
+
+def _docs_sections():
+    with open(DOCS) as f:
+        text = f.read()
+    assert _BEGIN in text and _END in text, \
+        'metrics-reference markers missing from docs/observability.md'
+    ref = text.split(_BEGIN, 1)[1].split(_END, 1)[0]
+    return text, ref
+
+
+class TestMetricsDocsConsistency:
+    def test_reference_table_matches_code_exactly(self):
+        code = _code_names()
+        _, ref = _docs_sections()
+        docs = set(_DOCS_RE.findall(ref))
+        undocumented = code - docs
+        stale = docs - code
+        assert not undocumented, (
+            'published but missing from the docs metrics reference: '
+            f'{sorted(undocumented)}')
+        assert not stale, (
+            'in the docs metrics reference but published nowhere: '
+            f'{sorted(stale)}')
+
+    def test_reference_rows_are_table_entries(self):
+        # every name sits in a `| \`name\` | module |` row — the
+        # appendix stays machine-parseable, not prose
+        _, ref = _docs_sections()
+        row_names = set()
+        for line in ref.splitlines():
+            m = re.match(r'\|\s*`(ptpu_[a-z0-9_]+)`\s*\|', line)
+            if m:
+                row_names.add(m.group(1))
+        assert row_names == set(_DOCS_RE.findall(ref))
+
+    def test_prose_mentions_are_real_or_retired(self):
+        # full literal names in the prose half must exist in code
+        # (brace patterns like ptpu_comm_{a,b} don't match the regex
+        # and carry their own meaning)
+        text, ref = _docs_sections()
+        prose = text.replace(ref, '')
+        code = _code_names()
+        ghosts = {n for n in _DOCS_RE.findall(prose)
+                  if n not in code and n not in _RETIRED}
+        assert not ghosts, (
+            f'docs prose references unpublished metrics: {sorted(ghosts)}')
+
+    def test_retired_names_not_resurrected(self):
+        code = _code_names()
+        _, ref = _docs_sections()
+        docs = set(_DOCS_RE.findall(ref))
+        for name in _RETIRED:
+            assert name not in code, f'{name} was removed in ISSUE 7'
+            assert name not in docs, \
+                f'{name} is retired and must stay out of the reference'

@@ -1,0 +1,86 @@
+"""core/monitor Histogram.percentile edge cases (ISSUE 16 satellite):
+empty histogram, single sample, all-samples-in-overflow-bucket, and the
+q=0 / q=100 bounds."""
+import os
+import sys
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(HERE))
+
+from paddle_tpu.core.monitor import Histogram   # noqa: E402
+
+
+def _hist(buckets=(1.0, 2.0, 4.0)):
+    return Histogram('t_hist', help='t', buckets=buckets)
+
+
+class TestPercentileEdges:
+    def test_empty_histogram_is_none(self):
+        h = _hist()
+        assert h.percentile(0) is None
+        assert h.percentile(50) is None
+        assert h.percentile(100) is None
+
+    def test_out_of_range_q_raises(self):
+        h = _hist()
+        h.observe(1.0)
+        with pytest.raises(ValueError):
+            h.percentile(-1)
+        with pytest.raises(ValueError):
+            h.percentile(101)
+
+    def test_single_sample(self):
+        h = _hist()
+        h.observe(1.5)      # lands in the (1, 2] bucket
+        # every quantile interpolates inside that one bucket
+        for q in (0, 25, 50, 75, 100):
+            p = h.percentile(q)
+            assert 1.0 <= p <= 2.0, (q, p)
+        assert h.percentile(100) == pytest.approx(2.0)
+
+    def test_all_samples_in_overflow_bucket(self):
+        h = _hist()
+        for _ in range(5):
+            h.observe(100.0)    # past the last finite bound
+        # the estimator can't see past the last finite boundary: every
+        # quantile degrades to it
+        for q in (0, 50, 99, 100):
+            assert h.percentile(q) == pytest.approx(4.0), q
+
+    def test_q0_lands_in_first_occupied_bucket(self):
+        h = _hist()
+        h.observe(3.0)      # (2, 4] — the leading buckets stay empty
+        # q=0 must NOT report the empty first bucket's upper bound (the
+        # pre-fix behavior); it converges to the occupied bucket's
+        # lower bound
+        assert h.percentile(0) == pytest.approx(2.0)
+
+    def test_q0_with_occupied_first_bucket(self):
+        h = _hist()
+        h.observe(0.5)
+        assert h.percentile(0) == pytest.approx(0.0)
+
+    def test_q100_is_last_occupied_upper_bound(self):
+        h = _hist()
+        h.observe(0.5)
+        h.observe(1.5)
+        assert h.percentile(100) == pytest.approx(2.0)
+
+    def test_interpolation_monotone(self):
+        h = _hist()
+        for v in (0.5, 0.6, 1.2, 1.8, 3.0, 3.5):
+            h.observe(v)
+        qs = [h.percentile(q) for q in (0, 10, 25, 50, 75, 90, 100)]
+        assert qs == sorted(qs), qs
+        assert qs[0] == pytest.approx(0.0)
+        assert qs[-1] == pytest.approx(4.0)
+
+    def test_labeled_children_are_independent(self):
+        h = Histogram('t_hist_l', help='t', labelnames=('site',),
+                      buckets=(1.0, 2.0))
+        h.observe(0.5, site='a')
+        h.observe(1.5, site='b')
+        assert h.percentile(100, site='a') == pytest.approx(1.0)
+        assert h.percentile(0, site='b') == pytest.approx(1.0)

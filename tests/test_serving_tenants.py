@@ -485,6 +485,42 @@ class TestEngineDegradation:
         assert outs == ref                      # tokens identical
         shed.shutdown()
 
+    def test_disagg_stage3_arms_both_pools(self, tiny_lm):
+        # regression (ISSUE 16 satellite): the disaggregated pipeline
+        # shares ONE ladder, but the observing engine used to arm the
+        # stage-3 weighted-eviction lever only on its own pool — the
+        # other side kept evicting pure-LRU under overload
+        from paddle_tpu.serving.cluster.disagg import (
+            DisaggregatedEngine)
+        d = DisaggregatedEngine(tiny_lm, ServingConfig(
+            page_size=8, max_batch_size=2, prefill_chunk=8,
+            disaggregate=True, clock=FakeClock(),
+            tenants={'a': {'weight': 0.2}, 'b': {'weight': 2.0}},
+            degrade=True, degrade_window=1,
+            degrade_up=(0.1, 0.2, 0.3),
+            degrade_down=(0.01, 0.02, 0.03), degrade_hold=1))
+        assert d.prefill._ladder is d.decode._ladder
+        d.decode.pool.utilization = lambda: 0.95    # forced pressure
+        for _ in range(3):
+            d.decode._observe_pressure()
+        assert d.decode.degrade_stage() == 3
+        assert d.decode.pool._evict_weights is not None
+        assert d.prefill.pool._evict_weights is not None
+        # calm signal walks back down: BOTH levers disarm on 3 -> 2
+        d.decode.pool.utilization = lambda: 0.0
+        for _ in range(12):
+            d.decode._observe_pressure()
+        assert d.decode.degrade_stage() < 3
+        assert d.decode.pool._evict_weights is None
+        assert d.prefill.pool._evict_weights is None
+        # symmetric: a PREFILL-side observation arms the decode pool
+        d.prefill.pool.utilization = lambda: 0.95
+        while d.prefill.degrade_stage() < 3:
+            d.prefill._observe_pressure()
+        assert d.prefill.pool._evict_weights is not None
+        assert d.decode.pool._evict_weights is not None
+        d.shutdown()
+
 
 # ---------------------------------------------------------------------------
 # no-tenant identity: default config is the PR-9 engine, bit for bit

@@ -1,0 +1,318 @@
+#!/usr/bin/env python
+"""Cross-round bench regression tracking (ISSUE 16).
+
+Load any two BENCH_r*.json artifacts, line their legs up, and emit
+per-leg metric deltas with regression/improvement verdicts against a
+relative threshold — plus the step-time ledger breakdown side by side
+when either round carries one — so a bench round produces attributable
+numbers instead of a flat headline.
+
+    python tools/bench_compare.py BENCH_r04.json BENCH_r05.json
+    python tools/bench_compare.py A.json B.json --json --threshold 0.05
+    python tools/bench_compare.py --selftest
+
+Record shapes handled:
+  * the driver wrapper {n, cmd, rc, tail, parsed} (parsed is the bench
+    record) or a bare bench.py stdout record;
+  * schema v2 (ISSUE 16): top-level `legs` dict + schema_version/round
+    stamps + the headline `detail.ledger` record;
+  * legacy r04/r05 records: no `legs` — satellite legs nest inside
+    `detail` beside the headline scalars (the normalizer lifts both
+    into one legs dict, headline under 'gpt1.3b_adamw').
+
+Verdicts: rel = (new - old) / old per metric; |rel| <= threshold is
+'flat', beyond it the metric's direction (higher-is-better tok/s vs
+lower-is-better ms) decides 'improvement' or 'regression'. With
+--strict the process exits 1 when any regression is found.
+"""
+import argparse
+import json
+import os
+import sys
+
+# metric -> direction ('higher'|'lower' is better). Anything numeric
+# and shared but unlisted is reported as 'info' (delta, no verdict).
+METRIC_DIRECTION = {
+    'mfu': 'higher',
+    'tflops': 'higher',
+    'tokens_per_sec': 'higher',
+    'samples_per_sec': 'higher',
+    'images_per_sec': 'higher',
+    'steps_per_sec': 'higher',
+    'decode_tokens_per_sec': 'higher',
+    'requests_per_sec': 'higher',
+    'build_rows_per_sec': 'higher',
+    'pull_rows_per_sec': 'higher',
+    'push_rows_per_sec': 'higher',
+    'ms_per_step': 'lower',
+    'pull_ms': 'lower',
+    'push_ms': 'lower',
+    'dense_ms': 'lower',
+    'ttft_p50_ms': 'lower',
+    'ttft_p99_ms': 'lower',
+    'tpot_p50_ms': 'lower',
+    'e2e_p99_ms': 'lower',
+}
+DEFAULT_THRESHOLD = 0.02
+HEADLINE_LEG = 'gpt1.3b_adamw'
+
+# legacy detail keys that are records riding with the headline, not
+# satellite legs of their own
+_NON_LEG_DETAIL = frozenset((
+    'host', 'remat', 'ledger', 'memory', 'telemetry', 'pipeline',
+    'fused_primitives', 'comm', 'comm_overlap'))
+
+
+def load_record(path):
+    """The bench record out of a driver artifact (or bare stdout)."""
+    with open(path) as f:
+        doc = json.load(f)
+    rec = doc.get('parsed') if isinstance(doc, dict) and 'parsed' in doc \
+        else doc
+    if not isinstance(rec, dict) or 'metric' not in rec:
+        raise ValueError(f'{path}: not a bench record (no metric)')
+    return rec
+
+
+def normalize(rec):
+    """-> {round, schema_version, metric, value, legs, ledger}."""
+    detail = rec.get('detail') or {}
+    legs = rec.get('legs')
+    if not isinstance(legs, dict):
+        # legacy shape: satellite legs nest inside detail; the headline
+        # scalars ARE detail. Lift both.
+        legs = {}
+        headline = {}
+        for k, v in detail.items():
+            if isinstance(v, dict) and k not in _NON_LEG_DETAIL:
+                legs[k] = v
+            elif isinstance(v, (int, float)) or k == 'optimizer':
+                headline[k] = v
+        if isinstance(rec.get('value'), (int, float)):
+            headline.setdefault('mfu', rec['value'])
+        legs[HEADLINE_LEG] = headline
+    ledger = None
+    head = legs.get(HEADLINE_LEG)
+    if isinstance(head, dict) and isinstance(head.get('ledger'), dict):
+        ledger = head['ledger']
+    elif isinstance(detail.get('ledger'), dict):
+        ledger = detail['ledger']
+    return {
+        'round': rec.get('round'),
+        'schema_version': rec.get('schema_version', 1),
+        'metric': rec.get('metric'),
+        'value': rec.get('value'),
+        'legs': legs,
+        'ledger': ledger,
+    }
+
+
+def _verdict(direction, rel, threshold):
+    if abs(rel) <= threshold:
+        return 'flat'
+    better = rel > 0 if direction == 'higher' else rel < 0
+    return 'improvement' if better else 'regression'
+
+
+def compare_legs(a, b, threshold=DEFAULT_THRESHOLD):
+    """Per-leg metric deltas. Returns a list of leg dicts:
+    {leg, status, metrics: [{name, old, new, rel, verdict}]}."""
+    out = []
+    for leg in sorted(set(a['legs']) | set(b['legs'])):
+        la, lb = a['legs'].get(leg), b['legs'].get(leg)
+        if la is None or lb is None:
+            out.append({'leg': leg,
+                        'status': 'added' if la is None else 'removed',
+                        'metrics': []})
+            continue
+        if 'error' in la or 'error' in lb:
+            which = ('both' if 'error' in la and 'error' in lb
+                     else ('old' if 'error' in la else 'new'))
+            out.append({'leg': leg, 'status': f'error({which})',
+                        'metrics': []})
+            continue
+        rows = []
+        for name in sorted(set(la) & set(lb)):
+            va, vb = la[name], lb[name]
+            if not (isinstance(va, (int, float))
+                    and isinstance(vb, (int, float))):
+                continue
+            if not va:
+                continue
+            direction = METRIC_DIRECTION.get(name)
+            rel = (vb - va) / abs(va)
+            rows.append({
+                'name': name, 'old': va, 'new': vb,
+                'rel': round(rel, 4),
+                'verdict': (_verdict(direction, rel, threshold)
+                            if direction else 'info'),
+            })
+        out.append({'leg': leg, 'status': 'compared', 'metrics': rows})
+    return out
+
+
+def compare(a, b, threshold=DEFAULT_THRESHOLD):
+    """The full comparison document for two normalized records."""
+    legs = compare_legs(a, b, threshold)
+    verdicts = [m['verdict'] for leg in legs for m in leg['metrics']]
+    return {
+        'old_round': a['round'], 'new_round': b['round'],
+        'old_metric': {'name': a['metric'], 'value': a['value']},
+        'new_metric': {'name': b['metric'], 'value': b['value']},
+        'threshold': threshold,
+        'legs': legs,
+        'ledger': {'old': a['ledger'], 'new': b['ledger']},
+        'regressions': verdicts.count('regression'),
+        'improvements': verdicts.count('improvement'),
+        'flat': verdicts.count('flat'),
+    }
+
+
+# ---------------------------------------------------------------------------
+# rendering
+# ---------------------------------------------------------------------------
+_MARK = {'regression': '!! regression', 'improvement': '++ improvement',
+         'flat': '   flat', 'info': '   info'}
+
+
+def render(cmp_doc):
+    old_r = cmp_doc.get('old_round') or 'old'
+    new_r = cmp_doc.get('new_round') or 'new'
+    out = [f'== bench compare {old_r} -> {new_r} ' + '=' * 30]
+    om, nm = cmp_doc['old_metric'], cmp_doc['new_metric']
+    out.append(f"headline: {om['name']} {om['value']} -> "
+               f"{nm['name']} {nm['value']}   (threshold "
+               f"{cmp_doc['threshold'] * 100:.1f}%)")
+    for leg in cmp_doc['legs']:
+        if leg['status'] != 'compared':
+            out.append(f"  {leg['leg']:<24} [{leg['status']}]")
+            continue
+        out.append(f"  {leg['leg']}:")
+        for m in leg['metrics']:
+            out.append(
+                f"    {m['name']:<22} {m['old']:>12.4g} -> "
+                f"{m['new']:>12.4g}  {m['rel'] * 100:>+7.2f}%  "
+                f"{_MARK.get(m['verdict'], m['verdict'])}")
+    led = cmp_doc.get('ledger') or {}
+    la, lb = led.get('old'), led.get('new')
+    if la or lb:
+        out.append('  step-time ledger (per-step seconds, '
+                   f'{old_r} | {new_r}):')
+        ca = (la or {}).get('components') or {}
+        cb = (lb or {}).get('components') or {}
+
+        def _f(v):
+            return f'{v * 1e3:10.3f}ms' if isinstance(
+                v, (int, float)) else '         --'
+
+        out.append(f"    {'wall':<14} "
+                   f"{_f((la or {}).get('wall_seconds'))} | "
+                   f"{_f((lb or {}).get('wall_seconds'))}")
+        for c in ('compute', 'exposed_comm', 'bubble', 'host_gap',
+                  'residue'):
+            out.append(f'    {c:<14} {_f(ca.get(c))} | {_f(cb.get(c))}')
+        for key in ('model_tflops', 'hardware_tflops', 'mfu'):
+            va = (la or {}).get(key)
+            vb = (lb or {}).get(key)
+            if va is not None or vb is not None:
+                fa = f'{va:.4g}' if isinstance(va, (int, float)) else '--'
+                fb = f'{vb:.4g}' if isinstance(vb, (int, float)) else '--'
+                out.append(f'    {key:<14} {fa:>12} | {fb:>12}')
+    out.append(f"verdicts: {cmp_doc['regressions']} regression(s), "
+               f"{cmp_doc['improvements']} improvement(s), "
+               f"{cmp_doc['flat']} flat")
+    return '\n'.join(out)
+
+
+# ---------------------------------------------------------------------------
+# selftest
+# ---------------------------------------------------------------------------
+def _repo_root():
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def selftest():
+    # 1) synthetic v2 pair: delta math, verdict signs, ledger rendering
+    def _rec(ms, toks, mfu, compute):
+        return {'schema_version': 2, 'round': f'r{int(ms)}',
+                'metric': 'm', 'value': mfu,
+                'legs': {HEADLINE_LEG: {
+                    'ms_per_step': ms, 'tokens_per_sec': toks,
+                    'mfu': mfu,
+                    'ledger': {'wall_seconds': ms / 1e3,
+                               'components': {'compute': compute,
+                                              'exposed_comm': 0.01,
+                                              'bubble': 0.02,
+                                              'host_gap': 0.005,
+                                              'residue': 0.001},
+                               'model_tflops': 100.0, 'mfu': mfu}}},
+                'detail': {}}
+
+    a = normalize(_rec(1000.0, 16000.0, 0.50, 0.9))
+    b = normalize(_rec(800.0, 20000.0, 0.625, 0.7))
+    doc = compare(a, b, threshold=0.02)
+    head = {m['name']: m for leg in doc['legs'] for m in leg['metrics']
+            if leg['leg'] == HEADLINE_LEG}
+    assert head['ms_per_step']['verdict'] == 'improvement', head
+    assert head['tokens_per_sec']['verdict'] == 'improvement', head
+    assert abs(head['ms_per_step']['rel'] - (-0.2)) < 1e-9, head
+    assert doc['ledger']['old'] and doc['ledger']['new']
+    text = render(doc)
+    assert 'step-time ledger' in text and 'compute' in text
+    rev = compare(b, a, threshold=0.02)
+    assert rev['regressions'] >= 2, 'reversed compare must regress'
+
+    # 2) the real r04 -> r05 artifacts: legacy-shape normalization and
+    # the asserted regression verdict (r05's headline MFU dropped 2.3%,
+    # past the 2% default threshold)
+    root = _repo_root()
+    r04 = os.path.join(root, 'BENCH_r04.json')
+    r05 = os.path.join(root, 'BENCH_r05.json')
+    a = normalize(load_record(r04))
+    b = normalize(load_record(r05))
+    assert HEADLINE_LEG in a['legs'] and HEADLINE_LEG in b['legs']
+    doc = compare(a, b)
+    head = {m['name']: m for leg in doc['legs'] for m in leg['metrics']
+            if leg['leg'] == HEADLINE_LEG}
+    assert head['mfu']['verdict'] == 'regression', head.get('mfu')
+    assert head['ms_per_step']['verdict'] == 'regression', \
+        head.get('ms_per_step')
+    assert doc['regressions'] >= 1
+    text = render(doc)
+    assert 'regression' in text
+    print(text)
+    print('bench_compare selftest OK')
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument('old', nargs='?', help='older BENCH_r*.json')
+    ap.add_argument('new', nargs='?', help='newer BENCH_r*.json')
+    ap.add_argument('--threshold', type=float, default=DEFAULT_THRESHOLD,
+                    help='relative delta past which a verdict is '
+                         'rendered (default 0.02)')
+    ap.add_argument('--json', action='store_true',
+                    help='emit the comparison document as JSON')
+    ap.add_argument('--strict', action='store_true',
+                    help='exit 1 when any regression is found')
+    ap.add_argument('--selftest', action='store_true')
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return selftest()
+    if not args.old or not args.new:
+        ap.error('need two BENCH_r*.json paths (or --selftest)')
+    doc = compare(normalize(load_record(args.old)),
+                  normalize(load_record(args.new)),
+                  threshold=args.threshold)
+    if args.json:
+        print(json.dumps(doc, indent=2))
+    else:
+        print(render(doc))
+    if args.strict and doc['regressions']:
+        return 1
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1637,8 +1637,144 @@ def pp_main(argv):
     return 0
 
 
+def _find_ledger(doc):
+    """Locate a step-time ledger account (ISSUE 16): either a single
+    StepLedger.account() record ({'wall_seconds', 'components', ...})
+    from a bench leg's `ledger` section, or a ledger_snapshot() map
+    ({engine: account}) from telemetry. Returns {engine: account}."""
+    if isinstance(doc, list):
+        for v in doc:
+            found = _find_ledger(v)
+            if found is not None:
+                return found
+        return None
+    if not isinstance(doc, dict):
+        return None
+    if 'wall_seconds' in doc and isinstance(doc.get('components'), dict):
+        return {doc.get('engine', 'step'): doc}
+    for key in ('ledger', 'detail', 'telemetry'):
+        found = _find_ledger(doc.get(key))
+        if found is not None:
+            return found
+    if doc and all(isinstance(v, dict) and 'wall_seconds' in v
+                   and 'components' in v for v in doc.values()):
+        return doc   # a ledger_snapshot() {engine: account} map
+    if 'legs' in doc:
+        for leg in (doc['legs'] or {}).values():
+            found = _find_ledger(leg)
+            if found is not None:
+                return found
+    return None
+
+
+def _ledger_selftest():
+    """CI smoke: tiny jitted train loop -> ptpu_ledger_* gauges ->
+    snapshot -> renderer; reconciliation invariant; bench-record
+    locator; straggler-report rendering."""
+    _repo_root_on_path()
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu import jit as pjit
+    from paddle_tpu.core.ledger import (ledger_snapshot, render_ledger,
+                                        render_straggler_report)
+
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(16, 4)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    m = M()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=m.parameters())
+    ts = pjit.TrainStep(
+        m, lambda model, x, y: ((model(x) - y) ** 2).mean(), opt)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(
+        8, 16).astype('float32'))
+    y = paddle.to_tensor(np.random.RandomState(1).randn(
+        8, 4).astype('float32'))
+    for _ in range(6):
+        ts.train_step(x, y)
+    ts.flush()
+    snap = ledger_snapshot()
+    assert snap and 'jit' in snap, snap
+    a = snap['jit']
+    comps = a['components']
+    assert set(comps) == {'compute', 'exposed_comm', 'bubble',
+                          'host_gap', 'residue'}, comps
+    wall = a['wall_seconds']
+    assert wall > 0 and abs(sum(comps.values()) - wall) <= 0.10 * wall, a
+    assert a['tokens_per_step'] == 128, a
+    text = render_ledger(snap)
+    assert 'engine: jit' in text and 'compute' in text, text
+    print(text)
+    # bench-record shape: detail.ledger account is found + rendered
+    acct = ts._ledger.account()
+    doc = {'legs': {'gpt1.3b_adamw': {'ledger': acct}}}
+    found = _find_ledger(doc)
+    assert found and 'jit' in found, found
+    print(render_ledger(found))
+    # straggler artifact rendering (the 2-rank path writes these)
+    report = {'kind': 'straggler_report', 'step': 50, 'world_size': 2,
+              'threshold': 1.25, 'median_wall_seconds': 0.010,
+              'ranks': {'0': 0.010, '1': 0.030},
+              'relative_wall': {'0': 1.0, '1': 3.0},
+              'offending_ranks': [1]}
+    text = render_straggler_report(report)
+    assert 'STRAGGLER' in text and 'rank 1' in text, text
+    print(text)
+    print('health_dump ledger selftest: OK')
+    return 0
+
+
+def ledger_main(argv):
+    ap = argparse.ArgumentParser(
+        prog='health_dump.py ledger',
+        description='render the step-time ledger (compute/exposed-comm/'
+                    'bubble/host-gap/residue decomposition + model '
+                    'TFLOP/s and MFU) from a bench record or telemetry '
+                    'snapshot, or a straggler_report artifact '
+                    '(docs/observability.md#step-time-ledger)')
+    ap.add_argument('artifact', nargs='?',
+                    help='bench record / telemetry / straggler_report '
+                         'JSON')
+    ap.add_argument('--json', action='store_true')
+    ap.add_argument('--selftest', action='store_true')
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return _ledger_selftest()
+    if not args.artifact:
+        ap.error('artifact path required (or --selftest)')
+    with open(args.artifact) as f:
+        doc = json.load(f)
+    _repo_root_on_path()
+    from paddle_tpu.core.ledger import (render_ledger,
+                                        render_straggler_report)
+    if isinstance(doc, dict) and doc.get('kind') == 'straggler_report':
+        print(json.dumps(doc, indent=2) if args.json
+              else render_straggler_report(doc))
+        return 0
+    led = _find_ledger(doc)
+    if led is None:
+        raise ValueError(
+            'no step-time ledger in this artifact (expected a record '
+            "with a 'ledger' section — the engines publish one via "
+            'flush(); bench.py attaches it to the headline leg)')
+    if args.json:
+        print(json.dumps(led, indent=2))
+    else:
+        print(render_ledger(led))
+    return 0
+
+
 def main(argv=None):
     argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == 'ledger':
+        return ledger_main(argv[1:])
     if argv and argv[0] == 'pp':
         return pp_main(argv[1:])
     if argv and argv[0] == 'host':

@@ -60,6 +60,13 @@
 #                      stage-transition trace events, weighted prefix
 #                      eviction, no-tenant token-identity, adversarial
 #                      heavy+light mix, per-tenant SLO rendering
+#   --ledger-selftest - step-time ledger & MFU observatory (ISSUE 16):
+#                      wall decomposition reconciliation, analytic
+#                      FLOPs/MFU with remat recompute factor, all-
+#                      engine gauge wiring, 2-rank injected-slow-rank
+#                      straggler detection, histogram percentile
+#                      edges, metrics-docs registry consistency,
+#                      bench_compare regression verdicts, ledger CLI
 set -e
 cd "$(dirname "$0")/.."
 TIER="${1:-all}"
@@ -72,7 +79,9 @@ case "$TIER" in
             tests/test_serving.py tests/test_serving_trace.py \
             tests/test_serving_cluster.py tests/test_serving_tenants.py \
             tests/test_remat.py \
-            tests/test_async_step.py tests/test_pipeline_schedule.py -q
+            tests/test_async_step.py tests/test_pipeline_schedule.py \
+            tests/test_ledger.py tests/test_monitor.py \
+            tests/test_metrics_docs.py -q
           # observability tooling smoke: tracer -> export -> summary CLI
           python tools/trace_summary.py --selftest
           # diagnostics smoke: flight recorder -> hang/OOM reports -> CLI
@@ -92,7 +101,11 @@ case "$TIER" in
           # async smoke: windowed loop -> host-gap gauges -> render
           python tools/health_dump.py host --selftest
           # pipeline smoke: schedule model -> pp gauges -> render
-          python tools/health_dump.py pp --selftest ;;
+          python tools/health_dump.py pp --selftest
+          # ledger smoke: TrainStep loop -> ledger gauges -> render
+          python tools/health_dump.py ledger --selftest
+          # bench-compare smoke: synthetic + real rounds -> verdicts
+          python tools/bench_compare.py --selftest ;;
   dist)   python -m pytest tests/test_distributed.py \
             tests/test_launch_elastic.py tests/test_bert_zero_asp.py -q ;;
   native) python -m pytest tests/test_native.py tests/test_ps.py -q ;;
@@ -179,6 +192,16 @@ case "$TIER" in
           python -m pytest tests/test_serving_tenants.py -q
           python tools/health_dump.py tenants --selftest
           python tools/trace_summary.py --selftest ;;
+  --ledger-selftest)
+          # the step-time ledger end to end (ISSUE 16): decomposition
+          # + FLOPs/MFU units, engine wiring, the 2-rank straggler
+          # subprocess leg, percentile edges, docs-registry
+          # consistency, then the ledger + bench-compare CLI smokes
+          XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+          python -m pytest tests/test_ledger.py tests/test_monitor.py \
+            tests/test_metrics_docs.py -q
+          python tools/health_dump.py ledger --selftest
+          python tools/bench_compare.py --selftest ;;
   all)    python -m pytest tests/ -q
           python tools/trace_summary.py --selftest
           python tools/health_dump.py --selftest
@@ -190,6 +213,8 @@ case "$TIER" in
           python tools/health_dump.py pallas --selftest
           python tools/health_dump.py mem --selftest
           python tools/health_dump.py host --selftest
-          python tools/health_dump.py pp --selftest ;;
-  *) echo "usage: $0 [fast|dist|native|e2e|all|--comm-selftest|--serve-selftest|--quant-selftest|--pallas-selftest|--overlap-selftest|--cluster-selftest|--remat-selftest|--async-selftest|--pp-selftest|--tenant-selftest]"; exit 1 ;;
+          python tools/health_dump.py pp --selftest
+          python tools/health_dump.py ledger --selftest
+          python tools/bench_compare.py --selftest ;;
+  *) echo "usage: $0 [fast|dist|native|e2e|all|--comm-selftest|--serve-selftest|--quant-selftest|--pallas-selftest|--overlap-selftest|--cluster-selftest|--remat-selftest|--async-selftest|--pp-selftest|--tenant-selftest|--ledger-selftest]"; exit 1 ;;
 esac
