@@ -687,6 +687,13 @@ def bench_gpt_serve():
 
     dense_cache_tokens = n_req * cfg.max_seq_len
     paged_tokens = st['pool']['high_water'] * page_size
+    # serving ledger (ISSUE 17), captured BEFORE shutdown (which
+    # unregisters the ledger): reconciled wall decomposition, the
+    # goodput identity and the decode roofline for the measured
+    # stream (warmup excluded by reset_stats)
+    serve_ledger = eng.ledger.account()
+    serve_goodput = eng.ledger.goodput()
+    serve_roofline = eng.ledger.roofline()
     eng.shutdown()
 
     # -- shared-prefix stream (ISSUE 9): N requests with a common
@@ -783,6 +790,18 @@ def bench_gpt_serve():
         'prompt_lens': [int(n) for n in lens],
         'kv_tokens_dense_vs_paged': [dense_cache_tokens, paged_tokens],
         'shared_prefix': shared_prefix,
+        # serving ledger & roofline (ISSUE 17): the wall decomposition
+        # (components reconcile to wall_seconds, residue surfaced),
+        # the delivered/wasted goodput account, and the decode
+        # bytes-moved roofline (MBU only on TPU, absolute GB/s always)
+        'ledger': serve_ledger,
+        'goodput': serve_goodput,
+        'roofline': serve_roofline,
+        'goodput_fraction': serve_goodput.get('goodput_fraction'),
+        'host_bound_fraction':
+            (serve_ledger or {}).get('host_bound_fraction'),
+        'hbm_gbps': (serve_roofline or {}).get('hbm_gbps'),
+        'mbu': (serve_roofline or {}).get('mbu'),
         'backend': jax.default_backend(),
     }
 
@@ -901,7 +920,11 @@ def bench_gpt_serve_cluster():
             'prefix_hits': st['prefix_hits_total'],
             'batch_occupancy': st['batch_occupancy'],
             'slo': _slo(table),
+            # per-replica goodput (ISSUE 17), read off the live ledger
+            'goodput': r.engine.ledger.goodput(),
         }
+    router.refresh()        # fresh statuses -> snapshot goodput sees
+                            # every replica's final token counts
     snap = router.snapshot()
 
     # -- structured-rejection retry-hint accuracy (ISSUE 15): overload
@@ -961,6 +984,11 @@ def bench_gpt_serve_cluster():
         'aggregate_decode_speedup_vs_single':
             (agg_decode_tps / single_rec['decode_tokens_per_sec']
              if single_rec['decode_tokens_per_sec'] else None),
+        # cluster-aggregated goodput (ISSUE 17): replica accounts
+        # summed, with any drain-resubmit recompute repriced wasted
+        'cluster_goodput': snap.get('goodput'),
+        'goodput_fraction':
+            (snap.get('goodput') or {}).get('goodput_fraction'),
         'affinity_hit_rate': snap['affinity_hit_rate'],
         'outputs_identical_to_single': outs == ref_outs,
         'backend': jax.default_backend(),
@@ -1091,6 +1119,9 @@ def bench_gpt_serve_tenants():
                 'max_stage': max(
                     [h['to'] for h in eng.ladder_history()] or [0]),
             },
+            # goodput account (ISSUE 17): delivered/wasted identity +
+            # the per-tenant split (who paid for the preempt churn)
+            'goodput': eng.ledger.goodput(),
         }
         outs = [r.output_ids() for r in hreqs + lreqs]
         eng.shutdown()
@@ -1441,6 +1472,53 @@ def _check_legs(result):
         assert 'ledger' in (headline.get('telemetry') or {}) \
             or 'error' in (headline.get('telemetry') or {}), \
             'headline leg telemetry lacks ledger'
+    # the serving goodput ledger (ISSUE 17): the throughput leg must
+    # carry the reconciled serve-step decomposition — five components
+    # summing to within 10% of the measured iteration wall (residue
+    # surfaced, never hidden) — a real host_bound_fraction, and the
+    # goodput account whose identity holds exactly
+    sleg = legs.get('gpt_serve_throughput') or {}
+    if 'error' not in sleg:
+        sled = sleg.get('ledger')
+        assert isinstance(sled, dict), 'serve leg lacks ledger'
+        scomps = sled.get('components')
+        assert isinstance(scomps, dict), 'serve ledger lacks components'
+        for key in ('compute', 'host_fetch', 'schedule', 'page_stream',
+                    'residue'):
+            assert key in scomps, f'serve ledger components lack {key}'
+        swall = sled.get('wall_seconds') or 0.0
+        assert swall > 0.0, 'serve ledger lacks wall_seconds'
+        stotal = sum(scomps.values())
+        assert abs(stotal - swall) <= 0.10 * swall, \
+            f'serve ledger components sum {stotal:.6f}s vs wall ' \
+            f'{swall:.6f}s (off by more than 10%)'
+        assert sled.get('host_bound_fraction') is not None, \
+            'serve ledger lacks host_bound_fraction'
+        sgp = sleg.get('goodput')
+        assert isinstance(sgp, dict), 'serve leg lacks goodput'
+        assert sgp['delivered_tokens'] + sgp['wasted_tokens'] \
+            == sgp['emitted_tokens'], \
+            'serve goodput identity broken (delivered + wasted != emitted)'
+        sroof = sleg.get('roofline')
+        assert isinstance(sroof, dict), 'serve leg lacks roofline'
+        assert 'decode_bytes_per_iteration' in sroof, \
+            'serve roofline lacks decode_bytes_per_iteration'
+
+    def _check_goodput_identity(gp, where):
+        if not isinstance(gp, dict):
+            return
+        assert gp['delivered_tokens'] + gp['wasted_tokens'] \
+            == gp['emitted_tokens'], \
+            f'{where}: goodput identity broken'
+
+    if 'error' not in cleg:
+        _check_goodput_identity(cleg.get('cluster_goodput'),
+                                'cluster leg')
+    if 'error' not in tleg:
+        for side in ('fcfs', 'slo'):
+            _check_goodput_identity(
+                (tleg.get('scheduler_comparison') or {})
+                .get(side, {}).get('goodput'), f'tenants leg {side}')
     # record stamps (ISSUE 16): schema version + round id at top level
     assert result.get('schema_version'), 'result lacks schema_version'
     assert result.get('round'), 'result lacks round id'

@@ -31,6 +31,7 @@ eviction coordination for zero benefit at preemption rates worth
 having.
 """
 import math
+import time
 
 from ..engine import ServingConfig, ServingEngine
 from ..kv_pool import PoolExhausted
@@ -73,7 +74,11 @@ class DisaggregatedEngine:
                         max_batch_size=config.prefill_slots,
                         trace=False,
                         clock=self.decode._clock)
-        self.prefill = ServingEngine(model, pcfg, mesh=mesh)
+        # its own ledger/gap-monitor site: both engines live in this
+        # process and the registries are latest-wins per site, so the
+        # prefill side must not shadow the decode engine's 'serve' row
+        self.prefill = ServingEngine(model, pcfg, mesh=mesh,
+                                     ledger_site='serve_prefill')
         self.prefill.tracer = self.decode.tracer
         # the facade checks deadline admission itself (combined
         # backlogs at the decode rate, submit() below) — the prefill
@@ -224,10 +229,16 @@ class DisaggregatedEngine:
         dst_pages = dst_pool.page_table(req.id)
         n = min(len(src_pages), len(dst_pages))
         if n > n_cached:
+            t0 = time.perf_counter()
             self.decode.pool.kv = stream_kv_pages(
                 src_pool.kv, dst_pool.kv,
                 src_pages[n_cached:n], dst_pages[n_cached:n],
                 chunk_pages=self.config.stream_chunk_pages)
+            # ledger: the handoff runs between the two engines' sweeps,
+            # so the stream wall lands in the decode engine's NEXT
+            # iteration as its page_stream component
+            self.decode.ledger.note_page_stream(
+                time.perf_counter() - t0)
             self._streamed_pages += n - n_cached
         # release the prefill side WITHOUT retiring: the request lives
         # on, its journal continues on the decode engine

@@ -161,6 +161,37 @@ class _EngineHost:
                 for r in live + waiting)
             rate = (eng._decode_tokens / eng._decode_time
                     if eng._decode_time else 0.0)
+            # serving ledger view (ISSUE 17): goodput counters + the
+            # wall decomposition summary ride the heartbeat so the
+            # router's cluster_snapshot() can aggregate without extra
+            # RPCs. account() is None until the engine iterated.
+            led = getattr(eng, 'ledger', None)
+            goodput = led.goodput() if led is not None else None
+            acct = led.account() if led is not None else None
+            # disaggregated replica: the prefill engine priced the
+            # prompt positions on ITS ledger — fold them in so the
+            # replica reports the whole pipeline's token stream
+            pre = getattr(self.engine, 'prefill', None)
+            pre_led = getattr(pre, 'ledger', None) if pre is not None \
+                else None
+            if goodput is not None and pre_led is not None:
+                g2 = pre_led.goodput()
+                for k in ('emitted_tokens', 'delivered_tokens',
+                          'wasted_tokens', 'spec_shed_tokens'):
+                    goodput[k] += g2[k]
+                for c, v in g2['wasted_by_cause'].items():
+                    goodput['wasted_by_cause'][c] = \
+                        goodput['wasted_by_cause'].get(c, 0) + v
+                for tid, row in g2['per_tenant'].items():
+                    dst = goodput['per_tenant'].setdefault(
+                        tid, {'delivered_tokens': 0,
+                              'wasted_tokens': 0})
+                    dst['delivered_tokens'] += row['delivered_tokens']
+                    dst['wasted_tokens'] += row['wasted_tokens']
+                goodput['goodput_fraction'] = (
+                    goodput['delivered_tokens']
+                    / goodput['emitted_tokens']
+                    if goodput['emitted_tokens'] else None)
             return {
                 'replica_id': self.replica_id,
                 'beat_age_s': now - self._beat,
@@ -176,6 +207,8 @@ class _EngineHost:
                 'pool': {'pages_in_use': eng.pool.pages_in_use,
                          'num_pages': eng.pool.num_pages},
                 'prefix_digest': _prefix_digest(self.engine),
+                'goodput': goodput,
+                'ledger': acct,
             }
 
     def drain(self):

@@ -168,6 +168,10 @@ class ClusterRouter:
         # a tenant lost to backpressure — a heavy tenant saturating
         # its affinity replica shows up here, not in global spills
         self.tenant_spills = {}
+        # goodput (ISSUE 17): prefix tokens drain-resubmits make peers
+        # re-prefill — the cluster-level wasted-work cause no single
+        # replica can see (each peer counts them as first-time work)
+        self._drain_recompute_tokens = 0
 
     OPTIMISTIC_GENERATIONS = 2
 
@@ -470,6 +474,22 @@ class ClusterRouter:
                 decision = 'spill'
                 peer = min(healthy, key=self._load_key)
             self._dispatch(req, peer, decision)
+            # goodput (ISSUE 17): the peer re-prefills the whole
+            # resubmitted prefix (prompt + tokens streamed so far) —
+            # work the cluster already paid for once. Priced here
+            # because only the router sees the resubmit; the peer's
+            # own ledger counts those positions as first-time
+            # delivered, and cluster_snapshot() moves this many from
+            # delivered to wasted. Upper bound: a peer prefix-cache
+            # hit shrinks the actual recompute.
+            recompute = len(req.prompt) + len(req.tokens)
+            self._drain_recompute_tokens += recompute
+            _m.counter(
+                'ptpu_route_drain_recompute_tokens_total',
+                help='drain-resubmit recompute: prefix tokens peers '
+                     're-prefill for requests moved off a drained '
+                     'replica (lifetime; priced as wasted in '
+                     'cluster_snapshot goodput)').inc(recompute)
             return True
         except Exception:                   # noqa: BLE001
             if req not in self._unplaced:
@@ -631,6 +651,7 @@ class ClusterRouter:
                 'digest_size': len(self._digest.get(rid) or ())
                 + len(self._optimistic.get(rid) or ()),
                 'requests_routed': self._routed_count[rid],
+                'goodput': st.get('goodput'),
             }
         total = sum(self.decisions.get(k, 0)
                     for k in ('affinity', 'least_loaded', 'spill'))
@@ -645,7 +666,45 @@ class ClusterRouter:
             'requests': self._total_requests,
             'requests_done': self._done_requests,
             'tenant_spills': dict(self.tenant_spills),
+            'goodput': self._cluster_goodput(per_replica),
         }
+
+    def _cluster_goodput(self, per_replica):
+        """Aggregate the replicas' goodput accounts and reprice the
+        drain-resubmit recompute: each peer counted a resubmitted
+        prefix as first-time delivered work, so the router MOVES those
+        tokens delivered -> wasted (cause drain_recompute), keeping
+        delivered + wasted == emitted exact at the cluster level. None
+        until some replica reports a goodput block (pre-ISSUE-17
+        workers)."""
+        agg = {'emitted_tokens': 0, 'delivered_tokens': 0,
+               'wasted_tokens': 0, 'wasted_by_cause': {},
+               'spec_shed_tokens': 0}
+        seen = False
+        for row in per_replica.values():
+            g = row.get('goodput')
+            if not g:
+                continue
+            seen = True
+            for k in ('emitted_tokens', 'delivered_tokens',
+                      'wasted_tokens', 'spec_shed_tokens'):
+                agg[k] += int(g.get(k, 0) or 0)
+            for c, v in (g.get('wasted_by_cause') or {}).items():
+                agg['wasted_by_cause'][c] = \
+                    agg['wasted_by_cause'].get(c, 0) + int(v)
+        if not seen:
+            return None
+        moved = min(self._drain_recompute_tokens,
+                    agg['delivered_tokens'])
+        agg['delivered_tokens'] -= moved
+        agg['wasted_tokens'] += moved
+        agg['wasted_by_cause']['drain_recompute'] = \
+            agg['wasted_by_cause'].get('drain_recompute', 0) + moved
+        agg['drain_recompute_tokens'] = self._drain_recompute_tokens
+        agg['goodput_fraction'] = (
+            agg['delivered_tokens'] / agg['emitted_tokens']
+            if agg['emitted_tokens'] else None)
+        return agg
 
     def request_slo(self):
         """Router-side per-request latency view (submit→finish as the
@@ -682,4 +741,7 @@ def cluster_snapshot():
         m = reg.get(name)
         if m is not None:
             out[name] = m.value()
+    m = reg.get('ptpu_route_drain_recompute_tokens_total')
+    if m is not None:
+        out['ptpu_route_drain_recompute_tokens_total'] = m.value()
     return out or None

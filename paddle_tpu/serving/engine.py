@@ -47,6 +47,8 @@ from .scheduler import (AdmissionRejected, DegradeLadder, Request,
 from .request_trace import (ENGINE_REQ, RequestTracer,
                             build_serve_report, write_serve_report)
 from . import metrics as _metrics
+from .ledger import ServeLedger
+from ..core.async_step import HostGapMonitor, unregister_monitor
 from ..profiler import RecordEvent
 
 
@@ -232,7 +234,8 @@ class ServingEngine:
     collectives inside the traced step). docs/serving.md#mp-sharding.
     """
 
-    def __init__(self, model, config=None, mesh=None, **cfg_kw):
+    def __init__(self, model, config=None, mesh=None, ledger_site=None,
+                 **cfg_kw):
         import jax
         import jax.numpy as jnp
         if config is None:
@@ -393,6 +396,37 @@ class ServingEngine:
         # decode rate misrepresent the pipeline) and checks the
         # combined estimate itself before forwarding the submit
         self.deadline_admission = True
+        # serving ledger + host-gap observatory (ISSUE 17): the
+        # sampled-token fetch is this engine's only host sync, so a
+        # registered HostGapMonitor over the step loop turns its wait
+        # into a real host_bound_fraction; the ServeLedger carries the
+        # wall decomposition, the goodput account and the decode
+        # bytes-moved roofline. Both unregister at shutdown().
+        self.ledger_site = ledger_site or 'serve'
+        self._gap = HostGapMonitor(site=self.ledger_site)
+        param_bytes = 0
+        for a in self._params.values():
+            if isinstance(a, dict):     # int8 weight: q + scales
+                param_bytes += int(a['q'].nbytes) + int(a['s'].nbytes)
+            else:
+                param_bytes += int(getattr(a, 'nbytes', 0) or 0)
+        n_params = sum(int(getattr(p.data, 'size', 0) or 0)
+                       for _n, p in model.named_parameters())
+        self.ledger = ServeLedger(
+            engine=self.ledger_site, gap=self._gap,
+            n_params=n_params, layers=mcfg.num_layers,
+            hidden=mcfg.hidden_size, param_bytes=param_bytes,
+            kv_bytes_per_token=self.pool.bytes_per_token())
+        # per-iteration phase accumulators step() resets and
+        # _prefill_chunk_step/_decode_step feed (host perf_counter
+        # segments — never a device sync)
+        self._it_compute = 0.0
+        self._it_fetch = 0.0
+        self._it_decode_s = 0.0
+        self._it_kv_read_tokens = 0
+        self._it_prefill_tokens = 0
+        self._it_prefill_s = 0.0
+        self._it_prefill_ctx = 0
 
     # followers a budget-blocked queue head tolerates being admitted
     # past it before the admission sweep reverts to blocking at the
@@ -575,9 +609,19 @@ class ServingEngine:
         stalled-request watchdog, publishes metrics."""
         completed_before = self._completed
         preempt_before = self.scheduler.preemptions
+        t_begin = self._gap.dispatch_begin()
+        self._it_compute = 0.0
+        self._it_fetch = 0.0
+        self._it_decode_s = 0.0
+        self._it_kv_read_tokens = 0
+        self._it_prefill_tokens = 0
+        self._it_prefill_s = 0.0
+        self._it_prefill_ctx = 0
+        t_sched = time.perf_counter()
         with RecordEvent('serve::schedule', event_type='serve'):
             self._check_stalled()
             admitted = self._admit()
+        sched_dt = time.perf_counter() - t_sched
         prefilling = [r for r in self.scheduler.slots
                       if r is not None and r.state == RequestState.PREFILL]
         prefill_tokens = 0
@@ -608,6 +652,24 @@ class ServingEngine:
             pool_pages_in_use=self.pool.pages_in_use,
             pool_pages_total=self.pool.num_pages,
             degrade_stage=self.degrade_stage())
+        # ledger close-out: the iteration wall and its measured phase
+        # segments, then the gap-monitor span. dispatch_end BEFORE
+        # note_gating — dispatch_end zeroes the pending gating
+        # attribution, and the fetch wait belongs to the span that just
+        # closed (it is consumed by the NEXT dispatch_begin).
+        self.ledger.observe_iteration(
+            wall=time.perf_counter() - t_begin,
+            compute=self._it_compute,
+            host_fetch=self._it_fetch,
+            schedule=sched_dt,
+            decode_seconds=self._it_decode_s,
+            kv_read_tokens=self._it_kv_read_tokens,
+            prefill_tokens=self._it_prefill_tokens,
+            prefill_seconds=self._it_prefill_s,
+            prefill_ctx_tokens=self._it_prefill_ctx)
+        self._gap.dispatch_end(depth=1)
+        if self._it_fetch > 0.0:
+            self._gap.note_gating(self._it_fetch)
         if (self._completed != completed_before
                 or not self.scheduler.has_work
                 or (self._clock() - self._last_publish
@@ -1069,6 +1131,7 @@ class ServingEngine:
         chunk = toks[start:start + n] + [0] * (C - n)
         fn = self._step_fn(1, C, req.top_k > 0)
         self._key, sub = self._jax.random.split(self._key)
+        tc0 = time.perf_counter()
         with RecordEvent('serve::compiled_step', event_type='serve',
                          shape='prefill'):
             nxt, new_kv = fn(
@@ -1080,22 +1143,47 @@ class ServingEngine:
                 sub,
                 jnp.asarray([req.temperature], jnp.float32),
                 jnp.asarray([req.top_k], jnp.int32))
+        tc1 = time.perf_counter()
+        self._it_compute += tc1 - tc0
+        self._it_prefill_s += tc1 - tc0
+        self._it_prefill_tokens += n
+        self._it_prefill_ctx += n * (start + n)
         self.pool.kv = new_kv
         req.prefilled = start + n
         self._prefill_tokens += n
         self._prefill_chunks += 1
+        # goodput: positions below the request's computed high-water
+        # mark were forward-passed before (then destroyed by a
+        # preemption release) — this chunk re-derives them, priced as
+        # preempt_recompute waste. Prefix-cache resurrection advanced
+        # `start` past the cached span, so resurrected pages never
+        # bill. First-time positions are delivered prompt work.
+        prev_high = getattr(req, '_computed_high', 0)
+        recompute = max(0, min(prev_high, start + n) - start)
+        req._computed_high = max(prev_high, start + n)
+        self.ledger.account_prefill(n - recompute, recompute,
+                                    tenant_id=req.tenant_id)
         # every prefilled token's K/V is resident: index the newly
         # completed full pages so siblings (and our own resume) share
         self.pool.register_prefix(req.id, toks, req.prefilled,
                                   owner=req.tenant_id)
+        extra = {'recompute_tokens': recompute} if recompute else {}
+        if req.prefilled == len(toks) and req.max_new_tokens > 0:
+            # this chunk completes (re-)prefill and samples a token off
+            # its final column below — marked so reconstruct() can tell
+            # prefill-sampled tokens (initial AND every resume) from
+            # decode-step tokens when pricing delivered work (v4)
+            extra['sampled'] = 1
         self._trace(req, 'prefill_chunk', tokens=n, prefilled=start + n,
-                    pages=len(self.pool.page_table(req.id)))
+                    pages=len(self.pool.page_table(req.id)), **extra)
         if req.prefilled == len(toks):
             if req.max_new_tokens <= 0:
                 self._retire(req)   # prefill-only request (scoring):
                 return n            # the budget says emit nothing
+            tf0 = time.perf_counter()
             with RecordEvent('serve::sample_fetch', event_type='serve'):
                 tok = int(_host_fetch(nxt)[0])  # the sampled-token fetch
+            self._it_fetch += time.perf_counter() - tf0
             req.generated.append(tok)
             if req.first_token_time is None:
                 req.first_token_time = self._clock()
@@ -1126,6 +1214,21 @@ class ServingEngine:
         jnp = self._jnp
         sched = self.scheduler
         K = self._effective_spec_k()
+        if self.config.spec_k > 0 and K == 0:
+            # degrade stage >= 1 shed the configured draft capacity this
+            # step: price the foregone draft columns (min(spec_k,
+            # remaining budget) per greedy running row) as shed
+            # capacity — never computed, so outside the emitted-token
+            # identity
+            for req in sched.slots:
+                if req is None or req.state != RequestState.RUNNING \
+                        or req.top_k > 0:
+                    continue
+                budget = req.max_new_tokens - len(req.generated) - 1
+                if budget > 0:
+                    self.ledger.account_spec_shed(
+                        min(self.config.spec_k, budget),
+                        tenant_id=req.tenant_id)
         proposals = {}
         if K > 0:
             for req in sched.slots:
@@ -1165,6 +1268,8 @@ class ServingEngine:
                     continue
                 drafts = proposals.get(req.id, ()) if verify else ()
                 active.append((i, req, list(drafts)))
+                # decode roofline: KV tokens this row's attention reads
+                self._it_kv_read_tokens += req.context_len + len(drafts)
                 tokens[i, 0] = (req.generated[-1] if req.generated
                                 else req.prompt[-1])
                 if drafts:
@@ -1190,15 +1295,21 @@ class ServingEngine:
                 jnp.asarray(seq_lens), jnp.asarray(q_lens), sub,
                 jnp.asarray(temps), jnp.asarray(top_ks))
         self.pool.kv = new_kv
+        t1 = time.perf_counter()
         with RecordEvent('serve::sample_fetch', event_type='serve'):
             nxt = _host_fetch(nxt)              # the sampled-token fetch
-        dt = time.perf_counter() - t0
+        t2 = time.perf_counter()
+        dt = t2 - t0
+        self._it_compute += t1 - t0
+        self._it_decode_s += t1 - t0
+        self._it_fetch += t2 - t1
         self._decode_time += dt
         self._decode_steps += 1
         self._occupancy_sum += len(active) / B
         self._util_sum += self.pool.utilization()
         emitted_total = 0
         for i, req, drafts in active:
+            spec_m = None
             if verify:
                 if req.top_k > 0:
                     appended = [int(nxt[i, T])]     # sampled column
@@ -1212,17 +1323,34 @@ class ServingEngine:
                         self._spec_proposed += len(drafts)
                         self._spec_accepted += m
                         self._spec_steps += 1
-                        self._trace(req, 'spec_verify',
-                                    proposed=len(drafts), accepted=m)
+                        spec_m = m
             else:
                 appended = [int(nxt[i])]
             # emit in order, honoring eos mid-burst exactly like the
             # one-token path would have (nothing after eos escapes)
+            delivered_row = 0
             for tok in appended:
                 req.generated.append(tok)
                 emitted_total += 1
+                delivered_row += 1
                 if req.done:
                     break
+            # goodput: this row computed 1 + len(drafts) query columns;
+            # columns that never reached the request (rejected drafts,
+            # post-eos overdraft) are spec_rejected waste
+            self.ledger.account_decode(
+                delivered_row, 1 + len(drafts) - delivered_row,
+                tenant_id=req.tenant_id)
+            if spec_m is not None:
+                # emitted after the append sweep so `discarded` prices
+                # the accepted-but-dropped tail (eos / budget cut the
+                # burst short) — trace v4 waste matches the ledger's
+                # spec_rejected charge per request exactly
+                self._trace(req, 'spec_verify', proposed=len(drafts),
+                            accepted=spec_m,
+                            discarded=len(appended) - delivered_row)
+            prev_high = getattr(req, '_computed_high', 0)
+            req._computed_high = max(prev_high, req.context_len - 1)
             if drafts:
                 # speculative rollback: hand back pages grown for
                 # rejected drafts beyond the accepted context
@@ -1467,6 +1595,8 @@ class ServingEngine:
         if self.tracer is not None:
             self.tracer.reset()
         self.timeline.reset()
+        self.ledger.reset()
+        self._gap.reset()
 
     def publish_metrics(self):
         s = self.stats()
@@ -1483,6 +1613,8 @@ class ServingEngine:
         s['timeline'] = self.timeline.summary()
         self._last_publish = self._clock()
         _metrics.publish(s)
+        self.ledger.publish()
+        self._gap.publish()
 
     def request_table(self):
         """Per-request SLO reconstruction from the lifecycle journals
@@ -1510,10 +1642,16 @@ class ServingEngine:
         return out
 
     def shutdown(self):
-        """Drop the pool's device pages and the compiled steps."""
+        """Drop the pool's device pages and the compiled steps, and
+        unregister the gap monitor + serve ledger so a dead engine
+        stops reporting (the PR-13 training-engine discipline —
+        serve_ledger_snapshot() and the host-gap registry read live
+        objects, not stale gauges)."""
         self.pool.drop_arrays()
         self._step_fns.clear()
         self._params = {}
+        unregister_monitor(self._gap)
+        self.ledger.unregister()
         return {'released': True}
 
 

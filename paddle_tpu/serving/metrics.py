@@ -299,4 +299,27 @@ def serve_snapshot():
             out['tenants'] = tenants
     if out and _last_timeline is not None:
         out['timeline'] = dict(_last_timeline)
+    # serving ledger / goodput / roofline (ISSUE 17): read the LIVE
+    # ledger registry — not the gauges — so engines that unregistered
+    # at shutdown stop reporting here; per-tenant goodput folds into
+    # the tenants rows beside the SLO percentiles
+    led = None
+    try:
+        from . import ledger as _serve_ledger
+        led = _serve_ledger.serve_ledger_snapshot()
+    except Exception:
+        pass
+    if led is not None:
+        if led.get('ledger'):
+            out['ledger'] = led['ledger']
+        good = led.get('goodput')
+        if good and good.get('emitted_tokens'):
+            out['goodput'] = {k: v for k, v in good.items()
+                              if k != 'per_tenant'}
+            for tid, row in (good.get('per_tenant') or {}).items():
+                dst = out.setdefault('tenants', {}).setdefault(tid, {})
+                dst['delivered_tokens'] = row['delivered_tokens']
+                dst['wasted_tokens'] = row['wasted_tokens']
+        if led.get('roofline'):
+            out['roofline'] = led['roofline']
     return out

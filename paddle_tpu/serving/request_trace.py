@@ -31,11 +31,11 @@ import os
 import threading
 import time
 
-SCHEMA = 'paddle_tpu.serve_trace/3'
+SCHEMA = 'paddle_tpu.serve_trace/4'
 # older files still load — load_trace accepts /1 (no route events),
-# /2 (no tenancy/degradation events) and /3
+# /2 (no tenancy/degradation events), /3 (no goodput pricing) and /4
 SCHEMAS = ('paddle_tpu.serve_trace/1', 'paddle_tpu.serve_trace/2',
-           SCHEMA)
+           'paddle_tpu.serve_trace/3', SCHEMA)
 
 # lifecycle event vocabulary (docs/serving.md#request-traces);
 # prefix_hit = cached pages mapped at prefill start (ISSUE 9),
@@ -48,6 +48,13 @@ SCHEMAS = ('paddle_tpu.serve_trace/1', 'paddle_tpu.serve_trace/2',
 # admission episode, deadline_miss a finish past the request's own
 # deadline, and degrade_stage — recorded under the engine-scope
 # pseudo-request ENGINE_REQ — a degradation-ladder transition.
+# Schema v4 (ISSUE 17) adds FIELDS only, no new events: prefill_chunk
+# carries `recompute_tokens` when the chunk re-derives positions a
+# preemption destroyed (pricing the request's wasted work in place)
+# and `sampled` when the chunk completes prefill and samples a token
+# off its final column; spec_verify carries `discarded` for the
+# accepted-but-dropped burst tail. reconstruct() folds them (with
+# rejected spec drafts) into per-request delivered/wasted columns.
 EVENTS = ('submit', 'route', 'admit', 'prefix_hit', 'prefill_chunk',
           'first_token', 'decode', 'spec_verify', 'preempt', 'resume',
           'quota_defer', 'deadline_miss', 'degrade_stage',
@@ -256,6 +263,12 @@ def reconstruct(events):
             # traces simply leave the defaults
             'tenant_id': None, 'priority': 0, 'deadline_s': None,
             'quota_defers': 0, 'deadline_miss': False,
+            # schema v4 goodput pricing (ISSUE 17): computed prefill
+            # positions, preempt-destroyed recompute, and the verify
+            # columns that never reached the request — older traces
+            # leave zeros and the derived columns degrade gracefully
+            'prefill_tokens_computed': 0, 'recompute_tokens': 0,
+            'spec_discarded': 0, 'prefill_samples': 0,
         })
         ev, t = e['event'], e['t']
         if 'pages' in e:
@@ -285,8 +298,14 @@ def reconstruct(events):
         elif ev == 'spec_verify':
             r['spec_proposed'] += int(e.get('proposed', 0))
             r['spec_accepted'] += int(e.get('accepted', 0))
+            # v4: accepted-but-dropped burst tail (eos/budget) — with
+            # the rejected drafts, the request's spec waste
+            r['spec_discarded'] += int(e.get('discarded', 0))
         elif ev == 'prefill_chunk':
             r['prefill_chunks'] += 1
+            r['prefill_tokens_computed'] += int(e.get('tokens', 0))
+            r['recompute_tokens'] += int(e.get('recompute_tokens', 0))
+            r['prefill_samples'] += int(e.get('sampled', 0))
         elif ev == 'first_token':
             r['first_token_t'] = t
             r['tokens_generated'] = max(r['tokens_generated'],
@@ -327,6 +346,25 @@ def reconstruct(events):
             and stop is not None and n > 1 else None
         r['e2e_s'] = (end - sub) if sub is not None \
             and end is not None else None
+        # v4 goodput columns: delivered = first-time prefill positions
+        # + appended decode tokens. Every COMPLETED prefill (the
+        # initial one and each post-preemption resume) samples a token
+        # off its final column — v4 marks those chunks `sampled`, so
+        # the decode share is n minus all of them; pre-v4 journals only
+        # know about the first token. Wasted = preempt recompute + spec
+        # columns that never landed. Matches the engine ledger's
+        # per-request charges exactly on a v4 trace; v1-v3 leave the
+        # prefill/spec fields zero and price what the journal knows.
+        decode_delivered = max(
+            n - max(r['prefill_samples'],
+                    1 if ft is not None else 0), 0)
+        r['delivered_tokens'] = (
+            max(r['prefill_tokens_computed'] - r['recompute_tokens'], 0)
+            + decode_delivered)
+        r['wasted_tokens'] = (
+            r['recompute_tokens']
+            + max(r['spec_proposed'] - r['spec_accepted'], 0)
+            + r['spec_discarded'])
     return out
 
 

@@ -52,9 +52,15 @@ METRIC_DIRECTION = {
     'ttft_p99_ms': 'lower',
     'tpot_p50_ms': 'lower',
     'e2e_p99_ms': 'lower',
+    # serving goodput ledger & decode roofline (ISSUE 17)
+    'goodput_fraction': 'higher',
+    'host_bound_fraction': 'lower',
+    'hbm_gbps': 'higher',
+    'mbu': 'higher',
 }
 DEFAULT_THRESHOLD = 0.02
 HEADLINE_LEG = 'gpt1.3b_adamw'
+SERVE_LEG = 'gpt_serve_throughput'
 
 # legacy detail keys that are records riding with the headline, not
 # satellite legs of their own
@@ -97,6 +103,17 @@ def normalize(rec):
         ledger = head['ledger']
     elif isinstance(detail.get('ledger'), dict):
         ledger = detail['ledger']
+    # serving twin (ISSUE 17): the throughput leg's serve-step ledger
+    # + goodput + decode roofline, rendered side by side like the
+    # training ledger above
+    serve = legs.get(SERVE_LEG)
+    serve_ledger = None
+    if isinstance(serve, dict) and isinstance(serve.get('ledger'), dict):
+        serve_ledger = {
+            'ledger': serve['ledger'],
+            'goodput': serve.get('goodput'),
+            'roofline': serve.get('roofline'),
+        }
     return {
         'round': rec.get('round'),
         'schema_version': rec.get('schema_version', 1),
@@ -104,6 +121,7 @@ def normalize(rec):
         'value': rec.get('value'),
         'legs': legs,
         'ledger': ledger,
+        'serve_ledger': serve_ledger,
     }
 
 
@@ -162,6 +180,8 @@ def compare(a, b, threshold=DEFAULT_THRESHOLD):
         'threshold': threshold,
         'legs': legs,
         'ledger': {'old': a['ledger'], 'new': b['ledger']},
+        'serve_ledger': {'old': a.get('serve_ledger'),
+                         'new': b.get('serve_ledger')},
         'regressions': verdicts.count('regression'),
         'improvements': verdicts.count('improvement'),
         'flat': verdicts.count('flat'),
@@ -218,6 +238,45 @@ def render(cmp_doc):
                 fa = f'{va:.4g}' if isinstance(va, (int, float)) else '--'
                 fb = f'{vb:.4g}' if isinstance(vb, (int, float)) else '--'
                 out.append(f'    {key:<14} {fa:>12} | {fb:>12}')
+    sled = cmp_doc.get('serve_ledger') or {}
+    sa, sb = sled.get('old'), sled.get('new')
+    if sa or sb:
+        out.append('  serve ledger (per-iteration seconds, '
+                   f'{old_r} | {new_r}):')
+        acct_a = (sa or {}).get('ledger') or {}
+        acct_b = (sb or {}).get('ledger') or {}
+        ca = acct_a.get('components') or {}
+        cb = acct_b.get('components') or {}
+
+        def _f(v):
+            return f'{v * 1e3:10.3f}ms' if isinstance(
+                v, (int, float)) else '         --'
+
+        out.append(f"    {'wall':<14} "
+                   f"{_f(acct_a.get('wall_seconds'))} | "
+                   f"{_f(acct_b.get('wall_seconds'))}")
+        for c in ('compute', 'host_fetch', 'schedule', 'page_stream',
+                  'residue'):
+            out.append(f'    {c:<14} {_f(ca.get(c))} | {_f(cb.get(c))}')
+
+        def _g(v, fmt='{:.4g}'):
+            return fmt.format(v) if isinstance(v, (int, float)) else '--'
+
+        gp_a = (sa or {}).get('goodput') or {}
+        gp_b = (sb or {}).get('goodput') or {}
+        rf_a = (sa or {}).get('roofline') or {}
+        rf_b = (sb or {}).get('roofline') or {}
+        for label, va, vb in (
+                ('goodput_frac', gp_a.get('goodput_fraction'),
+                 gp_b.get('goodput_fraction')),
+                ('wasted_tokens', gp_a.get('wasted_tokens'),
+                 gp_b.get('wasted_tokens')),
+                ('host_bound', acct_a.get('host_bound_fraction'),
+                 acct_b.get('host_bound_fraction')),
+                ('hbm_gbps', rf_a.get('hbm_gbps'), rf_b.get('hbm_gbps')),
+                ('mbu', rf_a.get('mbu'), rf_b.get('mbu'))):
+            if va is not None or vb is not None:
+                out.append(f'    {label:<14} {_g(va):>12} | {_g(vb):>12}')
     out.append(f"verdicts: {cmp_doc['regressions']} regression(s), "
                f"{cmp_doc['improvements']} improvement(s), "
                f"{cmp_doc['flat']} flat")
@@ -261,6 +320,57 @@ def selftest():
     assert 'step-time ledger' in text and 'compute' in text
     rev = compare(b, a, threshold=0.02)
     assert rev['regressions'] >= 2, 'reversed compare must regress'
+
+    # 1b) synthetic serve-ledger pair (ISSUE 17): goodput_fraction is
+    # higher-is-better, host_bound_fraction lower-is-better, and the
+    # serve ledger/goodput/roofline render side by side
+    def _srec(round_id, gf, hbf, mbu):
+        return {'schema_version': 2, 'round': round_id,
+                'metric': 'm', 'value': 0.5,
+                'legs': {
+                    HEADLINE_LEG: {'ms_per_step': 100.0},
+                    SERVE_LEG: {
+                        'decode_tokens_per_sec': 5000.0,
+                        'goodput_fraction': gf,
+                        'host_bound_fraction': hbf,
+                        'hbm_gbps': 400.0 * (1.0 + mbu),
+                        'mbu': mbu,
+                        'ledger': {
+                            'wall_seconds': 0.010,
+                            'host_bound_fraction': hbf,
+                            'components': {'compute': 0.006,
+                                           'host_fetch': 0.002,
+                                           'schedule': 0.001,
+                                           'page_stream': 0.0005,
+                                           'residue': 0.0005}},
+                        'goodput': {'emitted_tokens': 1000,
+                                    'delivered_tokens': int(gf * 1000),
+                                    'wasted_tokens':
+                                        1000 - int(gf * 1000),
+                                    'goodput_fraction': gf},
+                        'roofline': {'decode_bytes_per_iteration':
+                                     1 << 20,
+                                     'hbm_gbps': 400.0 * (1.0 + mbu),
+                                     'mbu': mbu}}},
+                'detail': {}}
+
+    sa = normalize(_srec('sA', 0.80, 0.20, 0.30))
+    sb = normalize(_srec('sB', 0.95, 0.10, 0.40))
+    sdoc = compare(sa, sb, threshold=0.02)
+    srows = {m['name']: m for leg in sdoc['legs']
+             for m in leg['metrics'] if leg['leg'] == SERVE_LEG}
+    assert srows['goodput_fraction']['verdict'] == 'improvement', srows
+    assert srows['host_bound_fraction']['verdict'] == 'improvement', \
+        srows
+    assert srows['mbu']['verdict'] == 'improvement', srows
+    srev = compare(sb, sa, threshold=0.02)
+    srev_rows = {m['name']: m for leg in srev['legs']
+                 for m in leg['metrics'] if leg['leg'] == SERVE_LEG}
+    assert srev_rows['goodput_fraction']['verdict'] == 'regression'
+    assert srev_rows['host_bound_fraction']['verdict'] == 'regression'
+    stext = render(sdoc)
+    assert 'serve ledger' in stext and 'page_stream' in stext, stext
+    assert 'goodput_frac' in stext and 'host_bound' in stext, stext
 
     # 2) the real r04 -> r05 artifacts: legacy-shape normalization and
     # the asserted regression verdict (r05's headline MFU dropped 2.3%,

@@ -619,6 +619,16 @@ def render_serve(s):
             f"{tl.get('admissions', 0)} admissions, "
             f"{tl.get('preemptions', 0)} preemptions, "
             f"max waiting {tl.get('max_waiting', 0)}")
+    # serving step-wall ledger + goodput + decode roofline (ISSUE 17):
+    # serve_snapshot() merges these beside the gauges when an engine's
+    # ledger has observed iterations — same renderer as the engine's
+    if s.get('ledger') or s.get('goodput') or s.get('roofline'):
+        _repo_root_on_path()
+        from paddle_tpu.serving.ledger import render_serve_ledger
+        out.append(render_serve_ledger(
+            {'ledger': s.get('ledger') or {},
+             'goodput': s.get('goodput') or {},
+             'roofline': s.get('roofline') or {}}))
     return '\n'.join(out)
 
 
@@ -668,6 +678,22 @@ def _serve_selftest():
     assert serve['ptpu_serve_prefix_hits'] >= 2, serve
     assert serve['prefix_hit_rate'] is not None, serve
     assert serve['ptpu_serve_prefix_hit_tokens_total'] >= 16, serve
+    # serving ledger + goodput + roofline (ISSUE 17): the live engine's
+    # ledger reaches the snapshot — components reconcile, the goodput
+    # identity holds, and the decode roofline reports absolute GB/s
+    led = serve.get('ledger')
+    assert led and 'serve' in led, serve.keys()
+    acct = led['serve']
+    assert acct['wall_seconds'] > 0, acct
+    assert set(acct['components']) == {
+        'compute', 'host_fetch', 'schedule', 'page_stream',
+        'residue'}, acct
+    assert acct['host_bound_fraction'] is not None, acct
+    gp = serve.get('goodput')
+    assert gp and gp['delivered_tokens'] + gp['wasted_tokens'] \
+        == gp['emitted_tokens'], gp
+    roof = (serve.get('roofline') or {}).get('serve')
+    assert roof and roof['decode_bytes_per_iteration'] > 0, roof
     text = render_serve(serve)
     assert 'decode throughput' in text and 'time-to-first-token' in text
     assert '3/3 requests completed' in text, text
@@ -675,6 +701,8 @@ def _serve_selftest():
     assert 'prefix cache:' in text and 'hit-rate' in text, text
     if serve.get('ptpu_serve_spec_proposed_tokens_total'):
         assert 'speculative decode:' in text, text
+    assert 'serving ledger' in text and 'goodput:' in text, text
+    assert 'roofline[serve]' in text, text
 
     # -- trace export round-trips and reconstructs the engine's truth
     with tempfile.TemporaryDirectory() as td:

@@ -67,6 +67,13 @@
 #                      straggler detection, histogram percentile
 #                      edges, metrics-docs registry consistency,
 #                      bench_compare regression verdicts, ledger CLI
+#   --serve-ledger-selftest - serving goodput ledger & decode roofline
+#                      (ISSUE 17): iteration-wall decomposition with
+#                      ordered clamps, goodput identity across
+#                      preemption / spec rejection / degrade shed /
+#                      cluster drain, trace-v4 delivered/wasted parity,
+#                      HBM roofline table, zero-extra-host-sync budget,
+#                      then the serve + bench-compare CLI smokes
 set -e
 cd "$(dirname "$0")/.."
 TIER="${1:-all}"
@@ -81,6 +88,7 @@ case "$TIER" in
             tests/test_remat.py \
             tests/test_async_step.py tests/test_pipeline_schedule.py \
             tests/test_ledger.py tests/test_monitor.py \
+            tests/test_serving_ledger.py \
             tests/test_metrics_docs.py -q
           # observability tooling smoke: tracer -> export -> summary CLI
           python tools/trace_summary.py --selftest
@@ -202,6 +210,15 @@ case "$TIER" in
             tests/test_metrics_docs.py -q
           python tools/health_dump.py ledger --selftest
           python tools/bench_compare.py --selftest ;;
+  --serve-ledger-selftest)
+          # the serving goodput ledger end to end (ISSUE 17): serve-
+          # wall decomposition + goodput identity + roofline units,
+          # trace-v4 pricing parity, sync-budget harness, then the
+          # serve-gauge + bench-compare CLI smokes
+          python -m pytest tests/test_serving_ledger.py \
+            tests/test_metrics_docs.py -q
+          python tools/health_dump.py serve --selftest
+          python tools/bench_compare.py --selftest ;;
   all)    python -m pytest tests/ -q
           python tools/trace_summary.py --selftest
           python tools/health_dump.py --selftest
@@ -216,5 +233,5 @@ case "$TIER" in
           python tools/health_dump.py pp --selftest
           python tools/health_dump.py ledger --selftest
           python tools/bench_compare.py --selftest ;;
-  *) echo "usage: $0 [fast|dist|native|e2e|all|--comm-selftest|--serve-selftest|--quant-selftest|--pallas-selftest|--overlap-selftest|--cluster-selftest|--remat-selftest|--async-selftest|--pp-selftest|--tenant-selftest|--ledger-selftest]"; exit 1 ;;
+  *) echo "usage: $0 [fast|dist|native|e2e|all|--comm-selftest|--serve-selftest|--quant-selftest|--pallas-selftest|--overlap-selftest|--cluster-selftest|--remat-selftest|--async-selftest|--pp-selftest|--tenant-selftest|--ledger-selftest|--serve-ledger-selftest]"; exit 1 ;;
 esac

@@ -11,8 +11,9 @@ Reads either exporter format (chrome-trace `traceEvents` or the raw
 
 It also reads SERVING request traces (the JSON-lines files
 `ServingEngine.export_trace` writes, schema paddle_tpu.serve_trace/1
-or /2) and prints the per-request SLO table: queue-wait, TTFT, TPOT,
-e2e, preemptions, pages high-water — plus cross-request percentiles.
+through /4) and prints the per-request SLO table: queue-wait, TTFT,
+TPOT, e2e, preemptions, pages high-water, delivered/wasted tokens —
+plus cross-request percentiles and the goodput aggregate (ISSUE 17).
 Serve traces are detected by their schema header (content sniff, not
 file extension); `--serve` forces that mode.
 
@@ -107,7 +108,7 @@ def render(summary):
 
 
 # ---------------------------------------------------------------------------
-# serving request traces (JSON-lines, paddle_tpu.serve_trace/1 – /3)
+# serving request traces (JSON-lines, paddle_tpu.serve_trace/1 – /4)
 # ---------------------------------------------------------------------------
 def summarize_serve(paths):
     """Per-request table + cross-request SLO percentiles from one or
@@ -118,7 +119,11 @@ def summarize_serve(paths):
     percentiles aggregate the whole cluster's requests. Schema-v3
     traces (ISSUE 15) additionally group the percentile table BY
     TENANT (`percentiles_by_tenant`) — the per-tenant SLO view the
-    multi-tenant scheduler is judged on."""
+    multi-tenant scheduler is judged on. Schema-v4 traces (ISSUE 17)
+    price each request's delivered vs wasted tokens (preempt-destroyed
+    prefill recompute + rejected/discarded spec drafts); the `goodput`
+    aggregate sums them across the table. v1-v3 merges are unchanged —
+    their recompute/discard fields reconstruct as zeros."""
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     from paddle_tpu.serving.request_trace import (load_trace,
@@ -162,10 +167,25 @@ def summarize_serve(paths):
                 by_tenant[tid][key] = {
                     f'p{q}': percentile_of(vals, q)
                     for q in (50, 90, 99)}
+    # cross-request goodput aggregate (schema v4, ISSUE 17): totals of
+    # the per-request delivered/wasted pricing — emitted is their sum
+    # by construction, mirroring the engine ledger identity
+    delivered = sum(r.get('delivered_tokens', 0) for r in rows)
+    wasted = sum(r.get('wasted_tokens', 0) for r in rows)
+    goodput = {
+        'delivered_tokens': delivered,
+        'wasted_tokens': wasted,
+        'emitted_tokens': delivered + wasted,
+        'recompute_tokens': sum(r.get('recompute_tokens', 0)
+                                for r in rows),
+        'goodput_fraction': (delivered / (delivered + wasted)
+                             if delivered + wasted else None),
+    }
     return {'schema': schema, 'files': len(paths),
             'dropped_events': dropped,
             'requests': rows, 'percentiles': pct,
-            'percentiles_by_tenant': by_tenant}
+            'percentiles_by_tenant': by_tenant,
+            'goodput': goodput}
 
 
 def _fmt_ms(v):
@@ -189,7 +209,8 @@ def render_serve(s):
     out.append(f"{'req':>8} {'state':<9} {'prompt':>6} {'gen':>5} "
                f"{'queue_ms':>9} {'ttft_ms':>9} {'tpot_ms':>9} "
                f"{'e2e_ms':>9} {'preempt':>7} {'pages_hw':>8} "
-               f"{'cached':>6} {'spec':>9}" + extra_hdr)
+               f"{'cached':>6} {'spec':>9} "
+               f"{'deliv':>6} {'wasted':>6}" + extra_hdr)
     for r in rows:
         prop = r.get('spec_proposed', 0)
         spec = (f"{r.get('spec_accepted', 0)}/{prop}" if prop else '-')
@@ -205,7 +226,9 @@ def render_serve(s):
             f"{_fmt_ms(r['queue_wait_s']):>9} {_fmt_ms(r['ttft_s']):>9} "
             f"{_fmt_ms(r['tpot_s']):>9} {_fmt_ms(r['e2e_s']):>9} "
             f"{r['preemptions']:>7} {r['pages_high_water']:>8} "
-            f"{r.get('prefix_cached_tokens', 0):>6} {spec:>9}" + extra)
+            f"{r.get('prefix_cached_tokens', 0):>6} {spec:>9} "
+            f"{r.get('delivered_tokens', 0):>6} "
+            f"{r.get('wasted_tokens', 0):>6}" + extra)
     # cross-request prefix/spec aggregates (ISSUE 9): prompt tokens
     # served from cache, and draft-token acceptance over the stream
     cached = sum(r.get('prefix_cached_tokens', 0) for r in rows)
@@ -222,6 +245,17 @@ def render_serve(s):
             out.append('')
         out.append(f"speculative decode: {acc}/{prop} draft tokens "
                    f"accepted ({100.0 * acc / prop:.1f}% acceptance)")
+    # goodput aggregate (schema v4, ISSUE 17) — only rendered once any
+    # request priced waste, so v1-v3 tables look exactly as before
+    gp = s.get('goodput') or {}
+    if gp.get('wasted_tokens'):
+        out.append('')
+        out.append(
+            f"goodput: {gp['delivered_tokens']}/{gp['emitted_tokens']} "
+            f"tokens delivered "
+            f"({100.0 * gp['goodput_fraction']:.1f}%), "
+            f"{gp['wasted_tokens']} wasted "
+            f"({gp['recompute_tokens']} preempt-recompute)")
     out.append('')
     out.append('-- SLO percentiles (ms) ' + '-' * 36)
     for key, label in (('queue_wait_s', 'queue wait'),
@@ -288,10 +322,15 @@ def _serve_selftest():
     tr.record(7, 'preempt', t=2.1, pages_released=1,
               tokens_generated=1)
     tr.record(7, 'resume', t=2.5, slot=1)
-    tr.record(7, 'prefill_chunk', t=2.6, tokens=6, prefilled=6, pages=2)
+    # v4 (ISSUE 17): the resume chunk re-derives the 5 positions the
+    # preemption destroyed; the verify burst drops one accepted token
+    # past eos — both priced as waste
+    tr.record(7, 'prefill_chunk', t=2.6, tokens=6, prefilled=6, pages=2,
+              recompute_tokens=5)
     for i, td in enumerate((2.8, 3.0)):
         tr.record(7, 'decode', t=td, tokens_generated=2 + i, pages=2)
-    tr.record(7, 'spec_verify', t=3.1, proposed=3, accepted=1)
+    tr.record(7, 'spec_verify', t=3.1, proposed=3, accepted=1,
+              discarded=1)
     tr.record(7, 'decode', t=3.2, tokens_generated=4, pages=2)
     tr.record(7, 'retire', t=3.2, tokens_generated=4, preemptions=1)
     with tempfile.TemporaryDirectory() as d:
@@ -306,10 +345,20 @@ def _serve_selftest():
     assert r['e2e_s'] == 2.2 and r['pages_high_water'] == 2, r
     assert r['prefix_cached_tokens'] == 4, r
     assert r['spec_proposed'] == 3 and r['spec_accepted'] == 1, r
+    # v4 goodput pricing: delivered = (11 computed - 5 recompute)
+    # prefill + 3 decode (4 generated, first rides the prefill column);
+    # wasted = 5 recompute + 2 rejected drafts + 1 discarded
+    assert r['delivered_tokens'] == 9 and r['wasted_tokens'] == 8, r
+    gp = s['goodput']
+    assert gp['delivered_tokens'] + gp['wasted_tokens'] \
+        == gp['emitted_tokens'] == 17, gp
+    assert gp['recompute_tokens'] == 5, gp
     assert abs(s['percentiles']['ttft_s']['p50'] - 1.0) < 1e-12
     text = render_serve(s)
     assert 'prefix cache: 4/5' in text, text
     assert 'speculative decode: 1/3' in text, text
+    assert 'goodput: 9/17 tokens delivered' in text, text
+    assert 'deliv' in text and 'wasted' in text, text
     print(text)
 
     # cross-replica merge (ISSUE 11): two per-replica exports with v2
