@@ -174,6 +174,14 @@ def bench_gpt_1p3b(optimizer='adamw'):
     _ledger_mod.configure('pipeline', layers=cfg.num_layers,
                           hidden=cfg.hidden_size, seq_len=L,
                           n_params=n_params, arch='gpt')
+    # telemetry time axis (ISSUE 18): history rings sample on the
+    # telemetry publishes inside the timed loop, and the engine alert
+    # pack rides along — a clean leg must not fire a critical rule
+    # (_check_legs asserts on the recorded summary)
+    from paddle_tpu.core import monitor as _monitor
+    from paddle_tpu.core.alerts import AlertManager, default_rules
+    hist = _monitor.metrics().enable_history(capacity=240)
+    alerts = AlertManager(hist, rules=default_rules(), source='bench')
     host, dt = _host_gap_record(
         eng,
         sync_step=lambda: float(
@@ -184,6 +192,10 @@ def bench_gpt_1p3b(optimizer='adamw'):
     # the reconciled where-did-the-step-go account, published by the
     # flush inside the windowed loop (health_dump ledger renders this)
     ledger_rec = eng._ledger.account()
+    _monitor.metrics().history_tick()   # final sample + rule pass
+    series_rec = hist.export(max_points=24)
+    alerts_rec = alerts.summary()
+    alerts.detach()
 
     tokens = A * mb * L
     flops = 6 * n_params * tokens + \
@@ -238,6 +250,11 @@ def bench_gpt_1p3b(optimizer='adamw'):
         # host-gap/residue decomposition + model TFLOP/s with the remat
         # recompute factor reflected (MFU only on real TPU peaks)
         'ledger': ledger_rec,
+        # telemetry time axis (ISSUE 18): the downsampled history-ring
+        # block + the alert summary for the leg (health_dump alerts
+        # renders both; _check_legs fails the leg on a critical fire)
+        'series': series_rec,
+        'alerts': alerts_rec,
         'live_buffers_before_shutdown': before,
         'live_buffers_after_shutdown': released.get('live_buffers'),
         'live_bytes_after_shutdown': released.get('live_bytes'),
@@ -656,6 +673,13 @@ def bench_gpt_serve():
     # cost (and the fallback's gather) scales with table width, and the
     # stream's contexts are known to fit hi+max_new tokens
     pages_per_seq = -(-(hi + max_new) // page_size)
+    # telemetry time axis (ISSUE 18): the serve publish cadence
+    # (telemetry_serve's publish -> history_tick) samples the rings
+    # while the stream runs; the engine alert pack must stay quiet
+    from paddle_tpu.core import monitor as _monitor
+    from paddle_tpu.core.alerts import AlertManager, default_rules
+    hist = _monitor.metrics().enable_history(capacity=240)
+    alerts = AlertManager(hist, rules=default_rules(), source='bench')
     eng = ServingEngine(model, ServingConfig(
         page_size=page_size, max_batch_size=batch, prefill_chunk=chunk,
         max_pages_per_seq=pages_per_seq))
@@ -694,6 +718,10 @@ def bench_gpt_serve():
     serve_ledger = eng.ledger.account()
     serve_goodput = eng.ledger.goodput()
     serve_roofline = eng.ledger.roofline()
+    _monitor.metrics().history_tick()   # final sample + rule pass
+    series_rec = hist.export(max_points=24)
+    alerts_rec = alerts.summary()
+    alerts.detach()
     eng.shutdown()
 
     # -- shared-prefix stream (ISSUE 9): N requests with a common
@@ -802,6 +830,11 @@ def bench_gpt_serve():
             (serve_ledger or {}).get('host_bound_fraction'),
         'hbm_gbps': (serve_roofline or {}).get('hbm_gbps'),
         'mbu': (serve_roofline or {}).get('mbu'),
+        # telemetry time axis (ISSUE 18): downsampled rings + alert
+        # summary for the measured stream (no critical may fire on a
+        # clean leg — _check_legs asserts it)
+        'series': series_rec,
+        'alerts': alerts_rec,
         'backend': jax.default_backend(),
     }
 
@@ -1503,6 +1536,29 @@ def _check_legs(result):
         assert isinstance(sroof, dict), 'serve leg lacks roofline'
         assert 'decode_bytes_per_iteration' in sroof, \
             'serve roofline lacks decode_bytes_per_iteration'
+    # the telemetry time axis (ISSUE 18): the headline and serve legs
+    # carry the downsampled history-ring block + the alert summary, and
+    # a clean leg must not have fired a critical rule — an alert there
+    # is a real regression (pool saturation, degrade ladder, dead
+    # publish cadence), not record noise
+    for name in ('gpt1.3b_adamw', 'gpt_serve_throughput'):
+        leg = legs.get(name) or {}
+        if 'error' in leg:
+            continue
+        arec = leg.get('alerts')
+        assert isinstance(arec, dict), f'{name} leg lacks alerts summary'
+        for key in ('rules', 'evals', 'fired_total', 'fired_critical',
+                    'active'):
+            assert key in arec, f'{name} leg alerts summary lacks {key}'
+        assert arec['fired_critical'] == 0, \
+            f"{name}: critical alert fired on a clean leg " \
+            f"({arec['fired_by_severity']}, active={arec['active']})"
+        srec = leg.get('series')
+        assert isinstance(srec, dict) and srec, \
+            f'{name} leg lacks the history-ring series block'
+        for sk, sv in srec.items():
+            assert 't' in sv and 'v' in sv and len(sv['t']) == \
+                len(sv['v']), f'{name}.series.{sk} torn'
 
     def _check_goodput_identity(gp, where):
         if not isinstance(gp, dict):

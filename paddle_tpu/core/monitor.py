@@ -16,6 +16,29 @@ dataloader, jit) all publish here.
 """
 import json
 import threading
+import time
+
+# ---------------------------------------------------------------------------
+# monotonic time source for staleness stamps + metric history (ISSUE 18)
+# ---------------------------------------------------------------------------
+# Injectable so alert/staleness tests run on a deterministic clock:
+# every Counter/Gauge/Histogram observation stamps `last_update` from
+# here, and MetricHistory/AlertManager default to the same source.
+_time_fn = time.monotonic
+
+
+def set_time_fn(fn):
+    """Swap the monotonic clock behind staleness stamps and history
+    sampling (None restores time.monotonic). Returns the previous fn
+    so tests can restore it."""
+    global _time_fn
+    prev = _time_fn
+    _time_fn = fn or time.monotonic
+    return prev
+
+
+def now():
+    return _time_fn()
 
 
 # ---------------------------------------------------------------------------
@@ -147,22 +170,34 @@ class Metric:
 
 
 class _CounterChild:
-    __slots__ = ('_value', '_lock')
+    __slots__ = ('_value', '_lock', 'last_update')
 
     def __init__(self):
         self._value = 0.0
         self._lock = threading.Lock()
+        self.last_update = None     # monotonic stamp of the last publish
 
     def inc(self, value=1):
         if value < 0:
             raise ValueError("counters only go up")
         with self._lock:
             self._value += value
+            self.last_update = _time_fn()
             return self._value
 
     def value(self):
         with self._lock:
             return self._value
+
+    def age_s(self, now_=None):
+        """Seconds since the last observation (None if never
+        published) — the staleness signal alert rules and health_dump
+        read to flag a section whose source engine went quiet."""
+        with self._lock:
+            if self.last_update is None:
+                return None
+            return (now_ if now_ is not None else _time_fn()) \
+                - self.last_update
 
 
 class Counter(Metric):
@@ -180,6 +215,7 @@ class _GaugeChild(_CounterChild):
     def inc(self, value=1):
         with self._lock:
             self._value += value
+            self.last_update = _time_fn()
             return self._value
 
     def dec(self, value=1):
@@ -188,6 +224,7 @@ class _GaugeChild(_CounterChild):
     def set(self, value):
         with self._lock:
             self._value = float(value)
+            self.last_update = _time_fn()
 
 
 class Gauge(Metric):
@@ -208,7 +245,8 @@ class Gauge(Metric):
 
 
 class _HistogramChild:
-    __slots__ = ('buckets', 'counts', 'sum', 'count', '_lock')
+    __slots__ = ('buckets', 'counts', 'sum', 'count', '_lock',
+                 'last_update')
 
     def __init__(self, buckets):
         self.buckets = buckets
@@ -216,15 +254,24 @@ class _HistogramChild:
         self.sum = 0.0
         self.count = 0
         self._lock = threading.Lock()
+        self.last_update = None
 
     def observe(self, value):
         value = float(value)
         with self._lock:
             self.sum += value
             self.count += 1
+            self.last_update = _time_fn()
             for i, b in enumerate(self.buckets):
                 if value <= b:
                     self.counts[i] += 1
+
+    def age_s(self, now_=None):
+        with self._lock:
+            if self.last_update is None:
+                return None
+            return (now_ if now_ is not None else _time_fn()) \
+                - self.last_update
 
     def value(self):
         with self._lock:
@@ -303,6 +350,7 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self.epoch = 0      # bumped on reset(); callers caching metric
                             # handles key their cache on this
+        self.history = None     # MetricHistory once enable_history()
 
     def _get_or_create(self, cls, name, help, labelnames, **kwargs):
         with self._lock:
@@ -335,10 +383,42 @@ class MetricsRegistry:
         with self._lock:
             return self._metrics.get(name)
 
+    def metrics_list(self):
+        """Stable copy of the registered metrics (history sampler's
+        iteration surface — no torn dict under concurrent creates)."""
+        with self._lock:
+            return sorted(self._metrics.values(), key=lambda m: m.name)
+
     def reset(self):
+        """Drop every metric (staleness stamps die with the children),
+        bump the epoch so cached handles invalidate, and clear the
+        history rings — old samples must not bleed across an epoch."""
         with self._lock:
             self._metrics.clear()
             self.epoch += 1
+        if self.history is not None:
+            self.history.clear()
+
+    # -- metric history (ISSUE 18) -------------------------------------------
+    def enable_history(self, capacity=240, min_interval_s=0.0,
+                       clock=None):
+        """Opt-in per-series ring-buffer history. Idempotent: returns
+        the existing MetricHistory when already enabled (capacity and
+        clock of the first call win)."""
+        if self.history is None:
+            from . import timeseries
+            self.history = timeseries.MetricHistory(
+                self, capacity=capacity, min_interval_s=min_interval_s,
+                clock=clock)
+        return self.history
+
+    def history_tick(self):
+        """Piggyback hook for existing flush/publish cadences
+        (serving metrics publish, profiler step telemetry): sample the
+        rings + run attached alert evaluation, metadata-only, no-op
+        until enable_history()."""
+        if self.history is not None:
+            self.history.tick()
 
     # -- renderers -----------------------------------------------------------
     @staticmethod
@@ -347,12 +427,15 @@ class MetricsRegistry:
         pairs.extend(f'{n}="{_escape(v)}"' for n, v in extra)
         return '{' + ','.join(pairs) + '}' if pairs else ''
 
-    def prometheus_text(self, include_stats=True):
+    def prometheus_text(self, include_stats=True, include_age=False):
         """Prometheus text exposition format (0.0.4), legacy STAT_*
-        stats included as untyped gauges."""
+        stats included as untyped gauges. `include_age` appends one
+        `# age ...` comment line per sample (scrapers ignore unknown
+        comments) carrying the per-series staleness stamp — the
+        operator-facing twin of snapshot()'s `age_s`."""
         lines = []
-        with self._lock:
-            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        t = _time_fn()
+        metrics = self.metrics_list()
         for m in metrics:
             if m.help:
                 lines.append(f'# HELP {m.name} {m.help}')
@@ -371,6 +454,12 @@ class MetricsRegistry:
                 else:
                     lbl = self._fmt_labels(m.labelnames, key)
                     lines.append(f'{m.name}{lbl} {_num(child.value())}')
+                if include_age:
+                    age = child.age_s(t)
+                    if age is not None:
+                        lbl = self._fmt_labels(m.labelnames, key)
+                        lines.append(
+                            f'# age {m.name}{lbl} {age:.3f}')
         if include_stats:
             for name, v in sorted(_registry.snapshot().items()):
                 safe = _sanitize(name)
@@ -380,17 +469,24 @@ class MetricsRegistry:
 
     def snapshot(self):
         """JSON-ready nested snapshot: {metric: {kind, series: [{labels,
-        value}]}} plus the legacy stats dict."""
+        value, age_s}]}} plus the legacy stats dict; when history is
+        enabled, a downsampled `series` export of the rings rides
+        along (ISSUE 18)."""
         out = {}
+        t = _time_fn()
         with self._lock:
             metrics = list(self._metrics.values())
         for m in metrics:
             series = []
             for key, child in sorted(m._series().items()):
                 series.append({'labels': dict(zip(m.labelnames, key)),
-                               'value': child.value()})
+                               'value': child.value(),
+                               'age_s': child.age_s(t)})
             out[m.name] = {'kind': m.kind, 'series': series}
-        return {'metrics': out, 'stats': _registry.snapshot()}
+        snap = {'metrics': out, 'stats': _registry.snapshot()}
+        if self.history is not None:
+            snap['series'] = self.history.export()
+        return snap
 
     def snapshot_json(self, **kwargs):
         return json.dumps(self.snapshot(), **kwargs)

@@ -792,6 +792,9 @@ class StepTelemetry:
             g('ptpu_device_bytes_in_use',
               help='live device memory (JAX backend)').set(
                   mem['bytes_in_use'])
+        # history sampling rides the publish cadence (ISSUE 18) —
+        # no-op unless MetricsRegistry.enable_history() opted in
+        _monitor.metrics().history_tick()
 
     def snapshot(self):
         reg = _monitor.metrics()

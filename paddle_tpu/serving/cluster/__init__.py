@@ -14,7 +14,10 @@ mesh (ROADMAP serve_scale item 1):
     least-occupancy fallback on the PR-6 SchedulerTimeline feedback,
     per-replica backpressure + reject-early, and drain (a hung
     replica's in-flight requests re-prefill on a peer via the PR-9
-    resurrect path);
+    resurrect path); plus metrics federation (ISSUE 18): one
+    cluster-wide scrape over a router-local registry fed by the
+    replicas' `metrics` channel op, with history rings and the
+    cluster-scope alert pack (core/alerts.router_rules) on top;
   * mp sharding   — `ServingEngine(..., mesh=...)` (engine.py) splits
     heads + KV pages over an 'mp' axis inside one replica;
   * `disagg.py`   — prefill/decode disaggregation behind a config

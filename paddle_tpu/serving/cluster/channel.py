@@ -1,7 +1,9 @@
 """Length-prefixed JSON control channel between router and replicas.
 
 The cluster's CONTROL plane only: submissions, token polls, status
-probes, drains. Token ids are small JSON ints; the DATA plane (KV
+probes, drains, and the `metrics` federation op (a compact
+per-replica series snapshot the router merges into its cluster
+registry — ISSUE 18). Token ids are small JSON ints; the DATA plane (KV
 pages) never crosses this socket — pages move device-to-device via
 page_stream.py. One request per message, strictly ordered per
 connection; the client serializes calls under a lock, so a replica

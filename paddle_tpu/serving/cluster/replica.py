@@ -209,7 +209,38 @@ class _EngineHost:
                 'prefix_digest': _prefix_digest(self.engine),
                 'goodput': goodput,
                 'ledger': acct,
+                # per-tenant accounting rides the heartbeat too so
+                # Router.cluster_snapshot() can expose the
+                # per-replica-bucket N x-quota effect (ISSUE 18)
+                'tenancy': eng._tenancy_stats(),
             }
+
+    def metrics(self):
+        """Compact per-replica metrics snapshot for cluster federation
+        (ISSUE 18): the scalar ptpu_serve_* series this engine WOULD
+        publish, straight off engine.stats() via the same declarative
+        table publish() uses — NOT read back from the process-global
+        registry, which in-process LocalReplicas share and would
+        cross-contaminate. The router merges these under a `replica`
+        label into its federated registry."""
+        from .. import metrics as _serve_metrics
+        with self._lock:
+            eng = _decode_engine(self.engine)
+            stats = eng.stats()
+            series = _serve_metrics.scalar_series(stats)
+            led = getattr(eng, 'ledger', None)
+            if led is not None:
+                acct = led.account()
+                if acct and acct.get('host_bound_fraction') is not None:
+                    series['ptpu_serve_ledger_host_bound_fraction'] = \
+                        acct['host_bound_fraction']
+                good = led.goodput()
+                gf = (good or {}).get('goodput_fraction')
+                if gf is not None:
+                    series['ptpu_serve_goodput_fraction'] = gf
+            return {'replica_id': self.replica_id,
+                    'beat_age_s': self._clock() - self._beat,
+                    'series': series}
 
     def drain(self):
         """Stop admitting, snapshot + abort every unfinished request.
@@ -248,7 +279,21 @@ class _EngineHost:
 class LocalReplica(_EngineHost):
     """In-process replica: the router pumps its engine directly."""
 
+    def __init__(self, engine, replica_id, clock=None):
+        super().__init__(engine, replica_id, clock=clock)
+        self._inject_hang = False
+
+    def inject_hang(self):
+        """Test hook mirroring ReplicaWorker's: pump() stops stamping
+        the heartbeat (and stepping), exactly what a wedged device
+        dispatch looks like to the router's watchdog + the
+        replica_heartbeat_stale alert rule."""
+        self._inject_hang = True
+        return {'ok': True}
+
     def pump(self):
+        if self._inject_hang:
+            return False
         with self._lock:
             self._beat = self._clock()
             if _has_work(self.engine):
@@ -302,6 +347,8 @@ class ReplicaWorker(_EngineHost):
             return {'reqs': self.poll()}
         if op == 'status':
             return self.status()
+        if op == 'metrics':
+            return self.metrics()
         if op == 'drain':
             return {'inflight': self.drain()}
         if op == 'abort':
@@ -345,7 +392,21 @@ class ReplicaWorker(_EngineHost):
             'timeline': {},
             'pool': {},
             'prefix_digest': None,      # keep the router's last view
+            'tenancy': None,
         }
+
+    def metrics(self):
+        # same wedged-lock discipline as status(): a federation poll
+        # must not join a hung step loop — stale beat_age_s and an
+        # empty series dict ARE the signal (staleness stamps go quiet)
+        if self._lock.acquire(timeout=0.5):
+            try:
+                return _EngineHost.metrics(self)
+            finally:
+                self._lock.release()
+        return {'replica_id': self.replica_id,
+                'beat_age_s': self._clock() - self._beat,
+                'series': {}}
 
     def drain(self):
         if self._lock.acquire(timeout=0.5):
@@ -536,6 +597,9 @@ class RemoteReplica:
 
     def status(self):
         return self.client.call({'op': 'status'}, timeout=5.0)
+
+    def metrics(self):
+        return self.client.call({'op': 'metrics'}, timeout=5.0)
 
     def drain(self):
         return self.client.call({'op': 'drain'},

@@ -38,6 +38,18 @@ wedged replica costs latency, not requests.
 Counters: ptpu_route_{affinity_hits,least_loaded,spills,rejects,
 drains}_total through core.monitor; `cluster_snapshot()` is the
 health_dump/bench view.
+
+Metrics federation (ISSUE 18): per-replica registries live in worker
+processes, so the router keeps its own FEDERATED MetricsRegistry —
+`refresh()` feeds it the status-derived signals (heartbeat age, queue,
+occupancy, pool pressure) and `federate()` merges each replica's
+compact `metrics` channel-op snapshot, every series under a `replica`
+label. `cluster_prometheus_text()` / `serve_metrics_http()` expose ONE
+scrape for the whole cluster; a `MetricHistory` over the federated
+registry plus an `AlertManager` running `router_rules()` (heartbeat
+staleness, cluster pool pressure, occupancy imbalance, drain/resubmit
+storms, spill rate) complete the input plane the ROADMAP autoscaler
+will consume.
 """
 import collections
 import itertools
@@ -46,6 +58,7 @@ import time
 from ..kv_pool import chain_hashes
 from ..scheduler import AdmissionRejected
 from ...core import monitor as _m
+from ...core.alerts import AlertManager, router_rules
 
 
 class RouterRejected(RuntimeError):
@@ -123,7 +136,9 @@ class RoutedRequest:
 class ClusterRouter:
     def __init__(self, replicas, page_size, max_queue=8,
                  deadline_bound_s=None, hang_timeout_s=10.0,
-                 refresh_interval_s=0.25, clock=None):
+                 refresh_interval_s=0.25, clock=None,
+                 history_capacity=512, alert_rules=None,
+                 report_dir=None):
         if not replicas:
             raise ValueError("a cluster needs at least one replica")
         self.page_size = int(page_size)
@@ -172,6 +187,21 @@ class ClusterRouter:
         # re-prefill — the cluster-level wasted-work cause no single
         # replica can see (each peer counts them as first-time work)
         self._drain_recompute_tokens = 0
+        # telemetry time axis (ISSUE 18): the federated registry is
+        # router-LOCAL (never the process-global one — in-process
+        # LocalReplicas share that and would cross-contaminate), with
+        # a history ring over it and the cluster-scope alert pack
+        # evaluating on every history tick. Alert gauges/counters
+        # still land in the GLOBAL registry (AlertManager default) so
+        # health_dump / bench see them without a federated scrape.
+        self._federated = _m.MetricsRegistry()
+        self.history = self._federated.enable_history(
+            capacity=history_capacity, clock=self._clock)
+        self.alerts = AlertManager(
+            self.history,
+            rules=(alert_rules if alert_rules is not None
+                   else router_rules()),
+            clock=self._clock, source='router', report_dir=report_dir)
 
     OPTIMISTIC_GENERATIONS = 2
 
@@ -360,6 +390,104 @@ class ClusterRouter:
         gen = self._refresh_gen[rid]
         self._optimistic[rid].update(dict.fromkeys(hashes, gen))
 
+    # -- metrics federation (ISSUE 18) ---------------------------------------
+    def _fed_gauge(self, name, help=''):
+        return self._federated.gauge(name, help=help,
+                                     labelnames=('replica',))
+
+    def _feed_federated(self, rid, st, tl):
+        """One replica's status into the federated registry — the
+        history/alert substrate. Single-engine ptpu_serve_* names keep
+        their meaning, one series per replica under the `replica`
+        label; staleness stamps on these series are how a quiet
+        replica shows in the cluster scrape."""
+        r = str(rid)
+        fg = self._fed_gauge
+        beat = st.get('beat_age_s')
+        if beat is not None:
+            help_ = ('replica step-loop heartbeat age at the last '
+                     'router refresh (replica_heartbeat_stale input)')
+            fg('ptpu_cluster_replica_beat_age_seconds',
+               help=help_).set(beat, replica=r)
+            _m.gauge('ptpu_cluster_replica_beat_age_seconds',
+                     help=help_, labelnames=('replica',)).set(
+                beat, replica=r)
+        fg('ptpu_cluster_replica_queue_depth',
+           help='per-replica waiting + in-flight (router view)').set(
+            self._queue_depth(rid), replica=r)
+        fg('ptpu_cluster_replica_occupancy',
+           help='per-replica mean decode-slot occupancy').set(
+            tl.get('mean_occupancy') or 0.0, replica=r)
+        pool = st.get('pool') or {}
+        if pool.get('num_pages'):
+            fg('ptpu_serve_kv_page_utilization',
+               help='KV pool pages in use / total').set(
+                pool.get('pages_in_use', 0) / pool['num_pages'],
+                replica=r)
+        fg('ptpu_serve_requests_waiting', help='queued requests').set(
+            st.get('waiting', 0), replica=r)
+        fg('ptpu_serve_requests_in_flight',
+           help='requests holding a decode slot').set(
+            st.get('in_flight', 0), replica=r)
+        fg('ptpu_serve_decode_tokens_per_sec',
+           help='batched decode throughput (tokens/sec)').set(
+            st.get('decode_tokens_per_sec') or 0.0, replica=r)
+        fg('ptpu_serve_degrade_stage',
+           help='graceful-degradation ladder stage').set(
+            st.get('degrade_stage', 0) or 0, replica=r)
+        gf = (st.get('goodput') or {}).get('goodput_fraction')
+        if gf is not None:
+            fg('ptpu_serve_goodput_fraction',
+               help='delivered / emitted tokens').set(gf, replica=r)
+
+    def _feed_router_counters(self):
+        """Router-scope lifetime counts as unlabeled federated gauges
+        — the substrate the drain/resubmit-storm and spill-rate delta
+        rules window over."""
+        for kind in ('drain', 'resubmit', 'spill', 'reject'):
+            name, help_ = _COUNTERS[kind]
+            val = (self.rejects if kind == 'reject'
+                   else self.decisions.get(kind, 0))
+            self._federated.gauge(name, help=help_).set(val)
+
+    def federate(self):
+        """Pull each live replica's compact `metrics` snapshot (the
+        channel op — engine-truth scalars, not the shared global
+        registry) and merge it under the `replica` label; ticks the
+        cluster history. Returns {replica_id: reply}."""
+        out = {}
+        for rid, replica in self._replicas.items():
+            if rid in self._drained:
+                continue
+            try:
+                m = replica.metrics()
+            except Exception:               # noqa: BLE001
+                continue                    # pre-ISSUE-18 worker
+            out[str(rid)] = m
+            for name, val in sorted((m.get('series') or {}).items()):
+                if val is None:
+                    continue
+                self._fed_gauge(name).set(float(val), replica=str(rid))
+        self.history.tick()
+        return out
+
+    def cluster_prometheus_text(self, federate=True):
+        """ONE scrape for the whole cluster: merge fresh per-replica
+        snapshots, then render the federated registry with per-series
+        staleness ages (a dead replica's series visibly age out)."""
+        if federate:
+            self.federate()
+        return self._federated.prometheus_text(include_age=True)
+
+    def serve_metrics_http(self, port=0, addr='127.0.0.1'):
+        """Embeddable cluster-wide /metrics endpoint over the
+        federated registry (GET /metrics, /metrics.json). The scrape
+        renders the LAST federated state — keep it fresh by calling
+        refresh()/federate() from the serving loop, which run() and
+        serve() already do."""
+        return _m.MetricsServer(port=port, addr=addr,
+                                registry=self._federated)
+
     # -- health / status -----------------------------------------------------
     def refresh(self, max_age_s=0.0):
         """Pull status from every live replica (digest, queue depth,
@@ -392,6 +520,7 @@ class ClusterRouter:
                           '(SchedulerTimeline window)',
                      labelnames=('replica',)).set(
                 tl.get('mean_occupancy') or 0.0, replica=str(rid))
+            self._feed_federated(rid, st, tl)
             digest = st.get('prefix_digest')
             if digest is not None:
                 # REPLACE with what the replica actually holds — a
@@ -412,6 +541,10 @@ class ClusterRouter:
                 self.drain(rid, reason=st.get(
                     'hang_reason') or
                     f"heartbeat stale {st.get('beat_age_s'):.1f}s")
+        # history sample + alert evaluation ride the refresh cadence
+        # (metadata-only; deterministic under an injected clock)
+        self._feed_router_counters()
+        self.history.tick()
 
     def drain(self, rid, reason='operator drain'):
         """Stop placement on `rid` and move its in-flight requests to
@@ -667,7 +800,41 @@ class ClusterRouter:
             'requests_done': self._done_requests,
             'tenant_spills': dict(self.tenant_spills),
             'goodput': self._cluster_goodput(per_replica),
+            'tenants': self._cluster_tenants(),
+            'alerts': self.alerts.summary(),
         }
+
+    def cluster_snapshot(self):
+        """The full cluster view (ISSUE 18 name): snapshot() including
+        the cluster-wide per-tenant table and the alert summary."""
+        return self.snapshot()
+
+    _TENANT_SUM_KEYS = ('submitted', 'completed', 'aborted',
+                        'quota_deferrals', 'preemptions_charged',
+                        'charge_tokens', 'deadline_rejects',
+                        'deadline_misses', 'tokens_billed')
+
+    def _cluster_tenants(self):
+        """Cluster-wide per-tenant accounting: each tenant's rows
+        summed across the replicas' last-published tenancy tables —
+        the per-replica-bucket N x-quota effect made measurable before
+        quota sharing ships (ISSUE 18 observe-only half) — plus the
+        router's own per-tenant spill counts."""
+        out = {}
+        for rid in self._replicas:
+            st = self._status.get(rid) or {}
+            rows = (st.get('tenancy') or {}).get('tenants') or {}
+            for tid, row in rows.items():
+                dst = out.setdefault(str(tid), {'replicas': 0})
+                dst['replicas'] += 1
+                for k in self._TENANT_SUM_KEYS:
+                    v = row.get(k)
+                    if v is not None:
+                        dst[k] = dst.get(k, 0) + v
+        for tid, n in self.tenant_spills.items():
+            out.setdefault(str(tid),
+                           {'replicas': 0})['router_spills'] = n
+        return out
 
     def _cluster_goodput(self, per_replica):
         """Aggregate the replicas' goodput accounts and reprice the

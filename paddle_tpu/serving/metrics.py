@@ -98,6 +98,89 @@ _COUNTER_NAMES = (
     'ptpu_serve_spec_accepted_tokens_total',
 )
 
+# scalar gauges: name -> (help, value(stats, pool)). One declarative
+# table so publish() (global registry) and scalar_series() (per-replica
+# compact snapshots for the cluster `metrics` op) can never drift.
+_SCALAR_GAUGES = (
+    ('ptpu_serve_decode_tokens_per_sec',
+     'batched decode throughput (generated tokens/sec)',
+     lambda s, p: s.get('decode_tokens_per_sec', 0.0)),
+    ('ptpu_serve_batch_occupancy',
+     'mean running slots / decode slots over decode steps',
+     lambda s, p: s.get('batch_occupancy', 0.0)),
+    ('ptpu_serve_kv_page_utilization',
+     'KV pool pages in use / total',
+     lambda s, p: s.get('kv_page_utilization', 0.0)),
+    ('ptpu_serve_kv_pages_total', 'KV pool size in pages',
+     lambda s, p: p.get('num_pages', 0)),
+    ('ptpu_serve_kv_pages_in_use', 'KV pages mapped right now',
+     lambda s, p: p.get('pages_in_use', 0)),
+    ('ptpu_serve_kv_pages_high_water',
+     'max KV pages simultaneously mapped',
+     lambda s, p: p.get('high_water', 0)),
+    ('ptpu_serve_kv_pool_bytes',
+     'device bytes of the paged KV pool (scale buffers '
+     'included for int8 pools)',
+     lambda s, p: p.get('pool_bytes', 0)),
+    ('ptpu_serve_kv_bytes_per_token',
+     'K+V device bytes per cached token across layers '
+     '(docs/serving.md#quantized-kv capacity math)',
+     lambda s, p: p.get('bytes_per_token', 0)),
+    ('ptpu_serve_batch_slots', 'decode batch slots',
+     lambda s, p: s.get('slots', 0)),
+    ('ptpu_serve_requests_in_flight', 'requests holding a decode slot',
+     lambda s, p: s.get('in_flight', 0)),
+    ('ptpu_serve_requests_waiting', 'queued requests',
+     lambda s, p: s.get('waiting', 0)),
+    ('ptpu_serve_prefix_hits',
+     'prefix-cache lookups that mapped shared pages (lifetime)',
+     lambda s, p: s.get('prefix_hits_total', 0)),
+    ('ptpu_serve_prefix_misses',
+     'prefix-cache lookups that found nothing (lifetime)',
+     lambda s, p: s.get('prefix_misses_total', 0)),
+    ('ptpu_serve_prefix_shared_pages',
+     'physical KV pages currently mapped by >1 request',
+     lambda s, p: s.get('prefix_shared_pages', 0)),
+    ('ptpu_serve_prefix_cached_pages',
+     'ref-0 pages retained by the prefix index '
+     '(evictable, resurrectable)',
+     lambda s, p: s.get('prefix_cached_pages', 0)),
+    ('ptpu_serve_quota_deferrals',
+     'requests deferred by a tenant token-rate quota '
+     '(defer episodes, lifetime)',
+     lambda s, p: s.get('quota_deferrals_total', 0)),
+    ('ptpu_serve_preemptions_charged',
+     'preemptions debited against the preempting tenant\'s '
+     'quota (lifetime)',
+     lambda s, p: s.get('preemptions_charged_total', 0)),
+    ('ptpu_serve_deadline_rejects',
+     'requests rejected at submit because their deadline was '
+     'already unmeetable (lifetime)',
+     lambda s, p: s.get('deadline_rejects_total', 0)),
+    ('ptpu_serve_deadline_misses',
+     'requests finished past their own deadline (lifetime)',
+     lambda s, p: s.get('deadline_misses_total', 0)),
+)
+
+
+def scalar_series(stats):
+    """Pure view: engine stats dict -> {gauge name: scalar value} for
+    every scalar ptpu_serve_* series publish() would set. Reads the
+    same keys, pops nothing — the replica `metrics` control-channel op
+    uses this to build compact per-replica snapshots without touching
+    the (process-global, shared between in-process replicas) registry."""
+    pool = stats.get('pool') or {}
+    out = {name: fn(stats, pool) for name, _h, fn in _SCALAR_GAUGES}
+    for name in _COUNTER_NAMES:
+        key = name[len('ptpu_serve_'):-len('_total')]
+        out[name] = stats.get(key + '_total', 0)
+    out['ptpu_serve_degrade_stage'] = stats.get('degrade_stage', 0)
+    tenancy = stats.get('tenancy')
+    out['ptpu_serve_degrade_pressure'] = \
+        (tenancy or {}).get('pressure', 0.0)
+    return out
+
+
 # scheduler-timeline summary from the engine's last publish — a dict,
 # not registry gauges: it is a windowed aggregate that the snapshot
 # passes through whole (the router-feedback signal)
@@ -128,53 +211,12 @@ def publish(stats):
     registry just mirrors it (monitor counters can't be set)."""
     global _last_timeline, _last_tenancy
     g = _m.gauge
-    g('ptpu_serve_decode_tokens_per_sec',
-      help='batched decode throughput (generated tokens/sec)').set(
-          stats.get('decode_tokens_per_sec', 0.0))
     # ptpu_serve_ttft_ms (deprecated mean gauge) was REMOVED in ISSUE 7
     # after its one-release grace: use the ptpu_serve_ttft_seconds
     # histogram percentiles
-    g('ptpu_serve_batch_occupancy',
-      help='mean running slots / decode slots over decode steps').set(
-          stats.get('batch_occupancy', 0.0))
-    g('ptpu_serve_kv_page_utilization',
-      help='KV pool pages in use / total').set(
-          stats.get('kv_page_utilization', 0.0))
     pool = stats.get('pool') or {}
-    g('ptpu_serve_kv_pages_total', help='KV pool size in pages').set(
-        pool.get('num_pages', 0))
-    g('ptpu_serve_kv_pages_in_use', help='KV pages mapped right now').set(
-        pool.get('pages_in_use', 0))
-    g('ptpu_serve_kv_pages_high_water',
-      help='max KV pages simultaneously mapped').set(
-          pool.get('high_water', 0))
-    g('ptpu_serve_kv_pool_bytes',
-      help='device bytes of the paged KV pool (scale buffers '
-           'included for int8 pools)').set(pool.get('pool_bytes', 0))
-    g('ptpu_serve_kv_bytes_per_token',
-      help='K+V device bytes per cached token across layers '
-           '(docs/serving.md#quantized-kv capacity math)').set(
-          pool.get('bytes_per_token', 0))
-    g('ptpu_serve_batch_slots', help='decode batch slots').set(
-        stats.get('slots', 0))
-    g('ptpu_serve_requests_in_flight',
-      help='requests holding a decode slot').set(
-          stats.get('in_flight', 0))
-    g('ptpu_serve_requests_waiting', help='queued requests').set(
-        stats.get('waiting', 0))
-    g('ptpu_serve_prefix_hits',
-      help='prefix-cache lookups that mapped shared pages '
-           '(lifetime)').set(stats.get('prefix_hits_total', 0))
-    g('ptpu_serve_prefix_misses',
-      help='prefix-cache lookups that found nothing (lifetime)').set(
-          stats.get('prefix_misses_total', 0))
-    g('ptpu_serve_prefix_shared_pages',
-      help='physical KV pages currently mapped by >1 request').set(
-          stats.get('prefix_shared_pages', 0))
-    g('ptpu_serve_prefix_cached_pages',
-      help='ref-0 pages retained by the prefix index '
-           '(evictable, resurrectable)').set(
-          stats.get('prefix_cached_pages', 0))
+    for name, help_, fn in _SCALAR_GAUGES:
+        g(name, help=help_).set(fn(stats, pool))
     for name in _COUNTER_NAMES:
         key = name[len('ptpu_serve_'):-len('_total')]
         g(name, help=f'serving {key.replace("_", " ")} (lifetime)').set(
@@ -192,24 +234,10 @@ def publish(stats):
         hh = _m.histogram(name, help=help_, buckets=buckets)
         for v in vals:
             hh.observe(v)
-    # multi-tenant layer (ISSUE 15): counters-as-gauges + the ladder
-    # stage/pressure, and one labeled series per tenant in the
-    # queue-wait/e2e histograms
-    g('ptpu_serve_quota_deferrals',
-      help='requests deferred by a tenant token-rate quota '
-           '(defer episodes, lifetime)').set(
-        stats.get('quota_deferrals_total', 0))
-    g('ptpu_serve_preemptions_charged',
-      help='preemptions debited against the preempting tenant\'s '
-           'quota (lifetime)').set(
-        stats.get('preemptions_charged_total', 0))
-    g('ptpu_serve_deadline_rejects',
-      help='requests rejected at submit because their deadline was '
-           'already unmeetable (lifetime)').set(
-        stats.get('deadline_rejects_total', 0))
-    g('ptpu_serve_deadline_misses',
-      help='requests finished past their own deadline (lifetime)').set(
-        stats.get('deadline_misses_total', 0))
+    # multi-tenant layer (ISSUE 15): the quota/deadline
+    # counters-as-gauges rode the table above; the ladder
+    # stage/pressure + one labeled series per tenant in the
+    # queue-wait/e2e histograms land here
     tenancy = stats.pop('tenancy', None)
     publish_degrade_stage(
         stats.get('degrade_stage', 0),
@@ -229,6 +257,10 @@ def publish(stats):
     tl = stats.pop('timeline', None)
     if tl is not None:
         _last_timeline = tl
+    # telemetry time axis (ISSUE 18): history sampling piggybacks on
+    # the publish cadence — metadata-only, no device work, no-op
+    # unless MetricsRegistry.enable_history() opted in
+    _m.metrics().history_tick()
 
 
 def _histogram_view(h, scale_ms=True):

@@ -1,15 +1,22 @@
 """core/monitor Histogram.percentile edge cases (ISSUE 16 satellite):
 empty histogram, single sample, all-samples-in-overflow-bucket, and the
-q=0 / q=100 bounds."""
+q=0 / q=100 bounds. Plus the ISSUE 18 registry concurrency contract:
+publisher threads racing scrapes (prometheus text / snapshot / HTTP)
+and the history sampler must never produce torn series, duplicate
+`# TYPE` lines, or non-monotone counter reads."""
 import os
+import re
 import sys
+import threading
+import urllib.request
 
 import pytest
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(HERE))
 
-from paddle_tpu.core.monitor import Histogram   # noqa: E402
+from paddle_tpu.core.monitor import (Histogram,   # noqa: E402
+                                     MetricsRegistry, MetricsServer)
 
 
 def _hist(buckets=(1.0, 2.0, 4.0)):
@@ -84,3 +91,153 @@ class TestPercentileEdges:
         h.observe(1.5, site='b')
         assert h.percentile(100, site='a') == pytest.approx(1.0)
         assert h.percentile(0, site='b') == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------------
+# registry concurrency (ISSUE 18 satellite): publishers vs scrapes
+# ---------------------------------------------------------------------------
+N_PUBLISHERS = 4
+ROUNDS = 400
+
+
+class TestConcurrentPublishers:
+    """4 publisher threads hammer one registry while scrape readers
+    (prometheus text, snapshot, the HTTP exporter) and the history
+    sampler run concurrently — renders must never tear."""
+
+    def _publish(self, reg, worker, stop):
+        c = reg.counter('t_cc_events_total', labelnames=('worker',))
+        g = reg.gauge('t_cc_depth', labelnames=('worker',))
+        h = reg.histogram('t_cc_lat_seconds', buckets=(0.01, 0.1, 1.0))
+        w = f'w{worker}'
+        for i in range(ROUNDS):
+            if stop.is_set():
+                break
+            c.inc(worker=w)
+            g.set(float(i), worker=w)
+            h.observe(0.05)
+
+    @staticmethod
+    def _counter_values(text):
+        out = {}
+        for line in text.splitlines():
+            m = re.match(r'^t_cc_events_total\{worker="(w\d+)"\} '
+                         r'(\d+(?:\.\d+)?)$', line)
+            if m:
+                out[m.group(1)] = float(m.group(2))
+        return out
+
+    def test_scrapes_never_tear(self):
+        reg = MetricsRegistry()
+        hist = reg.enable_history(capacity=16)
+        stop = threading.Event()
+        errors = []
+        seen = {}                       # worker -> last counter value
+
+        def scrape_loop():
+            try:
+                while not stop.is_set():
+                    text = reg.prometheus_text()
+                    # no duplicate # TYPE lines (a torn two-pass render
+                    # would repeat a metric's header)
+                    types = [ln for ln in text.splitlines()
+                             if ln.startswith('# TYPE')]
+                    assert len(types) == len(set(types)), types
+                    # counters are monotone across successive scrapes
+                    for w, v in self._counter_values(text).items():
+                        assert v >= seen.get(w, 0.0), (w, v, seen)
+                        seen[w] = v
+                    # snapshot agrees structurally: every series row
+                    # carries a numeric value + age
+                    snap = reg.snapshot()
+                    for name, m in snap['metrics'].items():
+                        for row in m['series']:
+                            assert row['age_s'] is None or \
+                                row['age_s'] >= 0.0, (name, row)
+                    hist.tick()
+            except Exception as e:      # noqa: BLE001
+                errors.append(e)
+                stop.set()
+
+        threads = [threading.Thread(target=self._publish,
+                                    args=(reg, i, stop))
+                   for i in range(N_PUBLISHERS)]
+        scraper = threading.Thread(target=scrape_loop)
+        for th in threads:
+            th.start()
+        scraper.start()
+        for th in threads:
+            th.join(timeout=60)
+        stop.set()
+        scraper.join(timeout=60)
+        assert not errors, errors
+        # final totals exact: no lost increments under the race
+        c = reg.counter('t_cc_events_total', labelnames=('worker',))
+        for i in range(N_PUBLISHERS):
+            assert c.value(worker=f'w{i}') == ROUNDS
+        v = reg.histogram('t_cc_lat_seconds').value()
+        assert v['count'] == N_PUBLISHERS * ROUNDS
+        # history rings sampled concurrently: bounded, time-ordered,
+        # counter streams monotone (no torn samples)
+        for name in hist.series_names():
+            for key, pts in hist.iter_series(name):
+                assert len(pts) <= 16, (name, key)
+                ts = [t for t, _v in pts]
+                assert ts == sorted(ts), (name, key)
+        for key, pts in hist.iter_series('t_cc_events_total'):
+            vals = [v for _t, v in pts]
+            assert vals == sorted(vals), (key, vals)
+
+    def test_http_scrape_races_publishers(self):
+        reg = MetricsRegistry()
+        stop = threading.Event()
+        srv = MetricsServer(port=0, registry=reg)
+        threads = [threading.Thread(target=self._publish,
+                                    args=(reg, i, stop))
+                   for i in range(N_PUBLISHERS)]
+        for th in threads:
+            th.start()
+        try:
+            for _ in range(10):
+                body = urllib.request.urlopen(
+                    f'http://127.0.0.1:{srv.port}/metrics',
+                    timeout=10).read().decode()
+                types = [ln for ln in body.splitlines()
+                         if ln.startswith('# TYPE')]
+                assert len(types) == len(set(types))
+        finally:
+            stop.set()
+            for th in threads:
+                th.join(timeout=60)
+            srv.close()
+        vals = self._counter_values(
+            reg.prometheus_text())
+        assert set(vals) == {f'w{i}' for i in range(N_PUBLISHERS)}
+        assert all(v == ROUNDS for v in vals.values()), vals
+
+    def test_history_wraparound_deterministic_clock(self):
+        """The ring keeps exactly `capacity` newest points under
+        concurrent sampling on an injected clock."""
+        t = {'now': 0.0}
+        lock = threading.Lock()
+        reg = MetricsRegistry()
+        hist = reg.enable_history(capacity=8, clock=lambda: t['now'])
+        g = reg.gauge('t_cc_wrap')
+
+        def advance(base):
+            for i in range(50):
+                with lock:
+                    t['now'] += 1.0
+                    g.set(t['now'])
+                    hist.sample(now=t['now'])
+
+        threads = [threading.Thread(target=advance, args=(j,))
+                   for j in range(4)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=60)
+        pts = hist.points('t_cc_wrap')
+        assert len(pts) == 8
+        assert [p[0] for p in pts] == list(range(193, 201))
+        assert [p[1] for p in pts] == [p[0] for p in pts]

@@ -1,0 +1,374 @@
+"""Metric history rings & derived views (ISSUE 18 tentpole): sampling
+on a deterministic injected clock, ring wraparound, counter/gauge/
+histogram streams, the rate/delta/ewma/window/sustained views the
+alert engine consumes, downsampled export + sparkline rendering,
+staleness stamps (snapshot age_s, prometheus `# age` lines, reset
+epoch), the background tick, and the zero-extra-host-syncs contract
+with the whole time axis enabled on the serving hot path."""
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(HERE))
+
+import paddle_tpu as paddle                              # noqa: E402
+from paddle_tpu.core import monitor                      # noqa: E402
+from paddle_tpu.core.monitor import MetricsRegistry      # noqa: E402
+from paddle_tpu.core.timeseries import (MetricHistory,   # noqa: E402
+                                        series_key, sparkline)
+
+
+@pytest.fixture()
+def clocked():
+    """Private registry + history on one injected clock; the monitor
+    module clock is swapped too so publish-side stamps agree."""
+    t = {'now': 0.0}
+    prev = monitor.set_time_fn(lambda: t['now'])
+    reg = MetricsRegistry()
+    hist = reg.enable_history(capacity=8, clock=lambda: t['now'])
+    try:
+        yield reg, hist, t
+    finally:
+        monitor.set_time_fn(prev)
+
+
+# ---------------------------------------------------------------------------
+# sampling & rings
+# ---------------------------------------------------------------------------
+class TestRings:
+    def test_sample_all_kinds(self, clocked):
+        reg, hist, t = clocked
+        reg.gauge('t_g').set(3.0)
+        reg.counter('t_c_total').inc(2)
+        reg.histogram('t_h_seconds', buckets=(0.1, 1.0)).observe(0.05)
+        hist.sample()
+        assert hist.points('t_g') == [(0.0, 3.0)]
+        assert hist.points('t_c_total') == [(0.0, 2.0)]
+        # histograms contribute their _count/_sum counter streams
+        assert hist.points('t_h_seconds_count') == [(0.0, 1.0)]
+        assert hist.points('t_h_seconds_sum')[0][1] == \
+            pytest.approx(0.05)
+
+    def test_labeled_series_are_separate_rings(self, clocked):
+        reg, hist, t = clocked
+        g = reg.gauge('t_lbl', labelnames=('site',))
+        g.set(1.0, site='a')
+        g.set(2.0, site='b')
+        hist.sample()
+        assert hist.last('t_lbl', labels={'site': 'a'}) == 1.0
+        assert hist.last('t_lbl', labels={'site': 'b'}) == 2.0
+        # ambiguous unlabeled access on a multi-series metric raises
+        with pytest.raises(ValueError):
+            hist.points('t_lbl')
+        assert hist.label_keys('t_lbl') == [('a',), ('b',)]
+
+    def test_ring_wraparound_bounds_memory(self, clocked):
+        reg, hist, t = clocked          # capacity=8
+        g = reg.gauge('t_wrap')
+        for i in range(20):
+            t['now'] = float(i)
+            g.set(float(i))
+            hist.sample()
+        pts = hist.points('t_wrap')
+        assert len(pts) == 8            # oldest overwritten, never 20
+        assert pts[0] == (12.0, 12.0) and pts[-1] == (19.0, 19.0)
+
+    def test_self_gauges_published(self, clocked):
+        reg, hist, t = clocked
+        reg.gauge('t_one').set(1.0)
+        hist.sample()
+        hist.sample()
+        assert reg.counter('ptpu_ts_samples_total').value() == 2
+        assert reg.gauge('ptpu_ts_ring_capacity').value() == 8
+        assert reg.gauge('ptpu_ts_series_tracked').value() >= 1
+        assert reg.gauge('ptpu_ts_points_retained').value() >= 2
+
+    def test_tick_rate_limit(self):
+        t = {'now': 0.0}
+        reg = MetricsRegistry()
+        hist = reg.enable_history(capacity=8, min_interval_s=5.0,
+                                  clock=lambda: t['now'])
+        reg.gauge('t_rl').set(1.0)
+        hist.tick()                     # first always samples
+        t['now'] = 2.0
+        hist.tick()                     # inside the interval: skipped
+        assert len(hist.points('t_rl')) == 1
+        t['now'] = 6.0
+        hist.tick()
+        assert len(hist.points('t_rl')) == 2
+
+    def test_registry_reset_clears_rings(self, clocked):
+        reg, hist, t = clocked
+        reg.gauge('t_epoch').set(1.0)
+        hist.sample()
+        assert hist.points('t_epoch')
+        reg.reset()                     # bumps epoch + clears history
+        assert hist.points('t_epoch') == []
+        # and samples never bleed across the epoch on the next pass
+        reg.gauge('t_epoch').set(9.0)
+        t['now'] = 1.0
+        hist.sample()
+        assert hist.points('t_epoch') == [(1.0, 9.0)]
+
+    def test_enable_history_idempotent(self, clocked):
+        reg, hist, t = clocked
+        assert reg.enable_history(capacity=999) is hist
+        assert hist.capacity == 8       # first call's capacity wins
+
+    def test_capacity_floor(self):
+        with pytest.raises(ValueError):
+            MetricHistory(MetricsRegistry(), capacity=1)
+
+
+# ---------------------------------------------------------------------------
+# derived views
+# ---------------------------------------------------------------------------
+class TestViews:
+    def _fill(self, values, step=1.0):
+        t = {'now': 0.0}
+        reg = MetricsRegistry()
+        hist = reg.enable_history(capacity=64, clock=lambda: t['now'])
+        g = reg.gauge('t_v')
+        for i, v in enumerate(values):
+            t['now'] = i * step
+            g.set(float(v))
+            hist.sample()
+        return hist, t
+
+    def test_delta_and_rate(self):
+        hist, t = self._fill([0, 10, 20, 30, 40])      # t = 0..4
+        assert hist.delta('t_v', 2.0) == 20.0          # 40 - v(t<=2)
+        assert hist.rate('t_v', 2.0) == pytest.approx(10.0)
+        # window wider than the ring: falls back to the oldest point
+        assert hist.delta('t_v', 100.0) == 40.0
+
+    def test_views_none_until_data(self):
+        reg = MetricsRegistry()
+        hist = reg.enable_history(capacity=8, clock=lambda: 0.0)
+        assert hist.last('absent') is None
+        assert hist.delta('absent', 10) is None
+        assert hist.rate('absent', 10) is None
+        assert hist.ewma('absent', 10) is None
+        assert hist.window('absent', 10)['n'] == 0
+        assert hist.age_s('absent') is None
+
+    def test_ewma_tracks_trend(self):
+        hist, _t = self._fill([100.0] * 30)
+        assert hist.ewma('t_v', tau_s=5.0) == pytest.approx(100.0)
+        hist2, _t2 = self._fill([100.0] * 20 + [10.0] * 10)
+        ew = hist2.ewma('t_v', tau_s=30.0)
+        # slow tau: the trend still remembers the 100s; the last value
+        # sits far below it (the decode_tps_drop rule's shape)
+        assert 10.0 < ew < 100.0
+        assert hist2.last('t_v') < 0.5 * ew
+
+    def test_window_stats(self):
+        hist, _t = self._fill([1, 2, 3, 4, 5])
+        w = hist.window('t_v', 2.0)     # t in [2, 4] -> values 3,4,5
+        assert w == {'mean': 4.0, 'min': 3.0, 'max': 5.0, 'n': 3}
+
+    def test_sustained_requires_full_coverage(self):
+        hist, _t = self._fill([0.98, 0.98, 0.98, 0.98, 0.98])
+        assert hist.sustained('t_v', lambda v: v >= 0.9, 2.0)
+        # one dip inside the window breaks the sustain
+        hist2, _t2 = self._fill([0.98, 0.98, 0.98, 0.5, 0.98])
+        assert not hist2.sustained('t_v', lambda v: v >= 0.9, 2.0)
+        # a series younger than the bound is never vacuously sustained
+        hist3, _t3 = self._fill([0.98, 0.98])
+        assert not hist3.sustained('t_v', lambda v: v >= 0.9, 10.0)
+
+    def test_age_tracks_sampling(self):
+        hist, t = self._fill([1, 2, 3])
+        t['now'] = 10.0
+        assert hist.age_s('t_v') == pytest.approx(8.0)
+
+
+# ---------------------------------------------------------------------------
+# export / rendering
+# ---------------------------------------------------------------------------
+class TestExport:
+    def test_export_downsamples(self):
+        t = {'now': 0.0}
+        reg = MetricsRegistry()
+        hist = reg.enable_history(capacity=128, clock=lambda: t['now'])
+        g = reg.gauge('t_exp')
+        for i in range(100):
+            t['now'] = float(i)
+            g.set(float(i))
+            hist.sample()
+        out = hist.export(max_points=10)
+        s = out['t_exp']
+        assert len(s['t']) == len(s['v']) == 10
+        assert s['t'][-1] == 0.0            # relative to newest
+        assert s['v'][-1] == s['last'] == 99.0
+        assert s['min'] <= s['v'][0] and s['max'] == 99.0
+        assert s['kind'] == 'gauge'
+
+    def test_export_label_keys_and_names_filter(self):
+        t = {'now': 0.0}
+        reg = MetricsRegistry()
+        hist = reg.enable_history(capacity=8, clock=lambda: t['now'])
+        reg.gauge('t_exp_l', labelnames=('replica',)).set(
+            1.0, replica='r0')
+        reg.gauge('t_other').set(2.0)
+        hist.sample()
+        out = hist.export(names={'t_exp_l'})
+        assert list(out) == [series_key('t_exp_l',
+                                        (('replica', 'r0'),))]
+
+    def test_snapshot_carries_series(self):
+        t = {'now': 0.0}
+        prev = monitor.set_time_fn(lambda: t['now'])
+        try:
+            reg = MetricsRegistry()
+            reg.gauge('t_snap_g').set(5.0)
+            hist = reg.enable_history(capacity=8,
+                                      clock=lambda: t['now'])
+            hist.sample()
+            snap = reg.snapshot()
+            assert 't_snap_g' in snap['series']
+            assert snap['series']['t_snap_g']['last'] == 5.0
+        finally:
+            monitor.set_time_fn(prev)
+
+    def test_sparkline(self):
+        assert sparkline([]) == ''
+        assert set(sparkline([1.0, 1.0, 1.0])) == {'▄'}
+        s = sparkline(list(range(100)), width=12)
+        assert len(s) == 12
+        assert s[0] == '▁' and s[-1] == '█'
+
+    def test_sampler_snapshot(self):
+        t = {'now': 0.0}
+        reg = MetricsRegistry()
+        hist = reg.enable_history(capacity=8, clock=lambda: t['now'])
+        reg.gauge('t_ss').set(1.0)
+        hist.sample()
+        ss = hist.snapshot()
+        assert ss['samples'] == 1 and ss['capacity'] == 8
+        assert ss['series'] >= 1 and ss['points'] >= 1
+
+
+# ---------------------------------------------------------------------------
+# staleness stamps (publish-side)
+# ---------------------------------------------------------------------------
+class TestStaleness:
+    def test_snapshot_and_prometheus_age(self):
+        t = {'now': 100.0}
+        prev = monitor.set_time_fn(lambda: t['now'])
+        try:
+            reg = MetricsRegistry()
+            reg.gauge('t_age_g').set(1.0)
+            t['now'] = 130.0
+            snap = reg.snapshot()
+            row = snap['metrics']['t_age_g']['series'][0]
+            assert row['age_s'] == pytest.approx(30.0)
+            text = reg.prometheus_text(include_age=True)
+            assert '# age t_age_g 30' in text
+            # age lines are comments: opt-in and scrape-compatible
+            assert '# age' not in reg.prometheus_text()
+        finally:
+            monitor.set_time_fn(prev)
+
+    def test_publish_refreshes_stamp(self):
+        t = {'now': 0.0}
+        prev = monitor.set_time_fn(lambda: t['now'])
+        try:
+            reg = MetricsRegistry()
+            g = reg.gauge('t_age_r')
+            g.set(1.0)
+            t['now'] = 50.0
+            g.set(2.0)
+            t['now'] = 51.0
+            row = reg.snapshot()['metrics']['t_age_r']['series'][0]
+            assert row['age_s'] == pytest.approx(1.0)
+        finally:
+            monitor.set_time_fn(prev)
+
+
+# ---------------------------------------------------------------------------
+# background tick
+# ---------------------------------------------------------------------------
+class TestBackgroundTick:
+    def test_background_samples_and_stops(self):
+        reg = MetricsRegistry()
+        hist = reg.enable_history(capacity=16)
+        reg.gauge('t_bg').set(1.0)
+        th = hist.start_background(interval_s=0.01)
+        assert hist.start_background() is th        # idempotent
+        deadline = time.time() + 5.0
+        while hist.snapshot()['samples'] < 2 and time.time() < deadline:
+            time.sleep(0.01)
+        hist.stop_background()
+        assert not th.is_alive()
+        assert hist.snapshot()['samples'] >= 2
+        n = hist.snapshot()['samples']
+        time.sleep(0.05)
+        assert hist.snapshot()['samples'] == n      # really stopped
+
+
+# ---------------------------------------------------------------------------
+# zero-overhead contract on the serving hot path (PR-6 harness)
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope='module')
+def tiny_lm():
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    paddle.seed(7)
+    cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                    num_heads=2, max_seq_len=128, hidden_dropout=0.0,
+                    attn_dropout=0.0, use_flash_attention=False)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+class TestSyncBudget:
+    def test_time_axis_adds_no_host_syncs(self, tiny_lm, monkeypatch):
+        """History sampling + alert evaluation read host-side floats
+        the publishers already materialized: enabling the WHOLE time
+        axis (rings + default rule pack) must not add a single
+        engine._host_fetch, and outputs stay identical."""
+        from paddle_tpu.serving import ServingConfig, ServingEngine
+        from paddle_tpu.serving import engine as engine_mod
+        from paddle_tpu.core.alerts import AlertManager, default_rules
+        rng = np.random.RandomState(3)
+        prompts = [list(rng.randint(1, 128, n)) for n in (5, 11, 3)]
+
+        def run(enable_axis):
+            monitor.metrics().reset()
+            counts = [0]
+            real = engine_mod._host_fetch
+
+            def counting(x):
+                counts[0] += 1
+                return real(x)
+            monkeypatch.setattr(engine_mod, '_host_fetch', counting)
+            mgr = None
+            try:
+                if enable_axis:
+                    hist = monitor.metrics().enable_history(
+                        capacity=64)
+                    mgr = AlertManager(hist, rules=default_rules(),
+                                       source='test')
+                eng = ServingEngine(tiny_lm, ServingConfig(
+                    page_size=8, max_batch_size=3, prefill_chunk=8,
+                    num_pages=4))
+                outs = eng.generate(prompts, max_new_tokens=6, top_k=0)
+                eng.publish_metrics()       # ticks the rings + rules
+                eng.shutdown()
+            finally:
+                monkeypatch.setattr(engine_mod, '_host_fetch', real)
+                if mgr is not None:
+                    mgr.detach()
+                monitor.metrics().reset()
+            return counts[0], outs
+
+        n_off, outs_off = run(False)
+        n_on, outs_on = run(True)
+        assert outs_on == outs_off
+        assert n_on == n_off, (n_on, n_off)

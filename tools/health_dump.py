@@ -56,6 +56,13 @@ the human post-mortem:
     (docs/serving.md#multi-tenant), from a serve snapshot or bench
     record.
 
+  * alert rules & metric history (`alerts` subcommand): the AlertManager
+    rule table (state, severity, last value vs threshold), the recent
+    fire/resolve transition tail, downsampled history-ring sparklines
+    per series, and stale metric-section flags
+    (docs/observability.md#time-series--alerts), from an AlertManager
+    snapshot/report, a router cluster_snapshot, or a bench record.
+
 Usage:
     python tools/health_dump.py ARTIFACT.json [--json] [--level ERROR]
     python tools/health_dump.py numerics ARTIFACT.json [--json]
@@ -64,6 +71,7 @@ Usage:
     python tools/health_dump.py pallas SNAPSHOT.json [--json]
     python tools/health_dump.py mem RECORD.json [--json]
     python tools/health_dump.py host RECORD.json [--json]
+    python tools/health_dump.py alerts SNAPSHOT.json [--json]
     python tools/health_dump.py --selftest           # CI smoke
     python tools/health_dump.py numerics --selftest  # numerics CI smoke
     python tools/health_dump.py comm --selftest      # comm CI smoke
@@ -74,6 +82,7 @@ Usage:
     python tools/health_dump.py mem --selftest       # mem CI smoke
     python tools/health_dump.py host --selftest      # async CI smoke
     python tools/health_dump.py pp --selftest        # pipeline CI smoke
+    python tools/health_dump.py alerts --selftest    # alerts CI smoke
 """
 import argparse
 import json
@@ -1799,8 +1808,249 @@ def ledger_main(argv):
     return 0
 
 
+def _find_alerts(doc):
+    """Locate an alert block (ISSUE 18): an AlertManager.snapshot() /
+    report() dict ({'rules': [...], 'events': [...]}), an
+    alert_report.*.json artifact, or a bench leg's compact `alerts`
+    summary ({'fired_total': ..}) — wrapped so the renderer always
+    sees the same shape."""
+    if isinstance(doc, list):
+        for v in doc:
+            found = _find_alerts(v)
+            if found is not None:
+                return found
+        return None
+    if not isinstance(doc, dict):
+        return None
+    if isinstance(doc.get('rules'), list) and 'events' in doc:
+        return doc
+    if 'fired_total' in doc and 'fired_by_severity' in doc:
+        return {'summary': doc, 'rules': [], 'events': []}
+    for key in ('alerts', 'alert_report', 'telemetry', 'detail'):
+        found = _find_alerts(doc.get(key))
+        if found is not None:
+            return found
+    if 'legs' in doc:
+        for leg in (doc['legs'] or {}).values():
+            found = _find_alerts(leg)
+            if found is not None:
+                return found
+    return None
+
+
+def _find_series_block(doc):
+    """Locate a MetricHistory.export() block ({'name{labels}':
+    {'kind', 't', 'v', ...}}) for the sparkline strip."""
+    if isinstance(doc, list):
+        for v in doc:
+            found = _find_series_block(v)
+            if found is not None:
+                return found
+        return None
+    if not isinstance(doc, dict):
+        return None
+    if doc and all(isinstance(v, dict) and 'v' in v and 't' in v
+                   for v in doc.values()):
+        return doc
+    for key in ('series', 'telemetry', 'detail'):
+        found = _find_series_block(doc.get(key))
+        if found is not None:
+            return found
+    if 'legs' in doc:
+        for leg in (doc['legs'] or {}).values():
+            found = _find_series_block(leg)
+            if found is not None:
+                return found
+    return None
+
+
+_STALE_SECTION_S = 60.0
+
+
+def _stale_sections(doc, now_age_bound=_STALE_SECTION_S):
+    """Group a MetricsRegistry.snapshot()'s per-series publish ages by
+    metric-family prefix and flag families whose FRESHEST series is
+    older than the bound — the source engine stopped publishing
+    (the staleness-stamp satellite)."""
+    metrics = (doc or {}).get('metrics')
+    if not isinstance(metrics, dict):
+        return []
+    fam_age = {}
+    for name, m in metrics.items():
+        ages = [s.get('age_s') for s in (m.get('series') or ())
+                if isinstance(s, dict) and s.get('age_s') is not None]
+        if not ages:
+            continue
+        parts = name.split('_')
+        fam = '_'.join(parts[:2]) + '_*' if len(parts) > 2 else name
+        best = min(ages)
+        fam_age[fam] = min(fam_age.get(fam, best), best)
+    return sorted((fam, age) for fam, age in fam_age.items()
+                  if age > now_age_bound)
+
+
+def render_alerts(a, series=None, registry_snap=None):
+    """Human view of an alert block: per-rule state table, the capped
+    transition ring, optional history sparklines and stale-section
+    flags — docs/observability.md#time-series--alerts."""
+    out = ['ALERTS — rule states'
+           + (f" (source {a['source']})" if a.get('source') else '')]
+    rules = a.get('rules') or []
+    if rules:
+        for r in rules:
+            state = r.get('state', '?')
+            mark = {'firing': '!!', 'pending': ' ~'}.get(state, '  ')
+            lv = r.get('last_value')
+            out.append(
+                f"{mark} {r.get('rule', '?'):<24} {state:<8} "
+                f"{r.get('severity', '?'):<8} "
+                f"fired x{r.get('fired', 0)}"
+                + (f"  last {lv:.4g}" if isinstance(lv, (int, float))
+                   else '')
+                + (f"  [{','.join(map(str, r['last_series']))}]"
+                   if r.get('last_series') else ''))
+    summ = a.get('summary')
+    if summ:
+        out.append(f"  fired {summ.get('fired_total', 0)} "
+                   f"(critical {summ.get('fired_critical', 0)}); "
+                   f"active: {summ.get('active') or 'none'}")
+    evs = a.get('events') or []
+    if evs:
+        out.append('transitions:')
+        for e in evs[-20:]:
+            v = e.get('value')
+            out.append(
+                f"  t={e.get('t')}: {e.get('rule')} {e.get('event')} "
+                f"({e.get('severity')})"
+                + (f" value {v:.4g}" if isinstance(v, (int, float))
+                   else '')
+                + (f" on {e.get('metric')}" if e.get('metric') else ''))
+    if series:
+        _repo_root_on_path()
+        from paddle_tpu.core.timeseries import sparkline
+        out.append('history (downsampled):')
+        for key in sorted(series)[:16]:
+            s = series[key]
+            vals = s.get('v') or []
+            if not vals:
+                continue
+            out.append(f"  {key:<48} {sparkline(vals, width=24)} "
+                       f"last {s.get('last'):.4g}"
+                       if isinstance(s.get('last'), (int, float))
+                       else f"  {key:<48} {sparkline(vals, width=24)}")
+        if len(series) > 16:
+            out.append(f"  ... {len(series) - 16} more series")
+    stale = _stale_sections(registry_snap) if registry_snap else []
+    if stale:
+        out.append('STALE sections (no publish within '
+                   f'{_STALE_SECTION_S:.0f}s — source engine quiet):')
+        for fam, age in stale:
+            out.append(f"  {fam:<32} freshest series {age:.1f}s old")
+    if len(out) == 1:
+        out.append('  (no rules or events in this artifact)')
+    return '\n'.join(out)
+
+
+def _alerts_selftest():
+    """CI smoke: a gauge on a private registry with an injected clock
+    walks a pool-pressure rule fire -> sustain -> hysteretic clear;
+    the renderer shows the firing row, the transitions, a sparkline
+    strip, and a stale-section flag — all deterministic."""
+    _repo_root_on_path()
+    from paddle_tpu.core import monitor as mon
+    from paddle_tpu.core.alerts import AlertManager, AlertRule
+
+    t = [0.0]
+    prev_clock = mon.set_time_fn(lambda: t[0])  # publish stamps too
+    reg = mon.MetricsRegistry()
+    hist = reg.enable_history(capacity=64, clock=lambda: t[0])
+    g = reg.gauge('ptpu_serve_kv_page_utilization', help='pool')
+    rule = AlertRule('kv_pool_pressure',
+                     metric='ptpu_serve_kv_page_utilization',
+                     op='>=', value=0.97, clear_value=0.8, for_s=2.0,
+                     clear_for_s=1.0, severity='critical')
+    am = AlertManager(hist, rules=[rule], clock=lambda: t[0],
+                      registry=reg, source='selftest')
+    events = []
+    # ramp to saturation, hold (sustain), then release (clear)
+    for i, util in enumerate([0.3, 0.6, 0.99, 0.99, 0.99, 0.99,
+                              0.5, 0.5, 0.5]):
+        t[0] = float(i)
+        g.set(util)
+        events += hist.tick() or []
+    kinds = [e['event'] for e in am.snapshot()['events']]
+    assert kinds == ['fired', 'resolved'], kinds
+    st = am.snapshot()['rules'][0]
+    assert st['state'] == 'ok' and st['fired'] == 1, st
+    assert reg.get('ptpu_alert_fired_total').value(
+        rule='kv_pool_pressure', severity='critical') == 1
+    assert reg.get('ptpu_alert_active').value(
+        rule='kv_pool_pressure', severity='critical') == 0
+    # render mid-fire state too: re-fire and leave it active
+    t[0] = 20.0
+    g.set(1.0)
+    hist.tick()
+    t[0] = 23.0
+    g.set(1.0)
+    hist.tick()
+    assert am.active(), am.snapshot()
+    # stale-section flag: a family that stopped publishing
+    reg.gauge('ptpu_dead_engine_signal', help='quiet').set(1.0)
+    t[0] = 200.0
+    try:
+        text = render_alerts(am.snapshot(),
+                             series=hist.export(max_points=24),
+                             registry_snap=reg.snapshot())
+    finally:
+        mon.set_time_fn(prev_clock)
+    assert 'kv_pool_pressure' in text and 'firing' in text, text
+    assert 'fired' in text and 'resolved' in text, text
+    assert 'history (downsampled)' in text, text
+    assert 'ptpu_dead_*' in text, text
+    print(text)
+    print('health_dump alerts selftest: OK')
+    return 0
+
+
+def alerts_main(argv):
+    ap = argparse.ArgumentParser(
+        prog='health_dump.py alerts',
+        description='render alert rule states, fire/resolve '
+                    'transitions, history sparklines and stale '
+                    'metric sections from an alert_report artifact, '
+                    'bench record or telemetry snapshot '
+                    '(docs/observability.md#time-series--alerts)')
+    ap.add_argument('artifact', nargs='?',
+                    help='alert_report / bench record / snapshot JSON')
+    ap.add_argument('--json', action='store_true')
+    ap.add_argument('--selftest', action='store_true',
+                    help='walk fire -> sustain -> hysteretic clear on '
+                         'an injected clock')
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return _alerts_selftest()
+    if not args.artifact:
+        ap.error('artifact path required (or --selftest)')
+    with open(args.artifact) as f:
+        doc = json.load(f)
+    alerts = _find_alerts(doc)
+    if alerts is None:
+        raise ValueError(
+            'no alert block in this artifact (expected an '
+            'alert_report.*.json, an AlertManager.snapshot(), or a '
+            "bench record with a leg-level 'alerts' summary — "
+            'docs/observability.md#time-series--alerts)')
+    if args.json:
+        print(json.dumps(alerts, indent=2))
+    else:
+        print(render_alerts(alerts, series=_find_series_block(doc)))
+    return 0
+
+
 def main(argv=None):
     argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == 'alerts':
+        return alerts_main(argv[1:])
     if argv and argv[0] == 'ledger':
         return ledger_main(argv[1:])
     if argv and argv[0] == 'pp':

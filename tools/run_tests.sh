@@ -74,6 +74,14 @@
 #                      cluster drain, trace-v4 delivered/wasted parity,
 #                      HBM roofline table, zero-extra-host-sync budget,
 #                      then the serve + bench-compare CLI smokes
+#   --alerts-selftest - telemetry time axis (ISSUE 18): history-ring
+#                      sampling/wraparound + derived views on injected
+#                      clocks, alert state machine fire -> sustain ->
+#                      hysteretic clear with artifact/journal/gauge
+#                      emissions, 2-replica federation (one scrape,
+#                      replica labels, heartbeat-staleness precedes
+#                      the watchdog drain), registry concurrency,
+#                      zero-sync budget, then the alerts CLI smoke
 set -e
 cd "$(dirname "$0")/.."
 TIER="${1:-all}"
@@ -89,6 +97,7 @@ case "$TIER" in
             tests/test_async_step.py tests/test_pipeline_schedule.py \
             tests/test_ledger.py tests/test_monitor.py \
             tests/test_serving_ledger.py \
+            tests/test_timeseries.py tests/test_alerts.py \
             tests/test_metrics_docs.py -q
           # observability tooling smoke: tracer -> export -> summary CLI
           python tools/trace_summary.py --selftest
@@ -112,6 +121,8 @@ case "$TIER" in
           python tools/health_dump.py pp --selftest
           # ledger smoke: TrainStep loop -> ledger gauges -> render
           python tools/health_dump.py ledger --selftest
+          # alerts smoke: history ring -> rule fire/clear -> render
+          python tools/health_dump.py alerts --selftest
           # bench-compare smoke: synthetic + real rounds -> verdicts
           python tools/bench_compare.py --selftest ;;
   dist)   python -m pytest tests/test_distributed.py \
@@ -219,6 +230,16 @@ case "$TIER" in
             tests/test_metrics_docs.py -q
           python tools/health_dump.py serve --selftest
           python tools/bench_compare.py --selftest ;;
+  --alerts-selftest)
+          # the telemetry time axis end to end (ISSUE 18): history-
+          # ring + derived-view units, alert state-machine legs on
+          # injected clocks, the 2-replica federation / forced-
+          # overload / injected-hang acceptance tests, registry
+          # concurrency, docs-registry consistency, then the
+          # alerts CLI smoke
+          python -m pytest tests/test_timeseries.py tests/test_alerts.py \
+            tests/test_monitor.py tests/test_metrics_docs.py -q
+          python tools/health_dump.py alerts --selftest ;;
   all)    python -m pytest tests/ -q
           python tools/trace_summary.py --selftest
           python tools/health_dump.py --selftest
@@ -232,6 +253,7 @@ case "$TIER" in
           python tools/health_dump.py host --selftest
           python tools/health_dump.py pp --selftest
           python tools/health_dump.py ledger --selftest
+          python tools/health_dump.py alerts --selftest
           python tools/bench_compare.py --selftest ;;
-  *) echo "usage: $0 [fast|dist|native|e2e|all|--comm-selftest|--serve-selftest|--quant-selftest|--pallas-selftest|--overlap-selftest|--cluster-selftest|--remat-selftest|--async-selftest|--pp-selftest|--tenant-selftest|--ledger-selftest|--serve-ledger-selftest]"; exit 1 ;;
+  *) echo "usage: $0 [fast|dist|native|e2e|all|--comm-selftest|--serve-selftest|--quant-selftest|--pallas-selftest|--overlap-selftest|--cluster-selftest|--remat-selftest|--async-selftest|--pp-selftest|--tenant-selftest|--ledger-selftest|--serve-ledger-selftest|--alerts-selftest]"; exit 1 ;;
 esac

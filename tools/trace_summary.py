@@ -157,6 +157,13 @@ def summarize_serve(paths):
                      if (r.get('tenant_id') or '-') == tid]
             by_tenant[tid] = {
                 'requests': len(trows),
+                # cluster-wide tenant visibility (ISSUE 18): how many
+                # replicas this tenant's requests landed on — each one
+                # holds a SEPARATE quota bucket, so replicas > 1 means
+                # the tenant's effective quota is multiplied until the
+                # ROADMAP quota-sharing fix ships
+                'replicas': len({r.get('replica_id') or '-'
+                                 for r in trows}),
                 'quota_defers': sum(r.get('quota_defers', 0)
                                     for r in trows),
                 'deadline_misses': sum(1 for r in trows
@@ -270,18 +277,25 @@ def render_serve(s):
     if by_tenant:
         out.append('')
         out.append('-- SLO percentiles by tenant (ms) ' + '-' * 26)
-        out.append(f"{'tenant':<12} {'n':>4} {'defer':>5} "
-                   f"{'dl-miss':>7} "
+        out.append(f"{'tenant':<12} {'n':>4} {'reps':>4} "
+                   f"{'defer':>5} {'dl-miss':>7} "
                    f"{'qwait p50':>10} {'qwait p99':>10} "
                    f"{'e2e p50':>9} {'e2e p99':>9}")
         for tid, row in sorted(by_tenant.items()):
             qw, e2e = row['queue_wait_s'], row['e2e_s']
             out.append(
                 f"{tid[:12]:<12} {row['requests']:>4} "
+                f"{row.get('replicas', 1):>4} "
                 f"{row['quota_defers']:>5} "
                 f"{row['deadline_misses']:>7} "
                 f"{_fmt_ms(qw['p50']):>10} {_fmt_ms(qw['p99']):>10} "
                 f"{_fmt_ms(e2e['p50']):>9} {_fmt_ms(e2e['p99']):>9}")
+        reps = {row.get('replicas', 1) for row in by_tenant.values()}
+        if max(reps, default=1) > 1:
+            out.append('note: reps > 1 — each replica holds a '
+                       'separate quota bucket for that tenant '
+                       '(effective quota multiplies until cluster '
+                       'quota sharing ships)')
     return '\n'.join(out)
 
 
