@@ -793,6 +793,60 @@ def bench_gpt_serve():
              / base_rec['decode_tokens_per_sec']
              if base_rec['decode_tokens_per_sec'] else None),
     }
+
+    # -- fused decode windows (ISSUE 19): small-batch decode is where
+    # per-token serving goes host-bound (one dispatch + one fetch per
+    # token, device done long before Python). The same stream at fused
+    # k in {1, 4, 8}: decode tok/s and the ledger's measured
+    # host_bound_fraction side by side, outputs identical across k.
+    sb_batch = min(4, batch)
+    sb_prompts = prompts[:sb_batch]
+    # long enough for several windows at k=8 — a stream one window
+    # swallows whole leaves no inter-step interval for the gap monitor
+    # to price, and host_bound_fraction would read None
+    sb_max_new = max(max_new, 24)
+    sb_pages = -(-(hi + sb_max_new) // page_size)
+
+    def _run_fused(k):
+        e = ServingEngine(model, ServingConfig(
+            page_size=page_size, max_batch_size=sb_batch,
+            prefill_chunk=chunk, max_pages_per_seq=sb_pages,
+            fused_k=k))
+        # warm every compiled shape this engine will hit — prefill,
+        # the [B, 1] step (mixed prefill/decode sweeps) and the fused
+        # (B,) scan — on a short pass over the same stream
+        e.generate(sb_prompts, max_new_tokens=2, top_k=0)
+        e.reset_stats()
+        t0 = time.time()
+        outs = e.generate(sb_prompts, max_new_tokens=sb_max_new,
+                          top_k=0)
+        dt = time.time() - t0
+        stf = e.stats()
+        led = e.ledger.account() or {}
+        e.shutdown()
+        toks = sum(len(o) - len(p) for o, p in zip(outs, sb_prompts))
+        return {
+            'fused_k': k,
+            'tokens_per_sec': toks / dt,
+            'decode_tokens_per_sec': stf['decode_tokens_per_sec'],
+            'host_bound_fraction': led.get('host_bound_fraction'),
+            'fused_windows': stf['fused_windows_total'],
+            'fused_iterations': stf['fused_iterations_total'],
+            'fused_tokens': stf['fused_tokens_total'],
+            'decode_steps': stf['decode_steps_total'],
+        }, outs
+
+    sb_recs, sb_outs = {}, {}
+    for k in (1, 4, 8):
+        sb_recs[k], sb_outs[k] = _run_fused(k)
+    small_batch = {
+        'requests': sb_batch,
+        'decode_slots': sb_batch,
+        'max_new_tokens': sb_max_new,
+        'per_k': {str(k): r for k, r in sb_recs.items()},
+        'outputs_identical':
+            sb_outs[1] == sb_outs[4] == sb_outs[8],
+    }
     return {
         'serve_tokens_per_sec': serve_tokens / serve_dt,
         'sequential_tokens_per_sec': seq_tps,
@@ -818,6 +872,18 @@ def bench_gpt_serve():
         'prompt_lens': [int(n) for n in lens],
         'kv_tokens_dense_vs_paged': [dense_cache_tokens, paged_tokens],
         'shared_prefix': shared_prefix,
+        # fused decode windows (ISSUE 19): the small-batch record plus
+        # flat headline keys bench_compare tracks across rounds (k=8
+        # leg vs the k=1 per-token path on the identical stream)
+        'small_batch': small_batch,
+        'small_batch_decode_tokens_per_sec':
+            sb_recs[8]['decode_tokens_per_sec'],
+        'small_batch_host_bound_fraction':
+            sb_recs[8]['host_bound_fraction'],
+        'fused_speedup_vs_per_token':
+            (sb_recs[8]['decode_tokens_per_sec']
+             / sb_recs[1]['decode_tokens_per_sec']
+             if sb_recs[1]['decode_tokens_per_sec'] else None),
         # serving ledger & roofline (ISSUE 17): the wall decomposition
         # (components reconcile to wall_seconds, residue surfaced),
         # the delivered/wasted goodput account, and the decode
@@ -1536,6 +1602,38 @@ def _check_legs(result):
         assert isinstance(sroof, dict), 'serve leg lacks roofline'
         assert 'decode_bytes_per_iteration' in sroof, \
             'serve roofline lacks decode_bytes_per_iteration'
+        # fused decode windows (ISSUE 19): the small-batch record —
+        # the same stream at fused k in {1, 4, 8}, token-identical,
+        # with decode tok/s and host_bound_fraction side by side, and
+        # the k>1 legs actually fusing
+        sb = sleg.get('small_batch')
+        assert isinstance(sb, dict), 'serve leg lacks small_batch'
+        assert sb.get('outputs_identical') is True, \
+            'small_batch outputs differ across fused k'
+        per_k = sb.get('per_k')
+        assert isinstance(per_k, dict) and set(per_k) == {'1', '4',
+                                                          '8'}, \
+            'small_batch.per_k must carry k in {1, 4, 8}'
+        for k, r in per_k.items():
+            for key in ('decode_tokens_per_sec', 'host_bound_fraction',
+                        'fused_windows', 'fused_iterations',
+                        'fused_tokens', 'decode_steps'):
+                assert key in r, f'small_batch.per_k[{k}] lacks {key}'
+            if k == '1':
+                assert r['fused_windows'] == 0, \
+                    'per-token leg reported fused windows'
+            else:
+                assert r['fused_windows'] > 0, \
+                    f'fused k={k} leg never fused'
+                assert r['fused_tokens'] <= r['fused_iterations'] \
+                    * sb['decode_slots'], \
+                    f'small_batch k={k} token overcount'
+        assert isinstance(
+            sleg.get('small_batch_decode_tokens_per_sec'),
+            (int, float)), 'serve leg lacks flat small-batch tok/s'
+        assert isinstance(sleg.get('fused_speedup_vs_per_token'),
+                          (int, float)), \
+            'serve leg lacks fused_speedup_vs_per_token'
     # the telemetry time axis (ISSUE 18): the headline and serve legs
     # carry the downsampled history-ring block + the alert summary, and
     # a clean leg must not have fired a critical rule — an alert there

@@ -117,6 +117,7 @@ class DisaggregatedEngine:
                     dst[k].extend(v)
                     v.clear()
             eng._last_publish = eng._clock()
+            eng._last_publish_wall = _m._time_fn()
         self.prefill.publish_metrics = _forward_publish
         self._pending = []          # prefilled, waiting for a slot
         self._handoffs = 0

@@ -203,6 +203,13 @@ class _EngineHost:
                 'pending_tokens': pending_tokens,
                 'decode_tokens_per_sec': rate,
                 'degrade_stage': eng.degrade_stage(),
+                # fused decode (ISSUE 19): the router polls at window
+                # granularity — a replica mid-window reports the last
+                # completed window's counters, so beat_age_s can lag
+                # by up to k iterations on a healthy fused engine
+                'fused_k': eng._effective_fused_k(),
+                'fused_windows_total': eng._fused_windows,
+                'fused_iterations_total': eng._fused_iterations,
                 'timeline': eng.timeline.summary(),
                 'pool': {'pages_in_use': eng.pool.pages_in_use,
                          'num_pages': eng.pool.num_pages},
@@ -389,6 +396,9 @@ class ReplicaWorker(_EngineHost):
             'pending_tokens': 0,
             'decode_tokens_per_sec': 0.0,
             'degrade_stage': 0,
+            'fused_k': 1,
+            'fused_windows_total': 0,
+            'fused_iterations_total': 0,
             'timeline': {},
             'pool': {},
             'prefix_digest': None,      # keep the router's last view

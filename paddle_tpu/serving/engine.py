@@ -1,6 +1,6 @@
 """Serving engine: fixed-shape jitted steps over the paged KV pool.
 
-Three compiled step shapes serve every request mix (the continuous-
+Four compiled step shapes serve every request mix (the continuous-
 batching contract — the device never recompiles as traffic changes):
 
   * chunked prefill  — B=1, T=prefill_chunk: one prompt chunk streams
@@ -13,7 +13,19 @@ batching contract — the device never recompiles as traffic changes):
     causal-within-sequence masking chunked prefill uses — and the
     accept-longest-agreeing-prefix rule plus a bonus token advances a
     request up to spec_k+1 tokens per dispatch, token-identical to the
-    one-token path.
+    one-token path;
+  * fused decode     — B=max_batch_size, k=fused_k iterations of the
+    decode step rolled into ONE dispatch via lax.scan (only with
+    fused_k > 1): the carry holds the sampled token, per-row seq_len,
+    eos/budget done-mask and the paged KV pool, so the host fetches
+    sampled ids once per k tokens instead of once per token. Engaged
+    per dispatch only when the scheduler is quiescent for the window
+    (no waiting work, no mid-window admit/retire hazard, no degrade
+    transition due) and every row's k-token page reservation fits;
+    otherwise the engine falls back to the [B, 1] step. Tokens are
+    IDENTICAL to serial decode for greedy and sampled rows alike: the
+    sampling key is folded per (request ordinal, absolute position),
+    never per dispatch.
 
 Prefix caching (ISSUE 9) rides in the pool: prompts sharing a prefix
 map the same physical pages (kv_pool.py refcounts + hash-chained
@@ -36,6 +48,7 @@ journal and the scheduler timeline, with zero extra device syncs.
 docs/serving.md covers tuning the knobs.
 """
 import math
+import os
 import time
 
 import numpy as np
@@ -48,6 +61,7 @@ from .request_trace import (ENGINE_REQ, RequestTracer,
                             build_serve_report, write_serve_report)
 from . import metrics as _metrics
 from .ledger import ServeLedger
+from ..core import monitor as _monitor
 from ..core.async_step import HostGapMonitor, unregister_monitor
 from ..profiler import RecordEvent
 
@@ -104,6 +118,18 @@ class ServingConfig:
     spec_ngram       proposer match length: the trailing n-gram looked
                      up in the request's own token history (prompt +
                      generated) to source draft continuations
+    fused_k          decode iterations fused into one dispatch
+                     (default: $PTPU_SERVE_FUSED_K, else 1 = off): a
+                     fourth compiled shape scans k decode steps on
+                     device and fetches sampled ids once per window,
+                     cutting the per-token host round-trip k-fold at
+                     small batch. Token-identical to fused_k=1; falls
+                     back to the [B, 1] step whenever the scheduler
+                     is not quiescent for a full window, draft
+                     proposals exist this dispatch (spec verify wins),
+                     or a row's k-token page reservation doesn't fit.
+                     Ladder stage 1+ sheds it before spec_k
+                     (docs/serving.md#fused-decode)
     seed             device sampling stream seed
     trace            per-request lifecycle journal on/off (host-only
                      bookkeeping; default on — docs/serving.md)
@@ -155,7 +181,8 @@ class ServingConfig:
     def __init__(self, page_size=16, max_batch_size=4, num_pages=None,
                  max_pages_per_seq=None, prefill_chunk=32,
                  kv_dtype=None, weight_dtype=None, prefix_cache=True,
-                 spec_k=0, spec_ngram=2, seed=0, trace=True,
+                 spec_k=0, spec_ngram=2, fused_k=None, seed=0,
+                 trace=True,
                  trace_events_per_request=512, trace_requests=512,
                  timeline_capacity=2048, request_deadline_s=None,
                  deadline_action='report', report_dir=None, clock=None,
@@ -168,6 +195,11 @@ class ServingConfig:
                              "prefill_chunk must be positive")
         if spec_k < 0 or spec_ngram < 1:
             raise ValueError("spec_k must be >= 0 and spec_ngram >= 1")
+        if fused_k is None:
+            fused_k = int(os.environ.get('PTPU_SERVE_FUSED_K', '1'))
+        if int(fused_k) < 1:
+            raise ValueError("fused_k must be >= 1 (1 = per-token "
+                             "decode, k > 1 = fused k-step windows)")
         if deadline_action not in ('report', 'abort'):
             raise ValueError("deadline_action must be 'report' or "
                              "'abort'")
@@ -185,6 +217,7 @@ class ServingConfig:
         self.prefix_cache = bool(prefix_cache)
         self.spec_k = int(spec_k)
         self.spec_ngram = int(spec_ngram)
+        self.fused_k = int(fused_k)
         self.seed = int(seed)
         self.trace = bool(trace)
         self.trace_events_per_request = int(trace_events_per_request)
@@ -342,7 +375,17 @@ class ServingEngine:
                 n: jax.device_put(a, NamedSharding(mesh, specs[n]))
                 for n, a in self._params.items()}
         self._step_fns = {}
+        # CONSTANT base sampling key: per-row keys are derived inside
+        # the step as fold_in(fold_in(base, request_ordinal),
+        # absolute_position), so the token sampled at position p of
+        # request o is a pure function of (seed, o, p) — the invariant
+        # that makes fused-k, serial decode, spec verify and
+        # preempt/resume re-prefill all emit IDENTICAL sampled tokens
         self._key = jax.random.key(config.seed)
+        # engine-local submission ordinal feeding that fold (NOT the
+        # process-global Request.id, which would couple sampled output
+        # to unrelated engines constructed earlier in the process)
+        self._next_sample_ord = 0
         self._jnp = jnp
         self._jax = jax
         # lifetime accounting for stats()/metrics
@@ -358,6 +401,16 @@ class ServingEngine:
         self._spec_proposed = 0
         self._spec_accepted = 0
         self._spec_steps = 0
+        # fused-decode accounting (ISSUE 19): windows dispatched, the
+        # device iterations they ran, and the tokens they delivered
+        self._fused_windows = 0
+        self._fused_iterations = 0
+        self._fused_tokens = 0
+        # per-step handoff from _fused_decode_window to step() so the
+        # timeline/ledger record one entry per fused ITERATION (the
+        # router occupancy tiebreak and staleness alerting consume
+        # per-iteration signals, not per-dispatch ones)
+        self._fused_last = None
         self._submitted = 0
         self._completed = 0
         self._aborted = 0
@@ -367,6 +420,13 @@ class ServingEngine:
         self._new_slo = {'queue_wait_s': [], 'tpot_s': [], 'e2e_s': [],
                          'preemptions': []}
         self._last_publish = 0.0
+        # WALL-clock twin of _last_publish: the periodic publish path
+        # keys staleness-relevant cadence to the monitor's time source
+        # (the same one gauge last_update stamps and `metrics_stale`
+        # alert rules read), so a deterministic injected config.clock —
+        # or a fused window that retires k tokens between steps — can
+        # never starve gauge freshness (ISSUE 19 satellite)
+        self._last_publish_wall = 0.0
         # multi-tenant SLO layer (ISSUE 15): policy table (priority /
         # quota buckets / eviction weights), the degradation ladder,
         # and per-tenant lifetime accounting. All None/zero when no
@@ -512,6 +572,34 @@ class ServingEngine:
             return min(C, max(self.pool.page_size, C // 2))
         return C
 
+    def _effective_fused_k(self):
+        """Ladder stage 1+ sheds the fused window FIRST, ahead of
+        spec_k in the same stage's use-site ordering: the window is a
+        pure latency-amortization whose k-token page reservations and
+        held retire slots are exactly the flexibility an overloaded
+        scheduler needs back. Outputs are fused-invariant by the ISSUE
+        19 bar, so shedding is invisible in tokens."""
+        if self._ladder is not None and self._ladder.stage >= 1:
+            return 1
+        return self.config.fused_k
+
+    def _fused_ok(self, k):
+        """Quiescence gate for a k-iteration fused window: the
+        scheduler must have no decision due (Scheduler.quiescent) and
+        the degrade ladder no stage transition reachable within k
+        observations of the CURRENT pressure (DegradeLadder.
+        would_transition) — a window the ladder would interrupt
+        mid-flight must not be dispatched at all."""
+        if not self.scheduler.quiescent():
+            return False
+        if self._ladder is not None:
+            p = DegradeLadder.pressure_of(
+                self.pool.utilization(), len(self.scheduler.waiting),
+                self.config.max_batch_size)
+            if self._ladder.would_transition(p, k):
+                return False
+        return True
+
     def ladder_history(self):
         """Stage-transition events [{t, from, to, pressure}] — the
         bench leg's ladder timeline."""
@@ -561,6 +649,15 @@ class ServingEngine:
                     'deadline_unmet',
                     retry_after_s=est - req.deadline_s,
                     estimated_s=est, deadline_s=req.deadline_s)
+        # sampling ordinal: engine-local, assigned in submission order
+        # so identically-seeded engines fed the same prompts derive
+        # identical per-position sampling keys (the fused-vs-serial
+        # and disaggregated-vs-unified token-identity bar). Adopted
+        # requests (disaggregation) carry the ordinal their submitting
+        # engine assigned.
+        if req.sample_ord is None:
+            req.sample_ord = self._next_sample_ord
+            self._next_sample_ord += 1
         self.scheduler.submit(req)
         self._submitted += 1
         if req.tenant_id is not None:
@@ -639,40 +736,74 @@ class ServingEngine:
                 # the surviving rows, tokens what they emitted (> slots
                 # when speculative decoding accepts drafts)
                 decode_slots, decode_tokens = self._decode_step()
-        self._observe_pressure()
-        self.timeline.record(
-            t=self._clock(),
-            decode_slots_occupied=decode_slots,
-            decode_slots=self.config.max_batch_size,
-            prefill_tokens=prefill_tokens,
-            decode_tokens=decode_tokens,
-            admissions=admitted,
-            preemptions=self.scheduler.preemptions - preempt_before,
-            waiting=len(self.scheduler.waiting),
-            pool_pages_in_use=self.pool.pages_in_use,
-            pool_pages_total=self.pool.num_pages,
-            degrade_stage=self.degrade_stage())
-        # ledger close-out: the iteration wall and its measured phase
-        # segments, then the gap-monitor span. dispatch_end BEFORE
-        # note_gating — dispatch_end zeroes the pending gating
-        # attribution, and the fetch wait belongs to the span that just
-        # closed (it is consumed by the NEXT dispatch_begin).
-        self.ledger.observe_iteration(
-            wall=time.perf_counter() - t_begin,
-            compute=self._it_compute,
-            host_fetch=self._it_fetch,
-            schedule=sched_dt,
-            decode_seconds=self._it_decode_s,
-            kv_read_tokens=self._it_kv_read_tokens,
-            prefill_tokens=self._it_prefill_tokens,
-            prefill_seconds=self._it_prefill_s,
-            prefill_ctx_tokens=self._it_prefill_ctx)
+        # one observability record per decode ITERATION: a fused
+        # window runs n_iter device iterations inside one dispatch,
+        # and the timeline / ladder / ledger must see the same per-
+        # iteration stream serial decode produces (k entries, each
+        # with that iteration's row occupancy; wall and phase segments
+        # amortized across the window) — otherwise every downstream
+        # consumer of these signals (router occupancy tiebreaks, alert
+        # rules, ledger decode throughput) would read a kx-slower
+        # engine. Admissions/preemptions/prefill attribute to the
+        # first entry only: they happened once, before the window.
+        fused = self._fused_last
+        self._fused_last = None
+        n_iter = fused['iters'] if fused else 1
+        wall = time.perf_counter() - t_begin
+        for j in range(n_iter):
+            first = (j == 0)
+            self._observe_pressure()
+            entry = dict(
+                t=self._clock(),
+                decode_slots_occupied=(fused['rows'][j] if fused
+                                       else decode_slots),
+                decode_slots=self.config.max_batch_size,
+                prefill_tokens=prefill_tokens if first else 0,
+                decode_tokens=(fused['rows'][j] if fused
+                               else decode_tokens),
+                admissions=admitted if first else 0,
+                preemptions=(self.scheduler.preemptions - preempt_before
+                             if first else 0),
+                waiting=len(self.scheduler.waiting),
+                pool_pages_in_use=self.pool.pages_in_use,
+                pool_pages_total=self.pool.num_pages,
+                degrade_stage=self.degrade_stage())
+            if fused:
+                entry['fused'] = True
+                entry['fused_k'] = fused['k']
+            self.timeline.record(**entry)
+            # ledger close-out: the iteration wall and its measured
+            # phase segments. Under a fused window the one host fetch
+            # amortizes over the window's iterations — the per-window
+            # host-fetch attribution that makes host_bound_fraction
+            # drop k-fold instead of misreading the window as one
+            # giant iteration.
+            self.ledger.observe_iteration(
+                wall=wall / n_iter,
+                compute=self._it_compute / n_iter,
+                host_fetch=self._it_fetch / n_iter,
+                schedule=sched_dt / n_iter,
+                decode_seconds=self._it_decode_s / n_iter,
+                kv_read_tokens=self._it_kv_read_tokens // n_iter,
+                prefill_tokens=self._it_prefill_tokens if first else 0,
+                prefill_seconds=self._it_prefill_s if first else 0.0,
+                prefill_ctx_tokens=self._it_prefill_ctx if first else 0)
+        # gap-monitor span close: dispatch_end BEFORE note_gating —
+        # dispatch_end zeroes the pending gating attribution, and the
+        # fetch wait belongs to the span that just closed (it is
+        # consumed by the NEXT dispatch_begin).
         self._gap.dispatch_end(depth=1)
         if self._it_fetch > 0.0:
             self._gap.note_gating(self._it_fetch)
+        # publish cadence: retire and drain publish immediately; the
+        # periodic path keys to the MONITOR's wall clock (the same
+        # source gauge last_update stamps and staleness alert rules
+        # read), never to config.clock — an injected deterministic
+        # clock, or fused windows retiring k tokens per step, must not
+        # let gauge freshness lapse into `metrics_stale` alerts.
         if (self._completed != completed_before
                 or not self.scheduler.has_work
-                or (self._clock() - self._last_publish
+                or (_monitor._time_fn() - self._last_publish_wall
                     >= self.PUBLISH_INTERVAL_S)):
             self.publish_metrics()
 
@@ -1007,7 +1138,7 @@ class ServingEngine:
             return g.reshape(lg.shape[:-1] + (lg.shape[-1] * mp,))
 
         def step(params, kv, tokens, page_tables, seq_lens, q_lens, key,
-                 temps, top_ks):
+                 ords, temps, top_ks):
             # int8 pools carry (k, v, k_scales, v_scales) per layer;
             # dense pools (k, v) — forward_paged keys off the arity
             cts = [tuple(Tensor(a) for a in c) for c in kv]
@@ -1048,8 +1179,8 @@ class ServingEngine:
                             logits_all, idx[:, None, None],
                             axis=1)[:, 0, :]
                         samp = _device_sample(
-                            last.astype(jnp.float32), key, temps,
-                            top_ks)
+                            last.astype(jnp.float32), key, ords,
+                            seq_lens, temps, top_ks)
                         nxt = jnp.concatenate([nxt, samp[:, None]], 1)
                     return nxt, [tuple(t.data for t in c)
                                  for c in new_kv]
@@ -1061,7 +1192,8 @@ class ServingEngine:
                     preferred_element_type=jnp.float32))
                 if sample:
                     nxt = _device_sample(logits.astype(jnp.float32),
-                                         key, temps, top_ks)
+                                         key, ords, seq_lens, temps,
+                                         top_ks)
                 else:
                     nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             return nxt, [tuple(t.data for t in c) for c in new_kv]
@@ -1081,7 +1213,7 @@ class ServingEngine:
             kv_specs = [tuple(P(None, None, 'mp') for _ in layer)
                         for layer in self.pool.kv]
             in_specs = (dict(self._param_specs), kv_specs,
-                        P(), P(), P(), P(), P(), P(), P())
+                        P(), P(), P(), P(), P(), P(), P(), P())
             out_specs = (P(), kv_specs)
             step = shard_map(step, mesh=self.mesh, in_specs=in_specs,
                              out_specs=out_specs, check_rep=False)
@@ -1097,6 +1229,250 @@ class ServingEngine:
                 if was:
                     model.train()
         return run
+
+    def _fused_fn(self, B, K, sample):
+        key = ('fused', B, K, sample)
+        fn = self._step_fns.get(key)
+        if fn is None:
+            fn = self._build_fused_step(B, K, sample)
+            self._step_fns[key] = fn
+        return fn
+
+    def _build_fused_step(self, B, K, sample):
+        """Fourth compiled shape (ISSUE 19): K decode iterations under
+        ONE jit via lax.scan. The carry is (kv pool, last token,
+        seq_len, done-mask, emitted count) per row; each scan body is
+        exactly the [B, 1] decode step — same forward_paged, same
+        positions, same on-device sampling with the key folded per
+        (ordinal, absolute position) — so the K stacked outputs are
+        token-identical to K serial dispatches. Rows that hit eos or
+        their budget mid-window flip `done` and ride the remaining
+        iterations with q_len=0 (the idle-slot mechanism: KV writes
+        dropped by the scatter, outputs ignored by the host)."""
+        jax, jnp = self._jax, self._jnp
+        import contextlib
+        model = self.model
+        from ..core.tensor import Tensor
+        from ..core.autograd import no_grad
+        from ..jit import bind_arrays
+        max_pos = model.config.max_seq_len - 1
+        qdtypes = dict(self._qparam_dtypes)
+        mp = self._mp
+
+        def _spmd():
+            if mp > 1:
+                from ..distributed import collective as C
+                return C.spmd_region(('mp',))
+            return contextlib.nullcontext()
+
+        def _full_logits(lg):
+            if mp <= 1:
+                return lg
+            g = jax.lax.all_gather(lg, 'mp')
+            g = jnp.moveaxis(g, 0, -2)
+            return g.reshape(lg.shape[:-1] + (lg.shape[-1] * mp,))
+
+        def step(params, kv, tokens, page_tables, seq_lens, ords,
+                 rems, eos_ids, live, key, temps, top_ks):
+            arrs = {}
+            for n, v in params.items():
+                if isinstance(v, dict):
+                    s = v['s'] * (1.0 / 127.0)
+                    shape = [1] * (v['q'].ndim - 1) + [-1]
+                    arrs[n] = (v['q'].astype(jnp.float32)
+                               * s.reshape(shape)).astype(qdtypes[n])
+                else:
+                    arrs[n] = v
+            with bind_arrays(model, arrs), _spmd():
+                w = model.gpt.embeddings.word_embeddings.weight
+
+                def body(carry, _):
+                    kv_c, tok, seq, done, emitted = carry
+                    alive = ~done
+                    q = jnp.where(alive, 1, 0).astype(jnp.int32)
+                    cts = [tuple(Tensor(a) for a in c) for c in kv_c]
+                    pos = jnp.clip(seq - q, 0, max_pos)[:, None]
+                    h, new_kv = model.gpt.forward_paged(
+                        Tensor(tok[:, None]), Tensor(pos), cts,
+                        page_tables, seq, q)
+                    h_last = h.data[:, 0, :]
+                    logits = _full_logits(jnp.einsum(
+                        'bh,vh->bv', h_last, w.data,
+                        preferred_element_type=jnp.float32))
+                    if sample:
+                        nxt = _device_sample(
+                            logits.astype(jnp.float32), key, ords,
+                            seq, temps, top_ks)
+                    else:
+                        nxt = jnp.argmax(logits, axis=-1) \
+                            .astype(jnp.int32)
+                    # serial-order accounting: the emitted token counts
+                    # BEFORE the eos/budget check (append-then-check),
+                    # so eos-in-window truncates precisely where the
+                    # one-token path stops
+                    emitted2 = emitted + q
+                    hit_eos = (eos_ids >= 0) & (nxt == eos_ids)
+                    done2 = done | hit_eos | (emitted2 >= rems)
+                    tok2 = jnp.where(alive, nxt, tok)
+                    seq2 = seq + q
+                    new_kv = [tuple(t.data for t in c) for c in new_kv]
+                    return (new_kv, tok2, seq2, done2, emitted2), nxt
+
+                carry0 = (kv, tokens, seq_lens, ~live,
+                          jnp.zeros((B,), jnp.int32))
+                (kv, _t, _s, _d, _e), ys = jax.lax.scan(
+                    body, carry0, xs=None, length=K)
+            return jnp.moveaxis(ys, 0, 1), kv           # [B, K]
+
+        donate = (1,) if jax.default_backend() != 'cpu' else ()
+        if mp > 1:
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec as P
+            kv_specs = [tuple(P(None, None, 'mp') for _ in layer)
+                        for layer in self.pool.kv]
+            in_specs = (dict(self._param_specs), kv_specs,
+                        P(), P(), P(), P(), P(), P(), P(), P(), P(),
+                        P())
+            out_specs = (P(), kv_specs)
+            step = shard_map(step, mesh=self.mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_rep=False)
+        jitted = jax.jit(step, donate_argnums=donate)
+
+        def run(*args):
+            was = model.training
+            model.eval()
+            try:
+                with no_grad():
+                    return jitted(*args)
+            finally:
+                if was:
+                    model.train()
+        return run
+
+    def _fused_decode_window(self, K):
+        """Up to K decode iterations in ONE dispatch + ONE host fetch.
+        The caller holds scheduler/ladder quiescence; this method owns
+        the page budget: every row's full window is reserved up front
+        (pool.try_reserve — all-or-nothing per row) and the unused
+        tail handed back with the spec-style trim after the fetch.
+        Returns (rows, tokens emitted), or None when a reservation
+        fails and the caller should fall back to the [B, 1] step."""
+        jnp = self._jnp
+        sched = self.scheduler
+        B = self.config.max_batch_size
+        rows = []
+        for i, req in enumerate(sched.slots):
+            if req is None or req.state != RequestState.RUNNING:
+                continue
+            w = min(K, req.max_new_tokens - len(req.generated))
+            if not self.pool.try_reserve(req.id, req.context_len + w):
+                # roll the earlier rows' fresh reservations back so the
+                # serial fallback sees the pool it would have seen
+                for _i, r, _w in rows:
+                    self.pool.trim(r.id, r.context_len)
+                return None
+            rows.append((i, req, w))
+        if not rows:
+            return 0, 0
+        with RecordEvent('serve::prepare', event_type='serve'):
+            tokens = np.zeros((B,), np.int32)
+            page_tables = np.zeros((B, self.max_pages_per_seq), np.int32)
+            seq_lens = np.ones((B,), np.int32)
+            ords = np.zeros((B,), np.int32)
+            rems = np.zeros((B,), np.int32)
+            eos_ids = np.full((B,), -1, np.int32)
+            live = np.zeros((B,), bool)
+            temps = np.zeros((B,), np.float32)
+            top_ks = np.zeros((B,), np.int32)
+            for i, req, w in rows:
+                tokens[i] = (req.generated[-1] if req.generated
+                             else req.prompt[-1])
+                page_tables[i, :] = self._page_row(req)
+                seq_lens[i] = req.context_len
+                ords[i] = _ord_of(req)
+                rems[i] = w
+                if req.eos_token_id is not None:
+                    eos_ids[i] = req.eos_token_id
+                live[i] = True
+                temps[i] = req.temperature
+                top_ks[i] = req.top_k
+                # decode roofline: iteration j of this row reads
+                # context_len + j KV tokens
+                self._it_kv_read_tokens += \
+                    w * req.context_len + w * (w - 1) // 2
+        sample = any(r.top_k > 0 for _, r, _ in rows)
+        fn = self._fused_fn(B, K, sample)
+        t0 = time.perf_counter()
+        with RecordEvent('serve::compiled_step', event_type='serve',
+                         shape='fused', batch=len(rows), k=K):
+            nxt, new_kv = fn(
+                self._params, self.pool.kv,
+                jnp.asarray(tokens), jnp.asarray(page_tables),
+                jnp.asarray(seq_lens), jnp.asarray(ords),
+                jnp.asarray(rems), jnp.asarray(eos_ids),
+                jnp.asarray(live), self._key,
+                jnp.asarray(temps), jnp.asarray(top_ks))
+        self.pool.kv = new_kv
+        t1 = time.perf_counter()
+        with RecordEvent('serve::sample_fetch', event_type='serve'):
+            nxt = _host_fetch(nxt)      # ONE fetch for the whole window
+        t2 = time.perf_counter()
+        self._it_compute += t1 - t0
+        self._it_decode_s += t1 - t0
+        self._it_fetch += t2 - t1
+        self._decode_time += t2 - t0
+        # host accept replays the serial append-then-check loop per
+        # row, so eos / max_new cuts truncate exactly where K serial
+        # iterations would have stopped (the device done-mask already
+        # idled the row past that point)
+        emitted_total = 0
+        per_iter_rows = [0] * K
+        accepted = {}
+        for i, req, w in rows:
+            a = 0
+            for j in range(K):
+                if req.done:
+                    break
+                req.generated.append(int(nxt[i, j]))
+                emitted_total += 1
+                per_iter_rows[j] += 1
+                a += 1
+            accepted[i] = a
+        iters_run = max(accepted.values())
+        util = self.pool.utilization()
+        for j in range(iters_run):
+            self._occupancy_sum += per_iter_rows[j] / B
+            self._util_sum += util
+        self._decode_steps += iters_run
+        self._decode_tokens += emitted_total
+        self._fused_windows += 1
+        self._fused_iterations += iters_run
+        self._fused_tokens += emitted_total
+        self.ledger.account_fused_window(K, iters_run, emitted_total)
+        for i, req, w in rows:
+            a = accepted[i]
+            # every emitted token reached its request: delivered work,
+            # nothing rejected (no draft columns in a fused window) —
+            # the ledger's delivered+wasted == emitted identity holds
+            # exactly as K serial account_decode(1, 0) calls would
+            self.ledger.account_decode(a, 0, tenant_id=req.tenant_id)
+            prev_high = getattr(req, '_computed_high', 0)
+            req._computed_high = max(prev_high, req.context_len - 1)
+            # hand back the reserved-but-unused window tail (early eos
+            # or budget cut) — the speculative-decode trim discipline
+            self.pool.trim(req.id, req.context_len)
+            self.pool.register_prefix(req.id, req.tokens,
+                                      req.context_len - 1,
+                                      owner=req.tenant_id)
+            self._trace(req, 'fused_decode', k=K, accepted=a,
+                        tokens_generated=len(req.generated),
+                        seq_len=req.context_len,
+                        pages=len(self.pool.page_table(req.id)))
+            if req.done:
+                self._retire(req)
+        self._fused_last = {'k': K, 'iters': iters_run,
+                            'rows': per_iter_rows[:iters_run]}
+        return len(rows), emitted_total
 
     def _page_row(self, req):
         row = self.pool.page_table(req.id)
@@ -1130,7 +1506,6 @@ class ServingEngine:
                             # re-queued, resumes when pressure clears
         chunk = toks[start:start + n] + [0] * (C - n)
         fn = self._step_fn(1, C, req.top_k > 0)
-        self._key, sub = self._jax.random.split(self._key)
         tc0 = time.perf_counter()
         with RecordEvent('serve::compiled_step', event_type='serve',
                          shape='prefill'):
@@ -1140,7 +1515,8 @@ class ServingEngine:
                 jnp.asarray([self._page_row(req)], jnp.int32),
                 jnp.asarray([start + n], jnp.int32),
                 jnp.asarray([n], jnp.int32),
-                sub,
+                self._key,
+                jnp.asarray([_ord_of(req)], jnp.int32),
                 jnp.asarray([req.temperature], jnp.float32),
                 jnp.asarray([req.top_k], jnp.int32))
         tc1 = time.perf_counter()
@@ -1241,6 +1617,18 @@ class ServingEngine:
                                         min(K, budget))
                 if drafts:
                     proposals[req.id] = drafts
+        # fused window (ISSUE 19): when no verify columns ride this
+        # dispatch (spec takes precedence — its drafts already amortize
+        # the host fetch) and the scheduler is quiescent for a full
+        # window, scan k decode iterations on device and fetch once.
+        # A failed page reservation falls through to the serial step
+        # below rather than preempting — the window is an optimization,
+        # never a capacity decision.
+        FK = self._effective_fused_k()
+        if FK > 1 and not proposals and self._fused_ok(FK):
+            res = self._fused_decode_window(FK)
+            if res is not None:
+                return res
         # capacity first (may preempt, or yield the request itself);
         # then snapshot the running set — a yielded request left its
         # slot, so the batch build below skips it naturally
@@ -1260,6 +1648,7 @@ class ServingEngine:
             page_tables = np.zeros((B, self.max_pages_per_seq), np.int32)
             seq_lens = np.ones((B,), np.int32)
             q_lens = np.zeros((B,), np.int32)
+            ords = np.zeros((B,), np.int32)
             temps = np.zeros((B,), np.float32)
             top_ks = np.zeros((B,), np.int32)
             active = []
@@ -1278,13 +1667,13 @@ class ServingEngine:
                 page_tables[i, :] = row
                 seq_lens[i] = req.context_len + len(drafts)
                 q_lens[i] = 1 + len(drafts)
+                ords[i] = _ord_of(req)
                 temps[i] = req.temperature
                 top_ks[i] = req.top_k
         if not active:
             return 0, 0
         sample = any(r.top_k > 0 for _, r, _ in active)
         fn = self._step_fn(B, T, sample, verify=verify)
-        self._key, sub = self._jax.random.split(self._key)
         t0 = time.perf_counter()
         with RecordEvent('serve::compiled_step', event_type='serve',
                          shape='verify' if verify else 'decode',
@@ -1292,7 +1681,8 @@ class ServingEngine:
             nxt, new_kv = fn(
                 self._params, self.pool.kv,
                 jnp.asarray(tokens), jnp.asarray(page_tables),
-                jnp.asarray(seq_lens), jnp.asarray(q_lens), sub,
+                jnp.asarray(seq_lens), jnp.asarray(q_lens), self._key,
+                jnp.asarray(ords),
                 jnp.asarray(temps), jnp.asarray(top_ks))
         self.pool.kv = new_kv
         t1 = time.perf_counter()
@@ -1527,6 +1917,11 @@ class ServingEngine:
             'spec_acceptance_rate':
                 (self._spec_accepted / self._spec_proposed
                  if self._spec_proposed else None),
+            # fused multi-token decode (ISSUE 19)
+            'fused_k': self.config.fused_k,
+            'fused_windows_total': self._fused_windows,
+            'fused_iterations_total': self._fused_iterations,
+            'fused_tokens_total': self._fused_tokens,
             # multi-tenant SLO layer (ISSUE 15): always present so the
             # snapshot shape is stable — zeros/empty when untenanted
             'quota_deferrals_total': self._quota_deferrals,
@@ -1585,6 +1980,9 @@ class ServingEngine:
         self._spec_proposed = 0
         self._spec_accepted = 0
         self._spec_steps = 0
+        self._fused_windows = 0
+        self._fused_iterations = 0
+        self._fused_tokens = 0
         self._ttfts_s = []
         self._new_ttfts_s = []
         for v in self._new_slo.values():
@@ -1612,6 +2010,7 @@ class ServingEngine:
                 v.clear()
         s['timeline'] = self.timeline.summary()
         self._last_publish = self._clock()
+        self._last_publish_wall = _monitor._time_fn()
         _metrics.publish(s)
         self.ledger.publish()
         self._gap.publish()
@@ -1681,12 +2080,31 @@ def _ngram_propose(tokens, ngram, k):
     return []
 
 
-def _device_sample(logits, key, temps, top_ks):
+def _ord_of(req):
+    """The request's sampling ordinal for the per-position key fold.
+    engine.submit assigns engine-local ordinals in submission order
+    (and adopted requests carry their submitter's); requests injected
+    past submit — scheduler-level tests driving engine internals —
+    fall back to the global request id, still a stable per-request
+    fold."""
+    o = getattr(req, 'sample_ord', None)
+    return int(o if o is not None else req.id)
+
+
+def _device_sample(logits, key, ords, positions, temps, top_ks):
     """On-device next-token choice, [B, V] fp32 logits -> [B] int32.
 
     Matches GPTForCausalLM._sample_next semantics: top_k <= 0 means
     GREEDY argmax (temperature ignored); top_k > 0 samples from the
-    temperature-scaled top-k renormalized distribution."""
+    temperature-scaled top-k renormalized distribution.
+
+    The per-row key is fold_in(fold_in(key, ords[b]), positions[b]) —
+    a pure function of (seed, request ordinal, absolute token
+    position), never of dispatch count or batch composition. That
+    invariance is what makes fused-k windows, serial decode, the spec
+    verify column and preempt/resume re-prefill all sample IDENTICAL
+    tokens (ISSUE 19); `positions` is the absolute index of the token
+    being sampled (== seq_lens in every step shape)."""
     import jax
     import jax.numpy as jnp
     V = logits.shape[-1]
@@ -1696,6 +2114,9 @@ def _device_sample(logits, key, temps, top_ks):
     srt = jnp.sort(scaled, axis=-1)             # ascending
     kth = jnp.take_along_axis(srt, (V - k)[:, None], axis=-1)
     masked = jnp.where(scaled < kth, -1e30, scaled)
-    sampled = jax.random.categorical(key, masked, axis=-1).astype(
+    keys = jax.vmap(
+        lambda o, p: jax.random.fold_in(jax.random.fold_in(key, o), p)
+    )(ords, positions)
+    sampled = jax.vmap(jax.random.categorical)(keys, masked).astype(
         jnp.int32)
     return jnp.where(top_ks > 0, sampled, greedy)

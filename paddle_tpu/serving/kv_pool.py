@@ -359,6 +359,34 @@ class KVPagePool:
                 self._take_page(seq_id)
         return self._seq_pages[seq_id]
 
+    def try_reserve(self, seq_id, n_tokens):
+        """Grow seq_id's page list to hold n_tokens, or change NOTHING
+        — the fused decode window's all-or-nothing reservation (ISSUE
+        19). Unlike ensure_capacity (partial growth kept because its
+        caller preempts and retries), a failed reservation rolls its
+        own fresh pages straight back: the engine falls back to the
+        [B, 1] step for this dispatch instead of preempting, so the
+        pool must come out untouched. Returns True when the pages are
+        held. Fresh pages are private and unindexed by construction,
+        so the rollback mirrors trim's bookkeeping."""
+        need = self.pages_for(n_tokens)
+        with self._lock:
+            grown = 0
+            try:
+                while len(self._seq_pages.get(seq_id, ())) < need:
+                    self._take_page(seq_id)
+                    grown += 1
+            except PoolExhausted:
+                pages = self._seq_pages.get(seq_id, [])
+                for _ in range(grown):
+                    page = pages.pop()
+                    del self._ref[page]
+                    del self._owners[page]
+                    self._free.append(page)
+                    self.free_total += 1
+                return False
+        return True
+
     def release(self, seq_id):
         """Drop seq_id's mapping of every page it holds, exactly once
         per page. A page whose refcount reaches zero becomes

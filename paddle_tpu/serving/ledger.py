@@ -160,6 +160,12 @@ class ServeLedger:
         self.delivered_tokens = 0
         self.wasted = {c: 0 for c in _WASTE_CAUSES}
         self.spec_shed_tokens = 0
+        # fused decode windows (ISSUE 19): dispatches, the device
+        # iterations they ran, and the tokens they delivered — the
+        # per-window host-fetch attribution's denominator
+        self.fused_windows = 0
+        self.fused_iterations = 0
+        self.fused_tokens = 0
         self._per_tenant = {}
         with _ledgers_lock:
             _ledgers[engine] = self
@@ -227,6 +233,17 @@ class ServeLedger:
             row['delivered_tokens'] += d
             row['wasted_tokens'] += rj
 
+    def account_fused_window(self, k, iterations, tokens):
+        """One fused decode window: configured window length `k`, the
+        `iterations` the scan actually advanced anyone (<= k when every
+        row went done early), and the tokens it delivered. The window's
+        single host fetch is already amortized across its iterations by
+        the engine's observe_iteration calls; these counters carry the
+        window shape itself (gauges + health_dump)."""
+        self.fused_windows += 1
+        self.fused_iterations += max(int(iterations), 0)
+        self.fused_tokens += max(int(tokens), 0)
+
     def account_spec_shed(self, tokens, tenant_id=None):
         """Draft capacity the degradation ladder shed this decode step
         (stage >= 1 with spec configured on): foregone tokens that were
@@ -283,6 +300,9 @@ class ServeLedger:
                 (max(total, overrun) / wall) if wall else 0.0,
             'host_bound_fraction': snap.get('host_bound_fraction'),
             'host_gap_seconds': snap.get('host_gap_seconds'),
+            'fused_windows': self.fused_windows,
+            'fused_iterations': self.fused_iterations,
+            'fused_tokens': self.fused_tokens,
         }
 
     def goodput(self):
@@ -374,6 +394,9 @@ class ServeLedger:
         self.delivered_tokens = 0
         self.wasted = {c: 0 for c in _WASTE_CAUSES}
         self.spec_shed_tokens = 0
+        self.fused_windows = 0
+        self.fused_iterations = 0
+        self.fused_tokens = 0
         self._per_tenant = {}
 
     def unregister(self):
@@ -415,6 +438,17 @@ class ServeLedger:
                              'token fetch (HostGapMonitor gating)',
                         labelnames=('engine',)).set(
                             acct['host_bound_fraction'], engine=e)
+                _m.gauge('ptpu_serve_ledger_fused_windows_total',
+                         help='fused decode: k-iteration windows '
+                              'dispatched (one host fetch each)',
+                         labelnames=('engine',)).set(
+                             acct['fused_windows'], engine=e)
+                _m.gauge('ptpu_serve_ledger_fused_iterations_total',
+                         help='fused decode: device iterations run '
+                              'inside fused windows (each the '
+                              'equivalent of one serial decode step)',
+                         labelnames=('engine',)).set(
+                             acct['fused_iterations'], engine=e)
             _m.gauge('ptpu_serve_goodput_emitted_tokens',
                      help='goodput: token positions the compiled steps '
                           'computed (lifetime)',
@@ -556,6 +590,14 @@ def render_serve_ledger(snap):
             v = comps.get(name) or 0.0
             pct = (v / wall * 100.0) if wall else 0.0
             out.append(f"  {name:<12} {v * 1e3:>10.3f} ms  {pct:5.1f}%")
+        fw = a.get('fused_windows') or 0
+        if fw:
+            fi = a.get('fused_iterations') or 0
+            out.append(
+                f"  fused decode: {fi} iterations in {fw} windows "
+                f"(mean k {fi / fw:.1f}), "
+                f"{a.get('fused_tokens') or 0} tokens, one host fetch "
+                f"per window")
     g = snap.get('goodput') or {}
     if g:
         frac = g.get('goodput_fraction')

@@ -71,6 +71,9 @@ _GAUGE_NAMES = (
     'ptpu_serve_deadline_misses',
     'ptpu_serve_degrade_stage',
     'ptpu_serve_degrade_pressure',
+    # fused multi-token decode (ISSUE 19): the configured window
+    # length (1 = per-token decode)
+    'ptpu_serve_fused_k',
 )
 
 # tenant-labeled SLO histograms: name -> (engine tenant-slo key,
@@ -96,6 +99,13 @@ _COUNTER_NAMES = (
     'ptpu_serve_prefix_hit_tokens_total',
     'ptpu_serve_spec_proposed_tokens_total',
     'ptpu_serve_spec_accepted_tokens_total',
+    # fused multi-token decode (ISSUE 19): windows dispatched (one
+    # host fetch each), device iterations inside them, tokens they
+    # delivered — decode_steps_total keeps counting ITERATIONS, so
+    # per-token dashboards stay comparable across fused/serial
+    'ptpu_serve_fused_windows_total',
+    'ptpu_serve_fused_iterations_total',
+    'ptpu_serve_fused_tokens_total',
 )
 
 # scalar gauges: name -> (help, value(stats, pool)). One declarative
@@ -160,6 +170,10 @@ _SCALAR_GAUGES = (
     ('ptpu_serve_deadline_misses',
      'requests finished past their own deadline (lifetime)',
      lambda s, p: s.get('deadline_misses_total', 0)),
+    ('ptpu_serve_fused_k',
+     'configured fused decode window length (decode iterations per '
+     'dispatch; 1 = per-token decode)',
+     lambda s, p: s.get('fused_k', 1)),
 )
 
 

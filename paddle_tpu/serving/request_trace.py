@@ -31,11 +31,13 @@ import os
 import threading
 import time
 
-SCHEMA = 'paddle_tpu.serve_trace/4'
+SCHEMA = 'paddle_tpu.serve_trace/5'
 # older files still load — load_trace accepts /1 (no route events),
-# /2 (no tenancy/degradation events), /3 (no goodput pricing) and /4
+# /2 (no tenancy/degradation events), /3 (no goodput pricing), /4
+# (no fused decode) and /5
 SCHEMAS = ('paddle_tpu.serve_trace/1', 'paddle_tpu.serve_trace/2',
-           'paddle_tpu.serve_trace/3', SCHEMA)
+           'paddle_tpu.serve_trace/3', 'paddle_tpu.serve_trace/4',
+           SCHEMA)
 
 # lifecycle event vocabulary (docs/serving.md#request-traces);
 # prefix_hit = cached pages mapped at prefill start (ISSUE 9),
@@ -55,8 +57,13 @@ SCHEMAS = ('paddle_tpu.serve_trace/1', 'paddle_tpu.serve_trace/2',
 # off its final column; spec_verify carries `discarded` for the
 # accepted-but-dropped burst tail. reconstruct() folds them (with
 # rejected spec drafts) into per-request delivered/wasted columns.
+# Schema v5 (ISSUE 19) adds fused_decode: one per request per fused
+# k-iteration window, carrying `k` (window length) and `accepted`
+# (tokens the request took before eos/budget idled it) — the fused
+# counterpart of `decode`, which stays per serial iteration.
 EVENTS = ('submit', 'route', 'admit', 'prefix_hit', 'prefill_chunk',
-          'first_token', 'decode', 'spec_verify', 'preempt', 'resume',
+          'first_token', 'decode', 'fused_decode', 'spec_verify',
+          'preempt', 'resume',
           'quota_defer', 'deadline_miss', 'degrade_stage',
           'retire', 'abort')
 
@@ -269,6 +276,10 @@ def reconstruct(events):
             # leave zeros and the derived columns degrade gracefully
             'prefill_tokens_computed': 0, 'recompute_tokens': 0,
             'spec_discarded': 0, 'prefill_samples': 0,
+            # schema v5 fused decode (ISSUE 19): windows this request
+            # rode and tokens it took from them — older traces leave
+            # zeros (no fused engine existed to emit them)
+            'fused_windows': 0, 'fused_tokens': 0,
         })
         ev, t = e['event'], e['t']
         if 'pages' in e:
@@ -316,6 +327,20 @@ def reconstruct(events):
             r['tokens_generated'] = max(r['tokens_generated'],
                                         e.get('tokens_generated',
                                               r['tokens_generated'] + 1))
+            r['last_token_t'] = t
+        elif ev == 'fused_decode':
+            # v5: one event per fused window; `accepted` tokens each
+            # stand in for one serial decode step, so the derived
+            # decode_steps/TPOT columns stay comparable across
+            # fused and serial traces
+            acc = int(e.get('accepted', 1))
+            r['decode_steps'] += acc
+            r['fused_windows'] += 1
+            r['fused_tokens'] += acc
+            r['tokens_generated'] = max(r['tokens_generated'],
+                                        e.get('tokens_generated',
+                                              r['tokens_generated']
+                                              + acc))
             r['last_token_t'] = t
         elif ev == 'quota_defer':
             r['quota_defers'] += 1

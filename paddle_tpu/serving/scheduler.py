@@ -243,6 +243,31 @@ class DegradeLadder:
         return (sum(self._ring) / len(self._ring)
                 if self._ring else 0.0)
 
+    def would_transition(self, pressure_signal, steps=1):
+        """Would holding `pressure_signal` for the next `steps`
+        observations move the stage? Pure simulation on COPIES of the
+        ring/calm/stage state — the engine's fused-window quiescence
+        guard (ISSUE 19): a k-iteration fused dispatch commits the
+        engine to k observations it cannot react to mid-window, so it
+        only engages when no stage transition is due within the
+        window."""
+        ring = collections.deque(self._ring, maxlen=self.window)
+        calm = self._calm
+        stage = self.stage
+        sig = min(max(float(pressure_signal), 0.0), 1.0)
+        for _ in range(int(steps)):
+            ring.append(sig)
+            p = sum(ring) / len(ring)
+            if stage < 3 and p >= self.up[stage]:
+                return True
+            elif stage > 0 and p < self.down[stage - 1]:
+                calm += 1
+                if calm >= self.hold:
+                    return True
+            else:
+                calm = 0
+        return False
+
     def observe(self, pool_utilization, waiting, slots):
         """Feed one iteration's raw signals; returns the transition
         dict when the stage changed this observation, else None."""
@@ -323,6 +348,12 @@ class Request:
         self.first_token_time = None
         self.finish_time = None
         self.preemptions = 0
+        # engine-local sampling ordinal (ISSUE 19): assigned once at
+        # engine.submit and folded with the absolute token position
+        # into the device sampling key, so a request's sampled tokens
+        # are a pure function of (seed, ordinal, position) — invariant
+        # across fused/serial decode, spec verify, and preempt/resume
+        self.sample_ord = None
 
     @property
     def tokens(self):
@@ -379,6 +410,25 @@ class Scheduler:
     @property
     def has_work(self):
         return bool(self.waiting or self.running())
+
+    def quiescent(self):
+        """True when a multi-iteration decode window can run with no
+        scheduling decision falling due mid-window (the fused-decode
+        eligibility gate, ISSUE 19): nothing waiting to admit, at
+        least one occupied slot, and every occupied slot a RUNNING
+        decoder. Retires inside the window need no host decision —
+        the fused done-mask idles finished rows on device and the
+        engine retires them at window end; with an empty queue the
+        held slot admits nobody late. Page growth (the only
+        preemption trigger) is pre-reserved per window by the engine,
+        and degrade-transition headroom is checked against the ladder
+        separately."""
+        if self.waiting:
+            return False
+        occupied = [r for r in self.slots if r is not None]
+        if not occupied:
+            return False
+        return all(r.state == RequestState.RUNNING for r in occupied)
 
     def admission_order(self):
         """The queue in admission order: priority classes high to low,
@@ -568,4 +618,9 @@ class SchedulerTimeline:
             'degrade_stage': rows[-1].get('degrade_stage', 0),
             'max_degrade_stage': max(r.get('degrade_stage', 0)
                                      for r in rows),
+            # fused decode (ISSUE 19): entries recorded for iterations
+            # that ran INSIDE a fused window — the engine records one
+            # entry per iteration, never per dispatch, so occupancy
+            # and token sums stay comparable across fused/serial
+            'fused_iterations': sum(1 for r in rows if r.get('fused')),
         }

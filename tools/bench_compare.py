@@ -57,6 +57,10 @@ METRIC_DIRECTION = {
     'host_bound_fraction': 'lower',
     'hbm_gbps': 'higher',
     'mbu': 'higher',
+    # fused decode windows (ISSUE 19): small-batch decode headline
+    'small_batch_decode_tokens_per_sec': 'higher',
+    'small_batch_host_bound_fraction': 'lower',
+    'fused_speedup_vs_per_token': 'higher',
 }
 DEFAULT_THRESHOLD = 0.02
 HEADLINE_LEG = 'gpt1.3b_adamw'

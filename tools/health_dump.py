@@ -595,6 +595,17 @@ def render_serve(s):
         out.append(
             f"  speculative decode: {acc}/{prop} draft tokens accepted "
             f"({100 * rate:.1f}% acceptance)")
+    # fused decode windows (ISSUE 19): k iterations per dispatch,
+    # one host fetch per window
+    fw = int(v('fused_windows_total'))
+    if fw:
+        fi = int(v('fused_iterations_total'))
+        out.append(
+            f"  fused decode: {fi} iterations in {fw} windows "
+            f"(mean k {fi / fw:.1f}, configured "
+            f"{int(v('fused_k')) or 1}), "
+            f"{int(v('fused_tokens_total'))} tokens — "
+            f"one host fetch per window")
     # SLO percentile section (bucket-interpolated p50/p90/p99 from the
     # ptpu_serve_* histograms — docs/serving.md#slo-metrics)
     slo_rows = []
@@ -732,6 +743,25 @@ def _serve_selftest():
         assert any(e.get('cat') == 'serve_request'
                    for e in doc['traceEvents']), 'no request tracks'
     eng.shutdown()
+
+    # -- fused decode windows (ISSUE 19): the window counters reach
+    # the gauges and the renderer draws the fused-window line
+    eng2 = ServingEngine(model, ServingConfig(page_size=8,
+                                              max_batch_size=4,
+                                              prefill_chunk=8,
+                                              fused_k=4))
+    outs2 = eng2.generate(prompts, max_new_tokens=6, top_k=0)
+    assert all(len(o) == len(p) + 6 for o, p in zip(outs2, prompts))
+    st2 = eng2.stats()
+    assert st2['fused_windows_total'] > 0, st2
+    snap2 = StepTelemetry(publish=False).snapshot()
+    serve2 = _find_serve({'telemetry': {'serve': snap2['serve']}})
+    assert serve2['ptpu_serve_fused_windows_total'] \
+        == st2['fused_windows_total'], serve2
+    assert serve2['ptpu_serve_fused_k'] == 4, serve2
+    text2 = render_serve(serve2)
+    assert 'fused decode:' in text2 and 'one host fetch' in text2, text2
+    eng2.shutdown()
 
     # -- stalled-request watchdog: deterministic clock, a request aged
     # past the deadline produces a serve_report that classifies/renders

@@ -74,6 +74,14 @@
 #                      cluster drain, trace-v4 delivered/wasted parity,
 #                      HBM roofline table, zero-extra-host-sync budget,
 #                      then the serve + bench-compare CLI smokes
+#   --fused-selftest - fused decode windows (ISSUE 19): k-iteration
+#                      scan dispatch token-identity vs serial (greedy
+#                      + sampled, eos-mid-window, page boundaries,
+#                      preempt/resume, budget cuts), quiescence-gate
+#                      units, one-fetch-per-window sync budget,
+#                      per-iteration timeline/ledger attribution,
+#                      wall-clock publish cadence, trace-v5 roundtrip,
+#                      mp2 sharded identity, then the serve CLI smoke
 #   --alerts-selftest - telemetry time axis (ISSUE 18): history-ring
 #                      sampling/wraparound + derived views on injected
 #                      clocks, alert state machine fire -> sustain ->
@@ -93,6 +101,7 @@ case "$TIER" in
             tests/test_fused_primitives.py tests/test_overlap.py \
             tests/test_serving.py tests/test_serving_trace.py \
             tests/test_serving_cluster.py tests/test_serving_tenants.py \
+            tests/test_serving_fused.py \
             tests/test_remat.py \
             tests/test_async_step.py tests/test_pipeline_schedule.py \
             tests/test_ledger.py tests/test_monitor.py \
@@ -230,6 +239,14 @@ case "$TIER" in
             tests/test_metrics_docs.py -q
           python tools/health_dump.py serve --selftest
           python tools/bench_compare.py --selftest ;;
+  --fused-selftest)
+          # fused decode windows end to end (ISSUE 19): token-identity
+          # vs serial across every truncation edge, quiescence gate,
+          # sync-budget and per-iteration observability, then the
+          # serve-gauge CLI smoke (renders the fused-window line)
+          python -m pytest tests/test_serving_fused.py \
+            tests/test_metrics_docs.py -q
+          python tools/health_dump.py serve --selftest ;;
   --alerts-selftest)
           # the telemetry time axis end to end (ISSUE 18): history-
           # ring + derived-view units, alert state-machine legs on
@@ -255,5 +272,5 @@ case "$TIER" in
           python tools/health_dump.py ledger --selftest
           python tools/health_dump.py alerts --selftest
           python tools/bench_compare.py --selftest ;;
-  *) echo "usage: $0 [fast|dist|native|e2e|all|--comm-selftest|--serve-selftest|--quant-selftest|--pallas-selftest|--overlap-selftest|--cluster-selftest|--remat-selftest|--async-selftest|--pp-selftest|--tenant-selftest|--ledger-selftest|--serve-ledger-selftest|--alerts-selftest]"; exit 1 ;;
+  *) echo "usage: $0 [fast|dist|native|e2e|all|--comm-selftest|--serve-selftest|--quant-selftest|--pallas-selftest|--overlap-selftest|--cluster-selftest|--remat-selftest|--async-selftest|--pp-selftest|--tenant-selftest|--ledger-selftest|--serve-ledger-selftest|--alerts-selftest|--fused-selftest]"; exit 1 ;;
 esac
