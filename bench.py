@@ -847,6 +847,109 @@ def bench_gpt_serve():
         'outputs_identical':
             sb_outs[1] == sb_outs[4] == sb_outs[8],
     }
+
+    # -- tiered KV cache (ISSUE 20): the SAME mixed stream through a
+    # device pool sized BELOW its concurrent contexts, with the host
+    # tier absorbing the overflow. The bars: token identity with a
+    # sized-to-fit run (spill/resurrect must be invisible in the
+    # tokens), sustained throughput + SLO percentiles under
+    # oversubscription, and resurrect-from-host TTFT strictly beating
+    # recompute-from-scratch on a long cold prompt.
+    fit_pages = batch * pages_per_seq          # sized-to-fit capacity
+    over_pages = max(pages_per_seq + 1, int(fit_pages * 0.5))
+
+    def _run_tiered(num_pages, host_pages):
+        e = ServingEngine(model, ServingConfig(
+            page_size=page_size, max_batch_size=batch,
+            prefill_chunk=chunk, max_pages_per_seq=pages_per_seq,
+            num_pages=num_pages, host_tier_pages=host_pages,
+            spill_watermark=0.7))
+        e.generate([prompts[0]], max_new_tokens=2, top_k=0)
+        e.reset_stats()
+        t0 = time.time()
+        o = e.generate(prompts, max_new_tokens=max_new, top_k=0)
+        dt = time.time() - t0
+        stt = e.stats()
+        pst = stt['pool']
+        tab = e.request_table()
+        pct = {
+            label: {f'p{q}': (round(v * 1000.0, 3)
+                              if (v := percentile_of(
+                                  [r[key] for r in tab.values()], q))
+                              is not None else None)
+                    for q in (50, 90, 99)}
+            for key, label in (('ttft_s', 'ttft_ms'),
+                               ('e2e_s', 'e2e_ms'))}
+        toks = sum(len(x) - len(p) for x, p in zip(o, prompts))
+        rec = {
+            'device_pages': num_pages,
+            'host_pages': host_pages,
+            'tokens_per_sec': toks / dt,
+            'decode_tokens_per_sec': stt['decode_tokens_per_sec'],
+            'preemptions': stt['preemptions_total'],
+            'slo': pct,
+            'spilled_pages': pst.get('tier_spilled_pages_total', 0),
+            'spilled_bytes': pst.get('tier_spilled_bytes_total', 0),
+            'fetched_pages': pst.get('tier_fetched_pages_total', 0),
+            'fetched_bytes': pst.get('tier_fetched_bytes_total', 0),
+            'resurrected_pages':
+                pst.get('tier_resurrected_pages_total', 0),
+        }
+        e.shutdown()
+        return rec, o
+
+    fit_rec, fit_outs = _run_tiered(fit_pages, 0)
+    over_rec, over_outs = _run_tiered(over_pages, fit_pages * 2)
+
+    # resurrect-vs-recompute TTFT: one long prompt whose prefix pages
+    # sit on the host tier vs the same prompt with a cold cache —
+    # best-of-3 each, the fetch must beat re-running the prefill.
+    # 16 pages of prompt (14 on the CPU CI shape — max_seq_len caps
+    # it): long enough that prefill compute dominates the
+    # (near-constant) fetch dispatch overhead
+    long_pages = 16 if on_tpu else 14
+    long_prompt = list(rng.randint(
+        1, cfg.vocab_size, long_pages * page_size + 1))
+    e = ServingEngine(model, ServingConfig(
+        page_size=page_size, max_batch_size=2, prefill_chunk=chunk,
+        max_pages_per_seq=long_pages + 4,
+        host_tier_pages=2 * long_pages + 4))
+    e.generate([long_prompt], max_new_tokens=2, top_k=0)  # warm shapes
+    recompute_ttft, resurrect_ttft = [], []
+    for _ in range(3):
+        e.pool.reset()                        # cold: nothing cached
+        e.reset_stats()
+        e.generate([long_prompt], max_new_tokens=2, top_k=0)
+        (r,) = e.request_table().values()
+        recompute_ttft.append(r['ttft_s'])
+        # prefix now registered: push it to the host tier, measure
+        # the resurrect path
+        spilled = e.pool.spill_lru(sync=True)
+        assert spilled >= long_pages, spilled
+        e.reset_stats()
+        outs_r = e.generate([long_prompt], max_new_tokens=2, top_k=0)
+        (r,) = e.request_table().values()
+        resurrect_ttft.append(r['ttft_s'])
+    resurrect_identical = outs_r[0][:len(long_prompt) + 2] \
+        == e.generate([long_prompt], max_new_tokens=2,
+                      top_k=0)[0][:len(long_prompt) + 2]
+    e.shutdown()
+    oversubscribed = {
+        'requests': n_req,
+        'oversubscription':
+            round(fit_pages / float(over_pages), 3),
+        'outputs_identical': over_outs == fit_outs,
+        'sized_to_fit': fit_rec,
+        'tiered': over_rec,
+        'recompute_ttft_ms':
+            round(min(recompute_ttft) * 1000.0, 3),
+        'resurrect_ttft_ms':
+            round(min(resurrect_ttft) * 1000.0, 3),
+        'resurrect_ttft_speedup':
+            (min(recompute_ttft) / min(resurrect_ttft)
+             if min(resurrect_ttft) else None),
+        'resurrect_outputs_identical': resurrect_identical,
+    }
     return {
         'serve_tokens_per_sec': serve_tokens / serve_dt,
         'sequential_tokens_per_sec': seq_tps,
@@ -884,6 +987,13 @@ def bench_gpt_serve():
             (sb_recs[8]['decode_tokens_per_sec']
              / sb_recs[1]['decode_tokens_per_sec']
              if sb_recs[1]['decode_tokens_per_sec'] else None),
+        # tiered KV cache (ISSUE 20): the oversubscribed record plus
+        # flat headline keys bench_compare tracks across rounds
+        'oversubscribed': oversubscribed,
+        'oversubscribed_decode_tokens_per_sec':
+            over_rec['decode_tokens_per_sec'],
+        'resurrect_ttft_speedup':
+            oversubscribed['resurrect_ttft_speedup'],
         # serving ledger & roofline (ISSUE 17): the wall decomposition
         # (components reconcile to wall_seconds, residue surfaced),
         # the delivered/wasted goodput account, and the decode
@@ -1634,6 +1744,37 @@ def _check_legs(result):
         assert isinstance(sleg.get('fused_speedup_vs_per_token'),
                           (int, float)), \
             'serve leg lacks fused_speedup_vs_per_token'
+        # tiered KV cache (ISSUE 20): the oversubscribed record — a
+        # device pool below its concurrent contexts with the host tier
+        # underneath, token-identical to the sized-to-fit run, with
+        # real spill traffic and resurrect TTFT beating recompute
+        ov = sleg.get('oversubscribed')
+        assert isinstance(ov, dict), 'serve leg lacks oversubscribed'
+        assert ov.get('outputs_identical') is True, \
+            'oversubscribed outputs differ from sized-to-fit'
+        assert ov.get('resurrect_outputs_identical') is True, \
+            'resurrected stream outputs differ'
+        assert ov.get('oversubscription', 0) > 1.0, \
+            'oversubscribed leg did not oversubscribe the pool'
+        tr = ov.get('tiered')
+        assert isinstance(tr, dict), 'oversubscribed lacks tiered rec'
+        for key in ('device_pages', 'host_pages', 'tokens_per_sec',
+                    'decode_tokens_per_sec', 'slo', 'spilled_pages',
+                    'spilled_bytes', 'fetched_pages', 'fetched_bytes',
+                    'resurrected_pages'):
+            assert key in tr, f'oversubscribed.tiered lacks {key}'
+        assert tr['spilled_pages'] > 0, \
+            'oversubscribed leg never spilled to the host tier'
+        assert isinstance(ov.get('resurrect_ttft_ms'), (int, float)) \
+            and isinstance(ov.get('recompute_ttft_ms'), (int, float)), \
+            'oversubscribed lacks the TTFT pair'
+        assert ov['resurrect_ttft_ms'] < ov['recompute_ttft_ms'], \
+            'resurrect-from-host TTFT did not beat recompute ' \
+            f"({ov['resurrect_ttft_ms']}ms vs " \
+            f"{ov['recompute_ttft_ms']}ms)"
+        assert isinstance(
+            sleg.get('oversubscribed_decode_tokens_per_sec'),
+            (int, float)), 'serve leg lacks flat oversubscribed tok/s'
     # the telemetry time axis (ISSUE 18): the headline and serve legs
     # carry the downsampled history-ring block + the alert summary, and
     # a clean leg must not have fired a critical rule — an alert there

@@ -267,6 +267,23 @@ class _EngineHost:
                     pass
         return snaps
 
+    def prefetch(self, prompt):
+        """Advisory host-tier warm (ISSUE 20): the router's
+        prefix-affinity hint arrives BEFORE the request and resurrects
+        host-resident prefix pages into parked device pages, so the
+        submit that follows prefix-hits device pages with the transfer
+        off its critical path. Purely advisory — a tierless engine (or
+        one whose pages were never spilled) warms nothing, and the
+        warm itself never evicts or preempts. Serialized with the step
+        loop by self._lock like every other pool mutation."""
+        pool = getattr(self.engine, 'pool', None)
+        if pool is None or getattr(pool, 'host_tier', None) is None:
+            return {'warmed_pages': 0}
+        with self._lock:
+            prompt = [int(t) for t in prompt]
+            n = pool.warm_prefix(prompt, limit=len(prompt) - 1)
+        return {'warmed_pages': int(n)}
+
     def abort(self, rid):
         req = self._reqs.get(str(rid))
         if req is None:
@@ -360,6 +377,9 @@ class ReplicaWorker(_EngineHost):
             return {'inflight': self.drain()}
         if op == 'abort':
             return {'ok': self.abort(msg.get('rid'))}
+        if op == 'prefetch':
+            # advisory host-tier warm (ISSUE 20) — never an error
+            return self.prefetch(msg.get('prompt') or [])
         if op == 'export_trace':
             return {'path': self.export_trace(msg['path'])['jsonl']}
         if op == 'inject_hang':
@@ -617,6 +637,11 @@ class RemoteReplica:
 
     def abort(self, rid):
         return self.client.call({'op': 'abort', 'rid': rid})['ok']
+
+    def prefetch(self, prompt):
+        return self.client.call({'op': 'prefetch',
+                                 'prompt': [int(t) for t in prompt]},
+                                timeout=30.0)
 
     def export_trace(self, jsonl_path):
         return self.client.call({'op': 'export_trace',

@@ -99,6 +99,9 @@ _COUNTERS = {
               'replicas drained (hung or operator-requested)'),
     'resubmit': ('ptpu_route_resubmits_total',
                  'in-flight requests moved to a peer by a drain'),
+    'prefetch_hint': ('ptpu_route_prefetch_hints_total',
+                      'advisory host-tier prefetch hints sent ahead '
+                      'of affinity placements (ISSUE 20)'),
 }
 
 
@@ -179,6 +182,9 @@ class ClusterRouter:
         self.drain_events = []
         self.decisions = {k: 0 for k in _COUNTERS if k != 'reject'}
         self.rejects = 0
+        # host-tier pages replicas reported warmed by advisory
+        # prefetch hints (ISSUE 20) — cluster-side resurrect signal
+        self.prefetch_warmed_pages = 0
         # per-tenant spill accounting (ISSUE 15): affinity placements
         # a tenant lost to backpressure — a heavy tenant saturating
         # its affinity replica shows up here, not in global spills
@@ -369,6 +375,22 @@ class ClusterRouter:
         replica = self._replicas[rid]
         prompt = req.prompt + req.tokens        # resubmit = resurrect
         req._dispatch_base = len(req.tokens)
+        if decision == 'affinity':
+            # advisory host-tier prefetch hint (ISSUE 20): the replica
+            # holds this prefix in its radix index — some of it may
+            # have spilled to host RAM, so warm it back onto device
+            # BEFORE the request lands. Best-effort by construction: a
+            # tierless replica warms 0 pages, a channel hiccup must
+            # not fail the placement (the submit path is authoritative
+            # and resurrects on its own if the hint was lost).
+            try:
+                reply = replica.prefetch(prompt)
+                self._count('prefetch_hint')
+                if (reply or {}).get('warmed_pages'):
+                    self.prefetch_warmed_pages += int(
+                        reply['warmed_pages'])
+            except Exception:               # noqa: BLE001
+                pass
         opts = dict(req.opts)
         opts['max_new_tokens'] = req.budget_left
         remote = replica.submit(prompt, opts, route_meta={
@@ -792,6 +814,7 @@ class ClusterRouter:
             'replicas': per_replica,
             'placements': dict(self.decisions),
             'rejects': self.rejects,
+            'prefetch_warmed_pages': self.prefetch_warmed_pages,
             'affinity_hit_rate':
                 (self.decisions.get('affinity', 0) / total
                  if total else None),

@@ -189,7 +189,9 @@ class ServingConfig:
                  disaggregate=False, prefill_slots=2,
                  stream_chunk_pages=0, tenants=None, degrade=None,
                  degrade_window=8, degrade_up=(0.85, 0.92, 0.97),
-                 degrade_down=(0.60, 0.70, 0.80), degrade_hold=4):
+                 degrade_down=(0.60, 0.70, 0.80), degrade_hold=4,
+                 host_tier_pages=0, spill_watermark=0.92,
+                 spill_chunk_pages=0, spill_window=2):
         if page_size <= 0 or max_batch_size <= 0 or prefill_chunk <= 0:
             raise ValueError("page_size, max_batch_size and "
                              "prefill_chunk must be positive")
@@ -242,6 +244,18 @@ class ServingConfig:
         self.degrade_up = tuple(degrade_up)
         self.degrade_down = tuple(degrade_down)
         self.degrade_hold = int(degrade_hold)
+        # host-RAM KV tier (ISSUE 20): 0 host pages = no tier — the
+        # engine then keeps PR-19's compiled shapes, host-sync count
+        # and gauge set exactly (asserted in test_serving_kvtier.py)
+        if int(host_tier_pages) < 0:
+            raise ValueError("host_tier_pages must be >= 0 (0 = no "
+                             "host tier)")
+        if not (0.0 < float(spill_watermark) <= 1.0):
+            raise ValueError("spill_watermark must be in (0, 1]")
+        self.host_tier_pages = int(host_tier_pages)
+        self.spill_watermark = float(spill_watermark)
+        self.spill_chunk_pages = int(spill_chunk_pages)
+        self.spill_window = int(spill_window)
 
     @property
     def degrade_enabled(self):
@@ -323,6 +337,21 @@ class ServingEngine:
             from jax.sharding import NamedSharding, PartitionSpec as P
             self._kv_sharding = NamedSharding(mesh, P(None, None, 'mp'))
         self.pool.materialize(sharding=self._kv_sharding)
+        # host-RAM KV tier (ISSUE 20): pinned host buffers + one
+        # background transfer thread under the pool. Spills are
+        # proactive (watermark in _observe_spill_pressure) or the
+        # pool's own synchronous exhaustion fallback; resurrection
+        # happens inside match_and_map on the prefill path. Disabled
+        # (the default) the attribute stays None and every tier hook
+        # below is a single falsy check.
+        self._host_tier = None
+        self._tier_spilled_seen = 0
+        if config.host_tier_pages > 0:
+            from .host_tier import HostTier
+            self._host_tier = self.pool.attach_host_tier(HostTier(
+                config.host_tier_pages,
+                chunk_pages=config.spill_chunk_pages,
+                window=config.spill_window))
         self._clock = config.clock or time.perf_counter
         self.scheduler = Scheduler(config.max_batch_size,
                                    clock=self._clock)
@@ -595,7 +624,8 @@ class ServingEngine:
         if self._ladder is not None:
             p = DegradeLadder.pressure_of(
                 self.pool.utilization(), len(self.scheduler.waiting),
-                self.config.max_batch_size)
+                self.config.max_batch_size,
+                spill=self._spill_pressure())
             if self._ladder.would_transition(p, k):
                 return False
         return True
@@ -788,6 +818,24 @@ class ServingEngine:
                 prefill_tokens=self._it_prefill_tokens if first else 0,
                 prefill_seconds=self._it_prefill_s if first else 0.0,
                 prefill_ctx_tokens=self._it_prefill_ctx if first else 0)
+        # host-tier close-out (ISSUE 20): transfer wall accumulated by
+        # spill/fetch since the last step folds into the ledger's
+        # page_stream component (the disagg-handoff attribution point),
+        # and newly spilled pages since the last step emit one engine-
+        # scope `spill` trace event. One falsy check when tierless; no
+        # host sync either way (the tier counts on the transfer thread).
+        if self._host_tier is not None:
+            tier_wall = self._host_tier.take_wall()
+            if tier_wall > 0.0:
+                self.ledger.note_page_stream(tier_wall)
+            spilled = self._host_tier.spilled_pages
+            if spilled > self._tier_spilled_seen:
+                if self.tracer is not None:
+                    self.tracer.record(
+                        ENGINE_REQ, 'spill',
+                        pages=spilled - self._tier_spilled_seen,
+                        host_used_pages=self._host_tier.used_slots)
+                self._tier_spilled_seen = spilled
         # gap-monitor span close: dispatch_end BEFORE note_gating —
         # dispatch_end zeroes the pending gating attribution, and the
         # fetch wait belongs to the span that just closed (it is
@@ -814,11 +862,13 @@ class ServingEngine:
         eviction lever armed/disarmed on the pool. Stage 1 (spec shed)
         and 2 (prefill shrink) act through _effective_spec_k /
         _effective_prefill_chunk at their use sites."""
+        self._observe_spill_pressure()
         if self._ladder is None:
             return
         ev = self._ladder.observe(self.pool.utilization(),
                                   len(self.scheduler.waiting),
-                                  self.config.max_batch_size)
+                                  self.config.max_batch_size,
+                                  spill=self._spill_pressure())
         if ev is None:
             return
         _metrics.publish_degrade_stage(self._ladder.stage,
@@ -836,6 +886,29 @@ class ServingEngine:
         elif ev['from'] >= 3 > ev['to']:
             for pool in (self.pool, *self._stage3_pools):
                 pool.set_eviction_weights(None)
+
+    def _spill_pressure(self):
+        """Host-tier occupancy in [0, 1] — the ladder's spill input
+        (ISSUE 20): while the tier has room, spilling absorbs pool
+        pressure and the ladder need not escalate to stage-3 weighted
+        eviction; a saturating tier pushes pressure back up so the
+        eviction lever arms only once the second tier is spent. 0.0
+        without a tier — the ladder then sees exactly PR-19's signal."""
+        t = self._host_tier
+        return t.used_slots / t.host_pages if t is not None else 0.0
+
+    def _observe_spill_pressure(self):
+        """The proactive spiller: pool utilization past the spill
+        watermark kicks an ASYNC spill of LRU-parked cached subtrees
+        (bounded by the transfer window) so the free list restocks off
+        the critical path — allocation's synchronous spill fallback is
+        for when this didn't keep up. A falsy check without a tier."""
+        if self._host_tier is None:
+            return
+        if self.pool.utilization() >= self.config.spill_watermark \
+                and self.pool.cached_pages > 0:
+            self.pool.spill_lru(
+                max_pages=max(self.pool.num_pages // 8, 1))
 
     def _admit(self):
         """Admit waiting requests one at a time against a free-page
@@ -903,7 +976,13 @@ class ServingEngine:
                 if victim is None:
                     break       # order is priority-sorted: nobody
                                 # later outranks the running set either
-            cached, live, _ = self.pool.peek_prefix(
+            # host-resurrect pages (ISSUE 20) bill the page budget one
+            # allocatable page each, same as device-resurrect — but
+            # their cost is a host→device TRANSFER, not prefill
+            # compute: the cached span still skips the prefill chunks,
+            # and the fetch wall lands in the ledger's page_stream
+            # component instead of compute
+            cached, live, _resv, _host = self.pool.peek_prefix(
                 req.tokens, limit=len(req.tokens) - 1)
             need = max(self.pool.pages_for(
                 min(len(req.tokens),
@@ -1499,6 +1578,15 @@ class ServingEngine:
                 req.prefilled = cached
                 self._trace(req, 'prefix_hit', cached_tokens=cached,
                             pages=len(self.pool.page_table(req.id)))
+                # host-tier resurrection rode the hit (ISSUE 20): the
+                # pages came back by prefetch, not re-prefill — the
+                # trace event is what reconstruct() prices as
+                # resurrected (transfer-cost) tokens
+                rz = (self.pool.pop_resurrect_stats()
+                      if self._host_tier is not None else None)
+                if rz:
+                    self._trace(req, 'resurrect', pages=rz['pages'],
+                                tokens=rz['tokens'])
         start = req.prefilled
         n = min(C, len(toks) - start)
         if not self._ensure_or_preempt(req, start + n):
@@ -2046,6 +2134,8 @@ class ServingEngine:
         stops reporting (the PR-13 training-engine discipline —
         serve_ledger_snapshot() and the host-gap registry read live
         objects, not stale gauges)."""
+        if self._host_tier is not None:
+            self._host_tier.shutdown()
         self.pool.drop_arrays()
         self._step_fns.clear()
         self._params = {}

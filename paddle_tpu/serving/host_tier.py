@@ -1,0 +1,298 @@
+"""Host-RAM tier under the paged KV pool (ISSUE 20).
+
+A pinned host buffer pool that holds KV pages spilled out of the
+device pool: one preallocated numpy buffer per layer buffer, shaped
+like the device pool's but with `host_pages` rows, so a spilled page
+lands in the host row its slot id names and a fetch scatters it back
+into whichever device page the pool hands out. Int8 pools need no
+special casing — each layer's buffer TUPLE is mirrored element-wise,
+so the fp32 scale siblings travel with their int8 pages bit-identically
+(the `page_stream` contract: rows move as stored, nothing re-quantizes).
+
+Transfer discipline is PR-13's background ring, adapted to spills:
+
+  * device→host SPILL stages a gather (`kv[l][b][pages]` — a fresh
+    device array, so live device pages are never aliased) on the
+    caller's thread, then hands the staged arrays to one background
+    transfer thread that blocks on `device_get` and copies rows into
+    the host buffers;
+  * the in-flight window is bounded (`window` jobs): a producer that
+    outruns the drain blocks on the semaphore instead of queueing
+    unbounded staging footprint;
+  * the spilled DEVICE pages stay pinned (outside the pool's free and
+    cached sets — `try_reserve` and `_take_page` cannot see them)
+    until the job lands and its completion callback returns them;
+  * host→device FETCH (resurrect/warm) runs synchronously on the
+    caller's thread — callers mutate `pool.kv`, which only the engine
+    thread (or a replica host holding the engine lock) may do — and
+    waits out any still-in-flight spill of the requested slots first.
+
+Transfers are chunked through `core.bucketing._chunk_spans` exactly
+like `cluster/page_stream.py`, which also makes the mp-sharded case
+fall out: gather/scatter on the page axis of a `P(None, None, 'mp')`
+sharded pool moves each rank's local-heads shard, so per-rank shards
+spill and fetch through the same chunked path.
+"""
+import queue
+import threading
+import time
+
+import numpy as np
+
+from ..core import monitor as _m
+from ..core.bucketing import _chunk_spans
+
+
+def _count_transfer(kind, pages, nbytes):
+    if kind == 'spilled':
+        _m.counter('ptpu_serve_tier_spilled_pages_total',
+                   help='KV pages spilled device->host tier '
+                        '(lifetime)').inc(pages)
+        _m.counter('ptpu_serve_tier_spilled_bytes_total',
+                   help='bytes spilled device->host tier, scale '
+                        'buffers included (lifetime)').inc(nbytes)
+    else:
+        _m.counter('ptpu_serve_tier_fetched_pages_total',
+                   help='KV pages fetched host->device tier '
+                        '(lifetime)').inc(pages)
+        _m.counter('ptpu_serve_tier_fetched_bytes_total',
+                   help='bytes fetched host->device tier, scale '
+                        'buffers included (lifetime)').inc(nbytes)
+
+
+class HostTier:
+    """Slot allocator + pinned host buffers + the transfer thread.
+
+    `host_pages` is the tier's capacity in pages; buffers allocate
+    lazily on first spill (mirroring the pool's materialized layer
+    shapes), so a tier-enabled engine that never spills costs no host
+    RAM and dispatches nothing — the no-spill path stays inert."""
+
+    def __init__(self, host_pages, chunk_pages=0, window=2):
+        if host_pages <= 0:
+            raise ValueError("host_pages must be positive")
+        self.host_pages = int(host_pages)
+        self.chunk_pages = int(chunk_pages)
+        self.window = max(int(window), 1)
+        self._free = list(range(self.host_pages - 1, -1, -1))
+        self._buffers = None            # [layer][buf] np arrays
+        self._landed = {}               # slot -> Event (in-flight spill)
+        self._jobs = queue.Queue()
+        self._slots_sem = threading.Semaphore(self.window)
+        self._thread = None
+        self._lock = threading.Lock()
+        self.spilled_pages = 0
+        self.spilled_bytes = 0
+        self.fetched_pages = 0
+        self.fetched_bytes = 0
+        self.spill_jobs = 0
+        self._wall_s = 0.0              # un-drained transfer wall —
+                                        # the engine folds it into the
+                                        # ledger's page_stream component
+
+    # -- slots ---------------------------------------------------------------
+    @property
+    def used_slots(self):
+        return self.host_pages - len(self._free)
+
+    @property
+    def free_slots(self):
+        return len(self._free)
+
+    def alloc_slots(self, n):
+        """Take n host slots, or None when the tier lacks room (the
+        pool then evicts its LRU host subtree or falls back to plain
+        device-side eviction)."""
+        with self._lock:
+            if len(self._free) < n:
+                return None
+            return [self._free.pop() for _ in range(n)]
+
+    def free_slot(self, slot):
+        with self._lock:
+            self._landed.pop(slot, None)
+            self._free.append(slot)
+
+    # -- buffers -------------------------------------------------------------
+    def _ensure_buffers(self, kv):
+        if self._buffers is not None:
+            return
+        bufs = []
+        for layer in kv:
+            bufs.append([np.zeros((self.host_pages,) + tuple(b.shape[1:]),
+                                  dtype=np.dtype(b.dtype))
+                         for b in layer])
+        self._buffers = bufs
+
+    @staticmethod
+    def _page_bytes(buf):
+        return int(buf.nbytes) // buf.shape[0]
+
+    # -- spill (device -> host, background) ----------------------------------
+    def _stage(self, kv, device_pages):
+        """Gather the page rows into fresh device arrays (one per
+        layer buffer, chunk boundaries preserved) — the never-alias
+        staging copy. Dispatch is async; the transfer thread's
+        device_get is what blocks on it."""
+        import jax.numpy as jnp
+        n = len(device_pages)
+        spans = _chunk_spans(n, 1, self.chunk_pages) or [(0, n)]
+        idx = jnp.asarray(list(device_pages), jnp.int32)
+        staged = []
+        for layer in kv:
+            staged.append([[b[idx[st:st + w]] for (st, w) in spans]
+                           for b in layer])
+        return staged, spans
+
+    def _land(self, staged, spans, slots):
+        import jax
+        t0 = time.perf_counter()
+        nbytes = 0
+        for li, layer in enumerate(staged):
+            for bi, chunks in enumerate(layer):
+                host = self._buffers[li][bi]
+                for (st, w), chunk in zip(spans, chunks):
+                    rows = jax.device_get(chunk)
+                    for j in range(w):
+                        host[slots[st + j]] = rows[j]
+                nbytes += len(slots) * self._page_bytes(host)
+        dt = time.perf_counter() - t0
+        with self._lock:
+            self.spilled_pages += len(slots)
+            self.spilled_bytes += nbytes
+            self.spill_jobs += 1
+            self._wall_s += dt
+            for s in slots:
+                ev = self._landed.get(s)
+                if ev is not None:
+                    ev.set()
+        _count_transfer('spilled', len(slots), nbytes)
+
+    def _worker(self):
+        while True:
+            job = self._jobs.get()
+            if job is None:
+                return
+            staged, spans, slots, on_landed = job
+            try:
+                self._land(staged, spans, slots)
+            finally:
+                # release the window slot BEFORE the callback: the
+                # producer may be blocked on the semaphore while
+                # holding the pool lock (submit_spill runs under it),
+                # and on_landed needs that same lock — callback-first
+                # would deadlock the pair
+                self._slots_sem.release()
+                if on_landed is not None:
+                    on_landed()
+
+    def submit_spill(self, kv, device_pages, slots, on_landed=None):
+        """Queue an async spill of `device_pages` into host `slots`.
+        Blocks only when `window` jobs are already in flight (the
+        bounded ring). `on_landed` runs on the transfer thread after
+        the rows are host-resident — the pool uses it to unpin the
+        device pages."""
+        self._ensure_buffers(kv)
+        with self._lock:
+            for s in slots:
+                self._landed[s] = threading.Event()
+        self._slots_sem.acquire()
+        staged, spans = self._stage(kv, device_pages)
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._worker, name='kvtier-spill', daemon=True)
+            self._thread.start()
+        self._jobs.put((staged, spans, slots, on_landed))
+
+    def spill_sync(self, kv, device_pages, slots):
+        """Inline spill — the exhaustion fallback when `_take_page`
+        needs a free page NOW and the proactive spiller hasn't kept
+        up. Same staging + landing path, no thread hop."""
+        self._ensure_buffers(kv)
+        with self._lock:
+            for s in slots:
+                self._landed[s] = threading.Event()
+        staged, spans = self._stage(kv, device_pages)
+        self._land(staged, spans, slots)
+
+    def wait_landed(self, slots):
+        """Block until every slot's in-flight spill (if any) has
+        landed — fetch correctness when a resurrect races a spill."""
+        for s in list(slots):
+            with self._lock:
+                ev = self._landed.get(s)
+            if ev is not None:
+                ev.wait()
+
+    # -- fetch (host -> device, synchronous) ---------------------------------
+    def fetch(self, kv, slots, device_pages):
+        """Scatter host rows `slots[i]` into device pages
+        `device_pages[i]` of every layer buffer; returns the NEW kv
+        list (functional, like page_stream). Waits out in-flight
+        spills of the requested slots first."""
+        import jax.numpy as jnp
+        self.wait_landed(slots)
+        n = len(slots)
+        spans = _chunk_spans(n, 1, self.chunk_pages) or [(0, n)]
+        dst_idx = jnp.asarray(list(device_pages), jnp.int32)
+        t0 = time.perf_counter()
+        out = []
+        nbytes = 0
+        for li, layer in enumerate(kv):
+            bufs = []
+            for bi, d in enumerate(layer):
+                host = self._buffers[li][bi]
+                for (st, w) in spans:
+                    rows = np.stack([host[slots[st + j]]
+                                     for j in range(w)])
+                    d = d.at[dst_idx[st:st + w]].set(
+                        jnp.asarray(rows))
+                nbytes += n * self._page_bytes(host)
+                bufs.append(d)
+            out.append(tuple(bufs))
+        dt = time.perf_counter() - t0
+        with self._lock:
+            self.fetched_pages += n
+            self.fetched_bytes += nbytes
+            self._wall_s += dt
+        _count_transfer('fetched', n, nbytes)
+        return out
+
+    # -- accounting ----------------------------------------------------------
+    def take_wall(self):
+        """Pop the accumulated transfer wall (spill + fetch seconds)
+        — the engine attributes it to the serve ledger's page_stream
+        component once per step."""
+        with self._lock:
+            w, self._wall_s = self._wall_s, 0.0
+        return w
+
+    def drain(self):
+        """Block until every queued spill job has landed (tests,
+        shutdown). The per-slot landed events already give completion,
+        so drain just waits out the pending ones."""
+        with self._lock:
+            pending = [ev for ev in self._landed.values()
+                       if not ev.is_set()]
+        for ev in pending:
+            ev.wait()
+
+    def stats(self):
+        with self._lock:
+            return {
+                'tier_host_pages': self.host_pages,
+                'tier_host_used_pages': self.used_slots,
+                'tier_spilled_pages_total': self.spilled_pages,
+                'tier_spilled_bytes_total': self.spilled_bytes,
+                'tier_fetched_pages_total': self.fetched_pages,
+                'tier_fetched_bytes_total': self.fetched_bytes,
+                'tier_spill_jobs_total': self.spill_jobs,
+            }
+
+    def shutdown(self):
+        self.drain()
+        if self._thread is not None:
+            self._jobs.put(None)
+            self._thread.join(timeout=5)
+            self._thread = None
+        self._buffers = None

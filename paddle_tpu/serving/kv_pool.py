@@ -41,6 +41,19 @@ stale chain), but a later matching prompt — including a preempted
 request resuming — resurrects them for free. Int8 pools share scale
 buffers automatically: scales are addressed by the same page id.
 
+Host-RAM tier (ISSUE 20, `attach_host_tier`): under pool pressure,
+cached (ref-0 parked) subtrees SPILL to a pinned host buffer pool
+(`host_tier.HostTier`) instead of evicting — each spilled page's index
+entry re-keys onto a negative HOST marker (`marker = -2 - host_slot`;
+device pages are >= 0 and the chain root sentinel is -1, so markers
+never collide), its children re-parent onto the marker, and the device
+page unpins into the free list when the background transfer lands.
+A matching prompt — or a preempted request resuming — walks the same
+radix chain, finds the markers, and RESURRECTS the pages by host→device
+prefetch instead of re-prefilling them; spill-in-flight device pages
+sit in `_spilling`, outside free AND cached, so `try_reserve` and
+`_take_page` see them as unavailable until landed.
+
 The allocator is deliberately host-side and dumb-simple: serving
 decisions (admit / grow / preempt) happen between jitted steps, where
 Python cost is amortized over a whole batch step. Invariants it
@@ -150,6 +163,15 @@ class KVPagePool:
                                             # this several times a
                                             # second per replica
         self._lock = threading.Lock()
+        # host-RAM tier (ISSUE 20): markers (<= -2) live in _index /
+        # _page_key / _children like device pages; _spilling pins
+        # device pages whose spill is in flight (outside free AND
+        # cached — no allocation path can hand them out)
+        self.host_tier = None
+        self._spilling = set()
+        self.host_resurrect_pages = 0
+        self.host_resurrect_tokens = 0
+        self._pending_resurrect = None      # engine pops for trace/ledger
         self.alloc_total = 0
         self.free_total = 0
         self.high_water = 0
@@ -157,6 +179,13 @@ class KVPagePool:
         self.prefix_misses = 0
         self.prefix_hit_tokens = 0
         self.prefix_evictions = 0
+
+    def attach_host_tier(self, tier):
+        """Install the host-RAM tier (host_tier.HostTier). Must happen
+        before any spill; the pool never constructs one itself so pure
+        allocator tests stay tier-free."""
+        self.host_tier = tier
+        return tier
 
     # -- device arrays -------------------------------------------------------
     @property
@@ -292,7 +321,13 @@ class KVPagePool:
             if parent != -1 and parent in self._children:
                 self._children[parent].discard(p)
             self._page_tenant.pop(p, None)
-            if p in self._cached:
+            if p <= -2:
+                # host-tier node: the index entry IS the page — drop it
+                # and hand the host slot back (device side owes nothing)
+                if self.host_tier is not None:
+                    self.host_tier.free_slot(-2 - p)
+                self.prefix_evictions += 1
+            elif p in self._cached:
                 del self._cached[p]
                 self._free.append(p)
                 self.prefix_evictions += 1
@@ -318,11 +353,210 @@ class KVPagePool:
         return min(self._cached,
                    key=lambda p: w.get(self._page_tenant.get(p), 1.0))
 
+    # -- host-RAM tier (ISSUE 20) --------------------------------------------
+    def _rekey_node(self, old, new):
+        """Move a radix node's identity from ref `old` to ref `new`:
+        its index entry, its children's keys (they chain through the
+        parent REF), the parent's child set, and the tenant tag. The
+        spill (page -> marker) and resurrect (marker -> page)
+        directions are the same bookkeeping."""
+        key = self._page_key.pop(old)
+        del self._index[key]
+        self._index[key] = new
+        self._page_key[new] = key
+        parent = key[0]
+        if parent != -1 and parent in self._children:
+            self._children[parent].discard(old)
+            self._children[parent].add(new)
+        kids = self._children.pop(old, None)
+        if kids:
+            self._children[new] = kids
+            for c in list(kids):
+                ckey = self._page_key.pop(c)
+                nkey = (new, ckey[1])
+                del self._index[ckey]
+                self._index[nkey] = c
+                self._page_key[c] = nkey
+        tn = self._page_tenant.pop(old, None)
+        if tn is not None:
+            self._page_tenant[new] = tn
+        self._digest_cache = None
+
+    def _spill_landed_locked(self, pages):
+        for p in pages:
+            if p in self._spilling:
+                self._spilling.discard(p)
+                self._free.append(p)
+
+    def _spill_landed(self, pages):
+        with self._lock:
+            self._spill_landed_locked(pages)
+
+    def _spill_prepare(self, root):
+        """Re-key `root`'s cached (ref-0) subtree pages onto HOST
+        markers and pin them in `_spilling` — the index mutation half
+        of a spill, lock held by caller. A matching prompt still
+        chain-walks to the markers; live descendants (mapped by a
+        sequence) stay device-resident — only their chain link
+        re-parents. Slot allocation is all-or-nothing per subtree (a
+        half-spilled subtree would split its chain); a full tier
+        prepares nothing and the caller falls back to plain eviction.
+        Returns (device_pages, host_slots) or None. The TRANSFER is
+        the caller's job: synchronous inline, or queued to the
+        background thread OUTSIDE the pool lock (the bounded window
+        semaphore must never be waited on while holding the lock the
+        landed-callback needs)."""
+        tier = self.host_tier
+        if tier is None or self.kv is None:
+            return None
+        cached = []
+        stack = [root]
+        while stack:                # parents visit before children, so
+            p = stack.pop()         # a child re-keys under its parent's
+            stack.extend(self._children.get(p, ()))     # marker
+            if p in self._cached:
+                cached.append(p)
+        if not cached:
+            return None
+        slots = tier.alloc_slots(len(cached))
+        if slots is None:
+            return None
+        for p, slot in zip(cached, slots):
+            self._rekey_node(p, -2 - slot)
+            del self._cached[p]
+            self._spilling.add(p)
+        return cached, slots
+
+    def _spill_subtree(self, root, sync=True):
+        """Synchronous spill of `root`'s cached subtree (the
+        `_take_page` exhaustion path — the page is needed NOW). Lock
+        held by caller. Returns the device pages spilled."""
+        assert sync, "async spills go through spill_lru"
+        prep = self._spill_prepare(root)
+        if prep is None:
+            return []
+        pages, slots = prep
+        self.host_tier.spill_sync(self.kv, pages, slots)
+        self._spill_landed_locked(pages)
+        return pages
+
+    def spill_lru(self, max_pages=None, sync=False):
+        """Spill LRU-parked cached subtrees (preempted requests'
+        released pages land there too) until `max_pages` device pages
+        are spilling (None = the whole parked set). The engine's
+        proactive spiller calls this when utilization crosses the
+        spill watermark, keeping the free list stocked so allocation
+        never has to spill synchronously. Returns pages spilled.
+
+        Async jobs are submitted AFTER the lock is released: the
+        tier's bounded in-flight window can block the producer, and
+        the landed callback that unblocks it needs this lock — queueing
+        under the lock would deadlock the pair. The pinned pages'
+        contents are immutable until landed and `self.kv` only swaps
+        on the engine thread (the thread running this), so staging
+        outside the lock reads exactly the rows that were pinned."""
+        if self.host_tier is None:
+            return 0
+        n = 0
+        jobs = []
+        with self._lock:
+            while self._cached and (max_pages is None or n < max_pages):
+                prep = self._spill_prepare(self._pick_eviction_root())
+                if prep is None:
+                    break
+                pages, slots = prep
+                if sync:
+                    self.host_tier.spill_sync(self.kv, pages, slots)
+                    self._spill_landed_locked(pages)
+                else:
+                    jobs.append((pages, slots))
+                n += len(pages)
+        for pages, slots in jobs:
+            self.host_tier.submit_spill(
+                self.kv, pages, slots,
+                on_landed=lambda pages=list(pages):
+                    self._spill_landed(pages))
+        return n
+
+    def host_resident_pages(self):
+        """Pages currently host-resident (markers in the index)."""
+        with self._lock:
+            return sum(1 for p in self._page_key if p <= -2)
+
+    def pop_resurrect_stats(self):
+        """Pop the pending resurrect accounting (pages/tokens fetched
+        since the last pop) — the engine turns it into a `resurrect`
+        trace event and ledger page_stream attribution."""
+        with self._lock:
+            r, self._pending_resurrect = self._pending_resurrect, None
+        return r
+
+    def _resurrect_locked(self, markers, seq_id=None):
+        """Fetch host-resident `markers` back into device pages: parked
+        (cached, ref-0) pages when seq_id is None (the router's warm
+        hint), mapped into seq_id's table otherwise. Lock held by
+        caller; allocation uses only the free list on the warm path
+        (a hint never evicts). Returns the device pages, aligned with
+        `markers` (shorter when the pool ran out mid-chain)."""
+        tier = self.host_tier
+        devs, slots = [], []
+        for m in markers:
+            if m not in self._page_key:
+                break               # destroyed under us by an eviction
+            if seq_id is not None:
+                try:
+                    page = self._take_page(seq_id)
+                except PoolExhausted:
+                    break
+            else:
+                if not self._free:
+                    break
+                page = self._free.pop()
+            devs.append(page)
+            slots.append(-2 - m)
+            self._rekey_node(m, page)
+            if seq_id is None:
+                self._cached[page] = None       # parked, LRU newest
+        if devs:
+            self.kv = tier.fetch(self.kv, slots, devs)
+            for s in slots:
+                tier.free_slot(s)
+            self.host_resurrect_pages += len(devs)
+            self.host_resurrect_tokens += len(devs) * self.page_size
+            pend = self._pending_resurrect or {'pages': 0, 'tokens': 0}
+            pend['pages'] += len(devs)
+            pend['tokens'] += len(devs) * self.page_size
+            self._pending_resurrect = pend
+        return devs
+
+    def warm_prefix(self, tokens, limit=None):
+        """Advisory host→device prefetch (the router's prefix-affinity
+        hint): resurrect the host-resident pages of the longest
+        indexed chain for `tokens` into PARKED (cached, ref-0) device
+        pages, so the request that follows prefix-hits device pages
+        with zero transfer on its own critical path. Uses only truly
+        free pages — a hint never evicts or preempts — and stops at
+        the first unavailable page. Returns pages warmed."""
+        if self.host_tier is None or not self.prefix_cache:
+            return 0
+        with self._lock:
+            refs = self._match_pages(tokens, limit)
+            markers = [m for m in refs if m <= -2]
+            return len(self._resurrect_locked(markers, seq_id=None))
+
     def _take_page(self, seq_id):
         if not self._free and self._cached:
-            # evict the least-recently-used cached prefix subtree
-            # (weight-ordered when eviction weights are installed)
-            self._evict_subtree(self._pick_eviction_root())
+            # host tier first (ISSUE 20): spill the LRU cached subtree
+            # synchronously — the page is needed NOW and the proactive
+            # spiller didn't keep up — so its prefix survives as
+            # host-resident markers instead of evaporating
+            if self.host_tier is not None and self.kv is not None:
+                self._spill_subtree(self._pick_eviction_root(),
+                                    sync=True)
+            if not self._free and self._cached:
+                # evict the least-recently-used cached prefix subtree
+                # (weight-ordered when eviction weights are installed)
+                self._evict_subtree(self._pick_eviction_root())
         if not self._free:
             raise PoolExhausted(
                 f"KV pool exhausted: {self.num_pages} pages of "
@@ -442,6 +676,12 @@ class KVPagePool:
 
     def reset(self):
         with self._lock:
+            if self.host_tier is not None:
+                for p in self._page_key:
+                    if p <= -2:
+                        self.host_tier.free_slot(-2 - p)
+            self._spilling.clear()
+            self._pending_resurrect = None
             self._free = list(range(self.num_pages - 1, -1, -1))
             self._ref.clear()
             self._owners.clear()
@@ -473,16 +713,21 @@ class KVPagePool:
 
     def peek_prefix(self, tokens, limit=None):
         """Non-mutating admission probe: (cached_tokens, live_pages,
-        resurrect_pages). Live pages are mapped by a sibling and cost
-        the page budget nothing; resurrect pages sit in the cached set
-        and cost one allocatable page each (they just skip the prefill
-        compute)."""
+        resurrect_pages, host_pages). Live pages are mapped by a
+        sibling and cost the page budget nothing; resurrect pages sit
+        in the device cached set and cost one allocatable page each
+        (they just skip the prefill compute); host pages (ISSUE 20)
+        also cost one allocatable page each PLUS a host→device
+        transfer — the engine budgets them as transfer cost, not
+        compute."""
         if not self.prefix_cache:
-            return 0, 0, 0
+            return 0, 0, 0, 0
         with self._lock:
             pages = self._match_pages(tokens, limit)
             live = sum(1 for p in pages if self._ref.get(p, 0) > 0)
-        return len(pages) * self.page_size, live, len(pages) - live
+            host = sum(1 for p in pages if p <= -2)
+        return (len(pages) * self.page_size, live,
+                len(pages) - live - host, host)
 
     def match_and_map(self, seq_id, tokens, limit=None):
         """Map the longest indexed prefix of `tokens` (full blocks,
@@ -500,16 +745,66 @@ class KVPagePool:
                 # FRONT of the table, so just prefill privately
                 return 0
             pages = self._match_pages(tokens, limit)
-            if not pages:
+            if self.host_tier is not None and any(p <= -2
+                                                 for p in pages):
+                mapped = self._match_and_map_tiered(seq_id, tokens,
+                                                    limit)
+            else:
+                for page in pages:
+                    self._map_existing(page, seq_id)
+                mapped = len(pages)
+            if not mapped:
                 self.prefix_misses += 1
                 return 0
-            for page in pages:
-                self._map_existing(page, seq_id)
-            cached = len(pages) * self.page_size
+            cached = mapped * self.page_size
             self.prefix_hits += 1
             self.prefix_hit_tokens += cached
             self._registered_upto[seq_id] = cached
         return cached
+
+    def _match_and_map_tiered(self, seq_id, tokens, limit=None):
+        """match_and_map's slow path when the matched chain crosses
+        host-resident markers: walk the index LIVE block by block
+        (resurrection re-keys nodes and allocation pressure may evict
+        or spill under us, so a pre-computed match would go stale),
+        mapping device pages and fetching each contiguous marker run
+        back in one chunked transfer. Lock held by caller. Returns
+        full blocks mapped."""
+        ps = self.page_size
+        n = len(tokens) if limit is None else min(len(tokens),
+                                                  max(int(limit), 0))
+        blocks = n // ps
+
+        def _block(j):
+            return tuple(tokens[j * ps:(j + 1) * ps])
+
+        parent, mapped, i = -1, 0, 0
+        while i < blocks:
+            ref = self._index.get((parent, _block(i)))
+            if ref is None:
+                break
+            if ref <= -2:
+                run, cur, j = [ref], ref, i + 1
+                while j < blocks:
+                    nxt = self._index.get((cur, _block(j)))
+                    if nxt is None or nxt > -2:
+                        break
+                    run.append(nxt)
+                    cur = nxt
+                    j += 1
+                devs = self._resurrect_locked(run, seq_id)
+                mapped += len(devs)
+                i += len(devs)
+                if len(devs) < len(run):
+                    return mapped       # pool ran out mid-chain: the
+                                        # prefix covered so far stands
+                parent = devs[-1] if devs else parent
+            else:
+                self._map_existing(ref, seq_id)
+                mapped += 1
+                i += 1
+                parent = ref
+        return mapped
 
     def register_prefix(self, seq_id, tokens, written, owner=None):
         """Index seq_id's newly completed full pages (first `written`
@@ -594,7 +889,7 @@ class KVPagePool:
                     for seq, pages in self._seq_pages.items()}
 
     def stats(self):
-        return {
+        s = {
             'num_pages': self.num_pages,
             'page_size': self.page_size,
             'kv_dtype': ('int8' if self.quantized
@@ -618,3 +913,10 @@ class KVPagePool:
             'prefix_evictions_total': self.prefix_evictions,
             'weighted_eviction': self._evict_weights is not None,
         }
+        if self.host_tier is not None:
+            s.update(self.host_tier.stats())
+            s['tier_resurrected_pages_total'] = self.host_resurrect_pages
+            s['tier_resurrected_tokens_total'] = \
+                self.host_resurrect_tokens
+            s['tier_spill_inflight_pages'] = len(self._spilling)
+        return s

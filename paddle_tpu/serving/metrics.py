@@ -74,6 +74,63 @@ _GAUGE_NAMES = (
     # fused multi-token decode (ISSUE 19): the configured window
     # length (1 = per-token decode)
     'ptpu_serve_fused_k',
+    # host-RAM KV tier (ISSUE 20): occupancy gauges — published ONLY
+    # when the engine has a host tier (pool stats carry tier_* keys),
+    # so tierless configs keep exactly the PR-19 gauge set (asserted
+    # in tests/test_serving_kvtier.py)
+    'ptpu_serve_tier_host_pages',
+    'ptpu_serve_tier_host_used_pages',
+    'ptpu_serve_tier_resident_pages',
+    'ptpu_serve_tier_spill_inflight_pages',
+)
+
+# host-RAM tier gauges: name -> (help, value(pool stats)). Conditional
+# on the pool actually carrying tier stats — see _GAUGE_NAMES note.
+_TIER_GAUGES = (
+    ('ptpu_serve_tier_host_pages',
+     'host-tier capacity in KV pages',
+     lambda p: p.get('tier_host_pages', 0)),
+    ('ptpu_serve_tier_host_used_pages',
+     'host-tier slots holding spilled pages right now',
+     lambda p: p.get('tier_host_used_pages', 0)),
+    ('ptpu_serve_tier_resident_pages',
+     'device-resident KV pages (mapped + parked) — the HBM side of '
+     'the tier split',
+     lambda p: (p.get('pages_in_use', 0) + p.get('cached_pages', 0))),
+    ('ptpu_serve_tier_spill_inflight_pages',
+     'device pages pinned by an in-flight spill (unavailable to '
+     'allocation until the transfer lands)',
+     lambda p: p.get('tier_spill_inflight_pages', 0)),
+)
+
+# host-RAM tier counters-as-gauges (engine-owned lifetime totals,
+# mirrored like _COUNTER_NAMES; conditional like _TIER_GAUGES)
+_TIER_COUNTERS = (
+    ('ptpu_serve_tier_resurrected_pages_total',
+     'host-resident pages resurrected by prefetch instead of '
+     're-prefill (lifetime)', 'tier_resurrected_pages_total'),
+    ('ptpu_serve_tier_resurrected_tokens_total',
+     'prompt tokens whose KV came back from the host tier instead of '
+     'recompute (lifetime)', 'tier_resurrected_tokens_total'),
+)
+
+# transfer totals: REAL monitor counters incremented by host_tier.py
+# at transfer time (never re-published as gauges — the registry would
+# conflict); scalar_series mirrors them from pool stats so per-replica
+# cluster snapshots carry them without touching the shared registry
+_TIER_TRANSFER_COUNTERS = (
+    ('ptpu_serve_tier_spilled_pages_total',
+     'KV pages spilled device->host tier (lifetime)',
+     'tier_spilled_pages_total'),
+    ('ptpu_serve_tier_spilled_bytes_total',
+     'bytes spilled device->host tier (lifetime)',
+     'tier_spilled_bytes_total'),
+    ('ptpu_serve_tier_fetched_pages_total',
+     'KV pages fetched host->device (lifetime)',
+     'tier_fetched_pages_total'),
+    ('ptpu_serve_tier_fetched_bytes_total',
+     'bytes fetched host->device (lifetime)',
+     'tier_fetched_bytes_total'),
 )
 
 # tenant-labeled SLO histograms: name -> (engine tenant-slo key,
@@ -188,6 +245,13 @@ def scalar_series(stats):
     for name in _COUNTER_NAMES:
         key = name[len('ptpu_serve_'):-len('_total')]
         out[name] = stats.get(key + '_total', 0)
+    if 'tier_host_pages' in pool:       # host tier attached (ISSUE 20)
+        for name, _h, fn in _TIER_GAUGES:
+            out[name] = fn(pool)
+        for name, _h, key in _TIER_COUNTERS:
+            out[name] = pool.get(key, 0)
+        for name, _h, key in _TIER_TRANSFER_COUNTERS:
+            out[name] = pool.get(key, 0)
     out['ptpu_serve_degrade_stage'] = stats.get('degrade_stage', 0)
     tenancy = stats.get('tenancy')
     out['ptpu_serve_degrade_pressure'] = \
@@ -235,6 +299,15 @@ def publish(stats):
         key = name[len('ptpu_serve_'):-len('_total')]
         g(name, help=f'serving {key.replace("_", " ")} (lifetime)').set(
             stats.get(key + '_total', 0))
+    # host-RAM tier (ISSUE 20): published only when the pool carries
+    # tier stats, so tierless engines keep exactly the PR-19 gauge
+    # set. Transfer totals are real counters host_tier.py owns — not
+    # re-published here.
+    if 'tier_host_pages' in pool:
+        for name, help_, fn in _TIER_GAUGES:
+            g(name, help=help_).set(fn(pool))
+        for name, help_, key in _TIER_COUNTERS:
+            g(name, help=help_).set(pool.get(key, 0))
     h = _m.histogram('ptpu_serve_ttft_seconds',
                      help='per-request time to first token',
                      buckets=TTFT_BUCKETS)
@@ -299,7 +372,9 @@ def serve_snapshot():
     scheduler-timeline summary from the engine's last publish."""
     reg = _m.metrics()
     out = {}
-    for name in _GAUGE_NAMES + _COUNTER_NAMES:
+    for name in (_GAUGE_NAMES + _COUNTER_NAMES
+                 + tuple(n for n, _h, _k in _TIER_COUNTERS)
+                 + tuple(n for n, _h, _k in _TIER_TRANSFER_COUNTERS)):
         m = reg.get(name)
         if m is None:
             continue

@@ -31,12 +31,13 @@ import os
 import threading
 import time
 
-SCHEMA = 'paddle_tpu.serve_trace/5'
+SCHEMA = 'paddle_tpu.serve_trace/6'
 # older files still load — load_trace accepts /1 (no route events),
 # /2 (no tenancy/degradation events), /3 (no goodput pricing), /4
-# (no fused decode) and /5
+# (no fused decode), /5 (no host tier) and /6
 SCHEMAS = ('paddle_tpu.serve_trace/1', 'paddle_tpu.serve_trace/2',
            'paddle_tpu.serve_trace/3', 'paddle_tpu.serve_trace/4',
+           'paddle_tpu.serve_trace/5',
            SCHEMA)
 
 # lifecycle event vocabulary (docs/serving.md#request-traces);
@@ -61,10 +62,18 @@ SCHEMAS = ('paddle_tpu.serve_trace/1', 'paddle_tpu.serve_trace/2',
 # k-iteration window, carrying `k` (window length) and `accepted`
 # (tokens the request took before eos/budget idled it) — the fused
 # counterpart of `decode`, which stays per serial iteration.
+# Schema v6 (ISSUE 20) adds the host-tier pair: `spill` — recorded
+# under ENGINE_REQ like degrade_stage, one per engine step that moved
+# pages device->host, carrying `pages` and `host_used_pages` — and
+# `resurrect`, a per-request event at prefill start when a prefix
+# match landed host-tier pages back on device instead of re-running
+# prefill, carrying `pages` and `tokens` (the re-prefill compute the
+# fetch replaced).
 EVENTS = ('submit', 'route', 'admit', 'prefix_hit', 'prefill_chunk',
           'first_token', 'decode', 'fused_decode', 'spec_verify',
           'preempt', 'resume',
           'quota_defer', 'deadline_miss', 'degrade_stage',
+          'spill', 'resurrect',
           'retire', 'abort')
 
 # engine-scope events (ladder transitions) journal under this pseudo
@@ -280,9 +289,16 @@ def reconstruct(events):
             # rode and tokens it took from them — older traces leave
             # zeros (no fused engine existed to emit them)
             'fused_windows': 0, 'fused_tokens': 0,
+            # schema v6 host tier (ISSUE 20): prefix pages this
+            # request resurrected from host RAM instead of
+            # re-prefilling, and the prompt tokens those pages carried
+            # — older traces leave zeros (no tier existed)
+            'resurrected_pages': 0, 'resurrected_tokens': 0,
         })
         ev, t = e['event'], e['t']
-        if 'pages' in e:
+        # `pages` on a resurrect event counts pages FETCHED, not the
+        # request's page-table size — keep it out of the high-water
+        if 'pages' in e and e['event'] != 'resurrect':
             r['pages_high_water'] = max(r['pages_high_water'],
                                         int(e['pages']))
         if ev == 'submit':
@@ -342,6 +358,11 @@ def reconstruct(events):
                                               r['tokens_generated']
                                               + acc))
             r['last_token_t'] = t
+        elif ev == 'resurrect':
+            # v6: prefix pages fetched back from the host tier at
+            # prefill start — compute the resurrect replaced
+            r['resurrected_pages'] += int(e.get('pages', 0))
+            r['resurrected_tokens'] += int(e.get('tokens', 0))
         elif ev == 'quota_defer':
             r['quota_defers'] += 1
         elif ev == 'deadline_miss':

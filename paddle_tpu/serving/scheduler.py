@@ -234,9 +234,16 @@ class DegradeLadder:
         self.transitions = 0
 
     @staticmethod
-    def pressure_of(pool_utilization, waiting, slots):
+    def pressure_of(pool_utilization, waiting, slots, spill=0.0):
+        """`spill` (ISSUE 20) is the host-tier occupancy fraction:
+        while the tier absorbs pool pressure by spilling, the pool-
+        utilization signal alone under-reports how close the system is
+        to REAL capacity — a saturating second tier must push the
+        ladder toward stage-3 weighted eviction before allocation
+        starts dropping prefixes outright. 0.0 (tierless) reproduces
+        the PR-15 signal exactly."""
         q = min(float(waiting) / max(2.0 * slots, 1.0), 1.0)
-        return min(max(float(pool_utilization), q), 1.0)
+        return min(max(float(pool_utilization), q, float(spill)), 1.0)
 
     def pressure(self):
         """Windowed mean of the observed pressure (0.0 when empty)."""
@@ -268,11 +275,11 @@ class DegradeLadder:
                 calm = 0
         return False
 
-    def observe(self, pool_utilization, waiting, slots):
+    def observe(self, pool_utilization, waiting, slots, spill=0.0):
         """Feed one iteration's raw signals; returns the transition
         dict when the stage changed this observation, else None."""
         self._ring.append(self.pressure_of(pool_utilization, waiting,
-                                           slots))
+                                           slots, spill))
         p = self.pressure()
         prev = self.stage
         if self.stage < 3 and p >= self.up[self.stage]:

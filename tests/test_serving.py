@@ -133,15 +133,15 @@ class TestPrefixCacheAllocator:
         pool.register_prefix('a', toks, written=8)
         # limit (engine passes len-1 so one token stays to compute):
         # 7 tokens -> only the first full block matches
-        assert pool.peek_prefix(toks, limit=7) == (4, 1, 0)
+        assert pool.peek_prefix(toks, limit=7) == (4, 1, 0, 0)
         assert pool.match_and_map('b', toks, limit=7) == 4
         # partial block never matches: 6 tokens -> 1 block
-        assert pool.peek_prefix(toks[:6]) == (4, 1, 0)
+        assert pool.peek_prefix(toks[:6]) == (4, 1, 0, 0)
         # disabled pool: no matching, no counting
         off = KVPagePool(num_pages=4, page_size=4)
         off.ensure_capacity('x', 4)
         off.register_prefix('x', [1, 2, 3, 4], written=4)
-        assert off.peek_prefix([1, 2, 3, 4]) == (0, 0, 0)
+        assert off.peek_prefix([1, 2, 3, 4]) == (0, 0, 0, 0)
         assert off.match_and_map('y', [1, 2, 3, 4]) == 0
         assert off.prefix_misses == 0
 

@@ -343,7 +343,7 @@ class TestTraceSchemaV2:
         p = str(tmp_path / 't.jsonl')
         tr.export_jsonl(p)
         header, events = load_trace(p)
-        assert header['schema'] == SCHEMA == 'paddle_tpu.serve_trace/5'
+        assert header['schema'] == SCHEMA == 'paddle_tpu.serve_trace/6'
         r = reconstruct(events)[3]
         assert r['replica_id'] == 'r1'
         assert r['router_decision'] == 'affinity'

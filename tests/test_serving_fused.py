@@ -236,7 +236,7 @@ class TestFusedObservability:
         assert st['fused_windows_total'] > 0
         paths = eng.export_trace(jsonl_path=str(tmp_path / 'f.jsonl'))
         header, events = load_trace(paths['jsonl'])
-        assert header['schema'] == 'paddle_tpu.serve_trace/5'
+        assert header['schema'] == 'paddle_tpu.serve_trace/6'
         fde = [e for e in events if e['event'] == 'fused_decode']
         assert fde and all('k' in e and 'accepted' in e for e in fde)
         assert sum(e['accepted'] for e in fde) \

@@ -1,0 +1,386 @@
+"""Tiered KV cache (ISSUE 20): host-RAM spill/prefetch under the
+paged pool. The bars: allocator invariants hold ACROSS tiers (every
+page released exactly once, COW refcounts and int8 scale siblings
+survive a spill+resurrect round trip bit-identically, LRU subtrees
+spill oldest-first), preempt->spill->resume streams stay
+token-identical, the fused-decode `try_reserve` gate treats
+spill-in-flight pages as unavailable until landed, the router's
+prefix-affinity prefetch hint warms a replica's host tier end-to-end,
+and a tierless (or never-spilling) config keeps PR-19's compiled
+shapes, host-sync count and gauge set exactly."""
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.serving.engine as engine_mod
+from paddle_tpu.core import monitor
+from paddle_tpu.serving import (KVPagePool, ServingConfig, ServingEngine)
+from paddle_tpu.serving.host_tier import HostTier
+from paddle_tpu.serving.request_trace import load_trace, reconstruct
+
+MODEL_KW = dict(vocab_size=128, hidden_size=64, num_layers=2,
+                num_heads=2, max_seq_len=160, hidden_dropout=0.0,
+                attn_dropout=0.0, use_flash_attention=False)
+
+
+@pytest.fixture(scope='module')
+def tiny_lm():
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    paddle.seed(7)
+    m = GPTForCausalLM(GPTConfig(**MODEL_KW))
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope='module')
+def prompts():
+    rng = np.random.RandomState(3)
+    return [list(rng.randint(1, 128, n)) for n in (5, 11, 3, 8)]
+
+
+def _pool(num_pages=8, page_size=4, host_pages=8, dtype=None, **tier_kw):
+    pool = KVPagePool(num_pages=num_pages, page_size=page_size,
+                      num_layers=2, num_heads=2, head_dim=4,
+                      dtype=dtype, prefix_cache=True)
+    pool.materialize()
+    pool.attach_host_tier(HostTier(host_pages, **tier_kw))
+    return pool
+
+
+def _fill_random(pool, seed=0):
+    """Give every pool row distinguishable contents so round trips
+    can be checked bit-for-bit."""
+    import jax.numpy as jnp
+    rng = np.random.RandomState(seed)
+    kv = []
+    for layer in pool.kv:
+        bufs = []
+        for b in layer:
+            if np.dtype(b.dtype) == np.int8:
+                a = rng.randint(-128, 128, size=b.shape).astype(np.int8)
+            else:
+                a = rng.rand(*b.shape).astype(b.dtype)
+            bufs.append(jnp.asarray(a))
+        kv.append(tuple(bufs))
+    pool.kv = kv
+
+
+def _rows(pool, pages):
+    """Snapshot the given page rows of every layer buffer as numpy."""
+    return [[np.asarray(b)[list(pages)] for b in layer]
+            for layer in pool.kv]
+
+
+def _park_chain(pool, seq, toks):
+    """Prefill-register a chain and release it into the cached set."""
+    pool.ensure_capacity(seq, len(toks))
+    pool.register_prefix(seq, toks, written=len(toks))
+    pool.release(seq)
+
+
+def _partition_ok(pool):
+    """free + cached + mapped + spill-pinned partitions the pool."""
+    return (len(pool._free) + len(pool._cached) + len(pool._ref)
+            + len(pool._spilling) == pool.num_pages)
+
+
+# ---------------------------------------------------------------------------
+# allocator invariants across tiers
+# ---------------------------------------------------------------------------
+class TestTierAllocator:
+    def test_exact_once_release_across_tiers(self):
+        pool = _pool(num_pages=8, page_size=4)
+        _fill_random(pool)
+        toks = list(range(10, 22))                 # 3 pages
+        _park_chain(pool, 'a', toks)
+        assert _partition_ok(pool) and len(pool._cached) == 3
+        assert pool.spill_lru(sync=True) == 3
+        # markers index the chain; no device page holds it anymore
+        assert pool.host_resident_pages() == 3
+        assert pool.free_pages == 8 and _partition_ok(pool)
+        assert pool.host_tier.used_slots == 3
+        # resurrect maps the chain into 'b' -- each page exactly once
+        assert pool.match_and_map('b', toks, limit=11) == 8
+        assert pool.host_tier.used_slots == 1      # tail page stays
+        assert pool.pages_in_use == 2 and _partition_ok(pool)
+        assert pool.release('b') == 2
+        assert pool.free_pages == 8 and _partition_ok(pool)
+        # nothing double-freed, nothing leaked: a full reset returns
+        # every slot on both tiers
+        pool.reset()
+        assert pool.host_tier.used_slots == 0
+        assert pool.free_pages == 8 and _partition_ok(pool)
+
+    def test_cow_refcount_survives_spill_resurrect(self):
+        pool = _pool(num_pages=8, page_size=4)
+        _fill_random(pool)
+        toks = list(range(30, 38))                 # 2 pages
+        _park_chain(pool, 'a', toks)
+        assert pool.spill_lru(sync=True) == 2
+        # two sequences share the resurrected pages copy-on-write
+        assert pool.match_and_map('b', toks + [1], limit=8) == 8
+        pages = list(pool.page_table('b'))
+        assert pool.match_and_map('c', toks + [2], limit=8) == 8
+        assert list(pool.page_table('c')) == pages
+        assert all(pool._ref[p] == 2 for p in pages)
+        # releases decrement; the second one parks the pages cached
+        pool.release('b')
+        assert all(pool._ref[p] == 1 for p in pages)
+        pool.release('c')
+        assert all(p in pool._cached for p in pages)
+        assert _partition_ok(pool)
+
+    @pytest.mark.parametrize('dtype', [None, 'int8'])
+    def test_round_trip_bit_identical(self, dtype):
+        # fp32 pages AND int8 pages with their fp32 scale siblings
+        # come back from the host tier bit-for-bit (the page_stream
+        # contract: rows move as stored, nothing re-quantizes)
+        pool = _pool(num_pages=8, page_size=4, dtype=dtype,
+                     chunk_pages=2)                # exercise chunking
+        if dtype == 'int8':
+            assert pool.quantized and len(pool.kv[0]) == 4
+        _fill_random(pool, seed=3)
+        toks = list(range(50, 62))                 # 3 pages
+        _park_chain(pool, 'a', toks)
+        before = _rows(pool, pool._match_pages(toks))
+        assert pool.spill_lru(sync=True) == 3
+        assert pool.match_and_map('b', toks, limit=11) == 8
+        after = _rows(pool, pool.page_table('b'))
+        for lb, la in zip(before, after):
+            for bb, ba in zip(lb, la):
+                assert bb.dtype == ba.dtype
+                np.testing.assert_array_equal(bb[:2], ba)
+
+    def test_lru_subtree_spill_ordering(self):
+        pool = _pool(num_pages=8, page_size=4, host_pages=4)
+        _fill_random(pool)
+        a_toks = list(range(10, 18))               # 2 pages, oldest
+        b_toks = list(range(40, 48))               # 2 pages, newest
+        _park_chain(pool, 'a', a_toks)
+        _park_chain(pool, 'b', b_toks)
+        # bounded spill takes the LRU subtree (a), not the newest
+        assert pool.spill_lru(max_pages=1, sync=True) == 2
+        assert all(m <= -2 for m in pool._match_pages(a_toks))
+        assert all(p >= 0 for p in pool._match_pages(b_toks))
+        # next round takes b; a 4-slot tier is now full, so further
+        # pressure falls back to eviction instead of spilling
+        _park_chain(pool, 'c', list(range(70, 78)))
+        assert pool.spill_lru(max_pages=1, sync=True) == 2
+        assert all(m <= -2 for m in pool._match_pages(b_toks))
+        assert pool.spill_lru(sync=True) == 0      # tier full
+        assert pool.host_tier.free_slots == 0
+
+    def test_try_reserve_sees_inflight_spill_as_unavailable(self):
+        # the fused-decode reservation gate (PR-19) must not hand out
+        # pages whose device->host transfer is still in flight
+        pool = _pool(num_pages=4, page_size=4, window=2)
+        _fill_random(pool)
+        _park_chain(pool, 'a', list(range(10, 26)))    # all 4 pages
+        gate = threading.Event()
+        tier = pool.host_tier
+        real_land = tier._land
+
+        def gated_land(staged, spans, slots):
+            gate.wait(10)
+            real_land(staged, spans, slots)
+        tier._land = gated_land
+        try:
+            assert pool.spill_lru(sync=False) == 4
+            # pinned: not free, not cached, not reservable
+            assert pool.free_pages == 0
+            assert len(pool._spilling) == 4 and _partition_ok(pool)
+            assert not pool.try_reserve('x', 4)
+            gate.set()
+            tier.drain()
+            for _ in range(500):
+                if pool.free_pages == 4:
+                    break
+                threading.Event().wait(0.01)
+            assert pool.free_pages == 4 and not pool._spilling
+            assert pool.try_reserve('x', 4)
+        finally:
+            tier._land = real_land
+            tier.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# engine: preempt -> spill -> resume token identity; trace + ledger
+# ---------------------------------------------------------------------------
+class TestTieredEngine:
+    def test_preempt_spill_resume_token_identity(self, tiny_lm,
+                                                 prompts):
+        ref_eng = ServingEngine(tiny_lm, ServingConfig(
+            page_size=8, max_batch_size=3, prefill_chunk=8, seed=11))
+        ref = ref_eng.generate(prompts, max_new_tokens=8, top_k=0)
+        ref_eng.shutdown()
+        # 5 pages cannot hold the concurrent contexts: the scheduler
+        # preempts, released pages spill to host under the aggressive
+        # watermark, and resumes resurrect -- outputs must not change
+        eng = ServingEngine(tiny_lm, ServingConfig(
+            page_size=8, max_batch_size=3, prefill_chunk=8, seed=11,
+            num_pages=5, host_tier_pages=16, spill_watermark=0.5))
+        outs = eng.generate(prompts, max_new_tokens=8, top_k=0)
+        assert outs == ref
+        st = eng.stats()
+        ps = st['pool']
+        assert st['preemptions_total'] > 0
+        assert ps['tier_spilled_pages_total'] > 0
+        assert eng.pool.pages_in_use == 0
+        eng.shutdown()
+
+    def test_resurrect_skips_prefill_and_lands_in_trace(self, tiny_lm,
+                                                        tmp_path):
+        eng = ServingEngine(tiny_lm, ServingConfig(
+            page_size=8, max_batch_size=2, prefill_chunk=8, seed=11,
+            host_tier_pages=16))
+        prompt = list(range(1, 21))                # 2 full pages
+        base = eng.generate([prompt], max_new_tokens=6, top_k=0)
+        assert eng.pool.spill_lru(sync=True) >= 2
+        outs = eng.generate([prompt], max_new_tokens=6, top_k=0)
+        assert outs == base                        # resurrected, not
+        ps = eng.pool.stats()                      # re-prefilled
+        assert ps['tier_resurrected_pages_total'] >= 2
+        assert ps['tier_resurrected_tokens_total'] >= 16
+        assert ps['tier_fetched_pages_total'] >= 2
+        assert ps['tier_fetched_bytes_total'] > 0
+        # trace schema v6: engine-scope spill + per-request resurrect
+        paths = eng.export_trace(jsonl_path=str(tmp_path / 't.jsonl'))
+        header, events = load_trace(paths['jsonl'])
+        assert header['schema'] == 'paddle_tpu.serve_trace/6'
+        spills = [e for e in events if e['event'] == 'spill']
+        assert spills and all(e['req'] == -1 for e in spills)
+        res = [e for e in events if e['event'] == 'resurrect']
+        assert res and res[0]['pages'] >= 2
+        table = reconstruct(events)
+        assert sum(r['resurrected_tokens']
+                   for r in table.values()) >= 16
+        assert sum(r['resurrected_pages']
+                   for r in table.values()) >= 2
+        # ledger ordered-clamp identity holds with the page_stream
+        # component carrying the transfer wall
+        a = eng.ledger.account()
+        assert a['components']['page_stream'] > 0
+        assert sum(a['components'].values()) \
+            == pytest.approx(a['wall_seconds'])
+        eng.shutdown()
+
+    def test_no_spill_config_is_inert(self, tiny_lm, prompts,
+                                      monkeypatch):
+        # a tierless engine and a tier-enabled engine that never
+        # spills must match PR-19 exactly: same compiled step shapes,
+        # same host-sync count, zero transfers; the tierless gauge
+        # set carries no tier series at all
+        counts = [0]
+        real = engine_mod._host_fetch
+
+        def counting(x):
+            counts[0] += 1
+            return real(x)
+        monkeypatch.setattr(engine_mod, '_host_fetch', counting)
+        runs = {}
+        for name, kw in (('plain', {}),
+                         ('tiered', dict(host_tier_pages=32))):
+            counts[0] = 0
+            eng = ServingEngine(tiny_lm, ServingConfig(
+                page_size=8, max_batch_size=4, prefill_chunk=8,
+                seed=11, **kw))
+            outs = eng.generate(prompts, max_new_tokens=8, top_k=0)
+            runs[name] = (outs, counts[0],
+                          sorted(map(str, eng._step_fns.keys())),
+                          dict(eng.pool.stats()))
+            eng.shutdown()
+        (o1, n1, shapes1, ps1), (o2, n2, shapes2, ps2) = \
+            runs['plain'], runs['tiered']
+        assert o1 == o2
+        assert n1 == n2                    # zero extra host syncs
+        assert shapes1 == shapes2          # same compiled shapes
+        assert 'tier_host_pages' not in ps1
+        assert ps2['tier_spilled_pages_total'] == 0
+        assert ps2['tier_fetched_pages_total'] == 0
+        assert ps2['tier_host_used_pages'] == 0
+
+    def test_tierless_gauge_set_matches_pr19(self, tiny_lm):
+        monitor.metrics().reset()
+        eng = ServingEngine(tiny_lm, ServingConfig(
+            page_size=8, max_batch_size=2, prefill_chunk=8))
+        eng.generate([[1, 2, 3]], max_new_tokens=4, top_k=0)
+        eng.publish_metrics()
+        from paddle_tpu.serving.metrics import (scalar_series,
+                                                serve_snapshot)
+        snap = serve_snapshot()
+        assert snap and not any('tier' in k for k in snap)
+        assert not any('tier' in m.name
+                       for m in monitor.metrics().metrics_list())
+        assert not any('tier' in k
+                       for k in scalar_series(eng.stats()))
+        eng.shutdown()
+
+    def test_spill_pressure_feeds_degrade_ladder(self):
+        from paddle_tpu.serving.scheduler import DegradeLadder
+        lad = DegradeLadder(window=2)
+        # spill pressure alone (tier nearly full) can drive the
+        # signal even when the device pool looks healthy
+        p = lad.pressure_of(0.2, 0, 4, spill=0.95)
+        assert p == pytest.approx(0.95)
+        assert lad.pressure_of(0.2, 0, 4) == pytest.approx(0.2)
+
+
+# ---------------------------------------------------------------------------
+# cluster: router prefetch hint warms the replica's host tier
+# ---------------------------------------------------------------------------
+class TestClusterPrefetchHint:
+    def test_router_hint_warms_host_tier_e2e(self, tiny_lm):
+        from paddle_tpu.serving.cluster import (ClusterRouter,
+                                                LocalReplica)
+        kw = dict(page_size=8, max_batch_size=3, prefill_chunk=16,
+                  host_tier_pages=16, seed=11)
+        reps = [LocalReplica(
+            ServingEngine(tiny_lm, ServingConfig(**kw)), rid)
+            for rid in ('r0', 'r1')]
+        router = ClusterRouter(reps, page_size=8, max_queue=32)
+        shared = list(range(1, 20))                # 2+ pages shared
+        prompts = [shared + [50 + i] for i in range(4)]
+        outs = router.serve(prompts, max_new_tokens=4, top_k=0)
+        # everything parked spills to host on both replicas
+        for r in reps:
+            r.engine.pool.spill_lru(sync=True)
+        resurrected0 = [r.engine.pool.stats()
+                        ['tier_resurrected_pages_total'] for r in reps]
+        outs2 = router.serve(prompts, max_new_tokens=4, top_k=0)
+        assert outs2 == outs
+        snap = router.snapshot()
+        assert snap['placements']['prefetch_hint'] > 0
+        assert snap['prefetch_warmed_pages'] > 0
+        # the hint resurrected pages on the affinity replica BEFORE
+        # its requests arrived (warm_prefix parks them cached)
+        warmed = [r.engine.pool.stats()
+                  ['tier_resurrected_pages_total'] - b
+                  for r, b in zip(reps, resurrected0)]
+        assert sum(warmed) >= snap['prefetch_warmed_pages'] > 0
+        from paddle_tpu.serving.cluster.router import cluster_snapshot
+        cs = cluster_snapshot()
+        assert cs.get('ptpu_route_prefetch_hints_total', 0) > 0
+        router.shutdown()
+
+    def test_hint_is_advisory_on_tierless_replica(self, tiny_lm):
+        from paddle_tpu.serving.cluster import (ClusterRouter,
+                                                LocalReplica)
+        kw = dict(page_size=8, max_batch_size=3, prefill_chunk=16,
+                  seed=11)
+        reps = [LocalReplica(
+            ServingEngine(tiny_lm, ServingConfig(**kw)), rid)
+            for rid in ('r0', 'r1')]
+        router = ClusterRouter(reps, page_size=8, max_queue=32)
+        shared = list(range(1, 20))
+        prompts = [shared + [50 + i] for i in range(3)]
+        outs = router.serve(prompts, max_new_tokens=4, top_k=0)
+        outs2 = router.serve(prompts, max_new_tokens=4, top_k=0)
+        assert outs2 == outs
+        snap = router.snapshot()
+        # hints fire on affinity placements but warm nothing -- and
+        # nothing breaks
+        assert snap['prefetch_warmed_pages'] == 0
+        assert reps[0].prefetch(shared) == {'warmed_pages': 0}
+        router.shutdown()

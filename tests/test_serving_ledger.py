@@ -287,7 +287,7 @@ class TestEngineGoodput:
             p = os.path.join(td, 'serve.jsonl')
             eng.export_trace(jsonl_path=p)
             header, events = load_trace(p)
-        assert header['schema'] == 'paddle_tpu.serve_trace/5'
+        assert header['schema'] == 'paddle_tpu.serve_trace/6'
         table = reconstruct(events)
         assert sum(r['delivered_tokens'] for r in table.values()) \
             == g['delivered_tokens']
@@ -462,10 +462,10 @@ class TestClusterDrainGoodput:
 
 
 # ---------------------------------------------------------------------------
-# trace schema v4: old schemas still load
+# trace schema v6: old schemas still load
 # ---------------------------------------------------------------------------
 class TestSchemaCompat:
-    @pytest.mark.parametrize('version', [1, 2, 3, 4])
+    @pytest.mark.parametrize('version', [1, 2, 3, 4, 5])
     def test_older_schemas_still_load(self, version, tmp_path):
         import json
         from paddle_tpu.serving.request_trace import (load_trace,

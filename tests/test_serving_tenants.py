@@ -731,7 +731,7 @@ class TestTenantTraceExport:
         path = str(tmp_path / 'tenants.jsonl')
         eng.export_trace(jsonl_path=path)
         header, events = load_trace(path)
-        assert header['schema'] == 'paddle_tpu.serve_trace/5'
+        assert header['schema'] == 'paddle_tpu.serve_trace/6'
         table = reconstruct(events)
         assert table[reqs[2].id]['tenant_id'] == 'gold'
         assert table[reqs[2].id]['priority'] == 2

@@ -194,7 +194,7 @@ class TestRequestTracing:
         prof.use_native_recorder(True)
 
         header, events = load_trace(paths['jsonl'])
-        assert header['schema'] == 'paddle_tpu.serve_trace/5'
+        assert header['schema'] == 'paddle_tpu.serve_trace/6'
         assert header['dropped_events'] == 0
         # JSON round trip preserves the reconstruction bit-for-bit
         assert reconstruct(events) == eng.request_table()
@@ -316,7 +316,7 @@ class TestStalledWatchdog:
         report = eng.last_serve_report
         assert report is not None
         assert report['kind'] == 'serve_report'
-        assert report['schema'] == 'paddle_tpu.serve_trace/5'
+        assert report['schema'] == 'paddle_tpu.serve_trace/6'
         assert report['request']['req'] == req.id
         assert report['request']['age_s'] > 5.0
         assert report['request']['deadline_s'] == 5.0

@@ -61,6 +61,9 @@ METRIC_DIRECTION = {
     'small_batch_decode_tokens_per_sec': 'higher',
     'small_batch_host_bound_fraction': 'lower',
     'fused_speedup_vs_per_token': 'higher',
+    # tiered KV cache (ISSUE 20): oversubscribed serving headline
+    'oversubscribed_decode_tokens_per_sec': 'higher',
+    'resurrect_ttft_speedup': 'higher',
 }
 DEFAULT_THRESHOLD = 0.02
 HEADLINE_LEG = 'gpt1.3b_adamw'

@@ -606,6 +606,27 @@ def render_serve(s):
             f"{int(v('fused_k')) or 1}), "
             f"{int(v('fused_tokens_total'))} tokens — "
             f"one host fetch per window")
+    # tiered KV cache (ISSUE 20): host-RAM spill tier under the paged
+    # pool — rendered only when the engine attached a host tier, so
+    # tierless dumps are unchanged
+    if 'ptpu_serve_tier_host_pages' in s:
+        out.append(
+            f"  host KV tier: {int(v('tier_host_used_pages'))}/"
+            f"{int(v('tier_host_pages'))} host pages used, "
+            f"{int(v('tier_resident_pages'))} resident in the radix "
+            f"chain, {int(v('tier_spill_inflight_pages'))} spill "
+            f"in flight")
+        sp, fp = int(v('tier_spilled_pages_total')), \
+            int(v('tier_fetched_pages_total'))
+        if sp or fp:
+            out.append(
+                f"  tier transfers: {sp} pages "
+                f"({_fmt_bytes(v('tier_spilled_bytes_total'))}) "
+                f"spilled, {fp} pages "
+                f"({_fmt_bytes(v('tier_fetched_bytes_total'))}) "
+                f"fetched back; {int(v('tier_resurrected_pages_total'))} "
+                f"pages / {int(v('tier_resurrected_tokens_total'))} "
+                f"tokens resurrected instead of re-prefilled")
     # SLO percentile section (bucket-interpolated p50/p90/p99 from the
     # ptpu_serve_* histograms — docs/serving.md#slo-metrics)
     slo_rows = []
@@ -762,6 +783,37 @@ def _serve_selftest():
     text2 = render_serve(serve2)
     assert 'fused decode:' in text2 and 'one host fetch' in text2, text2
     eng2.shutdown()
+
+    # -- tiered KV cache (ISSUE 20): spill a finished request's pages
+    # to the host tier, resurrect them on the repeat prompt, and assert
+    # the tier gauges/counters reach the snapshot and the renderer
+    # draws the host-tier lines. Also: the tierless engines above must
+    # NOT have published tier gauges (checked on serve2's keys)
+    assert not any('tier' in k for k in serve2), serve2.keys()
+    eng3 = ServingEngine(model, ServingConfig(page_size=8,
+                                              max_batch_size=2,
+                                              prefill_chunk=8,
+                                              host_tier_pages=16))
+    long_prompt = list(rng.randint(1, 64, 17))
+    out_a = eng3.generate([long_prompt], max_new_tokens=4, top_k=0)
+    spilled = eng3.pool.spill_lru(sync=True)
+    assert spilled >= 2, spilled
+    out_b = eng3.generate([long_prompt], max_new_tokens=4, top_k=0)
+    assert out_a == out_b, (out_a, out_b)
+    st3 = eng3.pool.stats()
+    assert st3['tier_spilled_pages_total'] >= 2, st3
+    assert st3['tier_resurrected_pages_total'] >= 2, st3
+    snap3 = StepTelemetry(publish=False).snapshot()
+    serve3 = _find_serve({'telemetry': {'serve': snap3['serve']}})
+    assert serve3['ptpu_serve_tier_host_pages'] == 16, serve3
+    assert serve3['ptpu_serve_tier_spilled_pages_total'] >= 2, serve3
+    assert serve3['ptpu_serve_tier_fetched_pages_total'] >= 2, serve3
+    assert serve3['ptpu_serve_tier_resurrected_tokens_total'] >= 16, \
+        serve3
+    text3 = render_serve(serve3)
+    assert 'host KV tier:' in text3 and 'tier transfers:' in text3, text3
+    assert 'resurrected instead of re-prefilled' in text3, text3
+    eng3.shutdown()
 
     # -- stalled-request watchdog: deterministic clock, a request aged
     # past the deadline produces a serve_report that classifies/renders

@@ -82,6 +82,16 @@
 #                      per-iteration timeline/ledger attribution,
 #                      wall-clock publish cadence, trace-v5 roundtrip,
 #                      mp2 sharded identity, then the serve CLI smoke
+#   --kvtier-selftest - tiered KV cache (ISSUE 20): host-RAM spill
+#                      tier allocator invariants (exactly-once release
+#                      across tiers, COW + int8 scale siblings bit-
+#                      identical over spill/resurrect, LRU subtree
+#                      ordering), preempt->spill->resume token
+#                      identity, fused try_reserve vs in-flight spill
+#                      pins, router prefetch-hint warming a replica's
+#                      host tier end-to-end, no-spill configs keeping
+#                      PR-19 shapes/syncs/gauges, then the serve CLI
+#                      smoke (renders the host-tier lines)
 #   --alerts-selftest - telemetry time axis (ISSUE 18): history-ring
 #                      sampling/wraparound + derived views on injected
 #                      clocks, alert state machine fire -> sustain ->
@@ -101,7 +111,7 @@ case "$TIER" in
             tests/test_fused_primitives.py tests/test_overlap.py \
             tests/test_serving.py tests/test_serving_trace.py \
             tests/test_serving_cluster.py tests/test_serving_tenants.py \
-            tests/test_serving_fused.py \
+            tests/test_serving_fused.py tests/test_serving_kvtier.py \
             tests/test_remat.py \
             tests/test_async_step.py tests/test_pipeline_schedule.py \
             tests/test_ledger.py tests/test_monitor.py \
@@ -247,6 +257,15 @@ case "$TIER" in
           python -m pytest tests/test_serving_fused.py \
             tests/test_metrics_docs.py -q
           python tools/health_dump.py serve --selftest ;;
+  --kvtier-selftest)
+          # tiered KV cache end to end (ISSUE 20): cross-tier
+          # allocator invariants, spill/resurrect token identity,
+          # in-flight pins vs fused reservations, cluster prefetch
+          # hints, tierless-inertness guards, then the serve-gauge
+          # CLI smoke (renders the host-tier section)
+          python -m pytest tests/test_serving_kvtier.py \
+            tests/test_metrics_docs.py -q
+          python tools/health_dump.py serve --selftest ;;
   --alerts-selftest)
           # the telemetry time axis end to end (ISSUE 18): history-
           # ring + derived-view units, alert state-machine legs on
@@ -272,5 +291,5 @@ case "$TIER" in
           python tools/health_dump.py ledger --selftest
           python tools/health_dump.py alerts --selftest
           python tools/bench_compare.py --selftest ;;
-  *) echo "usage: $0 [fast|dist|native|e2e|all|--comm-selftest|--serve-selftest|--quant-selftest|--pallas-selftest|--overlap-selftest|--cluster-selftest|--remat-selftest|--async-selftest|--pp-selftest|--tenant-selftest|--ledger-selftest|--serve-ledger-selftest|--alerts-selftest|--fused-selftest]"; exit 1 ;;
+  *) echo "usage: $0 [fast|dist|native|e2e|all|--comm-selftest|--serve-selftest|--quant-selftest|--pallas-selftest|--overlap-selftest|--cluster-selftest|--remat-selftest|--async-selftest|--pp-selftest|--tenant-selftest|--ledger-selftest|--serve-ledger-selftest|--alerts-selftest|--fused-selftest|--kvtier-selftest]"; exit 1 ;;
 esac

@@ -108,7 +108,7 @@ def render(summary):
 
 
 # ---------------------------------------------------------------------------
-# serving request traces (JSON-lines, paddle_tpu.serve_trace/1 – /4)
+# serving request traces (JSON-lines, paddle_tpu.serve_trace/1 – /6)
 # ---------------------------------------------------------------------------
 def summarize_serve(paths):
     """Per-request table + cross-request SLO percentiles from one or
@@ -211,7 +211,12 @@ def render_serve(s):
     # (schema v2 route events / merged per-replica files)
     routed = any(r.get('replica_id') is not None for r in rows)
     tenanted = any(r.get('tenant_id') is not None for r in rows)
-    extra_hdr = (f" {'tenant':>8} {'prio':>4}" if tenanted else '') \
+    # host-tier resurrects (schema v6, ISSUE 20): the column renders
+    # only when some request resurrected, so v1-v5 tables are
+    # byte-identical to before
+    tiered = any(r.get('resurrected_tokens', 0) for r in rows)
+    extra_hdr = (f" {'resurr':>6}" if tiered else '') \
+        + (f" {'tenant':>8} {'prio':>4}" if tenanted else '') \
         + (f" {'replica':>8} {'routed':>12}" if routed else '')
     out.append(f"{'req':>8} {'state':<9} {'prompt':>6} {'gen':>5} "
                f"{'queue_ms':>9} {'ttft_ms':>9} {'tpot_ms':>9} "
@@ -221,8 +226,10 @@ def render_serve(s):
     for r in rows:
         prop = r.get('spec_proposed', 0)
         spec = (f"{r.get('spec_accepted', 0)}/{prop}" if prop else '-')
-        extra = (f" {str(r.get('tenant_id') or '-'):>8} "
-                 f"{r.get('priority', 0):>4}" if tenanted else '') \
+        extra = (f" {r.get('resurrected_tokens', 0):>6}"
+                 if tiered else '') \
+            + (f" {str(r.get('tenant_id') or '-'):>8} "
+               f"{r.get('priority', 0):>4}" if tenanted else '') \
             + (f" {str(r.get('replica_id') or '-'):>8} "
                f"{str(r.get('router_decision') or '-'):>12}"
                if routed else '')
@@ -252,6 +259,16 @@ def render_serve(s):
             out.append('')
         out.append(f"speculative decode: {acc}/{prop} draft tokens "
                    f"accepted ({100.0 * acc / prop:.1f}% acceptance)")
+    # host-tier resurrect aggregate (schema v6, ISSUE 20): prompt
+    # tokens restored from spilled host pages instead of re-prefilled
+    res_tok = sum(r.get('resurrected_tokens', 0) for r in rows)
+    res_pages = sum(r.get('resurrected_pages', 0) for r in rows)
+    if res_tok:
+        if not cached and not prop:
+            out.append('')
+        out.append(f"host tier: {res_tok}/{prompt} prompt tokens "
+                   f"resurrected from spilled pages "
+                   f"({res_pages} pages fetched)")
     # goodput aggregate (schema v4, ISSUE 17) — only rendered once any
     # request priced waste, so v1-v3 tables look exactly as before
     gp = s.get('goodput') or {}
